@@ -1,0 +1,183 @@
+"""Observatory clock-correction chains.
+
+Reads TEMPO (``time.dat``-style) and TEMPO2 (``.clk``) clock files and
+evaluates piecewise-linear corrections, mirroring the reference's ClockFile
+(observatory/clock_file.py:23,434,553) including validity-limit behavior
+("warn" past the last entry).
+
+Discovery: the IPTA clock repository cannot be auto-downloaded here (the
+reference fetches it at runtime, global_clock_corrections.py:39); instead the
+chain searches ``PINT_CLOCK_OVERRIDE`` (a directory of clock files, same
+semantics as the reference's env override), then any directories given
+programmatically. With no files found, corrections are zero with a one-time
+warning — the same degraded mode the reference enters when downloads fail.
+
+The full chain for a topocentric TOA is
+  site clock -> UTC(obs) -> UTC(GPS) -> UTC  (per-site files)
+  UTC -> TT(TAI) -> TT(BIPMyyyy)             (gps + bipm files, optional)
+matching reference observatory/__init__.py:207-223.
+"""
+
+from __future__ import annotations
+
+import os
+from bisect import bisect_right
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from pint_tpu.utils.logging import get_logger
+
+log = get_logger("pint_tpu.clock")
+
+
+@dataclass
+class ClockFile:
+    """Piecewise-linear clock correction table: MJD -> seconds to ADD."""
+
+    mjd: np.ndarray
+    corr_s: np.ndarray
+    name: str = ""
+    valid_beyond: str = "warn"  # "warn" | "error" | "extrapolate"
+
+    def evaluate(self, mjd: np.ndarray) -> np.ndarray:
+        mjd = np.asarray(mjd, np.float64)
+        if len(self.mjd) == 0:
+            return np.zeros_like(mjd)
+        late = mjd > self.mjd[-1] + 1e-9
+        if np.any(late):
+            msg = f"clock file {self.name}: {late.sum()} TOAs beyond last entry MJD {self.mjd[-1]:.1f}"
+            if self.valid_beyond == "error":
+                raise ValueError(msg)
+            log.warning(msg)
+        return np.interp(mjd, self.mjd, self.corr_s)
+
+    @classmethod
+    def read_tempo2(cls, path: str) -> "ClockFile":
+        """TEMPO2 .clk: header line '<from> <to> <flags>', then 'mjd corr' rows."""
+        mjds, corrs = [], []
+        with open(path) as f:
+            header = f.readline()
+            for line in f:
+                line = line.split("#")[0].strip()
+                if not line:
+                    continue
+                parts = line.split()
+                try:
+                    m, c = float(parts[0]), float(parts[1])
+                except (ValueError, IndexError):
+                    continue
+                mjds.append(m)
+                corrs.append(c)
+        del header
+        return cls(np.asarray(mjds), np.asarray(corrs), name=os.path.basename(path))
+
+    @classmethod
+    def read_tempo(cls, path: str, site: str | None = None) -> "ClockFile":
+        """TEMPO time.dat: fixed columns 'mjd offset(us) ... site-code'.
+
+        Rows: MJD, clock offset in microseconds (col 2), optional second
+        offset, station code. When ``site`` given, keep matching rows only.
+        """
+        mjds, corrs = [], []
+        with open(path) as f:
+            for line in f:
+                if line.startswith(("#", "C ", "*")) or not line.strip():
+                    continue
+                parts = line.split()
+                try:
+                    m = float(parts[0])
+                    c = float(parts[1]) * 1e-6
+                except (ValueError, IndexError):
+                    continue
+                code = parts[-1] if len(parts) > 2 and not _isfloat(parts[-1]) else None
+                if site and code and code.lower() != site.lower():
+                    continue
+                mjds.append(m)
+                corrs.append(c)
+        return cls(np.asarray(mjds), np.asarray(corrs), name=os.path.basename(path))
+
+
+def _find_first(alternatives: list[str], obs_name: str) -> ClockFile | None:
+    for d in _candidate_dirs():
+        for fname in alternatives:
+            p = os.path.join(d, fname)
+            if os.path.exists(p):
+                try:
+                    if p.endswith(".clk"):
+                        return ClockFile.read_tempo2(p)
+                    return ClockFile.read_tempo(p, site=obs_name)
+                except Exception as e:  # malformed file: warn, keep searching
+                    log.warning(f"failed to read clock file {p}: {e}")
+    return None
+
+
+def _isfloat(s: str) -> bool:
+    try:
+        float(s)
+        return True
+    except ValueError:
+        return False
+
+
+@dataclass
+class ClockChain:
+    """Resolved chain of clock files for one observatory."""
+
+    files: list[ClockFile] = field(default_factory=list)
+
+    def evaluate(self, mjd: np.ndarray) -> np.ndarray:
+        out = np.zeros_like(np.asarray(mjd, np.float64))
+        for cf in self.files:
+            out = out + cf.evaluate(mjd)
+        return out
+
+
+_search_dirs: list[str] = []
+_warned_missing: set[str] = set()
+
+
+def add_clock_search_dir(path: str) -> None:
+    if path not in _search_dirs:
+        _search_dirs.insert(0, path)
+
+
+def _candidate_dirs() -> list[str]:
+    dirs = []
+    override = os.environ.get("PINT_CLOCK_OVERRIDE")
+    if override:
+        dirs.append(override)
+    dirs.extend(_search_dirs)
+    for env in ("TEMPO2", "TEMPO"):
+        base = os.environ.get(env)
+        if base:
+            dirs.append(os.path.join(base, "clock"))
+    return [d for d in dirs if os.path.isdir(d)]
+
+
+def get_clock_chain(obs_name: str, include_gps: bool = True, include_bipm: bool = False, bipm_version: str = "BIPM2019") -> ClockChain:
+    """Assemble the correction chain for a site from discovered files."""
+    chain = ClockChain()
+    # Each "role" in the chain is satisfied by the FIRST file found across the
+    # candidate dirs; alternatives within a role are the two storage formats
+    # of the same correction (never both — that would double-apply it).
+    roles: list[list[str]] = [[f"{obs_name}2gps.clk", f"time_{obs_name}.dat", "time.dat"]]
+    if include_gps:
+        roles.append(["gps2utc.clk"])
+    if include_bipm:
+        roles.append([f"tai2tt_{bipm_version.lower()}.clk"])
+    found = False
+    for role in roles:
+        cf = _find_first(role, obs_name)
+        if cf is not None:
+            chain.files.append(cf)
+            if role is roles[0]:
+                found = True
+    if not found and obs_name not in _warned_missing:
+        _warned_missing.add(obs_name)
+        log.warning(
+            f"no clock files found for {obs_name!r} (searched {_candidate_dirs() or 'nothing'}); "
+            "using zero clock corrections. Set PINT_CLOCK_OVERRIDE to a directory of "
+            ".clk/time.dat files for real corrections."
+        )
+    return chain

@@ -1,0 +1,349 @@
+"""TOA loading and preparation: .tim -> clock chain -> TDB -> solar-system
+geometry -> the dense device "TOA tensor".
+
+This is the reference's L2 pipeline (toa.py:104 get_TOAs -> 2141
+apply_clock_corrections -> 2219 compute_TDBs -> 2291 compute_posvels)
+re-architected for a host/device split: every step is once-per-dataset numpy
+work; the output of `TOAs.tensor()` is the single host->device transfer after
+which all timing-model math runs jitted on device (SURVEY.md §2.2 "TPU
+equivalent" note).
+
+Times ride as MJDEpoch (int day + two-double frac). The device tensor stores
+TDB as double-double *seconds since the fixed tensor epoch* (MJD 55000 TDB),
+so any epoch difference downstream is exact in dd arithmetic.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Sequence
+
+import numpy as np
+
+from pint_tpu import AU_LS, C_M_PER_S
+from pint_tpu.astro import clock as clockmod
+from pint_tpu.astro import time as ptime
+from pint_tpu.astro.ephemeris import get_ephemeris
+from pint_tpu.astro.observatories import get_observatory
+from pint_tpu.io.tim import TOALine, parse_tim
+from pint_tpu.utils.logging import get_logger
+
+log = get_logger("pint_tpu.toas")
+
+TENSOR_EPOCH_MJD = 55000  # fixed integer origin for device-side dd seconds
+
+PLANETS = ("jupiter", "saturn", "venus", "uranus", "neptune")
+
+
+@dataclass
+class TOATensor:
+    """Dense device-ready arrays (all numpy here; jnp conversion at use).
+
+    Positions are in light-seconds with ICRS axes; `t_hi + t_lo` is TDB
+    seconds since TENSOR_EPOCH_MJD.
+    """
+
+    t_hi: np.ndarray
+    t_lo: np.ndarray
+    error_s: np.ndarray
+    freq_mhz: np.ndarray
+    mjd_tdb: np.ndarray  # float64 convenience column (mask windows, plotting)
+    ssb_obs_pos_ls: np.ndarray  # (N,3)
+    ssb_obs_vel_ls: np.ndarray  # (N,3)
+    obs_sun_pos_ls: np.ndarray  # (N,3)
+    planet_pos_ls: dict[str, np.ndarray] = field(default_factory=dict)
+    pulse_number: np.ndarray | None = None
+    delta_pulse_number: np.ndarray | None = None
+
+    def __len__(self):
+        return len(self.t_hi)
+
+
+@dataclass
+class TOAs:
+    """Host TOA container (reference TOAs, toa.py:1157), numpy-backed.
+
+    Per-TOA flags stay host-side: mask parameters (JUMP/EFAC/DMX...) are
+    compiled to static index arrays at model-build time.
+    """
+
+    lines: list[TOALine]
+    utc: ptime.MJDEpoch
+    tdb: ptime.MJDEpoch
+    error_us: np.ndarray
+    freq_mhz: np.ndarray
+    obs: np.ndarray  # array of observatory names (str)
+    flags: list[dict[str, str]]
+    ssb_obs_pos_m: np.ndarray
+    ssb_obs_vel_m_s: np.ndarray
+    obs_sun_pos_m: np.ndarray
+    planet_pos_m: dict[str, np.ndarray] = field(default_factory=dict)
+    ephem: str = "analytic"
+    clock_applied: bool = True
+    planets: bool = False
+
+    def __len__(self):
+        return len(self.error_us)
+
+    @property
+    def ntoas(self) -> int:
+        return len(self)
+
+    def first_mjd(self) -> float:
+        return float(self.tdb.mjd_float().min())
+
+    def last_mjd(self) -> float:
+        return float(self.tdb.mjd_float().max())
+
+    def get_flag_values(self, key: str, default: str = "") -> list[str]:
+        return [f.get(key, default) for f in self.flags]
+
+    def get_pulse_numbers(self) -> np.ndarray | None:
+        pns = [f.get("pn") for f in self.flags]
+        if all(p is None for p in pns):
+            return None
+        return np.array([float(p) if p is not None else np.nan for p in pns])
+
+    def select(self, mask: np.ndarray) -> "TOAs":
+        """Boolean-mask subset (reference TOAs.select, toa.py:1852)."""
+        mask = np.asarray(mask)
+        idx = np.flatnonzero(mask)
+        return TOAs(
+            lines=[self.lines[i] for i in idx],
+            utc=ptime.MJDEpoch(self.utc.day[idx], self.utc.frac_hi[idx], self.utc.frac_lo[idx]),
+            tdb=ptime.MJDEpoch(self.tdb.day[idx], self.tdb.frac_hi[idx], self.tdb.frac_lo[idx]),
+            error_us=self.error_us[idx],
+            freq_mhz=self.freq_mhz[idx],
+            obs=self.obs[idx],
+            flags=[self.flags[i] for i in idx],
+            ssb_obs_pos_m=self.ssb_obs_pos_m[idx],
+            ssb_obs_vel_m_s=self.ssb_obs_vel_m_s[idx],
+            obs_sun_pos_m=self.obs_sun_pos_m[idx],
+            planet_pos_m={k: v[idx] for k, v in self.planet_pos_m.items()},
+            ephem=self.ephem,
+            clock_applied=self.clock_applied,
+            planets=self.planets,
+        )
+
+    def tensor(self) -> TOATensor:
+        t_hi, t_lo = self.tdb.seconds_since(TENSOR_EPOCH_MJD)
+        pn = self.get_pulse_numbers()
+        # both -padd (PHASE command) and -phase flags carry pulse offsets
+        # (reference toa.py:829,1924-1926)
+        dpn = np.array(
+            [float(f.get("padd", 0.0)) + float(f.get("phase", 0.0)) for f in self.flags]
+        )
+        return TOATensor(
+            t_hi=t_hi,
+            t_lo=t_lo,
+            error_s=self.error_us * 1e-6,
+            freq_mhz=self.freq_mhz,
+            mjd_tdb=self.tdb.mjd_float(),
+            ssb_obs_pos_ls=self.ssb_obs_pos_m / C_M_PER_S,
+            ssb_obs_vel_ls=self.ssb_obs_vel_m_s / C_M_PER_S,
+            obs_sun_pos_ls=self.obs_sun_pos_m / C_M_PER_S,
+            planet_pos_ls={k: v / C_M_PER_S for k, v in self.planet_pos_m.items()},
+            pulse_number=pn,
+            delta_pulse_number=dpn if np.any(dpn) else None,
+        )
+
+    def summary(self) -> str:
+        span = self.last_mjd() - self.first_mjd()
+        obs_counts = {o: int((self.obs == o).sum()) for o in np.unique(self.obs)}
+        return (
+            f"{len(self)} TOAs, MJD {self.first_mjd():.1f}-{self.last_mjd():.1f} "
+            f"({span / 365.25:.1f} yr), median error {np.median(self.error_us):.2f} us, "
+            f"observatories: {obs_counts}"
+        )
+
+
+def merge_TOAs(toas_list: Sequence[TOAs]) -> TOAs:
+    """Concatenate prepared TOAs sets (reference merge_TOAs, toa.py:2670)."""
+    t0 = toas_list[0]
+    for t in toas_list[1:]:
+        if t.ephem != t0.ephem:
+            raise ValueError(f"cannot merge TOAs with ephems {t0.ephem} vs {t.ephem}")
+    cat = np.concatenate
+    return TOAs(
+        lines=sum((list(t.lines) for t in toas_list), []),
+        utc=ptime.MJDEpoch(
+            cat([t.utc.day for t in toas_list]),
+            cat([t.utc.frac_hi for t in toas_list]),
+            cat([t.utc.frac_lo for t in toas_list]),
+        ),
+        tdb=ptime.MJDEpoch(
+            cat([t.tdb.day for t in toas_list]),
+            cat([t.tdb.frac_hi for t in toas_list]),
+            cat([t.tdb.frac_lo for t in toas_list]),
+        ),
+        error_us=cat([t.error_us for t in toas_list]),
+        freq_mhz=cat([t.freq_mhz for t in toas_list]),
+        obs=cat([t.obs for t in toas_list]),
+        flags=sum((list(t.flags) for t in toas_list), []),
+        ssb_obs_pos_m=cat([t.ssb_obs_pos_m for t in toas_list]),
+        ssb_obs_vel_m_s=cat([t.ssb_obs_vel_m_s for t in toas_list]),
+        obs_sun_pos_m=cat([t.obs_sun_pos_m for t in toas_list]),
+        planet_pos_m={
+            k: cat([t.planet_pos_m[k] for t in toas_list])
+            for k in t0.planet_pos_m
+        },
+        ephem=t0.ephem,
+        clock_applied=all(t.clock_applied for t in toas_list),
+        planets=t0.planets,
+    )
+
+
+def get_TOAs(
+    timfile: str,
+    ephem: str = "auto",
+    planets: bool = False,
+    include_gps: bool = True,
+    include_bipm: bool = False,
+    bipm_version: str = "BIPM2019",
+    model=None,
+) -> TOAs:
+    """One-stop TOA preparation (reference get_TOAs, toa.py:104).
+
+    When `model` is given, EPHEM/PLANET_SHAPIRO/CLOCK directives from the
+    model override the defaults (reference toa.py:188-230 behavior).
+    """
+    if model is not None:
+        ephem = getattr(model, "ephem", None) or ephem
+        planets = planets or bool(getattr(model, "planet_shapiro", False))
+    tf = parse_tim(timfile)
+    return prepare_TOAs(
+        tf.toas,
+        ephem=ephem,
+        planets=planets,
+        include_gps=include_gps,
+        include_bipm=include_bipm,
+        bipm_version=bipm_version,
+    )
+
+
+def prepare_TOAs(
+    lines: list[TOALine],
+    ephem: str = "auto",
+    planets: bool = False,
+    include_gps: bool = True,
+    include_bipm: bool = False,
+    bipm_version: str = "BIPM2019",
+) -> TOAs:
+    n = len(lines)
+    if n == 0:
+        raise ValueError("no TOAs to prepare")
+    utc = ptime.MJDEpoch(
+        np.array([t.mjd_day for t in lines], np.int64),
+        np.array([t.mjd_frac_hi for t in lines]),
+        np.array([t.mjd_frac_lo for t in lines]),
+    )
+    error_us = np.array([t.error_us for t in lines])
+    freq = np.array([t.freq_mhz if t.freq_mhz > 0 else np.inf for t in lines])
+    obs_names = np.array([get_observatory(t.obs).name for t in lines])
+    flags = [dict(t.flags) for t in lines]
+
+    # 1. clock corrections per observatory group (site -> UTC)
+    corr_s = np.zeros(n)
+    for name in np.unique(obs_names):
+        ob = get_observatory(str(name))
+        sel = obs_names == name
+        if ob.is_barycenter or ob.timescale != "utc":
+            continue
+        chain = clockmod.get_clock_chain(
+            str(name), include_gps=include_gps, include_bipm=include_bipm, bipm_version=bipm_version
+        )
+        corr_s[sel] = chain.evaluate(utc.mjd_float()[sel])
+    utc_corr = utc.add_seconds(corr_s)
+
+    # 2. UTC -> TT -> (geocentric) TDB
+    bary = np.array([get_observatory(str(o)).is_barycenter for o in obs_names])
+    tt = ptime.pulsar_mjd_utc_to_tt(utc_corr)
+    tt_jcent = ptime.mjd_tt_julian_centuries(tt)
+
+    # 3. site GCRS posvel (UT1 ~= UTC without EOP data)
+    ut1_mjd = utc_corr.mjd_float()
+    site_pos = np.zeros((n, 3))
+    site_vel = np.zeros((n, 3))
+    for name in np.unique(obs_names):
+        ob = get_observatory(str(name))
+        sel = obs_names == name
+        p, v = ob.site_posvel_gcrs(ut1_mjd[sel], tt_jcent[sel])
+        site_pos[sel] = p
+        site_vel[sel] = v
+
+    # 4. ephemeris: Earth & Sun & planets wrt SSB at (geocentric) TDB
+    eph = get_ephemeris() if ephem in ("auto", "analytic", None) else get_ephemeris(ephem)
+    # TDB for ephemeris lookup: geocentric series is plenty (us-level arg error
+    # moves Earth by < 0.1 mm)
+    tdb_geo = ptime.tt_to_tdb(tt)
+    tdb_jcent = (tdb_geo.mjd_float() - ptime.MJD_J2000) / 36525.0
+    earth_pos, earth_vel = eph.posvel_ssb("earth", tdb_jcent)
+    sun_pos, sun_vel = eph.posvel_ssb("sun", tdb_jcent)
+
+    ssb_obs_pos = earth_pos + site_pos
+    ssb_obs_vel = earth_vel + site_vel
+    # barycentric TOAs: observer is at the SSB
+    ssb_obs_pos[bary] = 0.0
+    ssb_obs_vel[bary] = 0.0
+    obs_sun_pos = sun_pos - ssb_obs_pos
+
+    planet_pos: dict[str, np.ndarray] = {}
+    if planets:
+        for p in PLANETS:
+            ppos, _ = eph.posvel_ssb(p, tdb_jcent)
+            planet_pos[p] = ppos - ssb_obs_pos
+
+    # 5. full TDB including the topocentric (site-dependent) term
+    topo = ptime.topocentric_tdb_correction(earth_vel, site_pos)
+    tdb = ptime.tt_to_tdb(tt, topo)
+    # barycentric TOAs are already TDB at the SSB
+    if np.any(bary):
+        for arr_dst, arr_src in (
+            (tdb.day, utc.day),
+            (tdb.frac_hi, utc.frac_hi),
+            (tdb.frac_lo, utc.frac_lo),
+        ):
+            arr_dst[bary] = arr_src[bary]
+
+    toas = TOAs(
+        lines=list(lines),
+        utc=utc_corr,
+        tdb=tdb,
+        error_us=error_us,
+        freq_mhz=freq,
+        obs=obs_names,
+        flags=flags,
+        ssb_obs_pos_m=ssb_obs_pos,
+        ssb_obs_vel_m_s=ssb_obs_vel,
+        obs_sun_pos_m=obs_sun_pos,
+        planet_pos_m=planet_pos,
+        ephem=getattr(eph, "name", "analytic"),
+        planets=planets,
+    )
+    log.info("prepared TOAs: " + toas.summary())
+    return toas
+
+
+def make_tzr_toa(
+    tzrmjd_day: int,
+    tzrmjd_frac_hi: float,
+    tzrmjd_frac_lo: float,
+    tzrsite: str,
+    tzrfrq_mhz: float,
+    ephem: str = "auto",
+    planets: bool = False,
+) -> TOAs:
+    """Prepare the single fiducial TZR TOA (reference absolute_phase.py
+    get_TZR_toa); runs the identical pipeline so the TZR row can be appended
+    to the TOA tensor and folded into the same jitted phase evaluation."""
+    line = TOALine(
+        name="TZR",
+        freq_mhz=tzrfrq_mhz if tzrfrq_mhz and np.isfinite(tzrfrq_mhz) else 0.0,
+        mjd_day=tzrmjd_day,
+        mjd_frac_hi=tzrmjd_frac_hi,
+        mjd_frac_lo=tzrmjd_frac_lo,
+        error_us=0.0,
+        obs=tzrsite,
+        flags={"tzr": "True"},
+    )
+    return prepare_TOAs([line], ephem=ephem, planets=planets)

@@ -1,0 +1,65 @@
+"""Test configuration: force an 8-device virtual CPU mesh BEFORE jax imports.
+
+Multi-chip sharding (tp/dp/grid axes) is validated on a virtual mesh exactly
+as the driver's dryrun does; the real-TPU path is exercised by bench.py.
+"""
+
+import os
+
+# Force CPU: the session environment pins the TPU platform and pre-imports
+# jax at interpreter startup, so the env var alone is too late — use the
+# config API (valid any time before backend initialization). Tests must run
+# on true-IEEE-f64 CPU with a virtual 8-device mesh.
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+from hypothesis import HealthCheck, settings  # noqa: E402
+
+# JIT compilation inside hypothesis examples is slow on first call; relax deadlines.
+settings.register_profile(
+    "default",
+    deadline=None,
+    suppress_health_check=[HealthCheck.too_slow],
+    max_examples=50,
+)
+settings.register_profile("ci", parent=settings.get_profile("default"), max_examples=200)
+settings.load_profile(os.environ.get("HYPOTHESIS_PROFILE", "default"))
+
+REFERENCE_DATA = "/root/reference/tests/datafile"
+
+
+def have_reference_data() -> bool:
+    return os.path.isdir(REFERENCE_DATA)
+
+
+@pytest.fixture
+def reference_datafile():
+    """Path factory for the reference's public par/tim datasets (read-only).
+
+    Tests that need real NANOGrav-style inputs read them in place from the
+    mounted reference checkout; they skip cleanly when it is absent.
+    """
+    if not have_reference_data():
+        pytest.skip("reference datafile directory not mounted")
+
+    def _path(name: str) -> str:
+        p = os.path.join(REFERENCE_DATA, name)
+        if not os.path.exists(p):
+            pytest.skip(f"reference datafile {name} not present")
+        return p
+
+    return _path
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0x5EED)

@@ -1,0 +1,181 @@
+"""Physics sanity tests for the self-contained astronomy stack (time scales,
+ephemeris, Earth rotation). Golden-number checks use well-known public values
+(leap-second history, J2000 sidereal time, orbital geometry ranges)."""
+
+import numpy as np
+import pytest
+
+from pint_tpu.astro import erot
+from pint_tpu.astro import time as ptime
+from pint_tpu.astro.ephemeris import AnalyticEphemeris
+
+
+def jcent(mjd):
+    return (np.asarray(mjd, float) - 51544.5) / 36525.0
+
+
+class TestTimescales:
+    def test_leap_seconds(self):
+        assert ptime.tai_minus_utc(41317.0)[0] == 10
+        assert ptime.tai_minus_utc(50000.0)[0] == 29
+        assert ptime.tai_minus_utc(53750.0)[0] == 33
+        assert ptime.tai_minus_utc(58000.0)[0] == 37
+        assert ptime.tai_minus_utc(60000.0)[0] == 37
+
+    def test_utc_to_tt_offset(self):
+        ep = ptime.MJDEpoch.from_mjd_float(53750.0)
+        tt = ptime.pulsar_mjd_utc_to_tt(ep)
+        dt_s = (tt.to_longdouble() - ep.to_longdouble()) * 86400.0
+        assert abs(float(dt_s[0]) - (33 + 32.184)) < 1e-9
+
+    def test_tdb_tt_amplitude(self):
+        mjds = np.linspace(50000, 60000, 5000)
+        d = ptime.tdb_minus_tt(jcent(mjds))
+        assert 0.0015 < np.max(np.abs(d)) < 0.0018  # dominant 1.657 ms annual term
+
+    def test_epoch_add_seconds_carries(self):
+        ep = ptime.MJDEpoch.from_mjd_float(53750.999999)
+        ep2 = ep.add_seconds(10.0)
+        assert ep2.day[0] == 53751
+        back = (ep2.to_longdouble() - ep.to_longdouble()) * 86400.0
+        assert abs(float(back[0]) - 10.0) < 1e-9
+
+    def test_seconds_since_exact(self):
+        ep = ptime.MJDEpoch.from_longdouble(np.longdouble("55123.123456789012345"))
+        hi, lo = ep.seconds_since(55000)
+        want = (np.longdouble("55123.123456789012345") - 55000) * np.longdouble(86400)
+        got = np.longdouble(hi[0]) + np.longdouble(lo[0])
+        assert abs(got - want) < 1e-10  # < 0.1 ns
+
+
+class TestEphemeris:
+    eph = AnalyticEphemeris()
+
+    def test_earth_distance_and_speed(self):
+        T = jcent(np.linspace(50000, 60000, 300))
+        pos, vel = self.eph.posvel_ssb("earth", T)
+        r_au = np.linalg.norm(pos, axis=-1) / 1.495978707e11
+        assert np.all((r_au > 0.975) & (r_au < 1.025))
+        v = np.linalg.norm(vel, axis=-1)
+        assert np.all((v > 28.5e3) & (v < 31.0e3))
+
+    def test_sun_near_ssb(self):
+        T = jcent(np.linspace(50000, 60000, 50))
+        pos = self.eph.pos_ssb("sun", T)
+        r = np.linalg.norm(pos, axis=-1)
+        assert np.all(r < 2.5e9)  # within ~3.5 solar radii of the barycenter
+        assert np.any(r > 1e8)  # but not at the origin
+
+    def test_moon_geocentric_distance(self):
+        T = jcent(np.linspace(55000, 55027, 100))
+        e = self.eph.pos_ssb("earth", T)
+        m = self.eph.pos_ssb("moon", T)
+        d = np.linalg.norm(m - e, axis=-1)
+        assert np.all((d > 3.5e8) & (d < 4.1e8))
+
+    def test_earth_orbit_in_equatorial_frame(self):
+        # z-amplitude ~ sin(23.44 deg) ~ 0.398 AU in ICRS equatorial axes
+        T = jcent(np.linspace(55000, 55366, 366))
+        pos = self.eph.pos_ssb("earth", T)
+        zmax = np.max(np.abs(pos[:, 2])) / 1.495978707e11
+        assert 0.36 < zmax < 0.42
+
+    def test_jupiter_distance(self):
+        T = jcent(np.array([55000.0]))
+        r = np.linalg.norm(self.eph.pos_ssb("jupiter", T), axis=-1) / 1.495978707e11
+        assert 4.9 < r[0] < 5.5
+
+    def test_velocity_consistency(self):
+        # velocity from differencing must match finer differencing (smoothness)
+        T = jcent(np.array([56000.0]))
+        _, v1 = self.eph.posvel_ssb("earth", T, dt_s=16.0)
+        _, v2 = self.eph.posvel_ssb("earth", T, dt_s=64.0)
+        assert np.linalg.norm(v1 - v2) < 1e-4  # m/s
+
+
+class TestEarthRotation:
+    def test_era_at_j2000(self):
+        # ERA(J2000 UT1) = 2*pi*0.7790572732640 rad ~ 280.4606 deg
+        got = np.degrees(erot.era(np.array([51544.5])))[0]
+        assert abs(got - 280.46061837504) < 1e-6
+
+    def test_gmst_at_j2000(self):
+        # GMST at J2000.0: 18h 41m 50.548s = 280.4606 deg (well-known value)
+        got = np.degrees(erot.gmst06(np.array([51544.5]), np.array([0.0])))[0] % 360
+        want = (18 + 41 / 60 + 50.54841 / 3600) / 24 * 360
+        assert abs(got - want) < 1e-3
+
+    def test_nutation_magnitude(self):
+        T = np.linspace(-0.3, 0.3, 400)
+        dpsi, deps = erot.nutation(T)
+        assert 16.0 < np.max(np.abs(np.degrees(dpsi) * 3600)) < 19.5
+        assert 8.0 < np.max(np.abs(np.degrees(deps) * 3600)) < 10.5
+
+    def test_itrf_roundtrip_norm(self):
+        itrf = np.array([882589.65, -4924872.32, 3943729.348])  # GBT
+        mjd = np.linspace(55000, 55001, 25)
+        pos, vel = erot.itrf_to_gcrs_posvel(itrf, mjd, jcent(mjd))
+        assert np.allclose(np.linalg.norm(pos, axis=-1), np.linalg.norm(itrf), rtol=1e-12)
+        vmag = np.linalg.norm(vel, axis=-1)
+        r_xy = np.hypot(*_tod_xy(itrf))
+        want_v = erot.OMEGA_EARTH * r_xy
+        assert np.allclose(vmag, want_v, rtol=1e-3)
+
+    def test_obliquity_orientation(self):
+        # A site on the equator stays near the GCRS equator plane (z small)
+        itrf = np.array([6378137.0, 0.0, 0.0])
+        mjd = np.linspace(55000, 55001, 10)
+        pos, _ = erot.itrf_to_gcrs_posvel(itrf, mjd, jcent(mjd))
+        assert np.all(np.abs(pos[:, 2]) < 0.02 * 6378137.0)
+
+
+def _tod_xy(itrf):
+    return itrf[0], itrf[1]
+
+
+class TestObservatories:
+    def test_every_tempo_code_resolves(self):
+        from pint_tpu.astro.observatories import get_observatory
+        from pint_tpu.io.tim import _OBS_1CHAR
+
+        for code, name in _OBS_1CHAR.items():
+            obs = get_observatory(name)  # must not raise
+            assert obs.name
+
+    def test_aliases(self):
+        from pint_tpu.astro.observatories import get_observatory
+
+        assert get_observatory("ao").name == "arecibo"
+        assert get_observatory("GBT").name == "gbt"
+        assert get_observatory("@").is_barycenter
+
+
+class TestTOAPipeline:
+    def test_prepare_ngc6440e(self, reference_datafile):
+        from pint_tpu.toas import get_TOAs
+
+        toas = get_TOAs(reference_datafile("NGC6440E.tim"))
+        assert len(toas) == 62
+        r = np.linalg.norm(toas.ssb_obs_pos_m, axis=-1) / 1.495978707e11
+        assert np.all((r > 0.975) & (r < 1.025))
+        # TDB-UTC = (TAI-UTC) + 32.184 + (TDB-TT); dataset spans the 2006
+        # leap second so the table value varies per-TOA
+        dt = np.asarray(
+            (toas.tdb.to_longdouble() - toas.utc.to_longdouble()) * 86400.0, float
+        )
+        want = ptime.tai_minus_utc(toas.utc.mjd_float()) + 32.184
+        assert np.all(np.abs(dt - want) < 0.01)
+        tensor = toas.tensor()
+        assert tensor.t_hi.shape == (62,)
+        # obs-sun vector ~ 1 AU
+        rs = np.linalg.norm(tensor.obs_sun_pos_ls, axis=-1)
+        assert np.all((rs > 480) & (rs < 520))
+
+    def test_barycentered_toas(self):
+        from pint_tpu.io.tim import TOALine
+        from pint_tpu.toas import prepare_TOAs
+
+        lines = [TOALine("t", 1400.0, 55000, 0.5, 0.0, 1.0, "@", {})]
+        toas = prepare_TOAs(lines)
+        assert np.all(toas.ssb_obs_pos_m == 0.0)
+        assert float(toas.tdb.to_longdouble()[0]) == pytest.approx(55000.5)

@@ -1,0 +1,167 @@
+"""Precision tests for double-double arithmetic vs host np.longdouble.
+
+Mirrors the reference's precision suite (tests/test_precision.py: longdouble
+<-> two-double round trips, two_sum/day_frac properties) but checks OUR jax
+dd kernels against 80-bit longdouble ground truth, under hypothesis.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from hypothesis import given
+from hypothesis import strategies as st
+
+from pint_tpu.ops import (
+    dd,
+    dd_add,
+    dd_div,
+    dd_from_sum,
+    dd_mul,
+    dd_rint,
+    dd_to_float,
+    from_longdouble,
+    taylor_horner,
+    taylor_horner_dd,
+    taylor_horner_deriv,
+    to_longdouble,
+    two_prod,
+    two_sum,
+)
+
+# Magnitudes bounded away from the subnormal range: XLA flushes denormals and
+# two_prod loses exactness once products underflow — irrelevant for timing
+# quantities (seconds ~1e9, frequencies ~1e2, spin-downs ~1e-26).
+def bounded(lo=1e-140, hi=1e15):
+    mag = st.floats(min_value=lo, max_value=hi, allow_nan=False, allow_infinity=False)
+    return st.one_of(st.just(0.0), mag, mag.map(lambda x: -x))
+
+
+finite = bounded()
+small = bounded(hi=1e6)
+
+
+@given(finite, finite)
+def test_two_sum_exact(a, b):
+    s, e = two_sum(jnp.float64(a), jnp.float64(b))
+    ld = np.longdouble(a) + np.longdouble(b)
+    assert np.longdouble(float(s)) + np.longdouble(float(e)) == ld
+
+
+@given(small, small)
+def test_two_prod_exact(a, b):
+    p, e = two_prod(jnp.float64(a), jnp.float64(b))
+    ld = np.longdouble(a) * np.longdouble(b)
+    # two_prod is exact in binary64 pairs; longdouble(80-bit) may round the
+    # true 106-bit product, so compare within 1 ulp of the longdouble.
+    got = np.longdouble(float(p)) + np.longdouble(float(e))
+    assert abs(got - ld) <= np.abs(ld) * np.finfo(np.longdouble).eps
+
+
+@given(finite, finite, finite, finite)
+def test_dd_add_mul_roundtrip(a, b, c, d):
+    x = dd_from_sum(jnp.float64(a), jnp.float64(b))
+    y = dd_from_sum(jnp.float64(c), jnp.float64(d))
+    lx = np.longdouble(a) + np.longdouble(b)
+    ly = np.longdouble(c) + np.longdouble(d)
+    s = to_longdouble(dd_add(x, y))
+    # the longdouble reference itself rounds at ~1.1e-19 relative
+    tol = max(abs(lx), abs(ly), abs(lx + ly), 1.0) * np.longdouble(3e-19)
+    assert abs(s - (lx + ly)) <= tol
+
+
+@given(small, small)
+def test_dd_mul_matches_longdouble(a, b):
+    x, y = dd(jnp.float64(a)), dd(jnp.float64(b))
+    got = to_longdouble(dd_mul(x, y))
+    want = np.longdouble(a) * np.longdouble(b)
+    assert abs(got - want) <= max(abs(want), 1.0) * np.finfo(np.longdouble).eps
+
+
+@given(small, st.floats(min_value=0.1, max_value=1e6))
+def test_dd_div(a, b):
+    got = to_longdouble(dd_div(dd(jnp.float64(a)), dd(jnp.float64(b))))
+    want = np.longdouble(a) / np.longdouble(b)
+    assert abs(got - want) <= max(abs(want), 1.0) * np.longdouble(3e-19)
+
+
+def test_longdouble_bridge_roundtrip():
+    vals = np.longdouble("58526.213721283497883") * np.longdouble(86400.0)
+    x = from_longdouble(vals)
+    back = to_longdouble(x)
+    assert back == vals  # hi/lo split is exact for 80-bit longdouble
+
+
+def test_phase_scale_precision():
+    """F0*dt at realistic pulsar scales: 1e11 turns to sub-1e-9-turn accuracy."""
+    f0 = 641.928222
+    dt_ld = np.longdouble("157680000.000000123456")  # ~5 yr in seconds
+    want = np.longdouble(f0) * dt_ld
+    dt = from_longdouble(dt_ld)
+    got = to_longdouble(dd_mul(dt, dd(jnp.float64(f0))))
+    assert abs(got - want) < 1e-9  # absolute turns
+
+
+def test_dd_rint():
+    x = dd_from_sum(jnp.float64(1e10 + 0.25), jnp.float64(1e-12))
+    n, frac = dd_rint(x)
+    assert float(n) == 1e10
+    assert abs(to_longdouble(frac) - (np.longdouble(0.25) + np.longdouble(1e-12))) < 1e-25
+
+
+def test_dd_rint_near_half():
+    x = dd(jnp.float64(2.5), jnp.float64(1e-20))
+    n, frac = dd_rint(x)
+    assert float(n) + float(dd_to_float(frac)) == 2.5 + 1e-20
+
+
+def test_taylor_horner_basic():
+    # 10 + 3x + 4 x^2/2 + 12 x^3/6  at x=2 -> 10+6+8+16 = 40 (reference doctest)
+    x = jnp.float64(2.0)
+    got = taylor_horner(x, [10.0, 3.0, 4.0, 12.0])
+    assert float(got) == 40.0
+
+
+def test_taylor_horner_deriv():
+    x = jnp.float64(2.0)
+    # d/dx -> 3 + 4x + 12 x^2/2 = 3+8+24 = 35
+    assert float(taylor_horner_deriv(x, [10.0, 3.0, 4.0, 12.0], 1)) == 35.0
+    assert float(taylor_horner_deriv(x, [10.0, 3.0, 4.0, 12.0], 0)) == 40.0
+
+
+def test_taylor_horner_dd_spindown_scale():
+    """Full spindown Horner at NANOGrav scales vs longdouble ground truth."""
+    f0, f1, f2 = 339.31568728824463, -1.6147513e-15, 1.2e-26
+    for dt_str in ["86400.0", "157680000.123456789012", "-94608000.987654321"]:
+        dt_ld = np.longdouble(dt_str)
+        want = (
+            np.longdouble(f0) * dt_ld
+            + np.longdouble(f1) * dt_ld**2 / 2
+            + np.longdouble(f2) * dt_ld**3 / 6
+        )
+        got = to_longdouble(taylor_horner_dd(from_longdouble(dt_ld), [0.0, f0, f1, f2]))
+        assert abs(got - want) < 1e-9, dt_str
+
+
+def test_dd_under_jit_and_grad():
+    """dd ops survive jit; jacfwd through dd gives correct f64 derivative."""
+
+    def phase(f0, dt):
+        return dd_to_float(taylor_horner_dd(dt, [0.0, f0, -1e-15]))
+
+    dt = from_longdouble(np.longdouble("1.5e8"))
+    g = jax.jit(jax.grad(phase))(jnp.float64(300.0), dt)
+    # d(phase)/d(F0) = dt
+    assert abs(float(g) - 1.5e8) < 1e-3
+
+
+def test_two_sum_exactness_under_jit():
+    """XLA must not optimize away the compensated error term."""
+
+    @jax.jit
+    def f(a, b):
+        return two_sum(a, b)
+
+    s, e = f(jnp.float64(1e16), jnp.float64(1.000000123))
+    got = np.longdouble(float(s)) + np.longdouble(float(e))
+    want = np.longdouble(1e16) + np.longdouble(1.000000123)
+    assert got == want

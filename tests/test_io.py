@@ -1,0 +1,101 @@
+"""Parfile and tim-file parsing tests (incl. exact MJD splitting), using the
+reference's public datasets read in place when mounted."""
+
+from fractions import Fraction
+
+import numpy as np
+import pytest
+from hypothesis import given
+from hypothesis import strategies as st
+
+from pint_tpu.io import parse_parfile, parse_tim
+from pint_tpu.io.tim import day_frac_to_mjd_string, mjd_string_to_day_frac
+
+
+def test_mjd_string_split_exact():
+    day, hi, lo = mjd_string_to_day_frac("53478.2858714192189")
+    assert day == 53478
+    want = Fraction(2858714192189, 10**13)
+    # hi+lo is a two-float64 approximation: correct to ~1e-32 days
+    assert abs(Fraction(hi) + Fraction(lo) - want) < Fraction(1, 10**30)
+
+
+def test_mjd_string_negative():
+    day, hi, lo = mjd_string_to_day_frac("-1.25")
+    assert day == -2
+    assert Fraction(hi) + Fraction(lo) == Fraction(3, 4)
+
+
+@given(st.integers(min_value=0, max_value=99999), st.integers(min_value=0, max_value=10**16 - 1))
+def test_mjd_string_roundtrip(day, fracdigits):
+    s = f"{day}.{fracdigits:016d}"
+    d, hi, lo = mjd_string_to_day_frac(s)
+    assert day_frac_to_mjd_string(d, hi, lo) == s
+
+
+def test_mjd_split_precision_vs_longdouble():
+    # The split must beat longdouble: frac error < 1e-16 days ~ 10 ps
+    s = "58526.2137212834978831"
+    d, hi, lo = mjd_string_to_day_frac(s)
+    got = Fraction(hi) + Fraction(lo)
+    want = Fraction(2137212834978831, 10**16)
+    assert abs(got - want) < Fraction(1, 10**20)
+
+
+def test_parse_parfile_text():
+    pf = parse_parfile(
+        """PSR  J0000+0000
+F0 61.485476554 1
+F1 -1.181D-15 1
+PEPOCH 53750.0
+JUMP -fe L-wide 0.1 1
+JUMP -fe 430 0.2 1
+# comment
+""",
+        from_text=True,
+    )
+    assert pf.get("F0") == "61.485476554"
+    assert len(pf.get_all("JUMP")) == 2
+    assert pf.get_all("JUMP")[1].tokens == ["-fe", "430", "0.2", "1"]
+    assert "F2" not in pf
+
+
+def test_parse_reference_par(reference_datafile):
+    pf = parse_parfile(reference_datafile("NGC6440E.par"))
+    assert pf.get("PSR") == "1748-2021E"
+    assert pf.get("F0") == "61.485476554"
+    assert pf.get("EPHEM") == "DE421"
+
+
+def test_parse_reference_tim_princeton(reference_datafile):
+    tf = parse_tim(reference_datafile("NGC6440E.tim"))
+    assert len(tf.toas) == 62  # the reference's test suite's canonical count
+    t0 = tf.toas[0]
+    assert t0.obs == "gbt"
+    assert t0.mjd_day == 53478
+    assert t0.freq_mhz == pytest.approx(1949.609)
+    assert t0.error_us == pytest.approx(21.71)
+
+
+def test_parse_reference_tim_tempo2(reference_datafile):
+    tf = parse_tim(reference_datafile("B1855+09_NANOGrav_9yv1.tim"))
+    assert len(tf.toas) > 4000
+    t0 = tf.toas[0]
+    assert t0.format == "Tempo2"
+    assert "fe" in t0.flags or "f" in t0.flags
+
+
+def test_tim_roundtrip(tmp_path):
+    from pint_tpu.io.tim import TOALine, write_tim
+
+    toas = [
+        TOALine("a.ff", 1400.0, 55000, 0.123456789012345678 % 1, 0.0, 1.5, "gbt", {"fe": "L"}),
+    ]
+    p = tmp_path / "t.tim"
+    write_tim(toas, str(p))
+    back = parse_tim(str(p))
+    assert len(back.toas) == 1
+    assert back.toas[0].obs == "gbt"
+    assert back.toas[0].mjd_day == 55000
+    got = back.toas[0].mjd_frac_hi + back.toas[0].mjd_frac_lo
+    assert np.abs(got - 0.123456789012345678) < 1e-16
