@@ -1,0 +1,16 @@
+"""Binary orbit engines (ELL1 family first; DD family next).
+
+Registry maps parfile BINARY values to component classes.
+"""
+
+from __future__ import annotations
+
+BINARY_REGISTRY: dict[str, type] = {}
+
+
+def register_binary(name: str):
+    def deco(cls):
+        BINARY_REGISTRY[name] = cls
+        return cls
+
+    return deco

@@ -1,0 +1,19 @@
+"""Binary model dispatch (BT/ELL1/DD families).
+
+Reference: pint/models/pulsar_binary.py + stand_alone_psr_binaries/. The
+concrete orbit engines land in pint_tpu/models/binaries/; this module maps
+the parfile BINARY line to a component class (reference
+timing_model.py:3370 search_binary_components).
+"""
+
+from __future__ import annotations
+
+
+def make_binary_component(kind: str, pf):
+    from pint_tpu.models.binaries import BINARY_REGISTRY
+
+    if kind not in BINARY_REGISTRY:
+        raise NotImplementedError(
+            f"BINARY {kind} not implemented yet (available: {sorted(BINARY_REGISTRY)})"
+        )
+    return BINARY_REGISTRY[kind]()

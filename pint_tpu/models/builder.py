@@ -1,0 +1,362 @@
+"""Model builder: parfile -> component selection -> TimingModel.
+
+Reference: pint/models/model_builder.py (ModelBuilder:67, parse_parfile:46,
+choose_model:354, get_model:609, get_model_and_toas:655). Component choice is
+by parameter presence (plus the BINARY line), conflicts and unknown lines are
+reported, and fit flags/uncertainties ride along — same contract, but the
+output is our static-component/pytree TimingModel.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from pint_tpu.io.par import ParFile, parse_fit_flag, parse_parfile
+from pint_tpu.io.tim import mjd_string_to_day_frac
+from pint_tpu.models.astrometry import AstrometryEcliptic, AstrometryEquatorial
+from pint_tpu.models.base import Component, epoch_dd_to_mjd_string
+from pint_tpu.models.dispersion import DispersionDM, DispersionDMX
+from pint_tpu.models.parameter import (
+    MaskParamInfo,
+    ParamSpec,
+    ParamValueMeta,
+    dd_to_str,
+    format_dms,
+    format_hms,
+    parse_mask_clause,
+)
+from pint_tpu.models.phase_misc import AbsPhase, DelayJump, PhaseJump, PhaseOffset
+from pint_tpu.models.solar_system_shapiro import SolarSystemShapiro
+from pint_tpu.models.spindown import Spindown
+from pint_tpu.models.timing_model import TimingModel
+from pint_tpu.ops.dd import DD
+from pint_tpu.utils.logging import get_logger
+
+log = get_logger("pint_tpu.builder")
+
+# top-level configuration keys that land in model.meta (not parameters)
+META_KEYS = {
+    "PSR",
+    "PSRJ",
+    "PSRB",
+    "EPHEM",
+    "CLK",
+    "CLOCK",
+    "UNITS",
+    "TIMEEPH",
+    "T2CMETHOD",
+    "ECL",
+    "DILATEFREQ",
+    "TRACK",
+    "INFO",
+}
+
+# recognized-but-inert bookkeeping keys (fit summary data etc.)
+IGNORED_KEYS = {
+    "START",
+    "FINISH",
+    "NTOA",
+    "TRES",
+    "CHI2",
+    "CHI2R",
+    "NITS",
+    "MODE",
+    "IBOOT",
+    "EPHVER",
+    "DMDATA",
+    "SWM",
+    "BADTOA",
+}
+
+# noise / not-yet-built families: consumed by later milestones, warned for now
+PENDING_KEYS = {
+    "EFAC",
+    "EQUAD",
+    "T2EFAC",
+    "T2EQUAD",
+    "ECORR",
+    "TNECORR",
+    "DMEFAC",
+    "DMEQUAD",
+    "RNAMP",
+    "RNIDX",
+    "TNREDAMP",
+    "TNREDGAM",
+    "TNREDC",
+    "TNDMAMP",
+    "TNDMGAM",
+    "TNDMC",
+    "NE_SW",
+    "SOLARN0",
+    "CORRECT_TROPOSPHERE",
+}
+
+
+def get_model(parfile: str, from_text: bool = False) -> TimingModel:
+    pf = parse_parfile(parfile, from_text=from_text)
+    return build_model(pf)
+
+
+def get_model_and_toas(parfile: str, timfile: str, **kw):
+    from pint_tpu.toas import get_TOAs
+
+    model = get_model(parfile)
+    toas = get_TOAs(timfile, model=model, **kw)
+    return model, toas
+
+
+def build_model(pf: ParFile) -> TimingModel:
+    consumed: set[str] = set(META_KEYS) | set(IGNORED_KEYS)
+    meta = _collect_meta(pf)
+
+    components: list[Component] = []
+
+    # --- component choice by parameter presence (reference choose_model) -------
+    if "F0" in pf or "F" in pf:
+        components.append(Spindown())
+    if "RAJ" in pf or "RA" in pf:
+        components.append(AstrometryEquatorial())
+    elif "ELONG" in pf or "LAMBDA" in pf:
+        components.append(AstrometryEcliptic())
+    if "DM" in pf or any(n.startswith("DM1") for n in pf.names()):
+        components.append(DispersionDM())
+    if any(n.startswith("DMX_") for n in pf.names()):
+        components.append(DispersionDMX())
+    if any(isinstance(c, (AstrometryEquatorial, AstrometryEcliptic)) for c in components):
+        ssshap = SolarSystemShapiro()
+        ssshap.planet_shapiro = _parse_bool(pf.get("PLANET_SHAPIRO", "N"))
+        meta["PLANET_SHAPIRO"] = ssshap.planet_shapiro
+        components.append(ssshap)
+        consumed.add("PLANET_SHAPIRO")
+    if "TZRMJD" in pf:
+        components.append(AbsPhase())
+        day, hi, lo = mjd_string_to_day_frac(pf.get("TZRMJD"))
+        meta["TZR_DAY"], meta["TZR_HI"], meta["TZR_LO"] = day, hi, lo
+        meta["TZRMJD_STR"] = pf.get("TZRMJD")
+        meta["TZRSITE"] = pf.get("TZRSITE", "ssb")
+        frq = pf.get("TZRFRQ")
+        meta["TZRFRQ"] = float(frq) if frq not in (None, "0", "0.0") else float("inf")
+        consumed |= {"TZRMJD", "TZRSITE", "TZRFRQ"}
+    if "PHOFF" in pf:
+        components.append(PhaseOffset())
+    if "JUMP" in pf:
+        components.append(PhaseJump())
+
+    binary = pf.get("BINARY")
+    if binary:
+        from pint_tpu.models.binary import make_binary_component
+
+        components.append(make_binary_component(binary.upper(), pf))
+        consumed.add("BINARY")
+
+    model = TimingModel(components, meta)
+
+    # --- parameter collection ---------------------------------------------------
+    for comp in model.components:
+        _collect_component_params(comp, pf, model, consumed)
+
+    # mask parameters (JUMP ...)
+    for comp in model.components:
+        for base_spec in comp.mask_bases():
+            _collect_mask_params(comp, base_spec, pf, model, consumed)
+            consumed.add(base_spec.name)
+
+    # DMX triplets
+    for comp in model.components:
+        if isinstance(comp, DispersionDMX):
+            _collect_dmx(comp, pf, model, consumed)
+
+    # --- leftovers ---------------------------------------------------------------
+    for name in pf.names():
+        if name in consumed:
+            continue
+        if name in PENDING_KEYS:
+            log.warning(f"parfile key {name} not yet supported; ignored")
+        else:
+            log.warning(f"unrecognized parfile key {name}; ignored")
+
+    model.validate()
+    return model
+
+
+def _parse_bool(tok: str) -> bool:
+    return str(tok).upper() in ("1", "Y", "YES", "T", "TRUE")
+
+
+def _collect_meta(pf: ParFile) -> dict:
+    meta: dict = {}
+    psr = pf.get("PSR") or pf.get("PSRJ") or pf.get("PSRB")
+    if psr:
+        meta["PSR"] = psr
+    for k in ("EPHEM", "UNITS", "TIMEEPH", "T2CMETHOD", "ECL", "TRACK", "INFO"):
+        v = pf.get(k)
+        if v is not None:
+            meta[k] = v
+    clk = pf.get("CLK") or pf.get("CLOCK")
+    if clk:
+        meta["CLOCK"] = clk
+    units = meta.get("UNITS", "TDB")
+    if units.upper() not in ("TDB", "SI"):
+        raise ValueError(
+            f"UNITS {units} not supported; run tcb2tdb conversion first (reference models/tcb_conversion.py)"
+        )
+    return meta
+
+
+def _find_entry(pf: ParFile, spec: ParamSpec):
+    for key in (spec.name, *spec.aliases):
+        if key in pf:
+            return pf.get_all(key)[0], key
+    return None, None
+
+
+def _collect_component_params(comp: Component, pf: ParFile, model: TimingModel, consumed: set):
+    # plain params
+    for spec in list(comp.specs.values()):
+        line, key = _find_entry(pf, spec)
+        if line is None:
+            if spec.default is not None:
+                model.params[spec.name] = spec.parse(str(spec.default))
+                model.param_meta[spec.name] = ParamValueMeta(spec=spec)
+            continue
+        consumed.add(key)
+        _store_param(model, spec, line, from_alias=key if key != spec.name else None)
+
+    # prefix families (F2.., DM2.., GLEP_..)
+    for pspec in comp.prefix_specs():
+        for name in list(pf.names()):
+            if name in consumed:
+                continue
+            k = pspec.matches(name)
+            if k is None:
+                continue
+            spec = pspec.make(k)
+            comp.add_prefix_param(spec)
+            consumed.add(name)
+            _store_param(model, spec, pf.get_all(name)[0])
+
+
+def _store_param(model: TimingModel, spec: ParamSpec, line, from_alias=None):
+    value = spec.parse(line.value)
+    if spec.is_fittable:
+        model.params[spec.name] = value
+        frozen, unc_tok = parse_fit_flag(line.tokens)
+        pm = ParamValueMeta(spec=spec, frozen=frozen, from_alias=from_alias)
+        if unc_tok is not None:
+            pm.uncertainty = spec.parse_uncertainty(unc_tok)
+        model.param_meta[spec.name] = pm
+    else:
+        model.meta[spec.name] = value
+
+
+def _collect_mask_params(comp, base_spec: ParamSpec, pf: ParFile, model: TimingModel, consumed: set):
+    lines = pf.get_all(base_spec.name)
+    for i, line in enumerate(lines, start=1):
+        clause, rest = parse_mask_clause(line.tokens)
+        name = f"{base_spec.name}{i}"
+        spec = ParamSpec(
+            name,
+            kind=base_spec.kind,
+            scale=base_spec.scale,
+            unit=base_spec.unit,
+            description=f"{base_spec.name} on {' '.join(clause.as_parfile_tokens())}",
+        )
+        info = MaskParamInfo(name=name, base=base_spec.name, index=i, clause=clause, spec=spec)
+        comp.mask_params.append(info)
+        comp.specs[name] = spec
+        if not rest:
+            raise ValueError(f"{base_spec.name} line missing value: {line.raw}")
+        model.params[name] = spec.parse(rest[0])
+        frozen, unc_tok = parse_fit_flag(rest)
+        pm = ParamValueMeta(spec=spec, frozen=frozen)
+        if unc_tok is not None:
+            pm.uncertainty = spec.parse_uncertainty(unc_tok)
+        model.param_meta[name] = pm
+
+
+def _collect_dmx(comp: DispersionDMX, pf: ParFile, model: TimingModel, consumed: set):
+    idxs = sorted(
+        int(n[4:]) for n in pf.names() if n.startswith("DMX_") and n[4:].isdigit()
+    )
+    for i in idxs:
+        r1 = pf.get(f"DMXR1_{i:04d}")
+        r2 = pf.get(f"DMXR2_{i:04d}")
+        if r1 is None or r2 is None:
+            raise ValueError(f"DMX_{i:04d} missing DMXR1/DMXR2 range")
+        comp.add_window(i, float(r1), float(r2))
+        spec = comp.specs[f"DMX_{i:04d}"]
+        _store_param(model, spec, pf.get_all(f"DMX_{i:04d}")[0])
+        consumed |= {f"DMX_{i:04d}", f"DMXR1_{i:04d}", f"DMXR2_{i:04d}"}
+
+
+# --- parfile output ------------------------------------------------------------
+
+
+def model_to_parfile(model: TimingModel) -> str:
+    """Serialize back to parfile text (reference as_parfile,
+    timing_model.py:2437); exact strings for DD quantities."""
+    import numpy as np
+
+    lines: list[tuple[str, str]] = []
+    meta = model.meta
+    if meta.get("PSR"):
+        lines.append(("PSR", meta["PSR"]))
+    for k in ("EPHEM", "UNITS", "ECL", "TIMEEPH"):
+        if meta.get(k):
+            lines.append((k, str(meta[k])))
+    if meta.get("CLOCK"):
+        lines.append(("CLK", meta["CLOCK"]))
+    if "PLANET_SHAPIRO" in meta:
+        lines.append(("PLANET_SHAPIRO", "Y" if meta["PLANET_SHAPIRO"] else "N"))
+
+    mask_lines: dict[str, list[str]] = {}
+    for comp in model.components:
+        for mp in comp.mask_params:
+            mask_lines[mp.name] = mp.clause.as_parfile_tokens()
+
+    for name, pm in model.param_meta.items():
+        v = model.params.get(name)
+        if v is None:
+            continue
+        spec = pm.spec
+        fit = "0" if pm.frozen else "1"
+        if name in mask_lines:
+            sel = " ".join(mask_lines[name])
+            val = _value_str(spec, v)
+            base = name[: len(name) - len(_tail_digits(name))]
+            lines.append((base, f"{sel} {val} {fit}"))
+            continue
+        val = _value_str(spec, v)
+        unc = f" {pm.uncertainty / spec.scale:.6g}" if pm.uncertainty else ""
+        lines.append((name, f"{val} {fit}{unc}"))
+
+    if model.has_abs_phase:
+        lines.append(("TZRMJD", meta.get("TZRMJD_STR", "")))
+        lines.append(("TZRSITE", str(meta.get("TZRSITE", "ssb"))))
+        frq = meta.get("TZRFRQ", float("inf"))
+        lines.append(("TZRFRQ", "0.0" if np.isinf(frq) else str(frq)))
+
+    from pint_tpu.io.par import write_parfile_lines
+
+    return write_parfile_lines(lines)
+
+
+def _tail_digits(name: str) -> str:
+    i = len(name)
+    while i > 0 and name[i - 1].isdigit():
+        i -= 1
+    return name[i:]
+
+
+def _value_str(spec: ParamSpec, v) -> str:
+    if isinstance(v, DD):
+        if spec.kind == "epoch":
+            return epoch_dd_to_mjd_string(v)
+        return dd_to_str(float(np.asarray(v.hi)), float(np.asarray(v.lo)))
+    if spec.kind == "hms":
+        return format_hms(float(v))
+    if spec.kind == "dms":
+        return format_dms(float(v))
+    if spec.kind == "deg":
+        return f"{float(v) * 180.0 / np.pi:.15g}"
+    return f"{float(v) / spec.scale:.15g}"

@@ -1,0 +1,249 @@
+"""TimingModel: ordered component chain -> pure jit-able phase function.
+
+Reference: pint/models/timing_model.py (TimingModel:166; delay:1270 sums
+delay funcs in DEFAULT_ORDER with accumulated-delay semantics; phase:1303
+sums phase funcs then anchors to the TZR fiducial TOA). The TPU re-design
+keeps those semantics but expresses the whole forward pass as
+
+    phase(params_pytree, tensor_dict) -> DD turns        (pure, jit-able)
+
+with all irregular work (mask compilation, TZR TOA preparation, parfile IO)
+done once on the host in `build_tensor`. Design matrices come from jax
+autodiff of this function (fitting/), replacing the reference's analytic
+d_phase_d_param/d_delay_d_param machinery (timing_model.py:1654-1724).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from pint_tpu.models.base import (
+    DEFAULT_ORDER,
+    Component,
+    epoch_dd_to_mjd_string,
+    epoch_mjd_float,
+)
+from pint_tpu.models.parameter import ParamValueMeta, dd_to_str, format_dms, format_hms
+from pint_tpu.ops.dd import DD, dd, dd_add, dd_neg, dd_rint, dd_to_float
+from pint_tpu.utils.logging import get_logger
+
+log = get_logger("pint_tpu.models")
+
+Array = jnp.ndarray
+
+# params that configure host-side tensor construction and cannot be fitted
+UNFITTABLE = {"TZRMJD", "TZRSITE", "TZRFRQ", "PLANET_SHAPIRO"}
+
+
+class TimingModel:
+    def __init__(self, components: list[Component], meta: dict | None = None):
+        order = {cat: i for i, cat in enumerate(DEFAULT_ORDER)}
+        self.components = sorted(components, key=lambda c: order.get(c.category, 99))
+        self.meta: dict = meta or {}
+        self.params: dict = {}
+        self.param_meta: dict[str, ParamValueMeta] = {}
+
+    # --- structure ---------------------------------------------------------------
+
+    def __getitem__(self, name: str) -> Component:
+        for c in self.components:
+            if c.name == name:
+                return c
+        raise KeyError(name)
+
+    def __contains__(self, name: str) -> bool:
+        return any(c.name == name for c in self.components)
+
+    @property
+    def component_names(self) -> list[str]:
+        return [c.name for c in self.components]
+
+    @property
+    def delay_components(self) -> list[Component]:
+        return [c for c in self.components if hasattr(c, "delay") and _overrides(c, "delay")]
+
+    @property
+    def phase_components(self) -> list[Component]:
+        return [c for c in self.components if hasattr(c, "phase") and _overrides(c, "phase")]
+
+    @property
+    def astrometry(self) -> Component | None:
+        for c in self.components:
+            if c.category == "astrometry":
+                return c
+        return None
+
+    @property
+    def has_abs_phase(self) -> bool:
+        return any(c.category == "absolute_phase" for c in self.components)
+
+    @property
+    def has_phase_offset(self) -> bool:
+        return any(c.category == "phase_offset" for c in self.components)
+
+    @property
+    def free_params(self) -> list[str]:
+        return [n for n, m in self.param_meta.items() if not m.frozen]
+
+    def set_free(self, names: list[str]) -> None:
+        for n in names:
+            if n not in self.param_meta:
+                raise KeyError(f"unknown parameter {n}")
+            if n in UNFITTABLE:
+                raise ValueError(f"{n} configures tensor construction; cannot fit")
+        for n, m in self.param_meta.items():
+            m.frozen = n not in names
+
+    def validate(self) -> None:
+        for c in self.components:
+            c.validate(self.params, self.meta)
+
+    @property
+    def psr_name(self) -> str:
+        return self.meta.get("PSR", "")
+
+    @property
+    def ephem(self) -> str | None:
+        return self.meta.get("EPHEM")
+
+    @property
+    def planet_shapiro(self) -> bool:
+        return bool(self.meta.get("PLANET_SHAPIRO", False))
+
+    # --- host: tensor construction ----------------------------------------------
+
+    def build_tensor(self, toas) -> dict:
+        """TOAs -> dict of jnp arrays, the single host->device handoff.
+
+        Adds component mask columns, planet columns, and (if AbsPhase) the TZR
+        fiducial TOA as the appended LAST row.
+        """
+        from pint_tpu.toas import make_tzr_toa
+
+        if self.has_abs_phase:
+            tzr_day, tzr_hi, tzr_lo = self.meta["TZR_DAY"], self.meta["TZR_HI"], self.meta["TZR_LO"]
+            tzr = make_tzr_toa(
+                tzr_day,
+                tzr_hi,
+                tzr_lo,
+                self.meta.get("TZRSITE", "ssb"),
+                self.meta.get("TZRFRQ", float("inf")),
+                ephem=toas.ephem,
+                planets=toas.planets,
+            )
+            from pint_tpu.toas import merge_TOAs
+
+            full = merge_TOAs([toas, tzr])
+        else:
+            full = toas
+
+        tens = full.tensor()
+        from pint_tpu.ops.dd import device_split
+
+        t_hi, t_lo = device_split(tens.t_hi, tens.t_lo)
+        out = {
+            "t_hi": jnp.asarray(t_hi),
+            "t_lo": jnp.asarray(t_lo),
+            "error_s": jnp.asarray(tens.error_s),
+            "freq_mhz": jnp.asarray(tens.freq_mhz),
+            "ssb_obs_pos_ls": jnp.asarray(tens.ssb_obs_pos_ls),
+            "ssb_obs_vel_ls": jnp.asarray(tens.ssb_obs_vel_ls),
+            "obs_sun_pos_ls": jnp.asarray(tens.obs_sun_pos_ls),
+        }
+        for p, arr in tens.planet_pos_ls.items():
+            out[f"obs_{p}_pos_ls"] = jnp.asarray(arr)
+        for c in self.components:
+            for k, col in c.host_columns(full, self.params).items():
+                col = np.asarray(col, np.float64)
+                if self.has_abs_phase:
+                    col[-1] = 0.0  # TZR row belongs to no mask
+                out[k] = jnp.asarray(col)
+        return out
+
+    # --- device: the forward pass -------------------------------------------------
+
+    def delay(self, params: dict, tensor: dict) -> Array:
+        """Total delay in seconds, accumulated in DEFAULT_ORDER."""
+        tensor = self._with_context(params, tensor)
+        total = jnp.zeros_like(tensor["t_hi"])
+        for c in self.delay_components:
+            total = total + c.delay(params, tensor, total)
+        return total
+
+    def phase(self, params: dict, tensor: dict) -> DD:
+        """Pulse phase in turns (DD), TZR-anchored when AbsPhase is present.
+
+        With AbsPhase the tensor's last row is the fiducial TOA; its phase is
+        subtracted from all rows and the result sliced back to the data rows.
+        """
+        tensor = self._with_context(params, tensor)
+        total_delay = jnp.zeros_like(tensor["t_hi"])
+        for c in self.delay_components:
+            total_delay = total_delay + c.delay(params, tensor, total_delay)
+        ph = dd(jnp.zeros_like(tensor["t_hi"]))
+        for c in self.phase_components:
+            ph = dd_add(ph, c.phase(params, tensor, total_delay))
+        if self.has_abs_phase:
+            tzr_phase = DD(ph.hi[-1], ph.lo[-1])
+            ph = DD(ph.hi[:-1], ph.lo[:-1])
+            ph = dd_add(ph, dd_neg(tzr_phase))
+        return ph
+
+    def _with_context(self, params: dict, tensor: dict) -> dict:
+        ast = self.astrometry
+        if ast is not None:
+            tensor = dict(tensor)
+            tensor["_psr_dir"] = ast.pulsar_direction(params, tensor)
+        return tensor
+
+    def spin_frequency(self, params: dict, tensor: dict) -> Array:
+        """f(t) at each TOA (for phase->time residual conversion)."""
+        tensor = self._with_context(params, tensor)
+        total_delay = jnp.zeros_like(tensor["t_hi"])
+        for c in self.delay_components:
+            total_delay = total_delay + c.delay(params, tensor, total_delay)
+        sd = self["Spindown"]
+        f = sd.spin_frequency(params, tensor, total_delay)
+        return f[:-1] if self.has_abs_phase else f
+
+    # --- reporting / parfile round trip -------------------------------------------
+
+    def get_mjd_param(self, name: str) -> float:
+        return epoch_mjd_float(self.params[name])
+
+    def as_parfile(self) -> str:
+        """Write the model back in parfile form (reference as_parfile,
+        timing_model.py:2437). Values convert from internal SI units."""
+        from pint_tpu.models import builder as _b
+
+        return _b.model_to_parfile(self)
+
+    def summary(self) -> str:
+        lines = [f"TimingModel {self.psr_name or '?'}: " + ", ".join(self.component_names)]
+        for n, m in self.param_meta.items():
+            v = self.params.get(n)
+            tag = "free" if not m.frozen else "    "
+            lines.append(f"  {n:<12s} {tag} {_fmt_value(n, v, m)}")
+        return "\n".join(lines)
+
+
+def _overrides(c: Component, method: str) -> bool:
+    return getattr(type(c), method, None) is not getattr(Component, method, None)
+
+
+def _fmt_value(name: str, v, m: ParamValueMeta) -> str:
+    if isinstance(v, DD):
+        if m.spec.kind == "epoch":
+            return f"MJD {epoch_mjd_float(v):.6f}"
+        return dd_to_str(float(np.asarray(v.hi)), float(np.asarray(v.lo)))
+    if m.spec.kind == "hms":
+        return format_hms(float(v))
+    if m.spec.kind == "dms":
+        return format_dms(float(v))
+    return repr(v)
+
+
+def phase_to_residual_frac(ph: DD) -> tuple[Array, DD]:
+    """Split TZR-anchored phase into (nearest pulse number, fractional DD)."""
+    return dd_rint(ph)

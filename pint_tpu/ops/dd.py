@@ -207,6 +207,38 @@ def dd_to_float(x: DD) -> Array:
     return x.hi + x.lo
 
 
+# --- host->device boundary splitting -------------------------------------------
+
+# TPU reality: XLA emulates f64 with ~48 effective mantissa bits, so a host
+# float64 loses its bottom ~4 bits in transfer — and that loss lands OUTSIDE
+# the lo compensation term, silently costing ~0.5 us on a 1e8-s time value
+# (observed as exactly-ulp(t_hi)-quantized residuals). Any DD crossing the
+# host->device boundary must therefore have its hi part exactly representable
+# on the device. DEVICE_SPLIT_BITS=40 keeps hi to 40 mantissa bits (safe on
+# every backend), pushing the remainder into lo; total dd precision is then
+# ~2^-(41+48) relative even on emulated-f64 TPUs.
+
+DEVICE_SPLIT_BITS = 40
+
+
+def device_split(hi, lo=None, bits: int = DEVICE_SPLIT_BITS):
+    """Host-side (numpy): re-split hi+lo so hi has at most `bits` mantissa
+    bits. Value-preserving to f64^2; apply to every DD that ships to device."""
+    hi = np.asarray(hi, np.float64)
+    lo_in = 0.0 if lo is None else np.asarray(lo, np.float64)
+    mant, exp = np.frexp(hi)
+    s = np.ldexp(np.ones_like(hi), exp - bits)
+    with np.errstate(invalid="ignore"):
+        hi2 = np.where(hi == 0.0, 0.0, np.round(hi / np.where(s == 0, 1.0, s)) * s)
+    lo2 = (hi - hi2) + lo_in
+    return hi2, lo2
+
+
+def dd_device_split(x: DD, bits: int = DEVICE_SPLIT_BITS) -> DD:
+    hi, lo = device_split(np.asarray(x.hi), np.asarray(x.lo), bits)
+    return DD(jnp.asarray(hi), jnp.asarray(lo))
+
+
 # --- host-side longdouble bridges (testing / golden comparisons only) ----------
 
 

@@ -32,7 +32,7 @@ def taylor_horner(x: Array, coeffs: Sequence[Array]) -> Array:
     acc = jnp.asarray(coeffs[-1], jnp.float64) / _FACT[len(coeffs) - 1]
     for i in range(len(coeffs) - 2, -1, -1):
         acc = acc * x + jnp.asarray(coeffs[i], jnp.float64) / _FACT[i]
-    return acc * jnp.ones_like(x) if not hasattr(acc, "shape") else acc
+    return jnp.broadcast_to(acc, jnp.shape(x))
 
 
 def taylor_horner_deriv(x: Array, coeffs: Sequence[Array], deriv_order: int = 1) -> Array:

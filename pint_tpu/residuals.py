@@ -1,0 +1,192 @@
+"""Residuals: phase/time residuals, pulse-number tracking, chi^2.
+
+Reference: pint/residuals.py (Residuals:30, calc_phase_resids:299,
+calc_time_resids:427, calc_chi2:470). The device-side core is a pure function
+(`phase_residuals`) over (params, tensor); the `Residuals` class is a thin
+host wrapper holding the model/TOAs pair and cached jitted callables.
+
+Tracking modes (reference residuals.py:119-135):
+- "nearest": residual is the DD fractional part of the TZR-anchored phase
+  (each TOA attaches to its nearest integer pulse);
+- "use_pulse_numbers": residual is phase minus the recorded pulse-number
+  column (TOAs with -pn flags / compute_pulse_numbers), catching phase wraps.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pint_tpu.models.timing_model import TimingModel
+from pint_tpu.ops.dd import DD, dd_add_fp, dd_rint, dd_to_float
+
+Array = jnp.ndarray
+
+
+def phase_residual_frac(
+    model: TimingModel,
+    params: dict,
+    tensor: dict,
+    track_pn: Array | None = None,
+    delta_pn: Array | None = None,
+    subtract_mean: bool = True,
+    weights: Array | None = None,
+) -> tuple[Array, Array]:
+    """Pure: -> (pulse_number, frac_phase_residual f64 turns).
+
+    With `track_pn` given (use_pulse_numbers mode) the residual is
+    phase - track_pn (+delta), otherwise the nearest-integer fractional part.
+    """
+    ph = model.phase(params, tensor)
+    if delta_pn is not None:
+        ph = dd_add_fp(ph, delta_pn)
+    if track_pn is not None:
+        r = dd_to_float(dd_add_fp(ph, -track_pn))
+        pn = track_pn
+    else:
+        pn, frac = dd_rint(ph)
+        r = dd_to_float(frac)
+    if subtract_mean and not model.has_phase_offset:
+        if weights is None:
+            r = r - jnp.mean(r)
+        else:
+            r = r - jnp.sum(r * weights) / jnp.sum(weights)
+    return pn, r
+
+
+def get_resid_fn(model: TimingModel, subtract_mean: bool):
+    """Jitted (params, tensor, track_pn, delta_pn, weights) -> (pn, r_phase,
+    r_time), cached on the model so repeated Residuals construction (downhill
+    loops, zero_residuals iterations, grids) never retraces."""
+    cache = model.__dict__.setdefault("_resid_fn_cache", {})
+    key = subtract_mean
+    if key not in cache:
+
+        def fn(params, tensor, track_pn, delta_pn, weights):
+            pn, r = phase_residual_frac(
+                model,
+                params,
+                tensor,
+                track_pn=track_pn,
+                delta_pn=delta_pn,
+                subtract_mean=subtract_mean,
+                weights=weights,
+            )
+            f = model.spin_frequency(params, tensor)
+            return pn, r, r / f
+
+        cache[key] = jax.jit(fn)
+    return cache[key]
+
+
+class Residuals:
+    """Host wrapper: residuals of a model against prepared TOAs."""
+
+    def __init__(
+        self,
+        toas,
+        model: TimingModel,
+        tensor: dict | None = None,
+        track_mode: str | None = None,
+        subtract_mean: bool = True,
+    ):
+        self.toas = toas
+        self.model = model
+        self.tensor = tensor if tensor is not None else model.build_tensor(toas)
+        if track_mode is None:
+            # reference: TRACK -2 in the model selects pulse-number tracking
+            track_mode = (
+                "use_pulse_numbers" if model.meta.get("TRACK") == "-2" else "nearest"
+            )
+        self.track_mode = track_mode
+        self.subtract_mean = subtract_mean
+
+        pn = toas.get_pulse_numbers()
+        self._track_pn = None
+        if track_mode == "use_pulse_numbers":
+            if pn is None:
+                raise ValueError("track_mode=use_pulse_numbers but TOAs have no pulse numbers")
+            self._track_pn = jnp.asarray(pn)
+        tens = toas.tensor()
+        self._delta_pn = (
+            jnp.asarray(tens.delta_pulse_number) if tens.delta_pulse_number is not None else None
+        )
+        # 1/error^2 weights over the DATA rows (tensor may carry a TZR row)
+        self.errors_s = np.asarray(tens.error_s)
+        self._weights = jnp.asarray(1.0 / self.errors_s**2)
+
+        self._jitted = get_resid_fn(model, subtract_mean)
+        self._cache = None
+
+    def _phase_resids_pure(self, params, tensor):
+        """Unjitted pure core, for embedding into fitter autodiff."""
+        pn, r = phase_residual_frac(
+            self.model,
+            params,
+            tensor,
+            track_pn=self._track_pn,
+            delta_pn=self._delta_pn,
+            subtract_mean=self.subtract_mean,
+            weights=self._weights,
+        )
+        f = self.model.spin_frequency(params, tensor)
+        return pn, r, r / f
+
+    def _phase_fn(self, params, tensor):
+        return self._jitted(params, tensor, self._track_pn, self._delta_pn, self._weights)
+
+    # --- cached views ------------------------------------------------------------
+
+    def _compute(self):
+        if self._cache is None:
+            pn, rphase, rtime = self._phase_fn(self.model.params, self.tensor)
+            self._cache = (np.asarray(pn), np.asarray(rphase), np.asarray(rtime))
+        return self._cache
+
+    def update(self):
+        self._cache = None
+
+    @property
+    def pulse_numbers(self) -> np.ndarray:
+        return self._compute()[0]
+
+    @property
+    def phase_resids(self) -> np.ndarray:
+        """Fractional phase residuals (turns)."""
+        return self._compute()[1]
+
+    @property
+    def time_resids(self) -> np.ndarray:
+        """Time residuals in seconds (phase / instantaneous f)."""
+        return self._compute()[2]
+
+    @property
+    def time_resids_us(self) -> np.ndarray:
+        return self.time_resids * 1e6
+
+    def rms_weighted(self) -> float:
+        """Weighted RMS of time residuals, seconds (reference
+        Residuals.rms_weighted)."""
+        r = self.time_resids
+        w = 1.0 / self.errors_s**2
+        mean = np.sum(r * w) / np.sum(w)
+        return float(np.sqrt(np.sum(w * (r - mean) ** 2) / np.sum(w)))
+
+    def calc_chi2(self) -> float:
+        """Uncorrelated (white) chi^2; the GLS chi^2 lives in fitting.gls."""
+        r = self.time_resids
+        return float(np.sum((r / self.errors_s) ** 2))
+
+    @property
+    def dof(self) -> int:
+        n = len(self.errors_s) - len(self.model.free_params)
+        if self.subtract_mean and not self.model.has_phase_offset:
+            n -= 1
+        return n
+
+    @property
+    def reduced_chi2(self) -> float:
+        return self.calc_chi2() / self.dof

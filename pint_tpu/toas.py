@@ -68,7 +68,7 @@ class TOAs:
     """
 
     lines: list[TOALine]
-    utc: ptime.MJDEpoch
+    utc: ptime.MJDEpoch  # clock-corrected UTC
     tdb: ptime.MJDEpoch
     error_us: np.ndarray
     freq_mhz: np.ndarray
@@ -81,6 +81,13 @@ class TOAs:
     ephem: str = "analytic"
     clock_applied: bool = True
     planets: bool = False
+    # raw site-arrival UTC (pre clock chain) + the chain settings, so
+    # re-preparation (simulation.zero_residuals) never double-applies
+    # corrections and keeps the caller's GPS/BIPM choices
+    utc_raw: ptime.MJDEpoch | None = None
+    include_gps: bool = True
+    include_bipm: bool = False
+    bipm_version: str = "BIPM2019"
 
     def __len__(self):
         return len(self.error_us)
@@ -108,10 +115,16 @@ class TOAs:
         """Boolean-mask subset (reference TOAs.select, toa.py:1852)."""
         mask = np.asarray(mask)
         idx = np.flatnonzero(mask)
+
+        def _sel(ep):
+            if ep is None:
+                return None
+            return ptime.MJDEpoch(ep.day[idx], ep.frac_hi[idx], ep.frac_lo[idx])
+
         return TOAs(
             lines=[self.lines[i] for i in idx],
-            utc=ptime.MJDEpoch(self.utc.day[idx], self.utc.frac_hi[idx], self.utc.frac_lo[idx]),
-            tdb=ptime.MJDEpoch(self.tdb.day[idx], self.tdb.frac_hi[idx], self.tdb.frac_lo[idx]),
+            utc=_sel(self.utc),
+            tdb=_sel(self.tdb),
             error_us=self.error_us[idx],
             freq_mhz=self.freq_mhz[idx],
             obs=self.obs[idx],
@@ -123,6 +136,10 @@ class TOAs:
             ephem=self.ephem,
             clock_applied=self.clock_applied,
             planets=self.planets,
+            utc_raw=_sel(self.utc_raw),
+            include_gps=self.include_gps,
+            include_bipm=self.include_bipm,
+            bipm_version=self.bipm_version,
         )
 
     def tensor(self) -> TOATensor:
@@ -164,18 +181,24 @@ def merge_TOAs(toas_list: Sequence[TOAs]) -> TOAs:
         if t.ephem != t0.ephem:
             raise ValueError(f"cannot merge TOAs with ephems {t0.ephem} vs {t.ephem}")
     cat = np.concatenate
+
+    def _cat_ep(eps):
+        if any(e is None for e in eps):
+            return None
+        return ptime.MJDEpoch(
+            cat([e.day for e in eps]),
+            cat([e.frac_hi for e in eps]),
+            cat([e.frac_lo for e in eps]),
+        )
+
     return TOAs(
         lines=sum((list(t.lines) for t in toas_list), []),
-        utc=ptime.MJDEpoch(
-            cat([t.utc.day for t in toas_list]),
-            cat([t.utc.frac_hi for t in toas_list]),
-            cat([t.utc.frac_lo for t in toas_list]),
-        ),
-        tdb=ptime.MJDEpoch(
-            cat([t.tdb.day for t in toas_list]),
-            cat([t.tdb.frac_hi for t in toas_list]),
-            cat([t.tdb.frac_lo for t in toas_list]),
-        ),
+        utc=_cat_ep([t.utc for t in toas_list]),
+        tdb=_cat_ep([t.tdb for t in toas_list]),
+        utc_raw=_cat_ep([t.utc_raw for t in toas_list]),
+        include_gps=t0.include_gps,
+        include_bipm=t0.include_bipm,
+        bipm_version=t0.bipm_version,
         error_us=cat([t.error_us for t in toas_list]),
         freq_mhz=cat([t.freq_mhz for t in toas_list]),
         obs=cat([t.obs for t in toas_list]),
@@ -205,11 +228,18 @@ def get_TOAs(
     """One-stop TOA preparation (reference get_TOAs, toa.py:104).
 
     When `model` is given, EPHEM/PLANET_SHAPIRO/CLOCK directives from the
-    model override the defaults (reference toa.py:188-230 behavior).
+    model override the defaults (reference toa.py:188-230 behavior): a model
+    ``CLK TT(BIPMyyyy)`` line turns on the TAI->TT(BIPM) correction chain.
     """
     if model is not None:
         ephem = getattr(model, "ephem", None) or ephem
         planets = planets or bool(getattr(model, "planet_shapiro", False))
+        clk = (model.meta.get("CLOCK") or "").upper().replace(" ", "")
+        if clk.startswith("TT(BIPM"):
+            include_bipm = True
+            ver = clk[3:].strip("()")
+            if ver != "BIPM":  # bare TT(BIPM) keeps the default version
+                bipm_version = ver
     tf = parse_tim(timfile)
     return prepare_TOAs(
         tf.toas,
@@ -241,6 +271,53 @@ def prepare_TOAs(
     freq = np.array([t.freq_mhz if t.freq_mhz > 0 else np.inf for t in lines])
     obs_names = np.array([get_observatory(t.obs).name for t in lines])
     flags = [dict(t.flags) for t in lines]
+    return prepare_arrays(
+        utc,
+        error_us,
+        freq,
+        obs_names,
+        flags,
+        lines=lines,
+        ephem=ephem,
+        planets=planets,
+        include_gps=include_gps,
+        include_bipm=include_bipm,
+        bipm_version=bipm_version,
+    )
+
+
+def prepare_arrays(
+    utc: ptime.MJDEpoch,
+    error_us: np.ndarray,
+    freq: np.ndarray,
+    obs_names: np.ndarray,
+    flags: list[dict] | None = None,
+    lines: list[TOALine] | None = None,
+    ephem: str = "auto",
+    planets: bool = False,
+    include_gps: bool = True,
+    include_bipm: bool = False,
+    bipm_version: str = "BIPM2019",
+) -> TOAs:
+    """Array-level TOA preparation: the core of get_TOAs, re-runnable for
+    simulation's zero-residual iteration (reference simulation.py:49)."""
+    n = len(utc)
+    if flags is None:
+        flags = [{} for _ in range(n)]
+    if lines is None:
+        lines = [
+            TOALine(
+                name=f"fake_{i}",
+                freq_mhz=float(freq[i]) if np.isfinite(freq[i]) else 0.0,
+                mjd_day=int(utc.day[i]),
+                mjd_frac_hi=float(utc.frac_hi[i]),
+                mjd_frac_lo=float(utc.frac_lo[i]),
+                error_us=float(error_us[i]),
+                obs=str(obs_names[i]),
+                flags=dict(flags[i]),
+            )
+            for i in range(n)
+        ]
 
     # 1. clock corrections per observatory group (site -> UTC)
     corr_s = np.zeros(n)
@@ -319,6 +396,10 @@ def prepare_TOAs(
         planet_pos_m=planet_pos,
         ephem=getattr(eph, "name", "analytic"),
         planets=planets,
+        utc_raw=utc,
+        include_gps=include_gps,
+        include_bipm=include_bipm,
+        bipm_version=bipm_version,
     )
     log.info("prepared TOAs: " + toas.summary())
     return toas

@@ -1,0 +1,206 @@
+"""Model-layer tests: builder, parameters, components, parfile round trip.
+
+Mirrors the reference's per-component unit tests (SURVEY.md §4.5) and
+parfile-round-trip tests (test_parfile_writing_format.py analogues).
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pint_tpu.models import (
+    AbsPhase,
+    AstrometryEquatorial,
+    DispersionDM,
+    SolarSystemShapiro,
+    Spindown,
+    get_model,
+)
+from pint_tpu.models.builder import build_model, get_model_and_toas
+from pint_tpu.models.parameter import (
+    format_dms,
+    format_hms,
+    parse_dms,
+    parse_hms,
+    str_to_dd,
+)
+from pint_tpu.io.par import parse_parfile
+from pint_tpu.ops.dd import DD
+
+NGC_PAR = "NGC6440E.par"
+NGC_TIM = "NGC6440E.tim"
+
+SIMPLE_PAR = """
+PSR J0000+0000
+RAJ 12:00:00.0 1
+DECJ 30:00:00.0 1
+PMRA 1.5
+PMDEC -2.5
+PX 0.8
+F0 100.123456789012345 1
+F1 -1.5e-15 1
+PEPOCH 55000
+POSEPOCH 55000
+DM 15.5 1
+DM1 0.001
+DMEPOCH 55000
+TZRMJD 55000.5
+TZRSITE @
+TZRFRQ 0.0
+"""
+
+
+class TestParsing:
+    def test_str_to_dd_exact(self):
+        hi, lo = str_to_dd("100.123456789012345678901")
+        import numpy as np
+
+        total = np.longdouble(hi) + np.longdouble(lo)
+        assert abs(float(total) - 100.123456789012345678901) < 1e-13
+        # lo carries digits beyond f64
+        assert lo != 0.0
+
+    def test_hms_dms_round_trip(self):
+        for s in ["12:34:56.789012", "00:00:01.5", "23:59:59.999"]:
+            assert format_hms(parse_hms(s), ndigits=6) == s.zfill(len(s)) or abs(
+                parse_hms(format_hms(parse_hms(s))) - parse_hms(s)
+            ) < 1e-15
+        for s in ["+12:34:56.7890", "-20:21:29.0"]:
+            assert abs(parse_dms(format_dms(parse_dms(s))) - parse_dms(s)) < 1e-15
+
+    def test_fortran_exponent(self):
+        m = build_model(parse_parfile("F0 61.0\nF1 -1.181D-15\nPEPOCH 53750\n", from_text=True))
+        assert abs(float(m.params["F1"].hi) + 1.181e-15) < 1e-25
+
+
+class TestBuilder:
+    def test_simple_model(self):
+        m = build_model(parse_parfile(SIMPLE_PAR, from_text=True))
+        assert "Spindown" in m
+        assert "AstrometryEquatorial" in m
+        assert "DispersionDM" in m
+        assert "SolarSystemShapiro" in m
+        assert "AbsPhase" in m
+        assert set(m.free_params) == {"RAJ", "DECJ", "F0", "F1", "DM"}
+        # DD params carried exactly
+        assert isinstance(m.params["F0"], DD)
+        assert isinstance(m.params["PEPOCH"], DD)
+
+    def test_component_order(self):
+        m = build_model(parse_parfile(SIMPLE_PAR, from_text=True))
+        names = m.component_names
+        assert names.index("AstrometryEquatorial") < names.index("DispersionDM")
+        assert names.index("DispersionDM") < names.index("Spindown")
+
+    def test_ngc6440e(self, reference_datafile):
+        m = get_model(reference_datafile(NGC_PAR))
+        assert m.psr_name == "1748-2021E"
+        assert set(m.free_params) == {"RAJ", "DECJ", "F0", "F1", "DM"}
+        assert m.meta["CLOCK"] == "UTC(NIST)"
+        # F1 with fortran exponent
+        assert abs(float(m.params["F1"].hi) + 1.181e-15) < 1e-25
+
+    def test_units_tcb_rejected(self):
+        with pytest.raises(ValueError, match="UNITS"):
+            build_model(parse_parfile("F0 1\nPEPOCH 55000\nUNITS TCB\n", from_text=True))
+
+    def test_jump_mask(self):
+        par = SIMPLE_PAR + "JUMP MJD 54000 56000 1e-4 1\n"
+        m = build_model(parse_parfile(par, from_text=True))
+        assert "PhaseJump" in m
+        assert "JUMP1" in m.params
+        assert "JUMP1" in m.free_params
+
+    def test_parfile_round_trip(self):
+        m = build_model(parse_parfile(SIMPLE_PAR, from_text=True))
+        text = m.as_parfile()
+        m2 = build_model(parse_parfile(text, from_text=True))
+        for name in ("F0", "F1", "PEPOCH", "DM"):
+            v1, v2 = m.params[name], m2.params[name]
+            if isinstance(v1, DD):
+                assert float(v1.hi) == float(v2.hi)
+                assert abs(float(v1.lo) - float(v2.lo)) < 1e-25 * max(1.0, abs(float(v1.hi)))
+            else:
+                assert np.isclose(float(v1), float(v2), rtol=1e-14)
+        assert m2.free_params == m.free_params
+
+
+class TestPhase:
+    def test_phase_spindown_only(self):
+        """Barycentric TOAs + pure spindown: phase must equal F0*dt + F1*dt^2/2
+        to dd precision."""
+        par = "PSR TEST\nF0 100.0 1\nF1 -1e-14\nPEPOCH 55000\n"
+        m = build_model(parse_parfile(par, from_text=True))
+        from pint_tpu.astro import time as ptime
+        from pint_tpu.toas import prepare_arrays
+
+        mjds = np.array([55000.0, 55001.0, 55100.25])
+        utc = ptime.MJDEpoch.from_mjd_float(mjds)
+        toas = prepare_arrays(
+            utc, np.ones(3), np.full(3, np.inf), np.array(["bat"] * 3)
+        )
+        tensor = m.build_tensor(toas)
+        ph = m.phase(m.params, tensor)
+        # dt in TDB seconds since PEPOCH: barycentric input means tdb == given mjd
+        dt = np.asarray((toas.tdb.to_longdouble() - np.longdouble(55000.0)) * 86400.0)
+        expect = np.longdouble(100.0) * dt + np.longdouble(-1e-14) / 2 * dt * dt
+        got = np.asarray(ph.hi, dtype=np.longdouble) + np.asarray(ph.lo, dtype=np.longdouble)
+        assert np.all(np.abs(got - expect) < 1e-7)  # < 1e-7 turns over 1e9 turns
+
+    def test_tzr_anchor_zero(self):
+        """Phase at the TZR epoch itself must be ~0 when TZR is a data TOA."""
+        par = "PSR TEST\nF0 100.0\nPEPOCH 55000\nTZRMJD 55010\nTZRSITE @\nTZRFRQ 0\n"
+        m = build_model(parse_parfile(par, from_text=True))
+        from pint_tpu.astro import time as ptime
+        from pint_tpu.toas import prepare_arrays
+
+        utc = ptime.MJDEpoch.from_mjd_float(np.array([55010.0, 55020.0]))
+        toas = prepare_arrays(utc, np.ones(2), np.full(2, np.inf), np.array(["bat", "bat"]))
+        tensor = m.build_tensor(toas)
+        ph = m.phase(m.params, tensor)
+        total0 = float(ph.hi[0]) + float(ph.lo[0])
+        assert abs(total0) < 1e-9
+
+    def test_dispersion_delay_scales(self):
+        from pint_tpu.models.dispersion import dispersion_time_delay
+        import jax.numpy as jnp
+
+        d1 = float(dispersion_time_delay(jnp.asarray(100.0), jnp.asarray(1400.0)))
+        d2 = float(dispersion_time_delay(jnp.asarray(100.0), jnp.asarray(2800.0)))
+        assert d1 / d2 == pytest.approx(4.0)
+        d3 = float(dispersion_time_delay(jnp.asarray(100.0), jnp.asarray(np.inf)))
+        assert d3 == 0.0
+
+    def test_astrometry_direction_unit_norm(self):
+        m = build_model(parse_parfile(SIMPLE_PAR, from_text=True))
+        from pint_tpu.astro import time as ptime
+        from pint_tpu.toas import prepare_arrays
+
+        utc = ptime.MJDEpoch.from_mjd_float(np.linspace(54000, 56000, 8))
+        toas = prepare_arrays(utc, np.ones(8), np.full(8, 1400.0), np.array(["gbt"] * 8))
+        tensor = m.build_tensor(toas)
+        ast = m["AstrometryEquatorial"]
+        n = np.asarray(ast.pulsar_direction(m.params, tensor))
+        assert np.allclose(np.linalg.norm(n, axis=-1), 1.0, atol=1e-12)
+        # proper motion moves the direction over 2000 days
+        assert np.linalg.norm(n[0] - n[-1]) > 1e-8
+
+
+class TestEcliptic:
+    def test_frame_rotation_consistency(self):
+        """Same sky position expressed in ecliptic gives the same direction."""
+        from pint_tpu.models.astrometry import ecliptic_to_icrs, icrs_to_ecliptic, unit_vector
+
+        v = np.asarray(unit_vector(jnp.asarray(1.1), jnp.asarray(0.3)))
+        w = np.asarray(ecliptic_to_icrs(icrs_to_ecliptic(jnp.asarray(v))))
+        assert np.allclose(v, w, atol=1e-15)
+
+    def test_north_ecliptic_pole(self):
+        from pint_tpu.models.astrometry import ecliptic_to_icrs
+
+        pole = np.asarray(ecliptic_to_icrs(jnp.asarray([0.0, 0.0, 1.0])))
+        # RA = 18h, dec = 90 - obliquity
+        ra = np.arctan2(pole[1], pole[0]) % (2 * np.pi)
+        dec = np.arcsin(pole[2])
+        assert ra == pytest.approx(1.5 * np.pi, abs=1e-12)
+        assert np.degrees(dec) == pytest.approx(90 - 23.4392794, abs=1e-4)

@@ -1,0 +1,65 @@
+"""Simulation tests (reference test_simulation.py / test_fake_toas.py
+analogues), including the clock-correction re-preparation regression."""
+
+import numpy as np
+import pytest
+
+from pint_tpu.io.par import parse_parfile
+from pint_tpu.models.builder import build_model
+from pint_tpu.residuals import Residuals
+from pint_tpu.simulation import make_fake_toas_uniform, zero_residuals
+
+PAR = """
+PSR FAKE2
+F0 150.0 1
+F1 -3e-15 1
+PEPOCH 55000
+TZRMJD 55000.5
+TZRSITE gbt
+TZRFRQ 1400
+RAJ 10:00:00
+DECJ 05:00:00
+DM 10.0
+POSEPOCH 55000
+"""
+
+
+@pytest.fixture
+def model():
+    return build_model(parse_parfile(PAR, from_text=True))
+
+
+def test_uniform_fakes_sit_on_model(model):
+    toas = make_fake_toas_uniform(54800, 55200, 30, model, obs="gbt", error_us=2.0)
+    r = Residuals(toas, model, subtract_mean=False)
+    assert np.max(np.abs(r.time_resids)) < 1e-9
+
+
+def test_zero_residuals_with_clock_corrections(model, monkeypatch):
+    """zero_residuals must converge with a nonzero clock chain — it must
+    shift the RAW site UTC, not re-apply corrections (regression: the loop
+    previously fed corrected UTC back through the clock chain and plateaued
+    at exactly the correction value)."""
+    from pint_tpu.astro import clock as clockmod
+
+    class FakeChain:
+        def evaluate(self, mjd):
+            return np.full(np.shape(mjd), 1e-4)  # 100 us constant correction
+
+    monkeypatch.setattr(clockmod, "get_clock_chain", lambda *a, **k: FakeChain())
+    toas = make_fake_toas_uniform(54800, 55200, 10, model, obs="gbt", error_us=2.0)
+    r = Residuals(toas, model, subtract_mean=False)
+    assert np.max(np.abs(r.time_resids)) < 1e-9
+    # the raw UTC and corrected UTC must differ by exactly the correction
+    d = (toas.utc.to_longdouble() - toas.utc_raw.to_longdouble()) * 86400.0
+    assert np.allclose(np.asarray(d, float), 1e-4, atol=1e-12)
+
+
+def test_noise_reproducible(model):
+    t1 = make_fake_toas_uniform(
+        54800, 55200, 20, model, error_us=3.0, add_noise=True, rng=np.random.default_rng(5)
+    )
+    t2 = make_fake_toas_uniform(
+        54800, 55200, 20, model, error_us=3.0, add_noise=True, rng=np.random.default_rng(5)
+    )
+    assert np.all(t1.tdb.to_longdouble() == t2.tdb.to_longdouble())
