@@ -45,12 +45,19 @@ class MaxiterReached(ConvergenceFailure):
 
 
 def apply_delta(params: dict, free_names: tuple[str, ...], delta: Array) -> dict:
-    """params + delta over the free subset; DD leaves absorb f64 steps
-    exactly (dd_add_fp is an error-free transformation)."""
+    """params + delta over the free subset; extended-precision leaves (DD or
+    QF) absorb f64 steps without losing their low-order bits."""
+    from pint_tpu.ops.qf32 import QF, qf_add_f64
+
     new = dict(params)
     for i, n in enumerate(free_names):
         v = params[n]
-        new[n] = dd_add_fp(v, delta[i]) if isinstance(v, DD) else v + delta[i]
+        if isinstance(v, DD):
+            new[n] = dd_add_fp(v, delta[i])
+        elif isinstance(v, QF):
+            new[n] = qf_add_f64(v, delta[i])
+        else:
+            new[n] = v + delta[i]
     return new
 
 
@@ -74,7 +81,7 @@ class FitResult:
 def get_step_fn(model: TimingModel, free: tuple[str, ...], subtract_mean: bool):
     """Jitted WLS step, cached on the model keyed by the free-param set."""
     cache = model.__dict__.setdefault("_wls_step_cache", {})
-    key = (free, subtract_mean)
+    key = (free, subtract_mean, model.xprec.name)
     if key in cache:
         return cache[key]
 
@@ -133,6 +140,7 @@ class WLSFitter:
     def _step_fn(self, params, tensor):
         r = self.resids
         fn = get_step_fn(self.model, self._free, r.subtract_mean)
+        params = self.model.xprec.convert_params(params)
         return fn(params, tensor, r._track_pn, r._delta_pn, r._weights, jnp.asarray(r.errors_s))
 
     def chi2_at(self, params: dict) -> float:
@@ -182,7 +190,9 @@ class WLSFitter:
             if np.all(rel < xtol) or len(self._free) == 0:
                 converged = True
                 break
-        self.model.params = params
+        from pint_tpu.ops.xprec import params_to_dd
+
+        self.model.params = params_to_dd(params)
         chi2_final = self.chi2_at(params)
         cov = np.asarray(cov)
         s = np.asarray(s)
@@ -236,7 +246,9 @@ class DownhillWLSFitter(WLSFitter):
                 break
         else:
             log.warning(f"downhill fit hit maxiter={maxiter}")
-        self.model.params = params
+        from pint_tpu.ops.xprec import params_to_dd
+
+        self.model.params = params_to_dd(params)
         cov = np.asarray(cov)
         unc = dict(zip(self._free, np.sqrt(np.diag(cov))))
         for n, u in unc.items():

@@ -22,13 +22,13 @@ import jax.numpy as jnp
 import numpy as np
 
 from pint_tpu import AU_LS, OBLIQUITY_J2000_ARCSEC
-from pint_tpu.models.base import DelayComponent, toa_time_dd
+from pint_tpu.models.base import DelayComponent, dt_since_epoch_f64, toa_time_dd
 from pint_tpu.models.parameter import (
     MAS_PER_YR_TO_RAD_PER_S,
     MAS_TO_RAD,
     ParamSpec,
 )
-from pint_tpu.ops.dd import dd_sub, dd_to_float
+from pint_tpu.ops.dd import dd_to_float
 
 Array = jnp.ndarray
 
@@ -64,7 +64,7 @@ class AstrometryBase(DelayComponent):
         ep = params.get("POSEPOCH", params.get("PEPOCH"))
         if ep is None:
             return dd_to_float(toa_time_dd(tensor))
-        return dd_to_float(dd_sub(toa_time_dd(tensor), ep))
+        return dt_since_epoch_f64(tensor, ep)
 
     def pulsar_direction(self, params: dict, tensor: dict) -> Array:
         """(N,3) ICRS unit vector at each TOA (proper-motion corrected)."""

@@ -103,8 +103,36 @@ def epoch_mjd_float(v: DD) -> float:
 
 
 def toa_time_dd(tensor: dict) -> DD:
-    """TDB seconds since tensor epoch for every row, as DD."""
+    """TDB seconds since tensor epoch for every row, as DD (f64 pair)."""
     return DD(tensor["t_hi"], tensor["t_lo"])
+
+
+def toa_time_x(xp, tensor: dict):
+    """TDB seconds since tensor epoch in the active precision backend."""
+    return xp.time_from_tensor(tensor)
+
+
+def barycentric_time_x(xp, params: dict, tensor: dict, total_delay):
+    """t_pulsar-frame = TDB - total_delay in backend precision."""
+    return xp.add_f(toa_time_x(xp, tensor), -total_delay)
+
+
+def dt_since_epoch_f64(tensor: dict, epoch_leaf) -> Array:
+    """Seconds since an epoch parameter, plain f64 — for delay components
+    (proper motion, DM Taylor...), which never need extended precision."""
+    ep = leaf_to_f64(epoch_leaf)
+    return (tensor["t_hi"] - ep) + tensor["t_lo"]
+
+
+def leaf_to_f64(v):
+    """Collapse any parameter leaf (DD, QF, or plain) to device f64."""
+    from pint_tpu.ops.qf32 import QF, qf_to_f64
+
+    if isinstance(v, DD):
+        return v.hi + v.lo
+    if isinstance(v, QF):
+        return qf_to_f64(v)
+    return jnp.asarray(v, jnp.float64)
 
 
 class Component:
@@ -172,8 +200,8 @@ class Component:
         """Additional delay in seconds (f64) given accumulated delay."""
         raise NotImplementedError
 
-    def phase(self, params: dict, tensor: dict, total_delay: Array) -> DD:
-        """Additional phase in turns (DD)."""
+    def phase(self, params: dict, tensor: dict, total_delay: Array, xp):
+        """Additional phase in turns, in the xp extended-precision backend."""
         raise NotImplementedError
 
 

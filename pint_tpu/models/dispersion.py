@@ -16,9 +16,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from pint_tpu import DMCONST
-from pint_tpu.models.base import DelayComponent, toa_time_dd
+from pint_tpu.models.base import DelayComponent, dt_since_epoch_f64, leaf_to_f64
 from pint_tpu.models.parameter import PER_YEAR_TO_PER_SEC, ParamSpec, PrefixSpec
-from pint_tpu.ops.dd import dd_sub, dd_to_float
 from pint_tpu.ops.taylor import taylor_horner
 
 Array = jnp.ndarray
@@ -69,10 +68,13 @@ class DispersionDM(DelayComponent):
             raise ValueError("DM derivatives need DMEPOCH")
 
     def base_dm(self, params: dict, tensor: dict) -> Array:
-        coeffs = [params.get(f"DM{k}" if k else "DM", 0.0) for k in range(self.num_terms)]
+        coeffs = [
+            leaf_to_f64(params.get(f"DM{k}" if k else "DM", 0.0))
+            for k in range(self.num_terms)
+        ]
         if self.num_terms == 1:
             return coeffs[0] * jnp.ones_like(tensor["t_hi"])
-        dt = dd_to_float(dd_sub(toa_time_dd(tensor), params["DMEPOCH"]))
+        dt = dt_since_epoch_f64(tensor, params["DMEPOCH"])
         # reference base_dm uses a plain (non-factorial) polynomial via
         # taylor_horner on DM_k with factorial scaling — keep its convention
         return taylor_horner(dt, coeffs)

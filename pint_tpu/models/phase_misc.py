@@ -15,9 +15,8 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
-from pint_tpu.models.base import Component, PhaseComponent, DelayComponent
+from pint_tpu.models.base import Component, DelayComponent, PhaseComponent, leaf_to_f64
 from pint_tpu.models.parameter import ParamSpec
-from pint_tpu.ops.dd import DD, dd
 
 Array = jnp.ndarray
 
@@ -37,8 +36,8 @@ class AbsPhase(PhaseComponent):
         if "TZR_DAY" not in meta:
             raise ValueError("AbsPhase requires TZRMJD")
 
-    def phase(self, params, tensor, total_delay) -> DD:
-        return dd(jnp.zeros_like(tensor["t_hi"]))
+    def phase(self, params, tensor, total_delay, xp):
+        return xp.zeros_like(tensor["t_hi"])
 
 
 class PhaseOffset(PhaseComponent):
@@ -52,8 +51,8 @@ class PhaseOffset(PhaseComponent):
     def param_specs(cls):
         return [ParamSpec("PHOFF", unit="turns", default=0.0)]
 
-    def phase(self, params, tensor, total_delay) -> DD:
-        return dd(-params["PHOFF"] * jnp.ones_like(tensor["t_hi"]))
+    def phase(self, params, tensor, total_delay, xp):
+        return xp.from_f64(-leaf_to_f64(params["PHOFF"]) * jnp.ones_like(tensor["t_hi"]))
 
 
 def _jump_spec(k: int) -> ParamSpec:
@@ -71,14 +70,12 @@ class PhaseJump(PhaseComponent):
     def mask_bases(cls):
         return [ParamSpec("JUMP", unit="s")]
 
-    def phase(self, params, tensor, total_delay) -> DD:
+    def phase(self, params, tensor, total_delay, xp):
         total = jnp.zeros_like(tensor["t_hi"])
         for mp in self.mask_params:
-            total = total + tensor[f"mask_{mp.name}"] * params[mp.name]
+            total = total + tensor[f"mask_{mp.name}"] * leaf_to_f64(params[mp.name])
         # F0 * jump (reference jump.py phase_d_jump): use F0 from params
-        f0 = params["F0"]
-        f0_f = f0.hi + f0.lo if isinstance(f0, DD) else f0
-        return dd(total * f0_f)
+        return xp.from_f64(total * leaf_to_f64(params["F0"]))
 
 
 class DelayJump(DelayComponent):

@@ -1,20 +1,18 @@
 """Spindown: Taylor-polynomial pulse phase in F0..Fn about PEPOCH.
 
 Reference: pint/models/spindown.py (Spindown:19, spindown_phase:138 — a
-longdouble Horner via utils.taylor_horner:355). Here the Horner runs in
-double-double on device (ops/taylor.taylor_horner_dd); F0 and F1 are carried
-as DD parsed exactly from the parfile string, higher orders as f64 (their
-contribution to phase is far below dd noise).
+longdouble Horner via utils.taylor_horner:355). Here the Horner runs in the
+active extended-precision backend (double-double f64 on CPU, quad-f32 on
+TPU; ops/xprec.py); F0 and F1 are carried as exact-split parameter leaves.
 """
 
 from __future__ import annotations
 
 import jax.numpy as jnp
 
-from pint_tpu.models.base import PhaseComponent, barycentric_time_dd
+from pint_tpu.models.base import PhaseComponent, barycentric_time_x, leaf_to_f64
 from pint_tpu.models.parameter import ParamSpec, PrefixSpec
-from pint_tpu.ops.dd import DD, dd_sub, dd_to_float
-from pint_tpu.ops.taylor import taylor_horner_dd, taylor_horner_deriv
+from pint_tpu.ops.taylor import taylor_horner_deriv, taylor_horner_x
 
 Array = jnp.ndarray
 
@@ -63,17 +61,17 @@ class Spindown(PhaseComponent):
         """[0, F0, F1, ...] — phase = sum F_k dt^(k+1)/(k+1)!."""
         return [0.0] + [params[f"F{k}"] for k in range(self.num_terms)]
 
-    def dt_dd(self, params: dict, tensor: dict, total_delay: Array) -> DD:
-        t = barycentric_time_dd(params, tensor, total_delay)
-        return dd_sub(t, params["PEPOCH"])
+    def dt_x(self, params: dict, tensor: dict, total_delay: Array, xp):
+        t = barycentric_time_x(xp, params, tensor, total_delay)
+        return xp.sub(t, xp.lift(params["PEPOCH"]))
 
-    def phase(self, params: dict, tensor: dict, total_delay: Array) -> DD:
-        return taylor_horner_dd(self.dt_dd(params, tensor, total_delay), self.coeffs(params))
+    def phase(self, params: dict, tensor: dict, total_delay: Array, xp):
+        return taylor_horner_x(xp, self.dt_x(params, tensor, total_delay, xp), self.coeffs(params))
 
-    def spin_frequency(self, params: dict, tensor: dict, total_delay: Array) -> Array:
+    def spin_frequency(self, params: dict, tensor: dict, total_delay: Array, xp) -> Array:
         """Instantaneous f(t) in Hz (f64) — the d_phase_d_toa used to convert
         phase residuals to time residuals (reference residuals.get_PSR_freq,
         residuals.py:251)."""
-        dt = dd_to_float(self.dt_dd(params, tensor, total_delay))
-        coeffs = [dd_to_float(c) if isinstance(c, DD) else jnp.asarray(c) for c in self.coeffs(params)]
+        dt = xp.to_f64(self.dt_x(params, tensor, total_delay, xp))
+        coeffs = [leaf_to_f64(c) for c in self.coeffs(params)]
         return taylor_horner_deriv(dt, coeffs, 1)

@@ -25,7 +25,7 @@ from pint_tpu.models.base import (
     epoch_mjd_float,
 )
 from pint_tpu.models.parameter import ParamValueMeta, dd_to_str, format_dms, format_hms
-from pint_tpu.ops.dd import DD, dd, dd_add, dd_neg, dd_rint, dd_to_float
+from pint_tpu.ops.dd import DD, dd_rint
 from pint_tpu.utils.logging import get_logger
 
 log = get_logger("pint_tpu.models")
@@ -43,6 +43,23 @@ class TimingModel:
         self.meta: dict = meta or {}
         self.params: dict = {}
         self.param_meta: dict[str, ParamValueMeta] = {}
+        self._xprec = None  # lazy; see xprec property
+
+    @property
+    def xprec(self):
+        """Extended-precision backend for the phase value path: dd64 on
+        true-f64 platforms, qf32 on TPUs with emulated f64 (ops/xprec.py)."""
+        if self._xprec is None:
+            from pint_tpu.ops.xprec import get_xprec
+
+            self._xprec = get_xprec()
+        return self._xprec
+
+    @xprec.setter
+    def xprec(self, backend):
+        from pint_tpu.ops.xprec import get_xprec
+
+        self._xprec = get_xprec(backend) if isinstance(backend, str) else backend
 
     # --- structure ---------------------------------------------------------------
 
@@ -140,11 +157,17 @@ class TimingModel:
 
         tens = full.tensor()
         from pint_tpu.ops.dd import device_split
+        from pint_tpu.ops.qf32 import qf_split_host
 
         t_hi, t_lo = device_split(tens.t_hi, tens.t_lo)
+        q0, q1, q2, q3 = qf_split_host(tens.t_hi, tens.t_lo)
         out = {
             "t_hi": jnp.asarray(t_hi),
             "t_lo": jnp.asarray(t_lo),
+            "t_q0": jnp.asarray(q0),
+            "t_q1": jnp.asarray(q1),
+            "t_q2": jnp.asarray(q2),
+            "t_q3": jnp.asarray(q3),
             "error_s": jnp.asarray(tens.error_s),
             "freq_mhz": jnp.asarray(tens.freq_mhz),
             "ssb_obs_pos_ls": jnp.asarray(tens.ssb_obs_pos_ls),
@@ -171,23 +194,25 @@ class TimingModel:
             total = total + c.delay(params, tensor, total)
         return total
 
-    def phase(self, params: dict, tensor: dict) -> DD:
-        """Pulse phase in turns (DD), TZR-anchored when AbsPhase is present.
+    def phase(self, params: dict, tensor: dict, xp=None):
+        """Pulse phase in turns (extended precision), TZR-anchored when
+        AbsPhase is present.
 
         With AbsPhase the tensor's last row is the fiducial TOA; its phase is
         subtracted from all rows and the result sliced back to the data rows.
         """
+        xp = xp or self.xprec
         tensor = self._with_context(params, tensor)
         total_delay = jnp.zeros_like(tensor["t_hi"])
         for c in self.delay_components:
             total_delay = total_delay + c.delay(params, tensor, total_delay)
-        ph = dd(jnp.zeros_like(tensor["t_hi"]))
+        ph = xp.zeros_like(tensor["t_hi"])
         for c in self.phase_components:
-            ph = dd_add(ph, c.phase(params, tensor, total_delay))
+            ph = xp.add(ph, c.phase(params, tensor, total_delay, xp))
         if self.has_abs_phase:
-            tzr_phase = DD(ph.hi[-1], ph.lo[-1])
-            ph = DD(ph.hi[:-1], ph.lo[:-1])
-            ph = dd_add(ph, dd_neg(tzr_phase))
+            tzr_phase = xp.index(ph, -1)
+            ph = xp.index(ph, slice(None, -1))
+            ph = xp.add(ph, xp.neg(tzr_phase))
         return ph
 
     def _with_context(self, params: dict, tensor: dict) -> dict:
@@ -197,14 +222,15 @@ class TimingModel:
             tensor["_psr_dir"] = ast.pulsar_direction(params, tensor)
         return tensor
 
-    def spin_frequency(self, params: dict, tensor: dict) -> Array:
+    def spin_frequency(self, params: dict, tensor: dict, xp=None) -> Array:
         """f(t) at each TOA (for phase->time residual conversion)."""
+        xp = xp or self.xprec
         tensor = self._with_context(params, tensor)
         total_delay = jnp.zeros_like(tensor["t_hi"])
         for c in self.delay_components:
             total_delay = total_delay + c.delay(params, tensor, total_delay)
         sd = self["Spindown"]
-        f = sd.spin_frequency(params, tensor, total_delay)
+        f = sd.spin_frequency(params, tensor, total_delay, xp)
         return f[:-1] if self.has_abs_phase else f
 
     # --- reporting / parfile round trip -------------------------------------------
@@ -244,6 +270,4 @@ def _fmt_value(name: str, v, m: ParamValueMeta) -> str:
     return repr(v)
 
 
-def phase_to_residual_frac(ph: DD) -> tuple[Array, DD]:
-    """Split TZR-anchored phase into (nearest pulse number, fractional DD)."""
-    return dd_rint(ph)
+

@@ -46,6 +46,18 @@ def taylor_horner_deriv(x: Array, coeffs: Sequence[Array], deriv_order: int = 1)
     return taylor_horner(x, shifted)
 
 
+def taylor_horner_x(xp, x, coeffs: Sequence) -> object:
+    """Backend-generic Horner: x and result in xp's extended precision;
+    coefficients may be backend leaves (DD/QF) or plain f64."""
+    if len(coeffs) == 0:
+        return xp.zeros_like(x[0] if hasattr(x, "__getitem__") else x)
+    acc = xp.mul_f(xp.lift(coeffs[-1]), 1.0 / _FACT[len(coeffs) - 1])
+    for i in range(len(coeffs) - 2, -1, -1):
+        acc = xp.mul(acc, x)
+        acc = xp.add(acc, xp.mul_f(xp.lift(coeffs[i]), 1.0 / _FACT[i]))
+    return acc
+
+
 def taylor_horner_dd(x: DD, coeffs: Sequence[Union[Array, DD]]) -> DD:
     """Double-double Horner: x is DD, coefficients float64 (or DD).
 

@@ -21,7 +21,6 @@ import jax.numpy as jnp
 import numpy as np
 
 from pint_tpu.models.timing_model import TimingModel
-from pint_tpu.ops.dd import DD, dd_add_fp, dd_rint, dd_to_float
 
 Array = jnp.ndarray
 
@@ -40,15 +39,16 @@ def phase_residual_frac(
     With `track_pn` given (use_pulse_numbers mode) the residual is
     phase - track_pn (+delta), otherwise the nearest-integer fractional part.
     """
-    ph = model.phase(params, tensor)
+    xp = model.xprec
+    ph = model.phase(params, tensor, xp)
     if delta_pn is not None:
-        ph = dd_add_fp(ph, delta_pn)
+        ph = xp.add_f(ph, delta_pn)
     if track_pn is not None:
-        r = dd_to_float(dd_add_fp(ph, -track_pn))
+        r = xp.to_f64(xp.add_f(ph, -track_pn))
         pn = track_pn
     else:
-        pn, frac = dd_rint(ph)
-        r = dd_to_float(frac)
+        pn, frac = xp.rint(ph)
+        r = xp.to_f64(frac)
     if subtract_mean and not model.has_phase_offset:
         if weights is None:
             r = r - jnp.mean(r)
@@ -62,7 +62,7 @@ def get_resid_fn(model: TimingModel, subtract_mean: bool):
     r_time), cached on the model so repeated Residuals construction (downhill
     loops, zero_residuals iterations, grids) never retraces."""
     cache = model.__dict__.setdefault("_resid_fn_cache", {})
-    key = subtract_mean
+    key = (subtract_mean, model.xprec.name)
     if key not in cache:
 
         def fn(params, tensor, track_pn, delta_pn, weights):
@@ -136,6 +136,7 @@ class Residuals:
         return pn, r, r / f
 
     def _phase_fn(self, params, tensor):
+        params = self.model.xprec.convert_params(params)
         return self._jitted(params, tensor, self._track_pn, self._delta_pn, self._weights)
 
     # --- cached views ------------------------------------------------------------

@@ -1,0 +1,176 @@
+"""Quad-float32 arithmetic tests: round-trips and op accuracy vs longdouble
+(hypothesis, mirroring tests/test_dd.py and the reference test_precision.py),
+plus dd64-vs-qf32 backend parity of the full phase function.
+"""
+
+import numpy as np
+import pytest
+from hypothesis import given, settings, strategies as st
+
+# each hypothesis example dispatches dozens of eager device ops; keep example
+# counts modest so the suite stays fast
+fast = settings(max_examples=15, deadline=None)
+
+import jax.numpy as jnp
+
+from pint_tpu.ops import qf32 as qf
+from pint_tpu.ops.qf32 import QF
+
+
+def to_ld(x: QF) -> np.ndarray:
+    return (
+        np.asarray(x.a, np.longdouble)
+        + np.asarray(x.b, np.longdouble)
+        + np.asarray(x.c, np.longdouble)
+        + np.asarray(x.d, np.longdouble)
+    )
+
+
+def from_f64(v: float) -> QF:
+    return qf.qf_from_host(np.float64(v))
+
+
+# qf32 components live in the f32 exponent range: values below ~1e-38 flush
+# to zero. Physical quantities here (seconds, turns, Hz) never get near it;
+# keep test magnitudes above 1e-30 (or exactly 0).
+def _bounded(lo, hi):
+    return st.floats(min_value=lo, max_value=hi, allow_nan=False).filter(
+        lambda v: v == 0.0 or abs(v) > 1e-30
+    )
+
+
+times = _bounded(-2e8, 2e8)
+small = _bounded(-1e3, 1e3)
+
+
+class TestSplitRoundTrip:
+    @fast
+    @given(times)
+    def test_f64_exact(self, x):
+        q = from_f64(x)
+        assert float(to_ld(q)) == x
+
+    @fast
+    @given(times, st.floats(min_value=-1e-5, max_value=1e-5, allow_nan=False))
+    def test_f64_pair(self, hi, lo):
+        """Exact-rational comparison: the dd value spans ~106 bits, beyond
+        longdouble, so Fraction is the only faithful reference."""
+        from fractions import Fraction
+
+        q = qf.qf_from_host(np.float64(hi), np.float64(lo))
+        got = sum(Fraction(float(c)) for c in (q.a, q.b, q.c, q.d))
+        want = Fraction(hi) + Fraction(lo)
+        err = abs(got - want)
+        assert err <= abs(want) * Fraction(1, 2**90) + Fraction(1, 10**30)
+
+
+class TestArithmetic:
+    @fast
+    @given(times, times)
+    def test_add_exact(self, x, y):
+        got = to_ld(qf.qf_add(from_f64(x), from_f64(y)))
+        want = np.longdouble(x) + np.longdouble(y)
+        assert abs(float(got - want)) <= max(abs(x + y), 1.0) * 2**-85
+
+    @fast
+    @given(times, small)
+    def test_mul(self, x, y):
+        got = to_ld(qf.qf_mul(from_f64(x), from_f64(y)))
+        want = np.longdouble(x) * np.longdouble(y)
+        assert abs(float(got - want)) <= max(abs(float(want)), 1.0) * 2**-80
+
+    @fast
+    @given(times, small)
+    def test_add_f64(self, x, f):
+        got = to_ld(qf.qf_add_f64(from_f64(x), jnp.asarray(f, jnp.float64)))
+        want = np.longdouble(x) + np.longdouble(f)
+        assert abs(float(got - want)) <= max(abs(float(want)), 1.0) * 2**-85
+
+    def test_spindown_scale_product(self):
+        """F0 * dt at realistic magnitudes keeps ns-of-phase precision."""
+        f0 = "61.48547655459238"
+        dt = 86400.0 * 1500.0 + 0.123456789
+        from pint_tpu.models.parameter import str_to_dd
+
+        hi, lo = str_to_dd(f0)
+        q = qf.qf_mul(qf.qf_from_host(hi, lo), from_f64(dt))
+        want = (np.longdouble(hi) + np.longdouble(lo)) * np.longdouble(dt)
+        err_turns = abs(float(to_ld(q) - want))
+        assert err_turns < 1e-12  # far below the 1e-9-turn requirement
+
+
+class TestRint:
+    @fast
+    @given(st.floats(min_value=-1e9, max_value=1e9, allow_nan=False), st.floats(min_value=-0.49, max_value=0.49))
+    def test_split_integer_frac(self, n_f, frac):
+        n_true = float(np.rint(n_f))
+        x = qf.qf_add(from_f64(n_true), from_f64(frac))
+        n, rem = qf.qf_rint(x)
+        assert float(np.asarray(n)) == pytest.approx(n_true, abs=0)
+        assert abs(float(to_ld(rem)) - frac) < 1e-9 * max(abs(n_true), 1.0) * 2**-30 + 1e-12
+
+    def test_huge_phase_frac(self):
+        """Phase ~ 1e11 turns with a 1e-9-turn fractional part survives."""
+        big = np.float64(12345678901.0)
+        tiny = np.float64(3.25e-9)
+        x = qf.qf_from_host(big, tiny)
+        n, rem = qf.qf_rint(x)
+        assert float(np.asarray(n)) == 12345678901.0
+        assert float(to_ld(rem)) == pytest.approx(3.25e-9, rel=1e-6)
+
+
+class TestBackendParity:
+    def test_phase_dd64_vs_qf32(self):
+        """The full model phase must agree between backends to ~1e-10 turns
+        (CPU: both arithmetics are exact here, so this checks the qf32
+        algorithm end to end)."""
+        from pint_tpu.io.par import parse_parfile
+        from pint_tpu.models.builder import build_model
+        from pint_tpu.astro import time as ptime
+        from pint_tpu.toas import prepare_arrays
+
+        par = """
+        PSR PARITY
+        RAJ 06:30:00.1 1
+        DECJ -10:30:00.5 1
+        F0 239.58 1
+        F1 -2e-15 1
+        PEPOCH 55100
+        DM 30.5
+        POSEPOCH 55100
+        TZRMJD 55100.3
+        TZRSITE gbt
+        TZRFRQ 1400
+        """
+        m = build_model(parse_parfile(par, from_text=True))
+        utc = ptime.MJDEpoch.from_mjd_float(np.linspace(54600, 55600, 25))
+        toas = prepare_arrays(utc, np.ones(25), np.full(25, 1400.0), np.array(["gbt"] * 25))
+        tensor = m.build_tensor(toas)
+        from pint_tpu.ops.xprec import get_xprec
+
+        dd64, qf32 = get_xprec("dd64"), get_xprec("qf32")
+        ph_dd = m.phase(dd64.convert_params(m.params), tensor, dd64)
+        ph_qf = m.phase(qf32.convert_params(m.params), tensor, qf32)
+        v_dd = np.asarray(ph_dd.hi, np.longdouble) + np.asarray(ph_dd.lo, np.longdouble)
+        v_qf = to_ld(ph_qf)
+        diff = np.abs(v_dd - v_qf)
+        assert np.max(diff) < 1e-9, np.max(diff)
+
+    def test_residuals_qf32_backend(self):
+        """Residuals through the qf32 backend match dd64 to sub-ns."""
+        from pint_tpu.io.par import parse_parfile
+        from pint_tpu.models.builder import build_model
+        from pint_tpu.astro import time as ptime
+        from pint_tpu.toas import prepare_arrays
+        from pint_tpu.residuals import Residuals
+
+        par = "PSR R\nF0 100.0 1\nF1 -1e-14\nPEPOCH 55000\nTZRMJD 55000.5\nTZRSITE @\nTZRFRQ 0\n"
+        m = build_model(parse_parfile(par, from_text=True))
+        utc = ptime.MJDEpoch.from_mjd_float(np.linspace(54900, 55100, 15))
+        toas = prepare_arrays(utc, np.ones(15), np.full(15, np.inf), np.array(["bat"] * 15))
+        m.xprec = "dd64"
+        r1 = Residuals(toas, m, subtract_mean=False).time_resids
+        m.xprec = "qf32"
+        m._resid_fn_cache = {}
+        r2 = Residuals(toas, m, subtract_mean=False).time_resids
+        assert np.max(np.abs(r1 - r2)) < 1e-10
