@@ -88,7 +88,7 @@ def get_step_fn(model: TimingModel, free: tuple[str, ...], subtract_mean: bool):
     from pint_tpu.residuals import phase_residual_frac
 
     def time_resids(params, tensor, track_pn, delta_pn, weights):
-        _, r = phase_residual_frac(
+        _, r, f = phase_residual_frac(
             model,
             params,
             tensor,
@@ -97,7 +97,7 @@ def get_step_fn(model: TimingModel, free: tuple[str, ...], subtract_mean: bool):
             subtract_mean=subtract_mean,
             weights=weights,
         )
-        return r / model.spin_frequency(params, tensor)
+        return r / f
 
     def step(params, tensor, track_pn, delta_pn, weights, errors):
         def rfun(delta):
@@ -122,7 +122,9 @@ def get_step_fn(model: TimingModel, free: tuple[str, ...], subtract_mean: bool):
         chi2_0 = jnp.sum(b * b)
         return r0, M, dx, cov, s, Vt, chi2_0
 
-    cache[key] = jax.jit(step)
+    from pint_tpu.ops.compile import precision_jit
+
+    cache[key] = precision_jit(step)
     return cache[key]
 
 
@@ -176,8 +178,27 @@ class WLSFitter:
 
     # --- host loop ---------------------------------------------------------------
 
-    def fit_toas(self, maxiter: int = 4, xtol: float = 1e-12) -> FitResult:
-        params = self.model.params
+    def _frozen_fit_result(self) -> FitResult:
+        """Degenerate fit with zero free parameters: report chi2/dof of the
+        existing residual settings, no step."""
+        self.result = FitResult(
+            chi2=self.chi2_at(self.model.params),
+            dof=self.resids.dof,
+            iterations=0,
+            converged=True,
+        )
+        return self.result
+
+    def fit_toas(self, maxiter: int = 4, xtol: float = 1e-2) -> FitResult:
+        """Gauss-Newton iteration.  Converged when every parameter step is
+        below `xtol` of its own uncertainty (reference downhill semantics,
+        fitter.py:1196-1240 — a step much smaller than sigma cannot change
+        any reported digit)."""
+        if len(self._free) == 0:
+            return self._frozen_fit_result()
+        # one host-side conversion: on qf32 the fit deltas then take the
+        # exact qf_add_f64 path instead of dd_add on emulated f64
+        params = self.model.xprec.convert_params(self.model.params)
         chi2 = None
         it = 0
         converged = False
@@ -187,7 +208,7 @@ class WLSFitter:
             # convergence: relative step in units of parameter uncertainty
             sigma = jnp.sqrt(jnp.diag(cov))
             rel = np.asarray(jnp.abs(dx) / jnp.where(sigma == 0, 1.0, sigma))
-            if np.all(rel < xtol) or len(self._free) == 0:
+            if np.all(rel < xtol):
                 converged = True
                 break
         from pint_tpu.ops.xprec import params_to_dd
@@ -225,7 +246,9 @@ class DownhillWLSFitter(WLSFitter):
     halve the step (reference DownhillFitter, fitter.py:1145-1274)."""
 
     def fit_toas(self, maxiter: int = 20, min_lambda: float = 1e-3, required_chi2_decrease: float = 1e-2) -> FitResult:
-        params = self.model.params
+        if len(self._free) == 0:
+            return self._frozen_fit_result()
+        params = self.model.xprec.convert_params(self.model.params)
         chi2_best = self.chi2_at(params)
         it = 0
         converged = False

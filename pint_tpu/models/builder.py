@@ -141,6 +141,8 @@ def build_model(pf: ParFile) -> TimingModel:
         components.append(PhaseOffset())
     if "JUMP" in pf:
         components.append(PhaseJump())
+    if "DJUMP" in pf:
+        components.append(DelayJump())
 
     binary = pf.get("BINARY")
     if binary:
@@ -216,8 +218,13 @@ def _collect_component_params(comp: Component, pf: ParFile, model: TimingModel, 
         line, key = _find_entry(pf, spec)
         if line is None:
             if spec.default is not None:
-                model.params[spec.name] = spec.parse(str(spec.default))
-                model.param_meta[spec.name] = ParamValueMeta(spec=spec)
+                # mirror _store_param: only fittable defaults belong in the
+                # jit pytree — config defaults (str/bool, e.g. ECL) go to meta
+                if spec.is_fittable:
+                    model.params[spec.name] = spec.parse(str(spec.default))
+                    model.param_meta[spec.name] = ParamValueMeta(spec=spec)
+                else:
+                    model.meta.setdefault(spec.name, spec.parse(str(spec.default)))
             continue
         consumed.add(key)
         _store_param(model, spec, line, from_alias=key if key != spec.name else None)

@@ -70,6 +70,12 @@ class PhaseJump(PhaseComponent):
     def mask_bases(cls):
         return [ParamSpec("JUMP", unit="s")]
 
+    def validate(self, params, meta):
+        # the phase-domain jump is F0 * jump_seconds; without a spindown F0
+        # the conversion is undefined (reference jump.py d_phase_d_jump)
+        if "F0" not in params:
+            raise ValueError("PhaseJump requires a Spindown F0 in the model")
+
     def phase(self, params, tensor, total_delay, xp):
         total = jnp.zeros_like(tensor["t_hi"])
         for mp in self.mask_params:

@@ -201,6 +201,13 @@ class TimingModel:
         With AbsPhase the tensor's last row is the fiducial TOA; its phase is
         subtracted from all rows and the result sliced back to the data rows.
         """
+        return self.phase_and_freq(params, tensor, xp)[0]
+
+    def phase_and_freq(self, params: dict, tensor: dict, xp=None):
+        """(phase, spin frequency) sharing ONE evaluation of the delay chain
+        — residual construction needs both, and the delay chain is the bulk
+        of the graph (reference computes d_phase_d_toa separately;
+        timing_model.py:1614)."""
         xp = xp or self.xprec
         tensor = self._with_context(params, tensor)
         total_delay = jnp.zeros_like(tensor["t_hi"])
@@ -209,11 +216,20 @@ class TimingModel:
         ph = xp.zeros_like(tensor["t_hi"])
         for c in self.phase_components:
             ph = xp.add(ph, c.phase(params, tensor, total_delay, xp))
+        if "Spindown" in self:
+            f = self["Spindown"].spin_frequency(params, tensor, total_delay, xp)
+        else:
+            # no spindown: phase residuals cannot be converted to time;
+            # f=1 leaves them numerically equal to turns (callers that need
+            # seconds must have F0 — builder always adds Spindown when F0
+            # is present)
+            f = jnp.ones_like(tensor["t_hi"])
         if self.has_abs_phase:
             tzr_phase = xp.index(ph, -1)
             ph = xp.index(ph, slice(None, -1))
             ph = xp.add(ph, xp.neg(tzr_phase))
-        return ph
+            f = f[:-1]
+        return ph, f
 
     def _with_context(self, params: dict, tensor: dict) -> dict:
         ast = self.astrometry
@@ -224,14 +240,7 @@ class TimingModel:
 
     def spin_frequency(self, params: dict, tensor: dict, xp=None) -> Array:
         """f(t) at each TOA (for phase->time residual conversion)."""
-        xp = xp or self.xprec
-        tensor = self._with_context(params, tensor)
-        total_delay = jnp.zeros_like(tensor["t_hi"])
-        for c in self.delay_components:
-            total_delay = total_delay + c.delay(params, tensor, total_delay)
-        sd = self["Spindown"]
-        f = sd.spin_frequency(params, tensor, total_delay, xp)
-        return f[:-1] if self.has_abs_phase else f
+        return self.phase_and_freq(params, tensor, xp)[1]
 
     # --- reporting / parfile round trip -------------------------------------------
 

@@ -221,15 +221,6 @@ def qf_mul(x: QF, y: QF) -> QF:
     return renorm(p0, t1, q00, t2, p3)
 
 
-def qf_mul_f32(x: QF, f: Array) -> QF:
-    f = jnp.asarray(f, F32)
-    p0, e0 = two_prod32(x.a, f)
-    p1, e1 = two_prod32(x.b, f)
-    p2, e2 = two_prod32(x.c, f)
-    p3 = x.d * f
-    return renorm(p0, p1, e0, p2, e1, p3 + e2)
-
-
 def qf_rint(x: QF) -> tuple[Array, QF]:
     """Split into (nearest-integer pulse number as device f64, QF remainder).
 

@@ -133,7 +133,9 @@ class QF32Prec:
         if isinstance(f, (int, float)):
             # static scalar: split exactly on host at trace time
             return qfm.qf_mul(x, qfm.qf_from_host(np.float64(f)))
-        return qfm.qf_mul_f32(x, f)
+        # traced array multiplicand: lift to QF so f64 factors keep their
+        # full precision (a bare f32 cast would drop ~29 bits silently)
+        return qfm.qf_mul(x, qfm.qf_from_f64(jnp.asarray(f, jnp.float64)))
 
     def rint(self, x: QF):
         return qfm.qf_rint(x)

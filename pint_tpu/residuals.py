@@ -33,14 +33,15 @@ def phase_residual_frac(
     delta_pn: Array | None = None,
     subtract_mean: bool = True,
     weights: Array | None = None,
-) -> tuple[Array, Array]:
-    """Pure: -> (pulse_number, frac_phase_residual f64 turns).
+) -> tuple[Array, Array, Array]:
+    """Pure: -> (pulse_number, frac_phase_residual f64 turns, spin freq Hz).
 
     With `track_pn` given (use_pulse_numbers mode) the residual is
     phase - track_pn (+delta), otherwise the nearest-integer fractional part.
+    The spin frequency rides along from the same delay-chain evaluation.
     """
     xp = model.xprec
-    ph = model.phase(params, tensor, xp)
+    ph, f = model.phase_and_freq(params, tensor, xp)
     if delta_pn is not None:
         ph = xp.add_f(ph, delta_pn)
     if track_pn is not None:
@@ -54,7 +55,7 @@ def phase_residual_frac(
             r = r - jnp.mean(r)
         else:
             r = r - jnp.sum(r * weights) / jnp.sum(weights)
-    return pn, r
+    return pn, r, f
 
 
 def get_resid_fn(model: TimingModel, subtract_mean: bool):
@@ -66,7 +67,7 @@ def get_resid_fn(model: TimingModel, subtract_mean: bool):
     if key not in cache:
 
         def fn(params, tensor, track_pn, delta_pn, weights):
-            pn, r = phase_residual_frac(
+            pn, r, f = phase_residual_frac(
                 model,
                 params,
                 tensor,
@@ -75,10 +76,11 @@ def get_resid_fn(model: TimingModel, subtract_mean: bool):
                 subtract_mean=subtract_mean,
                 weights=weights,
             )
-            f = model.spin_frequency(params, tensor)
             return pn, r, r / f
 
-        cache[key] = jax.jit(fn)
+        from pint_tpu.ops.compile import precision_jit
+
+        cache[key] = precision_jit(fn)
     return cache[key]
 
 
@@ -123,7 +125,7 @@ class Residuals:
 
     def _phase_resids_pure(self, params, tensor):
         """Unjitted pure core, for embedding into fitter autodiff."""
-        pn, r = phase_residual_frac(
+        pn, r, f = phase_residual_frac(
             self.model,
             params,
             tensor,
@@ -132,7 +134,6 @@ class Residuals:
             subtract_mean=self.subtract_mean,
             weights=self._weights,
         )
-        f = self.model.spin_frequency(params, tensor)
         return pn, r, r / f
 
     def _phase_fn(self, params, tensor):

@@ -172,5 +172,14 @@ class TestBackendParity:
         r1 = Residuals(toas, m, subtract_mean=False).time_resids
         m.xprec = "qf32"
         m._resid_fn_cache = {}
+        import time
+
+        t0 = time.time()
         r2 = Residuals(toas, m, subtract_mean=False).time_resids
+        elapsed = time.time() - t0
         assert np.max(np.abs(r1 - r2)) < 1e-10
+        # regression guard: XLA:CPU's fusion pass recompute-duplicates deep
+        # qf32 DAGs exponentially (this test took >10 min in round 1);
+        # ops/compile.precision_jit disables that pass on CPU. Compile+run of
+        # this 15-TOA model must stay interactive.
+        assert elapsed < 60.0, f"qf32 resid path took {elapsed:.1f}s — fusion blow-up is back"
