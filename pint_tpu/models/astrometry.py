@@ -73,7 +73,7 @@ class AstrometryBase(DelayComponent):
     def parallax_rad(self, params: dict) -> Array:
         return params.get("PX", jnp.asarray(0.0))
 
-    def delay(self, params: dict, tensor: dict, delay_so_far: Array) -> Array:
+    def delay(self, params: dict, tensor: dict, delay_so_far: Array, xp) -> Array:
         n = self.pulsar_direction(params, tensor)
         r = tensor["ssb_obs_pos_ls"]
         rn = jnp.sum(r * n, axis=-1)

@@ -196,8 +196,12 @@ class Component:
 
     # --- device-side pure functions --------------------------------------------
 
-    def delay(self, params: dict, tensor: dict, delay_so_far: Array) -> Array:
-        """Additional delay in seconds (f64) given accumulated delay."""
+    def delay(self, params: dict, tensor: dict, delay_so_far: Array, xp) -> Array:
+        """Additional delay in seconds (f64) given accumulated delay.
+
+        `xp` is the extended-precision backend — most delays are pure f64
+        and ignore it; the binary component uses it for exact orbital-phase
+        reduction."""
         raise NotImplementedError
 
     def phase(self, params: dict, tensor: dict, total_delay: Array, xp):

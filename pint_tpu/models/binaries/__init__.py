@@ -1,16 +1,6 @@
-"""Binary orbit engines (ELL1 family first; DD family next).
+"""Standalone binary-orbit numerics (reference stand_alone_psr_binaries/).
 
-Registry maps parfile BINARY values to component classes.
+`kepler` holds the differentiable fixed-iteration Kepler solver; `engines`
+the pure delay functions (BT/DD/DDS/ELL1/ELL1H/ELL1k). The PINT-facing
+component that wires them into the delay chain is models/binary.PulsarBinary.
 """
-
-from __future__ import annotations
-
-BINARY_REGISTRY: dict[str, type] = {}
-
-
-def register_binary(name: str):
-    def deco(cls):
-        BINARY_REGISTRY[name] = cls
-        return cls
-
-    return deco

@@ -359,7 +359,7 @@ def _value_str(spec: ParamSpec, v) -> str:
     if isinstance(v, DD):
         if spec.kind == "epoch":
             return epoch_dd_to_mjd_string(v)
-        return dd_to_str(float(np.asarray(v.hi)), float(np.asarray(v.lo)))
+        return dd_to_str(float(np.asarray(v.hi)), float(np.asarray(v.lo)), scale=spec.scale)
     if spec.kind == "hms":
         return format_hms(float(v))
     if spec.kind == "dms":

@@ -79,7 +79,7 @@ class DispersionDM(DelayComponent):
         # taylor_horner on DM_k with factorial scaling — keep its convention
         return taylor_horner(dt, coeffs)
 
-    def delay(self, params: dict, tensor: dict, delay_so_far: Array) -> Array:
+    def delay(self, params: dict, tensor: dict, delay_so_far: Array, xp) -> Array:
         return dispersion_time_delay(self.base_dm(params, tensor), tensor["freq_mhz"])
 
 
@@ -139,5 +139,5 @@ class DispersionDMX(DelayComponent):
         vals = jnp.stack([params[f"DMX_{i:04d}"] for i in self.sorted_indices])
         return tensor["dmx_onehot"] @ vals
 
-    def delay(self, params: dict, tensor: dict, delay_so_far: Array) -> Array:
+    def delay(self, params: dict, tensor: dict, delay_so_far: Array, xp) -> Array:
         return dispersion_time_delay(self.dmx_dm(params, tensor), tensor["freq_mhz"])

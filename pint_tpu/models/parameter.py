@@ -51,23 +51,27 @@ def normalize_number(s: str) -> str:
     return s.replace("D", "e").replace("d", "e")
 
 
-def str_to_dd(s: str) -> tuple[float, float]:
-    """Exact decimal string -> (hi, lo) float64 pair via rational arithmetic.
+def str_to_dd(s: str, scale: float = 1.0) -> tuple[float, float]:
+    """Exact decimal string (x scale) -> (hi, lo) float64 pair via rational
+    arithmetic.
 
     The reference protects F0/epoch precision by parsing into np.longdouble
     (parameter.py str->longdouble paths); we go further: the Fraction round
     trip is exact for any decimal literal, so hi+lo equals the written value
-    to the last printed digit.
+    to the last printed digit. `scale` converts parfile units to internal
+    units (e.g. PB days -> seconds) without an f64 round trip.
     """
-    f = Fraction(normalize_number(s))
+    f = Fraction(normalize_number(s)) * Fraction(scale)
     hi = float(f)
     lo = float(f - Fraction(hi))
     return hi, lo
 
 
-def dd_to_str(hi: float, lo: float, ndigits: int = 26) -> str:
-    """Render hi+lo as a decimal string with ~dd precision (for parfiles)."""
-    f = Fraction(hi) + Fraction(lo)
+def dd_to_str(hi: float, lo: float, ndigits: int = 26, scale: float = 1.0) -> str:
+    """Render (hi+lo)/scale as a decimal string with ~dd precision (for
+    parfiles; `scale` is the same internal-per-parfile-unit factor used by
+    str_to_dd)."""
+    f = (Fraction(hi) + Fraction(lo)) / Fraction(scale)
     sign = "-" if f < 0 else ""
     f = abs(f)
     ip = int(f)
@@ -146,21 +150,32 @@ KINDS = ("float", "dd", "epoch", "hms", "dms", "deg", "bool", "int", "str")
 class ParamSpec:
     name: str
     kind: str = "float"
-    scale: float = 1.0  # parfile-unit -> internal-unit multiplier (kind float)
+    scale: float = 1.0  # parfile-unit -> internal-unit multiplier (float/dd)
     description: str = ""
     aliases: tuple[str, ...] = ()
     default: object = None
     # parfile unit name, for reports
     unit: str = ""
+    # tempo-heritage implicit scaling (reference parameter.py unit_scale):
+    # values with |v| > unit_scale_threshold are multiplied by
+    # unit_scale_factor (e.g. "PBDOT -4.3" means -4.3e-12)
+    unit_scale: bool = False
+    unit_scale_factor: float = 1e-12
+    unit_scale_threshold: float = 1e-7
+
+    def _heuristic(self, v: float) -> float:
+        if self.unit_scale and abs(v) > self.unit_scale_threshold:
+            return v * self.unit_scale_factor
+        return v
 
     def parse(self, token: str):
         """Parfile token -> internal value (host-side, exact where needed)."""
         if self.kind == "float":
-            return float(normalize_number(token)) * self.scale
+            return self._heuristic(float(normalize_number(token))) * self.scale
         if self.kind == "dd":
             from pint_tpu.ops.dd import device_split
 
-            hi, lo = device_split(*str_to_dd(token))
+            hi, lo = device_split(*str_to_dd(token, self.scale))
             return DD(np.float64(hi), np.float64(lo))
         if self.kind == "epoch":
             from pint_tpu.models.base import epoch_dd_from_mjd_string
@@ -180,10 +195,11 @@ class ParamSpec:
 
     def parse_uncertainty(self, token: str) -> float:
         """Parfile uncertainty token -> internal units (always f64)."""
+        token = normalize_number(token)
         if self.kind in ("float",):
-            return float(token) * self.scale
+            return self._heuristic(float(token)) * self.scale
         if self.kind in ("dd",):
-            return float(token)
+            return float(token) * self.scale
         if self.kind == "epoch":
             return float(token) * SECS_PER_DAY
         if self.kind == "hms":

@@ -95,7 +95,7 @@ class DelayJump(DelayComponent):
     def mask_bases(cls):
         return [ParamSpec("DJUMP", unit="s")]
 
-    def delay(self, params, tensor, delay_so_far) -> Array:
+    def delay(self, params, tensor, delay_so_far, xp) -> Array:
         total = jnp.zeros_like(tensor["t_hi"])
         for mp in self.mask_params:
             total = total - tensor[f"mask_{mp.name}"] * params[mp.name]

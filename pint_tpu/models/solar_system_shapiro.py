@@ -53,7 +53,7 @@ class SolarSystemShapiro(DelayComponent):
         if self.planet_shapiro and not meta.get("toas_have_planets", True):
             raise ValueError("PLANET_SHAPIRO set but TOAs lack planet positions")
 
-    def delay(self, params: dict, tensor: dict, delay_so_far: Array) -> Array:
+    def delay(self, params: dict, tensor: dict, delay_so_far: Array, xp) -> Array:
         # pulsar direction from the astrometry component, stashed into the
         # tensor-independent params closure by TimingModel (the reference pulls
         # it from model.ssb_to_psb_xyz_ICRS at each call)

@@ -186,12 +186,13 @@ class TimingModel:
 
     # --- device: the forward pass -------------------------------------------------
 
-    def delay(self, params: dict, tensor: dict) -> Array:
+    def delay(self, params: dict, tensor: dict, xp=None) -> Array:
         """Total delay in seconds, accumulated in DEFAULT_ORDER."""
+        xp = xp or self.xprec
         tensor = self._with_context(params, tensor)
         total = jnp.zeros_like(tensor["t_hi"])
         for c in self.delay_components:
-            total = total + c.delay(params, tensor, total)
+            total = total + c.delay(params, tensor, total, xp)
         return total
 
     def phase(self, params: dict, tensor: dict, xp=None):
@@ -212,7 +213,7 @@ class TimingModel:
         tensor = self._with_context(params, tensor)
         total_delay = jnp.zeros_like(tensor["t_hi"])
         for c in self.delay_components:
-            total_delay = total_delay + c.delay(params, tensor, total_delay)
+            total_delay = total_delay + c.delay(params, tensor, total_delay, xp)
         ph = xp.zeros_like(tensor["t_hi"])
         for c in self.phase_components:
             ph = xp.add(ph, c.phase(params, tensor, total_delay, xp))
