@@ -216,8 +216,16 @@ def qf_mul(x: QF, y: QF) -> QF:
     )
     t1, te1 = two_sum32(p1a, p1b)
     # q00 (error of the leading product) is order-1; keep it a separate
-    # renorm component rather than folding into the order-2 bucket
-    t2 = (p2a + p2b) + (p2c + e1a) + (e1b + te1)
+    # renorm component rather than folding into the order-2 bucket.
+    # The order-2 bucket must itself be summed exactly: its terms are
+    # ~2^-48-relative, so a plain f32 add would inject ~2^-72 errors — the
+    # two_sum residues are order-3 and ride along with p3.
+    s, f1 = two_sum32(p2a, p2b)
+    s, f2 = two_sum32(s, p2c)
+    s, f3 = two_sum32(s, e1a)
+    s, f4 = two_sum32(s, e1b)
+    t2, f5 = two_sum32(s, te1)
+    p3 = p3 + (((f1 + f2) + (f3 + f4)) + f5)
     return renorm(p0, t1, q00, t2, p3)
 
 
