@@ -1,0 +1,179 @@
+#!/usr/bin/env python
+"""Driver benchmark: chi^2-grid throughput on the reference's headline bench.
+
+Re-implements /root/reference/profiling/bench_chisq_grid_WLSFitter.py:30-35 —
+a 3x3 grid over (M2, SINI) of the J0740+6620 model, refitting all other free
+parameters at every grid point — as ONE jitted TPU program
+(pint_tpu/gridutils.py). The reference runs this on ~1e5 real TOAs
+(J0740+6620.cfr+19.tim, not shipped in this environment) in 176.4 s
+⇒ 0.051 grid points/s (profiling/README.txt:62-71); here the same model is
+evaluated on simulated TOAs at the same scale and cadence.
+
+Prints ONE JSON line:
+  {"metric": "chisq_grid_points_per_sec_per_chip", "value": ..., "unit":
+   "points/s/chip", "vs_baseline": ..., ...extra diagnostics}
+
+Env knobs: PINT_TPU_BENCH_NTOAS (default 100000), PINT_TPU_BENCH_PAR,
+PINT_TPU_BENCH_MAXITER (GN refits per point, default 1 — the reference
+WLSFitter.fit_toas default), PINT_TPU_BENCH_REPEATS (default 3).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+BASELINE_PTS_PER_SEC = 9 / 176.437  # profiling/README.txt:62 (i7-6700K)
+
+FALLBACK_PAR = "/root/reference/tests/datafile/NGC6440E.par"
+
+
+def _build_dataset(par_path: str, ntoas: int):
+    from pint_tpu.models.builder import get_model
+    from pint_tpu.simulation import make_fake_toas_uniform
+
+    model = get_model(par_path)
+    start = float(model.meta.get("START", 56640.0))
+    finish = float(model.meta.get("FINISH", 58460.0))
+    rng = np.random.default_rng(2026)
+    # alternate two receivers so dispersion terms stay constrained
+    freqs = np.where(np.arange(ntoas) % 2 == 0, 1450.0, 810.0)
+    toas = make_fake_toas_uniform(
+        start + 0.5,
+        finish - 0.5,
+        ntoas,
+        model,
+        obs="gbt",
+        freq_mhz=freqs,
+        error_us=1.0,
+        add_noise=True,
+        rng=rng,
+    )
+    return model, toas
+
+
+def _residual_parity_ns(model, toas) -> float | None:
+    """Max |TPU-backend − CPU-dd64| time residual (ns), same params/tensor.
+
+    Only meaningful when the default backend is not the CPU: the comparison
+    recompiles the dd64 residual graph for the host CPU (with the CPU fusion
+    workaround, ops/compile.py) and diffs against the device result.
+    """
+    import jax
+
+    if jax.default_backend() == "cpu":
+        return None
+    try:
+        from pint_tpu.ops.xprec import get_xprec
+        from pint_tpu.residuals import Residuals, phase_residual_frac
+
+        res = Residuals(toas, model, subtract_mean=False)
+        r_dev = np.asarray(res.time_resids)
+
+        cpu = jax.devices("cpu")[0]
+        dd = get_xprec("dd64")
+        model._xprec = dd
+
+        def fn(params, tensor):
+            _, r, f = phase_residual_frac(model, params, tensor, subtract_mean=False)
+            return r / f
+
+        p_cpu = jax.device_put(model.params, cpu)
+        t_cpu = jax.device_put(res.tensor, cpu)
+        r_cpu = np.asarray(
+            jax.jit(fn, compiler_options={"xla_disable_hlo_passes": "fusion"})(
+                p_cpu, t_cpu
+            )
+        )
+        return float(np.max(np.abs(r_dev - r_cpu)) * 1e9)
+    finally:
+        model._xprec = None
+
+
+def main() -> None:
+    import jax
+
+    ntoas = int(os.environ.get("PINT_TPU_BENCH_NTOAS", "100000"))
+    maxiter = int(os.environ.get("PINT_TPU_BENCH_MAXITER", "1"))
+    repeats = int(os.environ.get("PINT_TPU_BENCH_REPEATS", "3"))
+    par = os.environ.get(
+        "PINT_TPU_BENCH_PAR", "/root/reference/profiling/J0740+6620.par"
+    )
+    if not os.path.exists(par):
+        par = FALLBACK_PAR
+
+    from pint_tpu.fitting import DownhillWLSFitter
+    from pint_tpu.gridutils import grid_chisq
+
+    t0 = time.time()
+    model, toas = _build_dataset(par, ntoas)
+    setup_s = time.time() - t0
+
+    ftr = DownhillWLSFitter(toas, model)
+    t0 = time.time()
+    ftr.fit_toas(maxiter=5)
+    fit_s = time.time() - t0
+
+    # 3x3 (M2, SINI) grid around the fitted values — the reference grid is
+    # sin(86.25..88.5 deg) x (0.20..0.30 Msun) (bench_chisq_grid_WLSFitter.py:33-34)
+    if "M2" in model.param_meta and "SINI" in model.param_meta:
+        parnames = ("M2", "SINI")
+        grids = (
+            np.linspace(0.20, 0.30, 3),
+            np.sin(np.deg2rad(np.linspace(86.25, 88.5, 3))),
+        )
+    else:  # fallback model without a binary: grid the spin terms
+        f0 = float(np.asarray(model.params["F0"].hi))
+        f1 = float(np.asarray(model.params["F1"].hi))
+        s0 = ftr.result.uncertainties.get("F0", 1e-10)
+        s1 = ftr.result.uncertainties.get("F1", 1e-18)
+        parnames = ("F0", "F1")
+        grids = (np.linspace(f0 - s0, f0 + s0, 3), np.linspace(f1 - s1, f1 + s1, 3))
+
+    run = lambda: grid_chisq(ftr, parnames, grids, maxiter=maxiter, batch=1)
+    t0 = time.time()
+    chi2 = run()  # compile + first run
+    compile_s = time.time() - t0
+
+    times = []
+    for _ in range(repeats):
+        t0 = time.time()
+        chi2 = run()
+        times.append(time.time() - t0)
+    best = min(times)
+    pts_per_sec = chi2.size / best
+
+    parity_ns = _residual_parity_ns(model, toas)
+
+    print(
+        json.dumps(
+            {
+                "metric": "chisq_grid_points_per_sec_per_chip",
+                "value": round(pts_per_sec, 4),
+                "unit": "points/s/chip",
+                "vs_baseline": round(pts_per_sec / BASELINE_PTS_PER_SEC, 2),
+                "grid": "3x3",
+                "grid_params": list(parnames),
+                "ntoas": len(toas),
+                "free_params_refit": len(ftr.model.free_params) - 2,
+                "gn_iters_per_point": maxiter,
+                "grid_wall_s": round(best, 3),
+                "compile_s": round(compile_s, 1),
+                "setup_s": round(setup_s, 1),
+                "initial_fit_s": round(fit_s, 1),
+                "fit_chi2_reduced": round(ftr.result.reduced_chi2, 3),
+                "residual_parity_ns": None if parity_ns is None else round(parity_ns, 3),
+                "backend": jax.default_backend(),
+                "par": os.path.basename(par),
+                "baseline": "bench_chisq_grid_WLSFitter 176.437s/9pts (profiling/README.txt:62)",
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,304 @@
+"""Chi^2 grid scans as one compiled SPMD program.
+
+Reference: pint/gridutils.py:156 (grid_chisq) — the reference deep-copies the
+fitter per grid point and refits in a process pool; its own profiling shows
+~82% of wall time in design-matrix construction + residual evaluation
+(profiling/README.txt:62-71, 176.4 s for a 3x3 grid).
+
+TPU re-design: ONE jitted program evaluates every grid point.
+
+- Per grid point: fix the gridded parameters, run `maxiter` Gauss-Newton
+  refits of the remaining free parameters (design matrix via jacfwd through
+  the extended-precision phase chain, normal equations on the MXU,
+  Cholesky solve), return chi^2.
+- Grid points are a `vmap` batch axis (single chip) and/or a sharded mesh
+  axis (multi chip).
+- The TOA axis can additionally be sharded over the mesh: weighted means,
+  column norms, normal equations G = A^T A, c = A^T b and the final chi^2
+  all reduce with `jax.lax.psum` over the `toa` mesh axis, so the collectives
+  ride ICI while each chip only ever touches its TOA block.
+
+TZR anchoring under TOA sharding: the fiducial TZR row (which the model
+subtracts from every phase, models/timing_model.py:228-232) is REPLICATED
+into every TOA shard as its last local row, so each shard anchors locally
+and no broadcast of the TZR phase is needed.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from pint_tpu.fitting.wls import apply_delta
+from pint_tpu.residuals import phase_residual_frac
+from pint_tpu.utils.logging import get_logger
+
+log = get_logger("pint_tpu.gridutils")
+
+Array = jnp.ndarray
+
+# ridge added to the equilibrated normal equations: keeps the Cholesky solve
+# finite along degenerate directions (the equilibrated G has unit diagonal,
+# so 1e-10 only moves singular values below ~1e-5 of the largest)
+_RIDGE = 1e-10
+
+
+def _point_kernel(model, grid_names, free, subtract_mean, maxiter, toa_axis=None):
+    """Pure per-grid-point chi^2 kernel.
+
+    kernel(vals, params, data) -> scalar chi^2, where
+      vals : (len(grid_names),) f64 values (model-internal units)
+      params : xprec-converted parameter pytree (replicated)
+      data : dict with 'tensor' (model tensor, rows possibly a TOA shard),
+             'w' (1/err^2, zero on padding rows), 'sqrt_w', 'track_pn',
+             'delta_pn' (either may be None).
+
+    With `toa_axis` set, every reduction over the TOA axis is completed with
+    a psum over that mesh axis, making the kernel valid inside shard_map.
+    """
+    xp = model.xprec
+    mean_free = subtract_mean and not model.has_phase_offset
+
+    def _reduce(x):
+        s = jnp.sum(x, axis=0)
+        if toa_axis is not None:
+            s = jax.lax.psum(s, toa_axis)
+        return s
+
+    def _reduce_mat(m):
+        if toa_axis is not None:
+            m = jax.lax.psum(m, toa_axis)
+        return m
+
+    def time_resids(params, data):
+        _, r, f = phase_residual_frac(
+            model,
+            params,
+            data["tensor"],
+            track_pn=data["track_pn"],
+            delta_pn=data["delta_pn"],
+            subtract_mean=False,
+        )
+        r = r / f
+        if mean_free:
+            w = data["w"]
+            r = r - _reduce(w * r) / _reduce(w)
+        return r
+
+    def gn_step(params, data):
+        sw = data["sqrt_w"]
+
+        def rfun(delta):
+            return time_resids(apply_delta(params, free, delta), data)
+
+        z = jnp.zeros(len(free))
+        r0 = rfun(z)
+        M = jax.jacfwd(rfun)(z)  # (N_local, p)
+        A = M * sw[:, None]
+        b = -r0 * sw
+        # global column equilibration (reference fitter.py:2186)
+        col2 = _reduce(A * A)
+        norm = jnp.sqrt(jnp.where(col2 == 0, 1.0, col2))
+        An = A / norm
+        G = _reduce_mat(An.T @ An) + _RIDGE * jnp.eye(len(free))
+        c = _reduce_mat(An.T @ b)
+        dx = jax.scipy.linalg.cho_solve(jax.scipy.linalg.cho_factor(G), c) / norm
+        return apply_delta(params, free, dx)
+
+    def kernel(vals, params, data):
+        params = dict(params)
+        for i, n in enumerate(grid_names):
+            params[n] = xp.lift(vals[i])
+        for _ in range(maxiter if free else 0):
+            params = gn_step(params, data)
+        r = time_resids(params, data)
+        return _reduce(data["w"] * r * r)
+
+    return kernel
+
+
+def _host_data(resids, tensor):
+    """Assemble the kernel's data dict from a Residuals object (host side)."""
+    w = 1.0 / np.asarray(resids.errors_s) ** 2
+    return {
+        "tensor": tensor,
+        "w": jnp.asarray(w),
+        "sqrt_w": jnp.asarray(np.sqrt(w)),
+        "track_pn": resids._track_pn,
+        "delta_pn": resids._delta_pn,
+    }
+
+
+def _shard_data_host(model, data, n_shards):
+    """Re-lay the TOA axis of `data` into `n_shards` equal blocks.
+
+    Each block is [chunk data rows ..., (pad rows), TZR row?]; pad rows get
+    w = sqrt_w = 0 so they drop out of every reduction. Returns (data',
+    n_rows_per_shard_total). All leaves stay host numpy until the caller
+    moves them.
+    """
+    has_tzr = model.has_abs_phase
+    tensor = {k: np.asarray(v) for k, v in data["tensor"].items()}
+    n_rows = next(iter(tensor.values())).shape[0]
+    n_data = n_rows - (1 if has_tzr else 0)
+    chunk = -(-n_data // n_shards)  # ceil
+
+    def lay_tensor(a):
+        tzr = a[-1:] if has_tzr else None
+        body = a[:n_data]
+        pad_row = body[-1:]  # any valid row; weights zero it out
+        blocks = []
+        for k in range(n_shards):
+            blk = body[k * chunk : (k + 1) * chunk]
+            n_pad = chunk - blk.shape[0]
+            parts = [blk]
+            if n_pad:
+                parts.append(np.repeat(pad_row, n_pad, axis=0))
+            if has_tzr:
+                parts.append(tzr)
+            blocks.append(np.concatenate(parts, axis=0))
+        return jnp.asarray(np.concatenate(blocks, axis=0))
+
+    def lay_vec(a, fill=0.0):
+        if a is None:
+            return None
+        a = np.asarray(a)
+        blocks = []
+        for k in range(n_shards):
+            blk = a[k * chunk : (k + 1) * chunk]
+            n_pad = chunk - blk.shape[0]
+            if n_pad:
+                blk = np.concatenate([blk, np.full((n_pad,), fill, a.dtype)])
+            blocks.append(blk)
+        return jnp.asarray(np.concatenate(blocks))
+
+    out = {
+        "tensor": {k: lay_tensor(v) for k, v in tensor.items()},
+        "w": lay_vec(data["w"]),
+        "sqrt_w": lay_vec(data["sqrt_w"]),
+        "track_pn": lay_vec(data["track_pn"]),
+        "delta_pn": lay_vec(data["delta_pn"]),
+    }
+    return out
+
+
+def grid_chisq(
+    fitter,
+    parnames,
+    parvalues,
+    maxiter: int = 1,
+    mesh=None,
+    grid_axis: str = "grid",
+    toa_axis: str = "toa",
+    batch: int | None = None,
+):
+    """Chi^2 over a parameter grid, refitting all other free parameters.
+
+    Mirrors the reference API (pint/gridutils.py:156): `parnames` is a tuple
+    of fittable parameter names, `parvalues` a matching tuple of 1-D value
+    arrays (model-internal units); the result has shape
+    ``np.meshgrid(*parvalues)`` — i.e. ``(len(parvalues[1]),
+    len(parvalues[0]), ...)`` for the default 'xy' indexing.
+
+    maxiter : Gauss-Newton refit iterations per grid point (the reference
+        WLSFitter.fit_toas default is one full linear step).
+    mesh : optional `jax.sharding.Mesh`. Axis `grid_axis` shards the
+        flattened grid points; axis `toa_axis` (if present in the mesh)
+        additionally shards the TOA rows, with psum collectives completing
+        every reduction.
+    batch : grid points evaluated concurrently per chip (vmap width); the
+        rest of the grid streams through `lax.map`. Default: everything at
+        once below 64 points, else 16 per chip.
+    """
+    model = fitter.model
+    resids = fitter.resids
+    if len(parnames) != len(parvalues):
+        raise ValueError(
+            f"{len(parnames)} parameter names but {len(parvalues)} value arrays"
+        )
+    for n in parnames:
+        if n not in model.param_meta:
+            raise KeyError(f"unknown parameter {n}")
+    free = tuple(n for n in model.free_params if n not in parnames)
+
+    grids = np.meshgrid(*[np.asarray(v, np.float64) for v in parvalues])
+    out_shape = grids[0].shape
+    pts = np.stack([g.ravel() for g in grids], axis=1)  # (npts, g)
+    npts = pts.shape[0]
+
+    params = model.xprec.convert_params(model.params)
+    data = _host_data(resids, fitter.tensor)
+
+    if mesh is not None:
+        chi2 = _grid_sharded(
+            model, parnames, free, resids.subtract_mean, maxiter, mesh,
+            grid_axis, toa_axis, pts, params, data,
+        )
+    else:
+        chi2 = _grid_single(
+            model, parnames, free, resids.subtract_mean, maxiter, pts,
+            params, data, batch,
+        )
+    return np.asarray(chi2)[:npts].reshape(out_shape)
+
+
+def _grid_single(model, parnames, free, subtract_mean, maxiter, pts, params, data, batch):
+    from pint_tpu.ops.compile import precision_jit
+
+    kernel = _point_kernel(model, parnames, free, subtract_mean, maxiter)
+    npts = pts.shape[0]
+    if batch is None:
+        batch = npts if npts <= 64 else 16
+    batch = min(batch, npts)
+    n_pad = (-npts) % batch
+    if n_pad:
+        pts = np.concatenate([pts, np.repeat(pts[-1:], n_pad, axis=0)])
+    tiles = jnp.asarray(pts.reshape(-1, batch, pts.shape[1]))
+
+    vk = jax.vmap(kernel, in_axes=(0, None, None))
+    fn = precision_jit(
+        lambda tiles, params, data: jax.lax.map(lambda t: vk(t, params, data), tiles)
+    )
+    return fn(tiles, params, data).reshape(-1)
+
+
+def _grid_sharded(model, parnames, free, subtract_mean, maxiter, mesh,
+                  grid_axis, toa_axis, pts, params, data):
+    from jax.sharding import PartitionSpec as P
+
+    shard_map = jax.shard_map
+
+    if grid_axis not in mesh.shape:
+        raise ValueError(f"mesh has no axis {grid_axis!r}")
+    n_grid = mesh.shape[grid_axis]
+    shard_toas = toa_axis in mesh.shape and mesh.shape[toa_axis] > 1
+    eff_toa_axis = toa_axis if shard_toas else None
+
+    npts = pts.shape[0]
+    n_pad = (-npts) % n_grid
+    if n_pad:
+        pts = np.concatenate([pts, np.repeat(pts[-1:], n_pad, axis=0)])
+    pts = jnp.asarray(pts)
+
+    if shard_toas:
+        data = _shard_data_host(model, data, mesh.shape[toa_axis])
+
+    kernel = _point_kernel(model, parnames, free, subtract_mean, maxiter,
+                           toa_axis=eff_toa_axis)
+    vk = jax.vmap(kernel, in_axes=(0, None, None))
+
+    data_specs = jax.tree.map(
+        lambda _: P(toa_axis) if shard_toas else P(), data
+    )
+    param_specs = jax.tree.map(lambda _: P(), params)
+    fn = shard_map(
+        vk,
+        mesh=mesh,
+        in_specs=(P(grid_axis), param_specs, data_specs),
+        out_specs=P(grid_axis),
+        check_vma=False,
+    )
+    from pint_tpu.ops.compile import precision_jit
+
+    return precision_jit(fn)(pts, params, data)

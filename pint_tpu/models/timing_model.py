@@ -176,11 +176,14 @@ class TimingModel:
         }
         for p, arr in tens.planet_pos_ls.items():
             out[f"obs_{p}_pos_ls"] = jnp.asarray(arr)
+        n_rows = tens.t_hi.shape[0]
         for c in self.components:
             for k, col in c.host_columns(full, self.params).items():
                 col = np.asarray(col, np.float64)
-                if self.has_abs_phase:
-                    col[-1] = 0.0  # TZR row belongs to no mask
+                # TZR row belongs to no mask; aux arrays that aren't
+                # row-indexed (e.g. ECORR column->param maps) pass through
+                if self.has_abs_phase and col.shape[:1] == (n_rows,):
+                    col[-1] = 0.0
                 out[k] = jnp.asarray(col)
         return out
 
