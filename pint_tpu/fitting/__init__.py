@@ -6,4 +6,16 @@ time); here the design matrix is jax.jacfwd of the jitted residual function,
 so one compiled program evaluates residuals + derivatives + the solve.
 """
 
-from pint_tpu.fitting.wls import DownhillWLSFitter, WLSFitter, fit_auto  # noqa: F401
+from pint_tpu.fitting.wls import DownhillWLSFitter, WLSFitter  # noqa: F401
+from pint_tpu.fitting.gls import DownhillGLSFitter, GLSFitter  # noqa: F401
+
+
+def fit_auto(toas, model, downhill: bool = True):
+    """Pick a fitter like the reference Fitter.auto (fitter.py:238): GLS
+    when the model carries correlated noise, WLS otherwise; wideband joins
+    when that milestone lands."""
+    if model.has_correlated_errors:
+        cls = DownhillGLSFitter if downhill else GLSFitter
+    else:
+        cls = DownhillWLSFitter if downhill else WLSFitter
+    return cls(toas, model)

@@ -1,0 +1,245 @@
+"""Generalized least-squares fitting with correlated noise.
+
+Reference: pint/fitter.py GLSFitter:2107-2254 (basis/Woodbury path,
+full_cov=False) and DownhillGLSFitter:1476. The covariance is
+C = diag(sigma^2) + F phi F^T with F the concatenated noise basis
+(ECORR epoch blocks, power-law Fourier modes; models/noise.py). The solve
+augments the design matrix with F and regularizes the noise block by
+1/phi — mathematically identical to the reference's mtcm/phiinv algebra —
+so the whole step is dense MXU matmuls + one Cholesky of a
+(p + k) x (p + k) matrix; the N x N covariance is never materialized.
+
+chi^2 at fixed parameters uses the Woodbury identity:
+    r^T C^-1 r = r^T N^-1 r - d^T S^-1 d,
+    d = F^T N^-1 r,  S = diag(1/phi) + F^T N^-1 F.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pint_tpu.fitting.wls import (
+    FitResult,
+    WLSFitter,
+    apply_delta,
+)
+from pint_tpu.models.timing_model import TimingModel
+from pint_tpu.utils.logging import get_logger
+
+log = get_logger("pint_tpu.fitting")
+
+Array = jnp.ndarray
+
+# tiny ridge on the normalized timing block: keeps the Cholesky finite on
+# exactly-degenerate columns (reference falls back to SVD there; the ridge
+# pins the degenerate direction's step to ~0 instead)
+_RIDGE = 1e-12
+
+
+def _gls_pieces(model: TimingModel, free, subtract_mean):
+    from pint_tpu.residuals import phase_residual_frac
+
+    def time_resids(params, tensor, track_pn, delta_pn, weights):
+        _, r, f = phase_residual_frac(
+            model, params, tensor,
+            track_pn=track_pn, delta_pn=delta_pn,
+            subtract_mean=subtract_mean, weights=weights,
+        )
+        return r / f
+
+    return time_resids
+
+
+def get_gls_step_fn(model: TimingModel, free, subtract_mean: bool):
+    """Jitted GLS step: (params, tensor, track_pn, delta_pn, weights, sigma)
+    -> (r0, M, dx, cov, chi2_0, ahat). Cached per model/free-set."""
+    cache = model.__dict__.setdefault("_gls_step_cache", {})
+    key = (free, subtract_mean, model.xprec.name)
+    if key in cache:
+        return cache[key]
+
+    time_resids = _gls_pieces(model, free, subtract_mean)
+    p = len(free)
+
+    def step(params, tensor, track_pn, delta_pn, weights, sigma):
+        def rfun(delta):
+            return time_resids(
+                apply_delta(params, free, delta), tensor, track_pn, delta_pn, weights
+            )
+
+        z = jnp.zeros(p)
+        r0, lin = jax.linearize(rfun, z)
+        M = jax.vmap(lin)(jnp.eye(p)).T  # (N, p), one primal evaluation
+        cinv = 1.0 / sigma**2
+
+        pair = model.noise_basis_and_weights(params, tensor)
+        if pair is None:
+            Maug = M
+            phiinv = jnp.zeros(p)
+        else:
+            F, phi = pair
+            Maug = jnp.concatenate([M, F], axis=1)
+            phiinv = jnp.concatenate([jnp.zeros(p), 1.0 / phi])
+
+        norm = jnp.sqrt(jnp.sum(Maug**2, axis=0))
+        norm = jnp.where(norm == 0, 1.0, norm)
+        Mn = Maug / norm
+        phiinv_n = phiinv / norm**2
+        mtcm = Mn.T @ (cinv[:, None] * Mn) + jnp.diag(phiinv_n + _RIDGE)
+        mtcy = Mn.T @ (cinv * (-r0))
+        cf = jax.scipy.linalg.cho_factor(mtcm)
+        xhat = jax.scipy.linalg.cho_solve(cf, mtcy)
+        # only the p x p timing block of the covariance is consumed: solve
+        # p right-hand sides, not p + k
+        xvar_p = jax.scipy.linalg.cho_solve(cf, jnp.eye(mtcm.shape[0])[:, :p])
+        dx_aug = xhat / norm
+        dx = dx_aug[:p]
+        cov = (xvar_p[:p, :] / norm[:p]).T / norm[:p]
+        # GLS chi^2 at the CURRENT params (Woodbury; for the downhill
+        # accept/reject decision and reporting)
+        if pair is None:
+            chi2_0 = jnp.sum(cinv * r0 * r0)
+            ahat = jnp.zeros(0)
+        else:
+            d = F.T @ (cinv * r0)
+            S = jnp.diag(1.0 / phi) + F.T @ (cinv[:, None] * F)
+            cfS = jax.scipy.linalg.cho_factor(S)
+            Sd = jax.scipy.linalg.cho_solve(cfS, d)
+            chi2_0 = jnp.sum(cinv * r0 * r0) - d @ Sd
+            ahat = Sd  # ML noise-coefficient realization at current params
+        return r0, M, dx, cov, chi2_0, ahat
+
+    from pint_tpu.ops.compile import precision_jit
+
+    cache[key] = precision_jit(step)
+    return cache[key]
+
+
+def get_gls_chi2_fn(model: TimingModel, subtract_mean: bool):
+    """Jitted Woodbury chi^2 at fixed params (no design matrix)."""
+    cache = model.__dict__.setdefault("_gls_chi2_cache", {})
+    key = (subtract_mean, model.xprec.name)
+    if key in cache:
+        return cache[key]
+
+    time_resids = _gls_pieces(model, (), subtract_mean)
+
+    def chi2fn(params, tensor, track_pn, delta_pn, weights, sigma):
+        r = time_resids(params, tensor, track_pn, delta_pn, weights)
+        cinv = 1.0 / sigma**2
+        pair = model.noise_basis_and_weights(params, tensor)
+        if pair is None:
+            return jnp.sum(cinv * r * r)
+        F, phi = pair
+        d = F.T @ (cinv * r)
+        S = jnp.diag(1.0 / phi) + F.T @ (cinv[:, None] * F)
+        Sd = jax.scipy.linalg.cho_solve(jax.scipy.linalg.cho_factor(S), d)
+        return jnp.sum(cinv * r * r) - d @ Sd
+
+    from pint_tpu.ops.compile import precision_jit
+
+    cache[key] = precision_jit(chi2fn)
+    return cache[key]
+
+
+def gls_chi2(resids) -> float:
+    """GLS chi^2 of a Residuals object at its current model params."""
+    model = resids.model
+    fn = get_gls_chi2_fn(model, resids.subtract_mean)
+    params = model.xprec.convert_params(model.params)
+    return float(
+        fn(
+            params,
+            resids.tensor,
+            resids._track_pn,
+            resids._delta_pn,
+            resids._weights,
+            jnp.asarray(resids.errors_s),
+        )
+    )
+
+
+class GLSFitter(WLSFitter):
+    """Iterated linear GLS (reference GLSFitter.fit_toas, fitter.py:2122)."""
+
+    def _step_fn(self, params, tensor):
+        r = self.resids
+        fn = get_gls_step_fn(self.model, self._free, r.subtract_mean)
+        params = self.model.xprec.convert_params(params)
+        return fn(
+            params, tensor, r._track_pn, r._delta_pn, r._weights,
+            jnp.asarray(r.errors_s),
+        )
+
+    def chi2_at(self, params: dict) -> float:
+        fn = get_gls_chi2_fn(self.model, self.resids.subtract_mean)
+        params = self.model.xprec.convert_params(params)
+        r = self.resids
+        return float(
+            fn(params, self.tensor, r._track_pn, r._delta_pn, r._weights,
+               jnp.asarray(r.errors_s))
+        )
+
+    def fit_toas(self, maxiter: int = 1, xtol: float = 1e-2) -> FitResult:
+        if len(self._free) == 0:
+            return self._frozen_fit_result()
+        params = self.model.xprec.convert_params(self.model.params)
+        it = 0
+        converged = False
+        for it in range(1, maxiter + 1):
+            r0, M, dx, cov, chi2_0, ahat = self._step_fn(params, self.tensor)
+            params = apply_delta(params, self._free, dx)
+            sigma = jnp.sqrt(jnp.diag(cov))
+            rel = np.asarray(jnp.abs(dx) / jnp.where(sigma == 0, 1.0, sigma))
+            if np.all(rel < xtol):
+                converged = True
+                break
+        self.noise_ampls = np.asarray(ahat)
+        return self._finalize_fit(params, self.chi2_at(params), it, converged, cov)
+
+    def noise_realization(self) -> np.ndarray | None:
+        """Maximum-likelihood correlated-noise waveform F @ ahat (seconds)
+        at the fitted params (reference Residuals.noise_resids)."""
+        pair_fn = getattr(self.model, "noise_basis_and_weights")
+        params = self.model.xprec.convert_params(self.model.params)
+        pair = pair_fn(params, self.tensor)
+        if pair is None or self.noise_ampls.size == 0:
+            return None
+        F, _ = pair
+        return np.asarray(F @ jnp.asarray(self.noise_ampls))
+
+
+class DownhillGLSFitter(GLSFitter):
+    """Damped GLS (reference DownhillGLSFitter, fitter.py:1476): accept a
+    step only if the Woodbury chi^2 decreases, else halve it."""
+
+    def fit_toas(self, maxiter: int = 20, min_lambda: float = 1e-3,
+                 required_chi2_decrease: float = 1e-2) -> FitResult:
+        if len(self._free) == 0:
+            return self._frozen_fit_result()
+        params = self.model.xprec.convert_params(self.model.params)
+        chi2_best = self.chi2_at(params)
+        it = 0
+        converged = False
+        ahat = jnp.zeros(0)
+        for it in range(1, maxiter + 1):
+            r0, M, dx, cov, chi2_0, ahat = self._step_fn(params, self.tensor)
+            lam = 1.0
+            improved = False
+            while lam >= min_lambda:
+                trial = apply_delta(params, self._free, lam * dx)
+                chi2_trial = self.chi2_at(trial)
+                if chi2_trial <= chi2_best:
+                    improved = chi2_best - chi2_trial > required_chi2_decrease
+                    params, chi2_best = trial, chi2_trial
+                    break
+                lam *= 0.5
+            if not improved:
+                converged = True
+                break
+        else:
+            log.warning(f"downhill GLS fit hit maxiter={maxiter}")
+        self.noise_ampls = np.asarray(ahat)
+        return self._finalize_fit(params, chi2_best, it, converged, cov)

@@ -211,34 +211,44 @@ class WLSFitter:
             if np.all(rel < xtol):
                 converged = True
                 break
+        return self._finalize_fit(
+            params, self.chi2_at(params), it, converged, cov, s=s, vt=vt
+        )
+
+    def designmatrix(self) -> np.ndarray:
+        """(N, p) d time-resid / d free-param, for inspection/tests.
+
+        Works for every fitter variant: M is the second element of each
+        step tuple (WLS and GLS)."""
+        return np.asarray(self._step_fn(self.model.params, self.tensor)[1])
+
+    def _finalize_fit(self, params, chi2: float, it: int, converged: bool,
+                      cov, s=None, vt=None) -> FitResult:
+        """Shared fit tail: write back params/uncertainties, rebuild
+        residuals, assemble the FitResult."""
         from pint_tpu.ops.xprec import params_to_dd
 
         self.model.params = params_to_dd(params)
-        chi2_final = self.chi2_at(params)
         cov = np.asarray(cov)
-        s = np.asarray(s)
-        degenerate = self._degenerate_params(s, np.asarray(vt))
         unc = dict(zip(self._free, np.sqrt(np.diag(cov))))
         for n, u in unc.items():
             self.model.param_meta[n].uncertainty = float(u)
+        degenerate = []
+        if s is not None and vt is not None:
+            degenerate = self._degenerate_params(np.asarray(s), np.asarray(vt))
         self.resids = self._rebuild_resids()
         self.result = FitResult(
-            chi2=chi2_final,
+            chi2=chi2,
             dof=self.resids.dof,
             iterations=it,
             converged=converged,
             uncertainties=unc,
             covariance=cov,
             free_params=list(self._free),
-            singular_values=s,
+            singular_values=None if s is None else np.asarray(s),
             degenerate=degenerate,
         )
         return self.result
-
-    def designmatrix(self) -> np.ndarray:
-        """(N, p) d time-resid / d free-param, for inspection/tests."""
-        r0, M, dx, cov, s, vt, chi2 = self._step_fn(self.model.params, self.tensor)
-        return np.asarray(M)
 
 
 class DownhillWLSFitter(WLSFitter):
@@ -269,29 +279,6 @@ class DownhillWLSFitter(WLSFitter):
                 break
         else:
             log.warning(f"downhill fit hit maxiter={maxiter}")
-        from pint_tpu.ops.xprec import params_to_dd
-
-        self.model.params = params_to_dd(params)
-        cov = np.asarray(cov)
-        unc = dict(zip(self._free, np.sqrt(np.diag(cov))))
-        for n, u in unc.items():
-            self.model.param_meta[n].uncertainty = float(u)
-        self.resids = self._rebuild_resids()
-        self.result = FitResult(
-            chi2=chi2_best,
-            dof=self.resids.dof,
-            iterations=it,
-            converged=converged,
-            uncertainties=unc,
-            covariance=cov,
-            free_params=list(self._free),
-            singular_values=np.asarray(s),
-        )
-        return self.result
+        return self._finalize_fit(params, chi2_best, it, converged, cov, s=s)
 
 
-def fit_auto(toas, model: TimingModel, downhill: bool = True):
-    """Pick a fitter like the reference Fitter.auto (fitter.py:238); GLS and
-    wideband variants join as the noise/wideband milestones land."""
-    cls = DownhillWLSFitter if downhill else WLSFitter
-    return cls(toas, model)

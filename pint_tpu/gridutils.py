@@ -59,6 +59,8 @@ def _point_kernel(model, grid_names, free, subtract_mean, maxiter, toa_axis=None
     """
     xp = model.xprec
     mean_free = subtract_mean and not model.has_phase_offset
+    correlated = model.has_correlated_errors
+    p = len(free)
 
     def _reduce(x):
         s = jnp.sum(x, axis=0)
@@ -87,24 +89,33 @@ def _point_kernel(model, grid_names, free, subtract_mean, maxiter, toa_axis=None
         return r
 
     def gn_step(params, data):
+        """One GLS/WLS Gauss-Newton refit: with correlated noise the design
+        matrix is augmented with the noise basis and the noise block
+        regularized by 1/phi (same algebra as fitting/gls.py)."""
         sw = data["sqrt_w"]
 
         def rfun(delta):
             return time_resids(apply_delta(params, free, delta), data)
 
-        z = jnp.zeros(len(free))
-        r0 = rfun(z)
-        M = jax.jacfwd(rfun)(z)  # (N_local, p)
+        z = jnp.zeros(p)
+        r0, lin = jax.linearize(rfun, z)
+        M = jax.vmap(lin)(jnp.eye(p)).T  # (N_local, p)
         A = M * sw[:, None]
         b = -r0 * sw
+        if correlated:
+            F, phi = model.noise_basis_and_weights(params, data["tensor"])
+            A = jnp.concatenate([A, F * sw[:, None]], axis=1)
+            phiinv = jnp.concatenate([jnp.zeros(p), 1.0 / phi])
+        else:
+            phiinv = jnp.zeros(p)
         # global column equilibration (reference fitter.py:2186)
         col2 = _reduce(A * A)
         norm = jnp.sqrt(jnp.where(col2 == 0, 1.0, col2))
         An = A / norm
-        G = _reduce_mat(An.T @ An) + _RIDGE * jnp.eye(len(free))
+        G = _reduce_mat(An.T @ An) + jnp.diag(phiinv / norm**2 + _RIDGE)
         c = _reduce_mat(An.T @ b)
         dx = jax.scipy.linalg.cho_solve(jax.scipy.linalg.cho_factor(G), c) / norm
-        return apply_delta(params, free, dx)
+        return apply_delta(params, free, dx[:p])
 
     def kernel(vals, params, data):
         params = dict(params)
@@ -113,7 +124,16 @@ def _point_kernel(model, grid_names, free, subtract_mean, maxiter, toa_axis=None
         for _ in range(maxiter if free else 0):
             params = gn_step(params, data)
         r = time_resids(params, data)
-        return _reduce(data["w"] * r * r)
+        w = data["w"]
+        chi2_w = _reduce(w * r * r)
+        if not correlated:
+            return chi2_w
+        # Woodbury GLS chi^2 (fitting/gls.py docstring)
+        F, phi = model.noise_basis_and_weights(params, data["tensor"])
+        d = _reduce_mat(F.T @ (w * r))
+        S = jnp.diag(1.0 / phi) + _reduce_mat(F.T @ (w[:, None] * F))
+        Sd = jax.scipy.linalg.cho_solve(jax.scipy.linalg.cho_factor(S), d)
+        return chi2_w - d @ Sd
 
     return kernel
 
@@ -134,13 +154,13 @@ def _shard_data_host(model, data, n_shards):
     """Re-lay the TOA axis of `data` into `n_shards` equal blocks.
 
     Each block is [chunk data rows ..., (pad rows), TZR row?]; pad rows get
-    w = sqrt_w = 0 so they drop out of every reduction. Returns (data',
-    n_rows_per_shard_total). All leaves stay host numpy until the caller
-    moves them.
+    w = sqrt_w = 0 so they drop out of every reduction. Returns
+    (data', specs') where specs' marks each leaf sharded (True) or
+    replicated (False).
     """
     has_tzr = model.has_abs_phase
     tensor = {k: np.asarray(v) for k, v in data["tensor"].items()}
-    n_rows = next(iter(tensor.values())).shape[0]
+    n_rows = tensor["t_hi"].shape[0]
     n_data = n_rows - (1 if has_tzr else 0)
     chunk = -(-n_data // n_shards)  # ceil
 
@@ -173,14 +193,27 @@ def _shard_data_host(model, data, n_shards):
             blocks.append(blk)
         return jnp.asarray(np.concatenate(blocks))
 
+    # non-row-indexed aux entries (noise_tspan, ecorr_widx, ...) stay
+    # replicated; only row-indexed leaves are re-laid into shards
+    row_keys = {k for k, v in tensor.items() if v.shape[:1] == (n_rows,)}
     out = {
-        "tensor": {k: lay_tensor(v) for k, v in tensor.items()},
+        "tensor": {
+            k: (lay_tensor(v) if k in row_keys else jnp.asarray(v))
+            for k, v in tensor.items()
+        },
         "w": lay_vec(data["w"]),
         "sqrt_w": lay_vec(data["sqrt_w"]),
         "track_pn": lay_vec(data["track_pn"]),
         "delta_pn": lay_vec(data["delta_pn"]),
     }
-    return out
+    sharded = {
+        "tensor": {k: k in row_keys for k in tensor},
+        "w": True,
+        "sqrt_w": True,
+        "track_pn": None if data["track_pn"] is None else True,
+        "delta_pn": None if data["delta_pn"] is None else True,
+    }
+    return out, sharded
 
 
 def grid_chisq(
@@ -282,15 +315,17 @@ def _grid_sharded(model, parnames, free, subtract_mean, maxiter, mesh,
     pts = jnp.asarray(pts)
 
     if shard_toas:
-        data = _shard_data_host(model, data, mesh.shape[toa_axis])
+        data, sharded = _shard_data_host(model, data, mesh.shape[toa_axis])
+        data_specs = jax.tree.map(
+            lambda s: P(toa_axis) if s else P(), sharded,
+            is_leaf=lambda x: isinstance(x, bool),
+        )
+    else:
+        data_specs = jax.tree.map(lambda _: P(), data)
 
     kernel = _point_kernel(model, parnames, free, subtract_mean, maxiter,
                            toa_axis=eff_toa_axis)
     vk = jax.vmap(kernel, in_axes=(0, None, None))
-
-    data_specs = jax.tree.map(
-        lambda _: P(toa_axis) if shard_toas else P(), data
-    )
     param_specs = jax.tree.map(lambda _: P(), params)
     fn = shard_map(
         vk,

@@ -68,24 +68,8 @@ IGNORED_KEYS = {
     "BADTOA",
 }
 
-# noise / not-yet-built families: consumed by later milestones, warned for now
+# not-yet-built families: consumed by later milestones, warned for now
 PENDING_KEYS = {
-    "EFAC",
-    "EQUAD",
-    "T2EFAC",
-    "T2EQUAD",
-    "ECORR",
-    "TNECORR",
-    "DMEFAC",
-    "DMEQUAD",
-    "RNAMP",
-    "RNIDX",
-    "TNREDAMP",
-    "TNREDGAM",
-    "TNREDC",
-    "TNDMAMP",
-    "TNDMGAM",
-    "TNDMC",
     "NE_SW",
     "SOLARN0",
     "CORRECT_TROPOSPHERE",
@@ -151,6 +135,27 @@ def build_model(pf: ParFile) -> TimingModel:
         components.append(make_binary_component(binary.upper(), pf))
         consumed.add("BINARY")
 
+    # noise components by parameter presence (reference model_builder
+    # choose_model + noise_model.py families)
+    from pint_tpu.models.noise import (
+        EcorrNoise,
+        PLDMNoise,
+        PLRedNoise,
+        ScaleDmError,
+        ScaleToaError,
+    )
+
+    if any(k in pf for k in ("EFAC", "T2EFAC", "EQUAD", "T2EQUAD")):
+        components.append(ScaleToaError())
+    if any(k in pf for k in ("ECORR", "TNECORR")):
+        components.append(EcorrNoise())
+    if ("RNAMP" in pf and "RNIDX" in pf) or "TNREDAMP" in pf:
+        components.append(PLRedNoise())
+    if "TNDMAMP" in pf:
+        components.append(PLDMNoise())
+    if "DMEFAC" in pf or "DMEQUAD" in pf:
+        components.append(ScaleDmError())
+
     model = TimingModel(components, meta)
 
     # --- parameter collection ---------------------------------------------------
@@ -167,6 +172,20 @@ def build_model(pf: ParFile) -> TimingModel:
     for comp in model.components:
         if isinstance(comp, DispersionDMX):
             _collect_dmx(comp, pf, model, consumed)
+
+    # noise parameters are fixed inputs to WLS/GLS (the reference fitters
+    # likewise refuse to fit them; they are sampled by the Bayesian/MCMC
+    # path instead) — force-freeze, warning if the parfile marked them free
+    from pint_tpu.models.noise import NoiseComponent
+
+    for comp in model.components:
+        if not isinstance(comp, NoiseComponent):
+            continue
+        for pname in comp.specs:
+            pm = model.param_meta.get(pname)
+            if pm is not None and not pm.frozen:
+                log.warning(f"noise parameter {pname} cannot be fit by WLS/GLS; freezing")
+                pm.frozen = True
 
     # --- leftovers ---------------------------------------------------------------
     for name in pf.names():
@@ -257,7 +276,11 @@ def _store_param(model: TimingModel, spec: ParamSpec, line, from_alias=None):
 
 
 def _collect_mask_params(comp, base_spec: ParamSpec, pf: ParFile, model: TimingModel, consumed: set):
-    lines = pf.get_all(base_spec.name)
+    lines = []
+    for key in (base_spec.name, *base_spec.aliases):
+        if key in pf:
+            lines.extend(pf.get_all(key))
+            consumed.add(key)
     for i, line in enumerate(lines, start=1):
         clause, rest = parse_mask_clause(line.tokens)
         name = f"{base_spec.name}{i}"

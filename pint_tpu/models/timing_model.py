@@ -103,6 +103,47 @@ class TimingModel:
     def free_params(self) -> list[str]:
         return [n for n, m in self.param_meta.items() if not m.frozen]
 
+    # --- noise surface (models/noise.py) -----------------------------------------
+
+    @property
+    def noise_components(self) -> list[Component]:
+        from pint_tpu.models.noise import NoiseComponent
+
+        return [c for c in self.components if isinstance(c, NoiseComponent)]
+
+    @property
+    def has_correlated_errors(self) -> bool:
+        return any(
+            getattr(c, "introduces_correlated_errors", False) for c in self.components
+        )
+
+    def scaled_sigma(self, params: dict, tensor: dict) -> Array:
+        """Noise-rescaled per-TOA sigma (seconds), DATA rows only (reference
+        scaled_toa_uncertainty, timing_model.py via ScaleToaError)."""
+        sigma = tensor["error_s"]
+        for c in self.noise_components:
+            sigma = c.scale_sigma(params, tensor, sigma)
+        if self.has_abs_phase:
+            sigma = sigma[:-1]
+        return sigma
+
+    def noise_basis_and_weights(self, params: dict, tensor: dict):
+        """Concatenated correlated-noise basis F (N_data, k) and prior
+        variances phi (k,), or None (reference noise_model_designmatrix /
+        noise_model_basis_weight, timing_model.py)."""
+        import jax.numpy as _jnp
+
+        sl = slice(None, -1) if self.has_abs_phase else slice(None)
+        Fs, phis = [], []
+        for c in self.noise_components:
+            pair = c.basis_and_weights(params, tensor, sl)
+            if pair is not None:
+                Fs.append(pair[0])
+                phis.append(pair[1])
+        if not Fs:
+            return None
+        return _jnp.concatenate(Fs, axis=1), _jnp.concatenate(phis)
+
     def set_free(self, names: list[str]) -> None:
         for n in names:
             if n not in self.param_meta:

@@ -116,8 +116,15 @@ class Residuals:
         self._delta_pn = (
             jnp.asarray(tens.delta_pulse_number) if tens.delta_pulse_number is not None else None
         )
-        # 1/error^2 weights over the DATA rows (tensor may carry a TZR row)
-        self.errors_s = np.asarray(tens.error_s)
+        # 1/error^2 weights over the DATA rows (tensor may carry a TZR row).
+        # With noise components the sigmas are EFAC/EQUAD-rescaled (treated
+        # as fixed inputs to the least-squares fits, like the reference).
+        self.raw_errors_s = np.asarray(tens.error_s)
+        if model.noise_components:
+            sigma = model.scaled_sigma(model.params, self.tensor)
+            self.errors_s = np.asarray(sigma)
+        else:
+            self.errors_s = self.raw_errors_s
         self._weights = jnp.asarray(1.0 / self.errors_s**2)
 
         self._jitted = get_resid_fn(model, subtract_mean)
@@ -178,7 +185,14 @@ class Residuals:
         return float(np.sqrt(np.sum(w * (r - mean) ** 2) / np.sum(w)))
 
     def calc_chi2(self) -> float:
-        """Uncorrelated (white) chi^2; the GLS chi^2 lives in fitting.gls."""
+        """Chi^2 of the residuals: white (scaled sigmas) normally, the
+        generalized (correlated-noise marginalized) form when the model has
+        correlated components (reference residuals.py calc_chi2:470, which
+        likewise dispatches on correlated errors)."""
+        if self.model.has_correlated_errors:
+            from pint_tpu.fitting.gls import gls_chi2
+
+            return gls_chi2(self)
         r = self.time_resids
         return float(np.sum((r / self.errors_s) ** 2))
 

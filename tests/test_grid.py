@@ -111,3 +111,40 @@ class TestGridSharded:
         mesh = Mesh(np.array(jax.devices()[:8]).reshape(2, 4), ("grid", "toa"))
         sharded = grid_chisq(fitted, ("F0", "F1"), (g_f0, g_f1), maxiter=1, mesh=mesh)
         np.testing.assert_allclose(sharded, single, rtol=1e-8)
+
+
+class TestGridCorrelatedNoise:
+    """Grids on a correlated-noise (ECORR) model use the Woodbury GLS chi^2
+    and the noise-augmented refit — consistent with Residuals.calc_chi2 and
+    the GLS fitter, on one device and sharded."""
+
+    @pytest.fixture(scope="class")
+    def gls_fitted(self):
+        from pint_tpu.fitting import DownhillGLSFitter
+        from tests.test_noise import _model, _epoch_toas
+
+        m = _model("ECORR -f be1 3.0\n")
+        toas = _epoch_toas(m, n_epochs=20, per_epoch=2)
+        for f in toas.flags:
+            f["f"] = "be1"
+        rng = np.random.default_rng(5)
+        from pint_tpu.simulation import _reprepare
+
+        noise = np.repeat(rng.standard_normal(20) * 3.0, 2) + rng.standard_normal(40)
+        toas = _reprepare(toas, noise * 1e-6)
+        ftr = DownhillGLSFitter(toas, m)
+        ftr.fit_toas(maxiter=6)
+        return ftr
+
+    def test_center_matches_gls_chi2(self, gls_fitted):
+        g_f0, g_f1 = _grids(gls_fitted)
+        chi2 = grid_chisq(gls_fitted, ("F0", "F1"), (g_f0, g_f1), maxiter=2)
+        assert chi2[1, 1] == pytest.approx(gls_fitted.result.chi2, rel=1e-4)
+        assert np.all(chi2 >= gls_fitted.result.chi2 - 1e-6)
+
+    def test_sharded_matches_single(self, gls_fitted):
+        g_f0, g_f1 = _grids(gls_fitted)
+        single = grid_chisq(gls_fitted, ("F0", "F1"), (g_f0, g_f1), maxiter=1)
+        mesh = Mesh(np.array(jax.devices()[:8]).reshape(2, 4), ("grid", "toa"))
+        sharded = grid_chisq(gls_fitted, ("F0", "F1"), (g_f0, g_f1), maxiter=1, mesh=mesh)
+        np.testing.assert_allclose(sharded, single, rtol=1e-8)

@@ -1,0 +1,229 @@
+"""Noise models + GLS fitting.
+
+Strategy mirrors the reference suite (test_white_noise.py, test_ecorr*.py,
+test_gls_fitter.py, SURVEY.md §4): analytic checks of the scaling/basis
+conventions, simulation closure (GLS recovers truth from data with injected
+correlated noise), and the white-noise limit where GLS must agree with WLS.
+"""
+
+import numpy as np
+import pytest
+
+from pint_tpu.io.par import parse_parfile
+from pint_tpu.models.builder import build_model
+from pint_tpu.fitting import DownhillGLSFitter, GLSFitter, WLSFitter, fit_auto
+from pint_tpu.residuals import Residuals
+from pint_tpu.simulation import make_fake_toas_uniform
+from pint_tpu.toas import prepare_arrays
+from pint_tpu.astro import time as ptime
+
+BASE_PAR = """
+PSR NOISEFAKE
+RAJ 05:00:00 1
+DECJ 20:00:00 1
+F0 300.123456789 1
+F1 -1.5e-15 1
+PEPOCH 55500
+POSEPOCH 55500
+DM 15.0 1
+TZRMJD 55500.1
+TZRSITE gbt
+TZRFRQ 1400
+"""
+
+
+def _model(extra: str = ""):
+    return build_model(parse_parfile(BASE_PAR + extra, from_text=True))
+
+
+def _epoch_toas(model, n_epochs=40, per_epoch=3, rng=None, error_us=1.0):
+    """Fake TOAs in simultaneous sub-band groups (same epoch, different
+    freqs) — the NANOGrav observing pattern ECORR models."""
+    mjds = np.repeat(np.linspace(55000, 56000, n_epochs), per_epoch)
+    freqs = np.tile(np.array([800.0, 1400.0, 2300.0][:per_epoch]), n_epochs)
+    utc = ptime.MJDEpoch.from_mjd_float(mjds)
+    err = np.full(mjds.shape, error_us)
+    obs = np.array(["gbt"] * len(mjds))
+    toas = prepare_arrays(utc, err, freqs, obs, ephem=model.ephem or "auto", planets=False)
+    from pint_tpu.simulation import zero_residuals
+
+    return zero_residuals(toas, model)
+
+
+class TestScaleToaError:
+    def test_efac_equad_formula(self):
+        m = _model("EFAC -f be1 1.5\nEQUAD -f be1 2.0\n")
+        # attach flags: half the TOAs get -f be1
+        toas = make_fake_toas_uniform(55000, 56000, 20, m, freq_mhz=1400.0, error_us=1.0)
+        for i, f in enumerate(toas.flags):
+            if i % 2 == 0:
+                f["f"] = "be1"
+        r = Residuals(toas, m)
+        exp_sel = 1.5 * np.hypot(1e-6, 2.0e-6)
+        np.testing.assert_allclose(r.errors_s[0::2], exp_sel, rtol=1e-12)
+        np.testing.assert_allclose(r.errors_s[1::2], 1e-6, rtol=1e-12)
+        # chi2 uses the scaled errors
+        assert r.calc_chi2() < np.sum((r.time_resids / r.raw_errors_s) ** 2) + 1e-9
+
+    def test_t2efac_alias(self):
+        m = _model("T2EFAC -f be1 2.0\n")
+        assert "EFAC1" in m.params
+        assert m.param_meta["EFAC1"].frozen
+
+
+class TestEcorrBasis:
+    def test_quantization(self):
+        m = _model("ECORR -f be1 0.5\n")
+        toas = _epoch_toas(m, n_epochs=10, per_epoch=3)
+        for f in toas.flags:
+            f["f"] = "be1"
+        tensor = m.build_tensor(toas)
+        U = np.asarray(tensor["ecorr_umat"])
+        # one column per epoch (3 simultaneous TOAs each), TZR row zeroed
+        assert U.shape == (31, 10)
+        np.testing.assert_allclose(U[:-1].sum(axis=0), 3.0)
+        np.testing.assert_allclose(U[-1], 0.0)
+        # each data row belongs to exactly one epoch
+        np.testing.assert_allclose(U[:-1].sum(axis=1), 1.0)
+        pair = m.noise_basis_and_weights(m.params, tensor)
+        assert pair is not None
+        F, phi = pair
+        assert F.shape == (30, 10)
+        np.testing.assert_allclose(np.asarray(phi), (0.5e-6) ** 2, rtol=1e-12)
+
+    def test_epochs_below_nmin_excluded(self):
+        m = _model("ECORR -f be1 0.5\n")
+        toas = _epoch_toas(m, n_epochs=8, per_epoch=1)  # singleton epochs
+        for f in toas.flags:
+            f["f"] = "be1"
+        tensor = m.build_tensor(toas)
+        U = np.asarray(tensor["ecorr_umat"])
+        np.testing.assert_allclose(U, 0.0)  # no epoch has >= 2 TOAs
+
+
+class TestPLRedNoiseBasis:
+    def test_fourier_basis_and_weights(self):
+        m = _model("TNREDAMP -13.5\nTNREDGAM 3.5\nTNREDC 10\n")
+        toas = make_fake_toas_uniform(55000, 56000, 30, m, freq_mhz=1400.0)
+        tensor = m.build_tensor(toas)
+        F, phi = m.noise_basis_and_weights(m.params, tensor)
+        F, phi = np.asarray(F), np.asarray(phi)
+        assert F.shape == (30, 20) and phi.shape == (20,)
+        # sin/cos interleave: F[:,0]=sin(2 pi f1 t), F[:,1]=cos(2 pi f1 t)
+        t = np.asarray(tensor["t_hi"][:-1])
+        T = t.max() - t.min()
+        np.testing.assert_allclose(F[:, 0], np.sin(2 * np.pi * t / T), rtol=1e-8, atol=1e-9)
+        np.testing.assert_allclose(F[:, 1], np.cos(2 * np.pi * t / T), rtol=1e-8, atol=1e-9)
+        # weights follow the reference powerlaw normalization
+        fyr = 1.0 / 3.16e7
+        amp, gam = 10**-13.5, 3.5
+        f1 = 1.0 / T
+        exp0 = amp**2 / 12 / np.pi**2 * fyr ** (gam - 3) * f1 ** (-gam) * f1
+        np.testing.assert_allclose(phi[0], exp0, rtol=1e-10)
+        # pair per frequency shares one weight
+        np.testing.assert_allclose(phi[::2], phi[1::2], rtol=1e-14)
+
+    def test_rnamp_conversion(self):
+        m = _model("RNAMP 0.017173\nRNIDX -4.91353\n")
+        amp, gam = m["PLRedNoise"]._amp_gamma(m.params)
+        fac = (86400.0 * 365.24 * 1e6) / (2.0 * np.pi * np.sqrt(3.0))
+        np.testing.assert_allclose(float(amp), 0.017173 / fac, rtol=1e-12)
+        np.testing.assert_allclose(float(gam), 4.91353, rtol=1e-12)
+
+
+class TestGLSFitting:
+    def test_white_limit_matches_wls(self):
+        """EFAC-only model: GLS must reproduce the WLS fit exactly."""
+        import copy
+
+        m1 = _model("EFAC -f be1 1.3\n")
+        toas = make_fake_toas_uniform(
+            55000, 56000, 40, m1,
+            freq_mhz=np.where(np.arange(40) % 2 == 0, 1400.0, 800.0),
+            error_us=1.0, add_noise=True, rng=np.random.default_rng(3),
+        )
+        for f in toas.flags:
+            f["f"] = "be1"
+        m2 = copy.deepcopy(m1)
+        wls = WLSFitter(toas, m1)
+        rw = wls.fit_toas(maxiter=3)
+        gls = GLSFitter(toas, m2)
+        rg = gls.fit_toas(maxiter=3)
+        np.testing.assert_allclose(rg.chi2, rw.chi2, rtol=1e-8)
+        for n in rw.uncertainties:
+            np.testing.assert_allclose(
+                rg.uncertainties[n], rw.uncertainties[n], rtol=1e-6
+            )
+
+    def test_ecorr_closure(self):
+        """Inject per-epoch correlated offsets + white noise; GLS recovers
+        the injected spin params within uncertainties and reports chi2 ~ dof,
+        while WLS's chi2 is inflated."""
+        import copy
+
+        ecorr_us = 5.0
+        m = _model(f"ECORR -f be1 {ecorr_us}\n")
+        truth = copy.deepcopy(m)
+        toas = _epoch_toas(m, n_epochs=50, per_epoch=3, error_us=1.0)
+        for f in toas.flags:
+            f["f"] = "be1"
+        rng = np.random.default_rng(11)
+        epoch_noise = np.repeat(rng.standard_normal(50) * ecorr_us, 3)
+        white = rng.standard_normal(150) * 1.0
+        from pint_tpu.simulation import _reprepare
+
+        toas = _reprepare(toas, (epoch_noise + white) * 1e-6)
+
+        ftr = DownhillGLSFitter(toas, m)
+        res = ftr.fit_toas(maxiter=8)
+        # chi2 ~ dof under the correlated model
+        assert res.chi2 / res.dof < 1.6
+        # recovery within 4 sigma (DD value = hi + lo; hi alone is the
+        # device-split high part)
+        for n in ("F0", "F1"):
+            tv = float(np.asarray(truth.params[n].hi)) + float(np.asarray(truth.params[n].lo))
+            fv = float(np.asarray(m.params[n].hi)) + float(np.asarray(m.params[n].lo))
+            assert abs(fv - tv) < 4 * res.uncertainties[n], n
+        # white-model chi2 on the same data is much worse
+        mw = copy.deepcopy(truth)
+        rw = Residuals(toas, mw)
+        assert np.sum((rw.time_resids / rw.errors_s) ** 2) > 3 * res.chi2
+        # noise realization has epoch structure: correlates with injection
+        nr = ftr.noise_realization()
+        assert nr is not None
+        c = np.corrcoef(nr * 1e6, epoch_noise)[0, 1]
+        assert c > 0.7
+
+    def test_fit_auto_picks_gls(self):
+        m = _model("ECORR -f be1 0.5\n")
+        toas = _epoch_toas(m, n_epochs=6, per_epoch=2)
+        for f in toas.flags:
+            f["f"] = "be1"
+        assert isinstance(fit_auto(toas, m), DownhillGLSFitter)
+        m2 = _model()
+        toas2 = make_fake_toas_uniform(55000, 55500, 10, m2, freq_mhz=1400.0)
+        from pint_tpu.fitting import DownhillWLSFitter
+
+        assert isinstance(fit_auto(toas2, m2), DownhillWLSFitter)
+
+
+class TestB1855GLSBuild:
+    def test_reference_gls_par_builds(self):
+        """The real NANOGrav 9yv1 B1855+09 GLS par must build with all its
+        noise components and freeze the noise params."""
+        import os
+        from conftest import REFERENCE_DATA
+        from pint_tpu.models.builder import get_model
+
+        m = get_model(os.path.join(REFERENCE_DATA, "B1855+09_NANOGrav_9yv1.gls.par"))
+        names = m.component_names
+        assert "ScaleToaError" in names
+        assert "EcorrNoise" in names
+        assert "PLRedNoise" in names
+        assert m.has_correlated_errors
+        # 4 T2EFAC + 4 T2EQUAD lines -> 8 mask params
+        efacs = [n for n in m.params if n.startswith("EFAC")]
+        equads = [n for n in m.params if n.startswith("EQUAD")]
+        ecorrs = [n for n in m.params if n.startswith("ECORR")]
+        assert len(efacs) == 4 and len(equads) == 4 and len(ecorrs) == 4
+        assert all(m.param_meta[n].frozen for n in efacs + equads + ecorrs)
