@@ -219,20 +219,27 @@ def _moon_geocentric_ecliptic_date(T: np.ndarray) -> np.ndarray:
     )
 
 
-def _precess_ecl_date_to_j2000(vec: np.ndarray, T: np.ndarray) -> np.ndarray:
-    """Approximate ecliptic-of-date -> ecliptic-J2000: rotate longitudes back
-    by the general precession (5029.0966" T). Neglects ~47"/cy ecliptic-pole
-    motion (error ~0.5" over 25 yr: ~1 km on the Moon, ~12 m on Earth)."""
-    p = (5029.0966 * T + 1.11113 * T**2) * ARCSEC
-    cp, sp = np.cos(p), np.sin(p)
-    x, y, z = vec[..., 0], vec[..., 1], vec[..., 2]
-    return np.stack([cp * x - sp * y, sp * x + cp * y, z], axis=-1)
+def _ecl_date_to_gcrs(vec: np.ndarray, T: np.ndarray) -> np.ndarray:
+    """Mean-ecliptic-&-equinox-of-date -> GCRS/ICRS, exactly consistent with
+    the IAU2006 Fukushima-Williams bias-precession of astro/erot.py:
+
+        r_gcrs = Rz(-gamma_bar) Rx(-phi_bar) Rz(psi_bar) r_ecl_date
+
+    (the F-W angles are literally defined by this chain: psi_bar along the
+    ecliptic of date, phi_bar its obliquity on the GCRS equator, gamma_bar
+    the GCRS equator <-> ecliptic node). Includes the ICRS frame bias."""
+    from pint_tpu.astro.erot import _rx, _rz, fukushima_williams
+
+    gamb, phib, psib, _ = fukushima_williams(np.asarray(T, np.float64))
+    M = _rz(-gamb) @ _rx(-phib) @ _rz(psib)
+    return np.einsum("...ij,...j->...i", M, vec)
 
 
 class AnalyticEphemeris:
     """Built-in analytic solar-system ephemeris (see module docstring)."""
 
     name = "analytic"
+    _nbody = None  # lazy NBodyEphemeris refinement (set per instance)
     bodies = (
         "sun",
         "mercury",
@@ -260,24 +267,32 @@ class AnalyticEphemeris:
 
     def pos_ssb(self, body: str, tdb_jcent: np.ndarray) -> np.ndarray:
         """Barycentric ICRS position [m] of a body at TDB centuries since
-        J2000; shape (..., 3)."""
+        J2000; shape (..., 3).
+
+        Earth/Moon/EMB use the truncated VSOP87D Earth theory
+        (astro/vsop87.py) + Meeus lunar series, rotated of-date -> GCRS via
+        the F-W angles; other planets use the Keplerian mean elements
+        (adequate for Shapiro delays and the Sun-wobble constraint)."""
         T = np.asarray(tdb_jcent, np.float64)
         helio = self._planets_helio(T)
         sun = self._sun_ssb_ecl(helio)
         if body == "sun":
-            ecl = sun
-        elif body == "emb":
-            ecl = sun + helio["emb"]
-        elif body in ("earth", "moon"):
-            moon_gc = _precess_ecl_date_to_j2000(_moon_geocentric_ecliptic_date(T), T)
-            emb = sun + helio["emb"]
-            earth = emb - moon_gc / (1.0 + EARTH_MOON_MASS_RATIO)
-            ecl = earth if body == "earth" else earth + moon_gc
-        else:
-            ecl = sun + helio[body]
-        return ecl @ _ECL2EQU.T
+            return sun @ _ECL2EQU.T
+        if body in ("earth", "moon", "emb"):
+            from pint_tpu.astro import vsop87
 
-    def posvel_ssb(self, body: str, tdb_jcent: np.ndarray, dt_s: float = 16.0):
+            earth = sun @ _ECL2EQU.T + _ecl_date_to_gcrs(
+                vsop87.earth_helio_ecl_date(T) * AU_M, T
+            )
+            if body == "earth":
+                return earth
+            moon_gc = _ecl_date_to_gcrs(_moon_geocentric_ecliptic_date(T), T)
+            if body == "moon":
+                return earth + moon_gc
+            return earth + moon_gc / (1.0 + EARTH_MOON_MASS_RATIO)
+        return (sun + helio[body]) @ _ECL2EQU.T
+
+    def _posvel_analytic(self, body: str, tdb_jcent: np.ndarray, dt_s: float = 16.0):
         """(pos [m], vel [m/s]) via central differencing of the analytic
         position (smooth series; differencing error << series error)."""
         T = np.asarray(tdb_jcent, np.float64)
@@ -287,6 +302,39 @@ class AnalyticEphemeris:
         pos = self.pos_ssb(body, T)
         vel = (p1 - p0) / (2 * dt_s)
         return pos, vel
+
+    def _nbody_for(self, T: np.ndarray):
+        """Lazy span-scoped N-body refinement (astro/nbody.py); returns None
+        when disabled via PINT_TPU_NBODY=0."""
+        if os.environ.get("PINT_TPU_NBODY", "1") == "0":
+            return None
+        nb = self._nbody
+        if nb is not None and nb.covers(T):
+            return nb
+        from pint_tpu.astro.nbody import NBodyEphemeris
+
+        lo = float(np.min(T))
+        hi = float(np.max(T))
+        if nb is not None:  # extend to cover the union of requests
+            lo = min(lo, nb.t0 + nb.grid_s[0] / (36525.0 * 86400.0))
+            hi = max(hi, nb.t0 + nb.grid_s[-1] / (36525.0 * 86400.0))
+        span_yr = max((hi - lo) * 100.0 + 4.0, 12.0)
+        self._nbody = NBodyEphemeris(self, (lo + hi) / 2.0, span_years=span_yr)
+        return self._nbody
+
+    def posvel_ssb(self, body: str, tdb_jcent: np.ndarray, dt_s: float = 16.0):
+        """(pos [m], vel [m/s]), N-body refined when available.
+
+        Earth and Moon are integrated as separate bodies (a point-mass EMB
+        misses the solar-tide deviation of the true barycenter) and served
+        with the hybrid in-band correction; 'emb' is their mass-weighted
+        combination; Sun/planets come from the same integration."""
+        T = np.asarray(tdb_jcent, np.float64)
+        known = body in ("earth", "moon", "emb", "sun") or body in _ELEMENTS
+        nb = self._nbody_for(T) if known else None
+        if nb is None:
+            return self._posvel_analytic(body, T, dt_s)
+        return nb.posvel(body, T)
 
 
 _DEFAULT: AnalyticEphemeris | None = None

@@ -54,7 +54,8 @@ def _gls_pieces(model: TimingModel, free, subtract_mean):
 
 def get_gls_step_fn(model: TimingModel, free, subtract_mean: bool):
     """Jitted GLS step: (params, tensor, track_pn, delta_pn, weights, sigma)
-    -> (r0, M, dx, cov, chi2_0, ahat). Cached per model/free-set."""
+    -> (r0, M, mtcm, mtcy, norm, chi2_0, ahat); solve with gls_solve().
+    Cached per model/free-set."""
     cache = model.__dict__.setdefault("_gls_step_cache", {})
     key = (free, subtract_mean, model.xprec.name)
     if key in cache:
@@ -89,14 +90,6 @@ def get_gls_step_fn(model: TimingModel, free, subtract_mean: bool):
         phiinv_n = phiinv / norm**2
         mtcm = Mn.T @ (cinv[:, None] * Mn) + jnp.diag(phiinv_n + _RIDGE)
         mtcy = Mn.T @ (cinv * (-r0))
-        cf = jax.scipy.linalg.cho_factor(mtcm)
-        xhat = jax.scipy.linalg.cho_solve(cf, mtcy)
-        # only the p x p timing block of the covariance is consumed: solve
-        # p right-hand sides, not p + k
-        xvar_p = jax.scipy.linalg.cho_solve(cf, jnp.eye(mtcm.shape[0])[:, :p])
-        dx_aug = xhat / norm
-        dx = dx_aug[:p]
-        cov = (xvar_p[:p, :] / norm[:p]).T / norm[:p]
         # GLS chi^2 at the CURRENT params (Woodbury; for the downhill
         # accept/reject decision and reporting)
         if pair is None:
@@ -109,7 +102,10 @@ def get_gls_step_fn(model: TimingModel, free, subtract_mean: bool):
             Sd = jax.scipy.linalg.cho_solve(cfS, d)
             chi2_0 = jnp.sum(cinv * r0 * r0) - d @ Sd
             ahat = Sd  # ML noise-coefficient realization at current params
-        return r0, M, dx, cov, chi2_0, ahat
+        # the (p+k) solve itself happens host-side (scipy Cholesky on a
+        # small matrix), so Levenberg-Marquardt re-solves at any damping
+        # need no recompute of the design matrix
+        return r0, M, mtcm, mtcy, norm, chi2_0, ahat
 
     from pint_tpu.ops.compile import precision_jit
 
@@ -161,6 +157,30 @@ def gls_chi2(resids) -> float:
     )
 
 
+def gls_solve(mtcm, mtcy, norm, p: int, lam: float = 0.0):
+    """(dx_timing, cov_timing) from the normalized GLS normal equations,
+    with optional Marquardt damping lam * diag(mtcm)."""
+    import scipy.linalg as sl
+
+    mtcm = np.asarray(mtcm)
+    mtcy = np.asarray(mtcy)
+    norm = np.asarray(norm)
+    G = mtcm + lam * np.diag(np.diag(mtcm)) if lam else mtcm
+    try:
+        cf = sl.cho_factor(G)
+        xhat = sl.cho_solve(cf, mtcy)
+        xvar_p = sl.cho_solve(cf, np.eye(G.shape[0])[:, :p])
+    except sl.LinAlgError:
+        # SVD fallback (reference fitter.py:2228)
+        U, s, Vt = sl.svd(G, full_matrices=False)
+        s_inv = np.where(s > 1e-14 * s[0], 1.0 / s, 0.0)
+        xhat = Vt.T @ (s_inv * (U.T @ mtcy))
+        xvar_p = (Vt.T * s_inv) @ U.T[:, :p]
+    dx = (xhat / norm)[:p]
+    cov = (xvar_p[:p, :] / norm[:p]).T / norm[:p]
+    return dx, cov
+
+
 class GLSFitter(WLSFitter):
     """Iterated linear GLS (reference GLSFitter.fit_toas, fitter.py:2122)."""
 
@@ -186,13 +206,15 @@ class GLSFitter(WLSFitter):
         if len(self._free) == 0:
             return self._frozen_fit_result()
         params = self.model.xprec.convert_params(self.model.params)
+        p = len(self._free)
         it = 0
         converged = False
         for it in range(1, maxiter + 1):
-            r0, M, dx, cov, chi2_0, ahat = self._step_fn(params, self.tensor)
+            r0, M, mtcm, mtcy, norm, chi2_0, ahat = self._step_fn(params, self.tensor)
+            dx, cov = gls_solve(mtcm, mtcy, norm, p)
             params = apply_delta(params, self._free, dx)
-            sigma = jnp.sqrt(jnp.diag(cov))
-            rel = np.asarray(jnp.abs(dx) / jnp.where(sigma == 0, 1.0, sigma))
+            sigma = np.sqrt(np.diag(cov))
+            rel = np.abs(dx) / np.where(sigma == 0, 1.0, sigma)
             if np.all(rel < xtol):
                 converged = True
                 break
@@ -212,34 +234,44 @@ class GLSFitter(WLSFitter):
 
 
 class DownhillGLSFitter(GLSFitter):
-    """Damped GLS (reference DownhillGLSFitter, fitter.py:1476): accept a
-    step only if the Woodbury chi^2 decreases, else halve it."""
+    """Levenberg-Marquardt damped GLS (reference DownhillGLSFitter,
+    fitter.py:1476): the damped normal-equation re-solve is a host-side
+    Cholesky of the cached (p+k)x(p+k) system, so rejected steps cost no
+    design-matrix recomputation."""
 
-    def fit_toas(self, maxiter: int = 20, min_lambda: float = 1e-3,
-                 required_chi2_decrease: float = 1e-2) -> FitResult:
+    def fit_toas(self, maxiter: int = 30, required_chi2_decrease: float = 1e-2,
+                 max_rejects: int = 16) -> FitResult:
         if len(self._free) == 0:
             return self._frozen_fit_result()
         params = self.model.xprec.convert_params(self.model.params)
+        p = len(self._free)
         chi2_best = self.chi2_at(params)
         it = 0
         converged = False
+        lam = 0.0
         ahat = jnp.zeros(0)
         for it in range(1, maxiter + 1):
-            r0, M, dx, cov, chi2_0, ahat = self._step_fn(params, self.tensor)
-            lam = 1.0
-            improved = False
-            while lam >= min_lambda:
-                trial = apply_delta(params, self._free, lam * dx)
+            r0, M, mtcm, mtcy, norm, chi2_0, ahat = self._step_fn(params, self.tensor)
+            accepted = False
+            gain = 0.0
+            for _ in range(max_rejects):
+                dx, cov = gls_solve(mtcm, mtcy, norm, p, lam=lam)
+                trial = apply_delta(params, self._free, dx)
                 chi2_trial = self.chi2_at(trial)
-                if chi2_trial <= chi2_best:
-                    improved = chi2_best - chi2_trial > required_chi2_decrease
+                if np.isfinite(chi2_trial) and chi2_trial <= chi2_best:
+                    gain = chi2_best - chi2_trial
                     params, chi2_best = trial, chi2_trial
+                    accepted = True
+                    lam = 0.0 if lam < 1e-10 else lam / 10.0
                     break
-                lam *= 0.5
-            if not improved:
+                lam = 1e-8 if lam == 0.0 else lam * 10.0
+            if not accepted or gain < required_chi2_decrease:
                 converged = True
                 break
         else:
             log.warning(f"downhill GLS fit hit maxiter={maxiter}")
+        # uncertainties always come from the UNDAMPED normal matrix — the
+        # last inner-loop cov may carry a large Marquardt lam
+        _, cov = gls_solve(mtcm, mtcy, norm, p)
         self.noise_ampls = np.asarray(ahat)
         return self._finalize_fit(params, chi2_best, it, converged, cov)

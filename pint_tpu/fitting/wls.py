@@ -104,8 +104,8 @@ def get_step_fn(model: TimingModel, free: tuple[str, ...], subtract_mean: bool):
             return time_resids(apply_delta(params, free, delta), tensor, track_pn, delta_pn, weights)
 
         z = jnp.zeros(len(free))
-        r0 = rfun(z)
-        M = jax.jacfwd(rfun)(z)  # (N, p)
+        r0, lin = jax.linearize(rfun, z)
+        M = jax.vmap(lin)(jnp.eye(len(free))).T  # (N, p), one primal eval
         w = 1.0 / errors
         A = M * w[:, None]
         b = -r0 * w
@@ -120,12 +120,31 @@ def get_step_fn(model: TimingModel, free: tuple[str, ...], subtract_mean: bool):
         # covariance of scaled problem -> unscale
         cov = (Vt.T * s_inv**2) @ Vt / jnp.outer(norm, norm)
         chi2_0 = jnp.sum(b * b)
-        return r0, M, dx, cov, s, Vt, chi2_0
+        # pieces for host-side Levenberg-Marquardt re-solves at any damping:
+        # dx(lam) = V diag(s/(s^2 + lam s0^2)) U^T b / norm  — no recompute
+        utb = U.T @ b
+        return r0, M, dx, cov, s, Vt, chi2_0, utb, norm
 
     from pint_tpu.ops.compile import precision_jit
 
     cache[key] = precision_jit(step)
     return cache[key]
+
+
+def lm_step(s, vt, utb, norm, lam: float):
+    """Damped (Levenberg-Marquardt) step from the cached SVD pieces:
+    dx = V diag(s/(s^2 + lam*s_max^2)) U^T b / norm. lam=0 recovers the
+    Gauss-Newton pseudo-inverse step."""
+    s = np.asarray(s)
+    vt = np.asarray(vt)
+    utb = np.asarray(utb)
+    norm = np.asarray(norm)
+    if s.size == 0:
+        return np.zeros(0)
+    damp = s / (s * s + lam * s[0] ** 2)
+    good = s > SVD_THRESHOLD * s[0]
+    damp = np.where(good, damp, 0.0)
+    return (vt.T * damp) @ utb / norm
 
 
 class WLSFitter:
@@ -203,7 +222,7 @@ class WLSFitter:
         it = 0
         converged = False
         for it in range(1, maxiter + 1):
-            r0, M, dx, cov, s, vt, chi2 = self._step_fn(params, self.tensor)
+            r0, M, dx, cov, s, vt, chi2, utb, norm = self._step_fn(params, self.tensor)
             params = apply_delta(params, self._free, dx)
             # convergence: relative step in units of parameter uncertainty
             sigma = jnp.sqrt(jnp.diag(cov))
@@ -252,29 +271,37 @@ class WLSFitter:
 
 
 class DownhillWLSFitter(WLSFitter):
-    """Damped Gauss-Newton: accept a step only if chi^2 decreases, else
-    halve the step (reference DownhillFitter, fitter.py:1145-1274)."""
+    """Levenberg-Marquardt damped Gauss-Newton (reference DownhillFitter,
+    fitter.py:1145-1274, upgraded from step-halving to LM: the damped SVD
+    re-solve is free on the host, so ill-conditioned directions — e.g.
+    near-degenerate DMX columns excited by a far-from-optimum start — are
+    suppressed instead of exploding the trial step)."""
 
-    def fit_toas(self, maxiter: int = 20, min_lambda: float = 1e-3, required_chi2_decrease: float = 1e-2) -> FitResult:
+    def fit_toas(self, maxiter: int = 30, required_chi2_decrease: float = 1e-2,
+                 max_rejects: int = 16) -> FitResult:
         if len(self._free) == 0:
             return self._frozen_fit_result()
         params = self.model.xprec.convert_params(self.model.params)
         chi2_best = self.chi2_at(params)
         it = 0
         converged = False
+        lam = 0.0
         for it in range(1, maxiter + 1):
-            r0, M, dx, cov, s, vt, _ = self._step_fn(params, self.tensor)
-            lam = 1.0
-            improved = False
-            while lam >= min_lambda:
-                trial = apply_delta(params, self._free, lam * dx)
+            r0, M, dx0, cov, s, vt, _, utb, norm = self._step_fn(params, self.tensor)
+            accepted = False
+            gain = 0.0
+            for _ in range(max_rejects):
+                dx = dx0 if lam == 0.0 else lm_step(s, vt, utb, norm, lam)
+                trial = apply_delta(params, self._free, dx)
                 chi2_trial = self.chi2_at(trial)
-                if chi2_trial <= chi2_best:
-                    improved = chi2_best - chi2_trial > required_chi2_decrease
+                if np.isfinite(chi2_trial) and chi2_trial <= chi2_best:
+                    gain = chi2_best - chi2_trial
                     params, chi2_best = trial, chi2_trial
+                    accepted = True
+                    lam = 0.0 if lam < 1e-10 else lam / 10.0
                     break
-                lam *= 0.5
-            if not improved:
+                lam = 1e-8 if lam == 0.0 else lam * 10.0
+            if not accepted or gain < required_chi2_decrease:
                 converged = True
                 break
         else:

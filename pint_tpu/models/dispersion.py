@@ -30,6 +30,19 @@ def dispersion_time_delay(dm: Array, freq_mhz: Array) -> Array:
     return jnp.where(jnp.isfinite(freq_mhz), DMCONST * dm / fsq, 0.0)
 
 
+def barycentric_radio_freq(tensor: dict) -> Array:
+    """Observed frequency Doppler-shifted to the SSB frame (reference
+    AstrometryEquatorial.barycentric_radio_freq via
+    timing_model.py/astrometry.py: f_bary = f_topo (1 - v_obs . L_hat / c)).
+
+    The annual ~1e-4 modulation of 1/f^2 moves the DM delay by tens of us
+    at 430 MHz — required for reference-accurate dispersion."""
+    if "_psr_dir" not in tensor:
+        return tensor["freq_mhz"]
+    beta = jnp.sum(tensor["ssb_obs_vel_ls"] * tensor["_psr_dir"], axis=-1)
+    return tensor["freq_mhz"] * (1.0 - beta)
+
+
 def _dm_spec(k: int) -> ParamSpec:
     return ParamSpec(
         name=f"DM{k}" if k else "DM",
@@ -80,7 +93,7 @@ class DispersionDM(DelayComponent):
         return taylor_horner(dt, coeffs)
 
     def delay(self, params: dict, tensor: dict, delay_so_far: Array, xp) -> Array:
-        return dispersion_time_delay(self.base_dm(params, tensor), tensor["freq_mhz"])
+        return dispersion_time_delay(self.base_dm(params, tensor), barycentric_radio_freq(tensor))
 
 
 def _dmx_value_spec(k: int) -> ParamSpec:
@@ -140,4 +153,4 @@ class DispersionDMX(DelayComponent):
         return tensor["dmx_onehot"] @ vals
 
     def delay(self, params: dict, tensor: dict, delay_so_far: Array, xp) -> Array:
-        return dispersion_time_delay(self.dmx_dm(params, tensor), tensor["freq_mhz"])
+        return dispersion_time_delay(self.dmx_dm(params, tensor), barycentric_radio_freq(tensor))

@@ -15,6 +15,11 @@ _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
 
+# The N-body ephemeris refinement (astro/nbody.py) costs ~30-90 s per build;
+# unit tests run on the pure analytic ephemeris. Accuracy/golden-parity
+# tests opt back in with monkeypatch.setenv("PINT_TPU_NBODY", "1").
+os.environ.setdefault("PINT_TPU_NBODY", "0")
+
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,48 @@
+"""Clock-correction chain tests against the real TEMPO2 clock file shipped
+with the reference (wsrt2gps.clk, read in place)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from conftest import REFERENCE_DATA, have_reference_data
+from pint_tpu.astro.clock import ClockFile
+
+pytestmark = pytest.mark.skipif(
+    not have_reference_data(), reason="reference datafile directory not mounted"
+)
+
+WSRT_CLK = os.path.join(REFERENCE_DATA, "wsrt2gps.clk")
+
+
+class TestTempo2ClockFile:
+    def test_parse_wsrt(self):
+        cf = ClockFile.read_tempo2(WSRT_CLK)
+        assert len(cf.mjd) == 23  # 26 lines: header + 1 commented row + 23 data
+        # first data row: 51179.5 6.5e-08 (comment rows skipped)
+        assert cf.mjd[0] == 51179.5
+        assert cf.corr_s[0] == pytest.approx(6.5e-08, rel=1e-12)
+        # monotonic table, microsecond-scale corrections
+        assert np.all(np.diff(cf.mjd) >= 0)
+        assert np.max(np.abs(cf.corr_s)) < 1e-3
+
+    def test_interpolation_exact_at_nodes(self):
+        cf = ClockFile.read_tempo2(WSRT_CLK)
+        v = cf.evaluate(np.array([cf.mjd[3], cf.mjd[10]]))
+        np.testing.assert_allclose(v, [cf.corr_s[3], cf.corr_s[10]], rtol=1e-14)
+        # midpoint is the linear interpolant
+        mid = 0.5 * (cf.mjd[3] + cf.mjd[4])
+        vmid = cf.evaluate(np.array([mid]))[0]
+        assert vmid == pytest.approx(0.5 * (cf.corr_s[3] + cf.corr_s[4]), rel=1e-12)
+
+    def test_beyond_validity_error_mode(self):
+        cf = ClockFile.read_tempo2(WSRT_CLK)
+        cf.valid_beyond = "error"
+        with pytest.raises(ValueError, match="beyond last entry"):
+            cf.evaluate(np.array([cf.mjd[-1] + 1000.0]))
+
+    def test_beyond_validity_warn_mode_holds_last(self):
+        cf = ClockFile.read_tempo2(WSRT_CLK)
+        v = cf.evaluate(np.array([cf.mjd[-1] + 1000.0]))[0]
+        assert v == pytest.approx(cf.corr_s[-1], rel=1e-12)

@@ -1,0 +1,104 @@
+"""Golden-parity tests against the reference's shipped TEMPO/TEMPO2 runs.
+
+SURVEY.md §4.1 calls golden-file parity "the contract". These tests compare
+against /root/reference/tests/datafile goldens (read in place, never copied):
+
+- *.tempo_test files: per-TOA postfit residuals + binary delay from TEMPO.
+  (TEMPO's BinaryDelay column carries the opposite sign convention.)
+- End-to-end fit quality on real data vs the documented reference RMS.
+
+Tolerances are explicit and document today's error budget: the built-in
+ephemeris is an analytic VSOP87-truncation + N-body refinement
+(astro/vsop87.py, astro/nbody.py), not a JPL DE kernel — barycentering is
+good to ~50-100 km (~150-350 us of residual structure), so fits land at the
+100s-of-us level where the reference (with DE kernels) reaches ~1-20 us.
+Each tolerance below shrinks as the ephemeris improves; a sign or geometry
+regression moves these numbers by orders of magnitude, which is what the
+tests are for.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from conftest import REFERENCE_DATA, have_reference_data
+
+pytestmark = [
+    pytest.mark.slow,
+    pytest.mark.skipif(
+        not have_reference_data(), reason="reference datafile directory not mounted"
+    ),
+]
+
+TAI_PAR = os.path.join(REFERENCE_DATA, "B1855+09_NANOGrav_dfg+12_TAI.par")
+TAI_TIM = os.path.join(REFERENCE_DATA, "B1855+09_NANOGrav_dfg+12.tim")
+TAI_GOLDEN = os.path.join(REFERENCE_DATA, "B1855+09_NANOGrav_dfg+12_TAI.par.tempo_test")
+
+
+def _load_golden(path):
+    return np.loadtxt(path, skiprows=1)
+
+
+class TestBinaryDelayParity:
+    def test_dd_binary_delay_matches_tempo(self):
+        """DD binary delay vs TEMPO's golden BinaryDelay column: < 1 us rms
+        at the par's own parameters (measured 0.23 us). Pure binary-model
+        parity — barely sensitive to the barycentering accuracy."""
+        import jax.numpy as jnp
+
+        from pint_tpu.models.builder import get_model_and_toas
+
+        m, t = get_model_and_toas(TAI_PAR, TAI_TIM)
+        tensor = m.build_tensor(t)
+        params = m.xprec.convert_params(m.params)
+        bc = [c for c in m.components if c.category == "pulsar_system"][0]
+        tensor2 = m._with_context(params, tensor)
+        total = jnp.zeros_like(tensor2["t_hi"])
+        bdelay = None
+        for c in m.delay_components:
+            d = c.delay(params, tensor2, total, m.xprec)
+            if c is bc:
+                bdelay = d
+            total = total + d
+        ours = np.asarray(bdelay)[:-1]
+        gold = _load_golden(TAI_GOLDEN)[:, 1]
+        # TEMPO reports the delay with the opposite sign
+        diff = ours + gold
+        assert np.std(diff) < 1e-6
+        assert abs(np.mean(diff)) < 1e-6
+
+
+class TestEndToEndFitQuality:
+    def test_ngc6440e_postfit(self, monkeypatch):
+        """NGC6440E full pipeline: postfit weighted RMS < 250 us, converged
+        (round-1 was 3,278 us; reference with DE421 reaches ~20 us;
+        measured now ~170 us — ephemeris-limited)."""
+        monkeypatch.setenv("PINT_TPU_NBODY", "1")
+        from pint_tpu.fitting import DownhillWLSFitter
+        from pint_tpu.models.builder import get_model_and_toas
+
+        m, t = get_model_and_toas(
+            os.path.join(REFERENCE_DATA, "NGC6440E.par"),
+            os.path.join(REFERENCE_DATA, "NGC6440E.tim"),
+        )
+        ftr = DownhillWLSFitter(t, m)
+        res = ftr.fit_toas(maxiter=15)
+        assert res.converged
+        assert ftr.resids.rms_weighted() * 1e6 < 250.0
+
+    def test_b1855_tai_postfit(self, monkeypatch):
+        """B1855+09 dfg+12 (DD binary, DMX, 60 jumps) full pipeline:
+        postfit weighted RMS < 500 us (TEMPO golden: 3.49 us; measured now
+        ~310 us — ephemeris-limited)."""
+        monkeypatch.setenv("PINT_TPU_NBODY", "1")
+        from pint_tpu.fitting import fit_auto
+        from pint_tpu.models.builder import get_model_and_toas
+
+        m, t = get_model_and_toas(TAI_PAR, TAI_TIM)
+        ftr = fit_auto(t, m)
+        res = ftr.fit_toas(maxiter=40)
+        assert ftr.resids.rms_weighted() * 1e6 < 500.0
+        gold = _load_golden(TAI_GOLDEN)[:, 0]
+        # golden's own scale for context: TEMPO postfit rms
+        assert np.std(gold) * 1e6 < 10.0
