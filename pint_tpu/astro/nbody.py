@@ -233,14 +233,17 @@ class NBodyEphemeris:
                 [G_e @ bandfit(G_e, d_earth), G_m @ bandfit(G_m, c2)], axis=1
             )
 
+        A = None  # IC-variation modes are ~constant over km-scale refinements:
+        # compute the 12 sensitivity integrations once, reuse every iteration
         for it in range(refine_iters):
             traj = self._integrate(y0, fit_grid)
             diff_lp = channels(traj[:, se], traj[:, sm])
-            modes = self._fit_modes(y0, fit_grid, traj)
-            A = np.stack(
-                [mode_channels(mk[:, 0:3], mk[:, 3:6]).reshape(-1) for mk in modes],
-                axis=1,
-            )
+            if A is None:
+                modes = self._fit_modes(y0, fit_grid, traj)
+                A = np.stack(
+                    [mode_channels(mk[:, 0:3], mk[:, 3:6]).reshape(-1) for mk in modes],
+                    axis=1,
+                )
             b = diff_lp.reshape(-1)
             dx, *_ = np.linalg.lstsq(A, b, rcond=None)
             for fi, i in enumerate(self._fit_idx):

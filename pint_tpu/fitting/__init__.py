@@ -8,12 +8,21 @@ so one compiled program evaluates residuals + derivatives + the solve.
 
 from pint_tpu.fitting.wls import DownhillWLSFitter, WLSFitter  # noqa: F401
 from pint_tpu.fitting.gls import DownhillGLSFitter, GLSFitter  # noqa: F401
+from pint_tpu.fitting.wideband import WidebandDownhillFitter  # noqa: F401
 
 
 def fit_auto(toas, model, downhill: bool = True):
-    """Pick a fitter like the reference Fitter.auto (fitter.py:238): GLS
-    when the model carries correlated noise, WLS otherwise; wideband joins
-    when that milestone lands."""
+    """Pick a fitter like the reference Fitter.auto (fitter.py:238):
+    wideband when the TOAs carry -pp_dm DM measurements, else GLS when the
+    model carries correlated noise, else WLS."""
+    if getattr(toas, "is_wideband", False):
+        if not downhill:
+            from pint_tpu.utils.logging import get_logger
+
+            get_logger("pint_tpu.fitting").warning(
+                "wideband fitting is always Levenberg-Marquardt; downhill=False ignored"
+            )
+        return WidebandDownhillFitter(toas, model)
     if model.has_correlated_errors:
         cls = DownhillGLSFitter if downhill else GLSFitter
     else:

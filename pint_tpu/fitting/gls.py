@@ -241,37 +241,24 @@ class DownhillGLSFitter(GLSFitter):
 
     def fit_toas(self, maxiter: int = 30, required_chi2_decrease: float = 1e-2,
                  max_rejects: int = 16) -> FitResult:
+        from pint_tpu.fitting.wls import run_lm
+
         if len(self._free) == 0:
             return self._frozen_fit_result()
         params = self.model.xprec.convert_params(self.model.params)
         p = len(self._free)
-        chi2_best = self.chi2_at(params)
-        it = 0
-        converged = False
-        lam = 0.0
-        ahat = jnp.zeros(0)
-        for it in range(1, maxiter + 1):
-            r0, M, mtcm, mtcy, norm, chi2_0, ahat = self._step_fn(params, self.tensor)
-            accepted = False
-            gain = 0.0
-            for _ in range(max_rejects):
-                dx, cov = gls_solve(mtcm, mtcy, norm, p, lam=lam)
-                trial = apply_delta(params, self._free, dx)
-                chi2_trial = self.chi2_at(trial)
-                if np.isfinite(chi2_trial) and chi2_trial <= chi2_best:
-                    gain = chi2_best - chi2_trial
-                    params, chi2_best = trial, chi2_trial
-                    accepted = True
-                    lam = 0.0 if lam < 1e-10 else lam / 10.0
-                    break
-                lam = 1e-8 if lam == 0.0 else lam * 10.0
-            if not accepted or gain < required_chi2_decrease:
-                converged = True
-                break
-        else:
-            log.warning(f"downhill GLS fit hit maxiter={maxiter}")
-        # uncertainties always come from the UNDAMPED normal matrix — the
-        # last inner-loop cov may carry a large Marquardt lam
+
+        params, chi2_best, it, converged, pieces = run_lm(
+            params, self.chi2_at(params),
+            compute_pieces=lambda pr: self._step_fn(pr, self.tensor),
+            solve=lambda pc, lam: gls_solve(pc[2], pc[3], pc[4], p, lam=lam)[0],
+            chi2_of=self.chi2_at,
+            apply_step=lambda pr, dx: apply_delta(pr, self._free, dx),
+            maxiter=maxiter, required_gain=required_chi2_decrease,
+            max_rejects=max_rejects, log_label="downhill GLS fit",
+        )
+        _, _, mtcm, mtcy, norm, _, ahat = pieces
+        # uncertainties always come from the UNDAMPED normal matrix
         _, cov = gls_solve(mtcm, mtcy, norm, p)
         self.noise_ampls = np.asarray(ahat)
         return self._finalize_fit(params, chi2_best, it, converged, cov)

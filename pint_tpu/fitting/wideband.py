@@ -1,0 +1,202 @@
+"""Wideband (TOA + DM-measurement) fitting.
+
+Reference: pint/fitter.py WidebandTOAFitter:2310 + WidebandDownhillFitter
+(combined design matrix over residual "quantities", fitter.py:2416
+combine_design_matrices_by_quantity). TPU re-design: the combined residual
+vector is ONE function
+
+    r_aug(delta) = [ r_toa / sigma_toa ; (dm_model - dm_data) / sigma_dm ]
+
+so jax.linearize gives the stacked design matrix in a single pass — DM-type
+parameters (DM, DMX_*, DMJUMP) automatically get their rows in both blocks.
+Correlated TOA noise (red noise, ECORR) augments the TOA block exactly as
+fitting/gls.py; DM rows of the noise basis are zero.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pint_tpu.fitting.gls import gls_solve
+from pint_tpu.fitting.wls import FitResult, WLSFitter, apply_delta
+from pint_tpu.residuals import WidebandTOAResiduals, phase_residual_frac
+from pint_tpu.utils.logging import get_logger
+
+log = get_logger("pint_tpu.fitting")
+
+_RIDGE = 1e-12
+
+
+def _weighted_resids(model, free, subtract_mean, params, tensor, track_pn,
+                     delta_pn, weights, sw_t, sw_dm, dm_data, delta):
+    """Combined weighted residual vector [r_toa*sw_t ; r_dm*sw_dm] at
+    params+delta — the ONE definition both the step linearization and the
+    accept/reject chi^2 share."""
+    pp = apply_delta(params, free, delta)
+    _, r, f = phase_residual_frac(
+        model, pp, tensor,
+        track_pn=track_pn, delta_pn=delta_pn,
+        subtract_mean=subtract_mean, weights=weights,
+    )
+    rt = (r / f) * sw_t
+    rdm = (model.total_dm(pp, tensor) - dm_data) * sw_dm
+    return jnp.concatenate([rt, rdm])
+
+
+def _noise_Fw(model, params, tensor, sw_t, n_dm):
+    """Weighted noise basis padded with zero DM rows, or None."""
+    pair = model.noise_basis_and_weights(params, tensor)
+    if pair is None:
+        return None
+    F, phi = pair
+    Fw = jnp.concatenate([F * sw_t[:, None], jnp.zeros((n_dm, F.shape[1]))])
+    return Fw, phi
+
+
+def _woodbury_chi2(r0, Fw_phi):
+    """r0^T C^-1 r0 for C = I + Fw phi Fw^T; also the ML noise coeffs."""
+    if Fw_phi is None:
+        return jnp.sum(r0 * r0), jnp.zeros(0)
+    Fw, phi = Fw_phi
+    d = Fw.T @ r0
+    S = jnp.diag(1.0 / phi) + Fw.T @ Fw
+    Sd = jax.scipy.linalg.cho_solve(jax.scipy.linalg.cho_factor(S), d)
+    return jnp.sum(r0 * r0) - d @ Sd, Sd
+
+
+def get_wb_step_fn(model, free, subtract_mean: bool):
+    """Jitted wideband step -> (r_aug, mtcm, mtcy, norm, chi2_0, ahat);
+    solve with fitting.gls.gls_solve."""
+    cache = model.__dict__.setdefault("_wb_step_cache", {})
+    key = (free, subtract_mean, model.xprec.name)
+    if key in cache:
+        return cache[key]
+
+    p = len(free)
+
+    def step(params, tensor, track_pn, delta_pn, weights, sigma_t, sigma_dm, dm_data):
+        sw_t = 1.0 / sigma_t
+        sw_dm = jnp.where(jnp.isfinite(sigma_dm), 1.0 / sigma_dm, 0.0)
+
+        def wres(delta):
+            return _weighted_resids(
+                model, free, subtract_mean, params, tensor, track_pn,
+                delta_pn, weights, sw_t, sw_dm, dm_data, delta,
+            )
+
+        z = jnp.zeros(p)
+        r0, lin = jax.linearize(wres, z)
+        A = jax.vmap(lin)(jnp.eye(p)).T  # (N_t + N_dm, p), already weighted
+        b = -r0
+
+        Fw_phi = _noise_Fw(model, params, tensor, sw_t, sw_dm.shape[0])
+        if Fw_phi is None:
+            Aaug = A
+            phiinv = jnp.zeros(p)
+        else:
+            Fw, phi = Fw_phi
+            Aaug = jnp.concatenate([A, Fw], axis=1)
+            phiinv = jnp.concatenate([jnp.zeros(p), 1.0 / phi])
+
+        norm = jnp.sqrt(jnp.sum(Aaug**2, axis=0))
+        norm = jnp.where(norm == 0, 1.0, norm)
+        An = Aaug / norm
+        mtcm = An.T @ An + jnp.diag(phiinv / norm**2 + _RIDGE)
+        mtcy = An.T @ b
+        chi2_0, ahat = _woodbury_chi2(r0, Fw_phi)
+        return r0, mtcm, mtcy, norm, chi2_0, ahat
+
+    from pint_tpu.ops.compile import precision_jit
+
+    cache[key] = precision_jit(step)
+    return cache[key]
+
+
+def get_wb_chi2_fn(model, subtract_mean: bool):
+    cache = model.__dict__.setdefault("_wb_chi2_cache", {})
+    key = (subtract_mean, model.xprec.name)
+    if key in cache:
+        return cache[key]
+
+    def chi2fn(params, tensor, track_pn, delta_pn, weights, sigma_t, sigma_dm, dm_data):
+        sw_t = 1.0 / sigma_t
+        sw_dm = jnp.where(jnp.isfinite(sigma_dm), 1.0 / sigma_dm, 0.0)
+        r0 = _weighted_resids(
+            model, (), subtract_mean, params, tensor, track_pn,
+            delta_pn, weights, sw_t, sw_dm, dm_data, jnp.zeros(0),
+        )
+        Fw_phi = _noise_Fw(model, params, tensor, sw_t, sw_dm.shape[0])
+        return _woodbury_chi2(r0, Fw_phi)[0]
+
+    from pint_tpu.ops.compile import precision_jit
+
+    cache[key] = precision_jit(chi2fn)
+    return cache[key]
+
+
+class WidebandDownhillFitter(WLSFitter):
+    """Levenberg-Marquardt wideband fitter (reference WidebandDownhillFitter,
+    fitter.py:1536 semantics on the combined TOA+DM system)."""
+
+    def __init__(self, toas, model, residuals=None):
+        self.toas = toas
+        self.model = model
+        self.resids = residuals or WidebandTOAResiduals(toas, model)
+        self.tensor = self.resids.tensor
+        self._free = tuple(model.free_params)
+        self.result: FitResult | None = None
+
+    def _rebuild_resids(self):
+        return WidebandTOAResiduals(
+            self.toas, self.model, tensor=self.tensor,
+            track_mode=self.resids.toa.track_mode,
+            subtract_mean=self.resids.toa.subtract_mean,
+        )
+
+    def _args(self, params):
+        r = self.resids.toa
+        params = self.model.xprec.convert_params(params)
+        return (
+            params, self.tensor, r._track_pn, r._delta_pn, r._weights,
+            jnp.asarray(r.errors_s), jnp.asarray(self.resids.dm_errors),
+            jnp.asarray(self.resids.dm_data),
+        )
+
+    def chi2_at(self, params) -> float:
+        fn = get_wb_chi2_fn(self.model, self.resids.toa.subtract_mean)
+        return float(fn(*self._args(params)))
+
+    def fit_toas(self, maxiter: int = 30, required_chi2_decrease: float = 1e-2,
+                 max_rejects: int = 16) -> FitResult:
+        from pint_tpu.fitting.wls import run_lm
+
+        if len(self._free) == 0:
+            return self._frozen_fit_result()
+        step = get_wb_step_fn(self.model, self._free, self.resids.toa.subtract_mean)
+        params = self.model.xprec.convert_params(self.model.params)
+        p = len(self._free)
+
+        params, chi2_best, it, converged, pieces = run_lm(
+            params, self.chi2_at(params),
+            compute_pieces=lambda pr: step(*self._args(pr)),
+            solve=lambda pc, lam: gls_solve(pc[1], pc[2], pc[3], p, lam=lam)[0],
+            chi2_of=self.chi2_at,
+            apply_step=lambda pr, dx: apply_delta(pr, self._free, dx),
+            maxiter=maxiter, required_gain=required_chi2_decrease,
+            max_rejects=max_rejects, log_label="wideband fit",
+        )
+        _, mtcm, mtcy, norm, _, ahat = pieces
+        _, cov = gls_solve(mtcm, mtcy, norm, p)
+        self.noise_ampls = np.asarray(ahat)
+        return self._finalize_fit(params, chi2_best, it, converged, cov)
+
+    def _frozen_fit_result(self) -> FitResult:
+        self.result = FitResult(
+            chi2=self.chi2_at(self.model.params),
+            dof=self.resids.dof,
+            iterations=0,
+            converged=True,
+        )
+        return self.result

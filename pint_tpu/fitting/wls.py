@@ -131,6 +131,44 @@ def get_step_fn(model: TimingModel, free: tuple[str, ...], subtract_mean: bool):
     return cache[key]
 
 
+def run_lm(params, chi2_best, compute_pieces, solve, chi2_of, apply_step,
+           maxiter: int, required_gain: float, max_rejects: int, log_label: str):
+    """Shared Levenberg-Marquardt outer loop for every downhill fitter.
+
+    compute_pieces(params) -> opaque linearization pieces (one jitted call);
+    solve(pieces, lam) -> dx; chi2_of(trial) -> float; apply_step(params, dx)
+    -> params'. Damping RESTARTS from zero each outer iteration (reference
+    DownhillFitter semantics): convergence is only declared against a fresh
+    Gauss-Newton attempt, never against a stale heavily-damped step.
+
+    Returns (params, chi2_best, iterations, converged, last_pieces).
+    """
+    it = 0
+    converged = False
+    pieces = None
+    for it in range(1, maxiter + 1):
+        pieces = compute_pieces(params)
+        lam = 0.0
+        accepted = False
+        gain = 0.0
+        for _ in range(max_rejects):
+            dx = solve(pieces, lam)
+            trial = apply_step(params, dx)
+            chi2_trial = chi2_of(trial)
+            if np.isfinite(chi2_trial) and chi2_trial <= chi2_best:
+                gain = chi2_best - chi2_trial
+                params, chi2_best = trial, chi2_trial
+                accepted = True
+                break
+            lam = 1e-8 if lam == 0.0 else lam * 10.0
+        if not accepted or gain < required_gain:
+            converged = True
+            break
+    else:
+        log.warning(f"{log_label} hit maxiter={maxiter}")
+    return params, chi2_best, it, converged, pieces
+
+
 def lm_step(s, vt, utb, norm, lam: float):
     """Damped (Levenberg-Marquardt) step from the cached SVD pieces:
     dx = V diag(s/(s^2 + lam*s_max^2)) U^T b / norm. lam=0 recovers the
@@ -282,30 +320,21 @@ class DownhillWLSFitter(WLSFitter):
         if len(self._free) == 0:
             return self._frozen_fit_result()
         params = self.model.xprec.convert_params(self.model.params)
-        chi2_best = self.chi2_at(params)
-        it = 0
-        converged = False
-        lam = 0.0
-        for it in range(1, maxiter + 1):
-            r0, M, dx0, cov, s, vt, _, utb, norm = self._step_fn(params, self.tensor)
-            accepted = False
-            gain = 0.0
-            for _ in range(max_rejects):
-                dx = dx0 if lam == 0.0 else lm_step(s, vt, utb, norm, lam)
-                trial = apply_delta(params, self._free, dx)
-                chi2_trial = self.chi2_at(trial)
-                if np.isfinite(chi2_trial) and chi2_trial <= chi2_best:
-                    gain = chi2_best - chi2_trial
-                    params, chi2_best = trial, chi2_trial
-                    accepted = True
-                    lam = 0.0 if lam < 1e-10 else lam / 10.0
-                    break
-                lam = 1e-8 if lam == 0.0 else lam * 10.0
-            if not accepted or gain < required_chi2_decrease:
-                converged = True
-                break
-        else:
-            log.warning(f"downhill fit hit maxiter={maxiter}")
+
+        def solve(pieces, lam):
+            r0, M, dx0, cov, s, vt, _, utb, norm = pieces
+            return dx0 if lam == 0.0 else lm_step(s, vt, utb, norm, lam)
+
+        params, chi2_best, it, converged, pieces = run_lm(
+            params, self.chi2_at(params),
+            compute_pieces=lambda p: self._step_fn(p, self.tensor),
+            solve=solve,
+            chi2_of=self.chi2_at,
+            apply_step=lambda p, dx: apply_delta(p, self._free, dx),
+            maxiter=maxiter, required_gain=required_chi2_decrease,
+            max_rejects=max_rejects, log_label="downhill WLS fit",
+        )
+        _, _, _, cov, s, *_ = pieces
         return self._finalize_fit(params, chi2_best, it, converged, cov, s=s)
 
 

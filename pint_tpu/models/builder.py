@@ -15,7 +15,7 @@ from pint_tpu.io.par import ParFile, parse_fit_flag, parse_parfile
 from pint_tpu.io.tim import mjd_string_to_day_frac
 from pint_tpu.models.astrometry import AstrometryEcliptic, AstrometryEquatorial
 from pint_tpu.models.base import Component, epoch_dd_to_mjd_string
-from pint_tpu.models.dispersion import DispersionDM, DispersionDMX
+from pint_tpu.models.dispersion import DispersionDM, DispersionDMX, DispersionJump
 from pint_tpu.models.parameter import (
     MaskParamInfo,
     ParamSpec,
@@ -106,6 +106,8 @@ def build_model(pf: ParFile) -> TimingModel:
         components.append(DispersionDM())
     if any(n.startswith("DMX_") for n in pf.names()):
         components.append(DispersionDMX())
+    if "DMJUMP" in pf:
+        components.append(DispersionJump())
     if any(isinstance(c, (AstrometryEquatorial, AstrometryEcliptic)) for c in components):
         ssshap = SolarSystemShapiro()
         ssshap.planet_shapiro = _parse_bool(pf.get("PLANET_SHAPIRO", "N"))

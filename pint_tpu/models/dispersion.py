@@ -92,6 +92,9 @@ class DispersionDM(DelayComponent):
         # taylor_horner on DM_k with factorial scaling — keep its convention
         return taylor_horner(dt, coeffs)
 
+    def dm_value(self, params: dict, tensor: dict) -> Array:
+        return self.base_dm(params, tensor)
+
     def delay(self, params: dict, tensor: dict, delay_so_far: Array, xp) -> Array:
         return dispersion_time_delay(self.base_dm(params, tensor), barycentric_radio_freq(tensor))
 
@@ -152,5 +155,31 @@ class DispersionDMX(DelayComponent):
         vals = jnp.stack([params[f"DMX_{i:04d}"] for i in self.sorted_indices])
         return tensor["dmx_onehot"] @ vals
 
+    def dm_value(self, params: dict, tensor: dict) -> Array:
+        return self.dmx_dm(params, tensor)
+
     def delay(self, params: dict, tensor: dict, delay_so_far: Array, xp) -> Array:
         return dispersion_time_delay(self.dmx_dm(params, tensor), barycentric_radio_freq(tensor))
+
+
+class DispersionJump(DelayComponent):
+    """Constant offsets to the MEASURED DM values per selection — models
+    instrument-dependent wideband-DM offsets; contributes to the model DM
+    (dm_value) but NOT to the dispersion time delay (reference
+    dispersion_model.py:710-790)."""
+
+    category = "dispersion_jump"
+    register = True
+
+    @classmethod
+    def mask_bases(cls):
+        return [
+            ParamSpec("DMJUMP", kind="float", unit="pc cm^-3",
+                      description="DM value offset"),
+        ]
+
+    def dm_value(self, params: dict, tensor: dict) -> Array:
+        out = jnp.zeros_like(tensor["t_hi"])
+        for mp in self.mask_params:
+            out = out - tensor[f"mask_{mp.name}"] * leaf_to_f64(params[mp.name])
+        return out

@@ -127,6 +127,32 @@ class TimingModel:
             sigma = sigma[:-1]
         return sigma
 
+    # --- wideband DM surface (reference timing_model total_dm /
+    # scaled_dm_uncertainty; residuals.py:590 WidebandDMResiduals) ----------------
+
+    @property
+    def dm_components(self) -> list[Component]:
+        return [c for c in self.components if hasattr(c, "dm_value")]
+
+    def total_dm(self, params: dict, tensor: dict) -> Array:
+        """Model DM at each TOA (pc/cm^3), DATA rows only."""
+        dm = jnp.zeros_like(tensor["t_hi"])
+        for c in self.dm_components:
+            dm = dm + c.dm_value(params, tensor)
+        if self.has_abs_phase:
+            dm = dm[:-1]
+        return dm
+
+    def scaled_dm_sigma(self, params: dict, tensor: dict) -> Array:
+        """DMEFAC/DMEQUAD-rescaled wideband DM uncertainties, DATA rows."""
+        sigma = tensor["wb_dme"]
+        for c in self.noise_components:
+            if hasattr(c, "scale_dm_sigma"):
+                sigma = c.scale_dm_sigma(params, tensor, sigma)
+        if self.has_abs_phase:
+            sigma = sigma[:-1]
+        return sigma
+
     def noise_basis_and_weights(self, params: dict, tensor: dict):
         """Concatenated correlated-noise basis F (N_data, k) and prior
         variances phi (k,), or None (reference noise_model_designmatrix /
@@ -217,6 +243,14 @@ class TimingModel:
         }
         for p, arr in tens.planet_pos_ls.items():
             out[f"obs_{p}_pos_ls"] = jnp.asarray(arr)
+        # wideband DM measurements (-pp_dm / -pp_dme flags); rows without a
+        # measurement (including the TZR row) get infinite error -> zero
+        # weight in the DM block
+        wb_dm, wb_dme = full.get_wideband_dm()
+        if wb_dm is not None:
+            out["wb_dm"] = jnp.asarray(wb_dm)
+            out["wb_dme"] = jnp.asarray(wb_dme)
+
         n_rows = tens.t_hi.shape[0]
         for c in self.components:
             for k, col in c.host_columns(full, self.params).items():

@@ -206,3 +206,48 @@ class Residuals:
     @property
     def reduced_chi2(self) -> float:
         return self.calc_chi2() / self.dof
+
+
+class WidebandTOAResiduals:
+    """Combined TOA + wideband-DM residuals (reference residuals.py:590
+    WidebandDMResiduals + :835 CombinedResiduals/WidebandTOAResiduals).
+
+    The DM block is dm_data − total_dm(model) with DMEFAC/DMEQUAD-scaled
+    uncertainties; chi^2 adds the two blocks."""
+
+    def __init__(self, toas, model, tensor: dict | None = None, **toa_kwargs):
+        self.toa = Residuals(toas, model, tensor=tensor, **toa_kwargs)
+        self.toas = toas
+        self.model = model
+        self.tensor = self.toa.tensor
+        if "wb_dm" not in self.tensor:
+            raise ValueError("TOAs carry no -pp_dm wideband DM measurements")
+        params = model.xprec.convert_params(model.params)
+        sl = slice(None, -1) if model.has_abs_phase else slice(None)
+        self.dm_data = np.asarray(self.tensor["wb_dm"][sl])
+        self.dm_errors = np.asarray(model.scaled_dm_sigma(params, self.tensor))
+
+    @property
+    def dm_resids(self) -> np.ndarray:
+        params = self.model.xprec.convert_params(self.model.params)
+        return self.dm_data - np.asarray(self.model.total_dm(params, self.tensor))
+
+    @property
+    def time_resids(self) -> np.ndarray:
+        return self.toa.time_resids
+
+    def calc_chi2(self) -> float:
+        w = np.where(np.isfinite(self.dm_errors), 1.0 / self.dm_errors**2, 0.0)
+        return self.toa.calc_chi2() + float(np.sum(w * self.dm_resids**2))
+
+    def rms_weighted(self) -> float:
+        return self.toa.rms_weighted()
+
+    @property
+    def dof(self) -> int:
+        n_dm = int(np.sum(np.isfinite(self.dm_errors)))
+        return self.toa.dof + n_dm
+
+    @property
+    def reduced_chi2(self) -> float:
+        return self.calc_chi2() / self.dof

@@ -111,6 +111,31 @@ class TOAs:
             return None
         return np.array([float(p) if p is not None else np.nan for p in pns])
 
+    @property
+    def is_wideband(self) -> bool:
+        """True when any TOA carries a -pp_dm wideband DM measurement
+        (reference toa.py:1628)."""
+        return any("pp_dm" in f for f in self.flags)
+
+    def get_wideband_dm(self) -> tuple[np.ndarray | None, np.ndarray | None]:
+        """(dm [pc/cm^3], dm_error) per TOA from -pp_dm/-pp_dme flags
+        (reference toa.py:1734-1747). Rows without a measurement get dm=0
+        with infinite error (zero weight); returns (None, None) when no TOA
+        has one."""
+        if not self.is_wideband:
+            return None, None
+        bad = [i for i, f in enumerate(self.flags) if "pp_dm" in f and "pp_dme" not in f]
+        if bad:
+            raise ValueError(
+                f"{len(bad)} TOAs carry -pp_dm without -pp_dme (first at index "
+                f"{bad[0]}); wideband DM measurements need both"
+            )
+        dm = np.array([float(f.get("pp_dm", 0.0)) for f in self.flags])
+        dme = np.array(
+            [float(f["pp_dme"]) if "pp_dme" in f else np.inf for f in self.flags]
+        )
+        return dm, dme
+
     def select(self, mask: np.ndarray) -> "TOAs":
         """Boolean-mask subset (reference TOAs.select, toa.py:1852)."""
         mask = np.asarray(mask)
