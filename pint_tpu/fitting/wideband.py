@@ -192,6 +192,24 @@ class WidebandDownhillFitter(WLSFitter):
         self.noise_ampls = np.asarray(ahat)
         return self._finalize_fit(params, chi2_best, it, converged, cov)
 
+    def designmatrix(self) -> np.ndarray:
+        """Combined weighted (N_toa + N_dm, p) design matrix."""
+        r = self.resids.toa
+        params = self.model.xprec.convert_params(self.model.params)
+        sw_t = 1.0 / jnp.asarray(r.errors_s)
+        dme = jnp.asarray(self.resids.dm_errors)
+        sw_dm = jnp.where(jnp.isfinite(dme), 1.0 / dme, 0.0)
+        dm_data = jnp.asarray(self.resids.dm_data)
+
+        def wres(delta):
+            return _weighted_resids(
+                self.model, self._free, r.subtract_mean, params, self.tensor,
+                r._track_pn, r._delta_pn, r._weights, sw_t, sw_dm, dm_data, delta,
+            )
+
+        _, lin = jax.linearize(wres, jnp.zeros(len(self._free)))
+        return np.asarray(jax.vmap(lin)(jnp.eye(len(self._free))).T)
+
     def _frozen_fit_result(self) -> FitResult:
         self.result = FitResult(
             chi2=self.chi2_at(self.model.params),

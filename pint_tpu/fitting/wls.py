@@ -273,10 +273,9 @@ class WLSFitter:
         )
 
     def designmatrix(self) -> np.ndarray:
-        """(N, p) d time-resid / d free-param, for inspection/tests.
-
-        Works for every fitter variant: M is the second element of each
-        step tuple (WLS and GLS)."""
+        """(N, p) d time-resid / d free-param, for inspection/tests (M is
+        the second element of the WLS and GLS step tuples; the wideband
+        fitter overrides this with the combined TOA+DM matrix)."""
         return np.asarray(self._step_fn(self.model.params, self.tensor)[1])
 
     def _finalize_fit(self, params, chi2: float, it: int, converged: bool,

@@ -69,11 +69,7 @@ IGNORED_KEYS = {
 }
 
 # not-yet-built families: consumed by later milestones, warned for now
-PENDING_KEYS = {
-    "NE_SW",
-    "SOLARN0",
-    "CORRECT_TROPOSPHERE",
-}
+PENDING_KEYS: set[str] = set()
 
 
 def get_model(parfile: str, from_text: bool = False) -> TimingModel:
@@ -130,6 +126,28 @@ def build_model(pf: ParFile) -> TimingModel:
     if "DJUMP" in pf:
         components.append(DelayJump())
 
+    # phase/delay tail components by parameter presence
+    from pint_tpu.models.frequency_dependent import FD
+    from pint_tpu.models.glitch import Glitch
+    from pint_tpu.models.solar_wind import SolarWindDispersion
+    from pint_tpu.models.troposphere import TroposphereDelay
+
+    if any(n.startswith("GLEP_") for n in pf.names()):
+        components.append(Glitch())
+    if "WAVE_OM" in pf:
+        components.append(_build_wave(pf, consumed))
+    if "FD1" in pf:
+        components.append(FD())
+    if "NE_SW" in pf or "NE1AU" in pf or "SOLARN0" in pf:
+        components.append(SolarWindDispersion())
+    if "SIFUNC" in pf:
+        components.append(_build_ifunc(pf, consumed))
+    if any(n.startswith("PWEP_") for n in pf.names()):
+        components.append(_build_piecewise(pf, consumed))
+    if _parse_bool(pf.get("CORRECT_TROPOSPHERE", "N")):
+        components.append(TroposphereDelay())
+    consumed.add("CORRECT_TROPOSPHERE")
+
     binary = pf.get("BINARY")
     if binary:
         from pint_tpu.models.binary import make_binary_component
@@ -175,6 +193,35 @@ def build_model(pf: ParFile) -> TimingModel:
         if isinstance(comp, DispersionDMX):
             _collect_dmx(comp, pf, model, consumed)
 
+    # deferred multi-token lines (WAVEk pairs, IFUNCk mjd/value triples)
+    from pint_tpu.models.ifunc import IFunc
+    from pint_tpu.models.wave import Wave
+
+    for comp in model.components:
+        pending = getattr(comp, "_pending_lines", None)
+        if pending is None:
+            continue
+        if isinstance(comp, Wave):
+            for k, line in pending.items():
+                if len(line.tokens) < 2:
+                    raise ValueError(f"WAVE{k} needs sin and cos values: {line.raw}")
+                for tag, tok in (("A", line.tokens[0]), ("B", line.tokens[1])):
+                    spec = comp.specs[f"WAVE{k}{tag}"]
+                    model.params[spec.name] = spec.parse(tok)
+                    model.param_meta[spec.name] = ParamValueMeta(spec=spec, frozen=True)
+        elif isinstance(comp, IFunc):
+            for k, line in pending.items():
+                if len(line.tokens) < 2:
+                    raise ValueError(f"IFUNC{k} needs 'mjd value': {line.raw}")
+                spec = comp.specs[f"IFUNC{k}"]
+                model.params[spec.name] = spec.parse(line.tokens[1])
+                frozen, unc = parse_fit_flag(line.tokens, value_index=1)
+                pm = ParamValueMeta(spec=spec, frozen=frozen)
+                if unc is not None:
+                    pm.uncertainty = spec.parse_uncertainty(unc)
+                model.param_meta[spec.name] = pm
+        del comp._pending_lines
+
     # noise parameters are fixed inputs to WLS/GLS (the reference fitters
     # likewise refuse to fit them; they are sampled by the Bayesian/MCMC
     # path instead) — force-freeze, warning if the parfile marked them free
@@ -206,6 +253,58 @@ def _parse_bool(tok: str) -> bool:
     return str(tok).upper() in ("1", "Y", "YES", "T", "TRUE")
 
 
+def _build_wave(pf: ParFile, consumed: set):
+    """WAVEk lines carry a (sin, cos) PAIR of values — collected here into
+    WAVEkA/WAVEkB params (reference wave.py prefixParameter pairs)."""
+    from pint_tpu.models.wave import Wave
+
+    comp = Wave()
+    k = 1
+    while f"WAVE{k}" in pf:
+        comp.add_wave_term(k)
+        consumed.add(f"WAVE{k}")
+        k += 1
+    comp._pending_lines = {
+        i: pf.get_all(f"WAVE{i}")[0] for i in range(1, comp.num_terms + 1)
+    }
+    return comp
+
+
+def _build_ifunc(pf: ParFile, consumed: set):
+    """IFUNCk lines are 'mjd value [err]' triples: the MJD is static node
+    structure, the value a fittable parameter (reference ifunc.py)."""
+    from pint_tpu.models.ifunc import IFunc
+
+    comp = IFunc()
+    k = 1
+    pending = {}
+    while f"IFUNC{k}" in pf:
+        line = pf.get_all(f"IFUNC{k}")[0]
+        mjd = float(line.tokens[0])
+        comp.add_node(k, mjd)
+        pending[k] = line
+        consumed.add(f"IFUNC{k}")
+        k += 1
+    comp._pending_lines = pending
+    return comp
+
+
+def _build_piecewise(pf: ParFile, consumed: set):
+    """PWSTART_k/PWSTOP_k are window config (host mask compilation)."""
+    from pint_tpu.models.piecewise import PiecewiseSpindown
+
+    comp = PiecewiseSpindown()
+    for name in pf.names():
+        if name.startswith("PWSTART_") and name[8:].isdigit():
+            k = int(name[8:])
+            stop = pf.get(f"PWSTOP_{k}")
+            if stop is None:
+                raise ValueError(f"PWSTART_{k} without PWSTOP_{k}")
+            comp.set_window(k, float(pf.get(name)), float(stop))
+            consumed |= {name, f"PWSTOP_{k}"}
+    return comp
+
+
 def _collect_meta(pf: ParFile) -> dict:
     meta: dict = {}
     psr = pf.get("PSR") or pf.get("PSRJ") or pf.get("PSRB")
@@ -234,8 +333,11 @@ def _find_entry(pf: ParFile, spec: ParamSpec):
 
 
 def _collect_component_params(comp: Component, pf: ParFile, model: TimingModel, consumed: set):
-    # plain params
+    # plain params (keys already consumed by special collectors — WAVEk,
+    # IFUNCk multi-token lines — are handled by the deferred-lines loop)
     for spec in list(comp.specs.values()):
+        if spec.name in consumed:
+            continue
         line, key = _find_entry(pf, spec)
         if line is None:
             if spec.default is not None:

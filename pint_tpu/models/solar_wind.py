@@ -1,0 +1,72 @@
+"""Solar-wind dispersion delay.
+
+Reference: pint/models/solar_wind_dispersion.py (SolarWindDispersion:265,
+solar_wind_geometry:329, SWM==0): the electron column through a 1/r^2 wind
+of density NE_SW at 1 AU is
+
+    DM_sw = NE_SW * AU^2 * rho / (r * sin(rho))        [rho = pi - sun angle]
+
+with r the observatory-Sun distance and rho the pulsar-Sun-observatory
+elongation; delay = DMconst * DM_sw / f^2. (SWM==1, the Hazboun et al. 2022
+generalized power-law wind, raises NotImplementedError exactly like a
+missing reference feature would.)
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from pint_tpu.models.base import DelayComponent, leaf_to_f64
+from pint_tpu.models.parameter import ParamSpec
+
+Array = jnp.ndarray
+
+# AU in light seconds and parsec in light seconds (tensor positions are ls)
+AU_LS = 499.00478384
+PC_LS = 3.0856775814913673e16 / 299792458.0
+
+
+class SolarWindDispersion(DelayComponent):
+    category = "solar_wind"
+    register = True
+
+    @classmethod
+    def param_specs(cls):
+        return [
+            ParamSpec("NE_SW", unit="cm^-3", default=0.0, aliases=("NE1AU", "SOLARN0"),
+                      description="solar wind electron density at 1 AU"),
+            ParamSpec("SWM", kind="int", default=0, description="solar wind model"),
+        ]
+
+    def validate(self, params, meta):
+        if int(meta.get("SWM", 0)) not in (0,):
+            raise NotImplementedError(
+                f"solar wind model SWM {meta.get('SWM')} not implemented (SWM 0 only)"
+            )
+
+    def solar_wind_dm(self, params: dict, tensor: dict) -> Array:
+        """DM_sw in pc/cm^3 (reference solar_wind_dm:367)."""
+        ne = leaf_to_f64(params["NE_SW"])
+        r_vec = tensor["obs_sun_pos_ls"]  # obs -> sun, light-seconds
+        r = jnp.linalg.norm(r_vec, axis=-1)
+        sun_dir = r_vec / r[:, None]
+        cos_angle = jnp.sum(sun_dir * tensor["_psr_dir"], axis=-1)
+        # rho = pi - angle(sun_dir, psr_dir)
+        rho = jnp.pi - jnp.arccos(jnp.clip(cos_angle, -1.0, 1.0))
+        # AU^2 * rho / (r sin rho), converted ls -> pc so DM is pc cm^-3
+        geom = (AU_LS**2) * rho / (r * jnp.sin(rho)) / PC_LS
+        return ne * geom
+
+    def delay(self, params: dict, tensor: dict, delay_so_far: Array, xp) -> Array:
+        from pint_tpu.models.dispersion import (
+            barycentric_radio_freq,
+            dispersion_time_delay,
+        )
+
+        return dispersion_time_delay(
+            self.solar_wind_dm(params, tensor), barycentric_radio_freq(tensor)
+        )
+
+    def dm_value(self, params: dict, tensor: dict) -> Array:
+        return self.solar_wind_dm(params, tensor)

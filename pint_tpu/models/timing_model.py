@@ -136,6 +136,7 @@ class TimingModel:
 
     def total_dm(self, params: dict, tensor: dict) -> Array:
         """Model DM at each TOA (pc/cm^3), DATA rows only."""
+        tensor = self._with_context(params, tensor)
         dm = jnp.zeros_like(tensor["t_hi"])
         for c in self.dm_components:
             dm = dm + c.dm_value(params, tensor)
@@ -255,9 +256,13 @@ class TimingModel:
         for c in self.components:
             for k, col in c.host_columns(full, self.params).items():
                 col = np.asarray(col, np.float64)
-                # TZR row belongs to no mask; aux arrays that aren't
-                # row-indexed (e.g. ECORR column->param maps) pass through
-                if self.has_abs_phase and col.shape[:1] == (n_rows,):
+                # The TZR fiducial row belongs to no flag/selection MASK
+                # (it is a synthetic TOA), but it DOES get every other
+                # model column (interpolation weights, window masks, tropo
+                # delay, ...) so its phase matches the reference's full
+                # model evaluation at TZRMJD. Non-row-indexed aux arrays
+                # (e.g. ECORR column->param maps) pass through untouched.
+                if self.has_abs_phase and k.startswith("mask_") and col.shape[:1] == (n_rows,):
                     col[-1] = 0.0
                 out[k] = jnp.asarray(col)
         return out

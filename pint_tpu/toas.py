@@ -124,12 +124,13 @@ class TOAs:
         has one."""
         if not self.is_wideband:
             return None, None
-        bad = [i for i, f in enumerate(self.flags) if "pp_dm" in f and "pp_dme" not in f]
-        if bad:
-            raise ValueError(
-                f"{len(bad)} TOAs carry -pp_dm without -pp_dme (first at index "
-                f"{bad[0]}); wideband DM measurements need both"
-            )
+        for a, b in (("pp_dm", "pp_dme"), ("pp_dme", "pp_dm")):
+            bad = [i for i, f in enumerate(self.flags) if a in f and b not in f]
+            if bad:
+                raise ValueError(
+                    f"{len(bad)} TOAs carry -{a} without -{b} (first at index "
+                    f"{bad[0]}); wideband DM measurements need both"
+                )
         dm = np.array([float(f.get("pp_dm", 0.0)) for f in self.flags])
         dme = np.array(
             [float(f["pp_dme"]) if "pp_dme" in f else np.inf for f in self.flags]

@@ -1,0 +1,211 @@
+"""Phase/delay tail components: Glitch, Wave, FD, SolarWind, IFunc,
+PiecewiseSpindown, Troposphere.
+
+Strategy per SURVEY §4: analytic value checks against the reference
+formulas, simulation closure (fitters recover injected parameters), and
+autodiff-vs-numerical derivative checks ride free through the shared WLS
+machinery (tests/test_fitting.py pattern).
+"""
+
+import numpy as np
+import pytest
+
+from pint_tpu.io.par import parse_parfile
+from pint_tpu.models.builder import build_model
+from pint_tpu.fitting import DownhillWLSFitter
+from pint_tpu.residuals import Residuals
+from pint_tpu.simulation import make_fake_toas_uniform
+
+BASE = """
+PSR TAILFAKE
+RAJ 06:30:00 1
+DECJ -10:00:00 1
+F0 200.5 1
+F1 -2e-15 1
+PEPOCH 55500
+POSEPOCH 55500
+DM 30.0 1
+TZRMJD 55500.1
+TZRSITE gbt
+TZRFRQ 1400
+"""
+
+
+def _model(extra=""):
+    return build_model(parse_parfile(BASE + extra, from_text=True))
+
+
+def _toas(model, n=60, span=(55000, 56000), **kw):
+    kw.setdefault("freq_mhz", np.where(np.arange(n) % 2 == 0, 800.0, 1400.0))
+    kw.setdefault("error_us", 1.0)
+    return make_fake_toas_uniform(span[0], span[1], n, model, **kw)
+
+
+class TestGlitch:
+    def test_phase_jump_structure(self):
+        m = _model("GLEP_1 55500\nGLF0_1 1e-7\nGLPH_1 0.1\n")
+        assert "Glitch" in m.component_names
+        toas = _toas(_model())  # fakes from the glitchless model
+        r = Residuals(toas, m, subtract_mean=False)
+        mjd = toas.tdb.mjd_float()
+        pre = mjd < 55499.9
+        post = mjd > 55500.1
+        # phases are TZR-anchored: the fiducial TOA (55500.1, post-glitch)
+        # carries the glitch phase too, shifting every residual by -phi(TZR)
+        tzr = 0.1 + 1e-7 * (55500.1 - 55500.0) * 86400.0
+        got_pre = r.phase_resids[pre] + np.round(-tzr - r.phase_resids[pre])
+        np.testing.assert_allclose(got_pre, -tzr, atol=1e-4)
+        dt = (mjd[post] - 55500.0) * 86400.0
+        expect = 0.1 + 1e-7 * dt - tzr
+        got = r.phase_resids[post] + np.round(expect - r.phase_resids[post])
+        # barycentric-vs-coordinate dt shifts each term by < GLF0*600s
+        np.testing.assert_allclose(got, expect, atol=1e-4)
+
+    def test_decay_term(self):
+        m = _model("GLEP_1 55300\nGLF0D_1 2e-7\nGLTD_1 50\n")
+        toas = _toas(_model())
+        r = Residuals(toas, m, subtract_mean=False)
+        mjd = toas.tdb.mjd_float()
+        post = mjd > 55301
+        tau = 50.0 * 86400.0
+        def phi(d_mjd):
+            dt = (d_mjd - 55300.0) * 86400.0
+            return 2e-7 * tau * (1 - np.exp(-dt / tau))
+        expect = phi(mjd[post]) - phi(55500.1)  # TZR-anchored
+        got = r.phase_resids[post] + np.round(expect - r.phase_resids[post])
+        np.testing.assert_allclose(got, expect, rtol=1e-4, atol=2e-4)
+
+    def test_recovery(self):
+        truth = _model("GLEP_1 55500\nGLF0_1 5e-10 1\nGLPH_1 0.0\n")
+        toas = _toas(truth, n=80, error_us=1.0)
+        m = _model("GLEP_1 55500\nGLF0_1 0.0 1\nGLPH_1 0.0\n")
+        m.set_free(["F0", "F1", "GLF0_1"])
+        ftr = DownhillWLSFitter(toas, m)
+        res = ftr.fit_toas(maxiter=10)
+        glf0 = float(np.asarray(m.params["GLF0_1"]))
+        assert glf0 == pytest.approx(5e-10, abs=4 * res.uncertainties["GLF0_1"])
+
+
+class TestWave:
+    def test_wave_phase_value(self):
+        m = _model("WAVEEPOCH 55000\nWAVE_OM 0.01\nWAVE1 0.002 -0.001\n")
+        assert "Wave" in m.component_names
+        toas = _toas(_model())
+        r = Residuals(toas, m, subtract_mean=False)
+        mjd = toas.tdb.mjd_float()
+        # reference wave_phase:97: tau = a sin(om dt) + b cos(om dt), phase = tau*F0
+        # (dt includes the delay chain; barycentric-vs-coordinate dt differs
+        # by < 500 s, i.e. < 6e-5 rad of wave phase)
+        def phi(d_mjd):
+            dt_d = d_mjd - 55000.0
+            tau = 0.002 * np.sin(0.01 * dt_d) + (-0.001) * np.cos(0.01 * dt_d)
+            return tau * 200.5
+        expect = phi(mjd) - phi(55500.1)  # TZR-anchored
+        got = r.phase_resids + np.round(expect - r.phase_resids)
+        np.testing.assert_allclose(got, expect, atol=2e-3)
+
+
+class TestFD:
+    def test_fd_delay_formula(self):
+        m = _model("FD1 1e-5\nFD2 -3e-6\n")
+        assert "FD" in m.component_names
+        toas = _toas(_model())
+        r = Residuals(toas, m, subtract_mean=False)
+        logf = np.log(np.asarray(toas.freq_mhz) / 1e3)
+        expect = -(1e-5 * logf + (-3e-6) * logf**2)
+        # residual = -delay (delay added -> pulses late); barycentric freq
+        # shifts log f by ~1e-4
+        np.testing.assert_allclose(r.time_resids, expect - np.mean(expect - r.time_resids), atol=5e-9)
+
+
+class TestSolarWind:
+    def test_solar_wind_dm_scale(self):
+        m = _model("NE_SW 10.0\n")
+        assert "SolarWindDispersion" in m.component_names
+        toas = _toas(_model(), n=120, span=(55000, 55365))
+        tensor = m.build_tensor(toas)
+        tensor = m._with_context(m.params, tensor)
+        sw = m["SolarWindDispersion"]
+        dm = np.asarray(sw.solar_wind_dm(m.params, tensor))[:-1]
+        # NE_SW=10: DM ranges ~1e-4..1e-2 pc/cm3 over the year, peaked near
+        # solar conjunction (reference test_solar_wind values)
+        assert dm.min() > 0
+        assert 1e-5 < dm.min() < 1e-3
+        assert dm.max() / dm.min() > 2.0
+
+    def test_zero_density_is_noop(self):
+        m0 = _model()
+        m1 = _model("NE_SW 0.0\n")
+        toas = _toas(m0)
+        r0 = Residuals(toas, m0, subtract_mean=False).time_resids
+        r1 = Residuals(toas, m1, subtract_mean=False).time_resids
+        np.testing.assert_allclose(r1, r0, atol=1e-12)
+
+
+class TestIFunc:
+    def test_linear_interpolation(self):
+        m = _model(
+            "SIFUNC 2\nIFUNC1 55000 0.0\nIFUNC2 55500 1e-4\nIFUNC3 56000 0.0\n"
+        )
+        assert "IFunc" in m.component_names
+        toas = _toas(_model())
+        r = Residuals(toas, m, subtract_mean=False)
+        mjd = toas.tdb.mjd_float()
+        def phi(d_mjd):
+            return np.interp(d_mjd, [55000, 55500, 56000], [0.0, 1e-4, 0.0]) * 200.5
+        expect = phi(mjd) - phi(55500.1)  # TZR-anchored
+        got = r.phase_resids + np.round(expect - r.phase_resids)
+        np.testing.assert_allclose(got, expect, atol=1e-4)
+
+    def test_value_recovery(self):
+        truth = _model("SIFUNC 2\nIFUNC1 55000 5e-5 1\nIFUNC2 56000 -5e-5 1\n")
+        toas = _toas(truth, n=60)
+        m = _model("SIFUNC 2\nIFUNC1 55000 0.0 1\nIFUNC2 56000 0.0 1\n")
+        m.set_free(["IFUNC1", "IFUNC2"])
+        ftr = DownhillWLSFitter(toas, m)
+        res = ftr.fit_toas(maxiter=8)
+        v1 = float(np.asarray(m.params["IFUNC1"]))
+        assert v1 == pytest.approx(5e-5, abs=4 * res.uncertainties["IFUNC1"])
+
+
+class TestPiecewise:
+    def test_segment_phase(self):
+        m = _model(
+            "PWEP_1 55250\nPWSTART_1 55100\nPWSTOP_1 55400\nPWF0_1 1e-8\n"
+        )
+        assert "PiecewiseSpindown" in m.component_names
+        toas = _toas(_model())
+        r = Residuals(toas, m, subtract_mean=False)
+        mjd = toas.tdb.mjd_float()
+        inside = (mjd >= 55100) & (mjd <= 55400)
+        outside = ~inside
+        assert np.max(np.abs(r.phase_resids[outside])) < 1e-6
+        dt = (mjd[inside] - 55250.0) * 86400.0
+        expect = 1e-8 * dt
+        got = r.phase_resids[inside] + np.round(expect - r.phase_resids[inside])
+        np.testing.assert_allclose(got, expect, atol=1e-5)
+
+
+class TestTroposphere:
+    def test_delay_magnitude_and_gating(self):
+        m0 = _model()
+        m1 = _model("CORRECT_TROPOSPHERE Y\n")
+        assert "TroposphereDelay" not in m0.component_names
+        assert "TroposphereDelay" in m1.component_names
+        toas = _toas(m0)
+        tensor = m1.build_tensor(toas)
+        d = np.asarray(tensor["tropo_delay"])[:-1]
+        # zenith hydrostatic delay ~7.7 ns * mapping >= 1; always positive,
+        # bounded by the 5-degree altitude cutoff (~11.5x zenith)
+        assert np.all(d > 5e-9)
+        assert np.all(d < 2e-7)
+
+    def test_residual_effect_is_subns_to_us(self):
+        m0 = _model()
+        m1 = _model("CORRECT_TROPOSPHERE Y\n")
+        toas = _toas(m0)
+        r0 = Residuals(toas, m0, subtract_mean=False).time_resids
+        r1 = Residuals(toas, m1, subtract_mean=False).time_resids
+        diff = np.abs(r1 - r0)
+        assert diff.max() > 1e-9  # it does something
+        assert diff.max() < 1e-6  # and stays at the tropospheric scale
