@@ -9,6 +9,7 @@ so one compiled program evaluates residuals + derivatives + the solve.
 from pint_tpu.fitting.wls import DownhillWLSFitter, WLSFitter  # noqa: F401
 from pint_tpu.fitting.gls import DownhillGLSFitter, GLSFitter  # noqa: F401
 from pint_tpu.fitting.wideband import WidebandDownhillFitter  # noqa: F401
+from pint_tpu.fitting.mcmc import MCMCFitter  # noqa: F401
 
 
 def fit_auto(toas, model, downhill: bool = True):

@@ -193,12 +193,14 @@ class WidebandDownhillFitter(WLSFitter):
         return self._finalize_fit(params, chi2_best, it, converged, cov)
 
     def designmatrix(self) -> np.ndarray:
-        """Combined weighted (N_toa + N_dm, p) design matrix."""
+        """Combined UNWEIGHTED (N_toa + N_dm, p) design matrix — TOA rows
+        are d(time resid)/d(param) like the base contract, DM rows
+        d(dm resid)/d(param) (rows without a DM measurement are zero)."""
         r = self.resids.toa
         params = self.model.xprec.convert_params(self.model.params)
-        sw_t = 1.0 / jnp.asarray(r.errors_s)
+        sw_t = jnp.ones(len(r.errors_s))
         dme = jnp.asarray(self.resids.dm_errors)
-        sw_dm = jnp.where(jnp.isfinite(dme), 1.0 / dme, 0.0)
+        sw_dm = jnp.where(jnp.isfinite(dme), 1.0, 0.0)
         dm_data = jnp.asarray(self.resids.dm_data)
 
         def wres(delta):

@@ -136,7 +136,7 @@ def build_model(pf: ParFile) -> TimingModel:
         components.append(Glitch())
     if "WAVE_OM" in pf:
         components.append(_build_wave(pf, consumed))
-    if "FD1" in pf:
+    if any(n.startswith("FD") and n[2:].isdigit() for n in pf.names()):
         components.append(FD())
     if "NE_SW" in pf or "NE1AU" in pf or "SOLARN0" in pf:
         components.append(SolarWindDispersion())
@@ -222,6 +222,16 @@ def build_model(pf: ParFile) -> TimingModel:
                 model.param_meta[spec.name] = pm
         del comp._pending_lines
 
+    # WAVEEPOCH defaults to PEPOCH (reference wave.py setup())
+    from pint_tpu.models.wave import Wave as _Wave
+
+    if any(isinstance(c, _Wave) for c in model.components) and "WAVEEPOCH" not in model.params:
+        if "PEPOCH" not in model.params:
+            raise ValueError("WAVE terms need WAVEEPOCH or PEPOCH")
+        spec = next(c for c in model.components if isinstance(c, _Wave)).specs["WAVEEPOCH"]
+        model.params["WAVEEPOCH"] = model.params["PEPOCH"]
+        model.param_meta["WAVEEPOCH"] = ParamValueMeta(spec=spec, frozen=True)
+
     # noise parameters are fixed inputs to WLS/GLS (the reference fitters
     # likewise refuse to fit them; they are sampled by the Bayesian/MCMC
     # path instead) — force-freeze, warning if the parfile marked them free
@@ -259,14 +269,14 @@ def _build_wave(pf: ParFile, consumed: set):
     from pint_tpu.models.wave import Wave
 
     comp = Wave()
-    k = 1
-    while f"WAVE{k}" in pf:
-        comp.add_wave_term(k)
-        consumed.add(f"WAVE{k}")
-        k += 1
-    comp._pending_lines = {
-        i: pf.get_all(f"WAVE{i}")[0] for i in range(1, comp.num_terms + 1)
-    }
+    pending = {}
+    for name in pf.names():  # tolerate gaps in the WAVEk numbering
+        if name.startswith("WAVE") and name[4:].isdigit():
+            k = int(name[4:])
+            comp.add_wave_term(k)
+            pending[k] = pf.get_all(name)[0]
+            consumed.add(name)
+    comp._pending_lines = pending
     return comp
 
 

@@ -26,6 +26,7 @@ class Wave(PhaseComponent):
     def __init__(self):
         super().__init__()
         self.num_terms = 0
+        self.term_indices: list[int] = []
 
     @classmethod
     def param_specs(cls):
@@ -44,6 +45,9 @@ class Wave(PhaseComponent):
                 description=f"wave harmonic {k} {'sin' if tag == 'A' else 'cos'}",
             )
         self.num_terms = max(self.num_terms, k)
+        if k not in self.term_indices:
+            self.term_indices.append(k)
+            self.term_indices.sort()
 
     def validate(self, params, meta):
         if self.num_terms and "WAVE_OM" not in params:
@@ -56,7 +60,7 @@ class Wave(PhaseComponent):
         dt = t - leaf_to_f64(params["WAVEEPOCH"])
         om = leaf_to_f64(params["WAVE_OM"])
         tau = jnp.zeros_like(t)
-        for k in range(1, self.num_terms + 1):
+        for k in self.term_indices:
             arg = k * om * dt
             tau = tau + leaf_to_f64(params[f"WAVE{k}A"]) * jnp.sin(arg)
             tau = tau + leaf_to_f64(params[f"WAVE{k}B"]) * jnp.cos(arg)

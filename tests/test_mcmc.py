@@ -1,0 +1,115 @@
+"""MCMC / Bayesian tests: determinism, posterior vs WLS agreement, priors.
+
+Mirrors the reference's test_mcmc_fitter/test_bayesian strategy + SURVEY
+§4.6 (fixed-seed determinism for sampling code).
+"""
+
+import numpy as np
+import pytest
+
+from pint_tpu.io.par import parse_parfile
+from pint_tpu.models.builder import build_model
+from pint_tpu.bayesian import BayesianTiming
+from pint_tpu.fitting import MCMCFitter, WLSFitter
+from pint_tpu.priors import NormalPrior, UniformPrior
+from pint_tpu.sampler import initial_ball, run_ensemble
+from pint_tpu.simulation import make_fake_toas_uniform
+
+PAR = """
+PSR MCMCFAKE
+RAJ 03:00:00
+DECJ 15:00:00
+F0 150.75 1 1e-10
+F1 -9e-16 1 1e-18
+PEPOCH 55400
+POSEPOCH 55400
+DM 10.0
+TZRMJD 55400.1
+TZRSITE gbt
+TZRFRQ 1400
+"""
+
+
+@pytest.fixture(scope="module")
+def setup():
+    import copy
+
+    model = build_model(parse_parfile(PAR, from_text=True))
+    toas = make_fake_toas_uniform(
+        55000, 55800, 40, model, freq_mhz=1400.0, error_us=2.0,
+        add_noise=True, rng=np.random.default_rng(9),
+    )
+    wls_model = copy.deepcopy(model)
+    wls = WLSFitter(toas, wls_model)
+    wres = wls.fit_toas(maxiter=3)
+    return model, toas, wres
+
+
+class TestSampler:
+    def test_fixed_seed_determinism(self):
+        def lnpost(x):
+            return -0.5 * np.sum(x**2) if isinstance(x, np.ndarray) else -0.5 * (x**2).sum()
+
+        x0 = initial_ball(np.ones(2), 8, seed=3)
+        c1, l1, a1 = run_ensemble(lnpost, x0, 50, seed=42)
+        c2, l2, a2 = run_ensemble(lnpost, x0, 50, seed=42)
+        np.testing.assert_array_equal(c1, c2)
+        assert a1 == a2
+
+    def test_samples_gaussian(self):
+        """Stretch sampler recovers a 2D Gaussian's moments."""
+        import jax.numpy as jnp
+
+        cov_true = np.array([[2.0, 0.6], [0.6, 1.0]])
+        icov = jnp.asarray(np.linalg.inv(cov_true))
+
+        def lnpost(x):
+            return -0.5 * x @ icov @ x
+
+        x0 = initial_ball(np.ones(2), 16, seed=1)
+        chain, _, acc = run_ensemble(lnpost, x0, 3000, seed=7)
+        flat = chain[1000:].reshape(-1, 2)
+        assert 0.2 < acc < 0.9
+        np.testing.assert_allclose(np.cov(flat.T), cov_true, rtol=0.25)
+
+
+class TestBayesianTiming:
+    def test_lnposterior_peak_near_truth(self, setup):
+        model, toas, wres = setup
+        bt = BayesianTiming(toas, model)
+        assert bt.nparams == 2
+        lp0 = bt.lnposterior(np.zeros(2))
+        # a 5-sigma offset must be much less probable
+        off = np.array([5 * wres.uncertainties["F0"], 0.0])
+        assert bt.lnposterior(off) < lp0 - 3.0
+
+    def test_prior_bounds(self, setup):
+        model, toas, _ = setup
+        bt = BayesianTiming(
+            toas, model, priors={"F0": UniformPrior(150.75 - 1e-9, 150.75 + 1e-9)}
+        )
+        assert np.isfinite(bt.lnposterior(np.zeros(2)))
+        assert bt.lnposterior(np.array([5e-9, 0.0])) == -np.inf
+
+    def test_normal_prior(self):
+        p = NormalPrior(0.0, 2.0)
+        assert float(p.logpdf(0.0)) > float(p.logpdf(4.0))
+
+
+class TestMCMCFitter:
+    def test_posterior_matches_wls(self, setup):
+        """Posterior mean/std agree with the WLS fit for this linear-ish
+        problem (reference test: MCMC and WLS give consistent results)."""
+        import copy
+
+        model, toas, wres = setup
+        m = copy.deepcopy(model)
+        ftr = MCMCFitter(toas, m, nwalkers=16)
+        res = ftr.fit_toas(nsteps=600, seed=5)
+        assert res.converged
+        flat = ftr.posterior_samples()
+        # delta-space mean should sit within 3 WLS sigma of the WLS optimum
+        for i, n in enumerate(ftr.bt.free):
+            s_wls = wres.uncertainties[n]
+            assert res.uncertainties[n] == pytest.approx(s_wls, rel=0.5), n
+            assert abs(np.mean(flat[:, i])) < 5 * s_wls
