@@ -55,9 +55,13 @@ class BayesianTiming:
         free = self.free
         params0 = self._params0
         tensor = r.tensor
-        sigma = jnp.asarray(r.errors_s)
         correlated = model.has_correlated_errors
-        lognorm = -jnp.sum(jnp.log(sigma)) - 0.5 * sigma.shape[0] * jnp.log(2 * jnp.pi)
+        # sigma is computed IN-GRAPH from the (possibly sampled) noise
+        # parameters: EFAC/EQUAD in the free set change the likelihood,
+        # including its normalization
+        has_noise = bool(model.noise_components)
+        sigma_fixed = jnp.asarray(r.errors_s)
+        n_toa = sigma_fixed.shape[0]
         track_pn, delta_pn, weights = r._track_pn, r._delta_pn, r._weights
         subtract_mean = r.subtract_mean
         prior_list = [self.priors[n] for n in free]
@@ -78,6 +82,8 @@ class BayesianTiming:
                 subtract_mean=subtract_mean, weights=weights,
             )
             rt = rr / f
+            sigma = model.scaled_sigma(pp, tensor) if has_noise else sigma_fixed
+            lognorm = -jnp.sum(jnp.log(sigma)) - 0.5 * n_toa * jnp.log(2 * jnp.pi)
             if not correlated:
                 return -0.5 * jnp.sum((rt / sigma) ** 2) + lognorm
             # Woodbury-marginalized likelihood (log|C| up to a delta-

@@ -1,0 +1,135 @@
+"""Conversion between binary model parameterizations.
+
+Reference: pint/binaryconvert.py (convert_binary:536 — ELL1<->DD/BT,
+ELL1H->ELL1, parameter transformations with the standard small-eccentricity
+relations). Operates in place on our TimingModel: swaps the PulsarBinary
+component's engine configuration and maps the parameter set.
+
+    ELL1 -> DD/BT:  ECC = hypot(EPS1, EPS2), OM = atan2(EPS1, EPS2),
+                    T0 = TASC + OM/(2 pi) * PB
+    DD/BT -> ELL1:  EPS1 = ECC sin OM, EPS2 = ECC cos OM,
+                    TASC = T0 - OM/(2 pi) * PB
+    ELL1H -> ELL1:  SINI = 2 STIG/(1+STIG^2), M2 = H3/(Tsun STIG^3)
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from pint_tpu import SECS_PER_DAY, TSUN_S
+from pint_tpu.models.base import leaf_to_f64
+from pint_tpu.models.binary import PulsarBinary
+from pint_tpu.models.parameter import ParamValueMeta
+from pint_tpu.ops.dd import DD, device_split
+from pint_tpu.utils.logging import get_logger
+
+log = get_logger("pint_tpu.binaryconvert")
+
+_ECCENTRIC = ("BT", "DD", "DDS")
+_ELL1_LIKE = ("ELL1", "ELL1H", "ELL1K")
+
+
+def _f(model, name, default=0.0):
+    v = model.params.get(name)
+    return default if v is None else float(np.asarray(leaf_to_f64(v)))
+
+
+def _set(model, comp, name, value, frozen=None):
+    spec = comp.specs.get(name)
+    if spec is None:
+        raise KeyError(f"{comp.model_name} has no parameter {name}")
+    if spec.kind in ("dd", "epoch"):
+        hi, lo = device_split(np.float64(value), np.float64(0.0))
+        model.params[name] = DD(np.float64(hi), np.float64(lo))
+    else:
+        model.params[name] = float(value)
+    pm = model.param_meta.get(name)
+    was_frozen = pm.frozen if pm is not None else True
+    model.param_meta[name] = ParamValueMeta(
+        spec=spec, frozen=was_frozen if frozen is None else frozen
+    )
+
+
+def _drop(model, *names):
+    for n in names:
+        model.params.pop(n, None)
+        model.param_meta.pop(n, None)
+
+
+def convert_binary(model, target: str):
+    """In-place conversion of the model's binary to `target` (reference
+    convert_binary:536). Returns the model for chaining."""
+    target = target.upper()
+    old = next((c for c in model.components if isinstance(c, PulsarBinary)), None)
+    if old is None:
+        raise ValueError("model has no binary component")
+    src = old.model_name.upper()
+    if src == target:
+        return model
+
+    # epoch conversions need PB in seconds
+    pb_s = _f(model, "PB")
+    if pb_s == 0.0 and "FB0" in model.params:
+        pb_s = 1.0 / _f(model, "FB0")
+
+    new = PulsarBinary(target)
+    model.components[model.components.index(old)] = new
+
+    if src in _ELL1_LIKE and target in _ECCENTRIC:
+        eps1, eps2 = _f(model, "EPS1"), _f(model, "EPS2")
+        ecc = float(np.hypot(eps1, eps2))
+        om = float(np.arctan2(eps1, eps2)) % (2 * np.pi)
+        tasc = model.params["TASC"]
+        t0_s = float(np.asarray(tasc.hi)) + float(np.asarray(tasc.lo)) + om / (2 * np.pi) * pb_s
+        _set(model, new, "ECC", ecc, frozen=model.param_meta.get("EPS1", ParamValueMeta(spec=None)).frozen)
+        new_om_spec = new.specs["OM"]
+        model.params["OM"] = om
+        model.param_meta["OM"] = ParamValueMeta(spec=new_om_spec, frozen=model.param_meta["EPS2"].frozen)
+        hi, lo = device_split(np.float64(t0_s), np.float64(0.0))
+        model.params["T0"] = DD(np.float64(hi), np.float64(lo))
+        model.param_meta["T0"] = ParamValueMeta(spec=new.specs["T0"], frozen=model.param_meta["TASC"].frozen)
+        _drop(model, "EPS1", "EPS2", "TASC", "H3", "H4", "STIGMA", "NHARMS", "LNEDOT")
+    elif src in _ECCENTRIC and target in _ELL1_LIKE:
+        ecc, om = _f(model, "ECC"), _f(model, "OM")
+        eps1, eps2 = ecc * np.sin(om), ecc * np.cos(om)
+        t0 = model.params["T0"]
+        tasc_s = float(np.asarray(t0.hi)) + float(np.asarray(t0.lo)) - om / (2 * np.pi) * pb_s
+        frozen_e = model.param_meta.get("ECC", ParamValueMeta(spec=None)).frozen
+        _set(model, new, "EPS1", eps1, frozen=frozen_e)
+        _set(model, new, "EPS2", eps2, frozen=frozen_e)
+        hi, lo = device_split(np.float64(tasc_s), np.float64(0.0))
+        model.params["TASC"] = DD(np.float64(hi), np.float64(lo))
+        model.param_meta["TASC"] = ParamValueMeta(spec=new.specs["TASC"], frozen=model.param_meta["T0"].frozen)
+        _drop(model, "ECC", "OM", "T0", "OMDOT" if target != "ELL1K" else "", "EDOT")
+    elif src == "ELL1H" and target == "ELL1":
+        h3 = _f(model, "H3")
+        stig = _f(model, "STIGMA")
+        if stig == 0.0 and "H4" in model.params:
+            stig = _f(model, "H4") / h3 if h3 else 0.0
+        if stig:
+            sini = 2 * stig / (1 + stig**2)
+            m2 = h3 / (TSUN_S * stig**3)
+            _set(model, new, "SINI", sini)
+            _set(model, new, "M2", m2)
+        _drop(model, "H3", "H4", "STIGMA", "NHARMS")
+    elif src == "ELL1" and target == "ELL1H":
+        m2, sini = _f(model, "M2"), _f(model, "SINI")
+        if m2 and sini:
+            c = np.sqrt(1 - sini**2)
+            stig = sini / (1 + c)
+            _set(model, new, "H3", TSUN_S * m2 * stig**3)
+            _set(model, new, "STIGMA", stig)
+        _drop(model, "M2", "SINI")
+    elif src in _ECCENTRIC and target in _ECCENTRIC:
+        pass  # shared eccentric parameterization (BT<->DD<->DDS)
+    elif src in _ELL1_LIKE and target in _ELL1_LIKE:
+        pass
+    else:
+        raise NotImplementedError(f"conversion {src} -> {target}")
+
+    model.meta["BINARY"] = target
+    model.clear_caches()  # jitted programs captured the old component
+    # validate the new configuration
+    new.validate(model.params, model.meta)
+    log.info(f"converted binary {src} -> {target}")
+    return model

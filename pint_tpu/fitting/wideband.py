@@ -147,6 +147,12 @@ class WidebandDownhillFitter(WLSFitter):
         self.tensor = self.resids.tensor
         self._free = tuple(model.free_params)
         self.result: FitResult | None = None
+        from pint_tpu.models.base import leaf_to_f64
+
+        self._prefit_values = {
+            n: float(np.asarray(leaf_to_f64(model.params[n]))) for n in self._free
+        }
+        self._prefit_wrms = self.resids.rms_weighted()
 
     def _rebuild_resids(self):
         return WidebandTOAResiduals(

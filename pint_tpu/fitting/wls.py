@@ -44,6 +44,20 @@ class MaxiterReached(ConvergenceFailure):
     pass
 
 
+def ftest(chi2_1: float, dof_1: int, chi2_2: float, dof_2: int) -> float:
+    """F-test p-value that the dof_2 < dof_1 (more-parameters) model's chi^2
+    improvement is by chance (reference utils.py FTest / fitter.ftest).
+    Small p => the added parameters are significant."""
+    from scipy.stats import f as fdist
+
+    if dof_2 >= dof_1 or chi2_2 > chi2_1:
+        return 1.0
+    delta_chi2 = chi2_1 - chi2_2
+    delta_dof = dof_1 - dof_2
+    F = (delta_chi2 / delta_dof) / (chi2_2 / dof_2)
+    return float(fdist.sf(F, delta_dof, dof_2))
+
+
 def apply_delta(params: dict, free_names: tuple[str, ...], delta: Array) -> dict:
     """params + delta over the free subset; extended-precision leaves (DD or
     QF) absorb f64 steps without losing their low-order bits."""
@@ -85,9 +99,14 @@ def get_step_fn(model: TimingModel, free: tuple[str, ...], subtract_mean: bool):
     if key in cache:
         return cache[key]
 
+    from pint_tpu.fitting.design import linear_columns, linear_split
     from pint_tpu.residuals import phase_residual_frac
 
-    def time_resids(params, tensor, track_pn, delta_pn, weights):
+    nonlin, lin_names, owners = linear_split(model, free)
+    mean_free = subtract_mean and not model.has_phase_offset
+    sl = slice(None, -1) if model.has_abs_phase else slice(None)
+
+    def time_resids_f(params, tensor, track_pn, delta_pn, weights):
         _, r, f = phase_residual_frac(
             model,
             params,
@@ -97,15 +116,31 @@ def get_step_fn(model: TimingModel, free: tuple[str, ...], subtract_mean: bool):
             subtract_mean=subtract_mean,
             weights=weights,
         )
-        return r / f
+        return r / f, f
 
     def step(params, tensor, track_pn, delta_pn, weights, errors):
+        # hybrid design matrix (fitting/design.py): autodiff tangents only
+        # over the nonlinear params, closed forms for the linear families
         def rfun(delta):
-            return time_resids(apply_delta(params, free, delta), tensor, track_pn, delta_pn, weights)
+            return time_resids_f(
+                apply_delta(params, nonlin, delta), tensor, track_pn, delta_pn, weights
+            )
 
-        z = jnp.zeros(len(free))
-        r0, lin = jax.linearize(rfun, z)
-        M = jax.vmap(lin)(jnp.eye(len(free))).T  # (N, p), one primal eval
+        z = jnp.zeros(len(nonlin))
+        (r0, f0), jvp = jax.linearize(rfun, z)
+        cols = {}
+        if nonlin:
+            M_nl = jax.vmap(jvp)(jnp.eye(len(nonlin)))[0].T
+            for i, n in enumerate(nonlin):
+                cols[n] = M_nl[:, i]
+        if lin_names:
+            M_l = linear_columns(model, params, tensor, f0, sl, lin_names, owners)
+            if mean_free:
+                w = weights if weights is not None else jnp.ones_like(r0)
+                M_l = M_l - jnp.sum(w[:, None] * M_l, axis=0) / jnp.sum(w)
+            for i, n in enumerate(lin_names):
+                cols[n] = M_l[:, i]
+        M = jnp.stack([cols[n] for n in free], axis=1)  # (N, p)
         w = 1.0 / errors
         A = M * w[:, None]
         b = -r0 * w
@@ -195,6 +230,13 @@ class WLSFitter:
         self.tensor = self.resids.tensor
         self._free = tuple(model.free_params)
         self.result: FitResult | None = None
+        # prefit snapshot for get_summary (reference Fitter keeps model_init)
+        from pint_tpu.models.base import leaf_to_f64
+
+        self._prefit_values = {
+            n: float(np.asarray(leaf_to_f64(model.params[n]))) for n in self._free
+        }
+        self._prefit_wrms = self.resids.rms_weighted()
 
     def _step_fn(self, params, tensor):
         r = self.resids
@@ -271,6 +313,40 @@ class WLSFitter:
         return self._finalize_fit(
             params, self.chi2_at(params), it, converged, cov, s=s, vt=vt
         )
+
+    def get_summary(self) -> str:
+        """Human-readable fit report (reference Fitter.get_summary,
+        fitter.py:334): fit quality + per-parameter prefit/postfit/
+        uncertainty table."""
+        from pint_tpu.models.base import leaf_to_f64
+
+        if self.result is None:
+            raise RuntimeError("run fit_toas first")
+        res = self.result
+        lines = [
+            f"Fitted model {self.model.psr_name or '?'} using"
+            f" {type(self).__name__} with {len(self._free)} free parameters"
+            f" to {len(self.resids.errors_s)} TOAs",
+            f"Prefit residuals Wrms = {self._prefit_wrms * 1e6:.4g} us,"
+            f" Postfit residuals Wrms = {self.resids.rms_weighted() * 1e6:.4g} us",
+            f"Chisq = {res.chi2:.4f} for {res.dof} d.o.f."
+            f" reduced Chisq = {res.reduced_chi2:.4f}"
+            f" {'(converged)' if res.converged else '(NOT converged)'}",
+            "",
+            f"{'PAR':<12s} {'Prefit':>24s} {'Postfit':>24s} {'Unc':>12s} Units",
+        ]
+        for n in self._free:
+            post = float(np.asarray(leaf_to_f64(self.model.params[n])))
+            unc = res.uncertainties.get(n)
+            spec = self.model.param_meta[n].spec
+            lines.append(
+                f"{n:<12s} {self._prefit_values[n]:>24.15g} {post:>24.15g}"
+                f" {'' if unc is None else format(unc, '>12.3g')} {spec.unit}"
+            )
+        return "\n".join(lines)
+
+    def print_summary(self) -> None:
+        print(self.get_summary())
 
     def designmatrix(self) -> np.ndarray:
         """(N, p) d time-resid / d free-param, for inspection/tests (M is

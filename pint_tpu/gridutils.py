@@ -57,10 +57,14 @@ def _point_kernel(model, grid_names, free, subtract_mean, maxiter, toa_axis=None
     With `toa_axis` set, every reduction over the TOA axis is completed with
     a psum over that mesh axis, making the kernel valid inside shard_map.
     """
+    from pint_tpu.fitting.design import linear_columns, linear_split
+
     xp = model.xprec
     mean_free = subtract_mean and not model.has_phase_offset
     correlated = model.has_correlated_errors
     p = len(free)
+    nonlin, lin_names, owners = linear_split(model, free)
+    sl_data = slice(None, -1) if model.has_abs_phase else slice(None)
 
     def _reduce(x):
         s = jnp.sum(x, axis=0)
@@ -73,7 +77,7 @@ def _point_kernel(model, grid_names, free, subtract_mean, maxiter, toa_axis=None
             m = jax.lax.psum(m, toa_axis)
         return m
 
-    def time_resids(params, data):
+    def time_resids_f(params, data):
         _, r, f = phase_residual_frac(
             model,
             params,
@@ -86,20 +90,38 @@ def _point_kernel(model, grid_names, free, subtract_mean, maxiter, toa_axis=None
         if mean_free:
             w = data["w"]
             r = r - _reduce(w * r) / _reduce(w)
-        return r
+        return r, f
+
+    def time_resids(params, data):
+        return time_resids_f(params, data)[0]
 
     def gn_step(params, data):
-        """One GLS/WLS Gauss-Newton refit: with correlated noise the design
-        matrix is augmented with the noise basis and the noise block
-        regularized by 1/phi (same algebra as fitting/gls.py)."""
+        """One GLS/WLS Gauss-Newton refit: hybrid design matrix (autodiff
+        over the nonlinear params + analytic columns for the linear
+        families, fitting/design.py); with correlated noise the matrix is
+        augmented with the noise basis and the noise block regularized by
+        1/phi (same algebra as fitting/gls.py)."""
         sw = data["sqrt_w"]
 
         def rfun(delta):
-            return time_resids(apply_delta(params, free, delta), data)
+            return time_resids_f(apply_delta(params, nonlin, delta), data)
 
-        z = jnp.zeros(p)
-        r0, lin = jax.linearize(rfun, z)
-        M = jax.vmap(lin)(jnp.eye(p)).T  # (N_local, p)
+        z = jnp.zeros(len(nonlin))
+        (r0, f0), jvp = jax.linearize(rfun, z)
+        cols = {}
+        if nonlin:
+            M_nl = jax.vmap(jvp)(jnp.eye(len(nonlin)))[0].T
+            for i, n in enumerate(nonlin):
+                cols[n] = M_nl[:, i]
+        if lin_names:
+            M_l = linear_columns(model, params, data["tensor"], f0, sl_data,
+                                 lin_names, owners)
+            if mean_free:
+                w = data["w"]
+                M_l = M_l - _reduce(w[:, None] * M_l) / _reduce(w)
+            for i, n in enumerate(lin_names):
+                cols[n] = M_l[:, i]
+        M = jnp.stack([cols[n] for n in free], axis=1)  # (N_local, p)
         A = M * sw[:, None]
         b = -r0 * sw
         if correlated:
@@ -279,7 +301,6 @@ def grid_chisq(
 def _grid_single(model, parnames, free, subtract_mean, maxiter, pts, params, data, batch):
     from pint_tpu.ops.compile import precision_jit
 
-    kernel = _point_kernel(model, parnames, free, subtract_mean, maxiter)
     npts = pts.shape[0]
     if batch is None:
         batch = npts if npts <= 64 else 16
@@ -289,11 +310,17 @@ def _grid_single(model, parnames, free, subtract_mean, maxiter, pts, params, dat
         pts = np.concatenate([pts, np.repeat(pts[-1:], n_pad, axis=0)])
     tiles = jnp.asarray(pts.reshape(-1, batch, pts.shape[1]))
 
-    vk = jax.vmap(kernel, in_axes=(0, None, None))
-    fn = precision_jit(
-        lambda tiles, params, data: jax.lax.map(lambda t: vk(t, params, data), tiles)
-    )
-    return fn(tiles, params, data).reshape(-1)
+    # compiled program cached on the model: repeated scans (bench repeats,
+    # profile sweeps) must not re-trace/re-compile
+    cache = model.__dict__.setdefault("_grid_fn_cache", {})
+    key = ("single", parnames, free, subtract_mean, maxiter, batch, model.xprec.name)
+    if key not in cache:
+        kernel = _point_kernel(model, parnames, free, subtract_mean, maxiter)
+        vk = jax.vmap(kernel, in_axes=(0, None, None))
+        cache[key] = precision_jit(
+            lambda tiles, params, data: jax.lax.map(lambda t: vk(t, params, data), tiles)
+        )
+    return cache[key](tiles, params, data).reshape(-1)
 
 
 def _grid_sharded(model, parnames, free, subtract_mean, maxiter, mesh,
@@ -323,17 +350,23 @@ def _grid_sharded(model, parnames, free, subtract_mean, maxiter, mesh,
     else:
         data_specs = jax.tree.map(lambda _: P(), data)
 
-    kernel = _point_kernel(model, parnames, free, subtract_mean, maxiter,
-                           toa_axis=eff_toa_axis)
-    vk = jax.vmap(kernel, in_axes=(0, None, None))
-    param_specs = jax.tree.map(lambda _: P(), params)
-    fn = shard_map(
-        vk,
-        mesh=mesh,
-        in_specs=(P(grid_axis), param_specs, data_specs),
-        out_specs=P(grid_axis),
-        check_vma=False,
-    )
     from pint_tpu.ops.compile import precision_jit
 
-    return precision_jit(fn)(pts, params, data)
+    cache = model.__dict__.setdefault("_grid_fn_cache", {})
+    key = ("sharded", parnames, free, subtract_mean, maxiter,
+           grid_axis, toa_axis, tuple(mesh.devices.flat),
+           tuple(sorted(mesh.shape.items())), shard_toas, model.xprec.name)
+    if key not in cache:
+        kernel = _point_kernel(model, parnames, free, subtract_mean, maxiter,
+                               toa_axis=eff_toa_axis)
+        vk = jax.vmap(kernel, in_axes=(0, None, None))
+        param_specs = jax.tree.map(lambda _: P(), params)
+        fn = shard_map(
+            vk,
+            mesh=mesh,
+            in_specs=(P(grid_axis), param_specs, data_specs),
+            out_specs=P(grid_axis),
+            check_vma=False,
+        )
+        cache[key] = precision_jit(fn)
+    return cache[key](pts, params, data)

@@ -72,8 +72,21 @@ IGNORED_KEYS = {
 PENDING_KEYS: set[str] = set()
 
 
-def get_model(parfile: str, from_text: bool = False) -> TimingModel:
+def get_model(parfile: str, from_text: bool = False, allow_tcb: bool = False) -> TimingModel:
+    """Parfile -> TimingModel. UNITS TCB parfiles are rejected unless
+    `allow_tcb`, in which case the model is built and converted to TDB
+    (approximately — re-fit afterwards; reference model_builder allow_tcb)."""
     pf = parse_parfile(parfile, from_text=from_text)
+    units = (pf.get("UNITS") or "TDB").upper()
+    if units == "TCB" and allow_tcb:
+        for line in pf.get_all("UNITS"):
+            line.tokens[0] = "TDB"
+        model = build_model(pf)
+        model.meta["UNITS"] = "TCB"
+        from pint_tpu.models.tcb_conversion import convert_tcb_tdb
+
+        convert_tcb_tdb(model)
+        return model
     return build_model(pf)
 
 

@@ -98,6 +98,26 @@ class DispersionDM(DelayComponent):
     def delay(self, params: dict, tensor: dict, delay_so_far: Array, xp) -> Array:
         return dispersion_time_delay(self.base_dm(params, tensor), barycentric_radio_freq(tensor))
 
+    # delay is exactly linear in every DM Taylor coefficient
+    def linear_param_names(self):
+        return [f"DM{k}" if k else "DM" for k in range(self.num_terms)]
+
+    def linear_resid_columns(self, params, tensor, f, sl):
+        import math
+
+        from pint_tpu.models.base import dt_since_epoch_f64
+
+        fb = barycentric_radio_freq(tensor)[sl]
+        base = jnp.where(jnp.isfinite(fb), -DMCONST / (fb * fb), 0.0)
+        out = {"DM": base}
+        if self.num_terms > 1:
+            dt = dt_since_epoch_f64(tensor, params["DMEPOCH"])[sl]
+            pw = jnp.ones_like(dt)
+            for k in range(1, self.num_terms):
+                pw = pw * dt
+                out[f"DM{k}"] = base * pw / math.factorial(k)
+        return out
+
 
 def _dmx_value_spec(k: int) -> ParamSpec:
     return ParamSpec(
@@ -157,6 +177,18 @@ class DispersionDMX(DelayComponent):
 
     def dm_value(self, params: dict, tensor: dict) -> Array:
         return self.dmx_dm(params, tensor)
+
+    def linear_param_names(self):
+        return [f"DMX_{i:04d}" for i in self.sorted_indices]
+
+    def linear_resid_columns(self, params, tensor, f, sl):
+        fb = barycentric_radio_freq(tensor)[sl]
+        base = jnp.where(jnp.isfinite(fb), -DMCONST / (fb * fb), 0.0)
+        onehot = tensor["dmx_onehot"][sl]
+        return {
+            f"DMX_{i:04d}": base * onehot[:, j]
+            for j, i in enumerate(self.sorted_indices)
+        }
 
     def delay(self, params: dict, tensor: dict, delay_so_far: Array, xp) -> Array:
         return dispersion_time_delay(self.dmx_dm(params, tensor), barycentric_radio_freq(tensor))

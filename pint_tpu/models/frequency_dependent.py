@@ -51,3 +51,19 @@ class FD(DelayComponent):
         for k in range(self.num_terms, 0, -1):
             out = (out + leaf_to_f64(params.get(f"FD{k}", 0.0))) * logf
         return jnp.where(finite, out, 0.0)
+
+    def linear_param_names(self):
+        return [f"FD{k}" for k in range(1, self.num_terms + 1)]
+
+    def linear_resid_columns(self, params, tensor, f, sl):
+        from pint_tpu.models.dispersion import barycentric_radio_freq
+
+        f_ghz = barycentric_radio_freq(tensor)[sl] / 1e3
+        finite = jnp.isfinite(f_ghz) & (f_ghz > 0)
+        logf = jnp.log(jnp.where(finite, f_ghz, 1.0))
+        out = {}
+        pw = jnp.ones_like(logf)
+        for k in range(1, self.num_terms + 1):
+            pw = pw * logf
+            out[f"FD{k}"] = jnp.where(finite, -pw, 0.0)
+        return out

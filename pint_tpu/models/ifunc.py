@@ -75,3 +75,13 @@ class IFunc(PhaseComponent):
         vals = jnp.stack([leaf_to_f64(params[f"IFUNC{k}"]) for k in self.node_indices])
         tau = tensor["ifunc_w"] @ vals
         return xp.from_f64(tau * leaf_to_f64(params["F0"]))
+
+    def linear_param_names(self):
+        return [f"IFUNC{k}" for k in self.node_indices]
+
+    def linear_resid_columns(self, params, tensor, f, sl):
+        f0 = leaf_to_f64(params["F0"])
+        W = tensor["ifunc_w"][sl]
+        return {
+            f"IFUNC{k}": W[:, j] * f0 / f for j, k in enumerate(self.node_indices)
+        }

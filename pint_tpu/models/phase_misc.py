@@ -83,6 +83,16 @@ class PhaseJump(PhaseComponent):
         # F0 * jump (reference jump.py phase_d_jump): use F0 from params
         return xp.from_f64(total * leaf_to_f64(params["F0"]))
 
+    def linear_param_names(self):
+        return [mp.name for mp in self.mask_params]
+
+    def linear_resid_columns(self, params, tensor, f, sl):
+        f0 = leaf_to_f64(params["F0"])
+        return {
+            mp.name: tensor[f"mask_{mp.name}"][sl] * f0 / f
+            for mp in self.mask_params
+        }
+
 
 class DelayJump(DelayComponent):
     """Time-domain jumps (reference jump.py:12; register=False there too —

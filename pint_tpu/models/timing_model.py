@@ -63,6 +63,18 @@ class TimingModel:
 
     # --- structure ---------------------------------------------------------------
 
+    _JIT_CACHES = (
+        "_resid_fn_cache", "_wls_step_cache", "_gls_step_cache",
+        "_gls_chi2_cache", "_wb_step_cache", "_wb_chi2_cache", "_grid_fn_cache",
+    )
+
+    def clear_caches(self) -> None:
+        """Drop every cached jitted program. REQUIRED after any structural
+        mutation (component swap/addition, e.g. binaryconvert or
+        add_dmx_to_model) — cached closures capture the old component list."""
+        for k in self._JIT_CACHES:
+            self.__dict__.pop(k, None)
+
     def __getitem__(self, name: str) -> Component:
         for c in self.components:
             if c.name == name:

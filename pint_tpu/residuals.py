@@ -228,6 +228,10 @@ class WidebandTOAResiduals:
         self.dm_errors = np.asarray(model.scaled_dm_sigma(params, self.tensor))
 
     @property
+    def errors_s(self) -> np.ndarray:
+        return self.toa.errors_s
+
+    @property
     def dm_resids(self) -> np.ndarray:
         params = self.model.xprec.convert_params(self.model.params)
         return self.dm_data - np.asarray(self.model.total_dm(params, self.tensor))

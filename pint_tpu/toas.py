@@ -92,6 +92,30 @@ class TOAs:
     def __len__(self):
         return len(self.error_us)
 
+    def write_tim(self, path: str, name: str = "fake") -> None:
+        """Write a Tempo2-format tim file (reference TOAs.write_TOA_file,
+        toa.py:549 format). Uses the raw (pre-clock-chain) site UTC."""
+        from pint_tpu.io.tim import TOALine, write_tim as _write
+
+        ep = self.utc_raw if self.utc_raw is not None else self.utc
+        lines = []
+        for i in range(len(self)):
+            frac_hi = float(ep.frac_hi[i])
+            frac_lo = float(ep.frac_lo[i])
+            lines.append(
+                TOALine(
+                    name=f"{name}_{i}",
+                    freq_mhz=float(self.freq_mhz[i]),
+                    mjd_day=int(ep.day[i]),
+                    mjd_frac_hi=frac_hi,
+                    mjd_frac_lo=frac_lo,
+                    error_us=float(self.error_us[i]),
+                    obs=str(self.obs[i]),
+                    flags=dict(self.flags[i]),
+                )
+            )
+        _write(lines, path)
+
     @property
     def ntoas(self) -> int:
         return len(self)

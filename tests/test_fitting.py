@@ -143,3 +143,26 @@ class TestDesignMatrix:
             numeric = (np.asarray(rp) - np.asarray(rm)) / (2 * h)
             scale = np.max(np.abs(M[:, i])) + 1e-300
             assert np.allclose(M[:, i], numeric, atol=2e-5 * scale), name
+
+
+class TestSummaryAndFtest:
+    def test_get_summary(self, model, fake_toas):
+        import copy
+
+        m = copy.deepcopy(model)
+        ftr = WLSFitter(fake_toas, m)
+        ftr.fit_toas(maxiter=3)
+        s = ftr.get_summary()
+        assert "free parameters" in s and "reduced Chisq" in s
+        for n in m.free_params:
+            assert n in s
+
+    def test_ftest(self):
+        from pint_tpu.fitting.wls import ftest
+
+        # adding 1 param that drops chi2 by 50 over 100 dof: significant
+        assert ftest(150.0, 101, 100.0, 100) < 1e-6
+        # adding 1 param that drops chi2 by 0.5: not significant
+        assert ftest(100.5, 101, 100.0, 100) > 0.4
+        # degenerate inputs
+        assert ftest(100.0, 100, 120.0, 99) == 1.0

@@ -1,0 +1,213 @@
+"""Productivity layer: derived quantities, polycos, binaryconvert, TCB
+conversion, DMX utils, CLI scripts."""
+
+import os
+
+import numpy as np
+import pytest
+
+from pint_tpu.io.par import parse_parfile
+from pint_tpu.models.builder import build_model, get_model
+from pint_tpu.simulation import make_fake_toas_uniform
+
+PAR = """
+PSR UTILFAKE
+RAJ 12:00:00 1
+DECJ 05:00:00 1
+F0 100.0 1
+F1 -1e-15 1
+PEPOCH 55500
+POSEPOCH 55500
+DM 12.5 1
+TZRMJD 55500.1
+TZRSITE gbt
+TZRFRQ 1400
+"""
+
+ELL1_PAR = PAR.replace("PSR UTILFAKE", "PSR BCFAKE") + """
+BINARY ELL1
+PB 10.0 1
+A1 5.0 1
+TASC 55490.0 1
+EPS1 1e-5 1
+EPS2 2e-5 1
+"""
+
+
+class TestDerivedQuantities:
+    def test_crab_like_values(self):
+        from pint_tpu import derived_quantities as dq
+
+        # Crab: F0=29.946, F1=-3.77e-10 -> tau_c ~ 1260 yr, B ~ 3.8e12 G
+        age = dq.pulsar_age(29.946, -3.77e-10)
+        assert 1100 < age < 1400
+        B = dq.pulsar_B(29.946, -3.77e-10)
+        assert 3e12 < B < 4.5e12
+        Edot = dq.pulsar_Edot(29.946, -3.77e-10)
+        assert 3e31 < Edot < 6e31  # ~4.5e31 W
+
+    def test_mass_function_and_companion(self):
+        from pint_tpu import derived_quantities as dq
+
+        # J0740+6620: Pb=4.7669 d, a1=3.9776 ls; consistency with the
+        # published masses (Mp ~ 2.08, Mc ~ 0.26, i ~ 87.4 deg)
+        fm = dq.mass_function(4.76694 * 86400, 3.97756)
+        fm2 = dq.mass_function_2(2.08, 0.26, np.sin(np.radians(87.35)))
+        assert fm == pytest.approx(fm2, rel=0.1)
+        mc = dq.companion_mass(4.76694 * 86400, 3.97756,
+                               inc_rad=np.radians(87.35), mp=2.08)
+        assert mc == pytest.approx(0.26, rel=0.15)
+
+    def test_gr_omdot_hulse_taylor(self):
+        from pint_tpu import derived_quantities as dq
+
+        # PSR B1913+16: Pb=0.3230 d, e=0.6171, m=1.441+1.387 -> 4.22 deg/yr
+        omdot = dq.omdot_gr(1.441, 1.387, 0.322997 * 86400, 0.6171)
+        assert omdot == pytest.approx(4.22, rel=0.02)
+        pbdot = dq.pbdot_gr(1.441, 1.387, 0.322997 * 86400, 0.6171)
+        assert pbdot == pytest.approx(-2.40e-12, rel=0.05)
+
+
+class TestPolycos:
+    def test_generate_eval_closure(self):
+        from pint_tpu.polycos import Polycos
+
+        m = build_model(parse_parfile(PAR, from_text=True))
+        pc = Polycos.generate_polycos(
+            m, 55500.0, 55500.5, obs="gbt", seg_length_min=60.0, ncoeff=12
+        )
+        assert len(pc.entries) == 12
+        # independent check epochs against the full model
+        from pint_tpu.astro import time as ptime
+        from pint_tpu.residuals import Residuals
+        from pint_tpu.toas import prepare_arrays
+
+        mjds = np.linspace(55500.01, 55500.49, 25)
+        utc = ptime.MJDEpoch.from_mjd_float(mjds)
+        toas = prepare_arrays(utc, np.ones(25), np.full(25, 1400.0),
+                              np.array(["gbt"] * 25))
+        r = Residuals(toas, m, subtract_mean=False, track_mode="nearest")
+        truth = np.asarray(r.pulse_numbers, np.longdouble) + np.asarray(
+            r.phase_resids, np.longdouble
+        )
+        # polyco DT is against the SITE UTC arrival time (TEMPO convention)
+        pred = pc.eval_abs_phase(mjds)
+        err = np.asarray(pred - truth, float)
+        assert np.max(np.abs(err)) < 1e-6  # < 1 uturn
+        f = pc.eval_spin_freq(mjds)
+        assert np.allclose(f, 100.0, atol=1e-2)
+
+    def test_write_read_roundtrip(self, tmp_path):
+        from pint_tpu.polycos import Polycos
+
+        m = build_model(parse_parfile(PAR, from_text=True))
+        pc = Polycos.generate_polycos(m, 55500.0, 55500.1, obs="gbt",
+                                      seg_length_min=60.0, ncoeff=8)
+        p = tmp_path / "polyco.dat"
+        pc.write(str(p))
+        pc2 = Polycos.read(str(p))
+        assert len(pc2.entries) == len(pc.entries)
+        t = 55500.03
+        assert float(pc2.eval_abs_phase(t)[0]) == pytest.approx(
+            float(pc.eval_abs_phase(t)[0]), abs=1e-4
+        )
+
+
+class TestBinaryConvert:
+    def test_ell1_dd_roundtrip_residuals(self):
+        import copy
+
+        from pint_tpu.binaryconvert import convert_binary
+        from pint_tpu.residuals import Residuals
+
+        m = build_model(parse_parfile(ELL1_PAR, from_text=True))
+        toas = make_fake_toas_uniform(55400, 55600, 30, m, freq_mhz=1400.0)
+        r0 = Residuals(toas, m, subtract_mean=False).time_resids
+
+        m2 = convert_binary(copy.deepcopy(m), "DD")
+        assert m2.meta["BINARY"] == "DD"
+        assert "ECC" in m2.params and "T0" in m2.params and "EPS1" not in m2.params
+        r1 = Residuals(toas, m2, subtract_mean=False).time_resids
+        # ELL1 ignores O(e^4); with e=2.2e-5 agreement is ~ns
+        np.testing.assert_allclose(r1, r0, atol=5e-8)
+
+        m3 = convert_binary(copy.deepcopy(m2), "ELL1")
+        r2 = Residuals(toas, m3, subtract_mean=False).time_resids
+        np.testing.assert_allclose(r2, r0, atol=5e-8)
+
+
+class TestTCBConversion:
+    def test_scaling_and_gate(self, tmp_path):
+        tcb_par = PAR.replace("PSR UTILFAKE", "PSR TCBFAKE") + "UNITS TCB\n"
+        p = tmp_path / "tcb.par"
+        p.write_text(tcb_par)
+        with pytest.raises(ValueError):
+            get_model(str(p))
+        m = get_model(str(p), allow_tcb=True)
+        assert m.meta["UNITS"] == "TDB"
+        from pint_tpu.models.tcb_conversion import IFTE_K
+        from pint_tpu.models.base import leaf_to_f64
+
+        f0 = float(np.asarray(leaf_to_f64(m.params["F0"])))
+        assert f0 == pytest.approx(100.0 / IFTE_K, rel=1e-12)
+        dm = float(np.asarray(m.params["DM"]))
+        assert dm == pytest.approx(12.5 / IFTE_K, rel=1e-12)
+
+
+class TestDMXUtils:
+    def test_ranges_cover_and_parse(self):
+        from pint_tpu.dmxutils import add_dmx_to_model, dmx_ranges, dmxparse
+        from pint_tpu.fitting import WLSFitter
+
+        m = build_model(parse_parfile(PAR, from_text=True))
+        freqs = np.where(np.arange(40) % 2 == 0, 800.0, 1600.0)
+        toas = make_fake_toas_uniform(55000, 55200, 40, m, freq_mhz=freqs,
+                                      error_us=1.0)
+        ranges = dmx_ranges(toas)
+        mjd = toas.tdb.mjd_float()
+        covered = np.zeros(len(toas), bool)
+        for r1, r2 in ranges:
+            assert r2 - r1 <= 7.0
+            covered |= (mjd >= r1) & (mjd <= r2)
+        assert covered.all()
+
+        add_dmx_to_model(m, ranges)
+        assert "DispersionDMX" in m.component_names
+        ftr = WLSFitter(toas, m)
+        ftr.fit_toas(maxiter=3)
+        out = dmxparse(ftr)
+        assert len(out["dmxs"]) == len(ranges)
+        assert np.all(np.isfinite(out["dmx_verrs"]))
+        # zero injected DMX: fitted values consistent with 0
+        assert np.all(np.abs(out["dmxs"]) < 6 * out["dmx_verrs"] + 1e-9)
+
+
+class TestCLIs:
+    def test_zima_pintempo_roundtrip(self, tmp_path):
+        from pint_tpu.scripts import pintempo, zima
+
+        par = tmp_path / "m.par"
+        par.write_text(PAR)
+        tim = tmp_path / "m.tim"
+        assert zima.main([str(par), str(tim), "--ntoa", "25",
+                          "--startMJD", "55400", "--duration", "200"]) == 0
+        assert tim.exists()
+        out = tmp_path / "post.par"
+        assert pintempo.main([str(par), str(tim), "--outfile", str(out)]) == 0
+        assert "F0" in out.read_text()
+
+    def test_pintbary(self, capsys):
+        from pint_tpu.scripts import pintbary
+
+        assert pintbary.main(["56000.0", "--ra", "05:00:00",
+                              "--dec", "20:00:00", "--obs", "gbt"]) == 0
+        assert "BAT" in capsys.readouterr().out
+
+    def test_tcb2tdb_cli(self, tmp_path):
+        from pint_tpu.scripts import tcb2tdb
+
+        src = tmp_path / "in.par"
+        src.write_text(PAR + "UNITS TCB\n")
+        dst = tmp_path / "out.par"
+        assert tcb2tdb.main([str(src), str(dst)]) == 0
+        assert "TDB" in dst.read_text()
