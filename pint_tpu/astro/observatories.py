@@ -110,6 +110,9 @@ _BUILTIN = [
     TopoObs("jbroach", ("r",), "utc", (3822626.04, -154105.65, 5086486.04), "r"),
     TopoObs("mkiii", ("j",), "utc", (3822626.04, -154105.65, 5086486.04), "j"),
     GeocenterObs("geocenter", ("coe", "0", "geo")),
+    # geocentered photon events keep their native TT timescale (no
+    # UTC leap-second chain): Fermi GEO FT1, geocentered X-ray events
+    GeocenterObs("geocenter_tt", ("geo_tt",), "tt"),
     BarycenterObs("barycenter", ("@", "bat", "ssb"), "tdb"),
 ]
 

@@ -19,14 +19,21 @@ def dmx_ranges(toas, bin_width_d: float = 6.5, pad_d: float = 0.05):
     semantics: consecutive TOAs group until the window would exceed
     bin_width days). Returns [(r1, r2), ...] MJD pairs."""
     mjd = np.sort(toas.tdb.mjd_float())
-    ranges = []
+    bounds = []
     start = prev = mjd[0]
     for t in mjd[1:]:
         if t - start > bin_width_d:
-            ranges.append((start - pad_d, prev + pad_d))
+            bounds.append((start, prev))
             start = t
         prev = t
-    ranges.append((start - pad_d, prev + pad_d))
+    bounds.append((start, prev))
+    # pad, clamping to half the gap between neighbors so windows never
+    # overlap (overlap would double-apply DM to boundary TOAs)
+    ranges = []
+    for i, (a, b) in enumerate(bounds):
+        lo_pad = pad_d if i == 0 else min(pad_d, (a - bounds[i - 1][1]) / 2.0)
+        hi_pad = pad_d if i == len(bounds) - 1 else min(pad_d, (bounds[i + 1][0] - b) / 2.0)
+        ranges.append((a - lo_pad, b + hi_pad))
     return ranges
 
 

@@ -125,7 +125,14 @@ class Residuals:
             self.errors_s = np.asarray(sigma)
         else:
             self.errors_s = self.raw_errors_s
-        self._weights = jnp.asarray(1.0 / self.errors_s**2)
+        # photon-event TOAs carry zero error: weight them equally rather
+        # than dividing by zero (their residual use is phase folding)
+        if np.all(self.errors_s == 0):
+            self._weights = jnp.ones(len(self.errors_s))
+        else:
+            with np.errstate(divide="ignore"):
+                w = np.where(self.errors_s > 0, 1.0 / self.errors_s**2, 0.0)
+            self._weights = jnp.asarray(w)
 
         self._jitted = get_resid_fn(model, subtract_mean)
         self._cache = None

@@ -1,0 +1,120 @@
+"""Photon pulse-profile templates: wrapped-Gaussian components + unbinned
+maximum-likelihood fitting.
+
+Reference: pint/templates/ (lcprimitives.py LCGaussian, lctemplate.py
+LCTemplate, lcfitters.py LCFitter — ~4.8k LoC of profile machinery; this
+module implements the load-bearing core: the 'gauss' text format the
+reference ships (e.g. tests/datafile/templateJ0030.3gauss), template
+evaluation as a wrapped-Gaussian mixture, and the unbinned weighted
+log-likelihood fit of a phase offset / component parameters used by
+photonphase-style analyses).
+
+Template density over phase x in [0,1):
+    f(x) = norm_free + sum_i ampl_i * N_w(x; phas_i, fwhm_i)
+with N_w a Gaussian wrapped over +-N cycles and the constant chosen so
+f integrates to 1 (amplitudes are the components' integral fractions).
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+
+import numpy as np
+
+FWHM_TO_SIGMA = 1.0 / (2.0 * np.sqrt(2.0 * np.log(2.0)))
+_WRAPS = 3
+
+
+@dataclass
+class LCGaussian:
+    phase: float
+    fwhm: float
+    ampl: float
+
+    def density(self, x: np.ndarray) -> np.ndarray:
+        """Wrapped normalized Gaussian at phases x (cycles)."""
+        s = self.fwhm * FWHM_TO_SIGMA
+        out = np.zeros_like(x, dtype=float)
+        for k in range(-_WRAPS, _WRAPS + 1):
+            out += np.exp(-0.5 * ((x - self.phase + k) / s) ** 2)
+        return out / (s * np.sqrt(2 * np.pi))
+
+
+@dataclass
+class LCTemplate:
+    components: list[LCGaussian] = field(default_factory=list)
+
+    @property
+    def total_ampl(self) -> float:
+        return sum(c.ampl for c in self.components)
+
+    def __call__(self, phases: np.ndarray) -> np.ndarray:
+        """Normalized profile density at phases (cycles)."""
+        x = np.mod(np.asarray(phases, float), 1.0)
+        out = np.full_like(x, max(1.0 - self.total_ampl, 0.0))
+        for c in self.components:
+            out = out + c.ampl * c.density(x)
+        return out
+
+    def shifted(self, dphi: float) -> "LCTemplate":
+        return LCTemplate(
+            [LCGaussian((c.phase + dphi) % 1.0, c.fwhm, c.ampl) for c in self.components]
+        )
+
+    # --- 'gauss' text format (reference lctemplate.prim_io) --------------------
+
+    @classmethod
+    def read(cls, path: str) -> "LCTemplate":
+        vals: dict[str, float] = {}
+        with open(path) as f:
+            for line in f:
+                m = re.match(r"\s*(\w+)\s*=\s*([-\d.eE+]+)", line)
+                if m:
+                    vals[m.group(1)] = float(m.group(2))
+        comps = []
+        k = 1
+        while f"phas{k}" in vals:
+            comps.append(
+                LCGaussian(vals[f"phas{k}"], vals[f"fwhm{k}"], vals[f"ampl{k}"])
+            )
+            k += 1
+        if not comps:
+            raise ValueError(f"{path}: no gaussian components found")
+        return cls(comps)
+
+    def write(self, path: str) -> None:
+        with open(path, "w") as f:
+            f.write("# gauss\n" + "-" * 25 + "\n")
+            f.write("const = 0.00000 +/- 0.00000\n")
+            for k, c in enumerate(self.components, start=1):
+                f.write(f"phas{k} = {c.phase:.5f} +/- 0.00000\n")
+                f.write(f"fwhm{k} = {c.fwhm:.5f} +/- 0.00000\n")
+                f.write(f"ampl{k} = {c.ampl:.5f} +/- 0.00000\n")
+            f.write("-" * 25 + "\n")
+
+
+def lnlikelihood(template: LCTemplate, phases, weights=None, dphi: float = 0.0) -> float:
+    """Unbinned weighted photon log-likelihood (reference lcfitters.py):
+    sum log(w f(phi - dphi) + (1 - w))."""
+    f = template(np.asarray(phases) - dphi)
+    if weights is None:
+        return float(np.sum(np.log(np.maximum(f, 1e-300))))
+    w = np.asarray(weights)
+    return float(np.sum(np.log(np.maximum(w * f + (1.0 - w), 1e-300))))
+
+
+def fit_phase_shift(template: LCTemplate, phases, weights=None, n_grid: int = 256):
+    """Maximum-likelihood phase offset of the data vs the template, with a
+    Fisher-information uncertainty (reference lcfitters.fit_position)."""
+    grid = np.linspace(0, 1, n_grid, endpoint=False)
+    ll = np.array([lnlikelihood(template, phases, weights, d) for d in grid])
+    i = int(np.argmax(ll))
+    # parabolic refinement around the grid peak
+    lm, l0, lp = ll[(i - 1) % n_grid], ll[i], ll[(i + 1) % n_grid]
+    denom = lm - 2 * l0 + lp
+    frac = 0.5 * (lm - lp) / denom if denom != 0 else 0.0
+    dphi = (grid[i] + frac / n_grid) % 1.0
+    curv = -denom * n_grid**2  # d2(-ll)/dphi2
+    err = 1.0 / np.sqrt(curv) if curv > 0 else np.nan
+    return dphi, err, float(l0)

@@ -382,9 +382,16 @@ def prepare_arrays(
         corr_s[sel] = chain.evaluate(utc.mjd_float()[sel])
     utc_corr = utc.add_seconds(corr_s)
 
-    # 2. UTC -> TT -> (geocentric) TDB
+    # 2. UTC -> TT -> (geocentric) TDB. Rows whose observatory runs on TT
+    # (photon-event data, e.g. Fermi MET after geocentering) skip the
+    # UTC->TT leap-second chain: their input times already ARE TT.
     bary = np.array([get_observatory(str(o)).is_barycenter for o in obs_names])
+    tt_scale = np.array([get_observatory(str(o)).timescale == "tt" for o in obs_names])
     tt = ptime.pulsar_mjd_utc_to_tt(utc_corr)
+    if np.any(tt_scale):
+        for dst, src in ((tt.day, utc_corr.day), (tt.frac_hi, utc_corr.frac_hi),
+                         (tt.frac_lo, utc_corr.frac_lo)):
+            dst[tt_scale] = src[tt_scale]
     tt_jcent = ptime.mjd_tt_julian_centuries(tt)
 
     # 3. site GCRS posvel (UT1 ~= UTC without EOP data)

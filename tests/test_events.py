@@ -1,0 +1,106 @@
+"""Photon-event stack: FITS reader, event TOAs, pulsation statistics,
+templates — validated against the reference's real mission data files."""
+
+import os
+
+import numpy as np
+import pytest
+
+from conftest import REFERENCE_DATA, have_reference_data
+
+pytestmark = pytest.mark.skipif(
+    not have_reference_data(), reason="reference datafile directory not mounted"
+)
+
+NICER_EVT = os.path.join(REFERENCE_DATA, "J0218_nicer_2070030405_cleanfilt_cut_bary.evt")
+NICER_PAR = os.path.join(REFERENCE_DATA, "PSR_J0218+4232.par")
+FERMI_FT1 = os.path.join(
+    REFERENCE_DATA,
+    "J0030+0451_P8_15.0deg_239557517_458611204_ft1weights_GEO_wt.gt.0.4.fits",
+)
+FERMI_PAR = os.path.join(REFERENCE_DATA, "J0030+0451_post.par")
+TEMPLATE = os.path.join(REFERENCE_DATA, "templateJ0030.3gauss")
+
+
+class TestEventStats:
+    def test_z2m_uniform_and_pulsed(self):
+        from pint_tpu.eventstats import hm, z2m
+
+        rng = np.random.default_rng(1)
+        uniform = rng.uniform(size=2000)
+        z = z2m(uniform, m=2)
+        assert z[-1] < 20  # chi2_4 tail
+        pulsed = np.concatenate([uniform, rng.normal(0.5, 0.02, 400) % 1.0])
+        assert z2m(pulsed, m=2)[-1] > 100
+        assert hm(pulsed) > hm(uniform)
+
+    def test_weighted_matches_unweighted_at_unit_weights(self):
+        from pint_tpu.eventstats import hm, hmw, z2m, z2mw
+
+        rng = np.random.default_rng(2)
+        ph = rng.uniform(size=500)
+        np.testing.assert_allclose(z2mw(ph, np.ones(500)), z2m(ph), rtol=1e-12)
+        assert hmw(ph, np.ones(500)) == pytest.approx(hm(ph), rel=1e-12)
+
+
+class TestFitsReader:
+    def test_nicer_events(self):
+        from pint_tpu.io.fitsio import find_extension, read_fits
+
+        hdus = read_fits(NICER_EVT)
+        ev = find_extension(hdus, "EVENTS")
+        assert ev.header["NAXIS2"] == len(ev.data["TIME"]) == 3361
+        assert ev.header["TIMESYS"] == "TDB"
+        gti = find_extension(hdus, "GTI")
+        assert "START" in gti.data
+
+    def test_fermi_ft1(self):
+        from pint_tpu.io.fitsio import find_extension, read_fits
+
+        ev = find_extension(read_fits(FERMI_FT1), "EVENTS")
+        assert len(ev.data["TIME"]) == 6973
+        # gtsrcprob names the weight column after the source
+        assert "PSRJ0030+0451" in ev.data
+        w = ev.data["PSRJ0030+0451"]
+        assert np.all((w > 0.39) & (w <= 1.0))
+
+
+class TestPhotonPhasing:
+    def test_nicer_j0218_detection(self):
+        """Barycentered NICER events fold at > 5 sigma with the model —
+        an absolute-phase end-to-end check of the whole pipeline."""
+        from pint_tpu.event_toas import load_NICER_TOAs
+        from pint_tpu.eventstats import hm
+        from pint_tpu.models.builder import get_model
+        from pint_tpu.residuals import Residuals
+
+        model = get_model(NICER_PAR)
+        toas = load_NICER_TOAs(NICER_EVT, planets=bool(model.planet_shapiro))
+        assert len(toas) == 3361
+        r = Residuals(toas, model, subtract_mean=False, track_mode="nearest")
+        h = hm(np.mod(r.phase_resids, 1.0))
+        assert h > 30  # measured 48.9 (5.9 sigma)
+
+    def test_fermi_j0030_weighted_detection_and_template(self):
+        from pint_tpu.event_toas import get_event_weights, load_Fermi_TOAs
+        from pint_tpu.eventstats import hmw
+        from pint_tpu.models.builder import get_model
+        from pint_tpu.residuals import Residuals
+        from pint_tpu.templates import LCTemplate, fit_phase_shift
+
+        model = get_model(FERMI_PAR)
+        toas = load_Fermi_TOAs(FERMI_FT1, weightcolumn="PSRJ0030+0451",
+                               planets=bool(model.planet_shapiro))
+        w = get_event_weights(toas)
+        assert w is not None and len(w) == 6973
+        r = Residuals(toas, model, subtract_mean=False, track_mode="nearest")
+        phases = np.mod(r.phase_resids, 1.0)
+        h = hmw(phases, w)
+        assert h > 300  # measured 483 (~19 sigma) with gtsrcprob weights
+        tpl = LCTemplate.read(TEMPLATE)
+        assert len(tpl.components) == 3
+        dphi, err, _ = fit_phase_shift(tpl, phases, w)
+        assert err < 0.01
+        # template integrates to ~1
+        x = np.linspace(0, 1, 10001)
+        assert np.trapezoid(tpl(x), x) == pytest.approx(1.0, abs=0.01)
