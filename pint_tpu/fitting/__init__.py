@@ -6,7 +6,7 @@ time); here the design matrix is jax.jacfwd of the jitted residual function,
 so one compiled program evaluates residuals + derivatives + the solve.
 """
 
-from pint_tpu.fitting.wls import DownhillWLSFitter, WLSFitter  # noqa: F401
+from pint_tpu.fitting.wls import DownhillWLSFitter, PowellFitter, WLSFitter, ftest  # noqa: F401
 from pint_tpu.fitting.gls import DownhillGLSFitter, GLSFitter  # noqa: F401
 from pint_tpu.fitting.wideband import WidebandDownhillFitter  # noqa: F401
 from pint_tpu.fitting.mcmc import MCMCFitter  # noqa: F401

@@ -413,3 +413,37 @@ class DownhillWLSFitter(WLSFitter):
         return self._finalize_fit(params, chi2_best, it, converged, cov, s=s)
 
 
+
+
+class PowellFitter(WLSFitter):
+    """Derivative-free simplex/Powell minimization of chi^2 (reference
+    PowellFitter, fitter.py:1916 via scipy) — for pathologically nonlinear
+    corners where Gauss-Newton struggles. Uncertainties still come from a
+    final WLS linearization at the optimum."""
+
+    def fit_toas(self, maxiter: int = 2000, xtol: float = 1e-10) -> FitResult:
+        from scipy.optimize import minimize
+
+        if len(self._free) == 0:
+            return self._frozen_fit_result()
+        params0 = self.model.xprec.convert_params(self.model.params)
+        # scale deltas by parfile uncertainties (or rough defaults)
+        scales = np.array(
+            [self.model.param_meta[n].uncertainty or 1e-10 for n in self._free]
+        )
+
+        def chi2_of(z):
+            return self.chi2_at(apply_delta(params0, self._free, z * scales))
+
+        res = minimize(
+            chi2_of, np.zeros(len(self._free)), method="Powell",
+            options={"maxiter": maxiter, "xtol": xtol},
+        )
+        params = apply_delta(params0, self._free, res.x * scales)
+        # linearize once at the optimum for the covariance
+        pieces = self._step_fn(params, self.tensor)
+        cov = pieces[3]
+        return self._finalize_fit(
+            params, float(res.fun), int(res.nit), bool(res.success), cov,
+            s=pieces[4],
+        )

@@ -321,3 +321,114 @@ def ell1k_delay(p: dict, dt: Array, phi: Array, norb: Array, pb: Array) -> Array
     tm2 = _get(p, "M2") * TSUN_S
     delayS = -2.0 * tm2 * jnp.log(1.0 - _get(p, "SINI") * s1)
     return delayI + delayS
+
+
+# --- DDGR: GR-derived post-Keplerian parameters ---------------------------------
+
+
+def ddgr_derived(params: dict) -> dict:
+    """Post-Keplerian parameters from (MTOT, M2) under GR (reference
+    DDGR_model.py; Damour & Deruelle 1986, Taylor & Weisberg 1989):
+
+        OMDOT = 3 n^(5/3) (Tsun MTOT)^(2/3) / (1 - e^2)   [+ XOMDOT]
+        GAMMA = e n^(-1/3) Tsun^(2/3) m2 (m1 + 2 m2) / MTOT^(4/3)
+        PBDOT = -(192 pi / 5) n^(5/3) f(e) Tsun^(5/3) m1 m2 / MTOT^(1/3)
+        SINI  = n^(2/3) x (Tsun MTOT)^(2/3) / (Tsun m2)
+        DR    = n^(2/3) Tsun^(2/3) (3 m1^2 + 6 m1 m2 + 2 m2^2) / MTOT^(4/3)
+        DTH   = n^(2/3) Tsun^(2/3) (3.5 m1^2 + 6 m1 m2 + 2 m2^2) / MTOT^(4/3)
+
+    Returned as plain f64 leaves; PBDOT is injected into the parameter
+    dict so the orbital-phase reduction sees it too.
+    """
+    from pint_tpu.models.base import leaf_to_f64
+
+    mt = leaf_to_f64(params["MTOT"])
+    m2 = leaf_to_f64(params["M2"])
+    m1 = mt - m2
+    e = leaf_to_f64(params.get("ECC", 0.0))
+    x = leaf_to_f64(params.get("A1", 0.0))
+    pb = leaf_to_f64(params["PB"])
+    n = 2.0 * jnp.pi / pb
+    t = TSUN_S
+    n23 = n ** (2.0 / 3.0)
+    omdot = 3.0 * n ** (5.0 / 3.0) * (t * mt) ** (2.0 / 3.0) / (1.0 - e * e)
+    omdot = omdot + leaf_to_f64(params.get("XOMDOT", 0.0))
+    gamma = e * n ** (-1.0 / 3.0) * t ** (2.0 / 3.0) * m2 * (m1 + 2.0 * m2) / mt ** (4.0 / 3.0)
+    fe = (1.0 + 73.0 / 24.0 * e**2 + 37.0 / 96.0 * e**4) / (1.0 - e * e) ** 3.5
+    pbdot = -192.0 * jnp.pi / 5.0 * n ** (5.0 / 3.0) * fe * t ** (5.0 / 3.0) \
+        * m1 * m2 / mt ** (1.0 / 3.0)
+    sini = n23 * x * (t * mt) ** (2.0 / 3.0) / (t * m2)
+    dr = n23 * t ** (2.0 / 3.0) * (3.0 * m1**2 + 6.0 * m1 * m2 + 2.0 * m2**2) / mt ** (4.0 / 3.0)
+    dth = n23 * t ** (2.0 / 3.0) * (3.5 * m1**2 + 6.0 * m1 * m2 + 2.0 * m2**2) / mt ** (4.0 / 3.0)
+    return {"OMDOT": omdot, "GAMMA": gamma, "PBDOT": pbdot, "SINI": sini,
+            "DR": dr, "DTH": dth}
+
+
+# --- DDK: Kopeikin proper-motion + annual-parallax corrections ------------------
+
+
+def ddk_corrections(params: dict, tensor: dict) -> dict:
+    """Per-TOA A1/OM/SINI corrections for the orbital orientation (KIN,
+    KOM) (reference DDK_model.py; Kopeikin 1995 eq 18, 1996 eq 10, 16):
+
+    proper motion:
+        d(A1)/A1 = cot(KIN) (-PMRA sin KOM + PMDEC cos KOM) dt
+        d(OM)    = csc(KIN) ( PMRA cos KOM + PMDEC sin KOM) dt
+    annual parallax (PX > 0), with obs position r in the (east, north)
+    sky basis at the pulsar:
+        d(A1)/A1 = -cot(KIN)/d * (r_e sin KOM - r_n cos KOM)
+        d(OM)    = -csc(KIN)/d * (r_e cos KOM + r_n sin KOM)
+    """
+    from pint_tpu.models.base import leaf_to_f64
+
+    if "PMELONG" in params or "PMELAT" in params or "ELONG" in params:
+        # KOM and the parallax basis below are EQUATORIAL; mixing ecliptic
+        # proper motion in would rotate the corrections by the obliquity
+        # (the reference likewise refuses DDK with ecliptic astrometry)
+        raise NotImplementedError(
+            "DDK requires equatorial astrometry (RAJ/DECJ/PMRA/PMDEC)"
+        )
+    kin0 = leaf_to_f64(params["KIN"])
+    kom = leaf_to_f64(params["KOM"])
+    x0 = leaf_to_f64(params["A1"])
+    om0 = leaf_to_f64(params.get("OM", 0.0))
+    sin_kom, cos_kom = jnp.sin(kom), jnp.cos(kom)
+
+    # time from the binary epoch rides in via the barycentric time column
+    t_s = tensor["t_hi"]
+    ep = leaf_to_f64(params.get("T0", 0.0))
+    dt = t_s - ep
+
+    pmra = leaf_to_f64(params.get("PMRA", 0.0))
+    pmdec = leaf_to_f64(params.get("PMDEC", 0.0))
+    # Kopeikin 1996: the proper motion DRIFTS the inclination itself,
+    # d(kin) = (-PMRA sin KOM + PMDEC cos KOM) dt, and rotates the node,
+    # d(OM) = csc(kin) (PMRA cos KOM + PMDEC sin KOM) dt
+    d_kin = (-pmra * sin_kom + pmdec * cos_kom) * dt
+    dom = (pmra * cos_kom + pmdec * sin_kom) * dt / jnp.sin(kin0)
+
+    px = leaf_to_f64(params.get("PX", 0.0))
+    if "_psr_dir" in tensor:
+        # sky basis at the pulsar: east = z_hat x n / |..|, north = n x east
+        n_hat = tensor["_psr_dir"]
+        zhat = jnp.array([0.0, 0.0, 1.0])
+        east = jnp.cross(jnp.broadcast_to(zhat, n_hat.shape), n_hat)
+        east = east / jnp.linalg.norm(east, axis=-1, keepdims=True)
+        north = jnp.cross(n_hat, east)
+        r = tensor["ssb_obs_pos_ls"]  # light-seconds
+        r_e = jnp.sum(r * east, axis=-1)
+        r_n = jnp.sum(r * north, axis=-1)
+        # 1/d in 1/ls from PX (rad): d = AU/PX
+        AU_LS = 499.00478384
+        inv_d = px / AU_LS
+        d_kin = d_kin - inv_d * (r_e * sin_kom - r_n * cos_kom)
+        dom = dom - inv_d * (r_e * cos_kom + r_n * sin_kom) / jnp.sin(kin0)
+
+    kin_t = kin0 + d_kin
+    # the drifting inclination shapes BOTH the projected semi-major axis
+    # and the Shapiro delay, keeping the orbital geometry self-consistent
+    return {
+        "A1": x0 * jnp.sin(kin_t) / jnp.sin(kin0),
+        "OM": om0 + dom,
+        "SINI": jnp.sin(kin_t),
+    }

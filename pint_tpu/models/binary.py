@@ -110,6 +110,43 @@ BINARY_MODELS: dict[str, dict] = {
         + [ParamSpec("SHAPMAX", unit="", default=0.0, description="-ln(1 - sin i)")],
         "drop": ("SINI",),
     },
+    "DDGR": {
+        # DD with every post-Keplerian parameter DERIVED from (MTOT, M2)
+        # under GR (reference binary_dd.py DDGRmodel / DDGR_model.py):
+        # OMDOT, GAMMA, PBDOT, SINI, DR, DTH come from the masses; XOMDOT/
+        # XPBDOT are additive excesses. Derivation happens in delay() so
+        # PBDOT_GR also enters the orbital phase.
+        "engine": eng.dd_delay,
+        "epoch": "T0",
+        "specs": lambda: _eccentric_specs()
+        + _dd_extra_specs()
+        + [
+            ParamSpec("MTOT", unit="Msun", description="Total mass"),
+            ParamSpec("XOMDOT", scale=DEG_PER_YEAR_TO_RAD_PER_SEC, unit="deg/yr",
+                      default=0.0, description="Excess periastron advance"),
+        ],
+        # every GR-derived post-Keplerian parameter is an OUTPUT here: a
+        # parfile setting (or freeing) one must be rejected, not silently
+        # overwritten into a zero design-matrix column
+        "drop": ("SINI", "OMDOT", "GAMMA", "PBDOT", "DR", "DTH"),
+        "derive": "ddgr",
+    },
+    "DDK": {
+        # DD + Kopeikin (1995, 1996) corrections: proper-motion and
+        # annual-parallax modulation of A1 and OM given the orbital
+        # orientation (KIN, KOM) (reference binary_ddk.py / DDK_model.py).
+        "engine": eng.dd_delay,
+        "epoch": "T0",
+        "specs": lambda: _eccentric_specs()
+        + _dd_extra_specs()
+        + [
+            ParamSpec("KIN", kind="deg", unit="deg", description="Inclination angle"),
+            ParamSpec("KOM", kind="deg", unit="deg", default=0.0,
+                      description="Longitude of ascending node"),
+        ],
+        "drop": ("SINI",),
+        "derive": "ddk",
+    },
     "ELL1": {"engine": eng.ell1_delay, "epoch": "TASC", "specs": _ell1_specs},
     "ELL1H": {
         "engine": eng.ell1h_delay,
@@ -164,6 +201,7 @@ class PulsarBinary(DelayComponent):
         cfg = BINARY_MODELS[self.model_name]
         self.engine = cfg["engine"]
         self.epoch_name = cfg["epoch"]
+        self.derive = cfg.get("derive")
         drop = set(cfg.get("drop", ()))
         self._spec_list = [
             s for s in _common_specs() + cfg["specs"]() if s.name not in drop
@@ -264,12 +302,19 @@ class PulsarBinary(DelayComponent):
     # --- delay -------------------------------------------------------------------
 
     def delay(self, params: dict, tensor: dict, delay_so_far: Array, xp) -> Array:
+        if self.derive == "ddgr":
+            params = {**params, **eng.ddgr_derived(params)}
         phi, norb, dt, pb = self._orbits(params, tensor, delay_so_far, xp)
         p = {
             name: leaf_to_f64(params[name])
             for name, spec in self.specs.items()
             if name in params and spec.is_fittable
         }
+        if self.derive == "ddgr":
+            for k in ("OMDOT", "GAMMA", "SINI", "PBDOT", "DR", "DTH"):
+                p[k] = params[k]
+        elif self.derive == "ddk":
+            p.update(eng.ddk_corrections(params, tensor))
         if self.model_name == "ELL1H":
             return self.engine(p, dt, phi, norb, pb, nharms=self.nharms, mode=self.h_mode)
         return self.engine(p, dt, phi, norb, pb)

@@ -350,6 +350,37 @@ class TimingModel:
 
         return _b.model_to_parfile(self)
 
+    def compare(self, other: "TimingModel", sigma: float = 3.0) -> str:
+        """Parameter-by-parameter comparison of two models (reference
+        TimingModel.compare, timing_model.py): flags values differing by
+        more than `sigma` of this model's uncertainties."""
+        from pint_tpu.models.base import leaf_to_f64
+
+        lines = [f"{'PAR':<12s} {'this':>22s} {'other':>22s} {'diff/sigma':>11s}"]
+        names = [
+            n for n in self.params
+            if n in self.param_meta and self.param_meta[n].spec.is_fittable
+        ]
+        for n in names:
+            v1 = float(np.asarray(leaf_to_f64(self.params[n])))
+            if n not in other.params:
+                lines.append(f"{n:<12s} {v1:>22.12g} {'---':>22s}")
+                continue
+            v2 = float(np.asarray(leaf_to_f64(other.params[n])))
+            unc = self.param_meta[n].uncertainty
+            if unc:
+                ns = (v2 - v1) / unc
+                flag = " !" if abs(ns) > sigma else ""
+                lines.append(f"{n:<12s} {v1:>22.12g} {v2:>22.12g} {ns:>11.2f}{flag}")
+            else:
+                lines.append(f"{n:<12s} {v1:>22.12g} {v2:>22.12g}")
+        for n in other.params:
+            if (n not in self.params and n in other.param_meta
+                    and other.param_meta[n].spec.is_fittable):
+                v2 = float(np.asarray(leaf_to_f64(other.params[n])))
+                lines.append(f"{n:<12s} {'---':>22s} {v2:>22.12g}")
+        return "\n".join(lines)
+
     def summary(self) -> str:
         lines = [f"TimingModel {self.psr_name or '?'}: " + ", ".join(self.component_names)]
         for n, m in self.param_meta.items():

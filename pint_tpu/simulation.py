@@ -106,3 +106,45 @@ def make_fake_toas_fromtim(timfile: str, model, add_noise: bool = False, rng=Non
         rng = rng or np.random.default_rng()
         toas = _reprepare(toas, rng.standard_normal(len(toas)) * toas.error_us * 1e-6)
     return toas
+
+
+def calculate_random_models(fitter, toas, n_models: int = 100, rng=None):
+    """Residual predictions for parameter vectors drawn from the fit
+    covariance (reference utils.calculate_random_models) — the draw
+    evaluates as ONE vmapped jitted program over the model batch.
+
+    Returns (dphase (n_models, ntoa) phase residuals, draws (n_models, p)).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from pint_tpu.fitting.wls import apply_delta
+    from pint_tpu.residuals import phase_residual_frac
+
+    res = fitter.result
+    if res is None or res.covariance is None:
+        raise RuntimeError("run fit_toas first")
+    rng = rng or np.random.default_rng()
+    free = tuple(res.free_params)
+    draws = rng.multivariate_normal(np.zeros(len(free)), res.covariance, n_models)
+
+    model = fitter.model
+    # reuse the fitter's prepared residuals/tensor when it is the same TOA
+    # set; only re-prepare for a different prediction epoch grid
+    r = fitter.resids if toas is fitter.toas else Residuals(toas, model)
+    if hasattr(r, "toa"):
+        r = r.toa
+    params = model.xprec.convert_params(model.params)
+
+    def one(delta):
+        _, rr, f = phase_residual_frac(
+            model, apply_delta(params, free, delta), r.tensor,
+            track_pn=r._track_pn, delta_pn=r._delta_pn,
+            subtract_mean=r.subtract_mean, weights=r._weights,
+        )
+        return rr
+
+    from pint_tpu.ops.compile import precision_jit
+
+    fn = precision_jit(jax.vmap(one))
+    return np.asarray(fn(jnp.asarray(draws))), draws

@@ -274,13 +274,21 @@ def get_TOAs(
     include_bipm: bool = False,
     bipm_version: str = "BIPM2019",
     model=None,
+    usepickle: bool = False,
 ) -> TOAs:
     """One-stop TOA preparation (reference get_TOAs, toa.py:104).
 
     When `model` is given, EPHEM/PLANET_SHAPIRO/CLOCK directives from the
     model override the defaults (reference toa.py:188-230 behavior): a model
     ``CLK TT(BIPMyyyy)`` line turns on the TAI->TT(BIPM) correction chain.
+
+    `usepickle` caches the fully prepared TOAs next to the tim file
+    (reference toa.py usepickle / pickle staleness checks): the cache is
+    invalidated by tim-file content and by the preparation settings.
     """
+    import hashlib
+    import os
+    import pickle
     if model is not None:
         ephem = getattr(model, "ephem", None) or ephem
         planets = planets or bool(getattr(model, "planet_shapiro", False))
@@ -290,8 +298,27 @@ def get_TOAs(
             ver = clk[3:].strip("()")
             if ver != "BIPM":  # bare TT(BIPM) keeps the default version
                 bipm_version = ver
+    # cache key is computed AFTER the model overrides so that calls
+    # differing only in model directives (planets, BIPM chain) never collide
+    cache_path = None
+    key = None
+    if usepickle:
+        with open(timfile, "rb") as f:
+            digest = hashlib.sha256(f.read()).hexdigest()[:16]
+        key = f"{digest}-{ephem}-{planets}-{include_gps}-{include_bipm}-{bipm_version}"
+        cache_path = timfile + ".pint_tpu_pickle"
+        if os.path.exists(cache_path):
+            try:
+                with open(cache_path, "rb") as f:
+                    cached_key, toas = pickle.load(f)
+                if cached_key == key:
+                    log.info(f"loaded TOAs from cache {cache_path}")
+                    return toas
+                log.info("TOA cache stale; regenerating")
+            except Exception as e:  # corrupt cache: regenerate
+                log.warning(f"ignoring unreadable TOA cache {cache_path}: {e}")
     tf = parse_tim(timfile)
-    return prepare_TOAs(
+    toas = prepare_TOAs(
         tf.toas,
         ephem=ephem,
         planets=planets,
@@ -299,6 +326,14 @@ def get_TOAs(
         include_bipm=include_bipm,
         bipm_version=bipm_version,
     )
+    if cache_path is not None:
+        try:
+            with open(cache_path, "wb") as f:
+                pickle.dump((key, toas), f)
+            log.info(f"cached prepared TOAs to {cache_path}")
+        except Exception as e:
+            log.warning(f"could not write TOA cache {cache_path}: {e}")
+    return toas
 
 
 def prepare_TOAs(

@@ -236,3 +236,125 @@ class TestRealParfiles:
         toas = _fake_toas(np.linspace(55000, 55500, 30))
         r = Residuals(toas, m)
         assert np.isfinite(r.time_resids).all()
+
+
+class TestDDGRandDDK:
+    def _base(self, binary_lines):
+        from pint_tpu.io.par import parse_parfile
+        from pint_tpu.models.builder import build_model
+
+        par = f"""
+PSR DDGRFAKE
+RAJ 09:00:00 1
+DECJ -20:00:00 1
+PMRA 5.0
+PMDEC -3.0
+PX 1.0
+F0 80.0 1
+F1 -5e-16 1
+PEPOCH 55500
+POSEPOCH 55500
+DM 40.0
+TZRMJD 55500.1
+TZRSITE gbt
+TZRFRQ 1400
+{binary_lines}
+"""
+        return build_model(parse_parfile(par, from_text=True))
+
+    def test_ddgr_matches_dd_at_derived_pk(self):
+        """DDGR with (MTOT, M2) must equal DD with the explicitly computed
+        GR post-Keplerian parameters."""
+        import numpy as np
+
+        from pint_tpu import derived_quantities as dq
+        from pint_tpu.residuals import Residuals
+        from pint_tpu.simulation import make_fake_toas_uniform
+
+        mtot, m2, pb_d, ecc, a1 = 2.8, 1.3, 0.5, 0.3, 2.0
+        ddgr = self._base(
+            f"BINARY DDGR\nPB {pb_d} 1\nA1 {a1} 1\nT0 55490 1\nECC {ecc} 1\n"
+            f"OM 45 1\nMTOT {mtot}\nM2 {m2}\n"
+        )
+        omdot = dq.omdot_gr(mtot - m2, m2, pb_d * 86400, ecc)
+        gamma = dq.gamma_gr(mtot - m2, m2, pb_d * 86400, ecc)
+        pbdot = dq.pbdot_gr(mtot - m2, m2, pb_d * 86400, ecc)
+        import jax.numpy as jnp
+
+        from pint_tpu.models.binaries.engines import ddgr_derived
+
+        der = ddgr_derived(ddgr.params)
+        # cross-check engine derivation against derived_quantities
+        assert float(der["GAMMA"]) == pytest.approx(gamma, rel=1e-10)
+        assert float(der["PBDOT"]) == pytest.approx(pbdot, rel=1e-10)
+        import numpy as _np
+
+        assert float(der["OMDOT"]) * 86400 * 365.25 * 180 / _np.pi == pytest.approx(
+            omdot / 1.0, rel=1e-10
+        )
+        sini = float(der["SINI"])
+        dd = self._base(
+            f"BINARY DD\nPB {pb_d} 1\nA1 {a1} 1\nT0 55490 1\nECC {ecc} 1\nOM 45 1\n"
+            f"M2 {m2}\nSINI {sini}\n"
+            f"GAMMA {gamma}\n"
+        )
+        # put the remaining derived PK params into the DD model directly
+        dd.params["OMDOT"] = float(der["OMDOT"])
+        dd.params["PBDOT"] = float(der["PBDOT"])
+        dd.params["DR"] = float(der["DR"])
+        dd.params["DTH"] = float(der["DTH"])
+        toas = make_fake_toas_uniform(55000, 56000, 40, dd, freq_mhz=1400.0)
+        r_dd = Residuals(toas, dd, subtract_mean=False).time_resids
+        r_gr = Residuals(toas, ddgr, subtract_mean=False).time_resids
+        np.testing.assert_allclose(r_gr, r_dd, atol=2e-9)
+
+    def test_ddk_reduces_to_dd_without_pm_px(self):
+        import numpy as np
+
+        from pint_tpu.residuals import Residuals
+        from pint_tpu.simulation import make_fake_toas_uniform
+
+        kin_deg = 60.0
+        ddk = self._base(
+            "BINARY DDK\nPB 0.8 1\nA1 3.0 1\nT0 55490 1\nECC 0.1 1\nOM 30 1\n"
+            f"M2 0.5\nKIN {kin_deg}\nKOM 120\n"
+        )
+        # zero out the astrometric drivers: corrections must vanish
+        ddk.params["PMRA"] = 0.0
+        ddk.params["PMDEC"] = 0.0
+        ddk.params["PX"] = 0.0
+        dd = self._base(
+            "BINARY DD\nPB 0.8 1\nA1 3.0 1\nT0 55490 1\nECC 0.1 1\nOM 30 1\n"
+            f"M2 0.5\nSINI {np.sin(np.radians(kin_deg))}\n"
+        )
+        dd.params["PMRA"] = 0.0
+        dd.params["PMDEC"] = 0.0
+        dd.params["PX"] = 0.0
+        toas = make_fake_toas_uniform(55300, 55700, 30, dd, freq_mhz=1400.0)
+        r_dd = Residuals(toas, dd, subtract_mean=False).time_resids
+        r_k = Residuals(toas, ddk, subtract_mean=False).time_resids
+        np.testing.assert_allclose(r_k, r_dd, atol=1e-10)
+
+    def test_ddk_pm_causes_secular_a1_drift(self):
+        import numpy as np
+
+        from pint_tpu.residuals import Residuals
+        from pint_tpu.simulation import make_fake_toas_uniform
+
+        ddk = self._base(
+            "BINARY DDK\nPB 0.8 1\nA1 3.0 1\nT0 55490 1\nECC 0.1 1\nOM 30 1\n"
+            "M2 0.5\nKIN 60\nKOM 120\n"
+        )
+        base = self._base(
+            "BINARY DDK\nPB 0.8 1\nA1 3.0 1\nT0 55490 1\nECC 0.1 1\nOM 30 1\n"
+            "M2 0.5\nKIN 60\nKOM 120\n"
+        )
+        base.params["PMRA"] = 0.0
+        base.params["PMDEC"] = 0.0
+        toas = make_fake_toas_uniform(54500, 56500, 40, base, freq_mhz=1400.0)
+        r0 = Residuals(toas, base, subtract_mean=False).time_resids
+        r1 = Residuals(toas, ddk, subtract_mean=False).time_resids
+        diff = r1 - r0
+        # PM-driven A1/OM drift: grows over the span, orbital-phase modulated
+        assert np.max(np.abs(diff)) > 1e-8
+        assert np.max(np.abs(diff[:5])) < np.max(np.abs(diff[-5:])) * 5

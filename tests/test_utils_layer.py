@@ -211,3 +211,87 @@ class TestCLIs:
         dst = tmp_path / "out.par"
         assert tcb2tdb.main([str(src), str(dst)]) == 0
         assert "TDB" in dst.read_text()
+
+
+class TestMiscAdditions:
+    def test_powell_fitter(self):
+        from pint_tpu.fitting import PowellFitter, WLSFitter
+
+        import copy
+
+        m = build_model(parse_parfile(PAR, from_text=True))
+        toas = make_fake_toas_uniform(55000, 55800, 30, m, freq_mhz=1400.0,
+                                      error_us=1.0, add_noise=True,
+                                      rng=np.random.default_rng(4))
+        m2 = copy.deepcopy(m)
+        w = WLSFitter(toas, m2)
+        rw = w.fit_toas(maxiter=3)
+        p = PowellFitter(toas, m)
+        rp = p.fit_toas()
+        assert rp.chi2 == pytest.approx(rw.chi2, rel=0.05)
+
+    def test_calculate_random_models(self):
+        from pint_tpu.fitting import WLSFitter
+        from pint_tpu.simulation import calculate_random_models
+
+        m = build_model(parse_parfile(PAR, from_text=True))
+        toas = make_fake_toas_uniform(55000, 55800, 25, m, freq_mhz=1400.0,
+                                      error_us=1.0, add_noise=True,
+                                      rng=np.random.default_rng(5))
+        ftr = WLSFitter(toas, m)
+        ftr.fit_toas(maxiter=3)
+        dph, draws = calculate_random_models(ftr, toas, n_models=20,
+                                             rng=np.random.default_rng(6))
+        assert dph.shape == (20, 25)
+        # spread grows toward the ends of the data span (F1 uncertainty)
+        assert np.std(dph[:, 0]) > 0
+
+    def test_model_compare(self):
+        import copy
+
+        m1 = build_model(parse_parfile(PAR, from_text=True))
+        m1.param_meta["F0"].uncertainty = 1e-10
+        m2 = copy.deepcopy(m1)
+        from pint_tpu.ops.dd import dd_add_fp
+
+        m2.params["F0"] = dd_add_fp(m1.params["F0"], 1e-9)  # 10 sigma
+        s = m1.compare(m2)
+        assert "F0" in s and "!" in s
+
+    def test_toa_pickle_cache(self, tmp_path):
+        import shutil
+
+        from pint_tpu.toas import get_TOAs
+
+        src = os.path.join("/root/reference/tests/datafile", "NGC6440E.tim")
+        if not os.path.exists(src):
+            pytest.skip("reference data absent")
+        tim = tmp_path / "c.tim"
+        shutil.copy(src, tim)
+        t1 = get_TOAs(str(tim), usepickle=True)
+        assert (tmp_path / "c.tim.pint_tpu_pickle").exists()
+        t2 = get_TOAs(str(tim), usepickle=True)
+        np.testing.assert_array_equal(t1.tdb.mjd_float(), t2.tdb.mjd_float())
+        # different settings invalidate the cache
+        t3 = get_TOAs(str(tim), usepickle=True, planets=True)
+        assert "jupiter" in t3.planet_pos_m
+
+    def test_plot_utils(self, tmp_path):
+        from pint_tpu.fitting import WLSFitter
+        from pint_tpu.plot_utils import phaseogram, plot_residuals_time, profile_plot
+
+        m = build_model(parse_parfile(PAR, from_text=True))
+        toas = make_fake_toas_uniform(55000, 55400, 20, m, freq_mhz=1400.0)
+        ftr = WLSFitter(toas, m)
+        ftr.fit_toas(maxiter=2)
+        f1 = tmp_path / "res.png"
+        plot_residuals_time(ftr, outfile=str(f1))
+        assert f1.exists() and f1.stat().st_size > 1000
+        rng = np.random.default_rng(0)
+        ph = rng.uniform(size=500)
+        f2 = tmp_path / "pg.png"
+        phaseogram(rng.uniform(55000, 55400, 500), ph, outfile=str(f2))
+        assert f2.exists()
+        f3 = tmp_path / "prof.png"
+        profile_plot(ph, outfile=str(f3))
+        assert f3.exists()
