@@ -323,6 +323,14 @@ class PulsarBinary(DelayComponent):
 def make_binary_component(name: str, pf) -> PulsarBinary:
     """Factory used by the model builder on a BINARY parfile line."""
     comp = PulsarBinary(name)
+    if comp.model_name == "DDGR":
+        bad = [k for k in ("SINI", "OMDOT", "GAMMA", "PBDOT", "DR", "DTH") if k in pf]
+        if bad:
+            raise ValueError(
+                f"BINARY DDGR derives {bad} from (MTOT, M2) under GR; remove "
+                "them from the parfile (use XOMDOT/XPBDOT for excesses, or "
+                "BINARY DD to set post-Keplerian parameters directly)"
+            )
     if comp.model_name == "ELL1H":
         nharms_tok = pf.get("NHARMS")
         nharms = int(float(nharms_tok)) if nharms_tok is not None else 3

@@ -10,6 +10,14 @@ from __future__ import annotations
 import numpy as np
 
 
+def _finish(fig, outfile):
+    if outfile and fig is not None:
+        fig.savefig(outfile)
+        import matplotlib.pyplot as plt
+
+        plt.close(fig)
+
+
 def _axes(ax=None):
     import matplotlib
 
@@ -42,11 +50,7 @@ def phaseogram(mjds, phases, weights=None, bins: int = 64, rotate: float = 0.0,
     ax.set_ylabel("MJD")
     if title:
         ax.set_title(title)
-    if outfile and fig is not None:
-        fig.savefig(outfile)
-        import matplotlib.pyplot as plt
-
-        plt.close(fig)
+    _finish(fig, outfile)
     return ax
 
 
@@ -67,11 +71,7 @@ def profile_plot(phases, weights=None, bins: int = 64, ax=None,
         ax.plot(xt, template(xt) * scale, "r-", alpha=0.7)
     ax.set_xlabel("Pulse phase")
     ax.set_ylabel("Counts / bin")
-    if outfile and fig is not None:
-        fig.savefig(outfile)
-        import matplotlib.pyplot as plt
-
-        plt.close(fig)
+    _finish(fig, outfile)
     return ax
 
 
@@ -89,11 +89,7 @@ def plot_residuals_time(fitter, ax=None, outfile: str | None = None):
     ax.set_xlabel("MJD")
     ax.set_ylabel("Residual (us)")
     ax.set_title(fitter.model.psr_name)
-    if outfile and fig is not None:
-        fig.savefig(outfile)
-        import matplotlib.pyplot as plt
-
-        plt.close(fig)
+    _finish(fig, outfile)
     return ax
 
 
@@ -113,9 +109,5 @@ def plot_residuals_orbit(fitter, ax=None, outfile: str | None = None):
     )
     ax.set_xlabel("Orbital phase")
     ax.set_ylabel("Residual (us)")
-    if outfile and fig is not None:
-        fig.savefig(outfile)
-        import matplotlib.pyplot as plt
-
-        plt.close(fig)
+    _finish(fig, outfile)
     return ax

@@ -266,6 +266,10 @@ def merge_TOAs(toas_list: Sequence[TOAs]) -> TOAs:
     )
 
 
+# bump when the prepared-TOA layout or pipeline changes incompatibly
+_TOA_CACHE_VERSION = 1
+
+
 def get_TOAs(
     timfile: str,
     ephem: str = "auto",
@@ -303,9 +307,27 @@ def get_TOAs(
     cache_path = None
     key = None
     if usepickle:
-        with open(timfile, "rb") as f:
-            digest = hashlib.sha256(f.read()).hexdigest()[:16]
-        key = f"{digest}-{ephem}-{planets}-{include_gps}-{include_bipm}-{bipm_version}"
+        # digest covers the master tim AND every INCLUDE'd file (resolved
+        # relative to it, like the parser does), plus a format-version tag
+        # so package upgrades never serve stale prepared arrays
+        h = hashlib.sha256()
+        stack = [timfile]
+        seen = set()
+        while stack:
+            path = stack.pop()
+            if path in seen or not os.path.exists(path):
+                continue
+            seen.add(path)
+            with open(path, "rb") as f:
+                content = f.read()
+            h.update(content)
+            for line in content.decode("utf-8", "replace").splitlines():
+                toks = line.split()
+                if len(toks) >= 2 and toks[0].upper() == "INCLUDE":
+                    stack.append(os.path.join(os.path.dirname(path), toks[1]))
+        digest = h.hexdigest()[:16]
+        key = (f"v{_TOA_CACHE_VERSION}-{digest}-{ephem}-{planets}-"
+               f"{include_gps}-{include_bipm}-{bipm_version}")
         cache_path = timfile + ".pint_tpu_pickle"
         if os.path.exists(cache_path):
             try:

@@ -295,3 +295,37 @@ class TestMiscAdditions:
         f3 = tmp_path / "prof.png"
         profile_plot(ph, outfile=str(f3))
         assert f3.exists()
+
+
+class TestPosVel:
+    def test_composition_and_labels(self):
+        from pint_tpu.utils.posvel import PosVel
+
+        a = PosVel([1, 0, 0], [0, 1, 0], origin="ssb", obj="earth")
+        b = PosVel([0, 2, 0], [0, 0, 3], origin="earth", obj="obs")
+        c = a + b
+        assert c.origin == "ssb" and c.obj == "obs"
+        np.testing.assert_array_equal(c.pos, [1, 2, 0])
+        d = -c
+        assert d.origin == "obs" and d.obj == "ssb"
+        with pytest.raises(ValueError):
+            a + PosVel([1, 1, 1], [0, 0, 0], origin="mars", obj="moon")
+
+    def test_obj_posvel(self):
+        from pint_tpu.utils.posvel import obj_posvel, obj_posvel_wrt_ssb
+
+        pv = obj_posvel_wrt_ssb("sun", np.array([0.1]))
+        assert pv.obj == "sun" and pv.origin == "ssb"
+        rel = obj_posvel("earth", "sun", np.array([0.1]))
+        # Earth-Sun distance ~ 1 AU
+        assert np.linalg.norm(rel.pos) == pytest.approx(1.496e11, rel=0.05)
+
+    def test_compare_parfiles_cli(self, tmp_path, capsys):
+        from pint_tpu.scripts import compare_parfiles
+
+        p1 = tmp_path / "a.par"
+        p1.write_text(PAR)
+        p2 = tmp_path / "b.par"
+        p2.write_text(PAR.replace("F0 100.0 1", "F0 100.0000001 1"))
+        assert compare_parfiles.main([str(p1), str(p2)]) == 0
+        assert "F0" in capsys.readouterr().out
