@@ -326,8 +326,15 @@ def get_TOAs(
                 if len(toks) >= 2 and toks[0].upper() == "INCLUDE":
                     stack.append(os.path.join(os.path.dirname(path), toks[1]))
         digest = h.hexdigest()[:16]
-        key = (f"v{_TOA_CACHE_VERSION}-{digest}-{ephem}-{planets}-"
-               f"{include_gps}-{include_bipm}-{bipm_version}")
+        # resolved ephemeris identity: the same 'auto' label can mean the
+        # analytic ephemeris, an SPK kernel (PINT_TPU_EPHEM), or the
+        # N-body-refined path (PINT_TPU_NBODY) — all change the arrays
+        spk = os.environ.get("PINT_TPU_EPHEM") or ""
+        if spk and os.path.exists(spk):
+            spk = f"{spk}@{os.path.getmtime(spk):.0f}"
+        nbody = os.environ.get("PINT_TPU_NBODY", "1")
+        key = (f"v{_TOA_CACHE_VERSION}-{digest}-{ephem}-{spk}-nb{nbody}-"
+               f"{planets}-{include_gps}-{include_bipm}-{bipm_version}")
         cache_path = timfile + ".pint_tpu_pickle"
         if os.path.exists(cache_path):
             try:

@@ -63,6 +63,10 @@ def obj_posvel_wrt_ssb(body: str, tdb_jcent, ephem=None) -> PosVel:
 
 def obj_posvel(obj1: str, obj2: str, tdb_jcent, ephem=None) -> PosVel:
     """PosVel of obj2 relative to obj1 (reference objPosVel)."""
+    if ephem is None:
+        from pint_tpu.astro.ephemeris import get_ephemeris
+
+        ephem = get_ephemeris()  # resolve once: the SPK path re-reads files
     return obj_posvel_wrt_ssb(obj2, tdb_jcent, ephem) - obj_posvel_wrt_ssb(
         obj1, tdb_jcent, ephem
     )

@@ -104,3 +104,15 @@ class TestPhotonPhasing:
         # template integrates to ~1
         x = np.linspace(0, 1, 10001)
         assert np.trapezoid(tpl(x), x) == pytest.approx(1.0, abs=0.01)
+
+
+class TestFermiphaseCLI:
+    def test_fermiphase(self, capsys, tmp_path):
+        from pint_tpu.scripts import fermiphase
+
+        plot = tmp_path / "pg.png"
+        assert fermiphase.main([FERMI_FT1, FERMI_PAR, "PSRJ0030+0451",
+                                "--plotfile", str(plot)]) == 0
+        out = capsys.readouterr().out
+        assert "Htest" in out
+        assert plot.exists()
