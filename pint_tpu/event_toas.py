@@ -6,12 +6,13 @@ load_NuSTAR_TOAs / load_event_TOAs:244-522) and pint/fermi_toas.py
 seconds converted with the header's MJDREF(I/F)+TIMEZERO; the resulting
 TOAs carry zero error and per-photon flags (energy, weights).
 
-Supported geometries (this environment has no orbit-file reconstruction):
+Supported geometries:
 - barycentered events (TIMESYS TDB): observatory 'barycenter';
-- geocentered events (TIMESYS TT): observatory 'geocenter_tt' — the TT
-  timescale bypasses the UTC clock chain (astro/observatories.py).
-Spacecraft positions from FT2/orbit files raise NotImplementedError like
-any absent reference capability.
+- geocentered events (TIMESYS TT, TIMEREF GEOCENTRIC): 'geocenter_tt' —
+  the TT timescale bypasses the UTC clock chain (astro/observatories.py);
+- spacecraft-frame events (TIMEREF LOCAL) with an `orbitfile` (Fermi FT2 /
+  orbit table): a satellite observatory reconstructed from the orbit data
+  (astro/satellite_obs.py).
 """
 
 from __future__ import annotations
@@ -66,11 +67,13 @@ def load_event_TOAs(
     maxmjd: float = np.inf,
     ephem: str = "auto",
     planets: bool = False,
+    orbitfile: str | None = None,
 ):
     """Photon TOAs from a FITS event file (reference load_event_TOAs:244).
 
-    Barycentered (TIMESYS TDB) and geocentered (TT) files are supported;
-    spacecraft frames need orbit reconstruction, which is not available.
+    Supported geometries: barycentered (TIMESYS TDB), geocentered (TT),
+    and — with `orbitfile` (Fermi FT2 / orbit table) — the spacecraft
+    frame via astro/satellite_obs.py orbit reconstruction.
     """
     from pint_tpu.astro import time as ptime
     from pint_tpu.toas import prepare_arrays
@@ -80,13 +83,26 @@ def load_event_TOAs(
     timeref = str(h.get("TIMEREF", "LOCAL")).strip().upper()
     if timesys == "TDB":
         obs = "barycenter"
-    elif timeref in ("GEOCENTRIC", "GEOCENTER") or timesys == "TT":
+    elif timeref in ("GEOCENTRIC", "GEOCENTER"):
+        # times are ALREADY geocentered (gtbary tcorrect=GEO): applying a
+        # spacecraft position on top would double-correct by up to ~23 ms
         obs = "geocenter_tt"
-        if timeref == "LOCAL":
+        if orbitfile is not None:
             log.warning(
-                f"{eventfile}: TIMEREF LOCAL (spacecraft frame) — treating "
-                "times as geocentric; orbit-file reconstruction is not available"
+                f"{eventfile}: TIMEREF GEOCENTRIC — ignoring orbitfile "
+                "(times are already geocentered)"
             )
+    elif orbitfile is not None:
+        from pint_tpu.astro.satellite_obs import get_satellite_observatory
+
+        obs = f"{mission.lower()}_sc"
+        get_satellite_observatory(obs, orbitfile)
+    elif timesys == "TT":
+        obs = "geocenter_tt"
+        log.warning(
+            f"{eventfile}: TIMEREF LOCAL (spacecraft frame) with no "
+            "orbitfile — treating times as geocentric"
+        )
     else:
         raise NotImplementedError(f"TIMESYS {timesys} / TIMEREF {timeref}")
 
@@ -157,6 +173,7 @@ def load_Fermi_TOAs(
     maxmjd: float = np.inf,
     ephem: str = "auto",
     planets: bool = False,
+    ft2name: str | None = None,
 ):
     """Fermi-LAT photon TOAs with weights (reference fermi_toas.py:145).
 
@@ -171,11 +188,21 @@ def load_Fermi_TOAs(
     toas = load_event_TOAs(
         ft1name, "fermi", weight_column=weightcolumn,
         minmjd=minmjd, maxmjd=maxmjd, ephem=ephem, planets=planets,
+        orbitfile=ft2name,
     )
     if weightcolumn and minweight > 0:
         w = get_event_weights(toas)
         toas = toas.select(w >= minweight)
     return toas
+
+
+def compute_event_phases(toas, model) -> np.ndarray:
+    """Absolute model phases mod 1 for photon TOAs (shared by the
+    photonphase / fermiphase CLIs)."""
+    from pint_tpu.residuals import Residuals
+
+    r = Residuals(toas, model, subtract_mean=False, track_mode="nearest")
+    return np.mod(r.phase_resids, 1.0)
 
 
 def get_event_weights(toas) -> np.ndarray | None:

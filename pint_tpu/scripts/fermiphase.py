@@ -22,18 +22,20 @@ def main(argv=None):
     ap.add_argument("--outfile", help="write phases as text")
     args = ap.parse_args(argv)
 
-    from pint_tpu.event_toas import get_event_weights, load_Fermi_TOAs
+    from pint_tpu.event_toas import (
+        compute_event_phases,
+        get_event_weights,
+        load_Fermi_TOAs,
+    )
     from pint_tpu.eventstats import h_sig, hm, hmw, sig2sigma
     from pint_tpu.models.builder import get_model
-    from pint_tpu.residuals import Residuals
 
     model = get_model(args.parfile)
     wc = None if args.weightcol.upper() == "NONE" else args.weightcol
     toas = load_Fermi_TOAs(args.ft1, weightcolumn=wc, minweight=args.minweight,
                            planets=bool(model.planet_shapiro))
     print(f"Read {len(toas)} photons")
-    r = Residuals(toas, model, subtract_mean=False, track_mode="nearest")
-    phases = np.mod(r.phase_resids, 1.0)
+    phases = compute_event_phases(toas, model)
     w = get_event_weights(toas)
     h = hm(phases) if w is None else hmw(phases, w)
     print(f"Htest : {h:.2f} ({sig2sigma(h_sig(h)):.2f} sigma)")

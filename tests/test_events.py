@@ -116,3 +116,46 @@ class TestFermiphaseCLI:
         out = capsys.readouterr().out
         assert "Htest" in out
         assert plot.exists()
+
+
+class TestSatelliteObs:
+    FT2 = os.path.join(REFERENCE_DATA, "lat_spacecraft_weekly_w323_p202_v001.fits")
+    W323 = os.path.join(REFERENCE_DATA, "J0030+0451_w323_ft1weights.fits")
+
+    def test_orbit_table(self):
+        from pint_tpu.astro.satellite_obs import get_satellite_observatory
+
+        obs = get_satellite_observatory("fermi_test", self.FT2)
+        assert len(obs.met_s) == 17305
+        # LEO sanity at a table midpoint: r ~ 6900 km, v ~ 7.5 km/s
+        tt_jcent = ((obs.mjdref + obs.met_s[5000] / 86400.0) - 51544.5) / 36525.0
+        p, v = obs.site_posvel_gcrs(np.array([0.0]), np.array([tt_jcent]))
+        assert np.linalg.norm(p) == pytest.approx(6.9e6, rel=0.02)
+        assert np.linalg.norm(v) == pytest.approx(7.55e3, rel=0.05)
+
+    def test_spacecraft_frame_restores_coherence(self):
+        """With FT2 orbit reconstruction the w323 photons fold coherently;
+        the geocentric approximation (+-23 ms ~ +-4.7 periods of J0030)
+        visibly decoheres them — measured H 6.1 vs 2.3, template lnlike
+        10.7 vs 0.8."""
+        from pint_tpu.event_toas import get_event_weights, load_Fermi_TOAs
+        from pint_tpu.models.builder import get_model
+        from pint_tpu.residuals import Residuals
+        from pint_tpu.templates import LCTemplate, lnlikelihood
+
+        m = get_model(FERMI_PAR)
+        tpl = LCTemplate.read(TEMPLATE)
+        lls = {}
+        for tag, ft2 in (("geo", None), ("sc", self.FT2)):
+            toas = load_Fermi_TOAs(
+                self.W323, weightcolumn="PSRJ0030+0451", ft2name=ft2,
+                planets=bool(m.planet_shapiro),
+            )
+            r = Residuals(toas, m, subtract_mean=False, track_mode="nearest")
+            ph = np.mod(r.phase_resids, 1.0)
+            w = get_event_weights(toas)
+            lls[tag] = max(
+                lnlikelihood(tpl, ph, w, d) for d in np.linspace(0, 1, 128)
+            )
+        assert lls["sc"] > 8.0
+        assert lls["sc"] > lls["geo"] + 5.0
