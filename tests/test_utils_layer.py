@@ -329,3 +329,26 @@ class TestPosVel:
         p2.write_text(PAR.replace("F0 100.0 1", "F0 100.0000001 1"))
         assert compare_parfiles.main([str(p1), str(p2)]) == 0
         assert "F0" in capsys.readouterr().out
+
+    def test_toa_cache_include_invalidation(self, tmp_path):
+        """Editing an INCLUDE'd tim file must invalidate the cache."""
+        from pint_tpu.toas import get_TOAs
+
+        inc = tmp_path / "part.tim"
+        inc.write_text(
+            "FORMAT 1\n"
+            "a 1400.0 55000.1234567890123 1.0 gbt\n"
+            "a 1400.0 55010.1234567890123 1.0 gbt\n"
+        )
+        master = tmp_path / "master.tim"
+        master.write_text("FORMAT 1\nINCLUDE part.tim\n")
+        t1 = get_TOAs(str(master), usepickle=True)
+        assert len(t1) == 2
+        inc.write_text(
+            "FORMAT 1\n"
+            "a 1400.0 55000.1234567890123 1.0 gbt\n"
+            "a 1400.0 55010.1234567890123 1.0 gbt\n"
+            "a 1400.0 55020.1234567890123 1.0 gbt\n"
+        )
+        t2 = get_TOAs(str(master), usepickle=True)
+        assert len(t2) == 3  # stale cache would have returned 2
