@@ -30,6 +30,8 @@ environment's substitute, validated against pulsar timing golden fits.
 
 from __future__ import annotations
 
+import os
+
 import numpy as np
 
 from pint_tpu import AU_M, EARTH_MOON_MASS_RATIO, GM_BODY, GM_SUN
@@ -102,6 +104,10 @@ class NBodyEphemeris:
     dense integration on `grid_days` spacing.
     """
 
+    #: bump when the integration/refinement algorithm changes — invalidates
+    #: every cached solution on disk
+    _CACHE_VERSION = 1
+
     def __init__(self, base, t0_jcent: float, span_years: float = 16.0,
                  grid_days: float = 0.5, refine_iters: int = 3):
         self.base = base
@@ -109,7 +115,76 @@ class NBodyEphemeris:
         self.half_span_s = span_years * 0.5 * 365.25 * DAY_S
         self.grid_days = grid_days
         self._fit_idx = [_BODIES.index(b) for b in _FIT_BODIES]
-        self._build(refine_iters)
+        if not self._load_cached(refine_iters):
+            self._build(refine_iters)
+            self._save_cache(refine_iters)
+
+    # --- disk cache ------------------------------------------------------------
+
+    def _cache_path(self, refine_iters: int) -> str | None:
+        """Cache file keyed by everything the solution depends on: epoch,
+        span, serving grid, refinement depth, body/GM table and algorithm
+        version. PINT_TPU_NBODY_CACHE=0 disables; PINT_TPU_CACHE_DIR moves it."""
+        if os.environ.get("PINT_TPU_NBODY_CACHE", "1") == "0":
+            return None
+        import hashlib
+
+        root = os.environ.get(
+            "PINT_TPU_CACHE_DIR", os.path.expanduser("~/.cache/pint_tpu")
+        )
+        # the cached solution is anchored to the base theory's output, so
+        # fingerprint that CONTENT (not just the class name): probe
+        # positions at three epochs change if any series/element table does
+        probe = np.concatenate([
+            np.asarray(self.base.pos_ssb(
+                b, np.array([self.t0 - 0.05, self.t0, self.t0 + 0.05])
+            )).ravel()
+            for b in ("earth", "moon", "jupiter")
+        ]).round(3)
+        key = hashlib.sha256(
+            repr((
+                self._CACHE_VERSION, round(self.t0, 10), round(self.half_span_s, 3),
+                self.grid_days, refine_iters, _BODIES, _GMS.tobytes(),
+                type(self.base).__name__, probe.tobytes(),
+            )).encode()
+        ).hexdigest()[:24]
+        return os.path.join(root, "nbody", f"{key}.npz")
+
+    def _load_cached(self, refine_iters: int) -> bool:
+        path = self._cache_path(refine_iters)
+        if path is None or not os.path.exists(path):
+            return False
+        try:
+            with np.load(path) as z:
+                self.grid_s = z["grid_s"]
+                self.pos = z["pos"]
+                self.vel = z["vel"]
+                self._corr_e = z["corr_e"]
+                self._corr_m = z["corr_m"]
+                self._periods_e = tuple(z["periods_e"])
+                self._periods_m = tuple(z["periods_m"])
+        except Exception as e:  # corrupt/stale file: rebuild
+            log.warning(f"nbody cache read failed ({e}); rebuilding")
+            return False
+        log.info(f"nbody ephemeris loaded from cache: {path}")
+        return True
+
+    def _save_cache(self, refine_iters: int) -> None:
+        path = self._cache_path(refine_iters)
+        if path is None:
+            return
+        try:
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            tmp = path + f".tmp{os.getpid()}.npz"
+            np.savez(
+                tmp, grid_s=self.grid_s, pos=self.pos, vel=self.vel,
+                corr_e=self._corr_e, corr_m=self._corr_m,
+                periods_e=np.array(self._periods_e),
+                periods_m=np.array(self._periods_m),
+            )
+            os.replace(tmp, path)
+        except OSError as e:  # read-only cache dir etc. — not fatal
+            log.warning(f"nbody cache write failed: {e}")
 
     # --- integration -----------------------------------------------------------
 

@@ -86,17 +86,24 @@ class BayesianTiming:
             lognorm = -jnp.sum(jnp.log(sigma)) - 0.5 * n_toa * jnp.log(2 * jnp.pi)
             if not correlated:
                 return -0.5 * jnp.sum((rt / sigma) ** 2) + lognorm
-            # Woodbury-marginalized likelihood (log|C| up to a delta-
-            # independent constant: the basis is parameter-independent)
+            # Woodbury-marginalized likelihood over the structured noise
+            # basis (fitting/woodbury.py); logdet_C carries the
+            # phi-dependent pieces so noise-parameter sampling stays correct
+            from pint_tpu.fitting.woodbury import (
+                logdet_C, s_factor, woodbury_chi2,
+            )
+
             cinv = 1.0 / sigma**2
-            F, phi = model.noise_basis_and_weights(pp, tensor)
-            d = F.T @ (cinv * rt)
-            S = jnp.diag(1.0 / phi) + F.T @ (cinv[:, None] * F)
-            cf = jax.scipy.linalg.cho_factor(S)
-            Sd = jax.scipy.linalg.cho_solve(cf, d)
-            chi2 = jnp.sum(cinv * rt * rt) - d @ Sd
-            logdet = 2.0 * jnp.sum(jnp.log(jnp.diag(cf[0]))) + jnp.sum(jnp.log(phi))
-            return -0.5 * (chi2 + logdet) + lognorm
+            basis = model.noise_basis_and_weights(pp, tensor)
+            if basis is None:  # e.g. ECORR whose masks bind no epochs
+                return -0.5 * jnp.sum((rt / sigma) ** 2) + lognorm
+            sf = s_factor(basis, cinv)
+            chi2, _ = woodbury_chi2(basis, cinv, rt, sf=sf)
+            # logdet_C includes the white -sum(log w) term, replacing the
+            # white branch's -sum(log sigma) half of lognorm
+            return -0.5 * (
+                chi2 + logdet_C(basis, cinv, sf) + n_toa * jnp.log(2 * jnp.pi)
+            )
 
         def lnpost(delta):
             lp = lnprior(delta)

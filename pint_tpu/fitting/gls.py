@@ -2,12 +2,17 @@
 
 Reference: pint/fitter.py GLSFitter:2107-2254 (basis/Woodbury path,
 full_cov=False) and DownhillGLSFitter:1476. The covariance is
-C = diag(sigma^2) + F phi F^T with F the concatenated noise basis
+C = diag(sigma^2) + F phi F^T with F the correlated-noise basis
 (ECORR epoch blocks, power-law Fourier modes; models/noise.py). The solve
-augments the design matrix with F and regularizes the noise block by
-1/phi — mathematically identical to the reference's mtcm/phiinv algebra —
-so the whole step is dense MXU matmuls + one Cholesky of a
-(p + k) x (p + k) matrix; the N x N covariance is never materialized.
+uses the MARGINALIZED normal equations M^T C^-1 M dx = -M^T C^-1 r with
+C^-1 applied through the structured Woodbury algebra of
+fitting/woodbury.py: the ECORR part of F stays an implicit epoch-index
+vector (gathers + segment-sums, O(N)), the Fourier part is dense MXU
+matmuls, and the inner solve is one small Cholesky of the dense-mode
+Schur complement. Mathematically identical to the reference's
+noise-augmented mtcm/phiinv algebra (Schur complement identity); neither
+the N x N covariance nor the (N, k_epoch) ECORR membership matrix is ever
+materialized.
 
 chi^2 at fixed parameters uses the Woodbury identity:
     r^T C^-1 r = r^T N^-1 r - d^T S^-1 d,
@@ -24,6 +29,12 @@ from pint_tpu.fitting.wls import (
     FitResult,
     WLSFitter,
     apply_delta,
+)
+from pint_tpu.fitting.woodbury import (
+    basis_matvec,
+    cinv_apply,
+    s_factor,
+    woodbury_chi2,
 )
 from pint_tpu.models.timing_model import TimingModel
 from pint_tpu.utils.logging import get_logger
@@ -75,34 +86,28 @@ def get_gls_step_fn(model: TimingModel, free, subtract_mean: bool):
         M = jax.vmap(lin)(jnp.eye(p)).T  # (N, p), one primal evaluation
         cinv = 1.0 / sigma**2
 
-        pair = model.noise_basis_and_weights(params, tensor)
-        if pair is None:
-            Maug = M
-            phiinv = jnp.zeros(p)
-        else:
-            F, phi = pair
-            Maug = jnp.concatenate([M, F], axis=1)
-            phiinv = jnp.concatenate([jnp.zeros(p), 1.0 / phi])
-
-        norm = jnp.sqrt(jnp.sum(Maug**2, axis=0))
+        basis = model.noise_basis_and_weights(params, tensor)
+        norm = jnp.sqrt(jnp.sum(M**2, axis=0))
         norm = jnp.where(norm == 0, 1.0, norm)
-        Mn = Maug / norm
-        phiinv_n = phiinv / norm**2
-        mtcm = Mn.T @ (cinv[:, None] * Mn) + jnp.diag(phiinv_n + _RIDGE)
-        mtcy = Mn.T @ (cinv * (-r0))
-        # GLS chi^2 at the CURRENT params (Woodbury; for the downhill
-        # accept/reject decision and reporting)
-        if pair is None:
-            chi2_0 = jnp.sum(cinv * r0 * r0)
-            ahat = jnp.zeros(0)
-        else:
-            d = F.T @ (cinv * r0)
-            S = jnp.diag(1.0 / phi) + F.T @ (cinv[:, None] * F)
-            cfS = jax.scipy.linalg.cho_factor(S)
-            Sd = jax.scipy.linalg.cho_solve(cfS, d)
-            chi2_0 = jnp.sum(cinv * r0 * r0) - d @ Sd
-            ahat = Sd  # ML noise-coefficient realization at current params
-        # the (p+k) solve itself happens host-side (scipy Cholesky on a
+        Mn = M / norm
+        # Marginalized normal equations: mtcm = Mn^T C^-1 Mn with C^-1
+        # applied via structured Woodbury (block-Schur over the diagonal
+        # ECORR block — woodbury.py). Identical to the timing block of the
+        # reference's noise-augmented solve (fitter.py:2177-2254) by the
+        # Schur complement identity, but the ECORR membership matrix never
+        # materializes.
+        sf = s_factor(basis, cinv) if basis is not None else None
+        CinvM = cinv_apply(basis, cinv, Mn, sf)
+        mtcm = Mn.T @ CinvM + _RIDGE * jnp.eye(p)
+        mtcy = CinvM.T @ (-r0)
+        # GLS chi^2 at the CURRENT params (for the downhill accept/reject
+        # decision and reporting) + ML noise-coefficient realization
+        chi2_0, (ze, zd) = woodbury_chi2(basis, cinv, r0, sf=sf)
+        ahat = jnp.concatenate([
+            ze if ze is not None else jnp.zeros(0),
+            zd if zd is not None else jnp.zeros(0),
+        ])
+        # the p x p solve itself happens host-side (scipy Cholesky on a
         # small matrix), so Levenberg-Marquardt re-solves at any damping
         # need no recompute of the design matrix
         return r0, M, mtcm, mtcy, norm, chi2_0, ahat
@@ -125,14 +130,9 @@ def get_gls_chi2_fn(model: TimingModel, subtract_mean: bool):
     def chi2fn(params, tensor, track_pn, delta_pn, weights, sigma):
         r = time_resids(params, tensor, track_pn, delta_pn, weights)
         cinv = 1.0 / sigma**2
-        pair = model.noise_basis_and_weights(params, tensor)
-        if pair is None:
-            return jnp.sum(cinv * r * r)
-        F, phi = pair
-        d = F.T @ (cinv * r)
-        S = jnp.diag(1.0 / phi) + F.T @ (cinv[:, None] * F)
-        Sd = jax.scipy.linalg.cho_solve(jax.scipy.linalg.cho_factor(S), d)
-        return jnp.sum(cinv * r * r) - d @ Sd
+        basis = model.noise_basis_and_weights(params, tensor)
+        chi2, _ = woodbury_chi2(basis, cinv, r)
+        return chi2
 
     from pint_tpu.ops.compile import precision_jit
 
@@ -224,13 +224,15 @@ class GLSFitter(WLSFitter):
     def noise_realization(self) -> np.ndarray | None:
         """Maximum-likelihood correlated-noise waveform F @ ahat (seconds)
         at the fitted params (reference Residuals.noise_resids)."""
-        pair_fn = getattr(self.model, "noise_basis_and_weights")
         params = self.model.xprec.convert_params(self.model.params)
-        pair = pair_fn(params, self.tensor)
-        if pair is None or self.noise_ampls.size == 0:
+        basis = self.model.noise_basis_and_weights(params, self.tensor)
+        if basis is None or self.noise_ampls.size == 0:
             return None
-        F, _ = pair
-        return np.asarray(F @ jnp.asarray(self.noise_ampls))
+        a = jnp.asarray(self.noise_ampls)
+        ke = basis.ke
+        return np.asarray(
+            basis_matvec(basis, a[:ke] if ke else None, a[ke:] if basis.kd else None)
+        )
 
 
 class DownhillGLSFitter(GLSFitter):

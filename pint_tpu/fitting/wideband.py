@@ -21,6 +21,12 @@ import numpy as np
 
 from pint_tpu.fitting.gls import gls_solve
 from pint_tpu.fitting.wls import FitResult, WLSFitter, apply_delta
+from pint_tpu.fitting.woodbury import (
+    NoiseBasis,
+    cinv_apply,
+    s_factor,
+    woodbury_chi2,
+)
 from pint_tpu.residuals import WidebandTOAResiduals, phase_residual_frac
 from pint_tpu.utils.logging import get_logger
 
@@ -45,25 +51,35 @@ def _weighted_resids(model, free, subtract_mean, params, tensor, track_pn,
     return jnp.concatenate([rt, rdm])
 
 
-def _noise_Fw(model, params, tensor, sw_t, n_dm):
-    """Weighted noise basis padded with zero DM rows, or None."""
-    pair = model.noise_basis_and_weights(params, tensor)
-    if pair is None:
+def _noise_basis_aug(model, params, tensor, sw_t, n_dm):
+    """Model noise basis lifted to the combined pre-whitened [TOA; DM]
+    system: rows scaled by 1/sigma_t on the TOA block, zero on the DM block
+    (DM measurements carry no TOA noise), via NoiseBasis.row_scale."""
+    basis = model.noise_basis_and_weights(params, tensor)
+    if basis is None:
         return None
-    F, phi = pair
-    Fw = jnp.concatenate([F * sw_t[:, None], jnp.zeros((n_dm, F.shape[1]))])
-    return Fw, phi
+    scale = jnp.concatenate([sw_t, jnp.zeros(n_dm)])
+    dense = None
+    if basis.dense is not None:
+        dense = jnp.concatenate(
+            [basis.dense, jnp.zeros((n_dm, basis.dense.shape[1]))]
+        )
+    eidx = None
+    if basis.ephi is not None:
+        eidx = jnp.concatenate(
+            [basis.eidx, jnp.full((n_dm,), -1, basis.eidx.dtype)]
+        )
+    return NoiseBasis(
+        dense=dense, dense_phi=basis.dense_phi, eidx=eidx, ephi=basis.ephi,
+        row_scale=scale,
+    )
 
 
-def _woodbury_chi2(r0, Fw_phi):
-    """r0^T C^-1 r0 for C = I + Fw phi Fw^T; also the ML noise coeffs."""
-    if Fw_phi is None:
-        return jnp.sum(r0 * r0), jnp.zeros(0)
-    Fw, phi = Fw_phi
-    d = Fw.T @ r0
-    S = jnp.diag(1.0 / phi) + Fw.T @ Fw
-    Sd = jax.scipy.linalg.cho_solve(jax.scipy.linalg.cho_factor(S), d)
-    return jnp.sum(r0 * r0) - d @ Sd, Sd
+def _cat_ahat(ze, zd):
+    return jnp.concatenate([
+        ze if ze is not None else jnp.zeros(0),
+        zd if zd is not None else jnp.zeros(0),
+    ])
 
 
 def get_wb_step_fn(model, free, subtract_mean: bool):
@@ -91,22 +107,19 @@ def get_wb_step_fn(model, free, subtract_mean: bool):
         A = jax.vmap(lin)(jnp.eye(p)).T  # (N_t + N_dm, p), already weighted
         b = -r0
 
-        Fw_phi = _noise_Fw(model, params, tensor, sw_t, sw_dm.shape[0])
-        if Fw_phi is None:
-            Aaug = A
-            phiinv = jnp.zeros(p)
-        else:
-            Fw, phi = Fw_phi
-            Aaug = jnp.concatenate([A, Fw], axis=1)
-            phiinv = jnp.concatenate([jnp.zeros(p), 1.0 / phi])
-
-        norm = jnp.sqrt(jnp.sum(Aaug**2, axis=0))
+        basis = _noise_basis_aug(model, params, tensor, sw_t, sw_dm.shape[0])
+        norm = jnp.sqrt(jnp.sum(A**2, axis=0))
         norm = jnp.where(norm == 0, 1.0, norm)
-        An = Aaug / norm
-        mtcm = An.T @ An + jnp.diag(phiinv / norm**2 + _RIDGE)
-        mtcy = An.T @ b
-        chi2_0, ahat = _woodbury_chi2(r0, Fw_phi)
-        return r0, mtcm, mtcy, norm, chi2_0, ahat
+        An = A / norm
+        # marginalized normal equations on the pre-whitened combined system
+        # (C = I + F_eff phi F_eff^T), structured Woodbury as fitting/gls.py
+        ones = jnp.ones_like(r0)
+        sf = s_factor(basis, ones) if basis is not None else None
+        CinvA = cinv_apply(basis, ones, An, sf)
+        mtcm = An.T @ CinvA + _RIDGE * jnp.eye(p)
+        mtcy = CinvA.T @ b
+        chi2_0, (ze, zd) = woodbury_chi2(basis, ones, r0, sf=sf)
+        return r0, mtcm, mtcy, norm, chi2_0, _cat_ahat(ze, zd)
 
     from pint_tpu.ops.compile import precision_jit
 
@@ -127,8 +140,9 @@ def get_wb_chi2_fn(model, subtract_mean: bool):
             model, (), subtract_mean, params, tensor, track_pn,
             delta_pn, weights, sw_t, sw_dm, dm_data, jnp.zeros(0),
         )
-        Fw_phi = _noise_Fw(model, params, tensor, sw_t, sw_dm.shape[0])
-        return _woodbury_chi2(r0, Fw_phi)[0]
+        basis = _noise_basis_aug(model, params, tensor, sw_t, sw_dm.shape[0])
+        chi2, _ = woodbury_chi2(basis, jnp.ones_like(r0), r0)
+        return chi2
 
     from pint_tpu.ops.compile import precision_jit
 

@@ -31,6 +31,7 @@ import jax
 import jax.numpy as jnp
 
 from pint_tpu.fitting.wls import apply_delta
+from pint_tpu.fitting.woodbury import cinv_apply, s_factor, woodbury_chi2
 from pint_tpu.residuals import phase_residual_frac
 from pint_tpu.utils.logging import get_logger
 
@@ -51,7 +52,7 @@ def _point_kernel(model, grid_names, free, subtract_mean, maxiter, toa_axis=None
       vals : (len(grid_names),) f64 values (model-internal units)
       params : xprec-converted parameter pytree (replicated)
       data : dict with 'tensor' (model tensor, rows possibly a TOA shard),
-             'w' (1/err^2, zero on padding rows), 'sqrt_w', 'track_pn',
+             'w' (1/err^2, zero on padding rows), 'track_pn',
              'delta_pn' (either may be None).
 
     With `toa_axis` set, every reduction over the TOA axis is completed with
@@ -98,10 +99,9 @@ def _point_kernel(model, grid_names, free, subtract_mean, maxiter, toa_axis=None
     def gn_step(params, data):
         """One GLS/WLS Gauss-Newton refit: hybrid design matrix (autodiff
         over the nonlinear params + analytic columns for the linear
-        families, fitting/design.py); with correlated noise the matrix is
-        augmented with the noise basis and the noise block regularized by
-        1/phi (same algebra as fitting/gls.py)."""
-        sw = data["sqrt_w"]
+        families, fitting/design.py); with correlated noise the marginalized
+        normal equations apply C^-1 through the structured Woodbury algebra
+        (same as fitting/gls.py)."""
 
         def rfun(delta):
             return time_resids_f(apply_delta(params, nonlin, delta), data)
@@ -122,22 +122,24 @@ def _point_kernel(model, grid_names, free, subtract_mean, maxiter, toa_axis=None
             for i, n in enumerate(lin_names):
                 cols[n] = M_l[:, i]
         M = jnp.stack([cols[n] for n in free], axis=1)  # (N_local, p)
-        A = M * sw[:, None]
-        b = -r0 * sw
-        if correlated:
-            F, phi = model.noise_basis_and_weights(params, data["tensor"])
-            A = jnp.concatenate([A, F * sw[:, None]], axis=1)
-            phiinv = jnp.concatenate([jnp.zeros(p), 1.0 / phi])
-        else:
-            phiinv = jnp.zeros(p)
+        w = data["w"]
         # global column equilibration (reference fitter.py:2186)
-        col2 = _reduce(A * A)
+        col2 = _reduce(w[:, None] * M * M)
         norm = jnp.sqrt(jnp.where(col2 == 0, 1.0, col2))
-        An = A / norm
-        G = _reduce_mat(An.T @ An) + jnp.diag(phiinv / norm**2 + _RIDGE)
-        c = _reduce_mat(An.T @ b)
+        Mn = M / norm
+        # marginalized normal equations, C^-1 via structured Woodbury
+        # (fitting/woodbury.py); segment-sums/contractions are local to the
+        # TOA shard and completed with psum
+        if correlated:
+            basis = model.noise_basis_and_weights(params, data["tensor"])
+            sf = s_factor(basis, w, reduce=_reduce_mat) if basis is not None else None
+            CinvM = cinv_apply(basis, w, Mn, sf, reduce=_reduce_mat)
+        else:
+            CinvM = w[:, None] * Mn
+        G = _reduce_mat(Mn.T @ CinvM) + _RIDGE * jnp.eye(p)
+        c = _reduce_mat(CinvM.T @ (-r0))
         dx = jax.scipy.linalg.cho_solve(jax.scipy.linalg.cho_factor(G), c) / norm
-        return apply_delta(params, free, dx[:p])
+        return apply_delta(params, free, dx)
 
     def kernel(vals, params, data):
         params = dict(params)
@@ -147,15 +149,12 @@ def _point_kernel(model, grid_names, free, subtract_mean, maxiter, toa_axis=None
             params = gn_step(params, data)
         r = time_resids(params, data)
         w = data["w"]
-        chi2_w = _reduce(w * r * r)
         if not correlated:
-            return chi2_w
-        # Woodbury GLS chi^2 (fitting/gls.py docstring)
-        F, phi = model.noise_basis_and_weights(params, data["tensor"])
-        d = _reduce_mat(F.T @ (w * r))
-        S = jnp.diag(1.0 / phi) + _reduce_mat(F.T @ (w[:, None] * F))
-        Sd = jax.scipy.linalg.cho_solve(jax.scipy.linalg.cho_factor(S), d)
-        return chi2_w - d @ Sd
+            return _reduce(w * r * r)
+        # Woodbury GLS chi^2 (fitting/gls.py docstring), structured basis
+        basis = model.noise_basis_and_weights(params, data["tensor"])
+        chi2, _ = woodbury_chi2(basis, w, r, reduce=_reduce_mat)
+        return chi2
 
     return kernel
 
@@ -166,7 +165,6 @@ def _host_data(resids, tensor):
     return {
         "tensor": tensor,
         "w": jnp.asarray(w),
-        "sqrt_w": jnp.asarray(np.sqrt(w)),
         "track_pn": resids._track_pn,
         "delta_pn": resids._delta_pn,
     }
@@ -176,7 +174,7 @@ def _shard_data_host(model, data, n_shards):
     """Re-lay the TOA axis of `data` into `n_shards` equal blocks.
 
     Each block is [chunk data rows ..., (pad rows), TZR row?]; pad rows get
-    w = sqrt_w = 0 so they drop out of every reduction. Returns
+    w = 0 so they drop out of every reduction. Returns
     (data', specs') where specs' marks each leaf sharded (True) or
     replicated (False).
     """
@@ -224,14 +222,12 @@ def _shard_data_host(model, data, n_shards):
             for k, v in tensor.items()
         },
         "w": lay_vec(data["w"]),
-        "sqrt_w": lay_vec(data["sqrt_w"]),
         "track_pn": lay_vec(data["track_pn"]),
         "delta_pn": lay_vec(data["delta_pn"]),
     }
     sharded = {
         "tensor": {k: k in row_keys for k in tensor},
         "w": True,
-        "sqrt_w": True,
         "track_pn": None if data["track_pn"] is None else True,
         "delta_pn": None if data["delta_pn"] is None else True,
     }

@@ -8,12 +8,13 @@ TPU re-design: noise enters the fit through two pure surfaces —
 
 - ``scale_sigma(params, tensor, sigma)``: per-TOA uncertainty rescaling
   (EFAC/EQUAD), a pure elementwise function usable inside any jitted graph;
-- ``basis_and_weights(params, tensor, sl)``: a dense (N, k) basis matrix F
-  and (k,) prior variances phi for correlated components (ECORR epoch
-  blocks, power-law Fourier modes). The GLS fitter appends F to the design
-  matrix and solves the Woodbury-regularized normal equations as plain
-  MXU matmuls + one Cholesky (fitting/gls.py) — never materializing the
-  N x N covariance.
+- ``basis_and_weights(params, tensor, sl)``: the correlated-noise basis in
+  STRUCTURED form (fitting/woodbury.py NoiseBasis) — dense Fourier-mode
+  columns for the power-law components, an implicit epoch-index vector for
+  ECORR. The GLS fitter solves the marginalized normal equations with
+  Woodbury/block-Schur algebra: MXU matmuls for the dense part, O(N)
+  gathers/segment-sums for ECORR, one small Cholesky — never materializing
+  the N x N covariance NOR the (N, k_epoch) ECORR membership matrix.
 
 Irregular host work (ECORR epoch grouping) happens once at tensor-build
 time (`host_columns`); everything on device is static-shape dense algebra.
@@ -45,7 +46,10 @@ class NoiseComponent(Component):
         return sigma
 
     def basis_and_weights(self, params: dict, tensor: dict, sl):
-        """(F (N_data, k), phi (k,)) for correlated components, else None.
+        """Tagged basis contribution for correlated components, else None:
+        ``("dense", F (N_data, kd), phi (kd,))`` for Fourier-mode bases or
+        ``("epoch", eidx (N_data,) int32, phi (ke,))`` for ECORR epoch
+        blocks (see fitting/woodbury.py NoiseBasis).
 
         `sl` is the row slice selecting data rows (dropping the TZR row)
         from row-indexed tensor arrays.
@@ -168,32 +172,51 @@ class EcorrNoise(NoiseComponent):
         # keep them out of the epoch grouping so a TZR coincident with a
         # lone TOA cannot fabricate a single-member ECORR block
         real = np.asarray(toas.error_us) > 0
-        blocks: list[np.ndarray] = []
+        # TPU-native representation: the epoch-membership matrix U stays
+        # implicit as a per-TOA epoch INDEX (-1 = no epoch). Every product
+        # with U is then a gather/segment-sum (fitting/woodbury.py) — O(N)
+        # instead of the reference's dense (N, k) quantization matrix
+        # (noise_model.py:635-673), which at 1e5 TOAs x 1e4 epochs would be
+        # ~10 GB and cap GLS at toy scale.
+        eidx = np.full(n, -1.0)
         widx: list[int] = []
+        k = 0
         for pi, mp in enumerate(self.mask_params):
             mask = np.flatnonzero((cols[f"mask_{mp.name}"] > 0) & real)
             for bucket in _quantize_epochs(t_s[mask]):
-                col = np.zeros(n)
-                col[mask[bucket]] = 1.0
-                blocks.append(col)
+                rows = mask[bucket]
+                taken = eidx[rows] >= 0
+                if taken.any():
+                    # overlapping ECORR selections: first selection wins
+                    # (NANOGrav backend flags are disjoint in practice)
+                    log.warning(
+                        f"{int(taken.sum())} TOAs already in an ECORR epoch; "
+                        f"{mp.name} keeps only the unclaimed ones"
+                    )
+                    rows = rows[~taken]
+                    if len(rows) < 2:
+                        continue
+                eidx[rows] = k
                 widx.append(pi)
-        if not blocks:
+                k += 1
+        if k == 0:
             log.warning("ECORR present but no epoch has >= 2 selected TOAs")
-            blocks = [np.zeros(n)]
-            widx = [0]
-        cols["ecorr_umat"] = np.stack(blocks, axis=1)
+        cols["ecorr_eidx"] = eidx
         # column -> ECORR-param map rides in the tensor (leading singleton
         # axis keeps it clear of the TZR row-zeroing in build_tensor), so a
         # cached tensor stays self-consistent with no component state
-        cols["ecorr_widx"] = np.asarray(widx, np.float64)[None, :]
+        cols["ecorr_widx"] = np.asarray(widx, np.float64)[None, :] if widx else np.zeros((1, 0))
         return cols
 
     def basis_and_weights(self, params, tensor, sl):
-        U = tensor["ecorr_umat"][sl]
-        widx = jnp.asarray(tensor["ecorr_widx"][0], jnp.int32)
+        widx_arr = tensor["ecorr_widx"]
+        if widx_arr.shape[1] == 0:  # static shape: no epochs bound
+            return None
+        eidx = jnp.asarray(tensor["ecorr_eidx"][sl], jnp.int32)
+        widx = jnp.asarray(widx_arr[0], jnp.int32)
         vals = jnp.stack([leaf_to_f64(params[mp.name]) for mp in self.mask_params])
         phi = vals[widx] ** 2
-        return U, phi
+        return ("epoch", eidx, phi)
 
 
 def _tspan_col(toas) -> np.ndarray:
@@ -278,7 +301,7 @@ class PLRedNoise(NoiseComponent):
         amp, gamma = self._amp_gamma(params)
         # weights = PSD * lowest frequency (reference noise_model.py:607-617)
         phi = powerlaw_psd_weights(freqs, amp, gamma) * freqs[0]
-        return F, phi
+        return ("dense", F, phi)
 
 
 class PLDMNoise(NoiseComponent):
@@ -319,4 +342,4 @@ class PLDMNoise(NoiseComponent):
         amp = 10.0 ** leaf_to_f64(params["TNDMAMP"])
         gamma = leaf_to_f64(params["TNDMGAM"])
         phi = powerlaw_psd_weights(freqs, amp, gamma) * freqs[0]
-        return F * D[:, None], phi
+        return ("dense", F * D[:, None], phi)

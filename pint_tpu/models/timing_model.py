@@ -167,21 +167,35 @@ class TimingModel:
         return sigma
 
     def noise_basis_and_weights(self, params: dict, tensor: dict):
-        """Concatenated correlated-noise basis F (N_data, k) and prior
-        variances phi (k,), or None (reference noise_model_designmatrix /
-        noise_model_basis_weight, timing_model.py)."""
+        """Structured correlated-noise basis (fitting/woodbury.py
+        NoiseBasis) or None: dense Fourier columns concatenated, the ECORR
+        epoch structure kept implicit (reference noise_model_designmatrix /
+        noise_model_basis_weight, timing_model.py — which concatenate
+        everything dense)."""
         import jax.numpy as _jnp
+
+        from pint_tpu.fitting.woodbury import NoiseBasis
 
         sl = slice(None, -1) if self.has_abs_phase else slice(None)
         Fs, phis = [], []
+        eidx = ephi = None
         for c in self.noise_components:
-            pair = c.basis_and_weights(params, tensor, sl)
-            if pair is not None:
-                Fs.append(pair[0])
-                phis.append(pair[1])
-        if not Fs:
+            out = c.basis_and_weights(params, tensor, sl)
+            if out is None:
+                continue
+            if out[0] == "dense":
+                Fs.append(out[1])
+                phis.append(out[2])
+            else:  # "epoch" — at most one EcorrNoise component per model
+                eidx, ephi = out[1], out[2]
+        if not Fs and eidx is None:
             return None
-        return _jnp.concatenate(Fs, axis=1), _jnp.concatenate(phis)
+        return NoiseBasis(
+            dense=_jnp.concatenate(Fs, axis=1) if Fs else None,
+            dense_phi=_jnp.concatenate(phis) if phis else None,
+            eidx=eidx,
+            ephi=ephi,
+        )
 
     def set_free(self, names: list[str]) -> None:
         for n in names:

@@ -33,14 +33,17 @@ def phase_residual_frac(
     delta_pn: Array | None = None,
     subtract_mean: bool = True,
     weights: Array | None = None,
+    xp=None,
 ) -> tuple[Array, Array, Array]:
     """Pure: -> (pulse_number, frac_phase_residual f64 turns, spin freq Hz).
 
     With `track_pn` given (use_pulse_numbers mode) the residual is
     phase - track_pn (+delta), otherwise the nearest-integer fractional part.
     The spin frequency rides along from the same delay-chain evaluation.
+    `xp` overrides the model's extended-precision backend for THIS evaluation
+    (parity cross-checks) without mutating model state.
     """
-    xp = model.xprec
+    xp = xp or model.xprec
     ph, f = model.phase_and_freq(params, tensor, xp)
     if delta_pn is not None:
         ph = xp.add_f(ph, delta_pn)

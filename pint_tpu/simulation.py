@@ -64,6 +64,47 @@ def _reprepare(toas: TOAs, shift_s: np.ndarray) -> TOAs:
     )
 
 
+def make_fake_toas_fromMJDs(
+    mjds: np.ndarray,
+    model,
+    obs: str = "gbt",
+    freq_mhz: float | np.ndarray = 1400.0,
+    error_us: float | np.ndarray = 1.0,
+    flags: list[dict] | None = None,
+    add_noise: bool = False,
+    add_correlated_noise: bool = False,
+    rng: np.random.Generator | None = None,
+    planets: bool | None = None,
+) -> TOAs:
+    """Fake TOAs at arbitrary MJDs lying exactly on `model`.
+
+    `flags` (per-TOA dicts, e.g. ``{"f": "Rcvr1_2_GUPPI"}``) bind the model's
+    mask parameters — EFAC/EQUAD/ECORR selections, JUMPs — exactly as real
+    tim-file flags would. `add_noise` draws white noise scaled by the TOA
+    errors; `add_correlated_noise` draws from the model's FULL noise
+    covariance instead (reference make_fake_toas_fromMJDs simulation.py:240
+    + add_correlated_noise:273)."""
+    ntoas = len(mjds)
+    utc = ptime.MJDEpoch.from_mjd_float(np.asarray(mjds, float))
+    err = np.broadcast_to(np.asarray(error_us, float), (ntoas,)).copy()
+    frq = np.broadcast_to(np.asarray(freq_mhz, float), (ntoas,)).copy()
+    obs_name = get_observatory(obs).name
+    obs_arr = np.array([obs_name] * ntoas)
+    if planets is None:
+        planets = bool(model.planet_shapiro)
+    toas = prepare_arrays(
+        utc, err, frq, obs_arr, flags=flags,
+        ephem=model.ephem or "auto", planets=planets,
+    )
+    toas = zero_residuals(toas, model)
+    if add_correlated_noise:
+        toas = add_noise_from_model(toas, model, rng=rng)
+    elif add_noise:
+        rng = rng or np.random.default_rng()
+        toas = _reprepare(toas, rng.standard_normal(ntoas) * err * 1e-6)
+    return toas
+
+
 def make_fake_toas_uniform(
     start_mjd: float,
     end_mjd: float,
@@ -72,27 +113,55 @@ def make_fake_toas_uniform(
     obs: str = "gbt",
     freq_mhz: float | np.ndarray = 1400.0,
     error_us: float | np.ndarray = 1.0,
+    flags: list[dict] | None = None,
     add_noise: bool = False,
+    add_correlated_noise: bool = False,
     rng: np.random.Generator | None = None,
     planets: bool | None = None,
 ) -> TOAs:
-    """Evenly spaced fake TOAs lying exactly on `model` (+ optional white
-    noise draw scaled by the errors). Reference make_fake_toas_uniform,
-    simulation.py:191."""
-    mjds = np.linspace(start_mjd, end_mjd, ntoas)
-    utc = ptime.MJDEpoch.from_mjd_float(mjds)
-    err = np.broadcast_to(np.asarray(error_us, float), (ntoas,)).copy()
-    frq = np.broadcast_to(np.asarray(freq_mhz, float), (ntoas,)).copy()
-    obs_name = get_observatory(obs).name
-    obs_arr = np.array([obs_name] * ntoas)
-    if planets is None:
-        planets = bool(model.planet_shapiro)
-    toas = prepare_arrays(utc, err, frq, obs_arr, ephem=model.ephem or "auto", planets=planets)
-    toas = zero_residuals(toas, model)
-    if add_noise:
-        rng = rng or np.random.default_rng()
-        toas = _reprepare(toas, rng.standard_normal(ntoas) * err * 1e-6)
-    return toas
+    """Evenly spaced fake TOAs lying exactly on `model` (+ optional noise
+    draw). Reference make_fake_toas_uniform, simulation.py:191."""
+    return make_fake_toas_fromMJDs(
+        np.linspace(start_mjd, end_mjd, ntoas), model, obs=obs,
+        freq_mhz=freq_mhz, error_us=error_us, flags=flags,
+        add_noise=add_noise, add_correlated_noise=add_correlated_noise,
+        rng=rng, planets=planets,
+    )
+
+
+def add_noise_from_model(toas: TOAs, model, rng=None) -> TOAs:
+    """Shift TOAs by one realization of the model's full noise covariance
+    C = diag(sigma_scaled^2) + F phi F^T.
+
+    The white part uses the EFAC/EQUAD-scaled uncertainties; the correlated
+    part draws independent normal coefficients with the prior variances phi
+    of every noise basis column (ECORR epoch blocks, power-law red/DM Fourier
+    modes) and maps them through the basis — the same covariance the GLS
+    fitter models, so GLS closure tests can inject exactly what they fit
+    (reference simulation.py:273-311)."""
+    rng = rng or np.random.default_rng()
+    res = Residuals(toas, model, subtract_mean=False)
+    n = len(toas)
+    sigma = np.asarray(model.scaled_sigma(model.params, res.tensor))[:n]
+    shift = rng.standard_normal(n) * sigma
+    basis = model.noise_basis_and_weights(model.params, res.tensor)
+    if basis is not None:
+        import jax.numpy as jnp
+
+        from pint_tpu.fitting.woodbury import basis_matvec
+
+        ae = ad = None
+        if basis.ephi is not None:
+            ae = jnp.asarray(
+                rng.standard_normal(basis.ke) * np.sqrt(np.asarray(basis.ephi))
+            )
+        if basis.dense_phi is not None:
+            ad = jnp.asarray(
+                rng.standard_normal(basis.kd)
+                * np.sqrt(np.asarray(basis.dense_phi))
+            )
+        shift = shift + np.asarray(basis_matvec(basis, ae, ad))[:n]
+    return _reprepare(toas, shift)
 
 
 def make_fake_toas_fromtim(timfile: str, model, add_noise: bool = False, rng=None) -> TOAs:
