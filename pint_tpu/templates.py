@@ -58,8 +58,10 @@ class LCTemplate:
         return out
 
     def shifted(self, dphi: float) -> "LCTemplate":
+        from dataclasses import replace
+
         return LCTemplate(
-            [LCGaussian((c.phase + dphi) % 1.0, c.fwhm, c.ampl) for c in self.components]
+            [replace(c, phase=(c.phase + dphi) % 1.0) for c in self.components]
         )
 
     # --- 'gauss' text format (reference lctemplate.prim_io) --------------------
@@ -84,6 +86,12 @@ class LCTemplate:
         return cls(comps)
 
     def write(self, path: str) -> None:
+        for c in self.components:
+            if not isinstance(c, LCGaussian):
+                raise TypeError(
+                    "the 'gauss' text format represents Gaussian components "
+                    f"only, not {type(c).__name__}"
+                )
         with open(path, "w") as f:
             f.write("# gauss\n" + "-" * 25 + "\n")
             f.write("const = 0.00000 +/- 0.00000\n")
@@ -92,6 +100,152 @@ class LCTemplate:
                 f.write(f"fwhm{k} = {c.fwhm:.5f} +/- 0.00000\n")
                 f.write(f"ampl{k} = {c.ampl:.5f} +/- 0.00000\n")
             f.write("-" * 25 + "\n")
+
+
+@dataclass
+class LCLorentzian:
+    """Wrapped Lorentzian (Cauchy) component; the wrapped sum over all
+    cycles has the closed form sinh(g) / (cosh(g) - cos(2 pi (x - mu)))
+    with g = 2 pi * HWHM (reference lcprimitives.LCLorentzian)."""
+
+    phase: float
+    fwhm: float
+    ampl: float
+
+    def density(self, x: np.ndarray) -> np.ndarray:
+        g = 2.0 * np.pi * (self.fwhm / 2.0)
+        return np.sinh(g) / (
+            np.cosh(g) - np.cos(2.0 * np.pi * (x - self.phase))
+        )
+
+
+@dataclass
+class LCVonMises:
+    """Von Mises component, exactly periodic and normalized on [0, 1)
+    (reference lcprimitives.LCVonMises); fwhm maps to the concentration
+    via cos(pi*fwhm) = 1 - log(2)/kappa."""
+
+    phase: float
+    fwhm: float
+    ampl: float
+
+    def density(self, x: np.ndarray) -> np.ndarray:
+        from scipy.special import i0
+
+        kappa = np.log(2.0) / (1.0 - np.cos(np.pi * self.fwhm))
+        return np.exp(kappa * np.cos(2 * np.pi * (x - self.phase))) / i0(kappa)
+
+
+def template_params(template: LCTemplate):
+    """(phases (k,), sigmas (k,), ampls (k,)) arrays of a pure-Gaussian
+    template — the jit-friendly representation used by the photon-MCMC
+    likelihood (event_optimize.py)."""
+    for c in template.components:
+        if not isinstance(c, LCGaussian):
+            raise TypeError(
+                "jitted template evaluation supports Gaussian components only"
+            )
+    return (
+        np.array([c.phase for c in template.components]),
+        np.array([c.fwhm * FWHM_TO_SIGMA for c in template.components]),
+        np.array([c.ampl for c in template.components]),
+    )
+
+
+def template_density_jnp(x, phases, sigmas, ampls):
+    """Normalized wrapped-Gaussian mixture density at phases x (jnp array,
+    any shape; values taken mod 1) — the jax twin of LCTemplate.__call__."""
+    import jax.numpy as jnp
+
+    x = jnp.mod(x, 1.0)[..., None]
+    out = jnp.zeros_like(x[..., 0]) + jnp.maximum(1.0 - jnp.sum(ampls), 0.0)
+    for k in range(-_WRAPS, _WRAPS + 1):
+        out = out + jnp.sum(
+            ampls
+            / (sigmas * np.sqrt(2 * np.pi))
+            * jnp.exp(-0.5 * ((x - phases + k) / sigmas) ** 2),
+            axis=-1,
+        )
+    return out
+
+
+def fit_template(template: LCTemplate, phases, weights=None,
+                 fit_shape: bool = True):
+    """Unbinned weighted ML fit of the template's component parameters
+    (phase, fwhm, ampl per component) to photon phases, with inverse-Hessian
+    uncertainties (reference lcfitters.LCFitter.fit / hess_errors).
+
+    Returns (fitted LCTemplate, {param: err}, lnlike). Gaussian components
+    only (the 'gauss' file format the reference ships)."""
+    import jax
+    import jax.numpy as jnp
+    from scipy.optimize import minimize
+
+    ph0, sg0, am0 = template_params(template)
+    k = len(ph0)
+    x = jnp.asarray(np.mod(np.asarray(phases, float), 1.0))
+    w = None if weights is None else jnp.asarray(np.asarray(weights, float))
+
+    def unpack(theta):
+        ph = theta[:k]
+        sg = jnp.exp(theta[k : 2 * k]) if fit_shape else jnp.asarray(sg0)
+        if not fit_shape:
+            return ph, sg, jnp.asarray(am0)
+        # amplitudes live on the simplex sum(am) <= 1 by construction:
+        # softmax over k component logits + an implicit 0 background logit
+        # (a per-amplitude sigmoid would let sum(am) exceed 1 and the
+        # likelihood become improper)
+        z = theta[2 * k : 3 * k]
+        denom = 1.0 + jnp.sum(jnp.exp(z))
+        return ph, sg, jnp.exp(z) / denom
+
+    def nll(theta):
+        ph, sg, am = unpack(theta)
+        f = template_density_jnp(x, ph, sg, am)
+        if w is None:
+            return -jnp.sum(jnp.log(jnp.maximum(f, 1e-300)))
+        return -jnp.sum(jnp.log(jnp.maximum(w * f + (1.0 - w), 1e-300)))
+
+    bg0 = max(1.0 - float(np.sum(am0)), 1e-4)
+    theta0 = np.concatenate([
+        ph0,
+        np.log(sg0) if fit_shape else np.zeros(0),
+        np.log(np.maximum(am0, 1e-6) / bg0) if fit_shape else np.zeros(0),
+    ])
+    g = jax.jit(jax.grad(nll))
+    res = minimize(
+        lambda t: float(nll(jnp.asarray(t))),
+        theta0,
+        jac=lambda t: np.asarray(g(jnp.asarray(t))),
+        method="L-BFGS-B",
+    )
+    theta = jnp.asarray(res.x)
+    ph, sg, am = (np.asarray(a) for a in unpack(theta))
+    fitted = LCTemplate(
+        [LCGaussian(float(p) % 1.0, float(s) / FWHM_TO_SIGMA, float(a))
+         for p, s, a in zip(ph, sg, am)]
+    )
+    # uncertainties: inverse Hessian in the unconstrained parametrization,
+    # propagated through the FULL transform jacobian to (phase, fwhm, ampl)
+    errs: dict[str, float] = {}
+    try:
+        H = np.asarray(jax.hessian(nll)(theta))
+        cov = np.linalg.inv(H)
+
+        def phys(theta):
+            p, s, a = unpack(theta)
+            return jnp.concatenate([p, s / FWHM_TO_SIGMA, a])
+
+        J = np.asarray(jax.jacobian(phys)(theta))
+        d = np.sqrt(np.maximum(np.diag(J @ cov @ J.T), 0.0))
+        for i in range(k):
+            errs[f"phas{i + 1}"] = float(d[i])
+            if fit_shape:
+                errs[f"fwhm{i + 1}"] = float(d[k + i])
+                errs[f"ampl{i + 1}"] = float(d[2 * k + i])
+    except np.linalg.LinAlgError:
+        pass
+    return fitted, errs, -float(res.fun)
 
 
 def lnlikelihood(template: LCTemplate, phases, weights=None, dphi: float = 0.0) -> float:
