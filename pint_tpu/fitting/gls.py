@@ -181,6 +181,36 @@ def gls_solve(mtcm, mtcy, norm, p: int, lam: float = 0.0):
     return dx, cov
 
 
+def full_cov_pieces(model, resids, r0, M, params=None):
+    """Dense-covariance GLS normal equations (reference fitter.py:2177-2203
+    full_cov=True): materialize C = diag(sigma^2) + F phi F^T and Cholesky
+    it on the host. O(N^2) memory / O(N^3) time — a small-N cross-check of
+    the structured Woodbury algebra, exactly like the reference's slow path.
+    Returns (mtcm, mtcy, chi2_0, cov_solve) in UNNORMALIZED units."""
+    import scipy.linalg as sl
+
+    from pint_tpu.fitting.woodbury import basis_dense
+
+    if params is None:
+        params = model.xprec.convert_params(model.params)
+    sigma = np.asarray(model.scaled_sigma(params, resids.tensor))
+    n = sigma.size
+    C = np.diag(sigma**2)
+    basis = model.noise_basis_and_weights(params, resids.tensor)
+    if basis is not None:
+        F, phi = (np.asarray(a) for a in basis_dense(basis, n))
+        C = C + (F * phi) @ F.T
+    cf = sl.cho_factor(C)
+    r0 = np.asarray(r0)
+    M = np.asarray(M)
+    CinvM = sl.cho_solve(cf, M)
+    Cinvr = sl.cho_solve(cf, r0)
+    mtcm = M.T @ CinvM
+    mtcy = M.T @ (-Cinvr)
+    chi2_0 = float(r0 @ Cinvr)
+    return mtcm, mtcy, chi2_0
+
+
 class GLSFitter(WLSFitter):
     """Iterated linear GLS (reference GLSFitter.fit_toas, fitter.py:2122)."""
 
@@ -202,7 +232,11 @@ class GLSFitter(WLSFitter):
                jnp.asarray(r.errors_s))
         )
 
-    def fit_toas(self, maxiter: int = 1, xtol: float = 1e-2) -> FitResult:
+    def fit_toas(self, maxiter: int = 1, xtol: float = 1e-2,
+                 full_cov: bool = False) -> FitResult:
+        """`full_cov` swaps the structured-Woodbury normal equations for
+        the dense-Cholesky covariance (reference fitter.py:2177 slow path)
+        — an O(N^3) cross-check, small TOA sets only."""
         if len(self._free) == 0:
             return self._frozen_fit_result()
         params = self.model.xprec.convert_params(self.model.params)
@@ -211,6 +245,13 @@ class GLSFitter(WLSFitter):
         converged = False
         for it in range(1, maxiter + 1):
             r0, M, mtcm, mtcy, norm, chi2_0, ahat = self._step_fn(params, self.tensor)
+            if full_cov:
+                mtcm_d, mtcy_d, _ = full_cov_pieces(
+                    self.model, self.resids, r0, M, params=params)
+                norm_d = np.sqrt(np.maximum(np.diag(mtcm_d), 1e-300))
+                mtcm = mtcm_d / norm_d[:, None] / norm_d[None, :]
+                mtcy = mtcy_d / norm_d
+                norm = norm_d
             dx, cov = gls_solve(mtcm, mtcy, norm, p)
             params = apply_delta(params, self._free, dx)
             sigma = np.sqrt(np.diag(cov))

@@ -45,7 +45,8 @@ Array = jnp.ndarray
 _RIDGE = 1e-10
 
 
-def _point_kernel(model, grid_names, free, subtract_mean, maxiter, toa_axis=None):
+def _point_kernel(model, grid_names, free, subtract_mean, maxiter, toa_axis=None,
+                  correlated=False):
     """Pure per-grid-point chi^2 kernel.
 
     kernel(vals, params, data) -> scalar chi^2, where
@@ -62,7 +63,6 @@ def _point_kernel(model, grid_names, free, subtract_mean, maxiter, toa_axis=None
 
     xp = model.xprec
     mean_free = subtract_mean and not model.has_phase_offset
-    correlated = model.has_correlated_errors
     p = len(free)
     nonlin, lin_names, owners = linear_split(model, free)
     sl_data = slice(None, -1) if model.has_abs_phase else slice(None)
@@ -278,23 +278,32 @@ def grid_chisq(
     pts = np.stack([g.ravel() for g in grids], axis=1)  # (npts, g)
     npts = pts.shape[0]
 
+    # the chi^2 STATISTIC follows the fitter type, like the reference's
+    # per-fitter grids: GLS fitters grid the Woodbury/correlated statistic,
+    # WLS fitters the plain weighted chi^2 even when the model carries
+    # noise components (reference bench_chisq_grid vs _WLSFitter)
+    from pint_tpu.fitting.gls import GLSFitter
+
+    correlated = isinstance(fitter, GLSFitter) and model.has_correlated_errors
+
     params = model.xprec.convert_params(model.params)
     data = _host_data(resids, fitter.tensor)
 
     if mesh is not None:
         chi2 = _grid_sharded(
             model, parnames, free, resids.subtract_mean, maxiter, mesh,
-            grid_axis, toa_axis, pts, params, data,
+            grid_axis, toa_axis, pts, params, data, correlated,
         )
     else:
         chi2 = _grid_single(
             model, parnames, free, resids.subtract_mean, maxiter, pts,
-            params, data, batch,
+            params, data, batch, correlated,
         )
     return np.asarray(chi2)[:npts].reshape(out_shape)
 
 
-def _grid_single(model, parnames, free, subtract_mean, maxiter, pts, params, data, batch):
+def _grid_single(model, parnames, free, subtract_mean, maxiter, pts, params, data,
+                 batch, correlated):
     from pint_tpu.ops.compile import precision_jit
 
     npts = pts.shape[0]
@@ -309,9 +318,11 @@ def _grid_single(model, parnames, free, subtract_mean, maxiter, pts, params, dat
     # compiled program cached on the model: repeated scans (bench repeats,
     # profile sweeps) must not re-trace/re-compile
     cache = model.__dict__.setdefault("_grid_fn_cache", {})
-    key = ("single", parnames, free, subtract_mean, maxiter, batch, model.xprec.name)
+    key = ("single", parnames, free, subtract_mean, maxiter, batch,
+           correlated, model.xprec.name)
     if key not in cache:
-        kernel = _point_kernel(model, parnames, free, subtract_mean, maxiter)
+        kernel = _point_kernel(model, parnames, free, subtract_mean, maxiter,
+                               correlated=correlated)
         vk = jax.vmap(kernel, in_axes=(0, None, None))
         cache[key] = precision_jit(
             lambda tiles, params, data: jax.lax.map(lambda t: vk(t, params, data), tiles)
@@ -320,7 +331,7 @@ def _grid_single(model, parnames, free, subtract_mean, maxiter, pts, params, dat
 
 
 def _grid_sharded(model, parnames, free, subtract_mean, maxiter, mesh,
-                  grid_axis, toa_axis, pts, params, data):
+                  grid_axis, toa_axis, pts, params, data, correlated):
     from jax.sharding import PartitionSpec as P
 
     shard_map = jax.shard_map
@@ -351,10 +362,11 @@ def _grid_sharded(model, parnames, free, subtract_mean, maxiter, mesh,
     cache = model.__dict__.setdefault("_grid_fn_cache", {})
     key = ("sharded", parnames, free, subtract_mean, maxiter,
            grid_axis, toa_axis, tuple(mesh.devices.flat),
-           tuple(sorted(mesh.shape.items())), shard_toas, model.xprec.name)
+           tuple(sorted(mesh.shape.items())), shard_toas, correlated,
+           model.xprec.name)
     if key not in cache:
         kernel = _point_kernel(model, parnames, free, subtract_mean, maxiter,
-                               toa_axis=eff_toa_axis)
+                               toa_axis=eff_toa_axis, correlated=correlated)
         vk = jax.vmap(kernel, in_axes=(0, None, None))
         param_specs = jax.tree.map(lambda _: P(), params)
         fn = shard_map(

@@ -163,3 +163,130 @@ class AstrometryEcliptic(AstrometryBase):
         lon = params["ELONG"] + params.get("PMELONG", 0.0) * dt / jnp.cos(lat0)
         lat = lat0 + params.get("PMELAT", 0.0) * dt
         return ecliptic_to_icrs(unit_vector(lon, lat))
+
+
+# --- frame conversion (reference timing_model.py as_ECL:2647 / as_ICRS:2697) ---
+
+def _tangent_basis(lon: float, lat: float) -> tuple[np.ndarray, np.ndarray]:
+    """(e_lon, e_lat) unit vectors of the local tangent plane."""
+    e_lon = np.array([-np.sin(lon), np.cos(lon), 0.0])
+    e_lat = np.array([
+        -np.cos(lon) * np.sin(lat), -np.sin(lon) * np.sin(lat), np.cos(lat)
+    ])
+    return e_lon, e_lat
+
+
+def _convert_astrometry(model, to_ecliptic: bool):
+    """Shared machinery of as_ECL/as_ICRS: exact rotation of the position
+    and proper-motion vectors by the IERS2010 obliquity, tangent-plane
+    jacobian propagation of the uncertainties, free-flag and PX/POSEPOCH
+    carry-over. Returns a NEW model (the input is untouched)."""
+    import copy
+
+    from pint_tpu.models.parameter import ParamValueMeta
+
+    m = copy.deepcopy(model)
+    old = m.astrometry
+    if old is None:
+        raise ValueError("model has no astrometry component")
+    want = AstrometryEcliptic if to_ecliptic else AstrometryEquatorial
+    if isinstance(old, want):
+        return m
+
+    def val(n, default=None):
+        if n not in m.params:
+            return default
+        return float(np.asarray(m.params[n]))
+
+    def unc(n):
+        meta = m.param_meta.get(n)
+        return None if meta is None else meta.uncertainty
+
+    if to_ecliptic:
+        names_in = ("RAJ", "DECJ", "PMRA", "PMDEC")
+        lon_in, lat_in = val("RAJ"), val("DECJ")
+        rot = lambda v: np.asarray(icrs_to_ecliptic(jnp.asarray(v)))
+        names_out = ("ELONG", "ELAT", "PMELONG", "PMELAT")
+    else:
+        names_in = ("ELONG", "ELAT", "PMELONG", "PMELAT")
+        lon_in, lat_in = val("ELONG"), val("ELAT")
+        rot = lambda v: np.asarray(ecliptic_to_icrs(jnp.asarray(v)))
+        names_out = ("RAJ", "DECJ", "PMRA", "PMDEC")
+
+    pm_lon, pm_lat = val(names_in[2], 0.0), val(names_in[3], 0.0)
+    u = rot(np.asarray(unit_vector(lon_in, lat_in)))
+    lon_out = float(np.arctan2(u[1], u[0]) % (2 * np.pi))
+    lat_out = float(np.arcsin(np.clip(u[2], -1.0, 1.0)))
+    e_lon_in, e_lat_in = _tangent_basis(lon_in, lat_in)
+    e_lon_out, e_lat_out = _tangent_basis(lon_out, lat_out)
+    pm3 = rot(pm_lon * e_lon_in + pm_lat * e_lat_in)
+    pm_lon_out = float(pm3 @ e_lon_out)
+    pm_lat_out = float(pm3 @ e_lat_out)
+
+    # tangent-plane jacobian (a pure rotation by the local position angle
+    # between the two frames' north directions)
+    J = np.array([
+        [e_lon_out @ rot(e_lon_in), e_lon_out @ rot(e_lat_in)],
+        [e_lat_out @ rot(e_lon_in), e_lat_out @ rot(e_lat_in)],
+    ])
+
+    def prop_unc(s_lon_t, s_lat):
+        if s_lon_t is None and s_lat is None:
+            return None, None
+        s = np.array([s_lon_t or 0.0, s_lat or 0.0])
+        out = np.sqrt((J**2) @ (s**2))
+        return float(out[0]), float(out[1])
+
+    # position uncertainties work in tangent-plane displacement
+    # (RAJ uncertainty is radians of RA -> displacement needs cos(dec))
+    s_pos = prop_unc(
+        None if unc(names_in[0]) is None else unc(names_in[0]) * np.cos(lat_in),
+        unc(names_in[1]),
+    )
+    s_pm = prop_unc(unc(names_in[2]), unc(names_in[3]))
+
+    carry = {
+        "PX": (m.params.get("PX"), m.param_meta.get("PX")),
+        "POSEPOCH": (m.params.get("POSEPOCH"), m.param_meta.get("POSEPOCH")),
+    }
+    free_map = dict(zip(names_out, [
+        not m.param_meta[n].frozen if n in m.param_meta else False
+        for n in names_in
+    ]))
+
+    m.remove_component(old.name)
+    new = want()
+    m.add_component(new, validate=False)
+    out_vals = (lon_out, lat_out, pm_lon_out, pm_lat_out)
+    out_uncs = (
+        None if s_pos[0] is None else s_pos[0] / np.cos(lat_out),
+        s_pos[1], s_pm[0], s_pm[1],
+    )
+    for n, v, s in zip(names_out, out_vals, out_uncs):
+        m.params[n] = np.float64(v)
+        m.param_meta[n] = ParamValueMeta(
+            spec=new.specs[n], frozen=not free_map[n], uncertainty=s,
+        )
+    for n, (v, meta) in carry.items():
+        if v is not None:
+            m.params[n] = v
+            m.param_meta[n] = meta
+    if to_ecliptic:
+        m.meta["ECL"] = "IERS2010"
+    else:
+        m.meta.pop("ECL", None)
+    new.validate(m.params, m.meta)
+    m.clear_caches()
+    return m
+
+
+def model_as_ECL(model):
+    """Equatorial -> ecliptic astrometry (reference as_ECL,
+    timing_model.py:2647); returns a new model."""
+    return _convert_astrometry(model, to_ecliptic=True)
+
+
+def model_as_ICRS(model):
+    """Ecliptic -> equatorial astrometry (reference as_ICRS,
+    timing_model.py:2697); returns a new model."""
+    return _convert_astrometry(model, to_ecliptic=False)

@@ -47,6 +47,7 @@ DEFAULT_ORDER = [
     "troposphere",
     "solar_system_shapiro",
     "solar_wind",
+    "solar_windx",
     "dispersion_constant",
     "dispersion_dmx",
     "dispersion_jump",
@@ -175,6 +176,21 @@ class Component:
 
     def add_prefix_param(self, spec: ParamSpec) -> None:
         self.specs[spec.name] = spec
+
+    def func_param_specs(self) -> list:
+        """Derived read-only parameters this component exposes (reference
+        funcParameter); list of parameter.FuncParamSpec."""
+        return []
+
+    def parfile_exclude(self) -> set:
+        """Parameter names the generic as_parfile loop must NOT emit
+        (multi-token families the component writes itself)."""
+        return set()
+
+    def extra_parfile_lines(self, model) -> list:
+        """Extra (key, text) parfile lines this component owns (window
+        ranges, multi-token WAVE/IFUNC lines, ...)."""
+        return []
 
     def default_params(self) -> dict:
         """Initial values for params whose spec has a default."""

@@ -214,6 +214,42 @@ class PulsarBinary(DelayComponent):
     def param_specs(self):  # instance-configured; shadows the classmethod
         return self._spec_list
 
+    def extra_parfile_lines(self, model):
+        out = [("BINARY", self.model_name)]
+        if self.model_name == "ELL1H":
+            out.append(("NHARMS", str(self.nharms)))
+        return out
+
+    def func_param_specs(self):
+        """Derived read-only parameters (reference funcParameter usage in
+        binary_dd.py:171-326): DDS exposes SINI(SHAPMAX); DDGR exposes the
+        full GR-derived post-Keplerian set from (MTOT, M2)."""
+        from pint_tpu.models.parameter import FuncParamSpec
+
+        if self.model_name == "DDS":
+            return [FuncParamSpec(
+                "SINI", ("SHAPMAX",), lambda s: 1.0 - np.exp(-s),
+                description="Sine of inclination (from SHAPMAX)",
+            )]
+        if self.model_name == "DDGR":
+            def mk(key):
+                def f(mtot, m2, ecc, a1, pb, xomdot):
+                    d = eng.ddgr_derived({
+                        "MTOT": mtot, "M2": m2, "ECC": ecc, "A1": a1,
+                        "PB": pb, "XOMDOT": xomdot,
+                    })
+                    return d[key]
+
+                return f
+
+            ins = ("MTOT", "M2", "ECC", "A1", "PB", "XOMDOT")
+            return [
+                FuncParamSpec(k, ins, mk(k),
+                              description=f"GR-derived {k} from (MTOT, M2)")
+                for k in ("OMDOT", "GAMMA", "PBDOT", "SINI", "DR", "DTH")
+            ]
+        return []
+
     @property
     def name(self) -> str:
         return f"Binary{self.model_name}"

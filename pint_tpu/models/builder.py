@@ -64,7 +64,6 @@ IGNORED_KEYS = {
     "IBOOT",
     "EPHVER",
     "DMDATA",
-    "SWM",
     "BADTOA",
 }
 
@@ -153,6 +152,10 @@ def build_model(pf: ParFile) -> TimingModel:
         components.append(FD())
     if "NE_SW" in pf or "NE1AU" in pf or "SOLARN0" in pf:
         components.append(SolarWindDispersion())
+    if any(n.startswith("SWXDM_") for n in pf.names()):
+        from pint_tpu.models.solar_wind import SolarWindDispersionX
+
+        components.append(SolarWindDispersionX())
     if "SIFUNC" in pf:
         components.append(_build_ifunc(pf, consumed))
     if any(n.startswith("PWEP_") for n in pf.names()):
@@ -166,6 +169,7 @@ def build_model(pf: ParFile) -> TimingModel:
         from pint_tpu.models.binary import make_binary_component
 
         components.append(make_binary_component(binary.upper(), pf))
+        meta["BINARY"] = binary.upper()
         consumed.add("BINARY")
 
     # noise components by parameter presence (reference model_builder
@@ -205,6 +209,13 @@ def build_model(pf: ParFile) -> TimingModel:
     for comp in model.components:
         if isinstance(comp, DispersionDMX):
             _collect_dmx(comp, pf, model, consumed)
+
+    # SWX segments (SWXDM/SWXP/SWXR1/SWXR2 quadruples)
+    from pint_tpu.models.solar_wind import SolarWindDispersionX
+
+    for comp in model.components:
+        if isinstance(comp, SolarWindDispersionX):
+            _collect_swx(comp, pf, model, consumed)
 
     # deferred multi-token lines (WAVEk pairs, IFUNCk mjd/value triples)
     from pint_tpu.models.ifunc import IFunc
@@ -446,6 +457,34 @@ def _collect_dmx(comp: DispersionDMX, pf: ParFile, model: TimingModel, consumed:
         consumed |= {f"DMX_{i:04d}", f"DMXR1_{i:04d}", f"DMXR2_{i:04d}"}
 
 
+def _collect_swx(comp, pf: ParFile, model: TimingModel, consumed: set):
+    """SWXDM_nnnn / SWXP_nnnn / SWXR1_nnnn / SWXR2_nnnn quadruples
+    (reference SolarWindDispersionX, solar_wind_dispersion.py:522)."""
+    idxs = sorted(
+        int(n[6:]) for n in pf.names() if n.startswith("SWXDM_") and n[6:].isdigit()
+    )
+    for i in idxs:
+        r1 = pf.get(f"SWXR1_{i:04d}")
+        r2 = pf.get(f"SWXR2_{i:04d}")
+        if r1 is None or r2 is None:
+            raise ValueError(f"SWXDM_{i:04d} missing SWXR1/SWXR2 range")
+        comp.add_swx_range(i, float(r1), float(r2))
+        _store_param(model, comp.specs[f"SWXDM_{i:04d}"],
+                     pf.get_all(f"SWXDM_{i:04d}")[0])
+        if f"SWXP_{i:04d}" in pf:
+            _store_param(model, comp.specs[f"SWXP_{i:04d}"],
+                         pf.get_all(f"SWXP_{i:04d}")[0])
+        else:
+            model.params[f"SWXP_{i:04d}"] = comp.specs[f"SWXP_{i:04d}"].default
+            from pint_tpu.models.parameter import ParamValueMeta
+
+            model.param_meta[f"SWXP_{i:04d}"] = ParamValueMeta(
+                spec=comp.specs[f"SWXP_{i:04d}"]
+            )
+        consumed |= {f"SWXDM_{i:04d}", f"SWXP_{i:04d}",
+                     f"SWXR1_{i:04d}", f"SWXR2_{i:04d}"}
+
+
 # --- parfile output ------------------------------------------------------------
 
 
@@ -467,13 +506,15 @@ def model_to_parfile(model: TimingModel) -> str:
         lines.append(("PLANET_SHAPIRO", "Y" if meta["PLANET_SHAPIRO"] else "N"))
 
     mask_lines: dict[str, list[str]] = {}
+    exclude: set[str] = set()
     for comp in model.components:
         for mp in comp.mask_params:
             mask_lines[mp.name] = mp.clause.as_parfile_tokens()
+        exclude |= comp.parfile_exclude()
 
     for name, pm in model.param_meta.items():
         v = model.params.get(name)
-        if v is None:
+        if v is None or name in exclude:
             continue
         spec = pm.spec
         fit = "0" if pm.frozen else "1"
@@ -486,6 +527,23 @@ def model_to_parfile(model: TimingModel) -> str:
         val = _value_str(spec, v)
         unc = f" {pm.uncertainty / spec.scale:.6g}" if pm.uncertainty else ""
         lines.append((name, f"{val} {fit}{unc}"))
+
+    # static-config params (SWM, NHARMS, TNREDC, ...) live in model.meta;
+    # emit them from the owning component's specs (ECL/UNITS handled above,
+    # SIFUNC written by IFunc itself)
+    done = {k for k, _ in lines} | {"SIFUNC", "NHARMS"}
+    for comp in model.components:
+        for spec in comp.specs.values():
+            if (not spec.is_fittable and spec.name in meta
+                    and spec.name not in done):
+                v = meta[spec.name]
+                if isinstance(v, bool):
+                    v = "Y" if v else "N"
+                lines.append((spec.name, str(v)))
+                done.add(spec.name)
+
+    for comp in model.components:
+        lines.extend(comp.extra_parfile_lines(model))
 
     if model.has_abs_phase:
         lines.append(("TZRMJD", meta.get("TZRMJD_STR", "")))

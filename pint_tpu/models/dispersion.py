@@ -171,6 +171,14 @@ class DispersionDMX(DelayComponent):
         cols["dmx_onehot"] = onehot
         return cols
 
+    def extra_parfile_lines(self, model):
+        out = []
+        for i in self.sorted_indices:
+            r1, r2 = self.windows[i]
+            out.append((f"DMXR1_{i:04d}", f"{r1:.10f}"))
+            out.append((f"DMXR2_{i:04d}", f"{r2:.10f}"))
+        return out
+
     def dmx_dm(self, params: dict, tensor: dict) -> Array:
         vals = jnp.stack([params[f"DMX_{i:04d}"] for i in self.sorted_indices])
         return tensor["dmx_onehot"] @ vals

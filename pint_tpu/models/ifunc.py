@@ -42,6 +42,18 @@ class IFunc(PhaseComponent):
             f"IFUNC{k}", unit="s", description=f"time-offset node {k}"
         )
 
+    def parfile_exclude(self):
+        return {f"IFUNC{k}" for k in range(1, len(self.node_mjds) + 1)}
+
+    def extra_parfile_lines(self, model):
+        import numpy as np
+
+        out = [("SIFUNC", f"{self.itype} 0")]
+        for k, mjd in enumerate(self.node_mjds, start=1):
+            v = float(np.asarray(model.params[f"IFUNC{k}"]))
+            out.append((f"IFUNC{k}", f"{mjd:.8f} {v:.12g} 0.0"))
+        return out
+
     def validate(self, params, meta):
         self.itype = int(meta.get("SIFUNC", 2))
         if self.itype not in (0, 2):

@@ -217,6 +217,30 @@ class ParamSpec:
 
 
 @dataclass
+class FuncParamSpec:
+    """Read-only DERIVED parameter: a named function of other parameters
+    (reference funcParameter, parameter.py:2166 — e.g. DDS exposes SINI
+    computed from SHAPMAX, DDGR its GR-derived post-Keplerian set).
+
+    `func` maps the f64 values of `inputs` (in internal units, in order) to
+    the derived value in internal units. Evaluated on demand via
+    TimingModel.get_derived; never part of the fit pytree.
+    """
+
+    name: str
+    inputs: tuple[str, ...]
+    func: Callable[..., float]
+    description: str = ""
+    unit: str = ""
+
+    def value(self, params: dict) -> float:
+        from pint_tpu.models.base import leaf_to_f64
+
+        args = [float(np.asarray(leaf_to_f64(params[n]))) for n in self.inputs]
+        return float(np.asarray(self.func(*args)))
+
+
+@dataclass
 class PrefixSpec:
     """A family of numbered parameters (F0..Fn, DM1.., GLEP_1..; reference
     prefixParameter, parameter.py:1301). `make` builds the concrete spec for

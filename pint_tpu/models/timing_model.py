@@ -75,6 +75,83 @@ class TimingModel:
         for k in self._JIT_CACHES:
             self.__dict__.pop(k, None)
 
+    def add_component(self, component: Component, params: dict | None = None,
+                      validate: bool = True) -> None:
+        """Insert a component into the chain at its DEFAULT_ORDER slot
+        (reference TimingModel.add_component, timing_model.py:1030).
+
+        `params` maps parameter names to values — parfile strings (parsed
+        through the spec) or internal-unit values. Params with spec defaults
+        are filled in automatically.
+        """
+        if component.name in self:
+            raise ValueError(f"component {component.name} already in model")
+        order = {cat: i for i, cat in enumerate(DEFAULT_ORDER)}
+        self.components.append(component)
+        self.components.sort(key=lambda c: order.get(c.category, 99))
+        for n, v in component.default_params().items():
+            if n not in self.params:
+                self.params[n] = v
+                self.param_meta[n] = ParamValueMeta(spec=component.specs[n])
+        if params:
+            for n, v in params.items():
+                spec = component.specs.get(n)
+                if spec is None:
+                    raise KeyError(f"{component.name} has no parameter {n}")
+                self.params[n] = spec.parse(v) if isinstance(v, str) else v
+                self.param_meta.setdefault(n, ParamValueMeta(spec=spec))
+        if validate:
+            component.validate(self.params, self.meta)
+        self.clear_caches()
+
+    def remove_component(self, name: str) -> Component:
+        """Remove a component and every parameter it owns (reference
+        TimingModel.remove_component, timing_model.py:1086)."""
+        comp = self[name]  # raises KeyError if absent
+        self.components.remove(comp)
+        owned = set(comp.specs) | {mp.name for mp in comp.mask_params}
+        for n in owned:
+            self.params.pop(n, None)
+            self.param_meta.pop(n, None)
+        self.clear_caches()
+        return comp
+
+    @property
+    def derived_params(self) -> dict:
+        """name -> FuncParamSpec of every component-exposed derived
+        parameter (reference funcParameter surface)."""
+        out = {}
+        for c in self.components:
+            for fp in c.func_param_specs():
+                out[fp.name] = fp
+        return out
+
+    def get_derived(self, name: str) -> float:
+        """Evaluate a derived (funcParameter-style) quantity; falls back to
+        the plain parameter value when `name` is a real parameter."""
+        fps = self.derived_params
+        if name in fps:
+            return fps[name].value(self.params)
+        if name in self.params:
+            from pint_tpu.models.base import leaf_to_f64
+
+            return float(np.asarray(leaf_to_f64(self.params[name])))
+        raise KeyError(f"no parameter or derived quantity {name}")
+
+    def as_ECL(self) -> "TimingModel":
+        """New model with ecliptic astrometry (reference as_ECL,
+        timing_model.py:2647)."""
+        from pint_tpu.models.astrometry import model_as_ECL
+
+        return model_as_ECL(self)
+
+    def as_ICRS(self) -> "TimingModel":
+        """New model with equatorial astrometry (reference as_ICRS,
+        timing_model.py:2697)."""
+        from pint_tpu.models.astrometry import model_as_ICRS
+
+        return model_as_ICRS(self)
+
     def __getitem__(self, name: str) -> Component:
         for c in self.components:
             if c.name == name:

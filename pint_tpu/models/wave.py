@@ -49,6 +49,19 @@ class Wave(PhaseComponent):
             self.term_indices.append(k)
             self.term_indices.sort()
 
+    def parfile_exclude(self):
+        return {f"WAVE{k}{t}" for k in self.term_indices for t in ("A", "B")}
+
+    def extra_parfile_lines(self, model):
+        import numpy as np
+
+        out = []
+        for k in self.term_indices:
+            a = float(np.asarray(model.params[f"WAVE{k}A"]))
+            b = float(np.asarray(model.params[f"WAVE{k}B"]))
+            out.append((f"WAVE{k}", f"{a:.17g} {b:.17g}"))
+        return out
+
     def validate(self, params, meta):
         if self.num_terms and "WAVE_OM" not in params:
             raise ValueError("WAVE terms need WAVE_OM")

@@ -213,6 +213,54 @@ class Residuals:
             n -= 1
         return n
 
+    def ecorr_average(self, use_noise_model: bool = True) -> dict:
+        """Epoch-averaged residuals over the ECORR time-binning (reference
+        Residuals.ecorr_average, residuals.py:524) — the NANOGrav summary-
+        plot representation.
+
+        Returns a dict with 'mjds', 'freqs', 'time_resids' (weighted
+        averages per epoch), 'errors' (sqrt(1/sum w + ECORR^2) when
+        `use_noise_model`, raw-weight errors otherwise) and 'indices'
+        (TOA index lists per epoch). TOAs outside every ECORR epoch are
+        excluded, exactly like the reference's U-matrix projection.
+        """
+        from pint_tpu.models.base import leaf_to_f64
+
+        comps = [c for c in self.model.noise_components
+                 if c.category == "ecorr_noise"]
+        if not comps:
+            raise ValueError("ECORR not present in noise model")
+        n = len(self.raw_errors_s)  # data rows (tensor may add a TZR row)
+        eidx = np.asarray(self.tensor["ecorr_eidx"])[:n].astype(int)
+        widx = np.asarray(self.tensor["ecorr_widx"])[0].astype(int)
+        ke = widx.size
+        if ke == 0:
+            raise ValueError("no ECORR epoch has >= 2 selected TOAs")
+        vals = np.array([
+            float(np.asarray(leaf_to_f64(self.model.params[mp.name])))
+            for mp in comps[0].mask_params
+        ])
+        ecorr_err2 = vals[widx] ** 2 if use_noise_model else np.zeros(ke)
+
+        err = self.errors_s if use_noise_model else self.raw_errors_s
+        err = np.asarray(err)[:n]
+        sel = eidx >= 0
+        wt = np.where(sel, 1.0 / err**2, 0.0)
+        idx = np.where(sel, eidx, 0)
+        a_norm = np.bincount(idx, weights=wt, minlength=ke)
+
+        def wtsum(x):
+            return np.bincount(idx, weights=wt * np.asarray(x)[:n],
+                               minlength=ke) / a_norm
+
+        return {
+            "mjds": wtsum(self.toas.tdb.mjd_float()),
+            "freqs": wtsum(self.toas.freq_mhz),
+            "time_resids": wtsum(self.time_resids),
+            "errors": np.sqrt(1.0 / a_norm + ecorr_err2),
+            "indices": [np.flatnonzero(eidx == i) for i in range(ke)],
+        }
+
     @property
     def reduced_chi2(self) -> float:
         return self.calc_chi2() / self.dof

@@ -204,3 +204,154 @@ class TestEcliptic:
         dec = np.arcsin(pole[2])
         assert ra == pytest.approx(1.5 * np.pi, abs=1e-12)
         assert np.degrees(dec) == pytest.approx(90 - 23.4392794, abs=1e-4)
+
+
+class TestModelAlgebra:
+    """add_component / remove_component / as_ECL / as_ICRS / derived params
+    (reference timing_model.py:1030,1086,2647,2697; parameter.py:2166)."""
+
+    def _model_and_toas(self, par=SIMPLE_PAR, ntoas=30):
+        from pint_tpu.simulation import make_fake_toas_uniform
+
+        m = build_model(parse_parfile(par, from_text=True))
+        toas = make_fake_toas_uniform(
+            54500, 55500, ntoas, m, obs="gbt",
+            freq_mhz=np.where(np.arange(ntoas) % 2 == 0, 1400.0, 800.0),
+            error_us=1.0, add_noise=True, rng=np.random.default_rng(8),
+        )
+        return m, toas
+
+    def test_add_remove_component(self):
+        from pint_tpu.models.frequency_dependent import FD, _fd_spec
+        from pint_tpu.residuals import Residuals
+
+        m, toas = self._model_and_toas()
+        r0 = Residuals(toas, m).time_resids
+        assert "FD" not in m
+        fd = FD()
+        fd.add_prefix_param(_fd_spec(1))
+        m.add_component(fd, params={"FD1": 1e-4})
+        assert "FD" in m
+        assert float(np.asarray(m.params["FD1"])) == 1e-4
+        r1 = Residuals(toas, m).time_resids
+        # FD1 changes the residuals (frequency-dependent delay now present)
+        assert np.max(np.abs(np.asarray(r1) - np.asarray(r0))) > 1e-8
+        removed = m.remove_component("FD")
+        assert removed is fd
+        assert "FD1" not in m.params and "FD" not in m
+        r2 = Residuals(toas, m).time_resids
+        np.testing.assert_allclose(np.asarray(r2), np.asarray(r0), atol=1e-12)
+
+    def test_add_duplicate_rejected(self):
+        from pint_tpu.models.frequency_dependent import FD, _fd_spec
+
+        m, _ = self._model_and_toas(ntoas=4)
+        fd = FD(); fd.add_prefix_param(_fd_spec(1))
+        m.add_component(fd, params={"FD1": 0.0})
+        with pytest.raises(ValueError, match="already in model"):
+            m.add_component(FD())
+
+    def test_ecl_icrs_round_trip(self):
+        m, _ = self._model_and_toas(ntoas=4)
+        m.param_meta["RAJ"].uncertainty = 1e-8
+        m.param_meta["DECJ"].uncertainty = 2e-8
+        ecl = m.as_ECL()
+        assert ecl.astrometry.name == "AstrometryEcliptic"
+        assert "ELONG" in ecl.params and "RAJ" not in ecl.params
+        back = ecl.as_ICRS()
+        for n in ("RAJ", "DECJ", "PMRA", "PMDEC", "PX"):
+            np.testing.assert_allclose(
+                float(np.asarray(back.params[n])),
+                float(np.asarray(m.params[n])), rtol=0, atol=1e-12,
+            )
+        # free flags survive the round trip; uncertainties stay the right
+        # order (quadrature through a rotation drops the cross-covariance,
+        # so exact round-trip is impossible — the reference loses it too)
+        assert not back.param_meta["RAJ"].frozen
+        assert 0.5e-8 < back.param_meta["RAJ"].uncertainty < 4e-8
+        assert 1e-8 < back.param_meta["DECJ"].uncertainty < 5e-8
+
+    def test_residuals_frame_invariant(self):
+        """The SAME sky position expressed in either frame must produce the
+        same delays."""
+        from pint_tpu.residuals import Residuals
+
+        m, toas = self._model_and_toas()
+        r_icrs = np.asarray(Residuals(toas, m).time_resids)
+        ecl = m.as_ECL()
+        r_ecl = np.asarray(Residuals(toas, ecl).time_resids)
+        np.testing.assert_allclose(r_ecl, r_icrs, atol=2e-9)
+
+    def test_fit_consistency_across_frames(self):
+        """Fit in ICRS == fit in ECL (reference as_ECL contract)."""
+        from pint_tpu.fitting import WLSFitter
+
+        m, toas = self._model_and_toas(ntoas=60)
+        ecl = m.as_ECL()
+        res_i = WLSFitter(toas, m).fit_toas(maxiter=3)
+        res_e = WLSFitter(toas, ecl).fit_toas(maxiter=3)
+        np.testing.assert_allclose(res_e.chi2, res_i.chi2, rtol=1e-6)
+        # the fitted sky position agrees when mapped back
+        back = ecl.as_ICRS()
+        for n in ("RAJ", "DECJ"):
+            a = float(np.asarray(back.params[n]))
+            b = float(np.asarray(m.params[n]))
+            assert abs(a - b) < 5 * res_i.uncertainties[n]
+
+    def test_ddgr_derived_params(self):
+        par = """
+PSR FAKEGR
+RAJ 05:00:00 1
+DECJ 20:00:00 1
+F0 50.0 1
+F1 -1e-15
+PEPOCH 55000
+DM 20.0
+BINARY DDGR
+PB 0.3
+A1 2.0
+ECC 0.17
+OM 90.0
+T0 55000.0
+MTOT 2.8
+M2 1.3
+TZRMJD 55000.1
+TZRSITE @
+TZRFRQ 0.0
+"""
+        m = build_model(parse_parfile(par, from_text=True))
+        dp = m.derived_params
+        for k in ("OMDOT", "GAMMA", "PBDOT", "SINI", "DR", "DTH"):
+            assert k in dp
+        # Hulse-Taylor-like system: omdot ~ 4.2 deg/yr
+        from pint_tpu.models.parameter import DEG_TO_RAD
+        from pint_tpu import SECS_PER_JULIAN_YEAR
+
+        omdot = m.get_derived("OMDOT") / DEG_TO_RAD * SECS_PER_JULIAN_YEAR
+        assert 2.0 < omdot < 8.0
+        assert m.get_derived("PBDOT") < 0  # GW decay shrinks the orbit
+        assert 0 < m.get_derived("SINI") <= 1.0
+
+    def test_dds_derived_sini(self):
+        par = """
+PSR FAKEDDS
+RAJ 05:00:00 1
+DECJ 20:00:00 1
+F0 50.0 1
+PEPOCH 55000
+DM 20.0
+BINARY DDS
+PB 10.0
+A1 20.0
+ECC 0.01
+OM 90.0
+T0 55000.0
+SHAPMAX 3.0
+M2 0.3
+TZRMJD 55000.1
+TZRSITE @
+TZRFRQ 0.0
+"""
+        m = build_model(parse_parfile(par, from_text=True))
+        np.testing.assert_allclose(
+            m.get_derived("SINI"), 1.0 - np.exp(-3.0), rtol=1e-12)

@@ -287,3 +287,49 @@ class TestB1855GLSBuild:
         ecorrs = [n for n in m.params if n.startswith("ECORR")]
         assert len(efacs) == 4 and len(equads) == 4 and len(ecorrs) == 4
         assert all(m.param_meta[n].frozen for n in efacs + equads + ecorrs)
+
+    def test_full_cov_matches_woodbury(self):
+        """Dense-Cholesky GLS (reference fitter.py:2177 full_cov) must
+        reproduce the structured-Woodbury fit exactly on a small set."""
+        import copy
+
+        m = _model("ECORR -f be1 2.0\nTNREDAMP -12.5\nTNREDGAM 3.0\nTNREDC 8\n")
+        toas = _epoch_toas(m, n_epochs=30, per_epoch=3, error_us=1.0)
+        for f in toas.flags:
+            f["f"] = "be1"
+        from pint_tpu.simulation import add_noise_from_model
+
+        toas = add_noise_from_model(toas, m, rng=np.random.default_rng(21))
+        m2 = copy.deepcopy(m)
+        r1 = GLSFitter(toas, m).fit_toas(maxiter=3)
+        r2 = GLSFitter(toas, m2).fit_toas(maxiter=3, full_cov=True)
+        np.testing.assert_allclose(r2.chi2, r1.chi2, rtol=1e-8)
+        for n in r1.uncertainties:
+            np.testing.assert_allclose(
+                r2.uncertainties[n], r1.uncertainties[n], rtol=1e-6)
+            from pint_tpu.models.base import leaf_to_f64
+
+            a = float(np.asarray(leaf_to_f64(m.params[n])))
+            b = float(np.asarray(leaf_to_f64(m2.params[n])))
+            assert abs(a - b) <= 1e-6 * max(abs(a), 1e-12) + 1e-3 * r1.uncertainties[n]
+
+    def test_ecorr_average(self):
+        """Epoch-averaged residuals (reference residuals.py:524)."""
+        m = _model("ECORR -f be1 0.5\nEFAC -f be1 1.2\n")
+        toas = _epoch_toas(m, n_epochs=20, per_epoch=3, error_us=1.0)
+        for f in toas.flags:
+            f["f"] = "be1"
+        r = Residuals(toas, m)
+        avg = r.ecorr_average()
+        assert len(avg["mjds"]) == 20
+        assert all(len(ix) == 3 for ix in avg["indices"])
+        # error: sqrt(1/(3 w) + ecorr^2) with w = 1/(1.2 us)^2
+        exp = np.sqrt((1.2e-6) ** 2 / 3 + (0.5e-6) ** 2)
+        np.testing.assert_allclose(avg["errors"], exp, rtol=1e-10)
+        # averaged resids equal the plain mean here (equal weights)
+        resh = np.asarray(r.time_resids).reshape(20, 3)
+        np.testing.assert_allclose(avg["time_resids"], resh.mean(axis=1),
+                                   rtol=0, atol=1e-15)
+        # raw-weight variant drops the ECORR term
+        avg2 = r.ecorr_average(use_noise_model=False)
+        np.testing.assert_allclose(avg2["errors"], 1e-6 / np.sqrt(3), rtol=1e-10)

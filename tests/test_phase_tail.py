@@ -209,3 +209,118 @@ class TestTroposphere:
         diff = np.abs(r1 - r0)
         assert diff.max() > 1e-9  # it does something
         assert diff.max() < 1e-6  # and stays at the tropospheric scale
+
+
+class TestSolarWindGeneral:
+    """SWM 1 + SWX (reference solar_wind_dispersion.py:265 SWM1, :522 SWX)."""
+
+    def test_geometry_matches_hypergeometric(self):
+        """The Gauss-Legendre geometry must agree with the reference's
+        scipy hyp2f1 formulation (solar_wind_dispersion.py:164-199)."""
+        import scipy.special as sp
+        from pint_tpu.models.solar_wind import AU_LS, PC_LS, sw_geometry_pc
+
+        def ref_geometry_pc(r_ls, theta, p):
+            b = r_ls * np.sin(theta)
+            z_sun = r_ls * np.cos(theta)
+            z_p = 1e14  # the reference/enterprise finite "infinity"
+
+            def dm_p_int(b, z, p):
+                return (z / b) * sp.hyp2f1(0.5, p / 2.0, 1.5, -(z**2) / b**2)
+
+            # our quadrature integrates to TRUE infinity; add the tail the
+            # reference truncates: int_{zp/b}^inf (1+t^2)^(-p/2) dt
+            tail = (z_p / b) ** (1.0 - p) / (p - 1.0)
+            return (
+                (AU_LS / b) ** p * b
+                * (dm_p_int(b, z_p, p) - dm_p_int(b, -z_sun, p) + tail)
+            ) / PC_LS
+
+        rng = np.random.default_rng(3)
+        for p in (1.5, 1.6, 2.0, 2.5, 3.7, 5.0):
+            thetas = rng.uniform(0.05, np.pi - 0.05, 12)
+            rs = rng.uniform(0.8, 1.2, 12) * AU_LS
+            got = np.asarray(sw_geometry_pc(rs, thetas, p))
+            want = ref_geometry_pc(rs, thetas, p)
+            np.testing.assert_allclose(got, want, rtol=2e-8)
+
+    def test_swm1_p2_matches_swm0(self):
+        m0 = _model("NE_SW 8.0\n")
+        m1 = _model("NE_SW 8.0\nSWM 1\nSWP 2.0\n")
+        toas = _toas(m0, n=40)
+        t0 = m0.build_tensor(toas)
+        t1 = m1.build_tensor(toas)
+        dm0 = np.asarray(m0["SolarWindDispersion"].solar_wind_dm(
+            m0.params, m0._with_context(m0.params, t0)))
+        dm1 = np.asarray(m1["SolarWindDispersion"].solar_wind_dm(
+            m1.params, m1._with_context(m1.params, t1)))
+        np.testing.assert_allclose(dm1, dm0, rtol=1e-8)
+
+    def test_swm1_steeper_wind_falls_faster(self):
+        """Higher p concentrates the wind at the Sun: smaller DM away from
+        conjunction relative to the peak."""
+        m = _model("NE_SW 8.0\nSWM 1\nSWP 3.0\n")
+        m2 = _model("NE_SW 8.0\nSWM 1\nSWP 2.0\n")
+        toas = _toas(m, n=80)
+        dm3 = np.asarray(m["SolarWindDispersion"].solar_wind_dm(
+            m.params, m._with_context(m.params, m.build_tensor(toas))))
+        dm2 = np.asarray(m2["SolarWindDispersion"].solar_wind_dm(
+            m2.params, m2._with_context(m2.params, m2.build_tensor(toas))))
+        assert (dm3.max() / dm3.min()) > (dm2.max() / dm2.min())
+
+    def test_swx_segments_bind_and_scale(self):
+        extra = (
+            "SWXDM_0001 0.005 1\nSWXP_0001 2.0\n"
+            "SWXR1_0001 55000\nSWXR2_0001 55500\n"
+            "SWXDM_0002 0.010 1\nSWXP_0002 2.5\n"
+            "SWXR1_0002 55500\nSWXR2_0002 56001\n"
+        )
+        m = _model(extra)
+        assert "SolarWindDispersionX" in m.component_names
+        toas = _toas(m, n=100)
+        tensor = m._with_context(m.params, m.build_tensor(toas))
+        comp = m["SolarWindDispersionX"]
+        dm = np.asarray(comp.swx_dm(m.params, tensor))[:-1]
+        mjd = toas.tdb.mjd_float()
+        # every TOA falls in exactly one segment; Delta DM >= 0 (zero at
+        # opposition by construction) and bounded by the segment max
+        assert (dm >= -1e-12).all()
+        assert dm[mjd < 55500].max() <= 0.005 + 1e-9
+        assert dm[mjd >= 55500].max() <= 0.010 + 1e-9
+        # the SWXDM columns are fittable linear-ish params: a WLS fit runs
+        from pint_tpu.fitting import WLSFitter
+
+        toas2 = _toas(m, n=100, add_noise=True, rng=np.random.default_rng(2))
+        res = WLSFitter(toas2, m).fit_toas(maxiter=3)
+        assert np.isfinite(res.chi2)
+
+    def test_swx_parfile_round_trip(self):
+        extra = (
+            "SWXDM_0001 0.005 1\nSWXP_0001 2.2\n"
+            "SWXR1_0001 55000\nSWXR2_0001 56001\n"
+        )
+        m = _model(extra)
+        text = m.as_parfile()
+        m2 = build_model(parse_parfile(text, from_text=True))
+        assert "SolarWindDispersionX" in m2.component_names
+        assert m2["SolarWindDispersionX"].windows[1] == (55000.0, 56001.0)
+        np.testing.assert_allclose(
+            float(np.asarray(m2.params["SWXDM_0001"])), 0.005, rtol=1e-10)
+        np.testing.assert_allclose(
+            float(np.asarray(m2.params["SWXP_0001"])), 2.2, rtol=1e-10)
+
+    def test_dmx_wave_parfile_round_trip(self):
+        """DMX windows and WAVE pairs must survive as_parfile -> rebuild
+        (the window/multi-token lines are component-owned output)."""
+        extra = (
+            "DMX_0001 0.001 1\nDMXR1_0001 55000\nDMXR2_0001 55400\n"
+            "WAVE_OM 0.01\nWAVEEPOCH 55500\nWAVE1 0.1 -0.2\n"
+        )
+        m = _model(extra)
+        text = m.as_parfile()
+        m2 = build_model(parse_parfile(text, from_text=True))
+        assert m2["DispersionDMX"].windows[1] == (55000.0, 55400.0)
+        np.testing.assert_allclose(
+            float(np.asarray(m2.params["WAVE1A"])), 0.1, rtol=1e-12)
+        np.testing.assert_allclose(
+            float(np.asarray(m2.params["WAVE1B"])), -0.2, rtol=1e-12)

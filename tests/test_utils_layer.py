@@ -352,3 +352,101 @@ class TestPosVel:
         )
         t2 = get_TOAs(str(master), usepickle=True)
         assert len(t2) == 3  # stale cache would have returned 2
+
+
+class TestBinaryConvertExtended:
+    """Uncertainty propagation + DDS/DDK/DDGR support (reference
+    binaryconvert.py:536 and its `uncertainties`-package threading)."""
+
+    DD_PAR = PAR.replace("PSR UTILFAKE", "PSR BCDD") + """
+BINARY DD
+PB 10.0 1 1e-6
+A1 5.0 1 1e-5
+T0 55490.0 1 1e-4
+ECC 0.01 1 1e-6
+OM 45.0 1 0.01
+M2 0.25 1 0.02
+SINI 0.95 1 0.005
+"""
+
+    def test_uncertainty_propagation_ell1(self):
+        import copy
+
+        from pint_tpu.binaryconvert import convert_binary
+
+        m = build_model(parse_parfile(self.DD_PAR, from_text=True))
+        m2 = convert_binary(copy.deepcopy(m), "ELL1")
+        s1 = m2.param_meta["EPS1"].uncertainty
+        s2 = m2.param_meta["EPS2"].uncertainty
+        st = m2.param_meta["TASC"].uncertainty
+        assert s1 is not None and s2 is not None and st is not None
+        # analytic: eps1 = e sin w -> sigma^2 = (sin w * se)^2 + (e cos w * sw)^2
+        e, w = 0.01, np.deg2rad(45.0)
+        se, sw = 1e-6, np.deg2rad(0.01)
+        np.testing.assert_allclose(
+            s1, np.hypot(np.sin(w) * se, e * np.cos(w) * sw), rtol=1e-10)
+        np.testing.assert_allclose(
+            s2, np.hypot(np.cos(w) * se, e * np.sin(w) * sw), rtol=1e-10)
+        # round trip keeps the right order (diagonal propagation drops
+        # cross-covariance, so exact inversion is impossible — same as the
+        # reference's independent-ufloat bookkeeping)
+        m3 = convert_binary(m2, "DD")
+        assert 0.5 * se < m3.param_meta["ECC"].uncertainty < 2.5 * se
+        assert 0.5 * sw < m3.param_meta["OM"].uncertainty < 2.5 * sw
+
+    def test_dds_ddk_targets(self):
+        import copy
+
+        from pint_tpu.binaryconvert import convert_binary
+        from pint_tpu.residuals import Residuals
+
+        m = build_model(parse_parfile(self.DD_PAR, from_text=True))
+        toas = make_fake_toas_uniform(55400, 55600, 30, m, freq_mhz=1400.0)
+        r0 = Residuals(toas, m, subtract_mean=False).time_resids
+
+        dds = convert_binary(copy.deepcopy(m), "DDS")
+        assert "SHAPMAX" in dds.params and "SINI" not in dds.params
+        np.testing.assert_allclose(
+            float(np.asarray(dds.params["SHAPMAX"])), -np.log(1 - 0.95),
+            rtol=1e-12)
+        # sigma(SHAPMAX) = s_sini / (1 - sini)
+        np.testing.assert_allclose(
+            dds.param_meta["SHAPMAX"].uncertainty, 0.005 / 0.05, rtol=1e-9)
+        r1 = Residuals(toas, dds, subtract_mean=False).time_resids
+        np.testing.assert_allclose(r1, r0, atol=1e-10)
+
+        ddk = convert_binary(copy.deepcopy(m), "DDK", kom_deg=90.0)
+        assert "KIN" in ddk.params and "KOM" in ddk.params
+        np.testing.assert_allclose(
+            float(np.asarray(ddk.params["KIN"])), np.arcsin(0.95), rtol=1e-12)
+        back = convert_binary(ddk, "DD")
+        np.testing.assert_allclose(
+            float(np.asarray(back.params["SINI"])), 0.95, rtol=1e-12)
+
+    def test_ddgr_input(self):
+        from pint_tpu.binaryconvert import convert_binary
+
+        par = PAR.replace("PSR UTILFAKE", "PSR BCGR") + """
+BINARY DDGR
+PB 0.4 1
+A1 2.0 1
+ECC 0.17 1
+OM 90.0 1
+T0 55490.0 1
+MTOT 2.8 1 0.01
+M2 1.3 1 0.01
+"""
+        m = build_model(parse_parfile(par, from_text=True))
+        dd = convert_binary(m, "DD")
+        assert dd.meta["BINARY"] == "DD"
+        for k in ("OMDOT", "GAMMA", "PBDOT", "SINI"):
+            assert k in dd.params, k
+            assert dd.param_meta[k].uncertainty is not None, k
+        # OMDOT of a Hulse-Taylor-like system: a few deg/yr, positive
+        from pint_tpu import SECS_PER_JULIAN_YEAR
+        from pint_tpu.models.parameter import DEG_TO_RAD
+
+        omdot = float(np.asarray(dd.params["OMDOT"])) / DEG_TO_RAD * SECS_PER_JULIAN_YEAR
+        assert 1.5 < omdot < 3.0  # ~1.87 deg/yr for PB=0.4 d, e=0.17, 2.8 Msun
+        with pytest.raises(NotImplementedError):
+            convert_binary(dd, "DDGR")
