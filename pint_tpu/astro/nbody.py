@@ -26,6 +26,22 @@ So the built-in ephemeris is refined dynamically:
 The reference gets all of this from JPL DE kernels
 (solar_system_ephemerides.py:133); this module is the zero-data
 environment's substitute, validated against pulsar timing golden fits.
+
+Measured accuracy vs DE421 (via TEMPO2's golden roemer column on the
+J1744-1134 8-yr GASP set, tests/test_tempo2_columns.py):
+
+- total Earth-position disagreement ~540 km RMS projected on the line of
+  sight, dominated by multi-year drift that a timing fit absorbs;
+- anchored bands: annual ~35 km, semi-annual ~16 km, 1/3-yr ~11 km;
+- lunar bands: anomalistic month ~115 km, sidereal ~50 km;
+- broadband remainder ~50 km.
+
+The anchor BANDS are load-bearing: the 6-DOF-per-body IC fit is only
+constrained inside them, and the unconstrained combinations leak
+kilometer-scale errors into every neighboring band (round 2 anchored only
+the annual fundamental and paid a 2000 km semi-annual error = 450 us of
+unabsorbable postfit systematics; NGC6440E went from 171 us to 34 us
+postfit when the harmonic bands were added).
 """
 
 from __future__ import annotations
@@ -56,6 +72,11 @@ def _gm(body: str) -> float:
 
 _GMS = np.array([_gm(b) for b in _BODIES])
 _FIT_BODIES = ("earth", "moon")  # ICs refined against the analytic anchors
+
+# trusted anchor bands (see _build): annual harmonics for the Earth series,
+# sidereal + anomalistic month + first harmonic for the lunar series
+_ANCHOR_PERIODS_E = (365.25, 182.625, 121.75)
+_ANCHOR_PERIODS_M = (27.321662, 27.554550, 31.811940, 29.530589, 13.660831)
 
 
 def _accelerations(pos: np.ndarray, vel: np.ndarray) -> np.ndarray:
@@ -106,7 +127,7 @@ class NBodyEphemeris:
 
     #: bump when the integration/refinement algorithm changes — invalidates
     #: every cached solution on disk
-    _CACHE_VERSION = 1
+    _CACHE_VERSION = 5
 
     def __init__(self, base, t0_jcent: float, span_years: float = 16.0,
                  grid_days: float = 0.5, refine_iters: int = 3):
@@ -145,6 +166,7 @@ class NBodyEphemeris:
             repr((
                 self._CACHE_VERSION, round(self.t0, 10), round(self.half_span_s, 3),
                 self.grid_days, refine_iters, _BODIES, _GMS.tobytes(),
+                _ANCHOR_PERIODS_E, _ANCHOR_PERIODS_M,
                 type(self.base).__name__, probe.tobytes(),
             )).encode()
         ).hexdigest()[:24]
@@ -247,8 +269,14 @@ class NBodyEphemeris:
         """
         S = self.half_span_s
         tn = t / S
-        cols = [np.ones_like(t), tn, tn * tn]
-        dcols = [np.zeros_like(t), np.full_like(t, 1.0 / S), 2.0 * tn / S]
+        # polynomial to t^4: the integration accumulates t^3+ drift from
+        # force-model error (the Keplerian planets' ~1e5 km offsets exert
+        # slightly wrong tides); the analytic theory's secular content is
+        # good, so pin low frequencies to it through quartic order —
+        # t^3-scale Roemer drift is NOT absorbable by an F0/F1-only fit
+        cols = [np.ones_like(t), tn, tn * tn, tn**3, tn**4]
+        dcols = [np.zeros_like(t), np.full_like(t, 1.0 / S), 2.0 * tn / S,
+                 3.0 * tn**2 / S, 4.0 * tn**3 / S]
         for period_d in periods_d:
             w = 2 * np.pi / (period_d * DAY_S)
             s, c = np.sin(w * t), np.cos(w * t)
@@ -283,8 +311,15 @@ class NBodyEphemeris:
         #  2. GEOCENTRIC Moon vs the lunar series, secular + monthly (+
         #     first harmonic) — a pure lunar-theory quantity, free of any
         #     Earth-series contamination.
-        self._periods_e = (365.25,)
-        self._periods_m = (27.321662, 13.660831)
+        # The Earth anchor must cover the ANNUAL HARMONICS too: the IC fit
+        # has 6 degrees of freedom constrained only in-band, and the
+        # unconstrained combinations leak O(1e3 km) errors into the
+        # eccentricity harmonics (measured: a 2000 km semi-annual error vs
+        # DE421 when only the fundamental was anchored, while the VSOP
+        # series is good to ~10 km there). Monthly stays excluded (the
+        # integrated lunar wobble is better than any truncated series).
+        self._periods_e = _ANCHOR_PERIODS_E
+        self._periods_m = _ANCHOR_PERIODS_M
         G_e = self._band_design(fit_grid, self._periods_e)
         G_m = self._band_design(fit_grid, self._periods_m)
         T_grid = self.t0 + fit_grid / CENT_S

@@ -164,6 +164,7 @@ def convert_binary(model, target: str, kom_deg: float = 0.0):
     # --- DDGR input: materialize its PK set first, then treat as DD ----------
     if src == "DDGR":
         pk = _ddgr_to_pk(model)
+        xpbdot, s_xpbdot = _f(model, "XPBDOT", 0.0), _u(model, "XPBDOT")
         _drop(model, "MTOT", "XOMDOT", "XPBDOT")
         src = "DD"
         if target in _ELL1_LIKE or target in ("BT",):
@@ -175,6 +176,11 @@ def convert_binary(model, target: str, kom_deg: float = 0.0):
                 continue
             v, s = pk[k]
             if k in new.specs:
+                if k == "PBDOT" and xpbdot:
+                    # the engine applied PBDOT_GR + XPBDOT; the target
+                    # carries the excess explicitly
+                    _set(model, new, "XPBDOT", xpbdot, unc=s_xpbdot,
+                         frozen=True)
                 _set(model, new, k, v, unc=s, frozen=True)
             else:
                 # not in the target's spec table directly (SINI for a
@@ -357,6 +363,10 @@ def _to_h3_stigma(model, new):
     """(M2, SINI) -> orthometric (H3, STIGMA) in place."""
     m2, sini = _f(model, "M2"), _f(model, "SINI")
     if m2 and sini:
+        # the engine must evaluate the exact STIGMA form, not the
+        # truncated 3-harmonic H3-only expansion (the builder keys this
+        # off STIGMA presence; mirror it here)
+        new.h_mode = "stigma"
         (h3, stig), (sh, sst) = propagate(
             lambda m, s: (
                 TSUN_S * m * (s / (1 + jnp.sqrt(1 - s**2))) ** 3,

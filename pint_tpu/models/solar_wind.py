@@ -54,10 +54,14 @@ _GL_WH = _GL_W / 2.0
 def _K_half(theta, p):
     """int_0^theta sin^(p-2)(psi) dpsi for theta <= pi/2, Gauss-Legendre
     with psi = theta * tau^7 (the endpoint map regularizes psi^(p-2) at 0;
-    integrand ~ tau^(7p-8), smooth for p >= 10/7)."""
+    integrand ~ tau^(7p-8), smooth for p >= 10/7). theta and p broadcast
+    (per-TOA power-law indices, SWX)."""
+    theta, p = jnp.broadcast_arrays(
+        jnp.asarray(theta, jnp.float64), jnp.asarray(p, jnp.float64)
+    )
     tau = jnp.asarray(_GL_T)
     psi = theta[..., None] * tau**7
-    integ = jnp.sin(psi) ** (p - 2.0) * 7.0 * tau**6 * theta[..., None]
+    integ = jnp.sin(psi) ** (p[..., None] - 2.0) * 7.0 * tau**6 * theta[..., None]
     return jnp.sum(jnp.asarray(_GL_WH) * integ, axis=-1)
 
 
@@ -133,6 +137,14 @@ class SolarWindDispersion(DelayComponent):
             raise NotImplementedError(
                 f"solar wind model SWM {meta.get('SWM')} not implemented (SWM 0/1)"
             )
+        if swm == 1:
+            p = float(np.asarray(leaf_to_f64(params.get("SWP", 2.0))))
+            if p <= 1.25:
+                raise ValueError(
+                    f"SWP = {p} <= 1.25: outside the validity of the "
+                    "quadrature (and p <= 1 is unphysical in the reference "
+                    "too); keep SWP well above 1.25 when fitting it"
+                )
         self.swm = swm
 
     def solar_wind_dm(self, params: dict, tensor: dict) -> Array:
@@ -236,17 +248,23 @@ class SolarWindDispersionX(DelayComponent):
     def swx_dm(self, params: dict, tensor: dict) -> Array:
         theta, r = _elongation(tensor)
         th0 = _theta0(tensor)
-        dm = jnp.zeros_like(theta)
-        for j, i in enumerate(self.sorted_indices):
-            p = leaf_to_f64(params.get(f"SWXP_{i:04d}", 2.0))
-            g = sw_geometry_pc(r, theta, p)
-            g_conj = sw_geometry_pc(jnp.asarray([AU_LS]), th0[None], p)[0]
-            g_opp = sw_geometry_pc(jnp.asarray([AU_LS]), jnp.pi - th0[None], p)[0]
-            scale = (g - g_opp) / (g_conj - g_opp)
-            dm = dm + tensor["swx_onehot"][:, j] * leaf_to_f64(
-                params[f"SWXDM_{i:04d}"]
-            ) * scale
-        return dm
+        onehot = tensor["swx_onehot"]
+        p_vec = jnp.stack([
+            leaf_to_f64(params.get(f"SWXP_{i:04d}", 2.0))
+            for i in self.sorted_indices
+        ])
+        dm_vec = jnp.stack([
+            leaf_to_f64(params[f"SWXDM_{i:04d}"]) for i in self.sorted_indices
+        ])
+        # each TOA belongs to at most one segment: ONE quadrature pass with
+        # the per-TOA power-law index (out-of-segment rows use p=2, masked
+        # out below), plus per-segment scalar conjunction/opposition anchors
+        p_toa = onehot @ p_vec + (1.0 - jnp.sum(onehot, axis=1)) * 2.0
+        g = sw_geometry_pc(r, theta, p_toa)
+        g_conj = sw_geometry_pc(jnp.full_like(p_vec, AU_LS), th0, p_vec)
+        g_opp = sw_geometry_pc(jnp.full_like(p_vec, AU_LS), jnp.pi - th0, p_vec)
+        scale = (g[:, None] - g_opp) / (g_conj - g_opp)
+        return jnp.sum(onehot * dm_vec * scale, axis=1)
 
     def delay(self, params: dict, tensor: dict, delay_so_far: Array, xp) -> Array:
         from pint_tpu.models.dispersion import (

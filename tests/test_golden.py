@@ -71,9 +71,10 @@ class TestBinaryDelayParity:
 
 class TestEndToEndFitQuality:
     def test_ngc6440e_postfit(self, monkeypatch):
-        """NGC6440E full pipeline: postfit weighted RMS < 250 us, converged
-        (round-1 was 3,278 us; reference with DE421 reaches ~20 us;
-        measured now ~170 us — ephemeris-limited)."""
+        """NGC6440E full pipeline: postfit weighted RMS < 60 us, converged
+        (round-1 was 3,278 us; round-2 ~170 us; the round-3 N-body anchor
+        band fix brought it to ~34 us — the reference with DE421 reaches
+        ~20 us)."""
         monkeypatch.setenv("PINT_TPU_NBODY", "1")
         from pint_tpu.fitting import DownhillWLSFitter
         from pint_tpu.models.builder import get_model_and_toas
@@ -85,12 +86,14 @@ class TestEndToEndFitQuality:
         ftr = DownhillWLSFitter(t, m)
         res = ftr.fit_toas(maxiter=15)
         assert res.converged
-        assert ftr.resids.rms_weighted() * 1e6 < 250.0
+        assert ftr.resids.rms_weighted() * 1e6 < 60.0
 
     def test_b1855_tai_postfit(self, monkeypatch):
         """B1855+09 dfg+12 (DD binary, DMX, 60 jumps) full pipeline:
-        postfit weighted RMS < 500 us (TEMPO golden: 3.49 us; measured now
-        ~310 us — ephemeris-limited)."""
+        postfit weighted RMS < 350 us (TEMPO golden: 3.49 us; measured
+        ~244 us after the round-3 ephemeris fixes — the Arecibo sets still
+        carry a ~150 km broadband ephemeris residual, see
+        test_tempo2_columns.py)."""
         monkeypatch.setenv("PINT_TPU_NBODY", "1")
         from pint_tpu.fitting import fit_auto
         from pint_tpu.models.builder import get_model_and_toas
@@ -98,7 +101,7 @@ class TestEndToEndFitQuality:
         m, t = get_model_and_toas(TAI_PAR, TAI_TIM)
         ftr = fit_auto(t, m)
         res = ftr.fit_toas(maxiter=40)
-        assert ftr.resids.rms_weighted() * 1e6 < 500.0
+        assert ftr.resids.rms_weighted() * 1e6 < 350.0
         gold = _load_golden(TAI_GOLDEN)[:, 0]
         # golden's own scale for context: TEMPO postfit rms
         assert np.std(gold) * 1e6 < 10.0

@@ -423,6 +423,33 @@ SINI 0.95 1 0.005
         np.testing.assert_allclose(
             float(np.asarray(back.params["SINI"])), 0.95, rtol=1e-12)
 
+    def test_ell1h_round_trip_high_sini(self):
+        """ELL1 -> ELL1H must evaluate the exact STIGMA Shapiro form
+        (code-review repro: the h3-only truncation was 35 us off at
+        SINI=0.99)."""
+        import copy
+
+        from pint_tpu.binaryconvert import convert_binary
+        from pint_tpu.residuals import Residuals
+
+        par = PAR.replace("PSR UTILFAKE", "PSR BCH") + """
+BINARY ELL1
+PB 0.8 1
+A1 1.9 1
+TASC 55490.0 1
+EPS1 1e-6 1
+EPS2 2e-6 1
+M2 0.9 1
+SINI 0.99 1
+"""
+        m = build_model(parse_parfile(par, from_text=True))
+        toas = make_fake_toas_uniform(55400, 55600, 40, m, freq_mhz=1400.0)
+        r0 = Residuals(toas, m, subtract_mean=False).time_resids
+        h = convert_binary(copy.deepcopy(m), "ELL1H")
+        assert h["BinaryELL1H"].h_mode == "stigma"
+        r1 = Residuals(toas, h, subtract_mean=False).time_resids
+        np.testing.assert_allclose(r1, r0, atol=2e-8)
+
     def test_ddgr_input(self):
         from pint_tpu.binaryconvert import convert_binary
 
