@@ -255,7 +255,7 @@ class NBodyEphemeris:
 
     def _band_design(self, t: np.ndarray, periods_d, deriv: bool = False):
         """Design matrix of the TRUSTED band of an analytic anchor:
-        {1, t, t^2} + (1, t) x sin/cos at the given periods.
+        {1, t, ..., t^4} + (1, t) x sin/cos at the given periods.
 
         The big series terms (secular + the fundamental at each listed
         period) are known to 7+ digits; everything else — harmonics,

@@ -167,30 +167,28 @@ def convert_binary(model, target: str, kom_deg: float = 0.0):
         xpbdot, s_xpbdot = _f(model, "XPBDOT", 0.0), _u(model, "XPBDOT")
         _drop(model, "MTOT", "XOMDOT", "XPBDOT")
         src = "DD"
-        if target in _ELL1_LIKE or target in ("BT",):
-            keep = ("SINI",) if target in _ELL1_LIKE else ()
-        else:
-            keep = ("OMDOT", "GAMMA", "PBDOT", "SINI", "DR", "DTH")
         for k in ("OMDOT", "GAMMA", "PBDOT", "SINI", "DR", "DTH"):
-            if k not in keep:
-                continue
             v, s = pk[k]
             if k in new.specs:
-                if k == "PBDOT" and xpbdot:
-                    # the engine applied PBDOT_GR + XPBDOT; the target
-                    # carries the excess explicitly
-                    _set(model, new, "XPBDOT", xpbdot, unc=s_xpbdot,
-                         frozen=True)
                 _set(model, new, k, v, unc=s, frozen=True)
-            else:
-                # not in the target's spec table directly (SINI for a
-                # DDS/DDK target): stage it for _retarget_incl to map
+            elif k == "SINI":
+                # not in a DDS/DDK target's spec table: stage it for
+                # _retarget_incl to map to SHAPMAX/KIN
                 from pint_tpu.models.parameter import ParamSpec
 
                 model.params[k] = float(v)
                 model.param_meta[k] = ParamValueMeta(
                     spec=ParamSpec(k, unit=""), frozen=True, uncertainty=s,
                 )
+            else:
+                log.warning(
+                    f"DDGR-derived {k} = {float(v):.3e} has no slot in "
+                    f"BINARY {target}; dropped"
+                )
+        if xpbdot and "XPBDOT" in new.specs:
+            # the engine applied PBDOT_GR + XPBDOT; the target carries the
+            # excess explicitly (every model's common specs include it)
+            _set(model, new, "XPBDOT", xpbdot, unc=s_xpbdot, frozen=True)
 
     # --- eccentric <-> ELL1-like --------------------------------------------
     if src in _ECCENTRIC and target in _ELL1_LIKE:

@@ -225,6 +225,13 @@ class SolarWindDispersionX(DelayComponent):
                     f"SWXP_{i:04d} = {p} <= 1.25: outside the validity of the "
                     "quadrature (and p <= 1 is unphysical in the reference too)"
                 )
+        idxs = self.sorted_indices
+        for a, b in zip(idxs, idxs[1:]):
+            if self.windows[a][1] > self.windows[b][0]:
+                raise ValueError(
+                    f"SWX segments {a} and {b} overlap: every TOA must "
+                    "belong to at most one segment"
+                )
 
     def host_columns(self, toas, params):
         cols = super().host_columns(toas, params)
@@ -233,7 +240,10 @@ class SolarWindDispersionX(DelayComponent):
         onehot = np.zeros((len(toas), len(idxs)))
         for j, i in enumerate(idxs):
             r1, r2 = self.windows[i]
-            onehot[:, j] = (mjd >= r1) & (mjd <= r2)
+            # half-open: a TOA on a shared boundary of contiguous segments
+            # belongs to exactly one (the vectorized per-TOA index mixing
+            # assumes one-hot rows)
+            onehot[:, j] = (mjd >= r1) & (mjd < r2)
         cols["swx_onehot"] = onehot
         return cols
 
