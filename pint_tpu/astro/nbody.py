@@ -30,11 +30,15 @@ environment's substitute, validated against pulsar timing golden fits.
 Measured accuracy vs DE421 (via TEMPO2's golden roemer column on the
 J1744-1134 8-yr GASP set, tests/test_tempo2_columns.py):
 
-- total Earth-position disagreement ~540 km RMS projected on the line of
-  sight, dominated by multi-year drift that a timing fit absorbs;
-- anchored bands: annual ~35 km, semi-annual ~16 km, 1/3-yr ~11 km;
-- lunar bands: anomalistic month ~115 km, sidereal ~50 km;
-- broadband remainder ~50 km.
+- total Earth-position disagreement ~520 km RMS projected on the line of
+  sight, dominated by multi-year (~5 yr) structure: the Sun-SSB wobble
+  error of the approximate giant-planet elements (Jupiter's mean
+  longitude is only good to ~arcmin; 740,000 km of wobble x 4e-4 rad
+  ~ 300 km). DE-grade accuracy there requires a real kernel
+  (PINT_TPU_EPHEM + astro/spk.py, proven by tests/test_spk.py);
+- anchored bands after the fix: annual ~20 km, harmonics 2-5 all
+  < 11 km, anomalistic month ~21 km, sidereal month ~12 km,
+  broadband remainder ~30 km.
 
 The anchor BANDS are load-bearing: the 6-DOF-per-body IC fit is only
 constrained inside them, and the unconstrained combinations leak
@@ -73,9 +77,13 @@ def _gm(body: str) -> float:
 _GMS = np.array([_gm(b) for b in _BODIES])
 _FIT_BODIES = ("earth", "moon")  # ICs refined against the analytic anchors
 
-# trusted anchor bands (see _build): annual harmonics for the Earth series,
-# sidereal + anomalistic month + first harmonic for the lunar series
-_ANCHOR_PERIODS_E = (365.25, 182.625, 121.75)
+# trusted anchor bands (see _build): annual harmonics 1-5 PLUS the
+# giant-planet synodic periods for the Earth series (VSOP87's synodic
+# perturbation terms are large, explicitly tabulated terms — far better
+# than the IC-fit leakage that otherwise lands in those bands);
+# sidereal + anomalistic month + harmonic/evection/variation for the Moon
+_ANCHOR_PERIODS_E = (365.25, 182.625, 121.75, 91.3125, 73.05,
+                     779.94, 583.92, 398.88)
 _ANCHOR_PERIODS_M = (27.321662, 27.554550, 31.811940, 29.530589, 13.660831)
 
 
@@ -127,7 +135,7 @@ class NBodyEphemeris:
 
     #: bump when the integration/refinement algorithm changes — invalidates
     #: every cached solution on disk
-    _CACHE_VERSION = 5
+    _CACHE_VERSION = 7
 
     def __init__(self, base, t0_jcent: float, span_years: float = 16.0,
                  grid_days: float = 0.5, refine_iters: int = 3):
@@ -260,8 +268,10 @@ class NBodyEphemeris:
         The big series terms (secular + the fundamental at each listed
         period) are known to 7+ digits; everything else — harmonics,
         planetary-synodic sidebands, the Earth's lunar-wobble term — is
-        exactly where a truncated theory is noisy, and those are FORCED
-        oscillations the dynamics reproduce from the ICs anyway. Notably
+        exactly where a truncated theory is noisy UNLESS its terms are
+        explicitly tabulated (the trusted band list includes the
+        giant-planet synodic periods for that reason), and the rest are
+        FORCED oscillations the dynamics reproduce from the ICs. Notably
         the EARTH anchor must exclude the monthly band: the integrated
         Earth wobble comes from the (separately anchored) lunar orbit,
         which is far better known than the wobble term of a truncated
