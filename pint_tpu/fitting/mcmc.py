@@ -38,10 +38,55 @@ class MCMCFitter:
         self.lnp: np.ndarray | None = None
         self.result: FitResult | None = None
 
-    def fit_toas(self, nsteps: int = 400, burn: float = 0.25, seed: int = 0) -> FitResult:
-        x0 = initial_ball(self.bt.scales, self.nwalkers, seed=seed)
+    def fit_toas(self, nsteps: int = 400, burn: float = 0.25, seed: int = 0,
+                 backend: str | None = None, resume: bool = False) -> FitResult:
+        """Run (or, with `backend`+`resume`, continue) the chain. `backend`
+        checkpoints chain/lnp to an .npz after the run — the equivalent of
+        the reference event_optimize's emcee HDF backend."""
+        import os
+
+        if backend and not backend.endswith(".npz"):
+            backend += ".npz"  # np.savez appends it; keep load/save symmetric
+        from pint_tpu.models.base import leaf_to_f64
+
+        v0 = np.array([
+            float(np.asarray(leaf_to_f64(self.bt._params0[n])))
+            for n in self.bt.free
+        ])
+        prev_chain = prev_lnp = None
+        if resume and backend and os.path.exists(backend):
+            with np.load(backend) as z:
+                if list(z["free"]) != list(self.bt.free):
+                    raise ValueError(
+                        f"backend {backend} free-params mismatch: {list(z['free'])}"
+                    )
+                if not np.allclose(z["params0"], v0, rtol=0, atol=0):
+                    raise ValueError(
+                        f"backend {backend} was sampled around different "
+                        "reference parameter values; delta-space chains "
+                        "cannot be concatenated across reference points"
+                    )
+                prev_chain, prev_lnp = z["chain"], z["lnp"]
+                seed = int(z["next_seed"])
+            x0 = prev_chain[-1]
+            if x0.shape[0] != self.nwalkers:
+                raise ValueError(
+                    f"backend has {x0.shape[0]} walkers, need {self.nwalkers}"
+                )
+            log.info(f"resuming chain from {backend}: {prev_chain.shape[0]} steps done")
+        else:
+            x0 = initial_ball(self.bt.scales, self.nwalkers, seed=seed)
         chain, lnp, acc = run_ensemble(self.bt.lnpost_fn(), x0, nsteps, seed=seed)
+        if prev_chain is not None:
+            chain = np.concatenate([prev_chain, chain])
+            lnp = np.concatenate([prev_lnp, lnp])
         self.chain, self.lnp = chain, lnp
+        if backend:
+            np.savez_compressed(
+                backend, chain=chain, lnp=lnp, params0=v0,
+                free=np.array(list(self.bt.free)), next_seed=seed + 1,
+            )
+        nsteps = chain.shape[0]
         log.info(f"MCMC: {self.nwalkers} walkers x {nsteps} steps, acceptance {acc:.2f}")
         nburn = int(burn * nsteps)
         flat = chain[nburn:].reshape(-1, self.bt.nparams)

@@ -348,6 +348,46 @@ class WLSFitter:
     def print_summary(self) -> None:
         print(self.get_summary())
 
+    # --- labeled matrices (reference pint_matrix.py:701-811 surface) -----------
+
+    def get_parameter_covariance_matrix(self, pretty_print: bool = False,
+                                        prec: int = 3) -> np.ndarray:
+        """Post-fit parameter covariance (reference
+        get_parameter_covariance_matrix, fitter.py:738); optionally
+        pretty-printed with parameter labels."""
+        if self.result is None or self.result.covariance is None:
+            raise RuntimeError("run fit_toas first")
+        cov = np.asarray(self.result.covariance)
+        if pretty_print:
+            print(self._format_labeled_matrix(cov, prec))
+        return cov
+
+    def get_parameter_correlation_matrix(self, pretty_print: bool = False,
+                                         prec: int = 3) -> np.ndarray:
+        """Post-fit parameter correlation matrix (reference
+        get_parameter_correlation_matrix, fitter.py:751)."""
+        cov = self.get_parameter_covariance_matrix()
+        sig = np.sqrt(np.diag(cov))
+        zero = sig == 0  # SVD-degenerate parameters have a zeroed cov row
+        sig = np.where(zero, 1.0, sig)
+        corr = cov / np.outer(sig, sig)
+        # a degenerate parameter is perfectly (un)determined, not
+        # "uncorrelated with itself": keep the unit diagonal
+        corr[np.diag_indices_from(corr)] = np.where(zero, 1.0, np.diag(corr))
+        if pretty_print:
+            print(self._format_labeled_matrix(corr, prec))
+        return corr
+
+    def _format_labeled_matrix(self, mat: np.ndarray, prec: int) -> str:
+        names = list(self._free)
+        w = max(max((len(n) for n in names), default=4), prec + 7)
+        head = " " * (w + 1) + " ".join(f"{n:>{w}s}" for n in names)
+        rows = [head]
+        for i, n in enumerate(names):
+            vals = " ".join(f"{mat[i, j]:>{w}.{prec}g}" for j in range(i + 1))
+            rows.append(f"{n:>{w}s} {vals}")
+        return "\n".join(rows)
+
     def designmatrix(self) -> np.ndarray:
         """(N, p) d time-resid / d free-param, for inspection/tests (M is
         the second element of the WLS and GLS step tuples; the wideband

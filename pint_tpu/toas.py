@@ -15,6 +15,7 @@ so any epoch difference downstream is exact in dd arithmetic.
 
 from __future__ import annotations
 
+import re
 from dataclasses import dataclass, field
 from typing import Sequence
 
@@ -26,6 +27,30 @@ from pint_tpu.astro import time as ptime
 from pint_tpu.astro.ephemeris import get_ephemeris
 from pint_tpu.astro.observatories import get_observatory
 from pint_tpu.io.tim import TOALine, parse_tim
+
+_FLAG_KEY_OK = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_+-]*$")
+
+
+def validate_flags(flags: list[dict]) -> list[dict]:
+    """Enforce the reference's FlagDict contract (toa.py:911): flag keys
+    are bare identifiers (no leading '-', no whitespace), values are
+    whitespace-free strings (non-strings are coerced)."""
+    for f in flags:
+        for k in list(f):
+            if not isinstance(k, str) or not _FLAG_KEY_OK.match(k):
+                raise ValueError(
+                    f"invalid TOA flag name {k!r}: flag names are bare "
+                    "identifiers (store '-fe L-wide' as {'fe': 'L-wide'})"
+                )
+            v = f[k]
+            if not isinstance(v, str):
+                f[k] = v = str(v)
+            if any(c.isspace() for c in v):
+                raise ValueError(
+                    f"invalid value {v!r} for TOA flag -{k}: flag values "
+                    "cannot contain whitespace"
+                )
+    return flags
 from pint_tpu.utils.logging import get_logger
 
 log = get_logger("pint_tpu.toas")
@@ -418,6 +443,8 @@ def prepare_arrays(
     n = len(utc)
     if flags is None:
         flags = [{} for _ in range(n)]
+    else:
+        validate_flags(flags)
     if lines is None:
         lines = [
             TOALine(

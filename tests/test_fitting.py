@@ -166,3 +166,38 @@ class TestSummaryAndFtest:
         assert ftest(100.5, 101, 100.0, 100) > 0.4
         # degenerate inputs
         assert ftest(100.0, 100, 120.0, 99) == 1.0
+
+
+def test_correlation_matrix_surface():
+    """Labeled covariance/correlation matrices (reference
+    fitter.py:738-765 / pint_matrix.py:701-811)."""
+    import numpy as np
+
+    from pint_tpu.io.par import parse_parfile
+    from pint_tpu.models.builder import build_model
+    from pint_tpu.fitting import WLSFitter
+    from pint_tpu.simulation import make_fake_toas_uniform
+
+    par = """
+PSR CORRFAKE
+RAJ 05:00:00 1
+DECJ 20:00:00 1
+F0 100.0 1
+F1 -1e-15 1
+PEPOCH 55500
+DM 10.0
+TZRMJD 55500.1
+TZRSITE gbt
+TZRFRQ 1400
+"""
+    m = build_model(parse_parfile(par, from_text=True))
+    toas = make_fake_toas_uniform(55000, 56000, 30, m, freq_mhz=1400.0,
+                                  error_us=1.0, add_noise=True)
+    ftr = WLSFitter(toas, m)
+    ftr.fit_toas(maxiter=3)
+    corr = ftr.get_parameter_correlation_matrix()
+    assert corr.shape == (4, 4)
+    np.testing.assert_allclose(np.diag(corr), 1.0, rtol=1e-12)
+    assert np.all(np.abs(corr) <= 1.0 + 1e-12)
+    txt = ftr._format_labeled_matrix(corr, 3)
+    assert "F0" in txt and "RAJ" in txt

@@ -99,3 +99,20 @@ def test_tim_roundtrip(tmp_path):
     assert back.toas[0].mjd_day == 55000
     got = back.toas[0].mjd_frac_hi + back.toas[0].mjd_frac_lo
     assert np.abs(got - 0.123456789012345678) < 1e-16
+
+
+class TestFlagValidation:
+    def test_flag_contract(self):
+        """Reference FlagDict contract (toa.py:911): bare identifier keys,
+        whitespace-free string values, non-strings coerced."""
+        import pytest
+
+        from pint_tpu.toas import validate_flags
+
+        f = [{"fe": "L-wide", "weight": 0.5}]
+        validate_flags(f)
+        assert f[0]["weight"] == "0.5"  # coerced to str
+        with pytest.raises(ValueError, match="flag name"):
+            validate_flags([{"-fe": "x"}])
+        with pytest.raises(ValueError, match="whitespace"):
+            validate_flags([{"fe": "L wide"}])

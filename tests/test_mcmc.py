@@ -113,3 +113,19 @@ class TestMCMCFitter:
             s_wls = wres.uncertainties[n]
             assert res.uncertainties[n] == pytest.approx(s_wls, rel=0.5), n
             assert abs(np.mean(flat[:, i])) < 5 * s_wls
+
+
+def test_mcmc_backend_resume(tmp_path, setup):
+    """Chain checkpoint + exact resume (the reference event_optimize
+    --backend h5 capability, on the general MCMC fitter)."""
+    import copy
+
+    model, toas, _ = setup
+    backend = str(tmp_path / "chain.npz")
+    ftr = MCMCFitter(toas, copy.deepcopy(model), nwalkers=12)
+    ftr.fit_toas(nsteps=30, seed=5, backend=backend)
+    assert ftr.chain.shape[0] == 30
+    ftr2 = MCMCFitter(toas, copy.deepcopy(model), nwalkers=12)
+    ftr2.fit_toas(nsteps=20, seed=5, backend=backend, resume=True)
+    assert ftr2.chain.shape[0] == 50
+    np.testing.assert_array_equal(ftr2.chain[:30], ftr.chain)
