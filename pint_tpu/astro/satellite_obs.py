@@ -93,11 +93,44 @@ def get_satellite_observatory(name: str, orbitfile: str) -> SatelliteObs:
                 pos = pos * 1e3
             table = (np.asarray(hdu.data["TIME"], float), pos, hdu.header)
             break
+        # RXTE/NICER FPorbit: ORBIT or XTE_PE extension with per-axis
+        # X/Y/Z columns in meters (reference load_FPorbit,
+        # satellite_obs.py:89)
+        cols = {c.lower(): c for c in hdu.data}
+        if {"time", "x", "y", "z"} <= set(cols):
+            pos = np.stack([
+                np.asarray(hdu.data[cols[a]], float) for a in ("x", "y", "z")
+            ], axis=1)
+            t = np.asarray(hdu.data[cols["time"]], float)
+            # drop zeroed position rows exactly like the reference
+            ok = (pos[:, 0] != 0.0) & (pos[:, 1] != 0.0)
+            table = (t[ok], pos[ok], hdu.header)
+            break
     if table is None:
-        raise ValueError(f"{orbitfile}: no SC_POSITION/POSITION table found")
+        raise ValueError(
+            f"{orbitfile}: no SC_POSITION/POSITION or FPorbit-style "
+            "TIME+X/Y/Z table found"
+        )
     met, pos, hdr = table
-    mjdref = float(hdr.get("MJDREFI", 51910)) + float(hdr.get("MJDREFF", 7.428703703703703e-4))
+    # MJDREF(+I/F) and TIMEZERO exactly as for event files (reference
+    # read_fits_event_mjds; same logic as event_toas.py)
+    if "MJDREFI" in hdr:
+        mjdref = float(int(hdr["MJDREFI"])) + float(hdr.get("MJDREFF", 0.0))
+    elif "MJDREF" in hdr:
+        mjdref = float(hdr["MJDREF"])
+    else:
+        mjdref = 51910 + 7.428703703703703e-4  # Fermi MET epoch
+    met = met + float(hdr.get("TIMEZERO", 0.0))
     order = np.argsort(met)
+    # concatenated FPorbit files can carry duplicate timestamps: drop them
+    # (reference load_FPorbit warns and filters the same way) — a zero-width
+    # interval would make the Hermite interpolation NaN
+    good = np.concatenate([[True], np.diff(met[order]) > 0])
+    if not good.all():
+        log.warning(
+            f"{orbitfile}: dropping {int((~good).sum())} duplicate orbit rows"
+        )
+        order = order[good]
     _load_builtin()  # registering first must not mask the built-in sites
     obs = SatelliteObs(
         name=name, aliases=(), met_s=met[order], pos_m=pos[order], mjdref=mjdref

@@ -266,3 +266,19 @@ class TestEventOptimize:
         dphi, ll = marginalize_over_phase(ph, tpl)
         # shifting data by dphi must land the pulse on the template peak
         assert abs(((0.20 + dphi) % 1.0) - 0.5) < 0.01
+
+
+class TestFPorbit:
+    def test_fporbit_loads(self):
+        """RXTE/NICER FPorbit orbit files (reference load_FPorbit,
+        satellite_obs.py:89) — real FPorbit_Day6223 file."""
+        from pint_tpu.astro.satellite_obs import get_satellite_observatory
+
+        obs = get_satellite_observatory(
+            "rxte_fporbit", os.path.join(REFERENCE_DATA, "FPorbit_Day6223"))
+        mjd0 = obs.mjdref + obs.met_s.mean() / 86400.0
+        p, v = obs.site_posvel_gcrs(
+            np.array([mjd0]), np.array([(mjd0 - 51544.5) / 36525.0]))
+        r = np.linalg.norm(p[0])
+        assert 6.6e6 < r < 7.3e6          # LEO radius (m)
+        assert 7e3 < np.linalg.norm(v[0]) < 8.2e3  # orbital speed (m/s)
