@@ -158,19 +158,31 @@ def gast06(ut1_mjd: np.ndarray, tt_jcent: np.ndarray) -> np.ndarray:
 
 
 def itrf_to_gcrs_posvel(
-    itrf_m: np.ndarray, ut1_mjd: np.ndarray, tt_jcent: np.ndarray
+    itrf_m: np.ndarray, ut1_mjd: np.ndarray, tt_jcent: np.ndarray,
+    xp_rad: np.ndarray | None = None, yp_rad: np.ndarray | None = None,
 ) -> tuple[np.ndarray, np.ndarray]:
     """Site GCRS position [m] and velocity [m/s] at each epoch.
 
     itrf_m: (3,) fixed site coordinates. Returns ((N,3), (N,3)).
-    Polar motion neglected (<= 0.3 um m-level ~ 26 ns*0.00003 = sub-ns).
-    """
+    `xp_rad`/`yp_rad` apply polar motion (small-angle W matrix,
+    W ~= R1(yp) R2(xp): x' = x - xp z, y' = y + yp z, z' = z + xp x - yp y
+    to first order — the <= 0.3 arcsec wobble is a <= 10 m / 30 ns site
+    effect, zero unless an EOP table is loaded, astro/eop.py)."""
+    x, y, z = itrf_m
+    if xp_rad is not None:
+        xw = x - xp_rad * z
+        yw = y + yp_rad * z
+        zw = z + xp_rad * x - yp_rad * y
+    else:
+        xw, yw, zw = x, y, z
     theta = gast06(ut1_mjd, tt_jcent)
     M = npb_matrix(tt_jcent)  # (N,3,3) gcrs->tod
     c, s = np.cos(theta), np.sin(theta)
-    x, y, z = itrf_m
-    r_tod = np.stack([c * x - s * y, s * x + c * y, np.full_like(c, z)], -1)
-    v_tod = OMEGA_EARTH * np.stack([-s * x - c * y, c * x - s * y, np.zeros_like(c)], -1)
+    r_tod = np.stack([c * xw - s * yw, s * xw + c * yw,
+                      np.broadcast_to(zw, c.shape)], -1)
+    v_tod = OMEGA_EARTH * np.stack(
+        [-s * xw - c * yw, c * xw - s * yw, np.zeros_like(c)], -1
+    )
     # transpose(M) maps tod -> gcrs
     r_gcrs = np.einsum("...ji,...j->...i", M, r_tod)
     v_gcrs = np.einsum("...ji,...j->...i", M, v_tod)

@@ -29,8 +29,10 @@ class Observatory:
     aliases: tuple[str, ...] = ()
     timescale: str = "utc"
 
-    def site_posvel_gcrs(self, ut1_mjd, tt_jcent):
-        """(pos[m], vel[m/s]) of the site wrt geocenter, GCRS axes."""
+    def site_posvel_gcrs(self, ut1_mjd, tt_jcent, xp_rad=None, yp_rad=None):
+        """(pos[m], vel[m/s]) of the site wrt geocenter, GCRS axes.
+        Polar-motion arguments apply only to ground sites; spaceborne and
+        special observatories ignore them."""
         raise NotImplementedError
 
     @property
@@ -46,13 +48,16 @@ class TopoObs(Observatory):
     tempo_code: str = ""
     clock_files: tuple[str, ...] = ()
 
-    def site_posvel_gcrs(self, ut1_mjd, tt_jcent):
-        return erot.itrf_to_gcrs_posvel(np.asarray(self.itrf_xyz_m), ut1_mjd, tt_jcent)
+    def site_posvel_gcrs(self, ut1_mjd, tt_jcent, xp_rad=None, yp_rad=None):
+        return erot.itrf_to_gcrs_posvel(
+            np.asarray(self.itrf_xyz_m), ut1_mjd, tt_jcent,
+            xp_rad=xp_rad, yp_rad=yp_rad,
+        )
 
 
 @dataclass
 class GeocenterObs(Observatory):
-    def site_posvel_gcrs(self, ut1_mjd, tt_jcent):
+    def site_posvel_gcrs(self, ut1_mjd, tt_jcent, xp_rad=None, yp_rad=None):
         n = np.shape(np.atleast_1d(ut1_mjd))[0]
         z = np.zeros((n, 3))
         return z, z.copy()
@@ -68,7 +73,7 @@ class BarycenterObs(Observatory):
     def is_barycenter(self) -> bool:
         return True
 
-    def site_posvel_gcrs(self, ut1_mjd, tt_jcent):
+    def site_posvel_gcrs(self, ut1_mjd, tt_jcent, xp_rad=None, yp_rad=None):
         n = np.shape(np.atleast_1d(ut1_mjd))[0]
         z = np.zeros((n, 3))
         return z, z.copy()

@@ -40,7 +40,7 @@ class SatelliteObs(Observatory):
         else:
             self.vel_m_s = np.zeros_like(self.pos_m)
 
-    def site_posvel_gcrs(self, ut1_mjd, tt_jcent):
+    def site_posvel_gcrs(self, ut1_mjd, tt_jcent, xp_rad=None, yp_rad=None):
         tt_mjd = MJD_J2000 + np.asarray(tt_jcent) * 36525.0
         met = (tt_mjd - self.mjdref) * 86400.0
         lo, hi = self.met_s[0], self.met_s[-1]

@@ -29,23 +29,29 @@ from pint_tpu.astro.observatories import get_observatory
 from pint_tpu.io.tim import TOALine, parse_tim
 
 _FLAG_KEY_OK = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_+-]*$")
+_FLAG_WS = re.compile(r"\s")
+#: names already proven valid — flag vocabularies are tiny while TOA counts
+#: are 1e5+, and validation runs on every zero-residual re-preparation
+_FLAG_KEYS_SEEN: set = set()
 
 
 def validate_flags(flags: list[dict]) -> list[dict]:
     """Enforce the reference's FlagDict contract (toa.py:911): flag keys
     are bare identifiers (no leading '-', no whitespace), values are
     whitespace-free strings (non-strings are coerced)."""
+    seen = _FLAG_KEYS_SEEN
     for f in flags:
-        for k in list(f):
-            if not isinstance(k, str) or not _FLAG_KEY_OK.match(k):
-                raise ValueError(
-                    f"invalid TOA flag name {k!r}: flag names are bare "
-                    "identifiers (store '-fe L-wide' as {'fe': 'L-wide'})"
-                )
-            v = f[k]
-            if not isinstance(v, str):
+        for k, v in f.items():
+            if k not in seen:
+                if not isinstance(k, str) or not _FLAG_KEY_OK.match(k):
+                    raise ValueError(
+                        f"invalid TOA flag name {k!r}: flag names are bare "
+                        "identifiers (store '-fe L-wide' as {'fe': 'L-wide'})"
+                    )
+                seen.add(k)
+            if type(v) is not str:
                 f[k] = v = str(v)
-            if any(c.isspace() for c in v):
+            if _FLAG_WS.search(v):
                 raise ValueError(
                     f"invalid value {v!r} for TOA flag -{k}: flag values "
                     "cannot contain whitespace"
@@ -358,8 +364,11 @@ def get_TOAs(
         if spk and os.path.exists(spk):
             spk = f"{spk}@{os.path.getmtime(spk):.0f}"
         nbody = os.environ.get("PINT_TPU_NBODY", "1")
+        eop = os.environ.get("PINT_TPU_EOP") or ""
+        if eop and os.path.exists(eop):
+            eop = f"{eop}@{os.path.getmtime(eop):.0f}"
         key = (f"v{_TOA_CACHE_VERSION}-{digest}-{ephem}-{spk}-nb{nbody}-"
-               f"{planets}-{include_gps}-{include_bipm}-{bipm_version}")
+               f"eop{eop}-{planets}-{include_gps}-{include_bipm}-{bipm_version}")
         cache_path = timfile + ".pint_tpu_pickle"
         if os.path.exists(cache_path):
             try:
@@ -485,14 +494,23 @@ def prepare_arrays(
             dst[tt_scale] = src[tt_scale]
     tt_jcent = ptime.mjd_tt_julian_centuries(tt)
 
-    # 3. site GCRS posvel (UT1 ~= UTC without EOP data)
-    ut1_mjd = utc_corr.mjd_float()
+    # 3. site GCRS posvel. UT1 = UTC + dUT1 and polar motion come from a
+    # user-supplied IERS table (PINT_TPU_EOP, astro/eop.py); both are zero
+    # without one (<= 1.4 us site effect).
+    from pint_tpu.astro.eop import get_eop
+
+    utc_mjd = utc_corr.mjd_float()
+    dut1_s, xp_rad, yp_rad = get_eop(utc_mjd)
+    ut1_mjd = utc_mjd + dut1_s / 86400.0
     site_pos = np.zeros((n, 3))
     site_vel = np.zeros((n, 3))
     for name in np.unique(obs_names):
         ob = get_observatory(str(name))
         sel = obs_names == name
-        p, v = ob.site_posvel_gcrs(ut1_mjd[sel], tt_jcent[sel])
+        p, v = ob.site_posvel_gcrs(
+            ut1_mjd[sel], tt_jcent[sel],
+            xp_rad=xp_rad[sel], yp_rad=yp_rad[sel],
+        )
         site_pos[sel] = p
         site_vel[sel] = v
 

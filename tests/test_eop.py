@@ -1,0 +1,106 @@
+"""User-supplied IERS EOP data (PINT_TPU_EOP): UT1-UTC + polar motion.
+
+The reference gets these through astropy's IERS machinery; no IERS data
+ships in this environment, so the default is UT1=UTC / zero polar motion
+and this test drives the env-knob path with a synthetic finals2000A file.
+"""
+
+import numpy as np
+import pytest
+
+
+def _finals_row(mjd, xp, yp, dut1):
+    """One fixed-width finals2000A line at the parser's column offsets."""
+    s = [" "] * 70
+
+    def put(start, text):
+        s[start:start + len(text)] = list(text)
+
+    put(0, "260101")
+    put(7, f"{mjd:8.2f}")
+    put(16, "I")
+    put(18, f"{xp:9.6f}")
+    put(27, f"{1e-4:9.6f}")
+    put(37, f"{yp:9.6f}")
+    put(46, f"{1e-4:9.6f}")
+    put(56, "I")
+    put(58, f"{dut1:10.7f}")
+    return "".join(s)
+
+
+def _write_finals(path, mjds, dut1, xp, yp):
+    with open(path, "w") as f:
+        for i, mjd in enumerate(mjds):
+            f.write(_finals_row(mjd, xp[i], yp[i], dut1[i]) + "\n")
+
+
+class TestEOP:
+    def test_parse_and_interp(self, tmp_path, monkeypatch):
+        from pint_tpu.astro import eop
+
+        mjds = np.arange(56000.0, 56010.0)
+        dut1 = np.linspace(-0.3, -0.2, 10)
+        xp = np.linspace(0.05, 0.07, 10)
+        yp = np.linspace(0.30, 0.32, 10)
+        p = tmp_path / "finals2000A.all"
+        _write_finals(str(p), mjds, dut1, xp, yp)
+        monkeypatch.setenv("PINT_TPU_EOP", str(p))
+        eop._table = None  # reset the cache
+        d, x, y = eop.get_eop(np.array([56004.5, 55990.0]))
+        np.testing.assert_allclose(d[0], np.interp(56004.5, mjds, dut1), rtol=1e-12)
+        arcsec = np.pi / 180 / 3600
+        np.testing.assert_allclose(x[0] / arcsec, np.interp(56004.5, mjds, xp), rtol=1e-9)
+        # outside the table: zero fallback
+        assert d[1] == 0.0 and x[1] == 0.0 and y[1] == 0.0
+
+    def test_dut1_rotates_site(self, tmp_path, monkeypatch):
+        """A UT1-UTC offset must rotate the site by omega*dut1: the site
+        position change is v_site * dut1 to first order."""
+        from pint_tpu.astro import eop
+        from pint_tpu.astro.observatories import get_observatory
+
+        ob = get_observatory("gbt")
+        mjd = np.array([56004.5])
+        T = (mjd - 51544.5) / 36525.0
+        p0, v0 = ob.site_posvel_gcrs(mjd, T)
+        dut1 = 0.4
+        p1, _ = ob.site_posvel_gcrs(mjd + dut1 / 86400.0, T)
+        # prediction: p1 ~= p0 + v0 * dut1  (site speed ~ 350 m/s at GBT)
+        np.testing.assert_allclose(p1, p0 + v0 * dut1, atol=0.05)
+        assert np.linalg.norm(p1 - p0) > 100.0  # the effect is real (~140 m)
+
+    def test_polar_motion_moves_site(self):
+        from pint_tpu.astro.observatories import get_observatory
+
+        ob = get_observatory("gbt")
+        mjd = np.array([56004.5])
+        T = (mjd - 51544.5) / 36525.0
+        p0, _ = ob.site_posvel_gcrs(mjd, T)
+        arcsec = np.pi / 180 / 3600
+        p1, _ = ob.site_posvel_gcrs(
+            mjd, T, xp_rad=np.array([0.3 * arcsec]), yp_rad=np.array([0.3 * arcsec]))
+        d = np.linalg.norm(p1 - p0)
+        # 0.3" of polar motion moves a mid-latitude site by ~6-13 m
+        assert 3.0 < d < 20.0
+
+    def test_prepare_arrays_uses_eop(self, tmp_path, monkeypatch):
+        """End to end: TOAs prepared with a dUT1=0.4 s table have their
+        site positions rotated accordingly."""
+        from pint_tpu.astro import eop, time as ptime
+        from pint_tpu.toas import prepare_arrays
+
+        mjds = np.array([56004.3, 56004.7])
+        utc = ptime.MJDEpoch.from_mjd_float(mjds)
+        kw = dict(error_us=np.ones(2), freq=np.full(2, 1400.0),
+                  obs_names=np.array(["gbt", "gbt"]))
+        monkeypatch.delenv("PINT_TPU_EOP", raising=False)
+        t0 = prepare_arrays(utc, kw["error_us"], kw["freq"], kw["obs_names"])
+        table = tmp_path / "finals.all"
+        _write_finals(str(table), np.arange(56000.0, 56010.0),
+                      np.full(10, 0.4), np.zeros(10), np.zeros(10))
+        monkeypatch.setenv("PINT_TPU_EOP", str(table))
+        eop._table = None
+        t1 = prepare_arrays(utc, kw["error_us"], kw["freq"], kw["obs_names"])
+        d = np.linalg.norm(t1.ssb_obs_pos_m - t0.ssb_obs_pos_m, axis=1)
+        assert np.all(d > 100.0)  # ~140 m from 0.4 s of Earth rotation
+        eop._table = None
