@@ -262,20 +262,46 @@ def grid_chisq(
         rest of the grid streams through `lax.map`. Default: everything at
         once below 64 points, else 16 per chip.
     """
-    model = fitter.model
-    resids = fitter.resids
     if len(parnames) != len(parvalues):
         raise ValueError(
             f"{len(parnames)} parameter names but {len(parvalues)} value arrays"
         )
+    grids = np.meshgrid(*[np.asarray(v, np.float64) for v in parvalues])
+    out_shape = grids[0].shape
+    pts = np.stack([g.ravel() for g in grids], axis=1)  # (npts, g)
+    chi2 = grid_chisq_points(
+        fitter, parnames, pts, maxiter=maxiter, mesh=mesh,
+        grid_axis=grid_axis, toa_axis=toa_axis, batch=batch,
+    )
+    return chi2.reshape(out_shape)
+
+
+def grid_chisq_points(
+    fitter,
+    parnames,
+    points,
+    maxiter: int = 1,
+    mesh=None,
+    grid_axis: str = "grid",
+    toa_axis: str = "toa",
+    batch: int | None = None,
+):
+    """Chi^2 at an ARBITRARY set of parameter points: `points` is
+    (npts, len(parnames)) in model-internal units. The shared engine under
+    grid_chisq / grid_chisq_derived."""
+    model = fitter.model
+    resids = fitter.resids
     for n in parnames:
         if n not in model.param_meta:
             raise KeyError(f"unknown parameter {n}")
     free = tuple(n for n in model.free_params if n not in parnames)
 
-    grids = np.meshgrid(*[np.asarray(v, np.float64) for v in parvalues])
-    out_shape = grids[0].shape
-    pts = np.stack([g.ravel() for g in grids], axis=1)  # (npts, g)
+    pts = np.asarray(points, np.float64)
+    if pts.ndim != 2 or pts.shape[1] != len(parnames):
+        raise ValueError(
+            f"points must be (npts, {len(parnames)}) for parameters "
+            f"{tuple(parnames)}; got shape {pts.shape}"
+        )
     npts = pts.shape[0]
 
     # the chi^2 STATISTIC follows the fitter type, like the reference's
@@ -299,7 +325,38 @@ def grid_chisq(
             model, parnames, free, resids.subtract_mean, maxiter, pts,
             params, data, batch, correlated,
         )
-    return np.asarray(chi2)[:npts].reshape(out_shape)
+    return np.asarray(chi2)[:npts]
+
+
+def grid_chisq_derived(
+    fitter,
+    parnames,
+    parfuncs,
+    gridvalues,
+    maxiter: int = 1,
+    mesh=None,
+    grid_axis: str = "grid",
+    toa_axis: str = "toa",
+    batch: int | None = None,
+):
+    """Chi^2 over a grid of DERIVED parameters (reference
+    gridutils.py:382): `parfuncs[i]` maps the meshgridded `gridvalues` to
+    the model parameter `parnames[i]` (e.g. grid over (Mp, Mc) while the
+    model is fit in (M2, SINI)).
+
+    Returns (chi2 array shaped like the meshgrid, [parvalues arrays]).
+    """
+    if len(parnames) != len(parfuncs):
+        raise ValueError("parnames and parfuncs must pair up")
+    grids = np.meshgrid(*[np.asarray(v, np.float64) for v in gridvalues])
+    out_shape = grids[0].shape
+    parvalues = [np.asarray(f(*grids), np.float64) for f in parfuncs]
+    pts = np.stack([v.ravel() for v in parvalues], axis=1)
+    chi2 = grid_chisq_points(
+        fitter, parnames, pts, maxiter=maxiter, mesh=mesh,
+        grid_axis=grid_axis, toa_axis=toa_axis, batch=batch,
+    )
+    return chi2.reshape(out_shape), parvalues
 
 
 def _grid_single(model, parnames, free, subtract_mean, maxiter, pts, params, data,

@@ -148,3 +148,33 @@ class TestGridCorrelatedNoise:
         mesh = Mesh(np.array(jax.devices()[:8]).reshape(2, 4), ("grid", "toa"))
         sharded = grid_chisq(gls_fitted, ("F0", "F1"), (g_f0, g_f1), maxiter=1, mesh=mesh)
         np.testing.assert_allclose(sharded, single, rtol=1e-8)
+
+
+def test_grid_chisq_derived(fitted):
+    """Derived-parameter grids (reference grid_chisq_derived,
+    gridutils.py:382): grid over (P0, F1) with P0 mapped to the model's
+    F0 = 1/P0."""
+    from pint_tpu.gridutils import grid_chisq, grid_chisq_derived
+
+    ftr = fitted
+    f0s, f1s = _grids(ftr)
+    # identity mapping must reproduce the direct grid exactly
+    direct = grid_chisq(ftr, ("F0", "F1"), (f0s, f1s), maxiter=1)
+    derived, parvals = grid_chisq_derived(
+        ftr, ("F0", "F1"),
+        (lambda a, b: a, lambda a, b: b),
+        (f0s, f1s), maxiter=1,
+    )
+    np.testing.assert_allclose(derived, direct, rtol=1e-10)
+    assert parvals[0].shape == derived.shape
+    # genuinely derived: grid in spin PERIOD, F0 = 1/P0
+    p0s = 1.0 / f0s[::-1]
+    chi2, pv = grid_chisq_derived(
+        ftr, ("F0", "F1"),
+        (lambda p, f1: 1.0 / p, lambda p, f1: f1),
+        (p0s, f1s), maxiter=1,
+    )
+    assert np.isfinite(chi2).all()
+    # chi2 surface is the direct one with the P0 axis reversed
+    np.testing.assert_allclose(np.sort(chi2.ravel()), np.sort(direct.ravel()),
+                               rtol=1e-6)
