@@ -97,6 +97,83 @@ class ClockFile:
                 corrs.append(c)
         return cls(np.asarray(mjds), np.asarray(corrs), name=os.path.basename(path))
 
+    # --- write / merge (reference clock_file.py:188 merge, :288/:348 writers) ---
+
+    def write_tempo2(self, path: str, hdrline: str | None = None,
+                     comment: str | None = None) -> None:
+        """Write in TEMPO2 .clk format (reference
+        write_tempo2_clock_file:348)."""
+        with open(path, "w") as f:
+            f.write((hdrline or f"# UTC({self.name or 'obs'}) UTC") + "\n")
+            if comment:
+                for line in comment.strip().splitlines():
+                    f.write(f"# {line}\n")
+            for m, c in zip(self.mjd, self.corr_s):
+                f.write(f"{m:.5f} {c:.12e}\n")
+
+    def write_tempo(self, path: str, obscode: str = "1",
+                    comment: str | None = None) -> None:
+        """Write in TEMPO time.dat format: 'mjd offset_us 0.0 site'
+        (reference write_tempo_clock_file:288)."""
+        with open(path, "w") as f:
+            if comment:
+                for line in comment.strip().splitlines():
+                    f.write(f"# {line}\n")
+            for m, c in zip(self.mjd, self.corr_s):
+                f.write(f"{m:10.2f}{c * 1e6:14.3f}{0.0:12.3f}  {obscode}\n")
+
+    @staticmethod
+    def merge(clocks: list["ClockFile"], trim: bool = True) -> "ClockFile":
+        """Sum of several clock corrections as one table (reference
+        ClockFile.merge:188 — e.g. ao2gps + gps2utc -> ao2utc): evaluated
+        on the union of the input grids, optionally trimmed to the common
+        validity range (piecewise-linear tables only; repeated-MJD
+        discontinuities survive because every input knot is a knot of the
+        merged table)."""
+        if not clocks:
+            raise ValueError("merge needs at least one ClockFile")
+        grids = [c.mjd for c in clocks if len(c.mjd)]
+        if not grids:
+            return ClockFile(np.zeros(0), np.zeros(0), name="merged")
+        uniq = np.unique(np.concatenate(grids))
+        # repeated MJDs encode step discontinuities: keep them doubled in
+        # the merged grid so steps stay steps (reference merge:188)
+        disc = set()
+        for g in grids:
+            disc.update(g[:-1][np.diff(g) == 0])
+        rep = np.ones(uniq.size, dtype=int)
+        for m in disc:
+            rep[np.searchsorted(uniq, m)] = 2
+        mjds = np.repeat(uniq, rep)
+        if trim:
+            lo = max(g[0] for g in grids)
+            hi = min(g[-1] for g in grids)
+            if hi < lo:
+                raise ValueError("merge: clock validity ranges do not overlap")
+            mjds = mjds[(mjds >= lo) & (mjds <= hi)]
+        corr = np.zeros_like(mjds)
+        for c in clocks:
+            if len(c.mjd) == 0:
+                continue  # an empty table contributes zero, like evaluate()
+            # evaluate() (not raw interp) so each clock's valid_beyond
+            # policy applies when trim=False reaches past its range
+            vals = c.evaluate(mjds)
+            # at a duplicated knot interp returns the RIGHT side; restore
+            # this clock's left-side value on the left copy of each pair
+            z = np.diff(c.mjd) == 0
+            zl = z.copy()
+            zl[1:] &= ~z[:-1]
+            ixl = np.flatnonzero(zl)
+            if ixl.size:
+                pos = np.searchsorted(mjds, c.mjd[ixl], side="left")
+                ok = (pos < mjds.size) & (mjds[np.minimum(pos, mjds.size - 1)] == c.mjd[ixl])
+                vals[pos[ok]] = c.corr_s[ixl[ok]]
+            corr = corr + vals
+        return ClockFile(
+            mjds, corr, name="+".join(c.name or "?" for c in clocks),
+            valid_beyond=clocks[0].valid_beyond,
+        )
+
 
 def _find_first(alternatives: list[str], obs_name: str) -> ClockFile | None:
     for d in _candidate_dirs():

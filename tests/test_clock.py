@@ -46,3 +46,51 @@ class TestTempo2ClockFile:
         cf = ClockFile.read_tempo2(WSRT_CLK)
         v = cf.evaluate(np.array([cf.mjd[-1] + 1000.0]))[0]
         assert v == pytest.approx(cf.corr_s[-1], rel=1e-12)
+
+
+class TestClockWriteMerge:
+    def test_write_read_round_trip(self, tmp_path):
+        from pint_tpu.astro.clock import ClockFile
+
+        c = ClockFile(np.array([55000.0, 55100.0, 55200.0]),
+                      np.array([1e-6, 2e-6, -3e-6]), name="fake")
+        p2 = tmp_path / "fake.clk"
+        c.write_tempo2(str(p2), comment="synthetic")
+        c2 = ClockFile.read_tempo2(str(p2))
+        np.testing.assert_allclose(c2.mjd, c.mjd)
+        np.testing.assert_allclose(c2.corr_s, c.corr_s, rtol=1e-10)
+        pt = tmp_path / "time.dat"
+        c.write_tempo(str(pt), obscode="3")
+        c3 = ClockFile.read_tempo(str(pt))
+        np.testing.assert_allclose(c3.corr_s, c.corr_s, rtol=1e-6, atol=1e-12)
+
+    def test_merge_sums_and_trims(self):
+        from pint_tpu.astro.clock import ClockFile
+
+        a = ClockFile(np.array([55000.0, 55200.0]), np.array([1e-6, 3e-6]),
+                      name="a2b")
+        b = ClockFile(np.array([55100.0, 55300.0]), np.array([10e-6, 20e-6]),
+                      name="b2c")
+        m = ClockFile.merge([a, b])
+        # common range [55100, 55200]
+        assert m.mjd[0] == 55100.0 and m.mjd[-1] == 55200.0
+        got = m.evaluate(np.array([55150.0]))
+        want = a.evaluate(np.array([55150.0])) + b.evaluate(np.array([55150.0]))
+        np.testing.assert_allclose(got, want, rtol=1e-12)
+        assert m.name == "a2b+b2c"
+
+    def test_merge_preserves_steps_and_empties(self):
+        from pint_tpu.astro.clock import ClockFile
+
+        step = ClockFile(np.array([55000.0, 55100.0, 55100.0, 55200.0]),
+                         np.array([0.0, 0.0, 5e-6, 5e-6]), name="step")
+        other = ClockFile(np.array([55000.0, 55200.0]),
+                          np.array([1e-6, 1e-6]), name="flat")
+        empty = ClockFile(np.zeros(0), np.zeros(0), name="empty")
+        m = ClockFile.merge([step, other, empty])
+        # before the step: no ramp leakage
+        np.testing.assert_allclose(m.evaluate(np.array([55050.0])), 1e-6,
+                                   rtol=1e-12)
+        # after the step
+        np.testing.assert_allclose(m.evaluate(np.array([55150.0])), 6e-6,
+                                   rtol=1e-12)
