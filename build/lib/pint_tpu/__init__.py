@@ -1,0 +1,98 @@
+"""pint_tpu: a TPU-native pulsar-timing framework.
+
+A ground-up re-design of the capabilities of PINT (the pure-numpy/astropy
+reference surveyed in SURVEY.md) for TPU hardware: the delay/phase chain of a
+pulsar timing model is expressed as jit-compiled pure JAX functions using
+double-double (compensated) arithmetic in place of 80/128-bit longdouble,
+design matrices come from autodiff (jax.jacfwd) instead of ~2.4k LoC of
+hand-written analytic partials, generalized-least-squares fits run on device,
+and parameter grids / sampler ensembles scale over `jax.sharding.Mesh` axes
+with XLA collectives.
+
+Layering (mirrors SURVEY.md §1 but TPU-first):
+
+- host side (numpy): parfile/tim parsing (`pint_tpu.io`), the astronomy
+  environment (`pint_tpu.astro`: time scales, solar-system ephemeris, Earth
+  rotation, observatories, clock chains) and TOA preparation (`pint_tpu.toas`)
+  which ends in ONE host->device transfer of a dense "TOA tensor";
+- device side (JAX): `pint_tpu.ops` (double-double arithmetic, Horner kernels,
+  Kepler solvers), `pint_tpu.models` (the timing-model component chain as pure
+  functions), `pint_tpu.residuals`, `pint_tpu.fitting` (WLS/GLS/downhill/
+  wideband/MCMC), `pint_tpu.gridutils` (sharded chi^2 grids) and
+  `pint_tpu.parallel` (mesh/sharding helpers).
+
+Physical constants below follow the conventions of the reference
+(`pint/__init__.py:56-103` defines ls, dmu, DMconst, Tsun; values here are
+the same public IAU/CODATA numbers, TEMPO-compatible where the reference is).
+"""
+
+import os as _os
+
+import jax
+
+# Nanosecond pulse-phase precision requires float64 carriers for the
+# double-double arithmetic everywhere; enable before any tracing happens.
+jax.config.update("jax_enable_x64", True)
+
+# Persistent XLA compilation cache: the residual/fit/grid graphs take minutes
+# to compile at 1e5-TOA scale, and every fresh process would otherwise pay
+# that again. PINT_TPU_COMPILE_CACHE overrides the location; "0" disables.
+_cache_dir = _os.environ.get(
+    "PINT_TPU_COMPILE_CACHE", _os.path.expanduser("~/.cache/pint_tpu/xla")
+)
+if _cache_dir and _cache_dir != "0":
+    try:
+        _os.makedirs(_cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", _cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:  # pragma: no cover - cache is an optimization only
+        pass
+
+__version__ = "0.1.0"
+
+# --- fundamental constants (SI) ------------------------------------------------
+C_M_PER_S = 299792458.0  # speed of light, exact
+AU_M = 149597870700.0  # IAU 2012 astronomical unit, exact
+AU_LS = AU_M / C_M_PER_S  # AU in light-seconds ~ 499.004784
+SECS_PER_DAY = 86400.0
+DAYS_PER_JULIAN_YEAR = 365.25
+SECS_PER_JULIAN_YEAR = SECS_PER_DAY * DAYS_PER_JULIAN_YEAR
+
+# MJD epochs
+MJD_J2000 = 51544.5  # TT epoch J2000.0 as an MJD
+MJD_UNIX_EPOCH = 40587.0
+
+# TEMPO-compatible dispersion constant, s MHz^2 / (pc cm^-3).  The reference
+# deliberately uses 1/2.41e-4 instead of the CODATA e^2/(2 pi m_e c) value for
+# TEMPO heritage compatibility (pint/__init__.py, "DMconst").
+DMCONST = 1.0 / 2.41e-4  # = 4149.377593360996
+
+# Solar-system GM / c^3 "mass in time units" (seconds).  Used by the Shapiro
+# delay and binary post-Keplerian physics.  GM values are the DE-series /
+# IAU-2015 nominal ones (public constants, not taken from the reference).
+GM_SUN = 1.32712440041279419e20  # m^3/s^2 (DE440 heliocentric)
+TSUN_S = GM_SUN / C_M_PER_S**3  # ~4.92549e-6 s
+
+# GM per body in m^3/s^2 (DE440 nominal values).
+GM_BODY = {
+    "mercury": 2.2031868551e13,
+    "venus": 3.24858592e14,
+    "earth": 3.98600435507e14,
+    "moon": 4.902800118e12,
+    "mars": 4.2828375816e13,  # mars system
+    "jupiter": 1.26712764100e17,  # jupiter system
+    "saturn": 3.7940584841800e16,  # saturn system
+    "uranus": 5.794556400e15,
+    "neptune": 6.8365271005800e15,
+}
+TBODY_S = {k: v / C_M_PER_S**3 for k, v in GM_BODY.items()}
+
+# Earth/Moon mass ratio (DE440)
+EARTH_MOON_MASS_RATIO = 81.3005682214972154
+
+# IAU 2006 obliquity of the ecliptic at J2000, arcseconds
+OBLIQUITY_J2000_ARCSEC = 84381.406
+
+from pint_tpu.utils.logging import get_logger  # noqa: E402
+
+log = get_logger("pint_tpu")

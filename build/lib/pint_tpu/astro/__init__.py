@@ -1,0 +1,20 @@
+"""Host-side astronomy environment (SURVEY.md L1): time scales, solar-system
+ephemerides, Earth rotation, observatories, and clock-correction chains.
+
+This subsystem is self-contained — unlike the reference, which delegates to
+astropy/erfa/jplephem, everything here is implemented from public algorithms
+and constants (IAU series, JPL approximate elements, IERS conventions) in
+numpy. Where ns-grade external data would be needed (JPL .bsp kernels, IERS
+EOP tables, observatory clock files) the interfaces accept user-supplied
+files; the built-in analytic fallbacks are documented with their accuracy.
+
+All work here is once-per-dataset host preparation; the output is the dense
+TOA tensor consumed by the jitted device code.
+"""
+
+from pint_tpu.astro.time import (  # noqa: F401
+    MJDEpoch,
+    tai_minus_utc,
+    tdb_minus_tt,
+    utc_to_tdb,
+)

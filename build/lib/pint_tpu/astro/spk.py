@@ -1,0 +1,184 @@
+"""Clean-room reader for JPL SPK (DAF) ephemeris kernels, types 2 and 3.
+
+Replaces the reference's jplephem dependency (reference
+solar_system_ephemerides.py:73 load_kernel). Implemented from the public
+NAIF/SPICE "DAF Required Reading" format description: a DAF file is a chain
+of 1024-byte records; the file record carries ND/NI and the first summary
+record pointer; each summary holds ND=2 doubles (segment start/stop epoch,
+TDB seconds past J2000) and NI=6 ints (target, center, frame, type, initial
+and final word addresses). Type-2 segments store Chebyshev coefficients for
+position (velocity by differentiating the polynomial); type-3 store both.
+
+Positions return in meters (kernels store km), ICRS axes, wrt the segment
+center; `SPKEphemeris` composes segments to the SSB like the reference's
+objPosVel_wrt_SSB (solar_system_ephemerides.py:133).
+"""
+
+from __future__ import annotations
+
+import struct
+
+import numpy as np
+
+RECLEN = 1024
+
+NAIF_IDS = {
+    "mercury": 1,
+    "venus": 2,
+    "emb": 3,
+    "mars": 4,
+    "jupiter": 5,
+    "saturn": 6,
+    "uranus": 7,
+    "neptune": 8,
+    "pluto": 9,
+    "sun": 10,
+    "moon": 301,
+    "earth": 399,
+    "ssb": 0,
+}
+# barycenter id -> representative body id for composing chains
+_BARY_FALLBACK = {4: 499, 5: 599, 6: 699, 7: 799, 8: 899}
+
+
+class SPKSegment:
+    def __init__(self, daf, target, center, frame, dtype, start_et, stop_et, ia, fa):
+        self.daf = daf
+        self.target = target
+        self.center = center
+        self.frame = frame
+        self.dtype = dtype
+        self.start_et = start_et
+        self.stop_et = stop_et
+        self.ia = ia
+        self.fa = fa
+        # segment trailer: INIT, INTLEN, RSIZE, N  (last 4 doubles)
+        init, intlen, rsize, n = daf.read_doubles(fa - 3, 4)
+        self.init = init
+        self.intlen = intlen
+        self.rsize = int(rsize)
+        self.n = int(n)
+        if dtype == 2:
+            self.ncoef = (self.rsize - 2) // 3
+            self.ncomp = 3
+        elif dtype == 3:
+            self.ncoef = (self.rsize - 2) // 6
+            self.ncomp = 6
+        else:
+            raise NotImplementedError(f"SPK data type {dtype} not supported")
+
+    def posvel(self, et: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+        """(pos[m], vel[m/s]) of target wrt center at TDB sec past J2000."""
+        et = np.atleast_1d(np.asarray(et, np.float64))
+        idx = np.clip(((et - self.init) / self.intlen).astype(np.int64), 0, self.n - 1)
+        pos = np.empty(et.shape + (3,))
+        vel = np.empty(et.shape + (3,))
+        # group by record for vectorized Chebyshev evaluation
+        for rec in np.unique(idx):
+            sel = idx == rec
+            words = self.daf.read_doubles(self.ia + rec * self.rsize, self.rsize)
+            mid, radius = words[0], words[1]
+            coeffs = np.asarray(words[2:]).reshape(self.ncomp, self.ncoef)
+            tau = (et[sel] - mid) / radius
+            T, dT = _cheby_and_deriv(tau, self.ncoef)
+            if self.dtype == 2:
+                pos[sel] = (T @ coeffs[:3].T) * 1e3
+                vel[sel] = (dT @ coeffs[:3].T) / radius * 1e3
+            else:
+                pos[sel] = (T @ coeffs[:3].T) * 1e3
+                vel[sel] = (T @ coeffs[3:].T) * 1e3
+        return pos, vel
+
+
+def _cheby_and_deriv(tau: np.ndarray, n: int) -> tuple[np.ndarray, np.ndarray]:
+    T = np.empty(tau.shape + (n,))
+    dT = np.empty_like(T)
+    T[..., 0] = 1.0
+    dT[..., 0] = 0.0
+    if n > 1:
+        T[..., 1] = tau
+        dT[..., 1] = 1.0
+    for k in range(2, n):
+        T[..., k] = 2 * tau * T[..., k - 1] - T[..., k - 2]
+        dT[..., k] = 2 * T[..., k - 1] + 2 * tau * dT[..., k - 1] - dT[..., k - 2]
+    return T, dT
+
+
+class DAF:
+    def __init__(self, path: str):
+        with open(path, "rb") as f:
+            self.data = f.read()
+        locidw = self.data[:8].decode("ascii", "replace")
+        if not locidw.startswith(("DAF/SPK", "NAIF/DAF")):
+            raise ValueError(f"not an SPK kernel: id word {locidw!r}")
+        locfmt = self.data[88:96].decode("ascii", "replace")
+        self.endian = "<" if "LTL" in locfmt else ">"
+        (self.nd,) = struct.unpack_from(self.endian + "i", self.data, 8)
+        (self.ni,) = struct.unpack_from(self.endian + "i", self.data, 12)
+        (self.fward,) = struct.unpack_from(self.endian + "i", self.data, 76)
+        (self.bward,) = struct.unpack_from(self.endian + "i", self.data, 80)
+
+    def read_doubles(self, word_addr: int, n: int) -> np.ndarray:
+        """Read n doubles starting at 1-based word address."""
+        off = (word_addr - 1) * 8
+        return np.frombuffer(self.data, dtype=self.endian + "f8", count=n, offset=off)
+
+    def summaries(self):
+        ss = self.nd + (self.ni + 1) // 2  # summary size in doubles
+        rec = self.fward
+        while rec:
+            base = (rec - 1) * RECLEN
+            nxt, _prev, nsum = struct.unpack_from(self.endian + "ddd", self.data, base)
+            for i in range(int(nsum)):
+                off = base + 24 + i * ss * 8
+                dbls = struct.unpack_from(self.endian + f"{self.nd}d", self.data, off)
+                ints = struct.unpack_from(
+                    self.endian + f"{self.ni}i", self.data, off + self.nd * 8
+                )
+                yield dbls, ints
+            rec = int(nxt)
+
+
+class SPKEphemeris:
+    """JPL kernel-backed ephemeris with the same surface as
+    AnalyticEphemeris (pos_ssb / posvel_ssb in meters, ICRS)."""
+
+    def __init__(self, path: str):
+        self.daf = DAF(path)
+        self.segments: dict[tuple[int, int], SPKSegment] = {}
+        for (start, stop), (t, c, frame, dtype, ia, fa) in self.daf.summaries():
+            seg = SPKSegment(self.daf, t, c, frame, dtype, start, stop, ia, fa)
+            self.segments[(t, c)] = seg
+        self.name = f"spk:{path}"
+
+    def _chain(self, body_id: int) -> list[tuple[SPKSegment, float]]:
+        """Segments composing body -> SSB with signs."""
+        chain = []
+        cur = body_id
+        guard = 0
+        while cur != 0 and guard < 5:
+            nxt = None
+            for (t, c), seg in self.segments.items():
+                if t == cur:
+                    chain.append((seg, +1.0))
+                    nxt = c
+                    break
+            if nxt is None:
+                raise KeyError(f"no segment chain from body {body_id} to SSB")
+            cur = nxt
+            guard += 1
+        return chain
+
+    def posvel_ssb(self, body: str, tdb_jcent: np.ndarray, dt_s: float = 0.0):
+        et = np.asarray(tdb_jcent, np.float64) * 36525.0 * 86400.0
+        bid = NAIF_IDS[body]
+        pos = 0.0
+        vel = 0.0
+        for seg, sign in self._chain(bid):
+            p, v = seg.posvel(et)
+            pos = pos + sign * p
+            vel = vel + sign * v
+        return pos, vel
+
+    def pos_ssb(self, body: str, tdb_jcent: np.ndarray) -> np.ndarray:
+        return self.posvel_ssb(body, tdb_jcent)[0]

@@ -1,0 +1,138 @@
+"""Bayesian timing: jitted ln-prior / ln-likelihood / ln-posterior.
+
+Reference: pint/bayesian.py (BayesianTiming:12 — lnprior, lnlikelihood,
+lnposterior, prior_transform over the free parameters). TPU re-design:
+
+- sampling happens in DELTA space: a walker position is an f64 offset
+  vector about the model's reference parameter values, applied through
+  `apply_delta` so extended-precision (dd/qf) leaves keep their low bits —
+  the same mechanism the fitters use;
+- the ln-posterior is ONE jitted function of the delta vector; the
+  ensemble sampler vmaps it over walkers, so a whole MCMC step is a single
+  compiled program (pint_tpu/sampler.py).
+
+White-noise models use the scaled-sigma chi^2; correlated-noise models use
+the Woodbury-marginalized GLS chi^2 — both reuse the fitters' machinery.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pint_tpu.fitting.wls import apply_delta
+from pint_tpu.priors import default_prior
+from pint_tpu.residuals import Residuals, phase_residual_frac
+
+
+class BayesianTiming:
+    """Posterior over the model's free parameters given prepared TOAs.
+
+    Priors default to the reference's parfile-driven uniform windows
+    (pint_tpu/priors.py); pass `priors={name: prior}` to override.
+    """
+
+    def __init__(self, toas, model, priors: dict | None = None):
+        self.toas = toas
+        self.model = model
+        self.resids = Residuals(toas, model)
+        self.free = tuple(model.free_params)
+        self.scales = np.array(
+            [model.param_meta[n].uncertainty or 1e-12 for n in self.free]
+        )
+        self._params0 = model.xprec.convert_params(model.params)
+        self.priors = {}
+        for n in self.free:
+            pm = model.param_meta[n]
+            v = _leaf_float(model.params[n])
+            self.priors[n] = (priors or {}).get(n) or default_prior(v, pm.uncertainty)
+        self._lnpost = self._build()
+
+    def _build(self):
+        model = self.model
+        r = self.resids
+        free = self.free
+        params0 = self._params0
+        tensor = r.tensor
+        correlated = model.has_correlated_errors
+        # sigma is computed IN-GRAPH from the (possibly sampled) noise
+        # parameters: EFAC/EQUAD in the free set change the likelihood,
+        # including its normalization
+        has_noise = bool(model.noise_components)
+        sigma_fixed = jnp.asarray(r.errors_s)
+        n_toa = sigma_fixed.shape[0]
+        track_pn, delta_pn, weights = r._track_pn, r._delta_pn, r._weights
+        subtract_mean = r.subtract_mean
+        prior_list = [self.priors[n] for n in free]
+        v0 = jnp.asarray([_leaf_float(self.model.params[n]) for n in free])
+
+        def lnprior(delta):
+            x = v0 + delta
+            lp = 0.0
+            for i, pr in enumerate(prior_list):
+                lp = lp + pr.logpdf(x[i])
+            return lp
+
+        def lnlike(delta):
+            pp = apply_delta(params0, free, delta)
+            _, rr, f = phase_residual_frac(
+                model, pp, tensor,
+                track_pn=track_pn, delta_pn=delta_pn,
+                subtract_mean=subtract_mean, weights=weights,
+            )
+            rt = rr / f
+            sigma = model.scaled_sigma(pp, tensor) if has_noise else sigma_fixed
+            lognorm = -jnp.sum(jnp.log(sigma)) - 0.5 * n_toa * jnp.log(2 * jnp.pi)
+            if not correlated:
+                return -0.5 * jnp.sum((rt / sigma) ** 2) + lognorm
+            # Woodbury-marginalized likelihood over the structured noise
+            # basis (fitting/woodbury.py); logdet_C carries the
+            # phi-dependent pieces so noise-parameter sampling stays correct
+            from pint_tpu.fitting.woodbury import (
+                logdet_C, s_factor, woodbury_chi2,
+            )
+
+            cinv = 1.0 / sigma**2
+            basis = model.noise_basis_and_weights(pp, tensor)
+            if basis is None:  # e.g. ECORR whose masks bind no epochs
+                return -0.5 * jnp.sum((rt / sigma) ** 2) + lognorm
+            sf = s_factor(basis, cinv)
+            chi2, _ = woodbury_chi2(basis, cinv, rt, sf=sf)
+            # logdet_C includes the white -sum(log w) term, replacing the
+            # white branch's -sum(log sigma) half of lognorm
+            return -0.5 * (
+                chi2 + logdet_C(basis, cinv, sf) + n_toa * jnp.log(2 * jnp.pi)
+            )
+
+        def lnpost(delta):
+            lp = lnprior(delta)
+            ll = jnp.where(jnp.isfinite(lp), lnlike(delta), 0.0)
+            return lp + ll
+
+        return lnpost
+
+    # --- public API (reference bayesian.py surface) ----------------------------
+
+    def lnprior(self, delta: np.ndarray) -> float:
+        x = np.atleast_1d(np.asarray(delta, float))
+        v0 = np.array([_leaf_float(self.model.params[n]) for n in self.free])
+        return float(sum(p.logpdf(v0[i] + x[i]) for i, p in enumerate([self.priors[n] for n in self.free])))
+
+    def lnposterior(self, delta) -> float:
+        return float(self._lnpost(jnp.asarray(delta)))
+
+    @property
+    def nparams(self) -> int:
+        return len(self.free)
+
+    def lnpost_fn(self):
+        """The jittable delta -> ln posterior callable (for samplers)."""
+        return self._lnpost
+
+
+def _leaf_float(v) -> float:
+    """Collapse any parameter leaf (DD, QF, plain) to a host float."""
+    from pint_tpu.models.base import leaf_to_f64
+
+    return float(np.asarray(leaf_to_f64(v)))

@@ -1,0 +1,381 @@
+"""Conversion between binary model parameterizations.
+
+Reference: pint/binaryconvert.py (convert_binary:536 — any of
+BT/DD/DDS/DDK/ELL1/ELL1H/ELL1k to any other; DDGR accepted as INPUT only,
+"there is not a well-defined way to get a unique output" :29). Operates in
+place on our TimingModel: swaps the PulsarBinary component's engine
+configuration and maps the parameter set.
+
+    ELL1 -> DD/BT:  ECC = hypot(EPS1, EPS2), OM = atan2(EPS1, EPS2),
+                    T0 = TASC + OM/(2 pi) * PB
+    DD/BT -> ELL1:  EPS1 = ECC sin OM, EPS2 = ECC cos OM,
+                    TASC = T0 - OM/(2 pi) * PB
+    ELL1H -> ELL1:  SINI = 2 STIG/(1+STIG^2), M2 = H3/(Tsun STIG^3)
+    DD <-> DDS:     SHAPMAX = -ln(1 - SINI)
+    DD -> DDK:      KIN = arcsin(SINI) (convention caveat as the
+                    reference: 180 deg - KIN is equally valid), KOM given
+    DDGR -> *:      post-Keplerian set derived from (MTOT, M2) under GR
+
+Uncertainty propagation: the reference threads every transformation
+through the `uncertainties` package; here each transform is a jax scalar
+function and the output sigmas come from its autodiff jacobian (diagonal
+input covariance, like the reference).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pint_tpu import TSUN_S
+from pint_tpu.models.base import leaf_to_f64
+from pint_tpu.models.binary import PulsarBinary
+from pint_tpu.models.parameter import ParamValueMeta
+from pint_tpu.ops.dd import DD, device_split
+from pint_tpu.utils.logging import get_logger
+
+log = get_logger("pint_tpu.binaryconvert")
+
+_ECCENTRIC = ("BT", "DD", "DDS", "DDK", "DDGR")
+_ELL1_LIKE = ("ELL1", "ELL1H", "ELL1K")
+
+
+def _f(model, name, default=0.0):
+    v = model.params.get(name)
+    return default if v is None else float(np.asarray(leaf_to_f64(v)))
+
+
+def _u(model, name):
+    pm = model.param_meta.get(name)
+    return None if pm is None else pm.uncertainty
+
+
+def propagate(fn, vals, uncs):
+    """(outputs, output_sigmas): evaluate the jnp transform and push the
+    diagonal input sigmas through its jacobian (autodiff replaces the
+    reference's `uncertainties`-package bookkeeping)."""
+    x = jnp.asarray([float(v) for v in vals], jnp.float64)
+
+    def f(x):
+        out = fn(*x)
+        if not isinstance(out, (tuple, list)):
+            out = (out,)
+        return jnp.stack([jnp.asarray(v, jnp.float64) for v in out])
+
+    y = np.asarray(f(x)).ravel()
+    if not any(u is not None for u in uncs):
+        return y, [None] * y.size
+    J = np.asarray(jax.jacfwd(f)(x)).reshape(y.size, x.size)
+    s = np.asarray([u if u is not None else 0.0 for u in uncs])
+    return y, list(np.sqrt((J**2) @ s**2))
+
+
+def _set(model, comp, name, value, unc=None, frozen=None):
+    spec = comp.specs.get(name)
+    if spec is None:
+        raise KeyError(f"{comp.model_name} has no parameter {name}")
+    if spec.kind in ("dd", "epoch"):
+        if isinstance(value, DD):
+            model.params[name] = value
+        else:
+            hi, lo = device_split(np.float64(value), np.float64(0.0))
+            model.params[name] = DD(np.float64(hi), np.float64(lo))
+    else:
+        model.params[name] = float(value)
+    pm = model.param_meta.get(name)
+    was_frozen = pm.frozen if pm is not None else True
+    model.param_meta[name] = ParamValueMeta(
+        spec=spec,
+        frozen=was_frozen if frozen is None else frozen,
+        uncertainty=None if unc is None else float(unc),
+    )
+
+
+def _drop(model, *names):
+    for n in names:
+        if n:
+            model.params.pop(n, None)
+            model.param_meta.pop(n, None)
+
+
+def _epoch_dd(model, name):
+    v = model.params[name]
+    return v if isinstance(v, DD) else DD(np.float64(float(v)), np.float64(0.0))
+
+
+def _dd_shift(v: DD, shift_s: float) -> DD:
+    from pint_tpu.ops.dd import dd_add_fp
+
+    out = dd_add_fp(v, np.float64(shift_s))
+    hi, lo = device_split(np.float64(np.asarray(out.hi)), np.float64(np.asarray(out.lo)))
+    return DD(np.float64(hi), np.float64(lo))
+
+
+def _pb_seconds(model):
+    pb = _f(model, "PB")
+    if pb == 0.0 and "FB0" in model.params:
+        pb = 1.0 / _f(model, "FB0")
+    return pb
+
+
+def _ddgr_to_pk(model):
+    """Materialize the GR-derived PK parameters (+ sigmas) of a DDGR model
+    (reference _DDGR_to_PK, binaryconvert.py:427) via the same closed
+    expressions the DDGR engine integrates (engines.ddgr_derived)."""
+    from pint_tpu.models.binaries.engines import ddgr_derived
+
+    names = ("MTOT", "M2", "ECC", "A1", "PB", "XOMDOT")
+    keys = ("OMDOT", "GAMMA", "PBDOT", "SINI", "DR", "DTH")
+
+    def fn(mtot, m2, ecc, a1, pb, xomdot):
+        d = ddgr_derived({
+            "MTOT": mtot, "M2": m2, "ECC": ecc, "A1": a1, "PB": pb,
+            "XOMDOT": xomdot,
+        })
+        return tuple(d[k] for k in keys)
+
+    vals, uncs = propagate(
+        fn, [_f(model, n) for n in names], [_u(model, n) for n in names]
+    )
+    return dict(zip(keys, zip(vals, uncs)))
+
+
+def convert_binary(model, target: str, kom_deg: float = 0.0):
+    """In-place conversion of the model's binary to `target` (reference
+    convert_binary:536). `kom_deg` seeds KOM for a DDK target. Returns the
+    model for chaining."""
+    target = target.upper()
+    old = next((c for c in model.components if isinstance(c, PulsarBinary)), None)
+    if old is None:
+        raise ValueError("model has no binary component")
+    src = old.model_name.upper()
+    if src == target:
+        return model
+    if target == "DDGR":
+        raise NotImplementedError(
+            "DDGR output is not well-defined (reference binaryconvert.py:29)"
+        )
+
+    pb_s = _pb_seconds(model)
+    new = PulsarBinary(target)
+    model.components[model.components.index(old)] = new
+
+    # --- DDGR input: materialize its PK set first, then treat as DD ----------
+    if src == "DDGR":
+        pk = _ddgr_to_pk(model)
+        xpbdot, s_xpbdot = _f(model, "XPBDOT", 0.0), _u(model, "XPBDOT")
+        _drop(model, "MTOT", "XOMDOT", "XPBDOT")
+        src = "DD"
+        for k in ("OMDOT", "GAMMA", "PBDOT", "SINI", "DR", "DTH"):
+            v, s = pk[k]
+            if k in new.specs:
+                _set(model, new, k, v, unc=s, frozen=True)
+            elif k == "SINI":
+                # not in a DDS/DDK target's spec table: stage it for
+                # _retarget_incl to map to SHAPMAX/KIN
+                from pint_tpu.models.parameter import ParamSpec
+
+                model.params[k] = float(v)
+                model.param_meta[k] = ParamValueMeta(
+                    spec=ParamSpec(k, unit=""), frozen=True, uncertainty=s,
+                )
+            else:
+                log.warning(
+                    f"DDGR-derived {k} = {float(v):.3e} has no slot in "
+                    f"BINARY {target}; dropped"
+                )
+        if xpbdot and "XPBDOT" in new.specs:
+            # the engine applied PBDOT_GR + XPBDOT; the target carries the
+            # excess explicitly (every model's common specs include it)
+            _set(model, new, "XPBDOT", xpbdot, unc=s_xpbdot, frozen=True)
+
+    # --- eccentric <-> ELL1-like --------------------------------------------
+    if src in _ECCENTRIC and target in _ELL1_LIKE:
+        ecc, om = _f(model, "ECC"), _f(model, "OM")
+        (eps1, eps2), (s1, s2) = propagate(
+            lambda e, w: (e * jnp.sin(w), e * jnp.cos(w)),
+            [ecc, om], [_u(model, "ECC"), _u(model, "OM")],
+        )
+        # reference: EPS frozen if EITHER source param is (binaryconvert)
+        frozen_e = (
+            model.param_meta.get("ECC", ParamValueMeta(spec=None)).frozen
+            or model.param_meta.get("OM", ParamValueMeta(spec=None)).frozen
+        )
+        _set(model, new, "EPS1", eps1, unc=s1, frozen=frozen_e)
+        _set(model, new, "EPS2", eps2, unc=s2, frozen=frozen_e)
+        tasc = _dd_shift(_epoch_dd(model, "T0"), -om / (2 * np.pi) * pb_s)
+        # sigma(TASC) from the (T0, OM, PB) jacobian
+        _, (st,) = propagate(
+            lambda t0, w, pb: t0 - w / (2 * jnp.pi) * pb,
+            [0.0, om, pb_s],
+            [_u(model, "T0"), _u(model, "OM"), _u(model, "PB")],
+        )
+        model.params["TASC"] = tasc
+        model.param_meta["TASC"] = ParamValueMeta(
+            spec=new.specs["TASC"],
+            frozen=model.param_meta["T0"].frozen,
+            uncertainty=st,
+        )
+        _drop(model, "ECC", "OM", "T0", "EDOT",
+              "OMDOT" if target != "ELL1K" else "")
+        _retarget_incl(model, new, target, kom_deg)
+        if target == "ELL1H":
+            _to_h3_stigma(model, new)
+    elif src in _ELL1_LIKE and target in _ECCENTRIC:
+        if src == "ELL1H":
+            _from_h3_stigma(model)
+        eps1, eps2 = _f(model, "EPS1"), _f(model, "EPS2")
+        (ecc, om), (se, so) = propagate(
+            lambda e1, e2: (jnp.hypot(e1, e2), jnp.arctan2(e1, e2)),
+            [eps1, eps2], [_u(model, "EPS1"), _u(model, "EPS2")],
+        )
+        om = float(om) % (2 * np.pi)
+        frozen_e = (
+            model.param_meta.get("EPS1", ParamValueMeta(spec=None)).frozen
+            or model.param_meta.get("EPS2", ParamValueMeta(spec=None)).frozen
+        )
+        _set(model, new, "ECC", ecc, unc=se, frozen=frozen_e)
+        _set(model, new, "OM", om, unc=so, frozen=frozen_e)
+        t0 = _dd_shift(_epoch_dd(model, "TASC"), om / (2 * np.pi) * pb_s)
+        _, (st,) = propagate(
+            lambda ta, w, pb: ta + w / (2 * jnp.pi) * pb,
+            [0.0, om, pb_s],
+            [_u(model, "TASC"), so, _u(model, "PB")],
+        )
+        model.params["T0"] = t0
+        model.param_meta["T0"] = ParamValueMeta(
+            spec=new.specs["T0"],
+            frozen=model.param_meta["TASC"].frozen,
+            uncertainty=st,
+        )
+        _drop(model, "EPS1", "EPS2", "TASC", "EPS1DOT", "EPS2DOT", "LNEDOT")
+        _retarget_incl(model, new, target, kom_deg)
+    elif src == "ELL1H" and target in ("ELL1", "ELL1K"):
+        _from_h3_stigma(model)
+        _drop(model, "H3", "H4", "STIGMA", "NHARMS")
+    elif src in ("ELL1", "ELL1K") and target == "ELL1H":
+        _to_h3_stigma(model, new)
+    elif src in _ECCENTRIC and target in _ECCENTRIC:
+        _retarget_incl(model, new, target, kom_deg)
+    elif src in _ELL1_LIKE and target in _ELL1_LIKE:
+        pass
+    else:
+        raise NotImplementedError(f"conversion {src} -> {target}")
+
+    model.meta["BINARY"] = target
+    model.clear_caches()  # jitted programs captured the old component
+    new.validate(model.params, model.meta)
+    log.info(f"converted binary {old.model_name} -> {target}")
+    return model
+
+
+def _retarget_incl(model, new, target, kom_deg):
+    """Map the inclination parameterization between eccentric flavors:
+    SINI <-> SHAPMAX (DDS) <-> KIN/KOM (DDK)."""
+    # the source's frozen state, captured BEFORE any _drop below
+    frz = _was_free_incl(model)
+    # normalize to SINI first
+    sini = s_sini = None
+    if "SHAPMAX" in model.params:
+        (sini,), (s_sini,) = propagate(
+            lambda s: 1.0 - jnp.exp(-s),
+            [_f(model, "SHAPMAX")], [_u(model, "SHAPMAX")],
+        )
+        _drop(model, "SHAPMAX")
+    elif "KIN" in model.params:
+        (sini,), (s_sini,) = propagate(
+            lambda k: jnp.sin(k), [_f(model, "KIN")], [_u(model, "KIN")],
+        )
+        _drop(model, "KIN", "KOM")
+    elif "SINI" in model.params:
+        sini, s_sini = _f(model, "SINI"), _u(model, "SINI")
+
+    if sini is None:
+        return
+    if target == "DDS":
+        (sm,), (ssm,) = propagate(
+            lambda s: -jnp.log(1.0 - s), [sini], [s_sini],
+        )
+        _set(model, new, "SHAPMAX", sm, unc=ssm, frozen=frz)
+        _drop(model, "SINI")
+    elif target == "DDK":
+        # convention caveat exactly as the reference warns: KIN and
+        # 180 deg - KIN are equally consistent with SINI
+        (kin,), (skin,) = propagate(
+            lambda s: jnp.arcsin(s), [sini], [s_sini],
+        )
+        log.warning(
+            "Using KIN = arcsin(SINI); 180 deg - KIN is an equally valid "
+            "solution (reference binaryconvert.py caveat)"
+        )
+        _set(model, new, "KIN", kin, unc=skin, frozen=frz)
+        _set(model, new, "KOM", np.deg2rad(kom_deg), frozen=True)
+        _drop(model, "SINI")
+    elif target == "BT":
+        _drop(model, "SINI", "M2")
+    else:  # DD keeps SINI
+        if "SINI" not in model.params and sini is not None:
+            _set(model, new, "SINI", sini, unc=s_sini, frozen=frz)
+
+
+def _was_free_incl(model):
+    for n in ("SINI", "SHAPMAX", "KIN"):
+        pm = model.param_meta.get(n)
+        if pm is not None:
+            return pm.frozen
+    return True
+
+
+def _from_h3_stigma(model):
+    """ELL1H orthometric (H3, STIGMA/H4) -> (M2, SINI) in place, with
+    propagated sigmas (Freire & Wex 2010 eqs 20-22)."""
+    h3 = _f(model, "H3")
+    stig = _f(model, "STIGMA")
+    if stig == 0.0 and "H4" in model.params and h3:
+        stig = _f(model, "H4") / h3
+    if not stig:
+        _drop(model, "H3", "H4", "STIGMA", "NHARMS")
+        return
+    (sini, m2), (ss, sm) = propagate(
+        lambda h, st: (2 * st / (1 + st**2), h / (TSUN_S * st**3)),
+        [h3, stig], [_u(model, "H3"), _u(model, "STIGMA")],
+    )
+    model.params["SINI"] = float(sini)
+    model.params["M2"] = float(m2)
+    spec_src = next(c for c in model.components if isinstance(c, PulsarBinary))
+    for n, v, s in (("SINI", sini, ss), ("M2", m2, sm)):
+        spec = spec_src.specs.get(n)
+        if spec is None:
+            from pint_tpu.models.parameter import ParamSpec
+
+            spec = ParamSpec(n, unit="")
+        model.param_meta[n] = ParamValueMeta(
+            spec=spec, frozen=model.param_meta.get("H3", ParamValueMeta(spec=None)).frozen,
+            uncertainty=s,
+        )
+    _drop(model, "H3", "H4", "STIGMA", "NHARMS")
+
+
+def _to_h3_stigma(model, new):
+    """(M2, SINI) -> orthometric (H3, STIGMA) in place."""
+    m2, sini = _f(model, "M2"), _f(model, "SINI")
+    if m2 and sini:
+        # the engine must evaluate the exact STIGMA form, not the
+        # truncated 3-harmonic H3-only expansion (the builder keys this
+        # off STIGMA presence; mirror it here)
+        new.h_mode = "stigma"
+        (h3, stig), (sh, sst) = propagate(
+            lambda m, s: (
+                TSUN_S * m * (s / (1 + jnp.sqrt(1 - s**2))) ** 3,
+                s / (1 + jnp.sqrt(1 - s**2)),
+            ),
+            [m2, sini], [_u(model, "M2"), _u(model, "SINI")],
+        )
+        frz = (
+            model.param_meta.get("M2", ParamValueMeta(spec=None)).frozen
+            or model.param_meta.get("SINI", ParamValueMeta(spec=None)).frozen
+        )
+        _set(model, new, "H3", h3, unc=sh, frozen=frz)
+        _set(model, new, "STIGMA", stig, unc=sst, frozen=frz)
+    _drop(model, "M2", "SINI")

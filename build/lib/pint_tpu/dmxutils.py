@@ -1,0 +1,99 @@
+"""DMX helpers: window planning and post-fit extraction.
+
+Reference: pint/utils.py (dmx_ranges:716 — propose DMX windows covering the
+TOAs; dmxparse:893 — pull fitted DMX values/errors/epochs with the
+covariance-corrected uncertainties used by NANOGrav).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from pint_tpu.utils.logging import get_logger
+
+log = get_logger("pint_tpu.dmx")
+
+
+def dmx_ranges(toas, bin_width_d: float = 6.5, pad_d: float = 0.05):
+    """Greedy DMX windows covering every TOA (reference dmx_ranges:716
+    semantics: consecutive TOAs group until the window would exceed
+    bin_width days). Returns [(r1, r2), ...] MJD pairs."""
+    mjd = np.sort(toas.tdb.mjd_float())
+    bounds = []
+    start = prev = mjd[0]
+    for t in mjd[1:]:
+        if t - start > bin_width_d:
+            bounds.append((start, prev))
+            start = t
+        prev = t
+    bounds.append((start, prev))
+    # pad, clamping to half the gap between neighbors so windows never
+    # overlap (overlap would double-apply DM to boundary TOAs)
+    ranges = []
+    for i, (a, b) in enumerate(bounds):
+        lo_pad = pad_d if i == 0 else min(pad_d, (a - bounds[i - 1][1]) / 2.0)
+        hi_pad = pad_d if i == len(bounds) - 1 else min(pad_d, (bounds[i + 1][0] - b) / 2.0)
+        ranges.append((a - lo_pad, b + hi_pad))
+    return ranges
+
+
+def add_dmx_to_model(model, ranges) -> None:
+    """Install DMX windows (all values 0, free) on a model (reference
+    utils.dmx_setup flow)."""
+    from pint_tpu.models.dispersion import DispersionDMX
+    from pint_tpu.models.parameter import ParamValueMeta
+
+    comp = next((c for c in model.components if isinstance(c, DispersionDMX)), None)
+    if comp is None:
+        comp = DispersionDMX()
+        model.components.append(comp)
+        from pint_tpu.models.base import DEFAULT_ORDER
+
+        order = {cat: i for i, cat in enumerate(DEFAULT_ORDER)}
+        model.components.sort(key=lambda c: order.get(c.category, 99))
+    for i, (r1, r2) in enumerate(ranges, start=1):
+        comp.add_window(i, float(r1), float(r2))
+        spec = comp.specs[f"DMX_{i:04d}"]
+        model.params[spec.name] = 0.0
+        model.param_meta[spec.name] = ParamValueMeta(spec=spec, frozen=False)
+    model.clear_caches()  # structural change: new component/columns
+
+
+def dmxparse(fitter) -> dict:
+    """Fitted DMX time series with covariance-corrected errors (reference
+    dmxparse:893: verr_i = sqrt(var_i + mean-DMX variance - 2 cov_i,mean),
+    accounting for the overall-DM degeneracy)."""
+    model = fitter.model
+    res = fitter.result
+    if res is None:
+        raise RuntimeError("run fit_toas first")
+    from pint_tpu.models.dispersion import DispersionDMX
+
+    comp = next((c for c in model.components if isinstance(c, DispersionDMX)), None)
+    if comp is None:
+        raise ValueError("model has no DMX component")
+    idxs = comp.sorted_indices
+    names = [f"DMX_{i:04d}" for i in idxs]
+    free = list(res.free_params)
+    vals = np.array([float(np.asarray(model.params[n])) for n in names])
+    r1 = np.array([comp.windows[i][0] for i in idxs])
+    r2 = np.array([comp.windows[i][1] for i in idxs])
+    eps = 0.5 * (r1 + r2)
+    out = {
+        "dmxs": vals,
+        "dmx_epochs": eps,
+        "r1s": r1,
+        "r2s": r2,
+        "dmx_verrs": np.full(len(names), np.nan),
+        "mean_dmx": float(np.mean(vals)),
+    }
+    if res.covariance is not None and all(n in free for n in names):
+        ii = np.array([free.index(n) for n in names])
+        C = res.covariance[np.ix_(ii, ii)]
+        var = np.diag(C)
+        # variance of the mean and covariance of each with the mean
+        var_mean = float(np.sum(C)) / len(names) ** 2
+        cov_with_mean = np.sum(C, axis=1) / len(names)
+        out["dmx_verrs"] = np.sqrt(var + var_mean - 2.0 * cov_with_mean)
+        out["mean_dmx_verr"] = float(np.sqrt(var_mean))
+    return out

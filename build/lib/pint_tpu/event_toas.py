@@ -1,0 +1,212 @@
+"""Photon-event TOAs from high-energy mission FITS files.
+
+Reference: pint/event_toas.py (load_NICER_TOAs / load_RXTE_TOAs /
+load_NuSTAR_TOAs / load_event_TOAs:244-522) and pint/fermi_toas.py
+(load_Fermi_TOAs:145 with photon weights). Event times are mission-elapsed
+seconds converted with the header's MJDREF(I/F)+TIMEZERO; the resulting
+TOAs carry zero error and per-photon flags (energy, weights).
+
+Supported geometries:
+- barycentered events (TIMESYS TDB): observatory 'barycenter';
+- geocentered events (TIMESYS TT, TIMEREF GEOCENTRIC): 'geocenter_tt' —
+  the TT timescale bypasses the UTC clock chain (astro/observatories.py);
+- spacecraft-frame events (TIMEREF LOCAL) with an `orbitfile` (Fermi FT2 /
+  orbit table): a satellite observatory reconstructed from the orbit data
+  (astro/satellite_obs.py).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from pint_tpu.io.fitsio import find_extension, read_fits
+from pint_tpu.utils.logging import get_logger
+
+log = get_logger("pint_tpu.event_toas")
+
+# per-mission energy conversion: PHA/PI channel -> keV (reference
+# event_toas.py mission tables)
+_MISSION_ENERGY = {
+    "nicer": ("PI", 0.01),
+    "nustar": ("PI", 0.04),
+    "rxte": ("PHA", None),
+    "xmm": ("PI", 0.001),
+    "swift": ("PI", 0.01),
+}
+
+
+def read_fits_event_mjds(eventfile: str, extname: str = "EVENTS"):
+    """(mjds, data, header): event times as MJD in the file's own
+    timescale (reference event_toas.read_fits_event_mjds)."""
+    hdus = read_fits(eventfile)
+    ev = find_extension(hdus, extname)
+    h = ev.header
+    if "MJDREFI" in h:
+        mjdref_i = int(h["MJDREFI"])
+        mjdref_f = float(h.get("MJDREFF", 0.0))
+    elif "MJDREF" in h:
+        mjdref_i = int(float(h["MJDREF"]))
+        mjdref_f = float(h["MJDREF"]) - mjdref_i
+    else:
+        raise ValueError(f"{eventfile}: no MJDREF in {extname} header")
+    tz = float(h.get("TIMEZERO", 0.0))
+    sec = ev.data["TIME"] + tz
+    day = mjdref_i + np.floor(sec / 86400.0).astype(int)
+    frac = mjdref_f + (sec % 86400.0) / 86400.0
+    day += np.floor(frac).astype(int)
+    frac -= np.floor(frac)
+    return (day, frac), ev.data, h
+
+
+def load_event_TOAs(
+    eventfile: str,
+    mission: str,
+    weights: np.ndarray | None = None,
+    weight_column: str | None = None,
+    minmjd: float = -np.inf,
+    maxmjd: float = np.inf,
+    ephem: str = "auto",
+    planets: bool = False,
+    orbitfile: str | None = None,
+):
+    """Photon TOAs from a FITS event file (reference load_event_TOAs:244).
+
+    Supported geometries: barycentered (TIMESYS TDB), geocentered (TT),
+    and — with `orbitfile` (Fermi FT2 / orbit table) — the spacecraft
+    frame via astro/satellite_obs.py orbit reconstruction.
+    """
+    from pint_tpu.astro import time as ptime
+    from pint_tpu.toas import prepare_arrays
+
+    (day, frac), data, h = read_fits_event_mjds(eventfile)
+    timesys = str(h.get("TIMESYS", "TT")).strip().upper()
+    timeref = str(h.get("TIMEREF", "LOCAL")).strip().upper()
+    if timesys == "TDB":
+        obs = "barycenter"
+    elif timeref in ("GEOCENTRIC", "GEOCENTER"):
+        # times are ALREADY geocentered (gtbary tcorrect=GEO): applying a
+        # spacecraft position on top would double-correct by up to ~23 ms
+        obs = "geocenter_tt"
+        if orbitfile is not None:
+            log.warning(
+                f"{eventfile}: TIMEREF GEOCENTRIC — ignoring orbitfile "
+                "(times are already geocentered)"
+            )
+    elif orbitfile is not None:
+        from pint_tpu.astro.satellite_obs import get_satellite_observatory
+
+        obs = f"{mission.lower()}_sc"
+        get_satellite_observatory(obs, orbitfile)
+    elif timesys == "TT":
+        obs = "geocenter_tt"
+        log.warning(
+            f"{eventfile}: TIMEREF LOCAL (spacecraft frame) with no "
+            "orbitfile — treating times as geocentric"
+        )
+    else:
+        raise NotImplementedError(f"TIMESYS {timesys} / TIMEREF {timeref}")
+
+    mjd_f = day + frac
+    keep = (mjd_f >= minmjd) & (mjd_f <= maxmjd)
+    day, frac = day[keep], frac[keep]
+    n = keep.sum()
+
+    flags: list[dict] = [{} for _ in range(n)]
+    mission_l = mission.lower()
+    if mission_l == "fermi" and "ENERGY" in data:
+        en = np.asarray(data["ENERGY"])[keep]  # MeV
+        for i in range(n):
+            flags[i]["energy"] = f"{en[i]:.2f}"
+    ecol = _MISSION_ENERGY.get(mission_l)
+    if ecol and ecol[0] in data:
+        chans = np.asarray(data[ecol[0]])[keep]
+        for i in range(n):
+            flags[i][ecol[0].lower()] = str(int(chans[i]))
+            if ecol[1] is not None:
+                flags[i]["energy"] = f"{chans[i] * ecol[1]:.4f}"
+    if weight_column is not None:
+        if weight_column not in data:
+            raise KeyError(
+                f"weight column {weight_column!r} not in {eventfile}; "
+                f"columns: {sorted(data)}"
+            )
+        weights = np.asarray(data[weight_column])
+    if weights is not None:
+        weights = np.asarray(weights)[keep]
+        for i in range(n):
+            flags[i]["weight"] = f"{weights[i]:.9g}"
+
+    epoch = ptime.MJDEpoch.from_arrays(day, frac, np.zeros(n))
+    return prepare_arrays(
+        epoch,
+        np.zeros(n),  # photon TOAs carry no timing error
+        np.full(n, np.inf),  # infinite frequency: no dispersion
+        np.array([obs] * n),
+        flags=flags,
+        ephem=ephem,
+        planets=planets,
+    )
+
+
+def load_NICER_TOAs(eventfile: str, **kw):
+    return load_event_TOAs(eventfile, "nicer", **kw)
+
+
+def load_RXTE_TOAs(eventfile: str, **kw):
+    return load_event_TOAs(eventfile, "rxte", **kw)
+
+
+def load_NuSTAR_TOAs(eventfile: str, **kw):
+    return load_event_TOAs(eventfile, "nustar", **kw)
+
+
+def load_XMM_TOAs(eventfile: str, **kw):
+    return load_event_TOAs(eventfile, "xmm", **kw)
+
+
+def load_Fermi_TOAs(
+    ft1name: str,
+    weightcolumn: str | None = None,
+    targetcoord=None,
+    minweight: float = 0.0,
+    minmjd: float = -np.inf,
+    maxmjd: float = np.inf,
+    ephem: str = "auto",
+    planets: bool = False,
+    ft2name: str | None = None,
+):
+    """Fermi-LAT photon TOAs with weights (reference fermi_toas.py:145).
+
+    Weights come from an FT1 column (gtsrcprob names it after the source,
+    e.g. 'PSRJ0030+0451'); photons below `minweight` are dropped.
+    """
+    if targetcoord is not None:
+        raise NotImplementedError(
+            "position-computed weights (weightcolumn='CALC') are not "
+            "implemented; use a gtsrcprob weight column"
+        )
+    toas = load_event_TOAs(
+        ft1name, "fermi", weight_column=weightcolumn,
+        minmjd=minmjd, maxmjd=maxmjd, ephem=ephem, planets=planets,
+        orbitfile=ft2name,
+    )
+    if weightcolumn and minweight > 0:
+        w = get_event_weights(toas)
+        toas = toas.select(w >= minweight)
+    return toas
+
+
+def compute_event_phases(toas, model) -> np.ndarray:
+    """Absolute model phases mod 1 for photon TOAs (shared by the
+    photonphase / fermiphase CLIs)."""
+    from pint_tpu.residuals import Residuals
+
+    r = Residuals(toas, model, subtract_mean=False, track_mode="nearest")
+    return np.mod(r.phase_resids, 1.0)
+
+
+def get_event_weights(toas) -> np.ndarray | None:
+    ws = [f.get("weight") for f in toas.flags]
+    if all(w is None for w in ws):
+        return None
+    return np.array([float(w) if w is not None else 1.0 for w in ws])

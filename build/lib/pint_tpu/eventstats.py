@@ -1,0 +1,72 @@
+"""Pulsation test statistics for photon phases.
+
+Reference: pint/eventstats.py (z2m:133, z2mw:156, hm:240, hmw:255,
+sig2sigma:49, h-test calibration after de Jager et al. 1989/2010). Phases
+in cycles [0, 1); weighted variants follow the reference normalization
+2/sum(w^2) with harmonic sums of w cos(k phi), w sin(k phi).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+TWOPI = 2 * np.pi
+
+
+def z2m(phases, m: int = 2) -> np.ndarray:
+    """Z^2_m statistics for m harmonics (cumulative, one entry per
+    harmonic; reference z2m:133)."""
+    phases = np.asarray(phases, float) * TWOPI
+    n = len(phases)
+    k = np.arange(1, m + 1)[:, None]
+    s = (np.cos(k * phases).sum(axis=1)) ** 2 + (np.sin(k * phases).sum(axis=1)) ** 2
+    return np.cumsum(s) * 2.0 / n
+
+
+def z2mw(phases, weights, m: int = 2) -> np.ndarray:
+    """Weighted Z^2_m (reference z2mw:156: normalization 2/sum(w^2))."""
+    phases = np.asarray(phases, float) * TWOPI
+    w = np.asarray(weights, float)
+    k = np.arange(1, m + 1)[:, None]
+    s = ((np.cos(k * phases) * w).sum(axis=1)) ** 2 + (
+        (np.sin(k * phases) * w).sum(axis=1)
+    ) ** 2
+    return np.cumsum(s) * 2.0 / np.sum(w**2)
+
+
+def hm(phases, m: int = 20, c: float = 4.0) -> float:
+    """H-test statistic: max_m (Z^2_m - c(m-1)) (reference hm:240,
+    de Jager et al. 1989)."""
+    z = z2m(phases, m=m)
+    return float(np.max(z - c * np.arange(m)))
+
+
+def hmw(phases, weights, m: int = 20, c: float = 4.0) -> float:
+    """Weighted H-test (reference hmw:255)."""
+    z = z2mw(phases, weights, m=m)
+    return float(np.max(z - c * np.arange(m)))
+
+
+def h_sig(h: float) -> float:
+    """H-test tail probability (de Jager & Busching 2010: P = exp(-0.4 H))."""
+    return float(np.exp(-0.39802 * h))
+
+
+def sf_z2m(z2: float, m: int = 2) -> float:
+    """Z^2_m survival probability (chi^2 with 2m dof; reference sf_z2m)."""
+    from scipy.stats import chi2
+
+    return float(chi2.sf(z2, 2 * m))
+
+
+def sig2sigma(sig: float) -> float:
+    """Two-tailed significance -> Gaussian sigma (reference sig2sigma:49)."""
+    from scipy.stats import norm
+
+    return float(norm.isf(0.5 * sig))
+
+
+def best_m(phases, weights=None, m: int = 20) -> int:
+    """Harmonic count maximizing the H-test argument (reference best_m)."""
+    z = z2m(phases, m=m) if weights is None else z2mw(phases, weights, m=m)
+    return int(np.argmax(z - 4.0 * np.arange(m)) + 1)

@@ -1,0 +1,307 @@
+"""Generalized least-squares fitting with correlated noise.
+
+Reference: pint/fitter.py GLSFitter:2107-2254 (basis/Woodbury path,
+full_cov=False) and DownhillGLSFitter:1476. The covariance is
+C = diag(sigma^2) + F phi F^T with F the correlated-noise basis
+(ECORR epoch blocks, power-law Fourier modes; models/noise.py). The solve
+uses the MARGINALIZED normal equations M^T C^-1 M dx = -M^T C^-1 r with
+C^-1 applied through the structured Woodbury algebra of
+fitting/woodbury.py: the ECORR part of F stays an implicit epoch-index
+vector (gathers + segment-sums, O(N)), the Fourier part is dense MXU
+matmuls, and the inner solve is one small Cholesky of the dense-mode
+Schur complement. Mathematically identical to the reference's
+noise-augmented mtcm/phiinv algebra (Schur complement identity); neither
+the N x N covariance nor the (N, k_epoch) ECORR membership matrix is ever
+materialized.
+
+chi^2 at fixed parameters uses the Woodbury identity:
+    r^T C^-1 r = r^T N^-1 r - d^T S^-1 d,
+    d = F^T N^-1 r,  S = diag(1/phi) + F^T N^-1 F.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pint_tpu.fitting.wls import (
+    FitResult,
+    WLSFitter,
+    apply_delta,
+)
+from pint_tpu.fitting.woodbury import (
+    basis_matvec,
+    cinv_apply,
+    s_factor,
+    woodbury_chi2,
+)
+from pint_tpu.models.timing_model import TimingModel
+from pint_tpu.utils.logging import get_logger
+
+log = get_logger("pint_tpu.fitting")
+
+Array = jnp.ndarray
+
+# tiny ridge on the normalized timing block: keeps the Cholesky finite on
+# exactly-degenerate columns (reference falls back to SVD there; the ridge
+# pins the degenerate direction's step to ~0 instead)
+_RIDGE = 1e-12
+
+
+def _gls_pieces(model: TimingModel, free, subtract_mean):
+    from pint_tpu.residuals import phase_residual_frac
+
+    def time_resids(params, tensor, track_pn, delta_pn, weights):
+        _, r, f = phase_residual_frac(
+            model, params, tensor,
+            track_pn=track_pn, delta_pn=delta_pn,
+            subtract_mean=subtract_mean, weights=weights,
+        )
+        return r / f
+
+    return time_resids
+
+
+def get_gls_step_fn(model: TimingModel, free, subtract_mean: bool):
+    """Jitted GLS step: (params, tensor, track_pn, delta_pn, weights, sigma)
+    -> (r0, M, mtcm, mtcy, norm, chi2_0, ahat); solve with gls_solve().
+    Cached per model/free-set."""
+    cache = model.__dict__.setdefault("_gls_step_cache", {})
+    key = (free, subtract_mean, model.xprec.name)
+    if key in cache:
+        return cache[key]
+
+    time_resids = _gls_pieces(model, free, subtract_mean)
+    p = len(free)
+
+    def step(params, tensor, track_pn, delta_pn, weights, sigma):
+        def rfun(delta):
+            return time_resids(
+                apply_delta(params, free, delta), tensor, track_pn, delta_pn, weights
+            )
+
+        z = jnp.zeros(p)
+        r0, lin = jax.linearize(rfun, z)
+        M = jax.vmap(lin)(jnp.eye(p)).T  # (N, p), one primal evaluation
+        cinv = 1.0 / sigma**2
+
+        basis = model.noise_basis_and_weights(params, tensor)
+        norm = jnp.sqrt(jnp.sum(M**2, axis=0))
+        norm = jnp.where(norm == 0, 1.0, norm)
+        Mn = M / norm
+        # Marginalized normal equations: mtcm = Mn^T C^-1 Mn with C^-1
+        # applied via structured Woodbury (block-Schur over the diagonal
+        # ECORR block — woodbury.py). Identical to the timing block of the
+        # reference's noise-augmented solve (fitter.py:2177-2254) by the
+        # Schur complement identity, but the ECORR membership matrix never
+        # materializes.
+        sf = s_factor(basis, cinv) if basis is not None else None
+        CinvM = cinv_apply(basis, cinv, Mn, sf)
+        mtcm = Mn.T @ CinvM + _RIDGE * jnp.eye(p)
+        mtcy = CinvM.T @ (-r0)
+        # GLS chi^2 at the CURRENT params (for the downhill accept/reject
+        # decision and reporting) + ML noise-coefficient realization
+        chi2_0, (ze, zd) = woodbury_chi2(basis, cinv, r0, sf=sf)
+        ahat = jnp.concatenate([
+            ze if ze is not None else jnp.zeros(0),
+            zd if zd is not None else jnp.zeros(0),
+        ])
+        # the p x p solve itself happens host-side (scipy Cholesky on a
+        # small matrix), so Levenberg-Marquardt re-solves at any damping
+        # need no recompute of the design matrix
+        return r0, M, mtcm, mtcy, norm, chi2_0, ahat
+
+    from pint_tpu.ops.compile import precision_jit
+
+    cache[key] = precision_jit(step)
+    return cache[key]
+
+
+def get_gls_chi2_fn(model: TimingModel, subtract_mean: bool):
+    """Jitted Woodbury chi^2 at fixed params (no design matrix)."""
+    cache = model.__dict__.setdefault("_gls_chi2_cache", {})
+    key = (subtract_mean, model.xprec.name)
+    if key in cache:
+        return cache[key]
+
+    time_resids = _gls_pieces(model, (), subtract_mean)
+
+    def chi2fn(params, tensor, track_pn, delta_pn, weights, sigma):
+        r = time_resids(params, tensor, track_pn, delta_pn, weights)
+        cinv = 1.0 / sigma**2
+        basis = model.noise_basis_and_weights(params, tensor)
+        chi2, _ = woodbury_chi2(basis, cinv, r)
+        return chi2
+
+    from pint_tpu.ops.compile import precision_jit
+
+    cache[key] = precision_jit(chi2fn)
+    return cache[key]
+
+
+def gls_chi2(resids) -> float:
+    """GLS chi^2 of a Residuals object at its current model params."""
+    model = resids.model
+    fn = get_gls_chi2_fn(model, resids.subtract_mean)
+    params = model.xprec.convert_params(model.params)
+    return float(
+        fn(
+            params,
+            resids.tensor,
+            resids._track_pn,
+            resids._delta_pn,
+            resids._weights,
+            jnp.asarray(resids.errors_s),
+        )
+    )
+
+
+def gls_solve(mtcm, mtcy, norm, p: int, lam: float = 0.0):
+    """(dx_timing, cov_timing) from the normalized GLS normal equations,
+    with optional Marquardt damping lam * diag(mtcm)."""
+    import scipy.linalg as sl
+
+    mtcm = np.asarray(mtcm)
+    mtcy = np.asarray(mtcy)
+    norm = np.asarray(norm)
+    G = mtcm + lam * np.diag(np.diag(mtcm)) if lam else mtcm
+    try:
+        cf = sl.cho_factor(G)
+        xhat = sl.cho_solve(cf, mtcy)
+        xvar_p = sl.cho_solve(cf, np.eye(G.shape[0])[:, :p])
+    except sl.LinAlgError:
+        # SVD fallback (reference fitter.py:2228)
+        U, s, Vt = sl.svd(G, full_matrices=False)
+        s_inv = np.where(s > 1e-14 * s[0], 1.0 / s, 0.0)
+        xhat = Vt.T @ (s_inv * (U.T @ mtcy))
+        xvar_p = (Vt.T * s_inv) @ U.T[:, :p]
+    dx = (xhat / norm)[:p]
+    cov = (xvar_p[:p, :] / norm[:p]).T / norm[:p]
+    return dx, cov
+
+
+def full_cov_pieces(model, resids, r0, M, params=None):
+    """Dense-covariance GLS normal equations (reference fitter.py:2177-2203
+    full_cov=True): materialize C = diag(sigma^2) + F phi F^T and Cholesky
+    it on the host. O(N^2) memory / O(N^3) time — a small-N cross-check of
+    the structured Woodbury algebra, exactly like the reference's slow path.
+    Returns (mtcm, mtcy, chi2_0, cov_solve) in UNNORMALIZED units."""
+    import scipy.linalg as sl
+
+    from pint_tpu.fitting.woodbury import basis_dense
+
+    if params is None:
+        params = model.xprec.convert_params(model.params)
+    sigma = np.asarray(model.scaled_sigma(params, resids.tensor))
+    n = sigma.size
+    C = np.diag(sigma**2)
+    basis = model.noise_basis_and_weights(params, resids.tensor)
+    if basis is not None:
+        F, phi = (np.asarray(a) for a in basis_dense(basis, n))
+        C = C + (F * phi) @ F.T
+    cf = sl.cho_factor(C)
+    r0 = np.asarray(r0)
+    M = np.asarray(M)
+    CinvM = sl.cho_solve(cf, M)
+    Cinvr = sl.cho_solve(cf, r0)
+    mtcm = M.T @ CinvM
+    mtcy = M.T @ (-Cinvr)
+    chi2_0 = float(r0 @ Cinvr)
+    return mtcm, mtcy, chi2_0
+
+
+class GLSFitter(WLSFitter):
+    """Iterated linear GLS (reference GLSFitter.fit_toas, fitter.py:2122)."""
+
+    def _step_fn(self, params, tensor):
+        r = self.resids
+        fn = get_gls_step_fn(self.model, self._free, r.subtract_mean)
+        params = self.model.xprec.convert_params(params)
+        return fn(
+            params, tensor, r._track_pn, r._delta_pn, r._weights,
+            jnp.asarray(r.errors_s),
+        )
+
+    def chi2_at(self, params: dict) -> float:
+        fn = get_gls_chi2_fn(self.model, self.resids.subtract_mean)
+        params = self.model.xprec.convert_params(params)
+        r = self.resids
+        return float(
+            fn(params, self.tensor, r._track_pn, r._delta_pn, r._weights,
+               jnp.asarray(r.errors_s))
+        )
+
+    def fit_toas(self, maxiter: int = 1, xtol: float = 1e-2,
+                 full_cov: bool = False) -> FitResult:
+        """`full_cov` swaps the structured-Woodbury normal equations for
+        the dense-Cholesky covariance (reference fitter.py:2177 slow path)
+        — an O(N^3) cross-check, small TOA sets only."""
+        if len(self._free) == 0:
+            return self._frozen_fit_result()
+        params = self.model.xprec.convert_params(self.model.params)
+        p = len(self._free)
+        it = 0
+        converged = False
+        for it in range(1, maxiter + 1):
+            r0, M, mtcm, mtcy, norm, chi2_0, ahat = self._step_fn(params, self.tensor)
+            if full_cov:
+                mtcm_d, mtcy_d, _ = full_cov_pieces(
+                    self.model, self.resids, r0, M, params=params)
+                norm_d = np.sqrt(np.maximum(np.diag(mtcm_d), 1e-300))
+                mtcm = mtcm_d / norm_d[:, None] / norm_d[None, :]
+                mtcy = mtcy_d / norm_d
+                norm = norm_d
+            dx, cov = gls_solve(mtcm, mtcy, norm, p)
+            params = apply_delta(params, self._free, dx)
+            sigma = np.sqrt(np.diag(cov))
+            rel = np.abs(dx) / np.where(sigma == 0, 1.0, sigma)
+            if np.all(rel < xtol):
+                converged = True
+                break
+        self.noise_ampls = np.asarray(ahat)
+        return self._finalize_fit(params, self.chi2_at(params), it, converged, cov)
+
+    def noise_realization(self) -> np.ndarray | None:
+        """Maximum-likelihood correlated-noise waveform F @ ahat (seconds)
+        at the fitted params (reference Residuals.noise_resids)."""
+        params = self.model.xprec.convert_params(self.model.params)
+        basis = self.model.noise_basis_and_weights(params, self.tensor)
+        if basis is None or self.noise_ampls.size == 0:
+            return None
+        a = jnp.asarray(self.noise_ampls)
+        ke = basis.ke
+        return np.asarray(
+            basis_matvec(basis, a[:ke] if ke else None, a[ke:] if basis.kd else None)
+        )
+
+
+class DownhillGLSFitter(GLSFitter):
+    """Levenberg-Marquardt damped GLS (reference DownhillGLSFitter,
+    fitter.py:1476): the damped normal-equation re-solve is a host-side
+    Cholesky of the cached (p+k)x(p+k) system, so rejected steps cost no
+    design-matrix recomputation."""
+
+    def fit_toas(self, maxiter: int = 30, required_chi2_decrease: float = 1e-2,
+                 max_rejects: int = 16) -> FitResult:
+        from pint_tpu.fitting.wls import run_lm
+
+        if len(self._free) == 0:
+            return self._frozen_fit_result()
+        params = self.model.xprec.convert_params(self.model.params)
+        p = len(self._free)
+
+        params, chi2_best, it, converged, pieces = run_lm(
+            params, self.chi2_at(params),
+            compute_pieces=lambda pr: self._step_fn(pr, self.tensor),
+            solve=lambda pc, lam: gls_solve(pc[2], pc[3], pc[4], p, lam=lam)[0],
+            chi2_of=self.chi2_at,
+            apply_step=lambda pr, dx: apply_delta(pr, self._free, dx),
+            maxiter=maxiter, required_gain=required_chi2_decrease,
+            max_rejects=max_rejects, log_label="downhill GLS fit",
+        )
+        _, _, mtcm, mtcy, norm, _, ahat = pieces
+        # uncertainties always come from the UNDAMPED normal matrix
+        _, cov = gls_solve(mtcm, mtcy, norm, p)
+        self.noise_ampls = np.asarray(ahat)
+        return self._finalize_fit(params, chi2_best, it, converged, cov)

@@ -1,0 +1,120 @@
+"""MCMC fitter: posterior sampling with the jitted ensemble sampler.
+
+Reference: pint/mcmc_fitter.py (MCMCFitter:110 — emcee over lnposterior,
+maximum-posterior point estimates, posterior-spread uncertainties).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from pint_tpu.fitting.wls import FitResult, apply_delta
+from pint_tpu.residuals import Residuals
+from pint_tpu.sampler import initial_ball, run_ensemble
+from pint_tpu.utils.logging import get_logger
+
+log = get_logger("pint_tpu.fitting")
+
+
+class MCMCFitter:
+    """Ensemble-MCMC over the model's free parameters.
+
+    fit_toas runs the chain, sets the model to the maximum-posterior
+    sample, and reports posterior-standard-deviation uncertainties.
+    """
+
+    def __init__(self, toas, model, nwalkers: int = 24, priors: dict | None = None):
+        # deferred: bayesian.py itself imports fitting.wls
+        from pint_tpu.bayesian import BayesianTiming
+
+        self.toas = toas
+        self.model = model
+        self.bt = BayesianTiming(toas, model, priors=priors)
+        ndim = self.bt.nparams
+        self.nwalkers = max(nwalkers, 2 * ndim + 2)
+        if self.nwalkers % 2:
+            self.nwalkers += 1
+        self.chain: np.ndarray | None = None
+        self.lnp: np.ndarray | None = None
+        self.result: FitResult | None = None
+
+    def fit_toas(self, nsteps: int = 400, burn: float = 0.25, seed: int = 0,
+                 backend: str | None = None, resume: bool = False) -> FitResult:
+        """Run (or, with `backend`+`resume`, continue) the chain. `backend`
+        checkpoints chain/lnp to an .npz after the run — the equivalent of
+        the reference event_optimize's emcee HDF backend."""
+        import os
+
+        if backend and not backend.endswith(".npz"):
+            backend += ".npz"  # np.savez appends it; keep load/save symmetric
+        from pint_tpu.models.base import leaf_to_f64
+
+        v0 = np.array([
+            float(np.asarray(leaf_to_f64(self.bt._params0[n])))
+            for n in self.bt.free
+        ])
+        prev_chain = prev_lnp = None
+        if resume and backend and os.path.exists(backend):
+            with np.load(backend) as z:
+                if list(z["free"]) != list(self.bt.free):
+                    raise ValueError(
+                        f"backend {backend} free-params mismatch: {list(z['free'])}"
+                    )
+                if not np.allclose(z["params0"], v0, rtol=0, atol=0):
+                    raise ValueError(
+                        f"backend {backend} was sampled around different "
+                        "reference parameter values; delta-space chains "
+                        "cannot be concatenated across reference points"
+                    )
+                prev_chain, prev_lnp = z["chain"], z["lnp"]
+                seed = int(z["next_seed"])
+            x0 = prev_chain[-1]
+            if x0.shape[0] != self.nwalkers:
+                raise ValueError(
+                    f"backend has {x0.shape[0]} walkers, need {self.nwalkers}"
+                )
+            log.info(f"resuming chain from {backend}: {prev_chain.shape[0]} steps done")
+        else:
+            x0 = initial_ball(self.bt.scales, self.nwalkers, seed=seed)
+        chain, lnp, acc = run_ensemble(self.bt.lnpost_fn(), x0, nsteps, seed=seed)
+        if prev_chain is not None:
+            chain = np.concatenate([prev_chain, chain])
+            lnp = np.concatenate([prev_lnp, lnp])
+        self.chain, self.lnp = chain, lnp
+        if backend:
+            np.savez_compressed(
+                backend, chain=chain, lnp=lnp, params0=v0,
+                free=np.array(list(self.bt.free)), next_seed=seed + 1,
+            )
+        nsteps = chain.shape[0]
+        log.info(f"MCMC: {self.nwalkers} walkers x {nsteps} steps, acceptance {acc:.2f}")
+        nburn = int(burn * nsteps)
+        flat = chain[nburn:].reshape(-1, self.bt.nparams)
+        # maximum-posterior point estimate (reference MCMCFitter maxpost_fitvals)
+        i_best = np.unravel_index(np.argmax(lnp), lnp.shape)
+        best = chain[i_best]
+        params = apply_delta(self.bt._params0, self.bt.free, best)
+        from pint_tpu.ops.xprec import params_to_dd
+
+        self.model.params = params_to_dd(params)
+        unc = dict(zip(self.bt.free, np.std(flat, axis=0)))
+        for n, u in unc.items():
+            self.model.param_meta[n].uncertainty = float(u)
+        resids = Residuals(self.toas, self.model, tensor=self.bt.resids.tensor)
+        self.resids = resids
+        self.result = FitResult(
+            chi2=resids.calc_chi2(),
+            dof=resids.dof,
+            iterations=nsteps,
+            converged=0.05 < acc < 0.9,
+            uncertainties=unc,
+            free_params=list(self.bt.free),
+        )
+        return self.result
+
+    def posterior_samples(self, burn: float = 0.25) -> np.ndarray:
+        """(nsamples, ndim) flattened post-burn-in delta samples."""
+        if self.chain is None:
+            raise RuntimeError("run fit_toas first")
+        nburn = int(burn * self.chain.shape[0])
+        return self.chain[nburn:].reshape(-1, self.bt.nparams)

@@ -1,0 +1,242 @@
+"""Wideband (TOA + DM-measurement) fitting.
+
+Reference: pint/fitter.py WidebandTOAFitter:2310 + WidebandDownhillFitter
+(combined design matrix over residual "quantities", fitter.py:2416
+combine_design_matrices_by_quantity). TPU re-design: the combined residual
+vector is ONE function
+
+    r_aug(delta) = [ r_toa / sigma_toa ; (dm_model - dm_data) / sigma_dm ]
+
+so jax.linearize gives the stacked design matrix in a single pass — DM-type
+parameters (DM, DMX_*, DMJUMP) automatically get their rows in both blocks.
+Correlated TOA noise (red noise, ECORR) augments the TOA block exactly as
+fitting/gls.py; DM rows of the noise basis are zero.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pint_tpu.fitting.gls import gls_solve
+from pint_tpu.fitting.wls import FitResult, WLSFitter, apply_delta
+from pint_tpu.fitting.woodbury import (
+    NoiseBasis,
+    cinv_apply,
+    s_factor,
+    woodbury_chi2,
+)
+from pint_tpu.residuals import WidebandTOAResiduals, phase_residual_frac
+from pint_tpu.utils.logging import get_logger
+
+log = get_logger("pint_tpu.fitting")
+
+_RIDGE = 1e-12
+
+
+def _weighted_resids(model, free, subtract_mean, params, tensor, track_pn,
+                     delta_pn, weights, sw_t, sw_dm, dm_data, delta):
+    """Combined weighted residual vector [r_toa*sw_t ; r_dm*sw_dm] at
+    params+delta — the ONE definition both the step linearization and the
+    accept/reject chi^2 share."""
+    pp = apply_delta(params, free, delta)
+    _, r, f = phase_residual_frac(
+        model, pp, tensor,
+        track_pn=track_pn, delta_pn=delta_pn,
+        subtract_mean=subtract_mean, weights=weights,
+    )
+    rt = (r / f) * sw_t
+    rdm = (model.total_dm(pp, tensor) - dm_data) * sw_dm
+    return jnp.concatenate([rt, rdm])
+
+
+def _noise_basis_aug(model, params, tensor, sw_t, n_dm):
+    """Model noise basis lifted to the combined pre-whitened [TOA; DM]
+    system: rows scaled by 1/sigma_t on the TOA block, zero on the DM block
+    (DM measurements carry no TOA noise), via NoiseBasis.row_scale."""
+    basis = model.noise_basis_and_weights(params, tensor)
+    if basis is None:
+        return None
+    scale = jnp.concatenate([sw_t, jnp.zeros(n_dm)])
+    dense = None
+    if basis.dense is not None:
+        dense = jnp.concatenate(
+            [basis.dense, jnp.zeros((n_dm, basis.dense.shape[1]))]
+        )
+    eidx = None
+    if basis.ephi is not None:
+        eidx = jnp.concatenate(
+            [basis.eidx, jnp.full((n_dm,), -1, basis.eidx.dtype)]
+        )
+    return NoiseBasis(
+        dense=dense, dense_phi=basis.dense_phi, eidx=eidx, ephi=basis.ephi,
+        row_scale=scale,
+    )
+
+
+def _cat_ahat(ze, zd):
+    return jnp.concatenate([
+        ze if ze is not None else jnp.zeros(0),
+        zd if zd is not None else jnp.zeros(0),
+    ])
+
+
+def get_wb_step_fn(model, free, subtract_mean: bool):
+    """Jitted wideband step -> (r_aug, mtcm, mtcy, norm, chi2_0, ahat);
+    solve with fitting.gls.gls_solve."""
+    cache = model.__dict__.setdefault("_wb_step_cache", {})
+    key = (free, subtract_mean, model.xprec.name)
+    if key in cache:
+        return cache[key]
+
+    p = len(free)
+
+    def step(params, tensor, track_pn, delta_pn, weights, sigma_t, sigma_dm, dm_data):
+        sw_t = 1.0 / sigma_t
+        sw_dm = jnp.where(jnp.isfinite(sigma_dm), 1.0 / sigma_dm, 0.0)
+
+        def wres(delta):
+            return _weighted_resids(
+                model, free, subtract_mean, params, tensor, track_pn,
+                delta_pn, weights, sw_t, sw_dm, dm_data, delta,
+            )
+
+        z = jnp.zeros(p)
+        r0, lin = jax.linearize(wres, z)
+        A = jax.vmap(lin)(jnp.eye(p)).T  # (N_t + N_dm, p), already weighted
+        b = -r0
+
+        basis = _noise_basis_aug(model, params, tensor, sw_t, sw_dm.shape[0])
+        norm = jnp.sqrt(jnp.sum(A**2, axis=0))
+        norm = jnp.where(norm == 0, 1.0, norm)
+        An = A / norm
+        # marginalized normal equations on the pre-whitened combined system
+        # (C = I + F_eff phi F_eff^T), structured Woodbury as fitting/gls.py
+        ones = jnp.ones_like(r0)
+        sf = s_factor(basis, ones) if basis is not None else None
+        CinvA = cinv_apply(basis, ones, An, sf)
+        mtcm = An.T @ CinvA + _RIDGE * jnp.eye(p)
+        mtcy = CinvA.T @ b
+        chi2_0, (ze, zd) = woodbury_chi2(basis, ones, r0, sf=sf)
+        return r0, mtcm, mtcy, norm, chi2_0, _cat_ahat(ze, zd)
+
+    from pint_tpu.ops.compile import precision_jit
+
+    cache[key] = precision_jit(step)
+    return cache[key]
+
+
+def get_wb_chi2_fn(model, subtract_mean: bool):
+    cache = model.__dict__.setdefault("_wb_chi2_cache", {})
+    key = (subtract_mean, model.xprec.name)
+    if key in cache:
+        return cache[key]
+
+    def chi2fn(params, tensor, track_pn, delta_pn, weights, sigma_t, sigma_dm, dm_data):
+        sw_t = 1.0 / sigma_t
+        sw_dm = jnp.where(jnp.isfinite(sigma_dm), 1.0 / sigma_dm, 0.0)
+        r0 = _weighted_resids(
+            model, (), subtract_mean, params, tensor, track_pn,
+            delta_pn, weights, sw_t, sw_dm, dm_data, jnp.zeros(0),
+        )
+        basis = _noise_basis_aug(model, params, tensor, sw_t, sw_dm.shape[0])
+        chi2, _ = woodbury_chi2(basis, jnp.ones_like(r0), r0)
+        return chi2
+
+    from pint_tpu.ops.compile import precision_jit
+
+    cache[key] = precision_jit(chi2fn)
+    return cache[key]
+
+
+class WidebandDownhillFitter(WLSFitter):
+    """Levenberg-Marquardt wideband fitter (reference WidebandDownhillFitter,
+    fitter.py:1536 semantics on the combined TOA+DM system)."""
+
+    def __init__(self, toas, model, residuals=None):
+        self.toas = toas
+        self.model = model
+        self.resids = residuals or WidebandTOAResiduals(toas, model)
+        self.tensor = self.resids.tensor
+        self._free = tuple(model.free_params)
+        self.result: FitResult | None = None
+        from pint_tpu.models.base import leaf_to_f64
+
+        self._prefit_values = {
+            n: float(np.asarray(leaf_to_f64(model.params[n]))) for n in self._free
+        }
+        self._prefit_wrms = self.resids.rms_weighted()
+
+    def _rebuild_resids(self):
+        return WidebandTOAResiduals(
+            self.toas, self.model, tensor=self.tensor,
+            track_mode=self.resids.toa.track_mode,
+            subtract_mean=self.resids.toa.subtract_mean,
+        )
+
+    def _args(self, params):
+        r = self.resids.toa
+        params = self.model.xprec.convert_params(params)
+        return (
+            params, self.tensor, r._track_pn, r._delta_pn, r._weights,
+            jnp.asarray(r.errors_s), jnp.asarray(self.resids.dm_errors),
+            jnp.asarray(self.resids.dm_data),
+        )
+
+    def chi2_at(self, params) -> float:
+        fn = get_wb_chi2_fn(self.model, self.resids.toa.subtract_mean)
+        return float(fn(*self._args(params)))
+
+    def fit_toas(self, maxiter: int = 30, required_chi2_decrease: float = 1e-2,
+                 max_rejects: int = 16) -> FitResult:
+        from pint_tpu.fitting.wls import run_lm
+
+        if len(self._free) == 0:
+            return self._frozen_fit_result()
+        step = get_wb_step_fn(self.model, self._free, self.resids.toa.subtract_mean)
+        params = self.model.xprec.convert_params(self.model.params)
+        p = len(self._free)
+
+        params, chi2_best, it, converged, pieces = run_lm(
+            params, self.chi2_at(params),
+            compute_pieces=lambda pr: step(*self._args(pr)),
+            solve=lambda pc, lam: gls_solve(pc[1], pc[2], pc[3], p, lam=lam)[0],
+            chi2_of=self.chi2_at,
+            apply_step=lambda pr, dx: apply_delta(pr, self._free, dx),
+            maxiter=maxiter, required_gain=required_chi2_decrease,
+            max_rejects=max_rejects, log_label="wideband fit",
+        )
+        _, mtcm, mtcy, norm, _, ahat = pieces
+        _, cov = gls_solve(mtcm, mtcy, norm, p)
+        self.noise_ampls = np.asarray(ahat)
+        return self._finalize_fit(params, chi2_best, it, converged, cov)
+
+    def designmatrix(self) -> np.ndarray:
+        """Combined UNWEIGHTED (N_toa + N_dm, p) design matrix — TOA rows
+        are d(time resid)/d(param) like the base contract, DM rows
+        d(dm resid)/d(param) (rows without a DM measurement are zero)."""
+        r = self.resids.toa
+        params = self.model.xprec.convert_params(self.model.params)
+        sw_t = jnp.ones(len(r.errors_s))
+        dme = jnp.asarray(self.resids.dm_errors)
+        sw_dm = jnp.where(jnp.isfinite(dme), 1.0, 0.0)
+        dm_data = jnp.asarray(self.resids.dm_data)
+
+        def wres(delta):
+            return _weighted_resids(
+                self.model, self._free, r.subtract_mean, params, self.tensor,
+                r._track_pn, r._delta_pn, r._weights, sw_t, sw_dm, dm_data, delta,
+            )
+
+        _, lin = jax.linearize(wres, jnp.zeros(len(self._free)))
+        return np.asarray(jax.vmap(lin)(jnp.eye(len(self._free))).T)
+
+    def _frozen_fit_result(self) -> FitResult:
+        self.result = FitResult(
+            chi2=self.chi2_at(self.model.params),
+            dof=self.resids.dof,
+            iterations=0,
+            converged=True,
+        )
+        return self.result

@@ -1,0 +1,489 @@
+"""Weighted least-squares fitting via autodiff design matrices.
+
+Reference: pint/fitter.py WLSFitter:1954 (single full step via scaled design
+matrix + SVD pseudo-inverse) and DownhillWLSFitter:1386 (damped Gauss-Newton
+with chi^2 backtracking, fitter.py:1145-1274). The TPU design compiles ONE
+function per model structure:
+
+    step(params, tensor) -> (r0, M, delta, chi2_pred)
+
+where M = d(time residual)/d(free param) from jax.jacfwd through the full
+dd-arithmetic phase chain — replacing the reference's per-parameter
+d_phase_d_param dispatch. Parameter updates are computed as f64 DELTAS and
+added into the DD parameter carriers, so nanosecond phase precision survives
+arbitrarily many fit iterations.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pint_tpu.models.timing_model import TimingModel
+from pint_tpu.ops.dd import DD, dd_add_fp
+from pint_tpu.residuals import Residuals
+from pint_tpu.utils.logging import get_logger
+
+log = get_logger("pint_tpu.fitting")
+
+Array = jnp.ndarray
+
+# singular values below this fraction of the largest are treated as degenerate
+# directions and zeroed (reference WLSFitter threshold semantics, fitter.py:2216)
+SVD_THRESHOLD = 1e-14
+
+
+class ConvergenceFailure(RuntimeError):
+    pass
+
+
+class MaxiterReached(ConvergenceFailure):
+    pass
+
+
+def ftest(chi2_1: float, dof_1: int, chi2_2: float, dof_2: int) -> float:
+    """F-test p-value that the dof_2 < dof_1 (more-parameters) model's chi^2
+    improvement is by chance (reference utils.py FTest / fitter.ftest).
+    Small p => the added parameters are significant."""
+    from scipy.stats import f as fdist
+
+    if dof_2 >= dof_1 or chi2_2 > chi2_1:
+        return 1.0
+    delta_chi2 = chi2_1 - chi2_2
+    delta_dof = dof_1 - dof_2
+    F = (delta_chi2 / delta_dof) / (chi2_2 / dof_2)
+    return float(fdist.sf(F, delta_dof, dof_2))
+
+
+def apply_delta(params: dict, free_names: tuple[str, ...], delta: Array) -> dict:
+    """params + delta over the free subset; extended-precision leaves (DD or
+    QF) absorb f64 steps without losing their low-order bits."""
+    from pint_tpu.ops.qf32 import QF, qf_add_f64
+
+    new = dict(params)
+    for i, n in enumerate(free_names):
+        v = params[n]
+        if isinstance(v, DD):
+            new[n] = dd_add_fp(v, delta[i])
+        elif isinstance(v, QF):
+            new[n] = qf_add_f64(v, delta[i])
+        else:
+            new[n] = v + delta[i]
+    return new
+
+
+@dataclass
+class FitResult:
+    chi2: float
+    dof: int
+    iterations: int
+    converged: bool
+    uncertainties: dict[str, float] = field(default_factory=dict)
+    covariance: np.ndarray | None = None
+    free_params: list[str] = field(default_factory=list)
+    singular_values: np.ndarray | None = None
+    degenerate: list[str] = field(default_factory=list)
+
+    @property
+    def reduced_chi2(self) -> float:
+        return self.chi2 / self.dof
+
+
+def get_step_fn(model: TimingModel, free: tuple[str, ...], subtract_mean: bool):
+    """Jitted WLS step, cached on the model keyed by the free-param set."""
+    cache = model.__dict__.setdefault("_wls_step_cache", {})
+    key = (free, subtract_mean, model.xprec.name)
+    if key in cache:
+        return cache[key]
+
+    from pint_tpu.fitting.design import linear_columns, linear_split
+    from pint_tpu.residuals import phase_residual_frac
+
+    nonlin, lin_names, owners = linear_split(model, free)
+    mean_free = subtract_mean and not model.has_phase_offset
+    sl = slice(None, -1) if model.has_abs_phase else slice(None)
+
+    def time_resids_f(params, tensor, track_pn, delta_pn, weights):
+        _, r, f = phase_residual_frac(
+            model,
+            params,
+            tensor,
+            track_pn=track_pn,
+            delta_pn=delta_pn,
+            subtract_mean=subtract_mean,
+            weights=weights,
+        )
+        return r / f, f
+
+    def step(params, tensor, track_pn, delta_pn, weights, errors):
+        # hybrid design matrix (fitting/design.py): autodiff tangents only
+        # over the nonlinear params, closed forms for the linear families
+        def rfun(delta):
+            return time_resids_f(
+                apply_delta(params, nonlin, delta), tensor, track_pn, delta_pn, weights
+            )
+
+        z = jnp.zeros(len(nonlin))
+        (r0, f0), jvp = jax.linearize(rfun, z)
+        cols = {}
+        if nonlin:
+            M_nl = jax.vmap(jvp)(jnp.eye(len(nonlin)))[0].T
+            for i, n in enumerate(nonlin):
+                cols[n] = M_nl[:, i]
+        if lin_names:
+            M_l = linear_columns(model, params, tensor, f0, sl, lin_names, owners)
+            if mean_free:
+                w = weights if weights is not None else jnp.ones_like(r0)
+                M_l = M_l - jnp.sum(w[:, None] * M_l, axis=0) / jnp.sum(w)
+            for i, n in enumerate(lin_names):
+                cols[n] = M_l[:, i]
+        M = jnp.stack([cols[n] for n in free], axis=1)  # (N, p)
+        w = 1.0 / errors
+        A = M * w[:, None]
+        b = -r0 * w
+        # column equilibration for conditioning (reference fitter.py:2186)
+        norm = jnp.linalg.norm(A, axis=0)
+        norm = jnp.where(norm == 0, 1.0, norm)
+        An = A / norm
+        U, s, Vt = jnp.linalg.svd(An, full_matrices=False)
+        good = s > SVD_THRESHOLD * s[0]
+        s_inv = jnp.where(good, 1.0 / jnp.where(good, s, 1.0), 0.0)
+        dx = (Vt.T * s_inv) @ (U.T @ b) / norm
+        # covariance of scaled problem -> unscale
+        cov = (Vt.T * s_inv**2) @ Vt / jnp.outer(norm, norm)
+        chi2_0 = jnp.sum(b * b)
+        # pieces for host-side Levenberg-Marquardt re-solves at any damping:
+        # dx(lam) = V diag(s/(s^2 + lam s0^2)) U^T b / norm  — no recompute
+        utb = U.T @ b
+        return r0, M, dx, cov, s, Vt, chi2_0, utb, norm
+
+    from pint_tpu.ops.compile import precision_jit
+
+    cache[key] = precision_jit(step)
+    return cache[key]
+
+
+def run_lm(params, chi2_best, compute_pieces, solve, chi2_of, apply_step,
+           maxiter: int, required_gain: float, max_rejects: int, log_label: str):
+    """Shared Levenberg-Marquardt outer loop for every downhill fitter.
+
+    compute_pieces(params) -> opaque linearization pieces (one jitted call);
+    solve(pieces, lam) -> dx; chi2_of(trial) -> float; apply_step(params, dx)
+    -> params'. Damping RESTARTS from zero each outer iteration (reference
+    DownhillFitter semantics): convergence is only declared against a fresh
+    Gauss-Newton attempt, never against a stale heavily-damped step.
+
+    Returns (params, chi2_best, iterations, converged, last_pieces).
+    """
+    it = 0
+    converged = False
+    pieces = None
+    for it in range(1, maxiter + 1):
+        pieces = compute_pieces(params)
+        lam = 0.0
+        accepted = False
+        gain = 0.0
+        for _ in range(max_rejects):
+            dx = solve(pieces, lam)
+            trial = apply_step(params, dx)
+            chi2_trial = chi2_of(trial)
+            if np.isfinite(chi2_trial) and chi2_trial <= chi2_best:
+                gain = chi2_best - chi2_trial
+                params, chi2_best = trial, chi2_trial
+                accepted = True
+                break
+            lam = 1e-8 if lam == 0.0 else lam * 10.0
+        if not accepted or gain < required_gain:
+            converged = True
+            break
+    else:
+        log.warning(f"{log_label} hit maxiter={maxiter}")
+    return params, chi2_best, it, converged, pieces
+
+
+def lm_step(s, vt, utb, norm, lam: float):
+    """Damped (Levenberg-Marquardt) step from the cached SVD pieces:
+    dx = V diag(s/(s^2 + lam*s_max^2)) U^T b / norm. lam=0 recovers the
+    Gauss-Newton pseudo-inverse step."""
+    s = np.asarray(s)
+    vt = np.asarray(vt)
+    utb = np.asarray(utb)
+    norm = np.asarray(norm)
+    if s.size == 0:
+        return np.zeros(0)
+    damp = s / (s * s + lam * s[0] ** 2)
+    good = s > SVD_THRESHOLD * s[0]
+    damp = np.where(good, damp, 0.0)
+    return (vt.T * damp) @ utb / norm
+
+
+class WLSFitter:
+    """Iterated linear WLS (Gauss-Newton without damping)."""
+
+    def __init__(self, toas, model: TimingModel, residuals: Residuals | None = None):
+        self.toas = toas
+        self.model = model
+        self.resids = residuals or Residuals(toas, model)
+        self.tensor = self.resids.tensor
+        self._free = tuple(model.free_params)
+        self.result: FitResult | None = None
+        # prefit snapshot for get_summary (reference Fitter keeps model_init)
+        from pint_tpu.models.base import leaf_to_f64
+
+        self._prefit_values = {
+            n: float(np.asarray(leaf_to_f64(model.params[n]))) for n in self._free
+        }
+        self._prefit_wrms = self.resids.rms_weighted()
+
+    def _step_fn(self, params, tensor):
+        r = self.resids
+        fn = get_step_fn(self.model, self._free, r.subtract_mean)
+        params = self.model.xprec.convert_params(params)
+        return fn(params, tensor, r._track_pn, r._delta_pn, r._weights, jnp.asarray(r.errors_s))
+
+    def chi2_at(self, params: dict) -> float:
+        _, _, rt = self.resids._phase_fn(params, self.tensor)
+        r = np.asarray(rt)
+        return float(np.sum((r / self.resids.errors_s) ** 2))
+
+    def _rebuild_resids(self) -> Residuals:
+        """Fresh post-fit residuals preserving the caller's tracking mode and
+        mean-subtraction choice."""
+        return Residuals(
+            self.toas,
+            self.model,
+            tensor=self.tensor,
+            track_mode=self.resids.track_mode,
+            subtract_mean=self.resids.subtract_mean,
+        )
+
+    def _degenerate_params(self, s: np.ndarray, vt: np.ndarray) -> list[str]:
+        """Names of free params dominating near-null singular directions
+        (reference fitter.py:2216-2246 degeneracy diagnostics)."""
+        if s.size == 0:
+            return []
+        bad_dirs = np.flatnonzero(s < SVD_THRESHOLD * s[0])
+        names: list[str] = []
+        for j in bad_dirs:
+            for i in np.flatnonzero(np.abs(vt[j]) > 0.3):
+                if self._free[i] not in names:
+                    names.append(self._free[i])
+        if names:
+            log.warning(f"degenerate fit directions involve: {names}")
+        return names
+
+    # --- host loop ---------------------------------------------------------------
+
+    def _frozen_fit_result(self) -> FitResult:
+        """Degenerate fit with zero free parameters: report chi2/dof of the
+        existing residual settings, no step."""
+        self.result = FitResult(
+            chi2=self.chi2_at(self.model.params),
+            dof=self.resids.dof,
+            iterations=0,
+            converged=True,
+        )
+        return self.result
+
+    def fit_toas(self, maxiter: int = 4, xtol: float = 1e-2) -> FitResult:
+        """Gauss-Newton iteration.  Converged when every parameter step is
+        below `xtol` of its own uncertainty (reference downhill semantics,
+        fitter.py:1196-1240 — a step much smaller than sigma cannot change
+        any reported digit)."""
+        if len(self._free) == 0:
+            return self._frozen_fit_result()
+        # one host-side conversion: on qf32 the fit deltas then take the
+        # exact qf_add_f64 path instead of dd_add on emulated f64
+        params = self.model.xprec.convert_params(self.model.params)
+        chi2 = None
+        it = 0
+        converged = False
+        for it in range(1, maxiter + 1):
+            r0, M, dx, cov, s, vt, chi2, utb, norm = self._step_fn(params, self.tensor)
+            params = apply_delta(params, self._free, dx)
+            # convergence: relative step in units of parameter uncertainty
+            sigma = jnp.sqrt(jnp.diag(cov))
+            rel = np.asarray(jnp.abs(dx) / jnp.where(sigma == 0, 1.0, sigma))
+            if np.all(rel < xtol):
+                converged = True
+                break
+        return self._finalize_fit(
+            params, self.chi2_at(params), it, converged, cov, s=s, vt=vt
+        )
+
+    def get_summary(self) -> str:
+        """Human-readable fit report (reference Fitter.get_summary,
+        fitter.py:334): fit quality + per-parameter prefit/postfit/
+        uncertainty table."""
+        from pint_tpu.models.base import leaf_to_f64
+
+        if self.result is None:
+            raise RuntimeError("run fit_toas first")
+        res = self.result
+        lines = [
+            f"Fitted model {self.model.psr_name or '?'} using"
+            f" {type(self).__name__} with {len(self._free)} free parameters"
+            f" to {len(self.resids.errors_s)} TOAs",
+            f"Prefit residuals Wrms = {self._prefit_wrms * 1e6:.4g} us,"
+            f" Postfit residuals Wrms = {self.resids.rms_weighted() * 1e6:.4g} us",
+            f"Chisq = {res.chi2:.4f} for {res.dof} d.o.f."
+            f" reduced Chisq = {res.reduced_chi2:.4f}"
+            f" {'(converged)' if res.converged else '(NOT converged)'}",
+            "",
+            f"{'PAR':<12s} {'Prefit':>24s} {'Postfit':>24s} {'Unc':>12s} Units",
+        ]
+        for n in self._free:
+            post = float(np.asarray(leaf_to_f64(self.model.params[n])))
+            unc = res.uncertainties.get(n)
+            spec = self.model.param_meta[n].spec
+            lines.append(
+                f"{n:<12s} {self._prefit_values[n]:>24.15g} {post:>24.15g}"
+                f" {'' if unc is None else format(unc, '>12.3g')} {spec.unit}"
+            )
+        return "\n".join(lines)
+
+    def print_summary(self) -> None:
+        print(self.get_summary())
+
+    # --- labeled matrices (reference pint_matrix.py:701-811 surface) -----------
+
+    def get_parameter_covariance_matrix(self, pretty_print: bool = False,
+                                        prec: int = 3) -> np.ndarray:
+        """Post-fit parameter covariance (reference
+        get_parameter_covariance_matrix, fitter.py:738); optionally
+        pretty-printed with parameter labels."""
+        if self.result is None or self.result.covariance is None:
+            raise RuntimeError("run fit_toas first")
+        cov = np.asarray(self.result.covariance)
+        if pretty_print:
+            print(self._format_labeled_matrix(cov, prec))
+        return cov
+
+    def get_parameter_correlation_matrix(self, pretty_print: bool = False,
+                                         prec: int = 3) -> np.ndarray:
+        """Post-fit parameter correlation matrix (reference
+        get_parameter_correlation_matrix, fitter.py:751)."""
+        cov = self.get_parameter_covariance_matrix()
+        sig = np.sqrt(np.diag(cov))
+        zero = sig == 0  # SVD-degenerate parameters have a zeroed cov row
+        sig = np.where(zero, 1.0, sig)
+        corr = cov / np.outer(sig, sig)
+        # a degenerate parameter is perfectly (un)determined, not
+        # "uncorrelated with itself": keep the unit diagonal
+        corr[np.diag_indices_from(corr)] = np.where(zero, 1.0, np.diag(corr))
+        if pretty_print:
+            print(self._format_labeled_matrix(corr, prec))
+        return corr
+
+    def _format_labeled_matrix(self, mat: np.ndarray, prec: int) -> str:
+        names = list(self._free)
+        w = max(max((len(n) for n in names), default=4), prec + 7)
+        head = " " * (w + 1) + " ".join(f"{n:>{w}s}" for n in names)
+        rows = [head]
+        for i, n in enumerate(names):
+            vals = " ".join(f"{mat[i, j]:>{w}.{prec}g}" for j in range(i + 1))
+            rows.append(f"{n:>{w}s} {vals}")
+        return "\n".join(rows)
+
+    def designmatrix(self) -> np.ndarray:
+        """(N, p) d time-resid / d free-param, for inspection/tests (M is
+        the second element of the WLS and GLS step tuples; the wideband
+        fitter overrides this with the combined TOA+DM matrix)."""
+        return np.asarray(self._step_fn(self.model.params, self.tensor)[1])
+
+    def _finalize_fit(self, params, chi2: float, it: int, converged: bool,
+                      cov, s=None, vt=None) -> FitResult:
+        """Shared fit tail: write back params/uncertainties, rebuild
+        residuals, assemble the FitResult."""
+        from pint_tpu.ops.xprec import params_to_dd
+
+        self.model.params = params_to_dd(params)
+        cov = np.asarray(cov)
+        unc = dict(zip(self._free, np.sqrt(np.diag(cov))))
+        for n, u in unc.items():
+            self.model.param_meta[n].uncertainty = float(u)
+        degenerate = []
+        if s is not None and vt is not None:
+            degenerate = self._degenerate_params(np.asarray(s), np.asarray(vt))
+        self.resids = self._rebuild_resids()
+        self.result = FitResult(
+            chi2=chi2,
+            dof=self.resids.dof,
+            iterations=it,
+            converged=converged,
+            uncertainties=unc,
+            covariance=cov,
+            free_params=list(self._free),
+            singular_values=None if s is None else np.asarray(s),
+            degenerate=degenerate,
+        )
+        return self.result
+
+
+class DownhillWLSFitter(WLSFitter):
+    """Levenberg-Marquardt damped Gauss-Newton (reference DownhillFitter,
+    fitter.py:1145-1274, upgraded from step-halving to LM: the damped SVD
+    re-solve is free on the host, so ill-conditioned directions — e.g.
+    near-degenerate DMX columns excited by a far-from-optimum start — are
+    suppressed instead of exploding the trial step)."""
+
+    def fit_toas(self, maxiter: int = 30, required_chi2_decrease: float = 1e-2,
+                 max_rejects: int = 16) -> FitResult:
+        if len(self._free) == 0:
+            return self._frozen_fit_result()
+        params = self.model.xprec.convert_params(self.model.params)
+
+        def solve(pieces, lam):
+            r0, M, dx0, cov, s, vt, _, utb, norm = pieces
+            return dx0 if lam == 0.0 else lm_step(s, vt, utb, norm, lam)
+
+        params, chi2_best, it, converged, pieces = run_lm(
+            params, self.chi2_at(params),
+            compute_pieces=lambda p: self._step_fn(p, self.tensor),
+            solve=solve,
+            chi2_of=self.chi2_at,
+            apply_step=lambda p, dx: apply_delta(p, self._free, dx),
+            maxiter=maxiter, required_gain=required_chi2_decrease,
+            max_rejects=max_rejects, log_label="downhill WLS fit",
+        )
+        _, _, _, cov, s, *_ = pieces
+        return self._finalize_fit(params, chi2_best, it, converged, cov, s=s)
+
+
+
+
+class PowellFitter(WLSFitter):
+    """Derivative-free simplex/Powell minimization of chi^2 (reference
+    PowellFitter, fitter.py:1916 via scipy) — for pathologically nonlinear
+    corners where Gauss-Newton struggles. Uncertainties still come from a
+    final WLS linearization at the optimum."""
+
+    def fit_toas(self, maxiter: int = 2000, xtol: float = 1e-10) -> FitResult:
+        from scipy.optimize import minimize
+
+        if len(self._free) == 0:
+            return self._frozen_fit_result()
+        params0 = self.model.xprec.convert_params(self.model.params)
+        # scale deltas by parfile uncertainties (or rough defaults)
+        scales = np.array(
+            [self.model.param_meta[n].uncertainty or 1e-10 for n in self._free]
+        )
+
+        def chi2_of(z):
+            return self.chi2_at(apply_delta(params0, self._free, z * scales))
+
+        res = minimize(
+            chi2_of, np.zeros(len(self._free)), method="Powell",
+            options={"maxiter": maxiter, "xtol": xtol},
+        )
+        params = apply_delta(params0, self._free, res.x * scales)
+        # linearize once at the optimum for the covariance
+        pieces = self._step_fn(params, self.tensor)
+        cov = pieces[3]
+        return self._finalize_fit(
+            params, float(res.fun), int(res.nit), bool(res.success), cov,
+            s=pieces[4],
+        )

@@ -1,0 +1,437 @@
+"""Chi^2 grid scans as one compiled SPMD program.
+
+Reference: pint/gridutils.py:156 (grid_chisq) — the reference deep-copies the
+fitter per grid point and refits in a process pool; its own profiling shows
+~82% of wall time in design-matrix construction + residual evaluation
+(profiling/README.txt:62-71, 176.4 s for a 3x3 grid).
+
+TPU re-design: ONE jitted program evaluates every grid point.
+
+- Per grid point: fix the gridded parameters, run `maxiter` Gauss-Newton
+  refits of the remaining free parameters (design matrix via jacfwd through
+  the extended-precision phase chain, normal equations on the MXU,
+  Cholesky solve), return chi^2.
+- Grid points are a `vmap` batch axis (single chip) and/or a sharded mesh
+  axis (multi chip).
+- The TOA axis can additionally be sharded over the mesh: weighted means,
+  column norms, normal equations G = A^T A, c = A^T b and the final chi^2
+  all reduce with `jax.lax.psum` over the `toa` mesh axis, so the collectives
+  ride ICI while each chip only ever touches its TOA block.
+
+TZR anchoring under TOA sharding: the fiducial TZR row (which the model
+subtracts from every phase, models/timing_model.py:228-232) is REPLICATED
+into every TOA shard as its last local row, so each shard anchors locally
+and no broadcast of the TZR phase is needed.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from pint_tpu.fitting.wls import apply_delta
+from pint_tpu.fitting.woodbury import cinv_apply, s_factor, woodbury_chi2
+from pint_tpu.residuals import phase_residual_frac
+from pint_tpu.utils.logging import get_logger
+
+log = get_logger("pint_tpu.gridutils")
+
+Array = jnp.ndarray
+
+# ridge added to the equilibrated normal equations: keeps the Cholesky solve
+# finite along degenerate directions (the equilibrated G has unit diagonal,
+# so 1e-10 only moves singular values below ~1e-5 of the largest)
+_RIDGE = 1e-10
+
+
+def _point_kernel(model, grid_names, free, subtract_mean, maxiter, toa_axis=None,
+                  correlated=False):
+    """Pure per-grid-point chi^2 kernel.
+
+    kernel(vals, params, data) -> scalar chi^2, where
+      vals : (len(grid_names),) f64 values (model-internal units)
+      params : xprec-converted parameter pytree (replicated)
+      data : dict with 'tensor' (model tensor, rows possibly a TOA shard),
+             'w' (1/err^2, zero on padding rows), 'track_pn',
+             'delta_pn' (either may be None).
+
+    With `toa_axis` set, every reduction over the TOA axis is completed with
+    a psum over that mesh axis, making the kernel valid inside shard_map.
+    """
+    from pint_tpu.fitting.design import linear_columns, linear_split
+
+    xp = model.xprec
+    mean_free = subtract_mean and not model.has_phase_offset
+    p = len(free)
+    nonlin, lin_names, owners = linear_split(model, free)
+    sl_data = slice(None, -1) if model.has_abs_phase else slice(None)
+
+    def _reduce(x):
+        s = jnp.sum(x, axis=0)
+        if toa_axis is not None:
+            s = jax.lax.psum(s, toa_axis)
+        return s
+
+    def _reduce_mat(m):
+        if toa_axis is not None:
+            m = jax.lax.psum(m, toa_axis)
+        return m
+
+    def time_resids_f(params, data):
+        _, r, f = phase_residual_frac(
+            model,
+            params,
+            data["tensor"],
+            track_pn=data["track_pn"],
+            delta_pn=data["delta_pn"],
+            subtract_mean=False,
+        )
+        r = r / f
+        if mean_free:
+            w = data["w"]
+            r = r - _reduce(w * r) / _reduce(w)
+        return r, f
+
+    def time_resids(params, data):
+        return time_resids_f(params, data)[0]
+
+    def gn_step(params, data):
+        """One GLS/WLS Gauss-Newton refit: hybrid design matrix (autodiff
+        over the nonlinear params + analytic columns for the linear
+        families, fitting/design.py); with correlated noise the marginalized
+        normal equations apply C^-1 through the structured Woodbury algebra
+        (same as fitting/gls.py)."""
+
+        def rfun(delta):
+            return time_resids_f(apply_delta(params, nonlin, delta), data)
+
+        z = jnp.zeros(len(nonlin))
+        (r0, f0), jvp = jax.linearize(rfun, z)
+        cols = {}
+        if nonlin:
+            M_nl = jax.vmap(jvp)(jnp.eye(len(nonlin)))[0].T
+            for i, n in enumerate(nonlin):
+                cols[n] = M_nl[:, i]
+        if lin_names:
+            M_l = linear_columns(model, params, data["tensor"], f0, sl_data,
+                                 lin_names, owners)
+            if mean_free:
+                w = data["w"]
+                M_l = M_l - _reduce(w[:, None] * M_l) / _reduce(w)
+            for i, n in enumerate(lin_names):
+                cols[n] = M_l[:, i]
+        M = jnp.stack([cols[n] for n in free], axis=1)  # (N_local, p)
+        w = data["w"]
+        # global column equilibration (reference fitter.py:2186)
+        col2 = _reduce(w[:, None] * M * M)
+        norm = jnp.sqrt(jnp.where(col2 == 0, 1.0, col2))
+        Mn = M / norm
+        # marginalized normal equations, C^-1 via structured Woodbury
+        # (fitting/woodbury.py); segment-sums/contractions are local to the
+        # TOA shard and completed with psum
+        if correlated:
+            basis = model.noise_basis_and_weights(params, data["tensor"])
+            sf = s_factor(basis, w, reduce=_reduce_mat) if basis is not None else None
+            CinvM = cinv_apply(basis, w, Mn, sf, reduce=_reduce_mat)
+        else:
+            CinvM = w[:, None] * Mn
+        G = _reduce_mat(Mn.T @ CinvM) + _RIDGE * jnp.eye(p)
+        c = _reduce_mat(CinvM.T @ (-r0))
+        dx = jax.scipy.linalg.cho_solve(jax.scipy.linalg.cho_factor(G), c) / norm
+        return apply_delta(params, free, dx)
+
+    def kernel(vals, params, data):
+        params = dict(params)
+        for i, n in enumerate(grid_names):
+            params[n] = xp.lift(vals[i])
+        for _ in range(maxiter if free else 0):
+            params = gn_step(params, data)
+        r = time_resids(params, data)
+        w = data["w"]
+        if not correlated:
+            return _reduce(w * r * r)
+        # Woodbury GLS chi^2 (fitting/gls.py docstring), structured basis
+        basis = model.noise_basis_and_weights(params, data["tensor"])
+        chi2, _ = woodbury_chi2(basis, w, r, reduce=_reduce_mat)
+        return chi2
+
+    return kernel
+
+
+def _host_data(resids, tensor):
+    """Assemble the kernel's data dict from a Residuals object (host side)."""
+    w = 1.0 / np.asarray(resids.errors_s) ** 2
+    return {
+        "tensor": tensor,
+        "w": jnp.asarray(w),
+        "track_pn": resids._track_pn,
+        "delta_pn": resids._delta_pn,
+    }
+
+
+def _shard_data_host(model, data, n_shards):
+    """Re-lay the TOA axis of `data` into `n_shards` equal blocks.
+
+    Each block is [chunk data rows ..., (pad rows), TZR row?]; pad rows get
+    w = 0 so they drop out of every reduction. Returns
+    (data', specs') where specs' marks each leaf sharded (True) or
+    replicated (False).
+    """
+    has_tzr = model.has_abs_phase
+    tensor = {k: np.asarray(v) for k, v in data["tensor"].items()}
+    n_rows = tensor["t_hi"].shape[0]
+    n_data = n_rows - (1 if has_tzr else 0)
+    chunk = -(-n_data // n_shards)  # ceil
+
+    def lay_tensor(a):
+        tzr = a[-1:] if has_tzr else None
+        body = a[:n_data]
+        pad_row = body[-1:]  # any valid row; weights zero it out
+        blocks = []
+        for k in range(n_shards):
+            blk = body[k * chunk : (k + 1) * chunk]
+            n_pad = chunk - blk.shape[0]
+            parts = [blk]
+            if n_pad:
+                parts.append(np.repeat(pad_row, n_pad, axis=0))
+            if has_tzr:
+                parts.append(tzr)
+            blocks.append(np.concatenate(parts, axis=0))
+        return jnp.asarray(np.concatenate(blocks, axis=0))
+
+    def lay_vec(a, fill=0.0):
+        if a is None:
+            return None
+        a = np.asarray(a)
+        blocks = []
+        for k in range(n_shards):
+            blk = a[k * chunk : (k + 1) * chunk]
+            n_pad = chunk - blk.shape[0]
+            if n_pad:
+                blk = np.concatenate([blk, np.full((n_pad,), fill, a.dtype)])
+            blocks.append(blk)
+        return jnp.asarray(np.concatenate(blocks))
+
+    # non-row-indexed aux entries (noise_tspan, ecorr_widx, ...) stay
+    # replicated; only row-indexed leaves are re-laid into shards
+    row_keys = {k for k, v in tensor.items() if v.shape[:1] == (n_rows,)}
+    out = {
+        "tensor": {
+            k: (lay_tensor(v) if k in row_keys else jnp.asarray(v))
+            for k, v in tensor.items()
+        },
+        "w": lay_vec(data["w"]),
+        "track_pn": lay_vec(data["track_pn"]),
+        "delta_pn": lay_vec(data["delta_pn"]),
+    }
+    sharded = {
+        "tensor": {k: k in row_keys for k in tensor},
+        "w": True,
+        "track_pn": None if data["track_pn"] is None else True,
+        "delta_pn": None if data["delta_pn"] is None else True,
+    }
+    return out, sharded
+
+
+def grid_chisq(
+    fitter,
+    parnames,
+    parvalues,
+    maxiter: int = 1,
+    mesh=None,
+    grid_axis: str = "grid",
+    toa_axis: str = "toa",
+    batch: int | None = None,
+):
+    """Chi^2 over a parameter grid, refitting all other free parameters.
+
+    Mirrors the reference API (pint/gridutils.py:156): `parnames` is a tuple
+    of fittable parameter names, `parvalues` a matching tuple of 1-D value
+    arrays (model-internal units); the result has shape
+    ``np.meshgrid(*parvalues)`` — i.e. ``(len(parvalues[1]),
+    len(parvalues[0]), ...)`` for the default 'xy' indexing.
+
+    maxiter : Gauss-Newton refit iterations per grid point (the reference
+        WLSFitter.fit_toas default is one full linear step).
+    mesh : optional `jax.sharding.Mesh`. Axis `grid_axis` shards the
+        flattened grid points; axis `toa_axis` (if present in the mesh)
+        additionally shards the TOA rows, with psum collectives completing
+        every reduction.
+    batch : grid points evaluated concurrently per chip (vmap width); the
+        rest of the grid streams through `lax.map`. Default: everything at
+        once below 64 points, else 16 per chip.
+    """
+    if len(parnames) != len(parvalues):
+        raise ValueError(
+            f"{len(parnames)} parameter names but {len(parvalues)} value arrays"
+        )
+    grids = np.meshgrid(*[np.asarray(v, np.float64) for v in parvalues])
+    out_shape = grids[0].shape
+    pts = np.stack([g.ravel() for g in grids], axis=1)  # (npts, g)
+    chi2 = grid_chisq_points(
+        fitter, parnames, pts, maxiter=maxiter, mesh=mesh,
+        grid_axis=grid_axis, toa_axis=toa_axis, batch=batch,
+    )
+    return chi2.reshape(out_shape)
+
+
+def grid_chisq_points(
+    fitter,
+    parnames,
+    points,
+    maxiter: int = 1,
+    mesh=None,
+    grid_axis: str = "grid",
+    toa_axis: str = "toa",
+    batch: int | None = None,
+):
+    """Chi^2 at an ARBITRARY set of parameter points: `points` is
+    (npts, len(parnames)) in model-internal units. The shared engine under
+    grid_chisq / grid_chisq_derived."""
+    model = fitter.model
+    resids = fitter.resids
+    for n in parnames:
+        if n not in model.param_meta:
+            raise KeyError(f"unknown parameter {n}")
+    free = tuple(n for n in model.free_params if n not in parnames)
+
+    pts = np.asarray(points, np.float64)
+    if pts.ndim != 2 or pts.shape[1] != len(parnames):
+        raise ValueError(
+            f"points must be (npts, {len(parnames)}) for parameters "
+            f"{tuple(parnames)}; got shape {pts.shape}"
+        )
+    npts = pts.shape[0]
+
+    # the chi^2 STATISTIC follows the fitter type, like the reference's
+    # per-fitter grids: GLS fitters grid the Woodbury/correlated statistic,
+    # WLS fitters the plain weighted chi^2 even when the model carries
+    # noise components (reference bench_chisq_grid vs _WLSFitter)
+    from pint_tpu.fitting.gls import GLSFitter
+
+    correlated = isinstance(fitter, GLSFitter) and model.has_correlated_errors
+
+    params = model.xprec.convert_params(model.params)
+    data = _host_data(resids, fitter.tensor)
+
+    if mesh is not None:
+        chi2 = _grid_sharded(
+            model, parnames, free, resids.subtract_mean, maxiter, mesh,
+            grid_axis, toa_axis, pts, params, data, correlated,
+        )
+    else:
+        chi2 = _grid_single(
+            model, parnames, free, resids.subtract_mean, maxiter, pts,
+            params, data, batch, correlated,
+        )
+    return np.asarray(chi2)[:npts]
+
+
+def grid_chisq_derived(
+    fitter,
+    parnames,
+    parfuncs,
+    gridvalues,
+    maxiter: int = 1,
+    mesh=None,
+    grid_axis: str = "grid",
+    toa_axis: str = "toa",
+    batch: int | None = None,
+):
+    """Chi^2 over a grid of DERIVED parameters (reference
+    gridutils.py:382): `parfuncs[i]` maps the meshgridded `gridvalues` to
+    the model parameter `parnames[i]` (e.g. grid over (Mp, Mc) while the
+    model is fit in (M2, SINI)).
+
+    Returns (chi2 array shaped like the meshgrid, [parvalues arrays]).
+    """
+    if len(parnames) != len(parfuncs):
+        raise ValueError("parnames and parfuncs must pair up")
+    grids = np.meshgrid(*[np.asarray(v, np.float64) for v in gridvalues])
+    out_shape = grids[0].shape
+    parvalues = [np.asarray(f(*grids), np.float64) for f in parfuncs]
+    pts = np.stack([v.ravel() for v in parvalues], axis=1)
+    chi2 = grid_chisq_points(
+        fitter, parnames, pts, maxiter=maxiter, mesh=mesh,
+        grid_axis=grid_axis, toa_axis=toa_axis, batch=batch,
+    )
+    return chi2.reshape(out_shape), parvalues
+
+
+def _grid_single(model, parnames, free, subtract_mean, maxiter, pts, params, data,
+                 batch, correlated):
+    from pint_tpu.ops.compile import precision_jit
+
+    npts = pts.shape[0]
+    if batch is None:
+        batch = npts if npts <= 64 else 16
+    batch = min(batch, npts)
+    n_pad = (-npts) % batch
+    if n_pad:
+        pts = np.concatenate([pts, np.repeat(pts[-1:], n_pad, axis=0)])
+    tiles = jnp.asarray(pts.reshape(-1, batch, pts.shape[1]))
+
+    # compiled program cached on the model: repeated scans (bench repeats,
+    # profile sweeps) must not re-trace/re-compile
+    cache = model.__dict__.setdefault("_grid_fn_cache", {})
+    key = ("single", parnames, free, subtract_mean, maxiter, batch,
+           correlated, model.xprec.name)
+    if key not in cache:
+        kernel = _point_kernel(model, parnames, free, subtract_mean, maxiter,
+                               correlated=correlated)
+        vk = jax.vmap(kernel, in_axes=(0, None, None))
+        cache[key] = precision_jit(
+            lambda tiles, params, data: jax.lax.map(lambda t: vk(t, params, data), tiles)
+        )
+    return cache[key](tiles, params, data).reshape(-1)
+
+
+def _grid_sharded(model, parnames, free, subtract_mean, maxiter, mesh,
+                  grid_axis, toa_axis, pts, params, data, correlated):
+    from jax.sharding import PartitionSpec as P
+
+    shard_map = jax.shard_map
+
+    if grid_axis not in mesh.shape:
+        raise ValueError(f"mesh has no axis {grid_axis!r}")
+    n_grid = mesh.shape[grid_axis]
+    shard_toas = toa_axis in mesh.shape and mesh.shape[toa_axis] > 1
+    eff_toa_axis = toa_axis if shard_toas else None
+
+    npts = pts.shape[0]
+    n_pad = (-npts) % n_grid
+    if n_pad:
+        pts = np.concatenate([pts, np.repeat(pts[-1:], n_pad, axis=0)])
+    pts = jnp.asarray(pts)
+
+    if shard_toas:
+        data, sharded = _shard_data_host(model, data, mesh.shape[toa_axis])
+        data_specs = jax.tree.map(
+            lambda s: P(toa_axis) if s else P(), sharded,
+            is_leaf=lambda x: isinstance(x, bool),
+        )
+    else:
+        data_specs = jax.tree.map(lambda _: P(), data)
+
+    from pint_tpu.ops.compile import precision_jit
+
+    cache = model.__dict__.setdefault("_grid_fn_cache", {})
+    key = ("sharded", parnames, free, subtract_mean, maxiter,
+           grid_axis, toa_axis, tuple(mesh.devices.flat),
+           tuple(sorted(mesh.shape.items())), shard_toas, correlated,
+           model.xprec.name)
+    if key not in cache:
+        kernel = _point_kernel(model, parnames, free, subtract_mean, maxiter,
+                               toa_axis=eff_toa_axis, correlated=correlated)
+        vk = jax.vmap(kernel, in_axes=(0, None, None))
+        param_specs = jax.tree.map(lambda _: P(), params)
+        fn = shard_map(
+            vk,
+            mesh=mesh,
+            in_specs=(P(grid_axis), param_specs, data_specs),
+            out_specs=P(grid_axis),
+            check_vma=False,
+        )
+        cache[key] = precision_jit(fn)
+    return cache[key](pts, params, data)

@@ -1,0 +1,9 @@
+"""Host-side IO: parfile and tim-file parsing/writing.
+
+These are irregular, string-heavy, once-per-dataset tasks and deliberately
+stay in pure Python/numpy on the host (SURVEY.md §7 design stance); nothing
+here is traced by JAX.
+"""
+
+from pint_tpu.io.par import ParFile, parse_parfile  # noqa: F401
+from pint_tpu.io.tim import TimFile, TOALine, parse_tim, write_tim  # noqa: F401

@@ -1,0 +1,154 @@
+"""Minimal FITS reader: headers + binary tables (no external deps).
+
+The reference reads event files through astropy.io.fits; this environment
+has no astropy, and the subset of FITS that photon-event files use —
+ASCII header cards in 2880-byte blocks, BINTABLE extensions with scalar
+big-endian columns — is small enough to read directly with numpy.
+
+Supports TFORM codes L, X(->bytes), B, I, J, K, E, D, A(strings) with
+repeat counts, and TSCALn/TZEROn scaling. Enough for Fermi FT1/FT2,
+NICER/RXTE/NuSTAR event files and their GTI extensions.
+"""
+
+from __future__ import annotations
+
+import re
+
+import numpy as np
+
+BLOCK = 2880
+CARD = 80
+
+_TFORM_RE = re.compile(r"^(\d*)([LXBIJKAED])")
+_DTYPES = {
+    "L": ("u1", 1),
+    "X": ("u1", 1),
+    "B": ("u1", 1),
+    "I": (">i2", 2),
+    "J": (">i4", 4),
+    "K": (">i8", 8),
+    "E": (">f4", 4),
+    "D": (">f8", 8),
+    "A": ("S", 1),
+}
+
+
+def _parse_header(fh) -> dict:
+    """Read header blocks until END; returns {keyword: value} with FITS
+    typing (bool/int/float/str)."""
+    hdr: dict = {}
+    while True:
+        block = fh.read(BLOCK)
+        if len(block) < BLOCK:
+            if not hdr:
+                return {}
+            raise EOFError("truncated FITS header")
+        for i in range(0, BLOCK, CARD):
+            card = block[i : i + CARD].decode("ascii", "replace")
+            key = card[:8].strip()
+            if key == "END":
+                return hdr
+            if not key or key in ("COMMENT", "HISTORY") or card[8:10] != "= ":
+                continue
+            val = card[10:]
+            # strip inline comment (outside quoted strings)
+            if val.lstrip().startswith("'"):
+                m = re.match(r"\s*'((?:[^']|'')*)'", val)
+                hdr[key] = m.group(1).replace("''", "'").rstrip() if m else val.strip()
+                continue
+            val = val.split("/")[0].strip()
+            if val in ("T", "F"):
+                hdr[key] = val == "T"
+            else:
+                try:
+                    hdr[key] = int(val)
+                except ValueError:
+                    try:
+                        hdr[key] = float(val.replace("D", "E").replace("d", "e"))
+                    except ValueError:
+                        hdr[key] = val
+
+
+def _skip_data(fh, hdr: dict) -> None:
+    naxis = hdr.get("NAXIS", 0)
+    if naxis == 0:
+        return
+    nbytes = abs(hdr.get("BITPIX", 8)) // 8
+    for i in range(1, naxis + 1):
+        nbytes *= hdr.get(f"NAXIS{i}", 0)
+    pad = -nbytes % BLOCK
+    fh.seek(nbytes + pad, 1)
+
+
+def _read_bintable(fh, hdr: dict) -> dict[str, np.ndarray]:
+    nrow = hdr["NAXIS2"]
+    rowlen = hdr["NAXIS1"]
+    nfield = hdr["TFIELDS"]
+    raw = fh.read(nrow * rowlen)
+    heap = hdr.get("PCOUNT", 0)
+    pad = -(nrow * rowlen + heap) % BLOCK
+    fh.seek(heap + pad, 1)
+    cols: dict[str, np.ndarray] = {}
+    offset = 0
+    for k in range(1, nfield + 1):
+        tform = str(hdr.get(f"TFORM{k}", "")).strip()
+        name = str(hdr.get(f"TTYPE{k}", f"COL{k}")).strip()
+        m = _TFORM_RE.match(tform)
+        if m is None:
+            raise ValueError(f"unsupported TFORM {tform!r}")
+        rep = int(m.group(1) or 1)
+        code = m.group(2)
+        if code == "X":
+            nby = (rep + 7) // 8
+            offset += nby
+            continue
+        dt, size = _DTYPES[code]
+        if code == "A":
+            arr = np.ndarray(
+                (nrow,), dtype=f"S{rep}", buffer=raw,
+                offset=offset, strides=(rowlen,),
+            ).astype(str)
+            offset += rep
+        else:
+            full = np.ndarray(
+                (nrow, rep), dtype=dt, buffer=raw,
+                offset=offset, strides=(rowlen, size),
+            )
+            arr = full[:, 0] if rep == 1 else full.copy()
+            offset += rep * size
+        scale = hdr.get(f"TSCAL{k}", 1)
+        zero = hdr.get(f"TZERO{k}", 0)
+        if scale != 1 or zero != 0:
+            arr = arr * scale + zero
+        cols[name] = np.asarray(arr)
+    return cols
+
+
+class HDU:
+    def __init__(self, header: dict, data: dict | None):
+        self.header = header
+        self.data = data
+        self.name = str(header.get("EXTNAME", "")).strip()
+
+
+def read_fits(path: str) -> list[HDU]:
+    """All HDUs of a FITS file; BINTABLE data as {column: array}."""
+    hdus: list[HDU] = []
+    with open(path, "rb") as fh:
+        while True:
+            hdr = _parse_header(fh)
+            if not hdr:
+                break
+            if str(hdr.get("XTENSION", "")).strip() == "BINTABLE":
+                hdus.append(HDU(hdr, _read_bintable(fh, hdr)))
+            else:
+                _skip_data(fh, hdr)
+                hdus.append(HDU(hdr, None))
+    return hdus
+
+
+def find_extension(hdus: list[HDU], name: str) -> HDU:
+    for h in hdus:
+        if h.name.upper() == name.upper():
+            return h
+    raise KeyError(f"no extension {name!r}; found {[h.name for h in hdus]}")

@@ -1,0 +1,14 @@
+"""Timing-model layer: components, TimingModel, parfile builder.
+
+TPU-first redesign of the reference's pint/models/ (SURVEY.md §2.4): static
+component structure + parameter pytrees + pure jit-able phase functions.
+"""
+
+from pint_tpu.models.astrometry import AstrometryEcliptic, AstrometryEquatorial  # noqa: F401
+from pint_tpu.models.base import Component, DEFAULT_ORDER  # noqa: F401
+from pint_tpu.models.builder import build_model, get_model, get_model_and_toas  # noqa: F401
+from pint_tpu.models.dispersion import DispersionDM, DispersionDMX  # noqa: F401
+from pint_tpu.models.phase_misc import AbsPhase, DelayJump, PhaseJump, PhaseOffset  # noqa: F401
+from pint_tpu.models.solar_system_shapiro import SolarSystemShapiro  # noqa: F401
+from pint_tpu.models.spindown import Spindown  # noqa: F401
+from pint_tpu.models.timing_model import TimingModel  # noqa: F401

@@ -1,0 +1,292 @@
+"""Astrometry: Roemer delay + parallax from site SSB position and the
+proper-motion-corrected source direction.
+
+Reference: pint/models/astrometry.py (Astrometry:37,
+solar_system_geometric_delay:121, AstrometryEquatorial:232,
+AstrometryEcliptic:582). The reference delegates coordinate math to astropy
+SkyCoord objects and writes ~480 LoC of hand-derived partials
+(d_delay_astrometry_d_*:393-871); here the source direction is computed
+directly with vectorized trig inside the jitted delay function, so autodiff
+provides every derivative, including through the ecliptic rotation.
+
+Geometry (all positions in light-seconds, ICRS axes):
+    n(t)   unit vector SSB->pulsar with linear proper motion in the angles
+    roemer = -r . n                      (r = ssb_obs_pos)
+    px     = px_rad * (|r|^2 - (r.n)^2) / (2 AU_ls)
+    delay  = roemer + px
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from pint_tpu import AU_LS, OBLIQUITY_J2000_ARCSEC
+from pint_tpu.models.base import DelayComponent, dt_since_epoch_f64, toa_time_dd
+from pint_tpu.models.parameter import (
+    MAS_PER_YR_TO_RAD_PER_S,
+    MAS_TO_RAD,
+    ParamSpec,
+)
+from pint_tpu.ops.dd import dd_to_float
+
+Array = jnp.ndarray
+
+# IERS2010/IAU2006 mean obliquity at J2000 (the reference reads this from
+# data/runtime/ecliptic.dat key IERS2010; same constant)
+OBL_RAD = OBLIQUITY_J2000_ARCSEC * np.pi / (180.0 * 3600.0)
+
+
+def ecliptic_to_icrs(v: Array, obl_rad=OBL_RAD) -> Array:
+    """Rotate (..., 3) vectors from ecliptic-of-J2000 to ICRS axes."""
+    x, y, z = v[..., 0], v[..., 1], v[..., 2]
+    c, s = jnp.cos(obl_rad), jnp.sin(obl_rad)
+    return jnp.stack([x, c * y - s * z, s * y + c * z], axis=-1)
+
+
+def icrs_to_ecliptic(v: Array, obl_rad=OBL_RAD) -> Array:
+    x, y, z = v[..., 0], v[..., 1], v[..., 2]
+    c, s = jnp.cos(obl_rad), jnp.sin(obl_rad)
+    return jnp.stack([x, c * y + s * z, -s * y + c * z], axis=-1)
+
+
+def unit_vector(lon: Array, lat: Array) -> Array:
+    cl = jnp.cos(lat)
+    return jnp.stack([cl * jnp.cos(lon), cl * jnp.sin(lon), jnp.sin(lat)], axis=-1)
+
+
+class AstrometryBase(DelayComponent):
+    category = "astrometry"
+    register = False
+
+    def dt_posepoch(self, params: dict, tensor: dict) -> Array:
+        """Seconds since POSEPOCH (f64 — proper-motion dt needs no dd)."""
+        ep = params.get("POSEPOCH", params.get("PEPOCH"))
+        if ep is None:
+            return dd_to_float(toa_time_dd(tensor))
+        return dt_since_epoch_f64(tensor, ep)
+
+    def pulsar_direction(self, params: dict, tensor: dict) -> Array:
+        """(N,3) ICRS unit vector at each TOA (proper-motion corrected)."""
+        raise NotImplementedError
+
+    def parallax_rad(self, params: dict) -> Array:
+        return params.get("PX", jnp.asarray(0.0))
+
+    def delay(self, params: dict, tensor: dict, delay_so_far: Array, xp) -> Array:
+        n = self.pulsar_direction(params, tensor)
+        r = tensor["ssb_obs_pos_ls"]
+        rn = jnp.sum(r * n, axis=-1)
+        roemer = -rn
+        px = self.parallax_rad(params)
+        r2 = jnp.sum(r * r, axis=-1)
+        px_delay = 0.5 * px * (r2 - rn * rn) / AU_LS
+        return roemer + px_delay
+
+
+class AstrometryEquatorial(AstrometryBase):
+    """RAJ/DECJ/PMRA/PMDEC/PX (reference astrometry.py:232)."""
+
+    register = True
+
+    @classmethod
+    def param_specs(cls):
+        return [
+            ParamSpec("RAJ", kind="hms", unit="H:M:S", description="Right ascension (ICRS)"),
+            ParamSpec("DECJ", kind="dms", unit="D:M:S", description="Declination (ICRS)"),
+            ParamSpec(
+                "PMRA",
+                scale=MAS_PER_YR_TO_RAD_PER_S,
+                unit="mas/yr",
+                description="Proper motion in RA (mu_alpha* = mu_alpha cos dec)",
+                default=0.0,
+            ),
+            ParamSpec("PMDEC", scale=MAS_PER_YR_TO_RAD_PER_S, unit="mas/yr", default=0.0),
+            ParamSpec("PX", scale=MAS_TO_RAD, unit="mas", description="Parallax", default=0.0),
+            ParamSpec("POSEPOCH", kind="epoch", unit="MJD"),
+        ]
+
+    def validate(self, params, meta):
+        for p in ("RAJ", "DECJ"):
+            if p not in params:
+                raise ValueError(f"AstrometryEquatorial requires {p}")
+
+    def pulsar_direction(self, params: dict, tensor: dict) -> Array:
+        dt = self.dt_posepoch(params, tensor)
+        dec0 = params["DECJ"]
+        ra = params["RAJ"] + params.get("PMRA", 0.0) * dt / jnp.cos(dec0)
+        dec = dec0 + params.get("PMDEC", 0.0) * dt
+        return unit_vector(ra, dec)
+
+
+class AstrometryEcliptic(AstrometryBase):
+    """ELONG/ELAT/PMELONG/PMELAT/PX in the IERS2010-obliquity ecliptic frame
+    (reference astrometry.py:582, pulsar_ecliptic.py:30)."""
+
+    register = True
+
+    @classmethod
+    def param_specs(cls):
+        return [
+            ParamSpec("ELONG", kind="deg", unit="deg", aliases=("LAMBDA",)),
+            ParamSpec("ELAT", kind="deg", unit="deg", aliases=("BETA",)),
+            ParamSpec(
+                "PMELONG",
+                scale=MAS_PER_YR_TO_RAD_PER_S,
+                unit="mas/yr",
+                aliases=("PMLAMBDA",),
+                default=0.0,
+            ),
+            ParamSpec(
+                "PMELAT",
+                scale=MAS_PER_YR_TO_RAD_PER_S,
+                unit="mas/yr",
+                aliases=("PMBETA",),
+                default=0.0,
+            ),
+            ParamSpec("PX", scale=MAS_TO_RAD, unit="mas", default=0.0),
+            ParamSpec("POSEPOCH", kind="epoch", unit="MJD"),
+            ParamSpec("ECL", kind="str", unit="", default="IERS2010"),
+        ]
+
+    def validate(self, params, meta):
+        for p in ("ELONG", "ELAT"):
+            if p not in params:
+                raise ValueError(f"AstrometryEcliptic requires {p}")
+        ecl = meta.get("ECL", "IERS2010")
+        if ecl not in ("IERS2010", "IERS2003"):
+            raise ValueError(f"unsupported obliquity model ECL {ecl}")
+
+    def pulsar_direction(self, params: dict, tensor: dict) -> Array:
+        dt = self.dt_posepoch(params, tensor)
+        lat0 = params["ELAT"]
+        lon = params["ELONG"] + params.get("PMELONG", 0.0) * dt / jnp.cos(lat0)
+        lat = lat0 + params.get("PMELAT", 0.0) * dt
+        return ecliptic_to_icrs(unit_vector(lon, lat))
+
+
+# --- frame conversion (reference timing_model.py as_ECL:2647 / as_ICRS:2697) ---
+
+def _tangent_basis(lon: float, lat: float) -> tuple[np.ndarray, np.ndarray]:
+    """(e_lon, e_lat) unit vectors of the local tangent plane."""
+    e_lon = np.array([-np.sin(lon), np.cos(lon), 0.0])
+    e_lat = np.array([
+        -np.cos(lon) * np.sin(lat), -np.sin(lon) * np.sin(lat), np.cos(lat)
+    ])
+    return e_lon, e_lat
+
+
+def _convert_astrometry(model, to_ecliptic: bool):
+    """Shared machinery of as_ECL/as_ICRS: exact rotation of the position
+    and proper-motion vectors by the IERS2010 obliquity, tangent-plane
+    jacobian propagation of the uncertainties, free-flag and PX/POSEPOCH
+    carry-over. Returns a NEW model (the input is untouched)."""
+    import copy
+
+    from pint_tpu.models.parameter import ParamValueMeta
+
+    m = copy.deepcopy(model)
+    old = m.astrometry
+    if old is None:
+        raise ValueError("model has no astrometry component")
+    want = AstrometryEcliptic if to_ecliptic else AstrometryEquatorial
+    if isinstance(old, want):
+        return m
+
+    def val(n, default=None):
+        if n not in m.params:
+            return default
+        return float(np.asarray(m.params[n]))
+
+    def unc(n):
+        meta = m.param_meta.get(n)
+        return None if meta is None else meta.uncertainty
+
+    if to_ecliptic:
+        names_in = ("RAJ", "DECJ", "PMRA", "PMDEC")
+        lon_in, lat_in = val("RAJ"), val("DECJ")
+        rot = lambda v: np.asarray(icrs_to_ecliptic(jnp.asarray(v)))
+        names_out = ("ELONG", "ELAT", "PMELONG", "PMELAT")
+    else:
+        names_in = ("ELONG", "ELAT", "PMELONG", "PMELAT")
+        lon_in, lat_in = val("ELONG"), val("ELAT")
+        rot = lambda v: np.asarray(ecliptic_to_icrs(jnp.asarray(v)))
+        names_out = ("RAJ", "DECJ", "PMRA", "PMDEC")
+
+    pm_lon, pm_lat = val(names_in[2], 0.0), val(names_in[3], 0.0)
+    u = rot(np.asarray(unit_vector(lon_in, lat_in)))
+    lon_out = float(np.arctan2(u[1], u[0]) % (2 * np.pi))
+    lat_out = float(np.arcsin(np.clip(u[2], -1.0, 1.0)))
+    e_lon_in, e_lat_in = _tangent_basis(lon_in, lat_in)
+    e_lon_out, e_lat_out = _tangent_basis(lon_out, lat_out)
+    pm3 = rot(pm_lon * e_lon_in + pm_lat * e_lat_in)
+    pm_lon_out = float(pm3 @ e_lon_out)
+    pm_lat_out = float(pm3 @ e_lat_out)
+
+    # tangent-plane jacobian (a pure rotation by the local position angle
+    # between the two frames' north directions)
+    J = np.array([
+        [e_lon_out @ rot(e_lon_in), e_lon_out @ rot(e_lat_in)],
+        [e_lat_out @ rot(e_lon_in), e_lat_out @ rot(e_lat_in)],
+    ])
+
+    def prop_unc(s_lon_t, s_lat):
+        if s_lon_t is None and s_lat is None:
+            return None, None
+        s = np.array([s_lon_t or 0.0, s_lat or 0.0])
+        out = np.sqrt((J**2) @ (s**2))
+        return float(out[0]), float(out[1])
+
+    # position uncertainties work in tangent-plane displacement
+    # (RAJ uncertainty is radians of RA -> displacement needs cos(dec))
+    s_pos = prop_unc(
+        None if unc(names_in[0]) is None else unc(names_in[0]) * np.cos(lat_in),
+        unc(names_in[1]),
+    )
+    s_pm = prop_unc(unc(names_in[2]), unc(names_in[3]))
+
+    carry = {
+        "PX": (m.params.get("PX"), m.param_meta.get("PX")),
+        "POSEPOCH": (m.params.get("POSEPOCH"), m.param_meta.get("POSEPOCH")),
+    }
+    free_map = dict(zip(names_out, [
+        not m.param_meta[n].frozen if n in m.param_meta else False
+        for n in names_in
+    ]))
+
+    m.remove_component(old.name)
+    new = want()
+    m.add_component(new, validate=False)
+    out_vals = (lon_out, lat_out, pm_lon_out, pm_lat_out)
+    out_uncs = (
+        None if s_pos[0] is None else s_pos[0] / np.cos(lat_out),
+        s_pos[1], s_pm[0], s_pm[1],
+    )
+    for n, v, s in zip(names_out, out_vals, out_uncs):
+        m.params[n] = np.float64(v)
+        m.param_meta[n] = ParamValueMeta(
+            spec=new.specs[n], frozen=not free_map[n], uncertainty=s,
+        )
+    for n, (v, meta) in carry.items():
+        if v is not None:
+            m.params[n] = v
+            m.param_meta[n] = meta
+    if to_ecliptic:
+        m.meta["ECL"] = "IERS2010"
+    else:
+        m.meta.pop("ECL", None)
+    new.validate(m.params, m.meta)
+    m.clear_caches()
+    return m
+
+
+def model_as_ECL(model):
+    """Equatorial -> ecliptic astrometry (reference as_ECL,
+    timing_model.py:2647); returns a new model."""
+    return _convert_astrometry(model, to_ecliptic=True)
+
+
+def model_as_ICRS(model):
+    """Ecliptic -> equatorial astrometry (reference as_ICRS,
+    timing_model.py:2697); returns a new model."""
+    return _convert_astrometry(model, to_ecliptic=False)

@@ -1,0 +1,242 @@
+"""Component framework: static model structure + pure delay/phase functions.
+
+The reference's TimingModel is a stateful container whose components mutate
+shared parameter objects (pint/models/timing_model.py:166, Component:2760).
+The TPU-first design splits that into:
+
+- `Component` instances = STATIC structure (which params exist, which mask
+  clauses, how many Taylor terms) fixed at model-build time;
+- parameter VALUES = a flat jax pytree (dict) threaded through pure functions;
+- the TOA side = a dict-of-arrays "tensor" built once per dataset
+  (`TimingModel.build_tensor`), including compiled mask columns and the TZR
+  fiducial row, so `phase(params, tensor)` is a closed jit-able function.
+
+Delay components implement ``delay(params, tensor, total_delay_so_far)``
+returning f64 seconds (delays need ~1e-11 relative precision, comfortably
+f64 — the reference likewise evaluates delays in f64, only phase in
+longdouble). Phase components implement ``phase(params, tensor, total_delay)``
+returning DD turns. The accumulated-delay chain semantics match reference
+timing_model.py:1270-1300.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from pint_tpu import SECS_PER_DAY
+from pint_tpu.io.tim import mjd_string_to_day_frac
+from pint_tpu.models.parameter import (
+    MaskParamInfo,
+    ParamSpec,
+    PrefixSpec,
+    dd_to_str,
+)
+from pint_tpu.ops.dd import DD, dd, dd_add_fp, dd_sub, dd_to_float
+
+Array = jnp.ndarray
+
+# Evaluation order of delay categories; matches the physics ordering of the
+# reference (timing_model.py:105-121 DEFAULT_ORDER) — each component sees the
+# barycentric time implied by the delays before it.
+DEFAULT_ORDER = [
+    "astrometry",
+    "jump_delay",
+    "troposphere",
+    "solar_system_shapiro",
+    "solar_wind",
+    "solar_windx",
+    "dispersion_constant",
+    "dispersion_dmx",
+    "dispersion_jump",
+    "frequency_dependent",
+    "pulsar_system",
+    "spindown",
+    "glitch",
+    "piecewise",
+    "ifunc",
+    "wave",
+    "phase_jump",
+    "absolute_phase",
+    "phase_offset",
+]
+
+
+def epoch_dd_from_mjd_string(s: str) -> DD:
+    """Parfile MJD string -> DD seconds since the tensor epoch, exactly."""
+    from pint_tpu.toas import TENSOR_EPOCH_MJD
+
+    day, hi, lo = mjd_string_to_day_frac(s)
+    from pint_tpu.astro.time import MJDEpoch
+
+    ep = MJDEpoch.from_arrays([day], [hi], [lo])
+    shi, slo = ep.seconds_since(TENSOR_EPOCH_MJD)
+    from pint_tpu.ops.dd import device_split
+
+    shi, slo = device_split(shi[0], slo[0])
+    return DD(np.float64(shi), np.float64(slo))
+
+
+def epoch_dd_to_mjd_string(v: DD, ndigits: int = 15) -> str:
+    """Inverse of epoch_dd_from_mjd_string (for parfile output)."""
+    from pint_tpu.io.tim import day_frac_to_mjd_string
+    from pint_tpu.toas import TENSOR_EPOCH_MJD
+
+    hi = float(np.asarray(v.hi))
+    lo = float(np.asarray(v.lo))
+    days = hi / SECS_PER_DAY
+    day = int(np.floor(days))
+    rem_hi = (hi - day * SECS_PER_DAY) / SECS_PER_DAY
+    rem_lo = lo / SECS_PER_DAY
+    # renormalize into [0,1)
+    carry = int(np.floor(rem_hi + rem_lo))
+    day += carry
+    rem_hi -= carry
+    return day_frac_to_mjd_string(day + TENSOR_EPOCH_MJD, rem_hi, rem_lo, ndigits)
+
+
+def epoch_mjd_float(v: DD) -> float:
+    from pint_tpu.toas import TENSOR_EPOCH_MJD
+
+    return TENSOR_EPOCH_MJD + (float(np.asarray(v.hi)) + float(np.asarray(v.lo))) / SECS_PER_DAY
+
+
+def toa_time_dd(tensor: dict) -> DD:
+    """TDB seconds since tensor epoch for every row, as DD (f64 pair)."""
+    return DD(tensor["t_hi"], tensor["t_lo"])
+
+
+def toa_time_x(xp, tensor: dict):
+    """TDB seconds since tensor epoch in the active precision backend."""
+    return xp.time_from_tensor(tensor)
+
+
+def barycentric_time_x(xp, params: dict, tensor: dict, total_delay):
+    """t_pulsar-frame = TDB - total_delay in backend precision."""
+    return xp.add_f(toa_time_x(xp, tensor), -total_delay)
+
+
+def dt_since_epoch_f64(tensor: dict, epoch_leaf) -> Array:
+    """Seconds since an epoch parameter, plain f64 — for delay components
+    (proper motion, DM Taylor...), which never need extended precision."""
+    ep = leaf_to_f64(epoch_leaf)
+    return (tensor["t_hi"] - ep) + tensor["t_lo"]
+
+
+def leaf_to_f64(v):
+    """Collapse any parameter leaf (DD, QF, or plain) to device f64."""
+    from pint_tpu.ops.qf32 import QF, qf_to_f64
+
+    if isinstance(v, DD):
+        return v.hi + v.lo
+    if isinstance(v, QF):
+        return qf_to_f64(v)
+    return jnp.asarray(v, jnp.float64)
+
+
+class Component:
+    """Base class; subclasses are auto-registered (cf. reference ModelMeta,
+    timing_model.py:2742)."""
+
+    category: str = ""
+    register: bool = True
+    component_types: dict[str, type] = {}
+
+    # static declarations, overridden by subclasses
+    @classmethod
+    def param_specs(cls) -> list[ParamSpec]:
+        return []
+
+    @classmethod
+    def prefix_specs(cls) -> list[PrefixSpec]:
+        return []
+
+    @classmethod
+    def mask_bases(cls) -> list[ParamSpec]:
+        """Specs for repeatable mask-parameter families (JUMP, EFAC, ...)."""
+        return []
+
+    def __init_subclass__(cls, **kw):
+        super().__init_subclass__(**kw)
+        if cls.__dict__.get("register", True) and cls.category:
+            Component.component_types[cls.__name__] = cls
+
+    def __init__(self):
+        # concrete (materialized) specs for this model instance
+        self.specs: dict[str, ParamSpec] = {s.name: s for s in self.param_specs()}
+        self.mask_params: list[MaskParamInfo] = []
+
+    @property
+    def name(self) -> str:
+        return type(self).__name__
+
+    # --- hooks -----------------------------------------------------------------
+
+    def add_prefix_param(self, spec: ParamSpec) -> None:
+        self.specs[spec.name] = spec
+
+    def func_param_specs(self) -> list:
+        """Derived read-only parameters this component exposes (reference
+        funcParameter); list of parameter.FuncParamSpec."""
+        return []
+
+    def parfile_exclude(self) -> set:
+        """Parameter names the generic as_parfile loop must NOT emit
+        (multi-token families the component writes itself)."""
+        return set()
+
+    def extra_parfile_lines(self, model) -> list:
+        """Extra (key, text) parfile lines this component owns (window
+        ranges, multi-token WAVE/IFUNC lines, ...)."""
+        return []
+
+    def default_params(self) -> dict:
+        """Initial values for params whose spec has a default."""
+        out = {}
+        for s in self.specs.values():
+            if s.default is not None and s.is_fittable:
+                out[s.name] = s.parse(str(s.default)) if isinstance(s.default, str) else s.default
+        return out
+
+    def validate(self, params: dict, meta: dict) -> None:
+        """Raise on inconsistent configuration (reference Component.validate)."""
+
+    def host_columns(self, toas, params: dict) -> dict[str, np.ndarray]:
+        """Per-TOA arrays this component needs in the tensor (masks etc.)."""
+        cols = {}
+        for mp in self.mask_params:
+            cols[f"mask_{mp.name}"] = mp.clause.select(toas).astype(np.float64)
+        return cols
+
+    # --- device-side pure functions --------------------------------------------
+
+    def delay(self, params: dict, tensor: dict, delay_so_far: Array, xp) -> Array:
+        """Additional delay in seconds (f64) given accumulated delay.
+
+        `xp` is the extended-precision backend — most delays are pure f64
+        and ignore it; the binary component uses it for exact orbital-phase
+        reduction."""
+        raise NotImplementedError
+
+    def phase(self, params: dict, tensor: dict, total_delay: Array, xp):
+        """Additional phase in turns, in the xp extended-precision backend."""
+        raise NotImplementedError
+
+
+class DelayComponent(Component):
+    register = False
+
+
+class PhaseComponent(Component):
+    register = False
+
+
+def barycentric_time_dd(params: dict, tensor: dict, total_delay: Array) -> DD:
+    """t_pulsar-frame = TDB - total_delay, as DD seconds since tensor epoch.
+
+    This is the time argument of all phase components (reference
+    spindown.get_dt, spindown.py:121).
+    """
+    return dd_add_fp(toa_time_dd(tensor), -total_delay)

@@ -1,0 +1,6 @@
+"""Standalone binary-orbit numerics (reference stand_alone_psr_binaries/).
+
+`kepler` holds the differentiable fixed-iteration Kepler solver; `engines`
+the pure delay functions (BT/DD/DDS/ELL1/ELL1H/ELL1k). The PINT-facing
+component that wires them into the delay chain is models/binary.PulsarBinary.
+"""

@@ -1,0 +1,434 @@
+"""Binary-orbit delay engines as pure jax functions.
+
+Each engine maps (params, dt, phi, norb, pb) -> delay seconds, where
+
+- ``params`` is a dict of f64 scalars in SI/rad internal units (already
+  collapsed from the extended-precision leaves by the component wrapper);
+- ``dt``     f64 seconds since the binary epoch (T0 or TASC), for secular
+  terms (EDOT, A1DOT, OMDOT, EPS1DOT, ...);
+- ``phi``    orbital phase in radians on the centered branch (|phi| <= pi),
+  computed by the wrapper in extended precision (the one quantity that f64
+  cannot carry over ~1e4 orbits);
+- ``norb``   orbit count (f64 integer-valued), to re-attach secular terms
+  that depend on the full true anomaly (DD omega = OM + k nu);
+- ``pb``     instantaneous orbital period pbprime in seconds.
+
+Physics follows the published models the reference implements — Blandford &
+Teukolsky (1976) for BT (reference BT_model.py:93-144), Damour & Deruelle
+(1986) eqs 25-52 for DD (DD_model.py:422-864), Lange et al. (2001) +
+third-order eccentricity terms of Zhu et al. (2019)/Fiore et al. (2023) for
+ELL1 (ELL1_model.py:220-330,598-634), Freire & Wex (2010) orthometric
+harmonics for ELL1H (ELL1H_model.py:66-300), Susobhanan et al. (2018) for
+ELL1k (ELL1k_model.py:40-130), Kramer et al. (2006) SHAPMAX for DDS
+(DDS_model.py:63-67) — re-derived as closed jax expressions; every
+parameter derivative comes from autodiff rather than the reference's ~3k
+LoC of hand-written partials.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from pint_tpu import TSUN_S
+from pint_tpu.models.binaries.kepler import kepler_E, true_anomaly
+
+Array = jnp.ndarray
+
+TWO_PI = 2.0 * jnp.pi
+
+
+def _get(p: dict, name: str, default: float = 0.0):
+    v = p.get(name)
+    return default if v is None else v
+
+
+# --- shared secular evolution ---------------------------------------------------
+
+
+def _ecc(p, dt):
+    return _get(p, "ECC") + _get(p, "EDOT") * dt
+
+
+def _a1(p, dt):
+    return _get(p, "A1") + _get(p, "A1DOT") * dt
+
+
+# --- BT (Blandford & Teukolsky 1976) -------------------------------------------
+
+
+def bt_delay(p: dict, dt: Array, phi: Array, norb: Array, pb: Array) -> Array:
+    e = _ecc(p, dt)
+    a1 = _a1(p, dt)
+    omega = _get(p, "OM") + _get(p, "OMDOT") * dt
+    gamma = _get(p, "GAMMA")
+    E = kepler_E(phi, e)
+    sinE, cosE = jnp.sin(E), jnp.cos(E)
+    sw, cw = jnp.sin(omega), jnp.cos(omega)
+    root = jnp.sqrt(1.0 - e * e)
+    L1 = a1 * sw * (cosE - e)
+    L2 = (a1 * cw * root + gamma) * sinE
+    num = a1 * cw * root * cosE - a1 * sw * sinE
+    D = 1.0 - TWO_PI * num / ((1.0 - e * cosE) * pb)
+    return (L1 + L2) * D
+
+
+# --- DD family (Damour & Deruelle 1986) ----------------------------------------
+
+
+def _dd_core(p: dict, dt: Array, phi: Array, norb: Array, pb: Array, sini: Array) -> Array:
+    e = _ecc(p, dt)
+    a1 = _a1(p, dt)
+    gamma = _get(p, "GAMMA")
+    E = kepler_E(phi, e)
+    sinE, cosE = jnp.sin(E), jnp.cos(E)
+    nu = true_anomaly(E, e)
+    nu_full = nu + TWO_PI * norb
+    # omega = OM + k*nu, k = OMDOT/n = OMDOT pb/2pi (DD eq between 16/17;
+    # reference DD_model.py:85-97 uses pbprime in k)
+    k = _get(p, "OMDOT") * pb / TWO_PI
+    omega = _get(p, "OM") + k * nu_full
+    sw, cw = jnp.sin(omega), jnp.cos(omega)
+    er = e * (1.0 + _get(p, "DR"))
+    eth = e * (1.0 + _get(p, "DTH"))
+    alpha = a1 * sw
+    beta = a1 * jnp.sqrt(1.0 - eth * eth) * cw
+    bg = beta + gamma
+    # Dre = Roemer + Einstein in proper time (DD eq 48)
+    Dre = alpha * (cosE - er) + bg * sinE
+    Drep = -alpha * sinE + bg * cosE
+    Drepp = -alpha * cosE - bg * sinE
+    one_m_ecosE = 1.0 - e * cosE
+    nhat = TWO_PI / pb / one_m_ecosE
+    # inverse timing, DD eqs 46-52 incl. the e sinE correction term
+    delayI = Dre * (
+        1.0
+        - nhat * Drep
+        + (nhat * Drep) ** 2
+        + 0.5 * nhat**2 * Dre * Drepp
+        - 0.5 * e * sinE / one_m_ecosE * nhat**2 * Dre * Drep
+    )
+    # Shapiro (DD eq 26)
+    tm2 = _get(p, "M2") * TSUN_S
+    delayS = -2.0 * tm2 * jnp.log(
+        1.0 - e * cosE - sini * (sw * (cosE - e) + jnp.sqrt(1.0 - e * e) * cw * sinE)
+    )
+    # aberration (DD eq 27)
+    wpnu = omega + nu_full
+    delayA = _get(p, "A0") * (jnp.sin(wpnu) + e * sw) + _get(p, "B0") * (
+        jnp.cos(wpnu) + e * cw
+    )
+    return delayI + delayS + delayA
+
+
+def dd_delay(p: dict, dt: Array, phi: Array, norb: Array, pb: Array) -> Array:
+    return _dd_core(p, dt, phi, norb, pb, _get(p, "SINI"))
+
+
+def dds_delay(p: dict, dt: Array, phi: Array, norb: Array, pb: Array) -> Array:
+    """DD with SHAPMAX = -ln(1 - sini) (Kramer et al. 2006)."""
+    sini = 1.0 - jnp.exp(-_get(p, "SHAPMAX"))
+    return _dd_core(p, dt, phi, norb, pb, sini)
+
+
+# --- ELL1 family (Lange et al. 2001) -------------------------------------------
+
+
+def _ell1_dre_da1(phi, e1, e2):
+    """ELL1 Roemer delay / (a1/c), to third order in eccentricity
+    (Zhu et al. 2019 eq 1; Fiore et al. 2023 eq 4; tempo bnryell1.f)."""
+    s1, c1 = jnp.sin(phi), jnp.cos(phi)
+    s2, c2 = jnp.sin(2 * phi), jnp.cos(2 * phi)
+    s3, c3 = jnp.sin(3 * phi), jnp.cos(3 * phi)
+    s4, c4 = jnp.sin(4 * phi), jnp.cos(4 * phi)
+    return (
+        s1
+        + 0.5 * (e2 * s2 - e1 * c2)
+        - 0.125
+        * (5 * e2**2 * s1 - 3 * e2**2 * s3 - 2 * e2 * e1 * c1 + 6 * e2 * e1 * c3 + 3 * e1**2 * s1 + 3 * e1**2 * s3)
+        - (1.0 / 12)
+        * (
+            5 * e2**3 * s2
+            + 3 * e1**2 * e2 * s2
+            - 6 * e1 * e2**2 * c2
+            - 4 * e1**3 * c2
+            - 4 * e2**3 * s4
+            + 12 * e1**2 * e2 * s4
+            + 12 * e1 * e2**2 * c4
+            - 4 * e1**3 * c4
+        )
+    )
+
+
+def _ell1_dre_dphi_da1(phi, e1, e2):
+    """d/dphi of _ell1_dre_da1."""
+    s1, c1 = jnp.sin(phi), jnp.cos(phi)
+    s2, c2 = jnp.sin(2 * phi), jnp.cos(2 * phi)
+    s3, c3 = jnp.sin(3 * phi), jnp.cos(3 * phi)
+    s4, c4 = jnp.sin(4 * phi), jnp.cos(4 * phi)
+    return (
+        c1
+        + e1 * s2
+        + e2 * c2
+        - 0.125
+        * (5 * e2**2 * c1 - 9 * e2**2 * c3 + 2 * e1 * e2 * s1 - 18 * e1 * e2 * s3 + 3 * e1**2 * c1 + 9 * e1**2 * c3)
+        - (1.0 / 12)
+        * (
+            10 * e2**3 * c2
+            + 6 * e1**2 * e2 * c2
+            + 12 * e1 * e2**2 * s2
+            + 8 * e1**3 * s2
+            - 16 * e2**3 * c4
+            + 48 * e1**2 * e2 * c4
+            - 48 * e1 * e2**2 * s4
+            + 16 * e1**3 * s4
+        )
+    )
+
+
+def _ell1_dre_dphi2_da1(phi, e1, e2):
+    """d^2/dphi^2 of _ell1_dre_da1."""
+    s1, c1 = jnp.sin(phi), jnp.cos(phi)
+    s2, c2 = jnp.sin(2 * phi), jnp.cos(2 * phi)
+    s3, c3 = jnp.sin(3 * phi), jnp.cos(3 * phi)
+    s4, c4 = jnp.sin(4 * phi), jnp.cos(4 * phi)
+    return (
+        -s1
+        + 2 * e1 * c2
+        - 2 * e2 * s2
+        - 0.125
+        * (-5 * e2**2 * s1 + 27 * e2**2 * s3 + 2 * e1 * e2 * c1 - 54 * e1 * e2 * c3 - 3 * e1**2 * s1 - 27 * e1**2 * s3)
+        - (1.0 / 12)
+        * (
+            -20 * e2**3 * s2
+            - 12 * e1**2 * e2 * s2
+            + 24 * e1 * e2**2 * c2
+            + 16 * e1**3 * c2
+            + 64 * e2**3 * s4
+            - 192 * e1**2 * e2 * s4
+            - 192 * e1 * e2**2 * c4
+            + 64 * e1**3 * c4
+        )
+    )
+
+
+def _ell1_inverse(a1, pb, dre_da1, drep_da1, drepp_da1):
+    """Inverse-timing expansion (ELL1_model.py:140-168): proper -> coordinate
+    time with nhat = 2 pi / pb."""
+    Dre = a1 * dre_da1
+    Drep = a1 * drep_da1
+    Drepp = a1 * drepp_da1
+    nhat = TWO_PI / pb
+    return Dre * (1.0 - nhat * Drep + (nhat * Drep) ** 2 + 0.5 * nhat**2 * Dre * Drepp)
+
+
+def _ell1_eps(p, dt):
+    e1 = _get(p, "EPS1") + _get(p, "EPS1DOT") * dt
+    e2 = _get(p, "EPS2") + _get(p, "EPS2DOT") * dt
+    return e1, e2
+
+
+def ell1_delay(p: dict, dt: Array, phi: Array, norb: Array, pb: Array) -> Array:
+    """ELL1: inverse Roemer + M2/SINI Shapiro (Lange et al. 2001 eq A16)."""
+    a1 = _a1(p, dt)
+    e1, e2 = _ell1_eps(p, dt)
+    delayI = _ell1_inverse(
+        a1,
+        pb,
+        _ell1_dre_da1(phi, e1, e2),
+        _ell1_dre_dphi_da1(phi, e1, e2),
+        _ell1_dre_dphi2_da1(phi, e1, e2),
+    )
+    tm2 = _get(p, "M2") * TSUN_S
+    delayS = -2.0 * tm2 * jnp.log(1.0 - _get(p, "SINI") * jnp.sin(phi))
+    return delayI + delayS
+
+
+def ell1h_shapiro(h3: Array, stigma: Array, phi: Array, nharms: int) -> Array:
+    """Freire & Wex (2010) orthometric Shapiro delay from the 3rd harmonic
+    up, 'approximate' form appropriate for medium inclinations (eq 19;
+    reference delayS3p_H3_STIGMA_approximate, ELL1H_model.py:251-262).
+
+    Harmonic k >= 3 contributes  (-1)^pwr * (2/k) * stigma^(k-3) * basis(k phi)
+    with basis=sin, pwr=(k+1)/2 for odd k; basis=cos, pwr=(k+2)/2 for even.
+    """
+    total = jnp.zeros_like(phi)
+    for k in range(3, nharms + 1):
+        if k % 2 == 0:
+            pwr = (k + 2) // 2
+            basis = jnp.cos(k * phi)
+        else:
+            pwr = (k + 1) // 2
+            basis = jnp.sin(k * phi)
+        total = total + (-1.0) ** pwr * (2.0 / k) * stigma ** (k - 3) * basis
+    return -2.0 * h3 * total
+
+
+def ell1h_delay(
+    p: dict, dt: Array, phi: Array, norb: Array, pb: Array, nharms: int = 3, mode: str = "h3"
+) -> Array:
+    """ELL1H: ELL1 Roemer + orthometric-harmonic Shapiro.
+
+    `mode` mirrors the reference's fit_params dispatch (binary_ell1.py:378-388
+    + ELL1H_model.delayS:66-85):
+    - "h3":     harmonic series with stigma = 0 (only the k=3 term survives)
+    - "h4":     harmonic series with stigma = H4/H3 (NHARMS >= 7 enforced by
+                the wrapper)
+    - "stigma": exact all-harmonics form, Freire & Wex (2010) eq 29:
+                -2 H3/stigma^3 ln(1 + stigma^2 - 2 stigma sin Phi)
+    """
+    a1 = _a1(p, dt)
+    e1, e2 = _ell1_eps(p, dt)
+    delayI = _ell1_inverse(
+        a1,
+        pb,
+        _ell1_dre_da1(phi, e1, e2),
+        _ell1_dre_dphi_da1(phi, e1, e2),
+        _ell1_dre_dphi2_da1(phi, e1, e2),
+    )
+    h3 = _get(p, "H3")
+    if mode == "stigma":
+        stigma = _get(p, "STIGMA")
+        lognum = 1.0 + stigma**2 - 2.0 * stigma * jnp.sin(phi)
+        delayS = -2.0 * h3 / stigma**3 * jnp.log(lognum)
+    else:
+        if mode == "h4":
+            h4 = _get(p, "H4")
+            stigma = h4 / jnp.where(h3 == 0.0, 1.0, h3)
+        else:
+            stigma = jnp.zeros_like(h3)
+        delayS = ell1h_shapiro(h3, stigma, phi, nharms)
+    return delayI + delayS
+
+
+def ell1k_delay(p: dict, dt: Array, phi: Array, norb: Array, pb: Array) -> Array:
+    """ELL1k (Susobhanan et al. 2018): rapid periastron advance OMDOT and
+    eccentricity decay LNEDOT; first-order Roemer with the extra -3 eps1/2
+    term (eq 6); M2/SINI Shapiro."""
+    a1 = _a1(p, dt)
+    omdot = _get(p, "OMDOT")
+    lnedot = _get(p, "LNEDOT")
+    e10, e20 = _get(p, "EPS1"), _get(p, "EPS2")
+    cw, sw = jnp.cos(omdot * dt), jnp.sin(omdot * dt)
+    growth = 1.0 + lnedot * dt
+    e1 = growth * (e10 * cw + e20 * sw)
+    e2 = growth * (e20 * cw - e10 * sw)
+    s1 = jnp.sin(phi)
+    s2, c2 = jnp.sin(2 * phi), jnp.cos(2 * phi)
+    dre_da1 = s1 + 0.5 * (e2 * s2 - e1 * (c2 + 3.0))
+    drep_da1 = jnp.cos(phi) + e2 * c2 + e1 * s2
+    drepp_da1 = -s1 - 2.0 * e2 * s2 + 2.0 * e1 * c2
+    delayI = _ell1_inverse(a1, pb, dre_da1, drep_da1, drepp_da1)
+    tm2 = _get(p, "M2") * TSUN_S
+    delayS = -2.0 * tm2 * jnp.log(1.0 - _get(p, "SINI") * s1)
+    return delayI + delayS
+
+
+# --- DDGR: GR-derived post-Keplerian parameters ---------------------------------
+
+
+def ddgr_derived(params: dict) -> dict:
+    """Post-Keplerian parameters from (MTOT, M2) under GR (reference
+    DDGR_model.py; Damour & Deruelle 1986, Taylor & Weisberg 1989):
+
+        OMDOT = 3 n^(5/3) (Tsun MTOT)^(2/3) / (1 - e^2)   [+ XOMDOT]
+        GAMMA = e n^(-1/3) Tsun^(2/3) m2 (m1 + 2 m2) / MTOT^(4/3)
+        PBDOT = -(192 pi / 5) n^(5/3) f(e) Tsun^(5/3) m1 m2 / MTOT^(1/3)
+        SINI  = n^(2/3) x (Tsun MTOT)^(2/3) / (Tsun m2)
+        DR    = n^(2/3) Tsun^(2/3) (3 m1^2 + 6 m1 m2 + 2 m2^2) / MTOT^(4/3)
+        DTH   = n^(2/3) Tsun^(2/3) (3.5 m1^2 + 6 m1 m2 + 2 m2^2) / MTOT^(4/3)
+
+    Returned as plain f64 leaves; PBDOT is injected into the parameter
+    dict so the orbital-phase reduction sees it too.
+    """
+    from pint_tpu.models.base import leaf_to_f64
+
+    mt = leaf_to_f64(params["MTOT"])
+    m2 = leaf_to_f64(params["M2"])
+    m1 = mt - m2
+    e = leaf_to_f64(params.get("ECC", 0.0))
+    x = leaf_to_f64(params.get("A1", 0.0))
+    pb = leaf_to_f64(params["PB"])
+    n = 2.0 * jnp.pi / pb
+    t = TSUN_S
+    n23 = n ** (2.0 / 3.0)
+    omdot = 3.0 * n ** (5.0 / 3.0) * (t * mt) ** (2.0 / 3.0) / (1.0 - e * e)
+    omdot = omdot + leaf_to_f64(params.get("XOMDOT", 0.0))
+    gamma = e * n ** (-1.0 / 3.0) * t ** (2.0 / 3.0) * m2 * (m1 + 2.0 * m2) / mt ** (4.0 / 3.0)
+    fe = (1.0 + 73.0 / 24.0 * e**2 + 37.0 / 96.0 * e**4) / (1.0 - e * e) ** 3.5
+    pbdot = -192.0 * jnp.pi / 5.0 * n ** (5.0 / 3.0) * fe * t ** (5.0 / 3.0) \
+        * m1 * m2 / mt ** (1.0 / 3.0)
+    sini = n23 * x * (t * mt) ** (2.0 / 3.0) / (t * m2)
+    dr = n23 * t ** (2.0 / 3.0) * (3.0 * m1**2 + 6.0 * m1 * m2 + 2.0 * m2**2) / mt ** (4.0 / 3.0)
+    dth = n23 * t ** (2.0 / 3.0) * (3.5 * m1**2 + 6.0 * m1 * m2 + 2.0 * m2**2) / mt ** (4.0 / 3.0)
+    return {"OMDOT": omdot, "GAMMA": gamma, "PBDOT": pbdot, "SINI": sini,
+            "DR": dr, "DTH": dth}
+
+
+# --- DDK: Kopeikin proper-motion + annual-parallax corrections ------------------
+
+
+def ddk_corrections(params: dict, tensor: dict) -> dict:
+    """Per-TOA A1/OM/SINI corrections for the orbital orientation (KIN,
+    KOM) (reference DDK_model.py; Kopeikin 1995 eq 18, 1996 eq 10, 16):
+
+    proper motion:
+        d(A1)/A1 = cot(KIN) (-PMRA sin KOM + PMDEC cos KOM) dt
+        d(OM)    = csc(KIN) ( PMRA cos KOM + PMDEC sin KOM) dt
+    annual parallax (PX > 0), with obs position r in the (east, north)
+    sky basis at the pulsar:
+        d(A1)/A1 = -cot(KIN)/d * (r_e sin KOM - r_n cos KOM)
+        d(OM)    = -csc(KIN)/d * (r_e cos KOM + r_n sin KOM)
+    """
+    from pint_tpu.models.base import leaf_to_f64
+
+    if "PMELONG" in params or "PMELAT" in params or "ELONG" in params:
+        # KOM and the parallax basis below are EQUATORIAL; mixing ecliptic
+        # proper motion in would rotate the corrections by the obliquity
+        # (the reference likewise refuses DDK with ecliptic astrometry)
+        raise NotImplementedError(
+            "DDK requires equatorial astrometry (RAJ/DECJ/PMRA/PMDEC)"
+        )
+    kin0 = leaf_to_f64(params["KIN"])
+    kom = leaf_to_f64(params["KOM"])
+    x0 = leaf_to_f64(params["A1"])
+    om0 = leaf_to_f64(params.get("OM", 0.0))
+    sin_kom, cos_kom = jnp.sin(kom), jnp.cos(kom)
+
+    # time from the binary epoch rides in via the barycentric time column
+    t_s = tensor["t_hi"]
+    ep = leaf_to_f64(params.get("T0", 0.0))
+    dt = t_s - ep
+
+    pmra = leaf_to_f64(params.get("PMRA", 0.0))
+    pmdec = leaf_to_f64(params.get("PMDEC", 0.0))
+    # Kopeikin 1996: the proper motion DRIFTS the inclination itself,
+    # d(kin) = (-PMRA sin KOM + PMDEC cos KOM) dt, and rotates the node,
+    # d(OM) = csc(kin) (PMRA cos KOM + PMDEC sin KOM) dt
+    d_kin = (-pmra * sin_kom + pmdec * cos_kom) * dt
+    dom = (pmra * cos_kom + pmdec * sin_kom) * dt / jnp.sin(kin0)
+
+    px = leaf_to_f64(params.get("PX", 0.0))
+    if "_psr_dir" in tensor:
+        # sky basis at the pulsar: east = z_hat x n / |..|, north = n x east
+        n_hat = tensor["_psr_dir"]
+        zhat = jnp.array([0.0, 0.0, 1.0])
+        east = jnp.cross(jnp.broadcast_to(zhat, n_hat.shape), n_hat)
+        east = east / jnp.linalg.norm(east, axis=-1, keepdims=True)
+        north = jnp.cross(n_hat, east)
+        r = tensor["ssb_obs_pos_ls"]  # light-seconds
+        r_e = jnp.sum(r * east, axis=-1)
+        r_n = jnp.sum(r * north, axis=-1)
+        # 1/d in 1/ls from PX (rad): d = AU/PX
+        AU_LS = 499.00478384
+        inv_d = px / AU_LS
+        d_kin = d_kin - inv_d * (r_e * sin_kom - r_n * cos_kom)
+        dom = dom - inv_d * (r_e * cos_kom + r_n * sin_kom) / jnp.sin(kin0)
+
+    kin_t = kin0 + d_kin
+    # the drifting inclination shapes BOTH the projected semi-major axis
+    # and the Shapiro delay, keeping the orbital geometry self-consistent
+    return {
+        "A1": x0 * jnp.sin(kin_t) / jnp.sin(kin0),
+        "OM": om0 + dom,
+        "SINI": jnp.sin(kin_t),
+    }

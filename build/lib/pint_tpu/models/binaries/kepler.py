@@ -1,0 +1,67 @@
+"""Differentiable Kepler-equation solver: E - e sin E = M.
+
+The reference iterates Newton's method to 5e-15 with a data-dependent while
+loop (stand_alone_psr_binaries/binary_generic.py:337
+compute_eccentric_anomaly). Data-dependent loops don't jit, so here the
+solve runs a FIXED number of Newton steps from Danby's starter — quadratic
+convergence makes 8 steps reach f64 roundoff for any e <= 0.97 (validated in
+tests/test_binary.py against mpmath-free numpy iteration) — and derivatives
+come from the implicit function theorem instead of unrolled-iteration AD:
+
+    dE/dM = 1 / (1 - e cos E)        dE/de = sin E / (1 - e cos E)
+
+which is both exact (independent of iteration count) and ~10x cheaper to
+trace than differentiating through the Newton recurrence.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+Array = jnp.ndarray
+
+NEWTON_ITERS = 10
+
+
+@jax.custom_jvp
+def kepler_E(M: Array, e: Array) -> Array:
+    """Eccentric anomaly for mean anomaly M (rad, any branch), ecc e.
+
+    Returns E on the same branch as M (E - M is periodic and bounded by e).
+    """
+    # Danby (1987) starter: robust for all e in [0, 1)
+    E = M + 0.85 * e * jnp.sign(jnp.sin(M))
+    for _ in range(NEWTON_ITERS):
+        f = E - e * jnp.sin(E) - M
+        fp = 1.0 - e * jnp.cos(E)
+        E = E - f / fp
+    return E
+
+
+@kepler_E.defjvp
+def _kepler_E_jvp(primals, tangents):
+    M, e = primals
+    dM, de = tangents
+    E = kepler_E(M, e)
+    denom = 1.0 - e * jnp.cos(E)
+    dE = (dM + jnp.sin(E) * de) / denom
+    return E, dE
+
+
+def true_anomaly(E: Array, e: Array) -> Array:
+    """True anomaly nu on the same branch as E (continuous across orbits).
+
+    nu_periodic = 2 atan2( sqrt(1+e) sin(E/2), sqrt(1-e) cos(E/2) ) is
+    computed on the centered branch, then re-attached to E's branch the way
+    the reference normalizes nu2 = 2 pi orbits + nu - M
+    (binary_generic.py:538-548).
+    """
+    two_pi = 2.0 * jnp.pi
+    n = jnp.round(E / two_pi)
+    Ec = E - two_pi * n  # centered (-pi, pi]
+    nu_c = 2.0 * jnp.arctan2(
+        jnp.sqrt(1.0 + e) * jnp.sin(0.5 * Ec),
+        jnp.sqrt(1.0 - e) * jnp.cos(0.5 * Ec),
+    )
+    return nu_c + two_pi * n

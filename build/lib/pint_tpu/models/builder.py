@@ -1,0 +1,577 @@
+"""Model builder: parfile -> component selection -> TimingModel.
+
+Reference: pint/models/model_builder.py (ModelBuilder:67, parse_parfile:46,
+choose_model:354, get_model:609, get_model_and_toas:655). Component choice is
+by parameter presence (plus the BINARY line), conflicts and unknown lines are
+reported, and fit flags/uncertainties ride along — same contract, but the
+output is our static-component/pytree TimingModel.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from pint_tpu.io.par import ParFile, parse_fit_flag, parse_parfile
+from pint_tpu.io.tim import mjd_string_to_day_frac
+from pint_tpu.models.astrometry import AstrometryEcliptic, AstrometryEquatorial
+from pint_tpu.models.base import Component, epoch_dd_to_mjd_string
+from pint_tpu.models.dispersion import DispersionDM, DispersionDMX, DispersionJump
+from pint_tpu.models.parameter import (
+    MaskParamInfo,
+    ParamSpec,
+    ParamValueMeta,
+    dd_to_str,
+    format_dms,
+    format_hms,
+    parse_mask_clause,
+)
+from pint_tpu.models.phase_misc import AbsPhase, DelayJump, PhaseJump, PhaseOffset
+from pint_tpu.models.solar_system_shapiro import SolarSystemShapiro
+from pint_tpu.models.spindown import Spindown
+from pint_tpu.models.timing_model import TimingModel
+from pint_tpu.ops.dd import DD
+from pint_tpu.utils.logging import get_logger
+
+log = get_logger("pint_tpu.builder")
+
+# top-level configuration keys that land in model.meta (not parameters)
+META_KEYS = {
+    "PSR",
+    "PSRJ",
+    "PSRB",
+    "EPHEM",
+    "CLK",
+    "CLOCK",
+    "UNITS",
+    "TIMEEPH",
+    "T2CMETHOD",
+    "ECL",
+    "DILATEFREQ",
+    "TRACK",
+    "INFO",
+}
+
+# recognized-but-inert bookkeeping keys (fit summary data etc.)
+IGNORED_KEYS = {
+    "START",
+    "FINISH",
+    "NTOA",
+    "TRES",
+    "CHI2",
+    "CHI2R",
+    "NITS",
+    "MODE",
+    "IBOOT",
+    "EPHVER",
+    "DMDATA",
+    "BADTOA",
+}
+
+# not-yet-built families: consumed by later milestones, warned for now
+PENDING_KEYS: set[str] = set()
+
+
+def get_model(parfile: str, from_text: bool = False, allow_tcb: bool = False) -> TimingModel:
+    """Parfile -> TimingModel. UNITS TCB parfiles are rejected unless
+    `allow_tcb`, in which case the model is built and converted to TDB
+    (approximately — re-fit afterwards; reference model_builder allow_tcb)."""
+    pf = parse_parfile(parfile, from_text=from_text)
+    units = (pf.get("UNITS") or "TDB").upper()
+    if units == "TCB" and allow_tcb:
+        for line in pf.get_all("UNITS"):
+            line.tokens[0] = "TDB"
+        model = build_model(pf)
+        model.meta["UNITS"] = "TCB"
+        from pint_tpu.models.tcb_conversion import convert_tcb_tdb
+
+        convert_tcb_tdb(model)
+        return model
+    return build_model(pf)
+
+
+def get_model_and_toas(parfile: str, timfile: str, **kw):
+    from pint_tpu.toas import get_TOAs
+
+    model = get_model(parfile)
+    toas = get_TOAs(timfile, model=model, **kw)
+    return model, toas
+
+
+def build_model(pf: ParFile) -> TimingModel:
+    consumed: set[str] = set(META_KEYS) | set(IGNORED_KEYS)
+    meta = _collect_meta(pf)
+
+    components: list[Component] = []
+
+    # --- component choice by parameter presence (reference choose_model) -------
+    if "F0" in pf or "F" in pf:
+        components.append(Spindown())
+    if "RAJ" in pf or "RA" in pf:
+        components.append(AstrometryEquatorial())
+    elif "ELONG" in pf or "LAMBDA" in pf:
+        components.append(AstrometryEcliptic())
+    if "DM" in pf or any(n.startswith("DM1") for n in pf.names()):
+        components.append(DispersionDM())
+    if any(n.startswith("DMX_") for n in pf.names()):
+        components.append(DispersionDMX())
+    if "DMJUMP" in pf:
+        components.append(DispersionJump())
+    if any(isinstance(c, (AstrometryEquatorial, AstrometryEcliptic)) for c in components):
+        ssshap = SolarSystemShapiro()
+        ssshap.planet_shapiro = _parse_bool(pf.get("PLANET_SHAPIRO", "N"))
+        meta["PLANET_SHAPIRO"] = ssshap.planet_shapiro
+        components.append(ssshap)
+        consumed.add("PLANET_SHAPIRO")
+    if "TZRMJD" in pf:
+        components.append(AbsPhase())
+        day, hi, lo = mjd_string_to_day_frac(pf.get("TZRMJD"))
+        meta["TZR_DAY"], meta["TZR_HI"], meta["TZR_LO"] = day, hi, lo
+        meta["TZRMJD_STR"] = pf.get("TZRMJD")
+        meta["TZRSITE"] = pf.get("TZRSITE", "ssb")
+        frq = pf.get("TZRFRQ")
+        meta["TZRFRQ"] = float(frq) if frq not in (None, "0", "0.0") else float("inf")
+        consumed |= {"TZRMJD", "TZRSITE", "TZRFRQ"}
+    if "PHOFF" in pf:
+        components.append(PhaseOffset())
+    if "JUMP" in pf:
+        components.append(PhaseJump())
+    if "DJUMP" in pf:
+        components.append(DelayJump())
+
+    # phase/delay tail components by parameter presence
+    from pint_tpu.models.frequency_dependent import FD
+    from pint_tpu.models.glitch import Glitch
+    from pint_tpu.models.solar_wind import SolarWindDispersion
+    from pint_tpu.models.troposphere import TroposphereDelay
+
+    if any(n.startswith("GLEP_") for n in pf.names()):
+        components.append(Glitch())
+    if "WAVE_OM" in pf:
+        components.append(_build_wave(pf, consumed))
+    if any(n.startswith("FD") and n[2:].isdigit() for n in pf.names()):
+        components.append(FD())
+    if "NE_SW" in pf or "NE1AU" in pf or "SOLARN0" in pf:
+        components.append(SolarWindDispersion())
+    if any(n.startswith("SWXDM_") for n in pf.names()):
+        from pint_tpu.models.solar_wind import SolarWindDispersionX
+
+        components.append(SolarWindDispersionX())
+    if "SIFUNC" in pf:
+        components.append(_build_ifunc(pf, consumed))
+    if any(n.startswith("PWEP_") for n in pf.names()):
+        components.append(_build_piecewise(pf, consumed))
+    if _parse_bool(pf.get("CORRECT_TROPOSPHERE", "N")):
+        components.append(TroposphereDelay())
+    consumed.add("CORRECT_TROPOSPHERE")
+
+    binary = pf.get("BINARY")
+    if binary:
+        from pint_tpu.models.binary import make_binary_component
+
+        components.append(make_binary_component(binary.upper(), pf))
+        meta["BINARY"] = binary.upper()
+        consumed.add("BINARY")
+
+    # noise components by parameter presence (reference model_builder
+    # choose_model + noise_model.py families)
+    from pint_tpu.models.noise import (
+        EcorrNoise,
+        PLDMNoise,
+        PLRedNoise,
+        ScaleDmError,
+        ScaleToaError,
+    )
+
+    if any(k in pf for k in ("EFAC", "T2EFAC", "EQUAD", "T2EQUAD")):
+        components.append(ScaleToaError())
+    if any(k in pf for k in ("ECORR", "TNECORR")):
+        components.append(EcorrNoise())
+    if ("RNAMP" in pf and "RNIDX" in pf) or "TNREDAMP" in pf:
+        components.append(PLRedNoise())
+    if "TNDMAMP" in pf:
+        components.append(PLDMNoise())
+    if "DMEFAC" in pf or "DMEQUAD" in pf:
+        components.append(ScaleDmError())
+
+    model = TimingModel(components, meta)
+
+    # --- parameter collection ---------------------------------------------------
+    for comp in model.components:
+        _collect_component_params(comp, pf, model, consumed)
+
+    # mask parameters (JUMP ...)
+    for comp in model.components:
+        for base_spec in comp.mask_bases():
+            _collect_mask_params(comp, base_spec, pf, model, consumed)
+            consumed.add(base_spec.name)
+
+    # DMX triplets
+    for comp in model.components:
+        if isinstance(comp, DispersionDMX):
+            _collect_dmx(comp, pf, model, consumed)
+
+    # SWX segments (SWXDM/SWXP/SWXR1/SWXR2 quadruples)
+    from pint_tpu.models.solar_wind import SolarWindDispersionX
+
+    for comp in model.components:
+        if isinstance(comp, SolarWindDispersionX):
+            _collect_swx(comp, pf, model, consumed)
+
+    # deferred multi-token lines (WAVEk pairs, IFUNCk mjd/value triples)
+    from pint_tpu.models.ifunc import IFunc
+    from pint_tpu.models.wave import Wave
+
+    for comp in model.components:
+        pending = getattr(comp, "_pending_lines", None)
+        if pending is None:
+            continue
+        if isinstance(comp, Wave):
+            for k, line in pending.items():
+                if len(line.tokens) < 2:
+                    raise ValueError(f"WAVE{k} needs sin and cos values: {line.raw}")
+                for tag, tok in (("A", line.tokens[0]), ("B", line.tokens[1])):
+                    spec = comp.specs[f"WAVE{k}{tag}"]
+                    model.params[spec.name] = spec.parse(tok)
+                    model.param_meta[spec.name] = ParamValueMeta(spec=spec, frozen=True)
+        elif isinstance(comp, IFunc):
+            for k, line in pending.items():
+                if len(line.tokens) < 2:
+                    raise ValueError(f"IFUNC{k} needs 'mjd value': {line.raw}")
+                spec = comp.specs[f"IFUNC{k}"]
+                model.params[spec.name] = spec.parse(line.tokens[1])
+                frozen, unc = parse_fit_flag(line.tokens, value_index=1)
+                pm = ParamValueMeta(spec=spec, frozen=frozen)
+                if unc is not None:
+                    pm.uncertainty = spec.parse_uncertainty(unc)
+                model.param_meta[spec.name] = pm
+        del comp._pending_lines
+
+    # WAVEEPOCH defaults to PEPOCH (reference wave.py setup())
+    from pint_tpu.models.wave import Wave as _Wave
+
+    if any(isinstance(c, _Wave) for c in model.components) and "WAVEEPOCH" not in model.params:
+        if "PEPOCH" not in model.params:
+            raise ValueError("WAVE terms need WAVEEPOCH or PEPOCH")
+        spec = next(c for c in model.components if isinstance(c, _Wave)).specs["WAVEEPOCH"]
+        model.params["WAVEEPOCH"] = model.params["PEPOCH"]
+        model.param_meta["WAVEEPOCH"] = ParamValueMeta(spec=spec, frozen=True)
+
+    # noise parameters are fixed inputs to WLS/GLS (the reference fitters
+    # likewise refuse to fit them; they are sampled by the Bayesian/MCMC
+    # path instead) — force-freeze, warning if the parfile marked them free
+    from pint_tpu.models.noise import NoiseComponent
+
+    for comp in model.components:
+        if not isinstance(comp, NoiseComponent):
+            continue
+        for pname in comp.specs:
+            pm = model.param_meta.get(pname)
+            if pm is not None and not pm.frozen:
+                log.warning(f"noise parameter {pname} cannot be fit by WLS/GLS; freezing")
+                pm.frozen = True
+
+    # --- leftovers ---------------------------------------------------------------
+    for name in pf.names():
+        if name in consumed:
+            continue
+        if name in PENDING_KEYS:
+            log.warning(f"parfile key {name} not yet supported; ignored")
+        else:
+            log.warning(f"unrecognized parfile key {name}; ignored")
+
+    model.validate()
+    return model
+
+
+def _parse_bool(tok: str) -> bool:
+    return str(tok).upper() in ("1", "Y", "YES", "T", "TRUE")
+
+
+def _build_wave(pf: ParFile, consumed: set):
+    """WAVEk lines carry a (sin, cos) PAIR of values — collected here into
+    WAVEkA/WAVEkB params (reference wave.py prefixParameter pairs)."""
+    from pint_tpu.models.wave import Wave
+
+    comp = Wave()
+    pending = {}
+    for name in pf.names():  # tolerate gaps in the WAVEk numbering
+        if name.startswith("WAVE") and name[4:].isdigit():
+            k = int(name[4:])
+            comp.add_wave_term(k)
+            pending[k] = pf.get_all(name)[0]
+            consumed.add(name)
+    comp._pending_lines = pending
+    return comp
+
+
+def _build_ifunc(pf: ParFile, consumed: set):
+    """IFUNCk lines are 'mjd value [err]' triples: the MJD is static node
+    structure, the value a fittable parameter (reference ifunc.py)."""
+    from pint_tpu.models.ifunc import IFunc
+
+    comp = IFunc()
+    k = 1
+    pending = {}
+    while f"IFUNC{k}" in pf:
+        line = pf.get_all(f"IFUNC{k}")[0]
+        mjd = float(line.tokens[0])
+        comp.add_node(k, mjd)
+        pending[k] = line
+        consumed.add(f"IFUNC{k}")
+        k += 1
+    comp._pending_lines = pending
+    return comp
+
+
+def _build_piecewise(pf: ParFile, consumed: set):
+    """PWSTART_k/PWSTOP_k are window config (host mask compilation)."""
+    from pint_tpu.models.piecewise import PiecewiseSpindown
+
+    comp = PiecewiseSpindown()
+    for name in pf.names():
+        if name.startswith("PWSTART_") and name[8:].isdigit():
+            k = int(name[8:])
+            stop = pf.get(f"PWSTOP_{k}")
+            if stop is None:
+                raise ValueError(f"PWSTART_{k} without PWSTOP_{k}")
+            comp.set_window(k, float(pf.get(name)), float(stop))
+            consumed |= {name, f"PWSTOP_{k}"}
+    return comp
+
+
+def _collect_meta(pf: ParFile) -> dict:
+    meta: dict = {}
+    psr = pf.get("PSR") or pf.get("PSRJ") or pf.get("PSRB")
+    if psr:
+        meta["PSR"] = psr
+    for k in ("EPHEM", "UNITS", "TIMEEPH", "T2CMETHOD", "ECL", "TRACK", "INFO"):
+        v = pf.get(k)
+        if v is not None:
+            meta[k] = v
+    clk = pf.get("CLK") or pf.get("CLOCK")
+    if clk:
+        meta["CLOCK"] = clk
+    units = meta.get("UNITS", "TDB")
+    if units.upper() not in ("TDB", "SI"):
+        raise ValueError(
+            f"UNITS {units} not supported; run tcb2tdb conversion first (reference models/tcb_conversion.py)"
+        )
+    return meta
+
+
+def _find_entry(pf: ParFile, spec: ParamSpec):
+    for key in (spec.name, *spec.aliases):
+        if key in pf:
+            return pf.get_all(key)[0], key
+    return None, None
+
+
+def _collect_component_params(comp: Component, pf: ParFile, model: TimingModel, consumed: set):
+    # plain params (keys already consumed by special collectors — WAVEk,
+    # IFUNCk multi-token lines — are handled by the deferred-lines loop)
+    for spec in list(comp.specs.values()):
+        if spec.name in consumed:
+            continue
+        line, key = _find_entry(pf, spec)
+        if line is None:
+            if spec.default is not None:
+                # mirror _store_param: only fittable defaults belong in the
+                # jit pytree — config defaults (str/bool, e.g. ECL) go to meta
+                if spec.is_fittable:
+                    model.params[spec.name] = spec.parse(str(spec.default))
+                    model.param_meta[spec.name] = ParamValueMeta(spec=spec)
+                else:
+                    model.meta.setdefault(spec.name, spec.parse(str(spec.default)))
+            continue
+        consumed.add(key)
+        _store_param(model, spec, line, from_alias=key if key != spec.name else None)
+
+    # prefix families (F2.., DM2.., GLEP_..)
+    for pspec in comp.prefix_specs():
+        for name in list(pf.names()):
+            if name in consumed:
+                continue
+            k = pspec.matches(name)
+            if k is None:
+                continue
+            spec = pspec.make(k)
+            comp.add_prefix_param(spec)
+            consumed.add(name)
+            _store_param(model, spec, pf.get_all(name)[0])
+
+
+def _store_param(model: TimingModel, spec: ParamSpec, line, from_alias=None):
+    value = spec.parse(line.value)
+    if spec.is_fittable:
+        model.params[spec.name] = value
+        frozen, unc_tok = parse_fit_flag(line.tokens)
+        pm = ParamValueMeta(spec=spec, frozen=frozen, from_alias=from_alias)
+        if unc_tok is not None:
+            pm.uncertainty = spec.parse_uncertainty(unc_tok)
+        model.param_meta[spec.name] = pm
+    else:
+        model.meta[spec.name] = value
+
+
+def _collect_mask_params(comp, base_spec: ParamSpec, pf: ParFile, model: TimingModel, consumed: set):
+    lines = []
+    for key in (base_spec.name, *base_spec.aliases):
+        if key in pf:
+            lines.extend(pf.get_all(key))
+            consumed.add(key)
+    for i, line in enumerate(lines, start=1):
+        clause, rest = parse_mask_clause(line.tokens)
+        name = f"{base_spec.name}{i}"
+        spec = ParamSpec(
+            name,
+            kind=base_spec.kind,
+            scale=base_spec.scale,
+            unit=base_spec.unit,
+            description=f"{base_spec.name} on {' '.join(clause.as_parfile_tokens())}",
+        )
+        info = MaskParamInfo(name=name, base=base_spec.name, index=i, clause=clause, spec=spec)
+        comp.mask_params.append(info)
+        comp.specs[name] = spec
+        if not rest:
+            raise ValueError(f"{base_spec.name} line missing value: {line.raw}")
+        model.params[name] = spec.parse(rest[0])
+        frozen, unc_tok = parse_fit_flag(rest)
+        pm = ParamValueMeta(spec=spec, frozen=frozen)
+        if unc_tok is not None:
+            pm.uncertainty = spec.parse_uncertainty(unc_tok)
+        model.param_meta[name] = pm
+
+
+def _collect_dmx(comp: DispersionDMX, pf: ParFile, model: TimingModel, consumed: set):
+    idxs = sorted(
+        int(n[4:]) for n in pf.names() if n.startswith("DMX_") and n[4:].isdigit()
+    )
+    for i in idxs:
+        r1 = pf.get(f"DMXR1_{i:04d}")
+        r2 = pf.get(f"DMXR2_{i:04d}")
+        if r1 is None or r2 is None:
+            raise ValueError(f"DMX_{i:04d} missing DMXR1/DMXR2 range")
+        comp.add_window(i, float(r1), float(r2))
+        spec = comp.specs[f"DMX_{i:04d}"]
+        _store_param(model, spec, pf.get_all(f"DMX_{i:04d}")[0])
+        consumed |= {f"DMX_{i:04d}", f"DMXR1_{i:04d}", f"DMXR2_{i:04d}"}
+
+
+def _collect_swx(comp, pf: ParFile, model: TimingModel, consumed: set):
+    """SWXDM_nnnn / SWXP_nnnn / SWXR1_nnnn / SWXR2_nnnn quadruples
+    (reference SolarWindDispersionX, solar_wind_dispersion.py:522)."""
+    idxs = sorted(
+        int(n[6:]) for n in pf.names() if n.startswith("SWXDM_") and n[6:].isdigit()
+    )
+    for i in idxs:
+        r1 = pf.get(f"SWXR1_{i:04d}")
+        r2 = pf.get(f"SWXR2_{i:04d}")
+        if r1 is None or r2 is None:
+            raise ValueError(f"SWXDM_{i:04d} missing SWXR1/SWXR2 range")
+        comp.add_swx_range(i, float(r1), float(r2))
+        _store_param(model, comp.specs[f"SWXDM_{i:04d}"],
+                     pf.get_all(f"SWXDM_{i:04d}")[0])
+        if f"SWXP_{i:04d}" in pf:
+            _store_param(model, comp.specs[f"SWXP_{i:04d}"],
+                         pf.get_all(f"SWXP_{i:04d}")[0])
+        else:
+            model.params[f"SWXP_{i:04d}"] = comp.specs[f"SWXP_{i:04d}"].default
+            from pint_tpu.models.parameter import ParamValueMeta
+
+            model.param_meta[f"SWXP_{i:04d}"] = ParamValueMeta(
+                spec=comp.specs[f"SWXP_{i:04d}"]
+            )
+        consumed |= {f"SWXDM_{i:04d}", f"SWXP_{i:04d}",
+                     f"SWXR1_{i:04d}", f"SWXR2_{i:04d}"}
+
+
+# --- parfile output ------------------------------------------------------------
+
+
+def model_to_parfile(model: TimingModel) -> str:
+    """Serialize back to parfile text (reference as_parfile,
+    timing_model.py:2437); exact strings for DD quantities."""
+    import numpy as np
+
+    lines: list[tuple[str, str]] = []
+    meta = model.meta
+    if meta.get("PSR"):
+        lines.append(("PSR", meta["PSR"]))
+    for k in ("EPHEM", "UNITS", "ECL", "TIMEEPH"):
+        if meta.get(k):
+            lines.append((k, str(meta[k])))
+    if meta.get("CLOCK"):
+        lines.append(("CLK", meta["CLOCK"]))
+    if "PLANET_SHAPIRO" in meta:
+        lines.append(("PLANET_SHAPIRO", "Y" if meta["PLANET_SHAPIRO"] else "N"))
+
+    mask_lines: dict[str, list[str]] = {}
+    exclude: set[str] = set()
+    for comp in model.components:
+        for mp in comp.mask_params:
+            mask_lines[mp.name] = mp.clause.as_parfile_tokens()
+        exclude |= comp.parfile_exclude()
+
+    for name, pm in model.param_meta.items():
+        v = model.params.get(name)
+        if v is None or name in exclude:
+            continue
+        spec = pm.spec
+        fit = "0" if pm.frozen else "1"
+        if name in mask_lines:
+            sel = " ".join(mask_lines[name])
+            val = _value_str(spec, v)
+            base = name[: len(name) - len(_tail_digits(name))]
+            lines.append((base, f"{sel} {val} {fit}"))
+            continue
+        val = _value_str(spec, v)
+        unc = f" {pm.uncertainty / spec.scale:.6g}" if pm.uncertainty else ""
+        lines.append((name, f"{val} {fit}{unc}"))
+
+    # static-config params (SWM, NHARMS, TNREDC, ...) live in model.meta;
+    # emit them from the owning component's specs (ECL/UNITS handled above,
+    # SIFUNC written by IFunc itself)
+    done = {k for k, _ in lines} | {"SIFUNC", "NHARMS"}
+    for comp in model.components:
+        for spec in comp.specs.values():
+            if (not spec.is_fittable and spec.name in meta
+                    and spec.name not in done):
+                v = meta[spec.name]
+                if isinstance(v, bool):
+                    v = "Y" if v else "N"
+                lines.append((spec.name, str(v)))
+                done.add(spec.name)
+
+    for comp in model.components:
+        lines.extend(comp.extra_parfile_lines(model))
+
+    if model.has_abs_phase:
+        lines.append(("TZRMJD", meta.get("TZRMJD_STR", "")))
+        lines.append(("TZRSITE", str(meta.get("TZRSITE", "ssb"))))
+        frq = meta.get("TZRFRQ", float("inf"))
+        lines.append(("TZRFRQ", "0.0" if np.isinf(frq) else str(frq)))
+
+    from pint_tpu.io.par import write_parfile_lines
+
+    return write_parfile_lines(lines)
+
+
+def _tail_digits(name: str) -> str:
+    i = len(name)
+    while i > 0 and name[i - 1].isdigit():
+        i -= 1
+    return name[i:]
+
+
+def _value_str(spec: ParamSpec, v) -> str:
+    if isinstance(v, DD):
+        if spec.kind == "epoch":
+            return epoch_dd_to_mjd_string(v)
+        return dd_to_str(float(np.asarray(v.hi)), float(np.asarray(v.lo)), scale=spec.scale)
+    if spec.kind == "hms":
+        return format_hms(float(v))
+    if spec.kind == "dms":
+        return format_dms(float(v))
+    if spec.kind == "deg":
+        return f"{float(v) * 180.0 / np.pi:.15g}"
+    return f"{float(v) / spec.scale:.15g}"

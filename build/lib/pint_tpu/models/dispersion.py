@@ -1,0 +1,225 @@
+"""Dispersion delay: cold-plasma DMconst * DM(t) / f^2.
+
+Reference: pint/models/dispersion_model.py (Dispersion:31,
+dispersion_time_delay:42, DispersionDM:132 base_dm:212 — DM Taylor polynomial
+about DMEPOCH; DispersionDMX:305 — piecewise-constant DM in MJD windows).
+
+DMX windows compile to a dense (N_toa, N_dmx) one-hot mask matrix at tensor
+build time; on device the window delay is a single matvec, which XLA maps to
+the MXU instead of the reference's per-window index scatter
+(toa_select.py hot spot, profiling/README.txt:60).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from pint_tpu import DMCONST
+from pint_tpu.models.base import DelayComponent, dt_since_epoch_f64, leaf_to_f64
+from pint_tpu.models.parameter import PER_YEAR_TO_PER_SEC, ParamSpec, PrefixSpec
+from pint_tpu.ops.taylor import taylor_horner
+
+Array = jnp.ndarray
+
+
+def dispersion_time_delay(dm: Array, freq_mhz: Array) -> Array:
+    """DMconst * DM / f^2, zero at infinite frequency (reference
+    dispersion_model.py:42)."""
+    fsq = freq_mhz * freq_mhz
+    return jnp.where(jnp.isfinite(freq_mhz), DMCONST * dm / fsq, 0.0)
+
+
+def barycentric_radio_freq(tensor: dict) -> Array:
+    """Observed frequency Doppler-shifted to the SSB frame (reference
+    AstrometryEquatorial.barycentric_radio_freq via
+    timing_model.py/astrometry.py: f_bary = f_topo (1 - v_obs . L_hat / c)).
+
+    The annual ~1e-4 modulation of 1/f^2 moves the DM delay by tens of us
+    at 430 MHz — required for reference-accurate dispersion."""
+    if "_psr_dir" not in tensor:
+        return tensor["freq_mhz"]
+    beta = jnp.sum(tensor["ssb_obs_vel_ls"] * tensor["_psr_dir"], axis=-1)
+    return tensor["freq_mhz"] * (1.0 - beta)
+
+
+def _dm_spec(k: int) -> ParamSpec:
+    return ParamSpec(
+        name=f"DM{k}" if k else "DM",
+        scale=PER_YEAR_TO_PER_SEC**k,
+        unit=f"pc cm^-3 / yr^{k}" if k else "pc cm^-3",
+        description=f"DM Taylor coefficient {k}",
+        default=0.0 if k else None,
+    )
+
+
+class DispersionDM(DelayComponent):
+    category = "dispersion_constant"
+    register = True
+
+    @classmethod
+    def param_specs(cls):
+        return [_dm_spec(0), ParamSpec("DMEPOCH", kind="epoch", unit="MJD")]
+
+    @classmethod
+    def prefix_specs(cls):
+        return [PrefixSpec("DM", _dm_spec, start=1)]
+
+    def __init__(self):
+        super().__init__()
+        self.num_terms = 1
+
+    def add_prefix_param(self, spec):
+        super().add_prefix_param(spec)
+        k = int(spec.name[2:])
+        self.num_terms = max(self.num_terms, k + 1)
+
+    def validate(self, params, meta):
+        if "DM" not in params:
+            raise ValueError("DispersionDM requires DM")
+        if self.num_terms > 1 and "DMEPOCH" not in params:
+            raise ValueError("DM derivatives need DMEPOCH")
+
+    def base_dm(self, params: dict, tensor: dict) -> Array:
+        coeffs = [
+            leaf_to_f64(params.get(f"DM{k}" if k else "DM", 0.0))
+            for k in range(self.num_terms)
+        ]
+        if self.num_terms == 1:
+            return coeffs[0] * jnp.ones_like(tensor["t_hi"])
+        dt = dt_since_epoch_f64(tensor, params["DMEPOCH"])
+        # reference base_dm uses a plain (non-factorial) polynomial via
+        # taylor_horner on DM_k with factorial scaling — keep its convention
+        return taylor_horner(dt, coeffs)
+
+    def dm_value(self, params: dict, tensor: dict) -> Array:
+        return self.base_dm(params, tensor)
+
+    def delay(self, params: dict, tensor: dict, delay_so_far: Array, xp) -> Array:
+        return dispersion_time_delay(self.base_dm(params, tensor), barycentric_radio_freq(tensor))
+
+    # delay is exactly linear in every DM Taylor coefficient
+    def linear_param_names(self):
+        return [f"DM{k}" if k else "DM" for k in range(self.num_terms)]
+
+    def linear_resid_columns(self, params, tensor, f, sl):
+        import math
+
+        from pint_tpu.models.base import dt_since_epoch_f64
+
+        fb = barycentric_radio_freq(tensor)[sl]
+        base = jnp.where(jnp.isfinite(fb), -DMCONST / (fb * fb), 0.0)
+        out = {"DM": base}
+        if self.num_terms > 1:
+            dt = dt_since_epoch_f64(tensor, params["DMEPOCH"])[sl]
+            pw = jnp.ones_like(dt)
+            for k in range(1, self.num_terms):
+                pw = pw * dt
+                out[f"DM{k}"] = base * pw / math.factorial(k)
+        return out
+
+
+def _dmx_value_spec(k: int) -> ParamSpec:
+    return ParamSpec(
+        name=f"DMX_{k:04d}",
+        unit="pc cm^-3",
+        description=f"DM offset in window {k}",
+        default=0.0,
+    )
+
+
+class DispersionDMX(DelayComponent):
+    """Piecewise-constant DM offsets in MJD windows (reference
+    dispersion_model.py:305: DMX_nnnn / DMXR1_nnnn / DMXR2_nnnn triplets)."""
+
+    category = "dispersion_dmx"
+    register = True
+
+    @classmethod
+    def param_specs(cls):
+        return [ParamSpec("DMX", unit="pc cm^-3", default=0.0)]
+
+    def __init__(self):
+        super().__init__()
+        # windows: index -> (mjd_start, mjd_end); filled by the builder
+        self.windows: dict[int, tuple[float, float]] = {}
+
+    def add_window(self, idx: int, r1_mjd: float, r2_mjd: float) -> None:
+        self.windows[idx] = (r1_mjd, r2_mjd)
+        self.specs[f"DMX_{idx:04d}"] = _dmx_value_spec(idx)
+
+    @property
+    def sorted_indices(self) -> list[int]:
+        return sorted(self.windows)
+
+    def validate(self, params, meta):
+        for i in self.sorted_indices:
+            r1, r2 = self.windows[i]
+            if not (r2 > r1):
+                raise ValueError(f"DMX window {i} has DMXR2 <= DMXR1")
+            if f"DMX_{i:04d}" not in params:
+                raise ValueError(f"DMX window {i} missing DMX_{i:04d}")
+
+    def host_columns(self, toas, params):
+        cols = super().host_columns(toas, params)
+        mjd = toas.tdb.mjd_float()
+        idxs = self.sorted_indices
+        onehot = np.zeros((len(toas), len(idxs)))
+        for j, i in enumerate(idxs):
+            r1, r2 = self.windows[i]
+            onehot[:, j] = (mjd >= r1) & (mjd <= r2)
+        cols["dmx_onehot"] = onehot
+        return cols
+
+    def extra_parfile_lines(self, model):
+        out = []
+        for i in self.sorted_indices:
+            r1, r2 = self.windows[i]
+            out.append((f"DMXR1_{i:04d}", f"{r1:.10f}"))
+            out.append((f"DMXR2_{i:04d}", f"{r2:.10f}"))
+        return out
+
+    def dmx_dm(self, params: dict, tensor: dict) -> Array:
+        vals = jnp.stack([params[f"DMX_{i:04d}"] for i in self.sorted_indices])
+        return tensor["dmx_onehot"] @ vals
+
+    def dm_value(self, params: dict, tensor: dict) -> Array:
+        return self.dmx_dm(params, tensor)
+
+    def linear_param_names(self):
+        return [f"DMX_{i:04d}" for i in self.sorted_indices]
+
+    def linear_resid_columns(self, params, tensor, f, sl):
+        fb = barycentric_radio_freq(tensor)[sl]
+        base = jnp.where(jnp.isfinite(fb), -DMCONST / (fb * fb), 0.0)
+        onehot = tensor["dmx_onehot"][sl]
+        return {
+            f"DMX_{i:04d}": base * onehot[:, j]
+            for j, i in enumerate(self.sorted_indices)
+        }
+
+    def delay(self, params: dict, tensor: dict, delay_so_far: Array, xp) -> Array:
+        return dispersion_time_delay(self.dmx_dm(params, tensor), barycentric_radio_freq(tensor))
+
+
+class DispersionJump(DelayComponent):
+    """Constant offsets to the MEASURED DM values per selection — models
+    instrument-dependent wideband-DM offsets; contributes to the model DM
+    (dm_value) but NOT to the dispersion time delay (reference
+    dispersion_model.py:710-790)."""
+
+    category = "dispersion_jump"
+    register = True
+
+    @classmethod
+    def mask_bases(cls):
+        return [
+            ParamSpec("DMJUMP", kind="float", unit="pc cm^-3",
+                      description="DM value offset"),
+        ]
+
+    def dm_value(self, params: dict, tensor: dict) -> Array:
+        out = jnp.zeros_like(tensor["t_hi"])
+        for mp in self.mask_params:
+            out = out - tensor[f"mask_{mp.name}"] * leaf_to_f64(params[mp.name])
+        return out

@@ -1,0 +1,69 @@
+"""Frequency-dependent (FD) profile-evolution delay.
+
+Reference: pint/models/frequency_dependent.py (FD:11, FD_delay:68):
+    delay = sum_i FD_i * log(f / 1 GHz)^i,  i = 1..n
+(zero at infinite/non-finite frequency).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from pint_tpu.models.base import DelayComponent, leaf_to_f64
+from pint_tpu.models.parameter import ParamSpec, PrefixSpec
+
+Array = jnp.ndarray
+
+
+def _fd_spec(k: int) -> ParamSpec:
+    return ParamSpec(f"FD{k}", unit="s", default=0.0,
+                     description=f"delay coefficient of log-frequency^{k}")
+
+
+class FD(DelayComponent):
+    category = "frequency_dependent"
+    register = True
+
+    def __init__(self):
+        super().__init__()
+        self.num_terms = 0
+
+    @classmethod
+    def prefix_specs(cls):
+        return [PrefixSpec("FD", _fd_spec, start=1)]
+
+    def add_prefix_param(self, spec):
+        super().add_prefix_param(spec)
+        self.num_terms = max(self.num_terms, int(spec.name[2:]))
+
+    def validate(self, params, meta):
+        if self.num_terms == 0:
+            raise ValueError("FD component with no FD terms")
+
+    def delay(self, params: dict, tensor: dict, delay_so_far: Array, xp) -> Array:
+        from pint_tpu.models.dispersion import barycentric_radio_freq
+
+        f_ghz = barycentric_radio_freq(tensor) / 1e3
+        finite = jnp.isfinite(f_ghz) & (f_ghz > 0)
+        logf = jnp.log(jnp.where(finite, f_ghz, 1.0))
+        # Horner over log-frequency, no constant term (reference FD_delay:75)
+        out = jnp.zeros_like(logf)
+        for k in range(self.num_terms, 0, -1):
+            out = (out + leaf_to_f64(params.get(f"FD{k}", 0.0))) * logf
+        return jnp.where(finite, out, 0.0)
+
+    def linear_param_names(self):
+        return [f"FD{k}" for k in range(1, self.num_terms + 1)]
+
+    def linear_resid_columns(self, params, tensor, f, sl):
+        from pint_tpu.models.dispersion import barycentric_radio_freq
+
+        f_ghz = barycentric_radio_freq(tensor)[sl] / 1e3
+        finite = jnp.isfinite(f_ghz) & (f_ghz > 0)
+        logf = jnp.log(jnp.where(finite, f_ghz, 1.0))
+        out = {}
+        pw = jnp.ones_like(logf)
+        for k in range(1, self.num_terms + 1):
+            pw = pw * logf
+            out[f"FD{k}"] = jnp.where(finite, -pw, 0.0)
+        return out

@@ -1,0 +1,99 @@
+"""IFUNC: tabulated interpolated time-offset signal (tempo2 SIFUNC/IFUNC).
+
+Reference: pint/models/ifunc.py (IFunc:9, ifunc_phase:106): node values
+(IFUNC1..N at MJDs) are interpolated to each TOA — piecewise-constant
+(SIFUNC 0) or linear (SIFUNC 2) — and converted to phase with F0.
+
+TPU design: the interpolation weights depend only on the (static) node MJDs
+and TOA times, so they compile to a dense (N_toa, N_node) weight matrix at
+tensor-build time; the per-TOA offset is one MXU matvec and the node VALUES
+stay fittable through it (the reference's derivative machinery for free).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from pint_tpu.models.base import PhaseComponent, leaf_to_f64
+from pint_tpu.models.parameter import ParamSpec
+
+Array = jnp.ndarray
+
+
+class IFunc(PhaseComponent):
+    category = "ifunc"
+    register = True
+
+    def __init__(self):
+        super().__init__()
+        self.itype = 2
+        self.node_mjds: list[float] = []  # parallel to node indices
+        self.node_indices: list[int] = []
+
+    @classmethod
+    def param_specs(cls):
+        return [ParamSpec("SIFUNC", kind="int", description="interpolation type")]
+
+    def add_node(self, k: int, mjd: float) -> None:
+        self.node_indices.append(k)
+        self.node_mjds.append(mjd)
+        self.specs[f"IFUNC{k}"] = ParamSpec(
+            f"IFUNC{k}", unit="s", description=f"time-offset node {k}"
+        )
+
+    def parfile_exclude(self):
+        return {f"IFUNC{k}" for k in range(1, len(self.node_mjds) + 1)}
+
+    def extra_parfile_lines(self, model):
+        import numpy as np
+
+        out = [("SIFUNC", f"{self.itype} 0")]
+        for k, mjd in enumerate(self.node_mjds, start=1):
+            v = float(np.asarray(model.params[f"IFUNC{k}"]))
+            out.append((f"IFUNC{k}", f"{mjd:.8f} {v:.12g} 0.0"))
+        return out
+
+    def validate(self, params, meta):
+        self.itype = int(meta.get("SIFUNC", 2))
+        if self.itype not in (0, 2):
+            raise ValueError(f"SIFUNC interpolation type {self.itype} not supported (0 or 2)")
+        if len(self.node_mjds) < 2:
+            raise ValueError("IFunc needs at least two nodes")
+        if sorted(self.node_mjds) != self.node_mjds:
+            raise ValueError("IFUNC nodes must be in increasing MJD order")
+
+    def host_columns(self, toas, params):
+        cols = super().host_columns(toas, params)
+        t = toas.tdb.mjd_float()
+        nodes = np.asarray(self.node_mjds)
+        n, k = len(toas), len(nodes)
+        W = np.zeros((n, k))
+        if self.itype == 0:
+            # piecewise constant: nearest node at or before the TOA
+            idx = np.clip(np.searchsorted(nodes, t, side="right") - 1, 0, k - 1)
+            W[np.arange(n), idx] = 1.0
+        else:
+            # linear, clamped at the ends (reference ifunc.py:128-138)
+            j = np.clip(np.searchsorted(nodes, t) - 1, 0, k - 2)
+            frac = (t - nodes[j]) / (nodes[j + 1] - nodes[j])
+            frac = np.clip(frac, 0.0, 1.0)
+            W[np.arange(n), j] = 1.0 - frac
+            W[np.arange(n), j + 1] = frac
+        cols["ifunc_w"] = W
+        return cols
+
+    def phase(self, params: dict, tensor: dict, total_delay: Array, xp):
+        vals = jnp.stack([leaf_to_f64(params[f"IFUNC{k}"]) for k in self.node_indices])
+        tau = tensor["ifunc_w"] @ vals
+        return xp.from_f64(tau * leaf_to_f64(params["F0"]))
+
+    def linear_param_names(self):
+        return [f"IFUNC{k}" for k in self.node_indices]
+
+    def linear_resid_columns(self, params, tensor, f, sl):
+        f0 = leaf_to_f64(params["F0"])
+        W = tensor["ifunc_w"][sl]
+        return {
+            f"IFUNC{k}": W[:, j] * f0 / f for j, k in enumerate(self.node_indices)
+        }

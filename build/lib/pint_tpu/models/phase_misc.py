@@ -1,0 +1,112 @@
+"""Absolute phase (TZR), explicit phase offset, and phase/delay jumps.
+
+Reference: pint/models/absolute_phase.py (AbsPhase:10 — TZRMJD/TZRSITE/TZRFRQ
+fiducial TOA), phase_offset.py (PhaseOffset:9 — PHOFF), jump.py (PhaseJump:75,
+DelayJump:12 — maskParameter JUMPs).
+
+TZR handling is the one place the reference does a host round trip (a
+recursive 1-TOA model evaluation, timing_model.py:1322-1336); here the TZR
+TOA is prepared once on the host and appended as the LAST ROW of the TOA
+tensor, so the whole absolute-phase computation stays inside one jitted
+function (SURVEY.md §7 "Host/device split of TZR").
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from pint_tpu.models.base import Component, DelayComponent, PhaseComponent, leaf_to_f64
+from pint_tpu.models.parameter import ParamSpec
+
+Array = jnp.ndarray
+
+
+class AbsPhase(PhaseComponent):
+    """Marks the model as absolute-phase-anchored; the TZR row logic lives in
+    TimingModel.phase (the subtraction must happen after ALL phase terms)."""
+
+    category = "absolute_phase"
+    register = True
+
+    # TZRMJD/TZRSITE/TZRFRQ configure host-side TZR-row construction; they
+    # live in model.meta (builder handles them), NOT in the fit pytree, so
+    # param_specs stays empty.
+
+    def validate(self, params, meta):
+        if "TZR_DAY" not in meta:
+            raise ValueError("AbsPhase requires TZRMJD")
+
+    def phase(self, params, tensor, total_delay, xp):
+        return xp.zeros_like(tensor["t_hi"])
+
+
+class PhaseOffset(PhaseComponent):
+    """Explicit overall phase offset PHOFF (turns); with it present the
+    residual mean subtraction is disabled (reference phase_offset.py:9)."""
+
+    category = "phase_offset"
+    register = True
+
+    @classmethod
+    def param_specs(cls):
+        return [ParamSpec("PHOFF", unit="turns", default=0.0)]
+
+    def phase(self, params, tensor, total_delay, xp):
+        return xp.from_f64(-leaf_to_f64(params["PHOFF"]) * jnp.ones_like(tensor["t_hi"]))
+
+
+def _jump_spec(k: int) -> ParamSpec:
+    return ParamSpec(f"JUMP{k}", unit="s", description="Time jump on TOA subset")
+
+
+class PhaseJump(PhaseComponent):
+    """JUMP as a phase offset F0 * jump_seconds on selected TOAs (reference
+    jump.py:75: phase-domain jumps are the registered default)."""
+
+    category = "phase_jump"
+    register = True
+
+    @classmethod
+    def mask_bases(cls):
+        return [ParamSpec("JUMP", unit="s")]
+
+    def validate(self, params, meta):
+        # the phase-domain jump is F0 * jump_seconds; without a spindown F0
+        # the conversion is undefined (reference jump.py d_phase_d_jump)
+        if "F0" not in params:
+            raise ValueError("PhaseJump requires a Spindown F0 in the model")
+
+    def phase(self, params, tensor, total_delay, xp):
+        total = jnp.zeros_like(tensor["t_hi"])
+        for mp in self.mask_params:
+            total = total + tensor[f"mask_{mp.name}"] * leaf_to_f64(params[mp.name])
+        # F0 * jump (reference jump.py phase_d_jump): use F0 from params
+        return xp.from_f64(total * leaf_to_f64(params["F0"]))
+
+    def linear_param_names(self):
+        return [mp.name for mp in self.mask_params]
+
+    def linear_resid_columns(self, params, tensor, f, sl):
+        f0 = leaf_to_f64(params["F0"])
+        return {
+            mp.name: tensor[f"mask_{mp.name}"][sl] * f0 / f
+            for mp in self.mask_params
+        }
+
+
+class DelayJump(DelayComponent):
+    """Time-domain jumps (reference jump.py:12; register=False there too —
+    only used when explicitly requested)."""
+
+    category = "jump_delay"
+    register = True
+
+    @classmethod
+    def mask_bases(cls):
+        return [ParamSpec("DJUMP", unit="s")]
+
+    def delay(self, params, tensor, delay_so_far, xp) -> Array:
+        total = jnp.zeros_like(tensor["t_hi"])
+        for mp in self.mask_params:
+            total = total - tensor[f"mask_{mp.name}"] * params[mp.name]
+        return total

@@ -1,0 +1,94 @@
+"""Piecewise-constant spindown solutions over MJD windows.
+
+Reference: pint/models/piecewise.py (PiecewiseSpindown:10): per group k,
+between PWSTART_k and PWSTOP_k, add a phase
+
+    dphi_k = PWPH_k + PWF0_k dt + PWF1_k dt^2/2 + PWF2_k dt^3/6,
+    dt = t - PWEP_k
+
+(windows compile to dense mask columns at tensor-build time, like DMX).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from pint_tpu.models.base import PhaseComponent, barycentric_time_x, leaf_to_f64
+from pint_tpu.models.parameter import ParamSpec, PrefixSpec
+
+Array = jnp.ndarray
+
+# PWSTART_/PWSTOP_ are window CONFIG (host-side mask compilation, like
+# DMXR1/DMXR2) — collected by the builder via set_window, not parameters
+_FAMS = ("PWEP_", "PWPH_", "PWF0_", "PWF1_", "PWF2_")
+
+
+def _pw_spec(prefix: str, k: int) -> ParamSpec:
+    kinds = {
+        "PWEP_": ParamSpec(f"PWEP_{k}", kind="epoch", unit="MJD",
+                           description=f"piecewise segment {k} reference epoch"),
+        "PWPH_": ParamSpec(f"PWPH_{k}", unit="turns", default=0.0,
+                           description=f"segment {k} phase offset"),
+        "PWF0_": ParamSpec(f"PWF0_{k}", unit="Hz", default=0.0,
+                           description=f"segment {k} F0 offset"),
+        "PWF1_": ParamSpec(f"PWF1_{k}", unit="Hz/s", default=0.0,
+                           description=f"segment {k} F1 offset"),
+        "PWF2_": ParamSpec(f"PWF2_{k}", unit="Hz/s^2", default=0.0,
+                           description=f"segment {k} F2 offset"),
+    }
+    return kinds[prefix]
+
+
+class PiecewiseSpindown(PhaseComponent):
+    category = "piecewise"
+    register = True
+
+    def __init__(self):
+        super().__init__()
+        self.indices: list[int] = []
+        self.windows: dict[int, tuple[float, float]] = {}
+
+    @classmethod
+    def prefix_specs(cls):
+        return [PrefixSpec(p, lambda k, p=p: _pw_spec(p, k)) for p in _FAMS]
+
+    def add_prefix_param(self, spec):
+        super().add_prefix_param(spec)
+        for p in _FAMS:
+            if spec.name.startswith(p):
+                k = int(spec.name[len(p):])
+                if k not in self.indices:
+                    self.indices.append(k)
+                    self.indices.sort()
+
+    def validate(self, params, meta):
+        for k in self.indices:
+            if f"PWEP_{k}" not in params:
+                raise ValueError(f"piecewise segment {k} missing PWEP_{k}")
+            r1 = self.windows.get(k, (None, None))
+            if r1[0] is None:
+                raise ValueError(f"piecewise segment {k} missing PWSTART/PWSTOP")
+
+    def set_window(self, k: int, start_mjd: float, stop_mjd: float) -> None:
+        self.windows[k] = (start_mjd, stop_mjd)
+
+    def host_columns(self, toas, params):
+        cols = super().host_columns(toas, params)
+        t = toas.tdb.mjd_float()
+        for k in self.indices:
+            r1, r2 = self.windows[k]
+            cols[f"pw_mask_{k}"] = ((t >= r1) & (t <= r2)).astype(np.float64)
+        return cols
+
+    def phase(self, params: dict, tensor: dict, total_delay: Array, xp):
+        t = xp.to_f64(barycentric_time_x(xp, params, tensor, total_delay))
+        ph = jnp.zeros_like(t)
+        for k in self.indices:
+            dt = t - leaf_to_f64(params[f"PWEP_{k}"])
+            p = leaf_to_f64(params.get(f"PWPH_{k}", 0.0))
+            p = p + leaf_to_f64(params.get(f"PWF0_{k}", 0.0)) * dt
+            p = p + leaf_to_f64(params.get(f"PWF1_{k}", 0.0)) * dt**2 / 2.0
+            p = p + leaf_to_f64(params.get(f"PWF2_{k}", 0.0)) * dt**3 / 6.0
+            ph = ph + tensor[f"pw_mask_{k}"] * p
+        return xp.from_f64(ph)

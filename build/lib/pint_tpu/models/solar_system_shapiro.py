@@ -1,0 +1,65 @@
+"""Solar-system Shapiro delay (GR light bending in the Sun/planet fields).
+
+Reference: pint/models/solar_system_shapiro.py (SolarSystemShapiro:23,
+ss_obj_shapiro_delay:60). For each body with "mass in time units"
+T = GM/c^3:
+
+    delay = -2 T ln( (r - r.n) / AU )
+
+with r the observatory->body vector (light-seconds) and n the pulsar
+direction; the constant AU divisor only shifts the absolute phase. Planetary
+terms are enabled by PLANET_SHAPIRO (parfile bool) exactly as in the
+reference.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from pint_tpu import AU_LS, TBODY_S, TSUN_S
+from pint_tpu.models.base import DelayComponent
+from pint_tpu.models.parameter import ParamSpec
+from pint_tpu.toas import PLANETS
+
+Array = jnp.ndarray
+
+
+def shapiro_delay(obs_obj_pos_ls: Array, psr_dir: Array, t_obj_s: float) -> Array:
+    r = jnp.linalg.norm(obs_obj_pos_ls, axis=-1)
+    rcostheta = jnp.sum(obs_obj_pos_ls * psr_dir, axis=-1)
+    return -2.0 * t_obj_s * jnp.log((r - rcostheta) / AU_LS)
+
+
+class SolarSystemShapiro(DelayComponent):
+    category = "solar_system_shapiro"
+    register = True
+
+    @classmethod
+    def param_specs(cls):
+        return [
+            ParamSpec(
+                "PLANET_SHAPIRO",
+                kind="bool",
+                default=False,
+                description="Include Jupiter/Saturn/Venus/Uranus/Neptune terms",
+            )
+        ]
+
+    def __init__(self):
+        super().__init__()
+        self.planet_shapiro = False  # set by builder from PLANET_SHAPIRO
+
+    def validate(self, params, meta):
+        if self.planet_shapiro and not meta.get("toas_have_planets", True):
+            raise ValueError("PLANET_SHAPIRO set but TOAs lack planet positions")
+
+    def delay(self, params: dict, tensor: dict, delay_so_far: Array, xp) -> Array:
+        # pulsar direction from the astrometry component, stashed into the
+        # tensor-independent params closure by TimingModel (the reference pulls
+        # it from model.ssb_to_psb_xyz_ICRS at each call)
+        psr_dir = tensor["_psr_dir"]
+        d = shapiro_delay(tensor["obs_sun_pos_ls"], psr_dir, TSUN_S)
+        if self.planet_shapiro:
+            for p in PLANETS:
+                d = d + shapiro_delay(tensor[f"obs_{p}_pos_ls"], psr_dir, TBODY_S[p])
+        return d

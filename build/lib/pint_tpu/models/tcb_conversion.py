@@ -1,0 +1,89 @@
+"""Approximate TCB <-> TDB timing-model conversion.
+
+Reference: pint/models/tcb_conversion.py (IFTE constants :17-19,
+scale_parameter:22, transform_mjd_parameter, convert_tcb_tdb:88 — the
+tempo2 `transform` plugin's recipe). Parameters scale by powers of
+IFTE_K = 1 + 1.55051979176e-8 according to their effective dimensionality;
+epochs map linearly about IFTE_MJD0. The conversion is approximate by
+construction (same caveats as the reference): re-fit afterwards.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from pint_tpu import SECS_PER_DAY
+from pint_tpu.ops.dd import DD
+from pint_tpu.utils.logging import get_logger
+
+log = get_logger("pint_tpu.tcb")
+
+IFTE_MJD0 = 43144.0003725
+IFTE_KM1 = 1.55051979176e-8
+IFTE_K = 1.0 + IFTE_KM1
+
+
+def _scale_leaf(v, factor: float):
+    if isinstance(v, DD):
+        return DD(v.hi * factor, v.lo * factor)
+    return v * factor
+
+
+def scale_parameter(model, name: str, n: int, backwards: bool) -> None:
+    """x_tdb = x_tcb * IFTE_K**n (reference scale_parameter:22)."""
+    if name not in model.params:
+        return
+    p = 1 if backwards else -1
+    factor = IFTE_K ** (p * n)
+    model.params[name] = _scale_leaf(model.params[name], factor)
+    pm = model.param_meta.get(name)
+    if pm is not None and pm.uncertainty is not None:
+        pm.uncertainty *= factor
+
+
+def transform_mjd_parameter(model, name: str, backwards: bool) -> None:
+    """t_tdb = IFTE_MJD0 + (t_tcb - IFTE_MJD0)/IFTE_K (reference
+    transform_mjd_parameter; epochs here are DD seconds since the tensor
+    epoch, itself TDB)."""
+    if name not in model.params:
+        return
+    from pint_tpu.toas import TENSOR_EPOCH_MJD
+
+    factor = IFTE_K if backwards else 1.0 / IFTE_K
+    v = model.params[name]
+    mjd = TENSOR_EPOCH_MJD + (float(np.asarray(v.hi)) + float(np.asarray(v.lo))) / SECS_PER_DAY
+    new_mjd = IFTE_MJD0 + (mjd - IFTE_MJD0) * factor
+    sec = (new_mjd - TENSOR_EPOCH_MJD) * SECS_PER_DAY
+    hi = np.float64(sec)
+    model.params[name] = DD(hi, np.float64(sec - hi))
+
+
+def convert_tcb_tdb(model, backwards: bool = False) -> None:
+    """In-place units conversion (reference convert_tcb_tdb:88)."""
+    target = "TCB" if backwards else "TDB"
+    if model.meta.get("UNITS", "TDB") == target:
+        log.warning("model already in %s; doing nothing", target)
+        return
+    log.warning(
+        "converting timing model %s; the conversion is approximate — re-fit "
+        "the resulting model", "TDB->TCB" if backwards else "TCB->TDB",
+    )
+    if "Spindown" in model:
+        for k in range(20):
+            scale_parameter(model, f"F{k}", k + 1, backwards)
+        transform_mjd_parameter(model, "PEPOCH", backwards)
+    for nm in ("PMRA", "PMDEC", "PMELAT", "PMELONG"):
+        scale_parameter(model, nm, 1, backwards)
+    transform_mjd_parameter(model, "POSEPOCH", backwards)
+    if "DispersionDM" in model:
+        for k in range(10):
+            scale_parameter(model, f"DM{k}" if k else "DM", k + 1, backwards)
+        transform_mjd_parameter(model, "DMEPOCH", backwards)
+    if any(c.category == "pulsar_system" for c in model.components):
+        transform_mjd_parameter(model, "T0", backwards)
+        transform_mjd_parameter(model, "TASC", backwards)
+        scale_parameter(model, "PB", -1, backwards)
+        scale_parameter(model, "FB0", 1, backwards)
+        scale_parameter(model, "FB1", 2, backwards)
+        scale_parameter(model, "A1", -1, backwards)
+    model.meta["UNITS"] = target

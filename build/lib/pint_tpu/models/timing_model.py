@@ -1,0 +1,500 @@
+"""TimingModel: ordered component chain -> pure jit-able phase function.
+
+Reference: pint/models/timing_model.py (TimingModel:166; delay:1270 sums
+delay funcs in DEFAULT_ORDER with accumulated-delay semantics; phase:1303
+sums phase funcs then anchors to the TZR fiducial TOA). The TPU re-design
+keeps those semantics but expresses the whole forward pass as
+
+    phase(params_pytree, tensor_dict) -> DD turns        (pure, jit-able)
+
+with all irregular work (mask compilation, TZR TOA preparation, parfile IO)
+done once on the host in `build_tensor`. Design matrices come from jax
+autodiff of this function (fitting/), replacing the reference's analytic
+d_phase_d_param/d_delay_d_param machinery (timing_model.py:1654-1724).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from pint_tpu.models.base import (
+    DEFAULT_ORDER,
+    Component,
+    epoch_dd_to_mjd_string,
+    epoch_mjd_float,
+)
+from pint_tpu.models.parameter import ParamValueMeta, dd_to_str, format_dms, format_hms
+from pint_tpu.ops.dd import DD, dd_rint
+from pint_tpu.utils.logging import get_logger
+
+log = get_logger("pint_tpu.models")
+
+Array = jnp.ndarray
+
+# params that configure host-side tensor construction and cannot be fitted
+UNFITTABLE = {"TZRMJD", "TZRSITE", "TZRFRQ", "PLANET_SHAPIRO"}
+
+
+class TimingModel:
+    def __init__(self, components: list[Component], meta: dict | None = None):
+        order = {cat: i for i, cat in enumerate(DEFAULT_ORDER)}
+        self.components = sorted(components, key=lambda c: order.get(c.category, 99))
+        self.meta: dict = meta or {}
+        self.params: dict = {}
+        self.param_meta: dict[str, ParamValueMeta] = {}
+        self._xprec = None  # lazy; see xprec property
+
+    @property
+    def xprec(self):
+        """Extended-precision backend for the phase value path: dd64 on
+        true-f64 platforms, qf32 on TPUs with emulated f64 (ops/xprec.py)."""
+        if self._xprec is None:
+            from pint_tpu.ops.xprec import get_xprec
+
+            self._xprec = get_xprec()
+        return self._xprec
+
+    @xprec.setter
+    def xprec(self, backend):
+        from pint_tpu.ops.xprec import get_xprec
+
+        self._xprec = get_xprec(backend) if isinstance(backend, str) else backend
+
+    # --- structure ---------------------------------------------------------------
+
+    _JIT_CACHES = (
+        "_resid_fn_cache", "_wls_step_cache", "_gls_step_cache",
+        "_gls_chi2_cache", "_wb_step_cache", "_wb_chi2_cache", "_grid_fn_cache",
+    )
+
+    def clear_caches(self) -> None:
+        """Drop every cached jitted program. REQUIRED after any structural
+        mutation (component swap/addition, e.g. binaryconvert or
+        add_dmx_to_model) — cached closures capture the old component list."""
+        for k in self._JIT_CACHES:
+            self.__dict__.pop(k, None)
+
+    def add_component(self, component: Component, params: dict | None = None,
+                      validate: bool = True) -> None:
+        """Insert a component into the chain at its DEFAULT_ORDER slot
+        (reference TimingModel.add_component, timing_model.py:1030).
+
+        `params` maps parameter names to values — parfile strings (parsed
+        through the spec) or internal-unit values. Params with spec defaults
+        are filled in automatically.
+        """
+        if component.name in self:
+            raise ValueError(f"component {component.name} already in model")
+        order = {cat: i for i, cat in enumerate(DEFAULT_ORDER)}
+        self.components.append(component)
+        self.components.sort(key=lambda c: order.get(c.category, 99))
+        for n, v in component.default_params().items():
+            if n not in self.params:
+                self.params[n] = v
+                self.param_meta[n] = ParamValueMeta(spec=component.specs[n])
+        if params:
+            for n, v in params.items():
+                spec = component.specs.get(n)
+                if spec is None:
+                    raise KeyError(f"{component.name} has no parameter {n}")
+                self.params[n] = spec.parse(v) if isinstance(v, str) else v
+                self.param_meta.setdefault(n, ParamValueMeta(spec=spec))
+        if validate:
+            component.validate(self.params, self.meta)
+        self.clear_caches()
+
+    def remove_component(self, name: str) -> Component:
+        """Remove a component and every parameter it owns (reference
+        TimingModel.remove_component, timing_model.py:1086)."""
+        comp = self[name]  # raises KeyError if absent
+        self.components.remove(comp)
+        owned = set(comp.specs) | {mp.name for mp in comp.mask_params}
+        for n in owned:
+            self.params.pop(n, None)
+            self.param_meta.pop(n, None)
+        self.clear_caches()
+        return comp
+
+    @property
+    def derived_params(self) -> dict:
+        """name -> FuncParamSpec of every component-exposed derived
+        parameter (reference funcParameter surface)."""
+        out = {}
+        for c in self.components:
+            for fp in c.func_param_specs():
+                out[fp.name] = fp
+        return out
+
+    def get_derived(self, name: str) -> float:
+        """Evaluate a derived (funcParameter-style) quantity; falls back to
+        the plain parameter value when `name` is a real parameter."""
+        fps = self.derived_params
+        if name in fps:
+            return fps[name].value(self.params)
+        if name in self.params:
+            from pint_tpu.models.base import leaf_to_f64
+
+            return float(np.asarray(leaf_to_f64(self.params[name])))
+        raise KeyError(f"no parameter or derived quantity {name}")
+
+    def as_ECL(self) -> "TimingModel":
+        """New model with ecliptic astrometry (reference as_ECL,
+        timing_model.py:2647)."""
+        from pint_tpu.models.astrometry import model_as_ECL
+
+        return model_as_ECL(self)
+
+    def as_ICRS(self) -> "TimingModel":
+        """New model with equatorial astrometry (reference as_ICRS,
+        timing_model.py:2697)."""
+        from pint_tpu.models.astrometry import model_as_ICRS
+
+        return model_as_ICRS(self)
+
+    def __getitem__(self, name: str) -> Component:
+        for c in self.components:
+            if c.name == name:
+                return c
+        raise KeyError(name)
+
+    def __contains__(self, name: str) -> bool:
+        return any(c.name == name for c in self.components)
+
+    @property
+    def component_names(self) -> list[str]:
+        return [c.name for c in self.components]
+
+    @property
+    def delay_components(self) -> list[Component]:
+        return [c for c in self.components if hasattr(c, "delay") and _overrides(c, "delay")]
+
+    @property
+    def phase_components(self) -> list[Component]:
+        return [c for c in self.components if hasattr(c, "phase") and _overrides(c, "phase")]
+
+    @property
+    def astrometry(self) -> Component | None:
+        for c in self.components:
+            if c.category == "astrometry":
+                return c
+        return None
+
+    @property
+    def has_abs_phase(self) -> bool:
+        return any(c.category == "absolute_phase" for c in self.components)
+
+    @property
+    def has_phase_offset(self) -> bool:
+        return any(c.category == "phase_offset" for c in self.components)
+
+    @property
+    def free_params(self) -> list[str]:
+        return [n for n, m in self.param_meta.items() if not m.frozen]
+
+    # --- noise surface (models/noise.py) -----------------------------------------
+
+    @property
+    def noise_components(self) -> list[Component]:
+        from pint_tpu.models.noise import NoiseComponent
+
+        return [c for c in self.components if isinstance(c, NoiseComponent)]
+
+    @property
+    def has_correlated_errors(self) -> bool:
+        return any(
+            getattr(c, "introduces_correlated_errors", False) for c in self.components
+        )
+
+    def scaled_sigma(self, params: dict, tensor: dict) -> Array:
+        """Noise-rescaled per-TOA sigma (seconds), DATA rows only (reference
+        scaled_toa_uncertainty, timing_model.py via ScaleToaError)."""
+        sigma = tensor["error_s"]
+        for c in self.noise_components:
+            sigma = c.scale_sigma(params, tensor, sigma)
+        if self.has_abs_phase:
+            sigma = sigma[:-1]
+        return sigma
+
+    # --- wideband DM surface (reference timing_model total_dm /
+    # scaled_dm_uncertainty; residuals.py:590 WidebandDMResiduals) ----------------
+
+    @property
+    def dm_components(self) -> list[Component]:
+        return [c for c in self.components if hasattr(c, "dm_value")]
+
+    def total_dm(self, params: dict, tensor: dict) -> Array:
+        """Model DM at each TOA (pc/cm^3), DATA rows only."""
+        tensor = self._with_context(params, tensor)
+        dm = jnp.zeros_like(tensor["t_hi"])
+        for c in self.dm_components:
+            dm = dm + c.dm_value(params, tensor)
+        if self.has_abs_phase:
+            dm = dm[:-1]
+        return dm
+
+    def scaled_dm_sigma(self, params: dict, tensor: dict) -> Array:
+        """DMEFAC/DMEQUAD-rescaled wideband DM uncertainties, DATA rows."""
+        sigma = tensor["wb_dme"]
+        for c in self.noise_components:
+            if hasattr(c, "scale_dm_sigma"):
+                sigma = c.scale_dm_sigma(params, tensor, sigma)
+        if self.has_abs_phase:
+            sigma = sigma[:-1]
+        return sigma
+
+    def noise_basis_and_weights(self, params: dict, tensor: dict):
+        """Structured correlated-noise basis (fitting/woodbury.py
+        NoiseBasis) or None: dense Fourier columns concatenated, the ECORR
+        epoch structure kept implicit (reference noise_model_designmatrix /
+        noise_model_basis_weight, timing_model.py — which concatenate
+        everything dense)."""
+        import jax.numpy as _jnp
+
+        from pint_tpu.fitting.woodbury import NoiseBasis
+
+        sl = slice(None, -1) if self.has_abs_phase else slice(None)
+        Fs, phis = [], []
+        eidx = ephi = None
+        for c in self.noise_components:
+            out = c.basis_and_weights(params, tensor, sl)
+            if out is None:
+                continue
+            if out[0] == "dense":
+                Fs.append(out[1])
+                phis.append(out[2])
+            else:  # "epoch" — at most one EcorrNoise component per model
+                eidx, ephi = out[1], out[2]
+        if not Fs and eidx is None:
+            return None
+        return NoiseBasis(
+            dense=_jnp.concatenate(Fs, axis=1) if Fs else None,
+            dense_phi=_jnp.concatenate(phis) if phis else None,
+            eidx=eidx,
+            ephi=ephi,
+        )
+
+    def set_free(self, names: list[str]) -> None:
+        for n in names:
+            if n not in self.param_meta:
+                raise KeyError(f"unknown parameter {n}")
+            if n in UNFITTABLE:
+                raise ValueError(f"{n} configures tensor construction; cannot fit")
+        for n, m in self.param_meta.items():
+            m.frozen = n not in names
+
+    def validate(self) -> None:
+        for c in self.components:
+            c.validate(self.params, self.meta)
+
+    @property
+    def psr_name(self) -> str:
+        return self.meta.get("PSR", "")
+
+    @property
+    def ephem(self) -> str | None:
+        return self.meta.get("EPHEM")
+
+    @property
+    def planet_shapiro(self) -> bool:
+        return bool(self.meta.get("PLANET_SHAPIRO", False))
+
+    # --- host: tensor construction ----------------------------------------------
+
+    def build_tensor(self, toas) -> dict:
+        """TOAs -> dict of jnp arrays, the single host->device handoff.
+
+        Adds component mask columns, planet columns, and (if AbsPhase) the TZR
+        fiducial TOA as the appended LAST row.
+        """
+        from pint_tpu.toas import make_tzr_toa
+
+        if self.has_abs_phase:
+            tzr_day, tzr_hi, tzr_lo = self.meta["TZR_DAY"], self.meta["TZR_HI"], self.meta["TZR_LO"]
+            tzr = make_tzr_toa(
+                tzr_day,
+                tzr_hi,
+                tzr_lo,
+                self.meta.get("TZRSITE", "ssb"),
+                self.meta.get("TZRFRQ", float("inf")),
+                ephem=toas.ephem,
+                planets=toas.planets,
+            )
+            from pint_tpu.toas import merge_TOAs
+
+            full = merge_TOAs([toas, tzr])
+        else:
+            full = toas
+
+        tens = full.tensor()
+        from pint_tpu.ops.dd import device_split
+        from pint_tpu.ops.qf32 import qf_split_host
+
+        t_hi, t_lo = device_split(tens.t_hi, tens.t_lo)
+        q0, q1, q2, q3 = qf_split_host(tens.t_hi, tens.t_lo)
+        out = {
+            "t_hi": jnp.asarray(t_hi),
+            "t_lo": jnp.asarray(t_lo),
+            "t_q0": jnp.asarray(q0),
+            "t_q1": jnp.asarray(q1),
+            "t_q2": jnp.asarray(q2),
+            "t_q3": jnp.asarray(q3),
+            "error_s": jnp.asarray(tens.error_s),
+            "freq_mhz": jnp.asarray(tens.freq_mhz),
+            "ssb_obs_pos_ls": jnp.asarray(tens.ssb_obs_pos_ls),
+            "ssb_obs_vel_ls": jnp.asarray(tens.ssb_obs_vel_ls),
+            "obs_sun_pos_ls": jnp.asarray(tens.obs_sun_pos_ls),
+        }
+        for p, arr in tens.planet_pos_ls.items():
+            out[f"obs_{p}_pos_ls"] = jnp.asarray(arr)
+        # wideband DM measurements (-pp_dm / -pp_dme flags); rows without a
+        # measurement (including the TZR row) get infinite error -> zero
+        # weight in the DM block
+        wb_dm, wb_dme = full.get_wideband_dm()
+        if wb_dm is not None:
+            out["wb_dm"] = jnp.asarray(wb_dm)
+            out["wb_dme"] = jnp.asarray(wb_dme)
+
+        n_rows = tens.t_hi.shape[0]
+        for c in self.components:
+            for k, col in c.host_columns(full, self.params).items():
+                col = np.asarray(col, np.float64)
+                # The TZR fiducial row belongs to no flag/selection MASK
+                # (it is a synthetic TOA), but it DOES get every other
+                # model column (interpolation weights, window masks, tropo
+                # delay, ...) so its phase matches the reference's full
+                # model evaluation at TZRMJD. Non-row-indexed aux arrays
+                # (e.g. ECORR column->param maps) pass through untouched.
+                if self.has_abs_phase and k.startswith("mask_") and col.shape[:1] == (n_rows,):
+                    col[-1] = 0.0
+                out[k] = jnp.asarray(col)
+        return out
+
+    # --- device: the forward pass -------------------------------------------------
+
+    def delay(self, params: dict, tensor: dict, xp=None) -> Array:
+        """Total delay in seconds, accumulated in DEFAULT_ORDER."""
+        xp = xp or self.xprec
+        tensor = self._with_context(params, tensor)
+        total = jnp.zeros_like(tensor["t_hi"])
+        for c in self.delay_components:
+            total = total + c.delay(params, tensor, total, xp)
+        return total
+
+    def phase(self, params: dict, tensor: dict, xp=None):
+        """Pulse phase in turns (extended precision), TZR-anchored when
+        AbsPhase is present.
+
+        With AbsPhase the tensor's last row is the fiducial TOA; its phase is
+        subtracted from all rows and the result sliced back to the data rows.
+        """
+        return self.phase_and_freq(params, tensor, xp)[0]
+
+    def phase_and_freq(self, params: dict, tensor: dict, xp=None):
+        """(phase, spin frequency) sharing ONE evaluation of the delay chain
+        — residual construction needs both, and the delay chain is the bulk
+        of the graph (reference computes d_phase_d_toa separately;
+        timing_model.py:1614)."""
+        xp = xp or self.xprec
+        tensor = self._with_context(params, tensor)
+        total_delay = jnp.zeros_like(tensor["t_hi"])
+        for c in self.delay_components:
+            total_delay = total_delay + c.delay(params, tensor, total_delay, xp)
+        ph = xp.zeros_like(tensor["t_hi"])
+        for c in self.phase_components:
+            ph = xp.add(ph, c.phase(params, tensor, total_delay, xp))
+        if "Spindown" in self:
+            f = self["Spindown"].spin_frequency(params, tensor, total_delay, xp)
+        else:
+            # no spindown: phase residuals cannot be converted to time;
+            # f=1 leaves them numerically equal to turns (callers that need
+            # seconds must have F0 — builder always adds Spindown when F0
+            # is present)
+            f = jnp.ones_like(tensor["t_hi"])
+        if self.has_abs_phase:
+            tzr_phase = xp.index(ph, -1)
+            ph = xp.index(ph, slice(None, -1))
+            ph = xp.add(ph, xp.neg(tzr_phase))
+            f = f[:-1]
+        return ph, f
+
+    def _with_context(self, params: dict, tensor: dict) -> dict:
+        ast = self.astrometry
+        if ast is not None:
+            tensor = dict(tensor)
+            tensor["_psr_dir"] = ast.pulsar_direction(params, tensor)
+        return tensor
+
+    def spin_frequency(self, params: dict, tensor: dict, xp=None) -> Array:
+        """f(t) at each TOA (for phase->time residual conversion)."""
+        return self.phase_and_freq(params, tensor, xp)[1]
+
+    # --- reporting / parfile round trip -------------------------------------------
+
+    def get_mjd_param(self, name: str) -> float:
+        return epoch_mjd_float(self.params[name])
+
+    def as_parfile(self) -> str:
+        """Write the model back in parfile form (reference as_parfile,
+        timing_model.py:2437). Values convert from internal SI units."""
+        from pint_tpu.models import builder as _b
+
+        return _b.model_to_parfile(self)
+
+    def compare(self, other: "TimingModel", sigma: float = 3.0) -> str:
+        """Parameter-by-parameter comparison of two models (reference
+        TimingModel.compare, timing_model.py): flags values differing by
+        more than `sigma` of this model's uncertainties."""
+        from pint_tpu.models.base import leaf_to_f64
+
+        lines = [f"{'PAR':<12s} {'this':>22s} {'other':>22s} {'diff/sigma':>11s}"]
+        names = [
+            n for n in self.params
+            if n in self.param_meta and self.param_meta[n].spec.is_fittable
+        ]
+        for n in names:
+            v1 = float(np.asarray(leaf_to_f64(self.params[n])))
+            if n not in other.params:
+                lines.append(f"{n:<12s} {v1:>22.12g} {'---':>22s}")
+                continue
+            v2 = float(np.asarray(leaf_to_f64(other.params[n])))
+            unc = self.param_meta[n].uncertainty
+            if unc:
+                ns = (v2 - v1) / unc
+                flag = " !" if abs(ns) > sigma else ""
+                lines.append(f"{n:<12s} {v1:>22.12g} {v2:>22.12g} {ns:>11.2f}{flag}")
+            else:
+                lines.append(f"{n:<12s} {v1:>22.12g} {v2:>22.12g}")
+        for n in other.params:
+            if (n not in self.params and n in other.param_meta
+                    and other.param_meta[n].spec.is_fittable):
+                v2 = float(np.asarray(leaf_to_f64(other.params[n])))
+                lines.append(f"{n:<12s} {'---':>22s} {v2:>22.12g}")
+        return "\n".join(lines)
+
+    def summary(self) -> str:
+        lines = [f"TimingModel {self.psr_name or '?'}: " + ", ".join(self.component_names)]
+        for n, m in self.param_meta.items():
+            v = self.params.get(n)
+            tag = "free" if not m.frozen else "    "
+            lines.append(f"  {n:<12s} {tag} {_fmt_value(n, v, m)}")
+        return "\n".join(lines)
+
+
+def _overrides(c: Component, method: str) -> bool:
+    return getattr(type(c), method, None) is not getattr(Component, method, None)
+
+
+def _fmt_value(name: str, v, m: ParamValueMeta) -> str:
+    if isinstance(v, DD):
+        if m.spec.kind == "epoch":
+            return f"MJD {epoch_mjd_float(v):.6f}"
+        return dd_to_str(float(np.asarray(v.hi)), float(np.asarray(v.lo)))
+    if m.spec.kind == "hms":
+        return format_hms(float(v))
+    if m.spec.kind == "dms":
+        return format_dms(float(v))
+    return repr(v)
+
+
+

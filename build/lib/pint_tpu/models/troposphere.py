@@ -1,0 +1,142 @@
+"""Tropospheric delay: Davis zenith hydrostatic delay + Niell mapping.
+
+Reference: pint/models/troposphere_delay.py (TroposphereDelay:15; Davis et
+al. 1985 zenith delay, Niell 1996 mapping functions eq. 4, wet zenith delay
+defaulting to zero like TEMPO2). Enabled by CORRECT_TROPOSPHERE.
+
+TPU design: the component has no fittable parameters (same as the
+reference), and the delay's dependence on the timing solution is only
+through the ~arcsecond-level pulsar direction — so the whole delay is
+compiled to a host-side per-TOA column at tensor-build time and the device
+delay is a constant lookup. Published Niell (1996) coefficient tables are
+public constants (category-b, like the IAU nutation series).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from pint_tpu.models.base import DelayComponent
+from pint_tpu.models.parameter import ParamSpec
+
+Array = jnp.ndarray
+
+C_M_S = 299792458.0
+EARTH_R = 6356766.0  # m, at 45 deg latitude (US Std Atmosphere convention)
+
+# Niell (1996) hydrostatic mapping coefficients at |lat| = 15..75 deg
+_NLAT = np.array([15.0, 30.0, 45.0, 60.0, 75.0])
+_A_AVG = np.array([1.2769934, 1.2683230, 1.2465397, 1.2196049, 1.2045996]) * 1e-3
+_B_AVG = np.array([2.9153695, 2.9152299, 2.9288445, 2.9022565, 2.9024912]) * 1e-3
+_C_AVG = np.array([62.610505, 62.837393, 63.721774, 63.824265, 64.258455]) * 1e-3
+_A_AMP = np.array([0.0, 1.2709626, 2.6523662, 3.4000452, 4.1202191]) * 1e-5
+_B_AMP = np.array([0.0, 2.1414979, 3.0160779, 7.2562722, 11.723375]) * 1e-5
+_C_AMP = np.array([0.0, 9.0128400, 4.3497037, 84.795348, 170.37206]) * 1e-5
+# height-correction coefficients (Niell 1996)
+_A_HT, _B_HT, _C_HT = 2.53e-5, 5.49e-3, 1.14e-3
+_DOY_OFFSET = -28.0  # MJD offset giving the annual phase (reference :82)
+
+_MIN_ALT_DEG = 5.0  # below this, hold the delay at its 5-degree value
+
+
+def _herring_map(sin_alt, a, b, c):
+    """Niell 1996 eq. 4 continued-fraction mapping (1 at zenith)."""
+    top = 1.0 + a / (1.0 + b / (1.0 + c))
+    bot = sin_alt + a / (sin_alt + b / (sin_alt + c))
+    return top / bot
+
+
+def _geodetic(itrf_m: np.ndarray) -> tuple[float, float]:
+    """(latitude rad, height m) from ITRF xyz; WGS84, Bowring's method."""
+    a, f = 6378137.0, 1.0 / 298.257223563
+    b = a * (1 - f)
+    e2 = f * (2 - f)
+    x, y, z = itrf_m
+    p = np.hypot(x, y)
+    th = np.arctan2(z * a, p * b)
+    ep2 = (a**2 - b**2) / b**2
+    lat = np.arctan2(z + ep2 * b * np.sin(th) ** 3, p - e2 * a * np.cos(th) ** 3)
+    n = a / np.sqrt(1 - e2 * np.sin(lat) ** 2)
+    h = p / np.cos(lat) - n
+    return float(lat), float(h)
+
+
+def _zenith_hydrostatic_s(lat: float, h_m: float) -> float:
+    """Davis et al. 1985 zenith hydrostatic delay in seconds (reference
+    zenith_delay:242 + US Standard Atmosphere pressure)."""
+    gph = EARTH_R * h_m / (EARTH_R + h_m)
+    T = 288.15 - 0.0065 * gph
+    p_kpa = 101.325 * (288.15 / T) ** -5.25575
+    return (p_kpa / 43.921) / (C_M_S * (1 - 0.00266 * np.cos(2 * lat) - 0.00028 * h_m / 1e3))
+
+
+def _niell_abc(lat: float, mjd: np.ndarray):
+    """Annual-varying hydrostatic (a, b, c), nearest-latitude interpolated."""
+    year_frac = ((mjd + _DOY_OFFSET) % 365.25) / 365.25
+    if lat < 0:  # southern hemisphere: half-year phase shift (Niell)
+        year_frac = year_frac + 0.5
+    cosy = np.cos(2 * np.pi * year_frac)
+    al = np.abs(np.degrees(lat))
+    a = np.interp(al, _NLAT, _A_AVG) + np.interp(al, _NLAT, _A_AMP) * cosy
+    b = np.interp(al, _NLAT, _B_AVG) + np.interp(al, _NLAT, _B_AMP) * cosy
+    c = np.interp(al, _NLAT, _C_AVG) + np.interp(al, _NLAT, _C_AMP) * cosy
+    return a, b, c
+
+
+class TroposphereDelay(DelayComponent):
+    category = "troposphere"
+    register = True
+
+    @classmethod
+    def param_specs(cls):
+        return [ParamSpec("CORRECT_TROPOSPHERE", kind="bool", default=True)]
+
+    def host_columns(self, toas, params):
+        from pint_tpu.astro.observatories import get_observatory
+        from pint_tpu.astro import time as ptime
+
+        cols = super().host_columns(toas, params)
+        n = len(toas)
+        delay = np.zeros(n)
+        # pulsar direction from the current astrometry (arcsecond-level
+        # changes during fitting move the tropo delay by < ns)
+        if "ELONG" in params:
+            from pint_tpu.astro.ephemeris import _ECL2EQU
+
+            el = float(np.asarray(params["ELONG"]))
+            eb = float(np.asarray(params["ELAT"]))
+            psr = _ECL2EQU @ np.array(
+                [np.cos(eb) * np.cos(el), np.cos(eb) * np.sin(el), np.sin(eb)]
+            )
+        else:
+            ra = float(np.asarray(params.get("RAJ", 0.0)))
+            dec = float(np.asarray(params.get("DECJ", 0.0)))
+            psr = np.array(
+                [np.cos(dec) * np.cos(ra), np.cos(dec) * np.sin(ra), np.sin(dec)]
+            )
+        tt = ptime.pulsar_mjd_utc_to_tt(toas.utc)
+        tt_jcent = ptime.mjd_tt_julian_centuries(tt)
+        ut1 = toas.utc.mjd_float()
+        for name in np.unique(toas.obs):
+            ob = get_observatory(str(name))
+            sel = np.flatnonzero(toas.obs == name)
+            itrf = getattr(ob, "itrf_xyz_m", None)
+            if itrf is None or not np.any(np.asarray(itrf)):
+                continue  # barycenter/geocenter rows: no atmosphere
+            lat, h = _geodetic(np.asarray(itrf, float))
+            pos, _ = ob.site_posvel_gcrs(ut1[sel], tt_jcent[sel])
+            zenith = pos / np.linalg.norm(pos, axis=-1)[:, None]
+            sin_alt = zenith @ psr
+            sin_alt = np.maximum(sin_alt, np.sin(np.radians(_MIN_ALT_DEG)))
+            a, b, c = _niell_abc(lat, ut1[sel])
+            base = _herring_map(sin_alt, a, b, c)
+            hcorr = _herring_map(sin_alt, _A_HT, _B_HT, _C_HT)
+            mapping = base + (1.0 / sin_alt - hcorr) * (h / 1e3)
+            delay[sel] = _zenith_hydrostatic_s(lat, h) * mapping
+            # wet zenith delay defaults to zero (reference :249, TEMPO2)
+        cols["tropo_delay"] = delay
+        return cols
+
+    def delay(self, params: dict, tensor: dict, delay_so_far: Array, xp) -> Array:
+        return tensor["tropo_delay"]

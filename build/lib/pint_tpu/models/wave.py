@@ -1,0 +1,80 @@
+"""Wave model: timing-noise whitening as a harmonic series.
+
+Reference: pint/models/wave.py (Wave:9, wave_phase:97): time offsets
+    tau(t) = sum_k [ WAVEk_A sin(k w dt) + WAVEk_B cos(k w dt) ]
+with w = WAVE_OM (rad/day) and dt from WAVEEPOCH, converted to phase by
+multiplying the fitted F0. Harmonic count is static model structure; the
+evaluation is one (N, 2K) sin/cos basis times the coefficient vector (an
+MXU matvec, like DMX).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from pint_tpu import SECS_PER_DAY
+from pint_tpu.models.base import PhaseComponent, barycentric_time_x, leaf_to_f64
+from pint_tpu.models.parameter import ParamSpec
+
+Array = jnp.ndarray
+
+
+class Wave(PhaseComponent):
+    category = "wave"
+    register = True
+
+    def __init__(self):
+        super().__init__()
+        self.num_terms = 0
+        self.term_indices: list[int] = []
+
+    @classmethod
+    def param_specs(cls):
+        return [
+            ParamSpec("WAVE_OM", scale=1.0 / SECS_PER_DAY, unit="rad/d",
+                      description="wave fundamental frequency"),
+            ParamSpec("WAVEEPOCH", kind="epoch", unit="MJD",
+                      description="wave reference epoch"),
+        ]
+
+    def add_wave_term(self, k: int) -> None:
+        """Register WAVEk (sin, cos) coefficient pair (seconds)."""
+        for tag in ("A", "B"):
+            self.specs[f"WAVE{k}{tag}"] = ParamSpec(
+                f"WAVE{k}{tag}", unit="s",
+                description=f"wave harmonic {k} {'sin' if tag == 'A' else 'cos'}",
+            )
+        self.num_terms = max(self.num_terms, k)
+        if k not in self.term_indices:
+            self.term_indices.append(k)
+            self.term_indices.sort()
+
+    def parfile_exclude(self):
+        return {f"WAVE{k}{t}" for k in self.term_indices for t in ("A", "B")}
+
+    def extra_parfile_lines(self, model):
+        import numpy as np
+
+        out = []
+        for k in self.term_indices:
+            a = float(np.asarray(model.params[f"WAVE{k}A"]))
+            b = float(np.asarray(model.params[f"WAVE{k}B"]))
+            out.append((f"WAVE{k}", f"{a:.17g} {b:.17g}"))
+        return out
+
+    def validate(self, params, meta):
+        if self.num_terms and "WAVE_OM" not in params:
+            raise ValueError("WAVE terms need WAVE_OM")
+        if self.num_terms and "WAVEEPOCH" not in params:
+            raise ValueError("WAVE terms need WAVEEPOCH (or PEPOCH)")
+
+    def phase(self, params: dict, tensor: dict, total_delay: Array, xp):
+        t = xp.to_f64(barycentric_time_x(xp, params, tensor, total_delay))
+        dt = t - leaf_to_f64(params["WAVEEPOCH"])
+        om = leaf_to_f64(params["WAVE_OM"])
+        tau = jnp.zeros_like(t)
+        for k in self.term_indices:
+            arg = k * om * dt
+            tau = tau + leaf_to_f64(params[f"WAVE{k}A"]) * jnp.sin(arg)
+            tau = tau + leaf_to_f64(params[f"WAVE{k}B"]) * jnp.cos(arg)
+        return xp.from_f64(tau * leaf_to_f64(params["F0"]))

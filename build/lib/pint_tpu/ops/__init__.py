@@ -1,0 +1,25 @@
+"""Device-side numerical kernels: double-double arithmetic, Horner evaluation,
+Kepler solvers, and linear-algebra helpers. Everything here is pure JAX and
+jit/vmap/grad-safe."""
+
+from pint_tpu.ops.dd import (  # noqa: F401
+    DD,
+    dd,
+    dd_add,
+    dd_add_fp,
+    dd_div,
+    dd_from_sum,
+    dd_mul,
+    dd_mul_fp,
+    dd_neg,
+    dd_normalize,
+    dd_rint,
+    dd_sub,
+    dd_to_float,
+    dd_zeros_like,
+    from_longdouble,
+    to_longdouble,
+    two_prod,
+    two_sum,
+)
+from pint_tpu.ops.taylor import taylor_horner, taylor_horner_dd, taylor_horner_deriv  # noqa: F401

@@ -1,0 +1,38 @@
+"""Backend-aware jit for extended-precision (dd64/qf32) computations.
+
+XLA:CPU's `fusion` pass (jax 0.9.0) recompute-duplicates multi-use
+intermediates when it fuses large elementwise DAGs. Compensated arithmetic
+(two_sum / renorm chains) is exactly that shape: every error term is used
+twice, so the emitted code grows ~2^depth. Measured on a 16-element array:
+a 15-deep qf_add/qf_mul chain runs in 2 ms, 16-deep in 0.4 s, 17-deep in
+>100 s — while the *optimized HLO is the same size*; the duplication happens
+at fusion codegen. The TPU compiler does not have this pathology (32-deep
+chain: 0.1 ms), and `lax.optimization_barrier` is stripped by the CPU
+pipeline before fusion, so the only effective cure is disabling the CPU
+fusion pass for the affected programs.
+
+`precision_jit` therefore compiles with
+`compiler_options={"xla_disable_hlo_passes": "fusion"}` when (and only
+when) the computation targets the CPU backend. The option is scoped to the
+single jitted program — nothing leaks into TPU compiles, where disabling
+fusion would be a real performance loss.
+"""
+
+from __future__ import annotations
+
+import jax
+
+_CPU_WORKAROUND = {"xla_disable_hlo_passes": "fusion"}
+
+
+def precision_jit(fn=None, **jit_kwargs):
+    """`jax.jit` for functions whose graph contains dd64/qf32 chains.
+
+    On the CPU backend, disables the XLA fusion pass for this program (see
+    module docstring); elsewhere it is plain `jax.jit`.
+    """
+    if fn is None:
+        return lambda f: precision_jit(f, **jit_kwargs)
+    if jax.default_backend() == "cpu":
+        jit_kwargs.setdefault("compiler_options", _CPU_WORKAROUND)
+    return jax.jit(fn, **jit_kwargs)

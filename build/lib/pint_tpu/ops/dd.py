@@ -1,0 +1,255 @@
+"""Double-double ("dd") arithmetic in JAX.
+
+Pulsar phase spans ~1e11 turns and must be known to ~1e-9 turns, i.e. ~20
+significant digits — beyond float64. The reference gets there with numpy's
+80/128-bit `np.longdouble` (it refuses to run without it, see reference
+conftest.py:49, pint/utils.py:116-135); TPUs have no extended-precision type,
+so this module carries precision-critical quantities as an unevaluated sum of
+two float64s `hi + lo` with |lo| <= ulp(hi)/2, giving ~32 significant digits.
+
+The error-free transformations (Knuth two_sum, Dekker split/two_prod) are the
+same algorithms the reference itself uses on the host to split MJDs into
+day/fraction pairs (pulsar_mjd.py:527,584,607 `day_frac/two_sum/two_product`);
+here they are expressed as JAX primitives so that XLA compiles them into the
+device program. XLA preserves IEEE-754 semantics (no fast-math reassociation),
+so the transforms remain exact under jit — verified by tests/test_dd.py which
+round-trips against np.longdouble under hypothesis.
+
+All ops are differentiable: mathematically each dd op computes an exact real
+quantity, and its JVP flows through the float64 carriers, which is exactly the
+precision needed for design matrices (the reference likewise evaluates its
+analytic derivatives in float64, fitter.py).
+
+TPU reality check (measured on v5e via the axon platform): XLA emulates f64
+as an f32 pair with ~48-bit effective mantissa, ~1e-14 relative error per op,
+and f32 exponent range (values below ~1e-38 flush to zero). The compensated
+algorithms below do not require *correct* rounding, only small per-op relative
+error, so dd-over-emulated-f64 still achieves ~90+ significant bits — a >20-bit
+margin over the ~67 bits that nanosecond phase at 1e11 turns requires. On CPU
+(tests, golden comparisons) base f64 is true IEEE and dd is the classic 106-bit
+double-double. bench.py measures the end-to-end CPU-vs-TPU phase parity.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Union
+
+import jax.numpy as jnp
+import numpy as np
+
+Array = jnp.ndarray
+Floatish = Union[float, Array]
+
+# Dekker splitter for binary64: 2^27 + 1
+_SPLITTER = 134217729.0
+
+
+class DD(NamedTuple):
+    """A number represented as the unevaluated exact sum ``hi + lo``.
+
+    NamedTuples are automatically JAX pytrees, so DD values flow through
+    jit/vmap/grad and can live inside parameter pytrees.
+    """
+
+    hi: Array
+    lo: Array
+
+    # Convenience operator sugar (thin wrappers over the functional ops).
+    def __add__(self, other):
+        return dd_add(self, other) if isinstance(other, DD) else dd_add_fp(self, other)
+
+    def __radd__(self, other):
+        return dd_add_fp(self, other)
+
+    def __sub__(self, other):
+        return dd_sub(self, other) if isinstance(other, DD) else dd_add_fp(self, -jnp.asarray(other))
+
+    def __rsub__(self, other):
+        return dd_add_fp(dd_neg(self), other)
+
+    def __mul__(self, other):
+        return dd_mul(self, other) if isinstance(other, DD) else dd_mul_fp(self, other)
+
+    def __rmul__(self, other):
+        return dd_mul_fp(self, other)
+
+    def __neg__(self):
+        return dd_neg(self)
+
+    def __truediv__(self, other):
+        return dd_div(self, other if isinstance(other, DD) else dd(other))
+
+
+def dd(hi: Floatish, lo: Floatish = 0.0) -> DD:
+    """Construct a DD from float64 parts (hi, lo are NOT renormalized)."""
+    hi = jnp.asarray(hi, dtype=jnp.float64)
+    lo = jnp.broadcast_to(jnp.asarray(lo, dtype=jnp.float64), hi.shape)
+    return DD(hi, lo)
+
+
+def dd_zeros_like(x: Array) -> DD:
+    z = jnp.zeros_like(x, dtype=jnp.float64)
+    return DD(z, z)
+
+
+# --- error-free transformations ------------------------------------------------
+
+
+def two_sum(a: Array, b: Array) -> tuple[Array, Array]:
+    """Knuth: s + err == a + b exactly, s = fl(a+b)."""
+    s = a + b
+    bb = s - a
+    err = (a - (s - bb)) + (b - bb)
+    return s, err
+
+
+def quick_two_sum(a: Array, b: Array) -> tuple[Array, Array]:
+    """Dekker fast path; requires |a| >= |b| (or a == 0)."""
+    s = a + b
+    err = b - (s - a)
+    return s, err
+
+
+def _split(a: Array) -> tuple[Array, Array]:
+    t = _SPLITTER * a
+    hi = t - (t - a)
+    lo = a - hi
+    return hi, lo
+
+
+def two_prod(a: Array, b: Array) -> tuple[Array, Array]:
+    """Dekker: p + err == a*b exactly, p = fl(a*b)."""
+    p = a * b
+    ah, al = _split(a)
+    bh, bl = _split(b)
+    err = ((ah * bh - p) + ah * bl + al * bh) + al * bl
+    return p, err
+
+
+# --- dd arithmetic -------------------------------------------------------------
+
+
+def dd_normalize(x: DD) -> DD:
+    hi, lo = quick_two_sum(x.hi, x.lo)
+    return DD(hi, lo)
+
+
+def dd_from_sum(a: Array, b: Array) -> DD:
+    """Exact DD value of a+b for arbitrary float64 a, b."""
+    return DD(*two_sum(jnp.asarray(a, jnp.float64), jnp.asarray(b, jnp.float64)))
+
+
+def dd_add(x: DD, y: DD) -> DD:
+    # Accurate (Knuth two-two_sum) variant: robust under the heavy
+    # cancellation of phase - TZR-phase subtractions, unlike the 3-op
+    # "sloppy" accumulation.
+    s1, s2 = two_sum(x.hi, y.hi)
+    t1, t2 = two_sum(x.lo, y.lo)
+    s2 = s2 + t1
+    s1, s2 = quick_two_sum(s1, s2)
+    s2 = s2 + t2
+    return DD(*quick_two_sum(s1, s2))
+
+
+def dd_add_fp(x: DD, b: Floatish) -> DD:
+    b = jnp.asarray(b, jnp.float64)
+    s, e = two_sum(x.hi, b)
+    e = e + x.lo
+    return DD(*quick_two_sum(s, e))
+
+
+def dd_sub(x: DD, y: DD) -> DD:
+    return dd_add(x, dd_neg(y))
+
+
+def dd_neg(x: DD) -> DD:
+    return DD(-x.hi, -x.lo)
+
+
+def dd_mul(x: DD, y: DD) -> DD:
+    p, e = two_prod(x.hi, y.hi)
+    e = e + x.hi * y.lo + x.lo * y.hi
+    return DD(*quick_two_sum(p, e))
+
+
+def dd_mul_fp(x: DD, b: Floatish) -> DD:
+    b = jnp.asarray(b, jnp.float64)
+    p, e = two_prod(x.hi, b)
+    e = e + x.lo * b
+    return DD(*quick_two_sum(p, e))
+
+
+def dd_div(x: DD, y: DD) -> DD:
+    """Newton-refined division; ~2 ulp of dd precision."""
+    q1 = x.hi / y.hi
+    r = dd_add(x, dd_neg(dd_mul(y, dd(q1))))
+    q2 = r.hi / y.hi
+    r = dd_add(r, dd_neg(dd_mul(y, dd(q2))))
+    q3 = r.hi / y.hi
+    s, e = two_sum(q1, q2)
+    return dd_normalize(DD(s, e + q3))
+
+
+def dd_rint(x: DD) -> tuple[Array, DD]:
+    """Split into (nearest integer as float64, dd fractional remainder).
+
+    The integer part of a pulse phase fits float64 exactly up to 2^53 turns
+    (~9e15), far above the ~1e11-turn span of real datasets.
+    """
+    n1 = jnp.rint(x.hi)
+    r = dd_add_fp(x, -n1)
+    n2 = jnp.rint(r.hi)
+    r = dd_add_fp(r, -n2)
+    return n1 + n2, r
+
+
+def dd_to_float(x: DD) -> Array:
+    return x.hi + x.lo
+
+
+# --- host->device boundary splitting -------------------------------------------
+
+# TPU reality: XLA emulates f64 with ~48 effective mantissa bits, so a host
+# float64 loses its bottom ~4 bits in transfer — and that loss lands OUTSIDE
+# the lo compensation term, silently costing ~0.5 us on a 1e8-s time value
+# (observed as exactly-ulp(t_hi)-quantized residuals). Any DD crossing the
+# host->device boundary must therefore have its hi part exactly representable
+# on the device. DEVICE_SPLIT_BITS=40 keeps hi to 40 mantissa bits (safe on
+# every backend), pushing the remainder into lo; total dd precision is then
+# ~2^-(41+48) relative even on emulated-f64 TPUs.
+
+DEVICE_SPLIT_BITS = 40
+
+
+def device_split(hi, lo=None, bits: int = DEVICE_SPLIT_BITS):
+    """Host-side (numpy): re-split hi+lo so hi has at most `bits` mantissa
+    bits. Value-preserving to f64^2; apply to every DD that ships to device."""
+    hi = np.asarray(hi, np.float64)
+    lo_in = 0.0 if lo is None else np.asarray(lo, np.float64)
+    mant, exp = np.frexp(hi)
+    s = np.ldexp(np.ones_like(hi), exp - bits)
+    with np.errstate(invalid="ignore"):
+        hi2 = np.where(hi == 0.0, 0.0, np.round(hi / np.where(s == 0, 1.0, s)) * s)
+    lo2 = (hi - hi2) + lo_in
+    return hi2, lo2
+
+
+def dd_device_split(x: DD, bits: int = DEVICE_SPLIT_BITS) -> DD:
+    hi, lo = device_split(np.asarray(x.hi), np.asarray(x.lo), bits)
+    return DD(jnp.asarray(hi), jnp.asarray(lo))
+
+
+# --- host-side longdouble bridges (testing / golden comparisons only) ----------
+
+
+def to_longdouble(x: DD) -> np.ndarray:
+    """Host: collapse to np.longdouble (80-bit) for comparison with goldens."""
+    return np.asarray(np.longdouble(np.asarray(x.hi)) + np.longdouble(np.asarray(x.lo)))
+
+
+def from_longdouble(x) -> DD:
+    """Host: split np.longdouble values into an exact (hi, lo) float64 pair."""
+    x = np.asarray(x, dtype=np.longdouble)
+    hi = np.asarray(x, dtype=np.float64)
+    lo = np.asarray(x - np.longdouble(hi), dtype=np.float64)
+    return DD(jnp.asarray(hi), jnp.asarray(lo))

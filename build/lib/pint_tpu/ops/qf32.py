@@ -1,0 +1,252 @@
+"""Quad-float32 ("qf") arithmetic: ~96-bit precision from f32 primitives.
+
+Why this exists: the TPU platform in use emulates float64 in software with a
+~48-bit effective mantissa, and the emulation is NOT correctly rounded —
+which breaks the preconditions of error-free transformations (two_sum /
+Dekker products), so double-double built on emulated f64 silently loses the
+nanosecond phase precision this framework exists to provide. float32 ops,
+however, ARE IEEE correctly rounded on the TPU vector unit (verified
+empirically in tests/test_qf32.py: two_sum32/two_prod32 are exact on
+device). This module therefore carries precision-critical quantities as an
+unevaluated sum of FOUR float32s, built entirely from f32 adds/muls — the
+TPU-native answer to the reference's np.longdouble (SURVEY.md L0;
+pulsar_mjd.py two_sum/two_product are the f64 ancestors of these kernels).
+
+Precision budget: pulse phase spans ~2^37 turns and must be good to ~2^-30
+turns (~67 bits); qf carries ~90+ bits after renormalization slop, a >20-bit
+margin. Host<->device: values must be pre-split ON HOST into f32 components
+(qf_split_host) — any f64 crossing the transfer boundary is silently rounded
+to the emulated format's precision first.
+
+All ops are branchless (XLA/SPMD-friendly) and differentiable; JVP tangents
+ride the f32 carriers, which bounds design-matrix accuracy at ~2^-24
+relative — ample for iterated least squares (the solve itself runs in f64).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+import numpy as np
+
+Array = jnp.ndarray
+
+_SPLIT32 = np.float32(4097.0)  # Dekker splitter for binary32: 2^12 + 1
+F32 = jnp.float32
+
+
+class QF(NamedTuple):
+    """Unevaluated sum a + b + c + d of float32s, |a| >= |b| >= |c| >= |d|
+    (approximately; one bit of overlap between neighbors is tolerated)."""
+
+    a: Array
+    b: Array
+    c: Array
+    d: Array
+
+
+# --- f32 error-free transformations --------------------------------------------
+
+
+def two_sum32(x: Array, y: Array) -> tuple[Array, Array]:
+    s = x + y
+    bb = s - x
+    err = (x - (s - bb)) + (y - bb)
+    return s, err
+
+
+def quick_two_sum32(x: Array, y: Array) -> tuple[Array, Array]:
+    s = x + y
+    return s, y - (s - x)
+
+
+def two_prod32(x: Array, y: Array) -> tuple[Array, Array]:
+    p = x * y
+    t = _SPLIT32 * x
+    xh = t - (t - x)
+    xl = x - xh
+    t2 = _SPLIT32 * y
+    yh = t2 - (t2 - y)
+    yl = y - yh
+    err = ((xh * yh - p) + xh * yl + xl * yh) + xl * yl
+    return p, err
+
+
+# --- renormalization -----------------------------------------------------------
+
+
+def _vecsum(comps: list[Array]) -> tuple[Array, list[Array]]:
+    """Ogita-Rump-Oishi VecSum: two_sum chain bottom-up. Returns
+    (fl(sum), error components), sum preserved exactly."""
+    s = comps[-1]
+    errs: list[Array] = []
+    for c in reversed(comps[:-1]):
+        s, e = two_sum32(c, s)
+        errs.append(e)
+    errs.reverse()
+    return s, errs
+
+
+def renorm(*comps: Array) -> QF:
+    """Collapse up to 6 components into a normalized QF (branchless: three
+    VecSum sweeps — each sweep extracts the float32 closest to the remaining
+    exact sum)."""
+    cs = list(comps)
+    r0, e0 = _vecsum(cs)
+    if not e0:
+        z = jnp.zeros_like(r0)
+        return QF(r0, z, z, z)
+    r1, e1 = _vecsum(e0)
+    if not e1:
+        z = jnp.zeros_like(r0)
+        return QF(r0, r1, z, z)
+    r2, e2 = _vecsum(e1)
+    r3 = e2[0] if e2 else jnp.zeros_like(r0)
+    for extra in e2[1:]:
+        r3 = r3 + extra
+    return QF(r0, r1, r2, r3)
+
+
+# --- construction / conversion -------------------------------------------------
+
+
+def _two_sum_np(a, b):
+    s = a + b
+    bb = s - a
+    return s, (a - (s - bb)) + (b - bb)
+
+
+def qf_split_host(hi, lo=None):
+    """HOST-side split of an f64 (or f64 pair hi+lo) into 4 float32 numpy
+    arrays capturing ~96 bits of the dd value. Must run on host:
+    device-transferred f64s are already rounded to the emulated format.
+
+    Components are peeled from the running double-double remainder so the
+    split stays accurate even when hi and lo have disparate scales (e.g.
+    hi == 0)."""
+    rhi = np.asarray(hi, np.float64).copy()
+    rlo = np.zeros_like(rhi) if lo is None else np.asarray(lo, np.float64).copy()
+    rhi, rlo = _two_sum_np(rhi, rlo)  # normalize: |rlo| <= ulp(rhi)/2
+    comps = []
+    for _ in range(4):
+        c = (rhi + rlo).astype(np.float32)
+        s, e = _two_sum_np(rhi, -c.astype(np.float64))  # exact
+        rhi, rlo = _two_sum_np(s, e + rlo)
+        comps.append(c)
+    return tuple(comps)
+
+
+def qf_from_host(hi, lo=None) -> QF:
+    return QF(*(jnp.asarray(c) for c in qf_split_host(hi, lo)))
+
+
+def qf_from_f64(x: Array) -> QF:
+    """DEVICE-side: lift an f64 (possibly emulated) array into QF. Exactness
+    is limited by the device's f64 representation — use only for quantities
+    that need <= f64-on-device precision (delays, fit deltas), never for the
+    absolute time/phase carriers."""
+    x = jnp.asarray(x)
+    c0 = x.astype(F32)
+    r = x - c0.astype(x.dtype)
+    c1 = r.astype(F32)
+    r2 = r - c1.astype(x.dtype)
+    c2 = r2.astype(F32)
+    z = jnp.zeros_like(c0)
+    return QF(c0, c1, c2, z)
+
+
+def qf_zeros_like(x: Array) -> QF:
+    z = jnp.zeros(jnp.shape(x), F32)
+    return QF(z, z, z, z)
+
+
+def qf_to_f64(x: QF) -> Array:
+    """Collapse to (device) f64 — accurate only for values whose magnitude
+    fits f64-on-device precision (residual fractions, tangents)."""
+    dt = jnp.float64
+    return ((x.d.astype(dt) + x.c.astype(dt)) + x.b.astype(dt)) + x.a.astype(dt)
+
+
+# --- arithmetic ----------------------------------------------------------------
+
+
+def qf_neg(x: QF) -> QF:
+    return QF(-x.a, -x.b, -x.c, -x.d)
+
+
+def qf_add(x: QF, y: QF) -> QF:
+    # pairwise exact sums; all error terms ride to renorm as SEPARATE
+    # components (e0 ~ ulp(s0) can be the same order as s1 — folding it into
+    # a lower bucket with a plain add would round away s2-order information)
+    s0, e0 = two_sum32(x.a, y.a)
+    s1, e1 = two_sum32(x.b, y.b)
+    s2, e2 = two_sum32(x.c, y.c)
+    s3 = x.d + y.d
+    return renorm(s0, s1, e0, s2, e1, s3 + e2)
+
+
+def qf_sub(x: QF, y: QF) -> QF:
+    return qf_add(x, qf_neg(y))
+
+
+def qf_add_f64(x: QF, f: Array) -> QF:
+    """x + f where f is a (device) f64 array — e.g. subtracting delays."""
+    return qf_add(x, qf_from_f64(f))
+
+
+def qf_mul(x: QF, y: QF) -> QF:
+    p0, q00 = two_prod32(x.a, y.a)
+    # order-1 cross terms
+    p1a, e1a = two_prod32(x.a, y.b)
+    p1b, e1b = two_prod32(x.b, y.a)
+    # order-2
+    p2a, e2a = two_prod32(x.a, y.c)
+    p2b, e2b = two_prod32(x.b, y.b)
+    p2c, e2c = two_prod32(x.c, y.a)
+    # order-3 (plain f32; their rounding is ~2^-96 relative)
+    p3 = (
+        x.a * y.d
+        + x.b * y.c
+        + x.c * y.b
+        + x.d * y.a
+        + e2a
+        + e2b
+        + e2c
+    )
+    t1, te1 = two_sum32(p1a, p1b)
+    # q00 (error of the leading product) is order-1; keep it a separate
+    # renorm component rather than folding into the order-2 bucket.
+    # The order-2 bucket must itself be summed exactly: its terms are
+    # ~2^-48-relative, so a plain f32 add would inject ~2^-72 errors — the
+    # two_sum residues are order-3 and ride along with p3.
+    s, f1 = two_sum32(p2a, p2b)
+    s, f2 = two_sum32(s, p2c)
+    s, f3 = two_sum32(s, e1a)
+    s, f4 = two_sum32(s, e1b)
+    t2, f5 = two_sum32(s, te1)
+    p3 = p3 + (((f1 + f2) + (f3 + f4)) + f5)
+    return renorm(p0, t1, q00, t2, p3)
+
+
+def qf_rint(x: QF) -> tuple[Array, QF]:
+    """Split into (nearest-integer pulse number as device f64, QF remainder).
+
+    Three extraction rounds: each pulls the integer part of the current
+    leading component; the remainder is exact. Integer parts are exact in
+    f32 above 2^24 by construction (all large f32s are integers) and below
+    via rint.
+    """
+    n_total = jnp.zeros(jnp.shape(x.a), jnp.float64)
+    cur = x
+    for _ in range(3):
+        n = jnp.rint(cur.a)
+        cur = qf_add(cur, QF(-n, jnp.zeros_like(n), jnp.zeros_like(n), jnp.zeros_like(n)))
+        n_total = n_total + n.astype(jnp.float64)
+    n = jnp.rint(qf_to_f64(cur))
+    cur = qf_add(cur, qf_from_f64(-n))
+    return n_total + n, cur
+
+
+def qf_index(x: QF, idx) -> QF:
+    return QF(x.a[idx], x.b[idx], x.c[idx], x.d[idx])

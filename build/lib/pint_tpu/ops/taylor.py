@@ -1,0 +1,82 @@
+"""Horner evaluation of factorial-scaled Taylor series.
+
+``taylor_horner(x, [c0, c1, c2, c3])`` = c0 + c1 x + c2 x^2/2! + c3 x^3/3!.
+
+This is the spindown-phase kernel (the reference's longdouble
+`pint.utils.taylor_horner`, utils.py:355 — its single hottest numerical
+convention). Here the precision-critical variant runs in double-double: the
+spin frequency term F0*dt with dt ~ 1e9 s and F0 ~ 1e2-1e3 Hz produces ~1e11
+turns that must stay exact to ~1e-9 turns.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Union
+
+import jax.numpy as jnp
+
+from pint_tpu.ops.dd import DD, dd, dd_add, dd_add_fp, dd_mul, dd_mul_fp
+
+Array = jnp.ndarray
+
+_FACT = [1.0]
+for _i in range(1, 40):
+    _FACT.append(_FACT[-1] * _i)
+
+
+def taylor_horner(x: Array, coeffs: Sequence[Array]) -> Array:
+    """float64 Horner sum_i coeffs[i] * x^i / i! (for derivatives and
+    non-critical series)."""
+    if len(coeffs) == 0:
+        return jnp.zeros_like(x)
+    acc = jnp.asarray(coeffs[-1], jnp.float64) / _FACT[len(coeffs) - 1]
+    for i in range(len(coeffs) - 2, -1, -1):
+        acc = acc * x + jnp.asarray(coeffs[i], jnp.float64) / _FACT[i]
+    return jnp.broadcast_to(acc, jnp.shape(x))
+
+
+def taylor_horner_deriv(x: Array, coeffs: Sequence[Array], deriv_order: int = 1) -> Array:
+    """d^n/dx^n of taylor_horner (reference: utils.py:382). The factorial
+    scaling makes this a simple coefficient shift."""
+    if deriv_order == 0:
+        return taylor_horner(x, coeffs)
+    shifted = list(coeffs[deriv_order:])
+    if not shifted:
+        return jnp.zeros_like(x)
+    return taylor_horner(x, shifted)
+
+
+def taylor_horner_x(xp, x, coeffs: Sequence) -> object:
+    """Backend-generic Horner: x and result in xp's extended precision;
+    coefficients may be backend leaves (DD/QF) or plain f64."""
+    if len(coeffs) == 0:
+        return xp.zeros_like(x[0] if hasattr(x, "__getitem__") else x)
+    acc = xp.mul_f(xp.lift(coeffs[-1]), 1.0 / _FACT[len(coeffs) - 1])
+    for i in range(len(coeffs) - 2, -1, -1):
+        acc = xp.mul(acc, x)
+        acc = xp.add(acc, xp.mul_f(xp.lift(coeffs[i]), 1.0 / _FACT[i]))
+    return acc
+
+
+def taylor_horner_dd(x: DD, coeffs: Sequence[Union[Array, DD]]) -> DD:
+    """Double-double Horner: x is DD, coefficients float64 (or DD).
+
+    Each step is acc = acc*x + c_i/i!, fully in dd arithmetic. The factorial
+    division happens in float64 (coefficients are model parameters known to
+    float64 anyway; the *accumulation* is what needs dd).
+    """
+    if len(coeffs) == 0:
+        return dd(jnp.zeros_like(x.hi))
+    last = coeffs[-1]
+    if isinstance(last, DD):
+        acc = dd_mul_fp(last, 1.0 / _FACT[len(coeffs) - 1])
+    else:
+        acc = dd(jnp.asarray(last, jnp.float64) / _FACT[len(coeffs) - 1])
+    for i in range(len(coeffs) - 2, -1, -1):
+        acc = dd_mul(acc, x)
+        c = coeffs[i]
+        if isinstance(c, DD):
+            acc = dd_add(acc, dd_mul_fp(c, 1.0 / _FACT[i]))
+        else:
+            acc = dd_add_fp(acc, jnp.asarray(c, jnp.float64) / _FACT[i])
+    return acc

@@ -1,0 +1,193 @@
+"""Extended-precision backend dispatch for the phase value path.
+
+Two interchangeable arithmetics carry the absolute pulse phase:
+
+- ``dd64`` — double-double over native float64 (ops/dd.py). Correct wherever
+  f64 is true IEEE binary64: CPU (tests, golden runs) and GPUs.
+- ``qf32`` — quad-float32 (ops/qf32.py). Correct on TPUs whose f64 is a
+  non-correctly-rounded software emulation, where error-free transforms over
+  f64 silently break (see ops/qf32.py docstring).
+
+`get_xprec()` auto-selects by the active JAX backend; `TimingModel` threads
+the chosen backend (`xp`) through every phase component, so the same model
+code runs exactly on both. The delay chain stays plain f64 on either backend
+(delays need only ~1e-12 s relative precision).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import importlib
+
+import pint_tpu.ops.qf32 as qfm
+from pint_tpu.ops.dd import DD
+from pint_tpu.ops.qf32 import QF
+
+# the ops package re-exports the dd() constructor, shadowing the submodule
+# attribute — resolve the module explicitly
+ddm = importlib.import_module("pint_tpu.ops.dd")
+
+Array = jnp.ndarray
+
+
+class DD64Prec:
+    """f64 double-double backend (true-IEEE-f64 platforms)."""
+
+    name = "dd64"
+    leaf_type = DD
+
+    # tensor/time
+    def time_from_tensor(self, tensor: dict) -> DD:
+        return DD(tensor["t_hi"], tensor["t_lo"])
+
+    def convert_params(self, params: dict) -> dict:
+        return params
+
+    # arithmetic
+    def from_f64(self, x) -> DD:
+        return ddm.dd(jnp.asarray(x, jnp.float64))
+
+    def zeros_like(self, x: Array) -> DD:
+        return ddm.dd_zeros_like(x)
+
+    def add(self, x: DD, y: DD) -> DD:
+        return ddm.dd_add(x, y)
+
+    def add_f(self, x: DD, f) -> DD:
+        return ddm.dd_add_fp(x, f)
+
+    def sub(self, x: DD, y: DD) -> DD:
+        return ddm.dd_sub(x, y)
+
+    def neg(self, x: DD) -> DD:
+        return ddm.dd_neg(x)
+
+    def mul(self, x: DD, y: DD) -> DD:
+        return ddm.dd_mul(x, y)
+
+    def mul_f(self, x: DD, f) -> DD:
+        return ddm.dd_mul_fp(x, jnp.asarray(f, jnp.float64))
+
+    def rint(self, x: DD):
+        return ddm.dd_rint(x)
+
+    def to_f64(self, x: DD) -> Array:
+        return ddm.dd_to_float(x)
+
+    def index(self, x: DD, idx) -> DD:
+        return DD(x.hi[idx], x.lo[idx])
+
+    def is_x(self, v) -> bool:
+        return isinstance(v, DD)
+
+    def lift(self, v):
+        """Accept a parameter leaf (DD or plain float) into backend form."""
+        return v if isinstance(v, DD) else self.from_f64(v)
+
+
+class QF32Prec:
+    """Quad-float32 backend (TPUs with emulated f64)."""
+
+    name = "qf32"
+    leaf_type = QF
+
+    def time_from_tensor(self, tensor: dict) -> QF:
+        return QF(tensor["t_q0"], tensor["t_q1"], tensor["t_q2"], tensor["t_q3"])
+
+    def convert_params(self, params: dict) -> dict:
+        """HOST-side: split DD leaves into exact 4xf32 components (device
+        transfer would round them first)."""
+        out = {}
+        for k, v in params.items():
+            if isinstance(v, DD):
+                out[k] = qfm.qf_from_host(np.asarray(v.hi), np.asarray(v.lo))
+            else:
+                out[k] = v
+        return out
+
+    def from_f64(self, x) -> QF:
+        return qfm.qf_from_f64(jnp.asarray(x, jnp.float64))
+
+    def zeros_like(self, x: Array) -> QF:
+        return qfm.qf_zeros_like(x)
+
+    def add(self, x: QF, y: QF) -> QF:
+        return qfm.qf_add(x, y)
+
+    def add_f(self, x: QF, f) -> QF:
+        return qfm.qf_add_f64(x, jnp.asarray(f, jnp.float64))
+
+    def sub(self, x: QF, y: QF) -> QF:
+        return qfm.qf_sub(x, y)
+
+    def neg(self, x: QF) -> QF:
+        return qfm.qf_neg(x)
+
+    def mul(self, x: QF, y: QF) -> QF:
+        return qfm.qf_mul(x, y)
+
+    def mul_f(self, x: QF, f) -> QF:
+        if isinstance(f, (int, float)):
+            # static scalar: split exactly on host at trace time
+            return qfm.qf_mul(x, qfm.qf_from_host(np.float64(f)))
+        # traced array multiplicand: lift to QF so f64 factors keep their
+        # full precision (a bare f32 cast would drop ~29 bits silently)
+        return qfm.qf_mul(x, qfm.qf_from_f64(jnp.asarray(f, jnp.float64)))
+
+    def rint(self, x: QF):
+        return qfm.qf_rint(x)
+
+    def to_f64(self, x: QF) -> Array:
+        return qfm.qf_to_f64(x)
+
+    def index(self, x: QF, idx) -> QF:
+        return qfm.qf_index(x, idx)
+
+    def is_x(self, v) -> bool:
+        return isinstance(v, QF)
+
+    def lift(self, v):
+        if isinstance(v, QF):
+            return v
+        if isinstance(v, DD):
+            # device-side DD lift loses sub-f64 bits; params should come
+            # through convert_params instead — this path is a fallback
+            return qfm.qf_add(self.from_f64(v.hi), self.from_f64(v.lo))
+        return self.from_f64(v)
+
+
+def params_to_dd(params: dict) -> dict:
+    """HOST-side: canonicalize any QF leaves back to DD (f64 pairs) — used
+    after fits so model.params stays backend-independent. Exact: adjacent
+    f32 components combine exactly in f64."""
+    out = {}
+    for k, v in params.items():
+        if isinstance(v, QF):
+            a = np.asarray(v.a, np.float64)
+            b = np.asarray(v.b, np.float64)
+            c = np.asarray(v.c, np.float64)
+            d = np.asarray(v.d, np.float64)
+            hi = a + b  # exact: both are f32 values
+            lo = c + d  # exact likewise; |lo| can slightly exceed ulp(hi)/2
+            s = hi + lo  # renormalize via two_sum (host f64 is IEEE)
+            e = (hi - s) + lo
+            out[k] = DD(jnp.asarray(s), jnp.asarray(e))
+        elif isinstance(v, DD):
+            out[k] = DD(jnp.asarray(np.asarray(v.hi)), jnp.asarray(np.asarray(v.lo)))
+        else:
+            out[k] = v
+    return out
+
+
+_BACKENDS = {"dd64": DD64Prec(), "qf32": QF32Prec()}
+
+
+def get_xprec(name: str | None = None):
+    """Select the phase-arithmetic backend: explicit name, else qf32 on TPU
+    backends (whose f64 is emulated), dd64 elsewhere."""
+    if name is not None:
+        return _BACKENDS[name]
+    return _BACKENDS["qf32" if jax.default_backend() == "tpu" else "dd64"]

@@ -1,0 +1,113 @@
+"""Plotting helpers: residual plots and photon phaseograms.
+
+Reference: pint/plot_utils.py (phaseogram:25, phaseogram_binned,
+plot_priors). Matplotlib with the Agg backend; every function accepts an
+existing axis or writes a file.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def _finish(fig, outfile):
+    if outfile and fig is not None:
+        fig.savefig(outfile)
+        import matplotlib.pyplot as plt
+
+        plt.close(fig)
+
+
+def _axes(ax=None):
+    import matplotlib
+
+    if ax is not None:
+        return ax, None
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    fig, ax = plt.subplots(figsize=(8, 6))
+    return ax, fig
+
+
+def phaseogram(mjds, phases, weights=None, bins: int = 64, rotate: float = 0.0,
+               ax=None, outfile: str | None = None, title: str | None = None):
+    """2D photon phase vs time histogram, phases doubled over [0, 2)
+    (reference phaseogram:25)."""
+    ax, fig = _axes(ax)
+    ph = np.mod(np.asarray(phases) + rotate, 1.0)
+    ph2 = np.concatenate([ph, ph + 1.0])
+    t2 = np.concatenate([mjds, mjds])
+    w2 = None if weights is None else np.concatenate([weights, weights])
+    ntbins = max(10, int(len(mjds) ** 0.5 / 2))
+    h, xe, ye = np.histogram2d(ph2, t2, bins=[2 * bins, ntbins], weights=w2)
+    ax.imshow(
+        h.T, origin="lower", aspect="auto", cmap="Greys",
+        extent=[0, 2, float(np.min(mjds)), float(np.max(mjds))],
+        interpolation="nearest",
+    )
+    ax.set_xlabel("Pulse phase")
+    ax.set_ylabel("MJD")
+    if title:
+        ax.set_title(title)
+    _finish(fig, outfile)
+    return ax
+
+
+def profile_plot(phases, weights=None, bins: int = 64, ax=None,
+                 outfile: str | None = None, template=None):
+    """Folded pulse profile histogram (two cycles), optional template
+    overlay."""
+    ax, fig = _axes(ax)
+    ph = np.mod(np.asarray(phases), 1.0)
+    h, edges = np.histogram(ph, bins=bins, range=(0, 1), weights=weights)
+    centers = 0.5 * (edges[:-1] + edges[1:])
+    x = np.concatenate([centers, centers + 1.0])
+    y = np.concatenate([h, h])
+    ax.step(x, y, where="mid", color="k")
+    if template is not None:
+        scale = np.mean(h) / np.mean(template(centers))
+        xt = np.linspace(0, 2, 512)
+        ax.plot(xt, template(xt) * scale, "r-", alpha=0.7)
+    ax.set_xlabel("Pulse phase")
+    ax.set_ylabel("Counts / bin")
+    _finish(fig, outfile)
+    return ax
+
+
+def plot_residuals_time(fitter, ax=None, outfile: str | None = None):
+    """Residuals vs MJD with error bars (reference pintempo plot)."""
+    ax, fig = _axes(ax)
+    toas = fitter.toas
+    res = fitter.resids.toa if hasattr(fitter.resids, "toa") else fitter.resids
+    mjd = toas.tdb.mjd_float()
+    ax.errorbar(
+        mjd, np.asarray(res.time_resids) * 1e6,
+        yerr=np.asarray(res.errors_s) * 1e6, fmt=".", alpha=0.7,
+    )
+    ax.axhline(0, color="k", lw=0.5)
+    ax.set_xlabel("MJD")
+    ax.set_ylabel("Residual (us)")
+    ax.set_title(fitter.model.psr_name)
+    _finish(fig, outfile)
+    return ax
+
+
+def plot_residuals_orbit(fitter, ax=None, outfile: str | None = None):
+    """Residuals vs orbital phase for binary models."""
+    from pint_tpu.models.base import leaf_to_f64
+
+    ax, fig = _axes(ax)
+    m = fitter.model
+    pb_s = float(np.asarray(leaf_to_f64(m.params["PB"])))
+    res = fitter.resids.toa if hasattr(fitter.resids, "toa") else fitter.resids
+    mjd = fitter.toas.tdb.mjd_float()
+    phase = np.mod(mjd * 86400.0 / pb_s, 1.0)
+    ax.errorbar(
+        phase, np.asarray(res.time_resids) * 1e6,
+        yerr=np.asarray(res.errors_s) * 1e6, fmt=".", alpha=0.7,
+    )
+    ax.set_xlabel("Orbital phase")
+    ax.set_ylabel("Residual (us)")
+    _finish(fig, outfile)
+    return ax

@@ -1,0 +1,242 @@
+"""Polycos: piecewise polynomial pulse-phase predictors (TEMPO format).
+
+Reference: pint/polycos.py (Polycos:677 — generate_polycos, TEMPO
+polyco.dat read/write, phase/frequency evaluation). Convention (TEMPO):
+
+    DT = (t - TMID) [minutes]
+    phase(t) = RPHASE + 60 DT F0 + sum_i COEFF[i] DT^i
+    f(t) [Hz] = F0 + (1/60) sum_i i COEFF[i] DT^(i-1)
+
+Generation evaluates the full timing model's TZR-anchored absolute phase at
+Chebyshev-spaced nodes per segment (one prepared-TOAs pipeline call for ALL
+segments at once) and least-squares fits the residual polynomial.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from pint_tpu.residuals import Residuals
+from pint_tpu.utils.logging import get_logger
+
+log = get_logger("pint_tpu.polycos")
+
+
+@dataclass
+class PolycoEntry:
+    psr: str
+    tmid_mjd: float
+    rphase_int: int
+    rphase_frac: float
+    f0: float
+    obs: str
+    span_min: float
+    coeffs: np.ndarray  # (ncoeff,)
+    freq_mhz: float
+    dm: float = 0.0
+
+    def covers(self, mjd) -> np.ndarray:
+        dt_min = (np.asarray(mjd) - self.tmid_mjd) * 1440.0
+        return np.abs(dt_min) <= self.span_min / 2.0
+
+    def phase(self, mjd) -> np.ndarray:
+        """Absolute phase (turns, relative to the generation's reference)."""
+        dt = (np.asarray(mjd, np.longdouble) - np.longdouble(self.tmid_mjd)) * 1440.0
+        poly = np.polynomial.polynomial.polyval(
+            np.asarray(dt, float), self.coeffs
+        )
+        return (
+            np.longdouble(self.rphase_int)
+            + np.longdouble(self.rphase_frac)
+            + 60.0 * dt * np.longdouble(self.f0)
+            + poly
+        )
+
+    def frequency(self, mjd) -> np.ndarray:
+        """Apparent spin frequency [Hz]."""
+        dt = (np.asarray(mjd, float) - self.tmid_mjd) * 1440.0
+        dcoef = self.coeffs[1:] * np.arange(1, len(self.coeffs))
+        return self.f0 + np.polynomial.polynomial.polyval(dt, dcoef) / 60.0
+
+
+@dataclass
+class Polycos:
+    entries: list[PolycoEntry] = field(default_factory=list)
+
+    def find_entry(self, mjd: float) -> PolycoEntry:
+        best, best_dt = None, np.inf
+        for e in self.entries:
+            dt = abs(mjd - e.tmid_mjd) * 1440.0
+            if dt <= e.span_min / 2.0 + 1e-6 and dt < best_dt:
+                best, best_dt = e, dt
+        if best is None:
+            raise ValueError(f"no polyco entry covers MJD {mjd}")
+        return best
+
+    def eval_abs_phase(self, mjd) -> np.ndarray:
+        mjd = np.atleast_1d(np.asarray(mjd, float))
+        return np.array([self.find_entry(m).phase(m) for m in mjd])
+
+    def eval_spin_freq(self, mjd) -> np.ndarray:
+        mjd = np.atleast_1d(np.asarray(mjd, float))
+        return np.array([self.find_entry(m).frequency(m) for m in mjd])
+
+    # --- generation ----------------------------------------------------------------
+
+    @classmethod
+    def generate_polycos(
+        cls,
+        model,
+        mjd_start: float,
+        mjd_end: float,
+        obs: str = "geocenter",
+        seg_length_min: float = 60.0,
+        ncoeff: int = 12,
+        obs_freq_mhz: float = 1400.0,
+        nodes_per_seg: int | None = None,
+    ) -> "Polycos":
+        """Fit polyco segments to the full model (reference
+        generate_polycos, polycos.py:677)."""
+        from pint_tpu.astro import time as ptime
+        from pint_tpu.toas import prepare_arrays
+
+        nseg = max(1, int(np.ceil((mjd_end - mjd_start) * 1440.0 / seg_length_min)))
+        nn = nodes_per_seg or max(2 * ncoeff, 24)
+        seg_len_d = seg_length_min / 1440.0
+        # Chebyshev-spaced nodes in every segment, one prep pipeline call
+        k = np.arange(nn)
+        cheb = np.cos(np.pi * (2 * k + 1) / (2 * nn))[::-1]  # (-1,1)
+        tmids = mjd_start + (np.arange(nseg) + 0.5) * seg_len_d
+        mjds = (tmids[:, None] + cheb[None, :] * seg_len_d / 2.0).ravel()
+        utc = ptime.MJDEpoch.from_mjd_float(mjds)
+        n = mjds.size
+        toas = prepare_arrays(
+            utc,
+            np.full(n, 1.0),
+            np.full(n, obs_freq_mhz),
+            np.array([obs] * n),
+            ephem=model.ephem or "auto",
+            planets=bool(model.planet_shapiro),
+        )
+        r = Residuals(toas, model, subtract_mean=False, track_mode="nearest")
+        # absolute (TZR-anchored) phase = integer pulse number + fractional
+        pn = r.pulse_numbers
+        frac = r.phase_resids
+        from pint_tpu.models.base import leaf_to_f64
+
+        f0 = float(np.asarray(leaf_to_f64(model.params["F0"])))
+        dm = float(np.asarray(leaf_to_f64(model.params.get("DM", 0.0))))
+        entries = []
+        for s in range(nseg):
+            sl = slice(s * nn, (s + 1) * nn)
+            tmid = tmids[s]
+            dt_min = (mjds[sl] - tmid) * 1440.0
+            phase = np.asarray(pn[sl], np.longdouble) + np.asarray(frac[sl], np.longdouble)
+            # reference phase at TMID: nearest integer of the node-mean trend
+            base = phase - 60.0 * np.asarray(dt_min, np.longdouble) * np.longdouble(f0)
+            rphase_int = int(np.floor(float(np.mean(base))))
+            resid = np.asarray(base - rphase_int, float)
+            # fit in u = dt/(span/2) in [-1,1] for conditioning, then
+            # rescale to the TEMPO dt-minutes basis
+            half = seg_length_min / 2.0
+            V = np.vander(dt_min / half, ncoeff, increasing=True)
+            cu, *_ = np.linalg.lstsq(V, resid, rcond=None)
+            coeffs = cu / half ** np.arange(ncoeff)
+            # fold the constant into RPHASE (TEMPO convention)
+            rphase_frac = float(coeffs[0] % 1.0)
+            rphase_int += int(np.floor(coeffs[0]))
+            coeffs[0] = 0.0
+            entries.append(
+                PolycoEntry(
+                    psr=model.psr_name or "PSR",
+                    tmid_mjd=float(tmid),
+                    rphase_int=rphase_int,
+                    rphase_frac=rphase_frac,
+                    f0=f0,
+                    obs=obs,
+                    span_min=seg_length_min,
+                    coeffs=coeffs,
+                    freq_mhz=obs_freq_mhz,
+                    dm=dm,
+                )
+            )
+        pc = cls(entries)
+        # report worst fit error
+        worst = pc._check(model_phase=(pn, frac, mjds), nn=nn)
+        log.info(
+            f"generated {nseg} polyco segments ({seg_length_min} min, "
+            f"{ncoeff} coeffs); worst node error {worst:.2e} turns"
+        )
+        return pc
+
+    def _check(self, model_phase, nn: int) -> float:
+        pn, frac, mjds = model_phase
+        worst = 0.0
+        for s, e in enumerate(self.entries):
+            sl = slice(s * nn, (s + 1) * nn)
+            pred = e.phase(mjds[sl])
+            truth = np.asarray(pn[sl], np.longdouble) + np.asarray(frac[sl], np.longdouble)
+            worst = max(worst, float(np.max(np.abs(np.asarray(pred - truth, float)))))
+        return worst
+
+    # --- TEMPO polyco.dat IO --------------------------------------------------------
+
+    def write(self, path: str) -> None:
+        """TEMPO polyco.dat format (reference polycos.py tempo writer)."""
+        with open(path, "w") as f:
+            for e in self.entries:
+                f.write(
+                    f"{e.psr:<12s} {'---':>9s} {'0.00':>10s} "
+                    f"{e.tmid_mjd:.11f} {e.dm:.6f} 0.000 0.000\n"
+                )
+                rphase = f"{e.rphase_int + e.rphase_frac:.6f}"
+                f.write(
+                    f"{rphase:>20s} {e.f0:18.12f} {e.obs:>5s}"
+                    f" {int(e.span_min):5d} {len(e.coeffs):5d}"
+                    f" {e.freq_mhz:10.3f}\n"
+                )
+                for i in range(0, len(e.coeffs), 3):
+                    f.write(
+                        "".join(f"{c:25.17e}" for c in e.coeffs[i : i + 3]) + "\n"
+                    )
+
+    @classmethod
+    def read(cls, path: str) -> "Polycos":
+        """Parse a TEMPO polyco.dat (reference polycos.py tempo_polyco_table_reader)."""
+        entries = []
+        with open(path) as f:
+            lines = [ln.rstrip("\n") for ln in f if ln.strip()]
+        i = 0
+        while i < len(lines):
+            h1 = lines[i].split()
+            psr = h1[0]
+            tmid = float(h1[3])
+            dm = float(h1[4]) if len(h1) > 4 else 0.0
+            h2 = lines[i + 1]
+            parts = h2.split()
+            rphase_s = parts[0]
+            rphase_int = int(float(rphase_s) // 1)
+            rphase_frac = float(rphase_s) - rphase_int
+            f0 = float(parts[1])
+            obs = parts[2]
+            span = float(parts[3])
+            ncoeff = int(parts[4])
+            freq = float(parts[5]) if len(parts) > 5 else 0.0
+            ncl = (ncoeff + 2) // 3
+            coeffs = []
+            for j in range(ncl):
+                coeffs.extend(
+                    float(x.replace("D", "e").replace("d", "e"))
+                    for x in lines[i + 2 + j].split()
+                )
+            entries.append(
+                PolycoEntry(
+                    psr=psr, tmid_mjd=tmid, rphase_int=rphase_int,
+                    rphase_frac=rphase_frac, f0=f0, obs=obs, span_min=span,
+                    coeffs=np.asarray(coeffs[:ncoeff]), freq_mhz=freq, dm=dm,
+                )
+            )
+            i += 2 + ncl
+        return cls(entries)

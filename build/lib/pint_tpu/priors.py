@@ -1,0 +1,49 @@
+"""Parameter priors for Bayesian timing.
+
+Reference: pint/models/priors.py (Prior:1, UniformUnboundedRV,
+UniformBoundedRV, GaussianRV usage in bayesian.py/mcmc_fitter.py). The TPU
+design keeps priors as tiny dataclasses whose logpdf is pure jnp — they
+compose directly into the jitted ln-posterior.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax.numpy as jnp
+import numpy as np
+
+Array = jnp.ndarray
+
+
+@dataclass(frozen=True)
+class UniformPrior:
+    """Flat within [lo, hi] (improper/unbounded when lo/hi infinite)."""
+
+    lo: float = -np.inf
+    hi: float = np.inf
+
+    def logpdf(self, x):
+        inside = (x >= self.lo) & (x <= self.hi)
+        width = self.hi - self.lo
+        norm = -jnp.log(width) if np.isfinite(width) else 0.0
+        return jnp.where(inside, norm, -jnp.inf)
+
+
+@dataclass(frozen=True)
+class NormalPrior:
+    mu: float
+    sigma: float
+
+    def logpdf(self, x):
+        z = (x - self.mu) / self.sigma
+        return -0.5 * z * z - jnp.log(self.sigma) - 0.5 * jnp.log(2 * jnp.pi)
+
+
+def default_prior(value: float, uncertainty: float | None, nsigma: float = 100.0):
+    """Reference bayesian.py default: uniform, centered on the parfile
+    value, spanning +-nsigma parfile uncertainties (unbounded when the
+    parfile gives no uncertainty)."""
+    if uncertainty is None or uncertainty == 0.0:
+        return UniformPrior()
+    return UniformPrior(value - nsigma * uncertainty, value + nsigma * uncertainty)

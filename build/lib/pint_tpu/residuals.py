@@ -1,0 +1,315 @@
+"""Residuals: phase/time residuals, pulse-number tracking, chi^2.
+
+Reference: pint/residuals.py (Residuals:30, calc_phase_resids:299,
+calc_time_resids:427, calc_chi2:470). The device-side core is a pure function
+(`phase_residuals`) over (params, tensor); the `Residuals` class is a thin
+host wrapper holding the model/TOAs pair and cached jitted callables.
+
+Tracking modes (reference residuals.py:119-135):
+- "nearest": residual is the DD fractional part of the TZR-anchored phase
+  (each TOA attaches to its nearest integer pulse);
+- "use_pulse_numbers": residual is phase minus the recorded pulse-number
+  column (TOAs with -pn flags / compute_pulse_numbers), catching phase wraps.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pint_tpu.models.timing_model import TimingModel
+
+Array = jnp.ndarray
+
+
+def phase_residual_frac(
+    model: TimingModel,
+    params: dict,
+    tensor: dict,
+    track_pn: Array | None = None,
+    delta_pn: Array | None = None,
+    subtract_mean: bool = True,
+    weights: Array | None = None,
+    xp=None,
+) -> tuple[Array, Array, Array]:
+    """Pure: -> (pulse_number, frac_phase_residual f64 turns, spin freq Hz).
+
+    With `track_pn` given (use_pulse_numbers mode) the residual is
+    phase - track_pn (+delta), otherwise the nearest-integer fractional part.
+    The spin frequency rides along from the same delay-chain evaluation.
+    `xp` overrides the model's extended-precision backend for THIS evaluation
+    (parity cross-checks) without mutating model state.
+    """
+    xp = xp or model.xprec
+    ph, f = model.phase_and_freq(params, tensor, xp)
+    if delta_pn is not None:
+        ph = xp.add_f(ph, delta_pn)
+    if track_pn is not None:
+        r = xp.to_f64(xp.add_f(ph, -track_pn))
+        pn = track_pn
+    else:
+        pn, frac = xp.rint(ph)
+        r = xp.to_f64(frac)
+    if subtract_mean and not model.has_phase_offset:
+        if weights is None:
+            r = r - jnp.mean(r)
+        else:
+            r = r - jnp.sum(r * weights) / jnp.sum(weights)
+    return pn, r, f
+
+
+def get_resid_fn(model: TimingModel, subtract_mean: bool):
+    """Jitted (params, tensor, track_pn, delta_pn, weights) -> (pn, r_phase,
+    r_time), cached on the model so repeated Residuals construction (downhill
+    loops, zero_residuals iterations, grids) never retraces."""
+    cache = model.__dict__.setdefault("_resid_fn_cache", {})
+    key = (subtract_mean, model.xprec.name)
+    if key not in cache:
+
+        def fn(params, tensor, track_pn, delta_pn, weights):
+            pn, r, f = phase_residual_frac(
+                model,
+                params,
+                tensor,
+                track_pn=track_pn,
+                delta_pn=delta_pn,
+                subtract_mean=subtract_mean,
+                weights=weights,
+            )
+            return pn, r, r / f
+
+        from pint_tpu.ops.compile import precision_jit
+
+        cache[key] = precision_jit(fn)
+    return cache[key]
+
+
+class Residuals:
+    """Host wrapper: residuals of a model against prepared TOAs."""
+
+    def __init__(
+        self,
+        toas,
+        model: TimingModel,
+        tensor: dict | None = None,
+        track_mode: str | None = None,
+        subtract_mean: bool = True,
+    ):
+        self.toas = toas
+        self.model = model
+        self.tensor = tensor if tensor is not None else model.build_tensor(toas)
+        if track_mode is None:
+            # reference: TRACK -2 in the model selects pulse-number tracking
+            track_mode = (
+                "use_pulse_numbers" if model.meta.get("TRACK") == "-2" else "nearest"
+            )
+        self.track_mode = track_mode
+        self.subtract_mean = subtract_mean
+
+        pn = toas.get_pulse_numbers()
+        self._track_pn = None
+        if track_mode == "use_pulse_numbers":
+            if pn is None:
+                raise ValueError("track_mode=use_pulse_numbers but TOAs have no pulse numbers")
+            self._track_pn = jnp.asarray(pn)
+        tens = toas.tensor()
+        self._delta_pn = (
+            jnp.asarray(tens.delta_pulse_number) if tens.delta_pulse_number is not None else None
+        )
+        # 1/error^2 weights over the DATA rows (tensor may carry a TZR row).
+        # With noise components the sigmas are EFAC/EQUAD-rescaled (treated
+        # as fixed inputs to the least-squares fits, like the reference).
+        self.raw_errors_s = np.asarray(tens.error_s)
+        if model.noise_components:
+            sigma = model.scaled_sigma(model.params, self.tensor)
+            self.errors_s = np.asarray(sigma)
+        else:
+            self.errors_s = self.raw_errors_s
+        # photon-event TOAs carry zero error: weight them equally rather
+        # than dividing by zero (their residual use is phase folding)
+        if np.all(self.errors_s == 0):
+            self._weights = jnp.ones(len(self.errors_s))
+        else:
+            with np.errstate(divide="ignore"):
+                w = np.where(self.errors_s > 0, 1.0 / self.errors_s**2, 0.0)
+            self._weights = jnp.asarray(w)
+
+        self._jitted = get_resid_fn(model, subtract_mean)
+        self._cache = None
+
+    def _phase_resids_pure(self, params, tensor):
+        """Unjitted pure core, for embedding into fitter autodiff."""
+        pn, r, f = phase_residual_frac(
+            self.model,
+            params,
+            tensor,
+            track_pn=self._track_pn,
+            delta_pn=self._delta_pn,
+            subtract_mean=self.subtract_mean,
+            weights=self._weights,
+        )
+        return pn, r, r / f
+
+    def _phase_fn(self, params, tensor):
+        params = self.model.xprec.convert_params(params)
+        return self._jitted(params, tensor, self._track_pn, self._delta_pn, self._weights)
+
+    # --- cached views ------------------------------------------------------------
+
+    def _compute(self):
+        if self._cache is None:
+            pn, rphase, rtime = self._phase_fn(self.model.params, self.tensor)
+            self._cache = (np.asarray(pn), np.asarray(rphase), np.asarray(rtime))
+        return self._cache
+
+    def update(self):
+        self._cache = None
+
+    @property
+    def pulse_numbers(self) -> np.ndarray:
+        return self._compute()[0]
+
+    @property
+    def phase_resids(self) -> np.ndarray:
+        """Fractional phase residuals (turns)."""
+        return self._compute()[1]
+
+    @property
+    def time_resids(self) -> np.ndarray:
+        """Time residuals in seconds (phase / instantaneous f)."""
+        return self._compute()[2]
+
+    @property
+    def time_resids_us(self) -> np.ndarray:
+        return self.time_resids * 1e6
+
+    def rms_weighted(self) -> float:
+        """Weighted RMS of time residuals, seconds (reference
+        Residuals.rms_weighted)."""
+        r = self.time_resids
+        w = 1.0 / self.errors_s**2
+        mean = np.sum(r * w) / np.sum(w)
+        return float(np.sqrt(np.sum(w * (r - mean) ** 2) / np.sum(w)))
+
+    def calc_chi2(self) -> float:
+        """Chi^2 of the residuals: white (scaled sigmas) normally, the
+        generalized (correlated-noise marginalized) form when the model has
+        correlated components (reference residuals.py calc_chi2:470, which
+        likewise dispatches on correlated errors)."""
+        if self.model.has_correlated_errors:
+            from pint_tpu.fitting.gls import gls_chi2
+
+            return gls_chi2(self)
+        r = self.time_resids
+        return float(np.sum((r / self.errors_s) ** 2))
+
+    @property
+    def dof(self) -> int:
+        n = len(self.errors_s) - len(self.model.free_params)
+        if self.subtract_mean and not self.model.has_phase_offset:
+            n -= 1
+        return n
+
+    def ecorr_average(self, use_noise_model: bool = True) -> dict:
+        """Epoch-averaged residuals over the ECORR time-binning (reference
+        Residuals.ecorr_average, residuals.py:524) — the NANOGrav summary-
+        plot representation.
+
+        Returns a dict with 'mjds', 'freqs', 'time_resids' (weighted
+        averages per epoch), 'errors' (sqrt(1/sum w + ECORR^2) when
+        `use_noise_model`, raw-weight errors otherwise) and 'indices'
+        (TOA index lists per epoch). TOAs outside every ECORR epoch are
+        excluded, exactly like the reference's U-matrix projection.
+        """
+        from pint_tpu.models.base import leaf_to_f64
+
+        comps = [c for c in self.model.noise_components
+                 if c.category == "ecorr_noise"]
+        if not comps:
+            raise ValueError("ECORR not present in noise model")
+        n = len(self.raw_errors_s)  # data rows (tensor may add a TZR row)
+        eidx = np.asarray(self.tensor["ecorr_eidx"])[:n].astype(int)
+        widx = np.asarray(self.tensor["ecorr_widx"])[0].astype(int)
+        ke = widx.size
+        if ke == 0:
+            raise ValueError("no ECORR epoch has >= 2 selected TOAs")
+        vals = np.array([
+            float(np.asarray(leaf_to_f64(self.model.params[mp.name])))
+            for mp in comps[0].mask_params
+        ])
+        ecorr_err2 = vals[widx] ** 2 if use_noise_model else np.zeros(ke)
+
+        err = self.errors_s if use_noise_model else self.raw_errors_s
+        err = np.asarray(err)[:n]
+        sel = eidx >= 0
+        wt = np.where(sel, 1.0 / err**2, 0.0)
+        idx = np.where(sel, eidx, 0)
+        a_norm = np.bincount(idx, weights=wt, minlength=ke)
+
+        def wtsum(x):
+            return np.bincount(idx, weights=wt * np.asarray(x)[:n],
+                               minlength=ke) / a_norm
+
+        return {
+            "mjds": wtsum(self.toas.tdb.mjd_float()),
+            "freqs": wtsum(self.toas.freq_mhz),
+            "time_resids": wtsum(self.time_resids),
+            "errors": np.sqrt(1.0 / a_norm + ecorr_err2),
+            "indices": [np.flatnonzero(eidx == i) for i in range(ke)],
+        }
+
+    @property
+    def reduced_chi2(self) -> float:
+        return self.calc_chi2() / self.dof
+
+
+class WidebandTOAResiduals:
+    """Combined TOA + wideband-DM residuals (reference residuals.py:590
+    WidebandDMResiduals + :835 CombinedResiduals/WidebandTOAResiduals).
+
+    The DM block is dm_data − total_dm(model) with DMEFAC/DMEQUAD-scaled
+    uncertainties; chi^2 adds the two blocks."""
+
+    def __init__(self, toas, model, tensor: dict | None = None, **toa_kwargs):
+        self.toa = Residuals(toas, model, tensor=tensor, **toa_kwargs)
+        self.toas = toas
+        self.model = model
+        self.tensor = self.toa.tensor
+        if "wb_dm" not in self.tensor:
+            raise ValueError("TOAs carry no -pp_dm wideband DM measurements")
+        params = model.xprec.convert_params(model.params)
+        sl = slice(None, -1) if model.has_abs_phase else slice(None)
+        self.dm_data = np.asarray(self.tensor["wb_dm"][sl])
+        self.dm_errors = np.asarray(model.scaled_dm_sigma(params, self.tensor))
+
+    @property
+    def errors_s(self) -> np.ndarray:
+        return self.toa.errors_s
+
+    @property
+    def dm_resids(self) -> np.ndarray:
+        params = self.model.xprec.convert_params(self.model.params)
+        return self.dm_data - np.asarray(self.model.total_dm(params, self.tensor))
+
+    @property
+    def time_resids(self) -> np.ndarray:
+        return self.toa.time_resids
+
+    def calc_chi2(self) -> float:
+        w = np.where(np.isfinite(self.dm_errors), 1.0 / self.dm_errors**2, 0.0)
+        return self.toa.calc_chi2() + float(np.sum(w * self.dm_resids**2))
+
+    def rms_weighted(self) -> float:
+        return self.toa.rms_weighted()
+
+    @property
+    def dof(self) -> int:
+        n_dm = int(np.sum(np.isfinite(self.dm_errors)))
+        return self.toa.dof + n_dm
+
+    @property
+    def reduced_chi2(self) -> float:
+        return self.calc_chi2() / self.dof

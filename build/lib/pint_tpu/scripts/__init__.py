@@ -1,0 +1,2 @@
+"""Command-line tools (reference pint/scripts/: pintempo, zima, pintbary,
+tcb2tdb, dmxparse, ...). Each module exposes main(argv=None)."""

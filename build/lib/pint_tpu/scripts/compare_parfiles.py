@@ -1,0 +1,29 @@
+"""Compare two parfiles parameter by parameter.
+
+Reference: pint/scripts/compare_parfiles.py (wraps TimingModel.compare).
+"""
+
+from __future__ import annotations
+
+import argparse
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(prog="compare_parfiles",
+                                 description="Compare two timing models")
+    ap.add_argument("par1")
+    ap.add_argument("par2")
+    ap.add_argument("--sigma", type=float, default=3.0,
+                    help="flag differences above this many sigma")
+    args = ap.parse_args(argv)
+
+    from pint_tpu.models.builder import get_model
+
+    m1 = get_model(args.par1)
+    m2 = get_model(args.par2)
+    print(m1.compare(m2, sigma=args.sigma))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

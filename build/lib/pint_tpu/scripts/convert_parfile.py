@@ -1,0 +1,57 @@
+"""Convert a par file: binary parameterization and/or frame.
+
+Reference: pint/scripts/convert_parfile.py — read a model, optionally
+convert the binary type (pint_tpu/binaryconvert.py) or the astrometry
+frame (as_ECL/as_ICRS), and write the result back out.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="convert_parfile",
+        description="Convert a par file's binary model and/or frame",
+    )
+    ap.add_argument("input", help="input par file")
+    ap.add_argument("-o", "--out", help="output par file (default stdout)")
+    ap.add_argument(
+        "-b", "--binary",
+        choices=["BT", "DD", "DDS", "DDK", "ELL1", "ELL1H", "ELL1K"],
+        help="convert the binary model to this parameterization",
+    )
+    ap.add_argument("--kom", type=float, default=0.0,
+                    help="KOM (deg) to seed a DDK conversion")
+    ap.add_argument("--frame", choices=["ecl", "icrs"],
+                    help="convert the astrometry frame")
+    ap.add_argument("--allow-tcb", action="store_true",
+                    help="accept (and convert) a UNITS TCB par file")
+    args = ap.parse_args(argv)
+
+    from pint_tpu.models.builder import get_model
+
+    model = get_model(args.input, allow_tcb=args.allow_tcb)
+    if args.binary:
+        from pint_tpu.binaryconvert import convert_binary
+
+        convert_binary(model, args.binary, kom_deg=args.kom)
+    if args.frame == "ecl":
+        model = model.as_ECL()
+    elif args.frame == "icrs":
+        model = model.as_ICRS()
+
+    text = model.as_parfile()
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(text)
+        print(f"wrote {args.out}", file=sys.stderr)
+    else:
+        sys.stdout.write(text)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,31 @@
+"""Print the fitted DMX time series (reference pint/scripts/dmxparse.py)."""
+
+from __future__ import annotations
+
+import argparse
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(prog="dmxparse", description="DMX time series from a fit")
+    ap.add_argument("parfile")
+    ap.add_argument("timfile")
+    args = ap.parse_args(argv)
+
+    from pint_tpu.dmxutils import dmxparse
+    from pint_tpu.fitting import fit_auto
+    from pint_tpu.models.builder import get_model_and_toas
+
+    model, toas = get_model_and_toas(args.parfile, args.timfile)
+    ftr = fit_auto(toas, model)
+    ftr.fit_toas()
+    out = dmxparse(ftr)
+    print(f"# mean DMX = {out['mean_dmx']:.6e}")
+    print("# epoch_mjd  dmx  err  r1  r2")
+    for e, v, ve, r1, r2 in zip(out["dmx_epochs"], out["dmxs"], out["dmx_verrs"],
+                                out["r1s"], out["r2s"]):
+        print(f"{e:.4f} {v:+.6e} {ve:.3e} {r1:.2f} {r2:.2f}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,53 @@
+"""Fermi-LAT photon phases: weighted H-test and phaseogram.
+
+Reference: pint/scripts/fermiphase.py (load FT1 with weights, compute
+phases, H-test, optional plot/FITS phase column).
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import numpy as np
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(prog="fermiphase",
+                                 description="Phase-fold Fermi-LAT photons")
+    ap.add_argument("ft1")
+    ap.add_argument("parfile")
+    ap.add_argument("weightcol", help="FT1 weight column name (or 'NONE')")
+    ap.add_argument("--minweight", type=float, default=0.0)
+    ap.add_argument("--plotfile", help="save a phaseogram")
+    ap.add_argument("--outfile", help="write phases as text")
+    args = ap.parse_args(argv)
+
+    from pint_tpu.event_toas import (
+        compute_event_phases,
+        get_event_weights,
+        load_Fermi_TOAs,
+    )
+    from pint_tpu.eventstats import h_sig, hm, hmw, sig2sigma
+    from pint_tpu.models.builder import get_model
+
+    model = get_model(args.parfile)
+    wc = None if args.weightcol.upper() == "NONE" else args.weightcol
+    toas = load_Fermi_TOAs(args.ft1, weightcolumn=wc, minweight=args.minweight,
+                           planets=bool(model.planet_shapiro))
+    print(f"Read {len(toas)} photons")
+    phases = compute_event_phases(toas, model)
+    w = get_event_weights(toas)
+    h = hm(phases) if w is None else hmw(phases, w)
+    print(f"Htest : {h:.2f} ({sig2sigma(h_sig(h)):.2f} sigma)")
+    if args.plotfile:
+        from pint_tpu.plot_utils import phaseogram
+
+        phaseogram(toas.tdb.mjd_float(), phases, weights=w, outfile=args.plotfile)
+        print(f"wrote {args.plotfile}")
+    if args.outfile:
+        np.savetxt(args.outfile, phases, fmt="%.9f")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

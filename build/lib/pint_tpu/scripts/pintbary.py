@@ -1,0 +1,53 @@
+"""Barycenter arbitrary times: topocentric MJD -> TDB @ SSB.
+
+Reference: pint/scripts/pintbary.py (time scale conversion + Roemer/Shapiro
+to the barycenter for a given sky position).
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import numpy as np
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(prog="pintbary", description="Barycenter times")
+    ap.add_argument("mjd", type=float, nargs="+", help="UTC MJD(s)")
+    ap.add_argument("--obs", default="geocenter")
+    ap.add_argument("--ra", required=True, help="hh:mm:ss.s")
+    ap.add_argument("--dec", required=True, help="dd:mm:ss.s")
+    ap.add_argument("--freq", type=float, default=np.inf, help="MHz")
+    ap.add_argument("--dm", type=float, default=0.0)
+    args = ap.parse_args(argv)
+
+    from pint_tpu.models.builder import build_model
+    from pint_tpu.io.par import parse_parfile
+    from pint_tpu.residuals import Residuals
+    from pint_tpu.astro import time as ptime
+    from pint_tpu.toas import prepare_arrays
+
+    par = (
+        f"PSR BARY\nRAJ {args.ra}\nDECJ {args.dec}\nF0 1.0\nPEPOCH 55000\n"
+        + (f"DM {args.dm}\n" if args.dm else "")
+    )
+    model = build_model(parse_parfile(par, from_text=True))
+    mjds = np.asarray(args.mjd, float)
+    utc = ptime.MJDEpoch.from_mjd_float(mjds)
+    n = mjds.size
+    toas = prepare_arrays(
+        utc, np.full(n, 1.0), np.full(n, args.freq), np.array([args.obs] * n),
+        ephem="auto", planets=False,
+    )
+    tensor = model.build_tensor(toas)
+    params = model.xprec.convert_params(model.params)
+    delay = np.asarray(model.delay(params, tensor))
+    tdb = toas.tdb.mjd_float()
+    bat = tdb - delay / 86400.0
+    for m, b in zip(mjds, bat):
+        print(f"{m:.10f} -> BAT {b:.15f}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,75 @@
+"""Fit a timing model to TOAs: the tempo/tempo2-style CLI.
+
+Reference: pint/scripts/pintempo.py:29-138 (load par+tim, fit, print
+summary, optionally write the post-fit parfile / plot residuals).
+"""
+
+from __future__ import annotations
+
+import argparse
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="pintempo", description="Fit a pulsar timing model to TOAs"
+    )
+    ap.add_argument("parfile")
+    ap.add_argument("timfile")
+    ap.add_argument("--outfile", help="write post-fit parfile here")
+    ap.add_argument("--fitter", default="auto",
+                    choices=["auto", "wls", "downhill", "gls", "wideband", "mcmc"])
+    ap.add_argument("--maxiter", type=int, default=30)
+    ap.add_argument("--no-fit", action="store_true", help="residuals only")
+    ap.add_argument("--plotfile", help="save a residual plot (requires matplotlib)")
+    args = ap.parse_args(argv)
+
+    from pint_tpu.models.builder import get_model_and_toas
+    from pint_tpu.residuals import Residuals
+
+    model, toas = get_model_and_toas(args.parfile, args.timfile)
+    r = Residuals(toas, model)
+    print(f"Read {len(toas)} TOAs; prefit weighted RMS = "
+          f"{r.rms_weighted() * 1e6:.3f} us")
+    if args.no_fit:
+        return 0
+
+    from pint_tpu import fitting
+
+    if args.fitter == "auto":
+        ftr = fitting.fit_auto(toas, model)
+    else:
+        cls = {
+            "wls": fitting.WLSFitter,
+            "downhill": fitting.DownhillWLSFitter,
+            "gls": fitting.DownhillGLSFitter,
+            "wideband": fitting.WidebandDownhillFitter,
+            "mcmc": fitting.MCMCFitter,
+        }[args.fitter]
+        ftr = cls(toas, model)
+    ftr.fit_toas(maxiter=args.maxiter) if args.fitter != "mcmc" else ftr.fit_toas()
+    print(ftr.get_summary() if hasattr(ftr, "get_summary") else ftr.result)
+    if args.outfile:
+        with open(args.outfile, "w") as f:
+            f.write(model.as_parfile())
+        print(f"wrote {args.outfile}")
+    if args.plotfile:
+        import matplotlib
+
+        matplotlib.use("Agg")
+        import matplotlib.pyplot as plt
+        import numpy as np
+
+        mjd = toas.tdb.mjd_float()
+        res = ftr.resids.time_resids if not hasattr(ftr.resids, "toa") else ftr.resids.toa.time_resids
+        err = ftr.resids.errors_s
+        plt.errorbar(mjd, np.asarray(res) * 1e6, yerr=np.asarray(err) * 1e6, fmt=".")
+        plt.xlabel("MJD")
+        plt.ylabel("residual (us)")
+        plt.title(model.psr_name)
+        plt.savefig(args.plotfile)
+        print(f"wrote {args.plotfile}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,24 @@
+"""Convert a TCB parfile to TDB (reference pint/scripts/tcb2tdb.py)."""
+
+from __future__ import annotations
+
+import argparse
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(prog="tcb2tdb", description="TCB -> TDB parfile")
+    ap.add_argument("input_par")
+    ap.add_argument("output_par")
+    args = ap.parse_args(argv)
+
+    from pint_tpu.models.builder import get_model
+
+    model = get_model(args.input_par, allow_tcb=True)
+    with open(args.output_par, "w") as f:
+        f.write(model.as_parfile())
+    print(f"wrote {args.output_par} (UNITS TDB; re-fit recommended)")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,44 @@
+"""Simulate fake TOAs from a timing model.
+
+Reference: pint/scripts/zima.py (uniform fake TOAs, optional noise,
+written as a Tempo2 tim file).
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import numpy as np
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(prog="zima", description="Simulate TOAs from a model")
+    ap.add_argument("parfile")
+    ap.add_argument("timfile", help="output tim file")
+    ap.add_argument("--ntoa", type=int, default=100)
+    ap.add_argument("--startMJD", type=float, default=56000.0)
+    ap.add_argument("--duration", type=float, default=400.0, help="days")
+    ap.add_argument("--obs", default="gbt")
+    ap.add_argument("--freq", type=float, default=1400.0, help="MHz")
+    ap.add_argument("--error", type=float, default=1.0, help="TOA error (us)")
+    ap.add_argument("--addnoise", action="store_true")
+    ap.add_argument("--seed", type=int, default=None)
+    args = ap.parse_args(argv)
+
+    from pint_tpu.models.builder import get_model
+    from pint_tpu.simulation import make_fake_toas_uniform
+
+    model = get_model(args.parfile)
+    rng = np.random.default_rng(args.seed)
+    toas = make_fake_toas_uniform(
+        args.startMJD, args.startMJD + args.duration, args.ntoa, model,
+        obs=args.obs, freq_mhz=args.freq, error_us=args.error,
+        add_noise=args.addnoise, rng=rng,
+    )
+    toas.write_tim(args.timfile)
+    print(f"wrote {args.ntoa} simulated TOAs to {args.timfile}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,219 @@
+"""Fake-TOA simulation: uniform grids, zero-residual iteration, noise draws.
+
+Reference: pint/simulation.py (zero_residuals:49 — iteratively shift TOA
+times until the model's residuals vanish, so fakes sit exactly on the model;
+make_fake_toas_uniform:191; make_fake_toas_fromtim). This is also the test
+suite's "fake backend" (SURVEY.md §4.4): fitters must recover injected
+parameters from data generated here.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from pint_tpu.astro import time as ptime
+from pint_tpu.astro.observatories import get_observatory
+from pint_tpu.residuals import Residuals
+from pint_tpu.toas import TOAs, prepare_arrays
+from pint_tpu.utils.logging import get_logger
+
+log = get_logger("pint_tpu.simulation")
+
+
+def zero_residuals(
+    toas: TOAs,
+    model,
+    maxiter: int = 10,
+    tolerance_s: float = 1e-10,
+) -> TOAs:
+    """Shift TOA (UTC) times until model residuals are < tolerance.
+
+    Each pass recomputes the full clock/TDB/posvel pipeline at the shifted
+    times, exactly like the reference (simulation.py:49-95, default tolerance
+    1 ns; ours defaults to 0.1 ns since dd phase affords it).
+    """
+    cur = toas
+    for i in range(maxiter):
+        r = Residuals(cur, model, subtract_mean=False, track_mode="nearest").time_resids
+        worst = float(np.max(np.abs(r)))
+        if worst < tolerance_s:
+            log.info(f"zero_residuals converged after {i} passes (worst {worst:.2e} s)")
+            return cur
+        cur = _reprepare(cur, -r)
+    raise RuntimeError(
+        f"zero_residuals did not reach {tolerance_s} s in {maxiter} passes (worst {worst:.2e} s)"
+    )
+
+
+def _reprepare(toas: TOAs, shift_s: np.ndarray) -> TOAs:
+    """Re-run the full preparation pipeline with the RAW site UTC shifted by
+    shift_s, preserving the clock-chain settings (never re-applies the clock
+    corrections already folded into toas.utc)."""
+    base = toas.utc_raw if toas.utc_raw is not None else toas.utc
+    return prepare_arrays(
+        base.add_seconds(shift_s),
+        toas.error_us,
+        toas.freq_mhz,
+        toas.obs,
+        flags=toas.flags,
+        ephem=toas.ephem,
+        planets=toas.planets,
+        include_gps=toas.include_gps,
+        include_bipm=toas.include_bipm,
+        bipm_version=toas.bipm_version,
+    )
+
+
+def make_fake_toas_fromMJDs(
+    mjds: np.ndarray,
+    model,
+    obs: str = "gbt",
+    freq_mhz: float | np.ndarray = 1400.0,
+    error_us: float | np.ndarray = 1.0,
+    flags: list[dict] | None = None,
+    add_noise: bool = False,
+    add_correlated_noise: bool = False,
+    rng: np.random.Generator | None = None,
+    planets: bool | None = None,
+) -> TOAs:
+    """Fake TOAs at arbitrary MJDs lying exactly on `model`.
+
+    `flags` (per-TOA dicts, e.g. ``{"f": "Rcvr1_2_GUPPI"}``) bind the model's
+    mask parameters — EFAC/EQUAD/ECORR selections, JUMPs — exactly as real
+    tim-file flags would. `add_noise` draws white noise scaled by the TOA
+    errors; `add_correlated_noise` draws from the model's FULL noise
+    covariance instead (reference make_fake_toas_fromMJDs simulation.py:240
+    + add_correlated_noise:273)."""
+    ntoas = len(mjds)
+    utc = ptime.MJDEpoch.from_mjd_float(np.asarray(mjds, float))
+    err = np.broadcast_to(np.asarray(error_us, float), (ntoas,)).copy()
+    frq = np.broadcast_to(np.asarray(freq_mhz, float), (ntoas,)).copy()
+    obs_name = get_observatory(obs).name
+    obs_arr = np.array([obs_name] * ntoas)
+    if planets is None:
+        planets = bool(model.planet_shapiro)
+    toas = prepare_arrays(
+        utc, err, frq, obs_arr, flags=flags,
+        ephem=model.ephem or "auto", planets=planets,
+    )
+    toas = zero_residuals(toas, model)
+    if add_correlated_noise:
+        toas = add_noise_from_model(toas, model, rng=rng)
+    elif add_noise:
+        rng = rng or np.random.default_rng()
+        toas = _reprepare(toas, rng.standard_normal(ntoas) * err * 1e-6)
+    return toas
+
+
+def make_fake_toas_uniform(
+    start_mjd: float,
+    end_mjd: float,
+    ntoas: int,
+    model,
+    obs: str = "gbt",
+    freq_mhz: float | np.ndarray = 1400.0,
+    error_us: float | np.ndarray = 1.0,
+    flags: list[dict] | None = None,
+    add_noise: bool = False,
+    add_correlated_noise: bool = False,
+    rng: np.random.Generator | None = None,
+    planets: bool | None = None,
+) -> TOAs:
+    """Evenly spaced fake TOAs lying exactly on `model` (+ optional noise
+    draw). Reference make_fake_toas_uniform, simulation.py:191."""
+    return make_fake_toas_fromMJDs(
+        np.linspace(start_mjd, end_mjd, ntoas), model, obs=obs,
+        freq_mhz=freq_mhz, error_us=error_us, flags=flags,
+        add_noise=add_noise, add_correlated_noise=add_correlated_noise,
+        rng=rng, planets=planets,
+    )
+
+
+def add_noise_from_model(toas: TOAs, model, rng=None) -> TOAs:
+    """Shift TOAs by one realization of the model's full noise covariance
+    C = diag(sigma_scaled^2) + F phi F^T.
+
+    The white part uses the EFAC/EQUAD-scaled uncertainties; the correlated
+    part draws independent normal coefficients with the prior variances phi
+    of every noise basis column (ECORR epoch blocks, power-law red/DM Fourier
+    modes) and maps them through the basis — the same covariance the GLS
+    fitter models, so GLS closure tests can inject exactly what they fit
+    (reference simulation.py:273-311)."""
+    rng = rng or np.random.default_rng()
+    res = Residuals(toas, model, subtract_mean=False)
+    n = len(toas)
+    sigma = np.asarray(model.scaled_sigma(model.params, res.tensor))[:n]
+    shift = rng.standard_normal(n) * sigma
+    basis = model.noise_basis_and_weights(model.params, res.tensor)
+    if basis is not None:
+        import jax.numpy as jnp
+
+        from pint_tpu.fitting.woodbury import basis_matvec
+
+        ae = ad = None
+        if basis.ephi is not None:
+            ae = jnp.asarray(
+                rng.standard_normal(basis.ke) * np.sqrt(np.asarray(basis.ephi))
+            )
+        if basis.dense_phi is not None:
+            ad = jnp.asarray(
+                rng.standard_normal(basis.kd)
+                * np.sqrt(np.asarray(basis.dense_phi))
+            )
+        shift = shift + np.asarray(basis_matvec(basis, ae, ad))[:n]
+    return _reprepare(toas, shift)
+
+
+def make_fake_toas_fromtim(timfile: str, model, add_noise: bool = False, rng=None) -> TOAs:
+    """Fakes at the epochs/errors/freqs of an existing tim file (reference
+    simulation.py make_fake_toas_fromtim)."""
+    from pint_tpu.toas import get_TOAs
+
+    real = get_TOAs(timfile, model=model)
+    toas = zero_residuals(real, model)
+    if add_noise:
+        rng = rng or np.random.default_rng()
+        toas = _reprepare(toas, rng.standard_normal(len(toas)) * toas.error_us * 1e-6)
+    return toas
+
+
+def calculate_random_models(fitter, toas, n_models: int = 100, rng=None):
+    """Residual predictions for parameter vectors drawn from the fit
+    covariance (reference utils.calculate_random_models) — the draw
+    evaluates as ONE vmapped jitted program over the model batch.
+
+    Returns (dphase (n_models, ntoa) phase residuals, draws (n_models, p)).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from pint_tpu.fitting.wls import apply_delta
+    from pint_tpu.residuals import phase_residual_frac
+
+    res = fitter.result
+    if res is None or res.covariance is None:
+        raise RuntimeError("run fit_toas first")
+    rng = rng or np.random.default_rng()
+    free = tuple(res.free_params)
+    draws = rng.multivariate_normal(np.zeros(len(free)), res.covariance, n_models)
+
+    model = fitter.model
+    # reuse the fitter's prepared residuals/tensor when it is the same TOA
+    # set; only re-prepare for a different prediction epoch grid
+    r = fitter.resids if toas is fitter.toas else Residuals(toas, model)
+    if hasattr(r, "toa"):
+        r = r.toa
+    params = model.xprec.convert_params(model.params)
+
+    def one(delta):
+        _, rr, f = phase_residual_frac(
+            model, apply_delta(params, free, delta), r.tensor,
+            track_pn=r._track_pn, delta_pn=r._delta_pn,
+            subtract_mean=r.subtract_mean, weights=r._weights,
+        )
+        return rr
+
+    from pint_tpu.ops.compile import precision_jit
+
+    fn = precision_jit(jax.vmap(one))
+    return np.asarray(fn(jnp.asarray(draws))), draws

@@ -1,0 +1,596 @@
+"""TOA loading and preparation: .tim -> clock chain -> TDB -> solar-system
+geometry -> the dense device "TOA tensor".
+
+This is the reference's L2 pipeline (toa.py:104 get_TOAs -> 2141
+apply_clock_corrections -> 2219 compute_TDBs -> 2291 compute_posvels)
+re-architected for a host/device split: every step is once-per-dataset numpy
+work; the output of `TOAs.tensor()` is the single host->device transfer after
+which all timing-model math runs jitted on device (SURVEY.md §2.2 "TPU
+equivalent" note).
+
+Times ride as MJDEpoch (int day + two-double frac). The device tensor stores
+TDB as double-double *seconds since the fixed tensor epoch* (MJD 55000 TDB),
+so any epoch difference downstream is exact in dd arithmetic.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from typing import Sequence
+
+import numpy as np
+
+from pint_tpu import AU_LS, C_M_PER_S
+from pint_tpu.astro import clock as clockmod
+from pint_tpu.astro import time as ptime
+from pint_tpu.astro.ephemeris import get_ephemeris
+from pint_tpu.astro.observatories import get_observatory
+from pint_tpu.io.tim import TOALine, parse_tim
+
+_FLAG_KEY_OK = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_+-]*$")
+_FLAG_WS = re.compile(r"\s")
+#: names already proven valid — flag vocabularies are tiny while TOA counts
+#: are 1e5+, and validation runs on every zero-residual re-preparation
+_FLAG_KEYS_SEEN: set = set()
+
+
+def validate_flags(flags: list[dict]) -> list[dict]:
+    """Enforce the reference's FlagDict contract (toa.py:911): flag keys
+    are bare identifiers (no leading '-', no whitespace), values are
+    whitespace-free strings (non-strings are coerced)."""
+    seen = _FLAG_KEYS_SEEN
+    for f in flags:
+        for k, v in f.items():
+            if k not in seen:
+                if not isinstance(k, str) or not _FLAG_KEY_OK.match(k):
+                    raise ValueError(
+                        f"invalid TOA flag name {k!r}: flag names are bare "
+                        "identifiers (store '-fe L-wide' as {'fe': 'L-wide'})"
+                    )
+                seen.add(k)
+            if type(v) is not str:
+                f[k] = v = str(v)
+            if _FLAG_WS.search(v):
+                raise ValueError(
+                    f"invalid value {v!r} for TOA flag -{k}: flag values "
+                    "cannot contain whitespace"
+                )
+    return flags
+from pint_tpu.utils.logging import get_logger
+
+log = get_logger("pint_tpu.toas")
+
+TENSOR_EPOCH_MJD = 55000  # fixed integer origin for device-side dd seconds
+
+PLANETS = ("jupiter", "saturn", "venus", "uranus", "neptune")
+
+
+@dataclass
+class TOATensor:
+    """Dense device-ready arrays (all numpy here; jnp conversion at use).
+
+    Positions are in light-seconds with ICRS axes; `t_hi + t_lo` is TDB
+    seconds since TENSOR_EPOCH_MJD.
+    """
+
+    t_hi: np.ndarray
+    t_lo: np.ndarray
+    error_s: np.ndarray
+    freq_mhz: np.ndarray
+    mjd_tdb: np.ndarray  # float64 convenience column (mask windows, plotting)
+    ssb_obs_pos_ls: np.ndarray  # (N,3)
+    ssb_obs_vel_ls: np.ndarray  # (N,3)
+    obs_sun_pos_ls: np.ndarray  # (N,3)
+    planet_pos_ls: dict[str, np.ndarray] = field(default_factory=dict)
+    pulse_number: np.ndarray | None = None
+    delta_pulse_number: np.ndarray | None = None
+
+    def __len__(self):
+        return len(self.t_hi)
+
+
+@dataclass
+class TOAs:
+    """Host TOA container (reference TOAs, toa.py:1157), numpy-backed.
+
+    Per-TOA flags stay host-side: mask parameters (JUMP/EFAC/DMX...) are
+    compiled to static index arrays at model-build time.
+    """
+
+    lines: list[TOALine]
+    utc: ptime.MJDEpoch  # clock-corrected UTC
+    tdb: ptime.MJDEpoch
+    error_us: np.ndarray
+    freq_mhz: np.ndarray
+    obs: np.ndarray  # array of observatory names (str)
+    flags: list[dict[str, str]]
+    ssb_obs_pos_m: np.ndarray
+    ssb_obs_vel_m_s: np.ndarray
+    obs_sun_pos_m: np.ndarray
+    planet_pos_m: dict[str, np.ndarray] = field(default_factory=dict)
+    ephem: str = "analytic"
+    clock_applied: bool = True
+    planets: bool = False
+    # raw site-arrival UTC (pre clock chain) + the chain settings, so
+    # re-preparation (simulation.zero_residuals) never double-applies
+    # corrections and keeps the caller's GPS/BIPM choices
+    utc_raw: ptime.MJDEpoch | None = None
+    include_gps: bool = True
+    include_bipm: bool = False
+    bipm_version: str = "BIPM2019"
+
+    def __len__(self):
+        return len(self.error_us)
+
+    def write_tim(self, path: str, name: str = "fake") -> None:
+        """Write a Tempo2-format tim file (reference TOAs.write_TOA_file,
+        toa.py:549 format). Uses the raw (pre-clock-chain) site UTC."""
+        from pint_tpu.io.tim import TOALine, write_tim as _write
+
+        ep = self.utc_raw if self.utc_raw is not None else self.utc
+        lines = []
+        for i in range(len(self)):
+            frac_hi = float(ep.frac_hi[i])
+            frac_lo = float(ep.frac_lo[i])
+            lines.append(
+                TOALine(
+                    name=f"{name}_{i}",
+                    freq_mhz=float(self.freq_mhz[i]),
+                    mjd_day=int(ep.day[i]),
+                    mjd_frac_hi=frac_hi,
+                    mjd_frac_lo=frac_lo,
+                    error_us=float(self.error_us[i]),
+                    obs=str(self.obs[i]),
+                    flags=dict(self.flags[i]),
+                )
+            )
+        _write(lines, path)
+
+    @property
+    def ntoas(self) -> int:
+        return len(self)
+
+    def first_mjd(self) -> float:
+        return float(self.tdb.mjd_float().min())
+
+    def last_mjd(self) -> float:
+        return float(self.tdb.mjd_float().max())
+
+    def get_flag_values(self, key: str, default: str = "") -> list[str]:
+        return [f.get(key, default) for f in self.flags]
+
+    def get_pulse_numbers(self) -> np.ndarray | None:
+        pns = [f.get("pn") for f in self.flags]
+        if all(p is None for p in pns):
+            return None
+        return np.array([float(p) if p is not None else np.nan for p in pns])
+
+    @property
+    def is_wideband(self) -> bool:
+        """True when any TOA carries a -pp_dm wideband DM measurement
+        (reference toa.py:1628)."""
+        return any("pp_dm" in f for f in self.flags)
+
+    def get_wideband_dm(self) -> tuple[np.ndarray | None, np.ndarray | None]:
+        """(dm [pc/cm^3], dm_error) per TOA from -pp_dm/-pp_dme flags
+        (reference toa.py:1734-1747). Rows without a measurement get dm=0
+        with infinite error (zero weight); returns (None, None) when no TOA
+        has one."""
+        if not self.is_wideband:
+            return None, None
+        for a, b in (("pp_dm", "pp_dme"), ("pp_dme", "pp_dm")):
+            bad = [i for i, f in enumerate(self.flags) if a in f and b not in f]
+            if bad:
+                raise ValueError(
+                    f"{len(bad)} TOAs carry -{a} without -{b} (first at index "
+                    f"{bad[0]}); wideband DM measurements need both"
+                )
+        dm = np.array([float(f.get("pp_dm", 0.0)) for f in self.flags])
+        dme = np.array(
+            [float(f["pp_dme"]) if "pp_dme" in f else np.inf for f in self.flags]
+        )
+        return dm, dme
+
+    def select(self, mask: np.ndarray) -> "TOAs":
+        """Boolean-mask subset (reference TOAs.select, toa.py:1852)."""
+        mask = np.asarray(mask)
+        idx = np.flatnonzero(mask)
+
+        def _sel(ep):
+            if ep is None:
+                return None
+            return ptime.MJDEpoch(ep.day[idx], ep.frac_hi[idx], ep.frac_lo[idx])
+
+        return TOAs(
+            lines=[self.lines[i] for i in idx],
+            utc=_sel(self.utc),
+            tdb=_sel(self.tdb),
+            error_us=self.error_us[idx],
+            freq_mhz=self.freq_mhz[idx],
+            obs=self.obs[idx],
+            flags=[self.flags[i] for i in idx],
+            ssb_obs_pos_m=self.ssb_obs_pos_m[idx],
+            ssb_obs_vel_m_s=self.ssb_obs_vel_m_s[idx],
+            obs_sun_pos_m=self.obs_sun_pos_m[idx],
+            planet_pos_m={k: v[idx] for k, v in self.planet_pos_m.items()},
+            ephem=self.ephem,
+            clock_applied=self.clock_applied,
+            planets=self.planets,
+            utc_raw=_sel(self.utc_raw),
+            include_gps=self.include_gps,
+            include_bipm=self.include_bipm,
+            bipm_version=self.bipm_version,
+        )
+
+    def tensor(self) -> TOATensor:
+        t_hi, t_lo = self.tdb.seconds_since(TENSOR_EPOCH_MJD)
+        pn = self.get_pulse_numbers()
+        # both -padd (PHASE command) and -phase flags carry pulse offsets
+        # (reference toa.py:829,1924-1926)
+        dpn = np.array(
+            [float(f.get("padd", 0.0)) + float(f.get("phase", 0.0)) for f in self.flags]
+        )
+        return TOATensor(
+            t_hi=t_hi,
+            t_lo=t_lo,
+            error_s=self.error_us * 1e-6,
+            freq_mhz=self.freq_mhz,
+            mjd_tdb=self.tdb.mjd_float(),
+            ssb_obs_pos_ls=self.ssb_obs_pos_m / C_M_PER_S,
+            ssb_obs_vel_ls=self.ssb_obs_vel_m_s / C_M_PER_S,
+            obs_sun_pos_ls=self.obs_sun_pos_m / C_M_PER_S,
+            planet_pos_ls={k: v / C_M_PER_S for k, v in self.planet_pos_m.items()},
+            pulse_number=pn,
+            delta_pulse_number=dpn if np.any(dpn) else None,
+        )
+
+    def summary(self) -> str:
+        span = self.last_mjd() - self.first_mjd()
+        obs_counts = {o: int((self.obs == o).sum()) for o in np.unique(self.obs)}
+        return (
+            f"{len(self)} TOAs, MJD {self.first_mjd():.1f}-{self.last_mjd():.1f} "
+            f"({span / 365.25:.1f} yr), median error {np.median(self.error_us):.2f} us, "
+            f"observatories: {obs_counts}"
+        )
+
+
+def merge_TOAs(toas_list: Sequence[TOAs]) -> TOAs:
+    """Concatenate prepared TOAs sets (reference merge_TOAs, toa.py:2670)."""
+    t0 = toas_list[0]
+    for t in toas_list[1:]:
+        if t.ephem != t0.ephem:
+            raise ValueError(f"cannot merge TOAs with ephems {t0.ephem} vs {t.ephem}")
+    cat = np.concatenate
+
+    def _cat_ep(eps):
+        if any(e is None for e in eps):
+            return None
+        return ptime.MJDEpoch(
+            cat([e.day for e in eps]),
+            cat([e.frac_hi for e in eps]),
+            cat([e.frac_lo for e in eps]),
+        )
+
+    return TOAs(
+        lines=sum((list(t.lines) for t in toas_list), []),
+        utc=_cat_ep([t.utc for t in toas_list]),
+        tdb=_cat_ep([t.tdb for t in toas_list]),
+        utc_raw=_cat_ep([t.utc_raw for t in toas_list]),
+        include_gps=t0.include_gps,
+        include_bipm=t0.include_bipm,
+        bipm_version=t0.bipm_version,
+        error_us=cat([t.error_us for t in toas_list]),
+        freq_mhz=cat([t.freq_mhz for t in toas_list]),
+        obs=cat([t.obs for t in toas_list]),
+        flags=sum((list(t.flags) for t in toas_list), []),
+        ssb_obs_pos_m=cat([t.ssb_obs_pos_m for t in toas_list]),
+        ssb_obs_vel_m_s=cat([t.ssb_obs_vel_m_s for t in toas_list]),
+        obs_sun_pos_m=cat([t.obs_sun_pos_m for t in toas_list]),
+        planet_pos_m={
+            k: cat([t.planet_pos_m[k] for t in toas_list])
+            for k in t0.planet_pos_m
+        },
+        ephem=t0.ephem,
+        clock_applied=all(t.clock_applied for t in toas_list),
+        planets=t0.planets,
+    )
+
+
+# bump when the prepared-TOA layout or pipeline changes incompatibly
+_TOA_CACHE_VERSION = 1
+
+
+def get_TOAs(
+    timfile: str,
+    ephem: str = "auto",
+    planets: bool = False,
+    include_gps: bool = True,
+    include_bipm: bool = False,
+    bipm_version: str = "BIPM2019",
+    model=None,
+    usepickle: bool = False,
+) -> TOAs:
+    """One-stop TOA preparation (reference get_TOAs, toa.py:104).
+
+    When `model` is given, EPHEM/PLANET_SHAPIRO/CLOCK directives from the
+    model override the defaults (reference toa.py:188-230 behavior): a model
+    ``CLK TT(BIPMyyyy)`` line turns on the TAI->TT(BIPM) correction chain.
+
+    `usepickle` caches the fully prepared TOAs next to the tim file
+    (reference toa.py usepickle / pickle staleness checks): the cache is
+    invalidated by tim-file content and by the preparation settings.
+    """
+    import hashlib
+    import os
+    import pickle
+    if model is not None:
+        ephem = getattr(model, "ephem", None) or ephem
+        planets = planets or bool(getattr(model, "planet_shapiro", False))
+        clk = (model.meta.get("CLOCK") or "").upper().replace(" ", "")
+        if clk.startswith("TT(BIPM"):
+            include_bipm = True
+            ver = clk[3:].strip("()")
+            if ver != "BIPM":  # bare TT(BIPM) keeps the default version
+                bipm_version = ver
+    # cache key is computed AFTER the model overrides so that calls
+    # differing only in model directives (planets, BIPM chain) never collide
+    cache_path = None
+    key = None
+    if usepickle:
+        # digest covers the master tim AND every INCLUDE'd file (resolved
+        # relative to it, like the parser does), plus a format-version tag
+        # so package upgrades never serve stale prepared arrays
+        h = hashlib.sha256()
+        stack = [timfile]
+        seen = set()
+        while stack:
+            path = stack.pop()
+            if path in seen or not os.path.exists(path):
+                continue
+            seen.add(path)
+            with open(path, "rb") as f:
+                content = f.read()
+            h.update(content)
+            for line in content.decode("utf-8", "replace").splitlines():
+                toks = line.split()
+                if len(toks) >= 2 and toks[0].upper() == "INCLUDE":
+                    stack.append(os.path.join(os.path.dirname(path), toks[1]))
+        digest = h.hexdigest()[:16]
+        # resolved ephemeris identity: the same 'auto' label can mean the
+        # analytic ephemeris, an SPK kernel (PINT_TPU_EPHEM), or the
+        # N-body-refined path (PINT_TPU_NBODY) — all change the arrays
+        spk = os.environ.get("PINT_TPU_EPHEM") or ""
+        if spk and os.path.exists(spk):
+            spk = f"{spk}@{os.path.getmtime(spk):.0f}"
+        nbody = os.environ.get("PINT_TPU_NBODY", "1")
+        eop = os.environ.get("PINT_TPU_EOP") or ""
+        if eop and os.path.exists(eop):
+            eop = f"{eop}@{os.path.getmtime(eop):.0f}"
+        key = (f"v{_TOA_CACHE_VERSION}-{digest}-{ephem}-{spk}-nb{nbody}-"
+               f"eop{eop}-{planets}-{include_gps}-{include_bipm}-{bipm_version}")
+        cache_path = timfile + ".pint_tpu_pickle"
+        if os.path.exists(cache_path):
+            try:
+                with open(cache_path, "rb") as f:
+                    cached_key, toas = pickle.load(f)
+                if cached_key == key:
+                    log.info(f"loaded TOAs from cache {cache_path}")
+                    return toas
+                log.info("TOA cache stale; regenerating")
+            except Exception as e:  # corrupt cache: regenerate
+                log.warning(f"ignoring unreadable TOA cache {cache_path}: {e}")
+    tf = parse_tim(timfile)
+    toas = prepare_TOAs(
+        tf.toas,
+        ephem=ephem,
+        planets=planets,
+        include_gps=include_gps,
+        include_bipm=include_bipm,
+        bipm_version=bipm_version,
+    )
+    if cache_path is not None:
+        try:
+            with open(cache_path, "wb") as f:
+                pickle.dump((key, toas), f)
+            log.info(f"cached prepared TOAs to {cache_path}")
+        except Exception as e:
+            log.warning(f"could not write TOA cache {cache_path}: {e}")
+    return toas
+
+
+def prepare_TOAs(
+    lines: list[TOALine],
+    ephem: str = "auto",
+    planets: bool = False,
+    include_gps: bool = True,
+    include_bipm: bool = False,
+    bipm_version: str = "BIPM2019",
+) -> TOAs:
+    n = len(lines)
+    if n == 0:
+        raise ValueError("no TOAs to prepare")
+    utc = ptime.MJDEpoch(
+        np.array([t.mjd_day for t in lines], np.int64),
+        np.array([t.mjd_frac_hi for t in lines]),
+        np.array([t.mjd_frac_lo for t in lines]),
+    )
+    error_us = np.array([t.error_us for t in lines])
+    freq = np.array([t.freq_mhz if t.freq_mhz > 0 else np.inf for t in lines])
+    obs_names = np.array([get_observatory(t.obs).name for t in lines])
+    flags = [dict(t.flags) for t in lines]
+    return prepare_arrays(
+        utc,
+        error_us,
+        freq,
+        obs_names,
+        flags,
+        lines=lines,
+        ephem=ephem,
+        planets=planets,
+        include_gps=include_gps,
+        include_bipm=include_bipm,
+        bipm_version=bipm_version,
+    )
+
+
+def prepare_arrays(
+    utc: ptime.MJDEpoch,
+    error_us: np.ndarray,
+    freq: np.ndarray,
+    obs_names: np.ndarray,
+    flags: list[dict] | None = None,
+    lines: list[TOALine] | None = None,
+    ephem: str = "auto",
+    planets: bool = False,
+    include_gps: bool = True,
+    include_bipm: bool = False,
+    bipm_version: str = "BIPM2019",
+) -> TOAs:
+    """Array-level TOA preparation: the core of get_TOAs, re-runnable for
+    simulation's zero-residual iteration (reference simulation.py:49)."""
+    n = len(utc)
+    if flags is None:
+        flags = [{} for _ in range(n)]
+    else:
+        validate_flags(flags)
+    if lines is None:
+        lines = [
+            TOALine(
+                name=f"fake_{i}",
+                freq_mhz=float(freq[i]) if np.isfinite(freq[i]) else 0.0,
+                mjd_day=int(utc.day[i]),
+                mjd_frac_hi=float(utc.frac_hi[i]),
+                mjd_frac_lo=float(utc.frac_lo[i]),
+                error_us=float(error_us[i]),
+                obs=str(obs_names[i]),
+                flags=dict(flags[i]),
+            )
+            for i in range(n)
+        ]
+
+    # 1. clock corrections per observatory group (site -> UTC)
+    corr_s = np.zeros(n)
+    for name in np.unique(obs_names):
+        ob = get_observatory(str(name))
+        sel = obs_names == name
+        if ob.is_barycenter or ob.timescale != "utc":
+            continue
+        chain = clockmod.get_clock_chain(
+            str(name), include_gps=include_gps, include_bipm=include_bipm, bipm_version=bipm_version
+        )
+        corr_s[sel] = chain.evaluate(utc.mjd_float()[sel])
+    utc_corr = utc.add_seconds(corr_s)
+
+    # 2. UTC -> TT -> (geocentric) TDB. Rows whose observatory runs on TT
+    # (photon-event data, e.g. Fermi MET after geocentering) skip the
+    # UTC->TT leap-second chain: their input times already ARE TT.
+    bary = np.array([get_observatory(str(o)).is_barycenter for o in obs_names])
+    tt_scale = np.array([get_observatory(str(o)).timescale == "tt" for o in obs_names])
+    tt = ptime.pulsar_mjd_utc_to_tt(utc_corr)
+    if np.any(tt_scale):
+        for dst, src in ((tt.day, utc_corr.day), (tt.frac_hi, utc_corr.frac_hi),
+                         (tt.frac_lo, utc_corr.frac_lo)):
+            dst[tt_scale] = src[tt_scale]
+    tt_jcent = ptime.mjd_tt_julian_centuries(tt)
+
+    # 3. site GCRS posvel. UT1 = UTC + dUT1 and polar motion come from a
+    # user-supplied IERS table (PINT_TPU_EOP, astro/eop.py); both are zero
+    # without one (<= 1.4 us site effect).
+    from pint_tpu.astro.eop import get_eop
+
+    utc_mjd = utc_corr.mjd_float()
+    dut1_s, xp_rad, yp_rad = get_eop(utc_mjd)
+    ut1_mjd = utc_mjd + dut1_s / 86400.0
+    site_pos = np.zeros((n, 3))
+    site_vel = np.zeros((n, 3))
+    for name in np.unique(obs_names):
+        ob = get_observatory(str(name))
+        sel = obs_names == name
+        p, v = ob.site_posvel_gcrs(
+            ut1_mjd[sel], tt_jcent[sel],
+            xp_rad=xp_rad[sel], yp_rad=yp_rad[sel],
+        )
+        site_pos[sel] = p
+        site_vel[sel] = v
+
+    # 4. ephemeris: Earth & Sun & planets wrt SSB at (geocentric) TDB
+    eph = get_ephemeris() if ephem in ("auto", "analytic", None) else get_ephemeris(ephem)
+    # TDB for ephemeris lookup: geocentric series is plenty (us-level arg error
+    # moves Earth by < 0.1 mm)
+    tdb_geo = ptime.tt_to_tdb(tt)
+    tdb_jcent = (tdb_geo.mjd_float() - ptime.MJD_J2000) / 36525.0
+    earth_pos, earth_vel = eph.posvel_ssb("earth", tdb_jcent)
+    sun_pos, sun_vel = eph.posvel_ssb("sun", tdb_jcent)
+
+    ssb_obs_pos = earth_pos + site_pos
+    ssb_obs_vel = earth_vel + site_vel
+    # barycentric TOAs: observer is at the SSB
+    ssb_obs_pos[bary] = 0.0
+    ssb_obs_vel[bary] = 0.0
+    obs_sun_pos = sun_pos - ssb_obs_pos
+
+    planet_pos: dict[str, np.ndarray] = {}
+    if planets:
+        for p in PLANETS:
+            ppos, _ = eph.posvel_ssb(p, tdb_jcent)
+            planet_pos[p] = ppos - ssb_obs_pos
+
+    # 5. full TDB including the topocentric (site-dependent) term
+    topo = ptime.topocentric_tdb_correction(earth_vel, site_pos)
+    tdb = ptime.tt_to_tdb(tt, topo)
+    # barycentric TOAs are already TDB at the SSB
+    if np.any(bary):
+        for arr_dst, arr_src in (
+            (tdb.day, utc.day),
+            (tdb.frac_hi, utc.frac_hi),
+            (tdb.frac_lo, utc.frac_lo),
+        ):
+            arr_dst[bary] = arr_src[bary]
+
+    toas = TOAs(
+        lines=list(lines),
+        utc=utc_corr,
+        tdb=tdb,
+        error_us=error_us,
+        freq_mhz=freq,
+        obs=obs_names,
+        flags=flags,
+        ssb_obs_pos_m=ssb_obs_pos,
+        ssb_obs_vel_m_s=ssb_obs_vel,
+        obs_sun_pos_m=obs_sun_pos,
+        planet_pos_m=planet_pos,
+        ephem=getattr(eph, "name", "analytic"),
+        planets=planets,
+        utc_raw=utc,
+        include_gps=include_gps,
+        include_bipm=include_bipm,
+        bipm_version=bipm_version,
+    )
+    log.info("prepared TOAs: " + toas.summary())
+    return toas
+
+
+def make_tzr_toa(
+    tzrmjd_day: int,
+    tzrmjd_frac_hi: float,
+    tzrmjd_frac_lo: float,
+    tzrsite: str,
+    tzrfrq_mhz: float,
+    ephem: str = "auto",
+    planets: bool = False,
+) -> TOAs:
+    """Prepare the single fiducial TZR TOA (reference absolute_phase.py
+    get_TZR_toa); runs the identical pipeline so the TZR row can be appended
+    to the TOA tensor and folded into the same jitted phase evaluation."""
+    line = TOALine(
+        name="TZR",
+        freq_mhz=tzrfrq_mhz if tzrfrq_mhz and np.isfinite(tzrfrq_mhz) else 0.0,
+        mjd_day=tzrmjd_day,
+        mjd_frac_hi=tzrmjd_frac_hi,
+        mjd_frac_lo=tzrmjd_frac_lo,
+        error_us=0.0,
+        obs=tzrsite,
+        flags={"tzr": "True"},
+    )
+    return prepare_TOAs([line], ephem=ephem, planets=planets)

@@ -1,0 +1,1 @@
+"""Cross-cutting utilities: logging, hashing, interval helpers."""

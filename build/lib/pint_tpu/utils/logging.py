@@ -1,0 +1,65 @@
+"""Lightweight structured logging for pint_tpu.
+
+The reference wraps loguru with a dedup filter (pint/logging.py:125-236);
+loguru is not a dependency here, so we provide the same surface (setup(),
+per-module loggers, repeated-message suppression) on stdlib logging.
+"""
+
+from __future__ import annotations
+
+import logging
+import sys
+
+_FORMAT = "%(asctime)s %(levelname)-7s %(name)s: %(message)s"
+_configured = False
+
+
+class DedupFilter(logging.Filter):
+    """Suppress exact-duplicate log records after the first N occurrences.
+
+    Mirrors the behavior of the reference's LogFilter (pint/logging.py:125):
+    chatty per-TOA warnings collapse to a single line.
+    """
+
+    def __init__(self, max_repeats: int = 3):
+        super().__init__()
+        self.max_repeats = max_repeats
+        self._counts: dict[str, int] = {}
+
+    def filter(self, record: logging.LogRecord) -> bool:  # noqa: A003
+        key = f"{record.name}:{record.levelno}:{record.getMessage()}"
+        n = self._counts.get(key, 0)
+        self._counts[key] = n + 1
+        if n == self.max_repeats:
+            record.msg = f"{record.msg} [further repeats suppressed]"
+        return n <= self.max_repeats
+
+
+def setup(level: str = "INFO", sink=sys.stderr, dedup: bool = True) -> None:
+    """Configure the root pint_tpu logger (reference: pint.logging.setup)."""
+    global _configured
+    root = logging.getLogger("pint_tpu")
+    root.handlers.clear()
+    handler = logging.StreamHandler(sink)
+    handler.setFormatter(logging.Formatter(_FORMAT))
+    if dedup:
+        handler.addFilter(DedupFilter())
+    root.addHandler(handler)
+    root.setLevel(getattr(logging, level.upper(), logging.INFO))
+    _configured = True
+
+
+def get_level(starting: str = "WARNING", verbosity: int = 0, quietness: int = 0) -> str:
+    """-v/-q CLI arithmetic (reference: pint/logging.py:323)."""
+    levels = ["TRACE", "DEBUG", "INFO", "WARNING", "ERROR", "CRITICAL"]
+    aliases = {"TRACE": "DEBUG"}  # stdlib has no TRACE
+    idx = levels.index(starting.upper()) - verbosity + quietness
+    idx = min(max(idx, 0), len(levels) - 1)
+    name = levels[idx]
+    return aliases.get(name, name)
+
+
+def get_logger(name: str) -> logging.Logger:
+    if not _configured:
+        setup()
+    return logging.getLogger(name)

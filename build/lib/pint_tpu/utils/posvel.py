@@ -1,0 +1,72 @@
+"""PosVel: position+velocity vectors with origin/object bookkeeping.
+
+Reference: pint/utils.py PosVel:137 — vectors know what they point from and
+to; addition composes legs (obj/origin chain-checked), subtraction and
+negation re-label consistently. Values are numpy (m, m/s), shape (..., 3).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class PosVel:
+    def __init__(self, pos, vel, origin: str | None = None, obj: str | None = None):
+        self.pos = np.asarray(pos, np.float64)
+        self.vel = np.asarray(vel, np.float64)
+        if self.pos.shape[-1] != 3 or self.vel.shape[-1] != 3:
+            raise ValueError("PosVel needs (..., 3) pos and vel")
+        if (origin is None) != (obj is None):
+            raise ValueError("specify both origin and obj, or neither")
+        self.origin = origin
+        self.obj = obj
+
+    def _unlabeled(self) -> bool:
+        return self.origin is None
+
+    def __add__(self, other: "PosVel") -> "PosVel":
+        if self._unlabeled() or other._unlabeled():
+            origin = obj = None
+        elif self.obj == other.origin:
+            origin, obj = self.origin, other.obj
+        elif other.obj == self.origin:
+            origin, obj = other.origin, self.obj
+        else:
+            raise ValueError(
+                f"cannot add PosVel {self.origin}->{self.obj} and "
+                f"{other.origin}->{other.obj}: no shared leg"
+            )
+        return PosVel(self.pos + other.pos, self.vel + other.vel, origin, obj)
+
+    def __neg__(self) -> "PosVel":
+        return PosVel(-self.pos, -self.vel, self.obj, self.origin)
+
+    def __sub__(self, other: "PosVel") -> "PosVel":
+        return self + (-other)
+
+    def __str__(self) -> str:
+        label = f" {self.origin}->{self.obj}" if self.origin else ""
+        return f"PosVel({self.pos} m, {self.vel} m/s{label})"
+
+    __repr__ = __str__
+
+
+def obj_posvel_wrt_ssb(body: str, tdb_jcent, ephem=None) -> PosVel:
+    """Barycentric PosVel of a solar-system body (reference
+    objPosVel_wrt_SSB, solar_system_ephemerides.py)."""
+    from pint_tpu.astro.ephemeris import get_ephemeris
+
+    eph = ephem or get_ephemeris()
+    p, v = eph.posvel_ssb(body, np.asarray(tdb_jcent))
+    return PosVel(p, v, origin="ssb", obj=body)
+
+
+def obj_posvel(obj1: str, obj2: str, tdb_jcent, ephem=None) -> PosVel:
+    """PosVel of obj2 relative to obj1 (reference objPosVel)."""
+    if ephem is None:
+        from pint_tpu.astro.ephemeris import get_ephemeris
+
+        ephem = get_ephemeris()  # resolve once: the SPK path re-reads files
+    return obj_posvel_wrt_ssb(obj2, tdb_jcent, ephem) - obj_posvel_wrt_ssb(
+        obj1, tdb_jcent, ephem
+    )

@@ -179,3 +179,18 @@ class TestTOAPipeline:
         toas = prepare_TOAs(lines)
         assert np.all(toas.ssb_obs_pos_m == 0.0)
         assert float(toas.tdb.to_longdouble()[0]) == pytest.approx(55000.5)
+
+
+class TestObservatoryRegistry:
+    def test_full_site_registry(self):
+        """The packaged long tail of sites (LOFAR stations, historic and
+        multi-messenger telescopes) loads with Earth-surface radii."""
+        from pint_tpu.astro.observatories import _load_builtin, _registry, get_observatory
+
+        _load_builtin()
+        names = {v.name for v in _registry.values()}
+        assert len(names) >= 120  # reference registry has 123 sites
+        for site in ("lofar", "de601", "fast", "meerkat", "hess", "algonquin"):
+            ob = get_observatory(site)
+            r = np.linalg.norm(ob.itrf_xyz_m)
+            assert 6.3e6 < r < 6.4e6, (site, r)
