@@ -170,6 +170,11 @@ def load_observatories_json(path: str) -> None:
         # aliases may shadow builtins; last-loaded wins like the reference
         for a in obs.aliases:
             _registry[a.lower()] = obs
+        # TEMPO site codes must resolve too (get_observatory contract) —
+        # but never at the cost of masking an existing site's name/alias
+        code = str(info.get("tempo_code", "")).lower()
+        if code and code not in _registry:
+            _registry[code] = obs
         n += 1
     log.info(f"loaded {n} observatories from {path}")
 
