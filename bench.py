@@ -21,7 +21,7 @@ TOAs at the same scale, cadence, and epoch structure.
 Env knobs: PINT_TPU_BENCH_NTOAS (default 100000), PINT_TPU_BENCH_PAR,
 PINT_TPU_BENCH_MAXITER (GN refits per point, default 1 — the reference
 WLSFitter.fit_toas default), PINT_TPU_BENCH_REPEATS (default 3),
-PINT_TPU_BENCH_MCMC_STEPS (default 100).
+PINT_TPU_BENCH_MCMC_STEPS (default 500).
 """
 
 from __future__ import annotations
@@ -232,7 +232,7 @@ def main() -> None:
     ntoas = int(os.environ.get("PINT_TPU_BENCH_NTOAS", "100000"))
     maxiter = int(os.environ.get("PINT_TPU_BENCH_MAXITER", "1"))
     repeats = int(os.environ.get("PINT_TPU_BENCH_REPEATS", "3"))
-    mcmc_steps = int(os.environ.get("PINT_TPU_BENCH_MCMC_STEPS", "100"))
+    mcmc_steps = int(os.environ.get("PINT_TPU_BENCH_MCMC_STEPS", "500"))
     par = os.environ.get(
         "PINT_TPU_BENCH_PAR", "/root/reference/profiling/J0740+6620.par"
     )

@@ -95,11 +95,14 @@ class EventOptimizer:
         self.chain: np.ndarray | None = None  # (nsteps, nwalkers, ndim)
         self.lnp: np.ndarray | None = None
         self.maxpost_theta: np.ndarray | None = None
-        params0 = model.xprec.convert_params(model.params)
+        #: the chain's reference point: deltas are relative to the model
+        #: state AT CONSTRUCTION (set_to_maxpost mutates the model, but the
+        #: cached posterior keeps sampling around this fixed reference)
+        self._params0 = model.xprec.convert_params(model.params)
         #: absolute offsets per theta component (chain walks deltas for the
         #: timing params, absolute cycles for PHASE)
         self.theta_offsets = np.array([
-            float(np.asarray(leaf_to_f64(params0[n]))) for n in self.free
+            float(np.asarray(leaf_to_f64(self._params0[n]))) for n in self.free
         ] + [0.0])
 
     # --- the jitted posterior --------------------------------------------------
@@ -116,11 +119,18 @@ class EventOptimizer:
             "weights": None if weights is None else np.asarray(weights, float),
             "setweight": float(setweight),
         })
+        self._lnpost_cached = None  # the posterior now spans more data
 
     def lnpost_fn(self):
+        # memoized: run_ensemble caches its compiled chain on the callable
+        # identity, so repeated fit()/resume calls must hand back the SAME
+        # closure to skip re-tracing the whole photon posterior
+        cached = getattr(self, "_lnpost_cached", None)
+        if cached is not None:
+            return cached
         model = self.model
         free = self.free
-        params0 = model.xprec.convert_params(model.params)
+        params0 = self._params0
         dsets = [
             {
                 "tensor": d["resids"].tensor,
@@ -185,9 +195,20 @@ class EventOptimizer:
                 ll = ll + ds["sw"] * li
             return jnp.where(jnp.isfinite(lp), lp + ll, -jnp.inf)
 
+        self._lnpost_cached = lnpost
         return lnpost
 
     # --- phases / diagnostics --------------------------------------------------
+
+    def _ref_phases(self, index: int) -> np.ndarray:
+        """Model phases mod 1 at the chain's reference params (delta=0)."""
+        from pint_tpu.residuals import phase_residual_frac
+
+        d = self.datasets[index]
+        _, r, _ = phase_residual_frac(
+            self.model, self._params0, d["resids"].tensor, subtract_mean=False
+        )
+        return np.mod(np.asarray(r), 1.0)
 
     def get_event_phases(self, index: int | None = None) -> np.ndarray:
         """Absolute model phases mod 1 at the CURRENT model params; all
@@ -286,8 +307,8 @@ class EventOptimizer:
             raise RuntimeError("run fit() first")
         from pint_tpu.ops.xprec import params_to_dd
 
-        params0 = self.model.xprec.convert_params(self.model.params)
-        pp = apply_delta(params0, self.free, jnp.asarray(self.maxpost_theta[:-1]))
+        pp = apply_delta(self._params0, self.free,
+                         jnp.asarray(self.maxpost_theta[:-1]))
         self.model.params = params_to_dd(pp)
 
 

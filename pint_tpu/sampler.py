@@ -18,22 +18,26 @@ import numpy as np
 Array = jnp.ndarray
 
 
-def run_ensemble(lnpost, x0: np.ndarray, nsteps: int, seed: int = 0, a: float = 2.0):
-    """Run the stretch sampler.
+#: compiled chain programs keyed on the lnpost CALLABLE (weakly, so dead
+#: posteriors — which capture whole datasets — are not pinned): re-running
+#: a fitter or resuming a chain must NOT re-trace, because the sampler
+#: graph embeds the whole posterior and rebuilding it costs far more than
+#: the sampling. Producers must hand back the SAME closure across calls
+#: (BayesianTiming/EventOptimizer memoize theirs).
+import weakref
 
-    lnpost : delta-vector -> scalar ln posterior (jit-traceable)
-    x0 : (nwalkers, ndim) initial walker positions (nwalkers even)
-    Returns (chain (nsteps, nwalkers, ndim), lnp (nsteps, nwalkers),
-    acceptance fraction).
-    """
-    x0 = jnp.asarray(x0, jnp.float64)
-    nw, nd = x0.shape
-    if nw % 2 or nw < 2 * nd:
-        raise ValueError(f"need an even nwalkers >= 2*ndim, got {nw} for ndim {nd}")
-    half = nw // 2
+_RUN_CACHE: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+
+
+def _get_run(lnpost, a: float):
+    per_a = _RUN_CACHE.get(lnpost)
+    if per_a is not None and a in per_a:
+        return per_a[a]
+
     vln = jax.vmap(lnpost)
 
     def half_step(key, x_move, lp_move, x_other):
+        half, nd = x_move.shape
         k1, k2, k3 = jax.random.split(key, 3)
         u = jax.random.uniform(k1, (half,))
         z = ((a - 1.0) * u + 1.0) ** 2 / a
@@ -49,6 +53,7 @@ def run_ensemble(lnpost, x0: np.ndarray, nsteps: int, seed: int = 0, a: float = 
 
     def step(carry, key):
         x, lp = carry
+        half = x.shape[0] // 2
         ka, kb = jax.random.split(key)
         xa, lpa, acc_a = half_step(ka, x[:half], lp[:half], x[half:])
         xb, lpb, acc_b = half_step(kb, x[half:], lp[half:], xa)
@@ -63,6 +68,24 @@ def run_ensemble(lnpost, x0: np.ndarray, nsteps: int, seed: int = 0, a: float = 
         (_, _), (chain, lnp, n_acc) = jax.lax.scan(step, (x0, lp0), keys)
         return chain, lnp, n_acc
 
+    _RUN_CACHE.setdefault(lnpost, {})[a] = run
+    return run
+
+
+def run_ensemble(lnpost, x0: np.ndarray, nsteps: int, seed: int = 0, a: float = 2.0):
+    """Run the stretch sampler.
+
+    lnpost : delta-vector -> scalar ln posterior (jit-traceable; reuse the
+        SAME callable across calls to reuse the compiled chain)
+    x0 : (nwalkers, ndim) initial walker positions (nwalkers even)
+    Returns (chain (nsteps, nwalkers, ndim), lnp (nsteps, nwalkers),
+    acceptance fraction).
+    """
+    x0 = jnp.asarray(x0, jnp.float64)
+    nw, nd = x0.shape
+    if nw % 2 or nw < 2 * nd:
+        raise ValueError(f"need an even nwalkers >= 2*ndim, got {nw} for ndim {nd}")
+    run = _get_run(lnpost, a)
     keys = jax.random.split(jax.random.PRNGKey(seed), nsteps)
     chain, lnp, n_acc = run(x0, keys)
     accept_frac = float(jnp.sum(n_acc)) / (nsteps * nw)
