@@ -477,3 +477,25 @@ M2 1.3 1 0.01
         assert 1.5 < omdot < 3.0  # ~1.87 deg/yr for PB=0.4 d, e=0.17, 2.8 Msun
         with pytest.raises(NotImplementedError):
             convert_binary(dd, "DDGR")
+
+
+class TestConvertParfileCLI:
+    def test_convert_chain(self, tmp_path):
+        """convert_parfile CLI: binary + frame conversion round trip."""
+        from pint_tpu.scripts.convert_parfile import main
+
+        src = tmp_path / "in.par"
+        src.write_text(TestBinaryConvertExtended.DD_PAR)
+        out1 = tmp_path / "ell1_ecl.par"
+        assert main([str(src), "-b", "ELL1", "--frame", "ecl",
+                     "-o", str(out1)]) == 0
+        text = out1.read_text()
+        assert "ELL1" in text.split() and "ELONG" in text
+        out2 = tmp_path / "back.par"
+        assert main([str(out1), "-b", "DD", "--frame", "icrs",
+                     "-o", str(out2)]) == 0
+        from pint_tpu.models.builder import get_model
+
+        m = get_model(str(out2))
+        assert m.meta["BINARY"] == "DD"
+        assert "RAJ" in m.params and "ECC" in m.params
