@@ -116,3 +116,42 @@ class TestFlagValidation:
             validate_flags([{"-fe": "x"}])
         with pytest.raises(ValueError, match="whitespace"):
             validate_flags([{"fe": "L wide"}])
+
+
+class TestRobustnessProbes:
+    """The failure-handling contract (SURVEY §5 / verify-skill probes)."""
+
+    def test_malformed_tim_line_warn_and_skip(self, tmp_path):
+        from pint_tpu.io.tim import parse_tim
+
+        p = tmp_path / "bad.tim"
+        p.write_text("FORMAT 1\n"
+                     "f.ff 1400.0 NOT_A_MJD 1.0 gbt\n"
+                     "f.ff 1400.0 55000.5 1.0 gbt\n")
+        tf = parse_tim(str(p))
+        assert len(tf.toas) == 1  # bad row skipped, good row kept
+
+    def test_unknown_observatory_lists_known(self):
+        import pytest
+
+        from pint_tpu.astro.observatories import get_observatory
+
+        with pytest.raises(KeyError, match="unknown observatory"):
+            get_observatory("notanobservatory")
+
+    def test_empty_toa_list_rejected(self):
+        import pytest
+
+        from pint_tpu.toas import prepare_TOAs
+
+        with pytest.raises(ValueError):
+            prepare_TOAs([])
+
+    def test_unknown_par_params_warn_but_build(self, caplog):
+        from pint_tpu.io.par import parse_parfile
+        from pint_tpu.models.builder import build_model
+
+        par = ("PSR FAKE\nRAJ 05:00:00\nDECJ 20:00:00\nF0 100.0\n"
+               "PEPOCH 55000\nDM 10.0\nNOTAREALPARAM 42\n")
+        m = build_model(parse_parfile(par, from_text=True))
+        assert "F0" in m.params  # model still builds
