@@ -54,6 +54,8 @@ __version__ = "0.1.0"
 C_M_PER_S = 299792458.0  # speed of light, exact
 AU_M = 149597870700.0  # IAU 2012 astronomical unit, exact
 AU_LS = AU_M / C_M_PER_S  # AU in light-seconds ~ 499.004784
+PC_M = 3.0856775814913673e16  # IAU 2015 parsec, meters
+PC_LS = PC_M / C_M_PER_S  # parsec in light-seconds
 SECS_PER_DAY = 86400.0
 DAYS_PER_JULIAN_YEAR = 365.25
 SECS_PER_JULIAN_YEAR = SECS_PER_DAY * DAYS_PER_JULIAN_YEAR

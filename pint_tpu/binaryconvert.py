@@ -314,8 +314,8 @@ def _retarget_incl(model, new, target, kom_deg):
         _drop(model, "SINI")
     elif target == "BT":
         _drop(model, "SINI", "M2")
-    else:  # DD keeps SINI
-        if "SINI" not in model.params and sini is not None:
+    else:  # DD keeps SINI (sini is non-None: the early return covers absence)
+        if "SINI" not in model.params:
             _set(model, new, "SINI", sini, unc=s_sini, frozen=frz)
 
 

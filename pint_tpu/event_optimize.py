@@ -28,7 +28,12 @@ from pint_tpu.fitting.wls import apply_delta
 from pint_tpu.models.base import leaf_to_f64
 from pint_tpu.residuals import Residuals
 from pint_tpu.sampler import run_ensemble
-from pint_tpu.templates import LCTemplate, template_density_jnp, template_params
+from pint_tpu.templates import (
+    LCTemplate,
+    lnlikelihood,
+    template_density_jnp,
+    template_params,
+)
 from pint_tpu.utils.logging import get_logger
 
 log = get_logger("pint_tpu.event_optimize")
@@ -37,11 +42,7 @@ log = get_logger("pint_tpu.event_optimize")
 def profile_lnlikelihood(phases, template: LCTemplate, weights=None):
     """Pletsch & Clark (2015) eq. 2 photon log-likelihood at fixed phases
     (host convenience; the jitted path lives in EventOptimizer)."""
-    f = template(np.asarray(phases))
-    if weights is None:
-        return float(np.sum(np.log(np.maximum(f, 1e-300))))
-    w = np.asarray(weights)
-    return float(np.sum(np.log(np.maximum(w * f + 1.0 - w, 1e-300))))
+    return lnlikelihood(template, phases, weights)
 
 
 def marginalize_over_phase(phases, template: LCTemplate, weights=None,

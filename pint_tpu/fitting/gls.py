@@ -32,6 +32,7 @@ from pint_tpu.fitting.wls import (
 )
 from pint_tpu.fitting.woodbury import (
     basis_matvec,
+    cat_ahat,
     cinv_apply,
     s_factor,
     woodbury_chi2,
@@ -103,10 +104,7 @@ def get_gls_step_fn(model: TimingModel, free, subtract_mean: bool):
         # GLS chi^2 at the CURRENT params (for the downhill accept/reject
         # decision and reporting) + ML noise-coefficient realization
         chi2_0, (ze, zd) = woodbury_chi2(basis, cinv, r0, sf=sf)
-        ahat = jnp.concatenate([
-            ze if ze is not None else jnp.zeros(0),
-            zd if zd is not None else jnp.zeros(0),
-        ])
+        ahat = cat_ahat(ze, zd)
         # the p x p solve itself happens host-side (scipy Cholesky on a
         # small matrix), so Levenberg-Marquardt re-solves at any damping
         # need no recompute of the design matrix

@@ -23,6 +23,7 @@ from pint_tpu.fitting.gls import gls_solve
 from pint_tpu.fitting.wls import FitResult, WLSFitter, apply_delta
 from pint_tpu.fitting.woodbury import (
     NoiseBasis,
+    cat_ahat,
     cinv_apply,
     s_factor,
     woodbury_chi2,
@@ -75,12 +76,6 @@ def _noise_basis_aug(model, params, tensor, sw_t, n_dm):
     )
 
 
-def _cat_ahat(ze, zd):
-    return jnp.concatenate([
-        ze if ze is not None else jnp.zeros(0),
-        zd if zd is not None else jnp.zeros(0),
-    ])
-
 
 def get_wb_step_fn(model, free, subtract_mean: bool):
     """Jitted wideband step -> (r_aug, mtcm, mtcy, norm, chi2_0, ahat);
@@ -119,7 +114,7 @@ def get_wb_step_fn(model, free, subtract_mean: bool):
         mtcm = An.T @ CinvA + _RIDGE * jnp.eye(p)
         mtcy = CinvA.T @ b
         chi2_0, (ze, zd) = woodbury_chi2(basis, ones, r0, sf=sf)
-        return r0, mtcm, mtcy, norm, chi2_0, _cat_ahat(ze, zd)
+        return r0, mtcm, mtcy, norm, chi2_0, cat_ahat(ze, zd)
 
     from pint_tpu.ops.compile import precision_jit
 

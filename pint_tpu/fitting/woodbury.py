@@ -216,6 +216,15 @@ def logdet_C(basis: NoiseBasis | None, w: Array, sf: SFactor | None = None,
     return out
 
 
+def cat_ahat(ze, zd):
+    """Concatenate the (epoch, dense) ML coefficient parts into the flat
+    `noise_ampls` layout (epoch columns first, matching basis_dense)."""
+    return jnp.concatenate([
+        ze if ze is not None else jnp.zeros(0),
+        zd if zd is not None else jnp.zeros(0),
+    ])
+
+
 def basis_dense(basis: NoiseBasis | None, n: int):
     """Materialize (F (n, k), phi (k,)) — for tests/small-N host work only
     (simulation draws, noise realizations); epoch columns first."""

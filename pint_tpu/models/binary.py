@@ -214,6 +214,11 @@ class PulsarBinary(DelayComponent):
     def param_specs(self):  # instance-configured; shadows the classmethod
         return self._spec_list
 
+    def parfile_exclude(self):
+        # NHARMS is emitted by extra_parfile_lines from the component's
+        # authoritative value (H4 presence bumps it past the parfile's)
+        return {"NHARMS"} if self.model_name == "ELL1H" else set()
+
     def extra_parfile_lines(self, model):
         out = [("BINARY", self.model_name)]
         if self.model_name == "ELL1H":

@@ -528,10 +528,11 @@ def model_to_parfile(model: TimingModel) -> str:
         unc = f" {pm.uncertainty / spec.scale:.6g}" if pm.uncertainty else ""
         lines.append((name, f"{val} {fit}{unc}"))
 
-    # static-config params (SWM, NHARMS, TNREDC, ...) live in model.meta;
-    # emit them from the owning component's specs (ECL/UNITS handled above,
-    # SIFUNC written by IFunc itself)
-    done = {k for k, _ in lines} | {"SIFUNC", "NHARMS"}
+    # static-config params (SWM, TNREDC, ...) live in model.meta; emit
+    # them from the owning component's specs (ECL/UNITS handled above;
+    # components that write their own lines exclude the names via
+    # parfile_exclude, e.g. IFunc's SIFUNC, ELL1H's NHARMS)
+    done = {k for k, _ in lines} | exclude
     for comp in model.components:
         for spec in comp.specs.values():
             if (not spec.is_fittable and spec.name in meta

@@ -43,7 +43,7 @@ class IFunc(PhaseComponent):
         )
 
     def parfile_exclude(self):
-        return {f"IFUNC{k}" for k in range(1, len(self.node_mjds) + 1)}
+        return {"SIFUNC", *(f"IFUNC{k}" for k in range(1, len(self.node_mjds) + 1))}
 
     def extra_parfile_lines(self, model):
         import numpy as np

@@ -41,9 +41,7 @@ from pint_tpu.models.parameter import ParamSpec
 
 Array = jnp.ndarray
 
-# AU in light seconds and parsec in light seconds (tensor positions are ls)
-AU_LS = 499.00478384
-PC_LS = 3.0856775814913673e16 / 299792458.0
+from pint_tpu import AU_LS, PC_LS  # tensor positions are light-seconds
 
 # Gauss-Legendre rule for K(theta, p)
 _GL_X, _GL_W = np.polynomial.legendre.leggauss(64)
