@@ -5,16 +5,17 @@ The reference reads JPL DE .bsp kernels via jplephem (reference
 solar_system_ephemerides.py:73-133). No kernels ship in this environment and
 there is no network, so pint_tpu provides:
 
-- ``AnalyticEphemeris`` (default): JPL "Keplerian elements for approximate
-  positions" (Standish/Williams public table, valid 1800-2050 AD) for the
-  planets + EMB, the truncated Meeus/ELP lunar series for the Moon, and the
-  barycentric constraint sum(GM_i r_i) = 0 for the Sun. Typical accuracy:
-  EMB position ~1e3 km (worst-case over the validity range), Moon ~1 km,
-  Earth-from-EMB offset ~10 m. The corresponding Roemer-delay systematics are
-  smooth orbital-period terms that fitted astrometry absorbs; absolute
-  barycentering accuracy is documented as ~ms-level, while *differential*
-  (fit-relevant) accuracy is far better. For DE-grade work, point
-  ``PINT_TPU_EPHEM`` at a type-2/3 SPK kernel (reader: pint_tpu.astro.spk).
+- ``AnalyticEphemeris`` (default): truncated VSOP87D series for the Earth
+  (astro/vsop87.py) and for Jupiter/Saturn (astro/vsop87_planets.py — they
+  dominate the Sun-SSB wobble, so Keplerian elements are not good enough
+  for them), JPL "Keplerian elements for approximate positions"
+  (Standish/Williams public table, valid 1800-2050 AD) for the other
+  planets, the truncated Meeus/ELP lunar series for the Moon, and the
+  barycentric constraint sum(GM_i r_i) = 0 for the Sun. Earth-SSB accuracy
+  ~120 km RMS vs DE421 (mostly fit-absorbable drift; measured in
+  tests/test_tempo2_columns.py), plus the N-body refinement below for the
+  high-frequency band. For DE-grade work, point ``PINT_TPU_EPHEM`` at a
+  type-2/3 SPK kernel (reader: pint_tpu.astro.spk).
 - body posvel composition utilities mirroring the reference's
   objPosVel_wrt_SSB API surface.
 
@@ -219,19 +220,27 @@ def _moon_geocentric_ecliptic_date(T: np.ndarray) -> np.ndarray:
     )
 
 
-def _ecl_date_to_gcrs(vec: np.ndarray, T: np.ndarray) -> np.ndarray:
-    """Mean-ecliptic-&-equinox-of-date -> GCRS/ICRS, exactly consistent with
-    the IAU2006 Fukushima-Williams bias-precession of astro/erot.py:
+def _ecl_date_matrix(T: np.ndarray) -> np.ndarray:
+    """Rotation mean-ecliptic-&-equinox-of-date -> GCRS/ICRS, exactly
+    consistent with the IAU2006 Fukushima-Williams bias-precession of
+    astro/erot.py:
 
         r_gcrs = Rz(-gamma_bar) Rx(-phi_bar) Rz(psi_bar) r_ecl_date
 
     (the F-W angles are literally defined by this chain: psi_bar along the
     ecliptic of date, phi_bar its obliquity on the GCRS equator, gamma_bar
-    the GCRS equator <-> ecliptic node). Includes the ICRS frame bias."""
+    the GCRS equator <-> ecliptic node). Includes the ICRS frame bias.
+    Computed once per epoch array and shared by every of-date series
+    (Earth, Moon, Jupiter, Saturn)."""
     from pint_tpu.astro.erot import _rx, _rz, fukushima_williams
 
     gamb, phib, psib, _ = fukushima_williams(np.asarray(T, np.float64))
-    M = _rz(-gamb) @ _rx(-phib) @ _rz(psib)
+    return _rz(-gamb) @ _rx(-phib) @ _rz(psib)
+
+
+def _ecl_date_to_gcrs(vec: np.ndarray, T: np.ndarray, M: np.ndarray | None = None) -> np.ndarray:
+    if M is None:
+        M = _ecl_date_matrix(T)
     return np.einsum("...ij,...j->...i", M, vec)
 
 
@@ -254,10 +263,31 @@ class AnalyticEphemeris:
         "emb",
     )
 
-    def _planets_helio(self, T: np.ndarray) -> dict[str, np.ndarray]:
-        return {b: _helio_ecliptic(b, T) * AU_M for b in _ELEMENTS}
+    def _planets_helio_icrs(self, T: np.ndarray, M_fw=None) -> dict[str, np.ndarray]:
+        """Heliocentric ICRS positions [m] of the planets/EMB.
 
-    def _sun_ssb_ecl(self, helio: dict[str, np.ndarray]) -> np.ndarray:
+        Jupiter and Saturn come from their truncated VSOP87D series
+        (astro/vsop87_planets.py, of-date frame rotated to GCRS with the
+        same F-W chain as the Earth series) — the Sun-SSB wobble carries
+        1/1047 resp. 1/3498 of their position error, so mean elements are
+        not good enough for them.  The remaining planets keep the Keplerian
+        mean elements (adequate for Shapiro delays and their small wobble
+        shares)."""
+        from pint_tpu.astro import vsop87_planets
+
+        if M_fw is None:
+            M_fw = _ecl_date_matrix(T)
+        helio = {}
+        for b in _ELEMENTS:
+            if b in vsop87_planets.bodies:
+                helio[b] = _ecl_date_to_gcrs(
+                    vsop87_planets.planet_helio_ecl_date(b, T) * AU_M, T, M_fw
+                )
+            else:
+                helio[b] = (_helio_ecliptic(b, T) * AU_M) @ _ECL2EQU.T
+        return helio
+
+    def _sun_ssb_icrs(self, helio: dict[str, np.ndarray]) -> np.ndarray:
         gm_tot = GM_SUN + sum(GM_BODY[b] for b in GM_BODY)
         acc = np.zeros_like(helio["emb"])
         for b, r in helio.items():
@@ -270,27 +300,28 @@ class AnalyticEphemeris:
         J2000; shape (..., 3).
 
         Earth/Moon/EMB use the truncated VSOP87D Earth theory
-        (astro/vsop87.py) + Meeus lunar series, rotated of-date -> GCRS via
-        the F-W angles; other planets use the Keplerian mean elements
-        (adequate for Shapiro delays and the Sun-wobble constraint)."""
+        (astro/vsop87.py) + Meeus lunar series; Jupiter/Saturn their
+        VSOP87D series; other planets the Keplerian mean elements.  The Sun
+        sits at the barycentric constraint over all of them."""
         T = np.asarray(tdb_jcent, np.float64)
-        helio = self._planets_helio(T)
-        sun = self._sun_ssb_ecl(helio)
+        M_fw = _ecl_date_matrix(T)
+        helio = self._planets_helio_icrs(T, M_fw)
+        sun = self._sun_ssb_icrs(helio)
         if body == "sun":
-            return sun @ _ECL2EQU.T
+            return sun
         if body in ("earth", "moon", "emb"):
             from pint_tpu.astro import vsop87
 
-            earth = sun @ _ECL2EQU.T + _ecl_date_to_gcrs(
-                vsop87.earth_helio_ecl_date(T) * AU_M, T
+            earth = sun + _ecl_date_to_gcrs(
+                vsop87.earth_helio_ecl_date(T) * AU_M, T, M_fw
             )
             if body == "earth":
                 return earth
-            moon_gc = _ecl_date_to_gcrs(_moon_geocentric_ecliptic_date(T), T)
+            moon_gc = _ecl_date_to_gcrs(_moon_geocentric_ecliptic_date(T), T, M_fw)
             if body == "moon":
                 return earth + moon_gc
             return earth + moon_gc / (1.0 + EARTH_MOON_MASS_RATIO)
-        return (sun + helio[body]) @ _ECL2EQU.T
+        return sun + helio[body]
 
     def _posvel_analytic(self, body: str, tdb_jcent: np.ndarray, dt_s: float = 16.0):
         """(pos [m], vel [m/s]) via central differencing of the analytic

@@ -30,15 +30,15 @@ environment's substitute, validated against pulsar timing golden fits.
 Measured accuracy vs DE421 (via TEMPO2's golden roemer column on the
 J1744-1134 8-yr GASP set, tests/test_tempo2_columns.py):
 
-- total Earth-position disagreement ~520 km RMS projected on the line of
-  sight, dominated by multi-year (~5 yr) structure: the Sun-SSB wobble
-  error of the approximate giant-planet elements (Jupiter's mean
-  longitude is only good to ~arcmin; 740,000 km of wobble x 4e-4 rad
-  ~ 300 km). DE-grade accuracy there requires a real kernel
-  (PINT_TPU_EPHEM + astro/spk.py, proven by tests/test_spk.py);
-- anchored bands after the fix: annual ~20 km, harmonics 2-5 all
-  < 11 km, anomalistic month ~21 km, sidereal month ~12 km,
-  broadband remainder ~30 km.
+- round 3 (Keplerian mean elements for all planets): ~520 km RMS on the
+  line of sight, dominated by the Sun-SSB wobble error of the
+  approximate giant-planet elements (Jupiter's mean longitude only good
+  to ~400 arcsec: 740,000 km of wobble x 2e-3 rad ~ 1500 km).
+- round 4 (truncated VSOP87D series for Jupiter/Saturn,
+  astro/vsop87_planets.py): ~120 km RMS total, mostly slow drift a
+  timing fit absorbs; ~40-80 km of 0.3-2 yr structure remains (series
+  truncation + Uranus/Neptune elements). DE-grade accuracy requires a
+  real kernel (PINT_TPU_EPHEM + astro/spk.py, proven by tests/test_spk.py).
 
 The anchor BANDS are load-bearing: the 6-DOF-per-body IC fit is only
 constrained inside them, and the unconstrained combinations leak

@@ -251,7 +251,7 @@ class GLSFitter(WLSFitter):
                 mtcy = mtcy_d / norm_d
                 norm = norm_d
             dx, cov = gls_solve(mtcm, mtcy, norm, p)
-            params = apply_delta(params, self._free, dx)
+            params = apply_delta(params, self._free, dx, project_domain=True)
             sigma = np.sqrt(np.diag(cov))
             rel = np.abs(dx) / np.where(sigma == 0, 1.0, sigma)
             if np.all(rel < xtol):
@@ -294,7 +294,8 @@ class DownhillGLSFitter(GLSFitter):
             compute_pieces=lambda pr: self._step_fn(pr, self.tensor),
             solve=lambda pc, lam: gls_solve(pc[2], pc[3], pc[4], p, lam=lam)[0],
             chi2_of=self.chi2_at,
-            apply_step=lambda pr, dx: apply_delta(pr, self._free, dx),
+            apply_step=lambda pr, dx: apply_delta(pr, self._free, dx,
+                                                  project_domain=True),
             maxiter=maxiter, required_gain=required_chi2_decrease,
             max_rejects=max_rejects, log_label="downhill GLS fit",
         )

@@ -198,7 +198,8 @@ class WidebandDownhillFitter(WLSFitter):
             compute_pieces=lambda pr: step(*self._args(pr)),
             solve=lambda pc, lam: gls_solve(pc[1], pc[2], pc[3], p, lam=lam)[0],
             chi2_of=self.chi2_at,
-            apply_step=lambda pr, dx: apply_delta(pr, self._free, dx),
+            apply_step=lambda pr, dx: apply_delta(pr, self._free, dx,
+                                                  project_domain=True),
             maxiter=maxiter, required_gain=required_chi2_decrease,
             max_rejects=max_rejects, log_label="wideband fit",
         )

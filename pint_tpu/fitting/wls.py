@@ -58,20 +58,61 @@ def ftest(chi2_1: float, dof_1: int, chi2_2: float, dof_2: int) -> float:
     return float(fdist.sf(F, delta_dof, dof_2))
 
 
-def apply_delta(params: dict, free_names: tuple[str, ...], delta: Array) -> dict:
+# hard physical domains: a Gauss-Newton step from a far-off-minimum start
+# (e.g. prefit offsets from the built-in ephemeris) can propose e.g.
+# SINI > 1, whose sqrt/arcsin turns the whole residual vector NaN — the
+# reference raises InvalidModelParameters and backtracks
+# (fitter.py:1036,1196-1240); here the step itself is projected onto the
+# domain boundary (works identically under jit, and the next linearization
+# proceeds from the clamped point)
+_EPS_DOM = 1e-12
+_PARAM_DOMAIN = {
+    "SINI": (-1.0 + _EPS_DOM, 1.0 - _EPS_DOM),
+    "ECC": (0.0, 1.0 - _EPS_DOM),
+    "EPS1": (-0.7, 0.7),
+    "EPS2": (-0.7, 0.7),
+    "STIGMA": (-1.0 + _EPS_DOM, 1.0 - _EPS_DOM),
+}
+
+
+def apply_delta(
+    params: dict,
+    free_names: tuple[str, ...],
+    delta: Array,
+    project_domain: bool = False,
+) -> dict:
     """params + delta over the free subset; extended-precision leaves (DD or
-    QF) absorb f64 steps without losing their low-order bits."""
+    QF) absorb f64 steps without losing their low-order bits.
+
+    ``project_domain=True`` (the FITTER step semantics) projects parameters
+    with a hard physical domain back onto it. Samplers must NOT set it: an
+    MCMC proposal outside the domain has to be evaluated where it was
+    proposed (and score NaN -> -inf), not silently moved to the boundary,
+    or the posterior grows a flat plateau past the physical limit."""
     from pint_tpu.ops.qf32 import QF, qf_add_f64
 
     new = dict(params)
     for i, n in enumerate(free_names):
         v = params[n]
+        dom = _PARAM_DOMAIN.get(n) if project_domain else None
         if isinstance(v, DD):
-            new[n] = dd_add_fp(v, delta[i])
+            out = dd_add_fp(v, delta[i])
+            if dom is not None:
+                # clamp on the high word; the low word is sub-ulp of the bound
+                hi = jnp.clip(out.hi, dom[0], dom[1])
+                out = DD(hi, jnp.where(hi == out.hi, out.lo, 0.0))
+            new[n] = out
         elif isinstance(v, QF):
-            new[n] = qf_add_f64(v, delta[i])
+            out = qf_add_f64(v, delta[i])
+            if dom is not None:
+                hi = jnp.clip(out.hi, jnp.float32(dom[0]), jnp.float32(dom[1]))
+                out = QF(hi, jnp.where(hi == out.hi, out.lo, jnp.float32(0.0)))
+            new[n] = out
         else:
-            new[n] = v + delta[i]
+            out = v + delta[i]
+            if dom is not None:
+                out = jnp.clip(out, dom[0], dom[1])
+            new[n] = out
     return new
 
 
@@ -303,7 +344,7 @@ class WLSFitter:
         converged = False
         for it in range(1, maxiter + 1):
             r0, M, dx, cov, s, vt, chi2, utb, norm = self._step_fn(params, self.tensor)
-            params = apply_delta(params, self._free, dx)
+            params = apply_delta(params, self._free, dx, project_domain=True)
             # convergence: relative step in units of parameter uncertainty
             sigma = jnp.sqrt(jnp.diag(cov))
             rel = np.asarray(jnp.abs(dx) / jnp.where(sigma == 0, 1.0, sigma))
@@ -445,7 +486,8 @@ class DownhillWLSFitter(WLSFitter):
             compute_pieces=lambda p: self._step_fn(p, self.tensor),
             solve=solve,
             chi2_of=self.chi2_at,
-            apply_step=lambda p, dx: apply_delta(p, self._free, dx),
+            apply_step=lambda p, dx: apply_delta(p, self._free, dx,
+                                                 project_domain=True),
             maxiter=maxiter, required_gain=required_chi2_decrease,
             max_rejects=max_rejects, log_label="downhill WLS fit",
         )
@@ -473,13 +515,16 @@ class PowellFitter(WLSFitter):
         )
 
         def chi2_of(z):
-            return self.chi2_at(apply_delta(params0, self._free, z * scales))
+            return self.chi2_at(
+                apply_delta(params0, self._free, z * scales, project_domain=True)
+            )
 
         res = minimize(
             chi2_of, np.zeros(len(self._free)), method="Powell",
             options={"maxiter": maxiter, "xtol": xtol},
         )
-        params = apply_delta(params0, self._free, res.x * scales)
+        params = apply_delta(params0, self._free, res.x * scales,
+                             project_domain=True)
         # linearize once at the optimum for the covariance
         pieces = self._step_fn(params, self.tensor)
         cov = pieces[3]

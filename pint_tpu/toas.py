@@ -317,8 +317,10 @@ def get_TOAs(
     model override the defaults (reference toa.py:188-230 behavior): a model
     ``CLK TT(BIPMyyyy)`` line turns on the TAI->TT(BIPM) correction chain.
 
-    `usepickle` caches the fully prepared TOAs next to the tim file
-    (reference toa.py usepickle / pickle staleness checks): the cache is
+    `usepickle` caches the fully prepared TOAs under
+    ``$PINT_TPU_CACHE_DIR/toas`` (default ``~/.cache/pint_tpu/toas`` —
+    never beside the tim file, which often lives on a read-only tree;
+    reference toa.py usepickle / pickle staleness checks): the cache is
     invalidated by tim-file content and by the preparation settings.
     """
     import hashlib
@@ -369,8 +371,27 @@ def get_TOAs(
             eop = f"{eop}@{os.path.getmtime(eop):.0f}"
         key = (f"v{_TOA_CACHE_VERSION}-{digest}-{ephem}-{spk}-nb{nbody}-"
                f"eop{eop}-{planets}-{include_gps}-{include_bipm}-{bipm_version}")
-        cache_path = timfile + ".pint_tpu_pickle"
-        if os.path.exists(cache_path):
+        # cache lives under the user cache dir, NOT beside the tim file:
+        # datasets are often read from read-only / shared trees
+        cache_root = os.path.join(
+            os.environ.get("PINT_TPU_CACHE_DIR",
+                           os.path.expanduser("~/.cache/pint_tpu")),
+            "toas",
+        )
+        try:
+            os.makedirs(cache_root, exist_ok=True)
+            # filename carries the FULL config key, not just the tim digest:
+            # configs differing in ephem/nbody/planets/BIPM must coexist as
+            # separate files instead of thrashing one slot
+            keyhash = hashlib.sha256(key.encode()).hexdigest()[:16]
+            cache_path = os.path.join(
+                cache_root,
+                f"{os.path.basename(timfile)}.{keyhash}.pickle",
+            )
+        except OSError as e:  # unwritable cache root: skip caching
+            log.warning(f"TOA cache disabled ({e})")
+            cache_path = None
+        if cache_path is not None and os.path.exists(cache_path):
             try:
                 with open(cache_path, "rb") as f:
                     cached_key, toas = pickle.load(f)

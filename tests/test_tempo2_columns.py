@@ -10,8 +10,10 @@ column isolates our delay chain component by component:
 - tt2tb: microsecond parity of the full TT->TDB chain;
 - Roemer: limited by the built-in ephemeris (no DE kernel exists in this
   environment). Round-3's N-body anchor-band fix cut the disagreement from
-  ~1590 km RMS (a 2000 km semi-annual leak of the IC fit) to ~540 km, most
-  of it slow drift a timing fit absorbs; the guard here locks that level.
+  ~1590 km RMS (a 2000 km semi-annual leak of the IC fit) to ~540 km;
+  round-4's VSOP87D Jupiter/Saturn series (astro/vsop87_planets.py)
+  removed the giant-planet Sun-wobble error and brought it to ~87 km RMS
+  (broadband ~39 km); the guards here lock that level.
 """
 
 import os
@@ -38,8 +40,19 @@ def chain():
     from pint_tpu.residuals import Residuals
     from pint_tpu.toas import get_TOAs
 
-    model = get_model(PAR)
-    toas = get_TOAs(TIM, model=model)
+    # measure the PRODUCTION ephemeris config: N-body refinement on
+    # (conftest turns it off for speed elsewhere; the build is disk-cached
+    # under ~/.cache/pint_tpu after the first run)
+    old = os.environ.get("PINT_TPU_NBODY")
+    os.environ["PINT_TPU_NBODY"] = "1"
+    try:
+        model = get_model(PAR)
+        toas = get_TOAs(TIM, model=model)
+    finally:
+        if old is None:
+            os.environ.pop("PINT_TPU_NBODY", None)
+        else:
+            os.environ["PINT_TPU_NBODY"] = old
     res = Residuals(toas, model, subtract_mean=False)
     # columns: residuals BinaryDelay tt2tb roemer post_phase shapiro shapiroJ
     golden = np.genfromtxt(GOLDEN, skip_header=1)
@@ -67,7 +80,7 @@ class TestTempo2Columns:
         d -= d.mean()
         rms_km = np.std(d) * C_KM_S
         # total ephemeris disagreement (mostly multi-year drift)
-        assert rms_km < 700.0  # measured ~540 km
+        assert rms_km < 150.0  # measured ~87 km
         # the fit-relevant bands must stay tight: harmonic amplitudes
         mjd = toas.tdb.mjd_float()
         yr = (mjd - mjd.mean()) / 365.25
@@ -83,12 +96,12 @@ class TestTempo2Columns:
             for i, per in enumerate(pers)
         }
         # the round-2 code had 2000 km here; the anchor-band fix must hold
-        assert amps[365.25] < 100.0      # measured ~35 km
-        assert amps[182.625] < 60.0      # measured ~16 km
-        assert amps[121.75] < 60.0       # measured ~11 km
-        assert amps[27.554] < 250.0      # measured ~115 km
+        assert amps[365.25] < 60.0       # measured ~29 km
+        assert amps[182.625] < 30.0      # measured ~12 km
+        assert amps[121.75] < 30.0       # measured ~10 km
+        assert amps[27.554] < 60.0       # measured ~24 km
         broadband = np.std(d - A @ c) * C_KM_S
-        assert broadband < 120.0         # measured ~50 km
+        assert broadband < 70.0          # measured ~39 km
 
     def test_prefit_residual_parity(self, chain):
         """End-to-end: our prefit residuals vs TEMPO2's (DE421) — the
@@ -97,4 +110,4 @@ class TestTempo2Columns:
         r = np.asarray(res.time_resids)
         d = r - golden[:, 0]
         d -= d.mean()
-        assert np.std(d) * 1e6 < 2500.0  # measured ~1800 us (ephemeris drift)
+        assert np.std(d) * 1e6 < 500.0  # measured ~290 us (ephemeris drift)

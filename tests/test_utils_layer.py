@@ -258,7 +258,7 @@ class TestMiscAdditions:
         s = m1.compare(m2)
         assert "F0" in s and "!" in s
 
-    def test_toa_pickle_cache(self, tmp_path):
+    def test_toa_pickle_cache(self, tmp_path, monkeypatch):
         import shutil
 
         from pint_tpu.toas import get_TOAs
@@ -268,8 +268,13 @@ class TestMiscAdditions:
             pytest.skip("reference data absent")
         tim = tmp_path / "c.tim"
         shutil.copy(src, tim)
+        # cache goes under PINT_TPU_CACHE_DIR, never beside the tim file
+        # (datasets are often on read-only trees)
+        monkeypatch.setenv("PINT_TPU_CACHE_DIR", str(tmp_path / "cache"))
         t1 = get_TOAs(str(tim), usepickle=True)
-        assert (tmp_path / "c.tim.pint_tpu_pickle").exists()
+        cached = list((tmp_path / "cache" / "toas").glob("c.tim.*.pickle"))
+        assert cached, "prepared-TOA cache file not written under cache dir"
+        assert not (tmp_path / "c.tim.pint_tpu_pickle").exists()
         t2 = get_TOAs(str(tim), usepickle=True)
         np.testing.assert_array_equal(t1.tdb.mjd_float(), t2.tdb.mjd_float())
         # different settings invalidate the cache
@@ -330,10 +335,11 @@ class TestPosVel:
         assert compare_parfiles.main([str(p1), str(p2)]) == 0
         assert "F0" in capsys.readouterr().out
 
-    def test_toa_cache_include_invalidation(self, tmp_path):
+    def test_toa_cache_include_invalidation(self, tmp_path, monkeypatch):
         """Editing an INCLUDE'd tim file must invalidate the cache."""
         from pint_tpu.toas import get_TOAs
 
+        monkeypatch.setenv("PINT_TPU_CACHE_DIR", str(tmp_path / "cache"))
         inc = tmp_path / "part.tim"
         inc.write_text(
             "FORMAT 1\n"
