@@ -5,12 +5,14 @@ evaluates piecewise-linear corrections, mirroring the reference's ClockFile
 (observatory/clock_file.py:23,434,553) including validity-limit behavior
 ("warn" past the last entry).
 
-Discovery: the IPTA clock repository cannot be auto-downloaded here (the
-reference fetches it at runtime, global_clock_corrections.py:39); instead the
-chain searches ``PINT_CLOCK_OVERRIDE`` (a directory of clock files, same
-semantics as the reference's env override), then any directories given
-programmatically. With no files found, corrections are zero with a one-time
-warning — the same degraded mode the reference enters when downloads fail.
+Discovery order: ``PINT_CLOCK_OVERRIDE`` (a directory of clock files, same
+semantics as the reference's env override), directories added
+programmatically, ``$TEMPO2/clock`` / ``$TEMPO/clock``, then the global
+clock-corrections repository cache (astro/global_clock.py — synced from
+``PINT_TPU_CLOCK_REPO``, the offline-capable counterpart of the reference's
+IPTA repository download, global_clock_corrections.py:39). With no files
+found, corrections are zero with a one-time warning — the same degraded
+mode the reference enters when downloads fail.
 
 The full chain for a topocentric TOA is
   site clock -> UTC(obs) -> UTC(GPS) -> UTC  (per-site files)
@@ -229,7 +231,33 @@ def _candidate_dirs() -> list[str]:
         base = os.environ.get(env)
         if base:
             dirs.append(os.path.join(base, "clock"))
+    # global clock-corrections repository cache (astro/global_clock.py):
+    # synced lazily from PINT_TPU_CLOCK_REPO; pre-existing cache contents
+    # are used even when no repository is configured
+    from pint_tpu.astro.global_clock import sync_if_configured
+
+    gc = sync_if_configured()
+    if gc is not None:
+        dirs.append(str(gc))
     return [d for d in dirs if os.path.isdir(d)]
+
+
+def clock_state_fingerprint() -> str:
+    """Short hash of every discoverable clock file's (path, mtime): cache
+    keys over prepared TOAs include it so a refreshed clock file (e.g. a
+    PINT_TPU_CLOCK_REPO sync) invalidates them."""
+    import hashlib
+
+    h = hashlib.sha256()
+    for d in _candidate_dirs():
+        try:
+            for fname in sorted(os.listdir(d)):
+                if fname.endswith(".clk") or fname.endswith(".dat"):
+                    p = os.path.join(d, fname)
+                    h.update(f"{p}@{os.path.getmtime(p):.0f};".encode())
+        except OSError:
+            continue
+    return h.hexdigest()[:12]
 
 
 def get_clock_chain(obs_name: str, include_gps: bool = True, include_bipm: bool = False, bipm_version: str = "BIPM2019") -> ClockChain:
@@ -255,6 +283,7 @@ def get_clock_chain(obs_name: str, include_gps: bool = True, include_bipm: bool 
         log.warning(
             f"no clock files found for {obs_name!r} (searched {_candidate_dirs() or 'nothing'}); "
             "using zero clock corrections. Set PINT_CLOCK_OVERRIDE to a directory of "
-            ".clk/time.dat files for real corrections."
+            ".clk/time.dat files, or PINT_TPU_CLOCK_REPO to a clock-corrections "
+            "repository (URL or local mirror), for real corrections."
         )
     return chain

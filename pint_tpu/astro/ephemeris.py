@@ -248,7 +248,10 @@ class AnalyticEphemeris:
     """Built-in analytic solar-system ephemeris (see module docstring)."""
 
     name = "analytic"
-    _nbody = None  # lazy NBodyEphemeris refinement (set per instance)
+
+    def __init__(self):
+        #: quantized-window key -> NBodyEphemeris (see _nbody_for)
+        self._nbody_windows: dict = {}
     bodies = (
         "sun",
         "mercury",
@@ -335,23 +338,34 @@ class AnalyticEphemeris:
         return pos, vel
 
     def _nbody_for(self, T: np.ndarray):
-        """Lazy span-scoped N-body refinement (astro/nbody.py); returns None
-        when disabled via PINT_TPU_NBODY=0."""
+        """Lazy N-body refinement (astro/nbody.py) on a DETERMINISTIC,
+        quantized window; returns None when disabled via PINT_TPU_NBODY=0.
+
+        The window depends only on the REQUESTED time range — center
+        snapped to whole years, span to multiples of 4 years — never on
+        what else the process loaded before (the round-3 code extended one
+        shared window to the union of every request, which made served
+        positions depend on dataset LOAD ORDER: the hybrid in-band
+        correction leaves window-shaped residuals, so the same dataset
+        could see tens of km of difference between a standalone run and a
+        multi-dataset session). Windows are cached per quantized key, and
+        each build is also disk-cached (nbody.py)."""
         if os.environ.get("PINT_TPU_NBODY", "1") == "0":
             return None
-        nb = self._nbody
-        if nb is not None and nb.covers(T):
-            return nb
-        from pint_tpu.astro.nbody import NBodyEphemeris
-
         lo = float(np.min(T))
         hi = float(np.max(T))
-        if nb is not None:  # extend to cover the union of requests
-            lo = min(lo, nb.t0 + nb.grid_s[0] / (36525.0 * 86400.0))
-            hi = max(hi, nb.t0 + nb.grid_s[-1] / (36525.0 * 86400.0))
-        span_yr = max((hi - lo) * 100.0 + 4.0, 12.0)
-        self._nbody = NBodyEphemeris(self, (lo + hi) / 2.0, span_years=span_yr)
-        return self._nbody
+        yr = 365.25 * 86400.0 / (36525.0 * 86400.0)  # 1 year in jcent
+        t0_q = round(((lo + hi) / 2.0) / yr) * yr
+        # span: data + 4 yr margin + 1 yr quantization slack, snapped UP to
+        # a multiple of 4 years, floor 12
+        span_yr = max(4.0 * np.ceil(((hi - lo) * 100.0 + 5.0) / 4.0), 12.0)
+        key = (round(t0_q, 6), span_yr)
+        cache = self._nbody_windows
+        if key not in cache:
+            from pint_tpu.astro.nbody import NBodyEphemeris
+
+            cache[key] = NBodyEphemeris(self, t0_q, span_years=span_yr)
+        return cache[key]
 
     def posvel_ssb(self, body: str, tdb_jcent: np.ndarray, dt_s: float = 16.0):
         """(pos [m], vel [m/s]), N-body refined when available.

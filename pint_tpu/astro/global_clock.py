@@ -1,0 +1,269 @@
+"""Global clock-corrections repository: index, staleness, sync, export.
+
+Reference: observatory/global_clock_corrections.py — PINT keeps observatory
+clock corrections current by syncing from the IPTA
+``pulsar-clock-corrections`` repository: an ``index.txt`` listing each
+file's update interval and a hard "invalid if older than" date
+(Index:149), per-file freshness policies (get_file:39), and bulk
+update/export (update_all:228).
+
+TPU-build redesign: the reference leans on astropy's download cache and
+assumes a network. Here the repository location is pluggable — an https
+URL *or a plain local directory* (the common case on air-gapped clusters:
+someone rsyncs the repository to shared storage) — via ``PINT_TPU_CLOCK_REPO``
+or the ``url_base`` argument, and the synced files live in a flat cache
+under ``$PINT_TPU_CACHE_DIR/clock_corrections`` whose mtimes record the
+last sync, reproducing the reference's expiry semantics without astropy.
+``astro/clock.py`` adds that cache to its search path automatically, so a
+configured repository feeds ``get_clock_chain`` with no further wiring.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import time
+from dataclasses import dataclass
+from datetime import datetime, timezone
+from pathlib import Path
+
+from pint_tpu.utils.logging import get_logger
+
+log = get_logger("pint_tpu.global_clock")
+
+#: repository-relative name of the index (reference index_name)
+INDEX_NAME = "index.txt"
+#: the index itself is refreshed at most daily (reference
+#: index_update_interval_days)
+INDEX_UPDATE_INTERVAL_DAYS = 1.0
+
+
+def repo_base() -> str | None:
+    """The configured repository location (env PINT_TPU_CLOCK_REPO): an
+    https/file URL or a local directory; None when unconfigured."""
+    return os.environ.get("PINT_TPU_CLOCK_REPO") or None
+
+
+def cache_dir() -> Path:
+    from pint_tpu.utils.cache import cache_root
+
+    return cache_root() / "clock_corrections"
+
+
+def _fetch(base: str, name: str, dest: Path) -> None:
+    """Copy `name` from the repository at `base` into `dest`.
+
+    Local-directory and file:// bases are a plain copy; http(s) bases go
+    through urllib (works only when the environment has egress)."""
+    if base.startswith(("http://", "https://")):
+        from urllib.request import urlopen
+
+        url = base.rstrip("/") + "/" + name
+        with urlopen(url, timeout=30) as r:
+            data = r.read()
+        dest.parent.mkdir(parents=True, exist_ok=True)
+        tmp = dest.with_suffix(dest.suffix + f".tmp{os.getpid()}")
+        tmp.write_bytes(data)
+        tmp.replace(dest)
+        return
+    if base.startswith("file://"):
+        base = base[len("file://"):]
+    src = Path(base) / name
+    if not src.exists():
+        raise FileNotFoundError(f"{name} not in repository {base}")
+    dest.parent.mkdir(parents=True, exist_ok=True)
+    tmp = dest.with_suffix(dest.suffix + f".tmp{os.getpid()}")
+    shutil.copy(src, tmp)
+    tmp.replace(dest)
+
+
+def get_file(
+    name: str,
+    update_interval_days: float = 7.0,
+    download_policy: str = "if_expired",
+    url_base: str | None = None,
+    url_mirrors: list[str] | None = None,
+    invalid_if_older_than: float | None = None,
+) -> Path:
+    """Local path of a current copy of `name` (reference get_file:39).
+
+    The cached copy's mtime records when it was last synced. Policies:
+    "always" (re-sync unconditionally), "never" (cache only;
+    FileNotFoundError when absent), "if_expired" (re-sync when older than
+    `update_interval_days`; fall back to the stale copy, with a warning,
+    when the repository is unreachable), "if_missing" (sync only when no
+    cached copy exists). `invalid_if_older_than` is a unix timestamp below
+    which the cached copy is force-refreshed.
+    """
+    if url_base is None:
+        url_base = repo_base()
+    if url_mirrors is None:
+        url_mirrors = [url_base] if url_base else []
+    local = cache_dir() / Path(name).name
+    have = local.exists()
+
+    if download_policy == "never":
+        if not have:
+            raise FileNotFoundError(name)
+        return local
+    if download_policy == "if_missing" and have:
+        return local
+
+    if have and invalid_if_older_than is not None:
+        if local.stat().st_mtime < invalid_if_older_than:
+            log.info(f"clock file {name} older than its validity date; re-syncing")
+            have = False
+
+    if download_policy == "if_expired" and have:
+        age_days = (time.time() - local.stat().st_mtime) / 86400.0
+        if age_days < update_interval_days:
+            return local
+        log.info(
+            f"clock file {name} is {age_days:.1f} d old "
+            f"(update interval {update_interval_days} d); re-syncing"
+        )
+
+    if not url_mirrors:
+        if have:
+            log.warning(
+                f"clock file {name} is stale but no repository is configured "
+                "(PINT_TPU_CLOCK_REPO); using the cached copy"
+            )
+            return local
+        raise FileNotFoundError(
+            f"{name}: not cached and no clock repository configured "
+            "(set PINT_TPU_CLOCK_REPO)"
+        )
+    last_err: Exception | None = None
+    for base in url_mirrors:
+        try:
+            _fetch(base, name, local)
+            return local
+        except Exception as e:  # noqa: BLE001 — try the next mirror
+            last_err = e
+    if have:
+        log.warning(
+            f"clock file {name} should be refreshed but every mirror failed "
+            f"({last_err}); using the stale cached copy"
+        )
+        return local
+    raise FileNotFoundError(f"{name}: all mirrors failed ({last_err})")
+
+
+@dataclass
+class IndexEntry:
+    """One line of index.txt (reference IndexEntry namedtuple)."""
+
+    file: str  # repository-relative path
+    update_interval_days: float
+    invalid_if_older_than: float | None  # unix timestamp
+    extra: str = ""
+
+
+def _parse_date(tok: str) -> float | None:
+    if tok == "---":
+        return None
+    return datetime.fromisoformat(tok).replace(tzinfo=timezone.utc).timestamp()
+
+
+class Index:
+    """Parsed repository index: basename -> IndexEntry (reference Index:149).
+
+    Format per line: ``<path> <update_interval_days> <iso-date-or---> [note]``;
+    '#' comments and blank lines ignored.
+    """
+
+    def __init__(self, download_policy: str = "if_expired",
+                 url_base: str | None = None,
+                 url_mirrors: list[str] | None = None):
+        index_file = get_file(
+            INDEX_NAME,
+            INDEX_UPDATE_INTERVAL_DAYS,
+            download_policy=download_policy,
+            url_base=url_base,
+            url_mirrors=url_mirrors,
+        )
+        self.files: dict[str, IndexEntry] = {}
+        for line in Path(index_file).read_text().splitlines():
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            toks = line.split(maxsplit=3)
+            if len(toks) < 3:
+                log.warning(f"malformed index line skipped: {line!r}")
+                continue
+            entry = IndexEntry(
+                file=toks[0],
+                update_interval_days=float(toks[1]),
+                invalid_if_older_than=_parse_date(toks[2]),
+                extra=toks[3] if len(toks) > 3 else "",
+            )
+            self.files[Path(entry.file).name] = entry
+
+
+def get_clock_correction_file(
+    filename: str,
+    download_policy: str = "if_expired",
+    url_base: str | None = None,
+    url_mirrors: list[str] | None = None,
+) -> Path:
+    """Current copy of one indexed clock file (reference
+    get_clock_correction_file:187); unknown names raise KeyError."""
+    index = Index(download_policy=download_policy, url_base=url_base,
+                  url_mirrors=url_mirrors)
+    details = index.files[filename]
+    return get_file(
+        details.file,
+        update_interval_days=details.update_interval_days,
+        download_policy=download_policy,
+        url_base=url_base,
+        url_mirrors=url_mirrors,
+        invalid_if_older_than=details.invalid_if_older_than,
+    )
+
+
+def update_all(
+    export_to: str | os.PathLike | None = None,
+    download_policy: str = "if_expired",
+    url_base: str | None = None,
+    url_mirrors: list[str] | None = None,
+) -> list[Path]:
+    """Sync every file in the index; optionally export copies to a
+    directory (reference update_all:228). Returns the local paths."""
+    index = Index(download_policy=download_policy, url_base=url_base,
+                  url_mirrors=url_mirrors)
+    out = []
+    for filename, details in index.files.items():
+        f = get_file(
+            details.file,
+            update_interval_days=details.update_interval_days,
+            download_policy=download_policy,
+            url_base=url_base,
+            url_mirrors=url_mirrors,
+            invalid_if_older_than=details.invalid_if_older_than,
+        )
+        out.append(f)
+        if export_to is not None:
+            dest = Path(export_to) / filename
+            dest.parent.mkdir(parents=True, exist_ok=True)
+            dest.write_bytes(Path(f).read_bytes())
+    return out
+
+
+_synced = False
+
+
+def sync_if_configured() -> Path | None:
+    """One-per-process lazy sync used by astro/clock.py discovery: when a
+    repository is configured, refresh the cache (stale copies survive a
+    broken mirror) and return the cache dir to add to the search path."""
+    global _synced
+    if repo_base() is None:
+        return cache_dir() if cache_dir().is_dir() else None
+    if not _synced:
+        _synced = True
+        try:
+            update_all()
+        except Exception as e:  # degraded mode: whatever is cached gets used
+            log.warning(f"clock repository sync failed: {e}")
+    return cache_dir() if cache_dir().is_dir() else None

@@ -85,6 +85,20 @@ _FIT_BODIES = ("earth", "moon")  # ICs refined against the analytic anchors
 _ANCHOR_PERIODS_E = (365.25, 182.625, 121.75, 91.3125, 73.05,
                      779.94, 583.92, 398.88)
 _ANCHOR_PERIODS_M = (27.321662, 27.554550, 31.811940, 29.530589, 13.660831)
+# the Earth anchor additionally gets a harmonic COMB of LONG periods
+# (span/1, span/2, ... down to this floor): the integration carries a
+# quartic drift (residual giant-planet series error exerts ~1e-10 m/s^2 of
+# tidal acceleration error), and with only poly+line anchors that drift
+# LEAKED into the unanchored 1.5-6 yr band differently for every window
+# choice (measured: the same dataset's postfit moved 14 -> 82 us between
+# two window centers). Pinning the drift band to the analytic theory
+# (good to ~10-50 km there) makes serving window-robust, while everything
+# faster than the floor still comes from the dynamics — whose forced-
+# oscillation reconstruction beats the truncated series there (pinning the
+# whole mid band was tried and REGRESSED NGC6440E 63 -> 98 us).
+# Harmonic (equal-frequency) spacing keeps the comb resolvable on the
+# window.
+_COMB_FLOOR_D = 550.0
 
 
 def _accelerations(pos: np.ndarray, vel: np.ndarray) -> np.ndarray:
@@ -135,7 +149,7 @@ class NBodyEphemeris:
 
     #: bump when the integration/refinement algorithm changes — invalidates
     #: every cached solution on disk
-    _CACHE_VERSION = 7
+    _CACHE_VERSION = 8
 
     def __init__(self, base, t0_jcent: float, span_years: float = 16.0,
                  grid_days: float = 0.5, refine_iters: int = 3):
@@ -174,7 +188,7 @@ class NBodyEphemeris:
             repr((
                 self._CACHE_VERSION, round(self.t0, 10), round(self.half_span_s, 3),
                 self.grid_days, refine_iters, _BODIES, _GMS.tobytes(),
-                _ANCHOR_PERIODS_E, _ANCHOR_PERIODS_M,
+                self._earth_periods(), _ANCHOR_PERIODS_M,
                 type(self.base).__name__, probe.tobytes(),
             )).encode()
         ).hexdigest()[:24]
@@ -261,6 +275,20 @@ class NBodyEphemeris:
                 modes[6 * fi + k] = d / eps
         return modes
 
+    def _earth_periods(self) -> tuple:
+        """Line anchors + the long-period drift comb (see _COMB_FLOOR_D
+        note): harmonics of the window span down to the floor, skipping any
+        within 8% of an existing line."""
+        pers = list(_ANCHOR_PERIODS_E)
+        span_d = 2.0 * self.half_span_s / DAY_S
+        k = 1
+        while span_d / k > _COMB_FLOOR_D:
+            p = span_d / k
+            if all(abs(p / q - 1.0) > 0.08 for q in pers):
+                pers.append(round(p, 3))
+            k += 1
+        return tuple(pers)
+
     def _band_design(self, t: np.ndarray, periods_d, deriv: bool = False):
         """Design matrix of the TRUSTED band of an analytic anchor:
         {1, t, ..., t^4} + (1, t) x sin/cos at the given periods.
@@ -328,7 +356,7 @@ class NBodyEphemeris:
         # DE421 when only the fundamental was anchored, while the VSOP
         # series is good to ~10 km there). Monthly stays excluded (the
         # integrated lunar wobble is better than any truncated series).
-        self._periods_e = _ANCHOR_PERIODS_E
+        self._periods_e = self._earth_periods()
         self._periods_m = _ANCHOR_PERIODS_M
         G_e = self._band_design(fit_grid, self._periods_e)
         G_m = self._band_design(fit_grid, self._periods_m)

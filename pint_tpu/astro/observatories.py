@@ -64,6 +64,52 @@ class GeocenterObs(Observatory):
 
 
 @dataclass
+class T2SpacecraftObs(Observatory):
+    """Spacecraft whose GCRS state rides on per-TOA flags, tempo2-style
+    (reference special_locations.py:159): ``-telx/-tely/-telz`` position in
+    km, optional ``-vx/-vy/-vz`` velocity in km/s. The tempo2-compatible way
+    to barycenter spacecraft data without an orbit file."""
+
+    needs_flags: bool = True
+
+    def site_posvel_gcrs(self, ut1_mjd, tt_jcent, xp_rad=None, yp_rad=None):
+        raise ValueError(
+            f"observatory {self.name!r} takes its position from per-TOA "
+            "-telx/-tely/-telz flags; TOAs without them cannot be prepared"
+        )
+
+    def site_posvel_gcrs_flags(self, flags: list[dict]):
+        """(pos[m], vel[m/s]) wrt geocenter from the rows' flags."""
+        try:
+            pos = np.array(
+                [[float(f["telx"]), float(f["tely"]), float(f["telz"])] for f in flags]
+            ) * 1e3
+        except KeyError as e:
+            raise ValueError(
+                f"observatory {self.name!r} needs -telx/-tely/-telz flags "
+                f"(km, GCRS) on every TOA; missing {e}"
+            ) from None
+        # per-row velocities; rows without -vx/-vy/-vz get zero (with a
+        # warning) instead of discarding the velocities other rows supplied
+        vel = np.zeros_like(pos)
+        missing = []
+        for i, f in enumerate(flags):
+            if "vx" in f and "vy" in f and "vz" in f:
+                vel[i] = [float(f["vx"]), float(f["vy"]), float(f["vz"])]
+                vel[i] *= 1e3
+            else:
+                missing.append(i)
+        if missing and len(missing) < len(flags):
+            from pint_tpu.utils.logging import get_logger
+
+            get_logger("pint_tpu.observatory").warning(
+                f"{self.name}: {len(missing)} of {len(flags)} TOAs lack "
+                "-vx/-vy/-vz velocity flags; those rows get zero GCRS velocity"
+            )
+        return pos, vel
+
+
+@dataclass
 class BarycenterObs(Observatory):
     """TOAs already referred to the SSB: no site, no Roemer, TDB timescale."""
 
@@ -119,6 +165,7 @@ _BUILTIN = [
     # UTC leap-second chain): Fermi GEO FT1, geocentered X-ray events
     GeocenterObs("geocenter_tt", ("geo_tt",), "tt"),
     BarycenterObs("barycenter", ("@", "bat", "ssb"), "tdb"),
+    T2SpacecraftObs("stl_geo", ("stl",)),
 ]
 
 _registry: dict[str, Observatory] = {}

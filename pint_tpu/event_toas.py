@@ -17,6 +17,8 @@ Supported geometries:
 
 from __future__ import annotations
 
+import os
+
 import numpy as np
 
 from pint_tpu.io.fitsio import find_extension, read_fits
@@ -25,14 +27,67 @@ from pint_tpu.utils.logging import get_logger
 log = get_logger("pint_tpu.event_toas")
 
 # per-mission energy conversion: PHA/PI channel -> keV (reference
-# event_toas.py mission tables)
+# event_toas.py mission tables; IXPE: 2019SPIE11118E..0VO — PI bins of
+# 0.04 keV, default TOA uncertainty 20 us in the reference's table :46)
 _MISSION_ENERGY = {
     "nicer": ("PI", 0.01),
     "nustar": ("PI", 0.04),
     "rxte": ("PHA", None),
     "xmm": ("PI", 0.001),
     "swift": ("PI", 0.01),
+    "ixpe": ("PI", 0.04),
 }
+
+
+def read_mission_info_from_heasoft() -> dict:
+    """Mission defaults from a HEASOFT installation's ``xselect.mdb``
+    (reference event_toas.py:74): ``MISSION:key[:subkey] value`` lines
+    become nested dicts, e.g. ``NICER:events EVENTS`` ->
+    ``{"nicer": {"events": "EVENTS"}}``. Empty when $HEADAS is unset —
+    the built-in tables then stand alone."""
+    headas = os.getenv("HEADAS")
+    if not headas:
+        return {}
+    fname = os.path.join(headas, "bin", "xselect.mdb")
+    if not os.path.exists(fname):
+        return {}
+    db: dict = {}
+    with open(fname) as fobj:
+        for line in fobj:
+            line = line.strip()
+            if not line or line.startswith("!"):
+                continue
+            toks = line.split()
+            path, value = toks[0], toks[1:]
+            if len(value) == 1:
+                value = value[0]
+            keys = path.split(":")
+            node = db.setdefault(keys[0].lower(), {})
+            for k in keys[1:-1]:
+                node = node.setdefault(k, {})
+            if len(keys) > 1:
+                node[keys[-1]] = value
+    return db
+
+
+def mission_config(mission: str) -> dict:
+    """Effective config for a mission: events-extension name and energy
+    column, from the built-in table with HEASOFT's xselect.mdb filling in
+    unknown missions (reference create_mission_config, event_toas.py:116)."""
+    m = mission.lower()
+    cfg = {"extname": "EVENTS", "ecol": None, "ekev": None}
+    if m in _MISSION_ENERGY:
+        cfg["ecol"], cfg["ekev"] = _MISSION_ENERGY[m]
+    heasoft = read_mission_info_from_heasoft().get(m, {})
+
+    def _first(v):  # multi-token mdb values arrive as lists
+        return str(v[0] if isinstance(v, list) else v)
+
+    if "events" in heasoft:
+        cfg["extname"] = _first(heasoft["events"])
+    if cfg["ecol"] is None and "ecol" in heasoft:
+        cfg["ecol"] = _first(heasoft["ecol"])
+    return cfg
 
 
 def read_fits_event_mjds(eventfile: str, extname: str = "EVENTS"):
@@ -78,7 +133,8 @@ def load_event_TOAs(
     from pint_tpu.astro import time as ptime
     from pint_tpu.toas import prepare_arrays
 
-    (day, frac), data, h = read_fits_event_mjds(eventfile)
+    cfg = mission_config(mission)
+    (day, frac), data, h = read_fits_event_mjds(eventfile, extname=cfg["extname"])
     timesys = str(h.get("TIMESYS", "TT")).strip().upper()
     timeref = str(h.get("TIMEREF", "LOCAL")).strip().upper()
     if timesys == "TDB":
@@ -117,13 +173,12 @@ def load_event_TOAs(
         en = np.asarray(data["ENERGY"])[keep]  # MeV
         for i in range(n):
             flags[i]["energy"] = f"{en[i]:.2f}"
-    ecol = _MISSION_ENERGY.get(mission_l)
-    if ecol and ecol[0] in data:
-        chans = np.asarray(data[ecol[0]])[keep]
+    if cfg["ecol"] and cfg["ecol"] in data:
+        chans = np.asarray(data[cfg["ecol"]])[keep]
         for i in range(n):
-            flags[i][ecol[0].lower()] = str(int(chans[i]))
-            if ecol[1] is not None:
-                flags[i]["energy"] = f"{chans[i] * ecol[1]:.4f}"
+            flags[i][cfg["ecol"].lower()] = str(int(chans[i]))
+            if cfg["ekev"] is not None:
+                flags[i]["energy"] = f"{chans[i] * cfg['ekev']:.4f}"
     if weight_column is not None:
         if weight_column not in data:
             raise KeyError(
@@ -162,6 +217,14 @@ def load_NuSTAR_TOAs(eventfile: str, **kw):
 
 def load_XMM_TOAs(eventfile: str, **kw):
     return load_event_TOAs(eventfile, "xmm", **kw)
+
+
+def load_IXPE_TOAs(eventfile: str, **kw):
+    return load_event_TOAs(eventfile, "ixpe", **kw)
+
+
+def load_Swift_TOAs(eventfile: str, **kw):
+    return load_event_TOAs(eventfile, "swift", **kw)
 
 
 def load_Fermi_TOAs(

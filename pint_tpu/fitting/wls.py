@@ -72,6 +72,8 @@ _PARAM_DOMAIN = {
     "EPS1": (-0.7, 0.7),
     "EPS2": (-0.7, 0.7),
     "STIGMA": (-1.0 + _EPS_DOM, 1.0 - _EPS_DOM),
+    "M2": (0.0, np.inf),
+    "MTOT": (0.0, np.inf),
 }
 
 
@@ -105,7 +107,16 @@ def apply_delta(
         elif isinstance(v, QF):
             out = qf_add_f64(v, delta[i])
             if dom is not None:
-                hi = jnp.clip(out.hi, jnp.float32(dom[0]), jnp.float32(dom[1]))
+                # round the f64 bounds INWARD to float32: a plain cast of
+                # 1 - 1e-12 lands exactly on 1.0, the singular point the
+                # margin exists to avoid
+                lo32 = np.float32(dom[0])
+                if lo32 < dom[0]:
+                    lo32 = np.nextafter(lo32, np.float32(np.inf))
+                hi32 = np.float32(dom[1])
+                if hi32 > dom[1]:
+                    hi32 = np.nextafter(hi32, np.float32(-np.inf))
+                hi = jnp.clip(out.hi, lo32, hi32)
                 out = QF(hi, jnp.where(hi == out.hi, out.lo, jnp.float32(0.0)))
             new[n] = out
         else:

@@ -372,10 +372,7 @@ def grid_chisq_derived(
     return chi2.reshape(out_shape), parvalues
 
 
-def _grid_single(model, parnames, free, subtract_mean, maxiter, pts, params, data,
-                 batch, correlated):
-    from pint_tpu.ops.compile import precision_jit
-
+def _grid_tiles(pts, batch):
     npts = pts.shape[0]
     if batch is None:
         batch = npts if npts <= 64 else 16
@@ -383,10 +380,16 @@ def _grid_single(model, parnames, free, subtract_mean, maxiter, pts, params, dat
     n_pad = (-npts) % batch
     if n_pad:
         pts = np.concatenate([pts, np.repeat(pts[-1:], n_pad, axis=0)])
-    tiles = jnp.asarray(pts.reshape(-1, batch, pts.shape[1]))
+    return jnp.asarray(pts.reshape(-1, batch, pts.shape[1])), batch
 
-    # compiled program cached on the model: repeated scans (bench repeats,
-    # profile sweeps) must not re-trace/re-compile
+
+def _grid_single_fn(model, parnames, free, subtract_mean, maxiter, batch,
+                    correlated):
+    """The compiled-program cache entry for a single-chip grid scan:
+    repeated scans (bench repeats, profile sweeps) must not
+    re-trace/re-compile."""
+    from pint_tpu.ops.compile import precision_jit
+
     cache = model.__dict__.setdefault("_grid_fn_cache", {})
     key = ("single", parnames, free, subtract_mean, maxiter, batch,
            correlated, model.xprec.name)
@@ -397,7 +400,57 @@ def _grid_single(model, parnames, free, subtract_mean, maxiter, pts, params, dat
         cache[key] = precision_jit(
             lambda tiles, params, data: jax.lax.map(lambda t: vk(t, params, data), tiles)
         )
-    return cache[key](tiles, params, data).reshape(-1)
+    return cache[key], key
+
+
+def _grid_single(model, parnames, free, subtract_mean, maxiter, pts, params, data,
+                 batch, correlated):
+    tiles, batch = _grid_tiles(pts, batch)
+    fn, key = _grid_single_fn(model, parnames, free, subtract_mean, maxiter,
+                              batch, correlated)
+    # a precompiled AOT executable (precompile_grid) is keyed by the exact
+    # tile shape; fall through to the shape-polymorphic jit wrapper otherwise
+    aot = model._grid_fn_cache.get((*key, "aot", tiles.shape))
+    if aot is not None:
+        fn = aot
+    return fn(tiles, params, data).reshape(-1)
+
+
+def precompile_grid(fitter, parnames, parvalues, maxiter: int = 1,
+                    batch: int | None = None):
+    """Ahead-of-time compile the grid program for the given scan shape.
+
+    Compilation is host-side work: calling this from a worker thread while
+    the chip is busy (e.g. running the initial fit) overlaps the two, so
+    the first `grid_chisq` call finds the executable ready. The compiled
+    program lands in the same in-process cache `grid_chisq` uses; the
+    persistent XLA cache makes repeat processes cheap too.
+
+    Thread-safe with respect to a concurrent fit: it touches only the
+    model's structure (read-only) and jax's compiler. Returns the number
+    of grid points the compiled program covers.
+    """
+    from pint_tpu.fitting.gls import GLSFitter
+
+    model = fitter.model
+    grids = np.meshgrid(*[np.asarray(v, np.float64) for v in parvalues])
+    pts = np.stack([g.ravel() for g in grids], axis=1)
+    free = tuple(n for n in model.free_params if n not in parnames)
+    correlated = isinstance(fitter, GLSFitter) and model.has_correlated_errors
+    tiles, batch = _grid_tiles(pts, batch)
+    fn, key = _grid_single_fn(model, tuple(parnames), free,
+                              fitter.resids.subtract_mean, maxiter, batch,
+                              correlated)
+    params = model.xprec.convert_params(model.params)
+    data = _host_data(fitter.resids, fitter.tensor)
+    compiled = fn.lower(tiles, params, data).compile()
+    # the AOT executable is valid only for this exact tile shape: store it
+    # under a shape-qualified key so different-sized scans still reach the
+    # shape-polymorphic jit wrapper
+    model._grid_fn_cache[(*key, "aot", tiles.shape)] = (
+        lambda t, p, d: compiled(t, p, d)
+    )
+    return pts.shape[0]
 
 
 def _grid_sharded(model, parnames, free, subtract_mean, maxiter, mesh,

@@ -1,0 +1,355 @@
+"""Headless interactive-timing session: the pintk core as a library.
+
+Reference: pint/pintk/pulsar.py:664 — the state machine under the Tkinter
+GUI (delete/restore TOAs, jump selected TOAs, phase wraps, refit, reset,
+random-model envelopes). The reference couples this to widgets; here the
+same operations are a plain object so scripts, notebooks and the thin
+matplotlib front end (pint_tpu.plot_utils.InteractivePlot) share one core.
+
+TPU-first redesign notes:
+
+- every edit operates on host-side state (flags, deleted-index set); device
+  tensors and compiled programs are rebuilt lazily on the next residual/fit
+  request (mask params compile to static index arrays at model-build time,
+  models/parameter.py — SURVEY.md §7 "maskParameter dynamism": interactive
+  jump editing implies a re-trace, which is accepted and documented);
+- jumps added on selections use per-TOA ``-gui_jump N`` flags exactly like
+  the reference (pulsar.py add_jump:370 semantics: toggle off when the
+  selection matches an existing gui jump, strip the overlap when it
+  partially covers one, else add a new JUMP);
+- phase wraps write ``-padd`` flags (the PHASE-command channel the TOA
+  tensor already folds into delta_pulse_number, toas.py:231) and flip the
+  session into pulse-number tracking;
+- undo is a real edit-history stack (the reference only has reset-to-start
+  and a one-slot TOA stash): every mutating operation pushes a full
+  snapshot (model copy, deleted set, flags, tracking mode) and ``undo()``
+  restores it, including across fits.
+"""
+
+from __future__ import annotations
+
+import copy
+
+import numpy as np
+
+from pint_tpu.utils.logging import get_logger
+
+log = get_logger("pint_tpu.interactive")
+
+
+class _Snapshot:
+    __slots__ = ("par", "deleted", "flags", "fitted", "track", "label")
+
+    def __init__(self, par, deleted, flags, fitted, track, label):
+        self.par = par
+        self.deleted = deleted
+        self.flags = flags
+        self.fitted = fitted
+        self.track = track
+        self.label = label
+
+
+class InteractivePulsar:
+    """Scriptable pintk session (reference pintk/pulsar.py Pulsar).
+
+    Parameters
+    ----------
+    parfile, timfile : str
+        Model and TOA inputs (timfile optional when `toas` is given).
+    fitter : str
+        "auto" (reference Fitter.auto choice), "downhill", "wls", "gls".
+    """
+
+    def __init__(self, parfile: str, timfile: str | None = None,
+                 fitter: str = "auto", toas=None):
+        from pint_tpu.models.builder import get_model
+        from pint_tpu.toas import get_TOAs
+
+        self.parfile = parfile
+        self.model = get_model(parfile)
+        if toas is None:
+            if timfile is None:
+                raise ValueError("need a timfile or a TOAs object")
+            toas = get_TOAs(timfile, model=self.model)
+        self.all_toas = toas
+        self.fit_method = fitter
+        #: indices (into all_toas) excluded from fitting
+        self.deleted: set[int] = set()
+        #: per-TOA selection used by jump/wrap edits and selected-residuals
+        self.selected = np.zeros(len(toas), dtype=bool)
+        self.fitted = False
+        self.track_pulse_numbers = False
+        self.last_fit = None
+        self.prefit_model = copy.deepcopy(self.model)
+        self._history: list[_Snapshot] = []
+        self._gui_jump_count = 0
+
+    # --- views -----------------------------------------------------------------
+
+    @property
+    def name(self) -> str:
+        return str(self.model.meta.get("PSR", "pulsar"))
+
+    def active_mask(self) -> np.ndarray:
+        m = np.ones(len(self.all_toas), dtype=bool)
+        if self.deleted:
+            m[np.fromiter(self.deleted, int)] = False
+        return m
+
+    def active_toas(self):
+        """TOAs currently participating in fits (deleted ones excluded)."""
+        mask = self.active_mask()
+        return self.all_toas if mask.all() else self.all_toas.select(mask)
+
+    def resids(self, model=None):
+        """Residuals of the ACTIVE TOAs under `model` (default: the working
+        model — postfit once fitted, prefit before)."""
+        from pint_tpu.residuals import Residuals
+
+        track = "use_pulse_numbers" if self.track_pulse_numbers else None
+        return Residuals(self.active_toas(), model or self.model,
+                         track_mode=track)
+
+    def rms_us(self) -> float:
+        return float(self.resids().rms_weighted() * 1e6)
+
+    # --- edit history ----------------------------------------------------------
+
+    def _push(self, label: str) -> None:
+        # snapshot the model object itself (not a parfile round trip: a
+        # mid-session model can hold transient states a validating rebuild
+        # would reject, e.g. a fit iterate at a domain boundary)
+        self._history.append(_Snapshot(
+            par=copy.deepcopy(self.model),
+            deleted=set(self.deleted),
+            flags=copy.deepcopy(self.all_toas.flags),
+            fitted=self.fitted,
+            track=self.track_pulse_numbers,
+            label=label,
+        ))
+
+    def undo(self) -> str:
+        """Revert the last mutating operation (delete/jump/wrap/fit/...).
+        Returns the label of the undone operation."""
+        if not self._history:
+            raise RuntimeError("nothing to undo")
+        snap = self._history.pop()
+        self.model = snap.par
+        self.deleted = snap.deleted
+        self.track_pulse_numbers = snap.track
+        self.all_toas.flags[:] = snap.flags
+        self.fitted = snap.fitted
+        # selection indices survive edits (the reference re-derives them per
+        # widget); sizes never change, only masks/params do
+        log.info(f"undid: {snap.label}")
+        return snap.label
+
+    def reset(self) -> None:
+        """Back to the loaded par/tim (reference resetAll, pulsar.py:160)."""
+        self._push("reset")
+        self.model = copy.deepcopy(self.prefit_model)
+        self.deleted = set()
+        for f in self.all_toas.flags:
+            f.pop("gui_jump", None)
+            f.pop("padd", None)
+        self.fitted = False
+        self.track_pulse_numbers = False
+
+    # --- edits -----------------------------------------------------------------
+
+    def delete_toas(self, indices) -> int:
+        """Exclude TOAs (by index into the loaded set) from fitting
+        (reference delete_TOAs, pulsar.py:172)."""
+        indices = {int(i) for i in np.atleast_1d(np.asarray(indices, int))}
+        bad = indices - set(range(len(self.all_toas)))
+        if bad:
+            raise IndexError(f"TOA indices out of range: {sorted(bad)}")
+        self._push(f"delete {len(indices)} TOAs")
+        self.deleted |= indices
+        self.selected[list(indices)] = False
+        return len(self.deleted)
+
+    def restore_toas(self, indices=None) -> None:
+        """Un-delete (all, or the given indices)."""
+        self._push("restore TOAs")
+        if indices is None:
+            self.deleted.clear()
+        else:
+            self.deleted -= {int(i) for i in np.atleast_1d(indices)}
+
+    def add_jump(self, selected: np.ndarray | None = None) -> str | None:
+        """Toggle a JUMP over the selected TOAs (boolean mask over the
+        loaded set; defaults to self.selected). Reference add_jump
+        semantics (pulsar.py:370): exact match with an existing gui jump
+        removes it; overlap strips the overlapped TOAs from that jump; no
+        match adds a new JUMP parameter tied to ``-gui_jump N`` flags.
+        Returns the affected JUMP parameter name (None when a jump was
+        fully removed)."""
+        sel = self.selected if selected is None else np.asarray(selected, bool)
+        if sel.shape != (len(self.all_toas),):
+            raise ValueError("selection mask must cover the loaded TOAs")
+        if not sel.any():
+            raise ValueError("empty selection")
+        flags = self.all_toas.flags
+        existing = {}  # gui_jump flag value -> boolean mask
+        for i, f in enumerate(flags):
+            v = f.get("gui_jump")
+            if v is not None:
+                existing.setdefault(v, np.zeros(len(flags), bool))[i] = True
+        for v, mask in existing.items():
+            if np.array_equal(mask, sel):
+                self._push(f"remove jump gui_jump={v}")
+                for i in np.flatnonzero(mask):
+                    flags[i].pop("gui_jump", None)
+                self._remove_gui_jump_param(v)
+                return None
+            if (mask & sel).any():
+                self._push(f"shrink jump gui_jump={v}")
+                for i in np.flatnonzero(mask & sel):
+                    flags[i].pop("gui_jump", None)
+                if not any(f.get("gui_jump") == v for f in flags):
+                    self._remove_gui_jump_param(v)
+                    return None
+                return self._gui_jump_param_name(v)
+        # brand-new jump
+        self._gui_jump_count += 1
+        v = str(self._gui_jump_count)
+        self._push(f"add jump gui_jump={v}")
+        for i in np.flatnonzero(sel):
+            flags[i]["gui_jump"] = v
+        return self._add_gui_jump_param(v)
+
+    def _phase_jump_component(self):
+        from pint_tpu.models.phase_misc import PhaseJump
+
+        for c in self.model.components:
+            if c.category == "phase_jump":
+                return c
+        comp = PhaseJump()
+        self.model.add_component(comp, validate=False)
+        return comp
+
+    def _add_gui_jump_param(self, flag_value: str) -> str:
+        from pint_tpu.models.parameter import (
+            MaskClause, MaskParamInfo, ParamSpec)
+
+        comp = self._phase_jump_component()
+        idx = max((mp.index for mp in comp.mask_params), default=0) + 1
+        name = f"JUMP{idx}"
+        clause = MaskClause("flag", key="gui_jump", args=(flag_value,))
+        spec = ParamSpec(
+            name, unit="s",
+            description=f"JUMP on {' '.join(clause.as_parfile_tokens())}",
+        )
+        info = MaskParamInfo(name=name, base="JUMP", index=idx,
+                             clause=clause, spec=spec)
+        comp.mask_params.append(info)
+        comp.specs[name] = spec
+        self.model.params[name] = spec.parse("0.0")
+        from pint_tpu.models.parameter import ParamValueMeta
+
+        self.model.param_meta[name] = ParamValueMeta(spec=spec, frozen=False)
+        self.model.clear_caches()
+        log.info(f"added {name} on -gui_jump {flag_value}")
+        return name
+
+    def _gui_jump_param_name(self, flag_value: str) -> str | None:
+        comp = self._phase_jump_component()
+        for mp in comp.mask_params:
+            if (mp.clause.kind == "flag" and mp.clause.key == "gui_jump"
+                    and mp.clause.args[0] == flag_value):
+                return mp.name
+        return None
+
+    def _remove_gui_jump_param(self, flag_value: str) -> None:
+        comp = self._phase_jump_component()
+        name = self._gui_jump_param_name(flag_value)
+        if name is None:
+            return
+        comp.mask_params = [mp for mp in comp.mask_params if mp.name != name]
+        comp.specs.pop(name, None)
+        self.model.params.pop(name, None)
+        self.model.param_meta.pop(name, None)
+        self.model.clear_caches()
+        log.info(f"removed {name}")
+
+    def add_phase_wrap(self, selected: np.ndarray | None = None,
+                       phase: int = 1) -> None:
+        """Add `phase` whole turns to the selected TOAs' pulse numbers via
+        ``-padd`` flags and switch to pulse-number tracking (reference
+        add_phase_wrap, pulsar.py:336)."""
+        sel = self.selected if selected is None else np.asarray(selected, bool)
+        if not sel.any():
+            raise ValueError("empty selection")
+        self._push(f"phase wrap {phase:+d} on {int(sel.sum())} TOAs")
+        if self.all_toas.get_pulse_numbers() is None:
+            self.compute_pulse_numbers()
+        for i in np.flatnonzero(sel):
+            f = self.all_toas.flags[i]
+            f["padd"] = str(float(f.get("padd", 0.0)) + phase)
+        self.track_pulse_numbers = True
+
+    def compute_pulse_numbers(self, model=None) -> None:
+        """Record each TOA's nearest pulse number under `model` as -pn flags
+        (reference TOAs.compute_pulse_numbers, toa.py:1941)."""
+        from pint_tpu.residuals import Residuals
+
+        res = Residuals(self.all_toas, model or self.model,
+                        subtract_mean=False)
+        pn = np.asarray(res.pulse_numbers)
+        for f, p in zip(self.all_toas.flags, pn):
+            f["pn"] = repr(float(p))
+
+    # --- fitting ---------------------------------------------------------------
+
+    def _make_fitter(self, toas):
+        from pint_tpu.fitting import (
+            DownhillGLSFitter, DownhillWLSFitter, GLSFitter, WLSFitter,
+            fit_auto)
+
+        meth = self.fit_method
+        if meth in ("auto", "downhill"):
+            return fit_auto(toas, self.model)
+        return {
+            "wls": WLSFitter, "gls": GLSFitter,
+            "downhill_wls": DownhillWLSFitter,
+            "downhill_gls": DownhillGLSFitter,
+        }[meth](toas, self.model)
+
+    def fit(self, maxiter: int = 10):
+        """Fit the active (non-deleted) TOAs in place; the working model
+        becomes the postfit model (reference fit, pulsar.py:481). Undoable."""
+        self._push("fit")
+        toas = self.active_toas()
+        ftr = self.fitter = self._make_fitter(toas)
+        result = ftr.fit_toas(maxiter=maxiter)
+        self.fitted = True
+        self.last_fit = result
+        log.info(
+            f"fit: chi2 {result.chi2:.2f} / dof {result.dof} "
+            f"({len(toas)} TOAs, {len(result.free_params)} free)"
+        )
+        return result
+
+    def random_models(self, n_models: int = 30, rng=None):
+        """Residual-envelope draws from the last fit's covariance over the
+        ACTIVE TOAs (reference random_models, pulsar.py:582 /
+        simulation.calculate_random_models)."""
+        if not self.fitted or self.fitter is None:
+            raise RuntimeError("fit first")
+        from pint_tpu.simulation import calculate_random_models
+
+        return calculate_random_models(self.fitter, self.active_toas(),
+                                       n_models=n_models, rng=rng)
+
+    # --- output ----------------------------------------------------------------
+
+    def as_parfile(self) -> str:
+        return self.model.as_parfile()
+
+    def write_par(self, path: str) -> None:
+        with open(path, "w") as f:
+            f.write(self.as_parfile())
+
+    def write_tim(self, path: str) -> None:
+        self.active_toas().write_tim(path, name=self.name)

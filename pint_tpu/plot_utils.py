@@ -111,3 +111,133 @@ def plot_residuals_orbit(fitter, ax=None, outfile: str | None = None):
     ax.set_ylabel("Residual (us)")
     _finish(fig, outfile)
     return ax
+
+
+class InteractivePlot:
+    """Thin matplotlib front end over interactive.InteractivePulsar — the
+    plk-style workflow (reference pintk/plk.py:1610) without Tk: residuals
+    vs MJD with rectangle-selection and single-key commands.
+
+    Keys (match the reference plk bindings where they exist):
+      d  delete selected TOAs          u  undo last operation
+      j  toggle jump on selection      f  fit the active TOAs
+      +/- add/subtract a phase wrap    r  reset to the loaded par/tim
+      c  clear selection
+
+    Every action routes through the headless core, so a script can drive
+    the same session object the window shows (`session` attribute); all
+    methods are callable directly for tests/headless use.
+    """
+
+    def __init__(self, session, ax=None):
+        self.session = session
+        self.ax, self.fig = _axes(ax)
+        if self.fig is None:
+            self.fig = self.ax.figure
+        self._selector = None
+        self.refresh()
+
+    # --- drawing ---------------------------------------------------------------
+
+    def refresh(self):
+        s = self.session
+        self.ax.clear()
+        res = s.resids()
+        active = np.flatnonzero(s.active_mask())
+        mjd = s.all_toas.tdb.mjd_float()[active]
+        r_us = np.asarray(res.time_resids) * 1e6
+        e_us = np.asarray(res.errors_s) * 1e6
+        sel = s.selected[active]
+        self.ax.errorbar(mjd[~sel], r_us[~sel], yerr=e_us[~sel], fmt=".",
+                         color="tab:blue", alpha=0.7)
+        if sel.any():
+            self.ax.errorbar(mjd[sel], r_us[sel], yerr=e_us[sel], fmt="o",
+                             color="tab:orange")
+        state = "postfit" if s.fitted else "prefit"
+        self.ax.set_xlabel("MJD (TDB)")
+        self.ax.set_ylabel(f"{state} residual (us)")
+        self.ax.set_title(
+            f"{s.name}: {len(active)} TOAs, wrms {s.rms_us():.2f} us"
+        )
+        self._mjd_active = mjd
+        self._active_idx = active
+        if self.fig.canvas is not None:
+            self.fig.canvas.draw_idle()
+
+    # --- selection + commands (bound to mpl events in connect()) ----------------
+
+    def select_range(self, mjd_lo: float, mjd_hi: float, extend=False):
+        """Select active TOAs whose MJD falls in [mjd_lo, mjd_hi]."""
+        s = self.session
+        hit = (self._mjd_active >= mjd_lo) & (self._mjd_active <= mjd_hi)
+        if not extend:
+            s.selected[:] = False
+        s.selected[self._active_idx[hit]] = True
+        self.refresh()
+        return int(hit.sum())
+
+    def clear_selection(self):
+        self.session.selected[:] = False
+        self.refresh()
+
+    def delete_selected(self):
+        s = self.session
+        idx = np.flatnonzero(s.selected)
+        if idx.size:
+            s.delete_toas(idx)
+            self.refresh()
+
+    def jump_selected(self):
+        name = self.session.add_jump()
+        self.refresh()
+        return name
+
+    def wrap_selected(self, phase: int = 1):
+        self.session.add_phase_wrap(phase=phase)
+        self.refresh()
+
+    def fit(self, **kw):
+        res = self.session.fit(**kw)
+        self.refresh()
+        return res
+
+    def undo(self):
+        label = self.session.undo()
+        self.refresh()
+        return label
+
+    def reset(self):
+        self.session.reset()
+        self.refresh()
+
+    # --- event wiring (only needed for a live window) ---------------------------
+
+    def connect(self):
+        """Attach the RectangleSelector + key bindings to the figure (call
+        this under an interactive matplotlib backend)."""
+        from matplotlib.widgets import RectangleSelector
+
+        def on_select(eclick, erelease):
+            lo, hi = sorted((eclick.xdata, erelease.xdata))
+            self.select_range(lo, hi, extend=eclick.key == "shift")
+
+        self._selector = RectangleSelector(self.ax, on_select, useblit=True,
+                                           button=[1])
+        keymap = {
+            "d": self.delete_selected,
+            "j": self.jump_selected,
+            "f": self.fit,
+            "u": self.undo,
+            "r": self.reset,
+            "c": self.clear_selection,
+            "+": lambda: self.wrap_selected(+1),
+            "-": lambda: self.wrap_selected(-1),
+        }
+
+        def on_key(event):
+            fn = keymap.get(event.key)
+            if fn is not None:
+                fn()
+
+        self.fig.canvas.mpl_connect("key_press_event", on_key)
+        return self

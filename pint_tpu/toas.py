@@ -369,15 +369,17 @@ def get_TOAs(
         eop = os.environ.get("PINT_TPU_EOP") or ""
         if eop and os.path.exists(eop):
             eop = f"{eop}@{os.path.getmtime(eop):.0f}"
+        # clock files refresh out-of-band (PINT_TPU_CLOCK_REPO syncs,
+        # PINT_CLOCK_OVERRIDE edits): their identity+mtimes join the key
+        clk = clockmod.clock_state_fingerprint()
         key = (f"v{_TOA_CACHE_VERSION}-{digest}-{ephem}-{spk}-nb{nbody}-"
-               f"eop{eop}-{planets}-{include_gps}-{include_bipm}-{bipm_version}")
+               f"eop{eop}-clk{clk}-{planets}-{include_gps}-{include_bipm}-"
+               f"{bipm_version}")
         # cache lives under the user cache dir, NOT beside the tim file:
         # datasets are often read from read-only / shared trees
-        cache_root = os.path.join(
-            os.environ.get("PINT_TPU_CACHE_DIR",
-                           os.path.expanduser("~/.cache/pint_tpu")),
-            "toas",
-        )
+        from pint_tpu.utils.cache import cache_root as _cache_root
+
+        cache_root = str(_cache_root() / "toas")
         try:
             os.makedirs(cache_root, exist_ok=True)
             # filename carries the FULL config key, not just the tim digest:
@@ -528,10 +530,17 @@ def prepare_arrays(
     for name in np.unique(obs_names):
         ob = get_observatory(str(name))
         sel = obs_names == name
-        p, v = ob.site_posvel_gcrs(
-            ut1_mjd[sel], tt_jcent[sel],
-            xp_rad=xp_rad[sel], yp_rad=yp_rad[sel],
-        )
+        if getattr(ob, "needs_flags", False):
+            # tempo2-style spacecraft: GCRS state from per-TOA flags
+            # (reference special_locations.py:159 T2SpacecraftObs)
+            p, v = ob.site_posvel_gcrs_flags(
+                [flags[i] for i in np.flatnonzero(sel)]
+            )
+        else:
+            p, v = ob.site_posvel_gcrs(
+                ut1_mjd[sel], tt_jcent[sel],
+                xp_rad=xp_rad[sel], yp_rad=yp_rad[sel],
+            )
         site_pos[sel] = p
         site_vel[sel] = v
 

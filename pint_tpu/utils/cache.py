@@ -1,0 +1,37 @@
+"""Shared disk-cache helpers.
+
+All pint_tpu disk caches live under ``$PINT_TPU_CACHE_DIR`` (default
+``~/.cache/pint_tpu``): prepared TOAs (toas.py), the N-body ephemeris
+solution (astro/nbody.py), synced clock corrections (astro/global_clock.py),
+the persistent XLA compilation cache, and benchmark datasets (bench.py).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+from pathlib import Path
+
+_FINGERPRINT: str | None = None
+
+
+def cache_root() -> Path:
+    return Path(
+        os.environ.get("PINT_TPU_CACHE_DIR", os.path.expanduser("~/.cache/pint_tpu"))
+    )
+
+
+def source_fingerprint() -> str:
+    """Hash of every pint_tpu source file — a conservative cache key
+    component: ANY source change invalidates entries keyed on it.
+    Computed once per process (~15k LoC, a few ms)."""
+    global _FINGERPRINT
+    if _FINGERPRINT is None:
+        import pint_tpu
+
+        pkg = Path(pint_tpu.__file__).parent
+        h = hashlib.sha256()
+        for p in sorted(pkg.rglob("*.py")):
+            h.update(p.read_bytes())
+        _FINGERPRINT = h.hexdigest()[:16]
+    return _FINGERPRINT

@@ -68,3 +68,23 @@ def reference_datafile():
 @pytest.fixture
 def rng():
     return np.random.default_rng(0x5EED)
+
+
+from contextlib import contextmanager  # noqa: E402
+
+
+@contextmanager
+def production_ephemeris():
+    """Run a block under the PRODUCTION ephemeris config (N-body refinement
+    on) — golden/parity fixtures use this; conftest disables it globally for
+    speed. The build is disk-cached under ~/.cache/pint_tpu after the first
+    run, so repeated suite runs stay fast."""
+    old = os.environ.get("PINT_TPU_NBODY")
+    os.environ["PINT_TPU_NBODY"] = "1"
+    try:
+        yield
+    finally:
+        if old is None:
+            os.environ.pop("PINT_TPU_NBODY", None)
+        else:
+            os.environ["PINT_TPU_NBODY"] = old

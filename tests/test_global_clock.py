@@ -1,0 +1,126 @@
+"""Global clock-corrections machinery against a local fake mirror.
+
+Reference behaviors covered (observatory/global_clock_corrections.py):
+index parsing (:149), per-file staleness/update-interval policies (:39),
+invalid-if-older-than forced refresh, mirror fallback to a stale cached
+copy, bulk update + export (:228), and the integration with clock-chain
+discovery. Everything runs against a temp-dir mirror — no network.
+"""
+
+import os
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+
+GPS2UTC = """# gps2utc.clk
+# UTC(GPS) to UTC
+51544.0 1.0e-6
+60000.0 1.0e-6
+"""
+
+TIME_GBT = """# time_gbt.dat
+ 51544.00    2.000
+ 60000.00    2.000
+"""
+
+INDEX = """# Index of clock correction files
+# file  update (days)  invalid if older than
+T2runtime/clock/gps2utc.clk 7.0 ---
+tempo/clock/time_gbt.dat 7.0 ---
+"""
+
+
+@pytest.fixture()
+def mirror(tmp_path, monkeypatch):
+    """A local repository mirror + an isolated cache dir."""
+    repo = tmp_path / "repo"
+    (repo / "T2runtime" / "clock").mkdir(parents=True)
+    (repo / "tempo" / "clock").mkdir(parents=True)
+    (repo / "index.txt").write_text(INDEX)
+    (repo / "T2runtime" / "clock" / "gps2utc.clk").write_text(GPS2UTC)
+    (repo / "tempo" / "clock" / "time_gbt.dat").write_text(TIME_GBT)
+    monkeypatch.setenv("PINT_TPU_CACHE_DIR", str(tmp_path / "cache"))
+    monkeypatch.setenv("PINT_TPU_CLOCK_REPO", str(repo))
+    import pint_tpu.astro.global_clock as gc
+
+    monkeypatch.setattr(gc, "_synced", False)
+    return repo
+
+
+class TestGlobalClock:
+    def test_index_parsing(self, mirror):
+        from pint_tpu.astro.global_clock import Index
+
+        idx = Index()
+        assert set(idx.files) == {"gps2utc.clk", "time_gbt.dat"}
+        e = idx.files["gps2utc.clk"]
+        assert e.file == "T2runtime/clock/gps2utc.clk"
+        assert e.update_interval_days == 7.0
+        assert e.invalid_if_older_than is None
+
+    def test_update_all_and_export(self, mirror, tmp_path):
+        from pint_tpu.astro.global_clock import cache_dir, update_all
+
+        paths = update_all(export_to=tmp_path / "exported")
+        assert len(paths) == 2
+        assert (cache_dir() / "gps2utc.clk").exists()
+        # export round-trips content byte-for-byte
+        assert (tmp_path / "exported" / "time_gbt.dat").read_text() == TIME_GBT
+
+    def test_staleness_policies(self, mirror):
+        from pint_tpu.astro.global_clock import cache_dir, get_file
+
+        p = get_file("T2runtime/clock/gps2utc.clk")
+        first_mtime = p.stat().st_mtime
+        # fresh: if_expired keeps the copy
+        p2 = get_file("T2runtime/clock/gps2utc.clk")
+        assert p2.stat().st_mtime == first_mtime
+        # age it past the interval -> re-synced (mtime advances)
+        old = time.time() - 30 * 86400
+        os.utime(p, (old, old))
+        p3 = get_file("T2runtime/clock/gps2utc.clk", update_interval_days=7.0)
+        assert p3.stat().st_mtime > old + 86400
+        # "never" with an empty cache raises
+        with pytest.raises(FileNotFoundError):
+            get_file("no_such.clk", download_policy="never")
+        # invalid_if_older_than forces a refresh even inside the interval
+        os.utime(p, (old, old))
+        p4 = get_file(
+            "T2runtime/clock/gps2utc.clk",
+            update_interval_days=1e9,
+            invalid_if_older_than=time.time() - 86400,
+        )
+        assert p4.stat().st_mtime > old + 86400
+
+    def test_stale_cache_survives_dead_mirror(self, mirror, monkeypatch):
+        from pint_tpu.astro.global_clock import get_file
+
+        p = get_file("T2runtime/clock/gps2utc.clk")
+        old = time.time() - 30 * 86400
+        os.utime(p, (old, old))
+        # break the repository: stale copy is served with a warning
+        monkeypatch.setenv("PINT_TPU_CLOCK_REPO", str(Path(str(mirror)) / "missing"))
+        p2 = get_file("T2runtime/clock/gps2utc.clk")
+        assert p2 == p and p2.exists()
+
+    def test_unknown_file_raises_keyerror(self, mirror):
+        from pint_tpu.astro.global_clock import get_clock_correction_file
+
+        with pytest.raises(KeyError):
+            get_clock_correction_file("nonexistent.clk")
+
+    def test_clock_chain_uses_repository(self, mirror):
+        """End to end: a configured repository feeds get_clock_chain with
+        real (nonzero) corrections for gbt, with the site file and
+        gps2utc both applied."""
+        import pint_tpu.astro.clock as clock
+
+        # fresh discovery state for this test
+        clock._warned_missing.clear()
+        chain = clock.get_clock_chain("gbt", include_gps=True)
+        corr = chain.evaluate(np.array([55000.0]))
+        # time_gbt.dat gives 2 us, gps2utc 1 us
+        assert corr[0] == pytest.approx(3.0e-6, rel=1e-9)

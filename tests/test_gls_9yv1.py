@@ -49,27 +49,27 @@ def fits():
     from pint_tpu.fitting import GLSFitter
     from pint_tpu.models.builder import get_model_and_toas
 
+    from conftest import production_ephemeris
+
     # production ephemeris config (N-body refinement on): without it the
-    # analytic high-frequency truncation noise dominates and neither GLS
-    # path converges in the iteration budget (conftest turns it off for
-    # speed elsewhere; the build is disk-cached after the first run)
-    old = os.environ.get("PINT_TPU_NBODY")
-    os.environ["PINT_TPU_NBODY"] = "1"
-    try:
+    # analytic high-frequency truncation noise dominates and the GLS fit
+    # does not settle in the iteration budget
+    with production_ephemeris():
         m, t = get_model_and_toas(PAR, TIM)
-    finally:
-        if old is None:
-            os.environ.pop("PINT_TPU_NBODY", None)
-        else:
-            os.environ["PINT_TPU_NBODY"] = old
-    m2 = copy.deepcopy(m)
     f_basis = GLSFitter(t, m)
-    r_basis = f_basis.fit_toas(maxiter=6, full_cov=False)
+    r_basis = f_basis.fit_toas(maxiter=8, full_cov=False)
+    # two-path comparison FROM THE SAME starting params (the fitted model):
+    # one Woodbury-basis step vs one dense-covariance step — the same
+    # normal equations assembled two ways (reference fitter.py:2177-2254)
+    m2 = copy.deepcopy(m)
     f_full = GLSFitter(t, m2)
-    r_full = f_full.fit_toas(maxiter=6, full_cov=True)
+    r_full = f_full.fit_toas(maxiter=1, full_cov=True)
+    m3 = copy.deepcopy(m)
+    f_basis1 = GLSFitter(t, m3)
+    r_basis1 = f_basis1.fit_toas(maxiter=1, full_cov=False)
     with open(T2JSON) as fp:
         t2 = json.load(fp)
-    return f_basis, r_basis, f_full, r_full, t2
+    return f_basis, r_basis, (r_basis1, r_full), t2
 
 
 class TestGLS9yv1:
@@ -79,18 +79,18 @@ class TestGLS9yv1:
 
     def test_full_cov_matches_basis(self, fits):
         """The dense-covariance and structured-Woodbury paths are the same
-        statistic computed two ways (reference fitter.py:2177-2254); on this
-        90-param real dataset they must agree to solver precision
-        (measured 8e-9 relative)."""
-        _, r_basis, _, r_full, _ = fits
-        assert np.isfinite(r_basis.chi2) and np.isfinite(r_full.chi2)
-        assert abs(r_basis.chi2 - r_full.chi2) / r_basis.chi2 < 1e-6
+        statistic computed two ways (reference fitter.py:2177-2254): one
+        step of each from identical starting params must land at the same
+        chi^2 to solver precision (measured ~1e-8 relative)."""
+        _, _, (r_basis1, r_full), _ = fits
+        assert np.isfinite(r_basis1.chi2) and np.isfinite(r_full.chi2)
+        assert abs(r_basis1.chi2 - r_full.chi2) / r_basis1.chi2 < 1e-6
 
     def test_uncertainties_match_tempo2(self, fits):
         """Curvature-level parity: uncertainties of the well-constrained,
         ephemeris-insensitive params within ~40% of tempo2's (measured
         0.89x/0.89x/0.95x for ELONG/ELAT/PB)."""
-        _, r_basis, _, _, t2 = fits
+        _, r_basis, _, t2 = fits
         for name, to_internal in (("ELONG", 1.0), ("ELAT", 1.0), ("PB", 86400.0)):
             ours = r_basis.uncertainties[name]
             t2_unc = t2[name][1] * to_internal

@@ -1,0 +1,140 @@
+"""Scripted pintk-core workflow (reference pintk/pulsar.py:664 state
+machine): delete TOAs, jump a selection, refit, phase wraps, undo — the
+headless session and the matplotlib front end share one core.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from conftest import REFERENCE_DATA, have_reference_data
+
+pytestmark = pytest.mark.skipif(
+    not have_reference_data(), reason="reference datafile directory not mounted"
+)
+
+PAR = os.path.join(REFERENCE_DATA, "B1855+09_NANOGrav_dfg+12_TAI.par")
+TIM = os.path.join(REFERENCE_DATA, "B1855+09_NANOGrav_dfg+12.tim")
+
+
+@pytest.fixture()
+def session():
+    from pint_tpu.interactive import InteractivePulsar
+
+    return InteractivePulsar(PAR, TIM, fitter="downhill_wls")
+
+
+class TestInteractiveSession:
+    def test_scripted_workflow(self, session):
+        """The VERDICT-prescribed script: load B1855, delete 5 TOAs, add a
+        jump, refit, undo — state verified at every step."""
+        ip = session
+        n0 = len(ip.all_toas)
+        rms0 = ip.rms_us()
+        par0 = ip.as_parfile()
+        assert not ip.fitted
+
+        # --- delete 5 TOAs -------------------------------------------------
+        ip.delete_toas([100, 200, 300, 400, 500])
+        assert len(ip.active_toas()) == n0 - 5
+        assert len(np.asarray(ip.resids().time_resids)) == n0 - 5
+
+        # --- jump a selection ---------------------------------------------
+        mjd = ip.all_toas.tdb.mjd_float()
+        sel = (mjd > mjd.min()) & (mjd < mjd.min() + 300.0)
+        sel &= ip.active_mask()
+        assert sel.sum() > 10
+        name = ip.add_jump(sel)
+        assert name is not None and name in ip.model.params
+        assert not ip.model.param_meta[name].frozen
+        # the new jump participates in residuals (flags -> mask recompile)
+        r = ip.resids()
+        assert np.isfinite(np.asarray(r.time_resids)).all()
+
+        # --- refit ---------------------------------------------------------
+        res = ip.fit(maxiter=3)
+        assert ip.fitted
+        assert np.isfinite(res.chi2)
+        assert name in res.free_params
+
+        # --- toggle the same jump off -> param removed ---------------------
+        removed = ip.add_jump(sel)
+        assert removed is None
+        assert name not in ip.model.params
+
+        # --- undo chain ----------------------------------------------------
+        assert ip.undo().startswith("remove jump")
+        assert name in ip.model.params  # jump restored
+        assert ip.undo() == "fit"
+        assert ip.undo().startswith("add jump")
+        assert name not in ip.model.params
+        assert ip.undo().startswith("delete")
+        assert len(ip.active_toas()) == n0
+        assert not ip.fitted
+        # fully unwound: parfile and residuals match the loaded state
+        assert ip.as_parfile() == par0
+        assert ip.rms_us() == pytest.approx(rms0, rel=1e-9)
+
+    def test_phase_wrap_roundtrip(self, session):
+        ip = session
+        mjd = ip.all_toas.tdb.mjd_float()
+        sel = mjd > np.median(mjd)
+        r0 = np.asarray(ip.resids().time_resids)
+        ip.add_phase_wrap(sel, phase=1)
+        assert ip.track_pulse_numbers
+        r1 = np.asarray(ip.resids().time_resids)
+        p0 = 1.0 / float(np.asarray(ip.model.params["F0"].hi))
+        # wrapped TOAs move by one pulse period relative to the others
+        shift = (r1 - r0)[sel].mean() - (r1 - r0)[~sel].mean()
+        assert shift == pytest.approx(p0, rel=1e-3)
+        ip.undo()
+        r2 = np.asarray(ip.resids().time_resids)
+        np.testing.assert_allclose(r2, r0, atol=1e-12)
+
+    def test_jump_overlap_shrinks(self, session):
+        """Partial overlap strips the overlapped TOAs from the existing jump
+        (reference add_jump overlap branch)."""
+        ip = session
+        mask_a = np.zeros(len(ip.all_toas), bool)
+        mask_a[:50] = True
+        name = ip.add_jump(mask_a)
+        mask_b = np.zeros(len(ip.all_toas), bool)
+        mask_b[25:50] = True
+        kept = ip.add_jump(mask_b)
+        assert kept == name
+        jumped = [f.get("gui_jump") is not None for f in ip.all_toas.flags]
+        assert sum(jumped) == 25
+
+    def test_random_models_envelope(self, session):
+        ip = session
+        ip.fit(maxiter=3)
+        dphase, draws = ip.random_models(n_models=5, rng=np.random.default_rng(3))
+        assert dphase.shape == (5, len(ip.active_toas()))
+        assert np.isfinite(dphase).all()
+
+
+class TestInteractivePlot:
+    def test_plot_front_end(self, session, tmp_path):
+        import matplotlib
+
+        matplotlib.use("Agg")
+        from pint_tpu.plot_utils import InteractivePlot
+
+        ip = session
+        plot = InteractivePlot(ip)
+        mjd = ip.all_toas.tdb.mjd_float()
+        n = plot.select_range(mjd.min(), mjd.min() + 200.0)
+        assert n > 0 and ip.selected.sum() == n
+        plot.delete_selected()
+        assert len(ip.active_toas()) == len(ip.all_toas) - n
+        plot.undo()
+        assert len(ip.active_toas()) == len(ip.all_toas)
+        plot.select_range(mjd.min(), mjd.min() + 200.0)
+        jname = plot.jump_selected()
+        assert jname in ip.model.params
+        res = plot.fit(maxiter=2)
+        assert np.isfinite(res.chi2)
+        out = tmp_path / "plk.png"
+        plot.fig.savefig(out)
+        assert out.stat().st_size > 0

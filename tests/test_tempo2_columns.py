@@ -40,19 +40,12 @@ def chain():
     from pint_tpu.residuals import Residuals
     from pint_tpu.toas import get_TOAs
 
+    from conftest import production_ephemeris
+
     # measure the PRODUCTION ephemeris config: N-body refinement on
-    # (conftest turns it off for speed elsewhere; the build is disk-cached
-    # under ~/.cache/pint_tpu after the first run)
-    old = os.environ.get("PINT_TPU_NBODY")
-    os.environ["PINT_TPU_NBODY"] = "1"
-    try:
+    with production_ephemeris():
         model = get_model(PAR)
         toas = get_TOAs(TIM, model=model)
-    finally:
-        if old is None:
-            os.environ.pop("PINT_TPU_NBODY", None)
-        else:
-            os.environ["PINT_TPU_NBODY"] = old
     res = Residuals(toas, model, subtract_mean=False)
     # columns: residuals BinaryDelay tt2tb roemer post_phase shapiro shapiroJ
     golden = np.genfromtxt(GOLDEN, skip_header=1)
