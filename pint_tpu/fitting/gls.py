@@ -155,27 +155,35 @@ def gls_chi2(resids) -> float:
     )
 
 
-def gls_solve(mtcm, mtcy, norm, p: int, lam: float = 0.0):
+def gls_solve(mtcm, mtcy, norm, p: int, lam: float = 0.0, return_eig: bool = False):
     """(dx_timing, cov_timing) from the normalized GLS normal equations,
-    with optional Marquardt damping lam * diag(mtcm)."""
+    with optional Marquardt damping lam * diag(mtcm).
+
+    The solve goes through the symmetric eigendecomposition of G rather
+    than a Cholesky inverse: the spectral pseudo-inverse V diag(1/s) V^T
+    (small/negative eigenvalues zeroed, matching the reference's SVD
+    fallback fitter.py:2228) keeps the covariance PSD BY CONSTRUCTION —
+    diag(cov) = sum_j s_inv_j V_ij^2 >= 0 — where the Cholesky-inverse of
+    a barely-positive-definite 90-param normal matrix could round to
+    negative diagonal entries and hand the caller NaN uncertainties.
+
+    With return_eig=True also returns (eigvals ascending, V.T) for
+    degeneracy naming."""
     import scipy.linalg as sl
 
     mtcm = np.asarray(mtcm)
     mtcy = np.asarray(mtcy)
     norm = np.asarray(norm)
     G = mtcm + lam * np.diag(np.diag(mtcm)) if lam else mtcm
-    try:
-        cf = sl.cho_factor(G)
-        xhat = sl.cho_solve(cf, mtcy)
-        xvar_p = sl.cho_solve(cf, np.eye(G.shape[0])[:, :p])
-    except sl.LinAlgError:
-        # SVD fallback (reference fitter.py:2228)
-        U, s, Vt = sl.svd(G, full_matrices=False)
-        s_inv = np.where(s > 1e-14 * s[0], 1.0 / s, 0.0)
-        xhat = Vt.T @ (s_inv * (U.T @ mtcy))
-        xvar_p = (Vt.T * s_inv) @ U.T[:, :p]
+    s, V = sl.eigh((G + G.T) / 2.0)
+    smax = s[-1] if s.size else 1.0
+    s_inv = np.where(s > 1e-14 * smax, 1.0 / np.where(s > 0, s, 1.0), 0.0)
+    xhat = V @ (s_inv * (V.T @ mtcy))
     dx = (xhat / norm)[:p]
-    cov = (xvar_p[:p, :] / norm[:p]).T / norm[:p]
+    cov_full = (V[:p, :] * s_inv) @ V[:p, :].T
+    cov = (cov_full / norm[:p]).T / norm[:p]
+    if return_eig:
+        return dx, cov, s, V.T
     return dx, cov
 
 
@@ -250,15 +258,17 @@ class GLSFitter(WLSFitter):
                 mtcm = mtcm_d / norm_d[:, None] / norm_d[None, :]
                 mtcy = mtcy_d / norm_d
                 norm = norm_d
-            dx, cov = gls_solve(mtcm, mtcy, norm, p)
+            dx, cov, es, evt = gls_solve(mtcm, mtcy, norm, p, return_eig=True)
             params = apply_delta(params, self._free, dx, project_domain=True)
-            sigma = np.sqrt(np.diag(cov))
+            sigma = np.sqrt(np.maximum(np.diag(cov), 0.0))
             rel = np.abs(dx) / np.where(sigma == 0, 1.0, sigma)
             if np.all(rel < xtol):
                 converged = True
                 break
         self.noise_ampls = np.asarray(ahat)
-        return self._finalize_fit(params, self.chi2_at(params), it, converged, cov)
+        # eigh returns ascending; _degenerate_params expects descending
+        return self._finalize_fit(params, self.chi2_at(params), it, converged, cov,
+                                  s=es[::-1], vt=evt[::-1])
 
     def noise_realization(self) -> np.ndarray | None:
         """Maximum-likelihood correlated-noise waveform F @ ahat (seconds)
@@ -301,6 +311,7 @@ class DownhillGLSFitter(GLSFitter):
         )
         _, _, mtcm, mtcy, norm, _, ahat = pieces
         # uncertainties always come from the UNDAMPED normal matrix
-        _, cov = gls_solve(mtcm, mtcy, norm, p)
+        _, cov, es, evt = gls_solve(mtcm, mtcy, norm, p, return_eig=True)
         self.noise_ampls = np.asarray(ahat)
-        return self._finalize_fit(params, chi2_best, it, converged, cov)
+        return self._finalize_fit(params, chi2_best, it, converged, cov,
+                                  s=es[::-1], vt=evt[::-1])

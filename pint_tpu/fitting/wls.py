@@ -454,7 +454,18 @@ class WLSFitter:
 
         self.model.params = params_to_dd(params)
         cov = np.asarray(cov)
-        unc = dict(zip(self._free, np.sqrt(np.diag(cov))))
+        diag = np.diag(cov).copy()
+        neg = diag < 0
+        if neg.any():
+            # a PSD covariance cannot have these; name them instead of
+            # silently writing NaN uncertainties into param_meta
+            bad_names = [self._free[i] for i in np.flatnonzero(neg)]
+            log.warning(
+                f"negative covariance diagonal for {bad_names}; clamping to 0 "
+                "(degenerate directions — uncertainties not meaningful)"
+            )
+            diag = np.where(neg, 0.0, diag)
+        unc = dict(zip(self._free, np.sqrt(diag)))
         for n, u in unc.items():
             self.model.param_meta[n].uncertainty = float(u)
         degenerate = []

@@ -24,24 +24,36 @@ def zero_residuals(
     toas: TOAs,
     model,
     maxiter: int = 10,
-    tolerance_s: float = 1e-10,
+    tolerance_s: float = 1e-9,
 ) -> TOAs:
     """Shift TOA (UTC) times until model residuals are < tolerance.
 
     Each pass recomputes the full clock/TDB/posvel pipeline at the shifted
-    times, exactly like the reference (simulation.py:49-95, default tolerance
-    1 ns; ours defaults to 0.1 ns since dd phase affords it).
+    times, exactly like the reference (simulation.py:49-95, whose default
+    tolerance is likewise 1 ns). If the iteration stalls within 10x the
+    tolerance the best-effort result is returned with a warning — fakes a
+    few ns off the model are still far below any TOA uncertainty — and only
+    a genuinely diverged iteration raises.
     """
     cur = toas
+    best, best_worst = toas, np.inf
     for i in range(maxiter):
         r = Residuals(cur, model, subtract_mean=False, track_mode="nearest").time_resids
         worst = float(np.max(np.abs(r)))
         if worst < tolerance_s:
             log.info(f"zero_residuals converged after {i} passes (worst {worst:.2e} s)")
             return cur
+        if worst < best_worst:
+            best, best_worst = cur, worst
         cur = _reprepare(cur, -r)
+    if best_worst < 10.0 * tolerance_s:
+        log.warning(
+            f"zero_residuals stalled at {best_worst:.2e} s after {maxiter} passes "
+            f"(tolerance {tolerance_s} s); returning best-effort TOAs"
+        )
+        return best
     raise RuntimeError(
-        f"zero_residuals did not reach {tolerance_s} s in {maxiter} passes (worst {worst:.2e} s)"
+        f"zero_residuals did not reach {tolerance_s} s in {maxiter} passes (worst {best_worst:.2e} s)"
     )
 
 

@@ -1,0 +1,102 @@
+"""Photon pulse-profile templates: primitives, normalization simplex,
+energy dependence, and maximum-likelihood fitters.
+
+Reference: pint/templates/ (~4.8k LoC across lcprimitives.py,
+lcnorm.py, lctemplate.py, lcfitters.py, lceprimitives.py, lcenorm.py).
+Layout here:
+
+- primitives.py — component shapes (Gaussian, two-sided/skew Gaussian,
+  Lorentzian(2), von Mises, King, top-hat, harmonic, KDE, empirical
+  Fourier) as pure jax densities; all derivatives via autodiff.
+- norms.py — NormAngles/ENormAngles amplitude simplex (sum <= 1 by
+  construction).
+- template.py — LCTemplate mixture + IO ('gauss' format), factories,
+  GaussianPrior.
+- fitters.py — LCFitter (unbinned/binned weighted likelihood, hessian
+  and bootstrap errors, position fits) + the original functional API
+  (fit_template, fit_phase_shift, lnlikelihood, template_params,
+  template_density_jnp) used by event_optimize and the photon CLIs.
+- energy.py — energy-dependent primitive variants (LCEGaussian, ...).
+
+Everything importable from the original flat module keeps working:
+``from pint_tpu.templates import LCTemplate, LCGaussian, fit_template``.
+"""
+
+from pint_tpu.templates.energy import (
+    LCEGaussian,
+    LCEGaussian2,
+    LCELorentzian,
+    LCELorentzian2,
+    LCESkewGaussian,
+    LCEVonMises,
+)
+from pint_tpu.templates.fitters import (
+    LCFitter,
+    fit_phase_shift,
+    fit_template,
+    lnlikelihood,
+    template_density_jnp,
+    template_params,
+    weighted_light_curve,
+)
+from pint_tpu.templates.norms import ENormAngles, NormAngles
+from pint_tpu.templates.primitives import (
+    FWHM_TO_SIGMA,
+    LCEmpiricalFourier,
+    LCGaussian,
+    LCGaussian2,
+    LCHarmonic,
+    LCKernelDensity,
+    LCKing,
+    LCLorentzian,
+    LCLorentzian2,
+    LCPrimitive,
+    LCSkewGaussian,
+    LCTopHat,
+    LCVonMises,
+    convert_primitive,
+)
+from pint_tpu.templates.template import (
+    GaussianPrior,
+    LCTemplate,
+    get_2pb,
+    get_gauss1,
+    get_gauss2,
+)
+
+__all__ = [
+    "FWHM_TO_SIGMA",
+    "ENormAngles",
+    "GaussianPrior",
+    "LCEGaussian",
+    "LCEGaussian2",
+    "LCELorentzian",
+    "LCELorentzian2",
+    "LCESkewGaussian",
+    "LCEVonMises",
+    "LCEmpiricalFourier",
+    "LCFitter",
+    "LCGaussian",
+    "LCGaussian2",
+    "LCHarmonic",
+    "LCKernelDensity",
+    "LCKing",
+    "LCLorentzian",
+    "LCLorentzian2",
+    "LCPrimitive",
+    "LCSkewGaussian",
+    "LCTemplate",
+    "LCTopHat",
+    "LCVonMises",
+    "NormAngles",
+    "convert_primitive",
+    "fit_phase_shift",
+    "fit_template",
+    "get_2pb",
+    "get_gauss1",
+    "get_gauss2",
+    "lnlikelihood",
+    "template_density_jnp",
+    "template_params",
+    "weighted_light_curve",
+]

@@ -1,0 +1,126 @@
+"""Energy-dependent pulse-profile primitives.
+
+Reference: pint/templates/lceprimitives.py (LCEGaussian etc.) and
+lcenorm.py. Fermi-LAT pulse shapes drift with photon energy; the
+reference models every primitive parameter as linear in
+log10(E/MeV) about the pivot energy 10^3 MeV:
+
+    p(e) = p + slope * (e - 3)
+
+Here that rule is one mixin: an energy-dependent primitive wraps its base
+class's `density_jnp` with shifted parameters, so the same autodiff
+machinery fits slopes with no extra derivative code. `density_e` is the
+host-side evaluation the LCTemplate.__call__ dispatches to when
+`log10_ens` is given.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from pint_tpu.templates.primitives import (
+    LCGaussian,
+    LCGaussian2,
+    LCLorentzian,
+    LCLorentzian2,
+    LCSkewGaussian,
+    LCVonMises,
+)
+
+__all__ = [
+    "LCEGaussian",
+    "LCEGaussian2",
+    "LCELorentzian",
+    "LCELorentzian2",
+    "LCESkewGaussian",
+    "LCEVonMises",
+]
+
+PIVOT = 3.0  # log10(MeV)
+
+
+class _EDepMixin:
+    """Adds linear-in-log10(E) drift to (phase, *shape) of the base
+    primitive. `slope` has one entry per (phase + shape) parameter."""
+
+    def _slopes(self) -> np.ndarray:
+        n = 1 + len(self.shape_names)
+        s = np.asarray(self.slope, float)
+        if s.size != n:
+            raise ValueError(f"slope must have {n} entries (phase + shapes)")
+        return s
+
+    def params_at(self, log10_en):
+        """(phase, *shapes) at the given energy."""
+        s = self._slopes()
+        de = np.asarray(log10_en, float) - PIVOT
+        vals = [self.phase + s[0] * de]
+        for i, n in enumerate(self.shape_names):
+            vals.append(getattr(self, n) + s[1 + i] * de)
+        return vals
+
+    def density_e(self, x, log10_ens) -> np.ndarray:
+        """Host-side density at per-photon energies (vector or scalar)."""
+        x = np.asarray(x, float)
+        e = np.asarray(log10_ens, float)
+        if e.ndim == 0:
+            p = self.params_at(float(e))
+            return np.asarray(self.density_jnp(x, *p))
+        return np.asarray(self.density_jnp_e(x, np.broadcast_to(e, x.shape)))
+
+    def density_jnp_e(self, x, log10_ens):
+        """jax-compatible density with per-photon energies — the form the
+        fitters jit. Slopes enter as fixed data here; use
+        `density_jnp_e_theta` to expose them to autodiff."""
+        import jax.numpy as jnp
+
+        s = self._slopes()
+        de = jnp.asarray(log10_ens) - PIVOT
+        phase = self.phase + s[0] * de
+        shapes = [getattr(self, n) + s[1 + i] * de
+                  for i, n in enumerate(self.shape_names)]
+        return self.density_jnp(x, phase, *shapes)
+
+    @classmethod
+    def density_jnp_e_theta(cls, x, log10_ens, phase, shapes, slopes):
+        """Fully-parameterized energy-dependent density for fitting:
+        `shapes` and `slopes` are sequences (slopes: phase first)."""
+        de = log10_ens - PIVOT
+        ph = phase + slopes[0] * de
+        sh = [s + slopes[1 + i] * de for i, s in enumerate(shapes)]
+        return cls.density_jnp(x, ph, *sh)
+
+    def is_energy_dependent(self) -> bool:
+        return True
+
+
+def _edep(name, base):
+    """Build the energy-dependent dataclass for a base primitive."""
+
+    @dataclass
+    class _E(_EDepMixin, base):
+        slope: np.ndarray = field(default=None)
+
+        def __post_init__(self):
+            if self.slope is None:
+                self.slope = np.zeros(1 + len(self.shape_names))
+            else:
+                self.slope = np.asarray(self.slope, float)
+
+    _E.__name__ = name
+    _E.__qualname__ = name
+    _E.__doc__ = (
+        f"Energy-dependent {base.__name__} (linear-in-log10E parameters; "
+        f"reference lceprimitives.{name})."
+    )
+    return _E
+
+
+LCEGaussian = _edep("LCEGaussian", LCGaussian)
+LCEGaussian2 = _edep("LCEGaussian2", LCGaussian2)
+LCELorentzian = _edep("LCELorentzian", LCLorentzian)
+LCELorentzian2 = _edep("LCELorentzian2", LCLorentzian2)
+LCESkewGaussian = _edep("LCESkewGaussian", LCSkewGaussian)
+LCEVonMises = _edep("LCEVonMises", LCVonMises)

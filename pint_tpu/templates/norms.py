@@ -1,0 +1,193 @@
+"""Normalization simplex for pulse-profile templates.
+
+Reference: pint/templates/lcnorm.py NormAngles (500 LoC) and
+lcenorm.py ENormAngles. Component amplitudes n_1..n_k with
+sum(n) <= 1 (the remainder is the unpulsed background) are encoded as k
+angles, so ANY unconstrained angle vector maps to a valid point of the
+simplex — the fitters can optimize freely with no barrier terms:
+
+    total = sin^2(t_0)                    (so 1 - sum(n) = cos^2(t_0))
+    the k-1 remaining angles stick-break the total among components:
+        g_1 = cos^2(t_1)
+        g_2 = sin^2(t_1) cos^2(t_2)
+        ...
+        g_k = sin^2(t_1) ... sin^2(t_{k-1})
+    n_i = total * g_i
+
+The invariant 1 - sum(n) = cos^2(t_0) matches the reference's convention
+(its test_norms asserts exactly that). All derivatives of the map come
+from jax autodiff; `norms_from_angles_jnp` is the jit-compatible form the
+fitters compose into the likelihood.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def norms_from_angles_jnp(t):
+    """Angles (k,) -> norms (k,) in jax-compatible form (see module doc).
+    Used INSIDE jitted fit likelihoods; host-side bookkeeping uses the
+    numpy twin `norms_from_angles` (on TPU backends device trig is only
+    f32-accurate, far below what parameter round-trips need)."""
+    import jax.numpy as jnp
+
+    total = jnp.sin(t[0]) ** 2
+    if t.shape[0] == 1:
+        return total[None] if total.ndim == 0 else jnp.asarray([total])
+    s2 = jnp.sin(t[1:]) ** 2
+    c2 = jnp.cos(t[1:]) ** 2
+    # prefix products of sin^2: prod_{j<i} s2_j
+    prefix = jnp.concatenate([jnp.ones(1), jnp.cumprod(s2)])
+    g = prefix[:-1] * c2  # g_1 .. g_{k-1}
+    g = jnp.concatenate([g, prefix[-1:]])  # g_k = full product
+    return total * g
+
+
+def norms_from_angles(t: np.ndarray) -> np.ndarray:
+    """Numpy twin of `norms_from_angles_jnp` (exact f64 on the host)."""
+    t = np.asarray(t, float)
+    total = np.sin(t[0]) ** 2
+    if t.size == 1:
+        return np.array([total])
+    s2 = np.sin(t[1:]) ** 2
+    c2 = np.cos(t[1:]) ** 2
+    prefix = np.concatenate([[1.0], np.cumprod(s2)])
+    g = np.concatenate([prefix[:-1] * c2, prefix[-1:]])
+    return total * g
+
+
+def angles_from_norms(n: np.ndarray) -> np.ndarray:
+    """Inverse map: norms (k,) with sum <= 1 -> angles (k,)."""
+    n = np.asarray(n, float)
+    total = n.sum()
+    if total > 1.0 + 1e-9:
+        raise ValueError(f"norms sum to {total} > 1")
+    k = n.size
+    t = np.empty(k)
+    t[0] = np.arcsin(np.sqrt(np.clip(total, 0.0, 1.0)))
+    rem = total
+    for i in range(k - 1):
+        # g_i fraction of remaining: cos^2(t_{i+1}) = n_i / rem
+        frac = n[i] / rem if rem > 0 else 1.0
+        t[i + 1] = np.arccos(np.sqrt(np.clip(frac, 0.0, 1.0)))
+        rem -= n[i]
+    return t
+
+
+class NormAngles:
+    """Mutable amplitude-simplex object (reference lcnorm.NormAngles:19).
+
+    `p` holds the angles; calling the object returns the norms. `free`
+    masks which angles the fitters may vary.
+    """
+
+    name = "NormAngles"
+
+    def __init__(self, norms, free=None):
+        norms = np.asarray(norms, float)
+        self.p = angles_from_norms(norms)
+        self.free = (
+            np.ones(self.p.size, bool) if free is None else np.asarray(free, bool)
+        )
+        self.errors = np.zeros_like(self.p)
+
+    def __call__(self, log10_ens=None) -> np.ndarray:
+        return norms_from_angles(self.p)
+
+    def __len__(self) -> int:
+        return self.p.size
+
+    def num_parameters(self, free: bool = True) -> int:
+        return int(self.free.sum()) if free else self.p.size
+
+    def get_parameters(self, free: bool = True) -> np.ndarray:
+        return self.p[self.free] if free else self.p.copy()
+
+    def set_parameters(self, q, free: bool = True) -> bool:
+        q = np.asarray(q, float)
+        if free:
+            self.p[self.free] = q
+        else:
+            self.p[:] = q
+        return True
+
+    def set_single_norm(self, index: int, value: float) -> None:
+        """Set one component's norm, preserving the others (re-encodes the
+        angle vector; raises if the new vector leaves the simplex)."""
+        n = np.array(self())
+        n[index] = value
+        self.p[:] = angles_from_norms(n)
+
+    def norm_ok(self) -> bool:
+        n = self()
+        return bool(np.all(n >= 0) and n.sum() <= 1.0 + 1e-9)
+
+    def sanity_checks(self) -> bool:
+        return self.norm_ok()
+
+    def copy(self) -> "NormAngles":
+        out = NormAngles(self())
+        out.p = self.p.copy()
+        out.free = self.free.copy()
+        out.errors = self.errors.copy()
+        return out
+
+    def gradient(self, log10_ens=None, free: bool = True) -> np.ndarray:
+        """(k, n_param) d norms / d angles via autodiff."""
+        import jax
+        import jax.numpy as jnp
+
+        J = np.asarray(jax.jacobian(norms_from_angles_jnp)(jnp.asarray(self.p)))
+        return J[:, self.free] if free else J
+
+
+class ENormAngles(NormAngles):
+    """Energy-dependent norms (reference lcenorm.ENormAngles:12): the
+    ANGLES move linearly in log10(E/MeV) around the pivot 3, so the
+    simplex constraint holds automatically at every energy:
+        t(e) = t + slope * (e - 3);  n(e) = norms(t(e)).
+    """
+
+    name = "ENormAngles"
+
+    def __init__(self, norms, slope=None, free=None, slope_free=None):
+        super().__init__(norms, free=free)
+        self.slope = (
+            np.zeros_like(self.p) if slope is None else np.asarray(slope, float)
+        )
+        self.slope_free = (
+            np.zeros(self.p.size, bool)
+            if slope_free is None
+            else np.asarray(slope_free, bool)
+        )
+
+    def __call__(self, log10_ens=None) -> np.ndarray:
+        if log10_ens is None:
+            return super().__call__()
+        e = np.atleast_1d(np.asarray(log10_ens, float))
+        t = self.p[:, None] + self.slope[:, None] * (e[None, :] - 3.0)
+        out = np.stack(
+            [norms_from_angles(t[:, i]) for i in range(e.size)], axis=1
+        )
+        return out  # (k, n_e)
+
+    def num_parameters(self, free: bool = True) -> int:
+        base = super().num_parameters(free)
+        return base + (int(self.slope_free.sum()) if free else self.slope.size)
+
+    def get_parameters(self, free: bool = True) -> np.ndarray:
+        if free:
+            return np.concatenate([self.p[self.free], self.slope[self.slope_free]])
+        return np.concatenate([self.p, self.slope])
+
+    def set_parameters(self, q, free: bool = True) -> bool:
+        q = np.asarray(q, float)
+        if free:
+            na = int(self.free.sum())
+            self.p[self.free] = q[:na]
+            self.slope[self.slope_free] = q[na:]
+        else:
+            self.p[:] = q[: self.p.size]
+            self.slope[:] = q[self.p.size :]
+        return True

@@ -1,0 +1,351 @@
+"""Pulse-profile template: a normalized mixture of primitive components
+plus a uniform unpulsed background.
+
+Reference: pint/templates/lctemplate.py (1,077 LoC). Density over phase
+x in [0,1):
+
+    f(x) = (1 - sum_i ampl_i) + sum_i ampl_i * comp_i(x)
+
+with each comp_i a unit-normalized primitive (primitives.py) and the
+amplitudes a point of the simplex (norms.py). Each component owns its
+amplitude — the NormAngles view is constructed on demand (`norm_angles`)
+for simplex-space manipulation, and the fitters parametrize amplitudes
+through the same angle map, so sum <= 1 holds by construction during fits.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import replace
+
+import numpy as np
+
+from pint_tpu.templates.norms import NormAngles
+from pint_tpu.templates.primitives import (
+    FWHM_TO_SIGMA,
+    LCGaussian,
+    LCLorentzian,
+    LCPrimitive,
+)
+
+__all__ = [
+    "LCTemplate",
+    "GaussianPrior",
+    "get_gauss1",
+    "get_gauss2",
+    "get_2pb",
+]
+
+
+class LCTemplate:
+    """Mixture-of-primitives profile (see module docstring).
+
+    Constructed from a list of primitives (each carrying its `ampl`); the
+    original round-2 API (list of LCGaussian dataclasses) is unchanged.
+    """
+
+    def __init__(self, components: list | None = None):
+        self.components = list(components or [])
+
+    # --- original surface (kept stable for event_optimize/photonphase) --------
+
+    @property
+    def primitives(self) -> list:
+        return self.components
+
+    def __getitem__(self, i):
+        return self.components[i]
+
+    def __len__(self) -> int:
+        return len(self.components)
+
+    @property
+    def total_ampl(self) -> float:
+        return float(sum(c.ampl for c in self.components))
+
+    def norm(self) -> float:
+        """Pulsed fraction (reference LCTemplate.norm)."""
+        return self.total_ampl
+
+    def __call__(self, phases, log10_ens=None) -> np.ndarray:
+        """Normalized profile density at phases (cycles)."""
+        x = np.mod(np.asarray(phases, float), 1.0)
+        out = np.full_like(x, max(1.0 - self.total_ampl, 0.0))
+        for c in self.components:
+            if log10_ens is not None and hasattr(c, "density_e"):
+                out = out + c.ampl * c.density_e(x, log10_ens)
+            else:
+                out = out + c.ampl * c.density(x)
+        return out
+
+    def shifted(self, dphi: float) -> "LCTemplate":
+        return LCTemplate(
+            [replace(c, phase=(c.phase + dphi) % 1.0) for c in self.components]
+        )
+
+    # --- component manipulation (reference lctemplate component API) ----------
+
+    def rotate(self, dphi: float) -> None:
+        """In-place phase rotation of every component (reference
+        LCTemplate.rotate — note our sign: new_phase = phase + dphi)."""
+        for c in self.components:
+            c.phase = (c.phase + dphi) % 1.0
+
+    def set_overall_phase(self, phase: float) -> None:
+        """Rotate so the FIRST component sits at `phase` (reference
+        LCTemplate.set_overall_phase)."""
+        if not self.components:
+            return
+        self.rotate(phase - self.components[0].phase)
+
+    def get_location(self) -> float:
+        return self.components[0].phase if self.components else 0.0
+
+    def get_display_point(self) -> float:
+        """Phase that centers the profile for display: half a cycle from
+        the amplitude-weighted circular mean of component locations."""
+        if not self.components:
+            return 0.5
+        z = sum(c.ampl * np.exp(2j * np.pi * c.phase) for c in self.components)
+        mean = (np.angle(z) / (2 * np.pi)) % 1.0
+        return (mean + 0.5) % 1.0
+
+    def add_primitive(self, prim: LCPrimitive) -> None:
+        self.components.append(prim)
+
+    def delete_primitive(self, index: int) -> "LCPrimitive":
+        """Remove a component; its amplitude returns to the background."""
+        return self.components.pop(index)
+
+    def order_primitives(self, order: int = 0) -> None:
+        """Sort components by location (order=0) or amplitude (order=1)."""
+        key = (lambda c: c.phase) if order == 0 else (lambda c: -c.ampl)
+        self.components.sort(key=key)
+
+    def norm_angles(self) -> NormAngles:
+        """Amplitudes as a NormAngles simplex object (lcnorm surface)."""
+        return NormAngles([c.ampl for c in self.components])
+
+    def set_norms(self, norms) -> None:
+        norms = np.asarray(norms, float)
+        if norms.sum() > 1.0 + 1e-9:
+            raise ValueError("norms sum past 1")
+        for c, n in zip(self.components, norms):
+            c.ampl = float(n)
+
+    def copy(self) -> "LCTemplate":
+        return LCTemplate([c.copy() for c in self.components])
+
+    def is_energy_dependent(self) -> bool:
+        return any(hasattr(c, "density_e") for c in self.components)
+
+    # --- integration / cdf / sampling -----------------------------------------
+
+    def integrate(self, x1, x2, log10_ens=None) -> np.ndarray | float:
+        """Integral of the density over [x1, x2] (wrapping when x2 < x1 is
+        interpreted as the signed integral, matching the reference)."""
+        x1a = np.atleast_1d(np.asarray(x1, float))
+        x2a = np.atleast_1d(np.asarray(x2, float))
+        out = np.array([self._cdf_scalar(b) - self._cdf_scalar(a)
+                        for a, b in zip(x1a, x2a)])
+        return out if np.ndim(x1) else float(out[0])
+
+    def _cdf_scalar(self, x: float) -> float:
+        # piece together whole cycles + the fractional part on a fine grid
+        whole, frac = divmod(x, 1.0)
+        grid = np.linspace(0, frac, max(int(1024 * frac), 2))
+        val = np.trapezoid(self(grid), grid) if frac > 0 else 0.0
+        return whole + val
+
+    def cdf(self, x, log10_ens=None):
+        x = np.asarray(x, float)
+        return self.integrate(np.zeros_like(x), x)
+
+    def random(self, n: int, rng=None, weights=None) -> np.ndarray:
+        """Draw n photon phases from the profile (reference
+        LCTemplate.random): each component by its own sampler fraction,
+        the rest uniform background."""
+        rng = rng or np.random.default_rng()
+        ampls = np.array([c.ampl for c in self.components])
+        probs = np.append(ampls, max(1.0 - ampls.sum(), 0.0))
+        probs = probs / probs.sum()
+        which = rng.choice(len(probs), size=n, p=probs)
+        out = rng.uniform(size=n)  # background default
+        grid = np.linspace(0, 1, 2048, endpoint=False)
+        for i, c in enumerate(self.components):
+            m = which == i
+            if not m.any():
+                continue
+            dens = np.maximum(c.density(grid), 0)
+            cdf = np.cumsum(dens)
+            cdf = cdf / cdf[-1]
+            out[m] = np.interp(rng.uniform(size=int(m.sum())), cdf, grid)
+        return np.mod(out, 1.0)
+
+    # --- parameter vector surface (used by LCFitter) --------------------------
+
+    def get_errors(self) -> dict:
+        errs = {}
+        for k, c in enumerate(self.components, start=1):
+            for name, val in getattr(c, "fit_errors", {}).items():
+                errs[f"{name}{k}"] = val
+        return errs
+
+    # --- 'gauss' text format (reference lctemplate.prim_io:1009) --------------
+
+    @classmethod
+    def read(cls, path: str) -> "LCTemplate":
+        """Read the reference's 'gauss' text template format, including
+        per-parameter errors; recognizes gaussian ('# gauss') and, for
+        forward compatibility, von Mises ('# vonmises') component blocks."""
+        with open(path) as f:
+            text = f.read()
+        return cls.parse(text)
+
+    @classmethod
+    def parse(cls, text: str) -> "LCTemplate":
+        from pint_tpu.templates.primitives import LCVonMises
+
+        kind = "gauss"
+        m = re.search(r"#\s*(\w+)", text)
+        if m:
+            kind = m.group(1).lower()
+        prim_cls = {"gauss": LCGaussian, "vonmises": LCVonMises}.get(kind, LCGaussian)
+        vals: dict[str, float] = {}
+        errs: dict[str, float] = {}
+        for line in text.splitlines():
+            mm = re.match(
+                r"\s*(\w+)\s*=\s*([-\d.eE+]+)(?:\s*\+/-\s*([-\d.eE+]+))?", line
+            )
+            if mm:
+                vals[mm.group(1)] = float(mm.group(2))
+                if mm.group(3) is not None:
+                    errs[mm.group(1)] = float(mm.group(3))
+        comps = []
+        k = 1
+        while f"phas{k}" in vals:
+            c = prim_cls(vals[f"phas{k}"], vals[f"fwhm{k}"], vals[f"ampl{k}"])
+            fe = {
+                n: errs[f"{n}{k}"]
+                for n in ("phas", "fwhm", "ampl")
+                if f"{n}{k}" in errs
+            }
+            if fe:
+                c.fit_errors = fe
+            comps.append(c)
+            k += 1
+        if not comps:
+            raise ValueError("no components found in template text")
+        return cls(comps)
+
+    def write(self, path: str) -> None:
+        """Write the 'gauss'/'vonmises' text format. Raises at WRITE time
+        for component mixes the text format cannot round-trip (the generic
+        __str__ rendering is display-only and unreadable by read())."""
+        from pint_tpu.templates.primitives import LCVonMises
+
+        if not (all(isinstance(c, LCGaussian) for c in self.components)
+                or all(isinstance(c, LCVonMises) for c in self.components)):
+            raise TypeError(
+                "the template text format represents all-Gaussian or "
+                "all-von-Mises profiles only; use pickle for "
+                f"{sorted({type(c).__name__ for c in self.components})}"
+            )
+        with open(path, "w") as f:
+            f.write(str(self) + "\n")
+
+    def __str__(self) -> str:
+        from pint_tpu.templates.primitives import LCVonMises
+
+        if self.components and all(
+            isinstance(c, LCVonMises) for c in self.components
+        ):
+            return self._str_block("vonmises")
+        for c in self.components:
+            if not isinstance(c, LCGaussian):
+                return self._str_generic()
+        return self._str_block("gauss")
+
+    def _str_block(self, kind: str) -> str:
+        lines = [f"# {kind}", "-" * 25]
+        bg_err = 0.0
+        lines.append(f"const = {max(1.0 - self.total_ampl, 0.0):.5f} +/- {bg_err:.5f}")
+        for k, c in enumerate(self.components, start=1):
+            fe = getattr(c, "fit_errors", {})
+            lines.append(f"phas{k} = {c.phase:.5f} +/- {fe.get('phas', 0.0):.5f}")
+            lines.append(f"fwhm{k} = {c.fwhm:.5f} +/- {fe.get('fwhm', 0.0):.5f}")
+            lines.append(f"ampl{k} = {c.ampl:.5f} +/- {fe.get('ampl', 0.0):.5f}")
+        lines.append("-" * 25)
+        return "\n".join(lines)
+
+    def _str_generic(self) -> str:
+        lines = [f"# {type(self).__name__}"]
+        for k, c in enumerate(self.components, start=1):
+            fe = getattr(c, "fit_errors", {})
+            lines.append(f"component {k}: {type(c).__name__}")
+            lines.append(f"  phas = {c.phase:.5f} +/- {fe.get('phas', 0.0):.5f}")
+            for n in c.shape_names:
+                lines.append(
+                    f"  {n} = {getattr(c, n):.5f} +/- {fe.get(n, 0.0):.5f}"
+                )
+            lines.append(f"  ampl = {c.ampl:.5f} +/- {fe.get('ampl', 0.0):.5f}")
+        return "\n".join(lines)
+
+
+class GaussianPrior:
+    """Independent Gaussian priors on a subset of fit parameters
+    (reference lctemplate.GaussianPrior:975). Call with the fitter's
+    physical parameter vector; returns -log prior (added to the NLL)."""
+
+    def __init__(self, locations, widths, mask):
+        self.loc = np.asarray(locations, float)
+        self.width = np.asarray(widths, float)
+        self.mask = np.asarray(mask, bool)
+
+    def __len__(self) -> int:
+        return int(self.mask.sum())
+
+    def __call__(self, p) -> float:
+        import jax.numpy as jnp
+
+        d = (jnp.asarray(p)[self.mask] - self.loc) / self.width
+        return 0.5 * jnp.sum(d * d)
+
+
+# --- factories (reference lctemplate.get_gauss1/get_gauss2/get_2pb) -----------
+
+
+def get_gauss1(pulse_frac: float = 1.0, x1: float = 0.5, width1: float = 0.01) -> LCTemplate:
+    return LCTemplate([LCGaussian(x1, width1 / FWHM_TO_SIGMA, pulse_frac)])
+
+
+def get_gauss2(
+    pulse_frac: float = 1.0,
+    x1: float = 0.1,
+    x2: float = 0.55,
+    ratio: float = 1.5,
+    width1: float = 0.01,
+    width2: float = 0.02,
+) -> LCTemplate:
+    """Two-Gaussian profile; `ratio` = ampl1/ampl2, widths are sigmas in
+    cycles (converted to fwhm internally), matching the reference factory."""
+    a1 = ratio * pulse_frac / (1.0 + ratio)
+    a2 = pulse_frac / (1.0 + ratio)
+    return LCTemplate(
+        [
+            LCGaussian(x1, width1 / FWHM_TO_SIGMA, a1),
+            LCGaussian(x2, width2 / FWHM_TO_SIGMA, a2),
+        ]
+    )
+
+
+def get_2pb(pulse_frac: float = 0.9, lorentzian: bool = False) -> LCTemplate:
+    """Canonical two-peak-and-bridge gamma-pulsar shape."""
+    cls = LCLorentzian if lorentzian else LCGaussian
+    return LCTemplate(
+        [
+            cls(0.1, 0.03, 0.3 * pulse_frac),
+            cls(0.3, 0.15, 0.2 * pulse_frac),  # the bridge
+            cls(0.55, 0.03, 0.5 * pulse_frac),
+        ]
+    )

