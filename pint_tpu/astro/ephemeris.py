@@ -6,15 +6,16 @@ solar_system_ephemerides.py:73-133). No kernels ship in this environment and
 there is no network, so pint_tpu provides:
 
 - ``AnalyticEphemeris`` (default): truncated VSOP87D series for the Earth
-  (astro/vsop87.py) and for Jupiter/Saturn (astro/vsop87_planets.py — they
-  dominate the Sun-SSB wobble, so Keplerian elements are not good enough
-  for them), JPL "Keplerian elements for approximate positions"
-  (Standish/Williams public table, valid 1800-2050 AD) for the other
-  planets, the truncated Meeus/ELP lunar series for the Moon, and the
+  (astro/vsop87.py) and for Venus/Jupiter/Saturn/Uranus/Neptune
+  (astro/vsop87_planets.py — the giants dominate the Sun-SSB wobble, so
+  Keplerian elements are not good enough for them), JPL "Keplerian
+  elements for approximate positions" (Standish/Williams public table,
+  valid 1800-2050 AD) for Mercury/Mars,
+  the truncated Meeus/ELP lunar series for the Moon, and the
   barycentric constraint sum(GM_i r_i) = 0 for the Sun. Earth-SSB accuracy
-  ~120 km RMS vs DE421 (mostly fit-absorbable drift; measured in
-  tests/test_tempo2_columns.py), plus the N-body refinement below for the
-  high-frequency band. For DE-grade work, point ``PINT_TPU_EPHEM`` at a
+  ~60 km line-of-sight RMS vs DE421 with the N-body refinement (broadband
+  ~31 km, the rest mostly fit-absorbable drift; measured in
+  tests/test_tempo2_columns.py). For DE-grade work, point ``PINT_TPU_EPHEM`` at a
   type-2/3 SPK kernel (reader: pint_tpu.astro.spk).
 - body posvel composition utilities mirroring the reference's
   objPosVel_wrt_SSB API surface.
@@ -269,13 +270,14 @@ class AnalyticEphemeris:
     def _planets_helio_icrs(self, T: np.ndarray, M_fw=None) -> dict[str, np.ndarray]:
         """Heliocentric ICRS positions [m] of the planets/EMB.
 
-        Jupiter and Saturn come from their truncated VSOP87D series
-        (astro/vsop87_planets.py, of-date frame rotated to GCRS with the
-        same F-W chain as the Earth series) — the Sun-SSB wobble carries
-        1/1047 resp. 1/3498 of their position error, so mean elements are
-        not good enough for them.  The remaining planets keep the Keplerian
-        mean elements (adequate for Shapiro delays and their small wobble
-        shares)."""
+        Venus/Jupiter/Saturn/Uranus/Neptune come from their truncated
+        VSOP87D series (astro/vsop87_planets.py, of-date frame rotated to
+        GCRS with the same F-W chain as the Earth series) — the Sun-SSB
+        wobble carries 1/1047 of Jupiter's position error, 1/3498 of
+        Saturn's, 1/22903 and 1/19412 of Uranus'/Neptune's, so mean
+        elements are not good enough for them.  Mercury/Mars keep the
+        Keplerian mean elements (adequate for Shapiro delays and their
+        tiny wobble shares)."""
         from pint_tpu.astro import vsop87_planets
 
         if M_fw is None:

@@ -35,10 +35,17 @@ J1744-1134 8-yr GASP set, tests/test_tempo2_columns.py):
   approximate giant-planet elements (Jupiter's mean longitude only good
   to ~400 arcsec: 740,000 km of wobble x 2e-3 rad ~ 1500 km).
 - round 4 (truncated VSOP87D series for Jupiter/Saturn,
-  astro/vsop87_planets.py): ~120 km RMS total, mostly slow drift a
-  timing fit absorbs; ~40-80 km of 0.3-2 yr structure remains (series
-  truncation + Uranus/Neptune elements). DE-grade accuracy requires a
-  real kernel (PINT_TPU_EPHEM + astro/spk.py, proven by tests/test_spk.py).
+  astro/vsop87_planets.py): ~87 km RMS, dominated by a ~60 km component
+  at ~1150 d — the long-period anchor comb pinning the 1.5-6 yr band to
+  the truncated Earth series' dropped-term noise.
+- round 5: Uranus/Neptune VSOP87D series (their mean-element error is
+  almost pure drift a fit absorbs, but the absolute positions improve
+  ~500 km), and the comb replaced by a SEXTIC drift polynomial — the
+  smooth force-model drift pins to the series' secular content while the
+  oscillatory 1.5-6 yr band (3+ cycles per window, resolvable against a
+  sextic) comes from the dynamics: ~60 km RMS total, broadband ~31 km;
+  B1855 postfit 75 -> 15.5 us, NGC6440E 55 -> 37 us. DE-grade accuracy
+  still requires a real kernel (PINT_TPU_EPHEM + astro/spk.py).
 
 The anchor BANDS are load-bearing: the 6-DOF-per-body IC fit is only
 constrained inside them, and the unconstrained combinations leak
@@ -85,19 +92,16 @@ _FIT_BODIES = ("earth", "moon")  # ICs refined against the analytic anchors
 _ANCHOR_PERIODS_E = (365.25, 182.625, 121.75, 91.3125, 73.05,
                      779.94, 583.92, 398.88)
 _ANCHOR_PERIODS_M = (27.321662, 27.554550, 31.811940, 29.530589, 13.660831)
-# the Earth anchor additionally gets a harmonic COMB of LONG periods
-# (span/1, span/2, ... down to this floor): the integration carries a
-# quartic drift (residual giant-planet series error exerts ~1e-10 m/s^2 of
-# tidal acceleration error), and with only poly+line anchors that drift
-# LEAKED into the unanchored 1.5-6 yr band differently for every window
-# choice (measured: the same dataset's postfit moved 14 -> 82 us between
-# two window centers). Pinning the drift band to the analytic theory
-# (good to ~10-50 km there) makes serving window-robust, while everything
-# faster than the floor still comes from the dynamics — whose forced-
-# oscillation reconstruction beats the truncated series there (pinning the
-# whole mid band was tried and REGRESSED NGC6440E 63 -> 98 us).
-# Harmonic (equal-frequency) spacing keeps the comb resolvable on the
-# window.
+# LEGACY long-period comb (PINT_TPU_NBODY_COMB=1): harmonics of the
+# window span down to this floor, pinning the whole 1.5-6 yr band to the
+# analytic series. Rounds 3-4 needed it because the quartic drift poly
+# let the ~1e-10 m/s^2 force-model drift leak into that band
+# window-dependently (the same dataset's postfit moved 14 -> 82 us
+# between two window centers) — but the comb pinned the band to the
+# truncated series' own dropped-term noise (~60 km at ~1150 d on the
+# J1744 golden Roemer column). The round-5 default replaces it with the
+# sextic drift polynomial of _band_design: smooth drift still pins to
+# the series, the oscillatory band comes from the dynamics.
 _COMB_FLOOR_D = 550.0
 
 
@@ -148,8 +152,10 @@ class NBodyEphemeris:
     """
 
     #: bump when the integration/refinement algorithm changes — invalidates
-    #: every cached solution on disk
-    _CACHE_VERSION = 8
+    #: every cached solution on disk. History: 9 = Uranus/Neptune VSOP87D
+    #: series in the force model; 10 = half-integer comb experiment
+    #: (superseded); 11 = sextic drift polynomial, comb off by default.
+    _CACHE_VERSION = 11
 
     def __init__(self, base, t0_jcent: float, span_years: float = 16.0,
                  grid_days: float = 0.5, refine_iters: int = 3):
@@ -182,7 +188,7 @@ class NBodyEphemeris:
             np.asarray(self.base.pos_ssb(
                 b, np.array([self.t0 - 0.05, self.t0, self.t0 + 0.05])
             )).ravel()
-            for b in ("earth", "moon", "jupiter")
+            for b in ("earth", "moon", "jupiter", "uranus", "neptune")
         ]).round(3)
         key = hashlib.sha256(
             repr((
@@ -277,13 +283,24 @@ class NBodyEphemeris:
 
     def _earth_periods(self) -> tuple:
         """Line anchors + the long-period drift comb (see _COMB_FLOOR_D
-        note): harmonics of the window span down to the floor, skipping any
-        within 8% of an existing line."""
+        note): HALF-INTEGER harmonics of the window span down to the floor
+        (span/1, span/1.5, span/2, ...), skipping any within 8% of an
+        existing line. Integer-harmonic spacing left a ~60 km leak of the
+        force-model drift in the tooth gaps (measured at ~1100-1250 d
+        between span/4 and span/3 on the J1744 golden Roemer column); the
+        (1, t)-modulated teeth keep the half-spacing resolvable on the
+        window and the analytic series is safely better than the leak in
+        this whole band."""
+        if os.environ.get("PINT_TPU_NBODY_COMB", "0") == "0":
+            # default since round 5: no comb — the sextic drift poly
+            # absorbs the smooth force-model drift and the 1.5-6 yr band
+            # comes from the dynamics (see _band_design note)
+            return tuple(_ANCHOR_PERIODS_E)
         pers = list(_ANCHOR_PERIODS_E)
         span_d = 2.0 * self.half_span_s / DAY_S
-        k = 1
-        while span_d / k > _COMB_FLOOR_D:
-            p = span_d / k
+        k = 2
+        while span_d * 2.0 / k > _COMB_FLOOR_D:
+            p = span_d * 2.0 / k  # span/(k/2): half-integer harmonics
             if all(abs(p / q - 1.0) > 0.08 for q in pers):
                 pers.append(round(p, 3))
             k += 1
@@ -291,7 +308,7 @@ class NBodyEphemeris:
 
     def _band_design(self, t: np.ndarray, periods_d, deriv: bool = False):
         """Design matrix of the TRUSTED band of an analytic anchor:
-        {1, t, ..., t^4} + (1, t) x sin/cos at the given periods.
+        {1, t, ..., t^6} + (1, t) x sin/cos at the given periods.
 
         The big series terms (secular + the fundamental at each listed
         period) are known to 7+ digits; everything else — harmonics,
@@ -307,14 +324,19 @@ class NBodyEphemeris:
         """
         S = self.half_span_s
         tn = t / S
-        # polynomial to t^4: the integration accumulates t^3+ drift from
-        # force-model error (the Keplerian planets' ~1e5 km offsets exert
-        # slightly wrong tides); the analytic theory's secular content is
-        # good, so pin low frequencies to it through quartic order —
-        # t^3-scale Roemer drift is NOT absorbable by an F0/F1-only fit
-        cols = [np.ones_like(t), tn, tn * tn, tn**3, tn**4]
-        dcols = [np.zeros_like(t), np.full_like(t, 1.0 / S), 2.0 * tn / S,
-                 3.0 * tn**2 / S, 4.0 * tn**3 / S]
+        # polynomial to t^6: the integration accumulates t^3+ drift from
+        # force-model error (the giant-planet series truncation exerts a
+        # ~3e-11 m/s^2 tide error); the analytic theory's secular content
+        # is good, so pin the SMOOTH drift to it through sextic order —
+        # while the oscillatory 1.5-6 yr band (3+ cycles on the window,
+        # resolvable against a sextic) stays with the dynamics, whose
+        # forced-oscillation reconstruction beats the truncated series'
+        # dropped-term noise there (measured ~60 km at ~1150 d when that
+        # band was comb-pinned to the series)
+        cols = [tn**k for k in range(7)]
+        cols[0] = np.ones_like(t)
+        dcols = [np.zeros_like(t), np.full_like(t, 1.0 / S)]
+        dcols += [k * tn ** (k - 1) / S for k in range(2, 7)]
         for period_d in periods_d:
             w = 2 * np.pi / (period_d * DAY_S)
             s, c = np.sin(w * t), np.cos(w * t)

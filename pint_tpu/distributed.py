@@ -1,0 +1,203 @@
+"""Multi-host initialization and mesh construction.
+
+SURVEY.md §2.9 communication backend: the reference scales across hosts
+with MPI/NCCL process groups; the TPU-native equivalent is
+`jax.distributed` + XLA collectives over ICI/DCN. This module is the
+package's entry point for that path:
+
+- :func:`initialize` — one call per process before any jax computation;
+  on TPU pods every argument is auto-detected from the runtime, on
+  CPU/GPU clusters pass coordinator/process counts explicitly (mirrors
+  `jax.distributed.initialize`, with eager validation so misconfigured
+  jobs fail at the call site, not in a collective timeout later).
+- :func:`global_mesh` — a named `jax.sharding.Mesh` over every device of
+  every process (with `-1` wildcard sizing, like a reshape).
+- :func:`process_info` — process/device topology of the running job.
+
+A multi-host chi^2 grid then needs NO new code: `gridutils.grid_chisq`
+accepts any Mesh whose axes name the grid/toa shardings, and under jit the
+psums it emits ride ICI within a host and DCN across hosts:
+
+    import pint_tpu.distributed as dist
+    dist.initialize()                       # no-op single-process
+    mesh = dist.global_mesh({"grid": -1, "toa": 1})
+    grid_chisq(ftr, ("M2", "SINI"), grids, mesh=mesh,
+               grid_axis="grid", toa_axis="toa")
+
+Every process runs the same script; each computes the full (replicated)
+small outputs and its own shard of the grid axis.
+"""
+
+from __future__ import annotations
+
+import os
+
+from pint_tpu.utils.logging import get_logger
+
+log = get_logger("pint_tpu.distributed")
+
+__all__ = ["initialize", "global_mesh", "process_info"]
+
+
+def _init_args(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+    local_device_ids=None,
+) -> dict:
+    """Validated kwargs for `jax.distributed.initialize`.
+
+    Pure assembly/validation (unit-testable without a cluster): either
+    ALL of coordinator/num_processes/process_id are given explicitly, or
+    NONE are and the runtime must auto-detect (TPU pods, SLURM, and Open
+    MPI environments do; anything else raises here rather than hanging in
+    the coordinator handshake)."""
+    explicit = {
+        "coordinator_address": coordinator_address,
+        "num_processes": num_processes,
+        "process_id": process_id,
+    }
+    given = {k: v for k, v in explicit.items() if v is not None}
+    if given and len(given) != 3:
+        missing = sorted(set(explicit) - set(given))
+        raise ValueError(
+            f"explicit multi-process init needs coordinator_address, "
+            f"num_processes AND process_id; missing {missing}"
+        )
+    if num_processes is not None and num_processes < 1:
+        raise ValueError(f"num_processes must be >= 1, got {num_processes}")
+    if process_id is not None and not (0 <= process_id < (num_processes or 1)):
+        raise ValueError(
+            f"process_id {process_id} outside [0, {num_processes})"
+        )
+    if coordinator_address is not None and ":" not in coordinator_address:
+        raise ValueError(
+            f"coordinator_address must be host:port, got {coordinator_address!r}"
+        )
+    args = dict(given)
+    if local_device_ids is not None:
+        args["local_device_ids"] = list(local_device_ids)
+    if not given:
+        if local_device_ids is not None:
+            raise ValueError(
+                "local_device_ids without coordinator_address/num_processes/"
+                "process_id would start an uncoordinated handshake; pass the "
+                "full explicit triple (or none, for autodetection)"
+            )
+        # environments jax.distributed can auto-detect a topology from.
+        # NOTE: GCE TPU-VM pods can also be detected through the metadata
+        # server with none of these exported — pass force=True to
+        # initialize() there (documented on the function).
+        markers = ("TPU_WORKER_HOSTNAMES", "CLOUD_TPU_TASK_ID",
+                   "TPU_PROCESS_BOUNDS", "TPU_WORKER_ID",
+                   "MEGASCALE_COORDINATOR_ADDRESS",
+                   "SLURM_JOB_ID", "OMPI_COMM_WORLD_SIZE")
+        args["_autodetect"] = any(os.environ.get(m) for m in markers)
+    return args
+
+
+_initialized = False
+
+
+def initialize(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+    local_device_ids=None,
+    force: bool = False,
+) -> None:
+    """Connect this process to the jax distributed runtime (idempotent).
+
+    Call once per process, BEFORE the first jax computation. With no
+    arguments: a no-op in a single-process environment, auto-detected
+    topology when an env marker shows one (TPU pod vars, SLURM, Open
+    MPI). On GCE TPU-VM pods whose topology only the metadata server
+    knows (no env markers), pass ``force=True`` to hand detection to
+    `jax.distributed.initialize` unconditionally. Explicit arguments
+    follow `jax.distributed.initialize`."""
+    global _initialized
+    if _initialized:
+        log.info("distributed runtime already initialized; skipping")
+        return
+    args = _init_args(coordinator_address, num_processes, process_id,
+                      local_device_ids)
+    auto = args.pop("_autodetect", None)
+    if not args and auto is False and not force:
+        log.info("single-process environment (no coordinator/autodetect); "
+                 "skipping jax.distributed — force=True overrides")
+        return
+    import jax
+
+    try:
+        jax.distributed.initialize(**args)
+    except (RuntimeError, ValueError) as e:
+        if args:  # explicit configuration must fail loudly
+            raise
+        # autodetect marker was a false positive (e.g. a single-host
+        # tunnel exporting TPU_WORKER_HOSTNAMES, where no cluster engine
+        # resolves a coordinator) or the backend was already up: stay
+        # single-process rather than killing the job
+        log.warning(f"distributed autodetect declined ({e}); "
+                    "continuing single-process")
+        return
+    _initialized = True
+    log.info(
+        f"distributed runtime up: process {jax.process_index()}/"
+        f"{jax.process_count()}, {jax.local_device_count()} local / "
+        f"{jax.device_count()} global devices"
+    )
+
+
+def global_mesh(axes: dict[str, int] | None = None, devices=None):
+    """Named `jax.sharding.Mesh` over all global devices.
+
+    `axes` maps axis name -> size; ONE size may be -1 (fills with the
+    remaining devices, like reshape). Default: {"grid": -1} — shard the
+    embarrassing axis, replicate TOAs. The axis order is the dict order
+    (outermost first); put the axis that should ride the faster
+    interconnect LAST (innermost = nearest devices)."""
+    import numpy as np
+
+    import jax
+    from jax.sharding import Mesh
+
+    devices = np.asarray(devices if devices is not None else jax.devices())
+    axes = dict(axes or {"grid": -1})
+    sizes = list(axes.values())
+    wild = [k for k, v in axes.items() if v == -1]
+    if len(wild) > 1:
+        raise ValueError(f"only one -1 axis allowed, got {wild}")
+    known = 1
+    for v in sizes:
+        if v != -1:
+            if v < 1:
+                raise ValueError(f"axis sizes must be >= 1 or -1, got {axes}")
+            known *= v
+    if wild:
+        if devices.size % known:
+            raise ValueError(
+                f"{devices.size} devices not divisible by {known} "
+                f"(fixed axes of {axes})"
+            )
+        axes[wild[0]] = devices.size // known
+    elif known != devices.size:
+        raise ValueError(
+            f"axes {axes} need {known} devices, have {devices.size}"
+        )
+    shape = tuple(axes.values())
+    return Mesh(devices.reshape(shape), tuple(axes.keys()))
+
+
+def process_info() -> dict:
+    """Topology of the running job (single-process values when the
+    distributed runtime is not up)."""
+    import jax
+
+    return {
+        "process_index": jax.process_index(),
+        "process_count": jax.process_count(),
+        "local_device_count": jax.local_device_count(),
+        "global_device_count": jax.device_count(),
+        "backend": jax.default_backend(),
+        "initialized": _initialized,
+    }

@@ -1,0 +1,214 @@
+#!/usr/bin/env python
+"""Profiling harness: xprof device trace + per-phase table + XLA cost report.
+
+TPU-native mirror of the reference's profiling tools
+(profiling/run_profile.py — cProfile + gprof2dot call graphs;
+high_level_benchmark.py — per-function pstats tables over the bench
+scripts). On a jit-compiled stack the host Python profile says almost
+nothing about device time, so the equivalents here are:
+
+- `jax.profiler.trace` -> an xprof/TensorBoard trace directory with the
+  device timeline (one per run, under --logdir);
+- a per-phase wall table (setup / initial fit / compile / steady-state)
+  for the same four benches bench.py times;
+- the compiled grid kernel's own XLA cost analysis (FLOPs, bytes
+  accessed) and memory analysis — the device-side "call tree" summary;
+- optional --cprofile for the host-side view (TOA loading, parfile
+  parsing — the phases that ARE host-bound), top functions by cumtime
+  like the reference's pstats tables.
+
+Usage:
+    python profiling/run_profile.py [wls_grid|gls_grid|mcmc|toa_load] \
+        [--ntoas 20000] [--logdir /tmp/pint_tpu_trace] [--cprofile]
+
+View the trace: `tensorboard --logdir <logdir>` (Profile tab) or xprof.
+"""
+
+from __future__ import annotations
+
+import argparse
+import cProfile
+import io
+import os
+import pstats
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _phase_table(rows):
+    w = max(len(r[0]) for r in rows) + 2
+    print(f"\n{'phase':<{w}s} {'wall [s]':>10s}")
+    print("-" * (w + 11))
+    for name, t in rows:
+        print(f"{name:<{w}s} {t:>10.3f}")
+
+
+def _cost_report(compiled):
+    """FLOPs/bytes of a compiled XLA executable (the device 'call tree')."""
+    try:
+        cost = compiled.cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0]
+        mem = compiled.memory_analysis()
+        print("\nXLA cost analysis (per grid execution):")
+        for k in ("flops", "bytes accessed", "utilization operand 0 {}"):
+            if cost and k in cost:
+                print(f"  {k:>16s}: {cost[k]:.3e}")
+        if mem is not None:
+            for k in ("temp_size_in_bytes", "argument_size_in_bytes",
+                      "output_size_in_bytes"):
+                v = getattr(mem, k, None)
+                if v is not None:
+                    print(f"  {k:>26s}: {v / 1e6:.1f} MB")
+    except Exception as e:  # cost analysis is best-effort per backend
+        print(f"(cost analysis unavailable on this backend: {e})")
+
+
+def profile_grid(kind: str, ntoas: int, logdir: str, repeats: int = 3):
+    import jax
+
+    import bench
+    from pint_tpu.fitting import DownhillGLSFitter, DownhillWLSFitter
+    from pint_tpu.gridutils import grid_chisq
+
+    par = os.environ.get(
+        "PINT_TPU_BENCH_PAR", "/root/reference/profiling/J0740+6620.par"
+    )
+    if not os.path.exists(par):
+        par = bench.FALLBACK_PAR
+    rows = []
+    t0 = time.time()
+    model, toas = bench._build_dataset(par, ntoas)
+    rows.append(("dataset build/load", time.time() - t0))
+
+    cls = DownhillGLSFitter if kind == "gls_grid" else DownhillWLSFitter
+    ftr = cls(toas, model)
+    t0 = time.time()
+    ftr.fit_toas(maxiter=5)
+    rows.append(("initial fit (incl. compile)", time.time() - t0))
+
+    parnames, grids = bench._grid_for(model, ftr)
+    t0 = time.time()
+    chi2 = grid_chisq(ftr, parnames, grids, maxiter=1, batch=1)
+    rows.append(("grid compile + first run", time.time() - t0))
+
+    with jax.profiler.trace(logdir):
+        t0 = time.time()
+        for _ in range(repeats):
+            chi2 = grid_chisq(ftr, parnames, grids, maxiter=1, batch=1)
+        steady = (time.time() - t0) / repeats
+    rows.append((f"steady-state grid (mean of {repeats})", steady))
+    _phase_table(rows)
+    print(f"\n{chi2.size / steady:.2f} grid points/s on {jax.default_backend()}")
+
+    # device-side cost report: lower+compile the same grid program the
+    # calls above used (hits the persistent XLA cache, so this is cheap)
+    from pint_tpu.fitting.gls import GLSFitter
+    from pint_tpu.gridutils import _grid_single_fn, _grid_tiles, _host_data
+
+    model2 = ftr.model
+    # same kernel choice grid_chisq made (gridutils.grid_chisq_points)
+    correlated = isinstance(ftr, GLSFitter) and model2.has_correlated_errors
+    free = tuple(n for n in model2.free_params if n not in parnames)
+    mg = np.meshgrid(*[np.asarray(v, np.float64) for v in grids])
+    pts = np.stack([g.ravel() for g in mg], axis=1)
+    tiles, _ = _grid_tiles(pts, 1)
+    fn, _key = _grid_single_fn(model2, tuple(parnames), free,
+                               ftr.resids.subtract_mean, 1, 1, correlated)
+    params = model2.xprec.convert_params(model2.params)
+    data = _host_data(ftr.resids, ftr.tensor)
+    _cost_report(fn.lower(tiles, params, data).compile())
+    return logdir
+
+
+def profile_toa_load(ntoas: int, logdir: str):
+    import jax
+
+    import bench
+    from pint_tpu.simulation import _reprepare
+
+    par = os.environ.get(
+        "PINT_TPU_BENCH_PAR", "/root/reference/profiling/J0740+6620.par"
+    )
+    if not os.path.exists(par):
+        par = bench.FALLBACK_PAR
+    rows = []
+    t0 = time.time()
+    model, toas = bench._build_dataset(par, ntoas)
+    rows.append(("dataset build/load", time.time() - t0))
+    with jax.profiler.trace(logdir):
+        t0 = time.time()
+        _reprepare(toas, np.zeros(len(toas)))
+        rows.append(("full re-preparation (clock+TDB+posvel)", time.time() - t0))
+    _phase_table(rows)
+    return logdir
+
+
+def profile_mcmc(logdir: str, nsteps: int = 200):
+    import jax
+
+    import bench
+    from pint_tpu.fitting import MCMCFitter
+    from pint_tpu.models.builder import get_model
+    from pint_tpu.toas import get_TOAs
+
+    model = get_model(bench.NGC6440E_PAR)
+    toas = get_TOAs(bench.NGC6440E_TIM, model=model)
+    ftr = MCMCFitter(toas, model, nwalkers=26)
+    rows = []
+    t0 = time.time()
+    ftr.fit_toas(nsteps=nsteps, seed=1)
+    rows.append(("chain compile + first run", time.time() - t0))
+    with jax.profiler.trace(logdir):
+        t0 = time.time()
+        ftr.fit_toas(nsteps=nsteps, seed=2)
+        rows.append((f"steady-state chain ({nsteps} steps)", time.time() - t0))
+    _phase_table(rows)
+    return logdir
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("target", nargs="?", default="wls_grid",
+                    choices=("wls_grid", "gls_grid", "mcmc", "toa_load"))
+    ap.add_argument("--ntoas", type=int,
+                    default=int(os.environ.get("PINT_TPU_BENCH_NTOAS", "20000")))
+    ap.add_argument("--logdir", default="/tmp/pint_tpu_trace")
+    ap.add_argument("--cprofile", action="store_true",
+                    help="host-side cProfile too (top 25 by cumtime)")
+    args = ap.parse_args(argv)
+
+    logdir = os.path.join(args.logdir, args.target)
+    os.makedirs(logdir, exist_ok=True)
+
+    def run():
+        if args.target in ("wls_grid", "gls_grid"):
+            profile_grid(args.target, args.ntoas, logdir)
+        elif args.target == "toa_load":
+            profile_toa_load(args.ntoas, logdir)
+        else:
+            profile_mcmc(logdir)
+
+    if args.cprofile:
+        pr = cProfile.Profile()
+        pr.enable()
+        run()
+        pr.disable()
+        buf = io.StringIO()
+        pstats.Stats(pr, stream=buf).strip_dirs().sort_stats("cumtime").print_stats(25)
+        print("\nhost-side cProfile (top 25 by cumtime):")
+        print(buf.getvalue())
+    else:
+        run()
+
+    print(f"\nxprof trace written to {logdir}")
+    print(f"view with: tensorboard --logdir {args.logdir}  (Profile tab)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

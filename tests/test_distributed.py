@@ -1,0 +1,122 @@
+"""Multi-host init helper + global mesh construction (SURVEY §2.9 comm
+backend). The cluster handshake itself cannot run here; the argument
+assembly, validation, autodetection markers, and mesh math are the
+unit-testable surface, plus an end-to-end sharded grid over the mesh the
+helper builds on the 8-device virtual CPU topology."""
+
+import numpy as np
+import pytest
+
+from pint_tpu.distributed import _init_args, global_mesh, process_info
+
+
+class TestInitArgs:
+    def test_all_or_nothing(self):
+        with pytest.raises(ValueError, match="missing"):
+            _init_args(coordinator_address="host:1234")
+        with pytest.raises(ValueError, match="missing"):
+            _init_args(num_processes=4, process_id=0)
+
+    def test_explicit_complete(self):
+        args = _init_args("host:1234", 4, 2)
+        assert args == {
+            "coordinator_address": "host:1234",
+            "num_processes": 4,
+            "process_id": 2,
+        }
+
+    def test_validation(self):
+        with pytest.raises(ValueError, match="host:port"):
+            _init_args("no-port", 2, 0)
+        with pytest.raises(ValueError, match="num_processes"):
+            _init_args("h:1", 0, 0)
+        with pytest.raises(ValueError, match="outside"):
+            _init_args("h:1", 2, 2)
+
+    def test_local_device_ids_pass_through(self):
+        args = _init_args("h:1", 2, 0, local_device_ids=(0, 1))
+        assert args["local_device_ids"] == [0, 1]
+
+    def test_local_device_ids_alone_rejected(self):
+        """Regression: local_device_ids without the coordinator triple
+        must fail eagerly, not start an uncoordinated handshake."""
+        with pytest.raises(ValueError, match="uncoordinated"):
+            _init_args(local_device_ids=[0])
+
+    def test_autodetect_markers(self, monkeypatch):
+        for m in ("TPU_WORKER_HOSTNAMES", "CLOUD_TPU_TASK_ID",
+                  "TPU_PROCESS_BOUNDS", "TPU_WORKER_ID",
+                  "MEGASCALE_COORDINATOR_ADDRESS",
+                  "SLURM_JOB_ID", "OMPI_COMM_WORLD_SIZE"):
+            monkeypatch.delenv(m, raising=False)
+        assert _init_args()["_autodetect"] is False
+        monkeypatch.setenv("SLURM_JOB_ID", "123")
+        assert _init_args()["_autodetect"] is True
+
+    def test_initialize_survives_false_positive_marker(self, monkeypatch):
+        """Regression: a single-host tunnel exporting TPU_WORKER_HOSTNAMES
+        made autodetect call jax.distributed.initialize after the backend
+        was up; auto mode must degrade to single-process, not raise."""
+        import pint_tpu.distributed as dist
+
+        monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "localhost")
+        monkeypatch.setattr(dist, "_initialized", False)
+        dist.initialize()  # backend already initialized by the test session
+        assert dist._initialized is False
+
+
+class TestGlobalMesh:
+    def test_wildcard_fill(self):
+        mesh = global_mesh({"grid": -1, "toa": 2})
+        assert mesh.shape["toa"] == 2
+        assert mesh.shape["grid"] * 2 == mesh.devices.size
+
+    def test_default_single_axis(self):
+        mesh = global_mesh()
+        assert tuple(mesh.axis_names) == ("grid",)
+        assert mesh.shape["grid"] == mesh.devices.size
+
+    def test_errors(self):
+        import jax
+
+        n = len(jax.devices())
+        with pytest.raises(ValueError, match="one -1 axis"):
+            global_mesh({"a": -1, "b": -1})
+        with pytest.raises(ValueError, match="not divisible"):
+            global_mesh({"a": -1, "b": n + 1})
+        with pytest.raises(ValueError, match="need"):
+            global_mesh({"a": 1, "b": 1})
+        with pytest.raises(ValueError, match=">= 1"):
+            global_mesh({"a": 0, "b": -1})
+
+    def test_process_info_single(self):
+        info = process_info()
+        assert info["process_count"] == 1
+        assert info["global_device_count"] == len(__import__("jax").devices())
+        assert info["initialized"] is False
+
+
+class TestShardedGridOnHelperMesh:
+    def test_grid_chisq_over_global_mesh(self, reference_datafile):
+        """The documented multi-host recipe end-to-end on the virtual
+        topology: grid_chisq over the mesh global_mesh builds matches the
+        unsharded scan."""
+        from pint_tpu.fitting import WLSFitter
+        from pint_tpu.gridutils import grid_chisq
+        from pint_tpu.models.builder import get_model_and_toas
+
+        m, t = get_model_and_toas(
+            reference_datafile("NGC6440E.par"), reference_datafile("NGC6440E.tim")
+        )
+        ftr = WLSFitter(t, m)
+        ftr.fit_toas(maxiter=2)
+        f0 = float(np.asarray(m.params["F0"].hi))
+        f1 = float(np.asarray(m.params["F1"].hi))
+        grids = (np.linspace(f0 - 1e-8, f0 + 1e-8, 4),
+                 np.linspace(f1 - 1e-16, f1 + 1e-16, 2))
+        plain = grid_chisq(ftr, ("F0", "F1"), grids, maxiter=1)
+        mesh = global_mesh({"grid": -1, "toa": 2})
+        sharded = grid_chisq(ftr, ("F0", "F1"), grids, maxiter=1, mesh=mesh,
+                             grid_axis="grid", toa_axis="toa")
+        np.testing.assert_allclose(np.asarray(sharded), np.asarray(plain),
+                                   rtol=1e-8)

@@ -72,11 +72,13 @@ class TestBinaryDelayParity:
 
 class TestEndToEndFitQuality:
     def test_ngc6440e_postfit(self, monkeypatch):
-        """NGC6440E full pipeline: postfit weighted RMS < 90 us, converged
-        (round-1 was 3,278 us; round-2 ~170 us; round 3/4 sit at 34-71 us
-        depending on the N-body window the run shares with other datasets —
-        the remaining wiggle is the ~40 km mid-band ephemeris error of
-        test_tempo2_columns.py; the reference with DE421 reaches ~20 us)."""
+        """NGC6440E full pipeline: postfit weighted RMS < 55 us, converged
+        (round-1 was 3,278 us; round-2 ~170 us; rounds 3/4 sat at 34-71 us
+        depending on the shared N-body window; round 5 made the window
+        deterministic per dataset AND replaced the drift comb with a
+        sextic drift poly — measured 37.1 us, reproducible to all digits
+        regardless of co-loaded datasets; the reference with DE421
+        reaches ~20 us). Bound = 1.5x the measured level."""
         monkeypatch.setenv("PINT_TPU_NBODY", "1")
         from pint_tpu.fitting import DownhillWLSFitter
         from pint_tpu.models.builder import get_model_and_toas
@@ -88,16 +90,16 @@ class TestEndToEndFitQuality:
         ftr = DownhillWLSFitter(t, m)
         res = ftr.fit_toas(maxiter=15)
         assert res.converged
-        assert ftr.resids.rms_weighted() * 1e6 < 90.0
+        assert ftr.resids.rms_weighted() * 1e6 < 55.0  # measured 37.1
 
     def test_b1855_tai_postfit(self, monkeypatch):
         """B1855+09 dfg+12 (DD binary, DMX, 60 jumps) full pipeline:
-        postfit weighted RMS < 90 us (TEMPO golden: 3.49 us; round 3
-        measured ~244 us; the round-4 VSOP87D giant-planet series cut the
-        Sun-SSB wobble error to the 14-75 us range depending on the N-body
-        window — the residual ~1e-10 m/s^2 force-model drift still leaks
-        tens of km of window-shaped structure; this bound locks the
-        window-robust level)."""
+        postfit weighted RMS < 25 us (TEMPO golden: 3.49 us; round 3
+        measured ~244 us; round 4's VSOP87D giant-planet series reached
+        14-75 us depending on the shared N-body window; round 5's
+        deterministic window + sextic-poly anchor measured 15.5 us,
+        identical across runs and co-loaded datasets). Bound = 1.5x the
+        measured level."""
         monkeypatch.setenv("PINT_TPU_NBODY", "1")
         from pint_tpu.fitting import fit_auto
         from pint_tpu.models.builder import get_model_and_toas
@@ -105,7 +107,7 @@ class TestEndToEndFitQuality:
         m, t = get_model_and_toas(TAI_PAR, TAI_TIM)
         ftr = fit_auto(t, m)
         res = ftr.fit_toas(maxiter=40)
-        assert ftr.resids.rms_weighted() * 1e6 < 90.0
+        assert ftr.resids.rms_weighted() * 1e6 < 25.0  # measured 15.5
         gold = _load_golden(TAI_GOLDEN)[:, 0]
         # golden's own scale for context: TEMPO postfit rms
         assert np.std(gold) * 1e6 < 10.0

@@ -12,8 +12,11 @@ column isolates our delay chain component by component:
   environment). Round-3's N-body anchor-band fix cut the disagreement from
   ~1590 km RMS (a 2000 km semi-annual leak of the IC fit) to ~540 km;
   round-4's VSOP87D Jupiter/Saturn series (astro/vsop87_planets.py)
-  removed the giant-planet Sun-wobble error and brought it to ~87 km RMS
-  (broadband ~39 km); the guards here lock that level.
+  removed the giant-planet Sun-wobble error (~87 km RMS); round 5
+  replaced the long-period anchor comb (which pinned the 1.5-6 yr band
+  to the truncated series' dropped-term noise, measured ~60 km at
+  ~1150 d) with a sextic drift polynomial, letting the dynamics supply
+  that band — ~60 km RMS total, broadband ~31 km. The guards lock that.
 """
 
 import os
@@ -73,7 +76,7 @@ class TestTempo2Columns:
         d -= d.mean()
         rms_km = np.std(d) * C_KM_S
         # total ephemeris disagreement (mostly multi-year drift)
-        assert rms_km < 150.0  # measured ~87 km
+        assert rms_km < 90.0  # measured ~60 km
         # the fit-relevant bands must stay tight: harmonic amplitudes
         mjd = toas.tdb.mjd_float()
         yr = (mjd - mjd.mean()) / 365.25
@@ -89,12 +92,12 @@ class TestTempo2Columns:
             for i, per in enumerate(pers)
         }
         # the round-2 code had 2000 km here; the anchor-band fix must hold
-        assert amps[365.25] < 60.0       # measured ~29 km
-        assert amps[182.625] < 30.0      # measured ~12 km
-        assert amps[121.75] < 30.0       # measured ~10 km
-        assert amps[27.554] < 60.0       # measured ~24 km
+        assert amps[365.25] < 40.0       # measured ~27 km
+        assert amps[182.625] < 15.0      # measured ~10 km
+        assert amps[121.75] < 15.0       # measured ~9 km
+        assert amps[27.554] < 20.0       # measured ~11 km
         broadband = np.std(d - A @ c) * C_KM_S
-        assert broadband < 70.0          # measured ~39 km
+        assert broadband < 45.0          # measured ~31 km
 
     def test_prefit_residual_parity(self, chain):
         """End-to-end: our prefit residuals vs TEMPO2's (DE421) — the
@@ -103,4 +106,4 @@ class TestTempo2Columns:
         r = np.asarray(res.time_resids)
         d = r - golden[:, 0]
         d -= d.mean()
-        assert np.std(d) * 1e6 < 500.0  # measured ~290 us (ephemeris drift)
+        assert np.std(d) * 1e6 < 300.0  # measured ~201 us (ephemeris drift)
