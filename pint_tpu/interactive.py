@@ -38,15 +38,21 @@ log = get_logger("pint_tpu.interactive")
 
 
 class _Snapshot:
-    __slots__ = ("par", "deleted", "flags", "fitted", "track", "label")
+    __slots__ = ("par", "deleted", "flags", "fitted", "track", "label",
+                 "toas")
 
-    def __init__(self, par, deleted, flags, fitted, track, label):
+    def __init__(self, par, deleted, flags, fitted, track, label, toas):
         self.par = par
         self.deleted = deleted
         self.flags = flags
         self.fitted = fitted
         self.track = track
         self.label = label
+        #: the TOAs OBJECT at snapshot time — normally the same object the
+        #: session still holds (edits mutate flags in place, which the
+        #: deep-copied `flags` restores), but a tim edit REPLACES it, and
+        #: undo must put the old set back
+        self.toas = toas
 
 
 class InteractivePulsar:
@@ -72,6 +78,9 @@ class InteractivePulsar:
                 raise ValueError("need a timfile or a TOAs object")
             toas = get_TOAs(timfile, model=self.model)
         self.all_toas = toas
+        #: the originally loaded TOA set — reset() returns to it even
+        #: after a tim edit replaced all_toas
+        self._loaded_toas = toas
         self.fit_method = fitter
         #: indices (into all_toas) excluded from fitting
         self.deleted: set[int] = set()
@@ -126,6 +135,7 @@ class InteractivePulsar:
             fitted=self.fitted,
             track=self.track_pulse_numbers,
             label=label,
+            toas=self.all_toas,
         ))
 
     def undo(self) -> str:
@@ -137,18 +147,27 @@ class InteractivePulsar:
         self.model = snap.par
         self.deleted = snap.deleted
         self.track_pulse_numbers = snap.track
+        if snap.toas is not self.all_toas:
+            # a tim edit swapped the TOA set; restore the old object (and
+            # a selection mask of its size)
+            self.all_toas = snap.toas
+            self.selected = np.zeros(len(snap.toas), dtype=bool)
         self.all_toas.flags[:] = snap.flags
         self.fitted = snap.fitted
-        # selection indices survive edits (the reference re-derives them per
-        # widget); sizes never change, only masks/params do
+        # selection indices survive in-place edits (the reference
+        # re-derives them per widget); sizes only change across tim edits
         log.info(f"undid: {snap.label}")
         return snap.label
 
     def reset(self) -> None:
-        """Back to the loaded par/tim (reference resetAll, pulsar.py:160)."""
+        """Back to the loaded par/tim (reference resetAll, pulsar.py:160)
+        — including undoing any tim-edit TOA-set replacement."""
         self._push("reset")
         self.model = copy.deepcopy(self.prefit_model)
         self.deleted = set()
+        if self.all_toas is not self._loaded_toas:
+            self.all_toas = self._loaded_toas
+            self.selected = np.zeros(len(self.all_toas), dtype=bool)
         for f in self.all_toas.flags:
             f.pop("gui_jump", None)
             f.pop("padd", None)
@@ -341,6 +360,65 @@ class InteractivePulsar:
 
         return calculate_random_models(self.fitter, self.active_toas(),
                                        n_models=n_models, rng=rng)
+
+    # --- editor channel (reference pintk/paredit.py, timedit.py) ---------------
+
+    def apply_par_text(self, text: str) -> None:
+        """Replace the working model with one rebuilt from edited parfile
+        text through the normal parse/build path (undoable; the par-editor
+        Apply button routes here)."""
+        from pint_tpu.io.par import parse_parfile
+        from pint_tpu.models.builder import build_model
+
+        model = build_model(parse_parfile(text, from_text=True))
+        self._push("par edit")
+        self.model = model
+        self.fitted = False
+
+    def apply_tim_text(self, text: str) -> None:
+        """Replace the loaded TOAs with ones re-read from edited tim text
+        (undoable in the model/flag dimensions; the TOA set itself is
+        replaced, so deletion/selection state resets — the tim-editor
+        Apply button routes here)."""
+        import os
+        import tempfile
+
+        from pint_tpu.toas import get_TOAs
+
+        with tempfile.NamedTemporaryFile("w", suffix=".tim",
+                                         delete=False) as f:
+            f.write(text)
+            tmp = f.name
+        try:
+            toas = get_TOAs(tmp, model=self.model)
+        finally:
+            os.unlink(tmp)
+        self._push("tim edit")
+        self.all_toas = toas
+        self.deleted = set()
+        self.selected = np.zeros(len(toas), dtype=bool)
+        self.fitted = False
+        # pulse-number tracking cannot survive a TOA-set swap: the new
+        # lines may lack -pn flags entirely (resids would raise) or
+        # partially (silent NaNs); the user re-wraps on the new set
+        self.track_pulse_numbers = False
+
+    def tim_text(self) -> str:
+        """ALL loaded TOAs as Tempo2 tim text (the tim editor's buffer).
+        Soft-deleted TOAs are included — deletion is session state, not
+        tim content, and an editor Apply must not silently discard
+        recoverable TOAs (write_tim() writes the active set instead)."""
+        import os
+        import tempfile
+
+        fd, tmp = tempfile.mkstemp(suffix=".tim")
+        os.close(fd)
+        try:
+            self.all_toas.write_tim(tmp, name=self.name)
+            with open(tmp) as f:
+                return f.read()
+        finally:
+            os.unlink(tmp)
 
     # --- output ----------------------------------------------------------------
 

@@ -1,0 +1,328 @@
+"""pintk: the Tk interactive-timing GUI.
+
+Reference: pint/pintk/ (plk.py:1610 plk widget, paredit.py par editor,
+timedit.py tim editor, pintk.py shell). The reference couples its state
+machine to the widgets; here the widgets are a THIN shell over the
+headless `interactive.InteractivePulsar` session and the matplotlib
+`plot_utils.InteractivePlot` front end — every button routes through the
+same methods a script or notebook would call, so the GUI adds wiring, not
+logic (and the whole workflow stays testable headless).
+
+Layout:
+- left column: fitter choice, Fit / Undo / Reset / write-par / write-tim,
+  a color-mode selector, the wrms readout, and the free-parameter
+  checkbox panel (fit flags; reference plk.py par panel);
+- right: the embedded matplotlib canvas with the plk rectangle selection
+  and single-key bindings (d/j/f/u/r/c/+/-, plot_utils.InteractivePlot);
+- Par... / Tim... buttons open editor windows (Text widget + Apply /
+  Revert / Save, reference paredit.py / timedit.py): Apply rebuilds the
+  model (or reloads the TOAs) from the edited text through the normal
+  parsing path, as an undoable operation.
+
+Run: ``pintk model.par toas.tim`` (or ``python -m pint_tpu.pintk``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from pint_tpu.utils.logging import get_logger
+
+log = get_logger("pint_tpu.pintk")
+
+
+class PintkApp:
+    """Main window wiring (constructed around a live Tk root; every
+    action delegates to the InteractivePulsar session)."""
+
+    FITTERS = ("auto", "wls", "gls", "downhill_wls", "downhill_gls")
+    COLOR_MODES = ("none", "obs", "fe-flag")
+
+    def __init__(self, session, master=None):
+        import tkinter as tk
+        from tkinter import ttk
+
+        import matplotlib
+
+        matplotlib.use("TkAgg", force=False)
+        from matplotlib.backends.backend_tkagg import (
+            FigureCanvasTkAgg,
+            NavigationToolbar2Tk,
+        )
+        from matplotlib.figure import Figure
+
+        from pint_tpu.plot_utils import InteractivePlot
+
+        self.session = session
+        self.root = master or tk.Tk()
+        self.root.title(f"pintk — {session.name}")
+
+        left = ttk.Frame(self.root)
+        left.pack(side=tk.LEFT, fill=tk.Y, padx=4, pady=4)
+
+        # fitter choice
+        ttk.Label(left, text="Fitter").pack(anchor="w")
+        self.fitter_var = tk.StringVar(value=session.fit_method)
+        ttk.OptionMenu(left, self.fitter_var, session.fit_method,
+                       *self.FITTERS, command=self._set_fitter).pack(
+            anchor="w", fill=tk.X)
+
+        # action buttons
+        for label, cmd in (
+            ("Fit", self.do_fit), ("Undo", self.do_undo),
+            ("Reset", self.do_reset), ("Clear selection", self.do_clear),
+            ("Delete selected", self.do_delete),
+            ("Jump selected", self.do_jump),
+            ("Write par...", self.do_write_par),
+            ("Write tim...", self.do_write_tim),
+            ("Par...", self.open_par_editor),
+            ("Tim...", self.open_tim_editor),
+        ):
+            ttk.Button(left, text=label, command=cmd).pack(
+                anchor="w", fill=tk.X, pady=1)
+
+        ttk.Label(left, text="Color by").pack(anchor="w", pady=(6, 0))
+        self.color_var = tk.StringVar(value="none")
+        ttk.OptionMenu(left, self.color_var, "none", *self.COLOR_MODES,
+                       command=lambda *_: self.refresh()).pack(
+            anchor="w", fill=tk.X)
+
+        self.status = tk.StringVar(value="")
+        ttk.Label(left, textvariable=self.status, wraplength=180).pack(
+            anchor="w", pady=(6, 0))
+
+        # free-parameter checkboxes (scrollable)
+        ttk.Label(left, text="Fit parameters").pack(anchor="w", pady=(6, 0))
+        canvas = tk.Canvas(left, width=180, height=320)
+        scroll = ttk.Scrollbar(left, orient="vertical", command=canvas.yview)
+        self.param_frame = ttk.Frame(canvas)
+        self.param_frame.bind(
+            "<Configure>",
+            lambda e: canvas.configure(scrollregion=canvas.bbox("all")),
+        )
+        canvas.create_window((0, 0), window=self.param_frame, anchor="nw")
+        canvas.configure(yscrollcommand=scroll.set)
+        canvas.pack(side=tk.LEFT, fill=tk.Y)
+        scroll.pack(side=tk.LEFT, fill=tk.Y)
+        self.param_vars: dict = {}
+        self._build_param_panel()
+
+        # the plk canvas
+        fig = Figure(figsize=(9, 6), dpi=100)
+        ax = fig.add_subplot(111)
+        self.canvas = FigureCanvasTkAgg(fig, master=self.root)
+        self.plot = InteractivePlot(session, ax=ax)
+        self.plot.connect()
+        NavigationToolbar2Tk(self.canvas, self.root)
+        self.canvas.get_tk_widget().pack(side=tk.RIGHT, fill=tk.BOTH,
+                                         expand=True)
+        self.canvas.draw()
+        self._update_status()
+
+    # --- panels ---------------------------------------------------------------
+
+    def _build_param_panel(self):
+        import tkinter as tk
+        from tkinter import ttk
+
+        for child in list(self.param_frame.children.values()):
+            child.destroy()
+        self.param_vars.clear()
+        meta = self.session.model.param_meta
+        for name in sorted(meta, key=lambda n: (len(n), n)):
+            m = meta[name]
+            if getattr(m.spec, "kind", None) in ("str",):
+                continue
+            var = tk.BooleanVar(value=not m.frozen)
+            ttk.Checkbutton(
+                self.param_frame, text=name, variable=var,
+                command=lambda n=name, v=var: self._toggle_param(n, v),
+            ).pack(anchor="w")
+            self.param_vars[name] = var
+
+    def _toggle_param(self, name: str, var) -> None:
+        self.session.model.param_meta[name].frozen = not var.get()
+        self.session.model.clear_caches()
+        # status text only — no rms readout here: residuals don't depend
+        # on fit flags, and the cache was just cleared (a recompute would
+        # re-trace per click)
+        self.status.set(f"{name} {'free' if var.get() else 'frozen'}")
+
+    def _update_status(self, msg: str | None = None):
+        s = self.session
+        state = "postfit" if s.fitted else "prefit"
+        base = (f"{len(s.all_toas) - len(s.deleted)} TOAs, "
+                f"{state} wrms {s.rms_us():.2f} us")
+        self.status.set(f"{msg}\n{base}" if msg else base)
+
+    def refresh(self):
+        mode = self.color_var.get()
+        self.plot.color_flag = {"obs": "_obs", "fe-flag": "fe"}.get(mode)
+        self.plot.refresh()
+        self._update_status()
+
+    # --- actions --------------------------------------------------------------
+
+    def _set_fitter(self, value):
+        self.session.fit_method = value
+        self._update_status(f"fitter: {value}")
+
+    #: sentinel distinguishing "action raised" from a legitimate None
+    #: result (add_jump returns None when it removes a jump)
+    _FAILED = object()
+
+    def _guard(self, fn, label):
+        try:
+            return fn()
+        except Exception as e:  # GUI survives bad input; log + show
+            log.warning(f"{label} failed: {e}")
+            self._update_status(f"{label} failed: {e}")
+            return self._FAILED
+
+    def do_fit(self):
+        res = self._guard(lambda: self.plot.fit(), "fit")
+        if res is not self._FAILED:
+            self._update_status(
+                f"chi2 {res.chi2:.2f} / dof {res.dof}"
+                f"{'' if res.converged else ' (NOT converged)'}")
+            self._build_param_panel()
+
+    def do_undo(self):
+        label = self._guard(self.plot.undo, "undo")
+        if label is not self._FAILED:
+            self._update_status(f"undid: {label}")
+            self._build_param_panel()
+
+    def do_reset(self):
+        if self._guard(self.plot.reset, "reset") is not self._FAILED:
+            self._build_param_panel()
+            self._update_status("reset")
+
+    def do_clear(self):
+        self.plot.clear_selection()
+        self._update_status()
+
+    def do_delete(self):
+        if self._guard(self.plot.delete_selected, "delete") is not self._FAILED:
+            self._update_status()
+
+    def do_jump(self):
+        name = self._guard(self.plot.jump_selected, "jump")
+        if name is self._FAILED:
+            return
+        self._build_param_panel()
+        self._update_status(f"jump: {name}" if name else "jump removed")
+
+    def do_write_par(self):
+        from tkinter import filedialog
+
+        path = filedialog.asksaveasfilename(
+            defaultextension=".par", initialfile=f"{self.session.name}.par")
+        if path:
+            self.session.write_par(path)
+            self._update_status(f"wrote {path}")
+
+    def do_write_tim(self):
+        from tkinter import filedialog
+
+        path = filedialog.asksaveasfilename(
+            defaultextension=".tim", initialfile=f"{self.session.name}.tim")
+        if path:
+            self.session.write_tim(path)
+            self._update_status(f"wrote {path}")
+
+    # --- editors (reference paredit.py / timedit.py) ---------------------------
+
+    def open_par_editor(self):
+        self._open_editor(
+            title="par editor",
+            text=self.session.as_parfile(),
+            apply=self._apply_par_text,
+            save_ext=".par",
+        )
+
+    def open_tim_editor(self):
+        self._open_editor(
+            title="tim editor",
+            text=self.session.tim_text(),
+            apply=self._apply_tim_text,
+            save_ext=".tim",
+        )
+
+    def _apply_par_text(self, text: str):
+        self.session.apply_par_text(text)
+        self.refresh()
+        self._build_param_panel()
+        self._update_status("applied edited par")
+
+    def _apply_tim_text(self, text: str):
+        self.session.apply_tim_text(text)
+        self.refresh()
+        self._update_status(
+            f"loaded {len(self.session.all_toas)} TOAs from edited tim")
+
+    def _open_editor(self, title, text, apply, save_ext):
+        import tkinter as tk
+        from tkinter import filedialog, ttk
+
+        win = tk.Toplevel(self.root)
+        win.title(f"{title} — {self.session.name}")
+        txt = tk.Text(win, width=90, height=40, undo=True)
+        txt.insert("1.0", text)
+        txt.pack(side=tk.TOP, fill=tk.BOTH, expand=True)
+        bar = ttk.Frame(win)
+        bar.pack(side=tk.BOTTOM, fill=tk.X)
+
+        def do_apply():
+            self._guard(lambda: apply(txt.get("1.0", "end-1c")),
+                        f"{title} apply")
+
+        def do_revert():
+            txt.delete("1.0", "end")
+            txt.insert("1.0", text)
+
+        def do_save():
+            path = filedialog.asksaveasfilename(defaultextension=save_ext)
+            if path:
+                with open(path, "w") as f:
+                    f.write(txt.get("1.0", "end-1c"))
+
+        for label, cmd in (("Apply", do_apply), ("Revert", do_revert),
+                           ("Save as...", do_save), ("Close", win.destroy)):
+            ttk.Button(bar, text=label, command=cmd).pack(side=tk.LEFT)
+        return win
+
+    def mainloop(self):
+        self.root.mainloop()
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Interactive timing GUI (reference pintk)")
+    ap.add_argument("parfile")
+    ap.add_argument("timfile")
+    ap.add_argument("--fitter", default="auto",
+                    choices=PintkApp.FITTERS)
+    args = ap.parse_args(argv)
+
+    from pint_tpu.interactive import InteractivePulsar
+
+    session = InteractivePulsar(args.parfile, args.timfile,
+                                fitter=args.fitter)
+    try:
+        app = PintkApp(session)
+    except Exception as e:
+        print(f"cannot open a Tk display ({e}); the matplotlib front end "
+              "works headless:\n"
+              "  from pint_tpu.interactive import InteractivePulsar\n"
+              "  from pint_tpu.plot_utils import InteractivePlot\n"
+              f"  s = InteractivePulsar({args.parfile!r}, {args.timfile!r})\n"
+              "  InteractivePlot(s).connect()", file=sys.stderr)
+        return 1
+    app.mainloop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -135,9 +135,24 @@ class InteractivePlot:
         if self.fig is None:
             self.fig = self.ax.figure
         self._selector = None
+        #: None, a flag key (e.g. "fe"), or "_obs" — color the unselected
+        #: points by group (reference plk color modes, plk.py jumped/
+        #: observatory coloring)
+        self.color_flag: str | None = None
         self.refresh()
 
     # --- drawing ---------------------------------------------------------------
+
+    def _color_groups(self, active):
+        """(label, mask-over-active) groups for the current color mode."""
+        s = self.session
+        if self.color_flag == "_obs":
+            vals = np.asarray(s.all_toas.obs)[active]
+        else:
+            vals = np.array(
+                [s.all_toas.flags[i].get(self.color_flag, "?") for i in active]
+            )
+        return [(v, vals == v) for v in sorted(set(vals.tolist()))]
 
     def refresh(self):
         s = self.session
@@ -148,8 +163,16 @@ class InteractivePlot:
         r_us = np.asarray(res.time_resids) * 1e6
         e_us = np.asarray(res.errors_s) * 1e6
         sel = s.selected[active]
-        self.ax.errorbar(mjd[~sel], r_us[~sel], yerr=e_us[~sel], fmt=".",
-                         color="tab:blue", alpha=0.7)
+        if self.color_flag is not None:
+            for label, gm in self._color_groups(active):
+                m = gm & ~sel
+                if m.any():
+                    self.ax.errorbar(mjd[m], r_us[m], yerr=e_us[m], fmt=".",
+                                     alpha=0.7, label=str(label))
+            self.ax.legend(loc="best", fontsize="small")
+        else:
+            self.ax.errorbar(mjd[~sel], r_us[~sel], yerr=e_us[~sel], fmt=".",
+                             color="tab:blue", alpha=0.7)
         if sel.any():
             self.ax.errorbar(mjd[sel], r_us[sel], yerr=e_us[sel], fmt="o",
                              color="tab:orange")

@@ -114,6 +114,87 @@ class TestInteractiveSession:
         assert np.isfinite(dphase).all()
 
 
+class TestEditorChannel:
+    """Par/tim editor Apply semantics (reference pintk/paredit.py,
+    timedit.py) on the headless session — what the pintk GUI's editor
+    windows route through."""
+
+    def test_par_edit_roundtrip_and_undo(self, session):
+        ip = session
+        f0_before = float(np.asarray(ip.model.params["F0"].hi))
+        # edit: freeze F1 by rewriting its fit flag via text
+        lines = []
+        for line in ip.as_parfile().splitlines():
+            if line.split() and line.split()[0] == "F1":
+                parts = line.split()
+                # par fit-flag column: value 1 -> 0
+                if "1" in parts[2:]:
+                    parts[parts.index("1", 2)] = "0"
+                line = "  ".join(parts)
+            lines.append(line)
+        ip.apply_par_text("\n".join(lines))
+        assert float(np.asarray(ip.model.params["F0"].hi)) == f0_before
+        assert ip.model.param_meta["F1"].frozen
+        ip.undo()
+        assert not ip.model.param_meta["F1"].frozen
+
+    def test_par_edit_bad_text_raises_and_preserves(self, session):
+        ip = session
+        before = ip.as_parfile()
+        with pytest.raises(Exception):
+            ip.apply_par_text("PSR nonsense\nF0 not_a_number\n")
+        assert ip.as_parfile() == before
+
+    def test_tim_edit_roundtrip_and_undo(self, session):
+        ip = session
+        n = len(ip.all_toas)
+        text = ip.tim_text()
+        assert text.startswith("FORMAT 1")
+        # drop the last TOA line
+        lines = text.strip().splitlines()
+        ip.apply_tim_text("\n".join(lines[:-1]) + "\n")
+        assert len(ip.all_toas) == n - 1
+        assert not ip.fitted
+        ip.undo()  # must restore the ORIGINAL TOA set object
+        assert len(ip.all_toas) == n
+        assert ip.selected.shape == (n,)
+
+    def test_tim_edit_clears_pulse_tracking(self, session):
+        """Regression: a tim edit after a phase wrap must drop
+        pulse-number tracking — the new lines may lack -pn flags and the
+        next resids() would raise (or go silently NaN)."""
+        ip = session
+        ip.selected[:10] = True
+        ip.add_phase_wrap(phase=1)
+        assert ip.track_pulse_numbers
+        ip.apply_tim_text(ip.tim_text())
+        assert not ip.track_pulse_numbers
+        assert np.isfinite(np.asarray(ip.resids().time_resids)).all()
+
+    def test_tim_text_includes_soft_deleted(self, session):
+        """Regression: the editor buffer must carry ALL loaded TOAs —
+        Apply after an unrelated edit must not discard recoverable
+        soft-deleted TOAs."""
+        ip = session
+        n = len(ip.all_toas)
+        ip.delete_toas(range(5))
+        assert ip.tim_text().count("\n") >= n  # FORMAT line + n TOA lines
+        ip.apply_tim_text(ip.tim_text())
+        assert len(ip.all_toas) == n
+
+    def test_reset_restores_loaded_toas(self, session):
+        """Regression: reset() must return to the LOADED tim even after a
+        tim edit replaced the TOA set."""
+        ip = session
+        n = len(ip.all_toas)
+        lines = ip.tim_text().strip().splitlines()
+        ip.apply_tim_text("\n".join(lines[:-1]) + "\n")
+        assert len(ip.all_toas) == n - 1
+        ip.reset()
+        assert ip.all_toas is ip._loaded_toas
+        assert len(ip.all_toas) == n
+
+
 class TestInteractivePlot:
     def test_plot_front_end(self, session, tmp_path):
         import matplotlib
@@ -138,3 +219,48 @@ class TestInteractivePlot:
         out = tmp_path / "plk.png"
         plot.fig.savefig(out)
         assert out.stat().st_size > 0
+
+    def test_color_modes(self, session, tmp_path):
+        import matplotlib
+
+        matplotlib.use("Agg")
+        from pint_tpu.plot_utils import InteractivePlot
+
+        plot = InteractivePlot(session)
+        for mode in ("_obs", "fe"):
+            plot.color_flag = mode
+            plot.refresh()
+        out = tmp_path / "colored.png"
+        plot.fig.savefig(out)
+        assert out.stat().st_size > 0
+
+
+class TestPintkShell:
+    def test_tk_shell_constructs(self, session):
+        """The full Tk GUI (pint_tpu/pintk.py) — needs a display; the
+        logic it wires is covered headless above."""
+        import os
+
+        import pytest
+
+        if not os.environ.get("DISPLAY"):
+            pytest.skip("no X display")
+        from pint_tpu.pintk import PintkApp
+
+        app = PintkApp(session)
+        app._build_param_panel()
+        app.do_clear()
+        app.root.destroy()
+
+    def test_cli_reports_headless(self, capsys):
+        """Without a display the pintk entry point must explain the
+        matplotlib fallback and exit 1, not traceback."""
+        import os
+
+        import pytest
+
+        if os.environ.get("DISPLAY"):
+            pytest.skip("display present")
+        from pint_tpu.pintk import main
+
+        assert main([PAR, TIM]) == 1
