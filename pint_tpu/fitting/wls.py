@@ -146,8 +146,12 @@ class FitResult:
 
 def get_step_fn(model: TimingModel, free: tuple[str, ...], subtract_mean: bool):
     """Jitted WLS step, cached on the model keyed by the free-param set."""
+    import os
+
     cache = model.__dict__.setdefault("_wls_step_cache", {})
-    key = (free, subtract_mean, model.xprec.name)
+    host_solve = (jax.default_backend() != "cpu"
+                  or os.environ.get("PINT_TPU_HOST_SOLVE", "0") == "1")
+    key = (free, subtract_mean, model.xprec.name, host_solve)
     if key in cache:
         return cache[key]
 
@@ -170,7 +174,7 @@ def get_step_fn(model: TimingModel, free: tuple[str, ...], subtract_mean: bool):
         )
         return r / f, f
 
-    def step(params, tensor, track_pn, delta_pn, weights, errors):
+    def design(params, tensor, track_pn, delta_pn, weights):
         # hybrid design matrix (fitting/design.py): autodiff tangents only
         # over the nonlinear params, closed forms for the linear families
         def rfun(delta):
@@ -193,6 +197,10 @@ def get_step_fn(model: TimingModel, free: tuple[str, ...], subtract_mean: bool):
             for i, n in enumerate(lin_names):
                 cols[n] = M_l[:, i]
         M = jnp.stack([cols[n] for n in free], axis=1)  # (N, p)
+        return r0, M
+
+    def step(params, tensor, track_pn, delta_pn, weights, errors):
+        r0, M = design(params, tensor, track_pn, delta_pn, weights)
         w = 1.0 / errors
         A = M * w[:, None]
         b = -r0 * w
@@ -214,7 +222,49 @@ def get_step_fn(model: TimingModel, free: tuple[str, ...], subtract_mean: bool):
 
     from pint_tpu.ops.compile import precision_jit
 
-    cache[key] = precision_jit(step)
+    # PINT_TPU_HOST_SOLVE=1 forces the host-solve path (tests exercise it
+    # on CPU; it is automatic on non-CPU backends). The flag is part of
+    # the cache key, so toggling it mid-process takes effect.
+    if not host_solve:
+        cache[key] = precision_jit(step)
+        return cache[key]
+
+    # Non-CPU backends: the TPU emulates f64 as f32-pairs whose RANGE is
+    # f32's — jnp.linalg.svd underflows to NaN singular values on
+    # ill-conditioned design matrices (measured: the 120-param B1855 DMX+
+    # jump matrix, cond ~1e6, NaNs on-device while the host SVD of the
+    # SAME device-computed M is clean and the fit lands at the CPU level).
+    # The physics (residuals + hybrid design matrix) stays on device; the
+    # small dense solve runs on the host in true f64.
+    device_fn = precision_jit(design)
+
+    def step_host_solve(params, tensor, track_pn, delta_pn, weights, errors):
+        r0_d, M_d = device_fn(params, tensor, track_pn, delta_pn, weights)
+        r0 = np.asarray(r0_d)
+        M = np.asarray(M_d)
+        p = M.shape[1]
+        if not (np.isfinite(r0).all() and np.isfinite(M).all()):
+            # mirror the device path's NaN propagation so run_lm's
+            # finite-chi2 backtracking handles a bad linearization point
+            # instead of np.linalg.svd raising out of the fit
+            nan_p = np.full(p, np.nan)
+            return (r0, M, nan_p, np.full((p, p), np.nan), nan_p,
+                    np.full((p, p), np.nan), np.nan, nan_p, np.ones(p))
+        w = 1.0 / np.asarray(errors)
+        A = M * w[:, None]
+        b = -r0 * w
+        norm = np.linalg.norm(A, axis=0)
+        norm[norm == 0] = 1.0
+        U, s, Vt = np.linalg.svd(A / norm, full_matrices=False)
+        good = s > SVD_THRESHOLD * s[0]
+        s_inv = np.where(good, 1.0 / np.where(good, s, 1.0), 0.0)
+        dx = (Vt.T * s_inv) @ (U.T @ b) / norm
+        cov = (Vt.T * s_inv**2) @ Vt / np.outer(norm, norm)
+        chi2_0 = float(b @ b)
+        utb = U.T @ b
+        return r0, M, dx, cov, s, Vt, chi2_0, utb, norm
+
+    cache[key] = step_host_solve
     return cache[key]
 
 

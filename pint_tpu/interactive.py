@@ -402,6 +402,19 @@ class InteractivePulsar:
         # lines may lack -pn flags entirely (resids would raise) or
         # partially (silent NaNs); the user re-wraps on the new set
         self.track_pulse_numbers = False
+        # prune gui-jump params whose flag values no longer appear in the
+        # new TOA set — a zero-TOA mask column is pure fit degeneracy
+        present = {f.get("gui_jump") for f in toas.flags} - {None}
+        stale = set()  # collect first: _remove_gui_jump_param mutates
+        for c in self.model.components:
+            if c.category == "phase_jump":
+                for mp in list(c.mask_params):
+                    if (mp.clause.kind == "flag"
+                            and mp.clause.key == "gui_jump"
+                            and mp.clause.args[0] not in present):
+                        stale.add(mp.clause.args[0])
+        for v in stale:
+            self._remove_gui_jump_param(v)
 
     def tim_text(self) -> str:
         """ALL loaded TOAs as Tempo2 tim text (the tim editor's buffer).

@@ -152,8 +152,11 @@ class PintkApp:
     def _update_status(self, msg: str | None = None):
         s = self.session
         state = "postfit" if s.fitted else "prefit"
-        base = (f"{len(s.all_toas) - len(s.deleted)} TOAs, "
-                f"{state} wrms {s.rms_us():.2f} us")
+        # reuse the wrms the last canvas refresh computed — a status
+        # update must not pay another full residual evaluation
+        wrms = getattr(self.plot, "last_wrms_us", None)
+        wtxt = "" if wrms is None else f", {state} wrms {wrms:.2f} us"
+        base = f"{len(s.all_toas) - len(s.deleted)} TOAs{wtxt}"
         self.status.set(f"{msg}\n{base}" if msg else base)
 
     def refresh(self):

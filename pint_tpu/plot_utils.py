@@ -179,8 +179,11 @@ class InteractivePlot:
         state = "postfit" if s.fitted else "prefit"
         self.ax.set_xlabel("MJD (TDB)")
         self.ax.set_ylabel(f"{state} residual (us)")
+        #: wrms of THIS refresh's residuals — status readouts reuse it
+        #: instead of rebuilding Residuals (pintk._update_status)
+        self.last_wrms_us = float(res.rms_weighted() * 1e6)
         self.ax.set_title(
-            f"{s.name}: {len(active)} TOAs, wrms {s.rms_us():.2f} us"
+            f"{s.name}: {len(active)} TOAs, wrms {self.last_wrms_us:.2f} us"
         )
         self._mjd_active = mjd
         self._active_idx = active

@@ -182,6 +182,23 @@ class TestEditorChannel:
         ip.apply_tim_text(ip.tim_text())
         assert len(ip.all_toas) == n
 
+    def test_tim_edit_prunes_stale_gui_jumps(self, session):
+        """Regression: a tim edit that drops the -gui_jump flagged TOAs
+        must also drop the matching JUMP parameter — a zero-TOA mask
+        column is pure fit degeneracy."""
+        ip = session
+        ip.selected[:20] = True
+        name = ip.add_jump()
+        assert name in ip.model.params
+        # re-apply tim text WITHOUT the gui_jump flags (write_tim writes
+        # flags, so strip them from the text)
+        text = "\n".join(
+            line for line in ip.tim_text().splitlines()
+        ).replace("-gui_jump 1", "")
+        ip.apply_tim_text(text)
+        assert name not in ip.model.params
+        assert all("gui_jump" not in f for f in ip.all_toas.flags)
+
     def test_reset_restores_loaded_toas(self, session):
         """Regression: reset() must return to the LOADED tim even after a
         tim edit replaced the TOA set."""
