@@ -223,10 +223,17 @@ def _grid_for(model, ftr):
     return _spin_grid(model, ftr)
 
 
+#: grid points evaluated concurrently per device program: 3 measured 1.45x
+#: the throughput of 1 at 100k TOAs (more parallelism for the same HBM
+#: traffic); 9 overflows the compile helper at this scale
+_GRID_BATCH = int(os.environ.get("PINT_TPU_BENCH_BATCH", "3"))
+
+
 def _time_grid(ftr, parnames, grids, maxiter, repeats):
     from pint_tpu.gridutils import grid_chisq
 
-    run = lambda: grid_chisq(ftr, parnames, grids, maxiter=maxiter, batch=1)
+    run = lambda: grid_chisq(ftr, parnames, grids, maxiter=maxiter,
+                             batch=_GRID_BATCH)
     t0 = time.time()
     chi2 = run()  # compile + first run
     compile_s = time.time() - t0
@@ -413,7 +420,8 @@ def main() -> None:
         try:
             from pint_tpu.gridutils import precompile_grid
 
-            precompile_grid(ftr, parnames, grids, maxiter=maxiter, batch=1)
+            precompile_grid(ftr, parnames, grids, maxiter=maxiter,
+                            batch=_GRID_BATCH)
         except Exception as e:  # noqa: BLE001 — overlap is best-effort
             precompile_err.append(e)
 

@@ -70,6 +70,20 @@ class SPKSegment:
     def posvel(self, et: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
         """(pos[m], vel[m/s]) of target wrt center at TDB sec past J2000."""
         et = np.atleast_1d(np.asarray(et, np.float64))
+        if et.size == 0:
+            return np.empty((0, 3)), np.empty((0, 3))
+        # outside-coverage epochs would silently evaluate the EDGE record's
+        # Chebyshev polynomial outside [-1, 1], which diverges fast; raise
+        # like the reference's jplephem does (1 s slack for row rounding)
+        lo, hi = float(np.min(et)), float(np.max(et))
+        if lo < self.start_et - 1.0 or hi > self.stop_et + 1.0:
+            day = 86400.0
+            raise ValueError(
+                f"epoch range [{lo / day + 51544.5:.1f}, {hi / day + 51544.5:.1f}] MJD "
+                f"outside SPK segment coverage "
+                f"[{self.start_et / day + 51544.5:.1f}, "
+                f"{self.stop_et / day + 51544.5:.1f}] for target {self.target}"
+            )
         idx = np.clip(((et - self.init) / self.intlen).astype(np.int64), 0, self.n - 1)
         pos = np.empty(et.shape + (3,))
         vel = np.empty(et.shape + (3,))

