@@ -159,22 +159,28 @@ class SPKEphemeris:
 
     def __init__(self, path: str):
         self.daf = DAF(path)
-        self.segments: dict[tuple[int, int], SPKSegment] = {}
+        # long-span/spkmerge kernels split one (target, center) arc across
+        # several time-consecutive segments: keep them ALL, time-ordered,
+        # and select per epoch (a single-slot dict silently dropped every
+        # segment but the last)
+        self.segments: dict[tuple[int, int], list[SPKSegment]] = {}
         for (start, stop), (t, c, frame, dtype, ia, fa) in self.daf.summaries():
             seg = SPKSegment(self.daf, t, c, frame, dtype, start, stop, ia, fa)
-            self.segments[(t, c)] = seg
+            self.segments.setdefault((t, c), []).append(seg)
+        for segs in self.segments.values():
+            segs.sort(key=lambda s: s.start_et)
         self.name = f"spk:{path}"
 
-    def _chain(self, body_id: int) -> list[tuple[SPKSegment, float]]:
-        """Segments composing body -> SSB with signs."""
+    def _chain(self, body_id: int) -> list[tuple[list[SPKSegment], float]]:
+        """Segment groups composing body -> SSB with signs."""
         chain = []
         cur = body_id
         guard = 0
         while cur != 0 and guard < 5:
             nxt = None
-            for (t, c), seg in self.segments.items():
+            for (t, c), segs in self.segments.items():
                 if t == cur:
-                    chain.append((seg, +1.0))
+                    chain.append((segs, +1.0))
                     nxt = c
                     break
             if nxt is None:
@@ -183,13 +189,39 @@ class SPKEphemeris:
             guard += 1
         return chain
 
+    @staticmethod
+    def _group_posvel(segs: list[SPKSegment], et: np.ndarray):
+        """Evaluate a time-ordered (target, center) segment group: each
+        epoch routes to the segment covering it (1 s slack at joins);
+        epochs outside the union coverage raise."""
+        if len(segs) == 1:
+            return segs[0].posvel(et)
+        pos = np.empty(et.shape + (3,))
+        vel = np.empty(et.shape + (3,))
+        done = np.zeros(et.shape, bool)
+        for seg in segs:
+            m = (~done & (et >= seg.start_et - 1.0) & (et <= seg.stop_et + 1.0))
+            if m.any():
+                pos[m], vel[m] = seg.posvel(et[m])
+                done |= m
+        if not done.all():
+            day = 86400.0
+            bad = et[~done]
+            raise ValueError(
+                f"epochs around MJD {bad[0] / day + 51544.5:.1f} outside the "
+                f"SPK coverage of target {segs[0].target} "
+                f"([{segs[0].start_et / day + 51544.5:.1f}, "
+                f"{segs[-1].stop_et / day + 51544.5:.1f}] with possible gaps)"
+            )
+        return pos, vel
+
     def posvel_ssb(self, body: str, tdb_jcent: np.ndarray, dt_s: float = 0.0):
-        et = np.asarray(tdb_jcent, np.float64) * 36525.0 * 86400.0
+        et = np.atleast_1d(np.asarray(tdb_jcent, np.float64)) * 36525.0 * 86400.0
         bid = NAIF_IDS[body]
         pos = 0.0
         vel = 0.0
-        for seg, sign in self._chain(bid):
-            p, v = seg.posvel(et)
+        for segs, sign in self._chain(bid):
+            p, v = self._group_posvel(segs, et)
             pos = pos + sign * p
             vel = vel + sign * v
         return pos, vel

@@ -64,19 +64,31 @@ def _gls_pieces(model: TimingModel, free, subtract_mean):
     return time_resids
 
 
+# the Woodbury/normal-equation algebra runs on the in-process CPU backend
+# on non-CPU devices: the TPU's emulated f64 has f32 RANGE, and the basis
+# weights / Schur Cholesky underflow to NaN on real red-noise models
+# (measured: the B1855 9yv1 GLS step produced a NaN normal matrix on the
+# TPU backend while the same algebra on CPU is clean) — the same
+# pathology as the WLS on-device SVD. Shared predicate:
+# ops.compile.use_host_solve.
+
+
 def get_gls_step_fn(model: TimingModel, free, subtract_mean: bool):
     """Jitted GLS step: (params, tensor, track_pn, delta_pn, weights, sigma)
     -> (r0, M, mtcm, mtcy, norm, chi2_0, ahat); solve with gls_solve().
     Cached per model/free-set."""
+    from pint_tpu.ops.compile import use_host_solve
+
     cache = model.__dict__.setdefault("_gls_step_cache", {})
-    key = (free, subtract_mean, model.xprec.name)
+    host = use_host_solve()
+    key = (free, subtract_mean, model.xprec.name, host)
     if key in cache:
         return cache[key]
 
     time_resids = _gls_pieces(model, free, subtract_mean)
     p = len(free)
 
-    def step(params, tensor, track_pn, delta_pn, weights, sigma):
+    def design(params, tensor, track_pn, delta_pn, weights):
         def rfun(delta):
             return time_resids(
                 apply_delta(params, free, delta), tensor, track_pn, delta_pn, weights
@@ -85,18 +97,20 @@ def get_gls_step_fn(model: TimingModel, free, subtract_mean: bool):
         z = jnp.zeros(p)
         r0, lin = jax.linearize(rfun, z)
         M = jax.vmap(lin)(jnp.eye(p)).T  # (N, p), one primal evaluation
-        cinv = 1.0 / sigma**2
+        return r0, M
 
+    def woodbury_pieces(params, tensor, r0, M, sigma):
+        """Marginalized normal equations: mtcm = Mn^T C^-1 Mn with C^-1
+        applied via structured Woodbury (block-Schur over the diagonal
+        ECORR block — woodbury.py). Identical to the timing block of the
+        reference's noise-augmented solve (fitter.py:2177-2254) by the
+        Schur complement identity, but the ECORR membership matrix never
+        materializes."""
+        cinv = 1.0 / sigma**2
         basis = model.noise_basis_and_weights(params, tensor)
         norm = jnp.sqrt(jnp.sum(M**2, axis=0))
         norm = jnp.where(norm == 0, 1.0, norm)
         Mn = M / norm
-        # Marginalized normal equations: mtcm = Mn^T C^-1 Mn with C^-1
-        # applied via structured Woodbury (block-Schur over the diagonal
-        # ECORR block — woodbury.py). Identical to the timing block of the
-        # reference's noise-augmented solve (fitter.py:2177-2254) by the
-        # Schur complement identity, but the ECORR membership matrix never
-        # materializes.
         sf = s_factor(basis, cinv) if basis is not None else None
         CinvM = cinv_apply(basis, cinv, Mn, sf)
         mtcm = Mn.T @ CinvM + _RIDGE * jnp.eye(p)
@@ -105,21 +119,64 @@ def get_gls_step_fn(model: TimingModel, free, subtract_mean: bool):
         # decision and reporting) + ML noise-coefficient realization
         chi2_0, (ze, zd) = woodbury_chi2(basis, cinv, r0, sf=sf)
         ahat = cat_ahat(ze, zd)
-        # the p x p solve itself happens host-side (scipy Cholesky on a
-        # small matrix), so Levenberg-Marquardt re-solves at any damping
-        # need no recompute of the design matrix
-        return r0, M, mtcm, mtcy, norm, chi2_0, ahat
+        return mtcm, mtcy, norm, chi2_0, ahat
+
+    def step(params, tensor, track_pn, delta_pn, weights, sigma):
+        r0, M = design(params, tensor, track_pn, delta_pn, weights)
+        # the p x p solve itself happens host-side (scipy on a small
+        # matrix), so Levenberg-Marquardt re-solves at any damping need
+        # no recompute of the design matrix
+        return (r0, M) + woodbury_pieces(params, tensor, r0, M, sigma)
 
     from pint_tpu.ops.compile import precision_jit
 
-    cache[key] = precision_jit(step)
+    if not host:
+        cache[key] = precision_jit(step)
+        return cache[key]
+
+    from pint_tpu.ops.compile import model_cpu_memo
+
+    device_fn = precision_jit(design)
+    # the host tail is jitted too (for the CPU target — its inputs live
+    # on the CPU device): the Woodbury assembly with its ECORR segment
+    # reductions would otherwise run eagerly per LM trial
+    pieces_fn = jax.jit(woodbury_pieces)
+    cpu = jax.devices("cpu")[0]
+    memo = model_cpu_memo(model)
+
+    def step_host(params, tensor, track_pn, delta_pn, weights, sigma):
+        r0_d, M_d = device_fn(params, tensor, track_pn, delta_pn, weights)
+        r0_np = np.asarray(r0_d)
+        if not np.isfinite(r0_np).all():
+            # mirror the WLS host path: NaN pieces let run_lm backtrack
+            # instead of scipy raising out of the fit
+            nan_p = np.full(p, np.nan)
+            return (r0_np, np.asarray(M_d), np.full((p, p), np.nan), nan_p,
+                    np.ones(p), np.nan, nan_p)
+        with jax.default_device(cpu):
+            # params change per LM iteration (small); the tensor is
+            # constant per fit and transfers once via the memo
+            params_c = jax.device_put(params, cpu)
+            tensor_c = memo("tensor", tensor)
+            r0 = jax.device_put(r0_d, cpu)
+            M = jax.device_put(M_d, cpu)
+            sig = jax.device_put(jnp.asarray(sigma), cpu)
+            pieces = pieces_fn(params_c, tensor_c, r0, M, sig)
+            return (r0, M) + tuple(pieces)
+
+    cache[key] = step_host
     return cache[key]
 
 
 def get_gls_chi2_fn(model: TimingModel, subtract_mean: bool):
-    """Jitted Woodbury chi^2 at fixed params (no design matrix)."""
+    """Jitted Woodbury chi^2 at fixed params (no design matrix). On
+    non-CPU backends the residual evaluates on the device and the
+    Woodbury reduction on the in-process CPU (ops.compile.use_host_solve)."""
+    from pint_tpu.ops.compile import use_host_solve
+
     cache = model.__dict__.setdefault("_gls_chi2_cache", {})
-    key = (subtract_mean, model.xprec.name)
+    host = use_host_solve()
+    key = (subtract_mean, model.xprec.name, host)
     if key in cache:
         return cache[key]
 
@@ -134,7 +191,36 @@ def get_gls_chi2_fn(model: TimingModel, subtract_mean: bool):
 
     from pint_tpu.ops.compile import precision_jit
 
-    cache[key] = precision_jit(chi2fn)
+    if not host:
+        cache[key] = precision_jit(chi2fn)
+        return cache[key]
+
+    from pint_tpu.ops.compile import model_cpu_memo
+
+    resid_fn = precision_jit(time_resids)
+
+    def chi2_tail(params, tensor, r, sigma):
+        basis = model.noise_basis_and_weights(params, tensor)
+        chi2, _ = woodbury_chi2(basis, 1.0 / sigma**2, r)
+        return chi2
+
+    tail_fn = jax.jit(chi2_tail)
+    cpu = jax.devices("cpu")[0]
+    memo = model_cpu_memo(model)
+
+    def chi2_host(params, tensor, track_pn, delta_pn, weights, sigma):
+        r_d = resid_fn(params, tensor, track_pn, delta_pn, weights)
+        r_np = np.asarray(r_d)
+        if not np.isfinite(r_np).all():
+            return np.nan  # bad trial point: run_lm rejects on non-finite
+        with jax.default_device(cpu):
+            params_c = jax.device_put(params, cpu)
+            tensor_c = memo("tensor", tensor)
+            r = jax.device_put(r_d, cpu)
+            sig = jax.device_put(jnp.asarray(sigma), cpu)
+            return tail_fn(params_c, tensor_c, r, sig)
+
+    cache[key] = chi2_host
     return cache[key]
 
 
@@ -174,6 +260,16 @@ def gls_solve(mtcm, mtcy, norm, p: int, lam: float = 0.0, return_eig: bool = Fal
     mtcm = np.asarray(mtcm)
     mtcy = np.asarray(mtcy)
     norm = np.asarray(norm)
+    if mtcm.size and not np.isfinite(mtcm).all():
+        # NaN normal matrix from a bad linearization point: hand NaN back
+        # so run_lm's finite-chi2 backtracking rejects the trial instead
+        # of scipy raising out of the fit
+        q = mtcm.shape[0]
+        nan_dx = np.full(p, np.nan)
+        nan_cov = np.full((p, p), np.nan)
+        if return_eig:
+            return nan_dx, nan_cov, np.full(q, np.nan), np.full((q, q), np.nan)
+        return nan_dx, nan_cov
     G = mtcm + lam * np.diag(np.diag(mtcm)) if lam else mtcm
     s, V = sl.eigh((G + G.T) / 2.0)
     smax = s[-1] if s.size else 1.0

@@ -79,18 +79,20 @@ def _noise_basis_aug(model, params, tensor, sw_t, n_dm):
 
 def get_wb_step_fn(model, free, subtract_mean: bool):
     """Jitted wideband step -> (r_aug, mtcm, mtcy, norm, chi2_0, ahat);
-    solve with fitting.gls.gls_solve."""
+    solve with fitting.gls.gls_solve. On non-CPU backends the combined
+    design matrix evaluates on the device and the Woodbury algebra on the
+    in-process CPU (same f32-range-underflow pathology as fitting/gls.py)."""
+    from pint_tpu.ops.compile import model_cpu_memo, precision_jit, use_host_solve
+
     cache = model.__dict__.setdefault("_wb_step_cache", {})
-    key = (free, subtract_mean, model.xprec.name)
+    host = use_host_solve()
+    key = (free, subtract_mean, model.xprec.name, host)
     if key in cache:
         return cache[key]
 
     p = len(free)
 
-    def step(params, tensor, track_pn, delta_pn, weights, sigma_t, sigma_dm, dm_data):
-        sw_t = 1.0 / sigma_t
-        sw_dm = jnp.where(jnp.isfinite(sigma_dm), 1.0 / sigma_dm, 0.0)
-
+    def design(params, tensor, track_pn, delta_pn, weights, sw_t, sw_dm, dm_data):
         def wres(delta):
             return _weighted_resids(
                 model, free, subtract_mean, params, tensor, track_pn,
@@ -100,9 +102,10 @@ def get_wb_step_fn(model, free, subtract_mean: bool):
         z = jnp.zeros(p)
         r0, lin = jax.linearize(wres, z)
         A = jax.vmap(lin)(jnp.eye(p)).T  # (N_t + N_dm, p), already weighted
-        b = -r0
+        return r0, A
 
-        basis = _noise_basis_aug(model, params, tensor, sw_t, sw_dm.shape[0])
+    def woodbury_pieces(params, tensor, r0, A, sw_t, n_dm):
+        basis = _noise_basis_aug(model, params, tensor, sw_t, n_dm)
         norm = jnp.sqrt(jnp.sum(A**2, axis=0))
         norm = jnp.where(norm == 0, 1.0, norm)
         An = A / norm
@@ -112,36 +115,111 @@ def get_wb_step_fn(model, free, subtract_mean: bool):
         sf = s_factor(basis, ones) if basis is not None else None
         CinvA = cinv_apply(basis, ones, An, sf)
         mtcm = An.T @ CinvA + _RIDGE * jnp.eye(p)
-        mtcy = CinvA.T @ b
+        mtcy = CinvA.T @ (-r0)
         chi2_0, (ze, zd) = woodbury_chi2(basis, ones, r0, sf=sf)
-        return r0, mtcm, mtcy, norm, chi2_0, cat_ahat(ze, zd)
+        return mtcm, mtcy, norm, chi2_0, cat_ahat(ze, zd)
 
-    from pint_tpu.ops.compile import precision_jit
+    def step(params, tensor, track_pn, delta_pn, weights, sigma_t, sigma_dm, dm_data):
+        sw_t = 1.0 / sigma_t
+        sw_dm = jnp.where(jnp.isfinite(sigma_dm), 1.0 / sigma_dm, 0.0)
+        r0, A = design(params, tensor, track_pn, delta_pn, weights, sw_t,
+                       sw_dm, dm_data)
+        return (r0,) + woodbury_pieces(params, tensor, r0, A, sw_t,
+                                       sw_dm.shape[0])
 
-    cache[key] = precision_jit(step)
+    if not host:
+        cache[key] = precision_jit(step)
+        return cache[key]
+
+    device_fn = precision_jit(design)
+    pieces_fn = jax.jit(woodbury_pieces, static_argnums=(5,))
+    cpu = jax.devices("cpu")[0]
+    memo = model_cpu_memo(model)
+
+    def step_host(params, tensor, track_pn, delta_pn, weights, sigma_t,
+                  sigma_dm, dm_data):
+        sw_t = 1.0 / jnp.asarray(sigma_t)
+        sw_dm = jnp.where(jnp.isfinite(jnp.asarray(sigma_dm)),
+                          1.0 / jnp.asarray(sigma_dm), 0.0)
+        r0_d, A_d = device_fn(params, tensor, track_pn, delta_pn, weights,
+                              sw_t, sw_dm, dm_data)
+        r0_np = np.asarray(r0_d)
+        if not np.isfinite(r0_np).all():
+            nan_p = np.full(p, np.nan)
+            return (r0_np, np.full((p, p), np.nan), nan_p, np.ones(p),
+                    np.nan, nan_p)
+        with jax.default_device(cpu):
+            params_c = jax.device_put(params, cpu)
+            tensor_c = memo("tensor", tensor)
+            r0 = jax.device_put(r0_d, cpu)
+            A = jax.device_put(A_d, cpu)
+            sw_t_c = jax.device_put(sw_t, cpu)
+            pieces = pieces_fn(params_c, tensor_c, r0, A, sw_t_c,
+                               int(sw_dm.shape[0]))
+            return (r0,) + tuple(pieces)
+
+    cache[key] = step_host
     return cache[key]
 
 
 def get_wb_chi2_fn(model, subtract_mean: bool):
+    from pint_tpu.ops.compile import model_cpu_memo, precision_jit, use_host_solve
+
     cache = model.__dict__.setdefault("_wb_chi2_cache", {})
-    key = (subtract_mean, model.xprec.name)
+    host = use_host_solve()
+    key = (subtract_mean, model.xprec.name, host)
     if key in cache:
         return cache[key]
+
+    def resids(params, tensor, track_pn, delta_pn, weights, sw_t, sw_dm, dm_data):
+        return _weighted_resids(
+            model, (), subtract_mean, params, tensor, track_pn,
+            delta_pn, weights, sw_t, sw_dm, dm_data, jnp.zeros(0),
+        )
 
     def chi2fn(params, tensor, track_pn, delta_pn, weights, sigma_t, sigma_dm, dm_data):
         sw_t = 1.0 / sigma_t
         sw_dm = jnp.where(jnp.isfinite(sigma_dm), 1.0 / sigma_dm, 0.0)
-        r0 = _weighted_resids(
-            model, (), subtract_mean, params, tensor, track_pn,
-            delta_pn, weights, sw_t, sw_dm, dm_data, jnp.zeros(0),
-        )
+        r0 = resids(params, tensor, track_pn, delta_pn, weights, sw_t,
+                    sw_dm, dm_data)
         basis = _noise_basis_aug(model, params, tensor, sw_t, sw_dm.shape[0])
         chi2, _ = woodbury_chi2(basis, jnp.ones_like(r0), r0)
         return chi2
 
-    from pint_tpu.ops.compile import precision_jit
+    if not host:
+        cache[key] = precision_jit(chi2fn)
+        return cache[key]
 
-    cache[key] = precision_jit(chi2fn)
+    resid_fn = precision_jit(resids)
+
+    def chi2_tail(params, tensor, r0, sw_t, n_dm):
+        basis = _noise_basis_aug(model, params, tensor, sw_t, n_dm)
+        chi2, _ = woodbury_chi2(basis, jnp.ones_like(r0), r0)
+        return chi2
+
+    tail_fn = jax.jit(chi2_tail, static_argnums=(4,))
+    cpu = jax.devices("cpu")[0]
+    memo = model_cpu_memo(model)
+
+    def chi2_host(params, tensor, track_pn, delta_pn, weights, sigma_t,
+                  sigma_dm, dm_data):
+        sw_t = 1.0 / jnp.asarray(sigma_t)
+        sw_dm = jnp.where(jnp.isfinite(jnp.asarray(sigma_dm)),
+                          1.0 / jnp.asarray(sigma_dm), 0.0)
+        r0_d = resid_fn(params, tensor, track_pn, delta_pn, weights, sw_t,
+                        sw_dm, dm_data)
+        r0_np = np.asarray(r0_d)
+        if not np.isfinite(r0_np).all():
+            return np.nan
+        with jax.default_device(cpu):
+            params_c = jax.device_put(params, cpu)
+            tensor_c = memo("tensor", tensor)
+            r0 = jax.device_put(r0_d, cpu)
+            sw_t_c = jax.device_put(sw_t, cpu)
+            return tail_fn(params_c, tensor_c, r0, sw_t_c,
+                           int(sw_dm.shape[0]))
+
+    cache[key] = chi2_host
     return cache[key]
 
 

@@ -146,11 +146,10 @@ class FitResult:
 
 def get_step_fn(model: TimingModel, free: tuple[str, ...], subtract_mean: bool):
     """Jitted WLS step, cached on the model keyed by the free-param set."""
-    import os
+    from pint_tpu.ops.compile import use_host_solve
 
     cache = model.__dict__.setdefault("_wls_step_cache", {})
-    host_solve = (jax.default_backend() != "cpu"
-                  or os.environ.get("PINT_TPU_HOST_SOLVE", "0") == "1")
+    host_solve = use_host_solve()
     key = (free, subtract_mean, model.xprec.name, host_solve)
     if key in cache:
         return cache[key]

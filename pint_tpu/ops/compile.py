@@ -36,3 +36,46 @@ def precision_jit(fn=None, **jit_kwargs):
     if jax.default_backend() == "cpu":
         jit_kwargs.setdefault("compiler_options", _CPU_WORKAROUND)
     return jax.jit(fn, **jit_kwargs)
+
+
+def use_host_solve() -> bool:
+    """True when the fitters' small dense linear algebra (SVD/eigh/
+    Cholesky, Woodbury assembly) must run on the host / in-process CPU
+    backend: non-CPU backends emulate f64 with f32 exponent RANGE, and
+    factorizations of ill-conditioned matrices underflow to NaN on device
+    (measured for both the WLS design-matrix SVD and the GLS red-noise
+    Woodbury pieces). ``PINT_TPU_HOST_SOLVE=1`` forces it on CPU so tests
+    exercise the host path."""
+    import os
+
+    return (jax.default_backend() != "cpu"
+            or os.environ.get("PINT_TPU_HOST_SOLVE", "0") == "1")
+
+
+def cpu_transfer_memo():
+    """Single-slot per-tag device->CPU transfer memo.
+
+    The fitters' host-solve paths move the (large, constant-per-fit) TOA
+    tensor to the CPU backend once per object rather than on every LM
+    trial. The slot holds a STRONG reference to the keyed object, so
+    ``is``-identity can never alias a recycled id() of a garbage-collected
+    tensor (the memo outlives any one fitter — it hangs off the model's
+    step-fn cache)."""
+    cpu = jax.devices("cpu")[0]
+    slots: dict = {}
+
+    def put(tag, obj):
+        keyed, cached = slots.get(tag, (None, None))
+        if keyed is not obj:
+            cached = jax.device_put(obj, cpu)
+            slots[tag] = (obj, cached)
+        return cached
+
+    return put
+
+
+def model_cpu_memo(model):
+    """One shared CPU-transfer memo per model: the GLS/wideband step and
+    chi^2 closures all move the same TOA tensor, so sharing the memo
+    halves the transfers."""
+    return model.__dict__.setdefault("_cpu_transfer_memo", cpu_transfer_memo())

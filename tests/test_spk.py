@@ -199,3 +199,26 @@ class TestSPKExport:
         f2 = DownhillWLSFitter(t2, m2)
         f2.fit_toas(maxiter=10)
         assert f2.resids.rms_weighted() == pytest.approx(rms_direct, rel=1e-3)
+
+    def test_time_split_segments_one_body(self, tmp_path):
+        """spkmerge-style kernels split one (target, center) arc across
+        consecutive segments; epochs in EVERY piece must evaluate (a
+        single-slot index used to silently drop all but the last)."""
+        from pint_tpu.astro.spk import SPKEphemeris
+        from pint_tpu.astro.spk_write import write_spk_type2
+
+        rng = np.random.default_rng(6)
+        emb = rng.standard_normal((3, 3)) * np.array([[1.5e8, 1e-3, 1e-11]])
+        pos_fn, _ = _poly_traj(emb)
+        day = 86400.0
+        path = str(tmp_path / "split.bsp")
+        write_spk_type2(path, [
+            (3, 0, -40 * day, 0.0, 8 * day, 12, pos_fn),
+            (3, 0, 0.0, 40 * day, 8 * day, 12, pos_fn),
+        ])
+        eph = SPKEphemeris(path)
+        t_s = np.array([-35 * day, -1.0, 1.0, 35 * day])
+        p, _ = eph.posvel_ssb("emb", t_s / J2000_JCENT_S)
+        np.testing.assert_allclose(p, pos_fn(t_s) * 1e3, rtol=1e-10, atol=1e-2)
+        with pytest.raises(ValueError, match="coverage"):
+            eph.posvel_ssb("emb", np.array([50 * day / J2000_JCENT_S]))
