@@ -136,6 +136,10 @@ def get_gls_step_fn(model: TimingModel, free, subtract_mean: bool):
 
     from pint_tpu.ops.compile import model_cpu_memo
 
+    # ADAPTIVE: try the fused on-device step first (no large transfers);
+    # fall back to the CPU-split Woodbury only when the device normal
+    # matrix comes back non-finite (see module note above)
+    fused_fn = precision_jit(step)
     device_fn = precision_jit(design)
     # the host tail is jitted too (for the CPU target — its inputs live
     # on the CPU device): the Woodbury assembly with its ECORR segment
@@ -143,7 +147,6 @@ def get_gls_step_fn(model: TimingModel, free, subtract_mean: bool):
     pieces_fn = jax.jit(woodbury_pieces)
     cpu = jax.devices("cpu")[0]
     memo = model_cpu_memo(model)
-
     def step_host(params, tensor, track_pn, delta_pn, weights, sigma):
         r0_d, M_d = device_fn(params, tensor, track_pn, delta_pn, weights)
         r0_np = np.asarray(r0_d)
@@ -164,7 +167,13 @@ def get_gls_step_fn(model: TimingModel, free, subtract_mean: bool):
             pieces = pieces_fn(params_c, tensor_c, r0, M, sig)
             return (r0, M) + tuple(pieces)
 
-    cache[key] = step_host
+    from pint_tpu.ops.compile import adaptive_fused
+
+    def _good(out):
+        return (np.isfinite(np.asarray(out[2])).all()
+                and np.isfinite(float(out[5])))
+
+    cache[key] = adaptive_fused(fused_fn, step_host, _good, "GLS step")
     return cache[key]
 
 
@@ -197,6 +206,7 @@ def get_gls_chi2_fn(model: TimingModel, subtract_mean: bool):
 
     from pint_tpu.ops.compile import model_cpu_memo
 
+    fused_fn = precision_jit(chi2fn)
     resid_fn = precision_jit(time_resids)
 
     def chi2_tail(params, tensor, r, sigma):
@@ -207,7 +217,6 @@ def get_gls_chi2_fn(model: TimingModel, subtract_mean: bool):
     tail_fn = jax.jit(chi2_tail)
     cpu = jax.devices("cpu")[0]
     memo = model_cpu_memo(model)
-
     def chi2_host(params, tensor, track_pn, delta_pn, weights, sigma):
         r_d = resid_fn(params, tensor, track_pn, delta_pn, weights)
         r_np = np.asarray(r_d)
@@ -220,7 +229,14 @@ def get_gls_chi2_fn(model: TimingModel, subtract_mean: bool):
             sig = jax.device_put(jnp.asarray(sigma), cpu)
             return tail_fn(params_c, tensor_c, r, sig)
 
-    cache[key] = chi2_host
+    from pint_tpu.ops.compile import adaptive_fused
+
+    # a finite device chi2 is trustworthy; NaN is ambiguous (device
+    # underflow OR a genuinely bad trial point) — the host recompute
+    # disambiguates, and the sticky flag only latches when the host
+    # answer is finite
+    cache[key] = adaptive_fused(
+        fused_fn, chi2_host, lambda c: np.isfinite(float(c)), "GLS chi2")
     return cache[key]
 
 
@@ -355,6 +371,15 @@ class GLSFitter(WLSFitter):
                 mtcy = mtcy_d / norm_d
                 norm = norm_d
             dx, cov, es, evt = gls_solve(mtcm, mtcy, norm, p, return_eig=True)
+            if not np.isfinite(np.asarray(dx)).all():
+                # this plain iterated loop has no LM backtracking: a NaN
+                # step must fail LOUDLY, never be applied to the model
+                raise RuntimeError(
+                    "GLS normal equations produced a non-finite step "
+                    f"(iteration {it}); the linearization point is invalid "
+                    "— check the starting parameters or use "
+                    "DownhillGLSFitter, whose damped loop backtracks"
+                )
             params = apply_delta(params, self._free, dx, project_domain=True)
             sigma = np.sqrt(np.maximum(np.diag(cov), 0.0))
             rel = np.abs(dx) / np.where(sigma == 0, 1.0, sigma)

@@ -131,11 +131,13 @@ def get_wb_step_fn(model, free, subtract_mean: bool):
         cache[key] = precision_jit(step)
         return cache[key]
 
+    # ADAPTIVE: fused on-device first, CPU-split Woodbury only on
+    # non-finite results (same strategy as fitting/gls.py)
+    fused_fn = precision_jit(step)
     device_fn = precision_jit(design)
     pieces_fn = jax.jit(woodbury_pieces, static_argnums=(5,))
     cpu = jax.devices("cpu")[0]
     memo = model_cpu_memo(model)
-
     def step_host(params, tensor, track_pn, delta_pn, weights, sigma_t,
                   sigma_dm, dm_data):
         sw_t = 1.0 / jnp.asarray(sigma_t)
@@ -158,7 +160,13 @@ def get_wb_step_fn(model, free, subtract_mean: bool):
                                int(sw_dm.shape[0]))
             return (r0,) + tuple(pieces)
 
-    cache[key] = step_host
+    from pint_tpu.ops.compile import adaptive_fused
+
+    def _good(out):
+        return (np.isfinite(np.asarray(out[1])).all()
+                and np.isfinite(float(out[4])))
+
+    cache[key] = adaptive_fused(fused_fn, step_host, _good, "wideband step")
     return cache[key]
 
 
@@ -190,6 +198,7 @@ def get_wb_chi2_fn(model, subtract_mean: bool):
         cache[key] = precision_jit(chi2fn)
         return cache[key]
 
+    fused_fn = precision_jit(chi2fn)
     resid_fn = precision_jit(resids)
 
     def chi2_tail(params, tensor, r0, sw_t, n_dm):
@@ -200,7 +209,6 @@ def get_wb_chi2_fn(model, subtract_mean: bool):
     tail_fn = jax.jit(chi2_tail, static_argnums=(4,))
     cpu = jax.devices("cpu")[0]
     memo = model_cpu_memo(model)
-
     def chi2_host(params, tensor, track_pn, delta_pn, weights, sigma_t,
                   sigma_dm, dm_data):
         sw_t = 1.0 / jnp.asarray(sigma_t)
@@ -219,7 +227,10 @@ def get_wb_chi2_fn(model, subtract_mean: bool):
             return tail_fn(params_c, tensor_c, r0, sw_t_c,
                            int(sw_dm.shape[0]))
 
-    cache[key] = chi2_host
+    from pint_tpu.ops.compile import adaptive_fused
+
+    cache[key] = adaptive_fused(
+        fused_fn, chi2_host, lambda c: np.isfinite(float(c)), "wideband chi2")
     return cache[key]
 
 

@@ -233,8 +233,12 @@ def get_step_fn(model: TimingModel, free: tuple[str, ...], subtract_mean: bool):
     # ill-conditioned design matrices (measured: the 120-param B1855 DMX+
     # jump matrix, cond ~1e6, NaNs on-device while the host SVD of the
     # SAME device-computed M is clean and the fit lands at the CPU level).
-    # The physics (residuals + hybrid design matrix) stays on device; the
-    # small dense solve runs on the host in true f64.
+    # ADAPTIVE strategy: try the fully-fused on-device step first (no
+    # large transfers — benign problems like the 100k-TOA bench fit keep
+    # device speed); only when its singular values come back non-finite
+    # recompute with the physics on device and the dense solve on the
+    # host in true f64.
+    fused_fn = precision_jit(step)
     device_fn = precision_jit(design)
 
     def step_host_solve(params, tensor, track_pn, delta_pn, weights, errors):
@@ -263,7 +267,14 @@ def get_step_fn(model: TimingModel, free: tuple[str, ...], subtract_mean: bool):
         utb = U.T @ b
         return r0, M, dx, cov, s, Vt, chi2_0, utb, norm
 
-    cache[key] = step_host_solve
+    from pint_tpu.ops.compile import adaptive_fused
+
+    def _good(out):
+        s = np.asarray(out[4])
+        return s.size == 0 or (np.isfinite(s).all()
+                               and np.isfinite(np.asarray(out[2])).all())
+
+    cache[key] = adaptive_fused(fused_fn, step_host_solve, _good, "WLS step")
     return cache[key]
 
 

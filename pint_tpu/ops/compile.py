@@ -77,5 +77,41 @@ def cpu_transfer_memo():
 def model_cpu_memo(model):
     """One shared CPU-transfer memo per model: the GLS/wideband step and
     chi^2 closures all move the same TOA tensor, so sharing the memo
-    halves the transfers."""
+    halves the transfers. Retention is BOUNDED: one (device, CPU) tensor
+    pair per tag, replaced on the next fit with a different tensor —
+    weakref slots are not an option because tensor pytrees are plain
+    dicts (not weakref-able)."""
     return model.__dict__.setdefault("_cpu_transfer_memo", cpu_transfer_memo())
+
+
+def adaptive_fused(fused_fn, host_fn, is_good, label: str):
+    """Fused-device-first dispatcher with sticky host fallback.
+
+    Calls `fused_fn` (the fully on-device program) and returns its result
+    when `is_good(out)`; otherwise recomputes through `host_fn` (device
+    physics + host/CPU dense solve). When the host result is good after a
+    fused failure, the failure was device underflow — structural for the
+    model, not the trial point — so subsequent calls skip the wasted
+    device pass. On the CPU backend (PINT_TPU_HOST_SOLVE test mode) the
+    host path is used unconditionally."""
+    import logging
+
+    forced = jax.default_backend() == "cpu"
+    state = {"skip_fused": False}
+
+    def call(*args):
+        if not forced and not state["skip_fused"]:
+            out = fused_fn(*args)
+            if is_good(out):
+                return out
+            host_out = host_fn(*args)
+            if is_good(host_out):
+                state["skip_fused"] = True
+                logging.getLogger("pint_tpu.fitting").info(
+                    f"{label}: on-device result non-finite but host result "
+                    "clean (device underflow) — using the host path from now on"
+                )
+            return host_out
+        return host_fn(*args)
+
+    return call
