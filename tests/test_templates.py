@@ -11,6 +11,10 @@ import pytest
 from conftest import REFERENCE_DATA, have_reference_data
 
 TEMPLATE = os.path.join(REFERENCE_DATA, "templateJ0030.3gauss")
+J0030_FT1 = os.path.join(
+    REFERENCE_DATA,
+    "J0030+0451_P8_15.0deg_239557517_458611204_ft1weights_GEO_wt.gt.0.4.fits",
+)
 
 
 def gauss(x, x0, s):
@@ -433,12 +437,8 @@ class TestJ0030Golden:
             lnlikelihood,
         )
 
-        ft1 = os.path.join(
-            REFERENCE_DATA,
-            "J0030+0451_P8_15.0deg_239557517_458611204_ft1weights_GEO_wt.gt.0.4.fits",
-        )
         model = get_model(os.path.join(REFERENCE_DATA, "J0030+0451_post.par"))
-        toas = load_Fermi_TOAs(ft1, weightcolumn="PSRJ0030+0451",
+        toas = load_Fermi_TOAs(J0030_FT1, weightcolumn="PSRJ0030+0451",
                                planets=bool(model.planet_shapiro))
         w = get_event_weights(toas)
         r = Residuals(toas, model, subtract_mean=False, track_mode="nearest")
@@ -488,3 +488,25 @@ class TestJ0030Golden:
                 5 * g.fit_errors["phas"], 0.01), (g, t)
             assert abs(g.fwhm - t.fwhm) < max(5 * g.fit_errors["fwhm"], 0.01)
             assert abs(g.ampl - t.ampl) < max(5 * g.fit_errors["ampl"], 0.03)
+
+    def test_j0030_production_htest_level(self):
+        """Lock the production-ephemeris pulsation significance: the
+        round-5 ephemeris (sextic drift anchor) lifted the full-dataset
+        weighted H from ~483 to ~1700 — a sharp, sensitive probe of phase
+        smearing. Bound at 1000 (reference on a --maxMJD 55000 subset with
+        DE421: 550-600, not directly comparable)."""
+        from conftest import production_ephemeris
+        from pint_tpu.event_toas import (
+            compute_event_phases,
+            get_event_weights,
+            load_Fermi_TOAs,
+        )
+        from pint_tpu.eventstats import hmw
+        from pint_tpu.models.builder import get_model
+
+        with production_ephemeris():
+            model = get_model(os.path.join(REFERENCE_DATA, "J0030+0451_post.par"))
+            toas = load_Fermi_TOAs(J0030_FT1, weightcolumn="PSRJ0030+0451",
+                                   planets=bool(model.planet_shapiro))
+        h = hmw(compute_event_phases(toas, model), get_event_weights(toas))
+        assert h > 1000.0  # measured ~1707
