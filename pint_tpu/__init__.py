@@ -26,8 +26,6 @@ Physical constants below follow the conventions of the reference
 the same public IAU/CODATA numbers, TEMPO-compatible where the reference is).
 """
 
-import os as _os
-
 import jax
 
 # Nanosecond pulse-phase precision requires float64 carriers for the
@@ -36,17 +34,12 @@ jax.config.update("jax_enable_x64", True)
 
 # Persistent XLA compilation cache: the residual/fit/grid graphs take minutes
 # to compile at 1e5-TOA scale, and every fresh process would otherwise pay
-# that again. PINT_TPU_COMPILE_CACHE overrides the location; "0" disables.
-_cache_dir = _os.environ.get(
-    "PINT_TPU_COMPILE_CACHE", _os.path.expanduser("~/.cache/pint_tpu/xla")
-)
-if _cache_dir and _cache_dir != "0":
-    try:
-        _os.makedirs(_cache_dir, exist_ok=True)
-        jax.config.update("jax_compilation_cache_dir", _cache_dir)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
-    except Exception:  # pragma: no cover - cache is an optimization only
-        pass
+# that again. ops/compile.py owns the wiring (versioned directory under the
+# shared cache root, utils/cache.py); PINT_TPU_COMPILE_CACHE overrides the
+# location, "0" disables.
+from pint_tpu.ops.compile import setup_persistent_cache as _setup_xla_cache  # noqa: E402
+
+_setup_xla_cache()
 
 __version__ = "0.1.0"
 

@@ -30,6 +30,7 @@ from pint_tpu.fitting.wls import (
     WLSFitter,
     apply_delta,
 )
+from pint_tpu.ops import perf
 from pint_tpu.fitting.woodbury import (
     basis_matvec,
     cat_ahat,
@@ -128,19 +129,19 @@ def get_gls_step_fn(model: TimingModel, free, subtract_mean: bool):
         # no recompute of the design matrix
         return (r0, M) + woodbury_pieces(params, tensor, r0, M, sigma)
 
-    from pint_tpu.ops.compile import precision_jit
+    from pint_tpu.ops.compile import TimedProgram, precision_jit
 
     if not host:
-        cache[key] = precision_jit(step)
+        cache[key] = TimedProgram(precision_jit(step), "gls_step")
         return cache[key]
 
-    from pint_tpu.ops.compile import model_cpu_memo
+    from pint_tpu.ops.compile import host_transfer, model_cpu_memo
 
     # ADAPTIVE: try the fused on-device step first (no large transfers);
     # fall back to the CPU-split Woodbury only when the device normal
     # matrix comes back non-finite (see module note above)
-    fused_fn = precision_jit(step)
-    device_fn = precision_jit(design)
+    fused_fn = TimedProgram(precision_jit(step), "gls_step_fused")
+    device_fn = TimedProgram(precision_jit(design), "gls_design")
     # the host tail is jitted too (for the CPU target — its inputs live
     # on the CPU device): the Woodbury assembly with its ECORR segment
     # reductions would otherwise run eagerly per LM trial
@@ -161,8 +162,7 @@ def get_gls_step_fn(model: TimingModel, free, subtract_mean: bool):
             # constant per fit and transfers once via the memo
             params_c = jax.device_put(params, cpu)
             tensor_c = memo("tensor", tensor)
-            r0 = jax.device_put(r0_d, cpu)
-            M = jax.device_put(M_d, cpu)
+            r0, M = host_transfer((r0_d, M_d), cpu)
             sig = jax.device_put(jnp.asarray(sigma), cpu)
             pieces = pieces_fn(params_c, tensor_c, r0, M, sig)
             return (r0, M) + tuple(pieces)
@@ -173,7 +173,13 @@ def get_gls_step_fn(model: TimingModel, free, subtract_mean: bool):
         return (np.isfinite(np.asarray(out[2])).all()
                 and np.isfinite(float(out[5])))
 
-    cache[key] = adaptive_fused(fused_fn, step_host, _good, "GLS step")
+    def _precompile(*args):
+        if jax.default_backend() != "cpu":
+            fused_fn.precompile(*args)
+        device_fn.precompile(*args[:5])
+
+    cache[key] = adaptive_fused(fused_fn, step_host, _good, "GLS step",
+                                precompile=_precompile)
     return cache[key]
 
 
@@ -198,16 +204,16 @@ def get_gls_chi2_fn(model: TimingModel, subtract_mean: bool):
         chi2, _ = woodbury_chi2(basis, cinv, r)
         return chi2
 
-    from pint_tpu.ops.compile import precision_jit
+    from pint_tpu.ops.compile import TimedProgram, precision_jit
 
     if not host:
-        cache[key] = precision_jit(chi2fn)
+        cache[key] = TimedProgram(precision_jit(chi2fn), "gls_chi2")
         return cache[key]
 
     from pint_tpu.ops.compile import model_cpu_memo
 
-    fused_fn = precision_jit(chi2fn)
-    resid_fn = precision_jit(time_resids)
+    fused_fn = TimedProgram(precision_jit(chi2fn), "gls_chi2_fused")
+    resid_fn = TimedProgram(precision_jit(time_resids), "gls_resid")
 
     def chi2_tail(params, tensor, r, sigma):
         basis = model.noise_basis_and_weights(params, tensor)
@@ -231,12 +237,18 @@ def get_gls_chi2_fn(model: TimingModel, subtract_mean: bool):
 
     from pint_tpu.ops.compile import adaptive_fused
 
+    def _precompile(*args):
+        if jax.default_backend() != "cpu":
+            fused_fn.precompile(*args)
+        resid_fn.precompile(*args[:5])
+
     # a finite device chi2 is trustworthy; NaN is ambiguous (device
     # underflow OR a genuinely bad trial point) — the host recompute
     # disambiguates, and the sticky flag only latches when the host
     # answer is finite
     cache[key] = adaptive_fused(
-        fused_fn, chi2_host, lambda c: np.isfinite(float(c)), "GLS chi2")
+        fused_fn, chi2_host, lambda c: np.isfinite(float(c)), "GLS chi2",
+        precompile=_precompile)
     return cache[key]
 
 
@@ -257,45 +269,111 @@ def gls_chi2(resids) -> float:
     )
 
 
-def gls_solve(mtcm, mtcy, norm, p: int, lam: float = 0.0, return_eig: bool = False):
-    """(dx_timing, cov_timing) from the normalized GLS normal equations,
-    with optional Marquardt damping lam * diag(mtcm).
+class GLSNormalFactor:
+    """Host-resident factorization of ONE GLS/wideband linearization.
 
-    The solve goes through the symmetric eigendecomposition of G rather
-    than a Cholesky inverse: the spectral pseudo-inverse V diag(1/s) V^T
-    (small/negative eigenvalues zeroed, matching the reference's SVD
-    fallback fitter.py:2228) keeps the covariance PSD BY CONSTRUCTION —
+    Moves (mtcm, mtcy, norm) to the host once and runs ONE symmetric
+    eigendecomposition; every damped Levenberg-Marquardt re-solve within
+    the same outer iteration is then an O(p^2) spectral re-weighting of
+    the cached basis — dx(lam) = V diag(1/(s + lam*s_max)) V^T mtcy / norm
+    — instead of a fresh transfer + eigh per backtracking trial. Damping
+    is SPECTRAL (lam * s_max * I on the normalized normal matrix, the
+    same Levenberg semantics as the WLS lm_step), which is exactly what
+    makes one factorization serve every lam.
+
+    The solve goes through the eigendecomposition rather than a Cholesky
+    inverse: the spectral pseudo-inverse V diag(1/s) V^T (small/negative
+    eigenvalues zeroed, matching the reference's SVD fallback
+    fitter.py:2228) keeps the covariance PSD BY CONSTRUCTION —
     diag(cov) = sum_j s_inv_j V_ij^2 >= 0 — where the Cholesky-inverse of
     a barely-positive-definite 90-param normal matrix could round to
     negative diagonal entries and hand the caller NaN uncertainties.
+    The covariance always comes from the UNDAMPED spectrum.
+
+    A non-finite normal matrix (bad linearization point) produces NaN
+    steps/covariance so run_lm's finite-chi2 backtracking rejects the
+    trial instead of scipy raising out of the fit.
+    """
+
+    def __init__(self, mtcm, mtcy, norm, p: int):
+        import scipy.linalg as sl
+
+        from pint_tpu.ops.compile import host_transfer
+
+        self.p = p
+        mtcm, mtcy, norm = host_transfer((mtcm, mtcy, norm))
+        self.mtcy = np.asarray(mtcy)
+        self.norm = np.asarray(norm)
+        mtcm = np.asarray(mtcm)
+        self.q = mtcm.shape[0] if mtcm.ndim else 0
+        self.ok = bool(not mtcm.size or np.isfinite(mtcm).all())
+        if self.ok:
+            perf.add("factorizations", 1)
+            self.s, self.V = sl.eigh((mtcm + mtcm.T) / 2.0)
+            self.smax = self.s[-1] if self.s.size else 1.0
+        else:
+            self.s = np.full(self.q, np.nan)
+            self.V = np.full((self.q, self.q), np.nan)
+            self.smax = np.nan
+
+    def _sinv(self, lam: float):
+        s, smax = self.s, self.smax
+        good = s > 1e-14 * smax
+        damped = s + (lam * smax if lam else 0.0)
+        return np.where(good, 1.0 / np.where(good, damped, 1.0), 0.0)
+
+    def solve(self, lam: float = 0.0) -> np.ndarray:
+        """Timing-parameter step dx at damping lam (lam=0: Gauss-Newton)."""
+        if not self.ok:
+            return np.full(self.p, np.nan)
+        xhat = self.V @ (self._sinv(lam) * (self.V.T @ self.mtcy))
+        return (xhat / self.norm)[: self.p]
+
+    def cov(self) -> np.ndarray:
+        """Undamped timing-parameter covariance (PSD by construction)."""
+        if not self.ok:
+            return np.full((self.p, self.p), np.nan)
+        p = self.p
+        s_inv = self._sinv(0.0)
+        cov_full = (self.V[:p, :] * s_inv) @ self.V[:p, :].T
+        return (cov_full / self.norm[:p]).T / self.norm[:p]
+
+    def eig(self):
+        """(eigvals ascending, V.T) for degeneracy naming."""
+        return self.s, self.V.T
+
+
+class _FactorSlot:
+    """Per-fit single-slot GLSNormalFactor cache keyed on the identity of
+    the linearization pieces tuple: every damped re-solve of one outer LM
+    iteration reuses one factorization (counter-verified in
+    tests/test_perf.py); a strong reference to the pieces prevents id()
+    aliasing."""
+
+    def __init__(self):
+        self._pieces = None
+        self.factor: GLSNormalFactor | None = None
+
+    def get(self, pieces, mtcm, mtcy, norm, p) -> GLSNormalFactor:
+        if self._pieces is not pieces:
+            self.factor = GLSNormalFactor(mtcm, mtcy, norm, p)
+            self._pieces = pieces
+        return self.factor
+
+
+def gls_solve(mtcm, mtcy, norm, p: int, lam: float = 0.0, return_eig: bool = False):
+    """(dx_timing, cov_timing) from the normalized GLS normal equations
+    (one-shot surface over GLSNormalFactor; iterating callers should hold
+    the factor to reuse its eigendecomposition across damping values).
 
     With return_eig=True also returns (eigvals ascending, V.T) for
     degeneracy naming."""
-    import scipy.linalg as sl
-
-    mtcm = np.asarray(mtcm)
-    mtcy = np.asarray(mtcy)
-    norm = np.asarray(norm)
-    if mtcm.size and not np.isfinite(mtcm).all():
-        # NaN normal matrix from a bad linearization point: hand NaN back
-        # so run_lm's finite-chi2 backtracking rejects the trial instead
-        # of scipy raising out of the fit
-        q = mtcm.shape[0]
-        nan_dx = np.full(p, np.nan)
-        nan_cov = np.full((p, p), np.nan)
-        if return_eig:
-            return nan_dx, nan_cov, np.full(q, np.nan), np.full((q, q), np.nan)
-        return nan_dx, nan_cov
-    G = mtcm + lam * np.diag(np.diag(mtcm)) if lam else mtcm
-    s, V = sl.eigh((G + G.T) / 2.0)
-    smax = s[-1] if s.size else 1.0
-    s_inv = np.where(s > 1e-14 * smax, 1.0 / np.where(s > 0, s, 1.0), 0.0)
-    xhat = V @ (s_inv * (V.T @ mtcy))
-    dx = (xhat / norm)[:p]
-    cov_full = (V[:p, :] * s_inv) @ V[:p, :].T
-    cov = (cov_full / norm[:p]).T / norm[:p]
+    f = GLSNormalFactor(mtcm, mtcy, norm, p)
+    dx = f.solve(lam)
+    cov = f.cov()
     if return_eig:
-        return dx, cov, s, V.T
+        s, vt = f.eig()
+        return dx, cov, s, vt
     return dx, cov
 
 
@@ -332,24 +410,36 @@ def full_cov_pieces(model, resids, r0, M, params=None):
 class GLSFitter(WLSFitter):
     """Iterated linear GLS (reference GLSFitter.fit_toas, fitter.py:2122)."""
 
-    def _step_fn(self, params, tensor):
+    def _step_program(self, params):
+        from pint_tpu.ops.compile import canonicalize_params
+
         r = self.resids
         fn = get_gls_step_fn(self.model, self._free, r.subtract_mean)
-        params = self.model.xprec.convert_params(params)
-        return fn(
-            params, tensor, r._track_pn, r._delta_pn, r._weights,
-            jnp.asarray(r.errors_s),
-        )
+        params = canonicalize_params(self.model.xprec.convert_params(params))
+        args = (params, self.tensor, r._track_pn, r._delta_pn, r._weights,
+                jnp.asarray(r.errors_s))
+        return fn, args
+
+    def _chi2_program(self, params):
+        from pint_tpu.ops.compile import canonicalize_params
+
+        r = self.resids
+        fn = get_gls_chi2_fn(self.model, r.subtract_mean)
+        params = canonicalize_params(self.model.xprec.convert_params(params))
+        args = (params, self.tensor, r._track_pn, r._delta_pn, r._weights,
+                jnp.asarray(r.errors_s))
+        return fn, args
+
+    def _programs(self):
+        return [self._step_program(self.model.params),
+                self._chi2_program(self.model.params)]
 
     def chi2_at(self, params: dict) -> float:
-        fn = get_gls_chi2_fn(self.model, self.resids.subtract_mean)
-        params = self.model.xprec.convert_params(params)
-        r = self.resids
-        return float(
-            fn(params, self.tensor, r._track_pn, r._delta_pn, r._weights,
-               jnp.asarray(r.errors_s))
-        )
+        fn, args = self._chi2_program(params)
+        with perf.stage("chi2"):
+            return float(fn(*args))
 
+    @perf.instrument_fit
     def fit_toas(self, maxiter: int = 1, xtol: float = 1e-2,
                  full_cov: bool = False) -> FitResult:
         """`full_cov` swaps the structured-Woodbury normal equations for
@@ -411,6 +501,7 @@ class DownhillGLSFitter(GLSFitter):
     Cholesky of the cached (p+k)x(p+k) system, so rejected steps cost no
     design-matrix recomputation."""
 
+    @perf.instrument_fit
     def fit_toas(self, maxiter: int = 30, required_chi2_decrease: float = 1e-2,
                  max_rejects: int = 16) -> FitResult:
         from pint_tpu.fitting.wls import run_lm
@@ -419,11 +510,12 @@ class DownhillGLSFitter(GLSFitter):
             return self._frozen_fit_result()
         params = self.model.xprec.convert_params(self.model.params)
         p = len(self._free)
+        slot = _FactorSlot()  # one factorization per linearization
 
         params, chi2_best, it, converged, pieces = run_lm(
             params, self.chi2_at(params),
             compute_pieces=lambda pr: self._step_fn(pr, self.tensor),
-            solve=lambda pc, lam: gls_solve(pc[2], pc[3], pc[4], p, lam=lam)[0],
+            solve=lambda pc, lam: slot.get(pc, pc[2], pc[3], pc[4], p).solve(lam),
             chi2_of=self.chi2_at,
             apply_step=lambda pr, dx: apply_delta(pr, self._free, dx,
                                                   project_domain=True),
@@ -431,8 +523,12 @@ class DownhillGLSFitter(GLSFitter):
             max_rejects=max_rejects, log_label="downhill GLS fit",
         )
         _, _, mtcm, mtcy, norm, _, ahat = pieces
-        # uncertainties always come from the UNDAMPED normal matrix
-        _, cov, es, evt = gls_solve(mtcm, mtcy, norm, p, return_eig=True)
+        # uncertainties always come from the UNDAMPED normal matrix — the
+        # final linearization's resident factor serves them with no extra
+        # transfer or eigendecomposition
+        factor = slot.get(pieces, mtcm, mtcy, norm, p)
+        cov = factor.cov()
+        es, evt = factor.eig()
         self.noise_ampls = np.asarray(ahat)
         return self._finalize_fit(params, chi2_best, it, converged, cov,
                                   s=es[::-1], vt=evt[::-1])

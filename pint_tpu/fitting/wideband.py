@@ -19,8 +19,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from pint_tpu.fitting.gls import gls_solve
+from pint_tpu.fitting.gls import _FactorSlot
 from pint_tpu.fitting.wls import FitResult, WLSFitter, apply_delta
+from pint_tpu.ops import perf
 from pint_tpu.fitting.woodbury import (
     NoiseBasis,
     cat_ahat,
@@ -127,14 +128,16 @@ def get_wb_step_fn(model, free, subtract_mean: bool):
         return (r0,) + woodbury_pieces(params, tensor, r0, A, sw_t,
                                        sw_dm.shape[0])
 
+    from pint_tpu.ops.compile import TimedProgram, host_transfer
+
     if not host:
-        cache[key] = precision_jit(step)
+        cache[key] = TimedProgram(precision_jit(step), "wb_step")
         return cache[key]
 
     # ADAPTIVE: fused on-device first, CPU-split Woodbury only on
     # non-finite results (same strategy as fitting/gls.py)
-    fused_fn = precision_jit(step)
-    device_fn = precision_jit(design)
+    fused_fn = TimedProgram(precision_jit(step), "wb_step_fused")
+    device_fn = TimedProgram(precision_jit(design), "wb_design")
     pieces_fn = jax.jit(woodbury_pieces, static_argnums=(5,))
     cpu = jax.devices("cpu")[0]
     memo = model_cpu_memo(model)
@@ -153,8 +156,7 @@ def get_wb_step_fn(model, free, subtract_mean: bool):
         with jax.default_device(cpu):
             params_c = jax.device_put(params, cpu)
             tensor_c = memo("tensor", tensor)
-            r0 = jax.device_put(r0_d, cpu)
-            A = jax.device_put(A_d, cpu)
+            r0, A = host_transfer((r0_d, A_d), cpu)
             sw_t_c = jax.device_put(sw_t, cpu)
             pieces = pieces_fn(params_c, tensor_c, r0, A, sw_t_c,
                                int(sw_dm.shape[0]))
@@ -166,7 +168,12 @@ def get_wb_step_fn(model, free, subtract_mean: bool):
         return (np.isfinite(np.asarray(out[1])).all()
                 and np.isfinite(float(out[4])))
 
-    cache[key] = adaptive_fused(fused_fn, step_host, _good, "wideband step")
+    def _precompile(*args):
+        if jax.default_backend() != "cpu":
+            fused_fn.precompile(*args)
+
+    cache[key] = adaptive_fused(fused_fn, step_host, _good, "wideband step",
+                                precompile=_precompile)
     return cache[key]
 
 
@@ -194,12 +201,14 @@ def get_wb_chi2_fn(model, subtract_mean: bool):
         chi2, _ = woodbury_chi2(basis, jnp.ones_like(r0), r0)
         return chi2
 
+    from pint_tpu.ops.compile import TimedProgram, host_transfer
+
     if not host:
-        cache[key] = precision_jit(chi2fn)
+        cache[key] = TimedProgram(precision_jit(chi2fn), "wb_chi2")
         return cache[key]
 
-    fused_fn = precision_jit(chi2fn)
-    resid_fn = precision_jit(resids)
+    fused_fn = TimedProgram(precision_jit(chi2fn), "wb_chi2_fused")
+    resid_fn = TimedProgram(precision_jit(resids), "wb_resid")
 
     def chi2_tail(params, tensor, r0, sw_t, n_dm):
         basis = _noise_basis_aug(model, params, tensor, sw_t, n_dm)
@@ -229,8 +238,13 @@ def get_wb_chi2_fn(model, subtract_mean: bool):
 
     from pint_tpu.ops.compile import adaptive_fused
 
+    def _precompile(*args):
+        if jax.default_backend() != "cpu":
+            fused_fn.precompile(*args)
+
     cache[key] = adaptive_fused(
-        fused_fn, chi2_host, lambda c: np.isfinite(float(c)), "wideband chi2")
+        fused_fn, chi2_host, lambda c: np.isfinite(float(c)), "wideband chi2",
+        precompile=_precompile)
     return cache[key]
 
 
@@ -260,8 +274,10 @@ class WidebandDownhillFitter(WLSFitter):
         )
 
     def _args(self, params):
+        from pint_tpu.ops.compile import canonicalize_params
+
         r = self.resids.toa
-        params = self.model.xprec.convert_params(params)
+        params = canonicalize_params(self.model.xprec.convert_params(params))
         return (
             params, self.tensor, r._track_pn, r._delta_pn, r._weights,
             jnp.asarray(r.errors_s), jnp.asarray(self.resids.dm_errors),
@@ -270,22 +286,36 @@ class WidebandDownhillFitter(WLSFitter):
 
     def chi2_at(self, params) -> float:
         fn = get_wb_chi2_fn(self.model, self.resids.toa.subtract_mean)
-        return float(fn(*self._args(params)))
+        with perf.stage("chi2"):
+            return float(fn(*self._args(params)))
 
+    def _step_program(self, params):
+        fn = get_wb_step_fn(self.model, self._free, self.resids.toa.subtract_mean)
+        return fn, self._args(params)
+
+    def _chi2_program(self, params):
+        fn = get_wb_chi2_fn(self.model, self.resids.toa.subtract_mean)
+        return fn, self._args(params)
+
+    def _programs(self):
+        return [self._step_program(self.model.params),
+                self._chi2_program(self.model.params)]
+
+    @perf.instrument_fit
     def fit_toas(self, maxiter: int = 30, required_chi2_decrease: float = 1e-2,
                  max_rejects: int = 16) -> FitResult:
         from pint_tpu.fitting.wls import run_lm
 
         if len(self._free) == 0:
             return self._frozen_fit_result()
-        step = get_wb_step_fn(self.model, self._free, self.resids.toa.subtract_mean)
         params = self.model.xprec.convert_params(self.model.params)
         p = len(self._free)
+        slot = _FactorSlot()  # one factorization per linearization
 
         params, chi2_best, it, converged, pieces = run_lm(
             params, self.chi2_at(params),
-            compute_pieces=lambda pr: step(*self._args(pr)),
-            solve=lambda pc, lam: gls_solve(pc[1], pc[2], pc[3], p, lam=lam)[0],
+            compute_pieces=lambda pr: self._step_fn(pr, self.tensor),
+            solve=lambda pc, lam: slot.get(pc, pc[1], pc[2], pc[3], p).solve(lam),
             chi2_of=self.chi2_at,
             apply_step=lambda pr, dx: apply_delta(pr, self._free, dx,
                                                   project_domain=True),
@@ -293,7 +323,7 @@ class WidebandDownhillFitter(WLSFitter):
             max_rejects=max_rejects, log_label="wideband fit",
         )
         _, mtcm, mtcy, norm, _, ahat = pieces
-        _, cov = gls_solve(mtcm, mtcy, norm, p)
+        cov = slot.get(pieces, mtcm, mtcy, norm, p).cov()
         self.noise_ampls = np.asarray(ahat)
         return self._finalize_fit(params, chi2_best, it, converged, cov)
 

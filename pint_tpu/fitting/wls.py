@@ -23,6 +23,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from pint_tpu.models.timing_model import TimingModel
+from pint_tpu.ops import perf
 from pint_tpu.ops.dd import DD, dd_add_fp
 from pint_tpu.residuals import Residuals
 from pint_tpu.utils.logging import get_logger
@@ -138,6 +139,9 @@ class FitResult:
     free_params: list[str] = field(default_factory=list)
     singular_values: np.ndarray | None = None
     degenerate: list[str] = field(default_factory=list)
+    #: stage breakdown of this fit (ops/perf.py fit_breakdown) when
+    #: telemetry was enabled, else None
+    perf: dict | None = None
 
     @property
     def reduced_chi2(self) -> float:
@@ -219,13 +223,13 @@ def get_step_fn(model: TimingModel, free: tuple[str, ...], subtract_mean: bool):
         utb = U.T @ b
         return r0, M, dx, cov, s, Vt, chi2_0, utb, norm
 
-    from pint_tpu.ops.compile import precision_jit
+    from pint_tpu.ops.compile import TimedProgram, precision_jit
 
     # PINT_TPU_HOST_SOLVE=1 forces the host-solve path (tests exercise it
     # on CPU; it is automatic on non-CPU backends). The flag is part of
     # the cache key, so toggling it mid-process takes effect.
     if not host_solve:
-        cache[key] = precision_jit(step)
+        cache[key] = TimedProgram(precision_jit(step), "wls_step")
         return cache[key]
 
     # Non-CPU backends: the TPU emulates f64 as f32-pairs whose RANGE is
@@ -238,13 +242,14 @@ def get_step_fn(model: TimingModel, free: tuple[str, ...], subtract_mean: bool):
     # device speed); only when its singular values come back non-finite
     # recompute with the physics on device and the dense solve on the
     # host in true f64.
-    fused_fn = precision_jit(step)
-    device_fn = precision_jit(design)
+    from pint_tpu.ops.compile import host_transfer
+
+    fused_fn = TimedProgram(precision_jit(step), "wls_step_fused")
+    device_fn = TimedProgram(precision_jit(design), "wls_design")
 
     def step_host_solve(params, tensor, track_pn, delta_pn, weights, errors):
         r0_d, M_d = device_fn(params, tensor, track_pn, delta_pn, weights)
-        r0 = np.asarray(r0_d)
-        M = np.asarray(M_d)
+        r0, M = host_transfer((r0_d, M_d))
         p = M.shape[1]
         if not (np.isfinite(r0).all() and np.isfinite(M).all()):
             # mirror the device path's NaN propagation so run_lm's
@@ -258,6 +263,7 @@ def get_step_fn(model: TimingModel, free: tuple[str, ...], subtract_mean: bool):
         b = -r0 * w
         norm = np.linalg.norm(A, axis=0)
         norm[norm == 0] = 1.0
+        perf.add("factorizations", 1)
         U, s, Vt = np.linalg.svd(A / norm, full_matrices=False)
         good = s > SVD_THRESHOLD * s[0]
         s_inv = np.where(good, 1.0 / np.where(good, s, 1.0), 0.0)
@@ -274,7 +280,15 @@ def get_step_fn(model: TimingModel, free: tuple[str, ...], subtract_mean: bool):
         return s.size == 0 or (np.isfinite(s).all()
                                and np.isfinite(np.asarray(out[2])).all())
 
-    cache[key] = adaptive_fused(fused_fn, step_host_solve, _good, "WLS step")
+    def _precompile(*args):
+        # warm only the programs this dispatch mode can reach: the forced
+        # host mode (CPU test path) never runs the fused step
+        if jax.default_backend() != "cpu":
+            fused_fn.precompile(*args)
+        device_fn.precompile(*args[:5])
+
+    cache[key] = adaptive_fused(fused_fn, step_host_solve, _good, "WLS step",
+                                precompile=_precompile)
     return cache[key]
 
 
@@ -294,12 +308,15 @@ def run_lm(params, chi2_best, compute_pieces, solve, chi2_of, apply_step,
     converged = False
     pieces = None
     for it in range(1, maxiter + 1):
+        perf.add("lm_iterations")
         pieces = compute_pieces(params)
         lam = 0.0
         accepted = False
         gain = 0.0
         for _ in range(max_rejects):
-            dx = solve(pieces, lam)
+            perf.add("lm_trials")
+            with perf.stage("solve"):
+                dx = solve(pieces, lam)
             trial = apply_step(params, dx)
             chi2_trial = chi2_of(trial)
             if np.isfinite(chi2_trial) and chi2_trial <= chi2_best:
@@ -307,6 +324,7 @@ def run_lm(params, chi2_best, compute_pieces, solve, chi2_of, apply_step,
                 params, chi2_best = trial, chi2_trial
                 accepted = True
                 break
+            perf.add("lm_rejects")
             lam = 1e-8 if lam == 0.0 else lam * 10.0
         if not accepted or gain < required_gain:
             converged = True
@@ -314,6 +332,31 @@ def run_lm(params, chi2_best, compute_pieces, solve, chi2_of, apply_step,
     else:
         log.warning(f"{log_label} hit maxiter={maxiter}")
     return params, chi2_best, it, converged, pieces
+
+
+class HostPieceSlot:
+    """Single-slot host residency for one linearization's solve operands.
+
+    Keyed on the pieces tuple's identity (a strong reference, so a
+    recycled id() can never alias), the extracted operands are moved to
+    the host exactly once per outer LM iteration no matter how many
+    damped re-solve trials the backtracking loop runs — the repeated
+    `np.asarray` conversions that used to happen per trial collapse to
+    one counted host transfer."""
+
+    __slots__ = ("_src", "_host")
+
+    def __init__(self):
+        self._src = None
+        self._host = None
+
+    def get(self, pieces, extract):
+        if self._src is not pieces:
+            from pint_tpu.ops.compile import host_transfer
+
+            self._host = host_transfer(extract(pieces))
+            self._src = pieces
+        return self._host
 
 
 def lm_step(s, vt, utb, norm, lam: float):
@@ -350,16 +393,68 @@ class WLSFitter:
         }
         self._prefit_wrms = self.resids.rms_weighted()
 
-    def _step_fn(self, params, tensor):
+    def _step_program(self, params):
+        """(step callable, argument tuple) — the one place the step
+        program and its concrete arguments pair up, shared by the live
+        fit path and `precompile`."""
+        from pint_tpu.ops.compile import canonicalize_params
+
         r = self.resids
         fn = get_step_fn(self.model, self._free, r.subtract_mean)
-        params = self.model.xprec.convert_params(params)
-        return fn(params, tensor, r._track_pn, r._delta_pn, r._weights, jnp.asarray(r.errors_s))
+        params = canonicalize_params(self.model.xprec.convert_params(params))
+        args = (params, self.tensor, r._track_pn, r._delta_pn, r._weights,
+                jnp.asarray(r.errors_s))
+        return fn, args
+
+    def _step_fn(self, params, tensor):
+        fn, args = self._step_program(params)
+        with perf.stage("step"):
+            out = fn(*args)
+        perf.put_default("solve_path",
+                         getattr(fn, "solve_path", "fused"))
+        return out
+
+    def precompile(self, background: bool = False):
+        """Ahead-of-time compile this fitter's step program(s) for its
+        data shapes. XLA compilation is host-side work that releases the
+        GIL: with ``background=True`` it runs in a daemon thread (returned,
+        so callers can join), overlapping the compile with whatever else
+        the session is doing — the first `fit_toas` then finds the
+        executables ready instead of serializing the compile inside the
+        fit (the dominant term of the flagship bench's 91 s first fit)."""
+        import threading
+
+        programs = self._programs()
+
+        def work():
+            for fn, args in programs:
+                pre = getattr(fn, "precompile", None)
+                if pre is not None:
+                    try:
+                        pre(*args)
+                    except Exception as e:  # noqa: BLE001 — warmup is best-effort
+                        log.warning(f"fit-step precompile failed: {e}")
+
+        if background:
+            th = threading.Thread(target=work, daemon=True,
+                                  name="pint-tpu-fit-precompile")
+            th.start()
+            return th
+        work()
+        return None
+
+    def _programs(self):
+        """The (callable, args) pairs `precompile` warms."""
+        return [self._step_program(self.model.params)]
 
     def chi2_at(self, params: dict) -> float:
-        _, _, rt = self.resids._phase_fn(params, self.tensor)
-        r = np.asarray(rt)
-        return float(np.sum((r / self.resids.errors_s) ** 2))
+        from pint_tpu.ops.compile import canonicalize_params
+
+        with perf.stage("chi2"):
+            _, _, rt = self.resids._phase_fn(
+                canonicalize_params(params), self.tensor)
+            r = np.asarray(rt)
+            return float(np.sum((r / self.resids.errors_s) ** 2))
 
     def _rebuild_resids(self) -> Residuals:
         """Fresh post-fit residuals preserving the caller's tracking mode and
@@ -400,6 +495,7 @@ class WLSFitter:
         )
         return self.result
 
+    @perf.instrument_fit
     def fit_toas(self, maxiter: int = 4, xtol: float = 1e-2) -> FitResult:
         """Gauss-Newton iteration.  Converged when every parameter step is
         below `xtol` of its own uncertainty (reference downhill semantics,
@@ -510,6 +606,12 @@ class WLSFitter:
                       cov, s=None, vt=None) -> FitResult:
         """Shared fit tail: write back params/uncertainties, rebuild
         residuals, assemble the FitResult."""
+        with perf.stage("finalize"):
+            return self._finalize_fit_inner(params, chi2, it, converged, cov,
+                                            s=s, vt=vt)
+
+    def _finalize_fit_inner(self, params, chi2, it, converged, cov,
+                            s=None, vt=None) -> FitResult:
         from pint_tpu.ops.xprec import params_to_dd
 
         self.model.params = params_to_dd(params)
@@ -553,15 +655,21 @@ class DownhillWLSFitter(WLSFitter):
     near-degenerate DMX columns excited by a far-from-optimum start — are
     suppressed instead of exploding the trial step)."""
 
+    @perf.instrument_fit
     def fit_toas(self, maxiter: int = 30, required_chi2_decrease: float = 1e-2,
                  max_rejects: int = 16) -> FitResult:
         if len(self._free) == 0:
             return self._frozen_fit_result()
         params = self.model.xprec.convert_params(self.model.params)
+        slot = HostPieceSlot()  # SVD pieces move to the host once per iteration
 
         def solve(pieces, lam):
-            r0, M, dx0, cov, s, vt, _, utb, norm = pieces
-            return dx0 if lam == 0.0 else lm_step(s, vt, utb, norm, lam)
+            if lam == 0.0:
+                return pieces[2]  # the undamped Gauss-Newton dx
+            s, vt, utb, norm = slot.get(
+                pieces, lambda pc: (pc[4], pc[5], pc[7], pc[8])
+            )
+            return lm_step(s, vt, utb, norm, lam)
 
         params, chi2_best, it, converged, pieces = run_lm(
             params, self.chi2_at(params),
@@ -585,6 +693,7 @@ class PowellFitter(WLSFitter):
     corners where Gauss-Newton struggles. Uncertainties still come from a
     final WLS linearization at the optimum."""
 
+    @perf.instrument_fit
     def fit_toas(self, maxiter: int = 2000, xtol: float = 1e-10) -> FitResult:
         from scipy.optimize import minimize
 

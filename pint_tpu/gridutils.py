@@ -443,7 +443,11 @@ def precompile_grid(fitter, parnames, parvalues, maxiter: int = 1,
                               correlated)
     params = model.xprec.convert_params(model.params)
     data = _host_data(fitter.resids, fitter.tensor)
-    compiled = fn.lower(tiles, params, data).compile()
+    from pint_tpu.ops import perf
+
+    with perf.stage("compile"):
+        compiled = fn.lower(tiles, params, data).compile()
+    perf.add("compiled:grid", 1)
     # the AOT executable is valid only for this exact tile shape: store it
     # under a shape-qualified key so different-sized scans still reach the
     # shape-polymorphic jit wrapper
@@ -453,11 +457,32 @@ def precompile_grid(fitter, parnames, parvalues, maxiter: int = 1,
     return pts.shape[0]
 
 
+def _shard_map():
+    """jax.shard_map across jax versions: top-level since 0.6, under
+    jax.experimental before that (with `check_rep` instead of `check_vma`
+    — normalize to the keyword this module uses)."""
+    import functools
+    import inspect
+
+    fn = getattr(jax, "shard_map", None)
+    if fn is None:
+        from jax.experimental.shard_map import shard_map as fn
+    if "check_vma" not in inspect.signature(fn).parameters:
+        @functools.wraps(fn)
+        def compat(f, *args, check_vma=None, **kwargs):
+            if check_vma is not None:
+                kwargs["check_rep"] = check_vma
+            return fn(f, *args, **kwargs)
+
+        return compat
+    return fn
+
+
 def _grid_sharded(model, parnames, free, subtract_mean, maxiter, mesh,
                   grid_axis, toa_axis, pts, params, data, correlated):
     from jax.sharding import PartitionSpec as P
 
-    shard_map = jax.shard_map
+    shard_map = _shard_map()
 
     if grid_axis not in mesh.shape:
         raise ValueError(f"mesh has no axis {grid_axis!r}")

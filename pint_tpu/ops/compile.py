@@ -1,41 +1,237 @@
-"""Backend-aware jit for extended-precision (dd64/qf32) computations.
+"""Backend-aware jit, persistent-compile-cache wiring, and AOT program
+handling for extended-precision (dd64/qf32) computations.
 
-XLA:CPU's `fusion` pass (jax 0.9.0) recompute-duplicates multi-use
-intermediates when it fuses large elementwise DAGs. Compensated arithmetic
-(two_sum / renorm chains) is exactly that shape: every error term is used
-twice, so the emitted code grows ~2^depth. Measured on a 16-element array:
-a 15-deep qf_add/qf_mul chain runs in 2 ms, 16-deep in 0.4 s, 17-deep in
->100 s — while the *optimized HLO is the same size*; the duplication happens
-at fusion codegen. The TPU compiler does not have this pathology (32-deep
-chain: 0.1 ms), and `lax.optimization_barrier` is stripped by the CPU
-pipeline before fusion, so the only effective cure is disabling the CPU
-fusion pass for the affected programs.
+CPU fusion history: XLA:CPU's `fusion` pass used to recompute-duplicate
+multi-use intermediates when fusing large elementwise DAGs — compensated
+arithmetic (two_sum / renorm chains) grew ~2^depth at fusion codegen, and
+`precision_jit` disabled the pass for CPU-target programs via per-program
+``compiler_options``. The XLA build in the current toolchain has BOTH
+fixed the pathology and broken the option: a 17-deep qf_add/qf_mul chain
+now compiles+runs in ~1 s with fusion ON and ~15 s with fusion OFF
+(measured on a 16-element array; 28-deep: 3.7 s with fusion on), while
+passing ``xla_disable_hlo_passes`` through ``compiler_options`` aborts in
+jaxlib's env-override application (protobuf: repeated field set through
+singular-field reflection). `precision_jit` is therefore plain `jax.jit`
+by default everywhere; set ``PINT_TPU_CPU_FUSION_WORKAROUND=1`` to restore
+the old per-program pass-disable on toolchains that still need it (guarded
+by tests/test_qf32.py's compile-time regression test either way).
 
-`precision_jit` therefore compiles with
-`compiler_options={"xla_disable_hlo_passes": "fusion"}` when (and only
-when) the computation targets the CPU backend. The option is scoped to the
-single jitted program — nothing leaks into TPU compiles, where disabling
-fusion would be a real performance loss.
+This module also owns the fit-path compile machinery the perf layer
+(ops/perf.py) reports on:
+
+- `setup_persistent_cache()` wires jax's on-disk XLA compilation cache
+  under the shared cache root (utils/cache.py), so a fresh process reuses
+  every previously compiled program — the dominant term of the 91 s
+  first-fit wall on the flagship bench.
+- `TimedProgram` wraps a jitted callable so compile time is split from
+  device-step time in the fit breakdown, and exposes `precompile()` for
+  the overlap trick: compilation is host-side work that releases the GIL,
+  so a worker thread can compile the fit-step program while the chip (or
+  the host) is busy with TOA preparation.
 """
 
 from __future__ import annotations
 
+import os
+import threading
+
 import jax
 
+from pint_tpu.ops import perf
+
 _CPU_WORKAROUND = {"xla_disable_hlo_passes": "fusion"}
+
+
+def cpu_fusion_compiler_options() -> dict:
+    """Per-program compiler options for CPU-target dd/qf programs: empty on
+    the current toolchain (see module docstring), the fusion-pass disable
+    when PINT_TPU_CPU_FUSION_WORKAROUND=1 opts back in."""
+    if os.environ.get("PINT_TPU_CPU_FUSION_WORKAROUND", "0") == "1":
+        return dict(_CPU_WORKAROUND)
+    return {}
 
 
 def precision_jit(fn=None, **jit_kwargs):
     """`jax.jit` for functions whose graph contains dd64/qf32 chains.
 
-    On the CPU backend, disables the XLA fusion pass for this program (see
-    module docstring); elsewhere it is plain `jax.jit`.
-    """
+    Ensures the persistent compilation cache is wired up, and applies the
+    CPU fusion workaround when opted in (module docstring)."""
     if fn is None:
         return lambda f: precision_jit(f, **jit_kwargs)
+    setup_persistent_cache()
     if jax.default_backend() == "cpu":
-        jit_kwargs.setdefault("compiler_options", _CPU_WORKAROUND)
+        opts = cpu_fusion_compiler_options()
+        if opts:
+            jit_kwargs.setdefault("compiler_options", opts)
     return jax.jit(fn, **jit_kwargs)
+
+
+# --- persistent XLA compilation cache -------------------------------------------
+
+_cache_state = {"dir": None, "done": False}
+_cache_lock = threading.Lock()
+
+
+def setup_persistent_cache(force: bool = False) -> str | None:
+    """Enable jax's persistent (on-disk) XLA compilation cache.
+
+    The directory is versioned like every other pint_tpu disk cache
+    (utils/cache.py): ``$PINT_TPU_CACHE_DIR/xla/jax-<version>`` — jax's own
+    cache key covers program/flags/platform, the version directory guards
+    against serialization-format drift across toolchains. Idempotent; call
+    ``force=True`` to re-apply after changing the env knobs.
+
+    Env: ``PINT_TPU_COMPILE_CACHE`` (the knob documented since the seed:
+    a directory overrides the location, ``0`` disables — the graft entry's
+    multi-device dryrun relies on the disable because XLA:CPU AOT entries
+    written under different detected host features can SIGILL on load);
+    ``PINT_TPU_XLA_CACHE=0`` / ``PINT_TPU_XLA_CACHE_DIR`` are equivalent
+    split knobs. Cache *errors* never break a fit
+    (``jax_raise_persistent_cache_errors`` is set False); a program that
+    cannot be cached just compiles normally.
+
+    Returns the cache directory in use, or None when disabled.
+    """
+    with _cache_lock:
+        if _cache_state["done"] and not force:
+            return _cache_state["dir"]
+        _cache_state["done"] = True
+        legacy = os.environ.get("PINT_TPU_COMPILE_CACHE")
+        if os.environ.get("PINT_TPU_XLA_CACHE", "1") == "0" or legacy == "0":
+            _cache_state["dir"] = None
+            return None
+        from pint_tpu.utils.cache import cache_root
+
+        path = os.environ.get("PINT_TPU_XLA_CACHE_DIR") or legacy or str(
+            cache_root() / "xla" / f"jax-{jax.__version__}"
+        )
+        try:
+            os.makedirs(path, exist_ok=True)
+            jax.config.update("jax_compilation_cache_dir", path)
+            # fit/grid programs compile in 0.5 s - minutes; cache everything
+            # that costs more than a disk read
+            jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.1)
+            jax.config.update("jax_raise_persistent_cache_errors", False)
+            # jax materializes its cache object on the first compile and
+            # then ignores jax_compilation_cache_dir updates: if anything
+            # compiled before this ran (or a test re-points the dir), the
+            # new directory only takes effect after an explicit reset
+            from jax.experimental.compilation_cache import (
+                compilation_cache as _cc,
+            )
+
+            _cc.reset_cache()
+        except Exception:  # pragma: no cover — config surface drift
+            _cache_state["dir"] = None
+            return None
+        _cache_state["dir"] = path
+        return path
+
+
+# --- AOT program wrapper ---------------------------------------------------------
+
+
+def canonicalize_params(params):
+    """Give every plain Python-float parameter leaf a concrete, strongly
+    typed f64 aval.
+
+    A Python float traces as a WEAK-typed scalar; after the first
+    `apply_delta` the same leaf is a strong f64 array, which is a
+    different abstract value — so the step and phase programs were being
+    traced AND compiled twice per first fit (measured: the duplicate
+    compile was a full second copy of the fit-step compile cost).
+    Canonicalizing up front makes iteration 1 and iteration N share one
+    program. Ints/bools are left alone: promoting them would change the
+    program's dtype semantics."""
+    import jax.numpy as jnp
+
+    def canon(x):
+        if type(x) is float:
+            return jnp.asarray(x, dtype=jnp.float64)
+        return x
+
+    return jax.tree_util.tree_map(canon, params)
+
+
+def _args_signature(args):
+    """Hashable (treedef, leaf shapes/dtypes) signature of a call."""
+    leaves, treedef = jax.tree_util.tree_flatten(args)
+    return treedef, tuple(
+        (tuple(getattr(x, "shape", ())), str(getattr(x, "dtype", type(x).__name__)))
+        for x in leaves
+    )
+
+
+class TimedProgram:
+    """Jitted-callable wrapper: compile-time split + ahead-of-time compile.
+
+    - With telemetry collecting (ops/perf.py), the first call per argument
+      signature explicitly lowers+compiles under a ``compile`` stage, so
+      the fit breakdown separates `fit_compile_s` from device-step time;
+      execution is blocked-on so the enclosing stage measures real device
+      time rather than async dispatch.
+    - `precompile(*args)` compiles the executable ahead of the first call
+      (safe from a worker thread — XLA compilation releases the GIL), so
+      a later first call finds it ready.
+    - With telemetry off and nothing precompiled, calls pass straight
+      through to the jitted callable.
+    """
+
+    __slots__ = ("jfn", "label", "_exes", "_lock")
+
+    def __init__(self, jfn, label: str):
+        self.jfn = jfn
+        self.label = label
+        self._exes: dict = {}
+        self._lock = threading.Lock()
+
+    # deepcopy-atomic, like the bare jit wrappers these replace: model
+    # deepcopies share the compiled-program cache entries by reference
+    # (the programs depend only on model STRUCTURE, which the copy shares)
+    def __deepcopy__(self, memo):
+        return self
+
+    def __copy__(self):
+        return self
+
+    def precompile(self, *args) -> None:
+        sig = _args_signature(args)
+        if sig not in self._exes:
+            self._compile(sig, args)
+
+    def _compile(self, sig, args):
+        with self._lock:
+            exe = self._exes.get(sig)
+            if exe is None:
+                # trace (host Python, never cached) split from backend
+                # compile (XLA, served from the persistent cache when warm)
+                with perf.stage("trace"):
+                    lowered = self.jfn.lower(*args)
+                with perf.stage("compile"):
+                    exe = lowered.compile()
+                perf.add(f"compiled:{self.label}", 1)
+                self._exes[sig] = exe
+        return exe
+
+    def __call__(self, *args):
+        collecting = perf.active()
+        if not self._exes and not collecting:
+            return self.jfn(*args)
+        sig = _args_signature(args)
+        exe = self._exes.get(sig)
+        if exe is None:
+            if not collecting:
+                return self.jfn(*args)
+            exe = self._compile(sig, args)
+        try:
+            out = exe(*args)
+        except Exception:
+            # AOT executables are stricter than jit (layout/sharding of the
+            # exact lowering); any mismatch falls back to the jit path
+            out = self.jfn(*args)
+        if collecting:
+            out = jax.block_until_ready(out)
+        return out
 
 
 def use_host_solve() -> bool:
@@ -46,10 +242,32 @@ def use_host_solve() -> bool:
     (measured for both the WLS design-matrix SVD and the GLS red-noise
     Woodbury pieces). ``PINT_TPU_HOST_SOLVE=1`` forces it on CPU so tests
     exercise the host path."""
-    import os
-
     return (jax.default_backend() != "cpu"
             or os.environ.get("PINT_TPU_HOST_SOLVE", "0") == "1")
+
+
+def _tree_nbytes(obj) -> int:
+    return sum(getattr(x, "nbytes", 0) for x in jax.tree_util.tree_leaves(obj))
+
+
+def host_transfer(obj, device=None):
+    """Move a pytree to the host/CPU device, counted and timed for the fit
+    breakdown (host_transfers / host_transfer_bytes counters + the
+    ``host_transfer`` stage)."""
+    import numpy as np
+
+    collecting = perf.active()
+    with perf.stage("host_transfer"):
+        if device is None:
+            out = jax.tree_util.tree_map(np.asarray, obj)
+        else:
+            out = jax.device_put(obj, device)
+            if collecting:
+                out = jax.block_until_ready(out)
+    if collecting:
+        perf.add("host_transfers", 1)
+        perf.add("host_transfer_bytes", _tree_nbytes(obj))
+    return out
 
 
 def cpu_transfer_memo():
@@ -67,7 +285,7 @@ def cpu_transfer_memo():
     def put(tag, obj):
         keyed, cached = slots.get(tag, (None, None))
         if keyed is not obj:
-            cached = jax.device_put(obj, cpu)
+            cached = host_transfer(obj, cpu)
             slots[tag] = (obj, cached)
         return cached
 
@@ -84,7 +302,8 @@ def model_cpu_memo(model):
     return model.__dict__.setdefault("_cpu_transfer_memo", cpu_transfer_memo())
 
 
-def adaptive_fused(fused_fn, host_fn, is_good, label: str):
+def adaptive_fused(fused_fn, host_fn, is_good, label: str,
+                   forced: bool | None = None, precompile=None):
     """Fused-device-first dispatcher with sticky host fallback.
 
     Calls `fused_fn` (the fully on-device program) and returns its result
@@ -93,25 +312,56 @@ def adaptive_fused(fused_fn, host_fn, is_good, label: str):
     fused failure, the failure was device underflow — structural for the
     model, not the trial point — so subsequent calls skip the wasted
     device pass. On the CPU backend (PINT_TPU_HOST_SOLVE test mode) the
-    host path is used unconditionally."""
+    host path is used unconditionally; `forced` overrides the backend
+    check (tests exercise the latch logic on CPU with forced=False).
+
+    The returned callable carries its dispatch telemetry as attributes —
+    ``solve_path`` ("fused" | "host", the sticky outcome), ``last_path``
+    (the path the most recent call used) and ``latch_reason`` (why the
+    host path latched) — and latches the same into any collecting perf
+    report. `precompile`, when given, is exposed as ``call.precompile``
+    so fitter-level AOT warmup reaches the right underlying programs.
+    """
     import logging
 
-    forced = jax.default_backend() == "cpu"
-    state = {"skip_fused": False}
+    if forced is None:
+        forced = jax.default_backend() == "cpu"
+    state = {"skip_fused": False, "reason": "forced_host" if forced else None}
+
+    def _note(path):
+        # refresh the callable's telemetry attributes + latch into any
+        # collecting perf report
+        call.last_path = path
+        call.solve_path = "host" if (forced or state["skip_fused"]) else "fused"
+        call.latch_reason = state["reason"]
+        perf.put("solve_path", call.solve_path)
+        perf.put("solve_path_reason", state["reason"])
 
     def call(*args):
         if not forced and not state["skip_fused"]:
             out = fused_fn(*args)
             if is_good(out):
+                _note("fused")
                 return out
             host_out = host_fn(*args)
             if is_good(host_out):
                 state["skip_fused"] = True
+                state["reason"] = "device_nonfinite_host_clean"
                 logging.getLogger("pint_tpu.fitting").info(
                     f"{label}: on-device result non-finite but host result "
                     "clean (device underflow) — using the host path from now on"
                 )
+            else:
+                state["reason"] = "both_paths_nonfinite"
+            _note("host")
             return host_out
+        _note("host")
         return host_fn(*args)
 
+    call.state = state
+    call.last_path = None
+    call.solve_path = "host" if forced else "fused"
+    call.latch_reason = state["reason"]
+    if precompile is not None:
+        call.precompile = precompile
     return call

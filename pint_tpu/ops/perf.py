@@ -1,0 +1,290 @@
+"""Stage-level performance telemetry for the fit path.
+
+The flagship bench showed a 91 s first `fit_toas()` on the 100k-TOA set
+while timing it as one opaque block (BENCH_r05 "initial_fit_s"), so nobody
+could say whether compile, device steps, host solves, or transfers were to
+blame. This module is the measuring instrument: a nesting stage timer plus
+counters that the fitters (fitting/wls.py, gls.py, wideband.py) and the
+compile layer (ops/compile.py) report into, aggregated into a per-fit
+breakdown (`fit_breakdown`) that lands on ``FitResult.perf`` and in the
+bench headline record.
+
+Design constraints:
+
+- **Near-zero cost when off.** Nothing is recorded unless a report is
+  active; `stage()` then returns one shared no-op context manager and
+  `add`/`put` are a single empty-list check. The fit path stays exactly
+  as fast as before when telemetry is off.
+- **Thread-aware.** The report registry is process-global (so the
+  overlapped precompile worker threads report into the same collection),
+  while the stage-nesting *path* is thread-local (so a worker's stages
+  don't splice into the fit thread's nesting).
+- **Nesting aggregates by path.** ``stage("fit")`` containing
+  ``stage("step")`` records under ``"fit"`` and ``"fit/step"``; repeated
+  entries of the same path sum their durations and count entries, so
+  per-iteration means fall out of (total, count).
+
+Enable with ``PINT_TPU_PERF=1`` (every fit then attaches a breakdown), or
+programmatically::
+
+    from pint_tpu.ops import perf
+    with perf.collect() as report:
+        fitter.fit_toas()
+    print(report.summary())          # raw stage/counter dump
+    print(fitter.result.perf)        # canonical fit breakdown
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+import threading
+import time
+from contextlib import contextmanager
+
+__all__ = [
+    "PerfReport", "active", "add", "collect", "enable", "enabled",
+    "fit_breakdown", "instrument_fit", "put", "put_default", "stage",
+]
+
+_env_enabled = os.environ.get("PINT_TPU_PERF", "0") == "1"
+# all reports currently collecting; stage/add/put record into every one
+_reports: list["PerfReport"] = []
+_tls = threading.local()  # .path: list[str] — per-thread stage nesting
+
+
+class PerfReport:
+    """Aggregated stage timings + counters + latched values."""
+
+    def __init__(self):
+        # path -> [total_seconds, count]
+        self.timings: dict[str, list] = {}
+        # name -> accumulated value
+        self.counters: dict[str, float] = {}
+        # name -> last latched value (solve_path, latch reason, ...)
+        self.values: dict[str, object] = {}
+
+    def seconds(self, path: str) -> float:
+        t = self.timings.get(path)
+        return 0.0 if t is None else t[0]
+
+    def count(self, path: str) -> int:
+        t = self.timings.get(path)
+        return 0 if t is None else int(t[1])
+
+    def summary(self) -> dict:
+        """JSON-ready dump of everything recorded."""
+        return {
+            "timings_s": {
+                p: {"total": round(t[0], 6), "count": int(t[1])}
+                for p, t in sorted(self.timings.items())
+            },
+            "counters": dict(self.counters),
+            "values": dict(self.values),
+        }
+
+
+def enable(flag: bool = True) -> None:
+    """Process-wide default: every subsequent fit collects its own report
+    (equivalent to PINT_TPU_PERF=1)."""
+    global _env_enabled
+    _env_enabled = flag
+
+
+def enabled() -> bool:
+    """True when fits should collect telemetry (env/programmatic flag, or
+    a `collect()` scope is already open)."""
+    return _env_enabled or bool(_reports)
+
+
+def active() -> bool:
+    """True when at least one report is collecting right now."""
+    return bool(_reports)
+
+
+@contextmanager
+def collect():
+    """Open a collection scope: stages/counters inside record into the
+    yielded report (in every thread). Scopes nest — an inner `collect`
+    (e.g. a fit's own breakdown) records into the outer report too."""
+    rep = PerfReport()
+    _reports.append(rep)
+    try:
+        yield rep
+    finally:
+        _reports.remove(rep)
+
+
+class _NullStage:
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL = _NullStage()
+
+
+class _Stage:
+    __slots__ = ("name", "_t0")
+
+    def __init__(self, name: str):
+        self.name = name
+
+    def __enter__(self):
+        path = getattr(_tls, "path", None)
+        if path is None:
+            path = _tls.path = []
+        path.append(self.name)
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        dt = time.perf_counter() - self._t0
+        path = _tls.path
+        key = "/".join(path)
+        path.pop()
+        for rep in _reports:
+            t = rep.timings.get(key)
+            if t is None:
+                rep.timings[key] = [dt, 1]
+            else:
+                t[0] += dt
+                t[1] += 1
+        return False
+
+
+def stage(name: str):
+    """Timed, nestable stage. No-op (shared null object) when nothing is
+    collecting."""
+    if not _reports:
+        return _NULL
+    return _Stage(name)
+
+
+def add(name: str, value: float = 1.0) -> None:
+    """Accumulate a counter (transfers, bytes, trials, ...)."""
+    for rep in _reports:
+        rep.counters[name] = rep.counters.get(name, 0) + value
+
+
+def put(name: str, value) -> None:
+    """Latch a value (e.g. solve_path); last write wins."""
+    for rep in _reports:
+        rep.values[name] = value
+
+
+def put_default(name: str, value) -> None:
+    """Latch a value only where nothing latched it yet."""
+    for rep in _reports:
+        rep.values.setdefault(name, value)
+
+
+# --- the canonical fit breakdown -------------------------------------------------
+
+#: stage leaves summed into the named breakdown components; everything else
+#: under "fit" lands in fit_other_s
+_COMPONENTS = ("step", "chi2", "solve", "finalize")
+
+
+def fit_breakdown(rep: PerfReport) -> dict:
+    """Map a report collected around one fit into the canonical breakdown.
+
+    The contract (enforced by the CPU smoke bench, tests/test_perf.py):
+    ``fit_compile_s + fit_trace_s + fit_step_s + fit_chi2_s +
+    fit_solve_s + fit_finalize_s + fit_other_s == fit_wall_s`` up to
+    clock jitter, i.e. the breakdown accounts for the whole measured fit
+    wall time. `fit_compile_s` is XLA backend compilation only (what the
+    persistent cache eliminates on warm runs); `fit_trace_s` is the host
+    Python trace/lowering, which no disk cache can serve.
+    """
+    t = rep.timings
+    wall = rep.seconds("fit")
+
+    def total(leaf):
+        return sum(v[0] for p, v in t.items()
+                   if p.startswith("fit/") and p.split("/")[-1] == leaf)
+
+    def count(leaf):
+        return sum(int(v[1]) for p, v in t.items()
+                   if p.startswith("fit/") and p.split("/")[-1] == leaf)
+
+    compile_s = total("compile")
+    trace_s = total("trace")
+    comp = {leaf: total(leaf) for leaf in _COMPONENTS}
+    # trace/compile time nests INSIDE the component that triggered it
+    # (e.g. fit/step/compile): subtract it from that component so the
+    # named fields partition the wall time instead of double counting
+    nested = {
+        leaf: sum(v[0] for p, v in t.items()
+                  if p.split("/")[-1] in ("compile", "trace")
+                  and len(p.split("/")) > 2 and p.split("/")[-2] == leaf
+                  and p.startswith("fit/"))
+        for leaf in _COMPONENTS
+    }
+    step_s = comp["step"] - nested["step"]
+    chi2_s = comp["chi2"] - nested["chi2"]
+    solve_s = comp["solve"] - nested["solve"]
+    finalize_s = comp["finalize"] - nested["finalize"]
+    # directly-under-fit components account against the wall; deeper
+    # nestings (fit/step/host_transfer) are already inside their parent
+    top = sum(v[0] for p, v in t.items()
+              if len(p.split("/")) == 2 and p.startswith("fit/"))
+    other_s = max(wall - top, 0.0)
+
+    n_steps = count("step")
+    xfer_bytes = rep.counters.get("host_transfer_bytes", 0)
+    xfer_s = sum(v[0] for p, v in t.items()
+                 if p.split("/")[-1] == "host_transfer")
+    out = {
+        "fit_wall_s": round(wall, 4),
+        "fit_compile_s": round(compile_s, 4),
+        "fit_trace_s": round(trace_s, 4),
+        "fit_step_s": round(step_s, 4),
+        "n_step_calls": n_steps,
+        "per_iter_step_ms": round(step_s / n_steps * 1e3, 3) if n_steps else None,
+        "fit_chi2_s": round(chi2_s, 4),
+        "n_chi2_calls": count("chi2"),
+        "fit_solve_s": round(solve_s, 4),
+        "fit_finalize_s": round(finalize_s, 4),
+        "fit_other_s": round(other_s, 4),
+        "solve_path": rep.values.get("solve_path"),
+        "solve_path_reason": rep.values.get("solve_path_reason"),
+        "lm_iterations": int(rep.counters.get("lm_iterations", 0)),
+        "lm_trials": int(rep.counters.get("lm_trials", 0)),
+        "lm_rejects": int(rep.counters.get("lm_rejects", 0)),
+        "host_transfers": int(rep.counters.get("host_transfers", 0)),
+        "host_transfer_bytes": int(xfer_bytes),
+        "host_transfer_s": round(xfer_s, 4),
+        "host_transfer_MB_per_s": (
+            round(xfer_bytes / xfer_s / 1e6, 1) if xfer_s > 0 else None
+        ),
+        "factorizations": int(rep.counters.get("factorizations", 0)),
+    }
+    return out
+
+
+def instrument_fit(fit_method):
+    """Decorator for `fit_toas` implementations: when telemetry is enabled,
+    collect a per-fit report around the call and attach the canonical
+    breakdown to ``result.perf`` (and ``fitter.last_perf``). Pass-through
+    (one bool check) when disabled."""
+
+    @functools.wraps(fit_method)
+    def wrapper(self, *args, **kwargs):
+        if not enabled():
+            return fit_method(self, *args, **kwargs)
+        with collect() as rep:
+            with stage("fit"):
+                result = fit_method(self, *args, **kwargs)
+        breakdown = fit_breakdown(rep)
+        self.last_perf = breakdown
+        self.last_perf_report = rep
+        if result is not None:
+            result.perf = breakdown
+        return result
+
+    return wrapper

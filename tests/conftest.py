@@ -27,17 +27,24 @@ jax.config.update("jax_platforms", "cpu")
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
-from hypothesis import HealthCheck, settings  # noqa: E402
+try:  # hypothesis is optional: property tests skip cleanly without it
+    from hypothesis import HealthCheck, settings  # noqa: E402
+except ImportError:
+    settings = None
 
-# JIT compilation inside hypothesis examples is slow on first call; relax deadlines.
-settings.register_profile(
-    "default",
-    deadline=None,
-    suppress_health_check=[HealthCheck.too_slow],
-    max_examples=50,
-)
-settings.register_profile("ci", parent=settings.get_profile("default"), max_examples=200)
-settings.load_profile(os.environ.get("HYPOTHESIS_PROFILE", "default"))
+if settings is not None:
+    # JIT compilation inside hypothesis examples is slow on first call;
+    # relax deadlines.
+    settings.register_profile(
+        "default",
+        deadline=None,
+        suppress_health_check=[HealthCheck.too_slow],
+        max_examples=50,
+    )
+    settings.register_profile(
+        "ci", parent=settings.get_profile("default"), max_examples=200
+    )
+    settings.load_profile(os.environ.get("HYPOTHESIS_PROFILE", "default"))
 
 REFERENCE_DATA = "/root/reference/tests/datafile"
 

@@ -8,6 +8,9 @@ dd kernels against 80-bit longdouble ground truth, under hypothesis.
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
+
+pytest.importorskip("hypothesis")  # property tests need hypothesis; skip cleanly without
 from hypothesis import given
 from hypothesis import strategies as st
 

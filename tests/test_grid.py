@@ -92,7 +92,11 @@ class TestGridChisq:
         g_f0, g_f1 = _grids(fitted, n=4)
         a = grid_chisq(fitted, ("F0", "F1"), (g_f0, g_f1), maxiter=1)
         b = grid_chisq(fitted, ("F0", "F1"), (g_f0, g_f1), maxiter=1, batch=3)
-        np.testing.assert_allclose(a, b, rtol=1e-12)
+        # with XLA:CPU's fusion pass active (ops/compile.py: the per-program
+        # disable is retired on the current toolchain) different batch
+        # shapes vectorize reductions in different orders — measured 2e-8
+        # relative; anything near chi2 precision (1e-6) would be a real bug
+        np.testing.assert_allclose(a, b, rtol=1e-6)
 
 
 class TestGridSharded:

@@ -5,6 +5,8 @@ from fractions import Fraction
 
 import numpy as np
 import pytest
+
+pytest.importorskip("hypothesis")  # property tests need hypothesis; skip cleanly without
 from hypothesis import given
 from hypothesis import strategies as st
 

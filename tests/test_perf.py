@@ -1,0 +1,433 @@
+"""Fit-path telemetry contract (ops/perf.py + ops/compile.py).
+
+The perf layer exists so the bench can attribute the first-fit wall time
+(BENCH_r05's opaque 91 s "initial_fit_s"); these tests lock its contract:
+
+- the stage timer nests/aggregates correctly and is a no-op when disabled;
+- `adaptive_fused` reports its dispatch outcome (solve_path + latch reason);
+- the persistent XLA compilation cache round-trips (a re-compile of the
+  same program under the same cache dir after the in-memory caches are
+  dropped is served from disk, not recompiled);
+- host design-matrix residency: repeated LM re-solves against one
+  linearization perform exactly one host transfer + one factorization;
+- the CPU smoke bench's breakdown fields are present and account for
+  >= 90% of the measured fit wall time.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from pint_tpu.ops import perf
+
+
+@pytest.fixture(autouse=True)
+def _perf_off():
+    """Every test starts and ends with telemetry globally off."""
+    perf.enable(False)
+    yield
+    perf.enable(False)
+
+
+class TestStageTimer:
+    def test_nesting_aggregates_by_path(self):
+        with perf.collect() as rep:
+            with perf.stage("a"):
+                time.sleep(0.01)
+                with perf.stage("b"):
+                    time.sleep(0.01)
+                with perf.stage("b"):
+                    time.sleep(0.01)
+            with perf.stage("a"):
+                pass
+        assert rep.count("a") == 2
+        assert rep.count("a/b") == 2
+        assert rep.seconds("a") >= rep.seconds("a/b") >= 0.02
+        assert "b" not in rep.timings  # the nested stage records its PATH
+
+    def test_counters_and_values(self):
+        with perf.collect() as rep:
+            perf.add("n", 2)
+            perf.add("n", 3)
+            perf.put("mode", "x")
+            perf.put("mode", "y")
+            perf.put_default("mode", "z")
+        assert rep.counters["n"] == 5
+        assert rep.values["mode"] == "y"  # put wins over put_default
+
+    def test_collect_scopes_nest(self):
+        with perf.collect() as outer:
+            with perf.collect() as inner:
+                with perf.stage("s"):
+                    pass
+                perf.add("c")
+        assert outer.count("s") == inner.count("s") == 1
+        assert outer.counters["c"] == inner.counters["c"] == 1
+
+    def test_noop_when_disabled(self):
+        """Disabled telemetry must cost nothing and record nothing: the
+        stage factory returns one shared null object and counters don't
+        accumulate anywhere."""
+        assert not perf.active()
+        s1 = perf.stage("x")
+        s2 = perf.stage("y")
+        assert s1 is s2  # the shared null context manager
+        with s1:
+            perf.add("never", 1)
+            perf.put("never", "v")
+        with perf.collect() as rep:
+            pass  # nothing recorded before the scope opened
+        assert rep.timings == {} and rep.counters == {} and rep.values == {}
+
+    def test_summary_is_json_ready(self):
+        import json
+
+        with perf.collect() as rep:
+            with perf.stage("s"):
+                pass
+            perf.add("c", 1)
+        json.dumps(rep.summary())
+
+
+class TestAdaptiveFusedTelemetry:
+    def test_fused_path_reports(self):
+        from pint_tpu.ops.compile import adaptive_fused
+
+        call = adaptive_fused(lambda x: x + 1.0, lambda x: x + 2.0,
+                              lambda o: np.isfinite(o), "t", forced=False)
+        with perf.collect() as rep:
+            assert call(1.0) == 2.0
+        assert call.solve_path == "fused"
+        assert call.last_path == "fused"
+        assert call.latch_reason is None
+        assert rep.values["solve_path"] == "fused"
+
+    def test_host_latch_reports_reason(self):
+        from pint_tpu.ops.compile import adaptive_fused
+
+        calls = {"fused": 0}
+
+        def fused(x):
+            calls["fused"] += 1
+            return np.nan
+
+        call = adaptive_fused(fused, lambda x: 1.0,
+                              lambda o: np.isfinite(o), "t", forced=False)
+        with perf.collect() as rep:
+            assert call(0.0) == 1.0
+            assert call(0.0) == 1.0
+        assert calls["fused"] == 1  # sticky: the second call skips the probe
+        assert call.solve_path == "host"
+        assert call.latch_reason == "device_nonfinite_host_clean"
+        assert rep.values["solve_path"] == "host"
+        assert rep.values["solve_path_reason"] == "device_nonfinite_host_clean"
+
+    def test_forced_host_reports(self):
+        from pint_tpu.ops.compile import adaptive_fused
+
+        call = adaptive_fused(lambda x: x, lambda x: -1.0,
+                              lambda o: np.isfinite(o), "t", forced=True)
+        assert call(0.0) == -1.0
+        assert call.solve_path == "host"
+        assert call.latch_reason == "forced_host"
+
+
+class TestTimedProgram:
+    def test_precompile_then_call_matches_jit(self):
+        import jax
+        import jax.numpy as jnp
+
+        from pint_tpu.ops.compile import TimedProgram
+
+        jfn = jax.jit(lambda x: jnp.sin(x) * 2.0)
+        tp = TimedProgram(jfn, "tp_test")
+        x = jnp.linspace(0.0, 1.0, 16)
+        with perf.collect() as rep:
+            tp.precompile(x)
+            out = tp(x)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(jfn(x)))
+        assert rep.counters["compiled:tp_test"] == 1
+        assert rep.count("compile") == 1 and rep.count("trace") == 1
+        # a second precompile of the same signature is a no-op
+        with perf.collect() as rep2:
+            tp.precompile(x)
+        assert "compiled:tp_test" not in rep2.counters
+
+    def test_passthrough_when_disabled(self):
+        import jax
+        import jax.numpy as jnp
+
+        from pint_tpu.ops.compile import TimedProgram
+
+        jfn = jax.jit(lambda x: x + 1)
+        tp = TimedProgram(jfn, "tp_plain")
+        out = tp(jnp.ones(3))  # no collect scope, nothing precompiled
+        np.testing.assert_allclose(np.asarray(out), 2.0)
+        assert tp._exes == {}  # went straight through the jit path
+
+    def test_deepcopy_atomic(self):
+        import copy
+
+        import jax
+
+        from pint_tpu.ops.compile import TimedProgram
+
+        tp = TimedProgram(jax.jit(lambda x: x), "tp_copy")
+        assert copy.deepcopy(tp) is tp
+
+
+class TestPersistentCompileCache:
+    def _big_program(self):
+        import jax.numpy as jnp
+
+        def f(x):
+            for _ in range(40):
+                x = jnp.sin(x @ x) + jnp.cos(x)
+            return x
+
+        return f
+
+    def test_roundtrip_is_a_cache_hit(self, tmp_path, monkeypatch):
+        """Same program, same cache dir, fresh in-memory compile caches:
+        the recompile must be served from disk — no new cache entry is
+        written (a miss would add one) and the compile is much faster."""
+        import os
+
+        import jax
+        import jax.numpy as jnp
+
+        from pint_tpu.ops.compile import TimedProgram, setup_persistent_cache
+
+        monkeypatch.setenv("PINT_TPU_XLA_CACHE_DIR", str(tmp_path))
+        monkeypatch.setenv("PINT_TPU_XLA_CACHE", "1")
+        assert setup_persistent_cache(force=True) == str(tmp_path)
+        try:
+            x = jnp.ones((64, 64))
+            f = self._big_program()
+            with perf.collect() as cold_rep:
+                tp = TimedProgram(jax.jit(f), "cache_probe")
+                tp.precompile(x)
+            n_entries = len(os.listdir(tmp_path))
+            assert n_entries >= 1, "no persistent cache entry written"
+            cold_s = cold_rep.seconds("compile")
+
+            jax.clear_caches()  # drop the in-memory caches: simulate a fresh process
+            with perf.collect() as warm_rep:
+                tp2 = TimedProgram(jax.jit(f), "cache_probe2")
+                tp2.precompile(x)
+            warm_s = warm_rep.seconds("compile")
+            assert len(os.listdir(tmp_path)) == n_entries, (
+                "recompile wrote a new entry — the cache key missed"
+            )
+            # disk load vs real XLA compile; enormous margin in practice
+            # (measured ~20x), asserted loosely against CI timing noise
+            assert warm_s < cold_s, (cold_s, warm_s)
+        finally:
+            # restore the default cache config for the rest of the suite
+            monkeypatch.delenv("PINT_TPU_XLA_CACHE_DIR")
+            setup_persistent_cache(force=True)
+
+
+class TestHostResidency:
+    def _pieces(self, p=4, seed=0):
+        rng = np.random.default_rng(seed)
+        A = rng.normal(size=(3 * p, p))
+        mtcm = A.T @ A + np.eye(p)
+        mtcy = rng.normal(size=p)
+        norm = np.ones(p)
+        return mtcm, mtcy, norm
+
+    def test_one_factorization_per_linearization(self):
+        """The acceptance contract: repeated LM trials against one
+        linearization = exactly one host transfer + one factorization,
+        counter-verified."""
+        from pint_tpu.fitting.gls import _FactorSlot
+
+        p = 4
+        mtcm, mtcy, norm = self._pieces(p)
+        pieces = ("linearization-1",)  # identity token, as in run_lm
+        with perf.collect() as rep:
+            slot = _FactorSlot()
+            for lam in (0.0, 1e-8, 1e-7, 1e-6, 1e-5):
+                dx = slot.get(pieces, mtcm, mtcy, norm, p).solve(lam)
+                assert np.isfinite(dx).all()
+        assert rep.counters["factorizations"] == 1
+        assert rep.counters["host_transfers"] == 1
+
+        # a NEW linearization re-factors exactly once more
+        mtcm2, mtcy2, norm2 = self._pieces(p, seed=1)
+        pieces2 = ("linearization-2",)
+        with perf.collect() as rep2:
+            for lam in (0.0, 1e-8):
+                slot.get(pieces2, mtcm2, mtcy2, norm2, p).solve(lam)
+        assert rep2.counters["factorizations"] == 1
+        assert rep2.counters["host_transfers"] == 1
+
+    def test_factor_matches_direct_solve(self):
+        """The resident factor's undamped step/covariance must equal the
+        one-shot gls_solve surface (same spectral pseudo-inverse)."""
+        from pint_tpu.fitting.gls import GLSNormalFactor, gls_solve
+
+        p = 5
+        mtcm, mtcy, norm = self._pieces(p, seed=2)
+        f = GLSNormalFactor(mtcm, mtcy, norm, p)
+        dx, cov = gls_solve(mtcm, mtcy, norm, p)
+        np.testing.assert_allclose(f.solve(0.0), dx, rtol=1e-12)
+        np.testing.assert_allclose(f.cov(), cov, rtol=1e-12)
+        # reference solve for a well-conditioned system
+        np.testing.assert_allclose(dx, np.linalg.solve(mtcm, mtcy),
+                                   rtol=1e-9)
+        # damping shrinks the step monotonically toward zero
+        n0 = np.linalg.norm(f.solve(0.0))
+        n1 = np.linalg.norm(f.solve(1e-2))
+        n2 = np.linalg.norm(f.solve(1e2))
+        assert n0 >= n1 >= n2
+
+    def test_nonfinite_pieces_give_nan_step(self):
+        from pint_tpu.fitting.gls import GLSNormalFactor
+
+        p = 3
+        mtcm = np.full((p, p), np.nan)
+        f = GLSNormalFactor(mtcm, np.ones(p), np.ones(p), p)
+        assert not f.ok
+        assert np.isnan(f.solve(0.0)).all()
+        assert np.isnan(f.cov()).all()
+
+
+FAKE_PAR = """
+PSR PERF
+RAJ 04:37:15.9 1
+DECJ -47:15:09.1 1
+F0 173.6879489990983 1
+F1 -1.728e-15 1
+PEPOCH 55000
+POSEPOCH 55000
+DM 2.64 1
+TZRMJD 55000.1
+TZRSITE gbt
+TZRFRQ 1400
+"""
+
+
+@pytest.fixture(scope="module")
+def perf_model_and_toas():
+    from pint_tpu.io.par import parse_parfile
+    from pint_tpu.models.builder import build_model
+    from pint_tpu.simulation import make_fake_toas_uniform
+
+    m = build_model(parse_parfile(FAKE_PAR, from_text=True))
+    freqs = np.where(np.arange(50) % 2 == 0, 1400.0, 2300.0)
+    toas = make_fake_toas_uniform(
+        54500, 55500, 50, m, obs="gbt", freq_mhz=freqs, error_us=1.0,
+        add_noise=True, rng=np.random.default_rng(3),
+    )
+    return m, toas
+
+
+class TestInstrumentedFit:
+    def test_result_carries_breakdown(self, perf_model_and_toas):
+        import copy
+
+        from pint_tpu.fitting import DownhillWLSFitter
+
+        m, toas = perf_model_and_toas
+        perf.enable(True)
+        try:
+            res = DownhillWLSFitter(toas, copy.deepcopy(m)).fit_toas()
+        finally:
+            perf.enable(False)
+        bd = res.perf
+        assert bd is not None
+        for key in ("fit_wall_s", "fit_compile_s", "fit_trace_s",
+                    "fit_step_s", "per_iter_step_ms", "fit_chi2_s",
+                    "fit_other_s", "solve_path", "lm_iterations",
+                    "lm_trials", "host_transfers", "host_transfer_bytes"):
+            assert key in bd, key
+        assert bd["solve_path"] in ("fused", "host")
+        assert bd["n_step_calls"] == bd["lm_iterations"] >= 1
+        assert bd["lm_trials"] >= bd["lm_iterations"]
+        assert bd["per_iter_step_ms"] > 0
+
+    def test_no_breakdown_when_disabled(self, perf_model_and_toas):
+        import copy
+
+        from pint_tpu.fitting import DownhillWLSFitter
+
+        m, toas = perf_model_and_toas
+        res = DownhillWLSFitter(toas, copy.deepcopy(m)).fit_toas()
+        assert res.perf is None
+
+    def test_host_solve_counts_residency(self, perf_model_and_toas,
+                                         monkeypatch):
+        """Under the forced host-solve path every outer iteration performs
+        exactly one host transfer of the design pieces and one SVD
+        factorization — never one per LM trial."""
+        import copy
+
+        from pint_tpu.fitting import DownhillWLSFitter
+
+        monkeypatch.setenv("PINT_TPU_HOST_SOLVE", "1")
+        m, toas = perf_model_and_toas
+        perf.enable(True)
+        try:
+            res = DownhillWLSFitter(toas, copy.deepcopy(m)).fit_toas()
+        finally:
+            perf.enable(False)
+        bd = res.perf
+        assert bd["solve_path"] == "host"
+        assert bd["solve_path_reason"] == "forced_host"
+        assert bd["factorizations"] == bd["lm_iterations"]
+        # one design-piece transfer per iteration, plus at most one
+        # damped-re-solve residency transfer on iterations with rejects —
+        # never one per trial
+        assert (bd["lm_iterations"] <= bd["host_transfers"]
+                <= 2 * bd["lm_iterations"])
+        assert bd["host_transfer_bytes"] > 0
+
+    def test_precompile_removes_compile_from_fit(self, perf_model_and_toas):
+        """A precompiled fitter's first fit must spend ~nothing in the
+        compile stage — the overlap trick's whole point."""
+        import copy
+
+        from pint_tpu.fitting import DownhillWLSFitter
+
+        m, toas = perf_model_and_toas
+        ftr = DownhillWLSFitter(toas, copy.deepcopy(m))
+        th = ftr.precompile(background=True)
+        th.join(timeout=600)
+        assert not th.is_alive()
+        perf.enable(True)
+        try:
+            res = ftr.fit_toas()
+        finally:
+            perf.enable(False)
+        assert res.perf["fit_compile_s"] < 0.05
+        assert res.perf["fit_trace_s"] < 0.05
+
+
+class TestSmokeBench:
+    def test_smoke_bench_telemetry_contract(self):
+        """The tier-1 telemetry contract: the smoke bench's breakdown
+        fields exist and account for >= 90% of the measured fit wall."""
+        import bench
+
+        rec = bench.smoke_bench(ntoas=200, maxiter=3)
+        for key in ("fit_wall_s", "fit_compile_s", "fit_trace_s",
+                    "fit_step_s", "per_iter_step_ms", "fit_chi2_s",
+                    "fit_solve_s", "fit_finalize_s", "fit_other_s",
+                    "solve_path", "host_transfers", "host_transfer_bytes",
+                    "measured_wall_s"):
+            assert key in rec, key
+        named = (rec["fit_compile_s"] + rec["fit_trace_s"]
+                 + rec["fit_step_s"] + rec["fit_chi2_s"]
+                 + rec["fit_solve_s"] + rec["fit_finalize_s"])
+        assert named >= 0.9 * rec["fit_wall_s"], rec
+        # the breakdown partitions the wall: named + other == wall
+        assert named + rec["fit_other_s"] == pytest.approx(
+            rec["fit_wall_s"], rel=0.02, abs=0.02)
+        # and the instrumented wall tracks the externally measured wall
+        assert rec["fit_wall_s"] == pytest.approx(
+            rec["measured_wall_s"], rel=0.05, abs=0.05)
+        assert rec["solve_path"] in ("fused", "host")
+        assert rec["per_iter_step_ms"] > 0

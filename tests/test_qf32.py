@@ -5,6 +5,8 @@ plus dd64-vs-qf32 backend parity of the full phase function.
 
 import numpy as np
 import pytest
+
+pytest.importorskip("hypothesis")  # property tests need hypothesis; skip cleanly without
 from hypothesis import given, settings, strategies as st
 
 # each hypothesis example dispatches dozens of eager device ops; keep example

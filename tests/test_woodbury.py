@@ -118,8 +118,11 @@ def test_sharded_segments_match_single():
     the single-device value even when epochs straddle shard boundaries."""
     import jax
     from jax.sharding import Mesh, PartitionSpec as P
-    from jax import shard_map
     from functools import partial
+
+    from pint_tpu.gridutils import _shard_map
+
+    shard_map = _shard_map()
 
     basis, w, r = _mk(n=48, ke=5, kd=4, seed=13)
     chi2_single, _ = woodbury_chi2(basis, w, r)
