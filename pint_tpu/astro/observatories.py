@@ -223,7 +223,9 @@ def load_observatories_json(path: str) -> None:
         if code and code not in _registry:
             _registry[code] = obs
         n += 1
-    log.info(f"loaded {n} observatories from {path}")
+    from pint_tpu.utils.logging import log_once
+
+    log_once(log, f"loaded {n} observatories from {path}")
 
 
 def get_observatory(name: str) -> Observatory:

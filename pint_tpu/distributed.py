@@ -36,7 +36,7 @@ from pint_tpu.utils.logging import get_logger
 
 log = get_logger("pint_tpu.distributed")
 
-__all__ = ["initialize", "global_mesh", "process_info"]
+__all__ = ["initialize", "fit_mesh", "global_mesh", "process_info"]
 
 
 def _init_args(
@@ -186,6 +186,20 @@ def global_mesh(axes: dict[str, int] | None = None, devices=None):
         )
     shape = tuple(axes.values())
     return Mesh(devices.reshape(shape), tuple(axes.keys()))
+
+
+def fit_mesh(devices=None, axis: str = "toa"):
+    """Single-axis mesh over every (global) device for TOA-sharded
+    fitting — the layout `fit_toas()` shards its normal equations over
+    (fitting/sharded.py). Returns None with fewer than two devices, so
+    callers can pass the result straight to a fitter's `mesh=` argument
+    and get the identical single-device program on one chip."""
+    import jax
+
+    devs = list(devices if devices is not None else jax.devices())
+    if len(devs) < 2:
+        return None
+    return global_mesh({axis: -1}, devices=devs)
 
 
 def process_info() -> dict:

@@ -12,10 +12,14 @@ from pint_tpu.fitting.wideband import WidebandDownhillFitter  # noqa: F401
 from pint_tpu.fitting.mcmc import MCMCFitter  # noqa: F401
 
 
-def fit_auto(toas, model, downhill: bool = True):
+def fit_auto(toas, model, downhill: bool = True, mesh=None,
+             toa_axis: str = "toa", fused: bool | None = None):
     """Pick a fitter like the reference Fitter.auto (fitter.py:238):
     wideband when the TOAs carry -pp_dm DM measurements, else GLS when the
-    model carries correlated noise, else WLS."""
+    model carries correlated noise, else WLS. `mesh`/`toa_axis`/`fused`
+    pass through to the fitter (TOA-sharded fused fitting,
+    fitting/sharded.py); `mesh` implies the downhill (fused-capable)
+    variants."""
     if getattr(toas, "is_wideband", False):
         if not downhill:
             from pint_tpu.utils.logging import get_logger
@@ -23,9 +27,10 @@ def fit_auto(toas, model, downhill: bool = True):
             get_logger("pint_tpu.fitting").warning(
                 "wideband fitting is always Levenberg-Marquardt; downhill=False ignored"
             )
-        return WidebandDownhillFitter(toas, model)
+        return WidebandDownhillFitter(toas, model, mesh=mesh,
+                                      toa_axis=toa_axis, fused=fused)
     if model.has_correlated_errors:
         cls = DownhillGLSFitter if downhill else GLSFitter
     else:
         cls = DownhillWLSFitter if downhill else WLSFitter
-    return cls(toas, model)
+    return cls(toas, model, mesh=mesh, toa_axis=toa_axis, fused=fused)

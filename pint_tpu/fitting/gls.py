@@ -410,6 +410,8 @@ def full_cov_pieces(model, resids, r0, M, params=None):
 class GLSFitter(WLSFitter):
     """Iterated linear GLS (reference GLSFitter.fit_toas, fitter.py:2122)."""
 
+    _fused_kind = "gls"
+
     def _step_program(self, params):
         from pint_tpu.ops.compile import canonicalize_params
 
@@ -430,13 +432,9 @@ class GLSFitter(WLSFitter):
                 jnp.asarray(r.errors_s))
         return fn, args
 
-    def _programs(self):
-        return [self._step_program(self.model.params),
-                self._chi2_program(self.model.params)]
-
     def chi2_at(self, params: dict) -> float:
-        fn, args = self._chi2_program(params)
         with perf.stage("chi2"):
+            fn, args = self._chi2_program(params)
             return float(fn(*args))
 
     @perf.instrument_fit
@@ -499,7 +497,13 @@ class DownhillGLSFitter(GLSFitter):
     """Levenberg-Marquardt damped GLS (reference DownhillGLSFitter,
     fitter.py:1476): the damped normal-equation re-solve is a host-side
     Cholesky of the cached (p+k)x(p+k) system, so rejected steps cost no
-    design-matrix recomputation."""
+    design-matrix recomputation.
+
+    With a mesh (or `fused=True`) the loop runs fused on device with the
+    Woodbury inner products psum-reduced over the TOA axis
+    (fitting/sharded.py); the host loop remains the fallback."""
+
+    _fused_capable = True
 
     @perf.instrument_fit
     def fit_toas(self, maxiter: int = 30, required_chi2_decrease: float = 1e-2,
@@ -508,6 +512,19 @@ class DownhillGLSFitter(GLSFitter):
 
         if len(self._free) == 0:
             return self._frozen_fit_result()
+        if self._fused_on():
+            from pint_tpu.fitting.sharded import run_fused_fit
+
+            out = run_fused_fit(self, maxiter, required_chi2_decrease,
+                                max_rejects)
+            if out is not None:
+                self.noise_ampls = np.asarray(out.ahat)
+                # eigh returns ascending; _degenerate_params expects descending
+                return self._finalize_fit(out.params, out.chi2,
+                                          out.iterations, out.converged,
+                                          out.cov, s=out.s[::-1],
+                                          vt=out.vt[::-1])
+            self._fused = False  # sticky: the failure is structural
         params = self.model.xprec.convert_params(self.model.params)
         p = len(self._free)
         slot = _FactorSlot()  # one factorization per linearization

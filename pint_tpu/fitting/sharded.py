@@ -1,0 +1,705 @@
+"""TOA-axis SPMD fitting: the fused on-device Levenberg-Marquardt loop.
+
+The flagship bench's first `fit_toas()` ran the LM loop from host Python —
+one device round-trip per damping trial — on a single chip while the rest
+of the mesh sat idle (BENCH_r05: 91 s `initial_fit_s`; only gridutils.py
+was SPMD). This module makes the fit itself a sharded, fused device
+program:
+
+- **Fused LM loop.** One jitted program runs the whole downhill fit as a
+  `lax.while_loop`: linearization, spectral-damped normal-equation solve,
+  chi^2 accept/reject backtracking, and the convergence test all stay on
+  device. The host syncs ONCE per fit (to read back the final parameters,
+  covariance and loop counters) instead of once per trial, and the
+  (N, p) design matrix never leaves HBM.
+- **TOA-axis sharding.** The design-matrix rows, whitening and residuals
+  are partitioned over a named mesh axis (`distributed.global_mesh` /
+  `fit_mesh`); the normal equations ``J^T W J`` / ``J^T W r`` (WLS) and
+  the Woodbury inner products ``U^T N^-1 U`` / ``U^T N^-1 r`` (GLS/ECORR,
+  via the reduction hooks fitting/woodbury.py always had) complete with
+  one `psum`, leaving the small p x p eigensolve replicated. This is the
+  GP-basis normal-equation shape of van Haasteren & Vallisneri
+  (arxiv 1407.1838), the same shape Vela.jl exploits for its parallel
+  likelihood (arxiv 2412.15858).
+- **1-device fallback.** Without a mesh (or on a 1-device mesh) the same
+  program builds with identity reductions: no collective appears in the
+  jaxpr and the arithmetic is identical to the sharded run.
+
+Algebraic parity with the host-loop fitters: the WLS host path solves via
+SVD of the equilibrated whitened design A_n = U S V^T; here the p x p
+normal matrix G = A_n^T A_n is eigendecomposed instead (eigenvalues
+e = s^2, eigenvectors = V), so the undamped step V e^-1 V^T A_n^T b, the
+Levenberg step V (e + lam e_max)^-1 V^T A_n^T b, and the covariance
+V e^-1 V^T are term-for-term the host formulas (fitting/wls.py lm_step,
+fitting/gls.py GLSNormalFactor). The degenerate-direction floor is kept
+in singular-value units (SVD_THRESHOLD on sqrt(e)) for WLS and in
+eigenvalue units for GLS/wideband, matching each host path. Sharded vs
+single-chip results differ only by psum-vs-local reduction order
+(~1e-15 relative; asserted <= 1e-10 end to end in
+tests/test_fit_sharded.py and the driver's multichip dryrun).
+
+TZR anchoring under sharding reuses the gridutils recipe: the fiducial
+TZR row is replicated as the last local row of every shard, so each shard
+anchors its phases locally with no broadcast.
+
+On buffer residency: the (N, p) design matrix, whitened rows and every
+damping trial's parameter pytree live exclusively in the while_loop carry
+— nothing is re-materialized on host between iterations. Explicit
+``donate_argnums`` on the params operand is deliberately NOT used:
+``convert_params``/``canonicalize_params`` pass extended-precision leaves
+through by reference, so the operand can alias live ``model.params``
+buffers and donation would invalidate them under the caller.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from pint_tpu.fitting.design import linear_columns, linear_split
+from pint_tpu.fitting.wls import SVD_THRESHOLD, apply_delta
+from pint_tpu.fitting.woodbury import (
+    cat_ahat,
+    cinv_apply,
+    s_factor,
+    woodbury_chi2,
+)
+from pint_tpu.ops import perf
+from pint_tpu.residuals import phase_residual_frac
+from pint_tpu.utils.logging import get_logger
+
+log = get_logger("pint_tpu.fitting")
+
+Array = jnp.ndarray
+
+# the GLS/wideband normal-matrix ridge, identical to fitting/gls.py
+_RIDGE = 1e-12
+
+
+def _shard_map():
+    """jax.shard_map across jax versions: top-level since 0.6, under
+    jax.experimental before that (with `check_rep` instead of `check_vma`
+    — normalize to the keyword this module uses)."""
+    import functools
+    import inspect
+
+    fn = getattr(jax, "shard_map", None)
+    if fn is None:
+        from jax.experimental.shard_map import shard_map as fn
+    if "check_vma" not in inspect.signature(fn).parameters:
+        @functools.wraps(fn)
+        def compat(f, *args, check_vma=None, **kwargs):
+            if check_vma is not None:
+                kwargs["check_rep"] = check_vma
+            return fn(f, *args, **kwargs)
+
+        return compat
+    return fn
+
+
+def n_fit_shards(mesh, toa_axis: str = "toa") -> int:
+    """TOA shards a (possibly None) mesh provides along `toa_axis`."""
+    if mesh is None or toa_axis not in mesh.shape:
+        return 1
+    return int(mesh.shape[toa_axis])
+
+
+# --- host-side row layout ---------------------------------------------------------
+
+
+def shard_fit_rows(model, tensor, vecs: dict, n_shards: int,
+                   fills: dict | None = None):
+    """Re-lay the TOA axis of a tensor + row-aligned vectors into
+    `n_shards` equal blocks.
+
+    Each tensor block is [chunk data rows ..., (pad rows), TZR row?]; the
+    TZR fiducial is replicated per shard as its last local row so
+    `has_abs_phase` models anchor locally (gridutils docstring). Vector
+    pads take the per-name fill value (default 0.0) — callers choose
+    fills that make pad rows drop out of every reduction (e.g. inf sigma
+    -> zero weight).
+
+    Returns (tensor', vecs', row_keys): row_keys names the tensor leaves
+    that were sharded (row-indexed); everything else stays replicated.
+    """
+    fills = fills or {}
+    has_tzr = model.has_abs_phase
+    tensor = {k: np.asarray(v) for k, v in tensor.items()}
+    n_rows = tensor["t_hi"].shape[0]
+    n_data = n_rows - (1 if has_tzr else 0)
+    chunk = -(-n_data // n_shards)  # ceil
+
+    def lay_tensor(a):
+        tzr = a[-1:] if has_tzr else None
+        body = a[:n_data]
+        pad_row = body[-1:]  # any valid row; weights zero it out
+        blocks = []
+        for k in range(n_shards):
+            blk = body[k * chunk : (k + 1) * chunk]
+            n_pad = chunk - blk.shape[0]
+            parts = [blk]
+            if n_pad:
+                parts.append(np.repeat(pad_row, n_pad, axis=0))
+            if has_tzr:
+                parts.append(tzr)
+            blocks.append(np.concatenate(parts, axis=0))
+        return jnp.asarray(np.concatenate(blocks, axis=0))
+
+    def lay_vec(a, fill=0.0):
+        if a is None:
+            return None
+        a = np.asarray(a)
+        blocks = []
+        for k in range(n_shards):
+            blk = a[k * chunk : (k + 1) * chunk]
+            n_pad = chunk - blk.shape[0]
+            if n_pad:
+                blk = np.concatenate([blk, np.full((n_pad,), fill, a.dtype)])
+            blocks.append(blk)
+        return jnp.asarray(np.concatenate(blocks))
+
+    # non-row-indexed aux entries (noise_tspan, ecorr_widx, ...) stay
+    # replicated; only row-indexed leaves are re-laid into shards
+    row_keys = {k for k, v in tensor.items() if v.shape[:1] == (n_rows,)}
+    tensor_out = {
+        k: (lay_tensor(v) if k in row_keys else jnp.asarray(v))
+        for k, v in tensor.items()
+    }
+    vecs_out = {k: lay_vec(v, fills.get(k, 0.0)) for k, v in vecs.items()}
+    return tensor_out, vecs_out, row_keys
+
+
+def build_fit_data(fitter, kind: str, n_shards: int):
+    """(data dict, PartitionSpec tree) for one fitter's fused fit program.
+
+    `data` carries the tensor plus every per-TOA vector the fit consumes;
+    with n_shards > 1 the rows are re-laid by `shard_fit_rows` and the
+    spec tree marks which leaves ride the `toa` mesh axis. Pad-row fills
+    are chosen so pads vanish from every reduction (sigma -> inf, weights
+    and mask -> 0).
+    """
+    model = fitter.model
+    r = fitter.resids.toa if kind == "wideband" else fitter.resids
+    vecs = {
+        "track_pn": None if r._track_pn is None else np.asarray(r._track_pn),
+        "delta_pn": None if r._delta_pn is None else np.asarray(r._delta_pn),
+        "weights": np.asarray(r._weights),
+        "sigma": np.asarray(r.errors_s),
+        "mask": np.ones(len(r.errors_s)),
+    }
+    fills = {"sigma": np.inf}
+    if kind == "wideband":
+        vecs["sigma_dm"] = np.asarray(fitter.resids.dm_errors)
+        vecs["dm_data"] = np.asarray(fitter.resids.dm_data)
+        fills["sigma_dm"] = np.inf
+
+    if n_shards <= 1:
+        data = {"tensor": fitter.tensor}
+        data.update({
+            k: (None if v is None else jnp.asarray(v)) for k, v in vecs.items()
+        })
+        return data, None
+
+    tensor_out, vecs_out, row_keys = shard_fit_rows(
+        model, fitter.tensor, vecs, n_shards, fills)
+    data = {"tensor": tensor_out}
+    data.update(vecs_out)
+
+    from jax.sharding import PartitionSpec as P
+
+    axis = fitter.toa_axis
+    specs = {"tensor": {k: P(axis) if k in row_keys else P()
+                        for k in tensor_out}}
+    specs.update({k: (None if v is None else P(axis))
+                  for k, v in vecs_out.items()})
+    # align the spec tree with the data tree (None leaves have no spec)
+    specs = jax.tree_util.tree_unflatten(
+        jax.tree_util.tree_structure(data, is_leaf=lambda x: x is None),
+        jax.tree_util.tree_leaves(specs, is_leaf=lambda x: x is None),
+    )
+    return data, specs
+
+
+# --- reductions -------------------------------------------------------------------
+
+
+class _AxisReduce:
+    """Reduction helper completing TOA-axis reductions with a psum.
+
+    With `axis=None` every completion is the identity — the program
+    contains no collective. `psum_bytes` is the per-symbolic-pass
+    collective payload in bytes (tallied at trace time; each retrace
+    resets it), which the host wrapper scales by the loop counters into
+    the per-fit `psum_bytes` telemetry estimate.
+    """
+
+    def __init__(self, axis: str | None):
+        self.axis = axis
+        self.psum_bytes = 0
+
+    def begin(self):
+        # called at the top of each instrumented closure: runs once per
+        # trace, so the tally always reflects one symbolic pass
+        self.psum_bytes = 0
+
+    def psum(self, x):
+        if self.axis is None:
+            return x
+        self.psum_bytes += int(np.prod(x.shape)) * x.dtype.itemsize
+        return jax.lax.psum(x, self.axis)
+
+    def sum(self, x):
+        """Row-axis sum completed across shards."""
+        return self.psum(jnp.sum(x, axis=0))
+
+
+# --- per-kind linearization pieces ------------------------------------------------
+#
+# Each builder returns (pieces_fn, chi2_fn):
+#   pieces_fn(params, data) -> (G, c, norm, ahat)
+#       G    : (p, p) equilibrated normal matrix (replicated after psum)
+#       c    : (p,) right-hand side in normalized units
+#       norm : (p,) column equilibration (step/cov unscale)
+#       ahat : (k,) ML correlated-noise coefficients (empty for WLS)
+#   chi2_fn(params, data) -> scalar fit statistic (the accept/reject test)
+# mirroring fitting/wls.py, fitting/gls.py and fitting/wideband.py
+# term for term, with every TOA-axis reduction completed through `red`.
+
+
+def _wls_fns(model, free, subtract_mean: bool, red: _AxisReduce):
+    nonlin, lin_names, owners = linear_split(model, free)
+    mean_free = subtract_mean and not model.has_phase_offset
+    sl = slice(None, -1) if model.has_abs_phase else slice(None)
+    p = len(free)
+
+    def time_resids_f(params, data):
+        _, r, f = phase_residual_frac(
+            model, params, data["tensor"],
+            track_pn=data["track_pn"], delta_pn=data["delta_pn"],
+            subtract_mean=False,
+        )
+        if mean_free:
+            w = data["weights"]
+            r = r - red.sum(w * r) / red.sum(w)
+        return r / f, f
+
+    def design(params, data):
+        def rfun(delta):
+            return time_resids_f(apply_delta(params, nonlin, delta), data)
+
+        z = jnp.zeros(len(nonlin))
+        (r0, f0), jvp = jax.linearize(rfun, z)
+        cols = {}
+        if nonlin:
+            M_nl = jax.vmap(jvp)(jnp.eye(len(nonlin)))[0].T
+            for i, n in enumerate(nonlin):
+                cols[n] = M_nl[:, i]
+        if lin_names:
+            M_l = linear_columns(model, params, data["tensor"], f0, sl,
+                                 lin_names, owners)
+            if mean_free:
+                w = data["weights"]
+                M_l = M_l - red.sum(w[:, None] * M_l) / red.sum(w)
+            for i, n in enumerate(lin_names):
+                cols[n] = M_l[:, i]
+        M = jnp.stack([cols[n] for n in free], axis=1)  # (N_local, p)
+        return r0, M
+
+    def pieces(params, data):
+        red.begin()
+        r0, M = design(params, data)
+        w = 1.0 / data["sigma"]  # pad rows: 1/inf -> 0
+        A = M * w[:, None]
+        b = -r0 * w
+        norm = jnp.sqrt(red.sum(A * A))
+        norm = jnp.where(norm == 0, 1.0, norm)
+        An = A / norm
+        G = red.psum(An.T @ An)
+        c = red.psum(An.T @ b)
+        return G, c, norm, jnp.zeros(0)
+
+    def chi2(params, data):
+        red.begin()
+        rt, _ = time_resids_f(params, data)
+        w = 1.0 / data["sigma"]
+        return red.sum((rt * w) ** 2)
+
+    return pieces, chi2
+
+
+def _gls_fns(model, free, subtract_mean: bool, red: _AxisReduce):
+    mean_free = subtract_mean and not model.has_phase_offset
+    p = len(free)
+
+    def time_resids(params, data):
+        _, r, f = phase_residual_frac(
+            model, params, data["tensor"],
+            track_pn=data["track_pn"], delta_pn=data["delta_pn"],
+            subtract_mean=False,
+        )
+        if mean_free:
+            w = data["weights"]
+            r = r - red.sum(w * r) / red.sum(w)
+        return r / f
+
+    def design(params, data):
+        def rfun(delta):
+            return time_resids(apply_delta(params, free, delta), data)
+
+        z = jnp.zeros(p)
+        r0, lin = jax.linearize(rfun, z)
+        M = jax.vmap(lin)(jnp.eye(p)).T  # (N_local, p), one primal evaluation
+        return r0, M
+
+    def pieces(params, data):
+        red.begin()
+        r0, M = design(params, data)
+        cinv = 1.0 / data["sigma"] ** 2  # pad rows -> 0
+        basis = model.noise_basis_and_weights(params, data["tensor"])
+        # pad rows duplicate real design rows: mask them out of the
+        # (unweighted) equilibration norm — everything else carries a
+        # cinv/weight factor that is already zero on pads
+        norm = jnp.sqrt(red.sum(data["mask"][:, None] * M * M))
+        norm = jnp.where(norm == 0, 1.0, norm)
+        Mn = M / norm
+        sf = s_factor(basis, cinv, reduce=red.psum) if basis is not None else None
+        CinvM = cinv_apply(basis, cinv, Mn, sf, reduce=red.psum)
+        mtcm = red.psum(Mn.T @ CinvM) + _RIDGE * jnp.eye(p)
+        mtcy = red.psum(CinvM.T @ (-r0))
+        _, (ze, zd) = woodbury_chi2(basis, cinv, r0, sf=sf, reduce=red.psum)
+        return mtcm, mtcy, norm, cat_ahat(ze, zd)
+
+    def chi2(params, data):
+        red.begin()
+        r = time_resids(params, data)
+        cinv = 1.0 / data["sigma"] ** 2
+        basis = model.noise_basis_and_weights(params, data["tensor"])
+        out, _ = woodbury_chi2(basis, cinv, r, reduce=red.psum)
+        return out
+
+    return pieces, chi2
+
+
+def _wb_fns(model, free, subtract_mean: bool, red: _AxisReduce):
+    from pint_tpu.fitting.wideband import _noise_basis_aug
+
+    mean_free = subtract_mean and not model.has_phase_offset
+    p = len(free)
+
+    def wres(params, data, free_names, delta, sw_t, sw_dm):
+        pp = apply_delta(params, free_names, delta)
+        _, r, f = phase_residual_frac(
+            model, pp, data["tensor"],
+            track_pn=data["track_pn"], delta_pn=data["delta_pn"],
+            subtract_mean=False,
+        )
+        if mean_free:
+            w = data["weights"]
+            r = r - red.sum(w * r) / red.sum(w)
+        rt = (r / f) * sw_t
+        rdm = (model.total_dm(pp, data["tensor"]) - data["dm_data"]) * sw_dm
+        return jnp.concatenate([rt, rdm])
+
+    def pieces(params, data):
+        red.begin()
+        sw_t = 1.0 / data["sigma"]
+        sw_dm = jnp.where(jnp.isfinite(data["sigma_dm"]),
+                          1.0 / data["sigma_dm"], 0.0)
+
+        def rfun(delta):
+            return wres(params, data, free, delta, sw_t, sw_dm)
+
+        r0, lin = jax.linearize(rfun, jnp.zeros(p))
+        A = jax.vmap(lin)(jnp.eye(p)).T  # (N_t + N_dm local, p), pre-whitened
+        basis = _noise_basis_aug(model, params, data["tensor"], sw_t,
+                                 sw_dm.shape[0])
+        norm = jnp.sqrt(red.sum(A * A))  # pad rows are exactly zero
+        norm = jnp.where(norm == 0, 1.0, norm)
+        An = A / norm
+        ones = jnp.ones_like(r0)
+        sf = s_factor(basis, ones, reduce=red.psum) if basis is not None else None
+        CinvA = cinv_apply(basis, ones, An, sf, reduce=red.psum)
+        mtcm = red.psum(An.T @ CinvA) + _RIDGE * jnp.eye(p)
+        mtcy = red.psum(CinvA.T @ (-r0))
+        _, (ze, zd) = woodbury_chi2(basis, ones, r0, sf=sf, reduce=red.psum)
+        return mtcm, mtcy, norm, cat_ahat(ze, zd)
+
+    def chi2(params, data):
+        red.begin()
+        sw_t = 1.0 / data["sigma"]
+        sw_dm = jnp.where(jnp.isfinite(data["sigma_dm"]),
+                          1.0 / data["sigma_dm"], 0.0)
+        r0 = wres(params, data, (), jnp.zeros(0), sw_t, sw_dm)
+        basis = _noise_basis_aug(model, params, data["tensor"], sw_t,
+                                 sw_dm.shape[0])
+        out, _ = woodbury_chi2(basis, jnp.ones_like(r0), r0, reduce=red.psum)
+        return out
+
+    return pieces, chi2
+
+
+_KIND_FNS = {"wls": _wls_fns, "gls": _gls_fns, "wideband": _wb_fns}
+# degenerate-direction floor on the eigenvalues e = sigma^2 of the
+# equilibrated normal matrix: WLS keeps the host path's singular-value
+# threshold (sigma > 1e-14 sigma_max <=> e > 1e-28 e_max), GLS/wideband
+# keep GLSNormalFactor's eigenvalue threshold
+_EIG_FLOOR = {"wls": SVD_THRESHOLD**2, "gls": 1e-14, "wideband": 1e-14}
+
+
+def _tree_select(pred, a, b):
+    return jax.tree_util.tree_map(lambda x, y: jnp.where(pred, x, y), a, b)
+
+
+def _lm_driver(free, pieces_fn, chi2_fn, eig_floor: float):
+    """The fused downhill loop: run_lm's exact semantics (fitting/wls.py)
+    as a pure device function.
+
+    Damping restarts from zero each outer iteration; the lam schedule is
+    0, 1e-8, x10...; a trial is accepted when its chi^2 is finite and
+    <= the best; convergence is declared when a fresh linearization fails
+    to accept or gains < required_gain. Returns
+    (params, chi2, iters, converged, cov, s, vt, ahat, trials, rejects)
+    with s the ascending eigenvalues of the LAST linearization's normal
+    matrix and cov its undamped spectral pseudo-inverse covariance.
+    """
+    p = len(free)
+
+    def solve(s, V, c, norm, lam):
+        smax = s[-1]
+        good = s > eig_floor * smax
+        sinv = jnp.where(good, 1.0 / jnp.where(good, s + lam * smax, 1.0), 0.0)
+        return (V @ (sinv * (V.T @ c))) / norm
+
+    def fit(params, data, maxiter, required_gain, max_rejects):
+        chi2_0 = jnp.asarray(chi2_fn(params, data), jnp.float64)
+        # carry shapes for the correlated-noise coefficient vector come
+        # from an abstract pass (no FLOPs, trace-time only)
+        ahat_aval = jax.eval_shape(pieces_fn, params, data)[3]
+        st0 = dict(
+            params=params,
+            chi2=chi2_0,
+            it=jnp.asarray(0, jnp.int32),
+            converged=jnp.asarray(False),
+            trials=jnp.asarray(0, jnp.int32),
+            rejects=jnp.asarray(0, jnp.int32),
+            s=jnp.zeros(p),
+            V=jnp.eye(p),
+            norm=jnp.ones(p),
+            ahat=jnp.zeros(ahat_aval.shape, ahat_aval.dtype),
+        )
+
+        def outer_cond(st):
+            return (st["it"] < maxiter) & (~st["converged"])
+
+        def outer_body(st):
+            G, c, norm, ahat = pieces_fn(st["params"], data)
+            s, V = jnp.linalg.eigh((G + G.T) / 2.0)
+
+            t0 = dict(
+                k=jnp.asarray(0, jnp.int32),
+                lam=jnp.asarray(0.0, jnp.float64),
+                accepted=jnp.asarray(False),
+                params=st["params"],
+                chi2=st["chi2"],
+                gain=jnp.asarray(0.0, jnp.float64),
+            )
+
+            def inner_cond(t):
+                return (t["k"] < max_rejects) & (~t["accepted"])
+
+            def inner_body(t):
+                dx = solve(s, V, c, norm, t["lam"])
+                trial = apply_delta(st["params"], free, dx,
+                                    project_domain=True)
+                chi2_t = jnp.asarray(chi2_fn(trial, data), jnp.float64)
+                ok = jnp.isfinite(chi2_t) & (chi2_t <= st["chi2"])
+                return dict(
+                    k=t["k"] + 1,
+                    lam=jnp.where(t["lam"] == 0.0, 1e-8, t["lam"] * 10.0),
+                    accepted=ok,
+                    params=_tree_select(ok, trial, t["params"]),
+                    chi2=jnp.where(ok, chi2_t, t["chi2"]),
+                    gain=jnp.where(ok, st["chi2"] - chi2_t, 0.0),
+                )
+
+            t = jax.lax.while_loop(inner_cond, inner_body, t0)
+            converged = (~t["accepted"]) | (t["gain"] < required_gain)
+            return dict(
+                params=t["params"],
+                chi2=t["chi2"],
+                it=st["it"] + 1,
+                converged=converged,
+                trials=st["trials"] + t["k"],
+                rejects=st["rejects"] + t["k"] - t["accepted"].astype(jnp.int32),
+                s=s,
+                V=V,
+                norm=norm,
+                ahat=ahat,
+            )
+
+        st = jax.lax.while_loop(outer_cond, outer_body, st0)
+        # undamped covariance from the final linearization's spectrum —
+        # PSD by construction, exactly GLSNormalFactor.cov / the WLS
+        # (Vt.T * s_inv**2) @ Vt form
+        s, V, norm = st["s"], st["V"], st["norm"]
+        good = s > eig_floor * s[-1]
+        sinv = jnp.where(good, 1.0 / jnp.where(good, s, 1.0), 0.0)
+        cov = ((V * sinv) @ V.T) / jnp.outer(norm, norm)
+        return (st["params"], st["chi2"], st["it"], st["converged"], cov,
+                s, V.T, st["ahat"], st["trials"], st["rejects"])
+
+    return fit
+
+
+class _FusedEntry(NamedTuple):
+    prog: object  # TimedProgram over the (possibly shard_mapped) fit fn
+    red_pieces: _AxisReduce
+    red_chi2: _AxisReduce
+    n_shards: int
+
+
+def get_fused_fit_fn(model, kind: str, free, subtract_mean: bool,
+                     mesh, toa_axis: str, data, specs) -> _FusedEntry:
+    """Compiled-program cache entry for one fused fit shape.
+
+    Keyed on (kind, free set, xprec, mesh layout, data structure); the
+    program is a TimedProgram so AOT precompile / the persistent XLA cache
+    and the fit-breakdown compile split all apply (ops/compile.py).
+    """
+    cache = model.__dict__.setdefault("_fused_fit_cache", {})
+    n_shards = n_fit_shards(mesh, toa_axis)
+    axis = toa_axis if n_shards > 1 else None
+    mesh_key = None
+    if axis is not None:
+        # device IDS, not Device objects: the key must survive
+        # copy.deepcopy(model) (Devices are not picklable)
+        mesh_key = (tuple(d.id for d in mesh.devices.flat),
+                    tuple(sorted(mesh.shape.items())), toa_axis)
+    key = (kind, tuple(free), subtract_mean, model.xprec.name, mesh_key,
+           str(jax.tree_util.tree_structure(data, is_leaf=lambda x: x is None)))
+    if key in cache:
+        return cache[key]
+
+    red_p = _AxisReduce(axis)
+    red_c = _AxisReduce(axis)
+    builder = _KIND_FNS[kind]
+    pieces_fn, _ = builder(model, free, subtract_mean, red_p)
+    _, chi2_fn = builder(model, free, subtract_mean, red_c)
+    fit = _lm_driver(free, pieces_fn, chi2_fn, _EIG_FLOOR[kind])
+
+    if axis is not None:
+        from jax.sharding import PartitionSpec as P
+
+        fit = _shard_map()(
+            fit,
+            mesh=mesh,
+            in_specs=(P(), specs, P(), P(), P()),
+            out_specs=P(),
+            check_vma=False,
+        )
+
+    from pint_tpu.ops.compile import TimedProgram, precision_jit
+
+    entry = _FusedEntry(
+        prog=TimedProgram(precision_jit(fit), f"fused_{kind}_fit"),
+        red_pieces=red_p,
+        red_chi2=red_c,
+        n_shards=n_shards,
+    )
+    cache[key] = entry
+    return entry
+
+
+class FusedFitResult(NamedTuple):
+    params: dict
+    chi2: float
+    iterations: int
+    converged: bool
+    cov: np.ndarray
+    s: np.ndarray      # ascending eigenvalues of the final normal matrix
+    vt: np.ndarray     # matching eigenvector rows
+    ahat: np.ndarray   # ML correlated-noise coefficients (empty for WLS)
+
+
+def fused_fit_program(fitter):
+    """(program, args) pair for `precompile` — the same construction the
+    live fused fit uses, so the AOT signature always matches."""
+    from pint_tpu.ops.compile import canonicalize_params
+
+    data, specs = fitter._fused_data()
+    entry = get_fused_fit_fn(
+        fitter.model, fitter._fused_kind, fitter._free,
+        _subtract_mean_of(fitter), fitter.mesh, fitter.toa_axis, data, specs,
+    )
+    params = canonicalize_params(
+        fitter.model.xprec.convert_params(fitter.model.params))
+    args = (params, data, np.int32(30), np.float64(1e-2), np.int32(16))
+    return entry.prog, args
+
+
+def _subtract_mean_of(fitter):
+    r = fitter.resids
+    return r.toa.subtract_mean if fitter._fused_kind == "wideband" else r.subtract_mean
+
+
+def run_fused_fit(fitter, maxiter: int, required_gain: float,
+                  max_rejects: int) -> FusedFitResult | None:
+    """Run one fused (optionally TOA-sharded) LM fit; one host sync.
+
+    Returns None when the device program produced non-finite results
+    (e.g. emulated-f64 eigensolve underflow on an ill-conditioned normal
+    matrix) — the caller then falls back to the host LM loop, mirroring
+    the adaptive_fused strategy of the per-step programs.
+    """
+    from pint_tpu.ops.compile import canonicalize_params
+
+    model = fitter.model
+    kind = fitter._fused_kind
+    data, specs = fitter._fused_data()
+    entry = get_fused_fit_fn(model, kind, fitter._free,
+                             _subtract_mean_of(fitter), fitter.mesh,
+                             fitter.toa_axis, data, specs)
+    with perf.stage("step"):
+        params = canonicalize_params(model.xprec.convert_params(model.params))
+        out = entry.prog(params, data, np.int32(maxiter),
+                         np.float64(required_gain), np.int32(max_rejects))
+    (params_out, chi2, it, converged, cov, s, vt, ahat, trials, rejects) = out
+    chi2 = float(chi2)
+    it, trials, rejects = int(it), int(trials), int(rejects)
+    converged = bool(converged)
+    cov = np.asarray(cov)
+    if not (np.isfinite(chi2) and np.isfinite(cov).all()):
+        # telemetry deliberately NOT latched: the host loop that runs next
+        # reports its own solve_path/counters, plus this marker
+        perf.put("solve_path_reason", "fused_nonfinite_fallback")
+        log.warning(
+            f"fused {kind} fit returned non-finite results "
+            "(device eigensolve underflow?); falling back to the host LM loop"
+        )
+        return None
+    perf.add("lm_iterations", it)
+    perf.add("lm_trials", trials)
+    perf.add("lm_rejects", rejects)
+    # total device while_loop bodies executed (outer linearizations +
+    # inner damping trials): the work the host loop used to dispatch
+    # one round-trip at a time
+    perf.add("while_loop_iters", it + trials)
+    perf.put("fit_shards", entry.n_shards)
+    perf.add("psum_bytes", entry.red_pieces.psum_bytes * it
+             + entry.red_chi2.psum_bytes * (trials + 1))
+    perf.put("solve_path", "fused_loop")
+    perf.put("solve_path_reason",
+             "sharded" if entry.n_shards > 1 else "single_device")
+    if not converged:
+        log.warning(f"fused {kind} fit hit maxiter={maxiter}")
+    # pull the fitted parameters off the mesh: leaves committed to a
+    # NamedSharding would poison every later single-device program that
+    # consumes model.params (e.g. the grid scans' AOT executables)
+    params_out = jax.device_get(params_out)
+    return FusedFitResult(
+        params=params_out, chi2=chi2, iterations=it, converged=converged,
+        cov=cov, s=np.asarray(s), vt=np.asarray(vt), ahat=np.asarray(ahat),
+    )

@@ -250,15 +250,26 @@ def get_wb_chi2_fn(model, subtract_mean: bool):
 
 class WidebandDownhillFitter(WLSFitter):
     """Levenberg-Marquardt wideband fitter (reference WidebandDownhillFitter,
-    fitter.py:1536 semantics on the combined TOA+DM system)."""
+    fitter.py:1536 semantics on the combined TOA+DM system). Accepts the
+    same `mesh`/`toa_axis`/`fused` knobs as the base class: the combined
+    [TOA; DM] rows shard together over the TOA axis (row i of the DM
+    block pairs with TOA i), fitting/sharded.py."""
 
-    def __init__(self, toas, model, residuals=None):
+    _fused_capable = True
+    _fused_kind = "wideband"
+
+    def __init__(self, toas, model, residuals=None,
+                 mesh=None, toa_axis: str = "toa", fused: bool | None = None):
         self.toas = toas
         self.model = model
         self.resids = residuals or WidebandTOAResiduals(toas, model)
         self.tensor = self.resids.tensor
         self._free = tuple(model.free_params)
         self.result: FitResult | None = None
+        self.mesh = mesh
+        self.toa_axis = toa_axis
+        self._fused = fused
+        self._fused_cache = None
         from pint_tpu.models.base import leaf_to_f64
 
         self._prefit_values = {
@@ -297,10 +308,6 @@ class WidebandDownhillFitter(WLSFitter):
         fn = get_wb_chi2_fn(self.model, self.resids.toa.subtract_mean)
         return fn, self._args(params)
 
-    def _programs(self):
-        return [self._step_program(self.model.params),
-                self._chi2_program(self.model.params)]
-
     @perf.instrument_fit
     def fit_toas(self, maxiter: int = 30, required_chi2_decrease: float = 1e-2,
                  max_rejects: int = 16) -> FitResult:
@@ -308,6 +315,17 @@ class WidebandDownhillFitter(WLSFitter):
 
         if len(self._free) == 0:
             return self._frozen_fit_result()
+        if self._fused_on():
+            from pint_tpu.fitting.sharded import run_fused_fit
+
+            out = run_fused_fit(self, maxiter, required_chi2_decrease,
+                                max_rejects)
+            if out is not None:
+                self.noise_ampls = np.asarray(out.ahat)
+                return self._finalize_fit(out.params, out.chi2,
+                                          out.iterations, out.converged,
+                                          out.cov)
+            self._fused = False  # sticky: the failure is structural
         params = self.model.xprec.convert_params(self.model.params)
         p = len(self._free)
         slot = _FactorSlot()  # one factorization per linearization

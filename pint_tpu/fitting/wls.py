@@ -316,8 +316,12 @@ def run_lm(params, chi2_best, compute_pieces, solve, chi2_of, apply_step,
         for _ in range(max_rejects):
             perf.add("lm_trials")
             with perf.stage("solve"):
+                # the damped re-solve AND the trial-step application (eager
+                # extended-precision parameter updates) — both are "produce
+                # the trial point" work, and the eager dd/qf dispatches are
+                # the measurable part on small precompiled fits
                 dx = solve(pieces, lam)
-            trial = apply_step(params, dx)
+                trial = apply_step(params, dx)
             chi2_trial = chi2_of(trial)
             if np.isfinite(chi2_trial) and chi2_trial <= chi2_best:
                 gain = chi2_best - chi2_trial
@@ -376,15 +380,32 @@ def lm_step(s, vt, utb, norm, lam: float):
 
 
 class WLSFitter:
-    """Iterated linear WLS (Gauss-Newton without damping)."""
+    """Iterated linear WLS (Gauss-Newton without damping).
 
-    def __init__(self, toas, model: TimingModel, residuals: Residuals | None = None):
+    `mesh`/`toa_axis` give the downhill subclasses a TOA-sharded, fused
+    on-device LM loop (fitting/sharded.py): design rows, whitening and
+    residuals partition over the mesh's `toa_axis`, the normal equations
+    reduce with one psum, and the whole damped loop runs as a single
+    device program with one host sync per fit. `fused` forces the fused
+    program on (True) or off (False); the default (None) engages it when
+    a mesh is given or PINT_TPU_FUSED_FIT=1.
+    """
+
+    _fused_kind = "wls"
+    _fused_capable = False  # downhill subclasses flip this on
+
+    def __init__(self, toas, model: TimingModel, residuals: Residuals | None = None,
+                 mesh=None, toa_axis: str = "toa", fused: bool | None = None):
         self.toas = toas
         self.model = model
         self.resids = residuals or Residuals(toas, model)
         self.tensor = self.resids.tensor
         self._free = tuple(model.free_params)
         self.result: FitResult | None = None
+        self.mesh = mesh
+        self.toa_axis = toa_axis
+        self._fused = fused
+        self._fused_cache = None  # (data, specs) row layout, built once
         # prefit snapshot for get_summary (reference Fitter keeps model_init)
         from pint_tpu.models.base import leaf_to_f64
 
@@ -392,6 +413,23 @@ class WLSFitter:
             n: float(np.asarray(leaf_to_f64(model.params[n]))) for n in self._free
         }
         self._prefit_wrms = self.resids.rms_weighted()
+
+    def _fused_on(self) -> bool:
+        import os
+
+        if self._fused is not None:
+            return self._fused
+        if self.mesh is not None:
+            return True
+        return os.environ.get("PINT_TPU_FUSED_FIT", "0") == "1"
+
+    def _fused_data(self):
+        if self._fused_cache is None:
+            from pint_tpu.fitting.sharded import build_fit_data, n_fit_shards
+
+            self._fused_cache = build_fit_data(
+                self, self._fused_kind, n_fit_shards(self.mesh, self.toa_axis))
+        return self._fused_cache
 
     def _step_program(self, params):
         """(step callable, argument tuple) — the one place the step
@@ -407,8 +445,11 @@ class WLSFitter:
         return fn, args
 
     def _step_fn(self, params, tensor):
-        fn, args = self._step_program(params)
+        # program construction (xprec conversion, canonicalization, arg
+        # assembly) is part of the step cost: keep it inside the stage so
+        # the breakdown attribution stays honest on precompiled fits
         with perf.stage("step"):
+            fn, args = self._step_program(params)
             out = fn(*args)
         perf.put_default("solve_path",
                          getattr(fn, "solve_path", "fused"))
@@ -443,16 +484,39 @@ class WLSFitter:
         work()
         return None
 
-    def _programs(self):
-        """The (callable, args) pairs `precompile` warms."""
-        return [self._step_program(self.model.params)]
-
-    def chi2_at(self, params: dict) -> float:
+    def _chi2_program(self, params):
+        """(residual program, argument tuple) behind `chi2_at` — ONE
+        canonicalized construction shared by the live fit path and
+        `precompile`, so the AOT executable warmed in the background is
+        the executable the fit actually calls (the r5 flagship overlap
+        missed because the chi^2/residual program was never warmed)."""
         from pint_tpu.ops.compile import canonicalize_params
 
+        r = self.resids
+        params = canonicalize_params(self.model.xprec.convert_params(params))
+        return r._jitted, (params, self.tensor, r._track_pn, r._delta_pn,
+                           r._weights)
+
+    def _programs(self):
+        """The (callable, args) pairs `precompile` warms. With the fused
+        fit engaged the fused program comes first: it is the one the next
+        `fit_toas` blocks on."""
+        progs = []
+        if self._fused_capable and self._fused_on():
+            from pint_tpu.fitting.sharded import fused_fit_program
+
+            try:
+                progs.append(fused_fit_program(self))
+            except Exception as e:  # noqa: BLE001 — warmup is best-effort
+                log.warning(f"fused fit program assembly failed: {e}")
+        progs.append(self._step_program(self.model.params))
+        progs.append(self._chi2_program(self.model.params))
+        return progs
+
+    def chi2_at(self, params: dict) -> float:
         with perf.stage("chi2"):
-            _, _, rt = self.resids._phase_fn(
-                canonicalize_params(params), self.tensor)
+            fn, args = self._chi2_program(params)
+            _, _, rt = fn(*args)
             r = np.asarray(rt)
             return float(np.sum((r / self.resids.errors_s) ** 2))
 
@@ -653,13 +717,33 @@ class DownhillWLSFitter(WLSFitter):
     fitter.py:1145-1274, upgraded from step-halving to LM: the damped SVD
     re-solve is free on the host, so ill-conditioned directions — e.g.
     near-degenerate DMX columns excited by a far-from-optimum start — are
-    suppressed instead of exploding the trial step)."""
+    suppressed instead of exploding the trial step).
+
+    With a mesh (or `fused=True`) the whole loop runs as one fused —
+    optionally TOA-sharded — device program (fitting/sharded.py); the
+    host LM loop below remains the fallback when the device program
+    comes back non-finite."""
+
+    _fused_capable = True
 
     @perf.instrument_fit
     def fit_toas(self, maxiter: int = 30, required_chi2_decrease: float = 1e-2,
                  max_rejects: int = 16) -> FitResult:
         if len(self._free) == 0:
             return self._frozen_fit_result()
+        if self._fused_on():
+            from pint_tpu.fitting.sharded import run_fused_fit
+
+            out = run_fused_fit(self, maxiter, required_chi2_decrease,
+                                max_rejects)
+            if out is not None:
+                # fused eigenvalues are sigma^2 of the whitened design:
+                # report singular values (descending) like the host path
+                s = np.sqrt(np.maximum(out.s[::-1], 0.0))
+                return self._finalize_fit(out.params, out.chi2,
+                                          out.iterations, out.converged,
+                                          out.cov, s=s, vt=out.vt[::-1])
+            self._fused = False  # sticky: the failure is structural
         params = self.model.xprec.convert_params(self.model.params)
         slot = HostPieceSlot()  # SVD pieces move to the host once per iteration
 

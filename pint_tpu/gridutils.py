@@ -189,57 +189,18 @@ def _shard_data_host(model, data, n_shards):
     Each block is [chunk data rows ..., (pad rows), TZR row?]; pad rows get
     w = 0 so they drop out of every reduction. Returns
     (data', specs') where specs' marks each leaf sharded (True) or
-    replicated (False).
+    replicated (False). The row layout itself is shared with the fused
+    sharded fitters (fitting/sharded.py shard_fit_rows).
     """
-    has_tzr = model.has_abs_phase
-    tensor = {k: np.asarray(v) for k, v in data["tensor"].items()}
-    n_rows = tensor["t_hi"].shape[0]
-    n_data = n_rows - (1 if has_tzr else 0)
-    chunk = -(-n_data // n_shards)  # ceil
+    from pint_tpu.fitting.sharded import shard_fit_rows
 
-    def lay_tensor(a):
-        tzr = a[-1:] if has_tzr else None
-        body = a[:n_data]
-        pad_row = body[-1:]  # any valid row; weights zero it out
-        blocks = []
-        for k in range(n_shards):
-            blk = body[k * chunk : (k + 1) * chunk]
-            n_pad = chunk - blk.shape[0]
-            parts = [blk]
-            if n_pad:
-                parts.append(np.repeat(pad_row, n_pad, axis=0))
-            if has_tzr:
-                parts.append(tzr)
-            blocks.append(np.concatenate(parts, axis=0))
-        return jnp.asarray(np.concatenate(blocks, axis=0))
-
-    def lay_vec(a, fill=0.0):
-        if a is None:
-            return None
-        a = np.asarray(a)
-        blocks = []
-        for k in range(n_shards):
-            blk = a[k * chunk : (k + 1) * chunk]
-            n_pad = chunk - blk.shape[0]
-            if n_pad:
-                blk = np.concatenate([blk, np.full((n_pad,), fill, a.dtype)])
-            blocks.append(blk)
-        return jnp.asarray(np.concatenate(blocks))
-
-    # non-row-indexed aux entries (noise_tspan, ecorr_widx, ...) stay
-    # replicated; only row-indexed leaves are re-laid into shards
-    row_keys = {k for k, v in tensor.items() if v.shape[:1] == (n_rows,)}
-    out = {
-        "tensor": {
-            k: (lay_tensor(v) if k in row_keys else jnp.asarray(v))
-            for k, v in tensor.items()
-        },
-        "w": lay_vec(data["w"]),
-        "track_pn": lay_vec(data["track_pn"]),
-        "delta_pn": lay_vec(data["delta_pn"]),
-    }
+    vecs = {"w": data["w"], "track_pn": data["track_pn"],
+            "delta_pn": data["delta_pn"]}
+    tensor_out, vecs_out, row_keys = shard_fit_rows(
+        model, data["tensor"], vecs, n_shards)
+    out = {"tensor": tensor_out, **vecs_out}
     sharded = {
-        "tensor": {k: k in row_keys for k in tensor},
+        "tensor": {k: k in row_keys for k in tensor_out},
         "w": True,
         "track_pn": None if data["track_pn"] is None else True,
         "delta_pn": None if data["delta_pn"] is None else True,
@@ -458,24 +419,11 @@ def precompile_grid(fitter, parnames, parvalues, maxiter: int = 1,
 
 
 def _shard_map():
-    """jax.shard_map across jax versions: top-level since 0.6, under
-    jax.experimental before that (with `check_rep` instead of `check_vma`
-    — normalize to the keyword this module uses)."""
-    import functools
-    import inspect
+    """jax.shard_map across jax versions (shared helper,
+    fitting/sharded.py)."""
+    from pint_tpu.fitting.sharded import _shard_map as fn
 
-    fn = getattr(jax, "shard_map", None)
-    if fn is None:
-        from jax.experimental.shard_map import shard_map as fn
-    if "check_vma" not in inspect.signature(fn).parameters:
-        @functools.wraps(fn)
-        def compat(f, *args, check_vma=None, **kwargs):
-            if check_vma is not None:
-                kwargs["check_rep"] = check_vma
-            return fn(f, *args, **kwargs)
-
-        return compat
-    return fn
+    return fn()
 
 
 def _grid_sharded(model, parnames, free, subtract_mean, maxiter, mesh,

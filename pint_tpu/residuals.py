@@ -81,9 +81,13 @@ def get_resid_fn(model: TimingModel, subtract_mean: bool):
             )
             return pn, r, r / f
 
-        from pint_tpu.ops.compile import precision_jit
+        from pint_tpu.ops.compile import TimedProgram, precision_jit
 
-        cache[key] = precision_jit(fn)
+        # TimedProgram so the fitters' precompile can warm the residual
+        # program too: the downhill loops call it once per damping trial,
+        # and on the flagship it was the compile the background overlap
+        # never covered (the r5 91 s first-fit wall)
+        cache[key] = TimedProgram(precision_jit(fn), "resid")
     return cache[key]
 
 
@@ -154,7 +158,13 @@ class Residuals:
         return pn, r, r / f
 
     def _phase_fn(self, params, tensor):
-        params = self.model.xprec.convert_params(params)
+        from pint_tpu.ops.compile import canonicalize_params
+
+        # canonicalize so EVERY caller (construction with raw parfile
+        # params, fit loops with apply_delta'd params) shares one
+        # abstract signature — without this the residual program compiled
+        # once for weak-float leaves and again for strong f64 arrays
+        params = canonicalize_params(self.model.xprec.convert_params(params))
         return self._jitted(params, tensor, self._track_pn, self._delta_pn, self._weights)
 
     # --- cached views ------------------------------------------------------------

@@ -597,7 +597,11 @@ def prepare_arrays(
         include_bipm=include_bipm,
         bipm_version=bipm_version,
     )
-    log.info("prepared TOAs: " + toas.summary())
+    # identical re-preparations of the same set (zero_residuals passes,
+    # per-shard re-init in the multichip dryrun) log exactly once
+    from pint_tpu.utils.logging import log_once
+
+    log_once(log, "prepared TOAs: " + toas.summary())
     return toas
 
 

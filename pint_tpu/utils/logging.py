@@ -14,17 +14,26 @@ _FORMAT = "%(asctime)s %(levelname)-7s %(name)s: %(message)s"
 _configured = False
 
 
+# dedup state survives handler re-initialization: repeated setup() calls
+# (multi-device dryruns re-point the backend and re-init logging; notebook
+# reloads) must NOT reset the suppression counts, or every re-init earns
+# the chatty messages another max_repeats round
+_dedup_counts: dict[str, int] = {}
+
+
 class DedupFilter(logging.Filter):
     """Suppress exact-duplicate log records after the first N occurrences.
 
     Mirrors the behavior of the reference's LogFilter (pint/logging.py:125):
-    chatty per-TOA warnings collapse to a single line.
+    chatty per-TOA warnings collapse to a single line. The counts are
+    process-global (shared by every filter instance), so a re-created
+    handler keeps suppressing what the old one suppressed.
     """
 
     def __init__(self, max_repeats: int = 3):
         super().__init__()
         self.max_repeats = max_repeats
-        self._counts: dict[str, int] = {}
+        self._counts = _dedup_counts
 
     def filter(self, record: logging.LogRecord) -> bool:  # noqa: A003
         key = f"{record.name}:{record.levelno}:{record.getMessage()}"
@@ -63,3 +72,21 @@ def get_logger(name: str) -> logging.Logger:
     if not _configured:
         setup()
     return logging.getLogger(name)
+
+
+_once_keys: set[str] = set()
+
+
+def log_once(logger: logging.Logger, msg: str, level: int = logging.INFO) -> None:
+    """Emit `msg` at most once per process (keyed on logger+level+message).
+
+    Tighter than the DedupFilter (which allows max_repeats before
+    latching): routine per-preparation summaries — "prepared TOAs",
+    observatory loads — repeat identically every time the same data set
+    is re-prepared (zero_residuals passes, per-shard re-init in the
+    multichip dryrun), and one line carries all the information."""
+    key = f"{logger.name}:{level}:{msg}"
+    if key in _once_keys:
+        return
+    _once_keys.add(key)
+    logger.log(level, msg)

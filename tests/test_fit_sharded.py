@@ -1,0 +1,299 @@
+"""Sharded fused-fit parity (fitting/sharded.py).
+
+The conftest forces an 8-device virtual CPU mesh
+(--xla_force_host_platform_device_count=8), so the TOA-sharded fused LM
+program runs its real psum collectives here. The contract locked:
+
+- WLS, GLS/ECORR and wideband downhill fits over a `toa` mesh match the
+  single-chip host-loop fits to <= 1e-10 relative in parameters AND
+  uncertainties (the models are chosen well-conditioned — cond(normal
+  matrix) ~1e4 — so eps * cond sits far below the bar and the assertion
+  measures the sharding, not the conditioning);
+- without a mesh the fused program is the identical computation with no
+  collective in its jaxpr (1-device fallback);
+- the fused path reports its telemetry (fit_shards, while_loop_iters,
+  psum_bytes, solve_path=fused_loop) and the host row layout drops pad
+  rows from every reduction.
+"""
+
+import copy
+
+import numpy as np
+import pytest
+
+import jax
+
+import pint_tpu.distributed as dist
+from pint_tpu.fitting import (
+    DownhillGLSFitter,
+    DownhillWLSFitter,
+    WidebandDownhillFitter,
+)
+from pint_tpu.fitting.wls import apply_delta
+from pint_tpu.io.par import parse_parfile
+from pint_tpu.models.base import leaf_to_f64
+from pint_tpu.models.builder import build_model
+from pint_tpu.ops import perf
+from pint_tpu.simulation import make_fake_toas_fromMJDs, make_fake_toas_uniform
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 2, reason="needs the multi-device virtual mesh"
+)
+
+PARITY = 1e-10
+
+WLS_PAR = """
+PSR SHARD
+RAJ 04:37:15.9 1
+DECJ -47:15:09.1 1
+F0 173.6879489990983 1
+F1 -1.728e-15 1
+PEPOCH 55000
+POSEPOCH 55000
+DM 2.64 1
+TZRMJD 55000.1
+TZRSITE gbt
+TZRFRQ 1400
+"""
+
+GLS_PAR = """
+PSR SHARDGLS
+RAJ 07:40:45.79 1
+DECJ 66:20:33.6 1
+F0 346.531996493 1
+F1 -1.46389e-15 1
+PEPOCH 57000
+POSEPOCH 57000
+DM 14.96 1
+EFAC -f sim 1.1
+ECORR -f sim 0.5
+TZRMJD 57000.1
+TZRSITE gbt
+TZRFRQ 1400
+"""
+
+WB_PAR = """
+PSR SHARDWB
+RAJ 08:00:00 1
+DECJ 30:00:00 1
+F0 250.1 1
+F1 -1e-15 1
+PEPOCH 55500
+POSEPOCH 55500
+DM 20.0 1
+DMEPOCH 55500
+DMJUMP -fe 430 0.0
+TZRMJD 55500.1
+TZRSITE gbt
+TZRFRQ 1400
+"""
+
+
+def _fit_pair(cls, toas, model0, mesh, maxiter=10, **shard_kwargs):
+    """(legacy fit, sharded/fused fit) from the same prefit model."""
+    f_ref = cls(toas, copy.deepcopy(model0))
+    r_ref = f_ref.fit_toas(maxiter=maxiter)
+    f_new = cls(toas, copy.deepcopy(model0), mesh=mesh, **shard_kwargs)
+    r_new = f_new.fit_toas(maxiter=maxiter)
+    return (f_ref, r_ref), (f_new, r_new)
+
+
+def _assert_parity(f_ref, r_ref, f_new, r_new, bar=PARITY):
+    free = f_ref._free
+    p_ref = np.array([
+        float(np.asarray(leaf_to_f64(f_ref.model.params[n]))) for n in free
+    ])
+    p_new = np.array([
+        float(np.asarray(leaf_to_f64(f_new.model.params[n]))) for n in free
+    ])
+    rel_p = np.max(np.abs(p_new - p_ref) / np.maximum(np.abs(p_ref), 1e-300))
+    assert rel_p <= bar, f"parameter parity {rel_p:.3e} > {bar}"
+    u_ref = np.array([r_ref.uncertainties[n] for n in free])
+    u_new = np.array([r_new.uncertainties[n] for n in free])
+    rel_u = np.max(np.abs(u_new - u_ref) / np.maximum(np.abs(u_ref), 1e-300))
+    assert rel_u <= bar, f"uncertainty parity {rel_u:.3e} > {bar}"
+    assert r_new.converged == r_ref.converged
+    assert abs(r_new.chi2 - r_ref.chi2) <= 1e-8 * max(abs(r_ref.chi2), 1.0)
+
+
+@pytest.fixture(scope="module")
+def toa_mesh():
+    mesh = dist.fit_mesh()
+    assert mesh is not None and mesh.shape["toa"] == len(jax.devices())
+    return mesh
+
+
+@pytest.fixture(scope="module")
+def wls_case():
+    model = build_model(parse_parfile(WLS_PAR, from_text=True))
+    n = 150  # not divisible by 8: exercises the pad rows
+    freqs = np.where(np.arange(n) % 2 == 0, 1400.0, 2300.0)
+    toas = make_fake_toas_uniform(
+        54500, 55500, n, model, obs="gbt", freq_mhz=freqs, error_us=1.0,
+        add_noise=True, rng=np.random.default_rng(11),
+    )
+    # start off-minimum so the LM loop iterates (and can reject trials)
+    free = tuple(model.free_params)
+    delta = np.array([2e-10 if nm == "F0" else 0.0 for nm in free])
+    model.params = apply_delta(model.params, free, delta)
+    return toas, model
+
+
+@pytest.fixture(scope="module")
+def gls_case():
+    model = build_model(parse_parfile(GLS_PAR, from_text=True))
+    n_ep = 21  # 42 TOAs: simultaneous pairs bind the ECORR epochs
+    mjds = np.repeat(np.linspace(56600, 57400, n_ep), 2)
+    mjds[1::2] += 0.5 / 86400.0
+    freqs = np.where(np.arange(len(mjds)) % 2 == 0, 1400.0, 800.0)
+    flags = [{"f": "sim"} for _ in mjds]
+    toas = make_fake_toas_fromMJDs(
+        np.sort(mjds), model, obs="gbt", freq_mhz=freqs, error_us=1.0,
+        flags=flags, add_noise=True, rng=np.random.default_rng(1),
+    )
+    return toas, model
+
+
+@pytest.fixture(scope="module")
+def wb_case():
+    model = build_model(parse_parfile(WB_PAR, from_text=True))
+    rng = np.random.default_rng(2)
+    n = 60
+    freqs = np.where(np.arange(n) % 2 == 0, 430.0, 1400.0)
+    toas = make_fake_toas_uniform(
+        55000, 56000, n, model, freq_mhz=freqs, error_us=1.0)
+    for i, f in enumerate(toas.flags):
+        fe = "430" if freqs[i] < 1000 else "L"
+        f["fe"] = fe
+        dm = 20.0 + rng.standard_normal() * 1e-4
+        if fe == "430":
+            dm -= 0.003
+        f["pp_dm"] = f"{dm:.10f}"
+        f["pp_dme"] = "0.000100"
+    return toas, model
+
+
+class TestShardedParity:
+    def test_wls(self, wls_case, toa_mesh):
+        toas, model = wls_case
+        (f_ref, r_ref), (f_new, r_new) = _fit_pair(
+            DownhillWLSFitter, toas, model, toa_mesh)
+        _assert_parity(f_ref, r_ref, f_new, r_new)
+
+    def test_gls_ecorr(self, gls_case, toa_mesh):
+        toas, model = gls_case
+        (f_ref, r_ref), (f_new, r_new) = _fit_pair(
+            DownhillGLSFitter, toas, model, toa_mesh)
+        _assert_parity(f_ref, r_ref, f_new, r_new)
+        # the ML correlated-noise coefficients ride the same psums
+        np.testing.assert_allclose(
+            f_new.noise_ampls, f_ref.noise_ampls, rtol=1e-10, atol=1e-300)
+
+    def test_wideband(self, wb_case, toa_mesh):
+        toas, model = wb_case
+        (f_ref, r_ref), (f_new, r_new) = _fit_pair(
+            WidebandDownhillFitter, toas, model, toa_mesh)
+        _assert_parity(f_ref, r_ref, f_new, r_new)
+
+
+class TestSingleDeviceFallback:
+    def test_fused_no_mesh_matches_legacy(self, wls_case):
+        """fused=True without a mesh: identical results through the fused
+        while_loop program, no collective anywhere."""
+        toas, model = wls_case
+        (f_ref, r_ref), (f_new, r_new) = _fit_pair(
+            DownhillWLSFitter, toas, model, None, fused=True)
+        _assert_parity(f_ref, r_ref, f_new, r_new)
+
+    def test_no_psum_in_jaxpr(self, gls_case):
+        from pint_tpu.fitting.sharded import get_fused_fit_fn
+        from pint_tpu.ops.compile import canonicalize_params
+
+        toas, model = gls_case
+        ftr = DownhillGLSFitter(toas, copy.deepcopy(model), fused=True)
+        data, specs = ftr._fused_data()
+        entry = get_fused_fit_fn(
+            ftr.model, "gls", ftr._free, ftr.resids.subtract_mean,
+            None, "toa", data, specs)
+        params = canonicalize_params(
+            ftr.model.xprec.convert_params(ftr.model.params))
+        jaxpr = jax.make_jaxpr(lambda *a: entry.prog.jfn(*a))(
+            params, data, np.int32(5), np.float64(1e-2), np.int32(16))
+        assert "psum" not in str(jaxpr)
+
+    def test_one_device_mesh_is_unsharded(self, wls_case):
+        """A 1-device mesh normalizes to the unsharded fused program."""
+        from pint_tpu.fitting.sharded import n_fit_shards
+
+        mesh1 = dist.global_mesh({"toa": 1, "grid": -1})
+        assert n_fit_shards(mesh1, "toa") == 1
+
+
+class TestFusedTelemetry:
+    def test_breakdown_counters(self, wls_case, toa_mesh):
+        toas, model = wls_case
+        ftr = DownhillWLSFitter(toas, copy.deepcopy(model), mesh=toa_mesh)
+        perf.enable(True)
+        try:
+            res = ftr.fit_toas(maxiter=10)
+        finally:
+            perf.enable(False)
+        bd = res.perf
+        assert bd["fit_shards"] == len(jax.devices())
+        assert bd["solve_path"] == "fused_loop"
+        assert bd["solve_path_reason"] == "sharded"
+        assert bd["lm_iterations"] >= 1
+        assert bd["while_loop_iters"] >= 2 * bd["lm_iterations"]  # + trials
+        assert bd["psum_bytes"] > 0
+        assert bd["n_step_calls"] == 1  # ONE device program call per fit
+        assert bd["host_transfers"] == 0  # no per-trial operand shipping
+        assert bd["per_iter_step_ms"] > 0
+
+    def test_single_device_reason(self, wls_case):
+        toas, model = wls_case
+        ftr = DownhillWLSFitter(toas, copy.deepcopy(model), fused=True)
+        perf.enable(True)
+        try:
+            res = ftr.fit_toas(maxiter=5)
+        finally:
+            perf.enable(False)
+        assert res.perf["fit_shards"] == 1
+        assert res.perf["solve_path_reason"] == "single_device"
+        assert res.perf["psum_bytes"] == 0
+
+
+class TestRowLayout:
+    def test_shard_fit_rows_roundtrip(self, gls_case):
+        """Pad rows carry zero weight/mask and the data rows reassemble to
+        the original order; the TZR fiducial is replicated per shard."""
+        from pint_tpu.fitting.sharded import shard_fit_rows
+        from pint_tpu.residuals import Residuals
+
+        toas, model = gls_case
+        model = copy.deepcopy(model)
+        res = Residuals(toas, model)
+        n = len(res.errors_s)
+        n_shards = 8
+        vecs = {
+            "sigma": np.asarray(res.errors_s),
+            "mask": np.ones(n),
+        }
+        tensor_out, vecs_out, row_keys = shard_fit_rows(
+            model, res.tensor, vecs, n_shards, fills={"sigma": np.inf})
+        chunk = -(-n // n_shards)
+        sig = np.asarray(vecs_out["sigma"]).reshape(n_shards, chunk)
+        msk = np.asarray(vecs_out["mask"]).reshape(n_shards, chunk)
+        # concatenating the unpadded rows restores the original vector
+        np.testing.assert_array_equal(
+            np.concatenate([sig[k][: min(chunk, max(0, n - k * chunk))]
+                            for k in range(n_shards)]),
+            np.asarray(res.errors_s))
+        # pad rows: infinite sigma (zero weight) and zero mask
+        assert np.all(np.isinf(sig[msk == 0]))
+        assert int(msk.sum()) == n
+        # TZR fiducial replicated as the last local row of every shard
+        assert model.has_abs_phase
+        t_hi = np.asarray(tensor_out["t_hi"]).reshape(n_shards, chunk + 1)
+        tzr = np.asarray(res.tensor["t_hi"])[-1]
+        np.testing.assert_array_equal(t_hi[:, -1], np.full(n_shards, tzr))
+        assert "t_hi" in row_keys

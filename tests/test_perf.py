@@ -409,7 +409,13 @@ class TestInstrumentedFit:
 class TestSmokeBench:
     def test_smoke_bench_telemetry_contract(self):
         """The tier-1 telemetry contract: the smoke bench's breakdown
-        fields exist and account for >= 90% of the measured fit wall."""
+        fields exist, account for >= 90% of the measured fit wall, and —
+        since the smoke bench precompiles first — the precompile overlap
+        must have ENGAGED (the r5 flagship recorded
+        fit_plus_compile_overlap_s == initial_fit_s because the warmed
+        AOT signature never matched the fit's; overlap_engaged is the
+        field that makes that failure visible and this assertion is the
+        latch that keeps it fixed)."""
         import bench
 
         rec = bench.smoke_bench(ntoas=200, maxiter=3)
@@ -417,12 +423,15 @@ class TestSmokeBench:
                     "fit_step_s", "per_iter_step_ms", "fit_chi2_s",
                     "fit_solve_s", "fit_finalize_s", "fit_other_s",
                     "solve_path", "host_transfers", "host_transfer_bytes",
-                    "measured_wall_s"):
+                    "measured_wall_s", "overlap_engaged"):
             assert key in rec, key
         named = (rec["fit_compile_s"] + rec["fit_trace_s"]
                  + rec["fit_step_s"] + rec["fit_chi2_s"]
                  + rec["fit_solve_s"] + rec["fit_finalize_s"])
-        assert named >= 0.9 * rec["fit_wall_s"], rec
+        # >= 90% attribution, with a 10 ms absolute allowance: the
+        # precompiled smoke fit completes in tens of ms, where one GC
+        # pause between stages would otherwise flip the ratio
+        assert named >= 0.9 * rec["fit_wall_s"] - 0.01, rec
         # the breakdown partitions the wall: named + other == wall
         assert named + rec["fit_other_s"] == pytest.approx(
             rec["fit_wall_s"], rel=0.02, abs=0.02)
@@ -431,3 +440,33 @@ class TestSmokeBench:
             rec["measured_wall_s"], rel=0.05, abs=0.05)
         assert rec["solve_path"] in ("fused", "host")
         assert rec["per_iter_step_ms"] > 0
+        # precompiled fit: every program AOT-warmed, nothing compiled or
+        # silently recompiled inside the fit
+        assert rec["overlap_engaged"] is True, rec
+        assert rec["aot_hits"] >= 1 and rec["aot_fallbacks"] == 0
+        assert rec["fit_compile_s"] < 0.05 and rec["fit_trace_s"] < 0.05
+
+    def test_sharded_smoke_contract(self):
+        """The forced-8-device sharded smoke fit (bench.py --smoke
+        --sharded runs the same entry): overlap engaged, solve path
+        recorded as the fused while_loop, shards/psum/loop telemetry
+        present, and the breakdown still attributes >= 90% of the wall."""
+        import jax
+
+        import bench
+
+        if len(jax.devices()) < 2:
+            pytest.skip("needs the multi-device virtual mesh")
+        rec = bench.smoke_bench(ntoas=200, maxiter=3, sharded=True)
+        assert rec["fit_shards"] == len(jax.devices())
+        assert rec["solve_path"] == "fused_loop"
+        assert rec["solve_path_reason"] == "sharded"
+        assert rec["overlap_engaged"] is True, rec
+        assert rec["while_loop_iters"] >= 2  # >= 1 linearization + 1 trial
+        assert rec["psum_bytes"] > 0
+        assert rec["n_step_calls"] == 1  # the whole LM loop is ONE program
+        assert rec["host_transfers"] == 0
+        named = (rec["fit_compile_s"] + rec["fit_trace_s"]
+                 + rec["fit_step_s"] + rec["fit_chi2_s"]
+                 + rec["fit_solve_s"] + rec["fit_finalize_s"])
+        assert named >= 0.9 * rec["fit_wall_s"] - 0.01, rec
