@@ -629,6 +629,10 @@ def main() -> None:
         "while_loop_iters": fitperf.get("while_loop_iters"),
         "psum_bytes": fitperf.get("psum_bytes"),
         "overlap_engaged": fitperf.get("overlap_engaged"),
+        # compile-time jaxpr-audit ledger (pint_tpu/analysis/): program
+        # count, pass count, any invariant violations — an audit
+        # regression is a bench diff, not a buried warning
+        "audit": fitperf.get("audit"),
         "fit_breakdown": fitperf,
         # the fit-step program compiled in a worker thread while the
         # TOA-load/GLS benches ran: this is the hidden (overlapped) cost

@@ -1,0 +1,44 @@
+"""Static analysis of pint_tpu's compiled programs and source.
+
+Two instruments, both zero-third-party-dependency:
+
+- :mod:`pint_tpu.analysis.jaxpr_audit` — a pluggable-pass auditor that
+  runs over every :class:`~pint_tpu.ops.compile.TimedProgram` at
+  lower/compile time (the hook lives in ``TimedProgram._compile``) and
+  checks the JAX invariants the last two PRs each re-discovered the hard
+  way: weak-type signature leaks, f64→f32 precision demotion, large
+  host constants baked into the jaxpr, collective placement vs the bound
+  mesh, host syncs inside the fused ``lax.while_loop`` fit program, and
+  the per-program retrace budget. Results aggregate into the ``audit``
+  block of ``FitResult.perf`` / the bench headline; ``PINT_TPU_AUDIT``
+  selects ``warn`` (default), ``strict`` (raise at compile time) or
+  ``0`` (off).
+- :mod:`pint_tpu.analysis.lint` — an AST lint
+  (``python -m pint_tpu.analysis.lint``) enforcing source-level JAX
+  idioms across ``pint_tpu/``: no ``np.*`` on traced values in jitted
+  code paths, no Python ``if`` on tracers, no ``float()``/``.item()``
+  host syncs inside fused-loop bodies, and no raw ``os.environ`` reads
+  outside the sanctioned knob registry (:mod:`pint_tpu.utils.knobs`).
+
+See docs/ANALYSIS.md for the executable walkthrough.
+"""
+
+from pint_tpu.analysis.jaxpr_audit import (  # noqa: F401
+    AuditError,
+    Violation,
+    audit_block,
+    audit_jitted,
+    audit_mode,
+    audit_program,
+    reset_ledger,
+)
+
+__all__ = [
+    "AuditError",
+    "Violation",
+    "audit_block",
+    "audit_jitted",
+    "audit_mode",
+    "audit_program",
+    "reset_ledger",
+]

@@ -1,0 +1,419 @@
+"""Jaxpr auditor: mechanical compile-time checks of JAX invariants.
+
+Each of the last two PRs shipped a fix for a *silently violated* invariant
+that only surfaced as an opaque bench regression — a weak-typed parameter
+leaf that compiled every first-fit step program twice, a chi^2 program the
+background precompile never warmed, a psum that must not appear in a
+1-device jaxpr. This module turns those one-off post-mortems into
+pluggable passes that run over every :class:`TimedProgram` as it lowers
+(the hook is in ``ops/compile.py`` ``TimedProgram._compile``), so the bug
+class fails at compile time instead of costing a bench round.
+
+Passes (each returns a list of human-readable violation details):
+
+``weak-type``
+    Any weak-typed float leaf in the call signature. An AOT executable
+    lowered for a strong f64 scalar rejects a weak-typed operand and jit
+    silently recompiles — the exact 2x-compile bug
+    ``canonicalize_params`` exists to prevent.
+``precision-demotion``
+    An f64→f32 ``convert_element_type`` inside a program whose inputs
+    and constants are pure f64/extended-precision (the dd64 dtype
+    contract, ops/dd.py): phase-critical values must never round-trip
+    through f32. Programs with any f32 input (qf32 mode carries f32
+    pairs by design) are exempt.
+``large-const``
+    Host arrays above ``PINT_TPU_AUDIT_CONST_BYTES`` baked into the
+    jaxpr as constants. Closure-captured tensors bloat the program,
+    defeat the persistent compile cache (the constant's bytes are part
+    of the cache key) and force a recompile per dataset — per-TOA data
+    belongs in the argument list.
+``collectives``
+    Collectives present *iff* the program declared a mesh axis for them:
+    a psum in an undeclared (1-device) program deadlocks or crashes at
+    scale-up, and a declared TOA axis with *no* collective means the
+    shards never reduce. Axis names must match the declaration
+    (``distributed.fit_mesh()``'s axis by default).
+``host-sync``
+    Callback/infeed primitives inside a ``lax.while_loop`` body: the
+    fused fit loop's contract is ONE host sync per fit, and a callback
+    in the body re-serializes every iteration.
+``retrace-budget``
+    A second compiled signature that differs from an existing one only
+    in dtype/weak_type at identical tree structure and shapes. A
+    canonicalized program has exactly one signature per shape; a
+    dtype-only second signature is the PR-2 bug class (duplicate
+    compile of the same logical program).
+
+Results accumulate in a process-global ledger; ``audit_block()``
+snapshots it for ``FitResult.perf`` / the bench headline. The
+``PINT_TPU_AUDIT`` knob selects ``warn`` (log each violation, default),
+``strict`` (raise :class:`AuditError` at compile time — CI mode) or
+``0`` (skip the passes entirely).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import NamedTuple
+
+from pint_tpu.utils import knobs
+from pint_tpu.utils.logging import get_logger
+
+log = get_logger("pint_tpu.analysis")
+
+__all__ = [
+    "AuditError", "Violation", "audit_block", "audit_jitted",
+    "audit_mode", "audit_program", "reset_ledger", "PASSES",
+]
+
+
+class AuditError(RuntimeError):
+    """A jaxpr-audit violation under PINT_TPU_AUDIT=strict."""
+
+
+class Violation(NamedTuple):
+    pass_name: str
+    program: str
+    detail: str
+
+
+class _Ctx(NamedTuple):
+    """Everything a pass may inspect for one program compile."""
+
+    label: str
+    closed: object  # ClosedJaxpr | None (None when tracing is unavailable)
+    args: tuple
+    collective_axes: tuple[str, ...]
+    canonical: bool
+    prior_sigs: tuple  # signatures already compiled for this program
+    sig: object  # the signature being compiled (ops/compile._args_signature)
+
+
+def audit_mode() -> str:
+    """"warn" | "strict" | "0" (PINT_TPU_AUDIT, defaulting to warn)."""
+    m = (knobs.get("PINT_TPU_AUDIT") or "warn").lower()
+    return m if m in ("warn", "strict", "0") else "warn"
+
+
+# --- jaxpr walking ----------------------------------------------------------------
+
+
+def _subjaxprs(params: dict):
+    """(sub_jaxpr, is_loop_body) pairs nested in one eqn's params."""
+    for name, v in params.items():
+        vs = v if isinstance(v, (list, tuple)) else (v,)
+        for item in vs:
+            jx = getattr(item, "jaxpr", None)  # ClosedJaxpr
+            if jx is not None and hasattr(jx, "eqns"):
+                yield jx, name in ("body_jaxpr", "cond_jaxpr")
+            elif hasattr(item, "eqns"):  # bare Jaxpr
+                yield item, name in ("body_jaxpr", "cond_jaxpr")
+
+
+def _iter_eqns(jaxpr, in_loop: bool = False):
+    """Yield (eqn, in_loop) over a jaxpr and every nested sub-jaxpr;
+    ``in_loop`` is True inside a while/scan body."""
+    for eqn in jaxpr.eqns:
+        yield eqn, in_loop
+        looping = in_loop or eqn.primitive.name in ("while", "scan")
+        for sub, is_body in _subjaxprs(eqn.params):
+            yield from _iter_eqns(sub, looping if not is_body else True)
+
+
+def _aval_of(atom):
+    return getattr(atom, "aval", None)
+
+
+def _dtype_name(aval) -> str:
+    return str(getattr(aval, "dtype", ""))
+
+
+def _leaf_paths(args):
+    """(path-string, leaf) pairs of the call arguments."""
+    import jax
+
+    try:
+        flat = jax.tree_util.tree_flatten_with_path(args)[0]
+        return [(jax.tree_util.keystr(path), leaf) for path, leaf in flat]
+    except Exception:  # pragma: no cover — tree API drift
+        leaves = jax.tree_util.tree_leaves(args)
+        return [(f"[{i}]", leaf) for i, leaf in enumerate(leaves)]
+
+
+# --- passes -----------------------------------------------------------------------
+
+
+def _pass_weak_type(ctx: _Ctx) -> list[str]:
+    out = []
+    for path, leaf in _leaf_paths(ctx.args):
+        if type(leaf) is float or getattr(leaf, "weak_type", False):
+            out.append(
+                f"weak-typed float leaf at args{path}: traces as a weak "
+                "scalar and recompiles once it becomes a strong array "
+                "(route it through canonicalize_params)"
+            )
+    return out
+
+
+def _pass_precision_demotion(ctx: _Ctx) -> list[str]:
+    if ctx.closed is None:
+        return []
+    jaxpr = ctx.closed.jaxpr
+    # qf32-mode programs carry f32 pairs by contract: any f32 input or
+    # constant exempts the whole program from this pass
+    for v in jaxpr.invars:
+        if _dtype_name(_aval_of(v)) == "float32":
+            return []
+    for c in ctx.closed.consts:
+        if str(getattr(c, "dtype", "")) == "float32":
+            return []
+    out = []
+    for eqn, _ in _iter_eqns(jaxpr):
+        if eqn.primitive.name != "convert_element_type":
+            continue
+        new = str(eqn.params.get("new_dtype", ""))
+        src = _dtype_name(_aval_of(eqn.invars[0]))
+        if new == "float32" and src == "float64":
+            shape = tuple(getattr(_aval_of(eqn.invars[0]), "shape", ()))
+            out.append(
+                f"f64->f32 convert_element_type on a {shape} value inside "
+                "a pure-f64 program (dd64 dtype contract, ops/dd.py): "
+                "phase-critical precision silently demoted"
+            )
+    return out
+
+
+def _pass_large_const(ctx: _Ctx) -> list[str]:
+    if ctx.closed is None:
+        return []
+    limit = int(knobs.get("PINT_TPU_AUDIT_CONST_BYTES") or 262144)
+    out = []
+    for c in ctx.closed.consts:
+        nbytes = int(getattr(c, "nbytes", 0) or 0)
+        if nbytes > limit:
+            out.append(
+                f"host array {getattr(c, 'shape', '?')} "
+                f"{getattr(c, 'dtype', '?')} ({nbytes} B > {limit} B) baked "
+                "into the jaxpr as a constant: recompile/bloat risk — pass "
+                "it as an argument instead of closing over it"
+            )
+    return out
+
+
+#: primitives that complete a cross-device reduction/collective
+_COLLECTIVES = {
+    "psum", "psum2", "pmax", "pmin", "pmean", "all_gather", "all_to_all",
+    "reduce_scatter", "ppermute", "pgather",
+}
+#: primitives that synchronize with the host
+_HOST_SYNC = {
+    "pure_callback", "io_callback", "debug_callback", "callback",
+    "outside_call", "infeed", "outfeed", "host_callback_call",
+}
+
+
+def _collective_axis_names(eqn) -> tuple[str, ...]:
+    names = eqn.params.get("axes", eqn.params.get("axis_name", ()))
+    if not isinstance(names, (tuple, list)):
+        names = (names,)
+    return tuple(str(n) for n in names if isinstance(n, str))
+
+
+def _pass_collectives(ctx: _Ctx) -> list[str]:
+    if ctx.closed is None:
+        return []
+    used: set[str] = set()
+    n_collectives = 0
+    for eqn, _ in _iter_eqns(ctx.closed.jaxpr):
+        if eqn.primitive.name in _COLLECTIVES:
+            n_collectives += 1
+            used.update(_collective_axis_names(eqn))
+    declared = set(ctx.collective_axes)
+    out = []
+    if n_collectives and not declared:
+        out.append(
+            f"{n_collectives} collective(s) over axes {sorted(used) or '?'} "
+            "in a program with no declared mesh axis: a 1-device program "
+            "must contain no psum/all_gather (fitting/sharded.py fallback "
+            "contract)"
+        )
+    for ax in sorted(used - declared):
+        if declared:
+            out.append(
+                f"collective over undeclared axis {ax!r} (declared: "
+                f"{sorted(declared)}): axis names must match the bound "
+                "mesh (distributed.fit_mesh())"
+            )
+    for ax in sorted(declared - used):
+        out.append(
+            f"declared collective axis {ax!r} but no collective references "
+            "it: TOA shards would never reduce"
+        )
+    return out
+
+
+def _pass_host_sync(ctx: _Ctx) -> list[str]:
+    if ctx.closed is None:
+        return []
+    out = []
+    for eqn, in_loop in _iter_eqns(ctx.closed.jaxpr):
+        if in_loop and eqn.primitive.name in _HOST_SYNC:
+            out.append(
+                f"host-sync primitive {eqn.primitive.name!r} inside a "
+                "lax.while_loop body: the fused fit contract is one host "
+                "sync per fit, this re-serializes every iteration"
+            )
+    return out
+
+
+def _pass_retrace_budget(ctx: _Ctx) -> list[str]:
+    if not ctx.canonical or ctx.sig is None:
+        return []
+    try:
+        treedef, leaves = ctx.sig
+    except Exception:
+        return []
+    shapes = tuple(s for s, _, _ in leaves)
+    out = []
+    for prior in ctx.prior_sigs:
+        ptreedef, pleaves = prior
+        if ptreedef != treedef or len(pleaves) != len(leaves):
+            continue  # genuinely different call structure
+        if tuple(s for s, _, _ in pleaves) == shapes:
+            diffs = [
+                f"leaf {i}: {pd}/{'weak' if pw else 'strong'} -> "
+                f"{d}/{'weak' if w else 'strong'}"
+                for i, ((_, pd, pw), (_, d, w)) in enumerate(zip(pleaves, leaves))
+                if (pd, pw) != (d, w)
+            ]
+            out.append(
+                "retrace budget exceeded: signature "
+                f"#{len(ctx.prior_sigs) + 1} differs from an existing one "
+                f"only in dtype/weak_type ({'; '.join(diffs)}) — the same "
+                "logical program is compiling twice (canonicalize the "
+                "operands)"
+            )
+    return out
+
+
+#: the registered pass pipeline (name, fn) — pluggable: tests and
+#: downstream code may append passes; audit_block reports the count
+PASSES: list[tuple[str, object]] = [
+    ("weak-type", _pass_weak_type),
+    ("precision-demotion", _pass_precision_demotion),
+    ("large-const", _pass_large_const),
+    ("collectives", _pass_collectives),
+    ("host-sync", _pass_host_sync),
+    ("retrace-budget", _pass_retrace_budget),
+]
+
+
+# --- ledger -----------------------------------------------------------------------
+
+_lock = threading.Lock()
+_programs: dict[tuple, dict] = {}  # (label, id) -> {"signatures": n}
+_violations: list[Violation] = []
+
+
+def reset_ledger() -> None:
+    """Forget every recorded program/violation (test isolation)."""
+    with _lock:
+        _programs.clear()
+        _violations.clear()
+
+
+def audit_block(max_violations: int = 20) -> dict:
+    """JSON-ready snapshot of the audit ledger: the ``audit`` block
+    attached to ``FitResult.perf`` and the bench headline."""
+    with _lock:
+        sigs: dict[str, int] = {}
+        for (label, _), entry in _programs.items():
+            sigs[label] = max(sigs.get(label, 0), entry["signatures"])
+        vs = list(_violations)
+    return {
+        "n_programs": len(sigs),
+        "n_passes": len(PASSES),
+        "n_violations": len(vs),
+        "violations": [
+            {"pass": v.pass_name, "program": v.program, "detail": v.detail}
+            for v in vs[:max_violations]
+        ],
+        "signatures": dict(sorted(sigs.items())),
+        "mode": audit_mode(),
+    }
+
+
+def audit_program(
+    label: str,
+    closed,
+    args: tuple,
+    collective_axes: tuple[str, ...] = (),
+    canonical: bool = True,
+    prior_sigs: tuple = (),
+    sig=None,
+    program_id=None,
+) -> list[Violation]:
+    """Run every registered pass over one lowering; record + escalate.
+
+    Called from ``TimedProgram._compile`` with the traced ClosedJaxpr
+    (``closed`` may be None when the running jax cannot produce one —
+    the signature-level passes still run). Never raises except under
+    ``PINT_TPU_AUDIT=strict``; a crashing pass is logged and skipped so
+    an auditor bug cannot break a fit.
+    """
+    mode = audit_mode()
+    if mode == "0":
+        return []
+    ctx = _Ctx(label, closed, args, tuple(collective_axes), canonical,
+               tuple(prior_sigs), sig)
+    found: list[Violation] = []
+    for name, fn in PASSES:
+        try:
+            found.extend(Violation(name, label, d) for d in fn(ctx))
+        except AuditError:
+            raise
+        except Exception as e:  # noqa: BLE001 — auditor bugs must not break compiles
+            log.warning(f"audit pass {name} crashed on {label}: {e}")
+    with _lock:
+        key = (label, program_id if program_id is not None else id(args))
+        entry = _programs.setdefault(key, {"signatures": 0})
+        entry["signatures"] = len(prior_sigs) + 1
+        _violations.extend(found)
+    if found:
+        msg = f"jaxpr audit: {len(found)} violation(s) in {label!r}:\n" + \
+            "\n".join(f"  [{v.pass_name}] {v.detail}" for v in found)
+        if mode == "strict":
+            raise AuditError(msg)
+        log.warning(msg)
+    return found
+
+
+def audit_jitted(fn, *args, label: str = "adhoc",
+                 collective_axes: tuple[str, ...] = (),
+                 canonical: bool = True) -> list[Violation]:
+    """Audit an arbitrary callable for the given example arguments.
+
+    Standalone entry point (docs walkthrough, notebooks, tests): jits
+    ``fn`` if it is not already staged, traces it, and runs the same
+    passes the TimedProgram hook runs — without compiling the program.
+    """
+    import jax
+
+    jfn = fn if hasattr(fn, "trace") or hasattr(fn, "lower") else jax.jit(fn)
+    closed = None
+    if hasattr(jfn, "trace"):
+        closed = jfn.trace(*args).jaxpr
+    from pint_tpu.ops.compile import _args_signature
+
+    return audit_program(
+        label, closed, args, collective_axes=collective_axes,
+        canonical=canonical, prior_sigs=(), sig=_args_signature(args),
+        program_id=id(jfn),
+    )
+
+
+if __name__ == "__main__":  # pragma: no cover — tiny smoke entry
+    import json
+
+    print(json.dumps(audit_block(), indent=2))

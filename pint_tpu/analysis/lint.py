@@ -1,0 +1,463 @@
+"""AST lint: source-level JAX-idiom enforcement for pint_tpu.
+
+``python -m pint_tpu.analysis.lint [paths...]`` — zero third-party
+dependencies (stdlib ``ast`` only), wired into tier-1 by a pytest gate
+(tests/test_lint.py) so a violation fails CI.
+
+Rules
+-----
+``env-read``
+    ``os.environ`` / ``os.getenv`` anywhere outside the sanctioned knob
+    registry (pint_tpu/utils/knobs.py). Scattered raw reads are how env
+    knobs drift out of the documentation and out of cache keys; route
+    reads through :func:`pint_tpu.utils.knobs.get`.
+``np-in-jit``
+    ``np.<fn>(param)`` with a bare function parameter — a potential
+    tracer — inside a jit-reachable function. Host numpy either raises a
+    ConcretizationError at trace time or, worse, silently constant-folds
+    a value that should be traced. (np on static metadata like
+    ``x.shape`` is fine and not flagged.)
+``tracer-if``
+    Python ``if``/``while`` branching on a bare function parameter (or a
+    comparison of one) inside a jit-reachable function: tracers have no
+    truth value; use ``jnp.where``/``lax.cond``. ``is None`` /
+    membership tests are structural (trace-time static) and exempt.
+``host-sync-in-loop``
+    ``float(...)``, ``.item()``, ``np.asarray(...)``,
+    ``.block_until_ready(...)``, ``jax.device_get(...)`` inside a
+    function passed as a ``lax.while_loop``/``scan``/``cond``/
+    ``fori_loop`` body: a host sync inside a fused loop body either
+    fails to trace or re-serializes every device iteration.
+
+Reachability is deliberately *lexical and conservative*: a function is
+jit-reachable when it (or an enclosing function) is passed by name or as
+a lambda to ``jax.jit`` / ``precision_jit`` / ``TimedProgram`` /
+``jax.vmap`` / ``jax.linearize`` / ``jax.jacfwd`` / ``shard_map`` /
+``jax.lax.map`` in the same module scope; loop bodies are the function
+arguments of the ``lax`` loop combinators. Interprocedural flows (a
+builder returning a closure that is jitted elsewhere) are not chased —
+the lint under-approximates rather than false-positives.
+
+Suppression: append ``# jaxlint: disable=<rule>[,<rule>...]`` to the
+flagged line (a justification after the rule list is encouraged), or put
+``# jaxlint: skip-file`` in the first 10 lines of a file. The pyproject
+``[tool.pint_tpu.lint]`` block configures paths / env-registry files /
+per-rule excludes (see load_config).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+import sys
+from dataclasses import dataclass, field
+
+__all__ = ["Finding", "lint_file", "lint_paths", "load_config", "main", "RULES"]
+
+RULES = ("env-read", "np-in-jit", "tracer-if", "host-sync-in-loop")
+
+#: call targets whose function arguments become jit-reachable
+_JIT_WRAPPERS = {"jit", "precision_jit", "pjit", "TimedProgram", "vmap",
+                 "linearize", "jacfwd", "jacrev", "grad", "checkpoint",
+                 "shard_map"}
+#: lax loop combinators whose function arguments are device loop bodies
+_LOOP_WRAPPERS = {"while_loop", "scan", "cond", "fori_loop", "map",
+                  "switch", "associated_scan", "associative_scan"}
+#: np.* attribute names that are metadata/dtype helpers, not array math
+_NP_SAFE = {"float32", "float64", "int32", "int64", "bool_", "dtype",
+            "shape", "ndim", "result_type", "finfo", "iinfo", "newaxis"}
+
+_SUPPRESS_RE = re.compile(r"#\s*jaxlint:\s*disable=([\w,-]+)")
+_SKIP_FILE_RE = re.compile(r"#\s*jaxlint:\s*skip-file")
+
+
+@dataclass(frozen=True)
+class Finding:
+    path: str
+    line: int
+    rule: str
+    msg: str
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.msg}"
+
+
+@dataclass
+class _Scope:
+    """One function scope with its reachability marks."""
+
+    node: ast.AST  # FunctionDef | AsyncFunctionDef | Lambda | Module
+    parent: "_Scope | None"
+    jitted: bool = False
+    loop_body: bool = False
+    defs: dict = field(default_factory=dict)  # name -> _Scope of local def
+
+    @property
+    def params(self) -> set[str]:
+        a = getattr(self.node, "args", None)
+        if a is None:
+            return set()
+        names = [p.arg for p in a.posonlyargs + a.args + a.kwonlyargs]
+        if a.vararg:
+            names.append(a.vararg.arg)
+        if a.kwarg:
+            names.append(a.kwarg.arg)
+        return set(names)
+
+    def jit_params(self) -> set[str]:
+        """Parameters of this function and every jit-reachable ancestor:
+        the names that may bind tracers."""
+        out, s = set(), self
+        while s is not None and s.parent is not None:
+            out |= s.params
+            s = s.parent
+        return out
+
+
+def _fn_name(func: ast.AST) -> str | None:
+    if isinstance(func, ast.Name):
+        return func.id
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    return None
+
+
+class _ScopeBuilder(ast.NodeVisitor):
+    """First pass: the scope tree + (scope, name) -> local def map."""
+
+    def __init__(self, module: ast.Module):
+        self.root = _Scope(module, None)
+        self.by_node: dict[ast.AST, _Scope] = {module: self.root}
+        self._stack = [self.root]
+        self.visit(module)
+
+    def _enter(self, node):
+        scope = _Scope(node, self._stack[-1])
+        self.by_node[node] = scope
+        name = getattr(node, "name", None)
+        if name:
+            self._stack[-1].defs[name] = scope
+        self._stack.append(scope)
+        self.generic_visit(node)
+        self._stack.pop()
+
+    visit_FunctionDef = _enter
+    visit_AsyncFunctionDef = _enter
+    visit_Lambda = _enter
+
+
+def _resolve(scope: _Scope, name: str) -> _Scope | None:
+    """A locally-defined function named `name`, searching outward."""
+    s = scope
+    while s is not None:
+        if name in s.defs:
+            return s.defs[name]
+        s = s.parent
+    return None
+
+
+class _ReachMarker(ast.NodeVisitor):
+    """Second pass: mark jit-reachable functions and loop bodies."""
+
+    def __init__(self, scopes: _ScopeBuilder):
+        self.scopes = scopes
+        self._stack = [scopes.root]
+
+    def _enter(self, node):
+        self._stack.append(self.scopes.by_node[node])
+        self.generic_visit(node)
+        self._stack.pop()
+
+    visit_FunctionDef = _enter
+    visit_AsyncFunctionDef = _enter
+    visit_Lambda = _enter
+
+    def visit_Call(self, node: ast.Call):
+        name = _fn_name(node.func)
+        scope = self._stack[-1]
+        if name in _JIT_WRAPPERS:
+            for arg in node.args[:1]:  # the function operand is first
+                self._mark(scope, arg, "jitted")
+        elif name in _LOOP_WRAPPERS:
+            for arg in node.args:
+                self._mark(scope, arg, "loop_body")
+        self.generic_visit(node)
+
+    def _mark(self, scope: _Scope, arg: ast.AST, kind: str):
+        target = None
+        if isinstance(arg, ast.Lambda):
+            target = self.scopes.by_node.get(arg)
+        elif isinstance(arg, ast.Name):
+            target = _resolve(scope, arg.id)
+        elif isinstance(arg, ast.Call):
+            # e.g. TimedProgram(precision_jit(step), ...): recurse into
+            # the inner wrapper's function operand
+            inner = _fn_name(arg.func)
+            if inner in _JIT_WRAPPERS and arg.args:
+                self._mark(scope, arg.args[0], kind)
+            return
+        if target is not None:
+            setattr(target, kind, True)
+
+
+def _mark_nested(scope: _Scope):
+    """Reachability is closed over lexical nesting: every def inside a
+    jitted/loop-body function traces with it."""
+    for child in scope.defs.values():
+        child.jitted = child.jitted or scope.jitted
+        child.loop_body = child.loop_body or scope.loop_body
+        _mark_nested(child)
+
+
+def _names_in(node: ast.AST) -> set[str]:
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+def _bare_param_args(call: ast.Call, params: set[str]) -> list[str]:
+    """Arguments that ARE a bare parameter name (direct tracer use)."""
+    out = []
+    for a in list(call.args) + [k.value for k in call.keywords]:
+        if isinstance(a, ast.Name) and a.id in params:
+            out.append(a.id)
+    return out
+
+
+class _RuleChecker(ast.NodeVisitor):
+    """Third pass: emit findings inside marked scopes."""
+
+    def __init__(self, path, scopes: _ScopeBuilder, select, registry: bool):
+        self.path = path
+        self.scopes = scopes
+        self.select = select
+        self.registry = registry  # file IS the env registry (env-read exempt)
+        self.findings: list[Finding] = []
+        self._stack: list[_Scope] = [scopes.root]
+
+    # --- scope tracking ---------------------------------------------------------
+    def _enter(self, node):
+        self._stack.append(self.scopes.by_node[node])
+        self.generic_visit(node)
+        self._stack.pop()
+
+    visit_FunctionDef = _enter
+    visit_AsyncFunctionDef = _enter
+    visit_Lambda = _enter
+
+    @property
+    def scope(self) -> _Scope:
+        return self._stack[-1]
+
+    def _emit(self, node, rule, msg):
+        if rule in self.select:
+            self.findings.append(Finding(self.path, node.lineno, rule, msg))
+
+    # --- env-read ---------------------------------------------------------------
+    def visit_Attribute(self, node: ast.Attribute):
+        if (not self.registry and node.attr in ("environ", "getenv")
+                and isinstance(node.value, ast.Name) and node.value.id == "os"):
+            self._emit(node, "env-read",
+                       "raw os.environ read: route it through the knob "
+                       "registry (pint_tpu.utils.knobs.get)")
+        self.generic_visit(node)
+
+    # --- call-shaped rules ------------------------------------------------------
+    def visit_Call(self, node: ast.Call):
+        scope = self.scope
+        fname = _fn_name(node.func)
+        if scope.jitted or scope.loop_body:
+            params = scope.jit_params()
+            if (isinstance(node.func, ast.Attribute)
+                    and isinstance(node.func.value, ast.Name)
+                    and node.func.value.id in ("np", "numpy")
+                    and node.func.attr not in _NP_SAFE):
+                hits = _bare_param_args(node, params)
+                if hits:
+                    self._emit(node, "np-in-jit",
+                               f"np.{node.func.attr}({', '.join(hits)}) on a "
+                               "function parameter inside a jitted code "
+                               "path: host numpy cannot consume tracers — "
+                               "use jnp")
+        if scope.loop_body:
+            if isinstance(node.func, ast.Name) and node.func.id == "float" \
+                    and node.args and not isinstance(node.args[0], ast.Constant):
+                self._emit(node, "host-sync-in-loop",
+                           "float(...) inside a fused-loop body forces a "
+                           "host sync per device iteration")
+            if isinstance(node.func, ast.Attribute) and node.func.attr in (
+                    "item", "block_until_ready"):
+                self._emit(node, "host-sync-in-loop",
+                           f".{node.func.attr}() inside a fused-loop body "
+                           "forces a host sync per device iteration")
+            if (isinstance(node.func, ast.Attribute)
+                    and isinstance(node.func.value, ast.Name)
+                    and node.func.value.id in ("np", "numpy")
+                    and node.func.attr == "asarray"):
+                self._emit(node, "host-sync-in-loop",
+                           "np.asarray(...) inside a fused-loop body "
+                           "materializes on host every device iteration")
+            if fname == "device_get":
+                self._emit(node, "host-sync-in-loop",
+                           "jax.device_get inside a fused-loop body forces "
+                           "a host sync per device iteration")
+        self.generic_visit(node)
+
+    # --- tracer-if --------------------------------------------------------------
+    def _tracer_test(self, test: ast.AST, params: set[str]) -> str | None:
+        if isinstance(test, ast.Name) and test.id in params:
+            return test.id
+        if isinstance(test, ast.Compare):
+            if all(isinstance(op, (ast.Is, ast.IsNot, ast.In, ast.NotIn))
+                   for op in test.ops):
+                return None  # structural: `x is None`, `n in names`
+            for side in [test.left] + list(test.comparators):
+                if isinstance(side, ast.Name) and side.id in params:
+                    return side.id
+        if isinstance(test, ast.BoolOp):
+            for v in test.values:
+                hit = self._tracer_test(v, params)
+                if hit:
+                    return hit
+        return None
+
+    def _check_branch(self, node):
+        scope = self.scope
+        if scope.jitted or scope.loop_body:
+            hit = self._tracer_test(node.test, scope.jit_params())
+            if hit:
+                kind = "if" if isinstance(node, ast.If) else "while"
+                self._emit(node, "tracer-if",
+                           f"Python `{kind}` on parameter {hit!r} inside a "
+                           "jitted code path: tracers have no truth value — "
+                           "use jnp.where / lax.cond")
+        self.generic_visit(node)
+
+    visit_If = _check_branch
+    visit_While = _check_branch
+
+
+def _suppressions(src: str) -> dict[int, set[str]]:
+    out: dict[int, set[str]] = {}
+    for i, line in enumerate(src.splitlines(), start=1):
+        m = _SUPPRESS_RE.search(line)
+        if m:
+            out[i] = {r.strip() for r in m.group(1).split(",")}
+    return out
+
+
+def lint_file(path: str, src: str | None = None,
+              config: dict | None = None) -> list[Finding]:
+    """Lint one file; returns surviving findings (suppressions applied)."""
+    config = config or load_config()
+    if src is None:
+        with open(path) as f:
+            src = f.read()
+    head = "\n".join(src.splitlines()[:10])
+    if _SKIP_FILE_RE.search(head):
+        return []
+    try:
+        tree = ast.parse(src, filename=path)
+    except SyntaxError as e:
+        return [Finding(path, e.lineno or 1, "syntax", str(e.msg))]
+    scopes = _ScopeBuilder(tree)
+    _ReachMarker(scopes).visit(tree)
+    _mark_nested(scopes.root)
+    norm = path.replace(os.sep, "/")
+    registry = any(norm.endswith(r) for r in config["env-registry"])
+    checker = _RuleChecker(path, scopes, set(config["select"]), registry)
+    checker.visit(tree)
+    sup = _suppressions(src)
+    return [f for f in checker.findings if f.rule not in sup.get(f.line, ())]
+
+
+def _iter_py(paths: list[str], exclude: list[str]):
+    for p in paths:
+        if os.path.isfile(p):
+            yield p
+            continue
+        for dirpath, dirnames, filenames in os.walk(p):
+            dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+            for fn in sorted(filenames):
+                if not fn.endswith(".py"):
+                    continue
+                full = os.path.join(dirpath, fn).replace(os.sep, "/")
+                if any(x and x in full for x in exclude):
+                    continue
+                yield full
+
+
+def lint_paths(paths: list[str] | None = None,
+               config: dict | None = None) -> tuple[list[Finding], int]:
+    """(findings, files-checked) over the configured (or given) paths."""
+    config = config or load_config()
+    paths = paths or config["paths"]
+    findings: list[Finding] = []
+    n = 0
+    for path in _iter_py(paths, config["exclude"]):
+        n += 1
+        findings.extend(lint_file(path, config=config))
+    return findings, n
+
+
+# --- configuration ----------------------------------------------------------------
+
+_DEFAULTS = {
+    "paths": ["pint_tpu"],
+    "env-registry": ["pint_tpu/utils/knobs.py"],
+    "exclude": [],
+    "select": list(RULES),
+}
+
+
+def load_config(root: str | None = None) -> dict:
+    """The ``[tool.pint_tpu.lint]`` block of pyproject.toml, merged over
+    defaults. Parsed with a minimal TOML-subset reader (string scalars
+    and string arrays) — python 3.10 has no tomllib and the lint must
+    stay dependency-free."""
+    cfg = {k: list(v) if isinstance(v, list) else v
+           for k, v in _DEFAULTS.items()}
+    root = root or os.getcwd()
+    py = os.path.join(root, "pyproject.toml")
+    if not os.path.exists(py):
+        return cfg
+    with open(py) as f:
+        text = f.read()
+    m = re.search(r"^\[tool\.pint_tpu\.lint\]\s*$(.*?)(?=^\[|\Z)", text,
+                  re.M | re.S)
+    if not m:
+        return cfg
+    for key, raw in re.findall(r"^([\w-]+)\s*=\s*(.+?)\s*$", m.group(1), re.M):
+        raw = raw.split("#")[0].strip()
+        try:
+            val = ast.literal_eval(raw)
+        except (ValueError, SyntaxError):
+            continue
+        if key in cfg and isinstance(val, (list, str)):
+            cfg[key] = list(val) if isinstance(val, list) else val
+    return cfg
+
+
+def main(argv: list[str] | None = None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="python -m pint_tpu.analysis.lint",
+        description="pint_tpu JAX-idiom AST lint (see module docstring)")
+    ap.add_argument("paths", nargs="*", help="files/dirs (default: pyproject"
+                    " [tool.pint_tpu.lint] paths)")
+    ap.add_argument("--root", default=None,
+                    help="project root holding pyproject.toml (default: cwd)")
+    args = ap.parse_args(argv)
+    config = load_config(args.root)
+    findings, n = lint_paths(args.paths or None, config)
+    for f in findings:
+        print(f)
+    if findings:
+        print(f"{len(findings)} finding(s) in {n} file(s)")
+        return 1
+    print(f"checked {n} file(s): clean")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -223,12 +223,16 @@ def add_clock_search_dir(path: str) -> None:
 
 def _candidate_dirs() -> list[str]:
     dirs = []
-    override = os.environ.get("PINT_CLOCK_OVERRIDE")
+    from pint_tpu.utils import knobs
+
+    override = knobs.get("PINT_CLOCK_OVERRIDE")
     if override:
         dirs.append(override)
     dirs.extend(_search_dirs)
     for env in ("TEMPO2", "TEMPO"):
-        base = os.environ.get(env)
+        # the reference toolchains' install-dir convention ($TEMPO2/clock):
+        # their variables, not pint_tpu knobs
+        base = os.environ.get(env)  # jaxlint: disable=env-read
         if base:
             dirs.append(os.path.join(base, "clock"))
     # global clock-corrections repository cache (astro/global_clock.py):

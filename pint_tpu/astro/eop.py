@@ -65,7 +65,9 @@ def get_eop(utc_mjd: np.ndarray):
     Zeros when PINT_TPU_EOP is unset; linear interpolation inside the
     table, zero-with-warning outside it."""
     global _table, _table_path
-    path = os.environ.get("PINT_TPU_EOP")
+    from pint_tpu.utils import knobs
+
+    path = knobs.get("PINT_TPU_EOP")
     utc_mjd = np.asarray(utc_mjd, float)
     if not path:
         z = np.zeros_like(utc_mjd)

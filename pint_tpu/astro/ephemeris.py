@@ -352,7 +352,9 @@ class AnalyticEphemeris:
         could see tens of km of difference between a standalone run and a
         multi-dataset session). Windows are cached per quantized key, and
         each build is also disk-cached (nbody.py)."""
-        if os.environ.get("PINT_TPU_NBODY", "1") == "0":
+        from pint_tpu.utils import knobs
+
+        if knobs.get("PINT_TPU_NBODY") == "0":
             return None
         lo = float(np.min(T))
         hi = float(np.max(T))
@@ -392,7 +394,9 @@ def get_ephemeris(name: str = "auto"):
     (loaded with the native reader when present); otherwise the analytic
     ephemeris serves all DE-name requests with a log notice."""
     global _DEFAULT
-    kernel = os.environ.get("PINT_TPU_EPHEM")
+    from pint_tpu.utils import knobs
+
+    kernel = knobs.get("PINT_TPU_EPHEM")
     if kernel and os.path.exists(kernel):
         from pint_tpu.astro.spk import SPKEphemeris
 

@@ -41,7 +41,9 @@ INDEX_UPDATE_INTERVAL_DAYS = 1.0
 def repo_base() -> str | None:
     """The configured repository location (env PINT_TPU_CLOCK_REPO): an
     https/file URL or a local directory; None when unconfigured."""
-    return os.environ.get("PINT_TPU_CLOCK_REPO") or None
+    from pint_tpu.utils import knobs
+
+    return knobs.get("PINT_TPU_CLOCK_REPO") or None
 
 
 def cache_dir() -> Path:

@@ -174,13 +174,15 @@ class NBodyEphemeris:
         """Cache file keyed by everything the solution depends on: epoch,
         span, serving grid, refinement depth, body/GM table and algorithm
         version. PINT_TPU_NBODY_CACHE=0 disables; PINT_TPU_CACHE_DIR moves it."""
-        if os.environ.get("PINT_TPU_NBODY_CACHE", "1") == "0":
+        from pint_tpu.utils import knobs
+
+        if knobs.get("PINT_TPU_NBODY_CACHE") == "0":
             return None
         import hashlib
 
-        root = os.environ.get(
-            "PINT_TPU_CACHE_DIR", os.path.expanduser("~/.cache/pint_tpu")
-        )
+        from pint_tpu.utils.cache import cache_root
+
+        root = str(cache_root())
         # the cached solution is anchored to the base theory's output, so
         # fingerprint that CONTENT (not just the class name): probe
         # positions at three epochs change if any series/element table does
@@ -291,7 +293,9 @@ class NBodyEphemeris:
         (1, t)-modulated teeth keep the half-spacing resolvable on the
         window and the analytic series is safely better than the leak in
         this whole band."""
-        if os.environ.get("PINT_TPU_NBODY_COMB", "0") == "0":
+        from pint_tpu.utils import knobs
+
+        if knobs.get("PINT_TPU_NBODY_COMB") == "0":
             # default since round 5: no comb — the sextic drift poly
             # absorbs the smooth force-model drift and the 1.5-6 yr band
             # comes from the dynamics (see _band_design note)

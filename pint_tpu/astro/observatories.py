@@ -191,7 +191,9 @@ def _load_builtin() -> None:
         load_observatories_json(extra)
     else:  # the file ships with the package: absence is a packaging bug
         log.warning(f"packaged observatory registry missing: {extra}")
-    for path in os.environ.get("PINT_TPU_OBS_JSON", "").split(":"):
+    from pint_tpu.utils import knobs
+
+    for path in (knobs.get("PINT_TPU_OBS_JSON") or "").split(":"):
         if path and os.path.exists(path):
             load_observatories_json(path)
 

@@ -92,7 +92,9 @@ def _init_args(
                    "TPU_PROCESS_BOUNDS", "TPU_WORKER_ID",
                    "MEGASCALE_COORDINATOR_ADDRESS",
                    "SLURM_JOB_ID", "OMPI_COMM_WORLD_SIZE")
-        args["_autodetect"] = any(os.environ.get(m) for m in markers)
+        # external cluster-engine markers, not pint_tpu knobs: the names
+        # are owned by the TPU runtime / SLURM / Open MPI
+        args["_autodetect"] = any(os.environ.get(m) for m in markers)  # jaxlint: disable=env-read
     return args
 
 

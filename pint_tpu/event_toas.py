@@ -45,7 +45,7 @@ def read_mission_info_from_heasoft() -> dict:
     become nested dicts, e.g. ``NICER:events EVENTS`` ->
     ``{"nicer": {"events": "EVENTS"}}``. Empty when $HEADAS is unset —
     the built-in tables then stand alone."""
-    headas = os.getenv("HEADAS")
+    headas = os.getenv("HEADAS")  # jaxlint: disable=env-read — HEASOFT's variable, not a pint_tpu knob
     if not headas:
         return {}
     fname = os.path.join(headas, "bin", "xselect.mdb")

@@ -604,7 +604,11 @@ def get_fused_fit_fn(model, kind: str, free, subtract_mean: bool,
     from pint_tpu.ops.compile import TimedProgram, precision_jit
 
     entry = _FusedEntry(
-        prog=TimedProgram(precision_jit(fit), f"fused_{kind}_fit"),
+        # declared collective axes arm the auditor's placement pass: the
+        # sharded program MUST psum over the TOA axis, the 1-device
+        # fallback must contain no collective at all
+        prog=TimedProgram(precision_jit(fit), f"fused_{kind}_fit",
+                          collective_axes=(axis,) if axis else ()),
         red_pieces=red_p,
         red_chi2=red_c,
         n_shards=n_shards,

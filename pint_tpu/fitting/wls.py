@@ -415,13 +415,13 @@ class WLSFitter:
         self._prefit_wrms = self.resids.rms_weighted()
 
     def _fused_on(self) -> bool:
-        import os
+        from pint_tpu.utils import knobs
 
         if self._fused is not None:
             return self._fused
         if self.mesh is not None:
             return True
-        return os.environ.get("PINT_TPU_FUSED_FIT", "0") == "1"
+        return knobs.flag("PINT_TPU_FUSED_FIT")
 
     def _fused_data(self):
         if self._fused_cache is None:

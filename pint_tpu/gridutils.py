@@ -348,8 +348,12 @@ def _grid_single_fn(model, parnames, free, subtract_mean, maxiter, batch,
                     correlated):
     """The compiled-program cache entry for a single-chip grid scan:
     repeated scans (bench repeats, profile sweeps) must not
-    re-trace/re-compile."""
-    from pint_tpu.ops.compile import precision_jit
+    re-trace/re-compile. A TimedProgram, so the grid program runs through
+    the jaxpr auditor like every fit program (single-chip scan: no
+    collective may appear), precompile_grid's AOT executable lands in the
+    per-signature cache, and the compile cost shows up split out in any
+    collecting perf report."""
+    from pint_tpu.ops.compile import TimedProgram, precision_jit
 
     cache = model.__dict__.setdefault("_grid_fn_cache", {})
     key = ("single", parnames, free, subtract_mean, maxiter, batch,
@@ -358,8 +362,12 @@ def _grid_single_fn(model, parnames, free, subtract_mean, maxiter, batch,
         kernel = _point_kernel(model, parnames, free, subtract_mean, maxiter,
                                correlated=correlated)
         vk = jax.vmap(kernel, in_axes=(0, None, None))
-        cache[key] = precision_jit(
-            lambda tiles, params, data: jax.lax.map(lambda t: vk(t, params, data), tiles)
+        cache[key] = TimedProgram(
+            precision_jit(
+                lambda tiles, params, data: jax.lax.map(
+                    lambda t: vk(t, params, data), tiles)
+            ),
+            "grid",
         )
     return cache[key], key
 
@@ -369,11 +377,9 @@ def _grid_single(model, parnames, free, subtract_mean, maxiter, pts, params, dat
     tiles, batch = _grid_tiles(pts, batch)
     fn, key = _grid_single_fn(model, parnames, free, subtract_mean, maxiter,
                               batch, correlated)
-    # a precompiled AOT executable (precompile_grid) is keyed by the exact
-    # tile shape; fall through to the shape-polymorphic jit wrapper otherwise
-    aot = model._grid_fn_cache.get((*key, "aot", tiles.shape))
-    if aot is not None:
-        fn = aot
+    # an executable precompiled for this exact tile shape (precompile_grid)
+    # is served from the TimedProgram's per-signature cache; other shapes
+    # reach the shape-polymorphic jit wrapper
     return fn(tiles, params, data).reshape(-1)
 
 
@@ -404,17 +410,10 @@ def precompile_grid(fitter, parnames, parvalues, maxiter: int = 1,
                               correlated)
     params = model.xprec.convert_params(model.params)
     data = _host_data(fitter.resids, fitter.tensor)
-    from pint_tpu.ops import perf
-
-    with perf.stage("compile"):
-        compiled = fn.lower(tiles, params, data).compile()
-    perf.add("compiled:grid", 1)
-    # the AOT executable is valid only for this exact tile shape: store it
-    # under a shape-qualified key so different-sized scans still reach the
-    # shape-polymorphic jit wrapper
-    model._grid_fn_cache[(*key, "aot", tiles.shape)] = (
-        lambda t, p, d: compiled(t, p, d)
-    )
+    # TimedProgram.precompile lowers (through the jaxpr auditor), compiles
+    # under the perf "compile" stage, and caches the executable for this
+    # exact tile-shape signature — the next grid_chisq call finds it ready
+    fn.precompile(tiles, params, data)
     return pts.shape[0]
 
 
@@ -453,7 +452,7 @@ def _grid_sharded(model, parnames, free, subtract_mean, maxiter, mesh,
     else:
         data_specs = jax.tree.map(lambda _: P(), data)
 
-    from pint_tpu.ops.compile import precision_jit
+    from pint_tpu.ops.compile import TimedProgram, precision_jit
 
     cache = model.__dict__.setdefault("_grid_fn_cache", {})
     key = ("sharded", parnames, free, subtract_mean, maxiter,
@@ -472,5 +471,11 @@ def _grid_sharded(model, parnames, free, subtract_mean, maxiter, mesh,
             out_specs=P(grid_axis),
             check_vma=False,
         )
-        cache[key] = precision_jit(fn)
+        # auditor contract: with the TOA axis sharded the reductions MUST
+        # psum over it; a grid-axis-only mesh is embarrassingly parallel
+        # and must contain no collective
+        cache[key] = TimedProgram(
+            precision_jit(fn), "grid_sharded",
+            collective_axes=(toa_axis,) if shard_toas else (),
+        )
     return cache[key](pts, params, data)

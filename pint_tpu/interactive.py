@@ -436,11 +436,14 @@ class InteractivePulsar:
     # --- output ----------------------------------------------------------------
 
     def as_parfile(self) -> str:
-        return self.model.as_parfile()
+        # editor-buffer text, compared verbatim by the undo machinery:
+        # no provenance stamp (its timestamp would defeat ==); write_par
+        # stamps the on-disk output
+        return self.model.as_parfile(include_info=False)
 
     def write_par(self, path: str) -> None:
         with open(path, "w") as f:
-            f.write(self.as_parfile())
+            f.write(self.model.as_parfile())
 
     def write_tim(self, path: str) -> None:
         self.active_toas().write_tim(path, name=self.name)

@@ -88,6 +88,11 @@ def parse_parfile(path_or_text: str, from_text: bool = False) -> ParFile:
             text = f.read()
     pf = ParFile()
     for raw in text.splitlines():
+        if raw.lstrip().startswith("#"):
+            # full-line comments (incl. provenance headers,
+            # utils/provenance.py) are retained but never interpreted
+            pf.comments.append(raw)
+            continue
         line = _COMMENT_RE.sub("", raw).strip()
         if not line:
             continue

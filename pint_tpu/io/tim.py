@@ -388,9 +388,14 @@ def _parse_data_line(stripped: str, line: str, fmt: str) -> TOALine | None:
 
 
 def write_tim(toas: list[TOALine], path: str, name_prefix: str = "pint_tpu") -> None:
-    """Write Tempo2-format tim file (reference format_toa_line toa.py:549)."""
+    """Write Tempo2-format tim file (reference format_toa_line toa.py:549),
+    provenance-stamped with ``C`` comment lines every tim parser skips
+    (utils/provenance.py)."""
+    from pint_tpu.utils.provenance import provenance_header
+
     with open(path, "w") as f:
-        f.write(f"FORMAT 1\nC  written by {name_prefix}\n")
+        f.write("FORMAT 1\n")
+        f.write(provenance_header("tim", comment="C "))
         for t in toas:
             mjd = day_frac_to_mjd_string(t.mjd_day, t.mjd_frac_hi, t.mjd_frac_lo)
             flags = " ".join(f"-{k} {v}" for k, v in sorted(t.flags.items()))

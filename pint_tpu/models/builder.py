@@ -488,9 +488,15 @@ def _collect_swx(comp, pf: ParFile, model: TimingModel, consumed: set):
 # --- parfile output ------------------------------------------------------------
 
 
-def model_to_parfile(model: TimingModel) -> str:
+def model_to_parfile(model: TimingModel, include_info: bool = True) -> str:
     """Serialize back to parfile text (reference as_parfile,
-    timing_model.py:2437); exact strings for DD quantities."""
+    timing_model.py:2437); exact strings for DD quantities.
+
+    ``include_info`` prepends the provenance header (version + command +
+    date, utils/provenance.py; the reference utils.py:1585 contract) as
+    ``#`` comment lines the parser skips. Callers that compare parfile
+    TEXT (the interactive session's undo checks) pass False — the stamp
+    carries a timestamp."""
     import numpy as np
 
     lines: list[tuple[str, str]] = []
@@ -554,7 +560,12 @@ def model_to_parfile(model: TimingModel) -> str:
 
     from pint_tpu.io.par import write_parfile_lines
 
-    return write_parfile_lines(lines)
+    text = write_parfile_lines(lines)
+    if include_info:
+        from pint_tpu.utils.provenance import provenance_header
+
+        text = provenance_header("par") + text
+    return text
 
 
 def _tail_digits(name: str) -> str:

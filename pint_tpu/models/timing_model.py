@@ -434,12 +434,14 @@ class TimingModel:
     def get_mjd_param(self, name: str) -> float:
         return epoch_mjd_float(self.params[name])
 
-    def as_parfile(self) -> str:
+    def as_parfile(self, include_info: bool = True) -> str:
         """Write the model back in parfile form (reference as_parfile,
-        timing_model.py:2437). Values convert from internal SI units."""
+        timing_model.py:2437). Values convert from internal SI units;
+        ``include_info`` (default) stamps the provenance header the
+        parser skips on read (utils/provenance.py)."""
         from pint_tpu.models import builder as _b
 
-        return _b.model_to_parfile(self)
+        return _b.model_to_parfile(self, include_info=include_info)
 
     def compare(self, other: "TimingModel", sigma: float = 3.0) -> str:
         """Parameter-by-parameter comparison of two models (reference

@@ -38,6 +38,7 @@ import threading
 import jax
 
 from pint_tpu.ops import perf
+from pint_tpu.utils import knobs
 
 _CPU_WORKAROUND = {"xla_disable_hlo_passes": "fusion"}
 
@@ -46,7 +47,7 @@ def cpu_fusion_compiler_options() -> dict:
     """Per-program compiler options for CPU-target dd/qf programs: empty on
     the current toolchain (see module docstring), the fusion-pass disable
     when PINT_TPU_CPU_FUSION_WORKAROUND=1 opts back in."""
-    if os.environ.get("PINT_TPU_CPU_FUSION_WORKAROUND", "0") == "1":
+    if knobs.flag("PINT_TPU_CPU_FUSION_WORKAROUND"):
         return dict(_CPU_WORKAROUND)
     return {}
 
@@ -96,13 +97,13 @@ def setup_persistent_cache(force: bool = False) -> str | None:
         if _cache_state["done"] and not force:
             return _cache_state["dir"]
         _cache_state["done"] = True
-        legacy = os.environ.get("PINT_TPU_COMPILE_CACHE")
-        if os.environ.get("PINT_TPU_XLA_CACHE", "1") == "0" or legacy == "0":
+        legacy = knobs.get("PINT_TPU_COMPILE_CACHE")
+        if knobs.get("PINT_TPU_XLA_CACHE") == "0" or legacy == "0":
             _cache_state["dir"] = None
             return None
         from pint_tpu.utils.cache import cache_root
 
-        path = os.environ.get("PINT_TPU_XLA_CACHE_DIR") or legacy or str(
+        path = knobs.get("PINT_TPU_XLA_CACHE_DIR") or legacy or str(
             cache_root() / "xla" / f"jax-{jax.__version__}"
         )
         try:
@@ -184,13 +185,26 @@ class TimedProgram:
       a later first call finds it ready.
     - With telemetry off and nothing precompiled, calls pass straight
       through to the jitted callable.
+    - Every lowering is run through the jaxpr auditor
+      (pint_tpu/analysis/jaxpr_audit.py) before it compiles:
+      ``collective_axes`` declares the mesh axes whose collectives the
+      program MUST contain (empty = no collective may appear, the
+      1-device contract), ``canonical=True`` (the default — every fit
+      program takes canonicalized operands) arms the retrace-budget
+      pass. ``PINT_TPU_AUDIT=strict`` turns violations into compile-time
+      errors; ``=0`` skips the audit.
     """
 
-    __slots__ = ("jfn", "label", "_exes", "_lock")
+    __slots__ = ("jfn", "label", "collective_axes", "canonical",
+                 "_exes", "_lock")
 
-    def __init__(self, jfn, label: str):
+    def __init__(self, jfn, label: str,
+                 collective_axes: tuple[str, ...] = (),
+                 canonical: bool = True):
         self.jfn = jfn
         self.label = label
+        self.collective_axes = tuple(collective_axes)
+        self.canonical = canonical
         self._exes: dict = {}
         self._lock = threading.Lock()
 
@@ -223,7 +237,26 @@ class TimedProgram:
                 # trace (host Python, never cached) split from backend
                 # compile (XLA, served from the persistent cache when warm)
                 with perf.stage("trace"):
-                    lowered = self.jfn.lower(*args)
+                    traced = None
+                    if hasattr(self.jfn, "trace"):
+                        try:
+                            traced = self.jfn.trace(*args)
+                        except Exception:  # pragma: no cover — stage API drift
+                            traced = None
+                    lowered = (traced.lower() if traced is not None
+                               else self.jfn.lower(*args))
+                from pint_tpu.analysis.jaxpr_audit import audit_program
+
+                audit_program(
+                    self.label,
+                    None if traced is None else traced.jaxpr,
+                    args,
+                    collective_axes=self.collective_axes,
+                    canonical=self.canonical,
+                    prior_sigs=tuple(self._exes.keys()),
+                    sig=sig,
+                    program_id=id(self),
+                )
                 with perf.stage("compile"):
                     exe = lowered.compile()
                 perf.add(f"compiled:{self.label}", 1)
@@ -271,7 +304,7 @@ def use_host_solve() -> bool:
     Woodbury pieces). ``PINT_TPU_HOST_SOLVE=1`` forces it on CPU so tests
     exercise the host path."""
     return (jax.default_backend() != "cpu"
-            or os.environ.get("PINT_TPU_HOST_SOLVE", "0") == "1")
+            or knobs.flag("PINT_TPU_HOST_SOLVE"))
 
 
 def _tree_nbytes(obj) -> int:

@@ -37,17 +37,18 @@ programmatically::
 from __future__ import annotations
 
 import functools
-import os
 import threading
 import time
 from contextlib import contextmanager
+
+from pint_tpu.utils import knobs
 
 __all__ = [
     "PerfReport", "active", "add", "collect", "enable", "enabled",
     "fit_breakdown", "instrument_fit", "put", "put_default", "stage",
 ]
 
-_env_enabled = os.environ.get("PINT_TPU_PERF", "0") == "1"
+_env_enabled = knobs.flag("PINT_TPU_PERF")
 # all reports currently collecting; stage/add/put record into every one
 _reports: list["PerfReport"] = []
 _tls = threading.local()  # .path: list[str] — per-thread stage nesting
@@ -293,6 +294,16 @@ def fit_breakdown(rep: PerfReport) -> dict:
         "while_loop_iters": int(rep.counters.get("while_loop_iters", 0)),
         "psum_bytes": int(rep.counters.get("psum_bytes", 0)),
     }
+    # compile-time jaxpr-audit ledger (pint_tpu/analysis/): every program
+    # the fit lowered, the passes it ran, and any invariant violations —
+    # the bench headline carries this block so an audit regression is a
+    # bench diff, not a silent warning
+    try:
+        from pint_tpu.analysis.jaxpr_audit import audit_block
+
+        out["audit"] = audit_block()
+    except Exception:  # pragma: no cover — audit must never break a fit
+        out["audit"] = None
     return out
 
 

@@ -32,6 +32,36 @@ from pint_tpu.utils.logging import get_logger
 log = get_logger("pint_tpu.pintk")
 
 
+def default_toolkit():
+    """The real Tk widget toolkit, bundled for injection.
+
+    PintkApp builds its whole widget tree through this namespace (tk,
+    ttk, filedialog, the TkAgg canvas classes, Figure), so a headless
+    test can substitute a fake toolkit and exercise every line of the
+    GUI wiring without an X display (tests/test_interactive.py
+    TestPintkShell) — the widgets stay a thin shell, and the shell
+    itself is CI-executed."""
+    from types import SimpleNamespace
+
+    import tkinter as tk
+    from tkinter import filedialog, ttk
+
+    import matplotlib
+
+    matplotlib.use("TkAgg", force=False)
+    from matplotlib.backends.backend_tkagg import (
+        FigureCanvasTkAgg,
+        NavigationToolbar2Tk,
+    )
+    from matplotlib.figure import Figure
+
+    return SimpleNamespace(
+        tk=tk, ttk=ttk, filedialog=filedialog,
+        FigureCanvasTkAgg=FigureCanvasTkAgg,
+        NavigationToolbar2Tk=NavigationToolbar2Tk, Figure=Figure,
+    )
+
+
 class PintkApp:
     """Main window wiring (constructed around a live Tk root; every
     action delegates to the InteractivePulsar session)."""
@@ -39,18 +69,12 @@ class PintkApp:
     FITTERS = ("auto", "wls", "gls", "downhill_wls", "downhill_gls")
     COLOR_MODES = ("none", "obs", "fe-flag")
 
-    def __init__(self, session, master=None):
-        import tkinter as tk
-        from tkinter import ttk
-
-        import matplotlib
-
-        matplotlib.use("TkAgg", force=False)
-        from matplotlib.backends.backend_tkagg import (
-            FigureCanvasTkAgg,
-            NavigationToolbar2Tk,
-        )
-        from matplotlib.figure import Figure
+    def __init__(self, session, master=None, toolkit=None):
+        self.toolkit = toolkit or default_toolkit()
+        tk, ttk = self.toolkit.tk, self.toolkit.ttk
+        FigureCanvasTkAgg = self.toolkit.FigureCanvasTkAgg
+        NavigationToolbar2Tk = self.toolkit.NavigationToolbar2Tk
+        Figure = self.toolkit.Figure
 
         from pint_tpu.plot_utils import InteractivePlot
 
@@ -123,8 +147,7 @@ class PintkApp:
     # --- panels ---------------------------------------------------------------
 
     def _build_param_panel(self):
-        import tkinter as tk
-        from tkinter import ttk
+        tk, ttk = self.toolkit.tk, self.toolkit.ttk
 
         for child in list(self.param_frame.children.values()):
             child.destroy()
@@ -218,7 +241,7 @@ class PintkApp:
         self._update_status(f"jump: {name}" if name else "jump removed")
 
     def do_write_par(self):
-        from tkinter import filedialog
+        filedialog = self.toolkit.filedialog
 
         path = filedialog.asksaveasfilename(
             defaultextension=".par", initialfile=f"{self.session.name}.par")
@@ -227,7 +250,7 @@ class PintkApp:
             self._update_status(f"wrote {path}")
 
     def do_write_tim(self):
-        from tkinter import filedialog
+        filedialog = self.toolkit.filedialog
 
         path = filedialog.asksaveasfilename(
             defaultextension=".tim", initialfile=f"{self.session.name}.tim")
@@ -266,8 +289,8 @@ class PintkApp:
             f"loaded {len(self.session.all_toas)} TOAs from edited tim")
 
     def _open_editor(self, title, text, apply, save_ext):
-        import tkinter as tk
-        from tkinter import filedialog, ttk
+        tk, ttk = self.toolkit.tk, self.toolkit.ttk
+        filedialog = self.toolkit.filedialog
 
         win = tk.Toplevel(self.root)
         win.title(f"{title} — {self.session.name}")

@@ -184,8 +184,12 @@ class Polycos:
     # --- TEMPO polyco.dat IO --------------------------------------------------------
 
     def write(self, path: str) -> None:
-        """TEMPO polyco.dat format (reference polycos.py tempo writer)."""
+        """TEMPO polyco.dat format (reference polycos.py tempo writer),
+        provenance-stamped with ``#`` comment lines ``read`` skips."""
+        from pint_tpu.utils.provenance import provenance_header
+
         with open(path, "w") as f:
+            f.write(provenance_header("polyco"))
             for e in self.entries:
                 f.write(
                     f"{e.psr:<12s} {'---':>9s} {'0.00':>10s} "
@@ -207,7 +211,9 @@ class Polycos:
         """Parse a TEMPO polyco.dat (reference polycos.py tempo_polyco_table_reader)."""
         entries = []
         with open(path) as f:
-            lines = [ln.rstrip("\n") for ln in f if ln.strip()]
+            # '#' lines are provenance/comment headers, not segment data
+            lines = [ln.rstrip("\n") for ln in f
+                     if ln.strip() and not ln.lstrip().startswith("#")]
         i = 0
         while i < len(lines):
             h1 = lines[i].split()

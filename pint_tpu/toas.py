@@ -326,6 +326,8 @@ def get_TOAs(
     import hashlib
     import os
     import pickle
+
+    from pint_tpu.utils import knobs
     if model is not None:
         ephem = getattr(model, "ephem", None) or ephem
         planets = planets or bool(getattr(model, "planet_shapiro", False))
@@ -362,11 +364,11 @@ def get_TOAs(
         # resolved ephemeris identity: the same 'auto' label can mean the
         # analytic ephemeris, an SPK kernel (PINT_TPU_EPHEM), or the
         # N-body-refined path (PINT_TPU_NBODY) — all change the arrays
-        spk = os.environ.get("PINT_TPU_EPHEM") or ""
+        spk = knobs.get("PINT_TPU_EPHEM") or ""
         if spk and os.path.exists(spk):
             spk = f"{spk}@{os.path.getmtime(spk):.0f}"
-        nbody = os.environ.get("PINT_TPU_NBODY", "1")
-        eop = os.environ.get("PINT_TPU_EOP") or ""
+        nbody = knobs.get("PINT_TPU_NBODY")
+        eop = knobs.get("PINT_TPU_EOP") or ""
         if eop and os.path.exists(eop):
             eop = f"{eop}@{os.path.getmtime(eop):.0f}"
         # clock files refresh out-of-band (PINT_TPU_CLOCK_REPO syncs,

@@ -16,8 +16,11 @@ _FINGERPRINT: str | None = None
 
 
 def cache_root() -> Path:
+    from pint_tpu.utils import knobs
+
     return Path(
-        os.environ.get("PINT_TPU_CACHE_DIR", os.path.expanduser("~/.cache/pint_tpu"))
+        knobs.get("PINT_TPU_CACHE_DIR")
+        or os.path.expanduser("~/.cache/pint_tpu")
     )
 
 

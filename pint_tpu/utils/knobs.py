@@ -1,0 +1,84 @@
+"""The sanctioned environment-knob registry.
+
+Every ``PINT_TPU_*`` behavior toggle is read through this module, for two
+reasons the analysis layer (pint_tpu/analysis/) enforces mechanically:
+
+- **One inventory.** The KNOBS table below is the complete, documented
+  list of environment switches the package honors; a knob that is not
+  registered here does not exist (``get``/``flag`` raise ``KeyError``),
+  so stale call sites and typo'd names fail loudly instead of silently
+  reading an empty default forever.
+- **Lintable call sites.** ``python -m pint_tpu.analysis.lint`` flags any
+  raw ``os.environ`` / ``os.getenv`` read in ``pint_tpu/`` outside this
+  module (rule ``env-read``): scattered raw reads are how knobs drift out
+  of the docs and out of cache keys. Genuinely dynamic reads (e.g. the
+  TEMPO/TEMPO2 clock-dir convention, jax distributed autodetect markers)
+  carry an inline ``# jaxlint: disable=env-read`` with a justification.
+
+The registry stores only (default, doc); values are ALWAYS re-read from
+``os.environ`` so tests can monkeypatch knobs mid-process.
+"""
+
+from __future__ import annotations
+
+import os
+
+__all__ = ["KNOBS", "get", "flag", "describe"]
+
+#: name -> (default, one-line doc). The default is what ``get`` returns
+#: when the variable is unset (None = no default).
+KNOBS: dict[str, tuple[str | None, str]] = {
+    # --- fit path / compile machinery ------------------------------------------
+    "PINT_TPU_PERF": ("0", "1: every fit collects a stage breakdown onto FitResult.perf"),
+    "PINT_TPU_FUSED_FIT": ("0", "1: downhill fitters default to the fused on-device LM loop"),
+    "PINT_TPU_HOST_SOLVE": ("0", "1: force the fitters' dense solves onto the host (CPU test mode)"),
+    "PINT_TPU_CPU_FUSION_WORKAROUND": ("0", "1: re-enable the per-program XLA:CPU fusion-pass disable"),
+    "PINT_TPU_COMPILE_CACHE": (None, "legacy knob: persistent-cache dir override, 0 disables"),
+    "PINT_TPU_XLA_CACHE": ("1", "0: disable the persistent XLA compilation cache"),
+    "PINT_TPU_XLA_CACHE_DIR": (None, "persistent XLA cache directory override"),
+    # --- program audit (pint_tpu/analysis/) ------------------------------------
+    "PINT_TPU_AUDIT": ("warn", "jaxpr auditor mode: warn (default), strict (raise), 0 (off)"),
+    "PINT_TPU_AUDIT_CONST_BYTES": ("262144", "large-constant-capture audit threshold in bytes"),
+    # --- ephemeris / astrometry chain ------------------------------------------
+    "PINT_TPU_EPHEM": (None, "path to a JPL SPK kernel; unset = analytic ephemeris"),
+    "PINT_TPU_NBODY": ("1", "0: disable the N-body ephemeris refinement"),
+    "PINT_TPU_NBODY_CACHE": ("1", "0: disable the N-body solution disk cache"),
+    "PINT_TPU_NBODY_COMB": ("0", "1: add the comb anchor periods to the N-body band design"),
+    "PINT_TPU_EOP": (None, "path to an IERS finals2000A file; unset = zero EOP"),
+    "PINT_TPU_OBS_JSON": ("", "colon-separated extra observatories.json overlays"),
+    # --- clocks ----------------------------------------------------------------
+    "PINT_TPU_CLOCK_REPO": (None, "clock-corrections repository (https/file URL or directory)"),
+    "PINT_CLOCK_OVERRIDE": (None, "directory searched first for clock files"),
+    # --- caches ----------------------------------------------------------------
+    "PINT_TPU_CACHE_DIR": (None, "disk-cache root (default ~/.cache/pint_tpu)"),
+}
+
+
+def get(name: str, default: str | None = "__registered__") -> str | None:
+    """The knob's current value (env, falling back to the registered
+    default). Unregistered names raise ``KeyError`` — register new knobs
+    in ``KNOBS`` so they stay documented and lintable."""
+    if name not in KNOBS:
+        raise KeyError(
+            f"{name} is not a registered pint_tpu knob; add it to "
+            "pint_tpu.utils.knobs.KNOBS"
+        )
+    if default == "__registered__":
+        default = KNOBS[name][0]
+    return os.environ.get(name, default)  # jaxlint: disable=env-read — the registry itself
+
+
+def flag(name: str) -> bool:
+    """Boolean knob with the package-wide convention: the string "1" is
+    true, anything else (including unset with a "0" default) is false."""
+    return get(name) == "1"
+
+
+def describe() -> str:
+    """Human-readable knob inventory (docs / --help surfaces)."""
+    width = max(len(n) for n in KNOBS)
+    lines = []
+    for n, (default, doc) in sorted(KNOBS.items()):
+        d = "unset" if default is None else repr(default)
+        lines.append(f"{n:<{width}s}  [{d}] {doc}")
+    return "\n".join(lines)

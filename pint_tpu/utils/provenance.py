@@ -1,0 +1,43 @@
+"""Provenance stamping for generated outputs.
+
+The reference stamps everything it writes (par, tim, polyco) with an
+info block — version, invoking command, creation date (reference
+utils.py:1585 ``info_string``) — so a file found on disk two years later
+identifies the toolchain that produced it. This module is the pint_tpu
+equivalent: one header format, one implementation, used by
+``TimingModel.as_parfile`` (models/builder.py), ``io/tim.py write_tim``
+and ``polycos.Polycos.write``.
+
+The headers are comment lines in each format's own comment convention,
+so every parser in ``pint_tpu/io`` (and the reference toolchains) skips
+them: round-tripping a stamped file is lossless (locked by
+tests/test_io.py / tests/test_polycos_golden.py).
+"""
+
+from __future__ import annotations
+
+import sys
+from datetime import datetime, timezone
+
+__all__ = ["provenance_lines", "provenance_header"]
+
+
+def provenance_lines(fmt: str) -> list[str]:
+    """The provenance fields, without comment markers:
+    created-date (UTC), package version, invoking command, format tag."""
+    from pint_tpu import __version__
+
+    cmd = " ".join(sys.argv) if sys.argv and sys.argv[0] else "(interactive)"
+    return [
+        f"Created: {datetime.now(timezone.utc).strftime('%Y-%m-%dT%H:%M:%S+00:00')}",
+        f"pint_tpu_version: {__version__}",
+        f"Command: {cmd}",
+        f"Format: {fmt}",
+    ]
+
+
+def provenance_header(fmt: str, comment: str = "# ") -> str:
+    """The stamped header block, each line prefixed with the target
+    format's comment convention (``# `` for par/polyco, ``C `` for
+    Tempo2 tim files), newline-terminated."""
+    return "".join(f"{comment}{line}\n" for line in provenance_lines(fmt))

@@ -1,0 +1,267 @@
+"""jaxpr-auditor contract (pint_tpu/analysis/jaxpr_audit.py).
+
+Two halves:
+
+- **Seeded violations**: every registered pass is proven LIVE by a tiny
+  program constructed to violate exactly its invariant — an auditor pass
+  that silently stops firing is itself the failure mode this subsystem
+  exists to prevent.
+- **Audit-clean production programs**: the smoke bench and the
+  forced-8-device sharded smoke run under ``PINT_TPU_AUDIT=strict`` and
+  must come up with zero violations and single-signature ledgers — the
+  PR-2 regression lock (a weak-type leak that duplicates a compile now
+  fails tier-1 instead of costing 2x compile on the flagship).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from pint_tpu.analysis import (
+    AuditError,
+    audit_block,
+    audit_jitted,
+    reset_ledger,
+)
+from pint_tpu.ops import perf
+from pint_tpu.ops.compile import TimedProgram
+
+
+@pytest.fixture(autouse=True)
+def _clean_ledger(monkeypatch):
+    """Every test starts with an empty ledger in warn mode, and leaves
+    nothing behind for other suites."""
+    monkeypatch.setenv("PINT_TPU_AUDIT", "warn")
+    reset_ledger()
+    yield
+    reset_ledger()
+
+
+def _passes(violations):
+    return [v.pass_name for v in violations]
+
+
+class TestSeededViolations:
+    """One deliberately broken program per pass: the pass must fire."""
+
+    def test_weak_type_leaf(self):
+        vs = audit_jitted(lambda x: x * 2, 3.0, label="seed_weak")
+        assert "weak-type" in _passes(vs)
+
+    def test_weak_type_clean_after_canonicalize(self):
+        from pint_tpu.ops.compile import canonicalize_params
+
+        (x,) = jax.tree_util.tree_leaves(canonicalize_params({"x": 3.0}))
+        vs = audit_jitted(lambda v: v * 2, x, label="seed_weak_ok")
+        assert vs == []
+
+    def test_precision_demotion(self):
+        vs = audit_jitted(
+            lambda x: x.astype(jnp.float32).astype(jnp.float64),
+            jnp.arange(4.0), label="seed_demote")
+        assert "precision-demotion" in _passes(vs)
+
+    def test_precision_demotion_exempts_qf32_style(self):
+        """An f32 input marks the program as qf32-mode: demotion is the
+        dtype contract there, not a bug."""
+        vs = audit_jitted(
+            lambda x, y: x.astype(jnp.float32) + y,
+            jnp.arange(4.0), jnp.zeros(4, jnp.float32), label="seed_qf")
+        assert "precision-demotion" not in _passes(vs)
+
+    def test_large_constant_capture(self):
+        big = np.ones(100_000)  # 800 kB > the 256 kB default threshold
+        vs = audit_jitted(lambda x: x + jnp.asarray(big)[0],
+                          jnp.float64(1.0), label="seed_const")
+        assert "large-const" in _passes(vs)
+
+    def test_large_constant_threshold_knob(self, monkeypatch):
+        monkeypatch.setenv("PINT_TPU_AUDIT_CONST_BYTES", str(1 << 30))
+        big = np.ones(100_000)
+        vs = audit_jitted(lambda x: x + jnp.asarray(big)[0],
+                          jnp.float64(1.0), label="seed_const_ok")
+        assert "large-const" not in _passes(vs)
+
+    def test_collective_in_undeclared_program(self):
+        """A psum in a program with no declared mesh axis — the exact
+        '1-device jaxpr must contain no collective' contract."""
+        if len(jax.devices()) < 2:
+            pytest.skip("needs the multi-device virtual mesh")
+        from jax.sharding import PartitionSpec as P
+
+        import pint_tpu.distributed as dist
+        from pint_tpu.fitting.sharded import _shard_map
+
+        mesh = dist.fit_mesh()
+        f = _shard_map()(
+            lambda x: jax.lax.psum(jnp.sum(x), "toa"),
+            mesh=mesh, in_specs=(P("toa"),), out_specs=P(),
+            check_vma=False,
+        )
+        vs = audit_jitted(jax.jit(f), jnp.arange(8.0), label="seed_psum")
+        assert "collectives" in _passes(vs)
+
+    def test_declared_axis_without_collective(self):
+        vs = audit_jitted(lambda x: jnp.sum(x), jnp.arange(8.0),
+                          collective_axes=("toa",), label="seed_nopsum")
+        assert "collectives" in _passes(vs)
+
+    def test_declared_axis_with_matching_psum_is_clean(self):
+        if len(jax.devices()) < 2:
+            pytest.skip("needs the multi-device virtual mesh")
+        from jax.sharding import PartitionSpec as P
+
+        import pint_tpu.distributed as dist
+        from pint_tpu.fitting.sharded import _shard_map
+
+        mesh = dist.fit_mesh()
+        f = _shard_map()(
+            lambda x: jax.lax.psum(jnp.sum(x), "toa"),
+            mesh=mesh, in_specs=(P("toa"),), out_specs=P(),
+            check_vma=False,
+        )
+        vs = audit_jitted(jax.jit(f), jnp.arange(8.0),
+                          collective_axes=("toa",), label="seed_psum_ok")
+        assert vs == []
+
+    def test_host_sync_inside_while_loop(self):
+        def body(c):
+            v = jax.pure_callback(
+                lambda a: np.asarray(a),
+                jax.ShapeDtypeStruct((), jnp.float64), c)
+            return v + 1.0
+
+        vs = audit_jitted(
+            lambda x: jax.lax.while_loop(lambda c: c < 3.0, body, x),
+            jnp.float64(0.0), label="seed_sync")
+        assert "host-sync" in _passes(vs)
+
+    def test_callback_outside_loop_is_clean(self):
+        def f(x):
+            return jax.pure_callback(
+                lambda a: np.asarray(a),
+                jax.ShapeDtypeStruct((), jnp.float64), x) + 1.0
+
+        vs = audit_jitted(f, jnp.float64(0.0), label="seed_sync_ok")
+        assert "host-sync" not in _passes(vs)
+
+    def test_retrace_budget(self):
+        """A second signature differing only in dtype at identical
+        shapes: the duplicate-compile bug class PR 2 fixed by hand."""
+        tp = TimedProgram(jax.jit(lambda x: x + 1), "seed_retrace")
+        tp.precompile(jnp.arange(4, dtype=jnp.float64))
+        tp.precompile(jnp.arange(4, dtype=jnp.float32))
+        blk = audit_block()
+        hits = [v for v in blk["violations"]
+                if v["program"] == "seed_retrace"
+                and v["pass"] == "retrace-budget"]
+        assert hits, blk
+        assert blk["signatures"]["seed_retrace"] == 2
+
+    def test_retrace_budget_allows_new_shapes(self):
+        tp = TimedProgram(jax.jit(lambda x: x + 1), "seed_shapes")
+        tp.precompile(jnp.arange(4, dtype=jnp.float64))
+        tp.precompile(jnp.arange(8, dtype=jnp.float64))  # new shape: legit
+        blk = audit_block()
+        assert not any(v["program"] == "seed_shapes"
+                       for v in blk["violations"])
+        assert blk["signatures"]["seed_shapes"] == 2
+
+
+class TestModes:
+    def test_strict_raises_at_compile_time(self, monkeypatch):
+        monkeypatch.setenv("PINT_TPU_AUDIT", "strict")
+        tp = TimedProgram(jax.jit(lambda x: x * 2), "strict_seed")
+        with pytest.raises(AuditError):
+            tp.precompile(3.0)  # weak-typed float leaf
+
+    def test_off_disables_passes(self, monkeypatch):
+        monkeypatch.setenv("PINT_TPU_AUDIT", "0")
+        vs = audit_jitted(lambda x: x * 2, 3.0, label="off_seed")
+        assert vs == []
+
+    def test_warn_records_without_raising(self):
+        audit_jitted(lambda x: x * 2, 3.0, label="warn_seed")
+        blk = audit_block()
+        assert blk["n_violations"] == 1
+        assert blk["mode"] == "warn"
+        assert blk["n_passes"] >= 6
+
+
+class TestAuditClean:
+    """Acceptance: every registered program of the smoke benches passes
+    the auditor with zero violations under strict mode, aot_fallbacks is
+    0 and every program ledger shows a single compiled signature (the
+    PR-2 regression lock)."""
+
+    def _check(self, rec):
+        audit = rec["audit"]
+        assert audit is not None
+        assert audit["mode"] == "strict"
+        assert audit["n_violations"] == 0, audit["violations"]
+        assert audit["n_programs"] >= 2
+        # single-signature ledger: a second signature for any fit
+        # program means a silent duplicate compile (weak-type leak /
+        # canonicalization miss)
+        assert all(n == 1 for n in audit["signatures"].values()), audit
+        # and nothing fell back to a silent jit recompile inside the fit
+        assert rec["aot_fallbacks"] == 0
+        assert rec["aot_hits"] >= 1
+
+    def test_smoke_bench_audit_clean_strict(self, monkeypatch):
+        import bench
+
+        monkeypatch.setenv("PINT_TPU_AUDIT", "strict")
+        reset_ledger()
+        rec = bench.smoke_bench(ntoas=150, maxiter=3)
+        self._check(rec)
+        assert set(audit_block()["signatures"]) >= {"resid", "wls_step"}
+
+    def test_sharded_smoke_audit_clean_strict(self, monkeypatch):
+        import bench
+
+        if len(jax.devices()) < 2:
+            pytest.skip("needs the multi-device virtual mesh")
+        monkeypatch.setenv("PINT_TPU_AUDIT", "strict")
+        reset_ledger()
+        rec = bench.smoke_bench(ntoas=150, maxiter=3, sharded=True)
+        self._check(rec)
+        # the fused sharded program is in the ledger (and its psums
+        # passed the collective-placement pass against the declared axis)
+        assert "fused_wls_fit" in audit_block()["signatures"]
+        assert rec["fit_shards"] == len(jax.devices())
+
+    def test_audit_block_rides_fit_result_perf(self):
+        """FitResult.perf carries the audit block whenever telemetry
+        collects — the bench headline path."""
+        import bench
+
+        rec = bench.smoke_bench(ntoas=120, maxiter=2)
+        assert rec["audit"]["n_passes"] >= 6
+        assert "signatures" in rec["audit"]
+
+
+class TestKnobRegistry:
+    def test_unregistered_knob_raises(self):
+        from pint_tpu.utils import knobs
+
+        with pytest.raises(KeyError):
+            knobs.get("PINT_TPU_NO_SUCH_KNOB")
+
+    def test_registered_default_and_env(self, monkeypatch):
+        from pint_tpu.utils import knobs
+
+        monkeypatch.delenv("PINT_TPU_PERF", raising=False)
+        assert knobs.get("PINT_TPU_PERF") == "0"
+        assert knobs.flag("PINT_TPU_PERF") is False
+        monkeypatch.setenv("PINT_TPU_PERF", "1")
+        assert knobs.flag("PINT_TPU_PERF") is True
+
+    def test_describe_lists_every_knob(self):
+        from pint_tpu.utils import knobs
+
+        text = knobs.describe()
+        for name in knobs.KNOBS:
+            assert name in text

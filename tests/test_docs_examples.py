@@ -13,18 +13,12 @@ import pytest
 
 from conftest import REFERENCE_DATA, have_reference_data
 
-pytestmark = [
-    pytest.mark.slow,
-    pytest.mark.skipif(
-        not have_reference_data(), reason="reference datafile directory not mounted"
-    ),
-]
-
-DOC = Path(__file__).resolve().parent.parent / "docs" / "EXAMPLES.md"
+DOCS = Path(__file__).resolve().parent.parent / "docs"
+DOC = DOCS / "EXAMPLES.md"
 
 
-def extract_blocks():
-    text = DOC.read_text()
+def extract_blocks(doc: Path = DOC):
+    text = doc.read_text()
     blocks = []
     skip_next = False
     fence = None
@@ -48,6 +42,9 @@ def extract_blocks():
     return blocks
 
 
+@pytest.mark.slow
+@pytest.mark.skipif(not have_reference_data(),
+                    reason="reference datafile directory not mounted")
 def test_examples_run(tmp_path, monkeypatch):
     blocks = extract_blocks()
     assert len(blocks) >= 5, "EXAMPLES.md lost its executable blocks"
@@ -67,3 +64,24 @@ def test_examples_run(tmp_path, monkeypatch):
         except Exception as e:
             pytest.fail(f"EXAMPLES.md block {i} failed: {type(e).__name__}: {e}\n{block}")
     assert (tmp_path / "postfit.par").exists()
+
+
+
+def test_analysis_walkthrough_runs(tmp_path, monkeypatch):
+    """docs/ANALYSIS.md is executable WITHOUT reference data (synthetic
+    TOAs only) and runs in tier-1: the auditor walkthrough a user copies
+    from must keep working verbatim."""
+    blocks = extract_blocks(DOCS / "ANALYSIS.md")
+    assert len(blocks) >= 4, "ANALYSIS.md lost its executable blocks"
+    monkeypatch.chdir(tmp_path)
+    from pint_tpu.analysis import reset_ledger
+
+    reset_ledger()
+    ns: dict = {}
+    for i, block in enumerate(blocks):
+        try:
+            exec(compile(block, f"ANALYSIS.md[block {i}]", "exec"), ns)
+        except Exception as e:
+            pytest.fail(
+                f"ANALYSIS.md block {i} failed: {type(e).__name__}: {e}\n{block}")
+    reset_ledger()
