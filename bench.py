@@ -313,6 +313,22 @@ def _grid_for(model, ftr):
 _GRID_BATCH = int(os.environ.get("PINT_TPU_BENCH_BATCH", "3"))
 
 
+def _degradation_count() -> int:
+    """Distinct degradation-ledger events recorded so far (ops/degrade.py);
+    0 on a fully-configured clean run."""
+    from pint_tpu.ops.degrade import degradation_count
+
+    return degradation_count()
+
+
+def _degradation_kinds() -> list[str]:
+    """The ledger's event kinds (empty on a clean run) — named in the
+    headline so a corner-cutting regression is readable at a glance."""
+    from pint_tpu.ops.degrade import degradation_block
+
+    return degradation_block()["kinds"]
+
+
 def _fit_mesh():
     """TOA-axis mesh over every visible device for the sharded fused fit
     (None on a single chip — the fused program then runs unsharded).
@@ -633,6 +649,13 @@ def main() -> None:
         # count, pass count, any invariant violations — an audit
         # regression is a bench diff, not a buried warning
         "audit": fitperf.get("audit"),
+        # degradation ledger (pint_tpu/ops/degrade.py): every silent
+        # corner the run cut (zero clocks, stale caches, analytic
+        # ephemeris, host fallbacks) — the perf trajectory also tracks
+        # corner-cutting regressions, not just speed
+        "degradation_count": _degradation_count(),
+        "degradation_kinds": _degradation_kinds(),
+        "degradations": fitperf.get("degradations"),
         "fit_breakdown": fitperf,
         # the fit-step program compiled in a worker thread while the
         # TOA-load/GLS benches ran: this is the hidden (overlapped) cost
@@ -748,6 +771,10 @@ def smoke_bench(ntoas: int = 300, maxiter: int = 5, sharded: bool = False,
         "backend": jax.default_backend(),
         "n_devices": len(jax.devices()),
         "xla_cache_dir": setup_persistent_cache(),
+        # silent-corner-cutting telemetry: a clean smoke run must report 0
+        # (tests/test_degrade.py locks it under PINT_TPU_DEGRADED=error)
+        "degradation_count": _degradation_count(),
+        "degradation_kinds": _degradation_kinds(),
     }
     rec.update(res.perf or {})
     return rec

@@ -17,8 +17,10 @@ Two instruments, both zero-third-party-dependency:
   (``python -m pint_tpu.analysis.lint``) enforcing source-level JAX
   idioms across ``pint_tpu/``: no ``np.*`` on traced values in jitted
   code paths, no Python ``if`` on tracers, no ``float()``/``.item()``
-  host syncs inside fused-loop bodies, and no raw ``os.environ`` reads
-  outside the sanctioned knob registry (:mod:`pint_tpu.utils.knobs`).
+  host syncs inside fused-loop bodies, no raw ``os.environ`` reads
+  outside the sanctioned knob registry (:mod:`pint_tpu.utils.knobs`),
+  and no broad ``except`` that swallows a degradation without a ledger
+  write (``silent-except``, :mod:`pint_tpu.ops.degrade`).
 
 See docs/ANALYSIS.md for the executable walkthrough.
 """

@@ -136,7 +136,7 @@ def _leaf_paths(args):
     try:
         flat = jax.tree_util.tree_flatten_with_path(args)[0]
         return [(jax.tree_util.keystr(path), leaf) for path, leaf in flat]
-    except Exception:  # pragma: no cover — tree API drift
+    except Exception:  # pragma: no cover — tree API drift  # jaxlint: disable=silent-except — tree-API drift degrades path labels only; passes still run
         leaves = jax.tree_util.tree_leaves(args)
         return [(f"[{i}]", leaf) for i, leaf in enumerate(leaves)]
 
@@ -272,7 +272,7 @@ def _pass_retrace_budget(ctx: _Ctx) -> list[str]:
         return []
     try:
         treedef, leaves = ctx.sig
-    except Exception:
+    except Exception:  # jaxlint: disable=silent-except — malformed signature skips one pass; auditor must never break a compile
         return []
     shapes = tuple(s for s, _, _ in leaves)
     out = []
@@ -373,7 +373,7 @@ def audit_program(
             found.extend(Violation(name, label, d) for d in fn(ctx))
         except AuditError:
             raise
-        except Exception as e:  # noqa: BLE001 — auditor bugs must not break compiles
+        except Exception as e:  # noqa: BLE001 — auditor bugs must not break compiles  # jaxlint: disable=silent-except — a crashing auditor pass is logged and skipped; must never break a fit
             log.warning(f"audit pass {name} crashed on {label}: {e}")
     with _lock:
         key = (label, program_id if program_id is not None else id(args))

@@ -28,6 +28,15 @@ Rules
     function passed as a ``lax.while_loop``/``scan``/``cond``/
     ``fori_loop`` body: a host sync inside a fused loop body either
     fails to trace or re-serializes every device iteration.
+``silent-except``
+    A broad ``except Exception``/``except BaseException``/bare
+    ``except`` whose handler neither re-raises nor writes a
+    degradation-ledger event (a ``degrade.record(...)`` call,
+    pint_tpu/ops/degrade.py). Swallowed broad exceptions are how
+    graceful degradation goes silent — the corner-cut must land on the
+    ledger, or the handler must carry an inline suppression with a
+    justification for why it is not a degradation (telemetry assembly,
+    best-effort warmup, GUI survival).
 
 Reachability is deliberately *lexical and conservative*: a function is
 jit-reachable when it (or an enclosing function) is passed by name or as
@@ -55,7 +64,8 @@ from dataclasses import dataclass, field
 
 __all__ = ["Finding", "lint_file", "lint_paths", "load_config", "main", "RULES"]
 
-RULES = ("env-read", "np-in-jit", "tracer-if", "host-sync-in-loop")
+RULES = ("env-read", "np-in-jit", "tracer-if", "host-sync-in-loop",
+         "silent-except")
 
 #: call targets whose function arguments become jit-reachable
 _JIT_WRAPPERS = {"jit", "precision_jit", "pjit", "TimedProgram", "vmap",
@@ -300,6 +310,48 @@ class _RuleChecker(ast.NodeVisitor):
                 self._emit(node, "host-sync-in-loop",
                            "jax.device_get inside a fused-loop body forces "
                            "a host sync per device iteration")
+        self.generic_visit(node)
+
+    # --- silent-except ----------------------------------------------------------
+    _BROAD_EXC = {"Exception", "BaseException"}
+
+    def _broad_catch(self, type_node) -> bool:
+        if type_node is None:  # bare `except:`
+            return True
+        if isinstance(type_node, ast.Name):
+            return type_node.id in self._BROAD_EXC
+        if isinstance(type_node, ast.Attribute):
+            return type_node.attr in self._BROAD_EXC
+        if isinstance(type_node, ast.Tuple):
+            return any(self._broad_catch(t) for t in type_node.elts)
+        return False
+
+    @staticmethod
+    def _handler_recovers(handler: ast.ExceptHandler) -> bool:
+        """True when the handler re-raises or writes a degradation-ledger
+        event (``degrade.record(...)`` / ``record_degradation(...)``) —
+        either keeps the failure observable."""
+        for stmt in handler.body:
+            for node in ast.walk(stmt):
+                if isinstance(node, ast.Raise):
+                    return True
+                if isinstance(node, ast.Call):
+                    f = node.func
+                    if (isinstance(f, ast.Attribute) and f.attr == "record"
+                            and isinstance(f.value, ast.Name)
+                            and f.value.id == "degrade"):
+                        return True
+                    if _fn_name(f) == "record_degradation":
+                        return True
+        return False
+
+    def visit_ExceptHandler(self, node: ast.ExceptHandler):
+        if self._broad_catch(node.type) and not self._handler_recovers(node):
+            self._emit(node, "silent-except",
+                       "broad except swallows the exception without a "
+                       "degradation-ledger write (degrade.record) or a "
+                       "re-raise: silent fallback — record it, or suppress "
+                       "with a justification")
         self.generic_visit(node)
 
     # --- tracer-if --------------------------------------------------------------

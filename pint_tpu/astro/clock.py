@@ -51,7 +51,19 @@ class ClockFile:
             msg = f"clock file {self.name}: {late.sum()} TOAs beyond last entry MJD {self.mjd[-1]:.1f}"
             if self.valid_beyond == "error":
                 raise ValueError(msg)
-            log.warning(msg)
+            # one warning + one ledger event per clock file, not one per
+            # evaluation: every LM trial re-evaluates the chain, and the
+            # identical line used to fire each time. degrade.record warns
+            # once per (kind, file) — the log_once semantics — and bumps
+            # a repeat count on the ledger entry after that.
+            from pint_tpu.ops import degrade
+
+            degrade.record(
+                "clock.beyond_table", self.name or "clock", msg,
+                bound_us=1.0,  # holds the last entry; tables drift sub-µs
+                fix="sync a newer clock file (PINT_TPU_CLOCK_REPO) or set "
+                    "valid_beyond='error'",
+            )
         return np.interp(mjd, self.mjd, self.corr_s)
 
     @classmethod
@@ -186,7 +198,7 @@ def _find_first(alternatives: list[str], obs_name: str) -> ClockFile | None:
                     if p.endswith(".clk"):
                         return ClockFile.read_tempo2(p)
                     return ClockFile.read_tempo(p, site=obs_name)
-                except Exception as e:  # malformed file: warn, keep searching
+                except Exception as e:  # malformed file: warn, keep searching  # jaxlint: disable=silent-except — malformed file logged and skipped; a missing role ends in clock.zero_corrections on the ledger
                     log.warning(f"failed to read clock file {p}: {e}")
     return None
 
@@ -282,12 +294,20 @@ def get_clock_chain(obs_name: str, include_gps: bool = True, include_bipm: bool 
             chain.files.append(cf)
             if role is roles[0]:
                 found = True
-    if not found and obs_name not in _warned_missing:
+    if not found:
+        from pint_tpu.ops import degrade
+
         _warned_missing.add(obs_name)
-        log.warning(
-            f"no clock files found for {obs_name!r} (searched {_candidate_dirs() or 'nothing'}); "
-            "using zero clock corrections. Set PINT_CLOCK_OVERRIDE to a directory of "
-            ".clk/time.dat files, or PINT_TPU_CLOCK_REPO to a clock-corrections "
-            "repository (URL or local mirror), for real corrections."
+        # the reference's degraded mode — but on the record: site clock
+        # corrections are µs-scale, far past the ~10 ns parity claim
+        degrade.record(
+            "clock.zero_corrections", obs_name,
+            f"no clock files found for {obs_name!r} "
+            f"(searched {_candidate_dirs() or 'nothing'}); "
+            "using zero clock corrections",
+            bound_us=5.0,  # site+GPS corrections are µs-scale
+            fix="set PINT_CLOCK_OVERRIDE to a directory of .clk/time.dat "
+                "files, or PINT_TPU_CLOCK_REPO to a clock-corrections "
+                "repository (URL or local mirror)",
         )
     return chain

@@ -83,9 +83,15 @@ def get_eop(utc_mjd: np.ndarray):
     mjd, dut1, xp, yp = _table
     inside = (utc_mjd >= mjd[0]) & (utc_mjd <= mjd[-1])
     if not inside.all():
-        log.warning(
-            f"{int((~inside).sum())} epochs outside the EOP table span; "
-            "using UT1=UTC / zero polar motion there"
+        from pint_tpu.ops import degrade
+
+        degrade.record(
+            "eop.outside_table", os.path.basename(path),
+            f"{int((~inside).sum())} epochs outside the EOP table span "
+            f"(MJD {mjd[0]:.0f}..{mjd[-1]:.0f}); using UT1=UTC / zero "
+            "polar motion there",
+            bound_us=1.4,  # the diurnal site-position effect (erot.py)
+            fix="point PINT_TPU_EOP at a finals2000A file covering the data",
         )
     out_d = np.where(inside, np.interp(utc_mjd, mjd, dut1), 0.0)
     out_x = np.where(inside, np.interp(utc_mjd, mjd, xp), 0.0)

@@ -392,15 +392,37 @@ _DEFAULT: AnalyticEphemeris | None = None
 def get_ephemeris(name: str = "auto"):
     """Ephemeris factory. ``PINT_TPU_EPHEM`` may point at a JPL SPK kernel
     (loaded with the native reader when present); otherwise the analytic
-    ephemeris serves all DE-name requests with a log notice."""
+    ephemeris serves all DE-name requests, on the degradation ledger
+    (``ephemeris.analytic_fallback`` — the ~60 km Earth-SSB error is the
+    dominant corner-cut against a real DE kernel)."""
     global _DEFAULT
+    from pint_tpu.ops import degrade
     from pint_tpu.utils import knobs
 
     kernel = knobs.get("PINT_TPU_EPHEM")
-    if kernel and os.path.exists(kernel):
-        from pint_tpu.astro.spk import SPKEphemeris
+    if kernel:
+        if os.path.exists(kernel):
+            from pint_tpu.astro.spk import SPKEphemeris
 
-        return SPKEphemeris(kernel)
+            return SPKEphemeris(kernel)
+        # a configured kernel that is missing used to silently fall back
+        degrade.record(
+            "ephemeris.analytic_fallback", os.path.basename(kernel),
+            f"PINT_TPU_EPHEM={kernel} does not exist; serving the analytic "
+            "ephemeris instead",
+            bound_us=200.0,  # ~60 km Earth-SSB line-of-sight ≈ 200 µs Roemer
+            fix="restore the SPK kernel at PINT_TPU_EPHEM",
+        )
+    elif name not in ("auto", "analytic", None):
+        # a model requested a JPL DE ephemeris by name (par EPHEM card)
+        degrade.record(
+            "ephemeris.analytic_fallback", str(name),
+            f"ephemeris {name!r} requested but no SPK kernel is configured; "
+            "serving the analytic ephemeris (~60 km Earth-SSB LOS RMS vs "
+            "DE421, mostly fit-absorbable)",
+            bound_us=200.0,
+            fix="point PINT_TPU_EPHEM at the matching JPL SPK kernel",
+        )
     if _DEFAULT is None:
         _DEFAULT = AnalyticEphemeris()
     return _DEFAULT

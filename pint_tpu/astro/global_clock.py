@@ -16,12 +16,19 @@ under ``$PINT_TPU_CACHE_DIR/clock_corrections`` whose mtimes record the
 last sync, reproducing the reference's expiry semantics without astropy.
 ``astro/clock.py`` adds that cache to its search path automatically, so a
 configured repository feeds ``get_clock_chain`` with no further wiring.
+
+Acquisition goes through the shared resilient fetch core
+(utils/fetch.py): per-mirror retry rounds with exponential backoff,
+per-attempt timeouts, atomic writes, and validation-with-quarantine so a
+corrupt download can never poison the cache until expiry. Serving a
+stale cached copy because every mirror failed is recorded in the
+degradation ledger (``clock.stale_cache``, ops/degrade.py) — set
+``PINT_TPU_DEGRADED=error`` to refuse instead.
 """
 
 from __future__ import annotations
 
 import os
-import shutil
 import time
 from dataclasses import dataclass
 from datetime import datetime, timezone
@@ -52,31 +59,17 @@ def cache_dir() -> Path:
     return cache_root() / "clock_corrections"
 
 
-def _fetch(base: str, name: str, dest: Path) -> None:
-    """Copy `name` from the repository at `base` into `dest`.
-
-    Local-directory and file:// bases are a plain copy; http(s) bases go
-    through urllib (works only when the environment has egress)."""
-    if base.startswith(("http://", "https://")):
-        from urllib.request import urlopen
-
-        url = base.rstrip("/") + "/" + name
-        with urlopen(url, timeout=30) as r:
-            data = r.read()
-        dest.parent.mkdir(parents=True, exist_ok=True)
-        tmp = dest.with_suffix(dest.suffix + f".tmp{os.getpid()}")
-        tmp.write_bytes(data)
-        tmp.replace(dest)
-        return
-    if base.startswith("file://"):
-        base = base[len("file://"):]
-    src = Path(base) / name
-    if not src.exists():
-        raise FileNotFoundError(f"{name} not in repository {base}")
-    dest.parent.mkdir(parents=True, exist_ok=True)
-    tmp = dest.with_suffix(dest.suffix + f".tmp{os.getpid()}")
-    shutil.copy(src, tmp)
-    tmp.replace(dest)
+def _looks_like_clock_text(data: bytes) -> bool:
+    """Post-download validation hook (utils/fetch.py `validate`): every
+    repository file (index.txt, .clk, time.dat) is line-oriented text —
+    binary garbage from a half-dead mirror is quarantined, not cached."""
+    if b"\x00" in data:
+        return False
+    try:
+        data.decode("utf-8")
+    except UnicodeDecodeError:
+        return False
+    return True
 
 
 def get_file(
@@ -136,20 +129,30 @@ def get_file(
             f"{name}: not cached and no clock repository configured "
             "(set PINT_TPU_CLOCK_REPO)"
         )
-    last_err: Exception | None = None
-    for base in url_mirrors:
-        try:
-            _fetch(base, name, local)
+    from pint_tpu.utils.fetch import FetchError, fetch
+
+    try:
+        # the resilient fetch core: every mirror retried with exponential
+        # backoff (PINT_TPU_FETCH_ATTEMPTS rounds), corrupt payloads
+        # quarantined instead of cached
+        return fetch(name, local, url_mirrors,
+                     validate=_looks_like_clock_text)
+    except FetchError as e:
+        if have:
+            from pint_tpu.ops import degrade
+
+            age_days = (time.time() - local.stat().st_mtime) / 86400.0
+            degrade.record(
+                "clock.stale_cache", Path(name).name,
+                f"every mirror failed after {e.attempts} attempts "
+                f"({e.last_error}); serving the cached copy, "
+                f"{age_days:.1f} d past its last sync",
+                bound_us=1.0,  # clock files drift sub-µs per update interval
+                fix="restore access to PINT_TPU_CLOCK_REPO or a mirror",
+            )
             return local
-        except Exception as e:  # noqa: BLE001 — try the next mirror
-            last_err = e
-    if have:
-        log.warning(
-            f"clock file {name} should be refreshed but every mirror failed "
-            f"({last_err}); using the stale cached copy"
-        )
-        return local
-    raise FileNotFoundError(f"{name}: all mirrors failed ({last_err})")
+        raise FileNotFoundError(
+            f"{name}: all mirrors failed ({e.last_error})") from e
 
 
 @dataclass
@@ -213,7 +216,13 @@ def get_clock_correction_file(
     get_clock_correction_file:187); unknown names raise KeyError."""
     index = Index(download_policy=download_policy, url_base=url_base,
                   url_mirrors=url_mirrors)
-    details = index.files[filename]
+    try:
+        details = index.files[filename]
+    except KeyError:
+        raise KeyError(
+            f"{filename!r} is not in the clock-corrections repository "
+            f"index; available entries: {sorted(index.files)}"
+        ) from None
     return get_file(
         details.file,
         update_interval_days=details.update_interval_days,
@@ -264,8 +273,12 @@ def sync_if_configured() -> Path | None:
         return cache_dir() if cache_dir().is_dir() else None
     if not _synced:
         _synced = True
+        from pint_tpu.ops.degrade import DegradedError
+
         try:
             update_all()
-        except Exception as e:  # degraded mode: whatever is cached gets used
+        except DegradedError:
+            raise  # PINT_TPU_DEGRADED=error: refuse, don't degrade
+        except Exception as e:  # jaxlint: disable=silent-except — the fetch core already recorded fetch.mirror_failed/clock.stale_cache for each file
             log.warning(f"clock repository sync failed: {e}")
     return cache_dir() if cache_dir().is_dir() else None

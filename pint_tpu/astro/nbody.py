@@ -215,7 +215,7 @@ class NBodyEphemeris:
                 self._corr_m = z["corr_m"]
                 self._periods_e = tuple(z["periods_e"])
                 self._periods_m = tuple(z["periods_m"])
-        except Exception as e:  # corrupt/stale file: rebuild
+        except Exception as e:  # corrupt/stale file: rebuild  # jaxlint: disable=silent-except — corrupt N-body cache is rebuilt from scratch — full recovery, no accuracy loss
             log.warning(f"nbody cache read failed ({e}); rebuilding")
             return False
         log.info(f"nbody ephemeris loaded from cache: {path}")

@@ -100,11 +100,13 @@ class T2SpacecraftObs(Observatory):
             else:
                 missing.append(i)
         if missing and len(missing) < len(flags):
-            from pint_tpu.utils.logging import get_logger
+            from pint_tpu.ops import degrade
 
-            get_logger("pint_tpu.observatory").warning(
-                f"{self.name}: {len(missing)} of {len(flags)} TOAs lack "
-                "-vx/-vy/-vz velocity flags; those rows get zero GCRS velocity"
+            degrade.record(
+                "obs.zero_velocity", self.name,
+                f"{len(missing)} of {len(flags)} TOAs lack -vx/-vy/-vz "
+                "velocity flags; those rows get zero GCRS velocity",
+                fix="add -vx/-vy/-vz (km/s, GCRS) flags to every TOA",
             )
         return pos, vel
 

@@ -670,6 +670,11 @@ def run_fused_fit(fitter, maxiter: int, required_gain: float,
         params = canonicalize_params(model.xprec.convert_params(model.params))
         out = entry.prog(params, data, np.int32(maxiter),
                          np.float64(required_gain), np.int32(max_rejects))
+    # fault-injection site: tier-1 NaN-poisons the fused program's output
+    # to drive the host-loop fallback (and its ledger event) on CPU
+    from pint_tpu.testing import faults
+
+    out = faults.poison_nonfinite("fit.fused", out, f"fused_{kind}_fit")
     (params_out, chi2, it, converged, cov, s, vt, ahat, trials, rejects) = out
     chi2 = float(chi2)
     it, trials, rejects = int(it), int(trials), int(rejects)
@@ -679,9 +684,15 @@ def run_fused_fit(fitter, maxiter: int, required_gain: float,
         # telemetry deliberately NOT latched: the host loop that runs next
         # reports its own solve_path/counters, plus this marker
         perf.put("solve_path_reason", "fused_nonfinite_fallback")
-        log.warning(
-            f"fused {kind} fit returned non-finite results "
-            "(device eigensolve underflow?); falling back to the host LM loop"
+        from pint_tpu.ops import degrade
+
+        degrade.record(
+            "fit.host_fallback", f"fused_{kind}_fit",
+            "fused on-device LM fit returned non-finite results (device "
+            "eigensolve underflow?); falling back to the host LM loop",
+            bound_us=0.0,  # accuracy preserved; one-sync-per-fit perf lost
+            fix="condition the normal matrix (freeze degenerate params) or "
+                "solve on a true-f64 backend",
         )
         return None
     perf.add("lm_iterations", it)

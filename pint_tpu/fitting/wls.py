@@ -473,7 +473,7 @@ class WLSFitter:
                 if pre is not None:
                     try:
                         pre(*args)
-                    except Exception as e:  # noqa: BLE001 — warmup is best-effort
+                    except Exception as e:  # noqa: BLE001 — warmup is best-effort  # jaxlint: disable=silent-except — warmup is best-effort; the live fit compiles on demand and reports compile_wait_s
                         log.warning(f"fit-step precompile failed: {e}")
 
         if background:
@@ -507,7 +507,7 @@ class WLSFitter:
 
             try:
                 progs.append(fused_fit_program(self))
-            except Exception as e:  # noqa: BLE001 — warmup is best-effort
+            except Exception as e:  # noqa: BLE001 — warmup is best-effort  # jaxlint: disable=silent-except — warmup is best-effort; fused assembly failure falls back to the step programs
                 log.warning(f"fused fit program assembly failed: {e}")
         progs.append(self._step_program(self.model.params))
         progs.append(self._chi2_program(self.model.params))

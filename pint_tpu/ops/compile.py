@@ -122,7 +122,7 @@ def setup_persistent_cache(force: bool = False) -> str | None:
             )
 
             _cc.reset_cache()
-        except Exception:  # pragma: no cover — config surface drift
+        except Exception:  # pragma: no cover — config surface drift  # jaxlint: disable=silent-except — cache-config drift just disables the compile cache; compile correctness unaffected
             _cache_state["dir"] = None
             return None
         _cache_state["dir"] = path
@@ -241,7 +241,7 @@ class TimedProgram:
                     if hasattr(self.jfn, "trace"):
                         try:
                             traced = self.jfn.trace(*args)
-                        except Exception:  # pragma: no cover — stage API drift
+                        except Exception:  # pragma: no cover — stage API drift  # jaxlint: disable=silent-except — trace-API drift falls back to lower(); same program, attribution only
                             traced = None
                     lowered = (traced.lower() if traced is not None
                                else self.jfn.lower(*args))
@@ -285,7 +285,7 @@ class TimedProgram:
                 # (precompile overlap or an earlier iteration): the
                 # overlap_engaged breakdown field keys on this
                 perf.add("aot_hits", 1)
-        except Exception:
+        except Exception:  # jaxlint: disable=silent-except — AOT layout mismatch re-dispatches through jit — counted as aot_fallbacks telemetry
             # AOT executables are stricter than jit (layout/sharding of the
             # exact lowering); any mismatch falls back to the jit path
             perf.add("aot_fallbacks", 1)
@@ -383,8 +383,6 @@ def adaptive_fused(fused_fn, host_fn, is_good, label: str,
     report. `precompile`, when given, is exposed as ``call.precompile``
     so fitter-level AOT warmup reaches the right underlying programs.
     """
-    import logging
-
     if forced is None:
         forced = jax.default_backend() == "cpu"
     state = {"skip_fused": False, "reason": "forced_host" if forced else None}
@@ -401,18 +399,31 @@ def adaptive_fused(fused_fn, host_fn, is_good, label: str,
     def call(*args):
         if not forced and not state["skip_fused"]:
             out = fused_fn(*args)
+            # fault-injection site: tier-1 drives the sticky fallback on
+            # any backend by NaN-poisoning the fused program's output
+            from pint_tpu.testing import faults
+
+            out = faults.poison_nonfinite("fit.step", out, label)
             if is_good(out):
                 _note("fused")
                 return out
             host_out = host_fn(*args)
+            from pint_tpu.ops import degrade
+
             if is_good(host_out):
                 state["skip_fused"] = True
                 state["reason"] = "device_nonfinite_host_clean"
-                logging.getLogger("pint_tpu.fitting").info(
-                    f"{label}: on-device result non-finite but host result "
-                    "clean (device underflow) — using the host path from now on"
+                degrade.record(
+                    "fit.host_fallback", label,
+                    "on-device result non-finite but host result clean "
+                    "(device underflow) — using the host path from now on",
+                    bound_us=0.0,  # accuracy preserved; throughput degraded
+                    fix="condition the model (fewer degenerate params) or "
+                        "run the solve on a true-f64 backend",
                 )
             else:
+                # NOT a degradation: both paths agree the trial point is
+                # bad; run_lm's backtracking handles it (no ledger write)
                 state["reason"] = "both_paths_nonfinite"
             _note("host")
             return host_out

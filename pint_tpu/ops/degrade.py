@@ -1,0 +1,183 @@
+"""Degradation ledger: every graceful-degradation decision, on the record.
+
+The paper's headline claim is Tempo/Tempo2 parity at the ~10 ns level,
+yet several paths degrade far past that while only emitting a log line:
+zero clock corrections when no clock files are discoverable (worth ~µs,
+astro/clock.py), a stale clock cache served because every mirror failed
+(astro/global_clock.py), zero EOP outside the IERS table (astro/eop.py),
+the analytic ephemeris standing in for a requested JPL DE kernel
+(astro/ephemeris.py), and the sticky host fallback when a fused device
+program goes non-finite (ops/compile.py::adaptive_fused,
+fitting/sharded.py::run_fused_fit). A production fit must carry a
+machine-readable record of every corner it cut; a pipeline operator must
+be able to turn "degrade silently" into "fail loudly".
+
+This module is that record — the degradation counterpart of the PR-3
+audit ledger (analysis/jaxpr_audit.py):
+
+- Call sites report through :func:`record`, passing a ``kind`` from the
+  registered :data:`KINDS` taxonomy (unregistered kinds raise — a typo'd
+  kind is a dead ledger entry nobody can alert on), the affected
+  component, a conservative quantified timing-error bound in µs where
+  one is statable, and the knob that would fix the degradation.
+- Events accumulate in a process-global ledger; repeated identical
+  degradations (same kind + component) bump a count instead of spamming
+  — the warning is emitted once, like utils.logging.log_once.
+- :func:`degradation_block` snapshots the ledger for ``FitResult.perf``
+  (the ``degradations`` block, ops/perf.py), ``Residuals.degradations``,
+  and both smoke-bench headlines (bench.py ``degradation_count``).
+- ``PINT_TPU_DEGRADED`` escalates: ``warn`` (default — log once and
+  record), ``error`` (raise :class:`DegradedError` at the moment of the
+  ledger write — production mode; the event is recorded first so the
+  ledger still shows WHAT refused), ``0`` (record silently).
+
+Every degradation kind is driven end-to-end by an injected fault in
+tier-1 (tests/test_degrade.py, pint_tpu/testing/faults.py) and asserted
+to both recover and write the right ledger event.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import NamedTuple
+
+from pint_tpu.utils import knobs
+from pint_tpu.utils.logging import get_logger
+
+log = get_logger("pint_tpu.degrade")
+
+__all__ = [
+    "KINDS", "DegradedError", "DegradationEvent", "degradation_block",
+    "degradation_count", "events", "mode", "record", "reset_ledger",
+]
+
+#: the degradation taxonomy: kind -> one-line description. A ledger write
+#: with a kind outside this table raises ValueError at the call site —
+#: the taxonomy is the contract tier-1 fault-injection enumerates.
+KINDS: dict[str, str] = {
+    "clock.zero_corrections": (
+        "no clock files discoverable for an observatory; corrections are zero"),
+    "clock.stale_cache": (
+        "every clock-repository mirror failed; serving the stale cached copy"),
+    "clock.beyond_table": (
+        "TOAs beyond a clock file's last entry; holding the last correction"),
+    "eop.outside_table": (
+        "epochs outside the configured EOP table; UT1=UTC / zero polar motion"),
+    "ephemeris.analytic_fallback": (
+        "a JPL DE kernel was requested/configured but the analytic ephemeris served"),
+    "fit.host_fallback": (
+        "a fused device fit program went non-finite; recomputed on the host"),
+    "fetch.mirror_failed": (
+        "a remote file could not be refreshed from any mirror"),
+    "fetch.corrupt_quarantined": (
+        "a downloaded file failed validation and was quarantined"),
+    "obs.zero_velocity": (
+        "spacecraft TOAs without velocity flags; zero GCRS velocity assumed"),
+}
+
+
+class DegradedError(RuntimeError):
+    """A graceful degradation under PINT_TPU_DEGRADED=error."""
+
+
+class DegradationEvent(NamedTuple):
+    kind: str
+    component: str
+    detail: str
+    #: conservative timing-error bound in µs, when one is statable
+    bound_us: float | None
+    #: the knob/config that would fix the degradation
+    fix: str | None
+    count: int = 1
+
+
+def mode() -> str:
+    """"warn" | "error" | "0" (PINT_TPU_DEGRADED, defaulting to warn)."""
+    m = (knobs.get("PINT_TPU_DEGRADED") or "warn").lower()
+    return m if m in ("warn", "error", "0") else "warn"
+
+
+_lock = threading.Lock()
+#: (kind, component) -> DegradationEvent (count bumped on repeats)
+_events: dict[tuple[str, str], DegradationEvent] = {}
+
+
+def reset_ledger() -> None:
+    """Forget every recorded degradation (test isolation)."""
+    with _lock:
+        _events.clear()
+
+
+def record(kind: str, component: str, detail: str = "",
+           bound_us: float | None = None, fix: str | None = None) -> bool:
+    """Record one graceful-degradation decision; escalate per the knob.
+
+    Returns True when this is the FIRST occurrence of (kind, component)
+    — callers use it to gate any extra side effects (the warning itself
+    is emitted here, once). Under ``PINT_TPU_DEGRADED=error`` the event
+    is recorded and then :class:`DegradedError` raises, so a production
+    pipeline refuses the corner-cut while the ledger still shows it.
+    """
+    if kind not in KINDS:
+        raise ValueError(
+            f"{kind!r} is not a registered degradation kind; add it to "
+            "pint_tpu.ops.degrade.KINDS so the taxonomy stays complete "
+            f"(known: {sorted(KINDS)})"
+        )
+    key = (kind, component)
+    with _lock:
+        prior = _events.get(key)
+        if prior is not None:
+            _events[key] = prior._replace(count=prior.count + 1)
+            first = False
+        else:
+            _events[key] = DegradationEvent(kind, component, detail,
+                                            bound_us, fix)
+            first = True
+    m = mode()
+    msg = f"degraded [{kind}] {component}: {detail}"
+    if bound_us is not None:
+        msg += f" (timing-error bound ~{bound_us:g} us)"
+    if fix:
+        msg += f" — fix: {fix}"
+    if m == "error":
+        raise DegradedError(
+            msg + " [raised because PINT_TPU_DEGRADED=error]")
+    if m == "warn" and first:
+        log.warning(msg)
+    return first
+
+
+def events() -> list[DegradationEvent]:
+    """Snapshot of the recorded events (insertion order)."""
+    with _lock:
+        return list(_events.values())
+
+
+def degradation_count() -> int:
+    """Distinct (kind, component) degradations recorded so far."""
+    with _lock:
+        return len(_events)
+
+
+def degradation_block(max_events: int = 20) -> dict:
+    """JSON-ready ledger snapshot: the ``degradations`` block attached to
+    ``FitResult.perf``, ``Residuals.degradations`` and both smoke-bench
+    headline records."""
+    evs = events()
+    return {
+        "n_events": len(evs),
+        "kinds": sorted({e.kind for e in evs}),
+        "events": [
+            {"kind": e.kind, "component": e.component, "detail": e.detail,
+             "bound_us": e.bound_us, "fix": e.fix, "count": e.count}
+            for e in evs[:max_events]
+        ],
+        "mode": mode(),
+    }
+
+
+if __name__ == "__main__":  # pragma: no cover — tiny smoke entry
+    import json
+
+    print(json.dumps(degradation_block(), indent=2))

@@ -302,8 +302,18 @@ def fit_breakdown(rep: PerfReport) -> dict:
         from pint_tpu.analysis.jaxpr_audit import audit_block
 
         out["audit"] = audit_block()
-    except Exception:  # pragma: no cover — audit must never break a fit
+    except Exception:  # pragma: no cover — audit must never break a fit  # jaxlint: disable=silent-except — telemetry assembly, not a degradation path
         out["audit"] = None
+    # degradation ledger (ops/degrade.py): every corner the pipeline cut
+    # to produce this fit — zero clock corrections, stale caches, the
+    # analytic-ephemeris fallback, host fallbacks — with timing-error
+    # bounds, so a fit result carries its own provenance
+    try:
+        from pint_tpu.ops.degrade import degradation_block
+
+        out["degradations"] = degradation_block()
+    except Exception:  # pragma: no cover — ledger must never break a fit  # jaxlint: disable=silent-except — telemetry assembly, not a degradation path
+        out["degradations"] = None
     return out
 
 

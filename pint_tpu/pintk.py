@@ -201,7 +201,7 @@ class PintkApp:
     def _guard(self, fn, label):
         try:
             return fn()
-        except Exception as e:  # GUI survives bad input; log + show
+        except Exception as e:  # GUI survives bad input; log + show  # jaxlint: disable=silent-except — GUI survives bad input; error shown to the user, not a pipeline degradation
             log.warning(f"{label} failed: {e}")
             self._update_status(f"{label} failed: {e}")
             return self._FAILED
@@ -338,7 +338,7 @@ def main(argv=None) -> int:
                                 fitter=args.fitter)
     try:
         app = PintkApp(session)
-    except Exception as e:
+    except Exception as e:  # jaxlint: disable=silent-except — GUI fit failure is reported in the status bar, not a silent fallback
         print(f"cannot open a Tk display ({e}); the matplotlib front end "
               "works headless:\n"
               "  from pint_tpu.interactive import InteractivePulsar\n"

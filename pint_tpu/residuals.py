@@ -223,6 +223,17 @@ class Residuals:
             n -= 1
         return n
 
+    @property
+    def degradations(self) -> dict:
+        """Degradation-ledger snapshot (ops/degrade.py): every graceful
+        degradation recorded in this process — zero clock corrections,
+        stale clock caches, the analytic-ephemeris fallback — each with a
+        conservative timing-error bound in µs. Downstream noise/Bayesian
+        inference should check this before trusting the residuals."""
+        from pint_tpu.ops.degrade import degradation_block
+
+        return degradation_block()
+
     def ecorr_average(self, use_noise_model: bool = True) -> dict:
         """Epoch-averaged residuals over the ECORR time-binning (reference
         Residuals.ecorr_average, residuals.py:524) — the NANOGrav summary-
@@ -314,6 +325,10 @@ class WidebandTOAResiduals:
 
     def rms_weighted(self) -> float:
         return self.toa.rms_weighted()
+
+    @property
+    def degradations(self) -> dict:
+        return self.toa.degradations
 
     @property
     def dof(self) -> int:

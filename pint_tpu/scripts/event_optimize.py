@@ -155,7 +155,7 @@ def _phaseogram(opt, toas, plotfile):
 
         phaseogram(toas.tdb.mjd_float(), opt.get_event_phases(),
                    weights=opt.weights, outfile=plotfile)
-    except Exception as e:  # plotting is best-effort
+    except Exception as e:  # plotting is best-effort  # jaxlint: disable=silent-except — plotting is best-effort; results already written
         print(f"phaseogram failed: {e}", file=sys.stderr)
 
 
@@ -177,7 +177,7 @@ def _plot_chains(opt, plotfile):
         fig.tight_layout()
         fig.savefig(plotfile)
         plt.close(fig)
-    except Exception as e:
+    except Exception as e:  # jaxlint: disable=silent-except — corner-plot dependency optional; results already written
         print(f"chain plot failed: {e}", file=sys.stderr)
 
 

@@ -423,7 +423,7 @@ class LCFitter:
             cov = (V * s_inv) @ V.T
             J = np.asarray(jax.jacobian(tmap.physical)(th))
             return np.sqrt(np.maximum(np.diag(J @ cov @ J.T), 0.0))
-        except Exception:
+        except Exception:  # jaxlint: disable=silent-except — hessian errors fall back to None uncertainties, surfaced to the caller
             return None
 
     def bootstrap_errors(self, n: int = 50, rng=None) -> np.ndarray:

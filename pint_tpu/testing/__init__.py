@@ -1,0 +1,15 @@
+"""Test-support machinery that ships with the package.
+
+:mod:`pint_tpu.testing.faults` — the deterministic fault-injection
+harness that drives every graceful-degradation path end-to-end in tier-1
+(tests/test_degrade.py): injected network refusals, timeouts, corrupt
+payloads, and NaN poisoning of fused fit programs. Shipping it in the
+package (rather than under tests/) keeps the injection points — the
+``maybe_raise``/``mangle``/``poison_nonfinite`` hooks that production
+modules call — importable from anywhere, including the docs walkthrough
+and operator smoke checks against a staging deployment.
+"""
+
+from pint_tpu.testing import faults  # noqa: F401
+
+__all__ = ["faults"]

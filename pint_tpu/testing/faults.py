@@ -1,0 +1,175 @@
+"""Deterministic fault injection for the robustness layer.
+
+Every graceful-degradation path in pint_tpu (the ledger taxonomy,
+ops/degrade.py) is driven end-to-end in tier-1 by faults injected here —
+no real network, no flaky timing. Production modules call the hooks at
+their failure points; the hooks are inert (one dict lookup) unless a
+fault is armed, so the instrumented paths cost nothing in production.
+
+Sites and modes
+---------------
+========================  =====================================================
+site                      armed modes
+========================  =====================================================
+``fetch``                 ``refuse`` (ConnectionRefusedError), ``timeout``
+                          (TimeoutError) — raised by :func:`maybe_raise`
+                          before each download attempt (utils/fetch.py)
+``fetch.payload``         ``truncate`` (empty payload), ``corrupt`` (garbage
+                          bytes) — applied by :func:`mangle` to the downloaded
+                          bytes before the atomic write
+``fit.fused``             ``nan`` — :func:`poison_nonfinite` NaN-fills the
+                          fused LM loop's outputs (fitting/sharded.py)
+``fit.step``              ``nan`` — same, for the per-step fused programs
+                          dispatched through adaptive_fused (ops/compile.py)
+========================  =====================================================
+
+Arming
+------
+Programmatically (tests)::
+
+    from pint_tpu.testing import faults
+    faults.arm("fetch", "refuse", times=2)   # next 2 attempts refused
+    ...
+    faults.reset()
+
+or via the ``PINT_TPU_FAULTS`` knob for whole-process runs (smoke checks
+against a staging deployment): a comma-separated ``site:mode[*N]`` spec,
+e.g. ``PINT_TPU_FAULTS="fetch:timeout*2,fit.fused:nan"``. ``*N`` bounds
+the fault to the first N firings; without it the fault fires every time.
+The spec is re-parsed whenever the knob's value changes, so tests can
+monkeypatch it mid-process.
+
+Every firing is appended to :data:`fired` (site, mode, context) so tests
+can unit-lock attempt counts without real network access.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+
+from pint_tpu.utils import knobs
+
+__all__ = ["arm", "fired", "mangle", "maybe_raise", "armed",
+           "poison_nonfinite", "reset"]
+
+
+@dataclass
+class _Fault:
+    mode: str
+    remaining: int | None  # None = unbounded
+
+
+_lock = threading.Lock()
+_armed: dict[str, _Fault] = {}
+#: log of every fault firing: (site, mode, context) tuples
+fired: list[tuple[str, str, str]] = []
+
+# env-spec cache: (raw knob string, parsed site -> _Fault)
+_env_cache: tuple[str | None, dict[str, _Fault]] = (None, {})
+
+
+def reset() -> None:
+    """Disarm everything and clear the firing log (test isolation)."""
+    global _env_cache
+    with _lock:
+        _armed.clear()
+        fired.clear()
+        _env_cache = (None, {})
+
+
+def arm(site: str, fault_mode: str, times: int | None = 1) -> None:
+    """Arm `site` to fail with `fault_mode` for the next `times` firings
+    (None = every firing until :func:`reset`)."""
+    with _lock:
+        _armed[site] = _Fault(fault_mode, times)
+
+
+def _parse_env(raw: str) -> dict[str, _Fault]:
+    out: dict[str, _Fault] = {}
+    for tok in raw.split(","):
+        tok = tok.strip()
+        if not tok or ":" not in tok:
+            continue
+        site, _, spec = tok.partition(":")
+        spec, _, n = spec.partition("*")
+        out[site.strip()] = _Fault(spec.strip(), int(n) if n else None)
+    return out
+
+
+def _take(site: str) -> _Fault | None:
+    """The armed fault for `site`, consuming one firing; None when inert."""
+    global _env_cache
+    with _lock:
+        f = _armed.get(site)
+        if f is None:
+            raw = knobs.get("PINT_TPU_FAULTS") or ""
+            if raw != _env_cache[0]:
+                _env_cache = (raw, _parse_env(raw))
+            f = _env_cache[1].get(site)
+        if f is None:
+            return None
+        if f.remaining is not None:
+            if f.remaining <= 0:
+                return None
+            f.remaining -= 1
+        return f
+
+
+def armed(site: str) -> bool:
+    """True when `site` has firings left (does not consume one)."""
+    with _lock:
+        f = _armed.get(site)
+        if f is None:
+            raw = knobs.get("PINT_TPU_FAULTS") or ""
+            parsed = _env_cache[1] if raw == _env_cache[0] else _parse_env(raw)
+            f = parsed.get(site)
+        return f is not None and (f.remaining is None or f.remaining > 0)
+
+
+def maybe_raise(site: str, context: str = "") -> None:
+    """Raise the armed exception-mode fault for `site`, if any."""
+    f = _take(site)
+    if f is None:
+        return
+    fired.append((site, f.mode, context))
+    if f.mode == "refuse":
+        raise ConnectionRefusedError(
+            f"injected connection refusal at {site} ({context})")
+    if f.mode == "timeout":
+        raise TimeoutError(f"injected timeout at {site} ({context})")
+    raise RuntimeError(f"injected fault {f.mode!r} at {site} ({context})")
+
+
+def mangle(site: str, data: bytes, context: str = "") -> bytes:
+    """Apply the armed payload-corruption fault for `site` to `data`."""
+    f = _take(site)
+    if f is None:
+        return data
+    fired.append((site, f.mode, context))
+    if f.mode == "truncate":
+        return b""
+    if f.mode == "corrupt":
+        return b"\x00CORRUPT\x00" * 3
+    return data
+
+
+def poison_nonfinite(site: str, out, context: str = ""):
+    """NaN-fill every floating leaf of `out` when `site` is armed with
+    mode ``nan`` — simulates a fused device program underflowing to
+    non-finite results so the sticky host-fallback path is exercisable
+    on any backend."""
+    f = _take(site)
+    if f is None:
+        return out
+    fired.append((site, f.mode, context))
+    import jax
+    import numpy as np
+
+    def nanify(x):
+        arr = np.asarray(x)
+        if np.issubdtype(arr.dtype, np.floating):
+            return np.full_like(arr, np.nan)
+        return x
+
+    return jax.tree_util.tree_map(nanify, out)

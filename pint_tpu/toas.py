@@ -403,7 +403,7 @@ def get_TOAs(
                     log.info(f"loaded TOAs from cache {cache_path}")
                     return toas
                 log.info("TOA cache stale; regenerating")
-            except Exception as e:  # corrupt cache: regenerate
+            except Exception as e:  # corrupt cache: regenerate  # jaxlint: disable=silent-except — corrupt TOA cache is regenerated from source — full recovery, no accuracy loss
                 log.warning(f"ignoring unreadable TOA cache {cache_path}: {e}")
     tf = parse_tim(timfile)
     toas = prepare_TOAs(
@@ -419,7 +419,7 @@ def get_TOAs(
             with open(cache_path, "wb") as f:
                 pickle.dump((key, toas), f)
             log.info(f"cached prepared TOAs to {cache_path}")
-        except Exception as e:
+        except Exception as e:  # jaxlint: disable=silent-except — cache write failure only costs the next run a re-preparation
             log.warning(f"could not write TOA cache {cache_path}: {e}")
     return toas
 

@@ -1,0 +1,171 @@
+"""Resilient file acquisition: retries, backoff, mirrors, quarantine.
+
+The clock-corrections repository sync (astro/global_clock.py) grew its
+download logic ad hoc: one attempt per mirror, no timeout policy, no
+validation — a corrupt download poisoned the cache until expiry. This
+module is the one shared primitive every remote acquisition goes through
+(global_clock, and any future EOP/ephemeris mirror sync):
+
+- **Bounded retries with exponential backoff + jitter.** Each mirror is
+  tried once per round, rounds repeat up to ``PINT_TPU_FETCH_ATTEMPTS``
+  times (default 3) with ``PINT_TPU_FETCH_BACKOFF``-seconds base delay
+  doubling between rounds (±10% jitter so a fleet of workers doesn't
+  retry in lockstep). Tests monkeypatch :data:`_sleep` to unit-lock the
+  schedule without real waiting.
+- **Per-attempt timeouts** (``PINT_TPU_FETCH_TIMEOUT``, default 30 s)
+  on http(s) mirrors.
+- **Atomic writes**: the payload lands in a pid-suffixed temp file and
+  is renamed over the destination only after validation, so a killed
+  process or corrupt download never leaves a half-written cache entry.
+- **Post-download validation + quarantine**: payloads must be non-empty
+  and pass the caller's ``validate`` hook (parseability); a failing
+  payload is moved to a ``quarantine/`` sibling of the destination —
+  preserved for diagnosis, never served from the cache — the attempt
+  counts as failed, and the retry loop continues.
+- **Degradation ledger wiring** (ops/degrade.py): a quarantined payload
+  records ``fetch.corrupt_quarantined``; exhausting every mirror records
+  ``fetch.mirror_failed`` before :class:`FetchError` raises, so under
+  ``PINT_TPU_DEGRADED=error`` a production pipeline refuses instead of
+  silently falling back to whatever is cached.
+- **Fault injection** (pint_tpu/testing/faults.py): the ``fetch`` /
+  ``fetch.payload`` sites let tier-1 drive refusals, timeouts, and
+  corrupt payloads deterministically with no network.
+
+Mirrors may be http(s) URLs, ``file://`` URLs, or plain directories.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import time
+from pathlib import Path
+
+from pint_tpu.utils.logging import get_logger
+
+log = get_logger("pint_tpu.fetch")
+
+__all__ = ["FetchError", "fetch"]
+
+#: injectable sleep so tests lock the backoff schedule without waiting
+_sleep = time.sleep
+
+
+class FetchError(OSError):
+    """Every mirror failed for every attempt round."""
+
+    def __init__(self, msg: str, attempts: int = 0,
+                 last_error: Exception | None = None):
+        super().__init__(msg)
+        self.attempts = attempts
+        self.last_error = last_error
+
+
+def _read_mirror(base: str, name: str, timeout_s: float) -> bytes:
+    """One download attempt of `name` from the mirror at `base`."""
+    from pint_tpu.testing import faults
+
+    faults.maybe_raise("fetch", f"{base}/{name}")
+    if base.startswith(("http://", "https://")):
+        from urllib.request import urlopen
+
+        url = base.rstrip("/") + "/" + name
+        with urlopen(url, timeout=timeout_s) as r:
+            data = r.read()
+    else:
+        if base.startswith("file://"):
+            base = base[len("file://"):]
+        src = Path(base) / name
+        if not src.exists():
+            raise FileNotFoundError(f"{name} not in repository {base}")
+        data = src.read_bytes()
+    return faults.mangle("fetch.payload", data, f"{base}/{name}")
+
+
+def _quarantine(dest: Path, data: bytes, reason: str) -> None:
+    """Preserve a failed payload beside the cache, never in it."""
+    from pint_tpu.ops import degrade
+
+    qdir = dest.parent / "quarantine"
+    qdir.mkdir(parents=True, exist_ok=True)
+    qpath = qdir / dest.name
+    qpath.write_bytes(data)
+    degrade.record(
+        "fetch.corrupt_quarantined", dest.name,
+        f"downloaded payload failed validation ({reason}); preserved at "
+        f"{qpath}, cache untouched",
+        fix="inspect the quarantined file and the mirror serving it",
+    )
+
+
+def fetch(name: str, dest: Path, mirrors: list[str],
+          validate=None,
+          attempts: int | None = None,
+          backoff_s: float | None = None,
+          timeout_s: float | None = None) -> Path:
+    """Download `name` from the first healthy mirror into `dest`.
+
+    `validate(payload: bytes)` may raise (or return False) to reject a
+    corrupt payload — rejected payloads are quarantined and the attempt
+    retried. Raises :class:`FetchError` after every mirror has failed
+    `attempts` rounds; callers with a stale local copy catch it and
+    record their own degradation (e.g. ``clock.stale_cache``).
+    """
+    from pint_tpu.ops import degrade
+    from pint_tpu.utils import knobs
+
+    if not mirrors:
+        raise ValueError("fetch needs at least one mirror")
+    if attempts is None:
+        attempts = int(knobs.get("PINT_TPU_FETCH_ATTEMPTS") or 3)
+    if backoff_s is None:
+        backoff_s = float(knobs.get("PINT_TPU_FETCH_BACKOFF") or 0.5)
+    if timeout_s is None:
+        timeout_s = float(knobs.get("PINT_TPU_FETCH_TIMEOUT") or 30.0)
+
+    dest = Path(dest)
+    last_err: Exception | None = None
+    n_tried = 0
+    for round_no in range(max(attempts, 1)):
+        if round_no:
+            # exponential backoff between rounds, jittered so a worker
+            # fleet retrying the same dead mirror doesn't sync up
+            _sleep(backoff_s * (2.0 ** (round_no - 1))
+                   * (1.0 + 0.1 * random.random()))
+        for base in mirrors:  # mirror rotation within each round
+            n_tried += 1
+            try:
+                data = _read_mirror(base, name, timeout_s)
+            except Exception as e:  # jaxlint: disable=silent-except — bounded retry; exhaustion is recorded below
+                last_err = e
+                log.info(f"fetch {name} from {base} failed "
+                         f"(attempt {n_tried}): {e}")
+                continue
+            reason = None
+            if not data:
+                reason = "empty payload"
+            elif validate is not None:
+                try:
+                    if validate(data) is False:
+                        reason = "validator returned False"
+                except Exception as e:  # jaxlint: disable=silent-except — rejection is quarantined+recorded below
+                    reason = f"validator raised {type(e).__name__}: {e}"
+            if reason is not None:
+                _quarantine(dest, data, reason)
+                last_err = ValueError(f"{name}: {reason}")
+                continue
+            dest.parent.mkdir(parents=True, exist_ok=True)
+            tmp = dest.with_suffix(dest.suffix + f".tmp{os.getpid()}")
+            tmp.write_bytes(data)
+            tmp.replace(dest)
+            return dest
+    degrade.record(
+        "fetch.mirror_failed", name,
+        f"every mirror failed after {n_tried} attempts "
+        f"({len(mirrors)} mirror(s) x {attempts} round(s)); last: {last_err}",
+        fix="check the mirror list / network, or pre-populate the cache",
+    )
+    raise FetchError(
+        f"{name}: all mirrors failed after {n_tried} attempts ({last_err})",
+        attempts=n_tried, last_error=last_err,
+    )

@@ -49,6 +49,12 @@ KNOBS: dict[str, tuple[str | None, str]] = {
     # --- clocks ----------------------------------------------------------------
     "PINT_TPU_CLOCK_REPO": (None, "clock-corrections repository (https/file URL or directory)"),
     "PINT_CLOCK_OVERRIDE": (None, "directory searched first for clock files"),
+    # --- robustness layer (ops/degrade.py, utils/fetch.py, testing/faults.py) --
+    "PINT_TPU_DEGRADED": ("warn", "degradation ledger escalation: warn (default), error (raise), 0 (silent record)"),
+    "PINT_TPU_FAULTS": ("", "fault-injection spec site:mode[*N][,...] (pint_tpu/testing/faults.py)"),
+    "PINT_TPU_FETCH_ATTEMPTS": ("3", "download retry rounds per mirror (utils/fetch.py)"),
+    "PINT_TPU_FETCH_BACKOFF": ("0.5", "base seconds between download retry rounds (doubles per round)"),
+    "PINT_TPU_FETCH_TIMEOUT": ("30", "per-attempt download timeout in seconds"),
     # --- caches ----------------------------------------------------------------
     "PINT_TPU_CACHE_DIR": (None, "disk-cache root (default ~/.cache/pint_tpu)"),
 }

@@ -1,0 +1,457 @@
+"""Degradation-ledger contract (pint_tpu/ops/degrade.py): every silent
+fallback is observable, testable, and refusable.
+
+Two halves, mirroring tests/test_analysis.py:
+
+- **Fault-driven degradations**: every kind in the ledger taxonomy is
+  driven end-to-end by an injected fault (pint_tpu/testing/faults.py or
+  an engineered environment) and asserted to BOTH recover and write the
+  exact ledger event — a degradation path that silently stops recording
+  is itself the failure mode this subsystem exists to prevent.
+- **Clean-run lock**: both smoke benches run under
+  ``PINT_TPU_DEGRADED=error`` (any ledger write raises) with a properly
+  configured clock environment and must produce an EMPTY ledger — the
+  production pipeline can refuse every corner-cut and still fit.
+"""
+
+import logging
+
+import numpy as np
+import pytest
+
+from pint_tpu.ops import degrade
+from pint_tpu.testing import faults
+
+GPS2UTC = """# gps2utc.clk
+# UTC(GPS) to UTC
+40000.0 1.0e-6
+62000.0 1.0e-6
+"""
+
+TIME_GBT = """# time_gbt.dat
+ 40000.00    2.000
+ 62000.00    2.000
+"""
+
+
+@pytest.fixture(autouse=True)
+def _clean(monkeypatch):
+    """Fresh ledger + disarmed faults around every test; warn mode."""
+    monkeypatch.delenv("PINT_TPU_DEGRADED", raising=False)
+    degrade.reset_ledger()
+    faults.reset()
+    yield
+    degrade.reset_ledger()
+    faults.reset()
+
+
+@pytest.fixture()
+def no_sleep(monkeypatch):
+    import pint_tpu.utils.fetch as fetchmod
+
+    monkeypatch.setattr(fetchmod, "_sleep", lambda s: None)
+
+
+@pytest.fixture()
+def bare_clock_env(monkeypatch, tmp_path):
+    """No discoverable clock files anywhere: empty cache root, no
+    override/repo/TEMPO dirs, no programmatic search dirs."""
+    import pint_tpu.astro.clock as clock
+    import pint_tpu.astro.global_clock as gc
+
+    monkeypatch.setenv("PINT_TPU_CACHE_DIR", str(tmp_path / "cache"))
+    for var in ("PINT_CLOCK_OVERRIDE", "PINT_TPU_CLOCK_REPO", "TEMPO",
+                "TEMPO2"):
+        monkeypatch.delenv(var, raising=False)
+    monkeypatch.setattr(clock, "_search_dirs", [])
+    monkeypatch.setattr(clock, "_warned_missing", set())
+    monkeypatch.setattr(gc, "_synced", False)
+    return clock
+
+
+def _kinds():
+    return [e.kind for e in degrade.events()]
+
+
+class TestLedgerAPI:
+    def test_record_dedup_and_block(self):
+        assert degrade.record("eop.outside_table", "f.all", "5 epochs out",
+                              bound_us=1.4, fix="knob") is True
+        assert degrade.record("eop.outside_table", "f.all", "again") is False
+        blk = degrade.degradation_block()
+        assert blk["n_events"] == 1
+        assert blk["kinds"] == ["eop.outside_table"]
+        ev = blk["events"][0]
+        assert ev["count"] == 2 and ev["bound_us"] == 1.4 and ev["fix"] == "knob"
+        assert degrade.degradation_count() == 1
+
+    def test_unknown_kind_refused(self):
+        with pytest.raises(ValueError, match="not a registered degradation"):
+            degrade.record("clock.typo", "x")
+
+    def test_every_kind_documented(self):
+        for kind, doc in degrade.KINDS.items():
+            assert "." in kind and doc
+
+    def test_warn_mode_logs_once(self, caplog):
+        with caplog.at_level(logging.WARNING, logger="pint_tpu.degrade"):
+            degrade.record("clock.stale_cache", "a.clk", "stale")
+            degrade.record("clock.stale_cache", "a.clk", "stale")
+        hits = [r for r in caplog.records if "clock.stale_cache" in r.message]
+        assert len(hits) == 1
+
+    def test_error_mode_raises_but_records(self, monkeypatch):
+        monkeypatch.setenv("PINT_TPU_DEGRADED", "error")
+        with pytest.raises(degrade.DegradedError, match="clock.stale_cache"):
+            degrade.record("clock.stale_cache", "a.clk", "stale")
+        assert _kinds() == ["clock.stale_cache"]  # the refusal is on record
+
+    def test_silent_mode_records_without_logging(self, monkeypatch, caplog):
+        monkeypatch.setenv("PINT_TPU_DEGRADED", "0")
+        with caplog.at_level(logging.WARNING, logger="pint_tpu.degrade"):
+            degrade.record("clock.stale_cache", "b.clk", "stale")
+        assert _kinds() == ["clock.stale_cache"]
+        assert not [r for r in caplog.records if "stale" in r.message]
+
+    def test_block_is_json_ready(self):
+        import json
+
+        degrade.record("fetch.mirror_failed", "x", "y")
+        json.dumps(degrade.degradation_block())
+
+
+class TestClockDegradations:
+    def test_missing_clock_files_zero_corrections_event(self, bare_clock_env):
+        """Injected fault: an environment with NO clock files. The chain
+        recovers (zero corrections) and writes clock.zero_corrections."""
+        chain = bare_clock_env.get_clock_chain("hobart")
+        corr = chain.evaluate(np.array([55000.0]))
+        assert corr[0] == 0.0  # recovery: zero corrections, no crash
+        evs = degrade.events()
+        assert [e.kind for e in evs] == ["clock.zero_corrections"]
+        assert evs[0].component == "hobart"
+        assert evs[0].bound_us == 5.0
+        assert "PINT_CLOCK_OVERRIDE" in evs[0].fix
+
+    def test_zero_corrections_refusable(self, bare_clock_env, monkeypatch):
+        monkeypatch.setenv("PINT_TPU_DEGRADED", "error")
+        with pytest.raises(degrade.DegradedError,
+                           match="clock.zero_corrections"):
+            bare_clock_env.get_clock_chain("hobart")
+
+    def test_beyond_table_warns_once_and_records_once(self, caplog):
+        """The warning used to fire on EVERY evaluation (every LM trial);
+        now it is one log line + one ledger entry with a bump count."""
+        from pint_tpu.astro.clock import ClockFile
+
+        cf = ClockFile(np.array([55000.0, 55100.0]), np.array([1e-6, 2e-6]),
+                       name="beyond_test.clk")
+        with caplog.at_level(logging.WARNING):
+            v1 = cf.evaluate(np.array([55500.0]))
+            v2 = cf.evaluate(np.array([55500.0]))
+        np.testing.assert_allclose([v1[0], v2[0]], 2e-6)  # holds last entry
+        warns = [r for r in caplog.records if "beyond last entry" in r.message]
+        assert len(warns) == 1  # once per clock file, not per evaluation
+        evs = [e for e in degrade.events() if e.kind == "clock.beyond_table"]
+        assert len(evs) == 1 and evs[0].count == 2
+
+    def test_beyond_table_error_mode_still_valueerror(self):
+        from pint_tpu.astro.clock import ClockFile
+
+        cf = ClockFile(np.array([55000.0]), np.array([1e-6]), name="e.clk",
+                       valid_beyond="error")
+        with pytest.raises(ValueError, match="beyond last entry"):
+            cf.evaluate(np.array([60000.0]))
+
+
+@pytest.fixture()
+def clock_mirror(tmp_path, monkeypatch, no_sleep):
+    """A local clock repository + isolated cache (test_global_clock's
+    fixture, minus network)."""
+    repo = tmp_path / "repo"
+    (repo / "T2runtime" / "clock").mkdir(parents=True)
+    (repo / "index.txt").write_text(
+        "T2runtime/clock/gps2utc.clk 7.0 ---\n")
+    (repo / "T2runtime" / "clock" / "gps2utc.clk").write_text(GPS2UTC)
+    monkeypatch.setenv("PINT_TPU_CACHE_DIR", str(tmp_path / "cache"))
+    monkeypatch.setenv("PINT_TPU_CLOCK_REPO", str(repo))
+    import pint_tpu.astro.global_clock as gc
+
+    monkeypatch.setattr(gc, "_synced", False)
+    return repo
+
+
+class TestFetchDegradations:
+    def test_refused_network_stale_cache_fallback(self, clock_mirror):
+        """Injected connection refusals on a stale cache: get_file serves
+        the stale copy and records BOTH fetch.mirror_failed and
+        clock.stale_cache."""
+        import os
+        import time
+
+        from pint_tpu.astro.global_clock import get_file
+
+        p = get_file("T2runtime/clock/gps2utc.clk")
+        old = time.time() - 30 * 86400
+        os.utime(p, (old, old))
+        faults.arm("fetch", "refuse", times=None)
+        p2 = get_file("T2runtime/clock/gps2utc.clk")
+        assert p2 == p and p2.exists()  # recovery: stale copy served
+        kinds = set(_kinds())
+        assert kinds == {"fetch.mirror_failed", "clock.stale_cache"}
+        stale = next(e for e in degrade.events()
+                     if e.kind == "clock.stale_cache")
+        assert stale.component == "gps2utc.clk"
+        assert "mirror failed" in stale.detail and stale.bound_us == 1.0
+
+    def test_stale_cache_refusable(self, clock_mirror, monkeypatch):
+        import os
+        import time
+
+        from pint_tpu.astro.global_clock import get_file
+
+        p = get_file("T2runtime/clock/gps2utc.clk")
+        os.utime(p, (time.time() - 30 * 86400,) * 2)
+        faults.arm("fetch", "refuse", times=None)
+        monkeypatch.setenv("PINT_TPU_DEGRADED", "error")
+        with pytest.raises(degrade.DegradedError):
+            get_file("T2runtime/clock/gps2utc.clk")
+
+    def test_corrupt_download_quarantined_and_retried(self, clock_mirror):
+        """Injected truncated payload: quarantined (never cached), the
+        retry lands the clean copy."""
+        from pint_tpu.astro.global_clock import cache_dir, get_file
+
+        faults.arm("fetch.payload", "truncate", times=1)
+        p = get_file("T2runtime/clock/gps2utc.clk",
+                     download_policy="always")
+        assert p.read_text() == GPS2UTC  # recovery: clean retry
+        assert (cache_dir() / "quarantine" / "gps2utc.clk").exists()
+        assert _kinds() == ["fetch.corrupt_quarantined"]
+
+    def test_binary_garbage_rejected_by_validator(self, clock_mirror):
+        """The clock-text validation hook: NUL-laden payloads quarantine
+        even though they are non-empty."""
+        from pint_tpu.astro.global_clock import get_file
+
+        faults.arm("fetch.payload", "corrupt", times=1)
+        p = get_file("T2runtime/clock/gps2utc.clk",
+                     download_policy="always")
+        assert p.read_text() == GPS2UTC
+        assert _kinds() == ["fetch.corrupt_quarantined"]
+
+    def test_unknown_index_name_lists_entries(self, clock_mirror):
+        from pint_tpu.astro.global_clock import get_clock_correction_file
+
+        with pytest.raises(KeyError, match="gps2utc.clk"):
+            get_clock_correction_file("nonexistent.clk")
+
+
+class TestEOPDegradation:
+    def test_outside_table_zero_fallback_event(self, tmp_path, monkeypatch):
+        from test_eop import _write_finals
+
+        from pint_tpu.astro import eop
+
+        mjds = np.arange(56000.0, 56010.0)
+        p = tmp_path / "finals2000A.all"
+        _write_finals(str(p), mjds, np.full(10, -0.3), np.full(10, 0.05),
+                      np.full(10, 0.30))
+        monkeypatch.setenv("PINT_TPU_EOP", str(p))
+        monkeypatch.setattr(eop, "_table", None)
+        d, x, y = eop.get_eop(np.array([56005.0, 40000.0]))
+        assert d[1] == 0.0 and x[1] == 0.0  # recovery: zero outside
+        assert d[0] != 0.0  # inside the table still served
+        evs = degrade.events()
+        assert [e.kind for e in evs] == ["eop.outside_table"]
+        assert evs[0].bound_us == 1.4
+        assert "1 epochs outside" in evs[0].detail
+
+
+class TestEphemerisDegradation:
+    def test_de_request_served_by_analytic(self, monkeypatch):
+        from pint_tpu.astro.ephemeris import AnalyticEphemeris, get_ephemeris
+
+        monkeypatch.delenv("PINT_TPU_EPHEM", raising=False)
+        eph = get_ephemeris("DE421")
+        assert isinstance(eph, AnalyticEphemeris)  # recovery
+        evs = degrade.events()
+        assert [e.kind for e in evs] == ["ephemeris.analytic_fallback"]
+        assert evs[0].component == "DE421" and evs[0].bound_us == 200.0
+
+    def test_missing_configured_kernel(self, monkeypatch, tmp_path):
+        from pint_tpu.astro.ephemeris import AnalyticEphemeris, get_ephemeris
+
+        monkeypatch.setenv("PINT_TPU_EPHEM", str(tmp_path / "no_such.bsp"))
+        eph = get_ephemeris()
+        assert isinstance(eph, AnalyticEphemeris)
+        evs = degrade.events()
+        assert [e.kind for e in evs] == ["ephemeris.analytic_fallback"]
+        assert "does not exist" in evs[0].detail
+
+    def test_auto_request_is_not_a_degradation(self, monkeypatch):
+        from pint_tpu.astro.ephemeris import get_ephemeris
+
+        monkeypatch.delenv("PINT_TPU_EPHEM", raising=False)
+        get_ephemeris("auto")
+        get_ephemeris("analytic")
+        assert degrade.events() == []
+
+    def test_refusable(self, monkeypatch):
+        from pint_tpu.astro.ephemeris import get_ephemeris
+
+        monkeypatch.delenv("PINT_TPU_EPHEM", raising=False)
+        monkeypatch.setenv("PINT_TPU_DEGRADED", "error")
+        with pytest.raises(degrade.DegradedError,
+                           match="ephemeris.analytic_fallback"):
+            get_ephemeris("DE440")
+
+
+class TestObservatoryDegradation:
+    def test_partial_velocity_flags_zeroed_with_event(self):
+        from pint_tpu.astro.observatories import get_observatory
+
+        ob = get_observatory("stl_geo")
+        flags = [
+            {"telx": "1000.0", "tely": "0.0", "telz": "0.0",
+             "vx": "1.0", "vy": "2.0", "vz": "-3.0"},
+            {"telx": "1000.0", "tely": "0.0", "telz": "0.0"},
+        ]
+        pos, vel = ob.site_posvel_gcrs_flags(flags)
+        np.testing.assert_allclose(vel[1], 0.0)  # recovery: zeros
+        np.testing.assert_allclose(vel[0], [1e3, 2e3, -3e3])
+        evs = degrade.events()
+        assert [e.kind for e in evs] == ["obs.zero_velocity"]
+        assert "1 of 2" in evs[0].detail
+
+
+class TestFitHostFallback:
+    def test_adaptive_fused_nan_poison_latches_and_records(self):
+        """Injected NaN in the fused step output: the dispatcher recomputes
+        on the host, latches sticky, and writes fit.host_fallback."""
+        from pint_tpu.ops.compile import adaptive_fused
+
+        calls = {"fused": 0}
+
+        def fused(x):
+            calls["fused"] += 1
+            return np.float64(x) + 1.0
+
+        call = adaptive_fused(
+            fused, lambda x: np.float64(x) + 1.0,
+            lambda o: bool(np.isfinite(o).all()), "demo step", forced=False)
+        faults.arm("fit.step", "nan", times=1)
+        out = call(1.0)
+        assert float(out) == 2.0  # recovery: host answer
+        assert call.solve_path == "host"
+        assert call.latch_reason == "device_nonfinite_host_clean"
+        evs = [e for e in degrade.events() if e.kind == "fit.host_fallback"]
+        assert len(evs) == 1 and evs[0].component == "demo step"
+        # sticky: the second call never probes the fused path again
+        call(1.0)
+        assert calls["fused"] == 1
+
+    def test_fused_fit_program_nan_poison_host_loop_recovers(self):
+        """End to end: the fused on-device LM program's output is
+        NaN-poisoned; the fitter falls back to the host LM loop, the fit
+        still lands, and fused_wls_fit is on the ledger."""
+        from pint_tpu.fitting import DownhillWLSFitter
+        from pint_tpu.io.par import parse_parfile
+        from pint_tpu.models.builder import build_model
+        from pint_tpu.simulation import make_fake_toas_uniform
+
+        par = """
+        PSR FAULT
+        RAJ 04:37:15.9 1
+        DECJ -47:15:09.1 1
+        F0 173.6879489990983 1
+        F1 -1.728e-15 1
+        PEPOCH 55000
+        DM 2.64
+        """
+        model = build_model(parse_parfile(par, from_text=True))
+        toas = make_fake_toas_uniform(
+            54800, 55200, 60, model, obs="gbt", freq_mhz=1400.0,
+            error_us=1.0, add_noise=True, rng=np.random.default_rng(3))
+        ftr = DownhillWLSFitter(toas, model, fused=True)
+        faults.arm("fit.fused", "nan", times=1)
+        res = ftr.fit_toas(maxiter=3)
+        assert np.isfinite(res.chi2)  # recovery: host loop finished the fit
+        evs = [e for e in degrade.events() if e.kind == "fit.host_fallback"]
+        assert [e.component for e in evs] == ["fused_wls_fit"]
+        assert ftr._fused is False  # sticky structural fallback
+
+
+def _write_clock_dir(path):
+    path.mkdir(parents=True, exist_ok=True)
+    (path / "time_gbt.dat").write_text(TIME_GBT)
+    (path / "gps2utc.clk").write_text(GPS2UTC)
+
+
+class TestCleanRunContract:
+    """Acceptance: a properly configured pipeline cuts NO corners — both
+    smoke benches run with every ledger write escalated to a raise
+    (PINT_TPU_DEGRADED=error) and end with an empty ledger."""
+
+    def test_smoke_bench_empty_ledger_strict(self, tmp_path, monkeypatch):
+        import bench
+
+        _write_clock_dir(tmp_path / "clk")
+        monkeypatch.setenv("PINT_CLOCK_OVERRIDE", str(tmp_path / "clk"))
+        monkeypatch.setenv("PINT_TPU_DEGRADED", "error")
+        degrade.reset_ledger()
+        rec = bench.smoke_bench(ntoas=120, maxiter=2)
+        assert rec["degradation_count"] == 0
+        assert rec["degradation_kinds"] == []
+        assert rec["degradations"]["n_events"] == 0
+        assert rec["degradations"]["mode"] == "error"
+
+    def test_sharded_smoke_bench_empty_ledger_strict(self, tmp_path,
+                                                     monkeypatch):
+        import jax
+
+        import bench
+
+        if len(jax.devices()) < 2:
+            pytest.skip("needs the multi-device virtual mesh")
+        _write_clock_dir(tmp_path / "clk")
+        monkeypatch.setenv("PINT_CLOCK_OVERRIDE", str(tmp_path / "clk"))
+        monkeypatch.setenv("PINT_TPU_DEGRADED", "error")
+        degrade.reset_ledger()
+        rec = bench.smoke_bench(ntoas=150, maxiter=3, sharded=True)
+        assert rec["degradation_count"] == 0
+        assert rec["degradations"]["n_events"] == 0
+
+    def test_degradations_block_rides_fit_result_perf(self):
+        """FitResult.perf and Residuals both carry the ledger block."""
+        import bench
+
+        degrade.record("eop.outside_table", "ride.along", "x", bound_us=1.4)
+        rec = bench.smoke_bench(ntoas=120, maxiter=2)
+        blk = rec["degradations"]
+        assert blk["n_events"] >= 1
+        assert "eop.outside_table" in blk["kinds"]
+        assert rec["degradation_count"] == blk["n_events"]
+
+    def test_residuals_surface(self):
+        from pint_tpu.io.par import parse_parfile
+        from pint_tpu.models.builder import build_model
+        from pint_tpu.residuals import Residuals
+        from pint_tpu.simulation import make_fake_toas_uniform
+
+        par = """
+        PSR SURF
+        RAJ 04:37:15.9 1
+        DECJ -47:15:09.1 1
+        F0 100.0 1
+        PEPOCH 55000
+        DM 2.64
+        """
+        model = build_model(parse_parfile(par, from_text=True))
+        toas = make_fake_toas_uniform(54900, 55100, 20, model, obs="gbt",
+                                      freq_mhz=1400.0, error_us=1.0)
+        degrade.reset_ledger()
+        degrade.record("clock.stale_cache", "surface.clk", "aged")
+        res = Residuals(toas, model)
+        blk = res.degradations
+        assert blk["kinds"] == ["clock.stale_cache"]

@@ -67,6 +67,38 @@ def test_examples_run(tmp_path, monkeypatch):
 
 
 
+def test_robustness_walkthrough_runs(tmp_path, monkeypatch):
+    """docs/ROBUSTNESS.md is executable WITHOUT reference data or network
+    (local mirror + fault injection only) and runs in tier-1: the
+    degradation-ledger walkthrough a pipeline operator copies from must
+    keep working verbatim."""
+    blocks = extract_blocks(DOCS / "ROBUSTNESS.md")
+    assert len(blocks) >= 5, "ROBUSTNESS.md lost its executable blocks"
+    monkeypatch.chdir(tmp_path)
+    # the blocks set/clean their own env vars; monkeypatch registers the
+    # originals so a mid-block failure cannot leak state into the suite
+    for var in ("PINT_TPU_CACHE_DIR", "PINT_TPU_CLOCK_REPO",
+                "PINT_TPU_DEGRADED", "PINT_TPU_EPHEM"):
+        monkeypatch.delenv(var, raising=False)
+    from pint_tpu.ops.degrade import reset_ledger
+    from pint_tpu.testing import faults
+
+    reset_ledger()
+    faults.reset()
+    ns: dict = {}
+    try:
+        for i, block in enumerate(blocks):
+            try:
+                exec(compile(block, f"ROBUSTNESS.md[block {i}]", "exec"), ns)
+            except Exception as e:
+                pytest.fail(
+                    f"ROBUSTNESS.md block {i} failed: "
+                    f"{type(e).__name__}: {e}\n{block}")
+    finally:
+        reset_ledger()
+        faults.reset()
+
+
 def test_analysis_walkthrough_runs(tmp_path, monkeypatch):
     """docs/ANALYSIS.md is executable WITHOUT reference data (synthetic
     TOAs only) and runs in tier-1: the auditor walkthrough a user copies

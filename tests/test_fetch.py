@@ -1,0 +1,177 @@
+"""Resilient fetch core contract (pint_tpu/utils/fetch.py).
+
+Everything here runs against temp-dir mirrors and the fault-injection
+harness (pint_tpu/testing/faults.py) — no network, no real sleeping
+(:data:`fetch._sleep` is monkeypatched). Locked behaviors: per-mirror
+retry rounds with exponential backoff + jitter, mirror rotation order,
+atomic writes, validation with quarantine (a corrupt download never
+reaches the cache), and the ``fetch.mirror_failed`` /
+``fetch.corrupt_quarantined`` degradation-ledger events.
+"""
+
+from pathlib import Path
+
+import pytest
+
+import pint_tpu.utils.fetch as fetchmod
+from pint_tpu.ops import degrade
+from pint_tpu.testing import faults
+from pint_tpu.utils.fetch import FetchError, fetch
+
+
+@pytest.fixture(autouse=True)
+def _isolated(monkeypatch):
+    """No real sleeping, no armed faults, a fresh ledger."""
+    delays: list[float] = []
+    monkeypatch.setattr(fetchmod, "_sleep", delays.append)
+    faults.reset()
+    degrade.reset_ledger()
+    yield delays
+    faults.reset()
+    degrade.reset_ledger()
+
+
+@pytest.fixture()
+def mirror(tmp_path):
+    repo = tmp_path / "repo"
+    repo.mkdir()
+    (repo / "data.txt").write_text("payload-v1\n")
+    return repo
+
+
+class TestRetrySchedule:
+    def test_succeeds_first_try_no_sleep(self, mirror, tmp_path, _isolated):
+        dest = tmp_path / "cache" / "data.txt"
+        p = fetch("data.txt", dest, [str(mirror)])
+        assert p.read_text() == "payload-v1\n"
+        assert _isolated == []  # no backoff on success
+
+    def test_retries_with_exponential_backoff(self, mirror, tmp_path,
+                                              _isolated):
+        """2 injected refusals -> success on round 3; the two inter-round
+        delays grow exponentially (base * 2^k, +0..10% jitter)."""
+        dest = tmp_path / "cache" / "data.txt"
+        faults.arm("fetch", "refuse", times=2)
+        p = fetch("data.txt", dest, [str(mirror)], backoff_s=0.5)
+        assert p.read_text() == "payload-v1\n"
+        assert [f[1] for f in faults.fired] == ["refuse", "refuse"]
+        assert len(_isolated) == 2
+        assert 0.5 <= _isolated[0] <= 0.55
+        assert 1.0 <= _isolated[1] <= 1.1
+
+    def test_attempt_count_is_bounded(self, mirror, tmp_path):
+        """A permanently-dead mirror is tried exactly `attempts` rounds,
+        then FetchError carries the attempt count."""
+        dest = tmp_path / "cache" / "data.txt"
+        faults.arm("fetch", "timeout", times=None)  # every attempt
+        with pytest.raises(FetchError) as ei:
+            fetch("data.txt", dest, [str(mirror)], attempts=3)
+        assert ei.value.attempts == 3
+        assert isinstance(ei.value.last_error, TimeoutError)
+        assert not dest.exists()  # nothing half-written
+
+    def test_mirror_rotation_within_rounds(self, mirror, tmp_path):
+        """Both mirrors are tried in order within each round: with 2
+        mirrors and 2 rounds, 4 attempts alternate A,B,A,B."""
+        dead = tmp_path / "dead"  # missing dir: FileNotFoundError per try
+        dest = tmp_path / "cache" / "nope.txt"
+        faults.arm("fetch", "refuse", times=None)
+        with pytest.raises(FetchError) as ei:
+            fetch("nope.txt", dest, [str(dead), str(mirror)], attempts=2)
+        assert ei.value.attempts == 4
+        contexts = [c for _, _, c in faults.fired]
+        assert contexts == [f"{dead}/nope.txt", f"{mirror}/nope.txt"] * 2
+
+    def test_env_knob_attempts(self, mirror, tmp_path, monkeypatch):
+        monkeypatch.setenv("PINT_TPU_FETCH_ATTEMPTS", "1")
+        dest = tmp_path / "cache" / "data.txt"
+        faults.arm("fetch", "refuse", times=None)
+        with pytest.raises(FetchError) as ei:
+            fetch("data.txt", dest, [str(mirror)])
+        assert ei.value.attempts == 1
+
+    def test_exhaustion_records_mirror_failed(self, mirror, tmp_path):
+        dest = tmp_path / "cache" / "data.txt"
+        faults.arm("fetch", "refuse", times=None)
+        with pytest.raises(FetchError):
+            fetch("data.txt", dest, [str(mirror)], attempts=2)
+        evs = degrade.events()
+        assert [e.kind for e in evs] == ["fetch.mirror_failed"]
+        assert evs[0].component == "data.txt"
+        assert "2 attempts" in evs[0].detail
+
+
+class TestValidationQuarantine:
+    def test_empty_payload_quarantined_then_retried(self, mirror, tmp_path):
+        """An injected truncated download is quarantined — preserved
+        beside the cache, never in it — and the retry succeeds."""
+        dest = tmp_path / "cache" / "data.txt"
+        faults.arm("fetch.payload", "truncate", times=1)
+        p = fetch("data.txt", dest, [str(mirror)])
+        assert p.read_text() == "payload-v1\n"  # clean retry won
+        q = dest.parent / "quarantine" / "data.txt"
+        assert q.exists() and q.read_bytes() == b""
+        assert [e.kind for e in degrade.events()] == [
+            "fetch.corrupt_quarantined"]
+
+    def test_caller_validate_hook(self, mirror, tmp_path):
+        """The parseable-by-caller hook: a validator that rejects the
+        payload quarantines it; the cache keeps the last good copy."""
+        dest = tmp_path / "cache" / "data.txt"
+        dest.parent.mkdir(parents=True)
+        dest.write_text("previous-good\n")
+
+        def validate(data: bytes):
+            raise ValueError("not parseable")
+
+        with pytest.raises(FetchError):
+            fetch("data.txt", dest, [str(mirror)], validate=validate,
+                  attempts=1)
+        assert dest.read_text() == "previous-good\n"  # cache not poisoned
+        q = dest.parent / "quarantine" / "data.txt"
+        assert q.read_text() == "payload-v1\n"
+        kinds = {e.kind for e in degrade.events()}
+        assert kinds == {"fetch.corrupt_quarantined", "fetch.mirror_failed"}
+
+    def test_validator_returning_false(self, mirror, tmp_path):
+        dest = tmp_path / "cache" / "data.txt"
+        with pytest.raises(FetchError):
+            fetch("data.txt", dest, [str(mirror)],
+                  validate=lambda d: False, attempts=1)
+        assert not dest.exists()
+
+    def test_atomic_write_leaves_no_tmp(self, mirror, tmp_path):
+        dest = tmp_path / "cache" / "data.txt"
+        fetch("data.txt", dest, [str(mirror)])
+        leftovers = [p for p in dest.parent.iterdir() if ".tmp" in p.name]
+        assert leftovers == []
+
+
+class TestFaultHarness:
+    def test_env_spec_arming(self, mirror, tmp_path, monkeypatch):
+        """PINT_TPU_FAULTS arms whole-process faults: site:mode*N."""
+        monkeypatch.setenv("PINT_TPU_FAULTS", "fetch:refuse*1")
+        assert faults.armed("fetch")
+        dest = tmp_path / "cache" / "data.txt"
+        p = fetch("data.txt", dest, [str(mirror)])
+        assert p.read_text() == "payload-v1\n"
+        assert [m for _, m, _ in faults.fired] == ["refuse"]
+        assert not faults.armed("fetch")  # *1 consumed
+
+    def test_programmatic_reset(self):
+        faults.arm("fetch", "refuse", times=None)
+        assert faults.armed("fetch")
+        faults.reset()
+        assert not faults.armed("fetch")
+
+    def test_poison_nonfinite_floats_only(self):
+        import numpy as np
+
+        faults.arm("fit.fused", "nan", times=1)
+        arr, n = faults.poison_nonfinite("fit.fused",
+                                         (np.arange(2.0), np.int32(7)))
+        assert np.isnan(arr).all()
+        assert int(n) == 7  # non-float leaves untouched
+        # consumed: inert afterwards
+        arr2, = faults.poison_nonfinite("fit.fused", (np.arange(2.0),))
+        assert np.isfinite(arr2).all()

@@ -33,6 +33,14 @@ tempo/clock/time_gbt.dat 7.0 ---
 """
 
 
+@pytest.fixture(autouse=True)
+def _no_sleep(monkeypatch):
+    """The fetch core's retry backoff must not slow the suite."""
+    import pint_tpu.utils.fetch as fetchmod
+
+    monkeypatch.setattr(fetchmod, "_sleep", lambda s: None)
+
+
 @pytest.fixture()
 def mirror(tmp_path, monkeypatch):
     """A local repository mirror + an isolated cache dir."""
@@ -106,11 +114,16 @@ class TestGlobalClock:
         p2 = get_file("T2runtime/clock/gps2utc.clk")
         assert p2 == p and p2.exists()
 
-    def test_unknown_file_raises_keyerror(self, mirror):
+    def test_unknown_file_raises_descriptive_keyerror(self, mirror):
+        """Unknown names raise a KeyError that LISTS the available index
+        entries instead of the bare index.files[filename] lookup."""
         from pint_tpu.astro.global_clock import get_clock_correction_file
 
-        with pytest.raises(KeyError):
+        with pytest.raises(KeyError) as ei:
             get_clock_correction_file("nonexistent.clk")
+        msg = str(ei.value)
+        assert "nonexistent.clk" in msg
+        assert "gps2utc.clk" in msg and "time_gbt.dat" in msg
 
     def test_clock_chain_uses_repository(self, mirror):
         """End to end: a configured repository feeds get_clock_chain with

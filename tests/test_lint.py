@@ -179,6 +179,80 @@ class TestHostSyncInLoop:
         assert _lint(src) == []
 
 
+SILENT_EXCEPT = """
+def f(g):
+    try:
+        return g()
+    except Exception:
+        return None
+"""
+
+SILENT_BARE = """
+def f(g):
+    try:
+        g()
+    except:
+        pass
+"""
+
+SILENT_OK_RERAISE = """
+def f(g):
+    try:
+        g()
+    except Exception as e:
+        raise RuntimeError("wrapped") from e
+"""
+
+SILENT_OK_LEDGER = """
+from pint_tpu.ops import degrade
+
+def f(g):
+    try:
+        g()
+    except Exception as e:
+        degrade.record("fetch.mirror_failed", "x", str(e))
+"""
+
+SILENT_OK_NARROW = """
+def f(g):
+    try:
+        g()
+    except (ValueError, OSError):
+        pass
+"""
+
+
+class TestSilentExcept:
+    def test_fires_on_swallowed_broad_except(self):
+        assert _rules(_lint(SILENT_EXCEPT)) == ["silent-except"]
+
+    def test_fires_on_bare_except(self):
+        assert _rules(_lint(SILENT_BARE)) == ["silent-except"]
+
+    def test_fires_on_broad_member_of_tuple(self):
+        src = ("def f(g):\n    try:\n        g()\n"
+               "    except (ValueError, Exception):\n        pass\n")
+        assert _rules(_lint(src)) == ["silent-except"]
+
+    def test_reraise_exempt(self):
+        assert _lint(SILENT_OK_RERAISE) == []
+
+    def test_ledger_write_exempt(self):
+        """A handler that records the degradation (degrade.record) keeps
+        the failure observable — the whole point of the rule."""
+        assert _lint(SILENT_OK_LEDGER) == []
+
+    def test_narrow_catch_exempt(self):
+        assert _lint(SILENT_OK_NARROW) == []
+
+    def test_inline_suppression(self):
+        src = ("def f(g):\n    try:\n        g()\n"
+               "    except Exception:  "
+               "# jaxlint: disable=silent-except — best-effort warmup\n"
+               "        pass\n")
+        assert _lint(src) == []
+
+
 class TestConfig:
     def test_pyproject_block_parsed(self):
         cfg = load_config(REPO)
