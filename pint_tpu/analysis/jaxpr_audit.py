@@ -44,6 +44,11 @@ Passes (each returns a list of human-readable violation details):
     canonicalized program has exactly one signature per shape; a
     dtype-only second signature is the PR-2 bug class (duplicate
     compile of the same logical program).
+``batch-retrace``
+    A fleet program (``batched_*``, fitting/batch.py) compiling any
+    second signature: bucket reuse is a contract — one compile per
+    (bucket, model-skeleton), so a per-element shape leaking past the
+    bucket padding is a violation, not just a perf regression.
 
 Results accumulate in a process-global ledger; ``audit_block()``
 snapshots it for ``FitResult.perf`` / the bench headline. The
@@ -297,6 +302,23 @@ def _pass_retrace_budget(ctx: _Ctx) -> list[str]:
     return out
 
 
+def _pass_batch_retrace(ctx: _Ctx) -> list[str]:
+    """Bucket reuse is a contract for fleet programs (fitting/batch.py):
+    one compile per (bucket, model-skeleton) signature. A batched program
+    (label ``batched_*``) compiling ANY second signature means a
+    per-element recompile leaked through the bucketing — a new dataset
+    size must land in a bucket (new program instance), never retrace an
+    existing one."""
+    if not ctx.label.startswith("batched_") or not ctx.prior_sigs:
+        return []
+    return [
+        f"fleet program compiled signature #{len(ctx.prior_sigs) + 1}: "
+        "one compile per (bucket, model-skeleton) is the batched-fit "
+        "contract — per-element shapes must be bucket-padded and stacked "
+        "before the program sees them (fitting/batch.py bucket_rows)"
+    ]
+
+
 #: the registered pass pipeline (name, fn) — pluggable: tests and
 #: downstream code may append passes; audit_block reports the count
 PASSES: list[tuple[str, object]] = [
@@ -306,6 +328,7 @@ PASSES: list[tuple[str, object]] = [
     ("collectives", _pass_collectives),
     ("host-sync", _pass_host_sync),
     ("retrace-budget", _pass_retrace_budget),
+    ("batch-retrace", _pass_batch_retrace),
 ]
 
 

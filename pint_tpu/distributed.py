@@ -36,7 +36,8 @@ from pint_tpu.utils.logging import get_logger
 
 log = get_logger("pint_tpu.distributed")
 
-__all__ = ["initialize", "fit_mesh", "global_mesh", "process_info"]
+__all__ = ["batch_fit_mesh", "initialize", "fit_mesh", "global_mesh",
+           "process_info"]
 
 
 def _init_args(
@@ -202,6 +203,34 @@ def fit_mesh(devices=None, axis: str = "toa"):
     if len(devs) < 2:
         return None
     return global_mesh({axis: -1}, devices=devs)
+
+
+def batch_fit_mesh(devices=None, batch_axis: str = "batch",
+                   toa_axis: str = "toa", batch: int | None = None,
+                   toa: int | None = None):
+    """2-D (batch, toa) mesh for fleet fitting (fitting/batch.py).
+
+    The batch axis shards independent fleet elements (no collective —
+    embarrassingly parallel); the toa axis shards each element's rows
+    exactly as the single-fit sharded path, completing the per-element
+    normal equations with one psum. Default layout puts every device on
+    the batch axis (``{"batch": -1, "toa": 1}``); pass explicit sizes to
+    trade batch parallelism for row parallelism (one of them may be -1).
+    Returns None with fewer than two devices — the batched program then
+    runs unsharded, same arithmetic.
+    """
+    import jax
+
+    devs = list(devices if devices is not None else jax.devices())
+    if len(devs) < 2:
+        return None
+    if batch is None and toa is None:
+        batch, toa = -1, 1
+    elif batch is None:
+        batch = -1
+    elif toa is None:
+        toa = -1
+    return global_mesh({batch_axis: batch, toa_axis: toa}, devices=devs)
 
 
 def process_info() -> dict:

@@ -59,6 +59,75 @@ def add_dmx_to_model(model, ranges) -> None:
     model.clear_caches()  # structural change: new component/columns
 
 
+def dmx_batch_refit(fitter, ranges=None, bin_width_d: float = 6.5,
+                    mesh=None, maxiter: int = 20,
+                    batch_axis: str = "batch", toa_axis: str = "toa") -> dict:
+    """Per-window DMX refits as ONE fleet fit (fitting/batch.py).
+
+    Each window becomes an independent mini-fit: the window's TOAs, a
+    copy of the model with every timing parameter frozen and a SINGLE
+    free DMX window covering the range — so all windows share one model
+    skeleton and batch into bucketed fused LM programs despite ragged
+    per-window TOA counts. This is the NANOGrav dmxparse workflow turned
+    into a batched-serving workload: B windows, one (or a few) compiled
+    programs, one device sync.
+
+    `ranges` defaults to `dmx_ranges(toas, bin_width_d)`. Returns the
+    dmxparse-shaped dict (dmxs / dmx_verrs / dmx_epochs / r1s / r2s)
+    plus the per-window FitResults and TOA counts.
+    """
+    import copy
+
+    from pint_tpu.fitting.batch import fit_batch
+    from pint_tpu.fitting.wls import DownhillWLSFitter
+    from pint_tpu.models.dispersion import DispersionDMX
+
+    model = fitter.model
+    toas = fitter.toas
+    if ranges is None:
+        ranges = dmx_ranges(toas, bin_width_d=bin_width_d)
+    mjd = toas.tdb.mjd_float()
+
+    def window_model(r1, r2):
+        m = copy.deepcopy(model)
+        for c in [c for c in m.components if isinstance(c, DispersionDMX)]:
+            for name in list(c.specs):
+                m.params.pop(name, None)
+                m.param_meta.pop(name, None)
+            m.components.remove(c)
+        for meta in m.param_meta.values():
+            meta.frozen = True  # timing solution held fixed per window
+        add_dmx_to_model(m, [(r1, r2)])
+        return m
+
+    kept, fleet = [], []
+    for r1, r2 in ranges:
+        sel = (mjd >= r1) & (mjd <= r2)
+        if not sel.any():
+            continue
+        kept.append((r1, r2))
+        fleet.append(DownhillWLSFitter(toas.select(sel), window_model(r1, r2)))
+    if not fleet:
+        raise ValueError("no DMX window contains any TOA")
+    results = fit_batch(fleet, maxiter=maxiter, mesh=mesh,
+                        batch_axis=batch_axis, toa_axis=toa_axis)
+    r1s = np.array([r[0] for r in kept])
+    r2s = np.array([r[1] for r in kept])
+    return {
+        "dmxs": np.array([
+            float(np.asarray(f.model.params["DMX_0001"])) for f in fleet
+        ]),
+        "dmx_verrs": np.array([
+            r.uncertainties.get("DMX_0001", np.nan) for r in results
+        ]),
+        "dmx_epochs": 0.5 * (r1s + r2s),
+        "r1s": r1s,
+        "r2s": r2s,
+        "ntoas": np.array([len(f.resids.errors_s) for f in fleet]),
+        "results": results,
+    }
+
+
 def dmxparse(fitter) -> dict:
     """Fitted DMX time series with covariance-corrected errors (reference
     dmxparse:893: verr_i = sqrt(var_i + mean-DMX variance - 2 cov_i,mean),

@@ -1,0 +1,570 @@
+"""Fleet fitting: B independent downhill fits as ONE device program.
+
+The fused, TOA-sharded LM loop (fitting/sharded.py) makes one fit fast,
+but every heavy real workload — Monte-Carlo uncertainty over fake-TOA
+realizations (simulation.monte_carlo_uncertainty), per-window DMX refits
+(dmxutils.dmx_batch_refit), WLS-vs-GLS recovery sweeps
+(validation/wls_vs_gls.py), multi-pulsar arrays — runs MANY structurally
+identical fits, paying program launch + host sync per dataset while the
+batch dimension of the chip sits idle. This module is the batched-serving
+shape for fitting:
+
+- **Skeleton grouping.** Fitters are grouped by model skeleton (fit kind,
+  free-parameter set, xprec backend, component structure) plus the exact
+  pytree signature of their parameters and prepared fit data — the same
+  "same structure, different numbers" contract `calculate_random_models`
+  exploits for its vmapped residual batch. Anything numeric rides the
+  stacked operands; the compiled program depends only on the skeleton.
+- **Bucketed padding.** Ragged TOA counts are padded up to power-of-two
+  row buckets with weight-zero pad rows (`shard_fit_rows` fills: inf
+  sigma, zero weights/mask), so ONE compiled executable serves every
+  dataset in a bucket and a new dataset size costs a bucket compile, not
+  a per-dataset compile. Padding cost is observable, not asserted:
+  `padding_waste_frac` / `bucket_occupancy` / `compile_reuse` land in the
+  fit breakdown (ops/perf.py) and the smoke/flagship bench records.
+- **Masked convergence.** The batch runs the SAME fused LM `lax.while_loop`
+  driver as a single fit (`sharded._lm_driver`) under `jax.vmap`: the
+  while_loop batching rule turns the per-element convergence test into
+  "loop until ALL elements converge", with converged elements frozen by
+  `select` (identity steps) — so every element's trajectory is the solo
+  trajectory, term for term, and batched ≡ sequential to reduction-order
+  rounding (locked <= 1e-10 rel by tests/test_fit_batch.py).
+- **2-D (batch, toa) mesh.** With a mesh carrying a `batch` and/or `toa`
+  axis (distributed.batch_fit_mesh), the stacked operands shard batch
+  elements across the batch axis and TOA rows across the toa axis; the
+  normal-equation / Woodbury reductions still complete with one psum over
+  the toa axis per element (batch needs no collective — it is
+  embarrassingly parallel).
+
+Per-element reductions are masked exactly as in the sharded single fit:
+pad rows carry zero weight (inf sigma), zero mask, and zero DM weight, so
+they vanish from J^T W J, J^T W r, the Woodbury inner products and every
+chi^2 — adding padded zeros only changes the floating-point reduction
+ORDER (~1e-16 relative), never the math.
+
+Failure handling mirrors `run_fused_fit`: an element whose device result
+comes back non-finite falls back to that fitter's own host LM loop and
+records a `fit.host_fallback` degradation event; the rest of the batch is
+unaffected.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from pint_tpu.fitting.sharded import (
+    _EIG_FLOOR,
+    _KIND_FNS,
+    _AxisReduce,
+    _lm_driver,
+    _shard_map,
+    _subtract_mean_of,
+    fit_vectors,
+    shard_fit_rows,
+)
+from pint_tpu.ops import perf
+from pint_tpu.utils.logging import get_logger
+
+log = get_logger("pint_tpu.fitting")
+
+__all__ = ["BatchedFitter", "bucket_rows", "clear_batch_cache", "fit_batch"]
+
+#: smallest row bucket — tiny fits share one executable instead of
+#: compiling per-count programs for 3 vs 5 vs 11 TOAs
+MIN_BUCKET_ROWS = 16
+
+
+def bucket_rows(n_data: int, n_toa_shards: int = 1,
+                min_rows: int = MIN_BUCKET_ROWS) -> tuple[int, int]:
+    """(padded data rows, per-shard chunk) for one dataset.
+
+    Rows are padded to the next power-of-two bucket >= n_data (floored at
+    `min_rows` and at the shard count), then rounded up to a multiple of
+    the TOA-shard count so every shard gets an equal chunk.
+    """
+    b = max(int(min_rows), int(n_toa_shards), 1)
+    while b < n_data:
+        b *= 2
+    chunk = -(-b // n_toa_shards)  # ceil
+    return chunk * n_toa_shards, chunk
+
+
+def _mesh_shards(mesh, batch_axis: str, toa_axis: str) -> tuple[int, int]:
+    """(batch shards, toa shards) a (possibly None) mesh provides."""
+    if mesh is None:
+        return 1, 1
+    shape = dict(mesh.shape)
+    return int(shape.get(batch_axis, 1)), int(shape.get(toa_axis, 1))
+
+
+def _model_skeleton(fitter, kind: str):
+    """Hashable structural fingerprint of one fitter's fit program.
+
+    Two fitters share a compiled batched program iff this skeleton AND
+    the pytree signature of their (params, data) operands match: the
+    program closes over the model only for STRUCTURE (component graph,
+    free set, precision backend) — every number, including flag-derived
+    mask columns and noise-basis indices, rides the tensor/params
+    operands (models/timing_model.py build_tensor).
+    """
+    m = fitter.model
+    comps = tuple(
+        (type(c).__name__, tuple(sorted(c.specs))) for c in m.components
+    )
+    return (kind, tuple(fitter._free), bool(_subtract_mean_of(fitter)),
+            m.xprec.name, bool(m.has_abs_phase), bool(m.has_phase_offset),
+            comps)
+
+
+def _element_data(fitter, kind: str, n_toa_shards: int, chunk: int):
+    """One fitter's bucket-padded (data dict, row_keys)."""
+    vecs, fills = fit_vectors(fitter, kind)
+    tensor_out, vecs_out, row_keys = shard_fit_rows(
+        fitter.model, fitter.tensor, vecs, n_toa_shards, fills, chunk=chunk)
+    data = {"tensor": tensor_out}
+    data.update(vecs_out)
+    return data, row_keys
+
+
+def _is_none(x):
+    return x is None
+
+
+def _stack_trees(trees):
+    """Stack a list of structurally identical pytrees along a new leading
+    batch axis (None leaves stay None — all-or-nothing per group, which
+    the group signature guarantees)."""
+    return jax.tree_util.tree_map(
+        lambda *xs: None if xs[0] is None else jnp.stack(
+            [jnp.asarray(x) for x in xs]),
+        *trees, is_leaf=_is_none)
+
+
+def _tree_index(tree, i: int):
+    """Element i of a batch-stacked pytree."""
+    return jax.tree_util.tree_map(
+        lambda x: None if x is None else x[i], tree, is_leaf=_is_none)
+
+
+class _BatchEntry:
+    """One compiled batched-fit program + its bookkeeping."""
+
+    __slots__ = ("prog", "red_pieces", "red_chi2", "n_batch", "n_toa",
+                 "label", "sigs")
+
+    def __init__(self, prog, red_pieces, red_chi2, n_batch, n_toa, label):
+        self.prog = prog
+        self.red_pieces = red_pieces
+        self.red_chi2 = red_chi2
+        self.n_batch = n_batch
+        self.n_toa = n_toa
+        self.label = label
+        #: call signatures this entry has traced — mirrors jit's retrace
+        #: behavior so compile_reuse telemetry needs no jit internals
+        self.sigs: set = set()
+
+
+# process-global program cache: (skeleton, mesh layout, stacked-operand
+# signature) -> _BatchEntry. Programs depend only on model STRUCTURE (see
+# _model_skeleton), so sibling deepcopies of a base model — the
+# Monte-Carlo / per-window-refit shape — reuse one compile across calls.
+_CACHE: dict = {}
+_CACHE_LOCK = threading.Lock()
+
+
+def clear_batch_cache() -> None:
+    """Drop every cached batched-fit program (test isolation; also
+    releases the model references the cached closures hold)."""
+    with _CACHE_LOCK:
+        _CACHE.clear()
+
+
+def get_batched_fit_fn(model, kind: str, free, subtract_mean: bool,
+                       mesh, batch_axis: str, toa_axis: str,
+                       skeleton, row_keys, data, B: int,
+                       rows: int) -> _BatchEntry:
+    """Compiled-program cache entry for one (bucket, model-skeleton)
+    batched fit shape — ONE compile serves every batch whose skeleton and
+    stacked-operand signature match (the fleet contract the jaxpr
+    auditor's batch-retrace pass enforces)."""
+    from pint_tpu.ops.compile import TimedProgram, _args_signature, precision_jit
+
+    n_batch, n_toa = _mesh_shards(mesh, batch_axis, toa_axis)
+    axis = toa_axis if n_toa > 1 else None
+    mesh_key = None
+    if mesh is not None:
+        # device IDs, not Device objects (deepcopy/pickle-safe keys)
+        mesh_key = (tuple(d.id for d in mesh.devices.flat),
+                    tuple(sorted(dict(mesh.shape).items())),
+                    batch_axis, toa_axis)
+    sig = _args_signature(data)
+    key = (skeleton, mesh_key, sig)
+    with _CACHE_LOCK:
+        entry = _CACHE.get(key)
+    if entry is not None:
+        return entry
+
+    red_p = _AxisReduce(axis)
+    red_c = _AxisReduce(axis)
+    builder = _KIND_FNS[kind]
+    pieces_fn, _ = builder(model, free, subtract_mean, red_p)
+    _, chi2_fn = builder(model, free, subtract_mean, red_c)
+    fit = _lm_driver(free, pieces_fn, chi2_fn, _EIG_FLOOR[kind])
+    # the masked-convergence batch: vmap's while_loop batching rule runs
+    # the loop until EVERY element's cond is false and freezes finished
+    # elements with select — identity steps, exactly the solo trajectory
+    vfit = jax.vmap(fit, in_axes=(0, 0, None, None, None))
+
+    if mesh is not None and (n_batch > 1 or n_toa > 1):
+        from jax.sharding import PartitionSpec as P
+
+        b = batch_axis if n_batch > 1 else None
+        t = axis
+        specs = {"tensor": {k: (P(b, t) if k in row_keys else P(b))
+                            for k in data["tensor"]}}
+        specs.update({k: (None if v is None else P(b, t))
+                      for k, v in data.items() if k != "tensor"})
+        # align the spec tree with the data tree (None leaves have no spec)
+        specs = jax.tree_util.tree_unflatten(
+            jax.tree_util.tree_structure(data, is_leaf=_is_none),
+            jax.tree_util.tree_leaves(specs, is_leaf=_is_none),
+        )
+        vfit = _shard_map()(
+            vfit,
+            mesh=mesh,
+            in_specs=(P(b), specs, P(), P(), P()),
+            out_specs=P(b),
+            check_vma=False,
+        )
+
+    label = f"batched_{kind}_fit_{B}x{rows}"
+    entry = _BatchEntry(
+        prog=TimedProgram(precision_jit(vfit), label,
+                          collective_axes=(axis,) if axis else ()),
+        red_pieces=red_p, red_chi2=red_c,
+        n_batch=n_batch, n_toa=n_toa, label=label,
+    )
+    with _CACHE_LOCK:
+        return _CACHE.setdefault(key, entry)
+
+
+class _Group:
+    """One (skeleton, bucket) slice of a fleet: the fitters it serves,
+    their stacked operands, and the compiled program entry."""
+
+    __slots__ = ("entry", "kind", "idxs", "params", "data", "rows",
+                 "n_data", "batch_pad")
+
+    def __init__(self, entry, kind, idxs, params, data, rows, n_data,
+                 batch_pad):
+        self.entry = entry
+        self.kind = kind
+        self.idxs = idxs          # fitter indices, real elements first
+        self.params = params      # stacked params pytree (B, ...)
+        self.data = data          # stacked data pytree (B, rows, ...)
+        self.rows = rows          # bucket data rows per element
+        self.n_data = n_data      # real data rows per real element
+        self.batch_pad = batch_pad  # duplicated trailing elements
+
+
+def _assemble_groups(fitters, mesh, batch_axis: str, toa_axis: str,
+                     min_rows: int) -> tuple[list[_Group], list[int]]:
+    """Group fitters by (skeleton, bucket, operand signature) and stack
+    each group's operands. Returns (groups, sequential_idxs) where
+    sequential_idxs are fitters the fleet engine cannot batch (non-
+    downhill classes without the fused LM semantics)."""
+    from pint_tpu.ops.compile import _args_signature, canonicalize_params
+
+    n_batch, n_toa = _mesh_shards(mesh, batch_axis, toa_axis)
+    sequential: list[int] = []
+    elems: dict[int, tuple] = {}
+    buckets: dict[tuple, list[int]] = {}
+    for i, f in enumerate(fitters):
+        if not getattr(f, "_fused_capable", False):
+            sequential.append(i)
+            continue
+        kind = f._fused_kind
+        n_data = len(f.resids.errors_s)
+        rows, chunk = bucket_rows(n_data, n_toa, min_rows)
+        data, row_keys = _element_data(f, kind, n_toa, chunk)
+        params = canonicalize_params(f.model.xprec.convert_params(f.model.params))
+        sig = _args_signature((params, data))
+        key = (_model_skeleton(f, kind), rows, sig)
+        elems[i] = (params, data, row_keys, n_data)
+        buckets.setdefault(key, []).append(i)
+
+    groups: list[_Group] = []
+    for (skeleton, rows, _sig), idxs in buckets.items():
+        kind = skeleton[0]
+        batch_pad = (-len(idxs)) % n_batch
+        # batch-axis padding duplicates the last element; its outputs are
+        # discarded (and it converges in lockstep with its twin, so it
+        # never extends the masked loop)
+        members = idxs + [idxs[-1]] * batch_pad
+        params = _stack_trees([elems[i][0] for i in members])
+        data = _stack_trees([elems[i][1] for i in members])
+        f0 = fitters[idxs[0]]
+        entry = get_batched_fit_fn(
+            f0.model, kind, f0._free, _subtract_mean_of(f0), mesh,
+            batch_axis, toa_axis, skeleton, elems[idxs[0]][2], data,
+            len(members), rows)
+        groups.append(_Group(entry, kind, idxs, params, data, rows,
+                             [elems[i][3] for i in idxs], batch_pad))
+    return groups, sequential
+
+
+def _install_result(fitter, kind: str, params_i, chi2: float, it: int,
+                    converged: bool, cov, s, vt, ahat):
+    """Write one element's batched outputs back through the fitter's own
+    finalize tail — identical post-processing to the solo fused branches
+    of DownhillWLSFitter / DownhillGLSFitter / WidebandDownhillFitter."""
+    # pull params off the mesh: NamedSharding-committed leaves would
+    # poison later single-device programs consuming model.params
+    params_i = jax.device_get(params_i)
+    if kind == "wls":
+        # fused eigenvalues are sigma^2 of the whitened design: report
+        # singular values (descending) like the host path
+        s_rep = np.sqrt(np.maximum(s[::-1], 0.0))
+        return fitter._finalize_fit(params_i, chi2, it, converged, cov,
+                                    s=s_rep, vt=vt[::-1])
+    fitter.noise_ampls = np.asarray(ahat)
+    if kind == "wideband":
+        return fitter._finalize_fit(params_i, chi2, it, converged, cov)
+    # eigh returns ascending; _degenerate_params expects descending
+    return fitter._finalize_fit(params_i, chi2, it, converged, cov,
+                                s=s[::-1], vt=vt[::-1])
+
+
+def _element_fallback(fitter, label: str, maxiter: int,
+                      required_chi2_decrease: float, max_rejects: int):
+    """Host-LM fallback for one non-finite batch element (mirrors
+    run_fused_fit's sticky fallback + ledger event)."""
+    from pint_tpu.ops import degrade
+
+    perf.put("solve_path_reason", "fused_nonfinite_fallback")
+    degrade.record(
+        "fit.host_fallback", label,
+        "batched fused LM fit returned non-finite results for one fleet "
+        "element (device eigensolve underflow?); refitting it through the "
+        "host LM loop",
+        bound_us=0.0,  # accuracy preserved; the batched amortization lost
+        fix="condition that element's normal matrix (freeze degenerate "
+            "params) or solve on a true-f64 backend",
+    )
+    fitter._fused = False  # sticky: the failure is structural
+    return fitter.fit_toas(maxiter=maxiter,
+                           required_chi2_decrease=required_chi2_decrease,
+                           max_rejects=max_rejects)
+
+
+class BatchedFitter:
+    """Fleet-fit engine: run every fitter's downhill fit as (a few) fused
+    batched device programs.
+
+    `fitters` may mix kinds (WLS / GLS-ECORR / wideband), free sets and
+    TOA counts: they are grouped by model skeleton and padded into
+    power-of-two row buckets, one compiled program per (skeleton, bucket).
+    `mesh` composes the batch with SPMD: a `batch` axis shards fleet
+    elements, a `toa` axis shards each element's rows exactly as the
+    single-fit sharded path (distributed.batch_fit_mesh builds the 2-D
+    layout). Results land on each fitter (`fitter.result`, model params,
+    uncertainties) exactly as its own `fit_toas` would leave them.
+    """
+
+    def __init__(self, fitters, mesh=None, batch_axis: str = "batch",
+                 toa_axis: str = "toa", min_bucket_rows: int = MIN_BUCKET_ROWS):
+        self.fitters = list(fitters)
+        self.mesh = mesh
+        self.batch_axis = batch_axis
+        self.toa_axis = toa_axis
+        self.min_bucket_rows = min_bucket_rows
+        self.results: list | None = None
+        self.stats: dict | None = None
+        self.last_perf: dict | None = None
+        self._groups = None
+        self._sequential = None
+
+    def _assembled(self):
+        if self._groups is None:
+            self._groups, self._sequential = _assemble_groups(
+                self.fitters, self.mesh, self.batch_axis, self.toa_axis,
+                self.min_bucket_rows)
+        return self._groups, self._sequential
+
+    @staticmethod
+    def _args(group, maxiter, required_chi2_decrease, max_rejects):
+        return (group.params, group.data, np.int32(maxiter),
+                np.float64(required_chi2_decrease), np.int32(max_rejects))
+
+    def precompile(self, maxiter: int = 30,
+                   required_chi2_decrease: float = 1e-2,
+                   max_rejects: int = 16, background: bool = False):
+        """Ahead-of-time compile every group's batched program (same
+        overlap contract as the single-fit `precompile`)."""
+
+        from pint_tpu.ops.compile import _args_signature
+
+        groups, _ = self._assembled()
+
+        def work():
+            for g in groups:
+                args = self._args(g, maxiter, required_chi2_decrease,
+                                  max_rejects)
+                try:
+                    g.entry.prog.precompile(*args)
+                    g.entry.sigs.add(_args_signature(args))
+                except Exception as e:  # noqa: BLE001 — warmup is best-effort  # jaxlint: disable=silent-except — warmup is best-effort; the live batch compiles on demand
+                    log.warning(f"batched-fit precompile failed: {e}")
+
+        if background:
+            th = threading.Thread(target=work, daemon=True,
+                                  name="pint-tpu-batch-precompile")
+            th.start()
+            return th
+        work()
+        return None
+
+    def fit_toas(self, maxiter: int = 30,
+                 required_chi2_decrease: float = 1e-2,
+                 max_rejects: int = 16) -> list:
+        """Run the fleet; returns per-fitter FitResults (input order)."""
+        if not perf.enabled():
+            return self._run(maxiter, required_chi2_decrease, max_rejects)
+        with perf.collect() as rep:
+            with perf.stage("fit"):
+                results = self._run(maxiter, required_chi2_decrease,
+                                    max_rejects)
+        breakdown = perf.fit_breakdown(rep)
+        self.last_perf = breakdown
+        for r in results:
+            if r is not None:
+                r.perf = breakdown
+        return results
+
+    def _run(self, maxiter, required_chi2_decrease, max_rejects) -> list:
+        from pint_tpu.ops.compile import _args_signature
+
+        t0 = time.perf_counter()
+        groups, sequential = self._assembled()
+        results: list = [None] * len(self.fitters)
+        occupancy: dict[str, int] = {}
+        total_rows = 0
+        total_data = 0
+        compiles = 0
+        reuse = 0
+        lm_iters = lm_trials = lm_rejects = 0
+        for g in groups:
+            args = self._args(g, maxiter, required_chi2_decrease, max_rejects)
+            sig = _args_signature(args)
+            compiled_here = sig not in g.entry.sigs
+            with perf.stage("step"):
+                out = g.entry.prog(*args)
+            g.entry.sigs.add(sig)
+            compiles += int(compiled_here)
+            reuse += len(g.idxs) - int(compiled_here)
+            okey = f"{g.kind}:{g.rows}"
+            occupancy[okey] = occupancy.get(okey, 0) + len(g.idxs)
+            total_rows += g.rows * (len(g.idxs) + g.batch_pad)
+            total_data += int(sum(g.n_data))
+            (p_b, chi2_b, it_b, conv_b, cov_b, s_b, vt_b, ahat_b,
+             trials_b, rejects_b) = out
+            chi2_b = np.asarray(chi2_b)
+            it_b = np.asarray(it_b)
+            conv_b = np.asarray(conv_b)
+            cov_b = np.asarray(cov_b)
+            s_b = np.asarray(s_b)
+            vt_b = np.asarray(vt_b)
+            ahat_b = np.asarray(ahat_b)
+            trials_b = np.asarray(trials_b)
+            rejects_b = np.asarray(rejects_b)
+            g_iters = g_trials = 0
+            for j, i in enumerate(g.idxs):
+                fitter = self.fitters[i]
+                chi2 = float(chi2_b[j])
+                cov = cov_b[j]
+                if not (np.isfinite(chi2) and np.isfinite(cov).all()):
+                    results[i] = _element_fallback(
+                        fitter, g.entry.label, maxiter,
+                        required_chi2_decrease, max_rejects)
+                    continue
+                it = int(it_b[j])
+                g_iters += it
+                g_trials += int(trials_b[j])
+                lm_rejects += int(rejects_b[j])
+                if not bool(conv_b[j]):
+                    log.warning(
+                        f"batched {g.kind} fit element {i} hit "
+                        f"maxiter={maxiter}")
+                results[i] = _install_result(
+                    fitter, g.kind, _tree_index(p_b, j), chi2, it,
+                    bool(conv_b[j]), cov, s_b[j], vt_b[j], ahat_b[j])
+            lm_iters += g_iters
+            lm_trials += g_trials
+            # per-element collective payload estimate scaled by the
+            # summed logical loop counters (same recipe as run_fused_fit;
+            # the reduce tallies are per-element symbolic passes)
+            perf.add("psum_bytes",
+                     g.entry.red_pieces.psum_bytes * g_iters
+                     + g.entry.red_chi2.psum_bytes
+                     * (g_trials + len(g.idxs)))
+        for i in sequential:
+            log.warning(
+                f"fitter {i} ({type(self.fitters[i]).__name__}) has no "
+                "fused LM loop; fitting it sequentially outside the fleet")
+            results[i] = self.fitters[i].fit_toas(maxiter=maxiter)
+
+        n_batch, n_toa = _mesh_shards(self.mesh, self.batch_axis,
+                                      self.toa_axis)
+        waste = (1.0 - total_data / total_rows) if total_rows else 0.0
+        self.stats = {
+            "batch_size": len(self.fitters),
+            "n_groups": len(groups),
+            "bucket_occupancy": occupancy,
+            "padding_waste_frac": round(waste, 4),
+            "batch_compiles": compiles,
+            "compile_reuse": reuse,
+            "batch_shards": n_batch,
+            "fit_shards": n_toa,
+            "wall_s": round(time.perf_counter() - t0, 4),
+        }
+        # telemetry: the batched fleet is one (or a few) fused programs
+        perf.add("lm_iterations", lm_iters)
+        perf.add("lm_trials", lm_trials)
+        perf.add("lm_rejects", lm_rejects)
+        perf.add("while_loop_iters", lm_iters + lm_trials)
+        perf.add("batch_compiles", compiles)
+        perf.add("batch_compile_reuse", reuse)
+        perf.put("solve_path", "batched_fused_loop")
+        perf.put("solve_path_reason",
+                 "sharded" if (n_batch > 1 or n_toa > 1) else "single_device")
+        perf.put("fit_shards", n_toa)
+        perf.put("batch_shards", n_batch)
+        perf.put("batch_size", len(self.fitters))
+        perf.put("bucket_occupancy", dict(occupancy))
+        perf.put("padding_waste_frac", round(waste, 4))
+        self.results = results
+        return results
+
+
+def fit_batch(fitters, maxiter: int = 30,
+              required_chi2_decrease: float = 1e-2, max_rejects: int = 16,
+              mesh=None, batch_axis: str = "batch", toa_axis: str = "toa",
+              min_bucket_rows: int = MIN_BUCKET_ROWS) -> list:
+    """Fit B independent fitters as one (or a few) batched fused device
+    programs; returns their FitResults in input order.
+
+    One-shot surface over :class:`BatchedFitter` — hold the engine object
+    instead when you want `precompile` overlap or the batch `stats`
+    (bucket occupancy, padding waste, compile reuse).
+    """
+    return BatchedFitter(
+        fitters, mesh=mesh, batch_axis=batch_axis, toa_axis=toa_axis,
+        min_bucket_rows=min_bucket_rows,
+    ).fit_toas(maxiter=maxiter,
+               required_chi2_decrease=required_chi2_decrease,
+               max_rejects=max_rejects)

@@ -111,7 +111,7 @@ def n_fit_shards(mesh, toa_axis: str = "toa") -> int:
 
 
 def shard_fit_rows(model, tensor, vecs: dict, n_shards: int,
-                   fills: dict | None = None):
+                   fills: dict | None = None, chunk: int | None = None):
     """Re-lay the TOA axis of a tensor + row-aligned vectors into
     `n_shards` equal blocks.
 
@@ -122,6 +122,12 @@ def shard_fit_rows(model, tensor, vecs: dict, n_shards: int,
     fills that make pad rows drop out of every reduction (e.g. inf sigma
     -> zero weight).
 
+    `chunk` forces the per-shard data-row count (must cover the data);
+    the fleet-fit engine (fitting/batch.py) uses it to pad ragged TOA
+    counts up to a shared power-of-two bucket so one compiled executable
+    serves every dataset in the bucket. Default: the minimal ceil-divide
+    layout.
+
     Returns (tensor', vecs', row_keys): row_keys names the tensor leaves
     that were sharded (row-indexed); everything else stays replicated.
     """
@@ -130,7 +136,14 @@ def shard_fit_rows(model, tensor, vecs: dict, n_shards: int,
     tensor = {k: np.asarray(v) for k, v in tensor.items()}
     n_rows = tensor["t_hi"].shape[0]
     n_data = n_rows - (1 if has_tzr else 0)
-    chunk = -(-n_data // n_shards)  # ceil
+    min_chunk = -(-n_data // n_shards)  # ceil
+    if chunk is None:
+        chunk = min_chunk
+    elif chunk < min_chunk:
+        raise ValueError(
+            f"chunk={chunk} cannot hold {n_data} data rows over "
+            f"{n_shards} shard(s) (needs >= {min_chunk})"
+        )
 
     def lay_tensor(a):
         tzr = a[-1:] if has_tzr else None
@@ -172,16 +185,11 @@ def shard_fit_rows(model, tensor, vecs: dict, n_shards: int,
     return tensor_out, vecs_out, row_keys
 
 
-def build_fit_data(fitter, kind: str, n_shards: int):
-    """(data dict, PartitionSpec tree) for one fitter's fused fit program.
-
-    `data` carries the tensor plus every per-TOA vector the fit consumes;
-    with n_shards > 1 the rows are re-laid by `shard_fit_rows` and the
-    spec tree marks which leaves ride the `toa` mesh axis. Pad-row fills
-    are chosen so pads vanish from every reduction (sigma -> inf, weights
-    and mask -> 0).
-    """
-    model = fitter.model
+def fit_vectors(fitter, kind: str):
+    """(vecs, fills) — the per-TOA vectors one fused/batched fit consumes
+    plus the pad-row fill values that make padding vanish from every
+    reduction (sigma -> inf so weights are zero, weights and mask -> 0,
+    dm_data -> 0 under a zero DM weight)."""
     r = fitter.resids.toa if kind == "wideband" else fitter.resids
     vecs = {
         "track_pn": None if r._track_pn is None else np.asarray(r._track_pn),
@@ -195,6 +203,20 @@ def build_fit_data(fitter, kind: str, n_shards: int):
         vecs["sigma_dm"] = np.asarray(fitter.resids.dm_errors)
         vecs["dm_data"] = np.asarray(fitter.resids.dm_data)
         fills["sigma_dm"] = np.inf
+    return vecs, fills
+
+
+def build_fit_data(fitter, kind: str, n_shards: int):
+    """(data dict, PartitionSpec tree) for one fitter's fused fit program.
+
+    `data` carries the tensor plus every per-TOA vector the fit consumes;
+    with n_shards > 1 the rows are re-laid by `shard_fit_rows` and the
+    spec tree marks which leaves ride the `toa` mesh axis. Pad-row fills
+    are chosen so pads vanish from every reduction (sigma -> inf, weights
+    and mask -> 0).
+    """
+    model = fitter.model
+    vecs, fills = fit_vectors(fitter, kind)
 
     if n_shards <= 1:
         data = {"tensor": fitter.tensor}

@@ -293,6 +293,18 @@ def fit_breakdown(rep: PerfReport) -> dict:
         "fit_shards": rep.values.get("fit_shards"),
         "while_loop_iters": int(rep.counters.get("while_loop_iters", 0)),
         "psum_bytes": int(rep.counters.get("psum_bytes", 0)),
+        # fleet-fit telemetry (fitting/batch.py): batch_size = fitters in
+        # the fleet, batch_shards = mesh shards along the batch axis,
+        # bucket_occupancy = datasets per (kind, padded-rows) bucket,
+        # padding_waste_frac = fraction of padded rows that are padding,
+        # compile_reuse = fits served without a fresh program compile —
+        # the amortization is observable, not asserted
+        "batch_size": rep.values.get("batch_size"),
+        "batch_shards": rep.values.get("batch_shards"),
+        "bucket_occupancy": rep.values.get("bucket_occupancy"),
+        "padding_waste_frac": rep.values.get("padding_waste_frac"),
+        "batch_compiles": int(rep.counters.get("batch_compiles", 0)),
+        "compile_reuse": int(rep.counters.get("batch_compile_reuse", 0)),
     }
     # compile-time jaxpr-audit ledger (pint_tpu/analysis/): every program
     # the fit lowered, the passes it ran, and any invariant violations —

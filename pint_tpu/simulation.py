@@ -57,10 +57,44 @@ def zero_residuals(
     )
 
 
-def _reprepare(toas: TOAs, shift_s: np.ndarray) -> TOAs:
-    """Re-run the full preparation pipeline with the RAW site UTC shifted by
+def _reprepare(toas: TOAs, shift_s: np.ndarray, force_full: bool = False) -> TOAs:
+    """Re-run the preparation pipeline with the RAW site UTC shifted by
     shift_s, preserving the clock-chain settings (never re-applies the clock
-    corrections already folded into toas.utc)."""
+    corrections already folded into toas.utc).
+
+    **Geometry reuse fast path.** For sub-threshold shifts (default 10 us,
+    ``PINT_TPU_REPREPARE_REUSE_US``) the already-prepared clock
+    corrections, EOP, site posvels and ephemeris columns are REUSED and
+    only the time columns shift: the geometry error of evaluating those
+    columns at a time dt away is bounded by (v_earth/c) * dt <= 1e-4 * dt
+    — ~1 ns at the 10 us threshold, far below any TOA uncertainty, and
+    the shifted TOAs stay exactly self-consistent for residual evaluation
+    (the tensor's times and geometry come from the same object). The
+    staleness ACCUMULATES across chained fast-path calls
+    (``TOAs.geom_stale_s``); once the running total would cross the
+    threshold the full pipeline runs and resets it, so the bound holds no
+    matter how many noise realizations or zero-residual passes chain.
+    This is what makes per-realization fake-TOA fleets
+    (monte_carlo_uncertainty) and the late zero_residuals passes cost
+    microseconds instead of a full clock/ephemeris rebuild each.
+    """
+    from pint_tpu.utils import knobs
+
+    shift = np.asarray(shift_s, float)
+    worst = float(np.max(np.abs(shift))) if shift.size else 0.0
+    limit = float(knobs.get("PINT_TPU_REPREPARE_REUSE_US")) * 1e-6
+    stale = getattr(toas, "geom_stale_s", 0.0) + worst
+    if not force_full and stale <= limit:
+        from dataclasses import replace
+
+        return replace(
+            toas,
+            utc=toas.utc.add_seconds(shift),
+            tdb=toas.tdb.add_seconds(shift),
+            utc_raw=(None if toas.utc_raw is None
+                     else toas.utc_raw.add_seconds(shift)),
+            geom_stale_s=stale,
+        )
     base = toas.utc_raw if toas.utc_raw is not None else toas.utc
     return prepare_arrays(
         base.add_seconds(shift_s),
@@ -229,3 +263,73 @@ def calculate_random_models(fitter, toas, n_models: int = 100, rng=None):
 
     fn = precision_jit(jax.vmap(one))
     return np.asarray(fn(jnp.asarray(draws))), draws
+
+
+def monte_carlo_uncertainty(
+    fitter,
+    n_realizations: int = 32,
+    rng: np.random.Generator | None = None,
+    correlated: bool = False,
+    mesh=None,
+    maxiter: int = 30,
+    batch_axis: str = "batch",
+    toa_axis: str = "toa",
+) -> dict:
+    """Monte-Carlo parameter uncertainties by refitting fake-TOA
+    realizations — run as ONE fleet fit (fitting/batch.py).
+
+    Where `calculate_random_models` samples the LINEARIZED covariance,
+    this is the full nonlinear bootstrap: fakes are generated exactly on
+    the fitted model (`zero_residuals` once), each realization draws
+    fresh noise (white from the TOA errors, or the model's full noise
+    covariance with ``correlated=True``) through `_reprepare`'s
+    geometry-reuse fast path, and every realization is refit from the
+    fitted parameters. All B refits run as one batched fused LM program
+    (same skeleton, same bucket → one compile), optionally sharded over a
+    (batch, toa) mesh (`distributed.batch_fit_mesh`).
+
+    Returns ``{"free", "draws" (B, p) fitted values, "mean", "scatter"
+    (per-parameter std), "fitted" (the original fit's values),
+    "uncertainties" (the original fit's formal sigmas), "results"}``.
+    """
+    import copy
+
+    from pint_tpu.fitting.batch import fit_batch
+    from pint_tpu.models.base import leaf_to_f64
+
+    if fitter.result is None:
+        raise RuntimeError("run fit_toas first")
+    rng = rng or np.random.default_rng()
+    model = fitter.model
+    free = tuple(fitter.result.free_params)
+    base = zero_residuals(fitter.toas, model)
+    n = len(base)
+    fleet = []
+    for _ in range(n_realizations):
+        if correlated:
+            toas_i = add_noise_from_model(base, model, rng=rng)
+        else:
+            toas_i = _reprepare(
+                base, rng.standard_normal(n) * base.error_us * 1e-6)
+        fleet.append(type(fitter)(toas_i, copy.deepcopy(model)))
+    results = fit_batch(fleet, maxiter=maxiter, mesh=mesh,
+                        batch_axis=batch_axis, toa_axis=toa_axis)
+    draws = np.array([
+        [float(np.asarray(leaf_to_f64(f.model.params[p]))) for p in free]
+        for f in fleet
+    ])
+    fitted = np.array([
+        float(np.asarray(leaf_to_f64(model.params[p]))) for p in free
+    ])
+    return {
+        "free": list(free),
+        "draws": draws,
+        "mean": draws.mean(axis=0),
+        "scatter": draws.std(axis=0, ddof=1) if n_realizations > 1
+        else np.zeros(len(free)),
+        "fitted": fitted,
+        "uncertainties": np.array([
+            fitter.result.uncertainties[p] for p in free
+        ]),
+        "results": results,
+    }

@@ -66,6 +66,46 @@ TENSOR_EPOCH_MJD = 55000  # fixed integer origin for device-side dd seconds
 PLANETS = ("jupiter", "saturn", "venus", "uranus", "neptune")
 
 
+class _LazyTOALines(Sequence):
+    """Per-row TOALine views materialized on demand.
+
+    `prepare_arrays` used to build one TOALine object per TOA up front —
+    a pure-Python per-row pass costing seconds at 1e5 TOAs on EVERY
+    re-preparation (simulation.zero_residuals runs several) even though
+    nothing on the fit path ever reads the lines. This sequence holds the
+    already-prepared column arrays and constructs a TOALine only when one
+    is actually indexed (tim writing, interactive inspection). Picklable:
+    the TOA disk caches store it as plain arrays.
+    """
+
+    __slots__ = ("_utc", "_error_us", "_freq", "_obs", "_flags")
+
+    def __init__(self, utc, error_us, freq, obs, flags):
+        self._utc = utc
+        self._error_us = error_us
+        self._freq = freq
+        self._obs = obs
+        self._flags = flags
+
+    def __len__(self):
+        return len(self._error_us)
+
+    def __getitem__(self, i):
+        if isinstance(i, slice):
+            return [self[j] for j in range(*i.indices(len(self)))]
+        f = float(self._freq[i])
+        return TOALine(
+            name=f"fake_{i}",
+            freq_mhz=f if np.isfinite(f) else 0.0,
+            mjd_day=int(self._utc.day[i]),
+            mjd_frac_hi=float(self._utc.frac_hi[i]),
+            mjd_frac_lo=float(self._utc.frac_lo[i]),
+            error_us=float(self._error_us[i]),
+            obs=str(self._obs[i]),
+            flags=dict(self._flags[i]),
+        )
+
+
 @dataclass
 class TOATensor:
     """Dense device-ready arrays (all numpy here; jnp conversion at use).
@@ -119,6 +159,12 @@ class TOAs:
     include_gps: bool = True
     include_bipm: bool = False
     bipm_version: str = "BIPM2019"
+    #: accumulated |time shift| (seconds) since the geometry columns
+    #: (clock corrections, EOP, site/ephemeris posvels) were last computed
+    #: — simulation._reprepare's fast path reuses them for sub-threshold
+    #: shifts and tracks the staleness here (worst-case timing error is
+    #: (v_earth/c) * geom_stale_s ~ 1e-4 * stale)
+    geom_stale_s: float = 0.0
 
     def __len__(self):
         return len(self.error_us)
@@ -161,10 +207,17 @@ class TOAs:
         return [f.get(key, default) for f in self.flags]
 
     def get_pulse_numbers(self) -> np.ndarray | None:
-        pns = [f.get("pn") for f in self.flags]
-        if all(p is None for p in pns):
-            return None
-        return np.array([float(p) if p is not None else np.nan for p in pns])
+        # one pass over the flag dicts into a preallocated array (the
+        # old two-comprehension version was 2x the Python-loop cost at
+        # 1e5 TOAs on every tensor build)
+        out = np.full(len(self.flags), np.nan)
+        any_pn = False
+        for i, f in enumerate(self.flags):
+            p = f.get("pn")
+            if p is not None:
+                out[i] = float(p)
+                any_pn = True
+        return out if any_pn else None
 
     @property
     def is_wideband(self) -> bool:
@@ -177,19 +230,32 @@ class TOAs:
         (reference toa.py:1734-1747). Rows without a measurement get dm=0
         with infinite error (zero weight); returns (None, None) when no TOA
         has one."""
-        if not self.is_wideband:
+        # ONE pass over the flag dicts filling preallocated arrays (was
+        # four comprehensions: two validation sweeps + two builds)
+        n = len(self.flags)
+        dm = np.zeros(n)
+        dme = np.full(n, np.inf)
+        has_dm = np.zeros(n, bool)
+        has_dme = np.zeros(n, bool)
+        for i, f in enumerate(self.flags):
+            v = f.get("pp_dm")
+            if v is not None:
+                dm[i] = float(v)
+                has_dm[i] = True
+            e = f.get("pp_dme")
+            if e is not None:
+                dme[i] = float(e)
+                has_dme[i] = True
+        if not has_dm.any():
             return None, None
-        for a, b in (("pp_dm", "pp_dme"), ("pp_dme", "pp_dm")):
-            bad = [i for i, f in enumerate(self.flags) if a in f and b not in f]
-            if bad:
+        for a, b, bad in (("pp_dm", "pp_dme", has_dm & ~has_dme),
+                          ("pp_dme", "pp_dm", has_dme & ~has_dm)):
+            if bad.any():
                 raise ValueError(
-                    f"{len(bad)} TOAs carry -{a} without -{b} (first at index "
-                    f"{bad[0]}); wideband DM measurements need both"
+                    f"{int(bad.sum())} TOAs carry -{a} without -{b} (first "
+                    f"at index {int(np.flatnonzero(bad)[0])}); wideband DM "
+                    "measurements need both"
                 )
-        dm = np.array([float(f.get("pp_dm", 0.0)) for f in self.flags])
-        dme = np.array(
-            [float(f["pp_dme"]) if "pp_dme" in f else np.inf for f in self.flags]
-        )
         return dm, dme
 
     def select(self, mask: np.ndarray) -> "TOAs":
@@ -221,16 +287,21 @@ class TOAs:
             include_gps=self.include_gps,
             include_bipm=self.include_bipm,
             bipm_version=self.bipm_version,
+            geom_stale_s=getattr(self, "geom_stale_s", 0.0),
         )
 
     def tensor(self) -> TOATensor:
         t_hi, t_lo = self.tdb.seconds_since(TENSOR_EPOCH_MJD)
         pn = self.get_pulse_numbers()
         # both -padd (PHASE command) and -phase flags carry pulse offsets
-        # (reference toa.py:829,1924-1926)
-        dpn = np.array(
-            [float(f.get("padd", 0.0)) + float(f.get("phase", 0.0)) for f in self.flags]
-        )
+        # (reference toa.py:829,1924-1926); single flag pass, zero-cost
+        # when (as almost always) neither flag appears
+        dpn = np.zeros(len(self.flags))
+        any_dpn = False
+        for i, f in enumerate(self.flags):
+            if "padd" in f or "phase" in f:
+                dpn[i] = float(f.get("padd", 0.0)) + float(f.get("phase", 0.0))
+                any_dpn = True
         return TOATensor(
             t_hi=t_hi,
             t_lo=t_lo,
@@ -242,7 +313,7 @@ class TOAs:
             obs_sun_pos_ls=self.obs_sun_pos_m / C_M_PER_S,
             planet_pos_ls={k: v / C_M_PER_S for k, v in self.planet_pos_m.items()},
             pulse_number=pn,
-            delta_pulse_number=dpn if np.any(dpn) else None,
+            delta_pulse_number=dpn if any_dpn and np.any(dpn) else None,
         )
 
     def summary(self) -> str:
@@ -294,6 +365,7 @@ def merge_TOAs(toas_list: Sequence[TOAs]) -> TOAs:
         ephem=t0.ephem,
         clock_applied=all(t.clock_applied for t in toas_list),
         planets=t0.planets,
+        geom_stale_s=max(getattr(t, "geom_stale_s", 0.0) for t in toas_list),
     )
 
 
@@ -480,19 +552,11 @@ def prepare_arrays(
     else:
         validate_flags(flags)
     if lines is None:
-        lines = [
-            TOALine(
-                name=f"fake_{i}",
-                freq_mhz=float(freq[i]) if np.isfinite(freq[i]) else 0.0,
-                mjd_day=int(utc.day[i]),
-                mjd_frac_hi=float(utc.frac_hi[i]),
-                mjd_frac_lo=float(utc.frac_lo[i]),
-                error_us=float(error_us[i]),
-                obs=str(obs_names[i]),
-                flags=dict(flags[i]),
-            )
-            for i in range(n)
-        ]
+        # lazy per-row views: nothing on the prepare/fit path reads the
+        # lines, so the per-TOA TOALine construction pass (seconds at
+        # 1e5 TOAs, repeated by every zero_residuals re-preparation) is
+        # deferred until a line is actually indexed
+        lines = _LazyTOALines(utc, error_us, freq, obs_names, flags)
 
     # 1. clock corrections per observatory group (site -> UTC)
     corr_s = np.zeros(n)
@@ -510,8 +574,12 @@ def prepare_arrays(
     # 2. UTC -> TT -> (geocentric) TDB. Rows whose observatory runs on TT
     # (photon-event data, e.g. Fermi MET after geocentering) skip the
     # UTC->TT leap-second chain: their input times already ARE TT.
-    bary = np.array([get_observatory(str(o)).is_barycenter for o in obs_names])
-    tt_scale = np.array([get_observatory(str(o)).timescale == "tt" for o in obs_names])
+    # Observatory lookups go per unique name, not per row (two
+    # get_observatory calls per TOA was a measurable prepare-path cost).
+    uniq_obs, obs_inv = np.unique(obs_names, return_inverse=True)
+    uniq_ob = [get_observatory(str(u)) for u in uniq_obs]
+    bary = np.array([ob.is_barycenter for ob in uniq_ob])[obs_inv]
+    tt_scale = np.array([ob.timescale == "tt" for ob in uniq_ob])[obs_inv]
     tt = ptime.pulsar_mjd_utc_to_tt(utc_corr)
     if np.any(tt_scale):
         for dst, src in ((tt.day, utc_corr.day), (tt.frac_hi, utc_corr.frac_hi),
@@ -581,7 +649,7 @@ def prepare_arrays(
             arr_dst[bary] = arr_src[bary]
 
     toas = TOAs(
-        lines=list(lines),
+        lines=lines if isinstance(lines, _LazyTOALines) else list(lines),
         utc=utc_corr,
         tdb=tdb,
         error_us=error_us,

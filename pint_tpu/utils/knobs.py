@@ -45,6 +45,7 @@ KNOBS: dict[str, tuple[str | None, str]] = {
     "PINT_TPU_NBODY_CACHE": ("1", "0: disable the N-body solution disk cache"),
     "PINT_TPU_NBODY_COMB": ("0", "1: add the comb anchor periods to the N-body band design"),
     "PINT_TPU_EOP": (None, "path to an IERS finals2000A file; unset = zero EOP"),
+    "PINT_TPU_REPREPARE_REUSE_US": ("10", "re-preparation geometry-reuse threshold in us (0 disables the fast path)"),
     "PINT_TPU_OBS_JSON": ("", "colon-separated extra observatories.json overlays"),
     # --- clocks ----------------------------------------------------------------
     "PINT_TPU_CLOCK_REPO": (None, "clock-corrections repository (https/file URL or directory)"),
