@@ -38,6 +38,11 @@ Passes (each returns a list of human-readable violation details):
     Callback/infeed primitives inside a ``lax.while_loop`` body: the
     fused fit loop's contract is ONE host sync per fit, and a callback
     in the body re-serializes every iteration.
+``prepare-sync``
+    Any host-sync primitive anywhere in a ``prepare_*`` program
+    (astro/device_prepare.py): the device-fused TOA prepare must never
+    round-trip to the host mid-program — a prepare step that needs host
+    data belongs on the host-numpy fallback path instead.
 ``retrace-budget``
     A second compiled signature that differs from an existing one only
     in dtype/weak_type at identical tree structure and shapes. A
@@ -272,6 +277,26 @@ def _pass_host_sync(ctx: _Ctx) -> list[str]:
     return out
 
 
+def _pass_prepare_sync(ctx: _Ctx) -> list[str]:
+    """Prepare programs (label ``prepare_*``, astro/device_prepare.py) are
+    the TOA-prepare pipeline's device residents: a host callback ANYWHERE
+    in one — not just inside a loop body — re-serializes the prepare path
+    the fusion exists to eliminate, so the contract is zero host-sync
+    primitives, full stop."""
+    if ctx.closed is None or not ctx.label.startswith("prepare_"):
+        return []
+    out = []
+    for eqn, _ in _iter_eqns(ctx.closed.jaxpr):
+        if eqn.primitive.name in _HOST_SYNC:
+            out.append(
+                f"host-sync primitive {eqn.primitive.name!r} in prepare "
+                f"program {ctx.label!r}: device-fused prepare must contain "
+                "zero host callbacks (the pipeline falls back to host "
+                "numpy instead of round-tripping mid-program)"
+            )
+    return out
+
+
 def _pass_retrace_budget(ctx: _Ctx) -> list[str]:
     if not ctx.canonical or ctx.sig is None:
         return []
@@ -327,6 +352,7 @@ PASSES: list[tuple[str, object]] = [
     ("large-const", _pass_large_const),
     ("collectives", _pass_collectives),
     ("host-sync", _pass_host_sync),
+    ("prepare-sync", _pass_prepare_sync),
     ("retrace-budget", _pass_retrace_budget),
     ("batch-retrace", _pass_batch_retrace),
 ]

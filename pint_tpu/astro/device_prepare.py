@@ -1,0 +1,286 @@
+"""Device-fused TOA-prepare programs: the prepare-path series on the chip.
+
+The flagship first fit's hidden cost is host-side prepare work: the
+VSOP87/analytic-ephemeris series, the IAU precession/nutation/Earth-rotation
+chain behind the site posvels, and the N-body serve interpolation all ran
+as numpy loops over 1e5 TOAs (BENCH_r05's unattributed 91 s; ROADMAP item
+1). The astro series modules are now array-namespace-parametric
+(``xp=np`` host default, ``xp=jnp`` here), so this module compiles each
+prepare step into ONE fused XLA program riding the existing
+``TimedProgram`` machinery — persistent compile cache, AOT warmup, the
+jaxpr auditor (every ``prepare_*`` program must contain zero host-sync
+primitives, the ``prepare-sync`` audit pass) and the stage telemetry all
+apply.
+
+Three programs:
+
+- ``prepare_geometry``: the full ITRF->GCRS chain (Fukushima-Williams
+  precession, IAU2000B nutation, ERA/GAST, polar motion) for one
+  observatory's epochs — ``astro/erot.py`` with ``xp=jnp``.
+- ``prepare_ephemeris``: analytic barycentric posvel (VSOP87 Earth +
+  planet series + Meeus Moon + Kepler elements + the Sun barycentric
+  constraint, central-difference velocities) for every requested body in
+  one program — ``astro/ephemeris.py`` with ``xp=jnp``.
+- ``prepare_nbody``: the N-body window's serve path (cubic-Hermite
+  interpolation of the integrated trajectory + the in-band
+  anchor-correction design), term-for-term ``astro/nbody.py``
+  ``posvel``/``_posvel_raw``/``_band_design``; the trajectory grids ride
+  the argument list (never baked constants — the large-const audit pass
+  enforces it).
+
+Engagement: ``PINT_TPU_DEVICE_PREPARE`` = ``auto`` (default; on for
+non-CPU backends, where the host numpy loops stall the chip), ``1``
+(force — the CPU parity tests), ``0`` (off). Any device-path failure
+falls back to the identical host formulas.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from pint_tpu.utils import knobs
+from pint_tpu.utils.logging import get_logger
+
+log = get_logger("pint_tpu.prepare")
+
+__all__ = [
+    "enabled", "site_posvel_device", "analytic_posvel_device",
+    "nbody_posvel_device",
+]
+
+
+def enabled() -> bool:
+    """True when prepare-path series should evaluate as fused device
+    programs (knob semantics in the module docstring)."""
+    mode = knobs.get("PINT_TPU_DEVICE_PREPARE")
+    if mode == "1":
+        return True
+    if mode != "auto":
+        return False
+    try:
+        import jax
+
+        return jax.default_backend() != "cpu"
+    except Exception:  # pragma: no cover — no usable jax backend  # jaxlint: disable=silent-except — device prepare is an optimization; host numpy path is the identical fallback
+        return False
+
+
+#: process-global program cache: key -> TimedProgram
+_programs: dict = {}
+
+
+def _program(key, build):
+    prog = _programs.get(key)
+    if prog is None:
+        prog = _programs[key] = build()
+        from pint_tpu.ops import perf
+
+        perf.add("prepare_device_programs")
+    return prog
+
+
+# --- site geometry ----------------------------------------------------------------
+
+
+def _build_geometry_program():
+    import jax
+    import jax.numpy as jnp
+
+    from pint_tpu.astro import erot
+    from pint_tpu.ops.compile import TimedProgram, precision_jit
+
+    def fn(itrf_m, ut1_mjd, tt_jcent, xp_rad, yp_rad):
+        return erot.itrf_to_gcrs_posvel(
+            itrf_m, ut1_mjd, tt_jcent, xp_rad=xp_rad, yp_rad=yp_rad, xp=jnp)
+
+    return TimedProgram(precision_jit(fn), "prepare_geometry")
+
+
+def site_posvel_device(itrf_m, ut1_mjd, tt_jcent, xp_rad, yp_rad):
+    """Fused-device ITRF->GCRS site posvel; same arithmetic as
+    ``erot.itrf_to_gcrs_posvel`` (host numpy) by construction."""
+    prog = _program("geometry", _build_geometry_program)
+    p, v = prog(np.asarray(itrf_m, np.float64), np.asarray(ut1_mjd),
+                np.asarray(tt_jcent), np.asarray(xp_rad), np.asarray(yp_rad))
+    return np.asarray(p), np.asarray(v)
+
+
+# --- analytic ephemeris -----------------------------------------------------------
+
+
+def _build_analytic_program(bodies: tuple[str, ...], dt_s: float):
+    import jax.numpy as jnp
+
+    from pint_tpu.astro.ephemeris import AnalyticEphemeris
+    from pint_tpu.ops.compile import TimedProgram, precision_jit
+
+    eph = AnalyticEphemeris()  # pure math; no window state touched here
+
+    def fn(T):
+        return tuple(
+            eph._posvel_analytic(b, T, dt_s=dt_s, xp=jnp) for b in bodies)
+
+    return TimedProgram(precision_jit(fn), "prepare_ephemeris")
+
+
+def analytic_posvel_device(bodies: tuple[str, ...], tdb_jcent,
+                           dt_s: float = 16.0) -> dict:
+    """{body: (pos [m], vel [m/s])} for all requested bodies from ONE
+    fused program evaluating the analytic series chain on device."""
+    prog = _program(("analytic", tuple(bodies), float(dt_s)),
+                    lambda: _build_analytic_program(tuple(bodies), dt_s))
+    out = prog(np.asarray(tdb_jcent, np.float64))
+    return {b: (np.asarray(p), np.asarray(v))
+            for b, (p, v) in zip(bodies, out)}
+
+
+# --- N-body window serve ----------------------------------------------------------
+
+
+def _band_design_jnp(t, periods_d, half_span_s):
+    """jnp twin of ``NBodyEphemeris._band_design(..., deriv=True)``:
+    {1, t..t^6} + (1, t) x sin/cos columns at the window's trusted
+    periods, plus the time-derivative columns."""
+    import jax.numpy as jnp
+
+    DAY_S = 86400.0
+    S = half_span_s
+    tn = t / S
+    cols = [tn**k for k in range(7)]
+    cols[0] = jnp.ones_like(t)
+    dcols = [jnp.zeros_like(t), jnp.full_like(t, 1.0 / S)]
+    dcols += [k * tn ** (k - 1) / S for k in range(2, 7)]
+    for period_d in periods_d:
+        w = 2 * np.pi / (period_d * DAY_S)
+        s, c = jnp.sin(w * t), jnp.cos(w * t)
+        cols += [s, c, tn * s, tn * c]
+        dcols += [w * c, -w * s, s / S + tn * w * c, c / S - tn * w * s]
+    return jnp.stack(cols, axis=1), jnp.stack(dcols, axis=1)
+
+
+def _build_nbody_program(body_indices: tuple[int, ...],
+                         band_of: tuple[int, ...],
+                         t0: float, half_span_s: float,
+                         periods_e: tuple, periods_m: tuple):
+    """One fused program serving every requested body of an N-body window:
+    Hermite interpolation for all bodies + the Earth/Moon in-band
+    corrections. ``band_of[i]`` = 0 none, 1 earth correction, 2 earth+moon
+    (term-for-term ``NBodyEphemeris.posvel``). Trajectory arrays are
+    ARGUMENTS: a window's 2+ MB grids must never bake into the jaxpr."""
+    import jax.numpy as jnp
+
+    from pint_tpu.ops.compile import TimedProgram, precision_jit
+
+    CENT_S = 36525.0 * 86400.0
+
+    def fn(t_jcent, grid_s, pos, vel, corr_e, corr_m):
+        t = (t_jcent - t0) * CENT_S
+        h = grid_s[1] - grid_s[0]
+        k = jnp.clip(((t - grid_s[0]) // h).astype(jnp.int32),
+                     0, grid_s.shape[0] - 2)
+        u = ((t - grid_s[k]) / h)[..., None]
+        h00 = 2 * u**3 - 3 * u**2 + 1
+        h10 = u**3 - 2 * u**2 + u
+        h01 = -2 * u**3 + 3 * u**2
+        h11 = u**3 - u**2
+        d00 = (6 * u**2 - 6 * u) / h
+        d10 = (3 * u**2 - 4 * u + 1) / h
+        d01 = (-6 * u**2 + 6 * u) / h
+        d11 = (3 * u**2 - 2 * u) / h
+        Ge, dGe = _band_design_jnp(t, periods_e, half_span_s)
+        Gm, dGm = _band_design_jnp(t, periods_m, half_span_s)
+        out = []
+        for bi, band in zip(body_indices, band_of):
+            p0, p1 = pos[k, bi], pos[k + 1, bi]
+            v0, v1 = vel[k, bi] * h, vel[k + 1, bi] * h
+            p = h00 * p0 + h10 * v0 + h01 * p1 + h11 * v1
+            v = d00 * p0 + d10 * v0 + d01 * p1 + d11 * v1
+            if band >= 1:
+                p = p - Ge @ corr_e
+                v = v - dGe @ corr_e
+            if band >= 2:
+                p = p - Gm @ corr_m
+                v = v - dGm @ corr_m
+            out.append((p, v))
+        return tuple(out)
+
+    return TimedProgram(precision_jit(fn), "prepare_nbody")
+
+
+#: mass weight of the Moon in the EMB combination, set lazily from the
+#: package constant (kept here so the program closure stays tiny)
+def _emb_weight():
+    from pint_tpu import EARTH_MOON_MASS_RATIO
+
+    return 1.0 / (1.0 + EARTH_MOON_MASS_RATIO)
+
+
+def nbody_posvel_device(nb, bodies: tuple[str, ...], t_jcent) -> dict | None:
+    """{body: (pos, vel)} served from `nb` (an ``NBodyEphemeris``) by one
+    fused device program; None when a requested body is outside the
+    window's integrated set (caller falls back to the host path)."""
+    from pint_tpu.astro.nbody import _BODIES
+
+    # expand emb into earth+moon rows; combine after the program returns
+    expanded: list[str] = []
+    for b in bodies:
+        for bb in (("earth", "moon") if b == "emb" else (b,)):
+            if bb not in _BODIES:
+                return None
+            if bb not in expanded:
+                expanded.append(bb)
+    body_indices = tuple(_BODIES.index(b) for b in expanded)
+    band_of = tuple(
+        (2 if b == "moon" else 1) if b in ("earth", "moon") else 0
+        for b in expanded)
+    key = ("nbody", body_indices, band_of, round(nb.t0, 10),
+           round(nb.half_span_s, 3), tuple(nb._periods_e),
+           tuple(nb._periods_m))
+    prog = _program(key, lambda: _build_nbody_program(
+        body_indices, band_of, nb.t0, nb.half_span_s,
+        tuple(nb._periods_e), tuple(nb._periods_m)))
+    out = prog(np.asarray(t_jcent, np.float64), nb.grid_s, nb.pos, nb.vel,
+               nb._corr_e, nb._corr_m)
+    served = {b: (np.asarray(p), np.asarray(v))
+              for b, (p, v) in zip(expanded, out)}
+    result = {}
+    for b in bodies:
+        if b == "emb":
+            (pe, ve), (pm, vm) = served["earth"], served["moon"]
+            w = _emb_weight()
+            result[b] = (pe + (pm - pe) * w, ve + (vm - ve) * w)
+        else:
+            result[b] = served[b]
+    return result
+
+
+def posvel_ssb_many(eph, bodies: tuple[str, ...], tdb_jcent) -> dict | None:
+    """Serve ``{body: (pos, vel)}`` for every requested body through the
+    fused device programs, or None when the device path cannot serve this
+    ephemeris/config (caller uses the per-body host path).
+
+    Mirrors ``AnalyticEphemeris.posvel_ssb``'s dispatch: the N-body
+    window when engaged, the analytic series otherwise. SPK-kernel
+    ephemerides stay on the host reader.
+    """
+    from pint_tpu.astro.ephemeris import AnalyticEphemeris, _ELEMENTS
+
+    if not enabled() or not isinstance(eph, AnalyticEphemeris):
+        return None
+    T = np.asarray(tdb_jcent, np.float64)
+    known = all(
+        b in ("earth", "moon", "emb", "sun") or b in _ELEMENTS
+        for b in bodies)
+    if not known:
+        return None
+    try:
+        nb = eph._nbody_for(T)
+        if nb is not None:
+            out = nbody_posvel_device(nb, tuple(bodies), T)
+            if out is not None:
+                return out
+            return None
+        return analytic_posvel_device(tuple(bodies), T)
+    except Exception as e:  # noqa: BLE001  # jaxlint: disable=silent-except — device prepare is an optimization; the host numpy path is the identical-formula fallback and the miss is logged
+        log.warning(f"device prepare fell back to host numpy: {e}")
+        return None

@@ -82,15 +82,17 @@ _ECL2EQU = np.array(
 )
 
 
-def _solve_kepler(M: np.ndarray, e: float, iters: int = 10) -> np.ndarray:
-    """Newton iteration for the eccentric anomaly (host, fixed count)."""
-    E = M + e * np.sin(M)
+def _solve_kepler(M: np.ndarray, e, iters: int = 10, xp=np) -> np.ndarray:
+    """Newton iteration for the eccentric anomaly (fixed count; pure
+    elementwise, so it runs identically under numpy and a traced jnp
+    program)."""
+    E = M + e * xp.sin(M)
     for _ in range(iters):
-        E = E - (E - e * np.sin(E) - M) / (1.0 - e * np.cos(E))
+        E = E - (E - e * xp.sin(E) - M) / (1.0 - e * xp.cos(E))
     return E
 
 
-def _helio_ecliptic(body: str, T: np.ndarray) -> np.ndarray:
+def _helio_ecliptic(body: str, T: np.ndarray, xp=np) -> np.ndarray:
     """Heliocentric ecliptic-J2000 position [AU], shape (..., 3)."""
     el0, rate = _ELEMENTS[body]
     a = el0[0] + rate[0] * T
@@ -99,18 +101,18 @@ def _helio_ecliptic(body: str, T: np.ndarray) -> np.ndarray:
     L = (el0[3] + rate[3] * T) * DEG
     lperi = (el0[4] + rate[4] * T) * DEG
     lnode = (el0[5] + rate[5] * T) * DEG
-    M = np.remainder(L - lperi, 2 * np.pi)
+    M = xp.remainder(L - lperi, 2 * np.pi)
     w = lperi - lnode
-    E = _solve_kepler(M, float(np.mean(e)))
-    xp = a * (np.cos(E) - e)
-    yp = a * np.sqrt(1 - e * e) * np.sin(E)
-    cw, sw = np.cos(w), np.sin(w)
-    cO, sO = np.cos(lnode), np.sin(lnode)
-    ci, si = np.cos(inc), np.sin(inc)
-    x = (cw * cO - sw * sO * ci) * xp + (-sw * cO - cw * sO * ci) * yp
-    y = (cw * sO + sw * cO * ci) * xp + (-sw * sO + cw * cO * ci) * yp
-    z = (sw * si) * xp + (cw * si) * yp
-    return np.stack([x, y, z], axis=-1)
+    E = _solve_kepler(M, xp.mean(e), xp=xp)
+    px = a * (xp.cos(E) - e)
+    py = a * xp.sqrt(1 - e * e) * xp.sin(E)
+    cw, sw = xp.cos(w), xp.sin(w)
+    cO, sO = xp.cos(lnode), xp.sin(lnode)
+    ci, si = xp.cos(inc), xp.sin(inc)
+    x = (cw * cO - sw * sO * ci) * px + (-sw * cO - cw * sO * ci) * py
+    y = (cw * sO + sw * cO * ci) * px + (-sw * sO + cw * cO * ci) * py
+    z = (sw * si) * px + (cw * si) * py
+    return xp.stack([x, y, z], axis=-1)
 
 
 # --- Moon (truncated Meeus ch.47 / ELP-2000 main terms) -------------------------
@@ -175,7 +177,7 @@ _MOON_B = [
 ]
 
 
-def _moon_geocentric_ecliptic_date(T: np.ndarray) -> np.ndarray:
+def _moon_geocentric_ecliptic_date(T: np.ndarray, xp=np) -> np.ndarray:
     """Geocentric ecliptic-of-date Moon position [m] (Meeus accuracy ~0.003
     deg in longitude, ~0.001 deg latitude, ~20 km distance with this
     truncation — Earth-offset error ~10 m)."""
@@ -186,42 +188,42 @@ def _moon_geocentric_ecliptic_date(T: np.ndarray) -> np.ndarray:
     F = (93.2720950 + 483202.0175233 * T - 0.0036539 * T**2 - T**3 / 3526000.0) * DEG
     E = 1.0 - 0.002516 * T - 0.0000074 * T**2
 
-    suml = np.zeros_like(T)
-    sumr = np.zeros_like(T)
+    suml = xp.zeros_like(T)
+    sumr = xp.zeros_like(T)
     for d, m, mp, f, sl, sr in _MOON_LR:
         arg = d * D + m * M + mp * Mp + f * F
         efac = E if abs(m) == 1 else (E * E if abs(m) == 2 else 1.0)
-        suml = suml + sl * efac * np.sin(arg)
-        sumr = sumr + sr * efac * np.cos(arg)
-    sumb = np.zeros_like(T)
+        suml = suml + sl * efac * xp.sin(arg)
+        sumr = sumr + sr * efac * xp.cos(arg)
+    sumb = xp.zeros_like(T)
     for d, m, mp, f, sb in _MOON_B:
         arg = d * D + m * M + mp * Mp + f * F
         efac = E if abs(m) == 1 else (E * E if abs(m) == 2 else 1.0)
-        sumb = sumb + sb * efac * np.sin(arg)
+        sumb = sumb + sb * efac * xp.sin(arg)
     # additive perturbations (Venus, Jupiter, flattening)
     A1 = (119.75 + 131.849 * T) * DEG
     A2 = (53.09 + 479264.290 * T) * DEG
     A3 = (313.45 + 481266.484 * T) * DEG
-    suml = suml + 3958 * np.sin(A1) + 1962 * np.sin(Lp - F) + 318 * np.sin(A2)
+    suml = suml + 3958 * xp.sin(A1) + 1962 * xp.sin(Lp - F) + 318 * xp.sin(A2)
     sumb = (
         sumb
-        - 2235 * np.sin(Lp)
-        + 382 * np.sin(A3)
-        + 175 * np.sin(A1 - F)
-        + 175 * np.sin(A1 + F)
-        + 127 * np.sin(Lp - Mp)
-        - 115 * np.sin(Lp + Mp)
+        - 2235 * xp.sin(Lp)
+        + 382 * xp.sin(A3)
+        + 175 * xp.sin(A1 - F)
+        + 175 * xp.sin(A1 + F)
+        + 127 * xp.sin(Lp - Mp)
+        - 115 * xp.sin(Lp + Mp)
     )
     lam = Lp + suml * 1e-6 * DEG
     beta = sumb * 1e-6 * DEG
     r = (385000.56 + sumr * 1e-3) * 1e3  # meters
-    cb = np.cos(beta)
-    return np.stack(
-        [r * cb * np.cos(lam), r * cb * np.sin(lam), r * np.sin(beta)], axis=-1
+    cb = xp.cos(beta)
+    return xp.stack(
+        [r * cb * xp.cos(lam), r * cb * xp.sin(lam), r * xp.sin(beta)], axis=-1
     )
 
 
-def _ecl_date_matrix(T: np.ndarray) -> np.ndarray:
+def _ecl_date_matrix(T: np.ndarray, xp=np) -> np.ndarray:
     """Rotation mean-ecliptic-&-equinox-of-date -> GCRS/ICRS, exactly
     consistent with the IAU2006 Fukushima-Williams bias-precession of
     astro/erot.py:
@@ -235,14 +237,14 @@ def _ecl_date_matrix(T: np.ndarray) -> np.ndarray:
     (Earth, Moon, Jupiter, Saturn)."""
     from pint_tpu.astro.erot import _rx, _rz, fukushima_williams
 
-    gamb, phib, psib, _ = fukushima_williams(np.asarray(T, np.float64))
-    return _rz(-gamb) @ _rx(-phib) @ _rz(psib)
+    gamb, phib, psib, _ = fukushima_williams(xp.asarray(T, np.float64), xp=xp)
+    return _rz(-gamb, xp) @ _rx(-phib, xp) @ _rz(psib, xp)
 
 
-def _ecl_date_to_gcrs(vec: np.ndarray, T: np.ndarray, M: np.ndarray | None = None) -> np.ndarray:
+def _ecl_date_to_gcrs(vec: np.ndarray, T: np.ndarray, M: np.ndarray | None = None, xp=np) -> np.ndarray:
     if M is None:
-        M = _ecl_date_matrix(T)
-    return np.einsum("...ij,...j->...i", M, vec)
+        M = _ecl_date_matrix(T, xp=xp)
+    return xp.einsum("...ij,...j->...i", M, vec)
 
 
 class AnalyticEphemeris:
@@ -267,7 +269,7 @@ class AnalyticEphemeris:
         "emb",
     )
 
-    def _planets_helio_icrs(self, T: np.ndarray, M_fw=None) -> dict[str, np.ndarray]:
+    def _planets_helio_icrs(self, T: np.ndarray, M_fw=None, xp=np) -> dict[str, np.ndarray]:
         """Heliocentric ICRS positions [m] of the planets/EMB.
 
         Venus/Jupiter/Saturn/Uranus/Neptune come from their truncated
@@ -281,26 +283,27 @@ class AnalyticEphemeris:
         from pint_tpu.astro import vsop87_planets
 
         if M_fw is None:
-            M_fw = _ecl_date_matrix(T)
+            M_fw = _ecl_date_matrix(T, xp=xp)
         helio = {}
         for b in _ELEMENTS:
             if b in vsop87_planets.bodies:
                 helio[b] = _ecl_date_to_gcrs(
-                    vsop87_planets.planet_helio_ecl_date(b, T) * AU_M, T, M_fw
+                    vsop87_planets.planet_helio_ecl_date(b, T, xp=xp) * AU_M,
+                    T, M_fw, xp=xp
                 )
             else:
-                helio[b] = (_helio_ecliptic(b, T) * AU_M) @ _ECL2EQU.T
+                helio[b] = (_helio_ecliptic(b, T, xp=xp) * AU_M) @ _ECL2EQU.T
         return helio
 
-    def _sun_ssb_icrs(self, helio: dict[str, np.ndarray]) -> np.ndarray:
+    def _sun_ssb_icrs(self, helio: dict[str, np.ndarray], xp=np) -> np.ndarray:
         gm_tot = GM_SUN + sum(GM_BODY[b] for b in GM_BODY)
-        acc = np.zeros_like(helio["emb"])
+        acc = xp.zeros_like(helio["emb"])
         for b, r in helio.items():
             gm = GM_BODY["earth"] + GM_BODY["moon"] if b == "emb" else GM_BODY[b]
             acc = acc + gm * r
         return -acc / gm_tot
 
-    def pos_ssb(self, body: str, tdb_jcent: np.ndarray) -> np.ndarray:
+    def pos_ssb(self, body: str, tdb_jcent: np.ndarray, xp=np) -> np.ndarray:
         """Barycentric ICRS position [m] of a body at TDB centuries since
         J2000; shape (..., 3).
 
@@ -308,34 +311,35 @@ class AnalyticEphemeris:
         (astro/vsop87.py) + Meeus lunar series; Jupiter/Saturn their
         VSOP87D series; other planets the Keplerian mean elements.  The Sun
         sits at the barycentric constraint over all of them."""
-        T = np.asarray(tdb_jcent, np.float64)
-        M_fw = _ecl_date_matrix(T)
-        helio = self._planets_helio_icrs(T, M_fw)
-        sun = self._sun_ssb_icrs(helio)
+        T = xp.asarray(tdb_jcent, np.float64)
+        M_fw = _ecl_date_matrix(T, xp=xp)
+        helio = self._planets_helio_icrs(T, M_fw, xp=xp)
+        sun = self._sun_ssb_icrs(helio, xp=xp)
         if body == "sun":
             return sun
         if body in ("earth", "moon", "emb"):
             from pint_tpu.astro import vsop87
 
             earth = sun + _ecl_date_to_gcrs(
-                vsop87.earth_helio_ecl_date(T) * AU_M, T, M_fw
+                vsop87.earth_helio_ecl_date(T, xp=xp) * AU_M, T, M_fw, xp=xp
             )
             if body == "earth":
                 return earth
-            moon_gc = _ecl_date_to_gcrs(_moon_geocentric_ecliptic_date(T), T, M_fw)
+            moon_gc = _ecl_date_to_gcrs(
+                _moon_geocentric_ecliptic_date(T, xp=xp), T, M_fw, xp=xp)
             if body == "moon":
                 return earth + moon_gc
             return earth + moon_gc / (1.0 + EARTH_MOON_MASS_RATIO)
         return sun + helio[body]
 
-    def _posvel_analytic(self, body: str, tdb_jcent: np.ndarray, dt_s: float = 16.0):
+    def _posvel_analytic(self, body: str, tdb_jcent: np.ndarray, dt_s: float = 16.0, xp=np):
         """(pos [m], vel [m/s]) via central differencing of the analytic
         position (smooth series; differencing error << series error)."""
-        T = np.asarray(tdb_jcent, np.float64)
+        T = xp.asarray(tdb_jcent, np.float64)
         dT = dt_s / (36525.0 * 86400.0)
-        p0 = self.pos_ssb(body, T - dT)
-        p1 = self.pos_ssb(body, T + dT)
-        pos = self.pos_ssb(body, T)
+        p0 = self.pos_ssb(body, T - dT, xp=xp)
+        p1 = self.pos_ssb(body, T + dT, xp=xp)
+        pos = self.pos_ssb(body, T, xp=xp)
         vel = (p1 - p0) / (2 * dt_s)
         return pos, vel
 
@@ -367,8 +371,15 @@ class AnalyticEphemeris:
         cache = self._nbody_windows
         if key not in cache:
             from pint_tpu.astro.nbody import NBodyEphemeris
+            from pint_tpu.ops import perf
 
-            cache[key] = NBodyEphemeris(self, t0_q, span_years=span_yr)
+            # the window build (disk-cached, but ~70 s at flagship span on
+            # a cold cache) is the single largest hidden prepare cost: it
+            # gets its own stage + counter so a first fit that triggers
+            # one is attributed instead of vanishing into "other"
+            with perf.stage("nbody_build"):
+                perf.add("nbody_window_builds")
+                cache[key] = NBodyEphemeris(self, t0_q, span_years=span_yr)
         return cache[key]
 
     def posvel_ssb(self, body: str, tdb_jcent: np.ndarray, dt_s: float = 16.0):

@@ -29,19 +29,19 @@ TWO_PI = 2.0 * np.pi
 OMEGA_EARTH = 1.00273781191135448 * TWO_PI / 86400.0
 
 
-def _poly(T, *coeffs):
-    out = np.zeros_like(T)
+def _poly(T, *coeffs, xp=np):
+    out = xp.zeros_like(T)
     for c in reversed(coeffs):
         out = out * T + c
     return out
 
 
-def fukushima_williams(T: np.ndarray):
+def fukushima_williams(T: np.ndarray, xp=np):
     """IAU2006 bias-precession F-W angles (radians); T = TT centuries J2000."""
-    gamb = _poly(T, -0.052928, 10.556378, 0.4932044, -0.00031238, -2.788e-6, 2.60e-8) * ARCSEC
-    phib = _poly(T, 84381.412819, -46.811016, 0.0511268, 0.00053289, -4.40e-7, -1.76e-8) * ARCSEC
-    psib = _poly(T, -0.041775, 5038.481484, 1.5584175, -0.00018522, -2.6452e-5, -1.48e-8) * ARCSEC
-    epsa = _poly(T, 84381.406, -46.836769, -0.0001831, 0.00200340, -5.76e-7, -4.34e-8) * ARCSEC
+    gamb = _poly(T, -0.052928, 10.556378, 0.4932044, -0.00031238, -2.788e-6, 2.60e-8, xp=xp) * ARCSEC
+    phib = _poly(T, 84381.412819, -46.811016, 0.0511268, 0.00053289, -4.40e-7, -1.76e-8, xp=xp) * ARCSEC
+    psib = _poly(T, -0.041775, 5038.481484, 1.5584175, -0.00018522, -2.6452e-5, -1.48e-8, xp=xp) * ARCSEC
+    epsa = _poly(T, 84381.406, -46.836769, -0.0001831, 0.00200340, -5.76e-7, -4.34e-8, xp=xp) * ARCSEC
     return gamb, phib, psib, epsa
 
 
@@ -91,75 +91,88 @@ _NUT = [
 ]
 
 
-def nutation(T: np.ndarray):
-    """(dpsi, deps) radians, truncated IAU2000B."""
+_NUT_TABLE = np.array(_NUT)  # (31, 9): argument multipliers + amplitudes
+
+
+def nutation(T: np.ndarray, xp=np):
+    """(dpsi, deps) radians, truncated IAU2000B.
+
+    One (N, terms) outer product instead of a Python loop over terms: the
+    same arithmetic (summation order over terms is preserved by summing
+    along the last axis), vectorized for both host numpy and the fused
+    device-prepare program (astro/device_prepare.py passes xp=jnp).
+    """
     l, lp, F, D, Om = delaunay_args(T)
-    dpsi = np.zeros_like(T)
-    deps = np.zeros_like(T)
-    for cl, clp, cF, cD, cOm, ps, pst, ec, ect in _NUT:
-        arg = cl * l + clp * lp + cF * F + cD * D + cOm * Om
-        dpsi = dpsi + (ps + pst * T) * np.sin(arg)
-        deps = deps + (ec + ect * T) * np.cos(arg)
+    mult = _NUT_TABLE[:, :5]  # (terms, 5)
+    ps, pst, ec, ect = (_NUT_TABLE[:, 5], _NUT_TABLE[:, 6],
+                        _NUT_TABLE[:, 7], _NUT_TABLE[:, 8])
+    args = xp.stack([l, lp, F, D, Om], axis=-1)  # (..., 5)
+    arg = args @ mult.T  # (..., terms)
+    Tcol = T[..., None]
+    dpsi = xp.sum((ps + pst * Tcol) * xp.sin(arg), axis=-1)
+    deps = xp.sum((ec + ect * Tcol) * xp.cos(arg), axis=-1)
     return dpsi * 1e-7 * ARCSEC, deps * 1e-7 * ARCSEC
 
 
-def _rx(theta):
-    c, s = np.cos(theta), np.sin(theta)
-    z, o = np.zeros_like(c), np.ones_like(c)
-    return np.stack(
+def _rx(theta, xp=np):
+    c, s = xp.cos(theta), xp.sin(theta)
+    z, o = xp.zeros_like(c), xp.ones_like(c)
+    return xp.stack(
         [
-            np.stack([o, z, z], -1),
-            np.stack([z, c, s], -1),
-            np.stack([z, -s, c], -1),
+            xp.stack([o, z, z], -1),
+            xp.stack([z, c, s], -1),
+            xp.stack([z, -s, c], -1),
         ],
         -2,
     )
 
 
-def _rz(theta):
-    c, s = np.cos(theta), np.sin(theta)
-    z, o = np.zeros_like(c), np.ones_like(c)
-    return np.stack(
+def _rz(theta, xp=np):
+    c, s = xp.cos(theta), xp.sin(theta)
+    z, o = xp.zeros_like(c), xp.ones_like(c)
+    return xp.stack(
         [
-            np.stack([c, s, z], -1),
-            np.stack([-s, c, z], -1),
-            np.stack([z, z, o], -1),
+            xp.stack([c, s, z], -1),
+            xp.stack([-s, c, z], -1),
+            xp.stack([z, z, o], -1),
         ],
         -2,
     )
 
 
-def npb_matrix(T: np.ndarray) -> np.ndarray:
+def npb_matrix(T: np.ndarray, xp=np) -> np.ndarray:
     """GCRS -> true-of-date matrix (..., 3, 3): r_tod = M @ r_gcrs."""
-    gamb, phib, psib, epsa = fukushima_williams(T)
-    dpsi, deps = nutation(T)
+    gamb, phib, psib, epsa = fukushima_williams(T, xp=xp)
+    dpsi, deps = nutation(T, xp=xp)
     # SOFA fw2m composition: R1(-eps) R3(-psi) R1(phi) R3(gamb)
-    return _rx(-(epsa + deps)) @ _rz(-(psib + dpsi)) @ _rx(phib) @ _rz(gamb)
+    return (_rx(-(epsa + deps), xp) @ _rz(-(psib + dpsi), xp)
+            @ _rx(phib, xp) @ _rz(gamb, xp))
 
 
-def era(ut1_mjd: np.ndarray) -> np.ndarray:
+def era(ut1_mjd: np.ndarray, xp=np) -> np.ndarray:
     """Earth rotation angle (radians) from UT1 MJD."""
-    du = np.asarray(ut1_mjd, np.float64) - 51544.5
-    f = np.remainder(du, 1.0)
-    return TWO_PI * np.remainder(0.7790572732640 + f + 0.00273781191135448 * du, 1.0)
+    du = xp.asarray(ut1_mjd, np.float64) - 51544.5
+    f = xp.remainder(du, 1.0)
+    return TWO_PI * xp.remainder(0.7790572732640 + f + 0.00273781191135448 * du, 1.0)
 
 
-def gmst06(ut1_mjd: np.ndarray, tt_jcent: np.ndarray) -> np.ndarray:
-    e = era(ut1_mjd)
+def gmst06(ut1_mjd: np.ndarray, tt_jcent: np.ndarray, xp=np) -> np.ndarray:
+    e = era(ut1_mjd, xp=xp)
     T = tt_jcent
-    corr = _poly(T, 0.014506, 4612.156534, 1.3915817, -0.00000044, -2.9956e-5, -3.68e-8) * ARCSEC
+    corr = _poly(T, 0.014506, 4612.156534, 1.3915817, -0.00000044, -2.9956e-5, -3.68e-8, xp=xp) * ARCSEC
     return e + corr
 
 
-def gast06(ut1_mjd: np.ndarray, tt_jcent: np.ndarray) -> np.ndarray:
-    _, _, _, epsa = fukushima_williams(tt_jcent)
-    dpsi, _ = nutation(tt_jcent)
-    return gmst06(ut1_mjd, tt_jcent) + dpsi * np.cos(epsa)
+def gast06(ut1_mjd: np.ndarray, tt_jcent: np.ndarray, xp=np) -> np.ndarray:
+    _, _, _, epsa = fukushima_williams(tt_jcent, xp=xp)
+    dpsi, _ = nutation(tt_jcent, xp=xp)
+    return gmst06(ut1_mjd, tt_jcent, xp=xp) + dpsi * xp.cos(epsa)
 
 
 def itrf_to_gcrs_posvel(
     itrf_m: np.ndarray, ut1_mjd: np.ndarray, tt_jcent: np.ndarray,
     xp_rad: np.ndarray | None = None, yp_rad: np.ndarray | None = None,
+    xp=np,
 ) -> tuple[np.ndarray, np.ndarray]:
     """Site GCRS position [m] and velocity [m/s] at each epoch.
 
@@ -175,15 +188,15 @@ def itrf_to_gcrs_posvel(
         zw = z + xp_rad * x - yp_rad * y
     else:
         xw, yw, zw = x, y, z
-    theta = gast06(ut1_mjd, tt_jcent)
-    M = npb_matrix(tt_jcent)  # (N,3,3) gcrs->tod
-    c, s = np.cos(theta), np.sin(theta)
-    r_tod = np.stack([c * xw - s * yw, s * xw + c * yw,
-                      np.broadcast_to(zw, c.shape)], -1)
-    v_tod = OMEGA_EARTH * np.stack(
-        [-s * xw - c * yw, c * xw - s * yw, np.zeros_like(c)], -1
+    theta = gast06(ut1_mjd, tt_jcent, xp=xp)
+    M = npb_matrix(tt_jcent, xp=xp)  # (N,3,3) gcrs->tod
+    c, s = xp.cos(theta), xp.sin(theta)
+    r_tod = xp.stack([c * xw - s * yw, s * xw + c * yw,
+                      xp.broadcast_to(zw, c.shape)], -1)
+    v_tod = OMEGA_EARTH * xp.stack(
+        [-s * xw - c * yw, c * xw - s * yw, xp.zeros_like(c)], -1
     )
     # transpose(M) maps tod -> gcrs
-    r_gcrs = np.einsum("...ji,...j->...i", M, r_tod)
-    v_gcrs = np.einsum("...ji,...j->...i", M, v_tod)
+    r_gcrs = xp.einsum("...ji,...j->...i", M, r_tod)
+    v_gcrs = xp.einsum("...ji,...j->...i", M, v_tod)
     return r_gcrs, v_gcrs

@@ -49,6 +49,21 @@ class TopoObs(Observatory):
     clock_files: tuple[str, ...] = ()
 
     def site_posvel_gcrs(self, ut1_mjd, tt_jcent, xp_rad=None, yp_rad=None):
+        from pint_tpu.astro import device_prepare
+
+        if device_prepare.enabled() and xp_rad is not None:
+            # the full precession/nutation/rotation chain as ONE fused
+            # device program (astro/device_prepare.py) — identical
+            # formulas, xp=jnp; any failure falls back to host numpy
+            try:
+                return device_prepare.site_posvel_device(
+                    np.asarray(self.itrf_xyz_m), ut1_mjd, tt_jcent,
+                    xp_rad, yp_rad)
+            except Exception as e:  # noqa: BLE001  # jaxlint: disable=silent-except — device prepare is an optimization; host numpy is the identical-formula fallback and the miss is logged
+                from pint_tpu.utils.logging import get_logger
+
+                get_logger("pint_tpu.prepare").warning(
+                    f"device site-geometry fell back to host numpy: {e}")
         return erot.itrf_to_gcrs_posvel(
             np.asarray(self.itrf_xyz_m), ut1_mjd, tt_jcent,
             xp_rad=xp_rad, yp_rad=yp_rad,

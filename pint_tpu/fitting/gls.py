@@ -508,10 +508,12 @@ class DownhillGLSFitter(GLSFitter):
     @perf.instrument_fit
     def fit_toas(self, maxiter: int = 30, required_chi2_decrease: float = 1e-2,
                  max_rejects: int = 16) -> FitResult:
+        from pint_tpu.fitting import state as _state
         from pint_tpu.fitting.wls import run_lm
 
         if len(self._free) == 0:
             return self._frozen_fit_result()
+        _state.maybe_auto_warm(self)
         if self._fused_on():
             from pint_tpu.fitting.sharded import run_fused_fit
 

@@ -549,9 +549,14 @@ def _lm_driver(free, pieces_fn, chi2_fn, eig_floor: float):
 
             t = jax.lax.while_loop(inner_cond, inner_body, t0)
             converged = (~t["accepted"]) | (t["gain"] < required_gain)
+            # a sub-threshold final step is reverted: convergence is
+            # declared AT the linearization point (run_lm's exact rule, so
+            # host ≡ fused stays term-for-term and a warm start from a
+            # converged snapshot reproduces the cold solution bitwise)
+            keep = t["accepted"] & (t["gain"] >= required_gain)
             return dict(
-                params=t["params"],
-                chi2=t["chi2"],
+                params=_tree_select(keep, t["params"], st["params"]),
+                chi2=jnp.where(keep, t["chi2"], st["chi2"]),
                 it=st["it"] + 1,
                 converged=converged,
                 trials=st["trials"] + t["k"],

@@ -1,0 +1,246 @@
+"""Fitter state as an explicit, serializable snapshot — warm-started fits.
+
+The flagship first fit spends its LM iterations walking from the parfile
+values to the optimum; a production service refits the same pulsar over
+and over, so those iterations are almost always re-deriving a solution a
+prior fit already found (ROADMAP item 1 "LM iterations wasted by a poor
+start", and the seed of item 4's serializable-fitter-state work). This
+module makes the fitted parameter vector a first-class artifact:
+
+- :class:`FitterState` — a JSON-serializable snapshot of one fit: the
+  model skeleton (fit kind, free-parameter set, extended-precision
+  backend), the fitted parameters as exact (hi, lo) float64 pairs (the
+  DD carriers round-trip losslessly), the formal uncertainties and chi².
+- :func:`snapshot` / :func:`warm_start` — capture a fitter's solution /
+  apply one to a compatible fitter before fitting. A warm-started
+  downhill fit starts at the prior optimum, so its FIRST fused-LM
+  iteration is an undamped Gauss-Newton polish (the damping schedule
+  restarts at lam=0) and convergence typically follows in 1-2
+  iterations instead of the cold walk — with the IDENTICAL fixed point:
+  the LM loop iterates until the same convergence test on the same
+  normal equations, so warm ≡ cold to the convergence tolerance
+  (locked ≤1e-10 rel in tests/test_warm_start.py).
+- **Skeleton safety.** ``warm_start`` refuses (returns False, or raises
+  with ``strict=True``) when the snapshot's skeleton does not match the
+  fitter — a stale snapshot can cost iterations, but it must never be
+  able to silently poison a different model's fit.
+- **Disk auto-warm.** With ``PINT_TPU_WARM_START=1`` every downhill
+  ``fit_toas`` first applies the newest matching snapshot under
+  ``$PINT_TPU_CACHE_DIR/fitstate`` (keyed by skeleton + dataset content)
+  and saves one after converging — a repeat flagship fit pays one GN
+  polish instead of the full cold walk. The telemetry latches
+  ``warm_start``/``warm_start_source`` into the fit breakdown either way.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass, field
+from pathlib import Path
+
+import numpy as np
+
+from pint_tpu.ops import perf
+from pint_tpu.utils.logging import get_logger
+
+log = get_logger("pint_tpu.fitting")
+
+__all__ = [
+    "FitterState", "snapshot", "warm_start", "dataset_key", "state_path",
+    "maybe_auto_warm", "auto_save",
+]
+
+_STATE_VERSION = 1
+
+
+@dataclass
+class FitterState:
+    """One fit's solution, serializable and backend-independent."""
+
+    kind: str                       # fused kind: "wls" | "gls" | "wideband"
+    free: tuple[str, ...]           # free-parameter names, fit order
+    xprec: str                      # extended-precision backend name
+    params: dict[str, tuple[float, float]] = field(default_factory=dict)
+    uncertainties: dict[str, float] = field(default_factory=dict)
+    chi2: float | None = None
+    dataset: str | None = None      # content key of the fitted TOAs
+    version: int = _STATE_VERSION
+
+    def skeleton(self) -> tuple:
+        return (self.version, self.kind, tuple(self.free), self.xprec)
+
+    def to_dict(self) -> dict:
+        return {
+            "version": self.version,
+            "kind": self.kind,
+            "free": list(self.free),
+            "xprec": self.xprec,
+            "params": {n: [hi, lo] for n, (hi, lo) in self.params.items()},
+            "uncertainties": dict(self.uncertainties),
+            "chi2": self.chi2,
+            "dataset": self.dataset,
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "FitterState":
+        return cls(
+            kind=d["kind"],
+            free=tuple(d["free"]),
+            xprec=d["xprec"],
+            params={n: (float(v[0]), float(v[1]))
+                    for n, v in d["params"].items()},
+            uncertainties={n: float(v)
+                           for n, v in d.get("uncertainties", {}).items()},
+            chi2=d.get("chi2"),
+            dataset=d.get("dataset"),
+            version=int(d.get("version", _STATE_VERSION)),
+        )
+
+    def save(self, path: str | Path) -> None:
+        path = Path(path)
+        os.makedirs(path.parent, exist_ok=True)
+        tmp = path.with_suffix(f".tmp{os.getpid()}")
+        with open(tmp, "w") as f:
+            json.dump(self.to_dict(), f, indent=1)
+        tmp.replace(path)
+
+    @classmethod
+    def load(cls, path: str | Path) -> "FitterState":
+        with open(path) as f:
+            return cls.from_dict(json.load(f))
+
+
+def _leaf_hilo(leaf) -> tuple[float, float]:
+    """Exact (hi, lo) float64 pair of any parameter leaf (DD/QF/plain)."""
+    from pint_tpu.ops.dd import DD
+    from pint_tpu.ops.xprec import params_to_dd
+
+    v = params_to_dd({"_": leaf})["_"]
+    if isinstance(v, DD):
+        return float(np.asarray(v.hi)), float(np.asarray(v.lo))
+    return float(np.asarray(v)), 0.0
+
+
+def snapshot(fitter) -> FitterState:
+    """Capture a fitter's current solution (post-fit model parameters +
+    the last FitResult's uncertainties/chi² when available)."""
+    res = fitter.result
+    return FitterState(
+        kind=fitter._fused_kind,
+        free=tuple(fitter._free),
+        xprec=fitter.model.xprec.name,
+        params={n: _leaf_hilo(fitter.model.params[n]) for n in fitter._free},
+        uncertainties=dict(res.uncertainties) if res is not None else {},
+        chi2=None if res is None else float(res.chi2),
+        dataset=dataset_key(fitter.toas),
+    )
+
+
+def warm_start(fitter, state: FitterState | str | Path,
+               strict: bool = False, source: str = "caller") -> bool:
+    """Apply a prior-fit snapshot to `fitter`'s model before fitting.
+
+    Validates the skeleton first: the fit kind, the exact free-parameter
+    set (order included — the fit vector is ordered) and the
+    extended-precision backend must all match, or nothing is applied
+    (False; raises ``ValueError`` under ``strict=True``). On success the
+    free parameters are overwritten with the snapshot's exact (hi, lo)
+    values and True is returned; the telemetry latch records the warm
+    start on the next fit's breakdown.
+    """
+    import jax.numpy as jnp
+
+    from pint_tpu.ops.dd import DD
+
+    if not isinstance(state, FitterState):
+        state = FitterState.load(state)
+    want = (_STATE_VERSION, fitter._fused_kind, tuple(fitter._free),
+            fitter.model.xprec.name)
+    if state.skeleton() != want:
+        msg = (f"fitter state skeleton {state.skeleton()} does not match "
+               f"fitter {want}; refusing the warm start")
+        if strict:
+            raise ValueError(msg)
+        log.warning(msg)
+        return False
+    params = dict(fitter.model.params)
+    for n, (hi, lo) in state.params.items():
+        if isinstance(params.get(n), DD):
+            params[n] = DD(jnp.asarray(hi, jnp.float64),
+                           jnp.asarray(lo, jnp.float64))
+        else:
+            # non-phase-critical leaves ride as plain f64 (the model code
+            # consumes them directly); hi is the exact fitted f64 value
+            params[n] = jnp.asarray(hi + lo, jnp.float64)
+    fitter.model.params = params
+    fitter._warm_source = source
+    perf.put("warm_start", True)
+    perf.put("warm_start_source", source)
+    return True
+
+
+# --- disk auto-warm ---------------------------------------------------------------
+
+
+def dataset_key(toas) -> str:
+    """Content key of a prepared TOA set: the TDB epochs + errors +
+    frequencies identify the fitted data (geometry columns follow from
+    them and the prepare config)."""
+    import hashlib
+
+    h = hashlib.sha256()
+    for a in (toas.tdb.day, toas.tdb.frac_hi, toas.tdb.frac_lo,
+              toas.error_us, toas.freq_mhz):
+        h.update(np.ascontiguousarray(a).tobytes())
+    return h.hexdigest()[:16]
+
+
+def state_path(fitter) -> Path:
+    """Canonical on-disk location of this (skeleton, dataset) snapshot."""
+    import hashlib
+
+    from pint_tpu.utils.cache import cache_root
+
+    skel = (f"v{_STATE_VERSION}-{fitter._fused_kind}-"
+            f"{','.join(fitter._free)}-{fitter.model.xprec.name}")
+    skel_h = hashlib.sha256(skel.encode()).hexdigest()[:16]
+    return (cache_root() / "fitstate"
+            / f"fit-{skel_h}-{dataset_key(fitter.toas)}.json")
+
+
+def maybe_auto_warm(fitter) -> bool:
+    """Hook run at the top of every downhill ``fit_toas``: under
+    ``PINT_TPU_WARM_START=1`` apply the matching disk snapshot when one
+    exists, and (re-)latch the warm-start telemetry into the fit's
+    collecting report either way (a caller-applied ``warm_start`` happens
+    BEFORE the instrumented fit opens its report, so the latch must be
+    refreshed here to land on the breakdown). Failures only cost the warm
+    start, never the fit."""
+    from pint_tpu.utils import knobs
+
+    applied = getattr(fitter, "_warm_source", None) is not None
+    if not applied and knobs.flag("PINT_TPU_WARM_START"):
+        path = state_path(fitter)
+        if path.exists():
+            try:
+                applied = warm_start(fitter, path, source=str(path))
+            except Exception as e:  # noqa: BLE001  # jaxlint: disable=silent-except — a bad snapshot only costs the warm start; the cold fit proceeds identically and the miss is logged
+                log.warning(f"warm start from {path} failed: {e}")
+    perf.put("warm_start", applied)
+    if applied:
+        perf.put("warm_start_source", getattr(fitter, "_warm_source", None))
+    return applied
+
+
+def auto_save(fitter) -> None:
+    """PINT_TPU_WARM_START=1 hook run after a converged downhill fit:
+    persist the solution for the next process."""
+    from pint_tpu.utils import knobs
+
+    if not knobs.flag("PINT_TPU_WARM_START"):
+        return
+    try:
+        snapshot(fitter).save(state_path(fitter))
+    except Exception as e:  # noqa: BLE001  # jaxlint: disable=silent-except — snapshot persistence is an optimization; losing it only costs the next run a cold start
+        log.warning(f"could not save fitter state: {e}")

@@ -302,6 +302,15 @@ def run_lm(params, chi2_best, compute_pieces, solve, chi2_of, apply_step,
     DownhillFitter semantics): convergence is only declared against a fresh
     Gauss-Newton attempt, never against a stale heavily-damped step.
 
+    A final step whose chi^2 gain is below `required_gain` is REVERTED:
+    convergence is declared AT the linearization point, whose pieces the
+    caller uses for the covariance — so the reported parameters and
+    uncertainties come from the same point, and a warm start from a
+    converged snapshot (fitting/state.py) reproduces the cold solution
+    bitwise instead of random-walking by one sub-threshold step per
+    restart. The fused device driver (fitting/sharded.py `_lm_driver`)
+    implements the identical rule.
+
     Returns (params, chi2_best, iterations, converged, last_pieces).
     """
     it = 0
@@ -313,6 +322,7 @@ def run_lm(params, chi2_best, compute_pieces, solve, chi2_of, apply_step,
         lam = 0.0
         accepted = False
         gain = 0.0
+        base_params, base_chi2 = params, chi2_best
         for _ in range(max_rejects):
             perf.add("lm_trials")
             with perf.stage("solve"):
@@ -331,6 +341,9 @@ def run_lm(params, chi2_best, compute_pieces, solve, chi2_of, apply_step,
             perf.add("lm_rejects")
             lam = 1e-8 if lam == 0.0 else lam * 10.0
         if not accepted or gain < required_gain:
+            if accepted:
+                # sub-threshold step: revert to the linearization point
+                params, chi2_best = base_params, base_chi2
             converged = True
             break
     else:
@@ -512,6 +525,23 @@ class WLSFitter:
         progs.append(self._step_program(self.model.params))
         progs.append(self._chi2_program(self.model.params))
         return progs
+
+    # --- fitter state / warm start (fitting/state.py) ----------------------------
+
+    def snapshot(self):
+        """Serializable :class:`~pint_tpu.fitting.state.FitterState` of the
+        current solution (run after fit_toas)."""
+        from pint_tpu.fitting.state import snapshot
+
+        return snapshot(self)
+
+    def warm_start(self, state, strict: bool = False) -> bool:
+        """Start the next ``fit_toas`` from a prior fit's snapshot (a
+        FitterState or a saved path). The skeleton must match or nothing
+        is applied; see fitting/state.py."""
+        from pint_tpu.fitting.state import warm_start
+
+        return warm_start(self, state, strict=strict)
 
     def chi2_at(self, params: dict) -> float:
         with perf.stage("chi2"):
@@ -709,6 +739,11 @@ class WLSFitter:
             singular_values=None if s is None else np.asarray(s),
             degenerate=degenerate,
         )
+        # PINT_TPU_WARM_START=1: persist the solution so the next process
+        # (or a repeat bench round) starts its LM loop at the optimum
+        from pint_tpu.fitting import state as _state
+
+        _state.auto_save(self)
         return self.result
 
 
@@ -729,8 +764,11 @@ class DownhillWLSFitter(WLSFitter):
     @perf.instrument_fit
     def fit_toas(self, maxiter: int = 30, required_chi2_decrease: float = 1e-2,
                  max_rejects: int = 16) -> FitResult:
+        from pint_tpu.fitting import state as _state
+
         if len(self._free) == 0:
             return self._frozen_fit_result()
+        _state.maybe_auto_warm(self)
         if self._fused_on():
             from pint_tpu.fitting.sharded import run_fused_fit
 

@@ -305,70 +305,81 @@ class TimingModel:
         """TOAs -> dict of jnp arrays, the single host->device handoff.
 
         Adds component mask columns, planet columns, and (if AbsPhase) the TZR
-        fiducial TOA as the appended LAST row.
+        fiducial TOA as the appended LAST row. Each step runs under a
+        ``prepare/*`` telemetry stage (ops/perf.py prepare_breakdown): the
+        TZR fiducial prepare, the longdouble->dd64/qf32 conversion, the
+        model-column assembly and the host->device transfers are the
+        tensor-build slice of the time-to-first-point attribution.
         """
+        from pint_tpu.ops import perf
         from pint_tpu.toas import make_tzr_toa
 
-        if self.has_abs_phase:
-            tzr_day, tzr_hi, tzr_lo = self.meta["TZR_DAY"], self.meta["TZR_HI"], self.meta["TZR_LO"]
-            tzr = make_tzr_toa(
-                tzr_day,
-                tzr_hi,
-                tzr_lo,
-                self.meta.get("TZRSITE", "ssb"),
-                self.meta.get("TZRFRQ", float("inf")),
-                ephem=toas.ephem,
-                planets=toas.planets,
-            )
-            from pint_tpu.toas import merge_TOAs
+        with perf.stage("prepare"):
+            if self.has_abs_phase:
+                with perf.stage("tzr"):
+                    tzr_day, tzr_hi, tzr_lo = self.meta["TZR_DAY"], self.meta["TZR_HI"], self.meta["TZR_LO"]
+                    tzr = make_tzr_toa(
+                        tzr_day,
+                        tzr_hi,
+                        tzr_lo,
+                        self.meta.get("TZRSITE", "ssb"),
+                        self.meta.get("TZRFRQ", float("inf")),
+                        ephem=toas.ephem,
+                        planets=toas.planets,
+                    )
+                    from pint_tpu.toas import merge_TOAs
 
-            full = merge_TOAs([toas, tzr])
-        else:
-            full = toas
+                    full = merge_TOAs([toas, tzr])
+            else:
+                full = toas
 
-        tens = full.tensor()
-        from pint_tpu.ops.dd import device_split
-        from pint_tpu.ops.qf32 import qf_split_host
+            from pint_tpu.ops.dd import device_split
+            from pint_tpu.ops.qf32 import qf_split_host
 
-        t_hi, t_lo = device_split(tens.t_hi, tens.t_lo)
-        q0, q1, q2, q3 = qf_split_host(tens.t_hi, tens.t_lo)
-        out = {
-            "t_hi": jnp.asarray(t_hi),
-            "t_lo": jnp.asarray(t_lo),
-            "t_q0": jnp.asarray(q0),
-            "t_q1": jnp.asarray(q1),
-            "t_q2": jnp.asarray(q2),
-            "t_q3": jnp.asarray(q3),
-            "error_s": jnp.asarray(tens.error_s),
-            "freq_mhz": jnp.asarray(tens.freq_mhz),
-            "ssb_obs_pos_ls": jnp.asarray(tens.ssb_obs_pos_ls),
-            "ssb_obs_vel_ls": jnp.asarray(tens.ssb_obs_vel_ls),
-            "obs_sun_pos_ls": jnp.asarray(tens.obs_sun_pos_ls),
-        }
-        for p, arr in tens.planet_pos_ls.items():
-            out[f"obs_{p}_pos_ls"] = jnp.asarray(arr)
-        # wideband DM measurements (-pp_dm / -pp_dme flags); rows without a
-        # measurement (including the TZR row) get infinite error -> zero
-        # weight in the DM block
-        wb_dm, wb_dme = full.get_wideband_dm()
-        if wb_dm is not None:
-            out["wb_dm"] = jnp.asarray(wb_dm)
-            out["wb_dme"] = jnp.asarray(wb_dme)
+            with perf.stage("dd_convert"):
+                tens = full.tensor()
+                t_hi, t_lo = device_split(tens.t_hi, tens.t_lo)
+                q0, q1, q2, q3 = qf_split_host(tens.t_hi, tens.t_lo)
+            with perf.stage("transfer"):
+                out = {
+                    "t_hi": jnp.asarray(t_hi),
+                    "t_lo": jnp.asarray(t_lo),
+                    "t_q0": jnp.asarray(q0),
+                    "t_q1": jnp.asarray(q1),
+                    "t_q2": jnp.asarray(q2),
+                    "t_q3": jnp.asarray(q3),
+                    "error_s": jnp.asarray(tens.error_s),
+                    "freq_mhz": jnp.asarray(tens.freq_mhz),
+                    "ssb_obs_pos_ls": jnp.asarray(tens.ssb_obs_pos_ls),
+                    "ssb_obs_vel_ls": jnp.asarray(tens.ssb_obs_vel_ls),
+                    "obs_sun_pos_ls": jnp.asarray(tens.obs_sun_pos_ls),
+                }
+                for p, arr in tens.planet_pos_ls.items():
+                    out[f"obs_{p}_pos_ls"] = jnp.asarray(arr)
+            # wideband DM measurements (-pp_dm / -pp_dme flags); rows without
+            # a measurement (including the TZR row) get infinite error ->
+            # zero weight in the DM block
+            wb_dm, wb_dme = full.get_wideband_dm()
+            if wb_dm is not None:
+                out["wb_dm"] = jnp.asarray(wb_dm)
+                out["wb_dme"] = jnp.asarray(wb_dme)
 
-        n_rows = tens.t_hi.shape[0]
-        for c in self.components:
-            for k, col in c.host_columns(full, self.params).items():
-                col = np.asarray(col, np.float64)
-                # The TZR fiducial row belongs to no flag/selection MASK
-                # (it is a synthetic TOA), but it DOES get every other
-                # model column (interpolation weights, window masks, tropo
-                # delay, ...) so its phase matches the reference's full
-                # model evaluation at TZRMJD. Non-row-indexed aux arrays
-                # (e.g. ECORR column->param maps) pass through untouched.
-                if self.has_abs_phase and k.startswith("mask_") and col.shape[:1] == (n_rows,):
-                    col[-1] = 0.0
-                out[k] = jnp.asarray(col)
-        return out
+            n_rows = tens.t_hi.shape[0]
+            with perf.stage("columns"):
+                for c in self.components:
+                    for k, col in c.host_columns(full, self.params).items():
+                        col = np.asarray(col, np.float64)
+                        # The TZR fiducial row belongs to no flag/selection
+                        # MASK (it is a synthetic TOA), but it DOES get every
+                        # other model column (interpolation weights, window
+                        # masks, tropo delay, ...) so its phase matches the
+                        # reference's full model evaluation at TZRMJD.
+                        # Non-row-indexed aux arrays (e.g. ECORR
+                        # column->param maps) pass through untouched.
+                        if self.has_abs_phase and k.startswith("mask_") and col.shape[:1] == (n_rows,):
+                            col[-1] = 0.0
+                        out[k] = jnp.asarray(col)
+            return out
 
     # --- device: the forward pass -------------------------------------------------
 

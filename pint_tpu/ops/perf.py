@@ -45,7 +45,8 @@ from pint_tpu.utils import knobs
 
 __all__ = [
     "PerfReport", "active", "add", "collect", "enable", "enabled",
-    "fit_breakdown", "instrument_fit", "put", "put_default", "stage",
+    "fit_breakdown", "instrument_fit", "prepare_breakdown", "put",
+    "put_default", "stage",
 ]
 
 _env_enabled = knobs.flag("PINT_TPU_PERF")
@@ -184,6 +185,59 @@ def put_default(name: str, value) -> None:
         rep.values.setdefault(name, value)
 
 
+# --- the canonical prepare breakdown ---------------------------------------------
+
+#: prepare sub-stages named in the breakdown; anything else directly under
+#: a "prepare" stage lands in prepare_other_s. These are the host (or
+#: device-program) steps of the TOA-prepare pipeline: the clock chain,
+#: EOP lookup, site geometry, ephemeris evaluation, time-scale
+#: conversion, the TZR fiducial prepare, longdouble->dd64 conversion,
+#: model-column assembly and the host->device transfers.
+_PREPARE_COMPONENTS = (
+    "clock", "eop", "geometry", "ephemeris", "tdb", "tzr",
+    "dd_convert", "columns", "transfer", "cache",
+)
+
+
+def prepare_breakdown(rep: PerfReport) -> dict:
+    """Map "prepare"-rooted stages into the canonical prepare breakdown.
+
+    Prepare stages nest anywhere (a bare `prepare_arrays` call, the TZR
+    prepare inside `build_tensor`'s own prepare stage, a prepare inside an
+    instrumented fit): a path contributes to the wall when its FIRST
+    ``prepare`` segment is its leaf, and to a component when the segment
+    after that first ``prepare`` is its leaf — deeper nestings (e.g. the
+    TZR row's own ``.../tzr/prepare/clock``) are already inside their
+    parent component, so the named fields partition the prepare wall.
+    """
+    wall = 0.0
+    comp = {leaf: 0.0 for leaf in _PREPARE_COMPONENTS}
+    direct = 0.0
+    for path, (total, _count) in rep.timings.items():
+        segs = path.split("/")
+        if "prepare" not in segs:
+            continue
+        i = segs.index("prepare")
+        if len(segs) == i + 1:
+            wall += total
+        elif len(segs) == i + 2:
+            direct += total
+            if segs[-1] in comp:
+                comp[segs[-1]] += total
+    out = {"prepare_wall_s": round(wall, 4)}
+    for leaf in _PREPARE_COMPONENTS:
+        out[f"prepare_{leaf}_s"] = round(comp[leaf], 4)
+    out["prepare_other_s"] = round(max(wall - direct, 0.0), 4)
+    out["prepare_cache_hits"] = int(rep.counters.get("prepare_cache_hits", 0))
+    out["prepare_cache_misses"] = int(
+        rep.counters.get("prepare_cache_misses", 0))
+    out["nbody_window_builds"] = int(
+        rep.counters.get("nbody_window_builds", 0))
+    out["prepare_device_programs"] = int(
+        rep.counters.get("prepare_device_programs", 0))
+    return out
+
+
 # --- the canonical fit breakdown -------------------------------------------------
 
 #: stage leaves summed into the named breakdown components; everything else
@@ -305,7 +359,17 @@ def fit_breakdown(rep: PerfReport) -> dict:
         "padding_waste_frac": rep.values.get("padding_waste_frac"),
         "batch_compiles": int(rep.counters.get("batch_compiles", 0)),
         "compile_reuse": int(rep.counters.get("batch_compile_reuse", 0)),
+        # warm-start telemetry (fitting/state.py): whether this fit
+        # started from a prior-fit parameter snapshot, and where the
+        # snapshot came from ("caller" | a state-file path)
+        "warm_start": bool(rep.values.get("warm_start", False)),
+        "warm_start_source": rep.values.get("warm_start_source"),
     }
+    # prepare work that ran INSIDE the fit (e.g. a TZR re-prepare in a
+    # tensor rebuild) — usually zero; the bench's time-to-first-point
+    # attribution assembles the full prepare block from its own scope
+    if any("prepare" in p.split("/") for p in t):
+        out["prepare"] = prepare_breakdown(rep)
     # compile-time jaxpr-audit ledger (pint_tpu/analysis/): every program
     # the fit lowered, the passes it ran, and any invariant violations —
     # the bench headline carries this block so an audit regression is a

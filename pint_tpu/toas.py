@@ -373,6 +373,130 @@ def merge_TOAs(toas_list: Sequence[TOAs]) -> TOAs:
 _TOA_CACHE_VERSION = 1
 
 
+def prepare_config_fingerprint(ephem) -> str:
+    """Resolved identity of every knob that changes prepared columns for
+    the same input arrays: the ephemeris (the same 'auto' label can mean
+    the analytic theory, an SPK kernel, or the N-body-refined path), the
+    EOP table, the clock-file state, and the prepared-layout version.
+    Shared by the tim-level (`get_TOAs`) and content-level
+    (`prepare_arrays`) caches so their invalidation can never diverge."""
+    import os
+
+    from pint_tpu.utils import knobs
+
+    spk = knobs.get("PINT_TPU_EPHEM") or ""
+    if spk and os.path.exists(spk):
+        spk = f"{spk}@{os.path.getmtime(spk):.0f}"
+    nbody = knobs.get("PINT_TPU_NBODY")
+    eop = knobs.get("PINT_TPU_EOP") or ""
+    if eop and os.path.exists(eop):
+        eop = f"{eop}@{os.path.getmtime(eop):.0f}"
+    clk = clockmod.clock_state_fingerprint()
+    return f"v{_TOA_CACHE_VERSION}-{ephem}-{spk}-nb{nbody}-eop{eop}-clk{clk}"
+
+
+# --- prepared-column content cache ------------------------------------------------
+#
+# The tim-level cache (get_TOAs usepickle) keys on FILE content; this one
+# keys on the prepared INPUT ARRAYS, so it also serves callers that never
+# had a tim file — most importantly the TZR fiducial prepare inside
+# `TimingModel.build_tensor`, which at flagship span can trigger a ~70 s
+# N-body window build INSIDE the first fit. A repeat fit of the same
+# dataset (same content, same knobs) skips the prepare pipeline entirely.
+
+
+def _prepared_cache_dir():
+    from pint_tpu.utils.cache import cache_root
+
+    return cache_root() / "prepared"
+
+
+def _prepared_content_key(utc, error_us, freq, obs_names, flags,
+                          ephem, planets, include_gps, include_bipm,
+                          bipm_version) -> str:
+    import hashlib
+
+    h = hashlib.sha256()
+    for a in (utc.day, utc.frac_hi, utc.frac_lo, error_us, freq):
+        h.update(np.ascontiguousarray(a).tobytes())
+    h.update("\x00".join(str(o) for o in obs_names).encode())
+    h.update(repr(flags).encode())
+    h.update(
+        f"{prepare_config_fingerprint(ephem)}-{planets}-{include_gps}-"
+        f"{include_bipm}-{bipm_version}".encode()
+    )
+    return h.hexdigest()[:32]
+
+
+def _prepared_cache_get(key: str):
+    """Cached TOAs for a content key, or None. A corrupt entry is moved to
+    the quarantine directory BESIDE the cache (never silently deleted:
+    the evidence survives for diagnosis) and recorded on the degradation
+    ledger — full recovery (the pipeline re-runs), zero accuracy loss."""
+    import os
+    import pickle
+
+    from pint_tpu.ops import perf
+
+    path = _prepared_cache_dir() / f"prep-{key}.pickle"
+    if not path.exists():
+        perf.add("prepare_cache_misses")
+        return None
+    try:
+        with open(path, "rb") as f:
+            stored_key, toas = pickle.load(f)
+        if stored_key != key:
+            # a truncated-hash collision would serve WRONG columns: the
+            # full key is stored and compared, so a mismatch is a miss
+            perf.add("prepare_cache_misses")
+            return None
+        perf.add("prepare_cache_hits")
+        log.info(f"prepared-TOA cache hit {path.name}")
+        return toas
+    except Exception as e:  # noqa: BLE001 — corrupt entry: quarantine + re-prepare
+        from pint_tpu.ops import degrade
+
+        qdir = _prepared_cache_dir() / "quarantine"
+        try:
+            os.makedirs(qdir, exist_ok=True)
+            os.replace(path, qdir / path.name)
+        except OSError:
+            pass
+        degrade.record(
+            "fetch.corrupt_quarantined", "prepare_cache",
+            f"corrupt prepared-TOA cache entry {path.name} quarantined "
+            f"({e}); re-running the prepare pipeline",
+            bound_us=0.0,  # full recovery: columns recomputed from source
+            fix="delete the quarantined entry after diagnosis; the cache "
+                "re-populates on the next prepare",
+        )
+        perf.add("prepare_cache_misses")
+        return None
+
+
+def _prepared_cache_put(key: str, toas: "TOAs") -> None:
+    import os
+    import pickle
+
+    from pint_tpu.utils import knobs
+
+    d = _prepared_cache_dir()
+    path = d / f"prep-{key}.pickle"
+    try:
+        os.makedirs(d, exist_ok=True)
+        tmp = path.with_suffix(f".tmp{os.getpid()}")
+        with open(tmp, "wb") as f:
+            pickle.dump((key, toas), f)
+        tmp.replace(path)
+        # bounded retention: newest PINT_TPU_PREPARE_CACHE_KEEP entries
+        keep = int(knobs.get("PINT_TPU_PREPARE_CACHE_KEEP"))
+        entries = sorted(d.glob("prep-*.pickle"), key=os.path.getmtime)
+        for old in entries[:-keep] if keep > 0 else []:
+            old.unlink(missing_ok=True)
+    except Exception as e:  # noqa: BLE001  # jaxlint: disable=silent-except — cache write failure only costs the next run a re-preparation
+        log.warning(f"could not write prepared-TOA cache: {e}")
+
+
 def get_TOAs(
     timfile: str,
     ephem: str = "auto",
@@ -381,7 +505,7 @@ def get_TOAs(
     include_bipm: bool = False,
     bipm_version: str = "BIPM2019",
     model=None,
-    usepickle: bool = False,
+    usepickle: bool | None = None,
 ) -> TOAs:
     """One-stop TOA preparation (reference get_TOAs, toa.py:104).
 
@@ -394,12 +518,17 @@ def get_TOAs(
     never beside the tim file, which often lives on a read-only tree;
     reference toa.py usepickle / pickle staleness checks): the cache is
     invalidated by tim-file content and by the preparation settings.
+    Default (None) follows ``PINT_TPU_PREPARE_CACHE`` (on): a repeat fit
+    of the same tim file skips the prepare pipeline entirely.
     """
     import hashlib
     import os
     import pickle
 
     from pint_tpu.utils import knobs
+
+    if usepickle is None:
+        usepickle = knobs.flag("PINT_TPU_PREPARE_CACHE")
     if model is not None:
         ephem = getattr(model, "ephem", None) or ephem
         planets = planets or bool(getattr(model, "planet_shapiro", False))
@@ -433,22 +562,10 @@ def get_TOAs(
                 if len(toks) >= 2 and toks[0].upper() == "INCLUDE":
                     stack.append(os.path.join(os.path.dirname(path), toks[1]))
         digest = h.hexdigest()[:16]
-        # resolved ephemeris identity: the same 'auto' label can mean the
-        # analytic ephemeris, an SPK kernel (PINT_TPU_EPHEM), or the
-        # N-body-refined path (PINT_TPU_NBODY) — all change the arrays
-        spk = knobs.get("PINT_TPU_EPHEM") or ""
-        if spk and os.path.exists(spk):
-            spk = f"{spk}@{os.path.getmtime(spk):.0f}"
-        nbody = knobs.get("PINT_TPU_NBODY")
-        eop = knobs.get("PINT_TPU_EOP") or ""
-        if eop and os.path.exists(eop):
-            eop = f"{eop}@{os.path.getmtime(eop):.0f}"
-        # clock files refresh out-of-band (PINT_TPU_CLOCK_REPO syncs,
-        # PINT_CLOCK_OVERRIDE edits): their identity+mtimes join the key
-        clk = clockmod.clock_state_fingerprint()
-        key = (f"v{_TOA_CACHE_VERSION}-{digest}-{ephem}-{spk}-nb{nbody}-"
-               f"eop{eop}-clk{clk}-{planets}-{include_gps}-{include_bipm}-"
-               f"{bipm_version}")
+        # resolved ephemeris/EOP/clock identity joins the key (the shared
+        # fingerprint also used by the prepare_arrays content cache)
+        key = (f"{prepare_config_fingerprint(ephem)}-{digest}-{planets}-"
+               f"{include_gps}-{include_bipm}-{bipm_version}")
         # cache lives under the user cache dir, NOT beside the tim file:
         # datasets are often read from read-only / shared trees
         from pint_tpu.utils.cache import cache_root as _cache_root
@@ -503,6 +620,7 @@ def prepare_TOAs(
     include_gps: bool = True,
     include_bipm: bool = False,
     bipm_version: str = "BIPM2019",
+    cache: bool = False,
 ) -> TOAs:
     n = len(lines)
     if n == 0:
@@ -528,6 +646,7 @@ def prepare_TOAs(
         include_gps=include_gps,
         include_bipm=include_bipm,
         bipm_version=bipm_version,
+        cache=cache,
     )
 
 
@@ -543,136 +662,184 @@ def prepare_arrays(
     include_gps: bool = True,
     include_bipm: bool = False,
     bipm_version: str = "BIPM2019",
+    cache: bool = False,
 ) -> TOAs:
     """Array-level TOA preparation: the core of get_TOAs, re-runnable for
-    simulation's zero-residual iteration (reference simulation.py:49)."""
-    n = len(utc)
-    if flags is None:
-        flags = [{} for _ in range(n)]
-    else:
-        validate_flags(flags)
-    if lines is None:
-        # lazy per-row views: nothing on the prepare/fit path reads the
-        # lines, so the per-TOA TOALine construction pass (seconds at
-        # 1e5 TOAs, repeated by every zero_residuals re-preparation) is
-        # deferred until a line is actually indexed
-        lines = _LazyTOALines(utc, error_us, freq, obs_names, flags)
+    simulation's zero-residual iteration (reference simulation.py:49).
 
-    # 1. clock corrections per observatory group (site -> UTC)
-    corr_s = np.zeros(n)
-    for name in np.unique(obs_names):
-        ob = get_observatory(str(name))
-        sel = obs_names == name
-        if ob.is_barycenter or ob.timescale != "utc":
-            continue
-        chain = clockmod.get_clock_chain(
-            str(name), include_gps=include_gps, include_bipm=include_bipm, bipm_version=bipm_version
-        )
-        corr_s[sel] = chain.evaluate(utc.mjd_float()[sel])
-    utc_corr = utc.add_seconds(corr_s)
+    Every pipeline step runs under a named ``prepare/*`` telemetry stage
+    (ops/perf.py prepare_breakdown), so a collecting scope — the bench's
+    time-to-first-point attribution, or an instrumented fit that triggers
+    a re-prepare — can say where the prepare wall goes. With ``cache=True``
+    (and ``PINT_TPU_PREPARE_CACHE`` on) the fully prepared TOAs are served
+    from / stored to the content-hash disk cache: identical input arrays
+    + identical clock/EOP/ephemeris knobs skip the pipeline entirely.
+    """
+    from pint_tpu.ops import perf
+    from pint_tpu.utils import knobs
 
-    # 2. UTC -> TT -> (geocentric) TDB. Rows whose observatory runs on TT
-    # (photon-event data, e.g. Fermi MET after geocentering) skip the
-    # UTC->TT leap-second chain: their input times already ARE TT.
-    # Observatory lookups go per unique name, not per row (two
-    # get_observatory calls per TOA was a measurable prepare-path cost).
-    uniq_obs, obs_inv = np.unique(obs_names, return_inverse=True)
-    uniq_ob = [get_observatory(str(u)) for u in uniq_obs]
-    bary = np.array([ob.is_barycenter for ob in uniq_ob])[obs_inv]
-    tt_scale = np.array([ob.timescale == "tt" for ob in uniq_ob])[obs_inv]
-    tt = ptime.pulsar_mjd_utc_to_tt(utc_corr)
-    if np.any(tt_scale):
-        for dst, src in ((tt.day, utc_corr.day), (tt.frac_hi, utc_corr.frac_hi),
-                         (tt.frac_lo, utc_corr.frac_lo)):
-            dst[tt_scale] = src[tt_scale]
-    tt_jcent = ptime.mjd_tt_julian_centuries(tt)
-
-    # 3. site GCRS posvel. UT1 = UTC + dUT1 and polar motion come from a
-    # user-supplied IERS table (PINT_TPU_EOP, astro/eop.py); both are zero
-    # without one (<= 1.4 us site effect).
-    from pint_tpu.astro.eop import get_eop
-
-    utc_mjd = utc_corr.mjd_float()
-    dut1_s, xp_rad, yp_rad = get_eop(utc_mjd)
-    ut1_mjd = utc_mjd + dut1_s / 86400.0
-    site_pos = np.zeros((n, 3))
-    site_vel = np.zeros((n, 3))
-    for name in np.unique(obs_names):
-        ob = get_observatory(str(name))
-        sel = obs_names == name
-        if getattr(ob, "needs_flags", False):
-            # tempo2-style spacecraft: GCRS state from per-TOA flags
-            # (reference special_locations.py:159 T2SpacecraftObs)
-            p, v = ob.site_posvel_gcrs_flags(
-                [flags[i] for i in np.flatnonzero(sel)]
-            )
+    with perf.stage("prepare"):
+        n = len(utc)
+        if flags is None:
+            flags = [{} for _ in range(n)]
         else:
-            p, v = ob.site_posvel_gcrs(
-                ut1_mjd[sel], tt_jcent[sel],
-                xp_rad=xp_rad[sel], yp_rad=yp_rad[sel],
-            )
-        site_pos[sel] = p
-        site_vel[sel] = v
+            validate_flags(flags)
 
-    # 4. ephemeris: Earth & Sun & planets wrt SSB at (geocentric) TDB
-    eph = get_ephemeris() if ephem in ("auto", "analytic", None) else get_ephemeris(ephem)
-    # TDB for ephemeris lookup: geocentric series is plenty (us-level arg error
-    # moves Earth by < 0.1 mm)
-    tdb_geo = ptime.tt_to_tdb(tt)
-    tdb_jcent = (tdb_geo.mjd_float() - ptime.MJD_J2000) / 36525.0
-    earth_pos, earth_vel = eph.posvel_ssb("earth", tdb_jcent)
-    sun_pos, sun_vel = eph.posvel_ssb("sun", tdb_jcent)
+        use_cache = cache and knobs.flag("PINT_TPU_PREPARE_CACHE")
+        key = None
+        if use_cache:
+            with perf.stage("cache"):
+                key = _prepared_content_key(
+                    utc, error_us, freq, obs_names, flags, ephem, planets,
+                    include_gps, include_bipm, bipm_version)
+                hit = _prepared_cache_get(key)
+            if hit is not None:
+                return hit
 
-    ssb_obs_pos = earth_pos + site_pos
-    ssb_obs_vel = earth_vel + site_vel
-    # barycentric TOAs: observer is at the SSB
-    ssb_obs_pos[bary] = 0.0
-    ssb_obs_vel[bary] = 0.0
-    obs_sun_pos = sun_pos - ssb_obs_pos
+        if lines is None:
+            # lazy per-row views: nothing on the prepare/fit path reads the
+            # lines, so the per-TOA TOALine construction pass (seconds at
+            # 1e5 TOAs, repeated by every zero_residuals re-preparation) is
+            # deferred until a line is actually indexed
+            lines = _LazyTOALines(utc, error_us, freq, obs_names, flags)
 
-    planet_pos: dict[str, np.ndarray] = {}
-    if planets:
-        for p in PLANETS:
-            ppos, _ = eph.posvel_ssb(p, tdb_jcent)
-            planet_pos[p] = ppos - ssb_obs_pos
+        # 1. clock corrections per observatory group (site -> UTC)
+        with perf.stage("clock"):
+            corr_s = np.zeros(n)
+            for name in np.unique(obs_names):
+                ob = get_observatory(str(name))
+                sel = obs_names == name
+                if ob.is_barycenter or ob.timescale != "utc":
+                    continue
+                chain = clockmod.get_clock_chain(
+                    str(name), include_gps=include_gps,
+                    include_bipm=include_bipm, bipm_version=bipm_version
+                )
+                corr_s[sel] = chain.evaluate(utc.mjd_float()[sel])
+            utc_corr = utc.add_seconds(corr_s)
 
-    # 5. full TDB including the topocentric (site-dependent) term
-    topo = ptime.topocentric_tdb_correction(earth_vel, site_pos)
-    tdb = ptime.tt_to_tdb(tt, topo)
-    # barycentric TOAs are already TDB at the SSB
-    if np.any(bary):
-        for arr_dst, arr_src in (
-            (tdb.day, utc.day),
-            (tdb.frac_hi, utc.frac_hi),
-            (tdb.frac_lo, utc.frac_lo),
-        ):
-            arr_dst[bary] = arr_src[bary]
+        # 2. UTC -> TT -> (geocentric) TDB. Rows whose observatory runs on TT
+        # (photon-event data, e.g. Fermi MET after geocentering) skip the
+        # UTC->TT leap-second chain: their input times already ARE TT.
+        # Observatory lookups go per unique name, not per row (two
+        # get_observatory calls per TOA was a measurable prepare-path cost).
+        with perf.stage("tdb"):
+            uniq_obs, obs_inv = np.unique(obs_names, return_inverse=True)
+            uniq_ob = [get_observatory(str(u)) for u in uniq_obs]
+            bary = np.array([ob.is_barycenter for ob in uniq_ob])[obs_inv]
+            tt_scale = np.array([ob.timescale == "tt" for ob in uniq_ob])[obs_inv]
+            tt = ptime.pulsar_mjd_utc_to_tt(utc_corr)
+            if np.any(tt_scale):
+                for dst, src in ((tt.day, utc_corr.day),
+                                 (tt.frac_hi, utc_corr.frac_hi),
+                                 (tt.frac_lo, utc_corr.frac_lo)):
+                    dst[tt_scale] = src[tt_scale]
+            tt_jcent = ptime.mjd_tt_julian_centuries(tt)
 
-    toas = TOAs(
-        lines=lines if isinstance(lines, _LazyTOALines) else list(lines),
-        utc=utc_corr,
-        tdb=tdb,
-        error_us=error_us,
-        freq_mhz=freq,
-        obs=obs_names,
-        flags=flags,
-        ssb_obs_pos_m=ssb_obs_pos,
-        ssb_obs_vel_m_s=ssb_obs_vel,
-        obs_sun_pos_m=obs_sun_pos,
-        planet_pos_m=planet_pos,
-        ephem=getattr(eph, "name", "analytic"),
-        planets=planets,
-        utc_raw=utc,
-        include_gps=include_gps,
-        include_bipm=include_bipm,
-        bipm_version=bipm_version,
-    )
-    # identical re-preparations of the same set (zero_residuals passes,
-    # per-shard re-init in the multichip dryrun) log exactly once
-    from pint_tpu.utils.logging import log_once
+        # 3. site GCRS posvel. UT1 = UTC + dUT1 and polar motion come from a
+        # user-supplied IERS table (PINT_TPU_EOP, astro/eop.py); both are zero
+        # without one (<= 1.4 us site effect).
+        from pint_tpu.astro.eop import get_eop
 
-    log_once(log, "prepared TOAs: " + toas.summary())
-    return toas
+        with perf.stage("eop"):
+            utc_mjd = utc_corr.mjd_float()
+            dut1_s, xp_rad, yp_rad = get_eop(utc_mjd)
+            ut1_mjd = utc_mjd + dut1_s / 86400.0
+
+        with perf.stage("geometry"):
+            site_pos = np.zeros((n, 3))
+            site_vel = np.zeros((n, 3))
+            for name in np.unique(obs_names):
+                ob = get_observatory(str(name))
+                sel = obs_names == name
+                if getattr(ob, "needs_flags", False):
+                    # tempo2-style spacecraft: GCRS state from per-TOA flags
+                    # (reference special_locations.py:159 T2SpacecraftObs)
+                    p, v = ob.site_posvel_gcrs_flags(
+                        [flags[i] for i in np.flatnonzero(sel)]
+                    )
+                else:
+                    p, v = ob.site_posvel_gcrs(
+                        ut1_mjd[sel], tt_jcent[sel],
+                        xp_rad=xp_rad[sel], yp_rad=yp_rad[sel],
+                    )
+                site_pos[sel] = p
+                site_vel[sel] = v
+
+        # 4. ephemeris: Earth & Sun & planets wrt SSB at (geocentric) TDB
+        with perf.stage("ephemeris"):
+            eph = (get_ephemeris() if ephem in ("auto", "analytic", None)
+                   else get_ephemeris(ephem))
+            # TDB for ephemeris lookup: geocentric series is plenty (us-level
+            # arg error moves Earth by < 0.1 mm)
+            tdb_geo = ptime.tt_to_tdb(tt)
+            tdb_jcent = (tdb_geo.mjd_float() - ptime.MJD_J2000) / 36525.0
+            bodies = ("earth", "sun") + (PLANETS if planets else ())
+            from pint_tpu.astro import device_prepare
+
+            served = device_prepare.posvel_ssb_many(eph, bodies, tdb_jcent)
+            if served is not None:
+                earth_pos, earth_vel = served["earth"]
+                sun_pos, _ = served["sun"]
+            else:
+                earth_pos, earth_vel = eph.posvel_ssb("earth", tdb_jcent)
+                sun_pos, _ = eph.posvel_ssb("sun", tdb_jcent)
+
+            ssb_obs_pos = earth_pos + site_pos
+            ssb_obs_vel = earth_vel + site_vel
+            # barycentric TOAs: observer is at the SSB
+            ssb_obs_pos[bary] = 0.0
+            ssb_obs_vel[bary] = 0.0
+            obs_sun_pos = sun_pos - ssb_obs_pos
+
+            planet_pos: dict[str, np.ndarray] = {}
+            if planets:
+                for p in PLANETS:
+                    ppos = (served[p][0] if served is not None
+                            else eph.posvel_ssb(p, tdb_jcent)[0])
+                    planet_pos[p] = ppos - ssb_obs_pos
+
+        # 5. full TDB including the topocentric (site-dependent) term
+        with perf.stage("tdb"):
+            topo = ptime.topocentric_tdb_correction(earth_vel, site_pos)
+            tdb = ptime.tt_to_tdb(tt, topo)
+            # barycentric TOAs are already TDB at the SSB
+            if np.any(bary):
+                for arr_dst, arr_src in (
+                    (tdb.day, utc.day),
+                    (tdb.frac_hi, utc.frac_hi),
+                    (tdb.frac_lo, utc.frac_lo),
+                ):
+                    arr_dst[bary] = arr_src[bary]
+
+        toas = TOAs(
+            lines=lines if isinstance(lines, _LazyTOALines) else list(lines),
+            utc=utc_corr,
+            tdb=tdb,
+            error_us=error_us,
+            freq_mhz=freq,
+            obs=obs_names,
+            flags=flags,
+            ssb_obs_pos_m=ssb_obs_pos,
+            ssb_obs_vel_m_s=ssb_obs_vel,
+            obs_sun_pos_m=obs_sun_pos,
+            planet_pos_m=planet_pos,
+            ephem=getattr(eph, "name", "analytic"),
+            planets=planets,
+            utc_raw=utc,
+            include_gps=include_gps,
+            include_bipm=include_bipm,
+            bipm_version=bipm_version,
+        )
+        if use_cache and key is not None:
+            with perf.stage("cache"):
+                _prepared_cache_put(key, toas)
+        # identical re-preparations of the same set (zero_residuals passes,
+        # per-shard re-init in the multichip dryrun) log exactly once
+        from pint_tpu.utils.logging import log_once
+
+        log_once(log, "prepared TOAs: " + toas.summary())
+        return toas
 
 
 def make_tzr_toa(
@@ -686,7 +853,12 @@ def make_tzr_toa(
 ) -> TOAs:
     """Prepare the single fiducial TZR TOA (reference absolute_phase.py
     get_TZR_toa); runs the identical pipeline so the TZR row can be appended
-    to the TOA tensor and folded into the same jitted phase evaluation."""
+    to the TOA tensor and folded into the same jitted phase evaluation.
+
+    Served through the prepared-column content cache: the TZR prepare runs
+    INSIDE the first fit's tensor build, and at flagship span a cold TZR
+    epoch can trigger a ~70 s N-body window build there — a repeat fit of
+    the same model skips it entirely."""
     line = TOALine(
         name="TZR",
         freq_mhz=tzrfrq_mhz if tzrfrq_mhz and np.isfinite(tzrfrq_mhz) else 0.0,
@@ -697,4 +869,4 @@ def make_tzr_toa(
         obs=tzrsite,
         flags={"tzr": "True"},
     )
-    return prepare_TOAs([line], ephem=ephem, planets=planets)
+    return prepare_TOAs([line], ephem=ephem, planets=planets, cache=True)

@@ -46,6 +46,12 @@ KNOBS: dict[str, tuple[str | None, str]] = {
     "PINT_TPU_NBODY_COMB": ("0", "1: add the comb anchor periods to the N-body band design"),
     "PINT_TPU_EOP": (None, "path to an IERS finals2000A file; unset = zero EOP"),
     "PINT_TPU_REPREPARE_REUSE_US": ("10", "re-preparation geometry-reuse threshold in us (0 disables the fast path)"),
+    # --- prepare path (toas.py, astro/device_prepare.py) -----------------------
+    "PINT_TPU_DEVICE_PREPARE": ("auto", "TOA-prepare series on device: auto (non-CPU backends), 1 (force), 0 (host numpy)"),
+    "PINT_TPU_PREPARE_CACHE": ("1", "0: disable the content-hash prepared-TOA disk cache"),
+    "PINT_TPU_PREPARE_CACHE_KEEP": ("32", "prepared-TOA cache entries kept (oldest pruned)"),
+    # --- fitter state / warm start (fitting/state.py) --------------------------
+    "PINT_TPU_WARM_START": ("0", "1: downhill fits warm-start from / save a disk snapshot of the prior fit"),
     "PINT_TPU_OBS_JSON": ("", "colon-separated extra observatories.json overlays"),
     # --- clocks ----------------------------------------------------------------
     "PINT_TPU_CLOCK_REPO": (None, "clock-corrections repository (https/file URL or directory)"),

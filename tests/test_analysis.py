@@ -147,6 +147,53 @@ class TestSeededViolations:
         vs = audit_jitted(f, jnp.float64(0.0), label="seed_sync_ok")
         assert "host-sync" not in _passes(vs)
 
+    def test_prepare_sync_flags_any_callback(self):
+        """`prepare_*` programs (astro/device_prepare.py) must contain
+        ZERO host-sync primitives — even outside loop bodies, where the
+        generic host-sync pass stays quiet."""
+        def f(x):
+            return jax.pure_callback(
+                lambda a: np.asarray(a),
+                jax.ShapeDtypeStruct((), jnp.float64), x) + 1.0
+
+        vs = audit_jitted(f, jnp.float64(0.0), label="prepare_seed")
+        assert "prepare-sync" in _passes(vs)
+        # the same program under a non-prepare label is not the prepare
+        # contract's business
+        vs = audit_jitted(f, jnp.float64(0.0), label="resid_seed")
+        assert "prepare-sync" not in _passes(vs)
+
+    def test_prepare_programs_are_sync_clean(self, monkeypatch):
+        """The real device-prepare programs lower with zero host-sync
+        primitives under PINT_TPU_AUDIT=strict (the CI contract: a
+        callback smuggled into the fused prepare fails the compile)."""
+        import numpy as np
+
+        from pint_tpu.analysis.jaxpr_audit import audit_block, reset_ledger
+        from pint_tpu.astro import device_prepare
+
+        monkeypatch.setenv("PINT_TPU_AUDIT", "strict")
+        monkeypatch.setenv("PINT_TPU_DEVICE_PREPARE", "1")
+        monkeypatch.setenv("PINT_TPU_NBODY", "0")
+        device_prepare._programs.clear()
+        reset_ledger()
+        try:
+            from pint_tpu.ops import perf
+
+            itrf = np.array([882589.65, -4924872.32, 3943729.35])
+            ut1 = np.linspace(55000.0, 55010.0, 16)
+            tj = (ut1 - 51544.5) / 36525.0
+            z = np.zeros(16)
+            with perf.collect():  # collecting => TimedProgram audits the lowering
+                device_prepare.site_posvel_device(itrf, ut1, tj, z, z)
+                device_prepare.analytic_posvel_device(("earth", "sun"), tj)
+            blk = audit_block()
+            assert blk["violations"] == []
+            assert blk["n_programs"] >= 2
+        finally:
+            device_prepare._programs.clear()
+            reset_ledger()
+
     def test_retrace_budget(self):
         """A second signature differing only in dtype at identical
         shapes: the duplicate-compile bug class PR 2 fixed by hand."""
@@ -232,6 +279,29 @@ class TestAuditClean:
         # passed the collective-placement pass against the declared axis)
         assert "fused_wls_fit" in audit_block()["signatures"]
         assert rec["fit_shards"] == len(jax.devices())
+
+    def test_host_transfers_are_per_fit_constant_strict(self, monkeypatch):
+        """The fused-LM host-sync contract under strict audit: the
+        breakdown's `host_transfers` must be a per-fit CONSTANT — running
+        twice the LM iterations must not change it (a per-iteration
+        transfer would scale), and on the fused path the constant is 0."""
+        import bench
+
+        monkeypatch.setenv("PINT_TPU_AUDIT", "strict")
+        reset_ledger()
+        # plain smoke: whatever the constant is, it must not scale with
+        # the iteration count
+        rec_a = bench.smoke_bench(ntoas=150, maxiter=3)
+        rec_b = bench.smoke_bench(ntoas=150, maxiter=6)
+        assert rec_a["host_transfers"] == rec_b["host_transfers"]
+        if len(jax.devices()) < 2:
+            pytest.skip("sharded half needs the multi-device virtual mesh")
+        rec_a = bench.smoke_bench(ntoas=150, maxiter=3, sharded=True)
+        rec_b = bench.smoke_bench(ntoas=150, maxiter=6, sharded=True)
+        assert rec_a["solve_path"] == rec_b["solve_path"] == "fused_loop"
+        assert rec_a["host_transfers"] == rec_b["host_transfers"] == 0
+        assert rec_a["n_step_calls"] == rec_b["n_step_calls"] == 1
+        assert audit_block()["n_violations"] == 0
 
     def test_audit_block_rides_fit_result_perf(self):
         """FitResult.perf carries the audit block whenever telemetry

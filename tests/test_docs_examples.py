@@ -99,6 +99,32 @@ def test_robustness_walkthrough_runs(tmp_path, monkeypatch):
         faults.reset()
 
 
+def test_performance_walkthrough_runs(tmp_path, monkeypatch):
+    """docs/PERFORMANCE.md is executable WITHOUT reference data or
+    network (synthetic TOAs, isolated cache dir) and runs in tier-1:
+    the prepare-telemetry / prepared-cache / warm-start walkthrough a
+    user copies from must keep working verbatim."""
+    blocks = extract_blocks(DOCS / "PERFORMANCE.md")
+    assert len(blocks) >= 5, "PERFORMANCE.md lost its executable blocks"
+    monkeypatch.chdir(tmp_path)
+    for var in ("PINT_TPU_CACHE_DIR", "PINT_TPU_NBODY",
+                "PINT_TPU_WARM_START"):
+        monkeypatch.delenv(var, raising=False)
+    from pint_tpu.ops import perf
+
+    ns: dict = {}
+    try:
+        for i, block in enumerate(blocks):
+            try:
+                exec(compile(block, f"PERFORMANCE.md[block {i}]", "exec"), ns)
+            except Exception as e:
+                pytest.fail(
+                    f"PERFORMANCE.md block {i} failed: "
+                    f"{type(e).__name__}: {e}\n{block}")
+    finally:
+        perf.enable(False)
+
+
 def test_analysis_walkthrough_runs(tmp_path, monkeypatch):
     """docs/ANALYSIS.md is executable WITHOUT reference data (synthetic
     TOAs only) and runs in tier-1: the auditor walkthrough a user copies

@@ -1,0 +1,257 @@
+"""Prepare-path contracts: the content-hash prepared-TOA cache and the
+device-fused prepare programs (toas.py, astro/device_prepare.py).
+
+Cache contract (ISSUE 6 satellite): content-hash hit/miss, invalidation
+on any clock/EOP/ephemeris knob change, corrupt entries quarantined
+through the degradation ledger (the ``fetch.corrupt_quarantined``
+pattern), and NEVER a wrong-answer stale hit — a full-key mismatch or a
+content change is always a miss.
+
+Device-prepare contract: with ``PINT_TPU_DEVICE_PREPARE=1`` the fused
+programs produce the same columns as the host numpy pipeline to well
+below the series' own physical accuracy (asserted at the mm / sub-mm/s
+level, i.e. tens of picoseconds of light travel), for both the analytic
+and the N-body-refined ephemeris path.
+"""
+
+import numpy as np
+import pytest
+
+from pint_tpu.astro import time as ptime
+from pint_tpu.ops import perf
+from pint_tpu.ops.degrade import events, reset_ledger
+from pint_tpu.toas import (
+    _prepared_cache_dir,
+    _prepared_content_key,
+    prepare_arrays,
+    prepare_config_fingerprint,
+)
+
+
+def _inputs(n=24, mjd0=55000.0):
+    utc = ptime.MJDEpoch.from_mjd_float(np.linspace(mjd0, mjd0 + 800.0, n))
+    return (utc, np.ones(n), np.full(n, 1400.0),
+            np.array(["gbt"] * n), [{} for _ in range(n)])
+
+
+@pytest.fixture(autouse=True)
+def _isolated_cache(tmp_path, monkeypatch):
+    monkeypatch.setenv("PINT_TPU_CACHE_DIR", str(tmp_path))
+    monkeypatch.setenv("PINT_TPU_NBODY", "0")  # keep the fixture fast
+    yield
+
+
+@pytest.fixture(scope="module")
+def nbody_cache_dir(tmp_path_factory):
+    """One shared cache dir for the N-body-flavored tests: the ~30 s
+    window build happens once and later tests load it from disk."""
+    return str(tmp_path_factory.mktemp("nbody_cache"))
+
+
+def _key_of(args, **kw):
+    utc, err, frq, obs, flags = args
+    return _prepared_content_key(utc, err, frq, obs, flags,
+                                 kw.get("ephem", "auto"),
+                                 kw.get("planets", False),
+                                 kw.get("include_gps", True),
+                                 kw.get("include_bipm", False),
+                                 kw.get("bipm_version", "BIPM2019"))
+
+
+class TestPreparedCache:
+    def test_hit_roundtrip(self):
+        args = _inputs()
+        with perf.collect() as rep:
+            t1 = prepare_arrays(*args, cache=True)
+        assert rep.counters.get("prepare_cache_misses") == 1
+        with perf.collect() as rep2:
+            t2 = prepare_arrays(*args, cache=True)
+        assert rep2.counters.get("prepare_cache_hits") == 1
+        np.testing.assert_array_equal(t1.ssb_obs_pos_m, t2.ssb_obs_pos_m)
+        np.testing.assert_array_equal(t1.tdb.frac_hi, t2.tdb.frac_hi)
+
+    def test_content_change_misses(self):
+        args = _inputs()
+        prepare_arrays(*args, cache=True)
+        shifted = _inputs()
+        shifted[0].frac_hi[0] += 1e-9 / 86400.0  # one TOA moved 1 ns
+        with perf.collect() as rep:
+            prepare_arrays(*shifted, cache=True)
+        assert rep.counters.get("prepare_cache_misses") == 1
+        assert "prepare_cache_hits" not in rep.counters
+
+    def test_knob_changes_invalidate(self, monkeypatch, tmp_path):
+        """Every prepare-relevant knob class changes the content key:
+        ephemeris identity, N-body refinement, EOP table, clock state."""
+        args = _inputs()
+        base = _key_of(args)
+        # ephemeris: a configured SPK kernel path joins the fingerprint
+        monkeypatch.setenv("PINT_TPU_EPHEM", str(tmp_path / "no.bsp"))
+        k_eph = _key_of(args)
+        monkeypatch.delenv("PINT_TPU_EPHEM")
+        # N-body refinement flip
+        monkeypatch.setenv("PINT_TPU_NBODY", "1")
+        k_nb = _key_of(args)
+        monkeypatch.setenv("PINT_TPU_NBODY", "0")
+        # EOP table
+        monkeypatch.setenv("PINT_TPU_EOP", str(tmp_path / "finals.all"))
+        k_eop = _key_of(args)
+        monkeypatch.delenv("PINT_TPU_EOP")
+        # clock state (an override dir joins clock_state_fingerprint)
+        clkdir = tmp_path / "clk"
+        clkdir.mkdir()
+        (clkdir / "time_gbt.dat").write_text("# empty\n")
+        monkeypatch.setenv("PINT_CLOCK_OVERRIDE", str(clkdir))
+        from pint_tpu.astro import clock as clockmod
+
+        if hasattr(clockmod, "clear_clock_cache"):
+            clockmod.clear_clock_cache()
+        k_clk = _key_of(args)
+        monkeypatch.delenv("PINT_CLOCK_OVERRIDE")
+        if hasattr(clockmod, "clear_clock_cache"):
+            clockmod.clear_clock_cache()
+        keys = {base, k_eph, k_nb, k_eop, k_clk}
+        assert len(keys) == 5, "a knob change failed to change the key"
+        # and the settings arguments join the key too
+        assert _key_of(args, planets=True) != base
+        assert _key_of(args, include_bipm=True) != base
+
+    def test_corrupt_entry_quarantined(self):
+        args = _inputs()
+        reset_ledger()
+        prepare_arrays(*args, cache=True)
+        entries = list(_prepared_cache_dir().glob("prep-*.pickle"))
+        assert len(entries) == 1
+        entries[0].write_bytes(b"not a pickle")
+        with perf.collect() as rep:
+            t2 = prepare_arrays(*args, cache=True)  # recovers by recompute
+        assert rep.counters.get("prepare_cache_misses") == 1
+        # the corrupt file moved BESIDE the cache, never silently deleted
+        q = list((_prepared_cache_dir() / "quarantine").glob("prep-*.pickle"))
+        assert len(q) == 1
+        evs = [e for e in events() if e.kind == "fetch.corrupt_quarantined"]
+        assert len(evs) == 1 and evs[0].component == "prepare_cache"
+        # and the recomputed answer is a fresh full pipeline result
+        assert len(t2) == len(args[1])
+        reset_ledger()
+
+    def test_stored_key_mismatch_is_a_miss(self):
+        """A filename collision with a different FULL key must never
+        serve wrong columns: the stored key is compared, mismatch = miss."""
+        import pickle
+
+        args = _inputs()
+        t1 = prepare_arrays(*args, cache=True)
+        entry = next(_prepared_cache_dir().glob("prep-*.pickle"))
+        with open(entry, "wb") as f:
+            pickle.dump(("some-other-full-key", t1), f)
+        with perf.collect() as rep:
+            prepare_arrays(*args, cache=True)
+        assert rep.counters.get("prepare_cache_misses") == 1
+        assert "prepare_cache_hits" not in rep.counters
+
+    def test_retention_prunes_oldest(self, monkeypatch):
+        monkeypatch.setenv("PINT_TPU_PREPARE_CACHE_KEEP", "3")
+        for i in range(5):
+            args = _inputs(mjd0=55000.0 + i)
+            prepare_arrays(*args, cache=True)
+        assert len(list(_prepared_cache_dir().glob("prep-*.pickle"))) == 3
+
+    def test_knob_opt_out(self, monkeypatch):
+        monkeypatch.setenv("PINT_TPU_PREPARE_CACHE", "0")
+        args = _inputs()
+        with perf.collect() as rep:
+            prepare_arrays(*args, cache=True)
+        assert not rep.counters.get("prepare_cache_misses")
+        assert not list(_prepared_cache_dir().glob("prep-*.pickle"))
+
+
+class TestPrepareTelemetry:
+    def test_stages_partition_the_prepare_wall(self):
+        from pint_tpu.ops.perf import prepare_breakdown
+
+        args = _inputs(n=64)
+        with perf.collect() as rep:
+            prepare_arrays(*args)
+        bd = prepare_breakdown(rep)
+        assert bd["prepare_wall_s"] > 0
+        named = sum(bd[f"prepare_{k}_s"] for k in
+                    ("clock", "eop", "geometry", "ephemeris", "tdb",
+                     "tzr", "dd_convert", "columns", "transfer", "cache"))
+        assert named + bd["prepare_other_s"] == pytest.approx(
+            bd["prepare_wall_s"], rel=0.05, abs=0.02)
+        # the dominant pipeline stages actually recorded
+        assert bd["prepare_ephemeris_s"] > 0
+        assert bd["prepare_geometry_s"] > 0
+
+    def test_nbody_build_is_counted(self, monkeypatch, nbody_cache_dir):
+        monkeypatch.setenv("PINT_TPU_CACHE_DIR", nbody_cache_dir)
+        monkeypatch.setenv("PINT_TPU_NBODY", "1")
+        monkeypatch.setenv("PINT_TPU_NBODY_CACHE", "1")
+        from pint_tpu.astro.ephemeris import AnalyticEphemeris
+
+        eph = AnalyticEphemeris()
+        # same epoch window as the parity fixture: the build is served
+        # from the shared disk cache, the counter still fires
+        T = (np.array([55000.0, 55800.0]) - 51544.5) / 36525.0
+        with perf.collect() as rep:
+            eph.posvel_ssb("earth", T)
+        assert rep.counters.get("nbody_window_builds", 0) >= 1
+        # the same window again: served from the in-memory window cache
+        with perf.collect() as rep2:
+            eph.posvel_ssb("earth", T)
+        assert rep2.counters.get("nbody_window_builds", 0) == 0
+
+
+class TestDevicePrepareParity:
+    """Fused device programs vs host numpy — identical formulas, jnp vs
+    numpy reductions; bounds far below the series' physical accuracy."""
+
+    POS_TOL_M = 0.05      # 50 mm ~ 0.17 ns of light travel
+    VEL_TOL_MS = 1e-3
+
+    def _columns(self, monkeypatch, device: str, nbody: str):
+        monkeypatch.setenv("PINT_TPU_DEVICE_PREPARE", device)
+        monkeypatch.setenv("PINT_TPU_NBODY", nbody)
+        args = _inputs(n=48)
+        return prepare_arrays(*args, planets=True)
+
+    @pytest.mark.parametrize("nbody", ["0", "1"])
+    def test_columns_match_host(self, monkeypatch, nbody, nbody_cache_dir):
+        monkeypatch.setenv("PINT_TPU_CACHE_DIR", nbody_cache_dir)
+        host = self._columns(monkeypatch, "0", nbody)
+        dev = self._columns(monkeypatch, "1", nbody)
+        for f in ("ssb_obs_pos_m", "obs_sun_pos_m"):
+            d = np.max(np.abs(getattr(host, f) - getattr(dev, f)))
+            assert d < self.POS_TOL_M, (f, d)
+        dv = np.max(np.abs(host.ssb_obs_vel_m_s - dev.ssb_obs_vel_m_s))
+        assert dv < self.VEL_TOL_MS, dv
+        for p, a in host.planet_pos_m.items():
+            d = np.max(np.abs(a - dev.planet_pos_m[p]))
+            assert d < self.POS_TOL_M, (p, d)
+        # the time columns are host-side either way: bitwise equal
+        np.testing.assert_array_equal(host.tdb.frac_hi, dev.tdb.frac_hi)
+
+    def test_auto_mode_is_off_on_cpu(self, monkeypatch):
+        import jax
+
+        from pint_tpu.astro import device_prepare
+
+        monkeypatch.setenv("PINT_TPU_DEVICE_PREPARE", "auto")
+        assert device_prepare.enabled() == (jax.default_backend() != "cpu")
+        monkeypatch.setenv("PINT_TPU_DEVICE_PREPARE", "1")
+        assert device_prepare.enabled()
+        monkeypatch.setenv("PINT_TPU_DEVICE_PREPARE", "0")
+        assert not device_prepare.enabled()
+
+    def test_device_programs_counted(self, monkeypatch):
+        from pint_tpu.astro import device_prepare
+
+        monkeypatch.setenv("PINT_TPU_DEVICE_PREPARE", "1")
+        monkeypatch.setenv("PINT_TPU_NBODY", "0")
+        device_prepare._programs.clear()
+        args = _inputs(n=16)
+        with perf.collect() as rep:
+            prepare_arrays(*args)
+        assert rep.counters.get("prepare_device_programs", 0) >= 2
+        device_prepare._programs.clear()

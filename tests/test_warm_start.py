@@ -1,0 +1,257 @@
+"""Warm-start parity + fitter-state snapshots (fitting/state.py).
+
+The contract locked here (ISSUE 6 satellite): a warm-started fit must
+converge to the cold-start solution to <= 1e-10 relative in parameters
+AND uncertainties for WLS, GLS/ECORR and wideband, and must record FEWER
+LM iterations on the perturbed-start fixture. The LM loop's
+sub-threshold-step revert (fitting/wls.py run_lm / fitting/sharded.py
+_lm_driver) is what makes the bound achievable: a warm start from a
+converged snapshot linearizes at the snapshot point, finds the fresh
+Gauss-Newton step gains less than `required_chi2_decrease`, reverts it
+and reports the snapshot point with the covariance of the SAME
+linearization — bitwise the cold endpoint.
+
+Also locked: snapshot JSON round-trip exactness, skeleton-mismatch
+refusal (a stale snapshot must never poison a different model's fit),
+and the PINT_TPU_WARM_START disk auto-warm path end to end.
+"""
+
+import copy
+import json
+
+import numpy as np
+import pytest
+
+from pint_tpu.fitting import (
+    DownhillGLSFitter,
+    DownhillWLSFitter,
+    WidebandDownhillFitter,
+)
+from pint_tpu.fitting.state import FitterState, snapshot, warm_start
+from pint_tpu.fitting.wls import apply_delta
+from pint_tpu.io.par import parse_parfile
+from pint_tpu.models.base import leaf_to_f64
+from pint_tpu.models.builder import build_model
+from pint_tpu.ops import perf
+from pint_tpu.simulation import make_fake_toas_fromMJDs, make_fake_toas_uniform
+
+PARITY = 1e-10
+
+WLS_PAR = """
+PSR WARMWLS
+RAJ 04:37:15.9 1
+DECJ -47:15:09.1 1
+F0 173.6879489990983 1
+F1 -1.728e-15 1
+PEPOCH 55000
+POSEPOCH 55000
+DM 2.64 1
+TZRMJD 55000.1
+TZRSITE gbt
+TZRFRQ 1400
+"""
+
+GLS_PAR = """
+PSR WARMGLS
+RAJ 07:40:45.79 1
+DECJ 66:20:33.6 1
+F0 346.531996493 1
+F1 -1.46389e-15 1
+PEPOCH 57000
+POSEPOCH 57000
+DM 14.96 1
+EFAC -f sim 1.1
+ECORR -f sim 0.5
+TZRMJD 57000.1
+TZRSITE gbt
+TZRFRQ 1400
+"""
+
+WB_PAR = """
+PSR WARMWB
+RAJ 08:00:00 1
+DECJ 30:00:00 1
+F0 250.1 1
+F1 -1e-15 1
+PEPOCH 55500
+POSEPOCH 55500
+DM 20.0 1
+DMEPOCH 55500
+DMJUMP -fe 430 0.0
+TZRMJD 55500.1
+TZRSITE gbt
+TZRFRQ 1400
+"""
+
+
+def _perturb(model, f0_delta=2e-9):
+    """Move the start away from the optimum so the cold LM loop walks."""
+    free = tuple(model.free_params)
+    delta = np.array([f0_delta if nm == "F0" else 0.0 for nm in free])
+    model.params = apply_delta(model.params, free, delta)
+    return model
+
+
+@pytest.fixture(scope="module")
+def wls_case():
+    model = build_model(parse_parfile(WLS_PAR, from_text=True))
+    n = 140
+    freqs = np.where(np.arange(n) % 2 == 0, 1400.0, 2300.0)
+    toas = make_fake_toas_uniform(
+        54500, 55500, n, model, obs="gbt", freq_mhz=freqs, error_us=1.0,
+        add_noise=True, rng=np.random.default_rng(7),
+    )
+    return toas, _perturb(model)
+
+
+@pytest.fixture(scope="module")
+def gls_case():
+    model = build_model(parse_parfile(GLS_PAR, from_text=True))
+    n_ep = 21
+    mjds = np.repeat(np.linspace(56600, 57400, n_ep), 2)
+    mjds[1::2] += 0.5 / 86400.0
+    freqs = np.where(np.arange(len(mjds)) % 2 == 0, 1400.0, 800.0)
+    flags = [{"f": "sim"} for _ in mjds]
+    toas = make_fake_toas_fromMJDs(
+        np.sort(mjds), model, obs="gbt", freq_mhz=freqs, error_us=1.0,
+        flags=flags, add_noise=True, rng=np.random.default_rng(1),
+    )
+    return toas, _perturb(model)
+
+
+@pytest.fixture(scope="module")
+def wb_case():
+    model = build_model(parse_parfile(WB_PAR, from_text=True))
+    rng = np.random.default_rng(2)
+    n = 60
+    freqs = np.where(np.arange(n) % 2 == 0, 430.0, 1400.0)
+    toas = make_fake_toas_uniform(
+        55000, 56000, n, model, freq_mhz=freqs, error_us=1.0)
+    for i, f in enumerate(toas.flags):
+        fe = "430" if freqs[i] < 1000 else "L"
+        f["fe"] = fe
+        dm = 20.0 + rng.standard_normal() * 1e-4
+        if fe == "430":
+            dm -= 0.003
+        f["pp_dm"] = f"{dm:.10f}"
+        f["pp_dme"] = "0.000100"
+    return toas, _perturb(model)
+
+
+def _cold_then_warm(cls, toas, model0, fused):
+    cold = cls(toas, copy.deepcopy(model0), fused=fused)
+    r_cold = cold.fit_toas()
+    warm = cls(toas, copy.deepcopy(model0), fused=fused)
+    assert warm.warm_start(cold.snapshot())
+    r_warm = warm.fit_toas()
+    return (cold, r_cold), (warm, r_warm)
+
+
+def _assert_warm_parity(cold, r_cold, warm, r_warm):
+    free = cold._free
+    p_c = np.array([float(np.asarray(leaf_to_f64(cold.model.params[n])))
+                    for n in free])
+    p_w = np.array([float(np.asarray(leaf_to_f64(warm.model.params[n])))
+                    for n in free])
+    rel_p = np.max(np.abs(p_w - p_c) / np.maximum(np.abs(p_c), 1e-300))
+    assert rel_p <= PARITY, f"param parity {rel_p:.3e}"
+    u_c = np.array([r_cold.uncertainties[n] for n in free])
+    u_w = np.array([r_warm.uncertainties[n] for n in free])
+    rel_u = np.max(np.abs(u_w - u_c) / np.maximum(np.abs(u_c), 1e-300))
+    assert rel_u <= PARITY, f"uncertainty parity {rel_u:.3e}"
+    # the whole point: the warm LM loop does strictly less work
+    assert r_warm.iterations < r_cold.iterations, (
+        r_warm.iterations, r_cold.iterations)
+    assert r_warm.converged
+
+
+class TestWarmStartParity:
+    @pytest.mark.parametrize("fused", [True, False])
+    def test_wls(self, wls_case, fused):
+        toas, model = wls_case
+        (c, rc), (w, rw) = _cold_then_warm(DownhillWLSFitter, toas, model,
+                                           fused)
+        _assert_warm_parity(c, rc, w, rw)
+
+    def test_gls_ecorr(self, gls_case):
+        toas, model = gls_case
+        (c, rc), (w, rw) = _cold_then_warm(DownhillGLSFitter, toas, model,
+                                           fused=True)
+        _assert_warm_parity(c, rc, w, rw)
+
+    def test_wideband(self, wb_case):
+        toas, model = wb_case
+        (c, rc), (w, rw) = _cold_then_warm(WidebandDownhillFitter, toas,
+                                           model, fused=True)
+        _assert_warm_parity(c, rc, w, rw)
+
+
+class TestFitterState:
+    def test_json_roundtrip_is_exact(self, wls_case):
+        toas, model = wls_case
+        f = DownhillWLSFitter(toas, copy.deepcopy(model), fused=True)
+        f.fit_toas()
+        st = f.snapshot()
+        st2 = FitterState.from_dict(json.loads(json.dumps(st.to_dict())))
+        # (hi, lo) float pairs survive JSON bit-for-bit
+        assert st2.params == st.params
+        assert st2.skeleton() == st.skeleton()
+        assert st2.uncertainties == st.uncertainties
+
+    def test_save_load(self, wls_case, tmp_path):
+        toas, model = wls_case
+        f = DownhillWLSFitter(toas, copy.deepcopy(model), fused=True)
+        f.fit_toas()
+        path = tmp_path / "state.json"
+        f.snapshot().save(path)
+        st = FitterState.load(path)
+        assert st.params == f.snapshot().params
+
+    def test_skeleton_mismatch_refused(self, wls_case, gls_case):
+        """A snapshot of a different model/kind must never apply."""
+        toas, model = wls_case
+        f = DownhillWLSFitter(toas, copy.deepcopy(model), fused=True)
+        f.fit_toas()
+        st = f.snapshot()
+        gtoas, gmodel = gls_case
+        g = DownhillGLSFitter(gtoas, copy.deepcopy(gmodel), fused=True)
+        before = {n: float(np.asarray(leaf_to_f64(g.model.params[n])))
+                  for n in g._free}
+        assert g.warm_start(st) is False
+        after = {n: float(np.asarray(leaf_to_f64(g.model.params[n])))
+                 for n in g._free}
+        assert before == after  # nothing applied
+        with pytest.raises(ValueError):
+            warm_start(g, st, strict=True)
+
+    def test_auto_disk_warm_start(self, wls_case, tmp_path, monkeypatch):
+        """PINT_TPU_WARM_START=1: fit once cold (saves the snapshot), then
+        a fresh fitter on the same data warm-starts from disk, does fewer
+        iterations, and latches the telemetry."""
+        monkeypatch.setenv("PINT_TPU_CACHE_DIR", str(tmp_path))
+        monkeypatch.setenv("PINT_TPU_WARM_START", "1")
+        toas, model = wls_case
+        cold = DownhillWLSFitter(toas, copy.deepcopy(model), fused=True)
+        r_cold = cold.fit_toas()
+        warm = DownhillWLSFitter(toas, copy.deepcopy(model), fused=True)
+        perf.enable(True)
+        try:
+            r_warm = warm.fit_toas()
+        finally:
+            perf.enable(False)
+        assert r_warm.iterations < r_cold.iterations
+        assert r_warm.perf["warm_start"] is True
+        assert "fitstate" in str(r_warm.perf["warm_start_source"])
+        _assert_warm_parity(cold, r_cold, warm, r_warm)
+
+    def test_cold_fit_latches_false(self, wls_case, tmp_path, monkeypatch):
+        monkeypatch.setenv("PINT_TPU_CACHE_DIR", str(tmp_path))
+        toas, model = wls_case
+        f = DownhillWLSFitter(toas, copy.deepcopy(model), fused=True)
+        perf.enable(True)
+        try:
+            res = f.fit_toas()
+        finally:
+            perf.enable(False)
+        assert res.perf["warm_start"] is False
+        assert res.perf["warm_start_source"] is None
