@@ -40,9 +40,11 @@ Passes (each returns a list of human-readable violation details):
     in the body re-serializes every iteration.
 ``prepare-sync``
     Any host-sync primitive anywhere in a ``prepare_*`` program
-    (astro/device_prepare.py): the device-fused TOA prepare must never
-    round-trip to the host mid-program — a prepare step that needs host
-    data belongs on the host-numpy fallback path instead.
+    (astro/device_prepare.py — geometry/ephemeris/N-body serve and the
+    ``prepare_kernel_eval`` Chebyshev kernel-pack program): the
+    device-fused TOA prepare must never round-trip to the host
+    mid-program — a prepare step that needs host data belongs on the
+    host-numpy fallback path instead.
 ``retrace-budget``
     A second compiled signature that differs from an existing one only
     in dtype/weak_type at identical tree structure and shapes. A
@@ -278,8 +280,9 @@ def _pass_host_sync(ctx: _Ctx) -> list[str]:
 
 
 def _pass_prepare_sync(ctx: _Ctx) -> list[str]:
-    """Prepare programs (label ``prepare_*``, astro/device_prepare.py) are
-    the TOA-prepare pipeline's device residents: a host callback ANYWHERE
+    """Prepare programs (label ``prepare_*``, astro/device_prepare.py —
+    including the ``prepare_kernel_eval`` kernel-pack serve) are the
+    TOA-prepare pipeline's device residents: a host callback ANYWHERE
     in one — not just inside a loop body — re-serializes the prepare path
     the fusion exists to eliminate, so the contract is zero host-sync
     primitives, full stop."""

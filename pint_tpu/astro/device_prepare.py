@@ -12,7 +12,7 @@ jaxpr auditor (every ``prepare_*`` program must contain zero host-sync
 primitives, the ``prepare-sync`` audit pass) and the stage telemetry all
 apply.
 
-Three programs:
+Four programs:
 
 - ``prepare_geometry``: the full ITRF->GCRS chain (Fukushima-Williams
   precession, IAU2000B nutation, ERA/GAST, polar motion) for one
@@ -27,6 +27,12 @@ Three programs:
   ``posvel``/``_posvel_raw``/``_band_design``; the trajectory grids ride
   the argument list (never baked constants — the large-const audit pass
   enforces it).
+- ``prepare_kernel_eval``: the Chebyshev kernel-pack serve
+  (astro/kernel_ephemeris.py): record index = integer gather, position =
+  Chebyshev-recurrence polyval, velocity = the analytic derivative on
+  the same coefficients, chain composition as a static row sum. The pack
+  tensors ride the argument list; the ``prepare-sync`` audit pass covers
+  it like every other prepare program.
 
 Engagement: ``PINT_TPU_DEVICE_PREPARE`` = ``auto`` (default; on for
 non-CPU backends, where the host numpy loops stall the chip), ``1``
@@ -45,7 +51,7 @@ log = get_logger("pint_tpu.prepare")
 
 __all__ = [
     "enabled", "site_posvel_device", "analytic_posvel_device",
-    "nbody_posvel_device",
+    "nbody_posvel_device", "kernel_posvel_device",
 ]
 
 
@@ -207,6 +213,62 @@ def _build_nbody_program(body_indices: tuple[int, ...],
     return TimedProgram(precision_jit(fn), "prepare_nbody")
 
 
+# --- Chebyshev kernel-pack serve --------------------------------------------------
+
+
+def _build_kernel_program(chains: tuple[tuple[int, ...], ...], C: int):
+    """One fused program serving every requested body from a kernel pack:
+    per body a static chain of pack rows, each row an integer record
+    gather + Chebyshev-recurrence polyval + the analytic-derivative
+    velocity — the
+    xp=jnp instantiation of ``kernel_ephemeris.eval_rows``. The pack
+    tensors are ARGUMENTS (never baked constants); only the chain layout
+    and the padded coefficient count are static."""
+    import jax.numpy as jnp
+
+    from pint_tpu.astro.kernel_ephemeris import eval_rows
+    from pint_tpu.ops.compile import TimedProgram, precision_jit
+
+    rows = tuple(sorted({r for ch in chains for r in ch}))
+    row_slot = {r: i for i, r in enumerate(rows)}
+
+    def fn(t_jcent, coef, mid, init, intlen, nrec):
+        # two-step jcent->ET like the host paths (spk.py / KernelEphemeris):
+        # a precomputed-product constant rounds epochs ~5e-8 s differently,
+        # ~2 mm of EMB motion against the host parity bound
+        t_et = t_jcent * 36525.0 * 86400.0
+        parts = eval_rows(t_et, coef, mid, init, intlen, nrec, rows, xp=jnp)
+        out = []
+        for ch in chains:
+            pos = sum(parts[row_slot[r]][0] for r in ch)
+            vel = sum(parts[row_slot[r]][1] for r in ch)
+            out.append((pos * 1e3, vel * 1e3))
+        return tuple(out)
+
+    return TimedProgram(precision_jit(fn), "prepare_kernel_eval")
+
+
+def kernel_posvel_device(pack, bodies: tuple[str, ...], t_jcent) -> dict | None:
+    """{body: (pos [m], vel [m/s])} served from a ``KernelPack`` by one
+    fused device program; None when a requested body has no chain in the
+    pack or the request leaves its coverage (caller falls back to the
+    host path, which raises the informative error)."""
+    try:
+        chains = tuple(pack.chain_rows(b) for b in bodies)
+    except KeyError:
+        return None
+    t = np.asarray(t_jcent, np.float64)
+    et = t * 36525.0 * 86400.0
+    if not all(pack.covers(b, et) for b in bodies):
+        return None
+    C = pack.coef.shape[2]
+    key = ("kernel", chains, C, pack.coef.shape, pack.source)
+    prog = _program(key, lambda: _build_kernel_program(chains, C))
+    out = prog(t, pack.coef, pack.mid, pack.init, pack.intlen, pack.nrec)
+    return {b: (np.asarray(p), np.asarray(v))
+            for b, (p, v) in zip(bodies, out)}
+
+
 #: mass weight of the Moon in the EMB combination, set lazily from the
 #: package constant (kept here so the program closure stays tiny)
 def _emb_weight():
@@ -259,21 +321,38 @@ def posvel_ssb_many(eph, bodies: tuple[str, ...], tdb_jcent) -> dict | None:
     fused device programs, or None when the device path cannot serve this
     ephemeris/config (caller uses the per-body host path).
 
-    Mirrors ``AnalyticEphemeris.posvel_ssb``'s dispatch: the N-body
-    window when engaged, the analytic series otherwise. SPK-kernel
-    ephemerides stay on the host reader.
+    Mirrors ``AnalyticEphemeris.posvel_ssb``'s dispatch: a Chebyshev
+    kernel pack when one serves this ephemeris (a configured SPK kernel
+    compiled by astro/kernel_ephemeris.py, or the forced pack snapshot of
+    the analytic/N-body path under ``PINT_TPU_KERNEL_EPHEM=1``), the
+    N-body window when engaged, the analytic series otherwise.
     """
     from pint_tpu.astro.ephemeris import AnalyticEphemeris, _ELEMENTS
+    from pint_tpu.astro.kernel_ephemeris import KernelEphemeris, forced
 
-    if not enabled() or not isinstance(eph, AnalyticEphemeris):
+    if not enabled():
         return None
     T = np.asarray(tdb_jcent, np.float64)
+    if isinstance(eph, KernelEphemeris):
+        try:
+            return kernel_posvel_device(eph.pack, tuple(bodies), T)
+        except Exception as e:  # noqa: BLE001  # jaxlint: disable=silent-except — device prepare is an optimization; the host pack eval is the identical-formula fallback and the miss is logged
+            log.warning(f"device kernel serve fell back to host: {e}")
+            return None
+    if not isinstance(eph, AnalyticEphemeris):
+        return None
     known = all(
         b in ("earth", "moon", "emb", "sun") or b in _ELEMENTS
         for b in bodies)
     if not known:
         return None
     try:
+        if forced():
+            pack = eph._kernel_pack_for(T)
+            if pack is not None:
+                out = kernel_posvel_device(pack, tuple(bodies), T)
+                if out is not None:
+                    return out
         nb = eph._nbody_for(T)
         if nb is not None:
             out = nbody_posvel_device(nb, tuple(bodies), T)

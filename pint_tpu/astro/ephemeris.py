@@ -103,7 +103,13 @@ def _helio_ecliptic(body: str, T: np.ndarray, xp=np) -> np.ndarray:
     lnode = (el0[5] + rate[5] * T) * DEG
     M = xp.remainder(L - lperi, 2 * np.pi)
     w = lperi - lnode
-    E = _solve_kepler(M, xp.mean(e), xp=xp)
+    # elementwise eccentricity: solving with mean(e) over the requested
+    # epoch ARRAY made served positions depend on how epochs were batched
+    # (km-level on Mercury between a 12-yr sampling grid and an 800-day
+    # request; ~0.1 m on everything else through the Sun constraint) —
+    # the same serve-set dependence the N-body window quantization exists
+    # to prevent, and fatal to kernel-pack ≡ direct parity
+    E = _solve_kepler(M, e, xp=xp)
     px = a * (xp.cos(E) - e)
     py = a * xp.sqrt(1 - e * e) * xp.sin(E)
     cw, sw = xp.cos(w), xp.sin(w)
@@ -247,6 +253,21 @@ def _ecl_date_to_gcrs(vec: np.ndarray, T: np.ndarray, M: np.ndarray | None = Non
     return xp.einsum("...ij,...j->...i", M, vec)
 
 
+def quantize_nbody_window(lo: float, hi: float) -> tuple[float, float]:
+    """Deterministic quantized serving window for a [lo, hi] jcent
+    request: center snapped to whole years, span to multiples of 4 years
+    (floor 12). Shared by the N-body refinement (_nbody_for) and the
+    kernel-pack snapshot (astro/kernel_ephemeris.pack_for_analytic) so
+    the pack and the window it samples always line up exactly — and
+    neither ever depends on what else the process loaded before."""
+    yr = 365.25 * 86400.0 / (36525.0 * 86400.0)  # 1 year in jcent
+    t0_q = round(((lo + hi) / 2.0) / yr) * yr
+    # span: data + 4 yr margin + 1 yr quantization slack, snapped UP to
+    # a multiple of 4 years, floor 12
+    span_yr = max(4.0 * np.ceil(((hi - lo) * 100.0 + 5.0) / 4.0), 12.0)
+    return round(t0_q, 6), span_yr
+
+
 class AnalyticEphemeris:
     """Built-in analytic solar-system ephemeris (see module docstring)."""
 
@@ -255,6 +276,9 @@ class AnalyticEphemeris:
     def __init__(self):
         #: quantized-window key -> NBodyEphemeris (see _nbody_for)
         self._nbody_windows: dict = {}
+        #: re-entrancy guard: a kernel-pack build samples posvel_ssb and
+        #: must see the DIRECT path, never recurse into pack serving
+        self._pack_building = False
     bodies = (
         "sun",
         "mercury",
@@ -303,6 +327,44 @@ class AnalyticEphemeris:
             acc = acc + gm * r
         return -acc / gm_tot
 
+    def pos_ssb_many(self, bodies, tdb_jcent: np.ndarray, xp=np) -> dict:
+        """``{body: barycentric ICRS position [m]}`` for several bodies
+        with the shared per-epoch work — the Fukushima-Williams rotation,
+        the full heliocentric planet dict and the Sun barycentric
+        constraint — computed ONCE instead of once per body. This is what
+        makes a kernel-pack snapshot (astro/kernel_ephemeris.py) cheap:
+        sampling N bodies costs one full-system series evaluation, not N."""
+        T = xp.asarray(tdb_jcent, np.float64)
+        M_fw = _ecl_date_matrix(T, xp=xp)
+        helio = self._planets_helio_icrs(T, M_fw, xp=xp)
+        sun = self._sun_ssb_icrs(helio, xp=xp)
+        out = {}
+        earth = moon_gc = None
+        for body in bodies:
+            if body == "sun":
+                out[body] = sun
+                continue
+            if body in ("earth", "moon", "emb"):
+                if earth is None:
+                    from pint_tpu.astro import vsop87
+
+                    earth = sun + _ecl_date_to_gcrs(
+                        vsop87.earth_helio_ecl_date(T, xp=xp) * AU_M,
+                        T, M_fw, xp=xp)
+                if body == "earth":
+                    out[body] = earth
+                    continue
+                if moon_gc is None:
+                    moon_gc = _ecl_date_to_gcrs(
+                        _moon_geocentric_ecliptic_date(T, xp=xp),
+                        T, M_fw, xp=xp)
+                out[body] = (earth + moon_gc if body == "moon"
+                             else earth + moon_gc
+                             / (1.0 + EARTH_MOON_MASS_RATIO))
+                continue
+            out[body] = sun + helio[body]
+        return out
+
     def pos_ssb(self, body: str, tdb_jcent: np.ndarray, xp=np) -> np.ndarray:
         """Barycentric ICRS position [m] of a body at TDB centuries since
         J2000; shape (..., 3).
@@ -311,26 +373,7 @@ class AnalyticEphemeris:
         (astro/vsop87.py) + Meeus lunar series; Jupiter/Saturn their
         VSOP87D series; other planets the Keplerian mean elements.  The Sun
         sits at the barycentric constraint over all of them."""
-        T = xp.asarray(tdb_jcent, np.float64)
-        M_fw = _ecl_date_matrix(T, xp=xp)
-        helio = self._planets_helio_icrs(T, M_fw, xp=xp)
-        sun = self._sun_ssb_icrs(helio, xp=xp)
-        if body == "sun":
-            return sun
-        if body in ("earth", "moon", "emb"):
-            from pint_tpu.astro import vsop87
-
-            earth = sun + _ecl_date_to_gcrs(
-                vsop87.earth_helio_ecl_date(T, xp=xp) * AU_M, T, M_fw, xp=xp
-            )
-            if body == "earth":
-                return earth
-            moon_gc = _ecl_date_to_gcrs(
-                _moon_geocentric_ecliptic_date(T, xp=xp), T, M_fw, xp=xp)
-            if body == "moon":
-                return earth + moon_gc
-            return earth + moon_gc / (1.0 + EARTH_MOON_MASS_RATIO)
-        return sun + helio[body]
+        return self.pos_ssb_many((body,), tdb_jcent, xp=xp)[body]
 
     def _posvel_analytic(self, body: str, tdb_jcent: np.ndarray, dt_s: float = 16.0, xp=np):
         """(pos [m], vel [m/s]) via central differencing of the analytic
@@ -360,14 +403,14 @@ class AnalyticEphemeris:
 
         if knobs.get("PINT_TPU_NBODY") == "0":
             return None
-        lo = float(np.min(T))
-        hi = float(np.max(T))
-        yr = 365.25 * 86400.0 / (36525.0 * 86400.0)  # 1 year in jcent
-        t0_q = round(((lo + hi) / 2.0) / yr) * yr
-        # span: data + 4 yr margin + 1 yr quantization slack, snapped UP to
-        # a multiple of 4 years, floor 12
-        span_yr = max(4.0 * np.ceil(((hi - lo) * 100.0 + 5.0) / 4.0), 12.0)
-        key = (round(t0_q, 6), span_yr)
+        t0_q, span_yr = quantize_nbody_window(
+            float(np.min(T)), float(np.max(T)))
+        return self._nbody_window(t0_q, span_yr)
+
+    def _nbody_window(self, t0_q: float, span_yr: float):
+        """The NBodyEphemeris for an already-quantized window key (shared
+        with the kernel-pack snapshot, which samples exactly this window)."""
+        key = (t0_q, span_yr)
         cache = self._nbody_windows
         if key not in cache:
             from pint_tpu.astro.nbody import NBodyEphemeris
@@ -388,24 +431,75 @@ class AnalyticEphemeris:
         Earth and Moon are integrated as separate bodies (a point-mass EMB
         misses the solar-tide deviation of the true barycenter) and served
         with the hybrid in-band correction; 'emb' is their mass-weighted
-        combination; Sun/planets come from the same integration."""
+        combination; Sun/planets come from the same integration.
+
+        With ``PINT_TPU_KERNEL_EPHEM=1`` the query serves from a
+        Chebyshev kernel-pack snapshot of this same path
+        (astro/kernel_ephemeris.py): built once per quantized span, disk
+        cached — a warm cache skips even the N-body window construction."""
         T = np.asarray(tdb_jcent, np.float64)
         known = body in ("earth", "moon", "emb", "sun") or body in _ELEMENTS
+        if known and not self._pack_building:
+            from pint_tpu.astro import kernel_ephemeris as ke
+
+            if ke.forced():
+                pack = self._kernel_pack_for(T)
+                if pack is not None and pack.covers(
+                        body, T * 36525.0 * 86400.0):
+                    from pint_tpu.astro.kernel_ephemeris import eval_posvel
+
+                    return eval_posvel(pack, body, T * 36525.0 * 86400.0)
         nb = self._nbody_for(T) if known else None
         if nb is None:
             return self._posvel_analytic(body, T, dt_s)
         return nb.posvel(body, T)
 
+    def _kernel_pack_for(self, T: np.ndarray):
+        """Kernel-pack snapshot covering a request (None when the build
+        fails — the direct path is the identical-source fallback)."""
+        from pint_tpu.astro import kernel_ephemeris as ke
+
+        self._pack_building = True
+        try:
+            return ke.pack_for_analytic(self, T)
+        except Exception as e:  # noqa: BLE001  # jaxlint: disable=silent-except — pack serving is an optimization; the direct refined path is the identical-source fallback and the miss is logged
+            from pint_tpu.utils.logging import get_logger
+
+            get_logger("pint_tpu.kernel_ephem").warning(
+                f"kernel pack build failed; serving directly: {e}")
+            return None
+        finally:
+            self._pack_building = False
+
 
 _DEFAULT: AnalyticEphemeris | None = None
 
 
+def _analytic_fallback_bound_us(kernel_path: str) -> float:
+    """Timing-error bound for the analytic-fallback ledger event: the
+    MEASURED Earth-position difference against a cached kernel pack when
+    one survives the unreadable/missing source (kernel_ephemeris.py),
+    the static conservative ~60 km / 200 µs figure otherwise."""
+    from pint_tpu.astro import kernel_ephemeris as ke
+
+    pack = ke.find_pack_for_source(f"spk:{os.path.abspath(kernel_path)}")
+    if pack is not None:
+        measured = ke.measured_fallback_bound_us(
+            pack, _DEFAULT or AnalyticEphemeris())
+        if measured is not None:
+            return round(measured, 3)
+    return 200.0  # ~60 km Earth-SSB line-of-sight ≈ 200 µs Roemer
+
+
 def get_ephemeris(name: str = "auto"):
     """Ephemeris factory. ``PINT_TPU_EPHEM`` may point at a JPL SPK kernel
-    (loaded with the native reader when present); otherwise the analytic
-    ephemeris serves all DE-name requests, on the degradation ledger
-    (``ephemeris.analytic_fallback`` — the ~60 km Earth-SSB error is the
-    dominant corner-cut against a real DE kernel)."""
+    — compiled into a Chebyshev tensor pack (astro/kernel_ephemeris.py,
+    same records as the host reader, vectorized/device-servable eval)
+    unless ``PINT_TPU_KERNEL_EPHEM=0`` keeps the per-record host reader.
+    Otherwise the analytic ephemeris serves all DE-name requests, on the
+    degradation ledger (``ephemeris.analytic_fallback`` — with the error
+    bound MEASURED against a surviving kernel pack when one is cached,
+    the conservative ~60 km figure otherwise)."""
     global _DEFAULT
     from pint_tpu.ops import degrade
     from pint_tpu.utils import knobs
@@ -413,17 +507,37 @@ def get_ephemeris(name: str = "auto"):
     kernel = knobs.get("PINT_TPU_EPHEM")
     if kernel:
         if os.path.exists(kernel):
+            from pint_tpu.astro import kernel_ephemeris as ke
             from pint_tpu.astro.spk import SPKEphemeris
 
-            return SPKEphemeris(kernel)
-        # a configured kernel that is missing used to silently fall back
-        degrade.record(
-            "ephemeris.analytic_fallback", os.path.basename(kernel),
-            f"PINT_TPU_EPHEM={kernel} does not exist; serving the analytic "
-            "ephemeris instead",
-            bound_us=200.0,  # ~60 km Earth-SSB line-of-sight ≈ 200 µs Roemer
-            fix="restore the SPK kernel at PINT_TPU_EPHEM",
-        )
+            if ke.enabled():
+                try:
+                    return ke.KernelEphemeris(ke.pack_for_spk_file(kernel))
+                except Exception as e:  # noqa: BLE001  # jaxlint: disable=silent-except — unpackable kernels (exotic segment layouts) keep full accuracy on the host reader; the miss is logged
+                    from pint_tpu.utils.logging import get_logger
+
+                    get_logger("pint_tpu.kernel_ephem").warning(
+                        f"kernel pack compilation failed for {kernel}; "
+                        f"using the host SPK reader: {e}")
+            try:
+                return SPKEphemeris(kernel)
+            except Exception as e:  # noqa: BLE001 — unreadable kernel: analytic fallback, measured bound
+                degrade.record(
+                    "ephemeris.analytic_fallback", os.path.basename(kernel),
+                    f"PINT_TPU_EPHEM={kernel} is unreadable ({e}); serving "
+                    "the analytic ephemeris instead",
+                    bound_us=_analytic_fallback_bound_us(kernel),
+                    fix="restore a valid SPK kernel at PINT_TPU_EPHEM",
+                )
+        else:
+            # a configured kernel that is missing used to silently fall back
+            degrade.record(
+                "ephemeris.analytic_fallback", os.path.basename(kernel),
+                f"PINT_TPU_EPHEM={kernel} does not exist; serving the "
+                "analytic ephemeris instead",
+                bound_us=_analytic_fallback_bound_us(kernel),
+                fix="restore the SPK kernel at PINT_TPU_EPHEM",
+            )
     elif name not in ("auto", "analytic", None):
         # a model requested a JPL DE ephemeris by name (par EPHEM card)
         degrade.record(

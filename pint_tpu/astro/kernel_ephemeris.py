@@ -1,0 +1,655 @@
+"""Device-native Chebyshev kernel ephemeris: every source, one tensor pack.
+
+Every ephemeris source this package can serve — a JPL SPK type-2/3 kernel
+(clean-room reader astro/spk.py), the analytic VSOP87+Moon theory
+(astro/ephemeris.py), or an N-body-refined trajectory (astro/nbody.py) —
+compiles into ONE padded coefficient tensor pack:
+
+    coef   (body, record, coef, dim)   Chebyshev coefficients [km]
+    mid    (body, record)              record midpoints [ET s past J2000]
+    init / intlen / nrec  (body,)      record grid metadata
+
+and every query evaluates as a pure gather + polyval: record
+index = integer gather from the uniform record grid, position = the
+Chebyshev series, velocity = the ANALYTIC derivative of the same
+coefficients (the differentiated Chebyshev recurrence — no central
+differencing, no second sampling pass). The evaluation is xp-parametric
+like the rest of astro/: ``xp=np`` is the host path, ``xp=jnp`` is the
+fused, audited XLA program in astro/device_prepare.py
+(``prepare_kernel_eval``, covered by the ``prepare-sync`` jaxpr-audit
+pass like every other prepare program).
+
+Why this exists (ROADMAP item 2 + item 1's residue):
+
+- With ``PINT_TPU_EPHEM`` pointing at a real DE kernel, serving used to
+  walk SPK records in a per-record host loop; the pack makes full
+  DE-kernel accuracy an in-program fast path (same records, same
+  polynomial — parity with the host reader is at float-rounding level,
+  locked <= 1 mm by tests/test_kernel_ephemeris.py).
+- With the built-in ephemeris, the ~70 s N-body window build
+  (astro/nbody.py DOP853 integration) dominated cold time-to-first-point.
+  A pack snapshot of the refined serving path is built ONCE per
+  (source, quantized span) and rides a content-hash disk cache with
+  quarantine (the PR-6 pattern): a repeat run loads coefficients in
+  milliseconds and never touches the integrator.
+
+Engagement: ``PINT_TPU_KERNEL_EPHEM`` = ``auto`` (default: pack-serve a
+configured SPK kernel; the analytic path stays direct), ``1`` (also
+serve the analytic/N-body ephemeris through a pack snapshot), ``0``
+(off). Ragged per-body record grids pad with zero coefficients (exact —
+a zero coefficient contributes nothing to the series) and the record
+gather clips at ``nrec-1``, so pad records are provably never selected
+(tests poison them with NaN).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+
+import numpy as np
+
+from pint_tpu.utils import knobs
+from pint_tpu.utils.logging import get_logger
+
+log = get_logger("pint_tpu.kernel_ephem")
+
+__all__ = [
+    "KernelPack", "KernelEphemeris", "eval_rows", "pack_from_spk",
+    "pack_from_source", "pack_for_spk_file", "pack_for_analytic",
+    "save_pack", "load_pack", "cached_pack", "clear_memory_cache",
+    "measured_fallback_bound_us", "enabled", "forced",
+]
+
+#: bump when the pack layout / build recipe changes — invalidates every
+#: cached pack on disk (the key carries it).
+PACK_VERSION = 1
+
+CENT_S = 36525.0 * 86400.0
+DAY_S = 86400.0
+C_M_S = 299792458.0
+
+#: per-body record length [days] for packs WE fit (analytic / N-body
+#: sources): half the spk_write export table — the Chebyshev truncation
+#: error falls ~2^ncoef per halving, so 4-day inner-body records put the
+#: fit error far below the mm level the parity suite locks.
+_RECORD_DAYS = {"moon": 4.0, "earth": 4.0, "emb": 4.0, "mercury": 8.0,
+                "venus": 8.0, "sun": 8.0, "mars": 16.0, "jupiter": 16.0,
+                "saturn": 16.0, "uranus": 16.0, "neptune": 16.0}
+_NCOEF_FIT = 14
+
+#: bodies snapshotted into an analytic/N-body pack: everything the
+#: prepare pipeline (earth/sun/planets) and the TZR fiducial can request.
+_DEFAULT_BODIES = ("sun", "mercury", "venus", "emb", "earth", "moon",
+                   "mars", "jupiter", "saturn", "uranus", "neptune")
+
+
+@dataclass(frozen=True)
+class KernelPack:
+    """Padded Chebyshev coefficient tensors for a set of bodies.
+
+    ``centers[i]`` names what ``bodies[i]``'s coefficients are relative
+    to: ``"ssb"`` or another body in the pack (the DE layout keeps Earth
+    and Moon relative to the EMB). Pad records (beyond ``nrec[b]``) and
+    pad coefficients (beyond a body's fitted order) are zero.
+    """
+
+    bodies: tuple[str, ...]
+    centers: tuple[str, ...]
+    coef: np.ndarray    # (B, R, C, 3) float64 [km]
+    mid: np.ndarray     # (B, R) float64 [ET s]
+    init: np.ndarray    # (B,) float64 [ET s]
+    intlen: np.ndarray  # (B,) float64 [s]
+    nrec: np.ndarray    # (B,) int32
+    source: str = "unknown"
+
+    def row(self, body: str) -> int:
+        return self.bodies.index(body)
+
+    def chain_rows(self, body: str) -> tuple[int, ...]:
+        """Pack rows summed to compose ``body`` -> SSB (DE-style chain)."""
+        rows = []
+        cur = body
+        guard = 0
+        while cur != "ssb":
+            if cur not in self.bodies:
+                raise KeyError(
+                    f"no pack chain from {body!r} to SSB (missing {cur!r}; "
+                    f"pack bodies: {self.bodies})")
+            i = self.row(cur)
+            rows.append(i)
+            cur = self.centers[i]
+            guard += 1
+            if guard > 8:
+                raise KeyError(f"pack center chain for {body!r} does not "
+                               "reach the SSB")
+        return tuple(rows)
+
+    def span_et(self, body: str) -> tuple[float, float]:
+        """Coverage [ET s] of ``body``: the intersection of its chain."""
+        lo, hi = -np.inf, np.inf
+        for i in self.chain_rows(body):
+            lo = max(lo, float(self.init[i]))
+            hi = min(hi, float(self.init[i]
+                               + self.nrec[i] * self.intlen[i]))
+        return lo, hi
+
+    def covers(self, body: str, et: np.ndarray, slack_s: float = 1.0) -> bool:
+        lo, hi = self.span_et(body)
+        return (float(np.min(et)) >= lo - slack_s
+                and float(np.max(et)) <= hi + slack_s)
+
+
+# --- evaluation (xp-parametric: np host / jnp fused program) ---------------------
+
+
+def eval_rows(t_et, coef, mid, init, intlen, nrec, rows: tuple[int, ...],
+              xp=np):
+    """Evaluate pack rows at epochs ``t_et`` [ET s]: per row a
+    ``(pos [km], vel [km/s])`` pair of shape ``(..., 3)``.
+
+    Record index = integer gather (clipped at ``nrec-1``, so pad records
+    are never selected); polyval = the Chebyshev three-term recurrence,
+    the same basis values as the host reader's ``spk._cheby_and_deriv``,
+    summed SMALL-TO-LARGE (high-order terms first, the dominant constant
+    term last) so evaluation rounding is one ulp of the result instead of
+    C of them — what keeps pack ≡ reader parity well under the 1 mm
+    golden bound at EMB magnitudes (1.5e8 km: a large-first sum drifts
+    ~50 ulp ≈ 2 mm). Velocity = the recurrence's analytic derivative on
+    the SAME coefficients — no central differencing, no second sampling
+    pass. Zero-padded coefficient slots contribute exactly nothing, so
+    ragged packs evaluate exactly.
+    """
+    C = coef.shape[2]
+    i32 = np.int32
+    out = []
+    for b in rows:
+        r = xp.clip(xp.floor((t_et - init[b]) / intlen[b]).astype(i32),
+                    0, nrec[b] - 1)
+        cb = coef[b, r]                       # (..., C, 3)
+        radius = intlen[b] * 0.5
+        tau = ((t_et - mid[b, r]) / radius)[..., None]
+        one = xp.ones_like(tau)
+        p_terms = []
+        v_terms = []
+        if C > 1:
+            p_terms.append(cb[..., 1, :] * tau)
+            v_terms.append(cb[..., 1, :] * one)
+        Tm2, Tm1 = one, tau
+        dTm2, dTm1 = xp.zeros_like(tau), one
+        for k in range(2, C):
+            Tk = 2.0 * tau * Tm1 - Tm2
+            dTk = 2.0 * Tm1 + 2.0 * tau * dTm1 - dTm2
+            p_terms.append(cb[..., k, :] * Tk)
+            v_terms.append(cb[..., k, :] * dTk)
+            Tm2, Tm1 = Tm1, Tk
+            dTm2, dTm1 = dTm1, dTk
+        pos_tail = xp.zeros_like(cb[..., 0, :])
+        vel = xp.zeros_like(cb[..., 0, :])
+        for pt, vt in zip(reversed(p_terms), reversed(v_terms)):
+            pos_tail = pos_tail + pt
+            vel = vel + vt
+        out.append((cb[..., 0, :] + pos_tail, vel / radius))
+    return out
+
+
+def eval_posvel(pack: KernelPack, body: str, t_et, xp=np):
+    """Composed ``(pos [m], vel [m/s])`` of ``body`` wrt SSB (host path)."""
+    rows = pack.chain_rows(body)
+    parts = eval_rows(t_et, pack.coef, pack.mid, pack.init, pack.intlen,
+                      pack.nrec, rows, xp=xp)
+    pos = sum(p for p, _ in parts)
+    vel = sum(v for _, v in parts)
+    return pos * 1e3, vel * 1e3
+
+
+# --- builders --------------------------------------------------------------------
+
+
+def pack_from_spk(path: str) -> KernelPack:
+    """Compile an SPK type-2/3 kernel's raw records into a pack.
+
+    The coefficients are extracted verbatim (no refitting), so pack
+    evaluation is the SAME polynomial the host reader (astro/spk.py)
+    evaluates — parity is float rounding, locked <= 1 mm by the golden
+    suite. Type-3 segments contribute their position coefficients; the
+    velocity comes from the analytic derivative (their stored velocity
+    polynomial is the consistent derivative in well-formed kernels).
+    Raises when a (target, center) arc cannot be expressed on one
+    uniform record grid (caller falls back to the host reader).
+    """
+    from pint_tpu.astro.spk import NAIF_IDS, SPKEphemeris
+
+    names = {v: k for k, v in NAIF_IDS.items()}
+    eph = SPKEphemeris(path)
+    bodies: list[str] = []
+    centers: list[str] = []
+    per_body: list[tuple[np.ndarray, np.ndarray, float, float]] = []
+    for (t, c), segs in eph.segments.items():
+        if t not in names or c not in names:
+            continue  # unnamed minor body: not servable through our API
+        intlen = segs[0].intlen
+        if any(abs(s.intlen - intlen) > 1e-6 for s in segs):
+            raise ValueError(
+                f"SPK target {t} splits across segments with unequal "
+                f"record lengths; pack compilation needs one uniform grid")
+        mids, coefs = [], []
+        expect = segs[0].init
+        for s in sorted(segs, key=lambda s: s.init):
+            if abs(s.init - expect) > 1e-3:
+                raise ValueError(
+                    f"SPK target {t} has a coverage gap at ET {expect}; "
+                    "pack compilation needs contiguous records")
+            m, _radius, cf = s.records()
+            mids.append(m)
+            coefs.append(cf)
+            expect = s.init + s.n * s.intlen
+        bodies.append(names[t])
+        centers.append(names[c])
+        per_body.append((np.concatenate(mids),
+                         np.concatenate(coefs), segs[0].init, intlen))
+    if not bodies:
+        raise ValueError(f"no packable segments in {path}")
+    return _assemble(tuple(bodies), tuple(centers), per_body,
+                     source=f"spk:{os.path.abspath(path)}")
+
+
+def pack_from_source(eph, start_mjd: float, end_mjd: float,
+                     bodies: tuple[str, ...] = _DEFAULT_BODIES,
+                     record_days: dict | None = None,
+                     ncoef: int = _NCOEF_FIT,
+                     pos_m_many=None, source: str = "analytic") -> KernelPack:
+    """Fit a pack from any ephemeris with ``posvel_ssb`` (the refined
+    serving path — the spk_write lesson: exporting the pure-analytic
+    series instead silently regressed fits).
+
+    ``pos_m_many(bodies, T_jcent) -> {body: pos_m}`` overrides the
+    sampling callable (used to bypass pack serving during a build and to
+    batch bodies sharing a record length into one series evaluation).
+    Earth/Moon are stored relative to the EMB, the DE layout.
+    """
+    from pint_tpu.astro.spk_write import chebyshev_fit_records
+
+    rec_d = dict(_RECORD_DAYS)
+    if record_days:
+        rec_d.update(record_days)
+    t0 = (start_mjd - 51544.5) * DAY_S
+    t1 = (end_mjd - 51544.5) * DAY_S
+    if pos_m_many is None:
+        def pos_m_many(bs, T):
+            return {b: np.asarray(eph.posvel_ssb(b, T)[0]) for b in bs}
+
+    # group bodies by record length: every group's CGL node epochs are
+    # shared, so the (expensive) source series evaluates once per group
+    groups: dict[float, list[str]] = {}
+    for b in bodies:
+        groups.setdefault(rec_d.get(b, 8.0), []).append(b)
+    per_body: dict[str, tuple] = {}
+    for days, group in sorted(groups.items()):
+        # snap the record length so the grid divides the span exactly:
+        # the last record must never extend past what the source covers
+        n = max(int(round((t1 - t0) / (days * DAY_S))), 1)
+        intlen = (t1 - t0) / n
+
+        def flat_pos_km(et, _group=tuple(group)):
+            T = np.asarray(et) / CENT_S
+            sampled = pos_m_many(_group, T)
+            return {b: np.asarray(sampled[b]) / 1e3 for b in _group}
+
+        # one shared sampling pass for the whole group, then per-body
+        # coefficient fits from the same samples
+        samples: dict[str, np.ndarray] = {}
+
+        def group_fn(et):
+            nonlocal samples
+            samples = flat_pos_km(et)
+            return samples[group[0]]
+
+        mids, coef0 = chebyshev_fit_records(group_fn, t0, t1, intlen, ncoef)
+        fits = {group[0]: coef0}
+        for b in group[1:]:
+            _, cf = chebyshev_fit_records(
+                lambda et, _b=b: samples[_b], t0, t1, intlen, ncoef)
+            fits[b] = cf
+        for b in group:
+            per_body[b] = (mids, fits[b], t0, intlen)
+
+    # DE layout: earth/moon relative to the EMB when the EMB is packed
+    centers = []
+    for b in bodies:
+        if b in ("earth", "moon") and "emb" in bodies:
+            centers.append("emb")
+        else:
+            centers.append("ssb")
+    rows = []
+    for b, c in zip(bodies, centers):
+        mids, cf, init, intlen = per_body[b]
+        if c != "ssb":
+            cf = cf - per_body[c][1]  # same grid within a group...
+            if per_body[c][3] != intlen:
+                raise ValueError(
+                    f"{b} and its center {c} must share a record length")
+        rows.append((mids, cf, init, intlen))
+    return _assemble(tuple(bodies), tuple(centers), rows, source=source)
+
+
+def _assemble(bodies, centers, per_body, source: str) -> KernelPack:
+    """Pad ragged per-body (mids, coef (n,3,ncoef), init, intlen) rows
+    into the dense (B, R, C, 3) tensors; pads are zero."""
+    B = len(bodies)
+    R = max(m.size for m, _, _, _ in per_body)
+    C = max(cf.shape[2] for _, cf, _, _ in per_body)
+    coef = np.zeros((B, R, C, 3))
+    mid = np.zeros((B, R))
+    init = np.zeros(B)
+    intlen = np.zeros(B)
+    nrec = np.zeros(B, np.int32)
+    for i, (m, cf, i0, dt) in enumerate(per_body):
+        n, _, nc = cf.shape
+        coef[i, :n, :nc, :] = np.transpose(cf, (0, 2, 1))
+        mid[i, :n] = m
+        init[i] = i0
+        intlen[i] = dt
+        nrec[i] = n
+    return KernelPack(tuple(bodies), tuple(centers), coef, mid, init,
+                      intlen, nrec, source=source)
+
+
+# --- persistence + content-hash disk cache ---------------------------------------
+
+
+def save_pack(path: str, pack: KernelPack, key: str = "") -> None:
+    """Write a pack (npz, float arrays bitwise-exact); atomic replace."""
+    tmp = f"{path}.tmp{os.getpid()}"
+    np.savez(
+        tmp, coef=pack.coef, mid=pack.mid, init=pack.init,
+        intlen=pack.intlen, nrec=pack.nrec,
+        bodies=np.array(pack.bodies), centers=np.array(pack.centers),
+        source=np.array(pack.source), key=np.array(key),
+        version=np.array(PACK_VERSION),
+    )
+    os.replace(tmp if tmp.endswith(".npz") else f"{tmp}.npz", path)
+
+
+def load_pack(path: str) -> tuple[KernelPack, str]:
+    """(pack, stored full key); raises on any corruption/drift."""
+    with np.load(path, allow_pickle=False) as z:
+        if int(z["version"]) != PACK_VERSION:
+            raise ValueError(f"pack version {int(z['version'])} != "
+                             f"{PACK_VERSION}")
+        pack = KernelPack(
+            tuple(str(b) for b in z["bodies"]),
+            tuple(str(c) for c in z["centers"]),
+            z["coef"], z["mid"], z["init"], z["intlen"],
+            z["nrec"].astype(np.int32), source=str(z["source"]),
+        )
+        return pack, str(z["key"])
+
+
+def _pack_cache_dir():
+    from pint_tpu.utils.cache import cache_root
+
+    return cache_root() / "ephem_packs"
+
+
+#: in-memory pack cache: full content key -> KernelPack (process-wide; a
+#: pack is immutable, so sharing across datasets/fitters is free)
+_MEM: dict[str, KernelPack] = {}
+
+
+def clear_memory_cache() -> None:
+    """Drop in-memory packs (test isolation; disk entries survive)."""
+    _MEM.clear()
+
+
+def cached_pack(key: str, build) -> KernelPack:
+    """Serve a pack from the content-hash cache, or build + store it.
+
+    The PR-6 cache discipline: the FULL key is stored inside the entry
+    and compared on load (a truncated-hash collision is a miss, never a
+    wrong answer); a corrupt entry is QUARANTINED beside the cache with a
+    ``fetch.corrupt_quarantined`` ledger event and rebuilt from source;
+    retention is bounded by ``PINT_TPU_KERNEL_EPHEM_KEEP``. Builds run
+    under the ``kernel_build`` telemetry stage so the time-to-first-point
+    attribution names them.
+    """
+    import hashlib
+
+    from pint_tpu.ops import perf
+
+    pack = _MEM.get(key)
+    if pack is not None:
+        perf.add("kernel_pack_cache_hits")
+        return pack
+    use_disk = knobs.get("PINT_TPU_KERNEL_EPHEM_CACHE") != "0"
+    path = None
+    if use_disk:
+        d = _pack_cache_dir()
+        path = d / f"pack-{hashlib.sha256(key.encode()).hexdigest()[:24]}.npz"
+        if path.exists():
+            try:
+                pack, stored = load_pack(str(path))
+                if stored == key:
+                    perf.add("kernel_pack_cache_hits")
+                    log.info(f"kernel pack cache hit {path.name}")
+                    _MEM[key] = pack
+                    return pack
+                log.info(f"kernel pack key mismatch for {path.name}; "
+                         "rebuilding")
+            except Exception as e:  # noqa: BLE001 — corrupt pack: quarantine + rebuild
+                from pint_tpu.ops import degrade
+
+                qdir = d / "quarantine"
+                try:
+                    os.makedirs(qdir, exist_ok=True)
+                    os.replace(path, qdir / path.name)
+                except OSError:
+                    pass
+                degrade.record(
+                    "fetch.corrupt_quarantined", "kernel_pack",
+                    f"corrupt kernel ephemeris pack {path.name} quarantined "
+                    f"({e}); rebuilding from source",
+                    bound_us=0.0,  # full recovery: coefficients refit
+                    fix="delete the quarantined entry after diagnosis; the "
+                        "cache re-populates on the next serve",
+                )
+    perf.add("kernel_pack_cache_misses")
+    with perf.stage("kernel_build"):
+        pack = build()
+    _MEM[key] = pack
+    if path is not None:
+        try:
+            os.makedirs(path.parent, exist_ok=True)
+            save_pack(str(path), pack, key=key)
+            keep = int(knobs.get("PINT_TPU_KERNEL_EPHEM_KEEP"))
+            entries = sorted(path.parent.glob("pack-*.npz"),
+                             key=os.path.getmtime)
+            for old in entries[:-keep] if keep > 0 else []:
+                old.unlink(missing_ok=True)
+        except Exception as e:  # noqa: BLE001  # jaxlint: disable=silent-except — cache write failure only costs the next run a rebuild
+            log.warning(f"could not write kernel pack cache: {e}")
+    return pack
+
+
+def find_pack_for_source(source: str) -> KernelPack | None:
+    """Newest cached pack recorded for a source label (used to MEASURE
+    the analytic-fallback error bound after the source itself became
+    unreadable — the pack outlives the kernel file)."""
+    for pack in _MEM.values():
+        if pack.source == source:
+            return pack
+    d = _pack_cache_dir()
+    if not d.is_dir():
+        return None
+    for path in sorted(d.glob("pack-*.npz"), key=os.path.getmtime,
+                       reverse=True):
+        try:
+            pack, _ = load_pack(str(path))
+        except Exception:  # noqa: BLE001  # jaxlint: disable=silent-except — scanning for a diagnostic bound; corrupt entries are handled by cached_pack
+            continue
+        if pack.source == source:
+            return pack
+    return None
+
+
+# --- knob semantics --------------------------------------------------------------
+
+
+def enabled() -> bool:
+    """True when a configured SPK kernel should serve through a pack
+    (``PINT_TPU_KERNEL_EPHEM`` auto/1; ``0`` disables)."""
+    return knobs.get("PINT_TPU_KERNEL_EPHEM") != "0"
+
+
+def forced() -> bool:
+    """True when the analytic/N-body ephemeris should ALSO serve through
+    a pack snapshot (``PINT_TPU_KERNEL_EPHEM=1``)."""
+    return knobs.get("PINT_TPU_KERNEL_EPHEM") == "1"
+
+
+# --- source-specific cache keys ---------------------------------------------------
+
+
+def pack_for_spk_file(path: str) -> KernelPack:
+    """Pack for an SPK kernel file, cache-keyed on (path, size, mtime)."""
+    st = os.stat(path)
+    key = (f"v{PACK_VERSION}-spk-{os.path.abspath(path)}-"
+           f"{st.st_size}-{st.st_mtime:.0f}")
+    return cached_pack(key, lambda: pack_from_spk(path))
+
+
+def pack_for_analytic(eph, tdb_jcent, planets: bool = True) -> KernelPack:
+    """Pack snapshot of the built-in ephemeris's REFINED serving path
+    over the deterministic quantized window covering the request (the
+    same window quantization as the N-body refinement, so pack and
+    window line up exactly and the key never depends on load order).
+
+    The key fingerprints everything the coefficients depend on: the
+    window, the body/record/coefficient layout, the N-body configuration
+    (knobs + integrator tolerances + GM table) and probe positions of
+    the analytic theory itself. A warm cache therefore serves the pack
+    without ever CONSTRUCTING the N-body window — the ~70 s integration
+    is paid once per (source, span).
+    """
+    import hashlib
+
+    from pint_tpu.astro.ephemeris import quantize_nbody_window
+    from pint_tpu.astro.nbody import _ATOL, _BODIES, _GMS, _RTOL
+
+    T = np.asarray(tdb_jcent, np.float64)
+    t0_q, span_yr = quantize_nbody_window(float(np.min(T)), float(np.max(T)))
+    nbody_on = knobs.get("PINT_TPU_NBODY") != "0"
+    # the content key needs probe evaluations of the analytic theory
+    # (~15 series calls); the theory is immutable within a process, so
+    # memoize per (window, config) on the instance — a warm serve must
+    # not pay the probes on every query
+    memo = getattr(eph, "_pack_key_memo", None)
+    if memo is None:
+        memo = eph._pack_key_memo = {}
+    mkey = (round(t0_q, 10), span_yr, nbody_on,
+            knobs.get("PINT_TPU_NBODY_COMB"))
+    key = memo.get(mkey)
+    if key is None:
+        probe = np.concatenate([
+            np.asarray(eph.pos_ssb(
+                b, np.array([t0_q - 0.05, t0_q, t0_q + 0.05]))).ravel()
+            for b in ("earth", "moon", "jupiter", "uranus", "neptune")
+        ]).round(3)
+        key_src = repr((
+            PACK_VERSION, round(t0_q, 10), span_yr, _DEFAULT_BODIES,
+            sorted(_RECORD_DAYS.items()), _NCOEF_FIT, nbody_on,
+            knobs.get("PINT_TPU_NBODY_COMB"), _BODIES, _GMS.tobytes(),
+            _RTOL, _ATOL, probe.tobytes(),
+        ))
+        key = memo[mkey] = (
+            f"v{PACK_VERSION}-analytic-"
+            f"{hashlib.sha256(key_src.encode()).hexdigest()[:24]}")
+
+    def build():
+        half_mjd = span_yr * 365.25 / 2.0
+        mid_mjd = t0_q * 36525.0 + 51544.5
+        if nbody_on:
+            nb = eph._nbody_window(t0_q, span_yr)
+
+            def pos_m_many(bodies, T):
+                return {b: nb.posvel(b, T)[0] for b in bodies}
+        else:
+            def pos_m_many(bodies, T):
+                return eph.pos_ssb_many(bodies, T)
+        return pack_from_source(
+            eph, mid_mjd - half_mjd, mid_mjd + half_mjd,
+            pos_m_many=pos_m_many,
+            source=f"analytic-nb{int(nbody_on)}",
+        )
+
+    return cached_pack(key, build)
+
+
+# --- serving class ---------------------------------------------------------------
+
+
+class KernelEphemeris:
+    """Pack-backed ephemeris with the SPKEphemeris/AnalyticEphemeris
+    surface (``posvel_ssb`` / ``pos_ssb`` in meters, ICRS, wrt SSB).
+
+    Host evaluation is the vectorized numpy gather+polyval; the fused
+    device program (astro/device_prepare.py ``kernel_posvel_device``)
+    serves the same arithmetic with ``xp=jnp`` when device prepare is
+    engaged. Out-of-coverage epochs raise like the host SPK reader does
+    (a Chebyshev record evaluated outside [-1, 1] diverges silently).
+    """
+
+    def __init__(self, pack: KernelPack):
+        self.pack = pack
+        self.name = f"kernelpack:{pack.source}"
+
+    def _check_coverage(self, body: str, et: np.ndarray) -> None:
+        if not self.pack.covers(body, et):
+            lo, hi = self.pack.span_et(body)
+            day = DAY_S
+            raise ValueError(
+                f"epoch range [{float(np.min(et)) / day + 51544.5:.1f}, "
+                f"{float(np.max(et)) / day + 51544.5:.1f}] MJD outside "
+                f"kernel pack coverage [{lo / day + 51544.5:.1f}, "
+                f"{hi / day + 51544.5:.1f}] for body {body!r}")
+
+    def posvel_ssb(self, body: str, tdb_jcent, dt_s: float = 0.0):
+        # the same two-step jcent->ET conversion as the host SPK reader
+        # (astro/spk.py): a precomputed-product constant rounds epochs
+        # differently by ~5e-8 s, which is ~2 mm of EMB motion — enough
+        # to break the golden <=1 mm pack ≡ reader parity bound
+        et = (np.atleast_1d(np.asarray(tdb_jcent, np.float64))
+              * 36525.0 * 86400.0)
+        self._check_coverage(body, et)
+        return eval_posvel(self.pack, body, et)
+
+    def pos_ssb(self, body: str, tdb_jcent) -> np.ndarray:
+        return self.posvel_ssb(body, tdb_jcent)[0]
+
+
+def measured_fallback_bound_us(pack: KernelPack, analytic_eph,
+                               n_probe: int = 64) -> float | None:
+    """Measured Earth-position error bound [µs of light travel] of the
+    ANALYTIC ephemeris against a kernel pack, over the pack's span.
+
+    Replaces the static conservative ~200 µs bound on the
+    ``ephemeris.analytic_fallback`` ledger event whenever a pack built
+    from the unavailable kernel is still cached: the event then carries
+    what the fallback actually costs THIS configuration.
+    """
+    try:
+        lo, hi = pack.span_et("earth")
+        et = np.linspace(lo + 1.0, hi - 1.0, n_probe)
+        p_pack, _ = eval_posvel(pack, "earth", et)
+        # the PURE analytic series (no N-body window, no pack recursion):
+        # a bound measurement must never trigger a ~70 s integration, and
+        # the series-only diff upper-bounds what the refined fallback
+        # actually serves
+        fn = getattr(analytic_eph, "_posvel_analytic",
+                     analytic_eph.posvel_ssb)
+        p_ana = fn("earth", et / CENT_S)[0]
+        d = np.max(np.linalg.norm(p_pack - p_ana, axis=-1))
+        return float(d / C_M_S * 1e6)
+    except Exception as e:  # noqa: BLE001  # jaxlint: disable=silent-except — a diagnostic bound measurement; the static bound stands in
+        log.warning(f"measured fallback bound failed: {e}")
+        return None

@@ -76,6 +76,13 @@ CENT_S = 36525.0 * DAY_S
 _BODIES = ("sun", "mercury", "venus", "earth", "moon", "mars",
            "jupiter", "saturn", "uranus", "neptune")
 
+#: DOP853 integrator tolerances — part of the solution's identity, so
+#: they join the disk-cache key (a tolerance change must never serve a
+#: stale trajectory) and the kernel-pack fingerprint
+#: (astro/kernel_ephemeris.pack_for_analytic).
+_RTOL = 1e-11
+_ATOL = 1e-3
+
 
 def _gm(body: str) -> float:
     return GM_SUN if body == "sun" else GM_BODY[body]
@@ -154,8 +161,9 @@ class NBodyEphemeris:
     #: bump when the integration/refinement algorithm changes — invalidates
     #: every cached solution on disk. History: 9 = Uranus/Neptune VSOP87D
     #: series in the force model; 10 = half-integer comb experiment
-    #: (superseded); 11 = sextic drift polynomial, comb off by default.
-    _CACHE_VERSION = 11
+    #: (superseded); 11 = sextic drift polynomial, comb off by default;
+    #: 12 = integrator tolerances join the key explicitly.
+    _CACHE_VERSION = 12
 
     def __init__(self, base, t0_jcent: float, span_years: float = 16.0,
                  grid_days: float = 0.5, refine_iters: int = 3):
@@ -196,6 +204,7 @@ class NBodyEphemeris:
             repr((
                 self._CACHE_VERSION, round(self.t0, 10), round(self.half_span_s, 3),
                 self.grid_days, refine_iters, _BODIES, _GMS.tobytes(),
+                _RTOL, _ATOL,
                 self._earth_periods(), _ANCHOR_PERIODS_M,
                 type(self.base).__name__, probe.tobytes(),
             )).encode()
@@ -203,8 +212,15 @@ class NBodyEphemeris:
         return os.path.join(root, "nbody", f"{key}.npz")
 
     def _load_cached(self, refine_iters: int) -> bool:
+        from pint_tpu.ops import perf
+
         path = self._cache_path(refine_iters)
         if path is None or not os.path.exists(path):
+            # a disabled cache is not a miss; an absent entry is — the
+            # prepare breakdown surfaces the counters so a flagship run
+            # can say whether the ~70 s window build was paid or served
+            if path is not None:
+                perf.add("nbody_cache_misses")
             return False
         try:
             with np.load(path) as z:
@@ -217,7 +233,9 @@ class NBodyEphemeris:
                 self._periods_m = tuple(z["periods_m"])
         except Exception as e:  # corrupt/stale file: rebuild  # jaxlint: disable=silent-except — corrupt N-body cache is rebuilt from scratch — full recovery, no accuracy loss
             log.warning(f"nbody cache read failed ({e}); rebuilding")
+            perf.add("nbody_cache_misses")
             return False
+        perf.add("nbody_cache_hits")
         log.info(f"nbody ephemeris loaded from cache: {path}")
         return True
 
@@ -253,7 +271,7 @@ class NBodyEphemeris:
             order = np.argsort(sign * ts)
             sol = solve_ivp(
                 _rhs, (0.0, sign * self.half_span_s), y0,
-                method="DOP853", rtol=1e-11, atol=1e-3,
+                method="DOP853", rtol=_RTOL, atol=_ATOL,
                 t_eval=ts[order],
                 dense_output=False,
             )

@@ -67,6 +67,18 @@ class SPKSegment:
         else:
             raise NotImplementedError(f"SPK data type {dtype} not supported")
 
+    def records(self) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """Raw Chebyshev position records: ``(mid (n,), radius (n,),
+        coef (n, 3, ncoef))`` in km — the tensor-pack compiler
+        (astro/kernel_ephemeris.py) lifts these verbatim, so pack
+        evaluation is the same polynomial this reader evaluates."""
+        words = self.daf.read_doubles(self.ia, self.n * self.rsize)
+        recs = np.asarray(words).reshape(self.n, self.rsize)
+        mid = recs[:, 0].copy()
+        radius = recs[:, 1].copy()
+        coef = recs[:, 2:].reshape(self.n, self.ncomp, self.ncoef)[:, :3, :]
+        return mid, radius, coef.copy()
+
     def posvel(self, et: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
         """(pos[m], vel[m/s]) of target wrt center at TDB sec past J2000."""
         et = np.atleast_1d(np.asarray(et, np.float64))

@@ -31,7 +31,7 @@ log = get_logger("pint_tpu.spk")
 
 J2000_JCENT_S = 36525.0 * 86400.0
 
-__all__ = ["write_spk_type2", "export_spk"]
+__all__ = ["write_spk_type2", "export_spk", "chebyshev_fit_records"]
 
 
 _CGL_CACHE: dict = {}
@@ -47,6 +47,26 @@ def _cgl_nodes(ncoef: int) -> np.ndarray:
         V = np.polynomial.chebyshev.chebvander(tau, ncoef - 1)
         _CGL_CACHE[ncoef] = (tau, np.linalg.inv(V))
     return _CGL_CACHE[ncoef]
+
+
+def chebyshev_fit_records(pos_fn, t0: float, t1: float, intlen: float,
+                          ncoef: int) -> tuple[np.ndarray, np.ndarray]:
+    """Near-minimax Chebyshev records of a sampled trajectory:
+    ``(mids (n,), coef (n, 3, ncoef))`` over uniform records of length
+    ``intlen`` covering [t0, t1].
+
+    Every record's CGL node epochs go to ``pos_fn`` in ONE flat call
+    (windowed ephemeris backends see the whole request at once), and
+    every record's coefficients come from one matmul. Shared by the SPK
+    writer below and the tensor-pack compiler
+    (astro/kernel_ephemeris.py)."""
+    n = int(np.ceil((t1 - t0) / intlen - 1e-9))
+    radius = intlen / 2.0
+    mids = t0 + intlen * (np.arange(n) + 0.5)
+    tau, vinv = _cgl_nodes(ncoef)
+    et_nodes = (mids[:, None] + radius * tau[None, :]).ravel()
+    xyz = np.asarray(pos_fn(et_nodes)).reshape(n, ncoef, 3)
+    return mids, np.einsum("ij,njc->nci", vinv, xyz)  # (n, 3, ncoef)
 
 
 def write_spk_type2(path: str, segments, comment: str = "pint_tpu export") -> None:
@@ -81,15 +101,11 @@ def write_spk_type2(path: str, segments, comment: str = "pint_tpu export") -> No
     payload = bytearray()
     for target, center, t0, t1, intlen, ncoef, pos_km_fn in segments:
         rsize = 2 + 3 * ncoef
-        n = int(np.ceil((t1 - t0) / intlen - 1e-9))
         radius = intlen / 2.0
-        mids = t0 + intlen * (np.arange(n) + 0.5)
         # every record's CGL nodes in one flat evaluation, then every
         # record's coefficients in one matmul (near-minimax interpolation)
-        tau, vinv = _cgl_nodes(ncoef)
-        et_nodes = (mids[:, None] + radius * tau[None, :]).ravel()
-        xyz = np.asarray(pos_km_fn(et_nodes)).reshape(n, ncoef, 3)
-        chs = np.einsum("ij,njc->nci", vinv, xyz)  # (n, 3, ncoef)
+        mids, chs = chebyshev_fit_records(pos_km_fn, t0, t1, intlen, ncoef)
+        n = mids.size
         ia = word
         for k in range(n):
             rec = np.concatenate([[mids[k], radius], chs[k].ravel()])

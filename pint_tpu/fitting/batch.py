@@ -451,6 +451,12 @@ class BatchedFitter:
 
         t0 = time.perf_counter()
         groups, sequential = self._assembled()
+        # serve-path provenance, like the single fused fit: the fleet's
+        # breakdown names the ephemeris that prepared its columns
+        if self.fitters:
+            perf.put_default(
+                "ephemeris_source",
+                getattr(self.fitters[0].toas, "ephem", None))
         results: list = [None] * len(self.fitters)
         occupancy: dict[str, int] = {}
         total_rows = 0

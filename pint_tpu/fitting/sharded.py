@@ -689,6 +689,11 @@ def run_fused_fit(fitter, maxiter: int, required_gain: float,
 
     model = fitter.model
     kind = fitter._fused_kind
+    # serve-path provenance: the parity headline is ephemeris-dominated,
+    # so every fit breakdown names the ephemeris that prepared the
+    # columns it consumed (analytic | kernelpack:... | spk:...)
+    perf.put_default("ephemeris_source",
+                     getattr(fitter.toas, "ephem", None))
     data, specs = fitter._fused_data()
     entry = get_fused_fit_fn(model, kind, fitter._free,
                              _subtract_mean_of(fitter), fitter.mesh,

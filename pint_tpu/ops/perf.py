@@ -213,8 +213,17 @@ def prepare_breakdown(rep: PerfReport) -> dict:
     wall = 0.0
     comp = {leaf: 0.0 for leaf in _PREPARE_COMPONENTS}
     direct = 0.0
+    kernel_build = 0.0
+    kb_in_ephemeris = 0.0
     for path, (total, _count) in rep.timings.items():
         segs = path.split("/")
+        # kernel-pack builds (astro/kernel_ephemeris.cached_pack) nest
+        # inside whatever serve triggered them — name them wherever they
+        # are so the pack-build cost is attributable on its own
+        if segs[-1] == "kernel_build":
+            kernel_build += total
+            if "ephemeris" in segs:
+                kb_in_ephemeris += total
         if "prepare" not in segs:
             continue
         i = segs.index("prepare")
@@ -233,8 +242,23 @@ def prepare_breakdown(rep: PerfReport) -> dict:
         rep.counters.get("prepare_cache_misses", 0))
     out["nbody_window_builds"] = int(
         rep.counters.get("nbody_window_builds", 0))
+    out["nbody_cache_hits"] = int(rep.counters.get("nbody_cache_hits", 0))
+    out["nbody_cache_misses"] = int(
+        rep.counters.get("nbody_cache_misses", 0))
     out["prepare_device_programs"] = int(
         rep.counters.get("prepare_device_programs", 0))
+    # kernel-pack telemetry (astro/kernel_ephemeris.py): build wall,
+    # cache traffic, and the per-TOA ephemeris serve cost with the
+    # one-time pack build excluded (the number a capacity plan needs)
+    out["prepare_kernel_build_s"] = round(kernel_build, 4)
+    out["kernel_pack_cache_hits"] = int(
+        rep.counters.get("kernel_pack_cache_hits", 0))
+    out["kernel_pack_cache_misses"] = int(
+        rep.counters.get("kernel_pack_cache_misses", 0))
+    serve_toas = rep.counters.get("ephemeris_serve_toas", 0)
+    serve_s = max(comp["ephemeris"] - kb_in_ephemeris, 0.0)
+    out["ephemeris_serve_us_per_toa"] = (
+        round(serve_s / serve_toas * 1e6, 3) if serve_toas else None)
     return out
 
 
@@ -364,6 +388,10 @@ def fit_breakdown(rep: PerfReport) -> dict:
         # snapshot came from ("caller" | a state-file path)
         "warm_start": bool(rep.values.get("warm_start", False)),
         "warm_start_source": rep.values.get("warm_start_source"),
+        # which ephemeris served the prepared columns this fit consumed
+        # ("analytic" | "kernelpack:..." | "spk:..."): the parity headline
+        # is ephemeris-dominated, so a fit result names its source
+        "ephemeris_source": rep.values.get("ephemeris_source"),
     }
     # prepare work that ran INSIDE the fit (e.g. a TZR re-prepare in a
     # tensor rebuild) — usually zero; the bench's time-to-first-point

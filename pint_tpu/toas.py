@@ -388,11 +388,16 @@ def prepare_config_fingerprint(ephem) -> str:
     if spk and os.path.exists(spk):
         spk = f"{spk}@{os.path.getmtime(spk):.0f}"
     nbody = knobs.get("PINT_TPU_NBODY")
+    # the kernel-pack path (astro/kernel_ephemeris.py) changes served
+    # columns at the (tiny) Chebyshev-fit level for the forced analytic
+    # snapshot, so the knob joins the key like every other serve switch
+    kern = knobs.get("PINT_TPU_KERNEL_EPHEM")
     eop = knobs.get("PINT_TPU_EOP") or ""
     if eop and os.path.exists(eop):
         eop = f"{eop}@{os.path.getmtime(eop):.0f}"
     clk = clockmod.clock_state_fingerprint()
-    return f"v{_TOA_CACHE_VERSION}-{ephem}-{spk}-nb{nbody}-eop{eop}-clk{clk}"
+    return (f"v{_TOA_CACHE_VERSION}-{ephem}-{spk}-nb{nbody}-ke{kern}"
+            f"-eop{eop}-clk{clk}")
 
 
 # --- prepared-column content cache ------------------------------------------------
@@ -768,6 +773,7 @@ def prepare_arrays(
 
         # 4. ephemeris: Earth & Sun & planets wrt SSB at (geocentric) TDB
         with perf.stage("ephemeris"):
+            perf.add("ephemeris_serve_toas", n)
             eph = (get_ephemeris() if ephem in ("auto", "analytic", None)
                    else get_ephemeris(ephem))
             # TDB for ephemeris lookup: geocentric series is plenty (us-level

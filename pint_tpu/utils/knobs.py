@@ -41,6 +41,9 @@ KNOBS: dict[str, tuple[str | None, str]] = {
     "PINT_TPU_AUDIT_CONST_BYTES": ("262144", "large-constant-capture audit threshold in bytes"),
     # --- ephemeris / astrometry chain ------------------------------------------
     "PINT_TPU_EPHEM": (None, "path to a JPL SPK kernel; unset = analytic ephemeris"),
+    "PINT_TPU_KERNEL_EPHEM": ("auto", "Chebyshev kernel-pack serving: auto (pack a configured SPK kernel), 1 (also snapshot the analytic/N-body path), 0 (off)"),
+    "PINT_TPU_KERNEL_EPHEM_CACHE": ("1", "0: disable the kernel-pack disk cache (packs rebuild per process)"),
+    "PINT_TPU_KERNEL_EPHEM_KEEP": ("8", "kernel-pack cache entries kept (oldest pruned)"),
     "PINT_TPU_NBODY": ("1", "0: disable the N-body ephemeris refinement"),
     "PINT_TPU_NBODY_CACHE": ("1", "0: disable the N-body solution disk cache"),
     "PINT_TPU_NBODY_COMB": ("0", "1: add the comb anchor periods to the N-body band design"),

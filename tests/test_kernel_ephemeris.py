@@ -1,0 +1,525 @@
+"""Chebyshev kernel-ephemeris contracts (astro/kernel_ephemeris.py).
+
+Four halves (ISSUE 7 golden parity suite + CI satellites):
+
+- **Golden parity**: pack evaluation of the CHECKED-IN mini-SPK
+  (tests/data/mini_de.bsp, written by astro/spk_write.py) against the
+  host reader (astro/spk.py) at <= 1 mm — the pack lifts the raw records
+  verbatim, so any drift is an evaluation bug, not an accuracy tradeoff.
+- **Pack integrity**: write -> load -> eval bitwise-stable; ragged
+  per-body padding proven weight-zero (pad records NaN-poisoned without
+  changing a single output bit).
+- **Serving integration**: get_ephemeris wraps a configured SPK kernel
+  in a pack; the forced analytic snapshot matches the direct refined
+  path at the Chebyshev-fit level; the fused ``prepare_kernel_eval``
+  device program matches the host eval within the device-prepare parity
+  contract and lowers strict-audit-clean.
+- **Cache discipline**: content-key hit/miss counters, corrupt entries
+  quarantined through the ``fetch.corrupt_quarantined`` ledger event,
+  bounded retention, and the measured (not static) analytic-fallback
+  error bound when a pack survives its source kernel.
+"""
+
+import os
+import shutil
+
+import numpy as np
+import pytest
+
+from pint_tpu.astro import kernel_ephemeris as ke
+from pint_tpu.ops import perf
+from pint_tpu.ops.degrade import events, reset_ledger
+
+MINI_SPK = os.path.join(os.path.dirname(__file__), "data", "mini_de.bsp")
+CENT_S = 36525.0 * 86400.0
+
+#: epochs safely inside the mini kernel's 55000-55120 MJD span
+T_PROBE = (np.linspace(55001.0, 55119.0, 160) - 51544.5) / 36525.0
+
+
+@pytest.fixture(autouse=True)
+def _isolated_cache(tmp_path, monkeypatch):
+    monkeypatch.setenv("PINT_TPU_CACHE_DIR", str(tmp_path))
+    monkeypatch.setenv("PINT_TPU_NBODY", "0")
+    ke.clear_memory_cache()
+    yield
+    ke.clear_memory_cache()
+
+
+class TestGoldenParity:
+    """Pack eval ≡ host SPK reader on the checked-in mini kernel."""
+
+    POS_TOL_M = 1e-3   # 1 mm — the ISSUE 7 acceptance bound
+    VEL_TOL_MS = 1e-7
+
+    def test_pack_matches_host_reader(self):
+        from pint_tpu.astro.spk import SPKEphemeris
+
+        eph = SPKEphemeris(MINI_SPK)
+        pack = ke.pack_from_spk(MINI_SPK)
+        assert set(pack.bodies) == {"sun", "emb", "earth", "moon",
+                                    "jupiter"}
+        # the DE layout survives compilation: earth/moon chain through EMB
+        assert pack.centers[pack.row("earth")] == "emb"
+        # the reader's own two-step jcent->ET conversion, so the parity
+        # comparison probes evaluation, not epoch rounding
+        et = T_PROBE * 36525.0 * 86400.0
+        for body in pack.bodies:
+            p0, v0 = eph.posvel_ssb(body, T_PROBE)
+            p1, v1 = ke.eval_posvel(pack, body, et)
+            dp = np.max(np.abs(p0 - p1))
+            dv = np.max(np.abs(v0 - v1))
+            assert dp < self.POS_TOL_M, (body, dp)
+            assert dv < self.VEL_TOL_MS, (body, dv)
+
+    def test_record_boundaries(self):
+        """Epochs exactly on record boundaries gather a valid record."""
+        from pint_tpu.astro.spk import SPKEphemeris
+
+        eph = SPKEphemeris(MINI_SPK)
+        pack = ke.pack_from_spk(MINI_SPK)
+        i = pack.row("emb")
+        edges = pack.init[i] + pack.intlen[i] * np.arange(
+            0, int(pack.nrec[i]) + 1)
+        edges = np.clip(edges, *pack.span_et("emb"))
+        T_edges = edges / CENT_S
+        p0, _ = eph.posvel_ssb("emb", T_edges)
+        # same two-step conversion as the reader (see the parity test)
+        p1, _ = ke.eval_posvel(pack, "emb", T_edges * 36525.0 * 86400.0)
+        assert np.max(np.abs(p0 - p1)) < self.POS_TOL_M
+
+    def test_out_of_coverage_raises(self):
+        pack = ke.pack_from_spk(MINI_SPK)
+        eph = ke.KernelEphemeris(pack)
+        with pytest.raises(ValueError, match="coverage"):
+            eph.pos_ssb("emb", np.array([(55300.0 - 51544.5) / 36525.0]))
+
+
+class TestPackIntegrity:
+    def test_roundtrip_bitwise(self, tmp_path):
+        pack = ke.pack_from_spk(MINI_SPK)
+        path = str(tmp_path / "p.npz")
+        ke.save_pack(path, pack, key="full-key")
+        pack2, key = ke.load_pack(path)
+        assert key == "full-key"
+        for f in ("coef", "mid", "init", "intlen", "nrec"):
+            np.testing.assert_array_equal(getattr(pack, f),
+                                          getattr(pack2, f))
+        assert pack2.bodies == pack.bodies
+        assert pack2.centers == pack.centers
+        et = T_PROBE * CENT_S
+        for body in pack.bodies:
+            pa, va = ke.eval_posvel(pack, body, et)
+            pb, vb = ke.eval_posvel(pack2, body, et)
+            np.testing.assert_array_equal(pa, pb)
+            np.testing.assert_array_equal(va, vb)
+
+    def test_ragged_padding_is_weight_zero(self):
+        """The mini kernel is genuinely ragged (4/8/16-day records): pad
+        records beyond each body's nrec must NEVER be gathered — NaN
+        poison there must not change one output bit — and pad
+        COEFFICIENT slots must contribute exactly zero."""
+        from dataclasses import replace
+
+        pack = ke.pack_from_spk(MINI_SPK)
+        assert len(set(int(n) for n in pack.nrec)) > 1, "not ragged"
+        et = T_PROBE * CENT_S
+        base = {b: ke.eval_posvel(pack, b, et) for b in pack.bodies}
+        coef = pack.coef.copy()
+        mid = pack.mid.copy()
+        for i in range(len(pack.bodies)):
+            coef[i, int(pack.nrec[i]):, :, :] = np.nan
+            mid[i, int(pack.nrec[i]):] = np.nan
+        poisoned = replace(pack, coef=coef, mid=mid)
+        for b in pack.bodies:
+            pp, vp = ke.eval_posvel(poisoned, b, et)
+            np.testing.assert_array_equal(base[b][0], pp)
+            np.testing.assert_array_equal(base[b][1], vp)
+        # widen the coefficient axis with zero pads: the recurrence is
+        # bit-identical only up to rounding — assert exact zero effect
+        # on the polynomial by checking against a tight bound
+        wide = np.zeros(pack.coef.shape[:2] + (pack.coef.shape[2] + 4, 3))
+        wide[:, :, : pack.coef.shape[2], :] = pack.coef
+        widened = replace(pack, coef=wide)
+        for b in pack.bodies:
+            pw, _ = ke.eval_posvel(widened, b, et)
+            assert np.max(np.abs(base[b][0] - pw)) < 1e-6
+
+
+class TestDeviceProgram:
+    def test_device_matches_host(self, monkeypatch):
+        """The fused prepare_kernel_eval program ≡ host numpy eval within
+        the device-prepare parity contract (identical formulas, jnp vs
+        numpy reductions)."""
+        from pint_tpu.astro import device_prepare
+
+        monkeypatch.setenv("PINT_TPU_DEVICE_PREPARE", "1")
+        pack = ke.pack_from_spk(MINI_SPK)
+        out = device_prepare.kernel_posvel_device(
+            pack, ("earth", "sun", "jupiter"), T_PROBE)
+        assert out is not None
+        for b, (p_dev, v_dev) in out.items():
+            p_host, v_host = ke.eval_posvel(pack, b,
+                                            T_PROBE * 36525.0 * 86400.0)
+            assert np.max(np.abs(p_dev - p_host)) < 0.05, b
+            assert np.max(np.abs(v_dev - v_host)) < 1e-3, b
+
+    def test_program_is_strict_audit_clean(self, monkeypatch):
+        """The kernel-eval program lowers with zero violations under
+        PINT_TPU_AUDIT=strict: no host sync (prepare-sync pass), pack
+        tensors as arguments (large-const pass), canonical operands."""
+        from pint_tpu.analysis.jaxpr_audit import audit_block
+        from pint_tpu.analysis.jaxpr_audit import reset_ledger as reset_audit
+        from pint_tpu.astro import device_prepare
+
+        monkeypatch.setenv("PINT_TPU_AUDIT", "strict")
+        monkeypatch.setenv("PINT_TPU_DEVICE_PREPARE", "1")
+        device_prepare._programs.clear()
+        reset_audit()
+        try:
+            pack = ke.pack_from_spk(MINI_SPK)
+            with perf.collect():  # collecting => TimedProgram audits
+                device_prepare.kernel_posvel_device(
+                    pack, ("earth", "sun"), T_PROBE)
+            blk = audit_block()
+            assert blk["violations"] == []
+            assert "prepare_kernel_eval" in blk["signatures"]
+        finally:
+            device_prepare._programs.clear()
+            reset_audit()
+
+    def test_out_of_coverage_returns_none(self, monkeypatch):
+        """The device path hands out-of-coverage requests back to the
+        host path (which raises the informative error)."""
+        from pint_tpu.astro import device_prepare
+
+        monkeypatch.setenv("PINT_TPU_DEVICE_PREPARE", "1")
+        pack = ke.pack_from_spk(MINI_SPK)
+        T_far = np.array([(55300.0 - 51544.5) / 36525.0])
+        assert device_prepare.kernel_posvel_device(
+            pack, ("earth",), T_far) is None
+
+
+class TestPackCache:
+    def test_miss_then_hit(self):
+        with perf.collect() as rep:
+            ke.pack_for_spk_file(MINI_SPK)
+        assert rep.counters.get("kernel_pack_cache_misses") == 1
+        ke.clear_memory_cache()  # force the disk path
+        with perf.collect() as rep2:
+            ke.pack_for_spk_file(MINI_SPK)
+        assert rep2.counters.get("kernel_pack_cache_hits") == 1
+        assert "kernel_pack_cache_misses" not in rep2.counters
+
+    def test_build_is_staged(self):
+        """The pack build runs under the kernel_build stage so the ttfp
+        attribution can name it (prepare_kernel_build_s)."""
+        with perf.collect() as rep:
+            ke.pack_for_spk_file(MINI_SPK)
+        assert rep.count("kernel_build") == 1
+
+    def test_corrupt_entry_quarantined(self):
+        reset_ledger()
+        ke.pack_for_spk_file(MINI_SPK)
+        ke.clear_memory_cache()
+        entries = list(ke._pack_cache_dir().glob("pack-*.npz"))
+        assert len(entries) == 1
+        entries[0].write_bytes(b"not an npz")
+        with perf.collect() as rep:
+            pack = ke.pack_for_spk_file(MINI_SPK)  # recovers by rebuild
+        assert rep.counters.get("kernel_pack_cache_misses") == 1
+        # the corrupt file moved BESIDE the cache, never silently deleted
+        q = list((ke._pack_cache_dir() / "quarantine").glob("pack-*.npz"))
+        assert len(q) == 1
+        evs = [e for e in events()
+               if e.kind == "fetch.corrupt_quarantined"]
+        assert len(evs) == 1 and evs[0].component == "kernel_pack"
+        # and the rebuilt pack serves
+        assert np.all(np.isfinite(
+            ke.eval_posvel(pack, "earth", T_PROBE * CENT_S)[0]))
+        reset_ledger()
+
+    def test_full_key_mismatch_is_a_miss(self, tmp_path):
+        """A filename collision with a different FULL key must rebuild,
+        never serve wrong coefficients."""
+        ke.pack_for_spk_file(MINI_SPK)
+        ke.clear_memory_cache()
+        entry = next(ke._pack_cache_dir().glob("pack-*.npz"))
+        pack, _ = ke.load_pack(str(entry))
+        ke.save_pack(str(entry), pack, key="some-other-full-key")
+        with perf.collect() as rep:
+            ke.pack_for_spk_file(MINI_SPK)
+        assert rep.counters.get("kernel_pack_cache_misses") == 1
+
+    def test_retention_prunes_oldest(self, monkeypatch, tmp_path):
+        monkeypatch.setenv("PINT_TPU_KERNEL_EPHEM_KEEP", "2")
+        for i in range(4):
+            dst = tmp_path / f"k{i}.bsp"
+            shutil.copy(MINI_SPK, dst)
+            os.utime(dst, (1000 + i, 1000 + i))
+            ke.pack_for_spk_file(str(dst))
+        assert len(list(ke._pack_cache_dir().glob("pack-*.npz"))) == 2
+
+    def test_disk_cache_opt_out(self, monkeypatch):
+        monkeypatch.setenv("PINT_TPU_KERNEL_EPHEM_CACHE", "0")
+        ke.pack_for_spk_file(MINI_SPK)
+        assert not list(ke._pack_cache_dir().glob("pack-*.npz"))
+
+
+class TestGetEphemerisIntegration:
+    def test_configured_kernel_serves_through_pack(self, monkeypatch):
+        from pint_tpu.astro.ephemeris import get_ephemeris
+        from pint_tpu.astro.spk import SPKEphemeris
+
+        monkeypatch.setenv("PINT_TPU_EPHEM", MINI_SPK)
+        eph = get_ephemeris("de440")
+        assert isinstance(eph, ke.KernelEphemeris)
+        host = SPKEphemeris(MINI_SPK)
+        p_pack, _ = eph.posvel_ssb("earth", T_PROBE)
+        p_host, _ = host.posvel_ssb("earth", T_PROBE)
+        assert np.max(np.abs(p_pack - p_host)) < 1e-3
+
+    def test_knob_zero_keeps_host_reader(self, monkeypatch):
+        from pint_tpu.astro.ephemeris import get_ephemeris
+
+        monkeypatch.setenv("PINT_TPU_EPHEM", MINI_SPK)
+        monkeypatch.setenv("PINT_TPU_KERNEL_EPHEM", "0")
+        assert type(get_ephemeris("de440")).__name__ == "SPKEphemeris"
+
+    def test_missing_kernel_measured_bound(self, monkeypatch, tmp_path):
+        """When the configured kernel vanishes but its pack survives in
+        the cache, the analytic_fallback ledger event carries the
+        MEASURED error bound, not the static 200 µs figure."""
+        from pint_tpu.astro.ephemeris import get_ephemeris
+
+        dst = tmp_path / "gone.bsp"
+        shutil.copy(MINI_SPK, dst)
+        monkeypatch.setenv("PINT_TPU_EPHEM", str(dst))
+        get_ephemeris("de440")  # builds + disk-caches the pack
+        os.unlink(dst)
+        ke.clear_memory_cache()  # survive only on disk, like a fresh process
+        reset_ledger()
+        eph = get_ephemeris("de440")
+        assert type(eph).__name__ == "AnalyticEphemeris"
+        evs = [e for e in events()
+               if e.kind == "ephemeris.analytic_fallback"]
+        assert len(evs) == 1
+        # measured: the mini kernel IS an analytic snapshot, so the
+        # measured bound is far below the static 200 µs figure
+        assert evs[0].bound_us is not None
+        assert evs[0].bound_us != 200.0
+        assert evs[0].bound_us < 1.0
+        reset_ledger()
+
+    def test_missing_kernel_static_bound_without_pack(self, monkeypatch,
+                                                      tmp_path):
+        from pint_tpu.astro.ephemeris import get_ephemeris
+
+        monkeypatch.setenv("PINT_TPU_EPHEM", str(tmp_path / "never.bsp"))
+        reset_ledger()
+        get_ephemeris("de440")
+        evs = [e for e in events()
+               if e.kind == "ephemeris.analytic_fallback"]
+        assert len(evs) == 1 and evs[0].bound_us == 200.0
+        reset_ledger()
+
+
+class TestForcedAnalyticSnapshot:
+    """PINT_TPU_KERNEL_EPHEM=1: the analytic path serves from a pack
+    snapshot of its own refined output."""
+
+    def test_matches_direct_path(self, monkeypatch):
+        from pint_tpu.astro.ephemeris import AnalyticEphemeris
+
+        eph = AnalyticEphemeris()
+        T = (np.linspace(55000.0, 55700.0, 80) - 51544.5) / 36525.0
+        p_direct, v_direct = eph.posvel_ssb("earth", T)
+        monkeypatch.setenv("PINT_TPU_KERNEL_EPHEM", "1")
+        p_pack, v_pack = eph.posvel_ssb("earth", T)
+        # Chebyshev-fit transport of the same source: cm-level positions
+        # (~0.1 ns of light travel), sub-mm/s velocities
+        assert np.max(np.abs(p_pack - p_direct)) < 0.05
+        assert np.max(np.abs(v_pack - v_direct)) < 1e-3
+
+    def test_prepared_columns_match(self, monkeypatch):
+        """End-to-end: prepare_arrays columns under the forced pack path
+        match the direct path within the device-prepare parity budget."""
+        from pint_tpu.astro import time as ptime
+        from pint_tpu.toas import prepare_arrays
+
+        def _cols():
+            n = 24
+            utc = ptime.MJDEpoch.from_mjd_float(
+                np.linspace(55000.0, 55700.0, n))
+            return prepare_arrays(utc, np.ones(n), np.full(n, 1400.0),
+                                  np.array(["gbt"] * n),
+                                  planets=True)
+
+        direct = _cols()
+        monkeypatch.setenv("PINT_TPU_KERNEL_EPHEM", "1")
+        packed = _cols()
+        for f in ("ssb_obs_pos_m", "obs_sun_pos_m"):
+            d = np.max(np.abs(getattr(direct, f) - getattr(packed, f)))
+            assert d < 0.05, (f, d)
+        dv = np.max(np.abs(direct.ssb_obs_vel_m_s - packed.ssb_obs_vel_m_s))
+        assert dv < 1e-3
+        for p, a in direct.planet_pos_m.items():
+            assert np.max(np.abs(a - packed.planet_pos_m[p])) < 0.1, p
+
+    def test_fingerprint_tracks_knob(self, monkeypatch):
+        from pint_tpu.toas import prepare_config_fingerprint
+
+        base = prepare_config_fingerprint("auto")
+        monkeypatch.setenv("PINT_TPU_KERNEL_EPHEM", "1")
+        assert prepare_config_fingerprint("auto") != base
+
+    def test_serve_telemetry(self, monkeypatch):
+        """The prepare breakdown names the pack build and reports the
+        per-TOA serve cost with the build excluded."""
+        from pint_tpu.astro import time as ptime
+        from pint_tpu.ops.perf import prepare_breakdown
+        from pint_tpu.toas import prepare_arrays
+
+        monkeypatch.setenv("PINT_TPU_KERNEL_EPHEM", "1")
+        n = 48
+        utc = ptime.MJDEpoch.from_mjd_float(np.linspace(55000.0, 55700.0, n))
+        with perf.collect() as rep:
+            prepare_arrays(utc, np.ones(n), np.full(n, 1400.0),
+                           np.array(["gbt"] * n))
+        bd = prepare_breakdown(rep)
+        assert bd["kernel_pack_cache_misses"] == 1  # cold build, named
+        assert bd["prepare_kernel_build_s"] > 0
+        assert bd["ephemeris_serve_us_per_toa"] is not None
+        # serve cost excludes the one-time build
+        assert (bd["ephemeris_serve_us_per_toa"] * n * 1e-6
+                < bd["prepare_ephemeris_s"] + 0.01)
+        # warm: pure serve, no build
+        with perf.collect() as rep2:
+            prepare_arrays(utc, np.ones(n), np.full(n, 1400.0),
+                           np.array(["gbt"] * n))
+        bd2 = prepare_breakdown(rep2)
+        assert bd2["kernel_pack_cache_hits"] >= 1
+        assert bd2["prepare_kernel_build_s"] == 0.0
+
+
+TIME_GBT = """# time_gbt.dat
+ 50000.0 0.0
+ 60000.0 0.0
+"""
+GPS2UTC = """# gps2utc.clk
+ 50000.0 0.0
+ 60000.0 0.0
+"""
+
+
+class TestKernelSmokeContracts:
+    """ISSUE 7 CI satellite: both smoke benches with the kernel path
+    FORCED on run strict-audit-clean with an empty degradation ledger."""
+
+    def _clock_dir(self, tmp_path):
+        d = tmp_path / "clk"
+        d.mkdir(parents=True, exist_ok=True)
+        (d / "time_gbt.dat").write_text(TIME_GBT)
+        (d / "gps2utc.clk").write_text(GPS2UTC)
+        return d
+
+    def test_smoke_bench_kernel_forced_clean(self, tmp_path, monkeypatch):
+        import bench
+        from pint_tpu.analysis.jaxpr_audit import reset_ledger as reset_audit
+        from pint_tpu.ops import degrade
+
+        monkeypatch.setenv("PINT_CLOCK_OVERRIDE",
+                           str(self._clock_dir(tmp_path)))
+        monkeypatch.setenv("PINT_TPU_DEGRADED", "error")
+        monkeypatch.setenv("PINT_TPU_AUDIT", "strict")
+        monkeypatch.setenv("PINT_TPU_KERNEL_EPHEM", "1")
+        degrade.reset_ledger()
+        reset_audit()
+        rec = bench.smoke_bench(ntoas=120, maxiter=2)
+        assert rec["degradation_count"] == 0
+        assert rec["audit"]["n_violations"] == 0, rec["audit"]
+        assert rec["aot_fallbacks"] == 0
+
+    def test_flagship_smoke_kernel_warm_cache(self, tmp_path, monkeypatch):
+        """The flagship acceptance shape at tier-1 budget: with a WARM
+        kernel-pack cache the window-build stage collapses to a cache
+        hit (<1 s attributed) while the ttfp attribution still names
+        >= 90%."""
+        import bench
+        from pint_tpu.ops import degrade
+
+        monkeypatch.setenv("PINT_CLOCK_OVERRIDE",
+                           str(self._clock_dir(tmp_path)))
+        monkeypatch.setenv("PINT_TPU_DEGRADED", "error")
+        degrade.reset_ledger()
+        bench.smoke_flagship_bench(ntoas=600, maxiter=4)   # cold: builds
+        ke.clear_memory_cache()  # a fresh process keeps only the disk pack
+        rec = bench.smoke_flagship_bench(ntoas=600, maxiter=4)
+        assert rec["kernel_pack_cache_hit"] is True, rec
+        assert rec["kernel_pack_build_s"] < 1.0
+        bd = rec["ttfp_breakdown"]
+        # >= 90% named with a 0.3 s absolute allowance: this warm span is
+        # a few seconds, where one GC pause flips the ratio — the strict
+        # ratio contract binds in test_perf on the longer cold span
+        assert (bd["attributed_s"]
+                >= 0.9 * bd["time_to_first_point_s"] - 0.3), bd
+        # the N-body window build never ran on the warm path
+        for blk in (bd["setup_prepare"], bd["tensor_build_prepare"]):
+            assert blk["nbody_window_builds"] == 0
+        assert rec["degradation_count"] == 0
+        assert rec["ephemeris_serve_us_per_toa"] is not None
+
+
+class TestNBodyCacheSatellite:
+    """ISSUE 7 satellite: the N-body trajectory cache keys on integrator
+    tolerances and reports hit/miss counters into prepare_breakdown."""
+
+    def test_tolerances_join_the_key(self, monkeypatch):
+        from pint_tpu.astro import nbody
+        from pint_tpu.astro.ephemeris import AnalyticEphemeris
+
+        nb = nbody.NBodyEphemeris.__new__(nbody.NBodyEphemeris)
+        nb.base = AnalyticEphemeris()
+        nb.t0 = 0.1
+        nb.half_span_s = 6 * 365.25 * 86400.0
+        nb.grid_days = 0.5
+        nb._fit_idx = [nbody._BODIES.index(b) for b in ("earth", "moon")]
+        base_key = nb._cache_path(3)
+        monkeypatch.setattr(nbody, "_RTOL", 1e-9)
+        assert nb._cache_path(3) != base_key
+        monkeypatch.setattr(nbody, "_RTOL", 1e-11)
+        monkeypatch.setattr(nbody, "_ATOL", 1.0)
+        assert nb._cache_path(3) != base_key
+
+    def test_hit_miss_counters(self, monkeypatch, tmp_path):
+        """Counter contract without a real 30 s integration: stub the
+        build, drive a miss -> save -> hit cycle through the real cache
+        read/write paths."""
+        from pint_tpu.astro import nbody
+        from pint_tpu.astro.ephemeris import AnalyticEphemeris
+
+        def fake_build(self, refine_iters):
+            n = len(nbody._BODIES)
+            self.grid_s = np.linspace(-self.half_span_s,
+                                      self.half_span_s, 8)
+            self.pos = np.zeros((8, n, 3))
+            self.vel = np.zeros((8, n, 3))
+            self._periods_e = self._earth_periods()
+            self._periods_m = nbody._ANCHOR_PERIODS_M
+            self._corr_e = np.zeros((7 + 4 * len(self._periods_e), 3))
+            self._corr_m = np.zeros((7 + 4 * len(self._periods_m), 3))
+
+        monkeypatch.setattr(nbody.NBodyEphemeris, "_build", fake_build)
+        base = AnalyticEphemeris()
+        with perf.collect() as rep:
+            nbody.NBodyEphemeris(base, 0.1, span_years=1.0)
+        assert rep.counters.get("nbody_cache_misses") == 1
+        assert "nbody_cache_hits" not in rep.counters
+        with perf.collect() as rep2:
+            nbody.NBodyEphemeris(base, 0.1, span_years=1.0)
+        assert rep2.counters.get("nbody_cache_hits") == 1
+        assert "nbody_cache_misses" not in rep2.counters
+        from pint_tpu.ops.perf import prepare_breakdown
+
+        bd = prepare_breakdown(rep2)
+        assert bd["nbody_cache_hits"] == 1 and bd["nbody_cache_misses"] == 0

@@ -68,15 +68,24 @@ class TestSyntheticSPK:
         np.testing.assert_allclose(
             v, (vel_fn(t_s) + ve_fn(t_s)) * 1e3, rtol=1e-7, atol=1e-8)
 
-    def test_env_knob_loads_kernel(self, kernel, monkeypatch):
+    def test_env_knob_loads_kernel(self, kernel, monkeypatch, tmp_path):
+        """A configured kernel serves through the Chebyshev tensor pack
+        by default (astro/kernel_ephemeris.py); PINT_TPU_KERNEL_EPHEM=0
+        keeps the per-record host reader."""
         path, _, _ = kernel
+        from pint_tpu.astro import kernel_ephemeris as ke
         from pint_tpu.astro.ephemeris import get_ephemeris
 
+        monkeypatch.setenv("PINT_TPU_CACHE_DIR", str(tmp_path))
+        ke.clear_memory_cache()
         monkeypatch.setenv("PINT_TPU_EPHEM", path)
         eph = get_ephemeris("de440")
-        assert type(eph).__name__ == "SPKEphemeris"
+        assert type(eph).__name__ == "KernelEphemeris"
         p = eph.pos_ssb("emb", np.array([0.001]))
         assert np.all(np.isfinite(p))
+        monkeypatch.setenv("PINT_TPU_KERNEL_EPHEM", "0")
+        assert type(get_ephemeris("de440")).__name__ == "SPKEphemeris"
+        ke.clear_memory_cache()
 
     def test_record_selection_at_boundaries(self, kernel):
         """Epochs exactly on record boundaries and at segment edges."""
