@@ -515,6 +515,157 @@ def bench_mcmc(nsteps: int, emit) -> None:
     })
 
 
+#: noise-bench par: spin + DM + EFAC/EQUAD/ECORR masks + power-law red
+#: noise — the hyperparameter families the Bayesian noise engine samples
+NOISE_PAR = """
+PSR NOISEBENCH
+RAJ 07:40:45.79 1
+DECJ 66:20:33.6 1
+F0 346.531996493 1
+F1 -1.46389e-15 1
+PEPOCH 57000
+POSEPOCH 57000
+DM 14.96 1
+EFAC -f Rcvr1_2_GUPPI 1.1
+EQUAD -f Rcvr1_2_GUPPI 0.2
+ECORR -f Rcvr1_2_GUPPI 0.4
+TNREDAMP -12.8
+TNREDGAM 3.5
+TNREDC 10
+TZRMJD 57000.1
+TZRSITE gbt
+TZRFRQ 1400
+"""
+
+
+def _noise_dataset(ntoas: int, seed: int = 23):
+    """Correlated-noise synthetic set: sub-band epoch pairs binding the
+    ECORR masks, red noise + ECORR + white drawn from the model's own
+    covariance (what the marginalized likelihood fits)."""
+    from pint_tpu.io.par import parse_parfile
+    from pint_tpu.models.builder import build_model
+    from pint_tpu.simulation import make_fake_toas_fromMJDs
+
+    model = build_model(parse_parfile(NOISE_PAR, from_text=True))
+    n_epochs = max(ntoas // 2, 4)
+    mjds = np.repeat(np.linspace(56300.0, 57700.0, n_epochs), 2)
+    mjds[1::2] += 0.5 / 86400.0
+    freqs = np.where(np.arange(len(mjds)) % 2 == 0, 1400.0, 800.0)
+    flags = [{"f": "Rcvr1_2_GUPPI"} for _ in mjds]
+    toas = make_fake_toas_fromMJDs(
+        np.sort(mjds), model, obs="gbt", freq_mhz=np.asarray(freqs),
+        error_us=0.5, flags=flags, add_correlated_noise=True,
+        rng=np.random.default_rng(seed),
+    )
+    return model, toas
+
+
+def _noise_bench_core(ntoas: int, n_evals: int, n_chains: int, nsteps: int,
+                      warmup: int, baseline_evals: int) -> dict:
+    """The Bayesian-noise-engine bench: fused marginalized-likelihood
+    evaluations + vmapped HMC chains vs the host-loop per-eval path.
+
+    Fused side: E hyperparameter points through ONE vmapped device
+    program (fitting/noise_like.py), compile included. Baseline side: the
+    pre-engine shape — a jitted `BayesianTiming` ln-posterior (full
+    phase-model re-evaluation per point) dispatched one host call per
+    eval, exactly what an emcee-style walker loop pays — compile included
+    on both sides.
+    """
+    import copy
+
+    import jax
+    import jax.numpy as jnp
+
+    from pint_tpu.bayesian import BayesianTiming
+    from pint_tpu.fitting.noise_like import NoiseLikelihood
+    from pint_tpu.ops import perf
+
+    model, toas = _noise_dataset(ntoas)
+    rec: dict = {
+        "ntoas": len(toas),
+        "backend": jax.default_backend(),
+        "n_devices": len(jax.devices()),
+    }
+    rng = np.random.default_rng(41)
+    with perf.collect() as rep:
+        t0 = time.time()
+        nl = NoiseLikelihood(toas, copy.deepcopy(model))
+        # modest prior-scaled perturbations around the parfile values —
+        # the surface a sampler actually evaluates
+        scales = 0.02 * nl.scales
+        etas = nl.x0 + scales * rng.standard_normal((n_evals, nl.nparams))
+        nl.loglike_many(etas)
+        fused_wall = time.time() - t0
+        t0 = time.time()
+        chains = nl.sample(n_chains=n_chains, nsteps=nsteps, warmup=warmup,
+                           kernel="hmc", seed=5)
+        chain_wall = time.time() - t0
+    breakdown = perf.noise_breakdown(rep)
+
+    # the host-loop per-eval baseline (compile included): one dispatch
+    # per hyperparameter point through the full-residual posterior
+    m_b = copy.deepcopy(model)
+    m_b.set_free(list(nl.hyper))
+    bt = BayesianTiming(toas, m_b)
+    lnp = jax.jit(bt.lnpost_fn())
+    deltas = 0.3 * scales * rng.standard_normal(
+        (baseline_evals, nl.nparams))
+    t0 = time.time()
+    for d in deltas:
+        float(lnp(jnp.asarray(d)))
+    base_wall = time.time() - t0
+    base_eps = baseline_evals / base_wall
+
+    fused_eps = n_evals / fused_wall
+    steps_ps = n_chains * nsteps / chain_wall
+    rhat = chains.rhat()
+    rec.update({
+        "noise_loglike_evals_per_sec_per_chip": round(fused_eps, 2),
+        "noise_vs_baseline": round(fused_eps / base_eps, 2),
+        "noise_chain_steps_per_sec_per_chip": round(steps_ps, 2),
+        "noise_hyper": list(nl.hyper),
+        "n_evals": n_evals,
+        "n_chains": n_chains,
+        "chain_steps": nsteps,
+        "chain_warmup": warmup,
+        "chain_accept_frac": round(chains.accept_frac, 3),
+        "chain_divergences": chains.divergences,
+        "chain_rhat_max": round(float(np.max(rhat)), 4),
+        "fused_eval_wall_s": round(fused_wall, 3),
+        "chain_wall_s": round(chain_wall, 3),
+        "baseline_evals": baseline_evals,
+        "baseline_evals_per_sec": round(base_eps, 2),
+        "baseline": "host-loop per-eval BayesianTiming lnposterior "
+                    "(jitted once, one dispatch per point, compile "
+                    "included on both sides)",
+    })
+    rec.update(breakdown)
+    try:
+        from pint_tpu.analysis.jaxpr_audit import audit_block
+
+        rec["audit"] = audit_block()
+    except Exception:  # noqa: BLE001 — telemetry only  # jaxlint: disable=silent-except — telemetry assembly
+        rec["audit"] = None
+    rec["degradation_count"] = _degradation_count()
+    rec["degradation_kinds"] = _degradation_kinds()
+    return rec
+
+
+def bench_noise(emit, ntoas: int | None = None) -> None:
+    """Full noise-engine bench for the flagship record (self-contained
+    synthetic dataset; PINT_TPU_BENCH_NOISE_NTOAS overrides the size)."""
+    if ntoas is None:
+        ntoas = int(os.environ.get("PINT_TPU_BENCH_NOISE_NTOAS", "2000"))
+    rec = _noise_bench_core(ntoas, n_evals=1024, n_chains=8, nsteps=400,
+                            warmup=200, baseline_evals=16)
+    rec["metric"] = "noise_loglike_evals_per_sec_per_chip"
+    rec["value"] = rec["noise_loglike_evals_per_sec_per_chip"]
+    rec["unit"] = "evals/s/chip"
+    rec["vs_baseline"] = rec["noise_vs_baseline"]
+    emit(rec)
+
+
 def bench_gls_grid(model, toas, par, maxiter, repeats, emit) -> float:
     """GLS grid with every noise mask bound (reference bench_chisq_grid.py).
     Returns the points/s figure so the headline line can carry it too (the
@@ -613,6 +764,12 @@ def main() -> None:
             bench_mcmc(mcmc_steps, emit)
         except Exception as e:
             print(f"mcmc bench failed: {e}", file=sys.stderr)
+
+    # --- 1b. Bayesian noise engine (fitting/noise_like.py) -------------------
+    try:
+        bench_noise(emit)
+    except Exception as e:
+        print(f"noise bench failed: {e}", file=sys.stderr)
 
     # --- shared J0740-scale dataset -----------------------------------------
     # Setup degrades instead of dying: a failure at the full TOA count falls
@@ -860,6 +1017,18 @@ def main() -> None:
             records.get("mcmc_walker_steps_per_sec_per_chip") or {}).get("value"),
         "mcmc_vs_baseline": (
             records.get("mcmc_walker_steps_per_sec_per_chip") or {}).get("vs_baseline"),
+        # Bayesian noise engine (fitting/noise_like.py): fused
+        # marginalized-GP likelihood throughput + vmapped chain
+        # throughput, folded in as TOP-LEVEL headline fields
+        "noise_loglike_evals_per_sec_per_chip": (
+            records.get("noise_loglike_evals_per_sec_per_chip") or {}
+        ).get("value"),
+        "noise_vs_baseline": (
+            records.get("noise_loglike_evals_per_sec_per_chip") or {}
+        ).get("vs_baseline"),
+        "noise_chain_steps_per_sec_per_chip": (
+            records.get("noise_loglike_evals_per_sec_per_chip") or {}
+        ).get("noise_chain_steps_per_sec_per_chip"),
         "toa_load_seconds": (records.get("toa_load_seconds") or {}).get("value"),
         # fleet-fitting figures (fitting/batch.py) folded in as TOP-LEVEL
         # fields so the single-last-line driver record carries the
@@ -1205,6 +1374,30 @@ def _smoke_fleet(n_fits: int, ntoas: int, seed: int = 11):
     return model, fleet_toas
 
 
+def smoke_noise_bench(ntoas: int = 220, n_evals: int = 8192,
+                      n_chains: int = 4, nsteps: int = 120,
+                      warmup: int = 80, baseline_evals: int = 12) -> dict:
+    """CPU noise-engine smoke bench: the fused marginalized GP likelihood
+    (fitting/noise_like.py) evaluated E times in ONE vmapped program plus
+    C vmapped HMC chains, vs the host-loop per-eval BayesianTiming path —
+    compile included on both sides.
+
+    This is the Bayesian-engine telemetry CONTRACT surface: tier-1
+    (tests/test_noise_like.py) asserts the `noise_breakdown` fields name
+    >= 90% of the noise wall, the jaxpr audit is strict-clean over every
+    noise program, and the degradation ledger stays empty under
+    PINT_TPU_DEGRADED=error. Run from the CLI with
+    ``python bench.py --smoke --noise`` (prints one JSON line).
+    """
+    from pint_tpu.ops.compile import setup_persistent_cache
+
+    setup_persistent_cache()
+    rec = _noise_bench_core(ntoas, n_evals, n_chains, nsteps, warmup,
+                            baseline_evals)
+    rec["metric"] = "smoke_noise_bench"
+    return rec
+
+
 def smoke_batched_bench(n_fits: int = 32, ntoas: int = 96, maxiter: int = 5,
                         compare_sequential: bool = True) -> dict:
     """CPU fleet-fit smoke bench: n_fits synthetic WLS fits as ONE batched
@@ -1309,8 +1502,12 @@ if __name__ == "__main__":
         sharded = "--sharded" in sys.argv
         batched = "--batched" in sys.argv
         flagship = "--flagship" in sys.argv
+        noise = "--noise" in sys.argv
         if flagship:
             print(json.dumps(smoke_flagship_bench()), flush=True)
+            sys.exit(0)
+        if noise:
+            print(json.dumps(smoke_noise_bench()), flush=True)
             sys.exit(0)
         if sharded or batched:
             # must precede the first jax import: the sharded/batched smoke

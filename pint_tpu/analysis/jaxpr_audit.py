@@ -39,12 +39,13 @@ Passes (each returns a list of human-readable violation details):
     fused fit loop's contract is ONE host sync per fit, and a callback
     in the body re-serializes every iteration.
 ``prepare-sync``
-    Any host-sync primitive anywhere in a ``prepare_*`` program
-    (astro/device_prepare.py — geometry/ephemeris/N-body serve and the
-    ``prepare_kernel_eval`` Chebyshev kernel-pack program): the
-    device-fused TOA prepare must never round-trip to the host
-    mid-program — a prepare step that needs host data belongs on the
-    host-numpy fallback path instead.
+    Any host-sync primitive anywhere in a ``prepare_*`` or ``noise_*``
+    program (astro/device_prepare.py — geometry/ephemeris/N-body serve
+    and the ``prepare_kernel_eval`` Chebyshev kernel-pack program;
+    fitting/noise_like.py — the marginalized noise likelihood and its
+    chain/optimizer programs): these device residents must never
+    round-trip to the host mid-program — a step that needs host data
+    belongs on a host fallback path instead.
 ``retrace-budget``
     A second compiled signature that differs from an existing one only
     in dtype/weak_type at identical tree structure and shapes. A
@@ -279,23 +280,32 @@ def _pass_host_sync(ctx: _Ctx) -> list[str]:
     return out
 
 
+#: label prefixes of programs contracted to contain ZERO host-sync
+#: primitives anywhere: the device-fused TOA prepare
+#: (astro/device_prepare.py, incl. the ``prepare_kernel_eval`` kernel-pack
+#: serve) and the Bayesian noise engine's likelihood/chain programs
+#: (fitting/noise_like.py ``noise_loglike*``/``noise_chain*``/
+#: ``noise_fleet_chain*``/``noise_optimize`` — a callback inside a chain
+#: scan re-serializes every step of every vmapped chain)
+_SYNC_FREE_PREFIXES = ("prepare_", "noise_")
+
+
 def _pass_prepare_sync(ctx: _Ctx) -> list[str]:
-    """Prepare programs (label ``prepare_*``, astro/device_prepare.py —
-    including the ``prepare_kernel_eval`` kernel-pack serve) are the
-    TOA-prepare pipeline's device residents: a host callback ANYWHERE
-    in one — not just inside a loop body — re-serializes the prepare path
-    the fusion exists to eliminate, so the contract is zero host-sync
-    primitives, full stop."""
-    if ctx.closed is None or not ctx.label.startswith("prepare_"):
+    """Device-resident programs (label ``prepare_*`` or ``noise_*``) are
+    contracted sync-free: a host callback ANYWHERE in one — not just
+    inside a loop body — re-serializes the pipeline the fusion exists to
+    eliminate, so the contract is zero host-sync primitives, full stop."""
+    if ctx.closed is None or not ctx.label.startswith(_SYNC_FREE_PREFIXES):
         return []
     out = []
     for eqn, _ in _iter_eqns(ctx.closed.jaxpr):
         if eqn.primitive.name in _HOST_SYNC:
             out.append(
-                f"host-sync primitive {eqn.primitive.name!r} in prepare "
-                f"program {ctx.label!r}: device-fused prepare must contain "
-                "zero host callbacks (the pipeline falls back to host "
-                "numpy instead of round-tripping mid-program)"
+                f"host-sync primitive {eqn.primitive.name!r} in "
+                f"device-resident program {ctx.label!r}: fused prepare and "
+                "noise-likelihood/chain programs must contain zero host "
+                "callbacks (fall back to the host path instead of "
+                "round-tripping mid-program)"
             )
     return out
 

@@ -17,6 +17,8 @@ the Woodbury-marginalized GLS chi^2 — both reuse the fitters' machinery.
 
 from __future__ import annotations
 
+import weakref
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -25,18 +27,59 @@ from pint_tpu.fitting.wls import apply_delta
 from pint_tpu.priors import default_prior
 from pint_tpu.residuals import Residuals, phase_residual_frac
 
+#: memoized posterior closures: id(toas) -> {state key: (lnpost, resids)}.
+#: The sampler's compiled-chain cache keys weakly on the lnpost CALLABLE
+#: (pint_tpu/sampler.py _RUN_CACHE), so a resumed chain — which constructs
+#: a fresh MCMCFitter/BayesianTiming, typically over a deepcopied model —
+#: must get the SAME closure back or the whole chain program re-traces.
+#: The key captures everything the closure's numbers depend on: component
+#: skeleton, free set, precision backend, track mode, priors, and the
+#: exact parameter bytes (dd low words included). TOAs is an eq-dataclass
+#: (unhashable), so the outer map keys on identity with a weakref
+#: finalizer evicting the entry when the TOAs object dies.
+_POSTERIOR_MEMO: dict[int, dict] = {}
+
+
+def _memo_for(toas) -> dict:
+    ident = id(toas)
+    entry = _POSTERIOR_MEMO.get(ident)
+    if entry is None:
+        try:
+            weakref.finalize(toas, _POSTERIOR_MEMO.pop, ident, None)
+        except TypeError:  # not weak-referenceable: never cached
+            return {}
+        entry = _POSTERIOR_MEMO[ident] = {}
+    return entry
+
+
+def _posterior_key(model, free, priors) -> tuple:
+    comps = tuple(
+        (type(c).__name__, tuple(sorted(c.specs))) for c in model.components
+    )
+    pbytes = tuple(
+        np.asarray(leaf).tobytes()
+        for leaf in jax.tree_util.tree_leaves(model.params)
+    )
+    priors_key = tuple((n, repr(priors[n])) for n in sorted(priors))
+    return (comps, tuple(free), model.xprec.name,
+            str(model.meta.get("TRACK")), pbytes, priors_key)
+
 
 class BayesianTiming:
     """Posterior over the model's free parameters given prepared TOAs.
 
     Priors default to the reference's parfile-driven uniform windows
     (pint_tpu/priors.py); pass `priors={name: prior}` to override.
+
+    The jitted ln-posterior closure is MEMOIZED per (toas, model state):
+    two BayesianTiming instances over the same data and parameter values
+    (deepcopies included) share one closure, so the sampler's compiled
+    chain program is reused and a chain resume never re-traces.
     """
 
     def __init__(self, toas, model, priors: dict | None = None):
         self.toas = toas
         self.model = model
-        self.resids = Residuals(toas, model)
         self.free = tuple(model.free_params)
         self.scales = np.array(
             [model.param_meta[n].uncertainty or 1e-12 for n in self.free]
@@ -47,7 +90,15 @@ class BayesianTiming:
             pm = model.param_meta[n]
             v = _leaf_float(model.params[n])
             self.priors[n] = (priors or {}).get(n) or default_prior(v, pm.uncertainty)
+        memo = _memo_for(toas)
+        key = _posterior_key(model, self.free, self.priors)
+        hit = memo.get(key)
+        if hit is not None:
+            self._lnpost, self.resids = hit
+            return
+        self.resids = Residuals(toas, model)
         self._lnpost = self._build()
+        memo[key] = (self._lnpost, self.resids)
 
     def _build(self):
         model = self.model

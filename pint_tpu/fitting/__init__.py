@@ -12,6 +12,12 @@ from pint_tpu.fitting.wideband import WidebandDownhillFitter  # noqa: F401
 from pint_tpu.fitting.mcmc import MCMCFitter  # noqa: F401
 from pint_tpu.fitting.batch import BatchedFitter, fit_batch  # noqa: F401
 from pint_tpu.fitting.state import FitterState  # noqa: F401
+from pint_tpu.fitting.noise_like import (  # noqa: F401
+    NoiseFleet,
+    NoiseLikelihood,
+    noise_param_names,
+    split_rhat,
+)
 
 
 def fit_auto(toas, model, downhill: bool = True, mesh=None,

@@ -72,7 +72,8 @@ from pint_tpu.utils.logging import get_logger
 
 log = get_logger("pint_tpu.fitting")
 
-__all__ = ["BatchedFitter", "bucket_rows", "clear_batch_cache", "fit_batch"]
+__all__ = ["BatchedFitter", "bucket_rows", "clear_batch_cache", "fit_batch",
+           "stack_trees", "tree_index"]
 
 #: smallest row bucket — tiny fits share one executable instead of
 #: compiling per-count programs for 3 vs 5 vs 11 TOAs
@@ -135,20 +136,26 @@ def _is_none(x):
     return x is None
 
 
-def _stack_trees(trees):
+def stack_trees(trees):
     """Stack a list of structurally identical pytrees along a new leading
     batch axis (None leaves stay None — all-or-nothing per group, which
-    the group signature guarantees)."""
+    the group signature guarantees). Shared by the fleet-fit engine and
+    the noise-chain fleets (fitting/noise_like.py)."""
     return jax.tree_util.tree_map(
         lambda *xs: None if xs[0] is None else jnp.stack(
             [jnp.asarray(x) for x in xs]),
         *trees, is_leaf=_is_none)
 
 
-def _tree_index(tree, i: int):
+def tree_index(tree, i: int):
     """Element i of a batch-stacked pytree."""
     return jax.tree_util.tree_map(
         lambda x: None if x is None else x[i], tree, is_leaf=_is_none)
+
+
+# internal aliases (original private names, kept for in-repo callers)
+_stack_trees = stack_trees
+_tree_index = tree_index
 
 
 class _BatchEntry:

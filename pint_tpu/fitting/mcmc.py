@@ -9,6 +9,7 @@ from __future__ import annotations
 import numpy as np
 
 from pint_tpu.fitting.wls import FitResult, apply_delta
+from pint_tpu.ops import perf
 from pint_tpu.residuals import Residuals
 from pint_tpu.sampler import initial_ball, run_ensemble
 from pint_tpu.utils.logging import get_logger
@@ -38,6 +39,7 @@ class MCMCFitter:
         self.lnp: np.ndarray | None = None
         self.result: FitResult | None = None
 
+    @perf.instrument_fit
     def fit_toas(self, nsteps: int = 400, burn: float = 0.25, seed: int = 0,
                  backend: str | None = None, resume: bool = False) -> FitResult:
         """Run (or, with `backend`+`resume`, continue) the chain. `backend`
@@ -76,7 +78,12 @@ class MCMCFitter:
             log.info(f"resuming chain from {backend}: {prev_chain.shape[0]} steps done")
         else:
             x0 = initial_ball(self.bt.scales, self.nwalkers, seed=seed)
-        chain, lnp, acc = run_ensemble(self.bt.lnpost_fn(), x0, nsteps, seed=seed)
+        # the whole chain is ONE device program (and — via the memoized
+        # posterior closure + the sampler's weak program cache — the SAME
+        # compiled program across fitter rebuilds and chain resumes)
+        with perf.stage("step"):
+            chain, lnp, acc = run_ensemble(self.bt.lnpost_fn(), x0, nsteps,
+                                           seed=seed)
         if prev_chain is not None:
             chain = np.concatenate([prev_chain, chain])
             lnp = np.concatenate([prev_lnp, lnp])

@@ -1,0 +1,848 @@
+"""Device-resident Bayesian noise engine: the marginalized GP likelihood.
+
+Production pulsar timing is dominated by NOISE analysis, not point fits:
+the reference's ML noise-parameter estimation (arXiv:2405.01977) and the
+GP formulation it rests on (van Haasteren & Vallisneri, arXiv:1407.1838)
+iterate a hyperparameter-marginalized likelihood thousands of times, and
+Vela.jl (arXiv:2412.15858) shows the win from accelerator-resident
+parallel chains. Before this module, every such evaluation routed through
+`BayesianTiming.lnposterior` — a full phase-model re-evaluation (delay
+chains, binary, astrometry) per point, dispatched one host-orchestrated
+program at a time by the ensemble sampler's walkers.
+
+The re-design exploits the structure of the problem:
+
+- **Linearize the timing model once.** Near a converged fit the timing
+  parameters enter the residual linearly: r(delta) = r0 - M delta with M
+  the design matrix at the fit point. Both are computed ONCE (one device
+  program) and become fixed operands.
+- **Profile the timing parameters analytically.** With C(eta) the noise
+  covariance at hyperparameters eta, the timing parameters marginalize in
+  closed form (flat prior; vH&V 2014 eq. 14):
+
+      2 ln L(eta) = -[ r0' C^-1 r0 - b' A^-1 b + ln|C| + ln|A|
+                       + (n - p) ln 2pi ],
+      A = M' C^-1 M,  b = M' C^-1 r0,
+
+  so each evaluation is a pure device expression of eta alone.
+  (`marginalize_timing=False` drops the ln|A| and p terms: the PROFILED
+  likelihood max_delta L, the ML-estimation objective.)
+- **Traced hyperparameters.** EFAC/EQUAD/ECORR and the power-law
+  (log10_A, gamma) pairs ride the argument list as one eta vector — the
+  white-noise rescaling and the Fourier-mode prior weights phi(eta) are
+  computed in-graph (models/noise.py), so ONE compiled program serves the
+  whole posterior surface, its gradient, and every chain step.
+- **Woodbury algebra with reduce hooks.** C^-1 applications go through
+  fitting/woodbury.py (`s_factor`/`woodbury_chi2`/`logdet_C`), every
+  TOA-axis reduction completed through an `_AxisReduce` psum — the same
+  contract as the fused fit loop, so the program shards over the existing
+  `toa` mesh axis unchanged.
+- **Chains as one executable.** On top: batched optimizer restarts
+  (vmapped Adam, `optimize`), vmapped stretch-ensemble chains and a
+  `lax.scan` HMC kernel with dual-averaging warmup (pint_tpu/sampler.py),
+  with divergent proposals rejected by per-chain `where` masks — C chains
+  x W walkers advance as one device program, and `NoiseFleet` stacks B
+  pulsars' bucket-padded operands (fitting/batch.py recipe) so B x C
+  chains are ONE executable.
+
+Telemetry: every surface records `noise_loglike_evals` /
+`noise_chain_steps` counters and nests under a ``noise`` stage
+(ops/perf.py `noise_breakdown`); the bench headline is
+`noise_loglike_evals_per_sec_per_chip` with
+`noise_chain_steps_per_sec_per_chip` beside it.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from pint_tpu.fitting.sharded import _AxisReduce, _shard_map, n_fit_shards, shard_fit_rows
+from pint_tpu.fitting.woodbury import (
+    cinv_apply,
+    logdet_C,
+    s_factor,
+    woodbury_chi2,
+)
+from pint_tpu.ops import perf
+from pint_tpu.priors import UniformPrior
+from pint_tpu.utils import knobs
+from pint_tpu.utils.logging import get_logger
+
+log = get_logger("pint_tpu.noise_like")
+
+Array = jnp.ndarray
+
+#: ridge on the equilibrated profiled-timing normal matrix A_n — the same
+#: conditioning pin as the GLS solve (fitting/gls.py _RIDGE); the golden
+#: parity suite applies it to the dense reference too, so it cancels
+RIDGE = 1e-12
+
+_LN2PI = float(np.log(2.0 * np.pi))
+
+
+def noise_param_names(model) -> tuple[str, ...]:
+    """Every noise hyperparameter the model owns (EFAC1.., EQUAD1..,
+    ECORR1.., TNREDAMP/TNREDGAM, TNDMAMP/TNDMGAM, ...), in component
+    order — the default sampling target set."""
+    names: list[str] = []
+    for c in model.noise_components:
+        for n in c.hyper_param_names(model.params):
+            if n not in names:
+                names.append(n)
+    return tuple(names)
+
+
+def default_noise_priors(model, hyper: tuple[str, ...]) -> dict:
+    """Reference-convention uniform windows per hyperparameter family
+    (enterprise/PINT noise runs): EFAC in [0.01, 10], EQUAD/ECORR in
+    [0, 100 us] (internal seconds), log10 amplitudes in [-20, -8],
+    spectral indices in [0, 7]. Override per-name via the `priors`
+    argument of :class:`NoiseLikelihood`."""
+    out = {}
+    for n in hyper:
+        base = n.rstrip("0123456789")
+        if base in ("EFAC", "T2EFAC", "DMEFAC"):
+            out[n] = UniformPrior(0.01, 10.0)
+        elif base in ("EQUAD", "T2EQUAD", "ECORR", "TNECORR"):
+            out[n] = UniformPrior(0.0, 1e-4)
+        elif base in ("TNREDAMP", "TNDMAMP"):
+            out[n] = UniformPrior(-20.0, -8.0)
+        elif base in ("TNREDGAM", "TNDMGAM"):
+            out[n] = UniformPrior(0.0, 7.0)
+        else:
+            out[n] = UniformPrior()
+    return out
+
+
+def _prior_scale(prior) -> float:
+    """Unit-scale guess for one hyperparameter (the HMC mass matrix /
+    restart ball): a tenth of the prior window, else 1."""
+    lo = getattr(prior, "lo", -np.inf)
+    hi = getattr(prior, "hi", np.inf)
+    if np.isfinite(lo) and np.isfinite(hi) and hi > lo:
+        return 0.1 * (hi - lo)
+    sig = getattr(prior, "sigma", None)
+    return float(sig) if sig else 1.0
+
+
+def _apply_eta(params0: dict, hyper: tuple[str, ...], eta: Array) -> dict:
+    """params with the hyper subset replaced by the traced eta entries
+    (noise hyperparameters are plain f64 leaves — no dd/qf precision)."""
+    params = dict(params0)
+    for i, n in enumerate(hyper):
+        params[n] = eta[i]
+    return params
+
+
+def _loglike_fn(model, hyper: tuple[str, ...], p_lin: int,
+                marginalize: bool, red: _AxisReduce):
+    """(eta, params0, data) -> scalar marginalized ln-likelihood.
+
+    data: tensor (model columns incl. any bucket pads + TZR row), r0
+    (N_data,) prefit residuals (s), Mn (N_data, p) column-equilibrated
+    timing design, Mnorm (p,) the equilibration (its log-det offset keeps
+    parity with the unequilibrated dense reference), mask (N_data,) 1 on
+    real rows / 0 on pads.
+    """
+
+    def loglike(eta, params0, data):
+        red.begin()
+        params = _apply_eta(params0, hyper, eta)
+        tensor = data["tensor"]
+        mask = data["mask"]
+        r0 = data["r0"]
+        sigma = model.scaled_sigma(params, tensor)
+        w = jnp.where(mask > 0, 1.0 / sigma**2, 0.0)
+        basis = model.noise_basis_and_weights(params, tensor)
+        sf = s_factor(basis, w, reduce=red.psum) if basis is not None else None
+        chi2, _ = woodbury_chi2(basis, w, r0, sf=sf, reduce=red.psum)
+        ld = logdet_C(basis, w, sf=sf, reduce=red.psum, mask=mask)
+        n_eff = red.sum(mask)
+        n_prof = 0.0
+        if p_lin:
+            Mn = data["Mn"]
+            CinvM = cinv_apply(basis, w, Mn, sf, reduce=red.psum)
+            A = red.psum(Mn.T @ CinvM) + RIDGE * jnp.eye(p_lin)
+            b = red.psum(CinvM.T @ r0)
+            cf = jax.scipy.linalg.cho_factor(A)
+            chi2 = chi2 - b @ jax.scipy.linalg.cho_solve(cf, b)
+            if marginalize:
+                # ln|A_unequilibrated| = ln|A_n| + 2 sum ln norm
+                ld = ld + 2.0 * jnp.sum(jnp.log(jnp.diag(cf[0])))
+                ld = ld + 2.0 * jnp.sum(jnp.log(data["Mnorm"]))
+                n_prof = float(p_lin)
+        return -0.5 * (chi2 + ld + (n_eff - n_prof) * _LN2PI)
+
+    return loglike
+
+
+class _ProgramSet(NamedTuple):
+    """Compiled surfaces over one likelihood shape (all TimedPrograms)."""
+
+    loglike: object        # (eta, params0, data) -> scalar
+    loglike_batch: object  # (etas (E, h), params0, data) -> (E,)
+    grad: object           # (eta, params0, data) -> (h,)
+
+
+def _wrap_sharded(fn, mesh, axis, specs, out_spec):
+    """shard_map a likelihood surface over the toa mesh axis: data rows
+    ride the axis, eta/params stay replicated, outputs are replicated."""
+    if axis is None:
+        return fn
+    from jax.sharding import PartitionSpec as P
+
+    return _shard_map()(
+        fn, mesh=mesh,
+        in_specs=(P(), P(), specs),
+        out_specs=out_spec,
+        check_vma=False,
+    )
+
+
+class NoiseLikelihood:
+    """The fused, audited noise-hyperparameter posterior of one dataset.
+
+    Construction fixes the linearization point (the model's CURRENT
+    parameters — run a downhill fit first), computes (r0, M) once, and
+    compiles the marginalized ln-likelihood as ONE `TimedProgram` whose
+    only traced inputs are the hyperparameter vector. `mesh` shards the
+    TOA axis exactly like the fused fitters (psum-completed reductions).
+
+    hyper      : hyperparameter names (default: every noise param the
+                 model owns, `noise_param_names`)
+    priors     : {name: prior} overrides (default_noise_priors otherwise)
+    marginalize_timing : True = vH&V marginalized likelihood (+ln|A|);
+                 False = profiled (ML-estimation objective)
+    """
+
+    def __init__(self, toas, model, hyper: tuple[str, ...] | None = None,
+                 priors: dict | None = None, marginalize_timing: bool = True,
+                 mesh=None, toa_axis: str = "toa"):
+        from pint_tpu.residuals import Residuals
+
+        if not model.noise_components:
+            raise ValueError("model has no noise components to sample")
+        self.toas = toas
+        self.model = model
+        self.mesh = mesh
+        self.toa_axis = toa_axis
+        self.marginalize_timing = bool(marginalize_timing)
+        self.hyper = tuple(hyper) if hyper else noise_param_names(model)
+        if not self.hyper:
+            raise ValueError("no noise hyperparameters bound on this model")
+        for n in self.hyper:
+            if n not in model.params:
+                raise KeyError(f"unknown hyperparameter {n}")
+        self.priors = default_noise_priors(model, self.hyper)
+        self.priors.update(priors or {})
+        self.scales = np.array([_prior_scale(self.priors[n]) for n in self.hyper])
+        from pint_tpu.models.base import leaf_to_f64
+
+        self.x0 = np.array([
+            float(np.asarray(leaf_to_f64(model.params[n]))) for n in self.hyper
+        ])
+
+        with perf.stage("noise"):
+            with perf.stage("build"):
+                self._build(Residuals(toas, model, subtract_mean=False))
+
+    # --- construction ------------------------------------------------------------
+
+    def _timing_free(self) -> tuple[str, ...]:
+        """Free TIMING parameters to profile: the model's free set minus
+        every noise-owned hyperparameter (their residual columns are
+        identically zero)."""
+        owned = set()
+        for c in self.model.noise_components:
+            owned.update(mp.name for mp in getattr(c, "mask_params", []))
+            owned.update(c.hyper_param_names(self.model.params))
+        return tuple(n for n in self.model.free_params if n not in owned)
+
+    def _build(self, resids):
+        from pint_tpu.fitting.wls import apply_delta
+        from pint_tpu.ops.compile import TimedProgram, canonicalize_params, precision_jit
+        from pint_tpu.residuals import phase_residual_frac
+
+        model = self.model
+        self.resids = resids
+        tensor = resids.tensor
+        free = self._timing_free()
+        params0 = canonicalize_params(model.xprec.convert_params(model.params))
+        self._params0 = params0
+
+        # (r0, M) at the linearization point: one device program, never
+        # re-run. subtract_mean=False — the phase offset is profiled as an
+        # explicit column instead (the reference's "Offset" column), so
+        # the marginalization stays exact as the weights move with EFAC.
+        def design(params, tensor):
+            def rfun(delta):
+                _, r, f = phase_residual_frac(
+                    model, apply_delta(params, free, delta), tensor,
+                    track_pn=resids._track_pn, delta_pn=resids._delta_pn,
+                    subtract_mean=False,
+                )
+                return r / f, f
+
+            (r0, f0), jvp = jax.linearize(rfun, jnp.zeros(len(free)))
+            cols = [jvp(col)[0] for col in jnp.eye(len(free))]
+            if not model.has_phase_offset:
+                cols.append(1.0 / f0)  # the profiled overall phase offset
+            M = (jnp.stack(cols, axis=1) if cols
+                 else jnp.zeros((r0.shape[0], 0)))
+            return r0, M
+
+        design_prog = TimedProgram(precision_jit(design), "noise_design")
+        r0, M = design_prog(params0, tensor)
+        r0 = np.asarray(r0)
+        M = np.asarray(M)
+        self.p_lin = M.shape[1]
+        self.timing_free = free
+
+        norm = np.sqrt(np.sum(M * M, axis=0))
+        norm = np.where(norm == 0, 1.0, norm)
+        vecs = {"r0": r0, "mask": np.ones(len(r0)), "Mn": M / norm}
+        self._vecs = vecs
+        self._n_data = len(r0)
+        self._mnorm = norm
+
+        n_shards = n_fit_shards(self.mesh, self.toa_axis)
+        self.data, self._specs = self._layout(n_shards)
+        # chains/Hessian/optimizer consume the REPLICATED row layout: the
+        # chain-level parallelism is the vmap over chains; TOA sharding
+        # applies to the likelihood/gradient eval surfaces (grad is taken
+        # OUTSIDE shard_map — per-shard autodiff of a psum-completed
+        # expression would double-count the replicated phi/log-det terms)
+        self._plain_data = (self.data if n_shards <= 1
+                            else self._layout(1)[0])
+        self._programs = self._compile(self.data, self._specs, n_shards)
+
+    def _layout(self, n_shards: int, chunk: int | None = None):
+        """(data dict, PartitionSpec tree) — rows re-laid for `n_shards`
+        TOA shards and/or padded to a fleet bucket (`chunk` data rows)."""
+        if n_shards <= 1 and chunk is None:
+            data = {"tensor": self.resids.tensor,
+                    "Mnorm": jnp.asarray(self._mnorm)}
+            data.update({k: jnp.asarray(v) for k, v in self._vecs.items()})
+            return data, None
+        tensor_out, vecs_out, row_keys = shard_fit_rows(
+            self.model, self.resids.tensor, self._vecs, max(n_shards, 1),
+            fills=None, chunk=chunk)
+        data = {"tensor": tensor_out, "Mnorm": jnp.asarray(self._mnorm)}
+        data.update(vecs_out)
+        if n_shards <= 1:
+            return data, None
+        from jax.sharding import PartitionSpec as P
+
+        axis = self.toa_axis
+        specs = {"tensor": {k: P(axis) if k in row_keys else P()
+                            for k in tensor_out},
+                 "Mnorm": P()}
+        specs.update({k: P(axis) for k in vecs_out})
+        specs = jax.tree_util.tree_unflatten(
+            jax.tree_util.tree_structure(data, is_leaf=lambda x: x is None),
+            jax.tree_util.tree_leaves(specs, is_leaf=lambda x: x is None),
+        )
+        return data, specs
+
+    def _compile(self, data, specs, n_shards: int) -> _ProgramSet:
+        from pint_tpu.ops.compile import TimedProgram, precision_jit
+
+        axis = self.toa_axis if n_shards > 1 else None
+        axes = (axis,) if axis else ()
+        mk = lambda: _AxisReduce(axis)  # noqa: E731 — one tally per program
+
+        from jax.sharding import PartitionSpec as P
+
+        red1 = mk()
+        ll = _loglike_fn(self.model, self.hyper, self.p_lin,
+                         self.marginalize_timing, red1)
+        # un-jitted core for chain/optimizer/Hessian composition: those
+        # surfaces consume the REPLICATED row layout, so the reductions
+        # are identity (no collective) regardless of the eval mesh
+        self._loglike_traced = _loglike_fn(
+            self.model, self.hyper, self.p_lin, self.marginalize_timing,
+            _AxisReduce(None))
+        single = _wrap_sharded(ll, self.mesh, axis, specs, P() if axis else None)
+
+        red2 = mk()
+        llb = _loglike_fn(self.model, self.hyper, self.p_lin,
+                          self.marginalize_timing, red2)
+        batch = jax.vmap(llb, in_axes=(0, None, None))
+        batch = _wrap_sharded(batch, self.mesh, axis, specs,
+                              P() if axis else None)
+
+        # gradient: differentiate the (possibly shard-mapped) VALUE
+        # function from outside — shard_map carries the correct AD rules,
+        # where grad-inside-then-psum would overcount every replicated
+        # (non-row-reduced) eta path by the shard count
+        red3 = mk()
+        llg = _loglike_fn(self.model, self.hyper, self.p_lin,
+                          self.marginalize_timing, red3)
+        llg = _wrap_sharded(llg, self.mesh, axis, specs, P() if axis else None)
+        grad = jax.grad(llg)
+
+        return _ProgramSet(
+            loglike=TimedProgram(precision_jit(single), "noise_loglike",
+                                 collective_axes=axes),
+            loglike_batch=TimedProgram(precision_jit(batch),
+                                       "noise_loglike_batch",
+                                       collective_axes=axes),
+            grad=TimedProgram(precision_jit(grad), "noise_loglike_grad",
+                              collective_axes=axes),
+        )
+
+    # --- prior / posterior ------------------------------------------------------
+
+    def lnprior(self, eta):
+        lp = 0.0
+        for i, n in enumerate(self.hyper):
+            lp = lp + self.priors[n].logpdf(eta[i])
+        return lp
+
+    def _lnpost_traced(self, eta, params0, data):
+        """Traceable (eta, params0, data) -> ln posterior — the closure
+        the chain kernels and vmapped optimizers compose over."""
+        lp = self.lnprior(eta)
+        ll = jnp.where(jnp.isfinite(lp),
+                       self._loglike_traced(eta, params0, data), 0.0)
+        return lp + ll
+
+    # --- public evaluation surfaces ----------------------------------------------
+
+    @property
+    def nparams(self) -> int:
+        return len(self.hyper)
+
+    def loglike(self, eta) -> float:
+        """Marginalized ln-likelihood at one hyperparameter vector."""
+        with perf.stage("noise"):
+            with perf.stage("eval"):
+                out = self._programs.loglike(
+                    jnp.asarray(eta, jnp.float64), self._params0, self.data)
+        perf.add("noise_loglike_evals", 1)
+        return float(out)
+
+    #: vmapped-eval bucket: loglike_many pads E up to multiples of this
+    #: (power-of-two floored below it for small E), so ONE compiled batch
+    #: program serves every request size — the fitting/batch.py bucket
+    #: contract, enforced by the batch-retrace audit pass
+    EVAL_CHUNK = 256
+
+    def loglike_many(self, etas, chunk: int | None = None) -> np.ndarray:
+        """Vectorized ln-likelihood over (E, h) hyperparameter rows.
+
+        Evaluations ride a bucket-padded vmapped program: E points cost
+        ceil(E/chunk) device dispatches and at most ONE compile per
+        process (pad rows repeat the last point and are dropped)."""
+        etas = np.asarray(etas, np.float64)
+        E = etas.shape[0]
+        if chunk is None:
+            chunk = self.EVAL_CHUNK
+            while chunk >= 2 * max(E, 1):
+                chunk //= 2
+        n_pad = (-E) % chunk
+        if n_pad:
+            etas = np.concatenate([etas, np.repeat(etas[-1:], n_pad, 0)])
+        outs = []
+        with perf.stage("noise"):
+            with perf.stage("eval"):
+                for k in range(0, etas.shape[0], chunk):
+                    outs.append(self._programs.loglike_batch(
+                        jnp.asarray(etas[k:k + chunk]), self._params0,
+                        self.data))
+        perf.add("noise_loglike_evals", E)
+        return np.concatenate([np.asarray(o) for o in outs])[:E]
+
+    def grad(self, eta) -> np.ndarray:
+        """d lnL / d eta (the surface NUTS/HMC and the ML optimizer ride)."""
+        with perf.stage("noise"):
+            with perf.stage("eval"):
+                out = self._programs.grad(
+                    jnp.asarray(eta, jnp.float64), self._params0, self.data)
+        perf.add("noise_loglike_evals", 1)
+        return np.asarray(out)
+
+    def precompile(self) -> None:
+        """AOT-compile every likelihood surface (overlap contract)."""
+        eta = jnp.asarray(self.x0, jnp.float64)
+        self._programs.loglike.precompile(eta, self._params0, self.data)
+        self._programs.grad.precompile(eta, self._params0, self.data)
+
+    # --- batched optimizer restarts ----------------------------------------------
+
+    def optimize(self, n_restarts: int | None = None, n_steps: int = 200,
+                 lr: float = 0.05, seed: int = 0):
+        """Maximum-likelihood hyperparameters by R vmapped Adam restarts
+        (arXiv:2405.01977's downhill shape, batched): R starting points —
+        the current values plus prior-scaled perturbations — advance as
+        ONE `lax.scan` device program in the prior-scaled coordinates;
+        the best final point wins. Returns (eta_hat, lnpost_at_hat)."""
+        if n_restarts is None:
+            n_restarts = int(knobs.get("PINT_TPU_NOISE_RESTARTS") or 8)
+        lnpost = self._lnpost_traced
+        scales = jnp.asarray(self.scales)
+        center = jnp.asarray(self.x0)
+
+        def neg(z, params0, data):
+            return -lnpost(center + z * scales, params0, data)
+
+        vg = jax.value_and_grad(neg)
+        b1, b2, eps = 0.9, 0.999, 1e-8
+
+        def run(z0, params0, data):
+            def step(carry, t):
+                z, m, v, best_z, best_f = carry
+                f, g = vg(z, params0, data)
+                g = jnp.where(jnp.isfinite(g), g, 0.0)
+                m = b1 * m + (1 - b1) * g
+                v = b2 * v + (1 - b2) * g * g
+                mh = m / (1 - b1 ** (t + 1.0))
+                vh = v / (1 - b2 ** (t + 1.0))
+                z_new = z - lr * mh / (jnp.sqrt(vh) + eps)
+                better = jnp.isfinite(f) & (f < best_f)
+                best_z = jnp.where(better, z, best_z)
+                best_f = jnp.where(better, f, best_f)
+                return (z_new, m, v, best_z, best_f), None
+
+            init = (z0, jnp.zeros_like(z0), jnp.zeros_like(z0), z0,
+                    jnp.asarray(jnp.inf, jnp.float64))
+            (z, _, _, best_z, best_f), _ = jax.lax.scan(
+                step, init, jnp.arange(n_steps, dtype=jnp.float64))
+            f_end = neg(z, params0, data)
+            better = jnp.isfinite(f_end) & (f_end < best_f)
+            return (jnp.where(better, z, best_z),
+                    jnp.where(better, f_end, best_f))
+
+        vrun = jax.vmap(run, in_axes=(0, None, None))
+        from pint_tpu.ops.compile import TimedProgram, precision_jit
+
+        prog = self.__dict__.setdefault(
+            "_opt_prog",
+            TimedProgram(precision_jit(vrun), "noise_optimize"))
+        rng = np.random.default_rng(seed)
+        z0 = np.zeros((n_restarts, self.nparams))
+        z0[1:] = rng.standard_normal((n_restarts - 1, self.nparams))
+        with perf.stage("noise"):
+            with perf.stage("optimize"):
+                zs, fs = prog(jnp.asarray(z0), self._params0,
+                              self._plain_data)
+        perf.add("noise_loglike_evals", n_restarts * (n_steps + 1))
+        fs = np.asarray(fs)
+        best = int(np.nanargmin(fs))
+        eta = self.x0 + np.asarray(zs)[best] * self.scales
+        return eta, float(-fs[best])
+
+    # --- device-resident chains --------------------------------------------------
+
+    def laplace_scales(self) -> np.ndarray:
+        """Per-hyperparameter posterior scales from the Laplace
+        approximation at the current values: 1/sqrt(-d2 lnpost / d eta2)
+        on the Hessian diagonal, falling back to the prior-window scale
+        where the curvature is non-positive or non-finite. These are the
+        HMC mass matrix / restart-ball scales — prior widths alone
+        mis-condition the kernel by orders of magnitude (an EQUAD prior
+        spans 100 us while its posterior is sub-us)."""
+        cached = self.__dict__.get("_laplace_scales")
+        if cached is not None:
+            return cached
+        from pint_tpu.ops.compile import TimedProgram, precision_jit
+
+        hess = jax.hessian(self._lnpost_traced)
+        prog = TimedProgram(precision_jit(hess), "noise_laplace_hessian")
+        with perf.stage("noise"):
+            with perf.stage("build"):
+                H = np.asarray(prog(jnp.asarray(self.x0), self._params0,
+                                    self._plain_data))
+        d = -np.diag(H)
+        good = np.isfinite(d) & (d > 0)
+        out = np.where(good, 1.0 / np.sqrt(np.where(good, d, 1.0)),
+                       self.scales)
+        # a curvature scale beyond the prior window is noise: clamp
+        out = np.minimum(out, self.scales * 10.0)
+        self._laplace_scales = out
+        return out
+
+    def _chain_kernel(self, kernel: str, nsteps: int, warmup: int,
+                      max_leapfrog: int | None = None):
+        """chain(z0, key, center, scales, params0, data) -> draws dict.
+
+        Chains run in CENTERED, SCALED coordinates z = (eta - center) /
+        scales (the HMC mass matrix); center/scales are operands so a
+        fleet vmaps per-member values through one program. Draws are
+        mapped back to eta on device."""
+        from pint_tpu import sampler as smp
+
+        if max_leapfrog is None:
+            max_leapfrog = int(knobs.get("PINT_TPU_NUTS_MAX_LEAPFROG") or 16)
+
+        def make(lnpost_z):
+            if kernel == "stretch":
+                return smp.make_stretch_chain(lnpost_z, nsteps)
+            return smp.make_hmc_chain(
+                lnpost_z, nsteps, warmup,
+                target_accept=float(
+                    knobs.get("PINT_TPU_NUTS_TARGET_ACCEPT") or 0.8),
+                max_leapfrog=max_leapfrog,
+                step_size0=0.5,
+            )
+
+        lnpost = self._lnpost_traced
+
+        def one_chain(z0, key, center, scales, params0, data):
+            def lnpost_z(z, params0, data):
+                return lnpost(center + z * scales, params0, data)
+
+            out = make(lnpost_z)(z0, key, params0, data)
+            out["samples"] = center + out["samples"] * scales
+            return out
+
+        return one_chain
+
+    def _chain_starts(self, kernel: str, nd: int, nwalkers: int, seed: int,
+                      chain_ids, center: np.ndarray, scales: np.ndarray):
+        """(z0, keys): overdispersed starts clamped into the prior
+        interior, and the per-chain fold_in(seed, chain_id) keys — chain
+        c's whole trajectory depends only on its id, so fleet and solo
+        runs of the same id draw identically."""
+        n_chains = len(chain_ids)
+        shape = ((n_chains, nwalkers, nd) if kernel == "stretch"
+                 else (n_chains, nd))
+        z0 = np.zeros(shape)
+        keys = []
+        base = jax.random.PRNGKey(seed)
+        lo = np.array([getattr(self.priors[n], "lo", -np.inf)
+                       for n in self.hyper])
+        hi = np.array([getattr(self.priors[n], "hi", np.inf)
+                       for n in self.hyper])
+        width = np.where(np.isfinite(hi - lo), hi - lo, np.inf)
+        for c, cid in enumerate(chain_ids):
+            keys.append(jax.random.fold_in(base, int(cid)))
+            rng = np.random.default_rng(seed * 100003 + int(cid))
+            z = 2.0 * rng.standard_normal(shape[1:])
+            eta = center + z * scales
+            eta = np.clip(eta, lo + 1e-3 * width, hi - 1e-3 * width)
+            z0[c] = (eta - center) / scales
+        return z0, jnp.stack(keys)
+
+    def sample(self, n_chains: int | None = None, nsteps: int = 500,
+               warmup: int | None = None, kernel: str = "hmc",
+               seed: int = 0, nwalkers: int | None = None,
+               chain_ids=None,
+               max_leapfrog: int | None = None) -> "NoiseChains":
+        """C vmapped device-resident chains over the hyperposterior.
+
+        kernel "hmc": the `lax.scan` HMC kernel with dual-averaging
+        step-size warmup (divergent trajectories masked per chain);
+        "stretch": the affine-invariant ensemble move with `nwalkers`
+        walkers per chain. Chain c's trajectory depends only on
+        ``fold_in(seed, chain_ids[c])`` — a fleet run and a solo rerun of
+        one chain id produce the SAME draws (locked <= 1e-10 in tests).
+        """
+        if n_chains is None:
+            n_chains = int(knobs.get("PINT_TPU_NOISE_CHAINS") or 4)
+        if warmup is None:
+            warmup = (int(knobs.get("PINT_TPU_NUTS_WARMUP") or 0)
+                      or max(nsteps // 2, 32))
+        if chain_ids is None:
+            chain_ids = list(range(n_chains))
+        n_chains = len(chain_ids)
+        nd = self.nparams
+        if nwalkers is None:
+            nwalkers = max(2 * nd + 2, 8)
+        if nwalkers % 2:
+            nwalkers += 1
+
+        one_chain = self._chain_kernel(kernel, nsteps, warmup,
+                                       max_leapfrog)
+        vchain = jax.vmap(one_chain, in_axes=(0, 0, None, None, None, None))
+        from pint_tpu.ops.compile import TimedProgram, precision_jit
+
+        label = f"noise_chain_{kernel}"
+        cache = self.__dict__.setdefault("_chain_progs", {})
+        key = (kernel, nsteps, warmup, max_leapfrog,
+               nwalkers if kernel == "stretch" else 0)
+        prog = cache.get(key)
+        if prog is None:
+            prog = cache[key] = TimedProgram(precision_jit(vchain), label)
+
+        scales = self.laplace_scales()
+        z0, keys = self._chain_starts(kernel, nd, nwalkers, seed, chain_ids,
+                                      self.x0, scales)
+        with perf.stage("noise"):
+            with perf.stage("chain"):
+                out = prog(jnp.asarray(z0), keys, jnp.asarray(self.x0),
+                           jnp.asarray(scales), self._params0,
+                           self._plain_data)
+        steps = n_chains * nsteps * (nwalkers if kernel == "stretch" else 1)
+        perf.add("noise_chain_steps", steps)
+        perf.add("noise_loglike_evals", steps)
+        div = np.asarray(out.get("divergent", np.zeros(1)))
+        acc = np.asarray(out["accept"])
+        res = NoiseChains(
+            hyper=self.hyper,
+            samples=np.asarray(out["samples"]),
+            lnpost=np.asarray(out["lnpost"]),
+            accept_frac=float(np.mean(acc)),
+            divergences=int(div.sum()),
+            kernel=kernel,
+            warmup=warmup if kernel != "stretch" else 0,
+        )
+        perf.add("noise_divergences", res.divergences)
+        return res
+
+
+class NoiseChains(NamedTuple):
+    """Draws + diagnostics of one vmapped chain-fleet run.
+
+    samples: (C, S, h) for HMC, (C, S, W, h) for stretch (walkers kept).
+    """
+
+    hyper: tuple
+    samples: np.ndarray
+    lnpost: np.ndarray
+    accept_frac: float
+    divergences: int
+    kernel: str
+    warmup: int
+
+    def flat(self, burn: float = 0.5) -> np.ndarray:
+        """(n, h) post-burn draws pooled over chains (and walkers)."""
+        s = self.samples[:, int(burn * self.samples.shape[1]):]
+        return s.reshape(-1, s.shape[-1])
+
+    def rhat(self, burn: float = 0.5) -> np.ndarray:
+        """Split-R-hat per hyperparameter across the vmapped chains."""
+        s = self.samples[:, int(burn * self.samples.shape[1]):]
+        if s.ndim == 4:  # stretch walkers: each walker is a chain
+            s = np.moveaxis(s, 2, 1).reshape(-1, s.shape[1], s.shape[-1])
+        return split_rhat(s)
+
+
+def split_rhat(chains: np.ndarray) -> np.ndarray:
+    """Gelman-Rubin split-R-hat per dimension; chains is (C, S, d).
+    Each chain is split in half (2C half-chains) so within-chain
+    non-stationarity inflates the statistic too."""
+    C, S, d = chains.shape
+    half = S // 2
+    s = np.concatenate([chains[:, :half], chains[:, half:2 * half]], axis=0)
+    m, n = s.shape[0], s.shape[1]
+    means = s.mean(axis=1)             # (m, d)
+    var_w = s.var(axis=1, ddof=1)      # (m, d)
+    W = var_w.mean(axis=0)
+    B = n * means.var(axis=0, ddof=1)
+    var_hat = (n - 1) / n * W + B / n
+    with np.errstate(divide="ignore", invalid="ignore"):
+        out = np.sqrt(var_hat / W)
+    return np.where(W > 0, out, 1.0)
+
+
+# --- B-pulsar fleets --------------------------------------------------------------
+
+
+class NoiseFleet:
+    """B pulsars' noise posteriors sampled as ONE device program.
+
+    Rides the fleet-fit recipe (fitting/batch.py): every member's rows are
+    padded up to a shared power-of-two bucket (pad rows carry mask=0 and
+    vanish from every reduction — the masked `logdet_C` keeps the white
+    log-det exact), the (params0, data) operands are stacked on a new
+    leading batch axis, and the chain kernel is vmapped over (B, C) so
+    B pulsars x C chains advance together. Members must share a model
+    skeleton and hyperparameter set (the fleet contract; a mixed fleet
+    belongs in separate NoiseFleets)."""
+
+    def __init__(self, likelihoods: list[NoiseLikelihood]):
+        from pint_tpu.fitting.batch import bucket_rows, stack_trees
+        from pint_tpu.ops.compile import _args_signature
+
+        if not likelihoods:
+            raise ValueError("empty fleet")
+        self.members = list(likelihoods)
+        nl0 = self.members[0]
+        self.hyper = nl0.hyper
+        for nl in self.members:
+            if nl.hyper != self.hyper:
+                raise ValueError(
+                    f"fleet hyper mismatch: {nl.hyper} vs {self.hyper}")
+            if nl.p_lin != nl0.p_lin:
+                raise ValueError("fleet timing-design width mismatch")
+        rows = max(bucket_rows(nl._n_data, 1)[0] for nl in self.members)
+        self.rows = rows
+        datas = [nl._layout(1, chunk=rows)[0] for nl in self.members]
+        sig0 = _args_signature(datas[0])
+        for d in datas[1:]:
+            if _args_signature(d) != sig0:
+                raise ValueError(
+                    "fleet operand-signature mismatch: members must share "
+                    "a model skeleton (component graph, Fourier mode "
+                    "counts, ECORR epoch counts)")
+        self.data = stack_trees(datas)
+        self.params0 = stack_trees([nl._params0 for nl in self.members])
+        self._progs: dict = {}
+
+    def sample(self, n_chains: int | None = None, nsteps: int = 500,
+               warmup: int | None = None, kernel: str = "hmc",
+               seed: int = 0,
+               max_leapfrog: int | None = None) -> list[NoiseChains]:
+        """Sample every member: (B, C) chains as one executable; returns
+        per-member NoiseChains (input order)."""
+        if n_chains is None:
+            n_chains = int(knobs.get("PINT_TPU_NOISE_CHAINS") or 4)
+        if warmup is None:
+            warmup = (int(knobs.get("PINT_TPU_NUTS_WARMUP") or 0)
+                      or max(nsteps // 2, 32))
+        nl0 = self.members[0]
+        nd = len(self.hyper)
+        nwalkers = max(2 * nd + 2, 8)
+        one_chain = nl0._chain_kernel(kernel, nsteps, warmup,
+                                      max_leapfrog)
+        # chains vmap inside pulsars: (B, C) advance as one executable
+        vchain = jax.vmap(one_chain, in_axes=(0, 0, None, None, None, None))
+        bchain = jax.vmap(vchain, in_axes=(0, 0, 0, 0, 0, 0))
+        from pint_tpu.ops.compile import TimedProgram, precision_jit
+
+        key = (kernel, nsteps, warmup, max_leapfrog,
+               len(self.members), n_chains)
+        prog = self._progs.get(key)
+        if prog is None:
+            prog = self._progs[key] = TimedProgram(
+                precision_jit(bchain), f"noise_fleet_chain_{kernel}")
+
+        B = len(self.members)
+        z0 = np.zeros((B, n_chains, nwalkers, nd) if kernel == "stretch"
+                      else (B, n_chains, nd))
+        keys = []
+        centers = np.stack([nl.x0 for nl in self.members])
+        scales = np.stack([nl.laplace_scales() for nl in self.members])
+        for b, nl in enumerate(self.members):
+            z0[b], kb = nl._chain_starts(
+                kernel, nd, nwalkers, seed + b, list(range(n_chains)),
+                centers[b], scales[b])
+            keys.append(kb)
+        with perf.stage("noise"):
+            with perf.stage("chain"):
+                out = prog(jnp.asarray(z0), jnp.stack(keys),
+                           jnp.asarray(centers), jnp.asarray(scales),
+                           self.params0, self.data)
+        steps = B * n_chains * nsteps * (nwalkers if kernel == "stretch" else 1)
+        perf.add("noise_chain_steps", steps)
+        perf.add("noise_loglike_evals", steps)
+        results = []
+        for b, nl in enumerate(self.members):
+            div = np.asarray(out.get("divergent", np.zeros((B, 1))))[b]
+            res = NoiseChains(
+                hyper=self.hyper,
+                samples=np.asarray(out["samples"][b]),
+                lnpost=np.asarray(out["lnpost"][b]),
+                accept_frac=float(np.mean(np.asarray(out["accept"][b]))),
+                divergences=int(div.sum()),
+                kernel=kernel,
+                warmup=warmup if kernel != "stretch" else 0,
+            )
+            results.append(res)
+        perf.add("noise_divergences", sum(r.divergences for r in results))
+        return results

@@ -170,7 +170,10 @@ def shard_fit_rows(model, tensor, vecs: dict, n_shards: int,
             blk = a[k * chunk : (k + 1) * chunk]
             n_pad = chunk - blk.shape[0]
             if n_pad:
-                blk = np.concatenate([blk, np.full((n_pad,), fill, a.dtype)])
+                # row-indexed matrices (the noise likelihood's fixed design
+                # columns) pad exactly like vectors: fill rows, axis 0
+                pad = np.full((n_pad,) + a.shape[1:], fill, a.dtype)
+                blk = np.concatenate([blk, pad])
             blocks.append(blk)
         return jnp.asarray(np.concatenate(blocks))
 

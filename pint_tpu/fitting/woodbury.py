@@ -199,11 +199,20 @@ def woodbury_chi2(
 
 
 def logdet_C(basis: NoiseBasis | None, w: Array, sf: SFactor | None = None,
-             reduce=_ident) -> Array:
+             reduce=_ident, mask: Array | None = None) -> Array:
     """log |C| = -sum log w + log|S| + sum log phi (Woodbury determinant
     lemma); the basis is parameter-independent but phi is not, so the full
-    value matters for noise-parameter sampling."""
-    out = -reduce(jnp.sum(jnp.log(w)))
+    value matters for noise-parameter sampling.
+
+    `mask` (0/1 per row) restricts the white -sum(log w) term to real data
+    rows: bucket-padded rows (fitting/batch.py, noise_like.py) carry w=0,
+    which vanishes from every w-weighted reduction but would turn
+    log(w) into -inf here."""
+    if mask is not None:
+        logw = jnp.where(mask > 0, jnp.log(jnp.where(mask > 0, w, 1.0)), 0.0)
+        out = -reduce(jnp.sum(logw))
+    else:
+        out = -reduce(jnp.sum(jnp.log(w)))
     if basis is None:
         return out
     if sf is None:

@@ -45,6 +45,14 @@ class NoiseComponent(Component):
         """Rescale per-TOA sigmas (seconds); identity by default."""
         return sigma
 
+    def hyper_param_names(self, params: dict) -> list[str]:
+        """Noise HYPERPARAMETERS this component owns among `params` — the
+        sampling/optimization targets of the marginalized GP likelihood
+        (fitting/noise_like.py). Default: the bound mask parameters
+        (EFAC1, EQUAD1, ECORR1, ...); power-law components add their
+        amplitude/index pairs."""
+        return [mp.name for mp in self.mask_params if mp.name in params]
+
     def basis_and_weights(self, params: dict, tensor: dict, sl):
         """Tagged basis contribution for correlated components, else None:
         ``("dense", F (N_data, kd), phi (kd,))`` for Fourier-mode bases or
@@ -295,6 +303,11 @@ class PLRedNoise(NoiseComponent):
         fac = (86400.0 * 365.24 * 1e6) / (2.0 * np.pi * np.sqrt(3.0))
         return leaf_to_f64(params["RNAMP"]) / fac, -leaf_to_f64(params["RNIDX"])
 
+    def hyper_param_names(self, params):
+        if "TNREDAMP" in params and "TNREDGAM" in params:
+            return ["TNREDAMP", "TNREDGAM"]
+        return [n for n in ("RNAMP", "RNIDX") if n in params]
+
     def basis_and_weights(self, params, tensor, sl):
         t = tensor["t_hi"][sl]
         F, freqs = fourier_basis(t, self.nf, tensor["noise_tspan"][0, 0])
@@ -328,6 +341,9 @@ class PLDMNoise(NoiseComponent):
         self.nf = int(meta.get("TNDMC", 30))
         if "TNDMAMP" not in params or "TNDMGAM" not in params:
             raise ValueError("PLDMNoise needs TNDMAMP and TNDMGAM")
+
+    def hyper_param_names(self, params):
+        return [n for n in ("TNDMAMP", "TNDMGAM") if n in params]
 
     def host_columns(self, toas, params):
         cols = super().host_columns(toas, params)

@@ -45,8 +45,8 @@ from pint_tpu.utils import knobs
 
 __all__ = [
     "PerfReport", "active", "add", "collect", "enable", "enabled",
-    "fit_breakdown", "instrument_fit", "prepare_breakdown", "put",
-    "put_default", "stage",
+    "fit_breakdown", "instrument_fit", "noise_breakdown",
+    "prepare_breakdown", "put", "put_default", "stage",
 ]
 
 _env_enabled = knobs.flag("PINT_TPU_PERF")
@@ -259,6 +259,64 @@ def prepare_breakdown(rep: PerfReport) -> dict:
     serve_s = max(comp["ephemeris"] - kb_in_ephemeris, 0.0)
     out["ephemeris_serve_us_per_toa"] = (
         round(serve_s / serve_toas * 1e6, 3) if serve_toas else None)
+    return out
+
+
+# --- the canonical noise-analysis breakdown --------------------------------------
+
+#: noise sub-stages named in the breakdown (fitting/noise_like.py): basis
+#: construction + (r0, M) linearization (`build`), batched likelihood/
+#: gradient evaluations (`eval`), vmapped chain programs (`chain`) and
+#: the batched optimizer restarts (`optimize`); anything else directly
+#: under a `noise` stage lands in noise_other_s.
+_NOISE_COMPONENTS = ("build", "eval", "chain", "optimize")
+
+
+def noise_breakdown(rep: PerfReport) -> dict:
+    """Map "noise"-rooted stages into the canonical noise breakdown.
+
+    The contract (enforced by the --smoke --noise bench, tests/
+    test_noise_like.py): named components + compile + trace + other
+    account for the noise wall, so the Bayesian-engine telemetry cannot
+    silently rot. Counters: `noise_loglike_evals` is every marginalized
+    likelihood (or gradient) evaluation served, `noise_chain_steps` is
+    chain-step draws (walker-steps for the stretch kernel),
+    `noise_divergences` counts masked divergent HMC trajectories.
+    """
+    wall = 0.0
+    comp = {leaf: 0.0 for leaf in _NOISE_COMPONENTS}
+    nested_ct = {leaf: 0.0 for leaf in _NOISE_COMPONENTS}
+    compile_s = trace_s = 0.0
+    direct = 0.0
+    for path, (total, _count) in rep.timings.items():
+        segs = path.split("/")
+        if "noise" not in segs:
+            continue
+        i = segs.index("noise")
+        if len(segs) == i + 1:
+            wall += total
+        elif len(segs) == i + 2:
+            direct += total
+            if segs[-1] in comp:
+                comp[segs[-1]] += total
+        if segs[-1] in ("compile", "trace") and len(segs) > i + 1:
+            if segs[-1] == "compile":
+                compile_s += total
+            else:
+                trace_s += total
+            if len(segs) > i + 2 and segs[i + 1] in nested_ct:
+                nested_ct[segs[i + 1]] += total
+    out = {"noise_wall_s": round(wall, 4)}
+    for leaf in _NOISE_COMPONENTS:
+        # compile/trace nests inside the component that triggered it:
+        # subtract so the named fields partition the wall
+        out[f"noise_{leaf}_s"] = round(comp[leaf] - nested_ct[leaf], 4)
+    out["noise_compile_s"] = round(compile_s, 4)
+    out["noise_trace_s"] = round(trace_s, 4)
+    out["noise_other_s"] = round(max(wall - direct, 0.0), 4)
+    out["noise_loglike_evals"] = int(rep.counters.get("noise_loglike_evals", 0))
+    out["noise_chain_steps"] = int(rep.counters.get("noise_chain_steps", 0))
+    out["noise_divergences"] = int(rep.counters.get("noise_divergences", 0))
     return out
 
 

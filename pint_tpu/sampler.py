@@ -1,4 +1,4 @@
-"""Ensemble MCMC: affine-invariant stretch sampler, fully jitted.
+"""Device-resident MCMC kernels: stretch ensembles + HMC chains.
 
 Reference: pint/sampler.py (EmceeSampler:60 wrapping emcee) and
 mcmc_fitter.py. TPU re-design: the Goodman & Weare (2010) stretch move is
@@ -7,9 +7,28 @@ jitted ln-posterior, the two half-ensembles update alternately (the
 standard parallel variant, Foreman-Mackey et al. 2013 §3), and the whole
 chain is ONE `lax.scan` compiled program. Fixed-seed deterministic
 (SURVEY §4.6).
+
+Two composable chain BUILDERS serve the noise engine
+(fitting/noise_like.py) and any other posterior:
+
+- `make_stretch_chain(lnpost, nsteps)`: the ensemble move as a
+  scan-kernel over (walkers, ndim) state, with arbitrary trailing
+  context operands threaded to the posterior;
+- `make_hmc_chain(lnpost, nsteps, warmup, ...)`: Hamiltonian Monte Carlo
+  with dual-averaging step-size warmup (Hoffman & Gelman 2014, Alg. 5 —
+  the NUTS adaptation recipe on a fixed-length leapfrog trajectory) as
+  ONE `lax.scan`. Divergent trajectories (non-finite or exploding
+  energy) are rejected by `where` masks — under `jax.vmap` each chain
+  masks its own divergences, so C chains advance in lockstep as one
+  executable with per-chain trajectories identical to solo runs.
+
+Both kernels take `lnpost(x, *ctx)`; vmapping over chains/pulsars is the
+caller's composition (noise_like.NoiseLikelihood.sample / NoiseFleet).
 """
 
 from __future__ import annotations
+
+import weakref
 
 import jax
 import jax.numpy as jnp
@@ -18,14 +37,166 @@ import numpy as np
 Array = jnp.ndarray
 
 
+# --- chain builders ---------------------------------------------------------------
+
+
+def make_stretch_chain(lnpost, nsteps: int, a: float = 2.0):
+    """Build the stretch-ensemble chain kernel.
+
+    Returns ``chain(x0 (W, nd), key, *ctx) -> {"samples": (S, W, nd),
+    "lnpost": (S, W), "accept": (S,)}`` — the whole chain one lax.scan.
+    """
+
+    def chain(x0, key, *ctx):
+        vln = jax.vmap(lambda x: lnpost(x, *ctx))
+
+        def half_step(key, x_move, lp_move, x_other):
+            half, nd = x_move.shape
+            k1, k2, k3 = jax.random.split(key, 3)
+            u = jax.random.uniform(k1, (half,))
+            z = ((a - 1.0) * u + 1.0) ** 2 / a
+            partners = jax.random.randint(k2, (half,), 0, half)
+            xp = x_other[partners]
+            prop = xp + z[:, None] * (x_move - xp)
+            lp_prop = vln(prop)
+            ln_accept = (nd - 1) * jnp.log(z) + lp_prop - lp_move
+            accept = jnp.log(jax.random.uniform(k3, (half,))) < ln_accept
+            x_new = jnp.where(accept[:, None], prop, x_move)
+            lp_new = jnp.where(accept, lp_prop, lp_move)
+            return x_new, lp_new, accept
+
+        def step(carry, key):
+            x, lp = carry
+            half = x.shape[0] // 2
+            ka, kb = jax.random.split(key)
+            xa, lpa, acc_a = half_step(ka, x[:half], lp[:half], x[half:])
+            xb, lpb, acc_b = half_step(kb, x[half:], lp[half:], xa)
+            x = jnp.concatenate([xa, xb])
+            lp = jnp.concatenate([lpa, lpb])
+            n_acc = jnp.sum(acc_a) + jnp.sum(acc_b)
+            return (x, lp), (x, lp, n_acc)
+
+        lp0 = vln(x0)
+        keys = jax.random.split(key, nsteps)
+        (_, _), (xs, lps, n_acc) = jax.lax.scan(step, (x0, lp0), keys)
+        return {
+            "samples": xs,
+            "lnpost": lps,
+            "accept": n_acc / x0.shape[0],
+        }
+
+    return chain
+
+
+def make_hmc_chain(lnpost, nsteps: int, warmup: int,
+                   target_accept: float = 0.8, max_leapfrog: int = 8,
+                   step_size0: float = 0.1,
+                   divergence_energy: float = 1000.0):
+    """Build the HMC chain kernel with dual-averaging warmup.
+
+    Returns ``chain(x0 (nd,), key, *ctx) -> {"samples": (S, nd),
+    "lnpost": (S,), "accept": (S,), "divergent": (S,), "step_size": ()}``
+    where S counts POST-warmup draws only; the whole (warmup + sampling)
+    trajectory is one lax.scan. The caller is expected to run in
+    unit-scaled coordinates (identity mass matrix) — noise_like wraps the
+    posterior in prior-scaled space for exactly that reason.
+
+    Dual averaging (Hoffman & Gelman 2014, Alg. 5): during warmup the log
+    step size tracks the target acceptance statistic; after warmup the
+    averaged iterate is frozen. A proposal whose energy error is
+    non-finite or exceeds `divergence_energy` is DIVERGENT: rejected
+    outright (masked per chain under vmap) and counted.
+    """
+    gamma, t0, kappa = 0.05, 10.0, 0.75
+    mu = float(np.log(10.0 * step_size0))
+    vg = jax.value_and_grad(lnpost, argnums=0)
+
+    def chain(x0, key, *ctx):
+        def vg_safe(x):
+            lp, g = vg(x, *ctx)
+            return lp, jnp.where(jnp.isfinite(g), g, 0.0)
+
+        lp0, g0 = vg_safe(x0)
+
+        def leapfrog(x, g, p, eps):
+            def lf_step(carry, _):
+                x, g, p = carry
+                p = p + 0.5 * eps * g
+                x = x + eps * p
+                lp, g = vg_safe(x)
+                p = p + 0.5 * eps * g
+                return (x, g, p), lp
+
+            (x, g, p), lps = jax.lax.scan(
+                lf_step, (x, g, p), None, length=max_leapfrog)
+            return x, g, p, lps[-1]
+
+        def step(carry, inp):
+            x, lp, g, log_eps, log_eps_bar, h_bar = carry
+            m, key = inp
+            k1, k2 = jax.random.split(key)
+            in_warmup = m < warmup
+            eps = jnp.exp(jnp.where(in_warmup, log_eps, log_eps_bar))
+            p0 = jax.random.normal(k1, x.shape)
+            h0 = -lp + 0.5 * jnp.sum(p0 * p0)
+            x1, g1, p1, lp1 = leapfrog(x, g, p0, eps)
+            h1 = -lp1 + 0.5 * jnp.sum(p1 * p1)
+            d_h = h0 - h1  # > 0 favors acceptance
+            # divergent = the PROPOSAL's energy exploded (NaN, or energy
+            # error past the threshold). d_h = +inf — escaping a start
+            # outside the prior support — is a certain accept, not a
+            # divergence, or chains initialized at lnpost = -inf would
+            # mask-reject every move forever.
+            divergent = jnp.isnan(d_h) | (d_h < -divergence_energy)
+            alpha = jnp.where(divergent, 0.0,
+                              jnp.minimum(1.0, jnp.exp(jnp.minimum(d_h, 0.0))))
+            accept = (~divergent) & (
+                jnp.log(jax.random.uniform(k2, ())) < d_h)
+            x = jnp.where(accept, x1, x)
+            lp = jnp.where(accept, lp1, lp)
+            g = jnp.where(accept, g1, g)
+            # dual averaging (warmup only; frozen after)
+            mw = jnp.minimum(m, warmup - 1) + 1.0  # 1-based warmup index
+            eta_h = 1.0 / (mw + t0)
+            h_new = (1.0 - eta_h) * h_bar + eta_h * (target_accept - alpha)
+            le_new = mu - jnp.sqrt(mw) / gamma * h_new
+            eta_x = mw ** (-kappa)
+            leb_new = eta_x * le_new + (1.0 - eta_x) * log_eps_bar
+            log_eps = jnp.where(in_warmup, le_new, log_eps)
+            log_eps_bar = jnp.where(in_warmup, leb_new, log_eps_bar)
+            h_bar = jnp.where(in_warmup, h_new, h_bar)
+            carry = (x, lp, g, log_eps, log_eps_bar, h_bar)
+            return carry, (x, lp, accept, divergent)
+
+        total = warmup + nsteps
+        keys = jax.random.split(key, total)
+        ms = jnp.arange(total, dtype=jnp.float64)
+        init = (x0, lp0, g0,
+                jnp.asarray(np.log(step_size0), jnp.float64),
+                jnp.asarray(np.log(step_size0), jnp.float64),
+                jnp.asarray(0.0, jnp.float64))
+        carry, (xs, lps, acc, div) = jax.lax.scan(step, init, (ms, keys))
+        return {
+            "samples": xs[warmup:],
+            "lnpost": lps[warmup:],
+            "accept": acc[warmup:],
+            "divergent": div[warmup:],
+            "step_size": jnp.exp(carry[4]),
+        }
+
+    return chain
+
+
+# --- the classic walker-ensemble surface ------------------------------------------
+
 #: compiled chain programs keyed on the lnpost CALLABLE (weakly, so dead
 #: posteriors — which capture whole datasets — are not pinned): re-running
 #: a fitter or resuming a chain must NOT re-trace, because the sampler
 #: graph embeds the whole posterior and rebuilding it costs far more than
 #: the sampling. Producers must hand back the SAME closure across calls
-#: (BayesianTiming/EventOptimizer memoize theirs).
-import weakref
-
+#: (BayesianTiming memoizes its posterior per (toas, model-state) so a
+#: resumed MCMCFitter — even over a deepcopied model — reuses the
+#: compiled chain; EventOptimizer memoizes too.)
 _RUN_CACHE: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
 
 
@@ -34,42 +205,17 @@ def _get_run(lnpost, a: float):
     if per_a is not None and a in per_a:
         return per_a[a]
 
-    vln = jax.vmap(lnpost)
+    from pint_tpu.ops.compile import TimedProgram, precision_jit
 
-    def half_step(key, x_move, lp_move, x_other):
-        half, nd = x_move.shape
-        k1, k2, k3 = jax.random.split(key, 3)
-        u = jax.random.uniform(k1, (half,))
-        z = ((a - 1.0) * u + 1.0) ** 2 / a
-        partners = jax.random.randint(k2, (half,), 0, half)
-        xp = x_other[partners]
-        prop = xp + z[:, None] * (x_move - xp)
-        lp_prop = vln(prop)
-        ln_accept = (nd - 1) * jnp.log(z) + lp_prop - lp_move
-        accept = jnp.log(jax.random.uniform(k3, (half,))) < ln_accept
-        x_new = jnp.where(accept[:, None], prop, x_move)
-        lp_new = jnp.where(accept, lp_prop, lp_move)
-        return x_new, lp_new, accept
+    def run(x0, key, nsteps: int):
+        return make_stretch_chain(lnpost, nsteps, a)(x0, key)
 
-    def step(carry, key):
-        x, lp = carry
-        half = x.shape[0] // 2
-        ka, kb = jax.random.split(key)
-        xa, lpa, acc_a = half_step(ka, x[:half], lp[:half], x[half:])
-        xb, lpb, acc_b = half_step(kb, x[half:], lp[half:], xa)
-        x = jnp.concatenate([xa, xb])
-        lp = jnp.concatenate([lpa, lpb])
-        n_acc = jnp.sum(acc_a) + jnp.sum(acc_b)
-        return (x, lp), (x, lp, n_acc)
-
-    @jax.jit
-    def run(x0, keys):
-        lp0 = vln(x0)
-        (_, _), (chain, lnp, n_acc) = jax.lax.scan(step, (x0, lp0), keys)
-        return chain, lnp, n_acc
-
-    _RUN_CACHE.setdefault(lnpost, {})[a] = run
-    return run
+    # static nsteps: a longer resume segment is a new program (same as the
+    # old split-key signature); the TimedProgram wrapper makes compiles
+    # visible to the perf breakdown and the jaxpr auditor
+    prog = TimedProgram(precision_jit(run, static_argnums=(2,)), "mcmc_chain")
+    _RUN_CACHE.setdefault(lnpost, {})[a] = prog
+    return prog
 
 
 def run_ensemble(lnpost, x0: np.ndarray, nsteps: int, seed: int = 0, a: float = 2.0):
@@ -86,10 +232,9 @@ def run_ensemble(lnpost, x0: np.ndarray, nsteps: int, seed: int = 0, a: float = 
     if nw % 2 or nw < 2 * nd:
         raise ValueError(f"need an even nwalkers >= 2*ndim, got {nw} for ndim {nd}")
     run = _get_run(lnpost, a)
-    keys = jax.random.split(jax.random.PRNGKey(seed), nsteps)
-    chain, lnp, n_acc = run(x0, keys)
-    accept_frac = float(jnp.sum(n_acc)) / (nsteps * nw)
-    return np.asarray(chain), np.asarray(lnp), accept_frac
+    out = run(x0, jax.random.PRNGKey(seed), nsteps)
+    accept_frac = float(jnp.mean(out["accept"]))
+    return np.asarray(out["samples"]), np.asarray(out["lnpost"]), accept_frac
 
 
 def initial_ball(scales: np.ndarray, nwalkers: int, seed: int = 0,

@@ -55,6 +55,12 @@ KNOBS: dict[str, tuple[str | None, str]] = {
     "PINT_TPU_PREPARE_CACHE_KEEP": ("32", "prepared-TOA cache entries kept (oldest pruned)"),
     # --- fitter state / warm start (fitting/state.py) --------------------------
     "PINT_TPU_WARM_START": ("0", "1: downhill fits warm-start from / save a disk snapshot of the prior fit"),
+    # --- Bayesian noise engine (fitting/noise_like.py, sampler.py) -------------
+    "PINT_TPU_NOISE_CHAINS": ("4", "vmapped noise-posterior chains per sample() call"),
+    "PINT_TPU_NOISE_RESTARTS": ("8", "batched optimizer restarts for ML noise estimation"),
+    "PINT_TPU_NUTS_WARMUP": ("0", "HMC dual-averaging warmup steps (0: half the chain length)"),
+    "PINT_TPU_NUTS_TARGET_ACCEPT": ("0.8", "dual-averaging target acceptance for the HMC kernel"),
+    "PINT_TPU_NUTS_MAX_LEAPFROG": ("16", "leapfrog steps per HMC trajectory"),
     "PINT_TPU_OBS_JSON": ("", "colon-separated extra observatories.json overlays"),
     # --- clocks ----------------------------------------------------------------
     "PINT_TPU_CLOCK_REPO": (None, "clock-corrections repository (https/file URL or directory)"),

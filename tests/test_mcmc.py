@@ -115,6 +115,48 @@ class TestMCMCFitter:
             assert abs(np.mean(flat[:, i])) < 5 * s_wls
 
 
+def test_resume_never_retraces(tmp_path, setup):
+    """The chain-resume contract (ISSUE 8 satellite): `_RUN_CACHE` keys
+    weakly on the lnpost callable, and BayesianTiming now MEMOIZES its
+    posterior closure per (toas, model state) — so a resume through a
+    fresh MCMCFitter (deepcopied model included) reuses the compiled
+    chain program: ONE step call, no chain recompile, zero retrace-budget
+    audit violations."""
+    import copy
+
+    from pint_tpu.analysis import jaxpr_audit
+    from pint_tpu.ops import perf
+
+    model, toas, _ = setup
+    backend = str(tmp_path / "chain.npz")
+    f1 = MCMCFitter(toas, copy.deepcopy(model), nwalkers=12)
+    f2 = MCMCFitter(toas, copy.deepcopy(model), nwalkers=12)
+    # the memoized closure IS the same object across fitter rebuilds
+    assert f1.bt.lnpost_fn() is f2.bt.lnpost_fn()
+
+    was = perf.enabled()
+    perf.enable(True)
+    try:
+        f1.fit_toas(nsteps=25, seed=5, backend=backend)
+        jaxpr_audit.reset_ledger()
+        f3 = MCMCFitter(toas, copy.deepcopy(model), nwalkers=12)
+        f3.fit_toas(nsteps=25, seed=5, backend=backend, resume=True)
+    finally:
+        perf.enable(was)
+    bd = f3.last_perf
+    # the whole resumed chain was ONE program dispatch...
+    assert bd["n_step_calls"] == 1
+    # ...of the ALREADY-COMPILED chain program: no mcmc_chain recompile
+    rep = f3.last_perf_report
+    assert rep.counters.get("compiled:mcmc_chain", 0) == 0
+    assert bd["fit_compile_s"] < 0.3
+    # and no dtype-only duplicate signature slipped through
+    audit = jaxpr_audit.audit_block()
+    retraces = [v for v in audit["violations"]
+                if v["pass"] in ("retrace-budget",)]
+    assert retraces == []
+
+
 def test_mcmc_backend_resume(tmp_path, setup):
     """Chain checkpoint + exact resume (the reference event_optimize
     --backend h5 capability, on the general MCMC fitter)."""
