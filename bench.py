@@ -373,6 +373,23 @@ def _kernel_fields(*reps) -> dict:
     }
 
 
+def _static_cost() -> dict:
+    """Per-headline-program static cost (pint_tpu/analysis/costmodel.py):
+    {label: {flops, hbm_bytes, collective_bytes, peak_bytes}} for every
+    program this process lowered — the hardware-free numbers future
+    BENCH rounds correlate against measured wall time (a wall-time
+    regression with flat static cost is scheduling/transfer; one that
+    tracks a flops jump is a hot-path regression)."""
+    from pint_tpu.analysis.costmodel import cost_block
+
+    return {
+        label: {"flops": rec["flops"], "hbm_bytes": rec["hbm_bytes"],
+                "collective_bytes": rec["collective_bytes"],
+                "peak_bytes": rec["peak_bytes"]}
+        for label, rec in cost_block().items()
+    }
+
+
 def _degradation_count() -> int:
     """Distinct degradation-ledger events recorded so far (ops/degrade.py);
     0 on a fully-configured clean run."""
@@ -994,6 +1011,10 @@ def main() -> None:
         # count, pass count, any invariant violations — an audit
         # regression is a bench diff, not a buried warning
         "audit": fitperf.get("audit"),
+        # static per-program cost (pint_tpu/analysis/costmodel.py):
+        # flops/hbm_bytes per headline program, the hardware-free perf
+        # ledger the cost-budget gate (analysis/cost.py) pins down
+        "static_cost": _static_cost(),
         # degradation ledger (pint_tpu/ops/degrade.py): every silent
         # corner the run cut (zero clocks, stale caches, analytic
         # ephemeris, host fallbacks) — the perf trajectory also tracks
@@ -1140,6 +1161,8 @@ def smoke_bench(ntoas: int = 300, maxiter: int = 5, sharded: bool = False,
         # (tests/test_degrade.py locks it under PINT_TPU_DEGRADED=error)
         "degradation_count": _degradation_count(),
         "degradation_kinds": _degradation_kinds(),
+        # per-program static flops/bytes (analysis/costmodel.py)
+        "static_cost": _static_cost(),
     }
     rec.update(res.perf or {})
     return rec
@@ -1339,6 +1362,7 @@ def _smoke_flagship_bench(ntoas: int, maxiter: int, grid_maxiter: int) -> dict:
         "fit_breakdown": fitperf,
         "degradation_count": _degradation_count(),
         "degradation_kinds": _degradation_kinds(),
+        "static_cost": _static_cost(),
     }
     return rec
 

@@ -19,8 +19,21 @@ Two instruments, both zero-third-party-dependency:
   code paths, no Python ``if`` on tracers, no ``float()``/``.item()``
   host syncs inside fused-loop bodies, no raw ``os.environ`` reads
   outside the sanctioned knob registry (:mod:`pint_tpu.utils.knobs`),
-  and no broad ``except`` that swallows a degradation without a ledger
-  write (``silent-except``, :mod:`pint_tpu.ops.degrade`).
+  no broad ``except`` that swallows a degradation without a ledger
+  write (``silent-except``, :mod:`pint_tpu.ops.degrade`), and no host
+  ``.hi`` read off a dd pair without its ``.lo`` (``dd-truncate``).
+- :mod:`pint_tpu.analysis.ddflow` — the dd-flow precision-dataflow
+  interpreter behind the auditor's ``dd-recombine`` /
+  ``dd-truncate-flow`` / ``dd-mix`` / ``dd-unnormalized`` passes:
+  every ``TimedProgram`` that declares a ``precision_spec`` has its
+  (hi, lo) pairs traced through the lowered jaxpr, with the
+  two_sum/quick_two_sum/two_prod chains of ops/dd.py recognized as
+  sanctioned pair ops.
+- :mod:`pint_tpu.analysis.costmodel` / :mod:`pint_tpu.analysis.cost` —
+  static per-program FLOPs / bytes / collective-payload / peak-memory
+  accounting over the same jaxprs, gated against the checked-in
+  ``cost_budgets.json`` by ``python -m pint_tpu.analysis.cost --check``
+  (the hardware-free perf-regression detector).
 
 See docs/ANALYSIS.md for the executable walkthrough.
 """
@@ -34,9 +47,11 @@ from pint_tpu.analysis.jaxpr_audit import (  # noqa: F401
     audit_program,
     reset_ledger,
 )
+from pint_tpu.analysis.ddflow import PrecisionSpec  # noqa: F401
 
 __all__ = [
     "AuditError",
+    "PrecisionSpec",
     "Violation",
     "audit_block",
     "audit_jitted",

@@ -1,0 +1,373 @@
+"""Static cost budgets: ``python -m pint_tpu.analysis.cost --check/--update``.
+
+The cost model (pint_tpu/analysis/costmodel.py) prices every lowered
+program; this module pins those prices down as a *regression gate*. It
+rebuilds each headline program — the fused WLS/GLS fit, the batched
+fleet fit, the chi² grid scan, the device-prepare programs (geometry /
+analytic ephemeris / Chebyshev kernel-pack serve), and the Bayesian
+noise likelihood + HMC chain — at fixed canonical shapes (tiny synthetic
+datasets, fixed seeds: the jaxpr, and therefore the static cost, depends
+only on shapes), prices the traced jaxprs WITHOUT compiling anything,
+and compares against the checked-in ``cost_budgets.json`` beside this
+file.
+
+``--check`` (the tier-1 gate, tests/test_cost.py) fails when any
+program's ``flops`` / ``bytes_read`` / ``bytes_written`` /
+``collective_bytes`` / ``peak_bytes`` grew more than
+``PINT_TPU_COST_BUDGET_TOL`` (default 15%) past its budget, when a
+headline program is missing from the budgets (coverage), or when the
+budgets list a program that no longer builds (stale). ``--update``
+regenerates the file — the explicit, reviewable act the gate exists to
+force: a hot-path change that adds FLOPs must either shrink back or
+check in its new budget with the diff that explains it.
+
+This is the perf-regression detector for rounds where no TPU bench can
+run: a duplicated ephemeris series or an accidental O(N·p²) reduction
+fails tier-1 the day it lands, not a bench round later.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+
+import numpy as np
+
+from pint_tpu.analysis import costmodel
+from pint_tpu.utils import knobs
+
+__all__ = ["BUDGET_PATH", "build_headline_costs", "check_budgets",
+           "load_budgets", "update_budgets", "main"]
+
+BUDGET_PATH = Path(__file__).resolve().parent / "cost_budgets.json"
+
+#: canonical dataset shapes — budgets are pinned at these; changing them
+#: is a budget regen, not a silent re-baseline
+CANON = {"ntoas": 60, "noise_ntoas": 48, "batch": 3, "grid_pts": 4,
+         "chain_steps": 8, "chain_warmup": 4, "seed": 7}
+
+_WLS_PAR = """
+PSR COST
+RAJ 04:37:15.9 1
+DECJ -47:15:09.1 1
+F0 173.6879489990983 1
+F1 -1.728e-15 1
+PEPOCH 55000
+DM 2.64 1
+"""
+
+_GLS_PAR = """
+PSR COSTG
+RAJ 04:37:15.9 1
+DECJ -47:15:09.1 1
+F0 173.6879489990983 1
+F1 -1.728e-15 1
+PEPOCH 55000
+DM 2.64 1
+EFAC -f L_wide 1.02
+EQUAD -f L_wide 0.01
+ECORR -f L_wide 0.01
+EFAC -f S_wide 1.03
+EQUAD -f S_wide 0.01
+ECORR -f S_wide 0.01
+"""
+
+
+def _model_toas(par_text: str, ntoas: int, flags: bool = False):
+    from pint_tpu.io.par import parse_parfile
+    from pint_tpu.models.builder import build_model
+    from pint_tpu.simulation import (make_fake_toas_fromMJDs,
+                                     make_fake_toas_uniform)
+
+    model = build_model(parse_parfile(par_text, from_text=True))
+    rng = np.random.default_rng(CANON["seed"])
+    if not flags:
+        toas = make_fake_toas_uniform(
+            54500, 55500, ntoas, model, obs="gbt", freq_mhz=1400.0,
+            error_us=1.0, add_noise=True, rng=rng)
+        return model, toas
+    # epoch/receiver structure so the ECORR masks bind
+    n_epochs = max(ntoas // 4, 2)
+    mjds, freqs, flag_list = [], [], []
+    for i, emjd in enumerate(np.linspace(54600.0, 55400.0, n_epochs)):
+        fname = "L_wide" if i % 2 == 0 else "S_wide"
+        for j, f in enumerate((1200.0, 1400.0, 1600.0, 1800.0)):
+            mjds.append(emjd + j * 0.1 / 86400.0)
+            freqs.append(f)
+            flag_list.append({"f": fname})
+    toas = make_fake_toas_fromMJDs(
+        np.array(mjds), model, obs="gbt", freq_mhz=np.array(freqs),
+        error_us=1.0, flags=flag_list, add_noise=True, rng=rng)
+    return model, toas
+
+
+def _trace_cost(prog, args) -> tuple[str, dict]:
+    """(label, cost record) by TRACING the TimedProgram — no compile."""
+    closed = prog.jfn.trace(*args).jaxpr
+    return prog.label, costmodel.program_cost(closed)
+
+
+# --- per-headline-program builders ------------------------------------------------
+# each returns (label, cost record); they run on any backend but are
+# canonical on the CPU tier-1 environment (mesh=None: 1-device programs,
+# so the virtual multi-device test mesh cannot skew the budgets)
+
+
+def _build_fused_wls():
+    from pint_tpu.fitting import DownhillWLSFitter
+    from pint_tpu.fitting.sharded import fused_fit_program
+
+    model, toas = _model_toas(_WLS_PAR, CANON["ntoas"])
+    ftr = DownhillWLSFitter(toas, model, fused=True)
+    return _trace_cost(*fused_fit_program(ftr))
+
+
+def _build_fused_gls():
+    from pint_tpu.fitting import DownhillGLSFitter
+    from pint_tpu.fitting.sharded import fused_fit_program
+
+    model, toas = _model_toas(_GLS_PAR, CANON["noise_ntoas"], flags=True)
+    ftr = DownhillGLSFitter(toas, model, fused=True)
+    return _trace_cost(*fused_fit_program(ftr))
+
+
+def _build_batched():
+    from pint_tpu.fitting import DownhillWLSFitter
+    from pint_tpu.fitting.batch import batched_fit_program
+
+    fitters = []
+    for k in range(CANON["batch"]):
+        model, toas = _model_toas(_WLS_PAR, CANON["ntoas"] + 4 * k)
+        fitters.append(DownhillWLSFitter(toas, model, fused=True))
+    return _trace_cost(*batched_fit_program(fitters))
+
+
+def _build_grid():
+    import jax.numpy as jnp
+
+    from pint_tpu import gridutils
+    from pint_tpu.fitting import DownhillWLSFitter
+
+    from pint_tpu.models.base import leaf_to_f64
+
+    model, toas = _model_toas(_WLS_PAR, CANON["ntoas"])
+    ftr = DownhillWLSFitter(toas, model)
+    parnames = ("F0", "F1")
+    free = tuple(n for n in model.free_params if n not in parnames)
+    f0 = float(np.asarray(leaf_to_f64(model.params["F0"])))
+    pts = np.stack([
+        np.repeat(np.linspace(f0 - 1e-9, f0 + 1e-9, 2), 2),
+        np.tile(np.linspace(-2e-15, -1e-15, 2), 2),
+    ], axis=1)[:CANON["grid_pts"]]
+    tiles, batch = gridutils._grid_tiles(pts, None)
+    fn, _key = gridutils._grid_single_fn(
+        model, parnames, free, ftr.resids.subtract_mean, 1, batch,
+        correlated=False)
+    params = model.xprec.convert_params(model.params)
+    data = gridutils._host_data(ftr.resids, ftr.tensor)
+    return _trace_cost(fn, (jnp.asarray(tiles), params, data))
+
+
+def _build_prepare_geometry():
+    from pint_tpu.astro import device_prepare
+
+    prog = device_prepare._build_geometry_program()
+    itrf = np.array([882589.65, -4924872.32, 3943729.35])
+    ut1 = np.linspace(55000.0, 55010.0, CANON["ntoas"])
+    tj = (ut1 - 51544.5) / 36525.0
+    z = np.zeros(CANON["ntoas"])
+    return _trace_cost(prog, (itrf, ut1, tj, z, z))
+
+
+def _build_prepare_ephemeris():
+    from pint_tpu.astro import device_prepare
+
+    prog = device_prepare._build_analytic_program(("earth", "sun", "moon"),
+                                                  16.0)
+    tj = np.linspace(0.5, 0.51, CANON["ntoas"])
+    return _trace_cost(prog, (tj,))
+
+
+def _build_kernel_eval():
+    from pint_tpu.astro import device_prepare
+
+    # synthetic pack tensors at flagship-like depth: 2 rows (an SSB chain),
+    # 16 records, 13 Chebyshev coefficients, 3 dims — the pack tensors
+    # ride the argument list, so only the shapes matter for the cost
+    nrows, nrec, C = 2, 16, 13
+    prog = device_prepare._build_kernel_program(((0, 1),), C)
+    rng = np.random.default_rng(CANON["seed"])
+    coef = rng.standard_normal((nrows, nrec, C, 3))
+    init = np.zeros(nrows)
+    intlen = np.full(nrows, 86400.0)
+    mid = init[:, None] + intlen[:, None] * (np.arange(nrec) + 0.5)
+    nrec_arr = np.full(nrows, nrec, np.int64)
+    t = np.linspace(0.5, 0.50001, CANON["ntoas"])
+    return _trace_cost(prog, (t, coef, mid, init, intlen, nrec_arr))
+
+
+def _noise_likelihood():
+    from pint_tpu.fitting.noise_like import NoiseLikelihood
+
+    model, toas = _model_toas(_GLS_PAR, CANON["noise_ntoas"], flags=True)
+    return NoiseLikelihood(toas, model)
+
+
+def _build_noise_loglike(nl=None):
+    import jax.numpy as jnp
+
+    nl = nl or _noise_likelihood()
+    eta = jnp.asarray(nl.x0)
+    return _trace_cost(nl._programs.loglike, (eta, nl._params0, nl.data))
+
+
+def _build_noise_chain(nl=None):
+    import jax
+
+    nl = nl or _noise_likelihood()
+    nd = nl.nparams
+    one = nl._chain_kernel("hmc", CANON["chain_steps"],
+                           CANON["chain_warmup"], 4)
+    vchain = jax.vmap(one, in_axes=(0, 0, None, None, None, None))
+    scales = np.ones(nd)
+    z0, keys = nl._chain_starts("hmc", nd, 0, CANON["seed"], [0, 1],
+                                nl.x0, scales)
+    import jax.numpy as jnp
+
+    from pint_tpu.ops.compile import TimedProgram, precision_jit
+
+    prog = TimedProgram(precision_jit(vchain), "noise_chain_hmc",
+                        precision_spec=nl.model.xprec.name)
+    return _trace_cost(prog, (jnp.asarray(z0), keys, jnp.asarray(nl.x0),
+                              jnp.asarray(scales), nl._params0,
+                              nl._plain_data))
+
+
+def build_headline_costs(verbose=print) -> dict[str, dict]:
+    """{label: cost record} for every headline program at the canonical
+    shapes. Raises on any builder failure — coverage is the contract."""
+    out: dict[str, dict] = {}
+    nl = None
+    for name, build in (
+        ("fused WLS fit", _build_fused_wls),
+        ("fused GLS fit", _build_fused_gls),
+        ("batched fleet fit", _build_batched),
+        ("chi2 grid", _build_grid),
+        ("prepare geometry", _build_prepare_geometry),
+        ("prepare ephemeris", _build_prepare_ephemeris),
+        ("kernel-pack eval", _build_kernel_eval),
+        ("noise loglike", lambda: _build_noise_loglike(nl)),
+        ("noise chain", lambda: _build_noise_chain(nl)),
+    ):
+        if name == "noise loglike" and nl is None:
+            nl = _noise_likelihood()
+        label, rec = build()
+        out[label] = rec
+        verbose(f"  {label:<24s} flops={rec['flops']:>12d} "
+                f"hbm={(rec['bytes_read'] + rec['bytes_written']):>12d} "
+                f"peak={rec['peak_bytes']:>11d}")
+    return out
+
+
+# --- budget file ------------------------------------------------------------------
+
+
+def load_budgets(path=None) -> dict:
+    path = Path(path or BUDGET_PATH)
+    with open(path) as f:
+        return json.load(f)
+
+
+def update_budgets(path=None, verbose=print) -> dict:
+    import jax
+
+    path = Path(path or BUDGET_PATH)
+    costs = build_headline_costs(verbose=verbose)
+    doc = {
+        "_comment": "static per-program cost budgets — regen with "
+                    "`python -m pint_tpu.analysis.cost --update` "
+                    "(see analysis/cost.py for the canonical shapes)",
+        "jax_version": jax.__version__,
+        "canonical": dict(CANON),
+        "programs": costs,
+    }
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=1, sort_keys=True)
+        f.write("\n")
+    verbose(f"wrote {len(costs)} program budgets to {path}")
+    return doc
+
+
+def check_budgets(path=None, tol: float | None = None,
+                  costs: dict | None = None,
+                  verbose=print) -> tuple[bool, list[str]]:
+    """Gate: (ok, failure lines). ``costs`` injects precomputed costs
+    (tests); default rebuilds the headline programs."""
+    if tol is None:
+        tol = float(knobs.get("PINT_TPU_COST_BUDGET_TOL") or 0.15)
+    doc = load_budgets(path)
+    budgets = doc.get("programs", {})
+    if costs is None:
+        costs = build_headline_costs(verbose=verbose)
+    failures: list[str] = []
+    for label in sorted(budgets):
+        if label not in costs:
+            failures.append(
+                f"{label}: budgeted program no longer builds — stale "
+                "budget entry, regen with --update")
+    for label in sorted(costs):
+        if label not in budgets:
+            failures.append(
+                f"{label}: headline program has NO checked-in budget — "
+                "run `python -m pint_tpu.analysis.cost --update`")
+            continue
+        for metric in costmodel.METRICS:
+            new = float(costs[label].get(metric, 0))
+            old = float(budgets[label].get(metric, 0))
+            if new > old * (1.0 + tol) and new - old > 1024:
+                failures.append(
+                    f"{label}: {metric} grew {old:.0f} -> {new:.0f} "
+                    f"(+{(new / max(old, 1.0) - 1.0) * 100:.1f}%, tol "
+                    f"{tol * 100:.0f}%) — shrink the hot path back or "
+                    "regen the budget with --update and justify the "
+                    "growth in the diff")
+    return not failures, failures
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="python -m pint_tpu.analysis.cost",
+        description="static per-program cost budgets (module docstring)")
+    g = ap.add_mutually_exclusive_group(required=True)
+    g.add_argument("--check", action="store_true",
+                   help="rebuild headline programs, gate against budgets")
+    g.add_argument("--update", action="store_true",
+                   help="rebuild headline programs, write the budgets")
+    g.add_argument("--show", action="store_true",
+                   help="print the checked-in budgets")
+    ap.add_argument("--tol", type=float, default=None,
+                    help="override PINT_TPU_COST_BUDGET_TOL")
+    ap.add_argument("--budgets", default=None,
+                    help=f"budget file (default {BUDGET_PATH})")
+    args = ap.parse_args(argv)
+    if args.show:
+        print(json.dumps(load_budgets(args.budgets), indent=1,
+                         sort_keys=True))
+        return 0
+    if args.update:
+        update_budgets(args.budgets)
+        return 0
+    ok, failures = check_budgets(args.budgets, tol=args.tol)
+    for line in failures:
+        print(f"FAIL {line}")
+    if ok:
+        print("cost budgets: clean")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

@@ -1,0 +1,766 @@
+"""dd-flow: double-double precision dataflow analysis over lowered jaxprs.
+
+The framework's whole claim to the reference's ~10 ns contract rests on
+the dd64 double-double discipline (ops/dd.py) replacing ``np.longdouble``
+on accelerators: precision-critical quantities ride as unevaluated
+``hi + lo`` float64 pairs, and every operation on them must either be an
+error-free transform (Knuth two_sum, Dekker split/two_prod) or a
+sanctioned collapse. Nothing in the type system enforces that — a single
+plain ``add`` recombining a pair, or a phase output fed from ``hi``
+alone, silently throws away 53 bits and only shows up µs-late in a bench
+round. This module turns that discipline into a *static check*: an
+abstract interpreter walks the lowered jaxpr of every
+:class:`~pint_tpu.ops.compile.TimedProgram`, assigns each intermediate a
+precision-lattice label, and reports definite violations into the
+jaxpr-audit ledger (pint_tpu/analysis/jaxpr_audit.py).
+
+Labels
+------
+Each jaxpr variable carries one of:
+
+``dd-hi(k)`` / ``dd-lo(k)``
+    The hi / lo member of tracked pair ``k``. Pairs are seeded from the
+    call arguments (``DD`` NamedTuple leaves, and dict columns paired by
+    a ``<stem>_hi``/``<stem>_lo`` naming convention like the tensor's
+    ``t_hi``/``t_lo``) and created by recognized error-free transforms.
+``loacc``
+    A compensation term in flight: plain accumulation of lo members
+    (``s2 + t1`` inside dd_add) awaiting a renormalizing quick_two_sum.
+``collapsed(k)``
+    The f64 result of plainly adding ``hi(k) + lo(k)`` — the sanctioned
+    ``dd_to_float`` collapse. Legal as an f64 from then on; feeding it
+    *directly* back into pair arithmetic is the dd-recombine bug.
+``f32up``
+    An f64 value produced by upcasting an f32: it carries only 24 bits
+    of information, so combining it with a dd pair member is still the
+    dd-mix bug even though the dtypes match at the op.
+``f64`` / ``f32`` / ``int``
+    Plain values by dtype.
+
+Error-free transforms are recognized *structurally*: the exact eqn DAGs
+``two_sum``/``quick_two_sum`` (add + Dekker error chain) and
+``two_prod`` (mul + splitter chain, splitter literal 2^27+1) from
+ops/dd.py. Matched chains are sanctioned — their internal plain adds and
+subs are the algorithm, not violations — and their outputs become a new
+tracked pair. ``lax.while_loop``/``scan``/``cond`` bodies and
+``pjit``/``shard_map``/custom-call sub-jaxprs are re-entered with the
+caller's labels; loop carries meet their init and body labels (one pass,
+labels only ever decay).
+
+Passes (reported through the audit ledger under these names)
+------------------------------------------------------------
+``dd-recombine``
+    A pair recombined by an unsanctioned op: ``mul(hi(k), lo(k))`` of
+    the same pair, or a ``collapsed(k)`` value fed directly into an
+    error-free transform (collapse-then-resplit: the lo bits are
+    already gone).
+``dd-truncate-flow``
+    A dd-labeled output reachable from ``hi`` without its ``lo``: an
+    output leaf labeled ``hi(k)`` whose partner ``lo(k)`` is not among
+    the outputs (spec ``dd_out="auto"``), or an explicitly declared
+    output pair whose lo slot does not carry the hi's compensation.
+``dd-mix``
+    A dd-labeled operand combined with an f32 operand in arithmetic,
+    outside ``qf32``-mode programs (where f32 pairs are the contract).
+``dd-unnormalized``
+    A declared dd output pair assembled with no renormalizing
+    two_sum/quick_two_sum on the path (both members plain f64): the
+    ``|lo| <= ulp(hi)/2`` invariant every downstream dd op assumes was
+    never established.
+
+Programs declare their discipline with ``precision_spec=`` on
+:class:`~pint_tpu.ops.compile.TimedProgram` — a :class:`PrecisionSpec`
+or the shorthand string ``"dd64"`` / ``"qf32"`` / ``"f64"``. Programs
+with no spec are not flow-analyzed (the ``dd-spec`` audit pass nags,
+warn-level, when such a program carries dd operands). The
+``PINT_TPU_DDFLOW`` knob (default on) disables the flow passes entirely
+when ``0``.
+
+The analysis is deliberately *conservative in what it flags*: any
+construct it cannot prove is a definite violation decays the label to
+plain f64 and stays quiet — it under-approximates, like the AST lint,
+so a pass firing always means a real discipline break.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+from pint_tpu.utils import knobs
+
+__all__ = [
+    "PrecisionSpec", "FlowResult", "analyze_closed", "arg_dd_pairs",
+    "enabled", "normalize_spec", "DDFLOW_PASSES",
+]
+
+#: audit-ledger pass names this module reports under
+DDFLOW_PASSES = ("dd-recombine", "dd-truncate-flow", "dd-mix",
+                 "dd-unnormalized")
+
+#: Dekker splitter literal for binary64 (2^27 + 1) — ops/dd.py _SPLITTER
+_SPLITTER = 134217729.0
+
+
+class PrecisionSpec(NamedTuple):
+    """The precision discipline a program declares for dd-flow.
+
+    ``mode``
+        ``"dd64"`` (f64 pairs — the default discipline), ``"qf32"``
+        (quad-float32: f32 components by contract, dd-mix and the f64
+        demotion audit are exempt) or ``"f64"`` (plain f64 — no pair
+        operands expected, flow still tracks any that appear).
+    ``dd_out``
+        ``"auto"`` (default): any output leaf labeled ``hi(k)`` must
+        have its ``lo(k)`` among the outputs. ``False``: outputs are
+        not checked (a program that deliberately collapses). A tuple of
+        ``(hi_index, lo_index)`` flat output-leaf pairs: those slots
+        must carry a properly renormalized pair (arming the
+        dd-unnormalized pass).
+    """
+
+    mode: str = "dd64"
+    dd_out: object = "auto"
+
+
+def normalize_spec(spec):
+    """None | PrecisionSpec | shorthand string -> PrecisionSpec | None."""
+    if spec is None or isinstance(spec, PrecisionSpec):
+        return spec
+    if isinstance(spec, str):
+        return PrecisionSpec(mode=spec)
+    raise TypeError(
+        f"precision_spec must be a PrecisionSpec or mode string, got "
+        f"{type(spec).__name__}")
+
+
+def enabled() -> bool:
+    """PINT_TPU_DDFLOW knob: anything but "0" runs the flow passes."""
+    return knobs.get("PINT_TPU_DDFLOW") != "0"
+
+
+# --- labels -----------------------------------------------------------------------
+
+
+class _Label(NamedTuple):
+    kind: str            # hi | lo | loacc | collapsed | f64 | f32 | int
+    pair: int | None = None
+
+
+_F64 = _Label("f64")
+_F32 = _Label("f32")
+_INT = _Label("int")
+
+#: primitives whose single-dd-operand output keeps the pair association
+#: (value-preserving or exact-per-element transforms; the non-dd
+#: operands — indices, sizes — ride into the derivation fingerprint)
+_STRUCTURAL = {
+    "copy", "device_put", "reshape", "squeeze", "expand_dims",
+    "broadcast_in_dim", "transpose", "rev", "slice", "dynamic_slice",
+    "gather", "neg", "stop_gradient",
+}
+#: primitives where SAME-kind dd operands keep the pair through a
+#: consistent derivation (hi slots and lo slots derive with the same
+#: key, so select-merged / concatenated pairs stay associated)
+_PARALLEL = {"select_n", "concatenate"}
+#: arithmetic primitives the dd-mix pass cares about
+_ARITH = {
+    "add", "sub", "mul", "div", "max", "min", "pow", "rem", "atan2",
+    "nextafter", "add_any",
+}
+
+
+def _decay(aval) -> _Label:
+    dt = str(getattr(aval, "dtype", ""))
+    if dt == "float32":
+        return _F32
+    if dt.startswith(("int", "uint", "bool")):
+        return _INT
+    return _F64
+
+
+def _is_var(atom) -> bool:
+    return not hasattr(atom, "val")  # Literals carry .val, Vars do not
+
+
+def _lit_val(atom):
+    v = getattr(atom, "val", None)
+    if v is None:
+        return None
+    try:
+        return float(v) if getattr(v, "ndim", 0) == 0 else None
+    except Exception:  # jaxlint: disable=silent-except — non-numeric literal just isn't the splitter
+        return None
+
+
+def _atom_eq(a, b) -> bool:
+    if a is b:
+        return True
+    va, vb = _lit_val(a), _lit_val(b)
+    return va is not None and vb is not None and va == vb
+
+
+# --- argument pair discovery ------------------------------------------------------
+
+
+def arg_dd_pairs(args) -> list[tuple[int, int]]:
+    """(hi_index, lo_index) pairs over the flattened argument leaves.
+
+    Two sources: ``DD`` NamedTuple nodes in the args pytree (their two
+    leaves are consecutive in flatten order), and dict columns paired by
+    the ``<stem>_hi``/``<stem>_lo`` naming convention under one parent
+    (the tensor layout ``t_hi``/``t_lo``, models/base.py).
+    """
+    import jax
+
+    from pint_tpu.ops.dd import DD
+
+    pairs: list[tuple[int, int]] = []
+    idx = 0
+    nodes = jax.tree_util.tree_flatten(
+        args, is_leaf=lambda x: isinstance(x, DD))[0]
+    claimed: set[int] = set()
+    for node in nodes:
+        if isinstance(node, DD):
+            pairs.append((idx, idx + 1))
+            claimed.update((idx, idx + 1))
+            idx += 2
+        else:
+            idx += 1
+    # name-paired dict columns
+    try:
+        flat = jax.tree_util.tree_flatten_with_path(args)[0]
+    except Exception:  # pragma: no cover — tree API drift  # jaxlint: disable=silent-except — name pairing degrades, DD pairs above still seed
+        return pairs
+    stems: dict[tuple, dict[str, int]] = {}
+    for i, (path, _leaf) in enumerate(flat):
+        if i in claimed or not path:
+            continue
+        name = getattr(path[-1], "key", None)
+        if isinstance(name, str) and name.endswith(("_hi", "_lo")):
+            key = (tuple(str(p) for p in path[:-1]), name[:-3])
+            stems.setdefault(key, {})[name[-2:]] = i
+    for members in stems.values():
+        if set(members) == {"hi", "lo"}:
+            pairs.append((members["hi"], members["lo"]))
+    return pairs
+
+
+# --- error-free-transform recognition ---------------------------------------------
+
+
+class _EFT(NamedTuple):
+    kind: str                  # two_sum | quick_two_sum | two_prod
+    root: int                  # eqn index of s = add(a,b) / p = mul(a,b)
+    s: object                  # hi output var
+    err: object                # lo output var
+    inputs: tuple              # the (a, b) atoms
+    eqns: frozenset            # all member eqn indices (sanctioned)
+
+
+def _index_uses(jaxpr):
+    uses: dict = {}
+    for i, eqn in enumerate(jaxpr.eqns):
+        for pos, a in enumerate(eqn.invars):
+            if _is_var(a):
+                uses.setdefault(a, []).append((i, pos))
+    return uses
+
+
+def _find_binop(jaxpr, uses, prim, x, y, commutative):
+    """Eqn index computing ``prim(x, y)`` (either order if commutative)."""
+    cands = []
+    if _is_var(x):
+        cands = uses.get(x, [])
+    elif _is_var(y):
+        cands = uses.get(y, [])
+    for i, _pos in cands:
+        eqn = jaxpr.eqns[i]
+        if eqn.primitive.name != prim or len(eqn.invars) != 2:
+            continue
+        a, b = eqn.invars
+        if _atom_eq(a, x) and _atom_eq(b, y):
+            return i
+        if commutative and _atom_eq(a, y) and _atom_eq(b, x):
+            return i
+    return None
+
+
+def _match_err_chain(jaxpr, uses, root, s, a, b):
+    """The two_sum / quick_two_sum error chain downstream of s=add(a,b).
+
+    quick: t = sub(s, a); err = sub(b, t)
+    full:  bb = sub(s, a); t1 = sub(s, bb); t2 = sub(a, t1);
+           t3 = sub(b, bb); err = add(t2, t3)
+    Returns (kind, err_var, member_eqn_idxs) or None.
+    """
+    i_t = _find_binop(jaxpr, uses, "sub", s, a, commutative=False)
+    if i_t is None:
+        return None
+    t = jaxpr.eqns[i_t].outvars[0]
+    # quick_two_sum
+    i_err = _find_binop(jaxpr, uses, "sub", b, t, commutative=False)
+    if i_err is not None:
+        return ("quick_two_sum", jaxpr.eqns[i_err].outvars[0],
+                frozenset((root, i_t, i_err)))
+    # two_sum (t is bb here)
+    i_t1 = _find_binop(jaxpr, uses, "sub", s, t, commutative=False)
+    if i_t1 is None:
+        return None
+    t1 = jaxpr.eqns[i_t1].outvars[0]
+    i_t2 = _find_binop(jaxpr, uses, "sub", a, t1, commutative=False)
+    i_t3 = _find_binop(jaxpr, uses, "sub", b, t, commutative=False)
+    if i_t2 is None or i_t3 is None:
+        return None
+    t2 = jaxpr.eqns[i_t2].outvars[0]
+    t3 = jaxpr.eqns[i_t3].outvars[0]
+    i_err = _find_binop(jaxpr, uses, "add", t2, t3, commutative=True)
+    if i_err is None:
+        return None
+    return ("two_sum", jaxpr.eqns[i_err].outvars[0],
+            frozenset((root, i_t, i_t1, i_t2, i_t3, i_err)))
+
+
+def _match_split(jaxpr, uses, x):
+    """Dekker _split(x): t = SPLITTER*x; v = sub(t,x); hi = sub(t,v);
+    lo = sub(x,hi). Returns (hi, lo, eqn_idxs) or None."""
+    if not _is_var(x):
+        return None
+    for i, _pos in uses.get(x, []):
+        eqn = jaxpr.eqns[i]
+        if eqn.primitive.name != "mul" or len(eqn.invars) != 2:
+            continue
+        other = eqn.invars[1] if _atom_eq(eqn.invars[0], x) else eqn.invars[0]
+        if _lit_val(other) != _SPLITTER:
+            continue
+        t = eqn.outvars[0]
+        i_v = _find_binop(jaxpr, uses, "sub", t, x, commutative=False)
+        if i_v is None:
+            continue
+        v = jaxpr.eqns[i_v].outvars[0]
+        i_hi = _find_binop(jaxpr, uses, "sub", t, v, commutative=False)
+        if i_hi is None:
+            continue
+        hi = jaxpr.eqns[i_hi].outvars[0]
+        i_lo = _find_binop(jaxpr, uses, "sub", x, hi, commutative=False)
+        if i_lo is None:
+            continue
+        return (hi, jaxpr.eqns[i_lo].outvars[0],
+                frozenset((i, i_v, i_hi, i_lo)))
+    return None
+
+
+def _match_two_prod(jaxpr, uses, root, p, a, b):
+    """Dekker two_prod downstream of p=mul(a,b):
+    err = ((ah*bh - p) + ah*bl + al*bh) + al*bl."""
+    sa = _match_split(jaxpr, uses, a)
+    sb = _match_split(jaxpr, uses, b)
+    if sa is None or sb is None:
+        return None
+    ah, al, ea = sa
+    bh, bl, eb = sb
+    i_m1 = _find_binop(jaxpr, uses, "mul", ah, bh, commutative=True)
+    if i_m1 is None:
+        return None
+    m1 = jaxpr.eqns[i_m1].outvars[0]
+    i_d1 = _find_binop(jaxpr, uses, "sub", m1, p, commutative=False)
+    if i_d1 is None:
+        return None
+    d1 = jaxpr.eqns[i_d1].outvars[0]
+    i_m2 = _find_binop(jaxpr, uses, "mul", ah, bl, commutative=True)
+    if i_m2 is None:
+        return None
+    m2 = jaxpr.eqns[i_m2].outvars[0]
+    i_s1 = _find_binop(jaxpr, uses, "add", d1, m2, commutative=True)
+    if i_s1 is None:
+        return None
+    s1 = jaxpr.eqns[i_s1].outvars[0]
+    i_m3 = _find_binop(jaxpr, uses, "mul", al, bh, commutative=True)
+    if i_m3 is None:
+        return None
+    m3 = jaxpr.eqns[i_m3].outvars[0]
+    i_s2 = _find_binop(jaxpr, uses, "add", s1, m3, commutative=True)
+    if i_s2 is None:
+        return None
+    s2 = jaxpr.eqns[i_s2].outvars[0]
+    i_m4 = _find_binop(jaxpr, uses, "mul", al, bl, commutative=True)
+    if i_m4 is None:
+        return None
+    m4 = jaxpr.eqns[i_m4].outvars[0]
+    i_err = _find_binop(jaxpr, uses, "add", s2, m4, commutative=True)
+    if i_err is None:
+        return None
+    eqns = frozenset(
+        {root, i_m1, i_d1, i_m2, i_s1, i_m3, i_s2, i_m4, i_err}
+        | ea | eb)
+    return ("two_prod", jaxpr.eqns[i_err].outvars[0], eqns)
+
+
+def _match_efts(jaxpr, uses) -> list[_EFT]:
+    out = []
+    taken: set[int] = set()
+    for i, eqn in enumerate(jaxpr.eqns):
+        if i in taken or len(eqn.invars) != 2 or len(eqn.outvars) != 1:
+            continue
+        prim = eqn.primitive.name
+        a, b = eqn.invars
+        res = None
+        if prim == "add":
+            res = _match_err_chain(jaxpr, uses, i, eqn.outvars[0], a, b)
+            if res is None:
+                res = _match_err_chain(jaxpr, uses, i, eqn.outvars[0], b, a)
+                if res is not None:
+                    a, b = b, a
+        elif prim == "mul" and _lit_val(a) != _SPLITTER \
+                and _lit_val(b) != _SPLITTER:
+            res = _match_two_prod(jaxpr, uses, i, eqn.outvars[0], a, b)
+        if res is None:
+            continue
+        kind, err, eqns = res
+        if eqns & taken:
+            continue
+        out.append(_EFT(kind, i, eqn.outvars[0], err, (a, b), eqns))
+        taken |= eqns
+    return out
+
+
+# --- the interpreter --------------------------------------------------------------
+
+
+class _State:
+    """Shared across sub-jaxpr re-entries of one analysis."""
+
+    __slots__ = ("next_pair", "derived", "violations", "n_efts")
+
+    def __init__(self):
+        self.next_pair = 0
+        self.derived: dict = {}
+        self.violations: list[tuple[str, str]] = []
+        self.n_efts = 0
+
+    def new_pair(self) -> int:
+        self.next_pair += 1
+        return self.next_pair
+
+    def derive(self, key) -> int:
+        d = self.derived.get(key)
+        if d is None:
+            d = self.derived[key] = self.new_pair()
+        return d
+
+    def flag(self, pass_name: str, detail: str) -> None:
+        if len(self.violations) < 50:  # ledger sanity bound
+            self.violations.append((pass_name, detail))
+
+
+def _params_key(params: dict) -> tuple:
+    try:
+        return tuple(sorted((k, str(v)) for k, v in params.items()
+                            if not hasattr(v, "eqns")
+                            and not hasattr(getattr(v, "jaxpr", None),
+                                            "eqns")))
+    except Exception:  # jaxlint: disable=silent-except — unkeyable params only weaken pair derivation
+        return ()
+
+
+def _meet(a: _Label, b: _Label, aval, st: _State) -> _Label:
+    if a == b:
+        return a
+    if a.kind == b.kind:
+        if a.kind in ("hi", "lo"):
+            return _Label(a.kind, st.derive(("join", a.pair, b.pair)))
+        return _Label(a.kind)
+    return _decay(aval)
+
+
+def _sub_open(item):
+    """(jaxpr, consts) for a ClosedJaxpr / bare Jaxpr param value."""
+    inner = getattr(item, "jaxpr", None)
+    if inner is not None and hasattr(inner, "eqns"):
+        return inner, list(getattr(item, "consts", ()))
+    if hasattr(item, "eqns"):
+        return item, []
+    return None, None
+
+
+def _interpret(jaxpr, consts, in_labels, st: _State, spec: PrecisionSpec,
+               where: str) -> list[_Label]:
+    env: dict = {}
+
+    def bind(var, label):
+        env[var] = label
+
+    def look(atom) -> _Label:
+        if not _is_var(atom):
+            return _decay(atom.aval)
+        return env.get(atom, _decay(atom.aval))
+
+    for var, const in zip(jaxpr.constvars, consts):
+        bind(var, _decay(var.aval))
+    for var, label in zip(jaxpr.invars, in_labels):
+        bind(var, label)
+
+    uses = _index_uses(jaxpr)
+    efts = _match_efts(jaxpr, uses)
+    st.n_efts += len(efts)
+    sanctioned: set[int] = set()
+    eft_out: dict = {}
+    eft_root: dict[int, _EFT] = {}
+    for eft in efts:
+        sanctioned |= eft.eqns
+        pair = st.new_pair()
+        eft_out[eft.s] = _Label("hi", pair)
+        eft_out[eft.err] = _Label("lo", pair)
+        eft_root[eft.root] = eft
+
+    for idx, eqn in enumerate(jaxpr.eqns):
+        prim = eqn.primitive.name
+        labels = [look(a) for a in eqn.invars]
+
+        # --- sub-jaxpr re-entry -----------------------------------------------
+        handled = False
+        if prim == "while":
+            body, bconsts = _sub_open(eqn.params.get("body_jaxpr"))
+            cond, cconsts = _sub_open(eqn.params.get("cond_jaxpr"))
+            if body is not None:
+                cn = int(eqn.params.get("cond_nconsts", 0))
+                bn = int(eqn.params.get("body_nconsts", 0))
+                carry = labels[cn + bn:]
+                if cond is not None:
+                    _interpret(cond, cconsts, labels[:cn] + carry, st, spec,
+                               where + "/while.cond")
+                out1 = _interpret(body, bconsts, labels[cn:cn + bn] + carry,
+                                  st, spec, where + "/while.body")
+                for var, init_l, body_l in zip(eqn.outvars, carry, out1):
+                    bind(var, _meet(init_l, body_l, var.aval, st))
+                handled = True
+        elif prim == "scan":
+            body, bconsts = _sub_open(eqn.params.get("jaxpr"))
+            if body is not None:
+                nc = int(eqn.params.get("num_consts", 0))
+                ncar = int(eqn.params.get("num_carry", 0))
+                xs = []
+                for pos, l in enumerate(labels[nc + ncar:]):
+                    if l.kind in ("hi", "lo"):
+                        l = _Label(l.kind,
+                                   st.derive(("scan_x", l.pair, where, idx)))
+                    xs.append(l)
+                out1 = _interpret(body, bconsts,
+                                  labels[nc:nc + ncar] + xs, st, spec,
+                                  where + "/scan.body")
+                for j, var in enumerate(eqn.outvars):
+                    if j < ncar:
+                        bind(var, _meet(labels[nc + j], out1[j], var.aval, st))
+                    else:
+                        l = out1[j] if j < len(out1) else _decay(var.aval)
+                        if l.kind in ("hi", "lo"):
+                            l = _Label(l.kind, st.derive(
+                                ("scan_y", l.pair, where, idx)))
+                        bind(var, l)
+                handled = True
+        elif prim == "cond":
+            branches = eqn.params.get("branches", ())
+            outs = None
+            for bi, br in enumerate(branches):
+                sub, sconsts = _sub_open(br)
+                if sub is None:
+                    outs = None
+                    break
+                o = _interpret(sub, sconsts, labels[1:], st, spec,
+                               where + f"/cond.{bi}")
+                outs = o if outs is None else [
+                    _meet(x, y, v.aval, st)
+                    for x, y, v in zip(outs, o, eqn.outvars)]
+            if outs is not None:
+                for var, l in zip(eqn.outvars, outs):
+                    bind(var, l)
+                handled = True
+        elif prim not in ("custom_jvp_call_jaxpr",):
+            # generic single-sub-jaxpr call (pjit, shard_map, remat,
+            # custom_jvp/vjp, closed_call): 1:1 invars alignment only
+            for pkey in ("jaxpr", "call_jaxpr", "fun_jaxpr"):
+                sub, sconsts = _sub_open(eqn.params.get(pkey))
+                if sub is not None and len(sub.invars) == len(eqn.invars):
+                    outs = _interpret(sub, sconsts, labels, st, spec,
+                                      where + f"/{prim}")
+                    for var, l in zip(eqn.outvars, outs):
+                        if l.kind in ("hi", "lo"):
+                            # keyed by the INNER pair id, never eqn
+                            # position: a pair whose hi and lo ride
+                            # separate per-leaf calls (jnp.where lowers
+                            # to one pjit per tree leaf) must derive to
+                            # one outer pair
+                            l = _Label(l.kind,
+                                       st.derive(("call", l.pair, where)))
+                        bind(var, l)
+                    handled = True
+                    break
+        if handled:
+            continue
+
+        pairish = [l for l in labels if l.kind in ("hi", "lo", "loacc")]
+
+        # --- violation checks (sanctioned EFT internals are the algorithm) ----
+        if idx in eft_root:
+            for atom, l in zip(eqn.invars, labels):
+                if l.kind == "collapsed":
+                    st.flag(
+                        "dd-recombine",
+                        f"{where}: a collapsed pair (hi+lo of pair "
+                        f"{l.pair}) feeds directly into a "
+                        f"{eft_root[idx].kind} — the lo compensation is "
+                        "already lost; keep the value as a dd pair "
+                        "instead of collapsing and re-splitting")
+        if idx not in sanctioned:
+            if spec.mode != "qf32" and pairish and prim in _ARITH \
+                    and any(l.kind in ("f32", "f32up") for l in labels):
+                st.flag(
+                    "dd-mix",
+                    f"{where}: {prim} mixes a dd-pair member with an f32 "
+                    "operand outside a qf32 program — ~29 bits of the "
+                    "pair silently truncate at the promotion")
+            if prim == "mul" and len(labels) == 2:
+                a, b = labels
+                if {a.kind, b.kind} == {"hi", "lo"} and a.pair == b.pair \
+                        and a.pair is not None:
+                    st.flag(
+                        "dd-recombine",
+                        f"{where}: mul(hi, lo) of the same dd pair "
+                        f"({a.pair}) — no sanctioned dd op multiplies a "
+                        "pair's own members together")
+
+        # --- transfer ---------------------------------------------------------
+        pre = [eft_out.get(v) for v in eqn.outvars]
+        if all(p is not None for p in pre):
+            for var, l in zip(eqn.outvars, pre):
+                bind(var, l)
+            continue
+
+        out_label = None
+        if prim in ("add", "sub") and len(labels) == 2 \
+                and idx not in sanctioned:
+            a, b = labels
+            if {a.kind, b.kind} == {"hi", "lo"} and a.pair == b.pair \
+                    and a.pair is not None and prim == "add":
+                out_label = _Label("collapsed", a.pair)
+            elif all(l.kind in ("lo", "loacc", "f64", "collapsed")
+                     for l in labels) and any(
+                         l.kind in ("lo", "loacc") for l in labels):
+                out_label = _Label("loacc")
+        elif prim == "mul" and len(labels) == 2 and idx not in sanctioned:
+            if any(l.kind in ("lo", "loacc") for l in labels) \
+                    and not any(l.kind == "hi" for l in labels):
+                out_label = _Label("loacc")
+        elif prim == "convert_element_type" and len(labels) == 1 \
+                and labels[0].kind == "f32" and str(
+                    getattr(eqn.outvars[0].aval, "dtype", "")) == "float64":
+            out_label = _Label("f32up")
+        elif prim in _STRUCTURAL:
+            dd_ops = [l for l in labels if l.kind in ("hi", "lo")]
+            if len(dd_ops) == 1 and len(eqn.outvars) == 1:
+                src = dd_ops[0]
+                new = str(getattr(eqn.outvars[0].aval, "dtype", "float64"))
+                if not new.startswith("float32"):
+                    others = tuple(id(a) for a, l in zip(eqn.invars, labels)
+                                   if l is not src)
+                    out_label = _Label(src.kind, st.derive(
+                        (src.pair, prim, _params_key(eqn.params), others,
+                         where)))
+        elif prim in _PARALLEL and len(eqn.outvars) == 1:
+            ops = labels[1:] if prim == "select_n" else labels
+            kinds = {l.kind for l in ops}
+            if kinds in ({"hi"}, {"lo"}) and ops:
+                # the key must be identical for the hi-slot and lo-slot
+                # eqns of one logical pair op (each jnp.where broadcasts
+                # its own copy of the predicate, so operand identity
+                # CANNOT enter the key): the source-pair tuple is the
+                # pairing signal
+                key = ("par", tuple(l.pair for l in ops), prim,
+                       _params_key(eqn.params), where)
+                out_label = _Label(ops[0].kind, st.derive(key))
+
+        if out_label is not None and len(eqn.outvars) == 1:
+            bind(eqn.outvars[0], out_label)
+        else:
+            for var in eqn.outvars:
+                bind(var, eft_out.get(var) or _decay(var.aval))
+
+    return [look(v) for v in jaxpr.outvars]
+
+
+# --- entry point ------------------------------------------------------------------
+
+
+class FlowResult(NamedTuple):
+    out_labels: tuple
+    violations: tuple          # ((pass_name, detail), ...)
+    n_arg_pairs: int
+    n_efts: int
+
+
+def analyze_closed(closed, args, spec) -> FlowResult:
+    """Run the dd-flow interpreter over one lowered program.
+
+    ``closed`` is the ClosedJaxpr from tracing, ``args`` the example
+    call arguments (pair seeding), ``spec`` the program's declared
+    :class:`PrecisionSpec` (or shorthand string). Returns labels for the
+    flat outputs plus the violations found — the caller (the jaxpr
+    auditor) routes them into the ledger.
+    """
+    import jax
+
+    spec = normalize_spec(spec) or PrecisionSpec()
+    jaxpr = closed.jaxpr
+    leaves = jax.tree_util.tree_leaves(args)
+    pairs = arg_dd_pairs(args) if len(leaves) == len(jaxpr.invars) else []
+    st = _State()
+    in_labels = [_decay(v.aval) for v in jaxpr.invars]
+    for i_hi, i_lo in pairs:
+        if i_lo < len(in_labels):
+            k = st.new_pair()
+            in_labels[i_hi] = _Label("hi", k)
+            in_labels[i_lo] = _Label("lo", k)
+    out_labels = _interpret(jaxpr, list(closed.consts), in_labels, st, spec,
+                            "program")
+    _check_outputs(out_labels, spec, st)
+    return FlowResult(tuple(out_labels), tuple(st.violations), len(pairs),
+                      st.n_efts)
+
+
+def _check_outputs(out_labels, spec: PrecisionSpec, st: _State) -> None:
+    if spec.dd_out is False:
+        return
+    if spec.dd_out in ("auto", True):
+        have_lo = {l.pair for l in out_labels if l.kind == "lo"}
+        for i, l in enumerate(out_labels):
+            if l.kind == "hi" and l.pair not in have_lo:
+                st.flag(
+                    "dd-truncate-flow",
+                    f"output leaf {i} carries the hi member of a dd pair "
+                    "whose lo member never reaches the outputs: 53 bits "
+                    "of compensation silently dropped (return the pair, "
+                    "or collapse it explicitly with dd_to_float and "
+                    "declare dd_out=False)")
+        return
+    for i_hi, i_lo in spec.dd_out:
+        if i_hi >= len(out_labels) or i_lo >= len(out_labels):
+            st.flag(
+                "dd-unnormalized",
+                f"declared dd output pair ({i_hi}, {i_lo}) is out of range "
+                f"for the {len(out_labels)} output leaves")
+            continue
+        lh, ll = out_labels[i_hi], out_labels[i_lo]
+        if lh.kind == "hi" and ll == _Label("lo", lh.pair):
+            continue
+        if lh.kind == "hi":
+            st.flag(
+                "dd-truncate-flow",
+                f"declared dd output pair ({i_hi}, {i_lo}): leaf {i_hi} is "
+                f"a pair's hi but leaf {i_lo} ({ll.kind}) is not that "
+                "pair's lo — the compensation escaped the output")
+        else:
+            st.flag(
+                "dd-unnormalized",
+                f"declared dd output pair ({i_hi}, {i_lo}) was assembled "
+                "with no renormalizing two_sum/quick_two_sum on the path "
+                f"(hi slot label: {lh.kind}) — the |lo| <= ulp(hi)/2 "
+                "invariant downstream dd ops assume was never established")

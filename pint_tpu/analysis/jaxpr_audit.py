@@ -57,6 +57,21 @@ Passes (each returns a list of human-readable violation details):
     second signature: bucket reuse is a contract — one compile per
     (bucket, model-skeleton), so a per-element shape leaking past the
     bucket padding is a violation, not just a perf regression.
+``dd-spec``
+    A program carrying dd/qf extended-precision operands with no
+    declared ``precision_spec=`` (warn-level, never raises under
+    strict): new programs cannot silently opt out of the dd-flow
+    analysis below.
+``dd-recombine`` / ``dd-truncate-flow`` / ``dd-mix`` / ``dd-unnormalized``
+    The dd-flow precision-dataflow passes (pint_tpu/analysis/ddflow.py):
+    an abstract interpreter labels every intermediate on a precision
+    lattice (dd-hi/dd-lo/pair/f32-upcast/f64/int), recognizing the
+    two_sum/quick_two_sum/two_prod chains of ops/dd.py as sanctioned
+    pair ops, and fires on a pair recombined by an unsanctioned op, a
+    dd output reachable from ``hi`` without its ``lo``, dd×f32 mixing
+    outside qf32 programs, and a declared output pair with no renorm on
+    the path. Runs only on programs that declare a ``precision_spec``;
+    ``PINT_TPU_DDFLOW=0`` disables.
 
 Results accumulate in a process-global ledger; ``audit_block()``
 snapshots it for ``FitResult.perf`` / the bench headline. The
@@ -101,6 +116,7 @@ class _Ctx(NamedTuple):
     canonical: bool
     prior_sigs: tuple  # signatures already compiled for this program
     sig: object  # the signature being compiled (ops/compile._args_signature)
+    spec: object = None  # declared PrecisionSpec / mode string / None
 
 
 def audit_mode() -> str:
@@ -173,14 +189,26 @@ def _pass_precision_demotion(ctx: _Ctx) -> list[str]:
     if ctx.closed is None:
         return []
     jaxpr = ctx.closed.jaxpr
-    # qf32-mode programs carry f32 pairs by contract: any f32 input or
-    # constant exempts the whole program from this pass
-    for v in jaxpr.invars:
-        if _dtype_name(_aval_of(v)) == "float32":
+    has_f32_input = any(
+        _dtype_name(_aval_of(v)) == "float32" for v in jaxpr.invars
+    ) or any(
+        str(getattr(c, "dtype", "")) == "float32" for c in ctx.closed.consts)
+    spec = None
+    if ctx.spec is not None:
+        from pint_tpu.analysis import ddflow
+
+        spec = ddflow.normalize_spec(ctx.spec)
+    if spec is not None:
+        # label-flow exemption (dd-flow rebase): only a DECLARED qf32
+        # program is exempt — an f32 input in a dd64/f64 program no
+        # longer silences the pass (the old blanket any-f32-input
+        # heuristic under-covered mixed-input programs)
+        if spec.mode == "qf32":
             return []
-    for c in ctx.closed.consts:
-        if str(getattr(c, "dtype", "")) == "float32":
-            return []
+    elif has_f32_input:
+        # no declared spec: fall back to the conservative dtype-contract
+        # heuristic (any f32 input marks the program qf32-style)
+        return []
     out = []
     for eqn, _ in _iter_eqns(jaxpr):
         if eqn.primitive.name != "convert_element_type":
@@ -191,7 +219,8 @@ def _pass_precision_demotion(ctx: _Ctx) -> list[str]:
             shape = tuple(getattr(_aval_of(eqn.invars[0]), "shape", ()))
             out.append(
                 f"f64->f32 convert_element_type on a {shape} value inside "
-                "a pure-f64 program (dd64 dtype contract, ops/dd.py): "
+                "a declared-" + (spec.mode if spec else "pure-f64")
+                + " program (dd64 dtype contract, ops/dd.py): "
                 "phase-critical precision silently demoted"
             )
     return out
@@ -357,6 +386,67 @@ def _pass_batch_retrace(ctx: _Ctx) -> list[str]:
     ]
 
 
+def _has_xprec_leaves(args) -> bool:
+    """True when the call args carry DD / QF extended-precision leaves."""
+    import jax
+
+    from pint_tpu.ops.dd import DD
+    from pint_tpu.ops.qf32 import QF
+
+    nodes = jax.tree_util.tree_flatten(
+        args, is_leaf=lambda x: isinstance(x, (DD, QF)))[0]
+    return any(isinstance(n, (DD, QF)) for n in nodes)
+
+
+def _pass_dd_spec(ctx: _Ctx) -> list[str]:
+    """Warn-level nag: a program whose operands carry dd/qf pairs but
+    that declares no ``precision_spec=`` opts out of the dd-flow
+    analysis silently — every extended-precision program must say what
+    discipline it rides (ops/compile.py TimedProgram precision_spec)."""
+    from pint_tpu.analysis import ddflow
+
+    if ctx.spec is not None or not ddflow.enabled():
+        return []
+    if not _has_xprec_leaves(ctx.args):
+        return []
+    return [
+        "program carries DD/QF extended-precision operands but declares "
+        "no precision_spec: pass precision_spec=\"dd64\"/\"qf32\"/\"f64\" "
+        "(or a ddflow.PrecisionSpec) to TimedProgram so the dd-flow "
+        "passes can bind"
+    ]
+
+
+# one-slot memo: the dd-flow interpreter runs ONCE per audited lowering,
+# then each registered dd pass reads its slice of the result. The slot
+# holds ONE (ctx, result) tuple written atomically, so concurrent audits
+# of different programs can at worst recompute — never cross results.
+_ddflow_memo: list = [(None, None)]
+
+
+def _ddflow_results(ctx: _Ctx) -> dict:
+    from pint_tpu.analysis import ddflow
+
+    memo_ctx, memo_out = _ddflow_memo[0]
+    if memo_ctx is ctx:
+        return memo_out
+    out: dict = {}
+    if ctx.closed is not None and ctx.spec is not None and ddflow.enabled():
+        res = ddflow.analyze_closed(ctx.closed, ctx.args, ctx.spec)
+        for pass_name, detail in res.violations:
+            out.setdefault(pass_name, []).append(detail)
+    _ddflow_memo[0] = (ctx, out)
+    return out
+
+
+def _mk_ddflow_pass(name: str):
+    def _pass(ctx: _Ctx) -> list[str]:
+        return _ddflow_results(ctx).get(name, [])
+
+    _pass.__name__ = f"_pass_{name.replace('-', '_')}"
+    return _pass
+
+
 #: the registered pass pipeline (name, fn) — pluggable: tests and
 #: downstream code may append passes; audit_block reports the count
 PASSES: list[tuple[str, object]] = [
@@ -368,7 +458,15 @@ PASSES: list[tuple[str, object]] = [
     ("prepare-sync", _pass_prepare_sync),
     ("retrace-budget", _pass_retrace_budget),
     ("batch-retrace", _pass_batch_retrace),
+    ("dd-spec", _pass_dd_spec),
 ]
+from pint_tpu.analysis.ddflow import DDFLOW_PASSES as _DDFLOW_PASSES  # noqa: E402
+
+PASSES.extend((n, _mk_ddflow_pass(n)) for n in _DDFLOW_PASSES)
+
+#: passes that record into the ledger but never raise under strict mode
+#: (dd-spec is a migration nag, not a correctness failure)
+WARN_ONLY_PASSES = {"dd-spec"}
 
 
 # --- ledger -----------------------------------------------------------------------
@@ -415,6 +513,7 @@ def audit_program(
     prior_sigs: tuple = (),
     sig=None,
     program_id=None,
+    spec=None,
 ) -> list[Violation]:
     """Run every registered pass over one lowering; record + escalate.
 
@@ -428,7 +527,7 @@ def audit_program(
     if mode == "0":
         return []
     ctx = _Ctx(label, closed, args, tuple(collective_axes), canonical,
-               tuple(prior_sigs), sig)
+               tuple(prior_sigs), sig, spec)
     found: list[Violation] = []
     for name, fn in PASSES:
         try:
@@ -445,7 +544,10 @@ def audit_program(
     if found:
         msg = f"jaxpr audit: {len(found)} violation(s) in {label!r}:\n" + \
             "\n".join(f"  [{v.pass_name}] {v.detail}" for v in found)
-        if mode == "strict":
+        # warn-only passes (dd-spec) land on the ledger and the log but
+        # never escalate: they nag about missing declarations, not bugs
+        if mode == "strict" and any(
+                v.pass_name not in WARN_ONLY_PASSES for v in found):
             raise AuditError(msg)
         log.warning(msg)
     return found
@@ -453,7 +555,8 @@ def audit_program(
 
 def audit_jitted(fn, *args, label: str = "adhoc",
                  collective_axes: tuple[str, ...] = (),
-                 canonical: bool = True) -> list[Violation]:
+                 canonical: bool = True,
+                 precision_spec=None) -> list[Violation]:
     """Audit an arbitrary callable for the given example arguments.
 
     Standalone entry point (docs walkthrough, notebooks, tests): jits
@@ -471,7 +574,7 @@ def audit_jitted(fn, *args, label: str = "adhoc",
     return audit_program(
         label, closed, args, collective_axes=collective_axes,
         canonical=canonical, prior_sigs=(), sig=_args_signature(args),
-        program_id=id(jfn),
+        program_id=id(jfn), spec=precision_spec,
     )
 
 

@@ -37,6 +37,16 @@ Rules
     ledger, or the handler must carry an inline suppression with a
     justification for why it is not a degradation (telemetry assembly,
     best-effort warmup, GUI survival).
+``dd-truncate``
+    Host code reading ``.hi`` off a value without ever reading the same
+    value's ``.lo`` in the same function scope: on a dd pair
+    (ops/dd.py) that read silently throws away 53 bits of compensation
+    — the source-level companion of the jaxpr-level
+    ``dd-truncate-flow`` audit pass. Collapse through the sanctioned
+    accessors (``dd_to_float`` / ``to_longdouble``) or read both
+    members. Files listed in the ``dd-accessors`` config (default: the
+    dd module itself) are exempt; a justified hi-only read carries an
+    inline suppression.
 
 Reachability is deliberately *lexical and conservative*: a function is
 jit-reachable when it (or an enclosing function) is passed by name or as
@@ -65,7 +75,7 @@ from dataclasses import dataclass, field
 __all__ = ["Finding", "lint_file", "lint_paths", "load_config", "main", "RULES"]
 
 RULES = ("env-read", "np-in-jit", "tracer-if", "host-sync-in-loop",
-         "silent-except")
+         "silent-except", "dd-truncate")
 
 #: call targets whose function arguments become jit-reachable
 _JIT_WRAPPERS = {"jit", "precision_jit", "pjit", "TimedProgram", "vmap",
@@ -236,23 +246,47 @@ def _bare_param_args(call: ast.Call, params: set[str]) -> list[str]:
 class _RuleChecker(ast.NodeVisitor):
     """Third pass: emit findings inside marked scopes."""
 
-    def __init__(self, path, scopes: _ScopeBuilder, select, registry: bool):
+    def __init__(self, path, scopes: _ScopeBuilder, select, registry: bool,
+                 dd_accessor: bool = False):
         self.path = path
         self.scopes = scopes
         self.select = select
         self.registry = registry  # file IS the env registry (env-read exempt)
+        self.dd_accessor = dd_accessor  # file IS a sanctioned dd accessor
         self.findings: list[Finding] = []
         self._stack: list[_Scope] = [scopes.root]
+        # per-scope {base-expr: {"hi"|"lo": first lineno}} for dd-truncate
+        self._dd_reads: list[dict] = [{}]
 
     # --- scope tracking ---------------------------------------------------------
     def _enter(self, node):
         self._stack.append(self.scopes.by_node[node])
+        self._dd_reads.append({})
         self.generic_visit(node)
+        self._flush_dd_reads(self._dd_reads.pop())
         self._stack.pop()
 
     visit_FunctionDef = _enter
     visit_AsyncFunctionDef = _enter
     visit_Lambda = _enter
+
+    def finalize(self):
+        """Evaluate module-scope dd reads (call after visit(tree))."""
+        self._flush_dd_reads(self._dd_reads[0])
+
+    def _flush_dd_reads(self, reads: dict):
+        for base, members in reads.items():
+            if "hi" in members and "lo" not in members:
+                self._emit_at(
+                    members["hi"], "dd-truncate",
+                    f"`{base}.hi` read without its `.lo` in this scope: "
+                    "on a dd pair this truncates 53 bits — collapse via "
+                    "dd_to_float/to_longdouble (ops/dd.py), read both "
+                    "members, or suppress with a justification")
+
+    def _emit_at(self, lineno, rule, msg):
+        if rule in self.select:
+            self.findings.append(Finding(self.path, lineno, rule, msg))
 
     @property
     def scope(self) -> _Scope:
@@ -262,13 +296,22 @@ class _RuleChecker(ast.NodeVisitor):
         if rule in self.select:
             self.findings.append(Finding(self.path, node.lineno, rule, msg))
 
-    # --- env-read ---------------------------------------------------------------
+    # --- env-read / dd-truncate attribute reads ---------------------------------
     def visit_Attribute(self, node: ast.Attribute):
         if (not self.registry and node.attr in ("environ", "getenv")
                 and isinstance(node.value, ast.Name) and node.value.id == "os"):
             self._emit(node, "env-read",
                        "raw os.environ read: route it through the knob "
                        "registry (pint_tpu.utils.knobs.get)")
+        if (not self.dd_accessor and node.attr in ("hi", "lo")
+                and isinstance(node.ctx, ast.Load)):
+            try:
+                base = ast.unparse(node.value)
+            except Exception:  # pragma: no cover — unparse drift  # jaxlint: disable=silent-except — unkeyable base just skips pairing for this read
+                base = None
+            if base is not None:
+                members = self._dd_reads[-1].setdefault(base, {})
+                members.setdefault(node.attr, node.lineno)
         self.generic_visit(node)
 
     # --- call-shaped rules ------------------------------------------------------
@@ -416,8 +459,11 @@ def lint_file(path: str, src: str | None = None,
     _mark_nested(scopes.root)
     norm = path.replace(os.sep, "/")
     registry = any(norm.endswith(r) for r in config["env-registry"])
-    checker = _RuleChecker(path, scopes, set(config["select"]), registry)
+    dd_accessor = any(norm.endswith(r) for r in config["dd-accessors"])
+    checker = _RuleChecker(path, scopes, set(config["select"]), registry,
+                           dd_accessor)
     checker.visit(tree)
+    checker.finalize()
     sup = _suppressions(src)
     return [f for f in checker.findings if f.rule not in sup.get(f.line, ())]
 
@@ -456,6 +502,9 @@ def lint_paths(paths: list[str] | None = None,
 _DEFAULTS = {
     "paths": ["pint_tpu"],
     "env-registry": ["pint_tpu/utils/knobs.py"],
+    # files whose whole PURPOSE is member access on dd pairs: the dd
+    # module's own accessors (dd_to_float, dd_rint, device_split, ...)
+    "dd-accessors": ["pint_tpu/ops/dd.py"],
     "exclude": [],
     "select": list(RULES),
 }

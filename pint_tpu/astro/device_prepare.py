@@ -99,7 +99,8 @@ def _build_geometry_program():
         return erot.itrf_to_gcrs_posvel(
             itrf_m, ut1_mjd, tt_jcent, xp_rad=xp_rad, yp_rad=yp_rad, xp=jnp)
 
-    return TimedProgram(precision_jit(fn), "prepare_geometry")
+    return TimedProgram(precision_jit(fn), "prepare_geometry",
+                        precision_spec="f64")
 
 
 def site_posvel_device(itrf_m, ut1_mjd, tt_jcent, xp_rad, yp_rad):
@@ -126,7 +127,8 @@ def _build_analytic_program(bodies: tuple[str, ...], dt_s: float):
         return tuple(
             eph._posvel_analytic(b, T, dt_s=dt_s, xp=jnp) for b in bodies)
 
-    return TimedProgram(precision_jit(fn), "prepare_ephemeris")
+    return TimedProgram(precision_jit(fn), "prepare_ephemeris",
+                        precision_spec="f64")
 
 
 def analytic_posvel_device(bodies: tuple[str, ...], tdb_jcent,
@@ -210,7 +212,8 @@ def _build_nbody_program(body_indices: tuple[int, ...],
             out.append((p, v))
         return tuple(out)
 
-    return TimedProgram(precision_jit(fn), "prepare_nbody")
+    return TimedProgram(precision_jit(fn), "prepare_nbody",
+                        precision_spec="f64")
 
 
 # --- Chebyshev kernel-pack serve --------------------------------------------------
@@ -245,7 +248,8 @@ def _build_kernel_program(chains: tuple[tuple[int, ...], ...], C: int):
             out.append((pos * 1e3, vel * 1e3))
         return tuple(out)
 
-    return TimedProgram(precision_jit(fn), "prepare_kernel_eval")
+    return TimedProgram(precision_jit(fn), "prepare_kernel_eval",
+                        precision_spec="f64")
 
 
 def kernel_posvel_device(pack, bodies: tuple[str, ...], t_jcent) -> dict | None:

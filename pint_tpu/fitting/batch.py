@@ -252,7 +252,8 @@ def get_batched_fit_fn(model, kind: str, free, subtract_mean: bool,
     label = f"batched_{kind}_fit_{B}x{rows}"
     entry = _BatchEntry(
         prog=TimedProgram(precision_jit(vfit), label,
-                          collective_axes=(axis,) if axis else ()),
+                          collective_axes=(axis,) if axis else (),
+                          precision_spec=model.xprec.name),
         red_pieces=red_p, red_chi2=red_c,
         n_batch=n_batch, n_toa=n_toa, label=label,
     )
@@ -562,6 +563,27 @@ class BatchedFitter:
         perf.put("padding_waste_frac", round(waste, 4))
         self.results = results
         return results
+
+
+def batched_fit_program(fitters, mesh=None, batch_axis: str = "batch",
+                        toa_axis: str = "toa",
+                        min_bucket_rows: int = MIN_BUCKET_ROWS,
+                        maxiter: int = 30,
+                        required_chi2_decrease: float = 1e-2,
+                        max_rejects: int = 16):
+    """(program, args) of the first assembled fleet group — the same
+    construction the live batch uses (mirror of
+    ``sharded.fused_fit_program``), so AOT warmup and the static cost
+    analysis (pint_tpu/analysis/cost.py) see exactly the program the
+    fleet executes."""
+    bf = BatchedFitter(fitters, mesh=mesh, batch_axis=batch_axis,
+                       toa_axis=toa_axis, min_bucket_rows=min_bucket_rows)
+    groups, _ = bf._assembled()
+    if not groups:
+        raise ValueError("no batch-capable fitters to assemble")
+    g = groups[0]
+    return g.entry.prog, bf._args(g, maxiter, required_chi2_decrease,
+                                  max_rejects)
 
 
 def fit_batch(fitters, maxiter: int = 30,

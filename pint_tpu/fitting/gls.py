@@ -132,7 +132,8 @@ def get_gls_step_fn(model: TimingModel, free, subtract_mean: bool):
     from pint_tpu.ops.compile import TimedProgram, precision_jit
 
     if not host:
-        cache[key] = TimedProgram(precision_jit(step), "gls_step")
+        cache[key] = TimedProgram(precision_jit(step), "gls_step",
+                                  precision_spec=model.xprec.name)
         return cache[key]
 
     from pint_tpu.ops.compile import host_transfer, model_cpu_memo
@@ -140,8 +141,10 @@ def get_gls_step_fn(model: TimingModel, free, subtract_mean: bool):
     # ADAPTIVE: try the fused on-device step first (no large transfers);
     # fall back to the CPU-split Woodbury only when the device normal
     # matrix comes back non-finite (see module note above)
-    fused_fn = TimedProgram(precision_jit(step), "gls_step_fused")
-    device_fn = TimedProgram(precision_jit(design), "gls_design")
+    fused_fn = TimedProgram(precision_jit(step), "gls_step_fused",
+                            precision_spec=model.xprec.name)
+    device_fn = TimedProgram(precision_jit(design), "gls_design",
+                             precision_spec=model.xprec.name)
     # the host tail is jitted too (for the CPU target — its inputs live
     # on the CPU device): the Woodbury assembly with its ECORR segment
     # reductions would otherwise run eagerly per LM trial
@@ -207,13 +210,16 @@ def get_gls_chi2_fn(model: TimingModel, subtract_mean: bool):
     from pint_tpu.ops.compile import TimedProgram, precision_jit
 
     if not host:
-        cache[key] = TimedProgram(precision_jit(chi2fn), "gls_chi2")
+        cache[key] = TimedProgram(precision_jit(chi2fn), "gls_chi2",
+                                  precision_spec=model.xprec.name)
         return cache[key]
 
     from pint_tpu.ops.compile import model_cpu_memo
 
-    fused_fn = TimedProgram(precision_jit(chi2fn), "gls_chi2_fused")
-    resid_fn = TimedProgram(precision_jit(time_resids), "gls_resid")
+    fused_fn = TimedProgram(precision_jit(chi2fn), "gls_chi2_fused",
+                            precision_spec=model.xprec.name)
+    resid_fn = TimedProgram(precision_jit(time_resids), "gls_resid",
+                            precision_spec=model.xprec.name)
 
     def chi2_tail(params, tensor, r, sigma):
         basis = model.noise_basis_and_weights(params, tensor)

@@ -295,7 +295,8 @@ class NoiseLikelihood:
                  else jnp.zeros((r0.shape[0], 0)))
             return r0, M
 
-        design_prog = TimedProgram(precision_jit(design), "noise_design")
+        design_prog = TimedProgram(precision_jit(design), "noise_design",
+                                   precision_spec=model.xprec.name)
         r0, M = design_prog(params0, tensor)
         r0 = np.asarray(r0)
         M = np.asarray(M)
@@ -387,12 +388,15 @@ class NoiseLikelihood:
 
         return _ProgramSet(
             loglike=TimedProgram(precision_jit(single), "noise_loglike",
-                                 collective_axes=axes),
+                                 collective_axes=axes,
+                                 precision_spec=self.model.xprec.name),
             loglike_batch=TimedProgram(precision_jit(batch),
                                        "noise_loglike_batch",
-                                       collective_axes=axes),
+                                       collective_axes=axes,
+                                       precision_spec=self.model.xprec.name),
             grad=TimedProgram(precision_jit(grad), "noise_loglike_grad",
-                              collective_axes=axes),
+                              collective_axes=axes,
+                              precision_spec=self.model.xprec.name),
         )
 
     # --- prior / posterior ------------------------------------------------------
@@ -522,7 +526,8 @@ class NoiseLikelihood:
 
         prog = self.__dict__.setdefault(
             "_opt_prog",
-            TimedProgram(precision_jit(vrun), "noise_optimize"))
+            TimedProgram(precision_jit(vrun), "noise_optimize",
+                         precision_spec=self.model.xprec.name))
         rng = np.random.default_rng(seed)
         z0 = np.zeros((n_restarts, self.nparams))
         z0[1:] = rng.standard_normal((n_restarts - 1, self.nparams))
@@ -552,7 +557,8 @@ class NoiseLikelihood:
         from pint_tpu.ops.compile import TimedProgram, precision_jit
 
         hess = jax.hessian(self._lnpost_traced)
-        prog = TimedProgram(precision_jit(hess), "noise_laplace_hessian")
+        prog = TimedProgram(precision_jit(hess), "noise_laplace_hessian",
+                            precision_spec=self.model.xprec.name)
         with perf.stage("noise"):
             with perf.stage("build"):
                 H = np.asarray(prog(jnp.asarray(self.x0), self._params0,
@@ -667,7 +673,9 @@ class NoiseLikelihood:
                nwalkers if kernel == "stretch" else 0)
         prog = cache.get(key)
         if prog is None:
-            prog = cache[key] = TimedProgram(precision_jit(vchain), label)
+            prog = cache[key] = TimedProgram(
+                precision_jit(vchain), label,
+                precision_spec=self.model.xprec.name)
 
         scales = self.laplace_scales()
         z0, keys = self._chain_starts(kernel, nd, nwalkers, seed, chain_ids,
@@ -810,7 +818,8 @@ class NoiseFleet:
         prog = self._progs.get(key)
         if prog is None:
             prog = self._progs[key] = TimedProgram(
-                precision_jit(bchain), f"noise_fleet_chain_{kernel}")
+                precision_jit(bchain), f"noise_fleet_chain_{kernel}",
+                precision_spec=nl0.model.xprec.name)
 
         B = len(self.members)
         z0 = np.zeros((B, n_chains, nwalkers, nd) if kernel == "stretch"

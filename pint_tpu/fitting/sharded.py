@@ -638,7 +638,8 @@ def get_fused_fit_fn(model, kind: str, free, subtract_mean: bool,
         # sharded program MUST psum over the TOA axis, the 1-device
         # fallback must contain no collective at all
         prog=TimedProgram(precision_jit(fit), f"fused_{kind}_fit",
-                          collective_axes=(axis,) if axis else ()),
+                          collective_axes=(axis,) if axis else (),
+                          precision_spec=model.xprec.name),
         red_pieces=red_p,
         red_chi2=red_c,
         n_shards=n_shards,

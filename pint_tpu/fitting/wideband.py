@@ -131,13 +131,16 @@ def get_wb_step_fn(model, free, subtract_mean: bool):
     from pint_tpu.ops.compile import TimedProgram, host_transfer
 
     if not host:
-        cache[key] = TimedProgram(precision_jit(step), "wb_step")
+        cache[key] = TimedProgram(precision_jit(step), "wb_step",
+                                  precision_spec=model.xprec.name)
         return cache[key]
 
     # ADAPTIVE: fused on-device first, CPU-split Woodbury only on
     # non-finite results (same strategy as fitting/gls.py)
-    fused_fn = TimedProgram(precision_jit(step), "wb_step_fused")
-    device_fn = TimedProgram(precision_jit(design), "wb_design")
+    fused_fn = TimedProgram(precision_jit(step), "wb_step_fused",
+                            precision_spec=model.xprec.name)
+    device_fn = TimedProgram(precision_jit(design), "wb_design",
+                             precision_spec=model.xprec.name)
     pieces_fn = jax.jit(woodbury_pieces, static_argnums=(5,))
     cpu = jax.devices("cpu")[0]
     memo = model_cpu_memo(model)
@@ -204,11 +207,14 @@ def get_wb_chi2_fn(model, subtract_mean: bool):
     from pint_tpu.ops.compile import TimedProgram, host_transfer
 
     if not host:
-        cache[key] = TimedProgram(precision_jit(chi2fn), "wb_chi2")
+        cache[key] = TimedProgram(precision_jit(chi2fn), "wb_chi2",
+                                  precision_spec=model.xprec.name)
         return cache[key]
 
-    fused_fn = TimedProgram(precision_jit(chi2fn), "wb_chi2_fused")
-    resid_fn = TimedProgram(precision_jit(resids), "wb_resid")
+    fused_fn = TimedProgram(precision_jit(chi2fn), "wb_chi2_fused",
+                            precision_spec=model.xprec.name)
+    resid_fn = TimedProgram(precision_jit(resids), "wb_resid",
+                            precision_spec=model.xprec.name)
 
     def chi2_tail(params, tensor, r0, sw_t, n_dm):
         basis = _noise_basis_aug(model, params, tensor, sw_t, n_dm)

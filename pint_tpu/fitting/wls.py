@@ -229,7 +229,8 @@ def get_step_fn(model: TimingModel, free: tuple[str, ...], subtract_mean: bool):
     # on CPU; it is automatic on non-CPU backends). The flag is part of
     # the cache key, so toggling it mid-process takes effect.
     if not host_solve:
-        cache[key] = TimedProgram(precision_jit(step), "wls_step")
+        cache[key] = TimedProgram(precision_jit(step), "wls_step",
+                                  precision_spec=model.xprec.name)
         return cache[key]
 
     # Non-CPU backends: the TPU emulates f64 as f32-pairs whose RANGE is
@@ -244,8 +245,10 @@ def get_step_fn(model: TimingModel, free: tuple[str, ...], subtract_mean: bool):
     # host in true f64.
     from pint_tpu.ops.compile import host_transfer
 
-    fused_fn = TimedProgram(precision_jit(step), "wls_step_fused")
-    device_fn = TimedProgram(precision_jit(design), "wls_design")
+    fused_fn = TimedProgram(precision_jit(step), "wls_step_fused",
+                            precision_spec=model.xprec.name)
+    device_fn = TimedProgram(precision_jit(design), "wls_design",
+                             precision_spec=model.xprec.name)
 
     def step_host_solve(params, tensor, track_pn, delta_pn, weights, errors):
         r0_d, M_d = device_fn(params, tensor, track_pn, delta_pn, weights)

@@ -368,6 +368,7 @@ def _grid_single_fn(model, parnames, free, subtract_mean, maxiter, batch,
                     lambda t: vk(t, params, data), tiles)
             ),
             "grid",
+            precision_spec=model.xprec.name,
         )
     return cache[key], key
 
@@ -477,5 +478,6 @@ def _grid_sharded(model, parnames, free, subtract_mean, maxiter, mesh,
         cache[key] = TimedProgram(
             precision_jit(fn), "grid_sharded",
             collective_axes=(toa_axis,) if shard_toas else (),
+            precision_spec=model.xprec.name,
         )
     return cache[key](pts, params, data)

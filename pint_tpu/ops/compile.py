@@ -191,20 +191,32 @@ class TimedProgram:
       program MUST contain (empty = no collective may appear, the
       1-device contract), ``canonical=True`` (the default — every fit
       program takes canonicalized operands) arms the retrace-budget
-      pass. ``PINT_TPU_AUDIT=strict`` turns violations into compile-time
+      pass, and ``precision_spec`` declares the extended-precision
+      discipline (``"dd64"`` / ``"qf32"`` / ``"f64"`` or a
+      :class:`~pint_tpu.analysis.ddflow.PrecisionSpec`) that arms the
+      dd-flow dataflow passes — a program carrying dd operands with no
+      spec draws a warn-level ``dd-spec`` audit event.
+      ``PINT_TPU_AUDIT=strict`` turns violations into compile-time
       errors; ``=0`` skips the audit.
+    - Each audited lowering also lands in the static cost ledger
+      (pint_tpu/analysis/costmodel.py): FLOPs, bytes moved, collective
+      bytes and peak live buffer bytes per program label — the numbers
+      ``python -m pint_tpu.analysis.cost --check`` gates against the
+      checked-in budgets.
     """
 
     __slots__ = ("jfn", "label", "collective_axes", "canonical",
-                 "_exes", "_lock")
+                 "precision_spec", "_exes", "_lock")
 
     def __init__(self, jfn, label: str,
                  collective_axes: tuple[str, ...] = (),
-                 canonical: bool = True):
+                 canonical: bool = True,
+                 precision_spec=None):
         self.jfn = jfn
         self.label = label
         self.collective_axes = tuple(collective_axes)
         self.canonical = canonical
+        self.precision_spec = precision_spec
         self._exes: dict = {}
         self._lock = threading.Lock()
 
@@ -247,16 +259,24 @@ class TimedProgram:
                                else self.jfn.lower(*args))
                 from pint_tpu.analysis.jaxpr_audit import audit_program
 
+                closed = None if traced is None else traced.jaxpr
                 audit_program(
                     self.label,
-                    None if traced is None else traced.jaxpr,
+                    closed,
                     args,
                     collective_axes=self.collective_axes,
                     canonical=self.canonical,
                     prior_sigs=tuple(self._exes.keys()),
                     sig=sig,
                     program_id=id(self),
+                    spec=self.precision_spec,
                 )
+                if closed is not None:
+                    # static cost ledger (analysis/costmodel.py): every
+                    # lowering's FLOPs/bytes land beside the audit block
+                    from pint_tpu.analysis import costmodel
+
+                    costmodel.record_program(self.label, closed)
                 with perf.stage("compile"):
                     exe = lowered.compile()
                 perf.add(f"compiled:{self.label}", 1)

@@ -66,7 +66,7 @@ def taylor_horner_dd(x: DD, coeffs: Sequence[Union[Array, DD]]) -> DD:
     float64 anyway; the *accumulation* is what needs dd).
     """
     if len(coeffs) == 0:
-        return dd(jnp.zeros_like(x.hi))
+        return dd(jnp.zeros_like(x.hi))  # jaxlint: disable=dd-truncate — shape/dtype metadata only, no value read
     last = coeffs[-1]
     if isinstance(last, DD):
         acc = dd_mul_fp(last, 1.0 / _FACT[len(coeffs) - 1])

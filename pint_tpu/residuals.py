@@ -87,7 +87,8 @@ def get_resid_fn(model: TimingModel, subtract_mean: bool):
         # program too: the downhill loops call it once per damping trial,
         # and on the flagship it was the compile the background overlap
         # never covered (the r5 91 s first-fit wall)
-        cache[key] = TimedProgram(precision_jit(fn), "resid")
+        cache[key] = TimedProgram(precision_jit(fn), "resid",
+                                  precision_spec=model.xprec.name)
     return cache[key]
 
 

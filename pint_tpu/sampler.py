@@ -213,7 +213,10 @@ def _get_run(lnpost, a: float):
     # static nsteps: a longer resume segment is a new program (same as the
     # old split-key signature); the TimedProgram wrapper makes compiles
     # visible to the perf breakdown and the jaxpr auditor
-    prog = TimedProgram(precision_jit(run, static_argnums=(2,)), "mcmc_chain")
+    # the chain state is a plain f64 hyperparameter vector; the posterior's
+    # internal dd arithmetic closes over the model (spec mode "f64")
+    prog = TimedProgram(precision_jit(run, static_argnums=(2,)), "mcmc_chain",
+                        precision_spec="f64")
     _RUN_CACHE.setdefault(lnpost, {})[a] = prog
     return prog
 

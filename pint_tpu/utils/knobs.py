@@ -39,6 +39,8 @@ KNOBS: dict[str, tuple[str | None, str]] = {
     # --- program audit (pint_tpu/analysis/) ------------------------------------
     "PINT_TPU_AUDIT": ("warn", "jaxpr auditor mode: warn (default), strict (raise), 0 (off)"),
     "PINT_TPU_AUDIT_CONST_BYTES": ("262144", "large-constant-capture audit threshold in bytes"),
+    "PINT_TPU_DDFLOW": ("1", "0: skip the dd-flow precision-dataflow audit passes (analysis/ddflow.py)"),
+    "PINT_TPU_COST_BUDGET_TOL": ("0.15", "fractional static-cost growth tolerated by python -m pint_tpu.analysis.cost --check"),
     # --- ephemeris / astrometry chain ------------------------------------------
     "PINT_TPU_EPHEM": (None, "path to a JPL SPK kernel; unset = analytic ephemeris"),
     "PINT_TPU_KERNEL_EPHEM": ("auto", "Chebyshev kernel-pack serving: auto (pack a configured SPK kernel), 1 (also snapshot the analytic/N-body path), 0 (off)"),

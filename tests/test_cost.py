@@ -1,0 +1,202 @@
+"""Static cost model + budget gate (pint_tpu/analysis/costmodel.py, cost.py).
+
+Three layers: unit locks on the cost walker's arithmetic (a priced
+matmul, scan trip-count multiplication, collective payload, peak-memory
+liveness), the budget-comparison gate proven live by a synthetic +15%
+FLOP regression (and by stale/missing-coverage entries), and the
+tier-1 acceptance run: the REAL headline programs rebuilt at canonical
+shapes price within tolerance of the checked-in
+``pint_tpu/analysis/cost_budgets.json``.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from pint_tpu.analysis import cost as costcli
+from pint_tpu.analysis import costmodel
+
+
+def _price(fn, *args):
+    return costmodel.program_cost(jax.jit(fn).trace(*args).jaxpr)
+
+
+class TestCostWalker:
+    def test_matmul_flops(self):
+        rec = _price(lambda a, b: a @ b, jnp.ones((8, 16)), jnp.ones((16, 4)))
+        assert rec["flops"] >= 2 * 8 * 16 * 4
+        assert rec["flops"] < 4 * 8 * 16 * 4  # and not wildly over
+
+    def test_elementwise_and_transcendental_weights(self):
+        lin = _price(lambda x: x + 1.0, jnp.ones(1000))
+        trig = _price(lambda x: jnp.sin(x), jnp.ones(1000))
+        assert trig["flops"] > 4 * lin["flops"]
+
+    def test_bytes_and_peak(self):
+        rec = _price(lambda x: (x * 2.0).sum(), jnp.ones(1024))
+        assert rec["bytes_read"] >= 1024 * 8
+        assert rec["bytes_written"] >= 1024 * 8
+        # peak: input + intermediate live together
+        assert rec["peak_bytes"] >= 2 * 1024 * 8
+
+    def test_scan_multiplies_by_trip_count(self):
+        def loop(x, n):
+            def body(c, _):
+                return jnp.sin(c) + 1.0, None
+
+            out, _ = jax.lax.scan(body, x, None, length=n)
+            return out
+
+        r10 = _price(lambda x: loop(x, 10), jnp.ones(64))
+        r40 = _price(lambda x: loop(x, 40), jnp.ones(64))
+        assert r40["flops"] > 3 * r10["flops"]
+
+    def test_while_body_counted_once(self):
+        """Dynamic trip counts are unknowable statically: the fused-LM
+        while body prices as per-iteration cost."""
+        def loop(x):
+            return jax.lax.while_loop(
+                lambda c: c[1] < 5,
+                lambda c: (jnp.sin(c[0]), c[1] + 1),
+                (x, jnp.int32(0)))[0]
+
+        r = _price(loop, jnp.ones(64))
+        one_sin = _price(lambda x: jnp.sin(x), jnp.ones(64))
+        assert r["flops"] < 3 * one_sin["flops"]
+
+    def test_collective_bytes(self):
+        if len(jax.devices()) < 2:
+            pytest.skip("needs the multi-device virtual mesh")
+        from jax.sharding import PartitionSpec as P
+
+        import pint_tpu.distributed as dist
+        from pint_tpu.fitting.sharded import _shard_map
+
+        mesh = dist.fit_mesh()
+        f = _shard_map()(
+            lambda x: jax.lax.psum(jnp.sum(x), "toa"),
+            mesh=mesh, in_specs=(P("toa"),), out_specs=P(),
+            check_vma=False,
+        )
+        rec = _price(jax.jit(f), jnp.arange(64.0))
+        assert rec["collective_bytes"] > 0
+        rec0 = _price(lambda x: jnp.sum(x), jnp.arange(64.0))
+        assert rec0["collective_bytes"] == 0
+
+    def test_ledger_records_max_per_label(self):
+        costmodel.reset_ledger()
+        costmodel.record_program(
+            "t", jax.jit(lambda x: x + 1).trace(jnp.ones(8)).jaxpr)
+        costmodel.record_program(
+            "t", jax.jit(lambda x: jnp.sin(x) + 1).trace(jnp.ones(8)).jaxpr)
+        big = costmodel.cost_block()["t"]["flops"]
+        costmodel.record_program(
+            "t", jax.jit(lambda x: x + 1).trace(jnp.ones(8)).jaxpr)
+        assert costmodel.cost_block()["t"]["flops"] == big  # max kept
+        costmodel.reset_ledger()
+        assert costmodel.cost_block() == {}
+
+
+def _fake_costs():
+    return {
+        "prog_a": {"flops": 1_000_000, "bytes_read": 8_000_000,
+                   "bytes_written": 4_000_000, "collective_bytes": 0,
+                   "peak_bytes": 100_000},
+        "prog_b": {"flops": 500_000, "bytes_read": 2_000_000,
+                   "bytes_written": 1_000_000, "collective_bytes": 64,
+                   "peak_bytes": 50_000},
+    }
+
+
+def _write_budget(tmp_path, programs):
+    path = tmp_path / "budgets.json"
+    path.write_text(json.dumps({"programs": programs}))
+    return path
+
+
+class TestBudgetGate:
+    def test_clean_within_tolerance(self, tmp_path):
+        path = _write_budget(tmp_path, _fake_costs())
+        costs = _fake_costs()
+        costs["prog_a"]["flops"] = int(1_000_000 * 1.10)  # +10% < tol
+        ok, failures = costcli.check_budgets(path, tol=0.15, costs=costs)
+        assert ok, failures
+
+    def test_synthetic_15pct_flop_regression_fails(self, tmp_path):
+        """THE acceptance fixture: a headline program whose static FLOPs
+        grew past the tolerance without a budget regen fails the gate."""
+        path = _write_budget(tmp_path, _fake_costs())
+        costs = _fake_costs()
+        costs["prog_a"]["flops"] = int(1_000_000 * 1.16)  # +16% > 15% tol
+        ok, failures = costcli.check_budgets(path, tol=0.15, costs=costs)
+        assert not ok
+        assert any("prog_a" in f and "flops" in f for f in failures)
+
+    def test_tol_knob(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("PINT_TPU_COST_BUDGET_TOL", "0.30")
+        path = _write_budget(tmp_path, _fake_costs())
+        costs = _fake_costs()
+        costs["prog_a"]["flops"] = int(1_000_000 * 1.25)
+        ok, _ = costcli.check_budgets(path, costs=costs)  # tol from knob
+        assert ok
+        ok, _ = costcli.check_budgets(path, tol=0.15, costs=costs)
+        assert not ok
+
+    def test_missing_coverage_fails(self, tmp_path):
+        budgets = _fake_costs()
+        budgets.pop("prog_b")
+        path = _write_budget(tmp_path, budgets)
+        ok, failures = costcli.check_budgets(path, tol=0.15,
+                                             costs=_fake_costs())
+        assert not ok
+        assert any("prog_b" in f and "NO checked-in budget" in f
+                   for f in failures)
+
+    def test_stale_budget_entry_fails(self, tmp_path):
+        path = _write_budget(tmp_path, _fake_costs())
+        costs = _fake_costs()
+        costs.pop("prog_b")
+        ok, failures = costcli.check_budgets(path, tol=0.15, costs=costs)
+        assert not ok
+        assert any("prog_b" in f and "stale" in f for f in failures)
+
+    def test_shrinks_are_clean(self, tmp_path):
+        path = _write_budget(tmp_path, _fake_costs())
+        costs = _fake_costs()
+        costs["prog_a"]["flops"] = 100  # massive improvement: no failure
+        ok, failures = costcli.check_budgets(path, tol=0.15, costs=costs)
+        assert ok, failures
+
+
+class TestHeadlineBudgets:
+    """The tier-1 acceptance gate over the REAL checked-in budgets."""
+
+    def test_budget_file_covers_every_headline_program(self):
+        doc = costcli.load_budgets()
+        programs = set(doc["programs"])
+        # the coverage contract from the issue: fused fit (WLS+GLS),
+        # batched fit, grids, prepare_*, kernel eval, noise
+        # likelihood/chain
+        assert {"fused_wls_fit", "fused_gls_fit", "grid",
+                "prepare_geometry", "prepare_ephemeris",
+                "prepare_kernel_eval", "noise_loglike",
+                "noise_chain_hmc"} <= programs
+        assert any(p.startswith("batched_wls_fit") for p in programs)
+        for rec in doc["programs"].values():
+            for metric in costmodel.METRICS:
+                assert metric in rec
+
+    def test_headline_programs_price_within_budget(self):
+        """Rebuild every headline program at the canonical shapes and
+        run the real gate (this IS `python -m pint_tpu.analysis.cost
+        --check`, in-process so jax warm-up is shared with the suite)."""
+        ok, failures = costcli.check_budgets(verbose=lambda *_: None)
+        assert ok, "\n".join(failures)
+
+    def test_cli_check_runs(self, capsys):
+        assert costcli.main(["--show"]) == 0
+        assert "programs" in capsys.readouterr().out

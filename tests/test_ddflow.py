@@ -1,0 +1,346 @@
+"""dd-flow contract (pint_tpu/analysis/ddflow.py + the audit wiring).
+
+Mirrors tests/test_analysis.py's proven-live pattern: every dd-flow
+pass is seeded by a tiny program constructed to violate exactly its
+invariant, with a clean counterpart locking the non-flagging case — an
+analysis pass that silently stops firing is itself the failure mode
+this subsystem exists to prevent. The production half locks the smoke
+bench strict-audit clean with dd-flow enabled and the precision-spec
+plumbing through TimedProgram.
+"""
+
+import importlib
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from pint_tpu.analysis import (
+    AuditError,
+    PrecisionSpec,
+    audit_block,
+    audit_jitted,
+    reset_ledger,
+)
+from pint_tpu.analysis import ddflow
+from pint_tpu.ops.compile import TimedProgram
+
+# the ops package re-exports the dd() constructor, shadowing the module
+ddm = importlib.import_module("pint_tpu.ops.dd")
+
+
+@pytest.fixture(autouse=True)
+def _clean_ledger(monkeypatch):
+    monkeypatch.setenv("PINT_TPU_AUDIT", "warn")
+    monkeypatch.delenv("PINT_TPU_DDFLOW", raising=False)
+    reset_ledger()
+    yield
+    reset_ledger()
+
+
+def _passes(violations):
+    return [v.pass_name for v in violations]
+
+
+def _dd(n=4, val=1.0):
+    # explicit dtype: strong-typed leaves, or the weak-type pass fires too
+    return ddm.DD(jnp.full(n, val, dtype=jnp.float64),
+                  jnp.zeros(n, dtype=jnp.float64))
+
+
+X = lambda: _dd(4, 2.0)  # noqa: E731 — fixture-lite
+Y = lambda: _dd(4, 3.0)  # noqa: E731
+
+
+class TestArgPairDiscovery:
+    def test_dd_leaves_pair(self):
+        pairs = ddflow.arg_dd_pairs((X(), jnp.ones(4), Y()))
+        assert pairs == [(0, 1), (3, 4)]
+
+    def test_named_dict_columns_pair(self):
+        args = ({"t_hi": jnp.ones(4), "t_lo": jnp.zeros(4),
+                 "w": jnp.ones(4)},)
+        pairs = ddflow.arg_dd_pairs(args)
+        assert pairs == [(0, 1)]
+
+    def test_spec_normalization(self):
+        assert ddflow.normalize_spec("dd64").mode == "dd64"
+        assert ddflow.normalize_spec(None) is None
+        spec = PrecisionSpec(mode="qf32", dd_out=False)
+        assert ddflow.normalize_spec(spec) is spec
+        with pytest.raises(TypeError):
+            ddflow.normalize_spec(42)
+
+
+class TestSeededViolations:
+    """One deliberately broken program per pass; clean counterpart each."""
+
+    # --- dd-truncate-flow -------------------------------------------------------
+    def test_truncation_hi_alone(self):
+        vs = audit_jitted(lambda a, b: ddm.dd_add(a, b).hi, X(), Y(),
+                          label="seed_trunc", precision_spec="dd64")
+        assert _passes(vs) == ["dd-truncate-flow"]
+
+    def test_truncation_fake_zero_lo(self):
+        vs = audit_jitted(
+            lambda a, b: ddm.DD(ddm.dd_add(a, b).hi, jnp.zeros(4)),
+            X(), Y(), label="seed_trunc_fake", precision_spec="dd64")
+        assert _passes(vs) == ["dd-truncate-flow"]
+
+    def test_clean_pair_output(self):
+        vs = audit_jitted(lambda a, b: ddm.dd_add(a, b), X(), Y(),
+                          label="seed_pair_ok", precision_spec="dd64")
+        assert vs == []
+
+    def test_clean_explicit_collapse(self):
+        """dd_to_float is the sanctioned collapse: an f64 output, not a
+        hi escaping its lo."""
+        vs = audit_jitted(lambda a, b: ddm.dd_to_float(ddm.dd_mul(a, b)),
+                          X(), Y(), label="seed_collapse_ok",
+                          precision_spec="dd64")
+        assert vs == []
+
+    def test_dd_out_false_disarms(self):
+        vs = audit_jitted(lambda a, b: ddm.dd_add(a, b).hi, X(), Y(),
+                          label="seed_trunc_optout",
+                          precision_spec=PrecisionSpec(mode="dd64",
+                                                       dd_out=False))
+        assert vs == []
+
+    # --- dd-recombine -----------------------------------------------------------
+    def test_recombine_collapse_then_resplit(self):
+        vs = audit_jitted(
+            lambda a, b: ddm.dd_add_fp(b, ddm.dd_to_float(a)), X(), Y(),
+            label="seed_recombine", precision_spec="dd64")
+        assert "dd-recombine" in _passes(vs)
+
+    def test_recombine_mul_of_own_members(self):
+        vs = audit_jitted(lambda a: a.hi * a.lo, X(),
+                          label="seed_recombine_mul",
+                          precision_spec=PrecisionSpec("dd64", dd_out=False))
+        assert "dd-recombine" in _passes(vs)
+
+    def test_clean_dd_chain(self):
+        """The full dd vocabulary — add/sub/mul/div/rint/normalize —
+        stays quiet: every EFT chain is recognized as sanctioned."""
+        def chain(a, b):
+            s = ddm.dd_add(a, b)
+            p = ddm.dd_mul(s, ddm.dd_sub(a, b))
+            q = ddm.dd_div(p, ddm.dd_add_fp(b, 2.0))
+            n, frac = ddm.dd_rint(q)
+            return n, ddm.dd_normalize(frac)
+
+        vs = audit_jitted(chain, X(), Y(), label="seed_chain_ok",
+                          precision_spec="dd64")
+        assert vs == []
+
+    # --- dd-mix -----------------------------------------------------------------
+    def test_mix_dd_times_f32(self):
+        vs = audit_jitted(lambda a, z: a.hi * z, X(),
+                          jnp.ones(4, jnp.float32),
+                          label="seed_mix",
+                          precision_spec=PrecisionSpec("dd64", dd_out=False))
+        assert "dd-mix" in _passes(vs)
+
+    def test_mix_exempt_under_qf32_spec(self):
+        vs = audit_jitted(lambda a, z: a.hi * z, X(),
+                          jnp.ones(4, jnp.float32),
+                          label="seed_mix_qf",
+                          precision_spec=PrecisionSpec("qf32", dd_out=False))
+        assert "dd-mix" not in _passes(vs)
+
+    # --- dd-unnormalized --------------------------------------------------------
+    def test_unnormalized_declared_pair(self):
+        spec = PrecisionSpec(mode="dd64", dd_out=((0, 1),))
+        vs = audit_jitted(lambda a, b: (a.hi * b.hi, a.lo * b.lo),
+                          X(), Y(), label="seed_unnorm",
+                          precision_spec=spec)
+        assert "dd-unnormalized" in _passes(vs)
+
+    def test_declared_pair_clean_through_eft(self):
+        spec = PrecisionSpec(mode="dd64", dd_out=((0, 1),))
+        vs = audit_jitted(lambda a, b: ddm.dd_mul(a, b), X(), Y(),
+                          label="seed_unnorm_ok", precision_spec=spec)
+        assert vs == []
+
+    def test_declared_pair_truncation_detected(self):
+        """Declared pair whose lo slot is not the hi's compensation."""
+        spec = PrecisionSpec(mode="dd64", dd_out=((0, 1),))
+        vs = audit_jitted(
+            lambda a, b: (ddm.dd_mul(a, b).hi, jnp.zeros(4)),
+            X(), Y(), label="seed_pair_trunc", precision_spec=spec)
+        assert "dd-truncate-flow" in _passes(vs)
+
+    # --- transforms stay quiet --------------------------------------------------
+    def test_vmap_scan_while_clean(self):
+        def loop(a, n):
+            def body(c):
+                acc, i = c
+                return ddm.dd_add(acc, ddm.dd(jnp.ones(4))), i + 1
+
+            acc, _ = jax.lax.while_loop(lambda c: c[1] < n, body,
+                                        (a, jnp.int32(0)))
+            return acc
+
+        vs = audit_jitted(loop, X(), jnp.int32(3), label="seed_while_ok",
+                          precision_spec="dd64")
+        assert vs == []
+
+        vs = audit_jitted(
+            jax.vmap(lambda a, b: ddm.dd_add(a, b)),
+            ddm.DD(jnp.full((3, 4), 2.0, dtype=jnp.float64),
+                   jnp.zeros((3, 4), dtype=jnp.float64)),
+            ddm.DD(jnp.ones((3, 4), dtype=jnp.float64),
+                   jnp.zeros((3, 4), dtype=jnp.float64)),
+            label="seed_vmap_ok", precision_spec="dd64")
+        assert vs == []
+
+    def test_linearize_clean(self):
+        """The design-matrix shape: jax.linearize over a dd chain — the
+        tangent arithmetic must not draw pair violations."""
+        def resid(a, delta):
+            v = ddm.dd_add_fp(a, delta)
+            return ddm.dd_to_float(ddm.dd_mul_fp(v, 2.0))
+
+        def design(a, d0):
+            (r0,), jvp = jax.linearize(lambda d: (resid(a, d),), d0)
+            return r0, jvp(jnp.ones(4))[0]
+
+        vs = audit_jitted(design, X(), jnp.zeros(4),
+                          label="seed_lin_ok", precision_spec="dd64")
+        assert vs == []
+
+
+class TestDdSpec:
+    def test_unannotated_dd_program_warns(self):
+        vs = audit_jitted(lambda a, b: ddm.dd_add(a, b), X(), Y(),
+                          label="seed_nospec")
+        assert _passes(vs) == ["dd-spec"]
+
+    def test_plain_f64_program_needs_no_spec(self):
+        vs = audit_jitted(lambda x: x * 2.0, jnp.arange(4.0),
+                          label="seed_nospec_f64")
+        assert "dd-spec" not in _passes(vs)
+
+    def test_dd_spec_never_raises_under_strict(self, monkeypatch):
+        """Warn-level contract: the nag lands on the ledger but cannot
+        fail a compile — unlike every real violation."""
+        monkeypatch.setenv("PINT_TPU_AUDIT", "strict")
+        vs = audit_jitted(lambda a, b: ddm.dd_add(a, b), X(), Y(),
+                          label="seed_nospec_strict")
+        assert _passes(vs) == ["dd-spec"]
+        assert audit_block()["n_violations"] == 1
+
+    def test_real_violation_still_raises_under_strict(self, monkeypatch):
+        monkeypatch.setenv("PINT_TPU_AUDIT", "strict")
+        with pytest.raises(AuditError):
+            audit_jitted(lambda a, b: ddm.dd_add(a, b).hi, X(), Y(),
+                         label="seed_strict_trunc", precision_spec="dd64")
+
+    def test_knob_disables_flow_passes(self, monkeypatch):
+        monkeypatch.setenv("PINT_TPU_DDFLOW", "0")
+        vs = audit_jitted(lambda a, b: ddm.dd_add(a, b).hi, X(), Y(),
+                          label="seed_knob_off", precision_spec="dd64")
+        assert vs == []
+        vs = audit_jitted(lambda a, b: ddm.dd_add(a, b), X(), Y(),
+                          label="seed_knob_off_nospec")
+        assert vs == []  # the dd-spec nag is off with the flow passes
+
+
+class TestPrecisionDemotionRebase:
+    """The precision-demotion pass is rebased on declared specs: qf32
+    exemption by label flow, not the blanket any-f32-input heuristic."""
+
+    def test_declared_dd64_with_f32_input_still_flags(self):
+        """The tightened coverage: an f32 aux input no longer silences
+        the pass when the program DECLARES dd64."""
+        vs = audit_jitted(
+            lambda x, z: x.astype(jnp.float32).astype(jnp.float64) + 0 * z,
+            jnp.arange(4.0), jnp.zeros(4, jnp.float32),
+            label="seed_demote_mixed", precision_spec="f64")
+        assert "precision-demotion" in _passes(vs)
+
+    def test_declared_qf32_exempt(self):
+        vs = audit_jitted(
+            lambda x: x.astype(jnp.float32).astype(jnp.float64),
+            jnp.arange(4.0), label="seed_demote_qf",
+            precision_spec="qf32")
+        assert "precision-demotion" not in _passes(vs)
+
+    def test_legacy_heuristic_without_spec(self):
+        """No declared spec: the conservative any-f32-input exemption
+        still applies (pre-rebase behavior preserved)."""
+        vs = audit_jitted(
+            lambda x, y: x.astype(jnp.float32) + y,
+            jnp.arange(4.0), jnp.zeros(4, jnp.float32),
+            label="seed_demote_legacy")
+        assert "precision-demotion" not in _passes(vs)
+
+
+class TestTimedProgramPlumbing:
+    def test_spec_reaches_the_auditor(self, monkeypatch):
+        monkeypatch.setenv("PINT_TPU_AUDIT", "strict")
+        tp = TimedProgram(jax.jit(lambda a, b: ddm.dd_add(a, b).hi),
+                          "plumb_trunc", precision_spec="dd64")
+        with pytest.raises(AuditError):
+            tp.precompile(X(), Y())
+
+    def test_spec_ok_compiles_and_prices(self, monkeypatch):
+        from pint_tpu.analysis import costmodel
+
+        costmodel.reset_ledger()
+        tp = TimedProgram(jax.jit(lambda a, b: ddm.dd_add(a, b)),
+                          "plumb_ok", precision_spec="dd64")
+        tp.precompile(X(), Y())
+        blk = audit_block()
+        assert not any(v["program"] == "plumb_ok"
+                       for v in blk["violations"])
+        # the same lowering landed on the static cost ledger
+        cost = costmodel.cost_block()["plumb_ok"]
+        assert cost["flops"] > 0 and cost["hbm_bytes"] > 0
+
+    def test_every_fit_program_site_declares_a_spec(self):
+        """Repo contract: every TimedProgram construction site in the
+        package declares a precision_spec — the dd-spec nag only binds
+        going forward if today's sites stay annotated."""
+        import os
+        import re
+
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        missing = []
+        for dirpath, dirnames, filenames in os.walk(
+                os.path.join(repo, "pint_tpu")):
+            dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+            for fn in filenames:
+                if not fn.endswith(".py") or fn in ("compile.py", "lint.py"):
+                    continue
+                path = os.path.join(dirpath, fn)
+                src = open(path).read()
+                for m in re.finditer(r"TimedProgram\(", src):
+                    call = src[m.start():m.start() + 400]
+                    if "precision_spec" not in call:
+                        line = src[:m.start()].count("\n") + 1
+                        missing.append(f"{os.path.relpath(path, repo)}:{line}")
+        assert not missing, \
+            f"TimedProgram sites without precision_spec: {missing}"
+
+
+class TestProductionClean:
+    def test_smoke_bench_strict_with_ddflow(self, monkeypatch):
+        """The acceptance lock: the instrumented smoke fit runs under
+        PINT_TPU_AUDIT=strict with dd-flow ON (the default) and comes up
+        violation-free, with the dd passes registered."""
+        import bench
+
+        monkeypatch.setenv("PINT_TPU_AUDIT", "strict")
+        monkeypatch.setenv("PINT_TPU_DDFLOW", "1")
+        reset_ledger()
+        rec = bench.smoke_bench(ntoas=120, maxiter=2)
+        audit = rec["audit"]
+        assert audit["n_violations"] == 0, audit["violations"]
+        assert audit["n_passes"] >= 13  # incl. dd-spec + 4 dd-flow passes
+        # the static cost block rode the record (bench satellite)
+        assert "wls_step" in rec["static_cost"]
+        assert rec["static_cost"]["wls_step"]["flops"] > 0

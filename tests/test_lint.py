@@ -253,12 +253,62 @@ class TestSilentExcept:
         assert _lint(src) == []
 
 
+class TestDdTruncate:
+    """Host `.hi` read without its `.lo` in the same scope: the 53-bit
+    truncation the jaxpr-level dd-truncate-flow pass catches on device,
+    caught at the source level for host code."""
+
+    def test_fires_on_hi_without_lo(self):
+        src = "def collapse(v):\n    return float(v.hi)\n"
+        assert _rules(_lint(src)) == ["dd-truncate"]
+
+    def test_reading_both_members_exempt(self):
+        src = ("def collapse(v):\n"
+               "    return float(v.hi) + float(v.lo)\n")
+        assert _lint(src) == []
+
+    def test_pairing_is_per_base_expression(self):
+        """Reading a.hi and b.lo does NOT pair: the truncation is on a."""
+        src = "def f(a, b):\n    return a.hi + b.lo\n"
+        assert _rules(_lint(src)) == ["dd-truncate"]
+
+    def test_pairing_is_per_scope(self):
+        """hi in one function, lo in another: both scopes truncate-read."""
+        src = ("def f(v):\n    return v.hi\n"
+               "def g(v):\n    return v.lo\n")
+        assert _rules(_lint(src)) == ["dd-truncate"]
+
+    def test_module_scope_pairs(self):
+        src = "HI = V.hi\nLO = V.lo\n"
+        assert _lint(src) == []
+
+    def test_subscripted_base_pairs(self):
+        src = ("def f(params):\n"
+               "    return params['F0'].hi, params['F0'].lo\n")
+        assert _lint(src) == []
+
+    def test_dd_accessor_file_exempt(self):
+        src = "def dd_to_float(x):\n    return x.hi\n"
+        assert _lint(src, path="pint_tpu/ops/dd.py") == []
+
+    def test_inline_suppression(self):
+        src = ("def f(x):\n"
+               "    return zeros_like(x.hi)  "
+               "# jaxlint: disable=dd-truncate — shape metadata only\n")
+        assert _lint(src) == []
+
+    def test_attribute_store_not_flagged(self):
+        src = "def f(obj):\n    obj.hi = 1.0\n"
+        assert _lint(src) == []
+
+
 class TestConfig:
     def test_pyproject_block_parsed(self):
         cfg = load_config(REPO)
         assert "pint_tpu" in cfg["paths"]
         assert any(p.endswith("knobs.py") for p in cfg["env-registry"])
         assert set(cfg["select"]) == set(RULES)
+        assert any(p.endswith("ops/dd.py") for p in cfg["dd-accessors"])
 
     def test_defaults_without_pyproject(self, tmp_path):
         cfg = load_config(str(tmp_path))
