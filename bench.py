@@ -1422,6 +1422,132 @@ def smoke_noise_bench(ntoas: int = 220, n_evals: int = 8192,
     return rec
 
 
+def smoke_session_bench(ntoas: int = 700, n_appends: int = 10, k: int = 8,
+                        n_full: int = 2) -> dict:
+    """CPU timing-session smoke bench: a replayed append trace against a
+    resident :class:`~pint_tpu.serve.session.TimingSession`.
+
+    A base dataset is fitted once; then ``n_appends`` batches of ``k``
+    TOAs (sliced from one pre-built consistent fake set, so they are
+    plausible observations) replay through ``session.append`` — the
+    O(k) prepared-column append + rank-k normal-equation update +
+    fixed-shape GN polish (fitting/incremental.py). Headline:
+    ``incremental_refit_ms_p50/p99``, ``append_fits_per_sec_per_chip``,
+    and ``incremental_vs_full`` — the incremental answer vs what a
+    non-resident server pays per append (a fresh warm fitter + full
+    fused refit at the new, never-before-seen shape; compile included on
+    that side because the shape change forces it, which is exactly the
+    cost the resident session's fixed-shape buckets delete).
+
+    This is the append-serving telemetry CONTRACT surface: tier-1
+    (tests/test_session.py) asserts every append took the incremental
+    path, the ``incremental_breakdown`` names ≥90% of the wall, the
+    jaxpr audit is strict-clean (the ``incr_*`` programs are sync-free
+    by the prepare-sync pass), and the degradation ledger stays empty
+    under ``PINT_TPU_DEGRADED=error``. Run from the CLI with
+    ``python bench.py --smoke --session`` (prints one JSON line).
+    """
+    import copy
+
+    import jax
+
+    from pint_tpu.astro import time as ptime
+    from pint_tpu.fitting import fit_auto
+    from pint_tpu.fitting.wls import apply_delta
+    from pint_tpu.io.par import parse_parfile
+    from pint_tpu.models.builder import build_model
+    from pint_tpu.ops import perf
+    from pint_tpu.ops.compile import setup_persistent_cache
+    from pint_tpu.serve import TimingSession
+    from pint_tpu.simulation import make_fake_toas_uniform
+
+    setup_persistent_cache()
+    model = build_model(parse_parfile(SMOKE_PAR, from_text=True))
+    N = ntoas + n_appends * k
+    freqs = np.where(np.arange(N) % 2 == 0, 1400.0, 2300.0)
+    full = make_fake_toas_uniform(
+        54500, 55500, N, model, obs="gbt", freq_mhz=freqs, error_us=1.0,
+        add_noise=True, rng=np.random.default_rng(11),
+    )
+    base = full.select(np.arange(N) < ntoas)
+    free = tuple(model.free_params)
+    delta = np.array([2e-10 if n == "F0" else 0.0 for n in free])
+    model.params = apply_delta(model.params, free, delta)
+
+    session = TimingSession(base, model)
+    t0 = time.time()
+    session.fit()
+    initial_fit_s = time.time() - t0
+
+    ep = full.utc_raw
+
+    def rows(lo, hi):
+        return dict(
+            utc=ptime.MJDEpoch(ep.day[lo:hi], ep.frac_hi[lo:hi],
+                               ep.frac_lo[lo:hi]),
+            error_us=full.error_us[lo:hi], freq_mhz=full.freq_mhz[lo:hi],
+            obs=full.obs[lo:hi], flags=[dict(f) for f in full.flags[lo:hi]],
+        )
+
+    was = perf.enabled()
+    perf.enable(True)
+    t0 = time.time()
+    with perf.collect() as rep:
+        for t in range(n_appends):
+            lo = ntoas + t * k
+            session.append(**rows(lo, lo + k))
+    append_wall = time.time() - t0
+    perf.enable(was)
+    breakdown = perf.incremental_breakdown(rep)
+    stats = session.stats()
+
+    # the non-resident comparator: what each of the LAST n_full appends
+    # would have cost served as a fresh warm full refit (new fitter, new
+    # shape => retrace+compile — the per-append price without the
+    # resident session's fixed-shape programs)
+    full_s = []
+    for t in range(max(n_appends - n_full, 0), n_appends):
+        toas_t = full.select(np.arange(N) < ntoas + (t + 1) * k)
+        m = copy.deepcopy(model)
+        t0 = time.time()
+        fit_auto(toas_t, m, fused=True).fit_toas()
+        full_s.append(time.time() - t0)
+    full_ms = float(np.mean(full_s)) * 1e3 if full_s else None
+    p50 = stats.get("incremental_refit_ms_p50")
+
+    rec = {
+        "metric": "smoke_session_bench",
+        "ntoas_base": ntoas,
+        "n_appends": n_appends,
+        "append_rows": k,
+        "backend": jax.default_backend(),
+        "n_devices": len(jax.devices()),
+        "initial_fit_s": round(initial_fit_s, 3),
+        "append_wall_s": round(append_wall, 3),
+        "append_fits_per_sec_per_chip": round(n_appends / append_wall, 3),
+        "incremental_refit_ms_p50": p50,
+        "incremental_refit_ms_p99": stats.get("incremental_refit_ms_p99"),
+        "full_refit_ms": None if full_ms is None else round(full_ms, 3),
+        "incremental_vs_full": (
+            None if (full_ms is None or not p50) else round(full_ms / p50, 2)),
+        "session_paths": stats["paths"],
+        "note": "full side = fresh warm fitter per append at the grown "
+                "shape, retrace/compile included (the cost a non-resident "
+                "server pays every append)",
+        "degradation_count": _degradation_count(),
+        "degradation_kinds": _degradation_kinds(),
+        "static_cost": _static_cost(),
+    }
+    rec.update(breakdown)
+    try:
+        from pint_tpu.analysis.jaxpr_audit import audit_block
+
+        rec["audit"] = audit_block()
+    except Exception:  # noqa: BLE001 — telemetry only  # jaxlint: disable=silent-except — telemetry assembly
+        rec["audit"] = None
+    return rec
+
+
 def smoke_batched_bench(n_fits: int = 32, ntoas: int = 96, maxiter: int = 5,
                         compare_sequential: bool = True) -> dict:
     """CPU fleet-fit smoke bench: n_fits synthetic WLS fits as ONE batched
@@ -1527,6 +1653,9 @@ if __name__ == "__main__":
         batched = "--batched" in sys.argv
         flagship = "--flagship" in sys.argv
         noise = "--noise" in sys.argv
+        if "--session" in sys.argv:
+            print(json.dumps(smoke_session_bench()), flush=True)
+            sys.exit(0)
         if flagship:
             print(json.dumps(smoke_flagship_bench()), flush=True)
             sys.exit(0)

@@ -44,7 +44,7 @@ BUDGET_PATH = Path(__file__).resolve().parent / "cost_budgets.json"
 #: canonical dataset shapes — budgets are pinned at these; changing them
 #: is a budget regen, not a silent re-baseline
 CANON = {"ntoas": 60, "noise_ntoas": 48, "batch": 3, "grid_pts": 4,
-         "chain_steps": 8, "chain_warmup": 4, "seed": 7}
+         "chain_steps": 8, "chain_warmup": 4, "seed": 7, "incr_k": 8}
 
 _WLS_PAR = """
 PSR COST
@@ -140,6 +140,15 @@ def _build_batched():
         model, toas = _model_toas(_WLS_PAR, CANON["ntoas"] + 4 * k)
         fitters.append(DownhillWLSFitter(toas, model, fused=True))
     return _trace_cost(*batched_fit_program(fitters))
+
+
+def _build_incr_blocks():
+    from pint_tpu.fitting import DownhillWLSFitter
+    from pint_tpu.fitting.incremental import incremental_blocks_program
+
+    model, toas = _model_toas(_WLS_PAR, CANON["ntoas"])
+    ftr = DownhillWLSFitter(toas, model, fused=True)
+    return _trace_cost(*incremental_blocks_program(ftr, k=CANON["incr_k"]))
 
 
 def _build_grid():
@@ -252,6 +261,7 @@ def build_headline_costs(verbose=print) -> dict[str, dict]:
         ("fused WLS fit", _build_fused_wls),
         ("fused GLS fit", _build_fused_gls),
         ("batched fleet fit", _build_batched),
+        ("incremental blocks", _build_incr_blocks),
         ("chi2 grid", _build_grid),
         ("prepare geometry", _build_prepare_geometry),
         ("prepare ephemeris", _build_prepare_ephemeris),

@@ -39,13 +39,14 @@ Passes (each returns a list of human-readable violation details):
     fused fit loop's contract is ONE host sync per fit, and a callback
     in the body re-serializes every iteration.
 ``prepare-sync``
-    Any host-sync primitive anywhere in a ``prepare_*`` or ``noise_*``
-    program (astro/device_prepare.py — geometry/ephemeris/N-body serve
-    and the ``prepare_kernel_eval`` Chebyshev kernel-pack program;
-    fitting/noise_like.py — the marginalized noise likelihood and its
-    chain/optimizer programs): these device residents must never
-    round-trip to the host mid-program — a step that needs host data
-    belongs on a host fallback path instead.
+    Any host-sync primitive anywhere in a ``prepare_*``, ``noise_*`` or
+    ``incr_*`` program (astro/device_prepare.py — geometry/ephemeris/
+    N-body serve and the ``prepare_kernel_eval`` Chebyshev kernel-pack
+    program; fitting/noise_like.py — the marginalized noise likelihood
+    and its chain/optimizer programs; fitting/incremental.py — the
+    rank-k block-update and trial-chi² programs): these device residents
+    must never round-trip to the host mid-program — a step that needs
+    host data belongs on a host fallback path instead.
 ``retrace-budget``
     A second compiled signature that differs from an existing one only
     in dtype/weak_type at identical tree structure and shapes. A
@@ -312,11 +313,15 @@ def _pass_host_sync(ctx: _Ctx) -> list[str]:
 #: label prefixes of programs contracted to contain ZERO host-sync
 #: primitives anywhere: the device-fused TOA prepare
 #: (astro/device_prepare.py, incl. the ``prepare_kernel_eval`` kernel-pack
-#: serve) and the Bayesian noise engine's likelihood/chain programs
+#: serve), the Bayesian noise engine's likelihood/chain programs
 #: (fitting/noise_like.py ``noise_loglike*``/``noise_chain*``/
 #: ``noise_fleet_chain*``/``noise_optimize`` — a callback inside a chain
-#: scan re-serializes every step of every vmapped chain)
-_SYNC_FREE_PREFIXES = ("prepare_", "noise_")
+#: scan re-serializes every step of every vmapped chain), and the
+#: incremental-refit engine's rank-k block/chi² programs
+#: (fitting/incremental.py ``incr_blocks_*``/``incr_chi2_*`` — the
+#: append-serving latency budget is milliseconds, a mid-program host
+#: round-trip is the wall it exists to avoid)
+_SYNC_FREE_PREFIXES = ("prepare_", "noise_", "incr_")
 
 
 def _pass_prepare_sync(ctx: _Ctx) -> list[str]:

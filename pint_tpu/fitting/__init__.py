@@ -11,6 +11,7 @@ from pint_tpu.fitting.gls import DownhillGLSFitter, GLSFitter  # noqa: F401
 from pint_tpu.fitting.wideband import WidebandDownhillFitter  # noqa: F401
 from pint_tpu.fitting.mcmc import MCMCFitter  # noqa: F401
 from pint_tpu.fitting.batch import BatchedFitter, fit_batch  # noqa: F401
+from pint_tpu.fitting.incremental import IncrementalEngine  # noqa: F401
 from pint_tpu.fitting.state import FitterState  # noqa: F401
 from pint_tpu.fitting.noise_like import (  # noqa: F401
     NoiseFleet,

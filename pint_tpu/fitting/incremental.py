@@ -1,0 +1,808 @@
+"""Incremental refits: rank-k normal-equation updates for TOA appends.
+
+A timing service (ROADMAP item 4) answers "k new TOAs arrived, refit"
+thousands of times against a dataset whose previous fit already holds
+~99.9% of the answer. The full pipeline pays O(N) twice over per append —
+an O(N) linearization inside the fused LM loop, and a retrace+recompile
+because N+k is a new program shape. This module makes the append refit
+O(k) + one fixed-shape polish:
+
+- **Additive normal-equation blocks.** Everything the downhill solve
+  consumes — the whitened Gram ``JᵀWJ``, right-hand side ``JᵀWr``, the
+  equilibration norms, the chi², and the ECORR Woodbury inner products
+  ``UᵀWJ`` / ``UᵀWr`` / ``UᵀWU`` — is a sum over rows. :class:`Blocks`
+  caches those sums at the converged fit point; k appended rows are
+  linearized at the same point by the fused ``incr_blocks_*`` program
+  (bucket-padded to a fixed shape, so appends never retrace) and added
+  in: a symmetric rank-k update. The weighted-mean subtraction
+  (``subtract_mean`` without PHOFF) is NOT additive row-wise, so the
+  blocks carry the centering cross-terms (``Σω·J``, ``Σw·v·J``, ...)
+  and :func:`assemble` forms the centered normal equations exactly —
+  in a **shifted frame** anchored at the cached fit point's means, so
+  the classic centered-Gram cancellation never amplifies.
+- **run_lm semantics, iteration 1 free.** The refit mirrors the fused
+  LM driver with ``maxiter=2``: iteration 1's linearization at the
+  cached point is served from the updated blocks (O(k)); its damped
+  trials re-solve the p×p system at any lam (free) with chi² checked by
+  the fixed-shape ``incr_chi2_*`` program; iteration 2 — the GN polish —
+  runs the blocks program once over the (bucket-padded) full data at the
+  accepted point, exactly the linearization the full warm refit would
+  converge on, so the reported parameters AND covariance are
+  term-for-term the full refit's (parity ≤ 1e-10 rel locked by
+  tests/test_incremental.py for WLS / GLS+ECORR / wideband).
+- **Declared staleness bounds.** The update is only used inside its
+  validity envelope: appended fraction ≤ ``PINT_TPU_INCR_MAX_FRAC``,
+  blocks-solve step ≤ ``PINT_TPU_INCR_MAX_SHIFT`` sigma, appended-TOA
+  geometry staleness ≤ the ``PINT_TPU_REPREPARE_REUSE_US`` bound, ECORR
+  epoch assignments of the OLD rows unchanged, no dense (Fourier) noise
+  basis (its frequencies move with the observing span), and the polish
+  must converge (a third LM iteration needed means the linearization was
+  stale). Any violation records a ``fit.incremental_fallback``
+  degradation event (refusable via ``PINT_TPU_DEGRADED=error``) and runs
+  the full warm-started refit — the incremental path can cost a
+  fallback, never a wrong answer.
+
+The resident surface that owns the cached state and the append loop is
+:class:`pint_tpu.serve.session.TimingSession`.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from pint_tpu.fitting.design import linear_columns, linear_split
+from pint_tpu.fitting.sharded import _RIDGE, fit_vectors, shard_fit_rows
+from pint_tpu.fitting.wls import SVD_THRESHOLD, apply_delta
+from pint_tpu.fitting.woodbury import seg_sum
+from pint_tpu.ops import perf
+from pint_tpu.residuals import phase_residual_frac
+from pint_tpu.utils.logging import get_logger
+
+log = get_logger("pint_tpu.fitting")
+
+Array = jnp.ndarray
+
+__all__ = ["Blocks", "IncrementalEngine", "IncrementalResult",
+           "StalenessError", "append_bucket", "epoch_capacity",
+           "incremental_blocks_program", "padded_fit_data"]
+
+#: appended rows pad to this bucket (power-of-two growth above it), so
+#: every small append reuses one compiled incr_blocks signature
+MIN_APPEND_BUCKET = 16
+#: minimum ECORR epoch capacity of the blocks programs (power-of-two
+#: growth; zero-padded epochs vanish from every seg-sum)
+MIN_EPOCH_CAP = 4
+
+_EIG_FLOOR = {"wls": SVD_THRESHOLD**2, "gls": 1e-14, "wideband": 1e-14}
+_RIDGE_OF = {"wls": 0.0, "gls": _RIDGE, "wideband": _RIDGE}
+
+
+def _pow2_at_least(n: int, floor: int) -> int:
+    b = max(int(floor), 1)
+    while b < n:
+        b *= 2
+    return b
+
+
+def append_bucket(k: int) -> int:
+    """Padded row count serving a k-row append."""
+    return _pow2_at_least(k, MIN_APPEND_BUCKET)
+
+
+def epoch_capacity(ke: int) -> int:
+    """Padded ECORR epoch capacity serving ke real epochs."""
+    return _pow2_at_least(ke, MIN_EPOCH_CAP)
+
+
+# --- per-kind raw row quantities --------------------------------------------------
+#
+# Each kind reduces to the same block algebra over its row space, given
+# per-row vectors computed from the fit data:
+#   rt0  : uncentered (whitened, for wideband) residual rows
+#   M0   : uncentered design rows, d rt0 / d free
+#   w    : the solve's row weights (1/sigma^2; wideband rows are
+#          pre-whitened, w = 1)
+#   v    : the centering OUTPUT direction — subtracting the phase-space
+#          weighted mean m shifts row i by -m * v_i (1/f for narrowband
+#          time residuals, sw_t/f on wideband time rows, 0 on DM rows)
+#   omega: the centering INPUT weights — m = sum(omega * rt0) / sum(u)
+#          with u the phase weights (omega = u * f / row-whitening)
+#   u    : the phase weights themselves (the mean's normalizer)
+#   mask : 1 on real data rows (the GLS equilibration norm's row filter)
+
+
+def _wls_rows(model, free, data):
+    nonlin, lin_names, owners = linear_split(model, free)
+    sl = slice(None, -1) if model.has_abs_phase else slice(None)
+
+    def resids(params):
+        _, r, f = phase_residual_frac(
+            model, params, data["tensor"],
+            track_pn=data["track_pn"], delta_pn=data["delta_pn"],
+            subtract_mean=False,
+        )
+        return r / f, f
+
+    def build(params):
+        def rfun(delta):
+            return resids(apply_delta(params, nonlin, delta))
+
+        z = jnp.zeros(len(nonlin))
+        (rt0, f0), jvp = jax.linearize(rfun, z)
+        cols = {}
+        if nonlin:
+            M_nl = jax.vmap(jvp)(jnp.eye(len(nonlin)))[0].T
+            for i, n in enumerate(nonlin):
+                cols[n] = M_nl[:, i]
+        if lin_names:
+            M_l = linear_columns(model, params, data["tensor"], f0, sl,
+                                 lin_names, owners)
+            for i, n in enumerate(lin_names):
+                cols[n] = M_l[:, i]
+        M0 = jnp.stack([cols[n] for n in free], axis=1)
+        u = data["weights"]
+        w = 1.0 / data["sigma"] ** 2          # pad rows: 0
+        v = 1.0 / f0
+        omega = u * f0
+        return rt0, M0, w, v, omega, u, data["mask"]
+
+    return build
+
+
+def _gls_rows(model, free, data):
+    p = len(free)
+
+    def resids(params):
+        _, r, f = phase_residual_frac(
+            model, params, data["tensor"],
+            track_pn=data["track_pn"], delta_pn=data["delta_pn"],
+            subtract_mean=False,
+        )
+        return r / f, f
+
+    def build(params):
+        def rfun(delta):
+            return resids(apply_delta(params, free, delta))
+
+        (rt0, f0), lin = jax.linearize(rfun, jnp.zeros(p))
+        M0 = jax.vmap(lin)(jnp.eye(p))[0].T
+        u = data["weights"]
+        w = 1.0 / data["sigma"] ** 2
+        v = 1.0 / f0
+        omega = u * f0
+        return rt0, M0, w, v, omega, u, data["mask"]
+
+    return build
+
+
+def _wb_rows(model, free, data):
+    p = len(free)
+
+    def resids(params):
+        _, r, f = phase_residual_frac(
+            model, params, data["tensor"],
+            track_pn=data["track_pn"], delta_pn=data["delta_pn"],
+            subtract_mean=False,
+        )
+        sw_t = 1.0 / data["sigma"]            # pad rows: 0
+        sw_dm = jnp.where(jnp.isfinite(data["sigma_dm"]),
+                          1.0 / data["sigma_dm"], 0.0)
+        rt = (r / f) * sw_t
+        rdm = (model.total_dm(params, data["tensor"]) - data["dm_data"]) * sw_dm
+        return jnp.concatenate([rt, rdm]), f, sw_t, sw_dm
+
+    def build(params):
+        def rfun(delta):
+            return resids(apply_delta(params, free, delta))
+
+        (rt0, f0, sw_t, sw_dm), lin = jax.linearize(rfun, jnp.zeros(p))
+        M0 = jax.vmap(lin)(jnp.eye(p))[0].T
+        u = data["weights"]
+        z = jnp.zeros_like(sw_dm)
+        w = jnp.ones_like(rt0)                # rows are pre-whitened
+        v = jnp.concatenate([sw_t / f0, z])
+        omega = jnp.concatenate(
+            [jnp.where(sw_t > 0, u * f0 / jnp.where(sw_t > 0, sw_t, 1.0), 0.0),
+             z])
+        uu = jnp.concatenate([u, z])
+        mask = jnp.concatenate([data["mask"], (sw_dm > 0).astype(rt0.dtype)])
+        return rt0, M0, w, v, omega, uu, mask
+
+    return build
+
+
+_ROW_FNS = {"wls": _wls_rows, "gls": _gls_rows, "wideband": _wb_rows}
+
+
+# --- the additive block set -------------------------------------------------------
+
+
+@dataclass
+class Blocks:
+    """Additive normal-equation sums over a row set, in the frame
+    (a0, m0): rows enter as M0 - v a0ᵀ and rt0 - m0 v, so the later
+    recentering shift is tiny and the centered Gram never cancels.
+    Adding two Blocks over disjoint row sets (same frame, same epoch
+    capacity) equals computing them over the union."""
+
+    data: dict = field(default_factory=dict)   # name -> np.ndarray
+    a0: np.ndarray | None = None               # (p,) frame anchor
+    m0: float = 0.0
+    n_rows: int = 0
+
+    def __add__(self, other: "Blocks") -> "Blocks":
+        a, b = self.data, other.data
+        ke = max(a["ewsum"].shape[0], b["ewsum"].shape[0])
+
+        def pad(x, n):
+            return x if x.shape[0] == n else np.concatenate(
+                [x, np.zeros((n - x.shape[0],) + x.shape[1:])])
+
+        out = {}
+        for k in a:
+            xa, xb = a[k], b[k]
+            if k.startswith("e"):  # epoch-indexed: align capacities
+                xa, xb = pad(xa, ke), pad(xb, ke)
+            out[k] = xa + xb
+        return Blocks(out, self.a0, self.m0, self.n_rows + other.n_rows)
+
+
+def _block_sums(rt0, M0, w, v, omega, u, mask, a0, m0, eidx, KE: int):
+    """The additive sums themselves (runs traced inside incr_blocks_*)."""
+    Ms = M0 - v[:, None] * a0[None, :]
+    rs = rt0 - m0 * v
+    wM = w[:, None] * Ms
+    out = {
+        "wmm": Ms.T @ wM,
+        "wvm": jnp.sum((w * v)[:, None] * Ms, axis=0),
+        "wvv": jnp.sum(w * v * v),
+        "wmr": wM.T @ rs,
+        "wvr": jnp.sum(w * v * rs),
+        "wrr": jnp.sum(w * rs * rs),
+        "om": jnp.sum(omega[:, None] * Ms, axis=0),
+        "or_": jnp.sum(omega * rs),
+        "osum": jnp.sum(u),
+        "mmd": jnp.sum(mask[:, None] * Ms * Ms, axis=0),
+        "mvm": jnp.sum((mask * v)[:, None] * Ms, axis=0),
+        "mvv": jnp.sum(mask * v * v),
+    }
+    # ECORR seg-sums: pad epochs past the real count stay exactly zero
+    # (no row points at them); pad ROWS carry w=0 so they vanish too
+    if eidx is None:
+        z = jnp.zeros(KE)
+        out.update(ewm=jnp.zeros((KE, Ms.shape[1])), ewv=z, ewr=z, ewsum=z)
+    else:
+        out.update(
+            ewm=seg_sum(wM, eidx, KE),
+            ewv=seg_sum(w * v, eidx, KE),
+            ewr=seg_sum(w * rs, eidx, KE),
+            ewsum=seg_sum(w, eidx, KE),
+        )
+    return out
+
+
+def _basis_eidx(model, data, n_time_rows):
+    """Row-aligned ECORR epoch indices for the blocks program, or None.
+    Wideband rows double (time + DM); DM rows carry no epoch."""
+    t = data["tensor"]
+    # shapes are static under trace; never coerce a traced value here
+    if "ecorr_eidx" not in t or int(t["ecorr_widx"].shape[1]) == 0:
+        return None
+    sl = slice(None, -1) if model.has_abs_phase else slice(None)
+    eidx = jnp.asarray(t["ecorr_eidx"][sl], jnp.int32)
+    if eidx.shape[0] < n_time_rows:  # never: data rows == eidx rows
+        raise ValueError("ecorr_eidx shorter than the data rows")
+    return eidx
+
+
+def get_blocks_fn(model, kind: str, free, subtract_mean: bool, KE: int,
+                  has_ecorr: bool):
+    """TimedProgram computing the additive block sums for one row set.
+
+    One program per (kind, free set, xprec, epoch capacity); the delta
+    (append bucket) and full (row bucket) row counts are two signatures
+    of the same program, so appends never retrace. The program is
+    declared sync-free (``incr_`` prefix — the prepare-sync audit pass
+    covers it), collective-free (no mesh in v1) and precision-annotated.
+    """
+    cache = model.__dict__.setdefault("_incr_blocks_cache", {})
+    key = (kind, tuple(free), subtract_mean, model.xprec.name, KE, has_ecorr)
+    if key in cache:
+        return cache[key]
+
+    rows_builder = _ROW_FNS[kind]
+
+    def blocks(params, data, a0, m0):
+        build = rows_builder(model, free, data)
+        rt0, M0, w, v, omega, u, mask = build(params)
+        eidx = _basis_eidx(model, data, rt0.shape[0]) if has_ecorr else None
+        if eidx is not None and kind == "wideband":  # jaxlint: disable=tracer-if — `kind` is a static closure string, never a tracer
+            # wideband rows double; DM rows carry no epoch membership
+            eidx = jnp.concatenate(
+                [eidx, jnp.full(rt0.shape[0] - eidx.shape[0], -1, jnp.int32)])
+        return _block_sums(rt0, M0, w, v, omega, u, mask, a0, m0, eidx, KE)
+
+    from pint_tpu.ops.compile import TimedProgram, precision_jit
+
+    cache[key] = TimedProgram(precision_jit(blocks), f"incr_blocks_{kind}",
+                              collective_axes=(),
+                              precision_spec=model.xprec.name)
+    return cache[key]
+
+
+def get_incr_chi2_fn(model, kind: str, subtract_mean: bool):
+    """TimedProgram for the accept/reject chi² over the (bucket-padded)
+    full data — the identical centered formulas the fused driver uses
+    (fitting/sharded._KIND_FNS), as a standalone fixed-shape program."""
+    from pint_tpu.fitting.sharded import _KIND_FNS, _AxisReduce
+
+    cache = model.__dict__.setdefault("_incr_chi2_cache", {})
+    key = (kind, subtract_mean, model.xprec.name)
+    if key in cache:
+        return cache[key]
+    _, chi2_fn = _KIND_FNS[kind](model, (), subtract_mean, _AxisReduce(None))
+
+    from pint_tpu.ops.compile import TimedProgram, precision_jit
+
+    cache[key] = TimedProgram(precision_jit(chi2_fn), f"incr_chi2_{kind}",
+                              collective_axes=(),
+                              precision_spec=model.xprec.name)
+    return cache[key]
+
+
+# --- assembling and solving the cached system -------------------------------------
+
+
+def assemble(kind: str, B: Blocks, ephi: np.ndarray | None, mean_free: bool):
+    """Centered, basis-marginalized normal equations from the block sums
+    (host numpy; everything here is p- or ke-sized).
+
+    Returns (Gn, cn, norm, chi2_0, ahat): Gn the equilibrated normal
+    matrix (+ the kind's ridge), cn the normalized RHS, norm the column
+    equilibration, chi2_0 the fit statistic at the linearization point,
+    ahat the ML ECORR coefficients — exactly the quantities the fused
+    driver's eigensolve consumes."""
+    d = {k: np.asarray(v) for k, v in B.data.items()}
+    osum = d["osum"]
+    da = d["om"] / osum if mean_free else np.zeros_like(d["om"])
+    dm = float(d["or_"] / osum) if mean_free else 0.0
+    wmm = (d["wmm"] - np.outer(d["wvm"], da) - np.outer(da, d["wvm"])
+           + d["wvv"] * np.outer(da, da))
+    wmr = d["wmr"] - d["wvm"] * dm - da * d["wvr"] + da * (d["wvv"] * dm)
+    wrr = float(d["wrr"] - 2.0 * dm * d["wvr"] + dm * dm * d["wvv"])
+    G, c, chi2_0 = wmm, -wmr, wrr
+    ahat = np.zeros(0)
+    if ephi is not None and len(ephi):
+        ke = len(ephi)
+        ewm = d["ewm"][:ke] - np.outer(d["ewv"][:ke], da)
+        ewr = d["ewr"][:ke] - d["ewv"][:ke] * dm
+        De = 1.0 / np.asarray(ephi) + d["ewsum"][:ke]
+        G = G - ewm.T @ (ewm / De[:, None])
+        c = -(wmr - ewm.T @ (ewr / De))
+        chi2_0 = wrr - float(ewr @ (ewr / De))
+        ahat = ewr / De
+    if kind == "gls":
+        mmd = d["mmd"] - 2.0 * da * d["mvm"] + da * da * d["mvv"]
+        norm = np.sqrt(np.maximum(mmd, 0.0))
+    else:
+        norm = np.sqrt(np.maximum(np.diag(G), 0.0))
+    norm = np.where(norm == 0, 1.0, norm)
+    Gn = G / np.outer(norm, norm) + _RIDGE_OF[kind] * np.eye(len(norm))
+    cn = c / norm
+    return Gn, cn, norm, chi2_0, ahat
+
+
+def eig_solve(Gn, cn, norm, lam: float, kind: str):
+    """The fused driver's damped spectral step from the equilibrated
+    normal matrix (sharded._lm_driver.solve, term for term)."""
+    s, V = np.linalg.eigh((Gn + Gn.T) / 2.0)
+    smax = s[-1]
+    good = s > _EIG_FLOOR[kind] * smax
+    sinv = np.where(good, 1.0 / np.where(good, s + lam * smax, 1.0), 0.0)
+    dx = (V @ (sinv * (V.T @ cn))) / norm
+    cinv = np.where(good, 1.0 / np.where(good, s, 1.0), 0.0)
+    cov = ((V * cinv) @ V.T) / np.outer(norm, norm)
+    return dx, cov, s, V
+
+
+def padded_fit_data(fitter, kind: str, lo: int, hi: int | None, bucket: int):
+    """Bucket-padded fused-fit data for tensor/vector rows [lo, hi) (+
+    the TZR row when the model anchors absolute phase): the operand
+    shape both the delta (append bucket) and full (row bucket) block
+    programs consume. Pads take the standard vanish fills (inf sigma,
+    zero weights/mask — fitting/sharded.fit_vectors)."""
+    model = fitter.model
+    vecs, fills = fit_vectors(fitter, kind)
+    tensor = {k: np.asarray(v) for k, v in fitter.tensor.items()}
+    n_rows = tensor["t_hi"].shape[0]
+    has_tzr = model.has_abs_phase
+    n_data = n_rows - (1 if has_tzr else 0)
+    hi = n_data if hi is None else hi
+
+    def cut_t(a):
+        if a.shape[:1] != (n_rows,):
+            return a                      # aux leaves stay whole
+        body = a[lo:hi]
+        return np.concatenate([body, a[-1:]], axis=0) if has_tzr else body
+
+    def cut_v(a):
+        return None if a is None else np.asarray(a)[lo:hi]
+
+    t_cut = {k: cut_t(v) for k, v in tensor.items()}
+    v_cut = {k: cut_v(v) for k, v in vecs.items()}
+    t_out, v_out, _ = shard_fit_rows(model, t_cut, v_cut, 1, fills,
+                                     chunk=bucket)
+    data = {"tensor": t_out}
+    data.update(v_out)
+    return data
+
+
+def incremental_blocks_program(fitter, k: int = 8):
+    """(program, args) of the rank-k block-update program at this
+    fitter's shapes — the AOT-warmup and static-cost-analysis surface
+    (mirror of ``sharded.fused_fit_program``; consumed by
+    pint_tpu/analysis/cost.py so the append path is cost-budgeted)."""
+    from pint_tpu.fitting.sharded import _subtract_mean_of
+    from pint_tpu.ops.compile import canonicalize_params
+
+    kind = fitter._fused_kind
+    model = fitter.model
+    free = tuple(fitter._free)
+    sm = _subtract_mean_of(fitter)
+    params = canonicalize_params(
+        model.xprec.convert_params(fitter.model.params))
+    basis = model.noise_basis_and_weights(params, fitter.tensor)
+    has_ecorr = (basis is not None and basis.dense is None
+                 and basis.ephi is not None and kind != "wideband")
+    KE = (epoch_capacity(int(np.asarray(basis.ephi).shape[0]))
+          if has_ecorr else MIN_EPOCH_CAP)
+    n = len(fitter.resids.errors_s)
+    data = padded_fit_data(fitter, kind, max(0, n - k), None,
+                           append_bucket(k))
+    prog = get_blocks_fn(model, kind, free, sm, KE, has_ecorr)
+    a0 = jnp.zeros(len(free))
+    return prog, (params, data, a0, np.float64(0.0))
+
+
+# --- the engine -------------------------------------------------------------------
+
+
+class StalenessError(RuntimeError):
+    """The append left the cached linearization's validity envelope."""
+
+
+@dataclass
+class IncrementalResult:
+    result: object                 # the FitResult installed on the fitter
+    path: str                      # "incremental" | "full_fallback"
+    k: int
+    reason: str | None = None      # fallback reason when path != incremental
+
+
+class IncrementalEngine:
+    """Cached normal-equation blocks + the rank-k append refit for one
+    (model, growing dataset) pair. Construct AFTER a converged full fit;
+    call :meth:`refresh` to (re)capture the blocks, then
+    :meth:`refit_appended` with the merged fitter after each append."""
+
+    def __init__(self, fitter):
+        self.kind = fitter._fused_kind
+        self.model = fitter.model
+        self.free = tuple(fitter._free)
+        from pint_tpu.fitting.sharded import _subtract_mean_of
+
+        self.subtract_mean = _subtract_mean_of(fitter)
+        self.mean_free = self.subtract_mean and not self.model.has_phase_offset
+        self.blocks: Blocks | None = None
+        self.ephi: np.ndarray | None = None
+        self._eidx_old: np.ndarray | None = None
+        self._widx_old: np.ndarray | None = None
+        self._ke_cap = MIN_EPOCH_CAP
+        self.n_rows = 0
+        self.refresh(fitter)
+
+    # -- data plumbing -------------------------------------------------------------
+
+    def _params0(self, fitter):
+        from pint_tpu.ops.compile import canonicalize_params
+
+        return canonicalize_params(
+            self.model.xprec.convert_params(fitter.model.params))
+
+    def _padded_data(self, fitter, lo: int, hi: int, bucket: int):
+        return padded_fit_data(fitter, self.kind, lo, hi, bucket)
+
+    def _basis_host(self, fitter, params):
+        """(ephi, eidx_data, widx) of the current tensor, or (None,)*3.
+        Raises StalenessError on a dense (Fourier) basis — its column
+        frequencies move with the observing span, so the cached blocks
+        cannot be updated row-wise."""
+        basis = self.model.noise_basis_and_weights(params, fitter.tensor)
+        if basis is None:
+            return None, None, None
+        if basis.dense is not None:
+            raise StalenessError(
+                "dense (Fourier) noise basis: its frequencies depend on the "
+                "observing span, which row appends move")
+        if self.kind == "wideband":
+            raise StalenessError(
+                "wideband correlated-noise basis (row-scaled ECORR) is not "
+                "supported by the rank-k update")
+        sl = slice(None, -1) if self.model.has_abs_phase else slice(None)
+        eidx = np.asarray(fitter.tensor["ecorr_eidx"])[sl]
+        widx = np.asarray(fitter.tensor["ecorr_widx"])[0]
+        return np.asarray(basis.ephi), eidx, widx
+
+    def _run_blocks(self, fitter, params, lo, hi, bucket) -> Blocks:
+        data = self._padded_data(fitter, lo, hi, bucket)
+        prog = get_blocks_fn(self.model, self.kind, self.free,
+                             self.subtract_mean, self._ke_cap,
+                             self.ephi is not None)
+        a0 = jnp.asarray(self._a0)
+        args = (params, data, a0, np.float64(self._m0))
+        # route through the AOT table even when telemetry is off: a
+        # signature warmed at session start must stay an exe-table hit
+        # on every later (collected) append, never a fresh lowering
+        prog.precompile(*args)
+        out = prog(*args)
+        n = (hi if hi is not None else len(fitter.resids.errors_s)) - lo
+        return Blocks({k: np.asarray(v) for k, v in out.items()},
+                      self._a0, self._m0, n)
+
+    # -- lifecycle -----------------------------------------------------------------
+
+    def refresh(self, fitter) -> None:
+        """Recapture the blocks at the fitter's CURRENT parameters (run
+        after a converged full fit). O(N), amortized over every
+        subsequent O(k) append. A model whose noise structure the rank-k
+        update cannot carry (dense Fourier basis, wideband row-scaled
+        ECORR) leaves the engine DISABLED: every append then takes the
+        declared full-refit fallback instead of raising."""
+        params = self._params0(fitter)
+        try:
+            self.ephi, eidx, widx = self._basis_host(fitter, params)
+        except StalenessError as e:
+            self.blocks = None
+            self._disabled = str(e)
+            self.n_rows = len(fitter.resids.errors_s)
+            return
+        self._disabled = None
+        self._eidx_old, self._widx_old = eidx, widx
+        if self.ephi is not None:
+            self._ke_cap = epoch_capacity(len(self.ephi))
+        n = len(fitter.resids.errors_s)
+        # frame anchor: the first refresh pins (a0, m0) = 0; later
+        # refreshes keep the frame (it is a conditioning device only)
+        if not hasattr(self, "_a0"):
+            self._a0 = np.zeros(len(self.free))
+            self._m0 = 0.0
+        bucket = _pow2_at_least(n, MIN_APPEND_BUCKET)
+        with perf.stage("blocks"):
+            self.blocks = self._run_blocks(fitter, params, 0, None, bucket)
+        self.n_rows = n
+        self._row_bucket = bucket
+        self._full_data = None  # rebuilt lazily per append
+
+    def precompile_append(self, fitter, k_hint: int = 8) -> None:
+        """AOT-warm the append-serving programs at this session's shapes:
+        the delta-blocks program at the ``k_hint`` append bucket and the
+        trial-chi² program at the current row bucket. Run at session
+        start so the FIRST append is already steady-state (the full-data
+        blocks program is warmed by :meth:`refresh` itself)."""
+        if self.blocks is None:
+            return
+        params = self._params0(fitter)
+        kb = append_bucket(k_hint)
+        lo = max(0, self.n_rows - min(k_hint, self.n_rows))
+        data_k = self._padded_data(fitter, lo, None, kb)
+        prog = get_blocks_fn(self.model, self.kind, self.free,
+                             self.subtract_mean, self._ke_cap,
+                             self.ephi is not None)
+        prog.precompile(params, data_k, jnp.asarray(self._a0),
+                        np.float64(self._m0))
+        data_full = self._padded_data(fitter, 0, None, self._row_bucket)
+        get_incr_chi2_fn(self.model, self.kind,
+                         self.subtract_mean).precompile(params, data_full)
+
+    # -- staleness envelope --------------------------------------------------------
+
+    def _check_staleness(self, fitter, k: int, params) -> None:
+        from pint_tpu.utils import knobs
+
+        n_new = len(fitter.resids.errors_s)
+        if n_new != self.n_rows + k:
+            raise StalenessError(
+                f"dataset rows {n_new} != cached {self.n_rows} + k={k}; the "
+                "append was not a pure suffix")
+        max_frac = float(knobs.get("PINT_TPU_INCR_MAX_FRAC"))
+        if k > max(1.0, max_frac * self.n_rows):
+            raise StalenessError(
+                f"appended fraction {k}/{self.n_rows} exceeds "
+                f"PINT_TPU_INCR_MAX_FRAC={max_frac}")
+        stale_s = getattr(fitter.toas, "geom_stale_s", 0.0)
+        limit = float(knobs.get("PINT_TPU_REPREPARE_REUSE_US")) * 1e-6
+        if stale_s > limit:
+            raise StalenessError(
+                f"geometry staleness {stale_s:.2e} s exceeds the "
+                f"{limit:.1e} s reuse bound")
+        # fault-injection drill: tier-1 forces the staleness fallback
+        from pint_tpu.testing import faults
+
+        if faults.trip("fit.incremental", f"incr_{self.kind}") is not None:
+            raise StalenessError("fault-injected staleness (PINT_TPU_FAULTS)")
+        ephi, eidx, widx = self._basis_host(fitter, params)
+        if (ephi is None) != (self.ephi is None):
+            raise StalenessError("ECORR basis appeared/vanished on append")
+        if ephi is not None:
+            if (self._widx_old is not None
+                    and (len(widx) < len(self._widx_old)
+                         or not np.array_equal(widx[:len(self._widx_old)],
+                                               self._widx_old))):
+                raise StalenessError("ECORR epoch->param map reordered")
+            if not np.array_equal(eidx[:self.n_rows], self._eidx_old):
+                raise StalenessError(
+                    "appended TOAs re-quantized existing ECORR epochs")
+            if len(ephi) > self._ke_cap:
+                # capacity grows: re-pad the cached epoch blocks (zero
+                # rows for the new epochs — they had no old-row members)
+                self._ke_cap = epoch_capacity(len(ephi))
+            self.ephi, self._eidx_old, self._widx_old = ephi, eidx, widx
+
+    # -- the refit -----------------------------------------------------------------
+
+    def refit_appended(self, fitter, k: int, maxiter: int = 30,
+                       required_gain: float = 1e-2,
+                       max_rejects: int = 16) -> IncrementalResult:
+        """Answer a k-row append with the rank-k update + GN polish;
+        falls back to ``fitter.fit_toas`` (full, warm by construction)
+        past any staleness bound. ``fitter`` must be a downhill fitter
+        over the APPENDED dataset whose model still holds the cached
+        fit's parameters.
+
+        Stages record as direct children of whatever scope is open (the
+        TimingSession wraps each request in an ``incremental`` stage, so
+        the canonical ``incremental_breakdown`` attributes them)."""
+        from pint_tpu.utils import knobs
+
+        try:
+            if self.blocks is None:
+                raise StalenessError(getattr(self, "_disabled", None)
+                                     or "no cached blocks")
+            return self._refit(fitter, k, maxiter, required_gain,
+                               max_rejects,
+                               float(knobs.get("PINT_TPU_INCR_MAX_SHIFT")))
+        except StalenessError as e:
+            return self._fallback(fitter, k, str(e), maxiter,
+                                  required_gain, max_rejects)
+
+    def _fallback(self, fitter, k, reason, maxiter, required_gain,
+                  max_rejects) -> IncrementalResult:
+        from pint_tpu.ops import degrade
+
+        perf.add("incremental_fallbacks")
+        degrade.record(
+            "fit.incremental_fallback", f"incr_{self.kind}",
+            f"incremental append refit fell back to the full warm refit: "
+            f"{reason}",
+            bound_us=0.0,  # accuracy preserved; the O(k) latency lost
+            fix="keep appends within PINT_TPU_INCR_MAX_FRAC /"
+                " PINT_TPU_INCR_MAX_SHIFT, or refresh the session state",
+        )
+        with perf.stage("full_refit"):
+            res = fitter.fit_toas(maxiter=maxiter,
+                                  required_chi2_decrease=required_gain,
+                                  max_rejects=max_rejects)
+        self.refresh(fitter)
+        return IncrementalResult(res, "full_fallback", k, reason)
+
+    def _chi2(self, fitter, params, data) -> float:
+        prog = get_incr_chi2_fn(self.model, self.kind, self.subtract_mean)
+        with perf.stage("chi2"):
+            prog.precompile(params, data)
+            return float(np.asarray(prog(params, data)))
+
+    def _trial_loop(self, fitter, params, data, Gn, cn, norm, chi2_best,
+                    max_rejects, max_shift_sigma):
+        """One run_lm backtracking round from the assembled system.
+        Returns (accepted, trial_params, chi2, gain, dx, cov, s, V)."""
+        lam = 0.0
+        for _ in range(max_rejects):
+            perf.add("lm_trials")
+            with perf.stage("solve"):
+                dx, cov, s, V = eig_solve(Gn, cn, norm, lam, self.kind)
+                if max_shift_sigma is not None:
+                    sigma = np.sqrt(np.maximum(np.diag(cov), 0.0))
+                    shift = np.max(np.abs(dx) / np.where(sigma > 0, sigma,
+                                                         np.inf))
+                    if shift > max_shift_sigma:
+                        raise StalenessError(
+                            f"blocks-solve step is {shift:.2f} sigma "
+                            f"(> PINT_TPU_INCR_MAX_SHIFT); linearization "
+                            "too far from the new optimum")
+                trial = apply_delta(params, self.free, jnp.asarray(dx),
+                                    project_domain=True)
+            chi2_t = self._chi2(fitter, trial, data)
+            if np.isfinite(chi2_t) and chi2_t <= chi2_best:
+                return (True, trial, chi2_t, chi2_best - chi2_t, dx, cov,
+                        s, V)
+            perf.add("lm_rejects")
+            lam = 1e-8 if lam == 0.0 else lam * 10.0
+        return False, params, chi2_best, 0.0, None, cov, s, V
+
+    def _install(self, fitter, params, chi2, it, cov, s, V, ahat):
+        params = jax.device_get(params)
+        perf.put("solve_path", "incremental")
+        perf.put("solve_path_reason", "rank_k_update")
+        if self.kind == "wls":
+            s_rep = np.sqrt(np.maximum(s[::-1], 0.0))
+            return fitter._finalize_fit(params, chi2, it, True, cov,
+                                        s=s_rep, vt=V.T[::-1])
+        fitter.noise_ampls = np.asarray(ahat)
+        if self.kind == "wideband":
+            return fitter._finalize_fit(params, chi2, it, True, cov)
+        return fitter._finalize_fit(params, chi2, it, True, cov,
+                                    s=s[::-1], vt=V.T[::-1])
+
+    def _refit(self, fitter, k, maxiter, required_gain, max_rejects,
+               max_shift_sigma) -> IncrementalResult:
+        perf.add("incremental_refits")
+        perf.add("incremental_rows_appended", k)
+        params0 = self._params0(fitter)
+        self._check_staleness(fitter, k, params0)
+        n = self.n_rows + k
+        kb = append_bucket(k)
+
+        # rank-k update: linearize ONLY the k new rows at the cached point
+        with perf.stage("delta"):
+            d_blocks = self._run_blocks(fitter, params0, self.n_rows, n, kb)
+            blocks = self.blocks + d_blocks
+        with perf.stage("assemble"):
+            Gn, cn, norm, chi2_0, ahat = assemble(self.kind, blocks,
+                                                  self.ephi, self.mean_free)
+
+        # full-data operand for the chi² trials and the polish: fixed
+        # bucket, grown power-of-two, so appends reuse the executables
+        bucket = _pow2_at_least(n, self._row_bucket)
+        with perf.stage("data"):
+            data = self._padded_data(fitter, 0, None, bucket)
+        self._row_bucket = bucket
+
+        perf.add("lm_iterations")
+        accepted, params1, chi2_1, gain, dx, cov0, s0, V0 = self._trial_loop(
+            fitter, params0, data, Gn, cn, norm, chi2_0, max_rejects,
+            max_shift_sigma)
+        if not accepted or gain < required_gain:
+            # converged AT the cached point: the full warm refit would
+            # revert its sub-threshold step and report the same state
+            self.blocks, self.n_rows = blocks, n
+            with perf.stage("finalize"):
+                res = self._install(fitter, params0, chi2_0, 1, cov0, s0,
+                                    V0, ahat)
+            return IncrementalResult(res, "incremental", k)
+
+        # GN polish: one full linearization at the accepted point — the
+        # exact second iteration of the full warm refit
+        perf.add("lm_iterations")
+        with perf.stage("polish"):
+            blocks1 = self._run_blocks(fitter, params1, 0, None, bucket)
+            Gn1, cn1, norm1, _chi2_b, ahat1 = assemble(
+                self.kind, blocks1, self.ephi, self.mean_free)
+        accepted2, params2, chi2_2, gain2, _dx2, cov1, s1, V1 = \
+            self._trial_loop(fitter, params1, data, Gn1, cn1, norm1, chi2_1,
+                             max_rejects, None)
+        if accepted2 and gain2 >= required_gain:
+            raise StalenessError(
+                "polish step still gained chi2; the cached linearization "
+                "was too stale for a 2-iteration refit")
+        # sub-threshold (or no) polish step reverts: converged at params1
+        # with the polish linearization's covariance — run_lm's exact rule
+        self.blocks, self.n_rows = blocks1, n
+        self.blocks.n_rows = n
+        with perf.stage("finalize"):
+            res = self._install(fitter, params1, chi2_1, 2, cov1, s1, V1,
+                                ahat1)
+        return IncrementalResult(res, "incremental", k)

@@ -48,7 +48,7 @@ log = get_logger("pint_tpu.fitting")
 
 __all__ = [
     "FitterState", "snapshot", "warm_start", "dataset_key", "state_path",
-    "maybe_auto_warm", "auto_save",
+    "find_warm_state", "maybe_auto_warm", "auto_save",
 ]
 
 _STATE_VERSION = 1
@@ -65,6 +65,10 @@ class FitterState:
     uncertainties: dict[str, float] = field(default_factory=dict)
     chi2: float | None = None
     dataset: str | None = None      # content key of the fitted TOAs
+    #: rows the dataset key covers — a dataset GROWN by appended rows
+    #: still prefix-matches this state (find_warm_state), so appends
+    #: never cold-miss the auto-warm cache
+    n_toas: int | None = None
     version: int = _STATE_VERSION
 
     def skeleton(self) -> tuple:
@@ -80,6 +84,7 @@ class FitterState:
             "uncertainties": dict(self.uncertainties),
             "chi2": self.chi2,
             "dataset": self.dataset,
+            "n_toas": self.n_toas,
         }
 
     @classmethod
@@ -94,6 +99,7 @@ class FitterState:
                            for n, v in d.get("uncertainties", {}).items()},
             chi2=d.get("chi2"),
             dataset=d.get("dataset"),
+            n_toas=d.get("n_toas"),
             version=int(d.get("version", _STATE_VERSION)),
         )
 
@@ -134,6 +140,7 @@ def snapshot(fitter) -> FitterState:
         uncertainties=dict(res.uncertainties) if res is not None else {},
         chi2=None if res is None else float(res.chi2),
         dataset=dataset_key(fitter.toas),
+        n_toas=len(fitter.toas),
     )
 
 
@@ -183,30 +190,70 @@ def warm_start(fitter, state: FitterState | str | Path,
 # --- disk auto-warm ---------------------------------------------------------------
 
 
-def dataset_key(toas) -> str:
+def dataset_key(toas, n: int | None = None) -> str:
     """Content key of a prepared TOA set: the TDB epochs + errors +
     frequencies identify the fitted data (geometry columns follow from
-    them and the prepare config)."""
+    them and the prepare config). With ``n``, the key covers only the
+    FIRST n rows — the prefix form `find_warm_state` matches an appended
+    dataset against its parent's snapshot with."""
     import hashlib
 
+    sl = slice(None) if n is None else slice(None, int(n))
     h = hashlib.sha256()
     for a in (toas.tdb.day, toas.tdb.frac_hi, toas.tdb.frac_lo,
               toas.error_us, toas.freq_mhz):
-        h.update(np.ascontiguousarray(a).tobytes())
+        h.update(np.ascontiguousarray(np.asarray(a)[sl]).tobytes())
     return h.hexdigest()[:16]
+
+
+def _skeleton_hash(fitter) -> str:
+    import hashlib
+
+    skel = (f"v{_STATE_VERSION}-{fitter._fused_kind}-"
+            f"{','.join(fitter._free)}-{fitter.model.xprec.name}")
+    return hashlib.sha256(skel.encode()).hexdigest()[:16]
 
 
 def state_path(fitter) -> Path:
     """Canonical on-disk location of this (skeleton, dataset) snapshot."""
-    import hashlib
-
     from pint_tpu.utils.cache import cache_root
 
-    skel = (f"v{_STATE_VERSION}-{fitter._fused_kind}-"
-            f"{','.join(fitter._free)}-{fitter.model.xprec.name}")
-    skel_h = hashlib.sha256(skel.encode()).hexdigest()[:16]
     return (cache_root() / "fitstate"
-            / f"fit-{skel_h}-{dataset_key(fitter.toas)}.json")
+            / f"fit-{_skeleton_hash(fitter)}-{dataset_key(fitter.toas)}.json")
+
+
+def find_warm_state(fitter) -> Path | None:
+    """The best on-disk snapshot for this fitter: the exact (skeleton,
+    dataset) entry when one exists, else the NEWEST skeleton-matching
+    snapshot whose recorded rows are a verified PREFIX of this dataset —
+    so a dataset grown by k appended rows still warm-starts from the
+    parent state instead of cold-missing (the append-serving shape of
+    ROADMAP item 4). Prefix matches are verified by recomputing the
+    n-row dataset key, never by the filename alone."""
+    import os
+
+    path = state_path(fitter)
+    if path.exists():
+        return path
+    d = path.parent
+    skel_h = _skeleton_hash(fitter)
+    n_here = len(fitter.toas)
+    try:
+        candidates = sorted(d.glob(f"fit-{skel_h}-*.json"),
+                            key=os.path.getmtime, reverse=True)
+    except OSError:
+        return None
+    for cand in candidates:
+        try:
+            st = FitterState.load(cand)
+        except Exception as e:  # noqa: BLE001  # jaxlint: disable=silent-except — an unreadable snapshot only disables this candidate; the cold fit proceeds
+            log.warning(f"skipping unreadable fitter state {cand}: {e}")
+            continue
+        n = st.n_toas
+        if (n is not None and 0 < n < n_here
+                and st.dataset == dataset_key(fitter.toas, n=n)):
+            return cand
+    return None
 
 
 def maybe_auto_warm(fitter) -> bool:
@@ -221,8 +268,8 @@ def maybe_auto_warm(fitter) -> bool:
 
     applied = getattr(fitter, "_warm_source", None) is not None
     if not applied and knobs.flag("PINT_TPU_WARM_START"):
-        path = state_path(fitter)
-        if path.exists():
+        path = find_warm_state(fitter)
+        if path is not None:
             try:
                 applied = warm_start(fitter, path, source=str(path))
             except Exception as e:  # noqa: BLE001  # jaxlint: disable=silent-except — a bad snapshot only costs the warm start; the cold fit proceeds identically and the miss is logged

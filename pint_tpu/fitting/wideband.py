@@ -281,7 +281,9 @@ class WidebandDownhillFitter(WLSFitter):
         self._prefit_values = {
             n: float(np.asarray(leaf_to_f64(model.params[n]))) for n in self._free
         }
-        self._prefit_wrms = self.resids.rms_weighted()
+        # lazy, like WLSFitter: construction must not compile the resid
+        # program at every fresh append shape (serve/session.py)
+        self._prefit_wrms = None
 
     def _rebuild_resids(self):
         return WidebandTOAResiduals(

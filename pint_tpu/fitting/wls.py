@@ -428,7 +428,22 @@ class WLSFitter:
         self._prefit_values = {
             n: float(np.asarray(leaf_to_f64(model.params[n]))) for n in self._free
         }
-        self._prefit_wrms = self.resids.rms_weighted()
+        # LAZY: evaluating the residuals here compiles the resid program
+        # at this dataset's raw shape inside every fitter CONSTRUCTION —
+        # for the append-serving path (serve/session.py) that is a fresh
+        # shape (N+k) per request, a ~100s-of-ms retrace the summary
+        # table alone needs. Deferred to the first prefit_wrms read.
+        self._prefit_wrms = None
+
+    @property
+    def prefit_wrms(self) -> float:
+        """Weighted RMS of the PREFIT residuals (evaluated lazily; the
+        prefit residual object is replaced by `_finalize_fit`, so the
+        value latches on first read — before the fit for exactness,
+        after it as a best-effort summary figure)."""
+        if self._prefit_wrms is None:
+            self._prefit_wrms = self.resids.rms_weighted()
+        return self._prefit_wrms
 
     def _fused_on(self) -> bool:
         from pint_tpu.utils import knobs
@@ -632,7 +647,7 @@ class WLSFitter:
             f"Fitted model {self.model.psr_name or '?'} using"
             f" {type(self).__name__} with {len(self._free)} free parameters"
             f" to {len(self.resids.errors_s)} TOAs",
-            f"Prefit residuals Wrms = {self._prefit_wrms * 1e6:.4g} us,"
+            f"Prefit residuals Wrms = {self.prefit_wrms * 1e6:.4g} us,"
             f" Postfit residuals Wrms = {self.resids.rms_weighted() * 1e6:.4g} us",
             f"Chisq = {res.chi2:.4f} for {res.dof} d.o.f."
             f" reduced Chisq = {res.reduced_chi2:.4f}"

@@ -67,6 +67,9 @@ KINDS: dict[str, str] = {
         "a JPL DE kernel was requested/configured but the analytic ephemeris served"),
     "fit.host_fallback": (
         "a fused device fit program went non-finite; recomputed on the host"),
+    "fit.incremental_fallback": (
+        "an incremental append refit left its staleness envelope; the full "
+        "warm refit ran instead"),
     "fetch.mirror_failed": (
         "a remote file could not be refreshed from any mirror"),
     "fetch.corrupt_quarantined": (

@@ -45,8 +45,8 @@ from pint_tpu.utils import knobs
 
 __all__ = [
     "PerfReport", "active", "add", "collect", "enable", "enabled",
-    "fit_breakdown", "instrument_fit", "noise_breakdown",
-    "prepare_breakdown", "put", "put_default", "stage",
+    "fit_breakdown", "incremental_breakdown", "instrument_fit",
+    "noise_breakdown", "prepare_breakdown", "put", "put_default", "stage",
 ]
 
 _env_enabled = knobs.flag("PINT_TPU_PERF")
@@ -320,6 +320,71 @@ def noise_breakdown(rep: PerfReport) -> dict:
     return out
 
 
+# --- the canonical incremental-refit breakdown -----------------------------------
+
+#: incremental-request sub-stages named in the breakdown (serve/session.py
+#: + fitting/incremental.py): the O(k) prepared-column append, the host
+#: tensor/fitter rebuild, the rank-k delta-blocks program, the host
+#: assemble + p×p solves, the full-data chi² trials and GN polish
+#: program, the full-blocks refresh, the finalize tail, and the
+#: full-refit fallback wall. Anything else directly under an
+#: ``incremental`` stage lands in incremental_other_s.
+_INCR_COMPONENTS = ("append", "tensor", "delta", "assemble", "data",
+                    "solve", "chi2", "polish", "blocks", "finalize",
+                    "full_refit")
+
+
+def incremental_breakdown(rep: PerfReport) -> dict:
+    """Map "incremental"-rooted stages into the canonical incremental
+    breakdown. Contract (the ``--smoke --session`` bench, tests/
+    test_session.py): named components + compile + trace + other account
+    for ≥90% of the incremental wall, so the append-serving telemetry
+    cannot silently rot. Counters: ``incremental_refits`` /
+    ``incremental_fallbacks`` / ``incremental_rows_appended`` come from
+    the engine; ``prepare_rows`` proves the append prepared only k rows.
+    """
+    wall = 0.0
+    comp = {leaf: 0.0 for leaf in _INCR_COMPONENTS}
+    nested_ct = {leaf: 0.0 for leaf in _INCR_COMPONENTS}
+    compile_s = trace_s = 0.0
+    direct = 0.0
+    for path, (total, _count) in rep.timings.items():
+        segs = path.split("/")
+        if "incremental" not in segs:
+            continue
+        i = segs.index("incremental")
+        if len(segs) == i + 1:
+            wall += total
+        elif len(segs) == i + 2:
+            direct += total
+            if segs[-1] in comp:
+                comp[segs[-1]] += total
+        if segs[-1] in ("compile", "trace") and len(segs) > i + 1:
+            if segs[-1] == "compile":
+                compile_s += total
+            else:
+                trace_s += total
+            if len(segs) > i + 2 and segs[i + 1] in nested_ct:
+                nested_ct[segs[i + 1]] += total
+    out = {"incremental_wall_s": round(wall, 4)}
+    for leaf in _INCR_COMPONENTS:
+        # compile/trace nests inside the component that triggered it:
+        # subtract so the named fields partition the wall
+        out[f"incremental_{leaf}_s"] = round(comp[leaf] - nested_ct[leaf], 4)
+    out["incremental_compile_s"] = round(compile_s, 4)
+    out["incremental_trace_s"] = round(trace_s, 4)
+    out["incremental_other_s"] = round(max(wall - direct, 0.0), 4)
+    out["incremental_refits"] = int(rep.counters.get("incremental_refits", 0))
+    out["incremental_fallbacks"] = int(
+        rep.counters.get("incremental_fallbacks", 0))
+    out["incremental_rows_appended"] = int(
+        rep.counters.get("incremental_rows_appended", 0))
+    out["prepare_rows"] = int(rep.counters.get("prepare_rows", 0))
+    out["prepare_prefix_hits"] = int(
+        rep.counters.get("prepare_prefix_hits", 0))
+    return out
+
+
 # --- the canonical fit breakdown -------------------------------------------------
 
 #: stage leaves summed into the named breakdown components; everything else
@@ -487,6 +552,13 @@ def instrument_fit(fit_method):
 
     @functools.wraps(fit_method)
     def wrapper(self, *args, **kwargs):
+        # latch the prefit weighted RMS before the fit moves the params:
+        # fitter construction defers it (a fresh-shape resid compile per
+        # construction is the append-serving path's dominant cost), and
+        # after the fit the residual object reports POSTFIT values
+        if (getattr(self, "_prefit_wrms", False) is None
+                and getattr(self, "result", None) is None):
+            self._prefit_wrms = self.resids.rms_weighted()
         if not enabled():
             return fit_method(self, *args, **kwargs)
         with collect() as rep:

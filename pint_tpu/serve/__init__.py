@@ -1,0 +1,11 @@
+"""Resident serving surfaces: warm processes answering timing requests.
+
+The "millions of users" shape (ROADMAP item 4) is not a script — it is a
+process that stays up, owns prepared TOAs + a converged fitter + the
+incremental-refit state, and answers small appends in milliseconds. This
+package holds those surfaces; the future async front-end plugs into
+:class:`~pint_tpu.serve.session.TimingSession` /
+:class:`~pint_tpu.serve.session.TimingService`.
+"""
+
+from pint_tpu.serve.session import SessionResult, TimingService, TimingSession  # noqa: F401

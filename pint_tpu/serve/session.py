@@ -1,0 +1,276 @@
+"""Resident timing sessions: the warm process a timing service keeps up.
+
+A :class:`TimingSession` owns one pulsar's prepared TOAs, its converged
+downhill fitter, and the incremental-refit engine
+(fitting/incremental.py). A k-TOA append is answered by the O(k)
+prepared-column append (``TOAs.append``) plus the rank-k
+normal-equation update — not a from-scratch prepare + fit — with
+per-request latency recorded through ops/perf.py and surfaced as
+p50/p99 in :meth:`TimingSession.stats`.
+
+A :class:`TimingService` fronts many sessions: requests queue through
+:meth:`~TimingService.submit` and :meth:`~TimingService.drain` answers
+them — appends to the same session COALESCE into one rank-k update, and
+full-refit requests across sessions batch into the fleet-fit engine's
+skeleton buckets (fitting/batch.py ``fit_batch``), so B structurally
+identical refits run as one fused device program. Draining is
+deterministic: batched ≡ the same requests served one at a time
+(locked by tests/test_session.py), because the fleet driver's masked
+convergence reproduces every element's solo trajectory.
+
+This is the substrate an async front-end plugs into (ROADMAP item 4):
+the request objects are plain dicts, the latency telemetry is already
+per-request, and ``PINT_TPU_DEGRADED=error`` turns every silent
+corner-cut (including an incremental-refit fallback) into a refusal.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from pint_tpu.ops import perf
+from pint_tpu.utils.logging import get_logger
+
+log = get_logger("pint_tpu.serve")
+
+__all__ = ["SessionResult", "TimingSession", "TimingService"]
+
+
+@dataclass
+class SessionResult:
+    """One answered request: the fit outcome plus its serving telemetry."""
+
+    result: object                 # FitResult (or None for no-refit appends)
+    path: str                      # "incremental" | "full_fallback" | "full" | "append_only"
+    k: int                         # rows this request appended
+    latency_ms: float
+    reason: str | None = None      # fallback reason, when any
+    breakdown: dict | None = None  # incremental_breakdown when telemetry on
+
+
+class TimingSession:
+    """One pulsar's resident state: prepared TOAs + converged fitter +
+    cached normal-equation blocks, answering appends incrementally.
+
+    Construct with prepared TOAs and a model; :meth:`fit` runs the
+    initial full (fused) downhill fit and captures the incremental
+    state. Every :meth:`append` then prepares ONLY the new rows, updates
+    the cached blocks rank-k, and polishes — falling back to a full warm
+    refit (recorded on the degradation ledger) past the staleness
+    bounds. The fitter kind follows ``fit_auto`` (WLS / GLS / wideband).
+    """
+
+    def __init__(self, toas, model, maxiter: int = 30,
+                 required_chi2_decrease: float = 1e-2, max_rejects: int = 16):
+        from pint_tpu.fitting import fit_auto
+
+        self.model = model
+        self.toas = toas
+        self.maxiter = maxiter
+        self.required_chi2_decrease = required_chi2_decrease
+        self.max_rejects = max_rejects
+        self.fitter = fit_auto(toas, model, fused=True)
+        self.engine = None
+        #: per-request SessionResult records, in arrival order
+        self.history: list[SessionResult] = []
+
+    # -- lifecycle -----------------------------------------------------------------
+
+    def fit(self, warm_appends: int = 8) -> SessionResult:
+        """Initial full fit + incremental-state capture. Idempotent: a
+        refit re-runs the (warm) full fit and refreshes the blocks.
+        ``warm_appends`` AOT-warms the append-serving programs at that
+        append size, so the session's FIRST append is already
+        steady-state (0 disables)."""
+        from pint_tpu.fitting.incremental import IncrementalEngine
+
+        t0 = time.perf_counter()
+        res = self.fitter.fit_toas(
+            maxiter=self.maxiter,
+            required_chi2_decrease=self.required_chi2_decrease,
+            max_rejects=self.max_rejects)
+        if self.engine is None:
+            self.engine = IncrementalEngine(self.fitter)
+        else:
+            self.engine.refresh(self.fitter)
+        if warm_appends:
+            self.engine.precompile_append(self.fitter, k_hint=warm_appends)
+        out = SessionResult(res, "full", 0,
+                            (time.perf_counter() - t0) * 1e3)
+        self.history.append(out)
+        return out
+
+    def precompile(self, background: bool = False):
+        """AOT-warm the session's full-fit programs (the incremental
+        blocks/chi² programs compile on the first append of each bucket
+        signature and persist in the XLA disk cache)."""
+        return self.fitter.precompile(background=background)
+
+    # -- serving -------------------------------------------------------------------
+
+    def _refit_appended(self, merged, k: int) -> "tuple":
+        from pint_tpu.fitting import fit_auto
+
+        with perf.stage("tensor"):
+            fitter = fit_auto(merged, self.model, fused=True)
+        ir = self.engine.refit_appended(
+            fitter, k, maxiter=self.maxiter,
+            required_gain=self.required_chi2_decrease,
+            max_rejects=self.max_rejects)
+        return fitter, ir
+
+    def append(self, lines=None, *, utc=None, error_us=None, freq_mhz=None,
+               obs=None, flags=None, refit: bool = True) -> SessionResult:
+        """Ingest k new TOAs and (by default) answer the refit
+        incrementally. Accepts tim ``lines`` or raw arrays
+        (``TOAs.append``)."""
+        if self.engine is None and refit:
+            self.fit()
+        t0 = time.perf_counter()
+        collecting = perf.enabled()
+        rep_cm = perf.collect() if collecting else None
+        rep = rep_cm.__enter__() if rep_cm is not None else None
+        try:
+            with perf.stage("incremental"):
+                with perf.stage("append"):
+                    merged = self.toas.append(
+                        lines, utc=utc, error_us=error_us,
+                        freq_mhz=freq_mhz, obs=obs, flags=flags)
+                k = len(merged) - len(self.toas)
+                if refit:
+                    fitter, ir = self._refit_appended(merged, k)
+                    self.fitter = fitter
+                self.toas = merged
+        finally:
+            if rep_cm is not None:
+                rep_cm.__exit__(None, None, None)
+        latency_ms = (time.perf_counter() - t0) * 1e3
+        bd = perf.incremental_breakdown(rep) if rep is not None else None
+        if not refit:
+            out = SessionResult(None, "append_only", k, latency_ms,
+                                breakdown=bd)
+        else:
+            out = SessionResult(ir.result, ir.path, k, latency_ms,
+                                reason=ir.reason, breakdown=bd)
+        self.history.append(out)
+        return out
+
+    # -- telemetry -----------------------------------------------------------------
+
+    def stats(self) -> dict:
+        """Per-request latency distribution + path counts — the per-chip
+        serving numbers the bench's ``--smoke --session`` record carries."""
+        lat = np.array([h.latency_ms for h in self.history
+                        if h.path in ("incremental", "full_fallback")])
+        paths: dict[str, int] = {}
+        for h in self.history:
+            paths[h.path] = paths.get(h.path, 0) + 1
+        out = {
+            "n_requests": len(self.history),
+            "paths": paths,
+            "n_toas": len(self.toas),
+        }
+        if lat.size:
+            out.update(
+                incremental_refit_ms_p50=round(float(np.percentile(lat, 50)), 3),
+                incremental_refit_ms_p99=round(float(np.percentile(lat, 99)), 3),
+            )
+        return out
+
+
+class TimingService:
+    """Many resident sessions behind one request queue.
+
+    ``submit`` enqueues ``{"session": sid, "kind": "append"|"refit",
+    ...rows}`` requests; ``drain`` answers everything queued:
+
+    - append requests for the same session coalesce into ONE prepared-
+      column append + ONE rank-k refit (the batching a bursty client
+      stream needs);
+    - ``refit`` requests across sessions group into fleet-fit skeleton
+      buckets (fitting/batch.py) and run as one fused batched program,
+      after which each session's incremental state is refreshed.
+
+    Batched ≡ sequential: the fleet driver freezes converged elements,
+    so every session's answer equals serving its requests alone.
+    """
+
+    def __init__(self):
+        self.sessions: dict[str, TimingSession] = {}
+        self._queue: list[dict] = []
+
+    def add_session(self, sid: str, session: TimingSession) -> None:
+        if sid in self.sessions:
+            raise ValueError(f"session {sid!r} already registered")
+        self.sessions[sid] = session
+
+    def submit(self, request: dict) -> None:
+        sid = request.get("session")
+        if sid not in self.sessions:
+            raise KeyError(f"unknown session {sid!r}")
+        kind = request.get("kind")
+        if kind not in ("append", "refit"):
+            raise ValueError(f"unknown request kind {kind!r}")
+        self._queue.append(dict(request))
+
+    def _coalesce_appends(self, reqs: list[dict]) -> dict:
+        """Merge several append payloads into one row block."""
+        from pint_tpu.astro import time as ptime
+
+        eps = [r["utc"] for r in reqs]
+        cat = np.concatenate
+        return {
+            "utc": ptime.MJDEpoch(cat([e.day for e in eps]),
+                                  cat([e.frac_hi for e in eps]),
+                                  cat([e.frac_lo for e in eps])),
+            "error_us": cat([np.asarray(r["error_us"]) for r in reqs]),
+            "freq_mhz": cat([np.asarray(r["freq_mhz"]) for r in reqs]),
+            "obs": cat([np.asarray(r["obs"]) for r in reqs]),
+            "flags": sum((list(r.get("flags") or
+                               [{} for _ in np.asarray(r["error_us"])])
+                          for r in reqs), []),
+        }
+
+    def drain(self) -> dict[str, list[SessionResult]]:
+        """Answer every queued request; returns per-session results in
+        submission order (coalesced/batched requests share one wall)."""
+        from pint_tpu.fitting.batch import fit_batch
+
+        queue, self._queue = self._queue, []
+        out: dict[str, list[SessionResult]] = {}
+        appends: dict[str, list[dict]] = {}
+        refits: list[str] = []
+        for r in queue:
+            sid = r["session"]
+            if r["kind"] == "append":
+                appends.setdefault(sid, []).append(r)
+            elif sid not in refits:
+                refits.append(sid)
+        for sid, reqs in appends.items():
+            ses = self.sessions[sid]
+            res = ses.append(**self._coalesce_appends(reqs))
+            # every coalesced request is answered by the shared refit
+            out.setdefault(sid, []).extend([res] * len(reqs))
+        if refits:
+            t0 = time.perf_counter()
+            fitters = [self.sessions[sid].fitter for sid in refits]
+            with perf.stage("incremental"), perf.stage("full_refit"):
+                results = fit_batch(
+                    fitters,
+                    maxiter=self.sessions[refits[0]].maxiter)
+            latency_ms = (time.perf_counter() - t0) * 1e3
+            for sid, res in zip(refits, results):
+                ses = self.sessions[sid]
+                if ses.engine is None:
+                    from pint_tpu.fitting.incremental import IncrementalEngine
+
+                    ses.engine = IncrementalEngine(ses.fitter)
+                else:
+                    ses.engine.refresh(ses.fitter)
+                sr = SessionResult(res, "full", 0, latency_ms)
+                ses.history.append(sr)
+                out.setdefault(sid, []).append(sr)
+        return out

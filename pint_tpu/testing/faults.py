@@ -21,6 +21,10 @@ site                      armed modes
                           fused LM loop's outputs (fitting/sharded.py)
 ``fit.step``              ``nan`` — same, for the per-step fused programs
                           dispatched through adaptive_fused (ops/compile.py)
+``fit.incremental``       ``stale`` — :func:`trip` makes the incremental
+                          append refit declare its cached linearization
+                          stale, driving the ``fit.incremental_fallback``
+                          full-refit path (fitting/incremental.py)
 ========================  =====================================================
 
 Arming
@@ -51,7 +55,7 @@ from dataclasses import dataclass
 from pint_tpu.utils import knobs
 
 __all__ = ["arm", "fired", "mangle", "maybe_raise", "armed",
-           "poison_nonfinite", "reset"]
+           "poison_nonfinite", "reset", "trip"]
 
 
 @dataclass
@@ -139,6 +143,17 @@ def maybe_raise(site: str, context: str = "") -> None:
     if f.mode == "timeout":
         raise TimeoutError(f"injected timeout at {site} ({context})")
     raise RuntimeError(f"injected fault {f.mode!r} at {site} ({context})")
+
+
+def trip(site: str, context: str = "") -> str | None:
+    """Consume one firing of `site` and return its mode (None when
+    inert) — the generic hook for control-flow faults that neither raise
+    nor mangle payloads (e.g. the incremental-refit staleness drill)."""
+    f = _take(site)
+    if f is None:
+        return None
+    fired.append((site, f.mode, context))
+    return f.mode
 
 
 def mangle(site: str, data: bytes, context: str = "") -> bytes:

@@ -165,6 +165,11 @@ class TOAs:
     #: shifts and tracks the staleness here (worst-case timing error is
     #: (v_earth/c) * geom_stale_s ~ 1e-4 * stale)
     geom_stale_s: float = 0.0
+    #: resolved prepare-config fingerprint the columns were computed under
+    #: (prepare_config_fingerprint at prepare time) — merge_TOAs refuses to
+    #: silently mix sets prepared under different clock/EOP/ephemeris
+    #: configs, and TOAs.append reuses it to prepare ONLY the new rows
+    prep_fp: str | None = None
 
     def __len__(self):
         return len(self.error_us)
@@ -288,7 +293,68 @@ class TOAs:
             include_bipm=self.include_bipm,
             bipm_version=self.bipm_version,
             geom_stale_s=getattr(self, "geom_stale_s", 0.0),
+            prep_fp=getattr(self, "prep_fp", None),
         )
+
+    def append(
+        self,
+        lines: "list[TOALine] | None" = None,
+        *,
+        utc: "ptime.MJDEpoch | None" = None,
+        error_us: np.ndarray | None = None,
+        freq_mhz: np.ndarray | None = None,
+        obs: np.ndarray | None = None,
+        flags: list[dict] | None = None,
+        ephem: str = "auto",
+        cache: bool = True,
+    ) -> "TOAs":
+        """Append raw TOAs, preparing ONLY the new rows — O(k), not O(N).
+
+        The k new rows run the full prepare pipeline (clock chain, EOP,
+        geometry, ephemeris, TDB) under the SAME process config as this
+        set — `merge_TOAs` verifies the resolved clock/EOP/ephemeris
+        fingerprints match, so an appended set can never silently mix
+        configs — and the already-prepared columns of the existing rows
+        are reused verbatim (zero re-prepare; the ``prepare_rows``
+        telemetry counter observes exactly k). With ``cache=True`` the
+        MERGED set is stored under its full content key (prefix form), so
+        a later from-scratch ``prepare_arrays`` of the same grown inputs
+        is a cache hit instead of an O(N+k) cold miss.
+
+        Accepts either parsed tim ``lines`` or the raw arrays the
+        array-level pipeline takes (site-arrival ``utc`` + errors/
+        frequencies/observatories/flags). Returns the merged TOAs; the
+        incremental-refit engine (fitting/incremental.py) answers the fit
+        for the grown set with a rank-k update.
+        """
+        if lines is not None:
+            new = prepare_TOAs(
+                lines, ephem=ephem, planets=self.planets,
+                include_gps=self.include_gps, include_bipm=self.include_bipm,
+                bipm_version=self.bipm_version,
+            )
+        else:
+            if utc is None or error_us is None:
+                raise ValueError("append needs `lines` or utc+error_us arrays")
+            n = len(utc)
+            new = prepare_arrays(
+                utc,
+                np.asarray(error_us, float),
+                (np.full(n, np.inf) if freq_mhz is None
+                 else np.asarray(freq_mhz, float)),
+                (np.array([str(self.obs[0])] * n) if obs is None
+                 else np.asarray(obs)),
+                flags=flags,
+                ephem=ephem,
+                planets=self.planets,
+                include_gps=self.include_gps,
+                include_bipm=self.include_bipm,
+                bipm_version=self.bipm_version,
+            )
+        merged = merge_TOAs([self, new])
+        if cache:
+            _prefix_cache_store(merged, ephem)
+        return merged
 
     def tensor(self) -> TOATensor:
         t_hi, t_lo = self.tdb.seconds_since(TENSOR_EPOCH_MJD)
@@ -327,11 +393,25 @@ class TOAs:
 
 
 def merge_TOAs(toas_list: Sequence[TOAs]) -> TOAs:
-    """Concatenate prepared TOAs sets (reference merge_TOAs, toa.py:2670)."""
+    """Concatenate prepared TOAs sets (reference merge_TOAs, toa.py:2670).
+
+    Merging REUSES every prepared column verbatim — no part of the
+    prepare pipeline re-runs (``prepare_rows`` stays untouched). The sets
+    must have been prepared under the same resolved clock/EOP/ephemeris
+    configuration: differing ``prep_fp`` fingerprints raise instead of
+    silently concatenating columns that mean different things (a set
+    restored from an old cache could otherwise mix configs)."""
     t0 = toas_list[0]
+    fp0 = getattr(t0, "prep_fp", None)
     for t in toas_list[1:]:
         if t.ephem != t0.ephem:
             raise ValueError(f"cannot merge TOAs with ephems {t0.ephem} vs {t.ephem}")
+        fp = getattr(t, "prep_fp", None)
+        if fp0 is not None and fp is not None and fp != fp0:
+            raise ValueError(
+                "cannot merge TOAs prepared under different configs: "
+                f"{fp0} vs {fp} — re-prepare one set under the current "
+                "clock/EOP/ephemeris knobs")
     cat = np.concatenate
 
     def _cat_ep(eps):
@@ -366,11 +446,13 @@ def merge_TOAs(toas_list: Sequence[TOAs]) -> TOAs:
         clock_applied=all(t.clock_applied for t in toas_list),
         planets=t0.planets,
         geom_stale_s=max(getattr(t, "geom_stale_s", 0.0) for t in toas_list),
+        prep_fp=fp0,
     )
 
 
 # bump when the prepared-TOA layout or pipeline changes incompatibly
-_TOA_CACHE_VERSION = 1
+# (v2: TOAs grew the prep_fp field + prefix-form cache entries)
+_TOA_CACHE_VERSION = 2
 
 
 def prepare_config_fingerprint(ephem) -> str:
@@ -479,7 +561,9 @@ def _prepared_cache_get(key: str):
         return None
 
 
-def _prepared_cache_put(key: str, toas: "TOAs") -> None:
+def _prepared_cache_put(key: str, toas: "TOAs",
+                        head: str | None = None) -> None:
+    import json
     import os
     import pickle
 
@@ -493,13 +577,114 @@ def _prepared_cache_put(key: str, toas: "TOAs") -> None:
         with open(tmp, "wb") as f:
             pickle.dump((key, toas), f)
         tmp.replace(path)
+        if head is not None:
+            # prefix-form sidecar: (row count, first-row head key) lets an
+            # APPENDED dataset find this entry as its parent and prepare
+            # only the suffix rows (_prepared_prefix_get) instead of
+            # cold-missing the whole pipeline
+            meta = path.with_suffix(".meta.json")
+            mtmp = meta.with_suffix(f".mtmp{os.getpid()}")
+            with open(mtmp, "w") as f:
+                json.dump({"n": len(toas), "head": head}, f)
+            mtmp.replace(meta)
         # bounded retention: newest PINT_TPU_PREPARE_CACHE_KEEP entries
         keep = int(knobs.get("PINT_TPU_PREPARE_CACHE_KEEP"))
         entries = sorted(d.glob("prep-*.pickle"), key=os.path.getmtime)
         for old in entries[:-keep] if keep > 0 else []:
             old.unlink(missing_ok=True)
+            old.with_suffix(".meta.json").unlink(missing_ok=True)
     except Exception as e:  # noqa: BLE001  # jaxlint: disable=silent-except — cache write failure only costs the next run a re-preparation
         log.warning(f"could not write prepared-TOA cache: {e}")
+
+
+def _prefix_head_key(utc, error_us, freq, obs_names, flags, ephem, planets,
+                     include_gps, include_bipm, bipm_version) -> str:
+    """Content key of the FIRST row + the resolved config: the cheap
+    filter that pairs an appended dataset with its cached parents before
+    any full prefix hash is computed."""
+    import hashlib
+
+    h = hashlib.sha256()
+    for a in (utc.day[:1], utc.frac_hi[:1], utc.frac_lo[:1],
+              np.asarray(error_us)[:1], np.asarray(freq)[:1]):
+        h.update(np.ascontiguousarray(a).tobytes())
+    h.update(str(obs_names[0]).encode())
+    h.update(repr(flags[0] if flags else {}).encode())
+    h.update(
+        f"{prepare_config_fingerprint(ephem)}-{planets}-{include_gps}-"
+        f"{include_bipm}-{bipm_version}".encode()
+    )
+    return h.hexdigest()[:24]
+
+
+def _prepared_prefix_get(utc, error_us, freq, obs_names, flags, ephem,
+                         planets, include_gps, include_bipm, bipm_version,
+                         head: str):
+    """Serve a full-key MISS from a cached PREFIX: when a cached entry's
+    rows are exactly the first n of these inputs (verified by recomputing
+    the n-row content key — never by the head filter alone), the parent's
+    prepared columns are reused and only the suffix rows run the
+    pipeline: O(k) prepare for an appended dataset. Returns the merged
+    TOAs or None."""
+    import json
+
+    from pint_tpu.ops import perf
+
+    n_total = len(utc)
+    d = _prepared_cache_dir()
+    candidates = []
+    try:
+        for meta in d.glob("prep-*.meta.json"):
+            try:
+                with open(meta) as f:
+                    m = json.load(f)
+            except Exception:  # noqa: BLE001  # jaxlint: disable=silent-except — an unreadable sidecar only disables this parent candidate
+                continue
+            if m.get("head") == head and 0 < int(m.get("n", 0)) < n_total:
+                candidates.append((int(m["n"]), meta.name[5:-10]))
+    except OSError:
+        return None
+    for n, key_n in sorted(candidates, reverse=True):
+        utc_n = ptime.MJDEpoch(utc.day[:n], utc.frac_hi[:n], utc.frac_lo[:n])
+        want = _prepared_content_key(
+            utc_n, error_us[:n], freq[:n], obs_names[:n], flags[:n], ephem,
+            planets, include_gps, include_bipm, bipm_version)
+        if want != key_n:
+            continue
+        parent = _prepared_cache_get(want)
+        if parent is None:
+            continue
+        utc_k = ptime.MJDEpoch(utc.day[n:], utc.frac_hi[n:], utc.frac_lo[n:])
+        suffix = prepare_arrays(
+            utc_k, error_us[n:], freq[n:], obs_names[n:], flags=flags[n:],
+            ephem=ephem, planets=planets, include_gps=include_gps,
+            include_bipm=include_bipm, bipm_version=bipm_version,
+            cache=False,
+        )
+        perf.add("prepare_prefix_hits")
+        log.info(f"prepared-TOA prefix hit: reused {n} cached rows, "
+                 f"prepared {n_total - n}")
+        return merge_TOAs([parent, suffix])
+    return None
+
+
+def _prefix_cache_store(toas: "TOAs", ephem: str = "auto") -> None:
+    """Store an appended/merged prepared set under its full content key
+    (TOAs.append): the grown dataset becomes a direct cache hit AND a
+    prefix parent for the next append. No-op when the raw site UTC is
+    unavailable or the cache knob is off."""
+    from pint_tpu.utils import knobs
+
+    if not knobs.flag("PINT_TPU_PREPARE_CACHE"):
+        return
+    ep = toas.utc_raw
+    if ep is None:
+        return
+    args = (ep, toas.error_us, toas.freq_mhz, toas.obs, toas.flags, ephem,
+            toas.planets, toas.include_gps, toas.include_bipm,
+            toas.bipm_version)
+    _prepared_cache_put(_prepared_content_key(*args), toas,
+                        head=_prefix_head_key(*args))
 
 
 def get_TOAs(
@@ -692,6 +877,7 @@ def prepare_arrays(
 
         use_cache = cache and knobs.flag("PINT_TPU_PREPARE_CACHE")
         key = None
+        head = None
         if use_cache:
             with perf.stage("cache"):
                 key = _prepared_content_key(
@@ -700,6 +886,21 @@ def prepare_arrays(
                 hit = _prepared_cache_get(key)
             if hit is not None:
                 return hit
+            # prefix form: an appended dataset whose first n rows are a
+            # cached entry reuses those prepared columns and pays only
+            # the O(k) suffix prepare (the suffix recursion runs OUTSIDE
+            # the cache stage so its pipeline stages attribute normally)
+            head = _prefix_head_key(utc, error_us, freq, obs_names, flags,
+                                    ephem, planets, include_gps,
+                                    include_bipm, bipm_version)
+            served = _prepared_prefix_get(
+                utc, error_us, freq, obs_names, flags, ephem, planets,
+                include_gps, include_bipm, bipm_version, head)
+            if served is not None:
+                with perf.stage("cache"):
+                    _prepared_cache_put(key, served, head=head)
+                return served
+        perf.add("prepare_rows", n)
 
         if lines is None:
             # lazy per-row views: nothing on the prepare/fit path reads the
@@ -836,10 +1037,14 @@ def prepare_arrays(
             include_gps=include_gps,
             include_bipm=include_bipm,
             bipm_version=bipm_version,
+            # fingerprint under the RESOLVED ephemeris name, so request
+            # aliases ("auto" vs the resolved label) stay merge-compatible
+            prep_fp=prepare_config_fingerprint(getattr(eph, "name",
+                                                       "analytic")),
         )
         if use_cache and key is not None:
             with perf.stage("cache"):
-                _prepared_cache_put(key, toas)
+                _prepared_cache_put(key, toas, head=head)
         # identical re-preparations of the same set (zero_residuals passes,
         # per-shard re-init in the multichip dryrun) log exactly once
         from pint_tpu.utils.logging import log_once

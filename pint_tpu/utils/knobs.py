@@ -57,6 +57,9 @@ KNOBS: dict[str, tuple[str | None, str]] = {
     "PINT_TPU_PREPARE_CACHE_KEEP": ("32", "prepared-TOA cache entries kept (oldest pruned)"),
     # --- fitter state / warm start (fitting/state.py) --------------------------
     "PINT_TPU_WARM_START": ("0", "1: downhill fits warm-start from / save a disk snapshot of the prior fit"),
+    # --- incremental refits / timing sessions (fitting/incremental.py, serve/) --
+    "PINT_TPU_INCR_MAX_FRAC": ("0.05", "appended-row fraction past which an incremental refit falls back to the full warm refit"),
+    "PINT_TPU_INCR_MAX_SHIFT": ("3.0", "blocks-solve step bound in units of parameter sigma past which the incremental linearization is declared stale"),
     # --- Bayesian noise engine (fitting/noise_like.py, sampler.py) -------------
     "PINT_TPU_NOISE_CHAINS": ("4", "vmapped noise-posterior chains per sample() call"),
     "PINT_TPU_NOISE_RESTARTS": ("8", "batched optimizer restarts for ML noise estimation"),

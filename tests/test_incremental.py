@@ -1,0 +1,331 @@
+"""Incremental refit engine (fitting/incremental.py): rank-k updates.
+
+The contract locked here (ISSUE 10): an incremental append refit must
+match the full warm refit of the grown dataset to <= 1e-10 relative in
+parameters AND uncertainties for WLS, GLS+ECORR and wideband, across
+several k/N ratios and across CHAINED appends (the engine's cached
+blocks carry from each polish to the next append). Every declared
+staleness bound — appended fraction, blocks-solve step size, fault
+injection, unsupported (dense Fourier) noise structure — must take the
+full-refit fallback, record exactly one ``fit.incremental_fallback``
+ledger event, and still return the full refit's answer: the incremental
+path can cost a fallback, never a wrong number.
+"""
+
+import copy
+
+import numpy as np
+import pytest
+
+from pint_tpu.astro import time as ptime
+from pint_tpu.fitting import (
+    DownhillGLSFitter,
+    DownhillWLSFitter,
+    IncrementalEngine,
+    WidebandDownhillFitter,
+)
+from pint_tpu.fitting.wls import apply_delta
+from pint_tpu.io.par import parse_parfile
+from pint_tpu.models.base import leaf_to_f64
+from pint_tpu.models.builder import build_model
+from pint_tpu.ops import degrade
+from pint_tpu.simulation import make_fake_toas_fromMJDs, make_fake_toas_uniform
+from pint_tpu.testing import faults
+
+PARITY = 1e-10
+
+WLS_PAR = """
+PSR INCWLS
+RAJ 04:37:15.9 1
+DECJ -47:15:09.1 1
+F0 173.6879489990983 1
+F1 -1.728e-15 1
+PEPOCH 55000
+POSEPOCH 55000
+DM 2.64 1
+TZRMJD 55000.1
+TZRSITE gbt
+TZRFRQ 1400
+"""
+
+GLS_PAR = """
+PSR INCGLS
+RAJ 07:40:45.79 1
+DECJ 66:20:33.6 1
+F0 346.531996493 1
+F1 -1.46389e-15 1
+PEPOCH 57000
+POSEPOCH 57000
+DM 14.96 1
+EFAC -f sim 1.1
+ECORR -f sim 0.5
+TZRMJD 57000.1
+TZRSITE gbt
+TZRFRQ 1400
+"""
+
+RED_PAR = GLS_PAR.replace("PSR INCGLS", "PSR INCRED") + """
+TNREDAMP -12.8
+TNREDGAM 3.5
+TNREDC 5
+"""
+
+WB_PAR = """
+PSR INCWB
+RAJ 08:00:00 1
+DECJ 30:00:00 1
+F0 250.1 1
+F1 -1e-15 1
+PEPOCH 55500
+POSEPOCH 55500
+DM 20.0 1
+DMEPOCH 55500
+DMJUMP -fe 430 0.0
+TZRMJD 55500.1
+TZRSITE gbt
+TZRFRQ 1400
+"""
+
+
+def _perturb(model, f0_delta=2e-10):
+    free = tuple(model.free_params)
+    delta = np.array([f0_delta if nm == "F0" else 0.0 for nm in free])
+    model.params = apply_delta(model.params, free, delta)
+    return model
+
+
+def _rows(full, lo, hi):
+    ep = full.utc_raw
+    return dict(
+        utc=ptime.MJDEpoch(ep.day[lo:hi], ep.frac_hi[lo:hi],
+                           ep.frac_lo[lo:hi]),
+        error_us=full.error_us[lo:hi], freq_mhz=full.freq_mhz[lo:hi],
+        obs=full.obs[lo:hi], flags=[dict(f) for f in full.flags[lo:hi]],
+    )
+
+
+def _assert_parity(inc_model, full_model, r_inc, r_full, free):
+    p_i = np.array([float(np.asarray(leaf_to_f64(inc_model.params[nm])))
+                    for nm in free])
+    p_f = np.array([float(np.asarray(leaf_to_f64(full_model.params[nm])))
+                    for nm in free])
+    rel = np.max(np.abs(p_i - p_f) / np.maximum(np.abs(p_f), 1e-300))
+    assert rel <= PARITY, f"param parity {rel:.3e}"
+    u_i = np.array([r_inc.uncertainties[nm] for nm in free])
+    u_f = np.array([r_full.uncertainties[nm] for nm in free])
+    relu = np.max(np.abs(u_i - u_f) / np.maximum(np.abs(u_f), 1e-300))
+    assert relu <= PARITY, f"uncertainty parity {relu:.3e}"
+
+
+def _wls_full(N, seed=5):
+    model = build_model(parse_parfile(WLS_PAR, from_text=True))
+    freqs = np.where(np.arange(N) % 2 == 0, 1400.0, 2300.0)
+    toas = make_fake_toas_uniform(
+        54500, 55500, N, model, obs="gbt", freq_mhz=freqs, error_us=1.0,
+        add_noise=True, rng=np.random.default_rng(seed))
+    return _perturb(model), toas
+
+
+@pytest.fixture(scope="module")
+def wls_case():
+    """(model, full N+k1+k2 fake set, n) — one prepared superset serves
+    every append slice as consistent observations."""
+    model, toas = _wls_full(280 + 8 + 4)
+    return model, toas, 280
+
+
+class TestIncrementalParity:
+    def _run(self, cls, model, full, n, ks):
+        base = full.select(np.arange(len(full)) < n)
+        free = tuple(model.free_params)
+        ftr = cls(base, model, fused=True)
+        ftr.fit_toas()
+        eng = IncrementalEngine(ftr)
+        cur = base
+        lo = n
+        for k in ks:
+            merged = cur.append(**_rows(full, lo, lo + k))
+            model_full = copy.deepcopy(model)
+            m_ftr = cls(merged, model, fused=True)
+            ir = eng.refit_appended(m_ftr, k)
+            assert ir.path == "incremental", ir.reason
+            f_ftr = cls(merged, model_full, fused=True)
+            rf = f_ftr.fit_toas()
+            _assert_parity(m_ftr.model, f_ftr.model, ir.result, rf, free)
+            # the engine's answer converges like the warm full refit
+            assert ir.result.converged and ir.result.iterations <= 2
+            cur, lo = merged, lo + k
+        return eng
+
+    def test_wls_chained_two_ratios(self, wls_case):
+        """Two chained appends at different k/N — the blocks cache must
+        carry exactly from the polish of one append into the next."""
+        model, full, n = wls_case
+        self._run(DownhillWLSFitter, copy.deepcopy(model), full, n, [8, 4])
+
+    def test_gls_ecorr(self):
+        model = build_model(parse_parfile(GLS_PAR, from_text=True))
+        n_ep, k_ep = 40, 2
+        mjds = np.repeat(np.linspace(56600, 57400, n_ep + k_ep), 2)
+        mjds[1::2] += 0.5 / 86400.0
+        freqs = np.where(np.arange(len(mjds)) % 2 == 0, 1400.0, 800.0)
+        flags = [{"f": "sim"} for _ in mjds]
+        full = make_fake_toas_fromMJDs(
+            mjds, model, obs="gbt", freq_mhz=freqs, error_us=1.0,
+            flags=flags, add_noise=True, rng=np.random.default_rng(1))
+        _perturb(model, 2e-9)
+        # 4 appended TOAs form 2 NEW ECORR epochs: the epoch capacity
+        # and the cached seg-sum blocks must grow consistently
+        eng = self._run(DownhillGLSFitter, model, full, 2 * n_ep, [2 * k_ep])
+        assert eng.ephi is not None and len(eng.ephi) == n_ep + k_ep
+
+    def test_wideband(self):
+        model = build_model(parse_parfile(WB_PAR, from_text=True))
+        rng = np.random.default_rng(2)
+        N, k = 124, 4
+        freqs = np.where(np.arange(N) % 2 == 0, 430.0, 1400.0)
+        full = make_fake_toas_uniform(55000, 56000, N, model,
+                                      freq_mhz=freqs, error_us=1.0)
+        for i, f in enumerate(full.flags):
+            fe = "430" if freqs[i] < 1000 else "L"
+            f["fe"] = fe
+            dm = 20.0 + rng.standard_normal() * 1e-4
+            if fe == "430":
+                dm -= 0.003
+            f["pp_dm"] = f"{dm:.10f}"
+            f["pp_dme"] = "0.000100"
+        _perturb(model, 2e-9)
+        self._run(WidebandDownhillFitter, model, full, N - k, [k])
+
+
+class TestBlocksAdditivity:
+    def test_half_plus_half_equals_full(self, wls_case):
+        """The additive-block contract itself: blocks over two disjoint
+        row halves sum to the full-set blocks (same frame)."""
+        model, full, n = wls_case
+        model = copy.deepcopy(model)
+        base = full.select(np.arange(len(full)) < n)
+        ftr = DownhillWLSFitter(base, model, fused=True)
+        ftr.fit_toas()
+        eng = IncrementalEngine(ftr)
+        params = eng._params0(ftr)
+        bucket = eng._row_bucket
+        whole = eng._run_blocks(ftr, params, 0, None, bucket)
+        h1 = eng._run_blocks(ftr, params, 0, n // 2, bucket)
+        h2 = eng._run_blocks(ftr, params, n // 2, None, bucket)
+        summed = h1 + h2
+        for key, v in whole.data.items():
+            np.testing.assert_allclose(
+                summed.data[key], v, rtol=1e-12, atol=1e-300,
+                err_msg=f"block {key} not additive")
+
+
+class TestStalenessFallbacks:
+    def _fitted_engine(self, n=240, extra=16):
+        model, full = _wls_full(n + extra, seed=9)
+        base = full.select(np.arange(n + extra) < n)
+        ftr = DownhillWLSFitter(base, model, fused=True)
+        ftr.fit_toas()
+        return model, full, base, ftr, IncrementalEngine(ftr)
+
+    def _append(self, model, full, base, k_lo, k_hi, cls=DownhillWLSFitter):
+        merged = base.append(**_rows(full, k_lo, k_hi))
+        return merged, cls(merged, model, fused=True)
+
+    def test_fraction_bound_falls_back(self, monkeypatch):
+        model, full, base, ftr, eng = self._fitted_engine()
+        monkeypatch.setenv("PINT_TPU_INCR_MAX_FRAC", "0.01")
+        degrade.reset_ledger()
+        n = len(base)
+        merged, m_ftr = self._append(model, full, base, n, n + 16)
+        ir = eng.refit_appended(m_ftr, 16)
+        assert ir.path == "full_fallback"
+        assert "PINT_TPU_INCR_MAX_FRAC" in ir.reason
+        evs = [e for e in degrade.events()
+               if e.kind == "fit.incremental_fallback"]
+        assert len(evs) == 1 and evs[0].component == "incr_wls"
+        # the fallback's answer IS a converged full refit, and the
+        # engine refreshed its cached state to the grown dataset
+        assert ir.result.converged
+        assert eng.n_rows == len(merged)
+
+    def test_fault_injected_staleness_drill(self, monkeypatch):
+        """PINT_TPU_FAULTS=fit.incremental:stale — the whole fallback
+        machinery drives end-to-end with no natural staleness."""
+        model, full, base, ftr, eng = self._fitted_engine()
+        degrade.reset_ledger()
+        faults.reset()
+        monkeypatch.setenv("PINT_TPU_FAULTS", "fit.incremental:stale*1")
+        try:
+            n = len(base)
+            merged, m_ftr = self._append(model, full, base, n, n + 8)
+            ir = eng.refit_appended(m_ftr, 8)
+            assert ir.path == "full_fallback"
+            assert "fault-injected" in ir.reason
+            assert ("fit.incremental", "stale",
+                    "incr_wls") in faults.fired
+            assert any(e.kind == "fit.incremental_fallback"
+                       for e in degrade.events())
+            # the drill consumed its one firing: the NEXT append takes
+            # the incremental path again (engine refreshed by the
+            # fallback, so the answer stays exact)
+            merged2 = merged.append(**_rows(full, n + 8, n + 16))
+            m2 = DownhillWLSFitter(merged2, model, fused=True)
+            ir2 = eng.refit_appended(m2, 8)
+            assert ir2.path == "incremental"
+        finally:
+            faults.reset()
+
+    def test_off_model_append_trips_shift_bound(self):
+        """Appended TOAs far off the model (garbage observations) must
+        not be absorbed by a silently-wrong linear update."""
+        model, full, base, ftr, eng = self._fitted_engine()
+        degrade.reset_ledger()
+        n = len(base)
+        rows = _rows(full, n, n + 8)
+        # poison the arrival times by ~1 ms: phase-wraps away from the
+        # fit, the blocks-solve step explodes past the sigma bound
+        rows["utc"] = rows["utc"].add_seconds(np.full(8, 1e-3))
+        merged = base.append(**rows)
+        m_ftr = DownhillWLSFitter(merged, model, fused=True)
+        ir = eng.refit_appended(m_ftr, 8)
+        assert ir.path == "full_fallback"
+        assert ir.result.converged
+
+    def test_dense_noise_basis_disables_engine(self):
+        """A red-noise (Fourier) model cannot ride the rank-k update —
+        the engine stays disabled and every append takes the declared
+        fallback instead of raising or mis-answering."""
+        model = build_model(parse_parfile(RED_PAR, from_text=True))
+        n_ep = 30
+        mjds = np.repeat(np.linspace(56600, 57400, n_ep + 1), 2)
+        mjds[1::2] += 0.5 / 86400.0
+        freqs = np.where(np.arange(len(mjds)) % 2 == 0, 1400.0, 800.0)
+        flags = [{"f": "sim"} for _ in mjds]
+        full = make_fake_toas_fromMJDs(
+            mjds, model, obs="gbt", freq_mhz=freqs, error_us=1.0,
+            flags=flags, add_noise=True, rng=np.random.default_rng(3))
+        n = 2 * n_ep
+        base = full.select(np.arange(len(full)) < n)
+        _perturb(model, 2e-9)
+        ftr = DownhillGLSFitter(base, model, fused=True)
+        ftr.fit_toas()
+        eng = IncrementalEngine(ftr)
+        assert eng.blocks is None and "Fourier" in eng._disabled
+        degrade.reset_ledger()
+        merged, m_ftr = self._append(model, full, base, n, n + 2,
+                                     cls=DownhillGLSFitter)
+        ir = eng.refit_appended(m_ftr, 2)
+        assert ir.path == "full_fallback"
+        assert any(e.kind == "fit.incremental_fallback"
+                   for e in degrade.events())
+
+    def test_non_suffix_append_refused(self):
+        """A dataset that did not grow as a pure suffix of the cached one
+        (row count mismatch) must fall back, not mis-update."""
+        model, full, base, ftr, eng = self._fitted_engine()
+        n = len(base)
+        merged, m_ftr = self._append(model, full, base, n, n + 8)
+        ir = eng.refit_appended(m_ftr, 5)  # wrong k
+        assert ir.path == "full_fallback"
+        assert "pure suffix" in ir.reason

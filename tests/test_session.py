@@ -1,0 +1,361 @@
+"""Timing sessions + prepared-column appends (serve/session.py, toas.py).
+
+Locks the append-serving surface of ISSUE 10:
+
+- ``TOAs.append`` prepares ONLY the k new rows (``prepare_rows`` == k —
+  the O(k) contract) and merging prepared sets NEVER re-runs prepare;
+  mismatched prepare-config fingerprints refuse to merge.
+- The prepared-TOA content cache serves appended datasets in PREFIX
+  form: a grown input whose first n rows are cached reuses them and
+  prepares only the suffix; a set stored by ``TOAs.append`` is a direct
+  hit for a later from-scratch prepare of the same grown inputs.
+- The FitterState auto-warm key survives appends: a dataset grown by k
+  rows warm-starts from the parent snapshot (prefix-verified) instead of
+  cold-missing.
+- ``TimingSession`` answers appends incrementally with per-request
+  latency stats; ``TimingService`` coalesces same-session appends and
+  batches cross-session full refits — batched ≡ sequential.
+- The ``--smoke --session`` bench contract: every append incremental,
+  ≥90% of the wall named by ``incremental_breakdown``, strict-audit
+  clean, empty degradation ledger under ``PINT_TPU_DEGRADED=error``.
+"""
+
+import copy
+
+import numpy as np
+import pytest
+
+from pint_tpu.astro import time as ptime
+from pint_tpu.fitting import DownhillWLSFitter
+from pint_tpu.fitting.wls import apply_delta
+from pint_tpu.io.par import parse_parfile
+from pint_tpu.models.base import leaf_to_f64
+from pint_tpu.models.builder import build_model
+from pint_tpu.ops import degrade, perf
+from pint_tpu.serve import TimingService, TimingSession
+from pint_tpu.simulation import make_fake_toas_uniform
+from pint_tpu.testing import faults
+
+PAR = """
+PSR SESTEST
+RAJ 04:37:15.9 1
+DECJ -47:15:09.1 1
+F0 173.6879489990983 1
+F1 -1.728e-15 1
+PEPOCH 55000
+POSEPOCH 55000
+DM 2.64 1
+TZRMJD 55000.1
+TZRSITE gbt
+TZRFRQ 1400
+"""
+
+GPS2UTC = """# gps2utc.clk
+ 40000.00    0.000
+ 62000.00    0.000
+"""
+
+TIME_GBT = """# time_gbt.dat
+ 40000.00    2.000
+ 62000.00    2.000
+"""
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    degrade.reset_ledger()
+    faults.reset()
+    yield
+    degrade.reset_ledger()
+    faults.reset()
+
+
+@pytest.fixture(autouse=True)
+def _isolated_cache(tmp_path, monkeypatch):
+    monkeypatch.setenv("PINT_TPU_CACHE_DIR", str(tmp_path / "cache"))
+    yield
+
+
+def _dataset(N, seed=11):
+    model = build_model(parse_parfile(PAR, from_text=True))
+    freqs = np.where(np.arange(N) % 2 == 0, 1400.0, 2300.0)
+    toas = make_fake_toas_uniform(
+        54500, 55500, N, model, obs="gbt", freq_mhz=freqs, error_us=1.0,
+        add_noise=True, rng=np.random.default_rng(seed))
+    free = tuple(model.free_params)
+    delta = np.array([2e-10 if nm == "F0" else 0.0 for nm in free])
+    model.params = apply_delta(model.params, free, delta)
+    return model, toas
+
+
+def _rows(full, lo, hi):
+    ep = full.utc_raw
+    return dict(
+        utc=ptime.MJDEpoch(ep.day[lo:hi], ep.frac_hi[lo:hi],
+                           ep.frac_lo[lo:hi]),
+        error_us=full.error_us[lo:hi], freq_mhz=full.freq_mhz[lo:hi],
+        obs=full.obs[lo:hi], flags=[dict(f) for f in full.flags[lo:hi]],
+    )
+
+
+class TestAppendPreparedColumns:
+    def test_append_prepares_only_new_rows(self):
+        model, full = _dataset(80)
+        base = full.select(np.arange(80) < 72)
+        with perf.collect() as rep:
+            merged = base.append(**_rows(full, 72, 80))
+        assert len(merged) == 80
+        # the O(k) contract: the pipeline ran for exactly the 8 new rows
+        assert rep.counters.get("prepare_rows") == 8
+        assert rep.counters.get("ephemeris_serve_toas") == 8
+        # the existing rows' prepared columns were reused verbatim
+        np.testing.assert_array_equal(merged.ssb_obs_pos_m[:72],
+                                      base.ssb_obs_pos_m)
+
+    def test_merge_refuses_mismatched_fingerprints(self):
+        from pint_tpu.toas import merge_TOAs
+
+        model, full = _dataset(40)
+        a = full.select(np.arange(40) < 20)
+        b = full.select(np.arange(40) >= 20)
+        b.prep_fp = "v2-OTHER-CONFIG"
+        with pytest.raises(ValueError, match="different configs"):
+            merge_TOAs([a, b])
+
+    def test_appended_set_is_direct_cache_hit(self):
+        """TOAs.append stores the merged set under its full content key:
+        a later from-scratch prepare of the grown inputs is a HIT."""
+        from pint_tpu.toas import prepare_arrays
+
+        model, full = _dataset(60)
+        base = full.select(np.arange(60) < 52)
+        merged = base.append(**_rows(full, 52, 60))
+        ep = merged.utc_raw
+        with perf.collect() as rep:
+            again = prepare_arrays(
+                ep, merged.error_us, merged.freq_mhz, merged.obs,
+                flags=[dict(f) for f in merged.flags], cache=True)
+        assert rep.counters.get("prepare_cache_hits") == 1
+        assert rep.counters.get("prepare_rows") is None  # pipeline skipped
+        np.testing.assert_array_equal(again.ssb_obs_pos_m,
+                                      merged.ssb_obs_pos_m)
+
+    def test_prefix_cache_serves_grown_inputs(self):
+        """A cold full-key miss whose first n rows are a cached entry
+        prepares only the suffix (prefix form of the content cache)."""
+        from pint_tpu.toas import prepare_arrays
+
+        model, full = _dataset(60, seed=13)
+        ep = full.utc_raw
+        n, N = 52, 60
+        flags = [dict(f) for f in full.flags]
+        with perf.collect():
+            prepare_arrays(
+                ptime.MJDEpoch(ep.day[:n], ep.frac_hi[:n], ep.frac_lo[:n]),
+                full.error_us[:n], full.freq_mhz[:n], full.obs[:n],
+                flags=flags[:n], cache=True)
+        with perf.collect() as rep:
+            grown = prepare_arrays(ep, full.error_us, full.freq_mhz,
+                                   full.obs, flags=flags, cache=True)
+        assert rep.counters.get("prepare_prefix_hits") == 1
+        assert rep.counters.get("prepare_rows") == N - n  # suffix only
+        assert len(grown) == N
+        # and the grown set was stored: a repeat is now a direct hit
+        with perf.collect() as rep2:
+            prepare_arrays(ep, full.error_us, full.freq_mhz, full.obs,
+                           flags=flags, cache=True)
+        assert rep2.counters.get("prepare_cache_hits") == 1
+
+
+class TestWarmStateSurvivesAppends:
+    def test_prefix_warm_start(self, monkeypatch):
+        """PINT_TPU_WARM_START=1: a dataset grown by k appended rows
+        warm-starts from the PARENT snapshot (prefix-verified dataset
+        key) instead of cold-missing."""
+        monkeypatch.setenv("PINT_TPU_WARM_START", "1")
+        model, full = _dataset(120, seed=3)
+        # a start far enough off that the COLD walk takes >2 iterations
+        # (the warm start's one-GN-polish advantage must be observable)
+        free = tuple(model.free_params)
+        model.params = apply_delta(
+            model.params, free,
+            np.array([3e-9 if nm == "F0" else 0.0 for nm in free]))
+        base = full.select(np.arange(120) < 112)
+        cold = DownhillWLSFitter(base, copy.deepcopy(model), fused=True)
+        r_cold = cold.fit_toas()  # auto-saves the snapshot
+        merged = base.append(**_rows(full, 112, 120))
+        warm = DownhillWLSFitter(merged, copy.deepcopy(model), fused=True)
+        from pint_tpu.fitting.state import find_warm_state, state_path
+
+        # the grown dataset's own (exact) key has no snapshot — the
+        # prefix scan must resolve to the PARENT's state file
+        parent_path = state_path(cold)
+        assert state_path(warm) != parent_path
+        assert find_warm_state(warm) == parent_path
+        perf.enable(True)
+        try:
+            r_warm = warm.fit_toas()
+        finally:
+            perf.enable(False)
+        assert r_warm.perf["warm_start"] is True
+        assert str(parent_path) == str(r_warm.perf["warm_start_source"])
+        # warm ≡ one GN step + revert from the parent optimum — never
+        # MORE work than the cold walk from the parfile start
+        assert r_warm.iterations <= r_cold.iterations
+        assert r_warm.converged
+
+
+class TestTimingSession:
+    def test_append_loop_stats_and_breakdown(self):
+        model, full = _dataset(240 + 16)
+        base = full.select(np.arange(len(full)) < 240)
+        ses = TimingSession(base, model)
+        ses.fit()
+        perf.enable(True)
+        try:
+            with perf.collect() as rep:
+                r1 = ses.append(**_rows(full, 240, 248))
+                r2 = ses.append(**_rows(full, 248, 256))
+        finally:
+            perf.enable(False)
+        assert r1.path == "incremental" and r2.path == "incremental"
+        assert len(ses.toas) == 256
+        st = ses.stats()
+        assert st["n_requests"] == 3  # fit + 2 appends
+        assert st["paths"] == {"full": 1, "incremental": 2}
+        assert st["incremental_refit_ms_p50"] > 0
+        assert st["incremental_refit_ms_p99"] >= st["incremental_refit_ms_p50"]
+        # the canonical breakdown names >= 90% of the serving wall
+        bd = perf.incremental_breakdown(rep)
+        named = sum(v for k, v in bd.items()
+                    if k.startswith("incremental_") and k.endswith("_s")
+                    and k not in ("incremental_wall_s",
+                                  "incremental_other_s"))
+        assert bd["incremental_wall_s"] > 0
+        assert named >= 0.9 * bd["incremental_wall_s"] - 0.01
+        assert bd["incremental_refits"] == 2
+        assert bd["prepare_rows"] == 16
+        # each request carries its own breakdown too
+        assert r1.breakdown["incremental_refits"] == 1
+
+    def test_session_result_matches_solo_fit(self):
+        model, full = _dataset(240 + 8, seed=7)
+        base = full.select(np.arange(len(full)) < 240)
+        ses = TimingSession(base, model)
+        ses.fit()
+        r = ses.append(**_rows(full, 240, 248))
+        solo_model = copy.deepcopy(model)
+        # the session's model already sits at the refit optimum: rebuild
+        # the comparator from the SAME merged data + the session model
+        solo = DownhillWLSFitter(ses.toas, solo_model, fused=True)
+        rs = solo.fit_toas()
+        free = tuple(model.free_params)
+        for nm in free:
+            a = float(np.asarray(leaf_to_f64(ses.fitter.model.params[nm])))
+            b = float(np.asarray(leaf_to_f64(solo.model.params[nm])))
+            assert abs(a - b) <= 1e-10 * max(abs(b), 1e-300)
+            assert (abs(r.result.uncertainties[nm] - rs.uncertainties[nm])
+                    <= 1e-10 * rs.uncertainties[nm])
+
+
+class TestTimingService:
+    def _service(self, n=200, k=4, seed=21):
+        model, full = _dataset(n + 4 * k, seed=seed)
+        base = full.select(np.arange(len(full)) < n)
+        ses = TimingSession(base, model)
+        ses.fit()
+        return model, full, ses, n, k
+
+    def test_appends_coalesce_per_session(self):
+        model, full, ses, n, k = self._service()
+        svc = TimingService()
+        svc.add_session("psr1", ses)
+        svc.submit({"session": "psr1", "kind": "append",
+                    **_rows(full, n, n + k)})
+        svc.submit({"session": "psr1", "kind": "append",
+                    **_rows(full, n + k, n + 2 * k)})
+        out = svc.drain()
+        assert len(out["psr1"]) == 2           # both requests answered
+        assert out["psr1"][0] is out["psr1"][1]  # by ONE coalesced refit
+        assert out["psr1"][0].k == 2 * k
+        assert out["psr1"][0].path == "incremental"
+        assert len(ses.toas) == n + 2 * k
+
+    def test_batched_equals_sequential(self):
+        """Service-drained answers ≡ the same requests served one at a
+        time on an identical twin setup."""
+        model_a, full, ses_a, n, k = self._service(seed=23)
+        model_b = copy.deepcopy(model_a)
+        # twin session over the same base data and start params
+        base = full.select(np.arange(len(full)) < n)
+        ses_b = TimingSession(base, model_b)
+        ses_b.fit()
+
+        svc = TimingService()
+        svc.add_session("a", ses_a)
+        svc.submit({"session": "a", "kind": "append",
+                    **_rows(full, n, n + k)})
+        svc.submit({"session": "a", "kind": "refit"})
+        out = svc.drain()
+
+        # sequential twin: append then full refit, directly
+        ses_b.append(**_rows(full, n, n + k))
+        rb = ses_b.fitter.fit_toas()
+
+        free = tuple(model_a.free_params)
+        ra = out["a"][-1].result
+        for nm in free:
+            a = float(np.asarray(leaf_to_f64(ses_a.fitter.model.params[nm])))
+            b = float(np.asarray(leaf_to_f64(ses_b.fitter.model.params[nm])))
+            assert abs(a - b) <= 1e-10 * max(abs(b), 1e-300)
+            assert (abs(ra.uncertainties[nm] - rb.uncertainties[nm])
+                    <= 1e-10 * rb.uncertainties[nm])
+
+    def test_unknown_session_and_kind_refused(self):
+        svc = TimingService()
+        with pytest.raises(KeyError):
+            svc.submit({"session": "nope", "kind": "append"})
+        model, full, ses, n, k = self._service()
+        svc.add_session("x", ses)
+        with pytest.raises(ValueError):
+            svc.submit({"session": "x", "kind": "frobnicate"})
+
+
+def _write_clock_dir(path):
+    path.mkdir(parents=True, exist_ok=True)
+    (path / "time_gbt.dat").write_text(TIME_GBT)
+    (path / "gps2utc.clk").write_text(GPS2UTC)
+
+
+class TestSessionBenchContract:
+    def test_smoke_session_bench_contract(self, tmp_path, monkeypatch):
+        """The --smoke --session acceptance surface: every append served
+        incrementally, ≥90% attribution, ≥1 speedup vs the full refit,
+        strict-audit clean, EMPTY ledger under PINT_TPU_DEGRADED=error."""
+        import bench
+
+        from pint_tpu.analysis import jaxpr_audit
+
+        _write_clock_dir(tmp_path / "clk")
+        monkeypatch.setenv("PINT_CLOCK_OVERRIDE", str(tmp_path / "clk"))
+        monkeypatch.setenv("PINT_TPU_DEGRADED", "error")
+        monkeypatch.setenv("PINT_TPU_AUDIT", "strict")
+        degrade.reset_ledger()
+        jaxpr_audit.reset_ledger()
+        rec = bench.smoke_session_bench(ntoas=300, n_appends=5, k=8,
+                                        n_full=1)
+        assert rec["degradation_count"] == 0
+        assert rec["session_paths"] == {"full": 1, "incremental": 5}
+        assert rec["incremental_fallbacks"] == 0
+        assert rec["prepare_rows"] == 5 * 8
+        assert rec["incremental_refit_ms_p50"] > 0
+        assert rec["incremental_vs_full"] is not None
+        named = sum(v for k2, v in rec.items()
+                    if k2.startswith("incremental_") and k2.endswith("_s")
+                    and k2 not in ("incremental_wall_s",
+                                   "incremental_other_s"))
+        assert named >= 0.9 * rec["incremental_wall_s"] - 0.01
+        # the incr_* programs audited strict-clean (incl. prepare-sync)
+        assert rec["audit"]["violations"] == []
+        labels = set(rec["audit"]["signatures"])
+        assert any(lbl.startswith("incr_blocks") for lbl in labels)
