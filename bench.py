@@ -42,13 +42,17 @@ FALLBACK_PAR = "/root/reference/tests/datafile/NGC6440E.par"
 NGC6440E_PAR = "/root/reference/tests/datafile/NGC6440E.par"
 NGC6440E_TIM = "/root/reference/tests/datafile/NGC6440E.tim"
 
-# NANOGrav GUPPI receiver setups: (flag value, sub-band frequencies MHz).
-# Simultaneous sub-band TOAs within an epoch are what ECORR models; the -f
-# flags are what the J0740 par's EFAC/EQUAD/ECORR masks select on.
-RECEIVERS = (
-    ("Rcvr1_2_GUPPI", np.linspace(1150.0, 1850.0, 8)),
-    ("Rcvr_800_GUPPI", np.linspace(722.0, 919.0, 8)),
-)
+# NANOGrav GUPPI receiver setups, smoke pars and dataset builders now live
+# in pint_tpu/profiles.py so the `pint_tpu warmup` CLI can replay the EXACT
+# same (model-skeleton, dataset-shape) profiles this bench measures —
+# imported lazily (inside functions) because the sharded/batched smoke
+# entries must set XLA_FLAGS before the first jax import.
+
+
+def _receivers():
+    from pint_tpu.profiles import RECEIVERS
+
+    return RECEIVERS
 
 
 def _build_dataset(par_path: str, ntoas: int):
@@ -67,6 +71,7 @@ def _build_dataset(par_path: str, ntoas: int):
     from pint_tpu.simulation import make_fake_toas_fromMJDs
     from pint_tpu.utils.cache import cache_root, source_fingerprint
 
+    RECEIVERS = _receivers()
     model = get_model(par_path)
     with open(par_path, "rb") as f:
         par_digest = hashlib.sha256(f.read()).hexdigest()[:16]
@@ -289,28 +294,18 @@ def bench_reference_parity(emit) -> float | None:
 
 
 def _spin_grid(model, ftr):
-    """3x3 (F0, F1) grid around the model values, +-1 sigma when the
-    fitter has uncertainties (it may not have run yet)."""
-    f0 = float(np.asarray(model.params["F0"].hi))
-    f1 = float(np.asarray(model.params["F1"].hi))
-    unc = ftr.result.uncertainties if ftr.result is not None else {}
-    s0 = unc.get("F0") or 1e-10
-    s1 = unc.get("F1") or 1e-18
-    return ("F0", "F1"), (
-        np.linspace(f0 - s0, f0 + s0, 3),
-        np.linspace(f1 - s1, f1 + s1, 3),
-    )
+    """3x3 (F0, F1) grid (pint_tpu/profiles.py — shared with warmup)."""
+    from pint_tpu.profiles import spin_grid
+
+    return spin_grid(model, ftr)
 
 
 def _grid_for(model, ftr):
-    """The reference 3x3 (M2, SINI) grid (bench_chisq_grid_WLSFitter.py:33-34)
-    or a spin-term fallback for non-binary pars."""
-    if "M2" in model.param_meta and "SINI" in model.param_meta:
-        return ("M2", "SINI"), (
-            np.linspace(0.20, 0.30, 3),
-            np.sin(np.deg2rad(np.linspace(86.25, 88.5, 3))),
-        )
-    return _spin_grid(model, ftr)
+    """The reference 3x3 (M2, SINI) grid or the spin-term fallback
+    (pint_tpu/profiles.py — shared with warmup)."""
+    from pint_tpu.profiles import grid_for
+
+    return grid_for(model, ftr)
 
 
 #: grid points evaluated concurrently per device program: 3 measured 1.45x
@@ -320,7 +315,12 @@ _GRID_BATCH = int(os.environ.get("PINT_TPU_BENCH_BATCH", "3"))
 
 
 _FIT_NAMED_FIELDS = ("fit_compile_s", "fit_trace_s", "fit_step_s",
-                     "fit_chi2_s", "fit_solve_s", "fit_finalize_s")
+                     "fit_chi2_s", "fit_solve_s", "fit_finalize_s",
+                     # outside the fit wall but inside the measured span:
+                     # the deferred prefit-wRMS residual evaluation — on a
+                     # warmed process this is the resid program's AOT
+                     # deserialize + cache-served compile
+                     "prefit_resid_s")
 
 
 def _ttfp_breakdown(setup_s, setup_rep, tensor_build_s, build_rep,
@@ -387,6 +387,33 @@ def _static_cost() -> dict:
                 "collective_bytes": rec["collective_bytes"],
                 "peak_bytes": rec["peak_bytes"]}
         for label, rec in cost_block().items()
+    }
+
+
+def _warm_fields(ttfp_s: float) -> dict:
+    """The warm/cold startup split (ROADMAP item 4): whether THIS process
+    served its programs from deserialized AOT artifacts (ops/compile.py)
+    or paid trace+compile, with the one measured time-to-first-point
+    reported under the matching headline field. ``traces_on_warm`` is the
+    audit ledger's trace+compile count — the number the retrace-zero
+    contract (PINT_TPU_EXPECT_WARM=1, tests/test_aot.py) holds at ZERO on
+    a process warmed by `pint_tpu warmup`; it is None on a cold process
+    (where compiles are expected, not a contract violation)."""
+    from pint_tpu.analysis.jaxpr_audit import compile_count
+    from pint_tpu.ops.compile import aot_block
+
+    aot = aot_block()
+    compiles = compile_count()
+    hits = int(aot["deserialize_hits"])
+    warm = hits > 0 and compiles == 0
+    return {
+        "aot_deserialize_hits": hits,
+        "aot_exports": int(aot["exports"]),
+        "ledger_compiles": compiles,
+        "ttfp_kind": "warm" if warm else "cold",
+        "warm_process_ttfp_s": round(ttfp_s, 3) if warm else None,
+        "cold_process_ttfp_s": None if warm else round(ttfp_s, 3),
+        "traces_on_warm": compiles if hits > 0 else None,
     }
 
 
@@ -745,6 +772,13 @@ def main() -> None:
     # vectorized (device-servable) gather+polyval. Opt out with
     # PINT_TPU_KERNEL_EPHEM=auto/0.
     os.environ.setdefault("PINT_TPU_KERNEL_EPHEM", "1")
+    # serialized AOT executables (ops/compile.py artifact store): the
+    # first round exports every headline executable, a repeat round (or a
+    # round after `pint_tpu warmup`) deserializes instead of retracing —
+    # the zero-trace startup ROADMAP item 4 measures as
+    # warm_process_ttfp_s / traces_on_warm. Opt out with
+    # PINT_TPU_AOT_EXPORT=0.
+    os.environ.setdefault("PINT_TPU_AOT_EXPORT", "1")
 
     ntoas = int(os.environ.get("PINT_TPU_BENCH_NTOAS", "100000"))
     maxiter = int(os.environ.get("PINT_TPU_BENCH_MAXITER", "1"))
@@ -985,6 +1019,11 @@ def main() -> None:
         "ttfp_breakdown": _ttfp_breakdown(
             setup_s, setup_rep, tensor_build_s, build_rep, fit_s, fitperf,
             compile_tail_s, compile_s),
+        # warm/cold startup split (ROADMAP item 4): the round after a
+        # `pint_tpu warmup` (or a prior exporting round) must report
+        # ttfp_kind=warm, traces_on_warm == 0 and the <10 s target under
+        # warm_process_ttfp_s
+        **_warm_fields(time_to_first_point),
         # warm start: with PINT_TPU_WARM_START=1 a repeat round starts the
         # LM loop at the previous round's solution (fitting/state.py)
         "warm_start": fitperf.get("warm_start"),
@@ -1072,19 +1111,7 @@ def main() -> None:
     })
 
 
-SMOKE_PAR = """
-PSR SMOKE
-RAJ 04:37:15.9 1
-DECJ -47:15:09.1 1
-F0 173.6879489990983 1
-F1 -1.728e-15 1
-PEPOCH 55000
-POSEPOCH 55000
-DM 2.64 1
-TZRMJD 55000.1
-TZRSITE gbt
-TZRFRQ 1400
-"""
+# SMOKE_PAR lives in pint_tpu/profiles.py (shared with `pint_tpu warmup`)
 
 
 def smoke_bench(ntoas: int = 300, maxiter: int = 5, sharded: bool = False,
@@ -1115,6 +1142,7 @@ def smoke_bench(ntoas: int = 300, maxiter: int = 5, sharded: bool = False,
     from pint_tpu.io.par import parse_parfile
     from pint_tpu.ops import perf
     from pint_tpu.ops.compile import setup_persistent_cache
+    from pint_tpu.profiles import SMOKE_PAR
     from pint_tpu.simulation import make_fake_toas_uniform
 
     import jax
@@ -1168,68 +1196,13 @@ def smoke_bench(ntoas: int = 300, maxiter: int = 5, sharded: bool = False,
     return rec
 
 
-#: flagship-shaped smoke par: every major component family the J0740
-#: flagship model engages — astrometry incl. parallax/proper motion, spin,
-#: dispersion + derivative, an ELL1 binary, and the EFAC/EQUAD/ECORR
-#: noise masks bound to the NANOGrav-style receiver flags
-FLAGSHIP_SMOKE_PAR = """
-PSR FLAGSMOKE
-RAJ 07:40:45.79 1
-DECJ 66:20:33.6 1
-PMRA -9.9 1
-PMDEC -33.0 1
-PX 0.4 1
-F0 346.531996 1
-F1 -1.46e-15 1
-PEPOCH 57000
-POSEPOCH 57000
-DM 14.96 1
-DM1 0.0 1
-DMEPOCH 57000
-BINARY ELL1
-PB 4.766944 1
-A1 3.9775561 1
-TASC 56999.1 1
-EPS1 -5.7e-6 1
-EPS2 -1.4e-5 1
-M2 0.26
-SINI 0.99
-EFAC -f Rcvr1_2_GUPPI 1.02
-EQUAD -f Rcvr1_2_GUPPI 0.01
-ECORR -f Rcvr1_2_GUPPI 0.01
-EFAC -f Rcvr_800_GUPPI 1.03
-EQUAD -f Rcvr_800_GUPPI 0.01
-ECORR -f Rcvr_800_GUPPI 0.01
-TZRMJD 57000.1
-TZRSITE gbt
-TZRFRQ 1400
-"""
-
-
 def _flagship_smoke_dataset(ntoas: int):
-    """J0740-shaped synthetic set at reduced N: sub-band epoch structure,
-    receiver flags binding every noise mask, all model components live."""
-    from pint_tpu.io.par import parse_parfile
-    from pint_tpu.models.builder import build_model
-    from pint_tpu.simulation import make_fake_toas_fromMJDs
+    """J0740-shaped synthetic set at reduced N (pint_tpu/profiles.py —
+    shared with `pint_tpu warmup`, which must reproduce these program
+    signatures exactly for the zero-trace warm contract to hold)."""
+    from pint_tpu.profiles import flagship_smoke_dataset
 
-    model = build_model(parse_parfile(FLAGSHIP_SMOKE_PAR, from_text=True))
-    per_epoch = len(RECEIVERS[0][1])
-    n_epochs = max(ntoas // per_epoch, 2)
-    epoch_mjds = np.linspace(56650.0, 57350.0, n_epochs)
-    mjds, freqs, flags = [], [], []
-    for i, emjd in enumerate(epoch_mjds):
-        fname, subbands = RECEIVERS[i % len(RECEIVERS)]
-        for j, f in enumerate(subbands):
-            mjds.append(emjd + j * 0.1 / 86400.0)
-            freqs.append(f)
-            flags.append({"f": fname, "fe": fname.split("_GUPPI")[0]})
-    toas = make_fake_toas_fromMJDs(
-        np.array(mjds), model, obs="gbt", freq_mhz=np.array(freqs),
-        error_us=1.0, flags=flags, add_noise=True,
-        rng=np.random.default_rng(17),
-    )
-    return model, toas
+    return flagship_smoke_dataset(ntoas)
 
 
 def smoke_flagship_bench(ntoas: int = 1000, maxiter: int = 5,
@@ -1336,6 +1309,25 @@ def _smoke_flagship_bench(ntoas: int, maxiter: int, grid_maxiter: int) -> dict:
                       batch=_GRID_BATCH)
     first_grid_s = time.time() - t0
 
+    # the flagship's OTHER headline programs, outside the measured
+    # WLS time-to-first-point span: the GLS/ECORR fused fit and one
+    # marginalized noise-likelihood eval — so the smoke covers (and a
+    # `pint_tpu warmup`-ed process deserializes) the same program set
+    # the real flagship bench compiles
+    import copy
+
+    from pint_tpu.fitting import DownhillGLSFitter
+    from pint_tpu.fitting.noise_like import NoiseLikelihood
+
+    t0 = time.time()
+    gftr = DownhillGLSFitter(toas, copy.deepcopy(model), fused=True)
+    gres = gftr.fit_toas(maxiter=2)
+    gls_fit_s = time.time() - t0
+    t0 = time.time()
+    nl = NoiseLikelihood(toas, copy.deepcopy(model))
+    nl.loglike(nl.x0)
+    noise_eval_s = time.time() - t0
+
     fitperf = res.perf or {}
     empty = perf.PerfReport()
     rec = {
@@ -1354,12 +1346,21 @@ def _smoke_flagship_bench(ntoas: int, maxiter: int, grid_maxiter: int) -> dict:
         "ttfp_breakdown": _ttfp_breakdown(
             0.0, empty, tensor_build_s, build_rep, fit_s, fitperf,
             compile_tail_s, first_grid_s),
+        # warm/cold startup split: a `pint_tpu warmup`-ed fresh process
+        # must report ttfp_kind=warm with traces_on_warm == 0
+        **_warm_fields(tensor_build_s + overlap_s + first_grid_s),
         # kernel-pack outcome over the whole run INCLUDING the dataset
         # build (where a cold pack compiles): a warm-cache rerun must
         # report kernel_pack_cache_hit with a <1 s build wall
         **_kernel_fields(data_rep, build_rep),
         "ephemeris_source": fitperf.get("ephemeris_source"),
         "fit_breakdown": fitperf,
+        # the post-span headline-program legs (GLS fused fit + one noise
+        # loglike): their wall is reported but NOT part of the WLS
+        # time-to-first-point contract above
+        "gls_fit_s": round(gls_fit_s, 3),
+        "gls_chi2_reduced": round(gres.reduced_chi2, 3),
+        "noise_eval_s": round(noise_eval_s, 3),
         "degradation_count": _degradation_count(),
         "degradation_kinds": _degradation_kinds(),
         "static_cost": _static_cost(),
@@ -1378,6 +1379,7 @@ def _smoke_fleet(n_fits: int, ntoas: int, seed: int = 11):
     from pint_tpu.fitting.wls import apply_delta
     from pint_tpu.io.par import parse_parfile
     from pint_tpu.models.builder import build_model
+    from pint_tpu.profiles import SMOKE_PAR
     from pint_tpu.simulation import _reprepare, make_fake_toas_uniform
 
     model = build_model(parse_parfile(SMOKE_PAR, from_text=True))
@@ -1458,6 +1460,7 @@ def smoke_session_bench(ntoas: int = 700, n_appends: int = 10, k: int = 8,
     from pint_tpu.models.builder import build_model
     from pint_tpu.ops import perf
     from pint_tpu.ops.compile import setup_persistent_cache
+    from pint_tpu.profiles import SMOKE_PAR
     from pint_tpu.serve import TimingSession
     from pint_tpu.simulation import make_fake_toas_uniform
 

@@ -93,7 +93,8 @@ log = get_logger("pint_tpu.analysis")
 
 __all__ = [
     "AuditError", "Violation", "audit_block", "audit_jitted",
-    "audit_mode", "audit_program", "reset_ledger", "PASSES",
+    "audit_mode", "audit_program", "compile_count", "expect_warm_violation",
+    "record_compile", "reset_ledger", "PASSES",
 ]
 
 
@@ -479,6 +480,11 @@ WARN_ONLY_PASSES = {"dd-spec"}
 _lock = threading.Lock()
 _programs: dict[tuple, dict] = {}  # (label, id) -> {"signatures": n}
 _violations: list[Violation] = []
+#: label -> ledger-visible trace+compile events (TimedProgram._compile
+#: records every one UNCONDITIONALLY — even under PINT_TPU_AUDIT=0 — so
+#: the zero-trace warm contract and the bench's ``traces_on_warm`` field
+#: read from the same ledger the violations do)
+_compiles: dict[str, int] = {}
 
 
 def reset_ledger() -> None:
@@ -486,6 +492,35 @@ def reset_ledger() -> None:
     with _lock:
         _programs.clear()
         _violations.clear()
+        _compiles.clear()
+
+
+def record_compile(label: str) -> None:
+    """Record one trace+compile event (a TimedProgram signature that was
+    NOT served by a deserialized artifact)."""
+    with _lock:
+        _compiles[label] = _compiles.get(label, 0) + 1
+
+
+def compile_count() -> int:
+    """Total ledger-visible trace+compile events this process — the
+    number a warmed process must hold at ZERO (``traces_on_warm``)."""
+    with _lock:
+        return sum(_compiles.values())
+
+
+def expect_warm_violation(label: str, detail: str) -> None:
+    """Record an ``expect-warm`` violation and raise — unconditionally,
+    regardless of PINT_TPU_AUDIT mode: the retrace-zero contract
+    (``PINT_TPU_EXPECT_WARM=1``) escalates EVERY trace/compile event to a
+    strict failure, with the miss on the ledger before the raise so a
+    crashed warm process still shows which program was uncovered."""
+    v = Violation("expect-warm", label, detail)
+    with _lock:
+        _violations.append(v)
+    msg = f"jaxpr audit: [expect-warm] {label!r}: {detail}"
+    log.error(msg)
+    raise AuditError(msg)
 
 
 def audit_block(max_violations: int = 20) -> dict:
@@ -496,7 +531,9 @@ def audit_block(max_violations: int = 20) -> dict:
         for (label, _), entry in _programs.items():
             sigs[label] = max(sigs.get(label, 0), entry["signatures"])
         vs = list(_violations)
-    return {
+        n_compiles = sum(_compiles.values())
+        compiles = dict(sorted(_compiles.items()))
+    out = {
         "n_programs": len(sigs),
         "n_passes": len(PASSES),
         "n_violations": len(vs),
@@ -506,7 +543,18 @@ def audit_block(max_violations: int = 20) -> dict:
         ],
         "signatures": dict(sorted(sigs.items())),
         "mode": audit_mode(),
+        # trace+compile events + serialized-executable traffic: the
+        # warm-process contract reads both from this one block
+        "n_compiles": n_compiles,
+        "compiles": compiles,
     }
+    try:
+        from pint_tpu.ops.compile import aot_block
+
+        out["aot"] = aot_block()
+    except Exception:  # pragma: no cover — ledger must never break a fit  # jaxlint: disable=silent-except — telemetry assembly, not a degradation path
+        out["aot"] = None
+    return out
 
 
 def audit_program(

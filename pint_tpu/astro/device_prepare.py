@@ -100,7 +100,10 @@ def _build_geometry_program():
             itrf_m, ut1_mjd, tt_jcent, xp_rad=xp_rad, yp_rad=yp_rad, xp=jnp)
 
     return TimedProgram(precision_jit(fn), "prepare_geometry",
-                        precision_spec="f64")
+                        precision_spec="f64",
+                        # closure is the static erot series chain: AOT-
+                        # serializable (ops/compile.py artifact store)
+                        aot_key="geometry")
 
 
 def site_posvel_device(itrf_m, ut1_mjd, tt_jcent, xp_rad, yp_rad):
@@ -128,7 +131,10 @@ def _build_analytic_program(bodies: tuple[str, ...], dt_s: float):
             eph._posvel_analytic(b, T, dt_s=dt_s, xp=jnp) for b in bodies)
 
     return TimedProgram(precision_jit(fn), "prepare_ephemeris",
-                        precision_spec="f64")
+                        precision_spec="f64",
+                        # closure = the requested body set + the central-
+                        # difference step: AOT-serializable
+                        aot_key=f"analytic|{bodies!r}|dt={dt_s!r}")
 
 
 def analytic_posvel_device(bodies: tuple[str, ...], tdb_jcent,
@@ -212,8 +218,13 @@ def _build_nbody_program(body_indices: tuple[int, ...],
             out.append((p, v))
         return tuple(out)
 
-    return TimedProgram(precision_jit(fn), "prepare_nbody",
-                        precision_spec="f64")
+    return TimedProgram(
+        precision_jit(fn), "prepare_nbody",
+        precision_spec="f64",
+        # closure = window layout (bodies, bands, epoch, span, trusted
+        # periods); the trajectory grids ride the argument list
+        aot_key=(f"nbody|{body_indices!r}|{band_of!r}|t0={t0!r}|"
+                 f"span={half_span_s!r}|pe={periods_e!r}|pm={periods_m!r}"))
 
 
 # --- Chebyshev kernel-pack serve --------------------------------------------------
@@ -249,7 +260,10 @@ def _build_kernel_program(chains: tuple[tuple[int, ...], ...], C: int):
         return tuple(out)
 
     return TimedProgram(precision_jit(fn), "prepare_kernel_eval",
-                        precision_spec="f64")
+                        precision_spec="f64",
+                        # closure = the static chain layout + padded
+                        # coefficient count; pack tensors ride the args
+                        aot_key=f"kernel|{chains!r}|C={C}")
 
 
 def kernel_posvel_device(pack, bodies: tuple[str, ...], t_jcent) -> dict | None:

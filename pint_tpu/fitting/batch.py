@@ -253,7 +253,11 @@ def get_batched_fit_fn(model, kind: str, free, subtract_mean: bool,
     entry = _BatchEntry(
         prog=TimedProgram(precision_jit(vfit), label,
                           collective_axes=(axis,) if axis else (),
-                          precision_spec=model.xprec.name),
+                          precision_spec=model.xprec.name,
+                          # closure = the bucket's model skeleton + mesh
+                          # layout (sig rides the call signature): AOT-
+                          # serializable for zero-trace warm starts
+                          aot_key=f"{skeleton!r}|{mesh_key!r}"),
         red_pieces=red_p, red_chi2=red_c,
         n_batch=n_batch, n_toa=n_toa, label=label,
     )

@@ -131,9 +131,13 @@ def get_gls_step_fn(model: TimingModel, free, subtract_mean: bool):
 
     from pint_tpu.ops.compile import TimedProgram, precision_jit
 
+    # closure = model structure + the step config in the cache key: AOT-
+    # serializable for zero-trace warm starts (ops/compile.py)
+    akey = f"{model.aot_structure_key()}|{key!r}"
     if not host:
         cache[key] = TimedProgram(precision_jit(step), "gls_step",
-                                  precision_spec=model.xprec.name)
+                                  precision_spec=model.xprec.name,
+                                  aot_key=akey)
         return cache[key]
 
     from pint_tpu.ops.compile import host_transfer, model_cpu_memo
@@ -142,9 +146,9 @@ def get_gls_step_fn(model: TimingModel, free, subtract_mean: bool):
     # fall back to the CPU-split Woodbury only when the device normal
     # matrix comes back non-finite (see module note above)
     fused_fn = TimedProgram(precision_jit(step), "gls_step_fused",
-                            precision_spec=model.xprec.name)
+                            precision_spec=model.xprec.name, aot_key=akey)
     device_fn = TimedProgram(precision_jit(design), "gls_design",
-                             precision_spec=model.xprec.name)
+                             precision_spec=model.xprec.name, aot_key=akey)
     # the host tail is jitted too (for the CPU target — its inputs live
     # on the CPU device): the Woodbury assembly with its ECORR segment
     # reductions would otherwise run eagerly per LM trial
@@ -209,17 +213,20 @@ def get_gls_chi2_fn(model: TimingModel, subtract_mean: bool):
 
     from pint_tpu.ops.compile import TimedProgram, precision_jit
 
+    # closure = model structure + the chi2 config in the cache key
+    akey = f"{model.aot_structure_key()}|chi2|{key!r}"
     if not host:
         cache[key] = TimedProgram(precision_jit(chi2fn), "gls_chi2",
-                                  precision_spec=model.xprec.name)
+                                  precision_spec=model.xprec.name,
+                                  aot_key=akey)
         return cache[key]
 
     from pint_tpu.ops.compile import model_cpu_memo
 
     fused_fn = TimedProgram(precision_jit(chi2fn), "gls_chi2_fused",
-                            precision_spec=model.xprec.name)
+                            precision_spec=model.xprec.name, aot_key=akey)
     resid_fn = TimedProgram(precision_jit(time_resids), "gls_resid",
-                            precision_spec=model.xprec.name)
+                            precision_spec=model.xprec.name, aot_key=akey)
 
     def chi2_tail(params, tensor, r, sigma):
         basis = model.noise_basis_and_weights(params, tensor)

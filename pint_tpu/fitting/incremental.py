@@ -330,7 +330,12 @@ def get_blocks_fn(model, kind: str, free, subtract_mean: bool, KE: int,
 
     cache[key] = TimedProgram(precision_jit(blocks), f"incr_blocks_{kind}",
                               collective_axes=(),
-                              precision_spec=model.xprec.name)
+                              precision_spec=model.xprec.name,
+                              # closure = model structure + the block
+                              # config in the cache key: AOT-serializable
+                              # (warm sessions deserialize their append
+                              # programs, ops/compile.py)
+                              aot_key=f"{model.aot_structure_key()}|{key!r}")
     return cache[key]
 
 
@@ -350,7 +355,10 @@ def get_incr_chi2_fn(model, kind: str, subtract_mean: bool):
 
     cache[key] = TimedProgram(precision_jit(chi2_fn), f"incr_chi2_{kind}",
                               collective_axes=(),
-                              precision_spec=model.xprec.name)
+                              precision_spec=model.xprec.name,
+                              # closure = model structure + the chi2
+                              # config in the cache key: AOT-serializable
+                              aot_key=f"{model.aot_structure_key()}|{key!r}")
     return cache[key]
 
 

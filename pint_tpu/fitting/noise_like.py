@@ -278,11 +278,14 @@ class NoiseLikelihood:
         # re-run. subtract_mean=False — the phase offset is profiled as an
         # explicit column instead (the reference's "Offset" column), so
         # the marginalization stays exact as the weights move with EFAC.
-        def design(params, tensor):
+        def design(params, tensor, track_pn, delta_pn):
+            # pulse-number tracking columns ride the ARGUMENT list (like
+            # get_resid_fn): the closure stays structural, so the program
+            # is AOT-serializable for zero-trace warm starts
             def rfun(delta):
                 _, r, f = phase_residual_frac(
                     model, apply_delta(params, free, delta), tensor,
-                    track_pn=resids._track_pn, delta_pn=resids._delta_pn,
+                    track_pn=track_pn, delta_pn=delta_pn,
                     subtract_mean=False,
                 )
                 return r / f, f
@@ -295,9 +298,13 @@ class NoiseLikelihood:
                  else jnp.zeros((r0.shape[0], 0)))
             return r0, M
 
-        design_prog = TimedProgram(precision_jit(design), "noise_design",
-                                   precision_spec=model.xprec.name)
-        r0, M = design_prog(params0, tensor)
+        design_prog = TimedProgram(
+            precision_jit(design), "noise_design",
+            precision_spec=model.xprec.name,
+            aot_key=(f"{model.aot_structure_key()}|design|"
+                     f"free={','.join(free)}"))
+        r0, M = design_prog(params0, tensor, resids._track_pn,
+                            resids._delta_pn)
         r0 = np.asarray(r0)
         M = np.asarray(M)
         self.p_lin = M.shape[1]
@@ -349,6 +356,23 @@ class NoiseLikelihood:
         )
         return data, specs
 
+    def _aot_base(self) -> str:
+        """Structural closure fingerprint shared by every noise program:
+        model structure + the hyperparameter set + the linearized-column
+        count + the marginalization mode (everything `_loglike_fn` bakes
+        in; the row data rides the ``data`` operand) — the ``aot_key``
+        that makes the noise engine's executables serializable for
+        zero-trace warm starts (ops/compile.py artifact store)."""
+        return (f"{self.model.aot_structure_key()}|"
+                f"hyper={','.join(self.hyper)}|plin={self.p_lin}|"
+                f"marg={self.marginalize_timing}")
+
+    def _aot_priors(self) -> str:
+        """Prior fingerprint for the posterior-composing programs (chain/
+        optimizer/Hessian): the frozen-dataclass reprs are deterministic
+        and carry every prior parameter the lnprior closure bakes in."""
+        return ";".join(f"{n}={self.priors[n]!r}" for n in self.hyper)
+
     def _compile(self, data, specs, n_shards: int) -> _ProgramSet:
         from pint_tpu.ops.compile import TimedProgram, precision_jit
 
@@ -386,17 +410,21 @@ class NoiseLikelihood:
         llg = _wrap_sharded(llg, self.mesh, axis, specs, P() if axis else None)
         grad = jax.grad(llg)
 
+        akey = f"{self._aot_base()}|shards={n_shards}"
         return _ProgramSet(
             loglike=TimedProgram(precision_jit(single), "noise_loglike",
                                  collective_axes=axes,
-                                 precision_spec=self.model.xprec.name),
+                                 precision_spec=self.model.xprec.name,
+                                 aot_key=akey),
             loglike_batch=TimedProgram(precision_jit(batch),
                                        "noise_loglike_batch",
                                        collective_axes=axes,
-                                       precision_spec=self.model.xprec.name),
+                                       precision_spec=self.model.xprec.name,
+                                       aot_key=akey),
             grad=TimedProgram(precision_jit(grad), "noise_loglike_grad",
                               collective_axes=axes,
-                              precision_spec=self.model.xprec.name),
+                              precision_spec=self.model.xprec.name,
+                              aot_key=akey),
         )
 
     # --- prior / posterior ------------------------------------------------------
@@ -524,10 +552,21 @@ class NoiseLikelihood:
         vrun = jax.vmap(run, in_axes=(0, None, None))
         from pint_tpu.ops.compile import TimedProgram, precision_jit
 
+        # the optimizer closure bakes the CENTER/SCALE values (x0, prior
+        # scales) and the Adam schedule: all of it lands in the aot_key so
+        # a serialized executable can never serve a different start point
+        import hashlib as _hashlib
+
+        cs = _hashlib.sha256(
+            np.asarray(self.x0).tobytes()
+            + np.asarray(self.scales).tobytes()).hexdigest()[:16]
         prog = self.__dict__.setdefault(
             "_opt_prog",
             TimedProgram(precision_jit(vrun), "noise_optimize",
-                         precision_spec=self.model.xprec.name))
+                         precision_spec=self.model.xprec.name,
+                         aot_key=(f"{self._aot_base()}|"
+                                  f"priors={self._aot_priors()}|"
+                                  f"opt={n_steps},{lr!r}|cs={cs}")))
         rng = np.random.default_rng(seed)
         z0 = np.zeros((n_restarts, self.nparams))
         z0[1:] = rng.standard_normal((n_restarts - 1, self.nparams))
@@ -558,7 +597,11 @@ class NoiseLikelihood:
 
         hess = jax.hessian(self._lnpost_traced)
         prog = TimedProgram(precision_jit(hess), "noise_laplace_hessian",
-                            precision_spec=self.model.xprec.name)
+                            precision_spec=self.model.xprec.name,
+                            # lnpost closure = structure + priors; the
+                            # evaluation point rides the argument list
+                            aot_key=(f"{self._aot_base()}|"
+                                     f"priors={self._aot_priors()}|hessian"))
         with perf.stage("noise"):
             with perf.stage("build"):
                 H = np.asarray(prog(jnp.asarray(self.x0), self._params0,
@@ -675,7 +718,11 @@ class NoiseLikelihood:
         if prog is None:
             prog = cache[key] = TimedProgram(
                 precision_jit(vchain), label,
-                precision_spec=self.model.xprec.name)
+                precision_spec=self.model.xprec.name,
+                # chain closure = structure + priors + the kernel config
+                # in the cache key; starts/center/scales ride the args
+                aot_key=(f"{self._aot_base()}|"
+                         f"priors={self._aot_priors()}|{key!r}"))
 
         scales = self.laplace_scales()
         z0, keys = self._chain_starts(kernel, nd, nwalkers, seed, chain_ids,

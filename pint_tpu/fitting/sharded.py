@@ -639,7 +639,12 @@ def get_fused_fit_fn(model, kind: str, free, subtract_mean: bool,
         # fallback must contain no collective at all
         prog=TimedProgram(precision_jit(fit), f"fused_{kind}_fit",
                           collective_axes=(axis,) if axis else (),
-                          precision_spec=model.xprec.name),
+                          precision_spec=model.xprec.name,
+                          # closure = model structure + the fused-loop
+                          # config already in the cache key (mesh device
+                          # ids included): AOT-serializable for
+                          # zero-trace warm starts (ops/compile.py)
+                          aot_key=f"{model.aot_structure_key()}|{key!r}"),
         red_pieces=red_p,
         red_chi2=red_c,
         n_shards=n_shards,

@@ -130,17 +130,21 @@ def get_wb_step_fn(model, free, subtract_mean: bool):
 
     from pint_tpu.ops.compile import TimedProgram, host_transfer
 
+    # closure = model structure + the step config in the cache key: AOT-
+    # serializable for zero-trace warm starts (ops/compile.py)
+    akey = f"{model.aot_structure_key()}|{key!r}"
     if not host:
         cache[key] = TimedProgram(precision_jit(step), "wb_step",
-                                  precision_spec=model.xprec.name)
+                                  precision_spec=model.xprec.name,
+                                  aot_key=akey)
         return cache[key]
 
     # ADAPTIVE: fused on-device first, CPU-split Woodbury only on
     # non-finite results (same strategy as fitting/gls.py)
     fused_fn = TimedProgram(precision_jit(step), "wb_step_fused",
-                            precision_spec=model.xprec.name)
+                            precision_spec=model.xprec.name, aot_key=akey)
     device_fn = TimedProgram(precision_jit(design), "wb_design",
-                             precision_spec=model.xprec.name)
+                             precision_spec=model.xprec.name, aot_key=akey)
     pieces_fn = jax.jit(woodbury_pieces, static_argnums=(5,))
     cpu = jax.devices("cpu")[0]
     memo = model_cpu_memo(model)
@@ -206,15 +210,18 @@ def get_wb_chi2_fn(model, subtract_mean: bool):
 
     from pint_tpu.ops.compile import TimedProgram, host_transfer
 
+    # closure = model structure + the chi2 config in the cache key
+    akey = f"{model.aot_structure_key()}|chi2|{key!r}"
     if not host:
         cache[key] = TimedProgram(precision_jit(chi2fn), "wb_chi2",
-                                  precision_spec=model.xprec.name)
+                                  precision_spec=model.xprec.name,
+                                  aot_key=akey)
         return cache[key]
 
     fused_fn = TimedProgram(precision_jit(chi2fn), "wb_chi2_fused",
-                            precision_spec=model.xprec.name)
+                            precision_spec=model.xprec.name, aot_key=akey)
     resid_fn = TimedProgram(precision_jit(resids), "wb_resid",
-                            precision_spec=model.xprec.name)
+                            precision_spec=model.xprec.name, aot_key=akey)
 
     def chi2_tail(params, tensor, r0, sw_t, n_dm):
         basis = _noise_basis_aug(model, params, tensor, sw_t, n_dm)

@@ -228,9 +228,14 @@ def get_step_fn(model: TimingModel, free: tuple[str, ...], subtract_mean: bool):
     # PINT_TPU_HOST_SOLVE=1 forces the host-solve path (tests exercise it
     # on CPU; it is automatic on non-CPU backends). The flag is part of
     # the cache key, so toggling it mid-process takes effect.
+    # closure = model structure + the step config in the cache key: every
+    # number rides the operands, so the programs are AOT-serializable for
+    # zero-trace warm starts (ops/compile.py artifact store)
+    akey = f"{model.aot_structure_key()}|{key!r}"
     if not host_solve:
         cache[key] = TimedProgram(precision_jit(step), "wls_step",
-                                  precision_spec=model.xprec.name)
+                                  precision_spec=model.xprec.name,
+                                  aot_key=akey)
         return cache[key]
 
     # Non-CPU backends: the TPU emulates f64 as f32-pairs whose RANGE is
@@ -246,9 +251,9 @@ def get_step_fn(model: TimingModel, free: tuple[str, ...], subtract_mean: bool):
     from pint_tpu.ops.compile import host_transfer
 
     fused_fn = TimedProgram(precision_jit(step), "wls_step_fused",
-                            precision_spec=model.xprec.name)
+                            precision_spec=model.xprec.name, aot_key=akey)
     device_fn = TimedProgram(precision_jit(design), "wls_design",
-                             precision_spec=model.xprec.name)
+                             precision_spec=model.xprec.name, aot_key=akey)
 
     def step_host_solve(params, tensor, track_pn, delta_pn, weights, errors):
         r0_d, M_d = device_fn(params, tensor, track_pn, delta_pn, weights)

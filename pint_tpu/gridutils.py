@@ -369,6 +369,9 @@ def _grid_single_fn(model, parnames, free, subtract_mean, maxiter, batch,
             ),
             "grid",
             precision_spec=model.xprec.name,
+            # closure = model structure + the scan config already in the
+            # cache key: AOT-serializable (ops/compile.py artifact store)
+            aot_key=f"{model.aot_structure_key()}|{key!r}",
         )
     return cache[key], key
 
@@ -479,5 +482,8 @@ def _grid_sharded(model, parnames, free, subtract_mean, maxiter, mesh,
             precision_jit(fn), "grid_sharded",
             collective_axes=(toa_axis,) if shard_toas else (),
             precision_spec=model.xprec.name,
+            # closure = model structure + mesh/scan config (the cache
+            # key, device ids included): AOT-serializable
+            aot_key=f"{model.aot_structure_key()}|{key!r}",
         )
     return cache[key](pts, params, data)

@@ -192,6 +192,23 @@ class TimingModel:
     def free_params(self) -> list[str]:
         return [n for n, m in self.param_meta.items() if not m.frozen]
 
+    def aot_structure_key(self) -> str:
+        """Structural fingerprint of everything a traced program may bake
+        in from this model's CLOSURE: component graph (types + specs, in
+        evaluation order), free-parameter set (order included — the fit
+        vector is ordered), precision backend and the phase-layout flags.
+        Every NUMBER rides the (params, tensor) operands (build_tensor's
+        contract, enforced by the large-const audit pass), so this key +
+        the call signature content-address a compiled executable for the
+        serialized-AOT artifact store (ops/compile.py ``aot_key=``)."""
+        comps = ";".join(
+            f"{type(c).__name__}:{','.join(sorted(getattr(c, 'specs', ())))}"
+            for c in self.components)
+        return (f"model[{self.xprec.name};"
+                f"free={','.join(self.free_params)};"
+                f"abs={int(self.has_abs_phase)};"
+                f"po={int(self.has_phase_offset)};{comps}]")
+
     # --- noise surface (models/noise.py) -----------------------------------------
 
     @property

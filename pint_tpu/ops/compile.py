@@ -28,17 +28,34 @@ This module also owns the fit-path compile machinery the perf layer
   the overlap trick: compilation is host-side work that releases the GIL,
   so a worker thread can compile the fit-step program while the chip (or
   the host) is busy with TOA preparation.
+- The serialized-AOT-executable artifact store (``PINT_TPU_AOT_EXPORT``):
+  the persistent XLA cache eliminates warm-process *compiles* but every
+  fresh process still pays the host-Python *trace* of every program —
+  the remaining term of the cold-start wall no disk cache served. Every
+  AOT-eligible `TimedProgram` (constructed with ``aot_key=``, a
+  structural fingerprint of its closure) round-trips its compiled
+  executable through a content-addressed artifact beside the compile
+  cache, keyed on (label, call signature, device topology, jax/jaxlib/
+  XLA versions, source fingerprint, the declared ``aot_key``): a warmed
+  process deserializes the executable — zero traces, zero compiles,
+  bitwise-identical results — and ``PINT_TPU_EXPECT_WARM=1`` escalates
+  any trace/compile that slips through to a strict audit failure (the
+  ``pint_tpu warmup`` CLI populates the store for a workload profile).
 """
 
 from __future__ import annotations
 
 import os
 import threading
+from pathlib import Path
 
 import jax
 
 from pint_tpu.ops import perf
 from pint_tpu.utils import knobs
+from pint_tpu.utils.logging import get_logger
+
+log = get_logger("pint_tpu.compile")
 
 _CPU_WORKAROUND = {"xla_disable_hlo_passes": "fusion"}
 
@@ -96,10 +113,14 @@ def setup_persistent_cache(force: bool = False) -> str | None:
     with _cache_lock:
         if _cache_state["done"] and not force:
             return _cache_state["dir"]
+        prev_done = _cache_state["done"]
+        prev_dir = _cache_state["dir"]
         _cache_state["done"] = True
         legacy = knobs.get("PINT_TPU_COMPILE_CACHE")
         if knobs.get("PINT_TPU_XLA_CACHE") == "0" or legacy == "0":
             _cache_state["dir"] = None
+            if prev_done and prev_dir is not None:
+                _bump_aot_epoch()
             return None
         from pint_tpu.utils.cache import cache_root
 
@@ -126,7 +147,345 @@ def setup_persistent_cache(force: bool = False) -> str | None:
             _cache_state["dir"] = None
             return None
         _cache_state["dir"] = path
+        # a dir CHANGE must also invalidate every in-process deserialized
+        # executable handle: the epoch bump makes TimedProgram drop (and
+        # re-resolve from the NEW root) anything it loaded from the old
+        # one — a test that swaps PINT_TPU_COMPILE_CACHE mid-session can
+        # never be served an executable from the superseded directory
+        if prev_done and prev_dir != path:
+            _bump_aot_epoch()
         return path
+
+
+# --- serialized AOT executables (the artifact store) -----------------------------
+
+#: artifact container format; bumped on any layout change so old entries
+#: full-key-miss instead of half-loading
+_AOT_FORMAT = 1
+
+_aot_lock = threading.Lock()
+#: in-process AOT state: ``epoch`` bumps whenever the persistent-cache
+#: directory changes, invalidating every deserialized executable handle
+#: (TimedProgram drops and re-resolves them); ``override`` is the
+#: programmatic enable (None = follow the env knobs).
+_aot_state: dict = {"epoch": 0, "override": None}
+#: process-wide artifact-store telemetry (aot_block() snapshots it)
+_AOT_STATS: dict = {
+    "deserialize_hits": 0, "deserialize_misses": 0, "exports": 0,
+    "export_failures": 0, "layout_fallbacks": 0,
+    "labels": {},  # label -> {"hits": n, "misses": n, "exports": n}
+}
+#: labels whose executables this backend refused to serialize — tried
+#: once, then skipped (the artifact store is an optimization)
+_aot_unserializable: set = set()
+
+
+def _bump_aot_epoch() -> None:
+    _aot_state["epoch"] += 1
+
+
+def aot_epoch() -> int:
+    """Monotone counter of persistent-cache-directory changes: a
+    deserialized executable handle is only valid within the epoch it was
+    loaded in."""
+    return _aot_state["epoch"]
+
+
+def set_aot_export(flag: bool | None) -> None:
+    """Programmatic override of the artifact store (None = follow the
+    ``PINT_TPU_AOT_EXPORT`` / ``PINT_TPU_EXPECT_WARM`` knobs)."""
+    _aot_state["override"] = flag
+
+
+def aot_enabled() -> bool:
+    """True when AOT-eligible programs should round-trip their compiled
+    executables through the on-disk artifact store (deserialize-first,
+    export-on-compile)."""
+    if _aot_state["override"] is not None:
+        return bool(_aot_state["override"])
+    return (knobs.flag("PINT_TPU_AOT_EXPORT")
+            or knobs.flag("PINT_TPU_EXPECT_WARM"))
+
+
+def aot_cache_dir() -> Path | None:
+    """The serialized-executable artifact directory, or None when the
+    persistent compile cache is disabled (the artifact store lives
+    BESIDE the XLA cache entries and inherits every dir-override /
+    disable knob, including the graft dryrun's ``PINT_TPU_COMPILE_CACHE=0``
+    host-feature-SIGILL escape hatch)."""
+    xla_dir = setup_persistent_cache()
+    if xla_dir is None:
+        return None
+    return Path(xla_dir) / "aot"
+
+
+def reset_aot_stats() -> None:
+    """Zero the artifact-store counters (test isolation)."""
+    with _aot_lock:
+        _AOT_STATS.update(deserialize_hits=0, deserialize_misses=0,
+                          exports=0, export_failures=0, layout_fallbacks=0,
+                          labels={})
+
+
+def aot_note(label: str, event: str) -> None:
+    """Record one artifact-store event (``hits``/``misses``/``exports``/
+    ``export_failures``/``layout_fallbacks``) process-wide and per label."""
+    total_key = {"hits": "deserialize_hits",
+                 "misses": "deserialize_misses"}.get(event, event)
+    with _aot_lock:
+        _AOT_STATS[total_key] += 1
+        if event in ("hits", "misses", "exports"):
+            per = _AOT_STATS["labels"].setdefault(
+                label, {"hits": 0, "misses": 0, "exports": 0})
+            per[event] += 1
+
+
+def aot_block() -> dict:
+    """JSON-ready snapshot of the artifact store: deserialize traffic,
+    exports, per-label detail and the directory in use — the ``aot``
+    block the audit ledger and the bench headline carry."""
+    with _aot_lock:
+        out = {k: (dict(v) if isinstance(v, dict) else v)
+               for k, v in _AOT_STATS.items()}
+        out["labels"] = {k: dict(v) for k, v in _AOT_STATS["labels"].items()}
+    d = _cache_state["dir"]
+    out["cache_dir"] = None if d is None else str(Path(d) / "aot")
+    out["enabled"] = aot_enabled()
+    return out
+
+
+def _aot_topology() -> str:
+    """Device-topology key component: an executable is loadable only onto
+    the client layout it was compiled for (device count/kind/process
+    layout; the XLA platform version guards serialized-binary drift)."""
+    devs = jax.devices()
+    kinds = ",".join(f"{d.platform}:{getattr(d, 'device_kind', '?')}"
+                     for d in devs)
+    try:
+        plat = devs[0].client.platform_version
+    except Exception:  # pragma: no cover — client API drift  # jaxlint: disable=silent-except — version component degrades to '?'; the jax/jaxlib components still key the artifact
+        plat = "?"
+    return (f"{jax.default_backend()};n={len(devs)};"
+            f"procs={jax.process_count()};{kinds};xla={plat}")
+
+
+def _aot_full_key(label: str, sig, collective_axes, aot_key: str) -> str:
+    """The FULL content key stored inside an artifact and compared on
+    load (a truncated-filename-hash collision is a miss, never a wrong
+    executable). Components: container format, program label, jax +
+    jaxlib + XLA-platform versions, the package source fingerprint (any
+    source change conservatively invalidates — the traced program is a
+    function of the source), device topology, declared collective axes,
+    the caller's structural ``aot_key`` (what the closure bakes in), and
+    the exact call signature (treedef + shapes/dtypes/weak_type)."""
+    import jaxlib
+
+    from pint_tpu.utils.cache import source_fingerprint
+
+    treedef, leaves = sig
+    return "\n".join([
+        f"format={_AOT_FORMAT}",
+        f"label={label}",
+        f"jax={jax.__version__}",
+        f"jaxlib={getattr(jaxlib, '__version__', '?')}",
+        f"src={source_fingerprint()}",
+        f"topo={_aot_topology()}",
+        f"axes={','.join(collective_axes)}",
+        f"extra={aot_key}",
+        f"tree={treedef}",
+        f"leaves={leaves}",
+    ])
+
+
+def _aot_path(label: str, key: str) -> Path | None:
+    import hashlib
+
+    d = aot_cache_dir()
+    if d is None:
+        return None
+    safe = "".join(c if (c.isalnum() or c in "-_") else "_" for c in label)
+    return d / f"{safe}-{hashlib.sha256(key.encode()).hexdigest()[:24]}.aotx"
+
+
+#: artifact container: magic + little-endian u32 header length + JSON
+#: header (format/key/label) + the `jax.export` serialized module bytes.
+#: No pickle anywhere in the load path — a tampered artifact can at worst
+#: fail deserialization (quarantine), never execute host code.
+_AOT_MAGIC = b"PINTAOT1"
+
+
+def _aot_write_file(path: Path, header: dict, blob: bytes) -> None:
+    import json
+    import struct
+
+    h = json.dumps(header).encode()
+    os.makedirs(path.parent, exist_ok=True)
+    tmp = path.with_suffix(f".tmp{os.getpid()}")
+    with open(tmp, "wb") as f:
+        f.write(_AOT_MAGIC)
+        f.write(struct.pack("<I", len(h)))
+        f.write(h)
+        f.write(blob)
+    tmp.replace(path)
+
+
+def _aot_read_file(path: Path) -> tuple[dict, bytes]:
+    import json
+    import struct
+
+    with open(path, "rb") as f:
+        magic = f.read(len(_AOT_MAGIC))
+        if magic != _AOT_MAGIC:
+            raise ValueError(f"bad AOT artifact magic {magic!r}")
+        (hlen,) = struct.unpack("<I", f.read(4))
+        header = json.loads(f.read(hlen).decode())
+        blob = f.read()
+    return header, blob
+
+
+_export_registered = [False]
+
+
+def _ensure_export_registrations() -> None:
+    """Register the package's NamedTuple pytree carriers with
+    `jax.export`'s treedef serializer (stable dotted names, so an
+    artifact written by one process reconstructs the identical call/
+    result trees in another). Idempotent; unknown future carriers only
+    cost an export failure for that one program, never a wrong load."""
+    if _export_registered[0]:
+        return
+    _export_registered[0] = True
+    from jax import export as _jexport
+
+    from pint_tpu.fitting.sharded import FusedFitResult
+    from pint_tpu.ops.dd import DD
+    from pint_tpu.ops.qf32 import QF
+
+    for t in (DD, QF, FusedFitResult):
+        try:
+            _jexport.register_namedtuple_serialization(
+                t, serialized_name=f"{t.__module__}.{t.__qualname__}")
+        except ValueError:  # pragma: no cover — double registration  # jaxlint: disable=silent-except — already-registered is the idempotent success case
+            pass
+    # XLA:CPU lapack custom calls resolve scipy's BLAS/LAPACK function
+    # pointers LAZILY: jax's own lowering shims call _lapack.initialize()
+    # on first use, but a DESERIALIZED module bypasses those shims
+    # entirely — executing its lapack_*_ffi custom call with unresolved
+    # pointers segfaults. Importing jaxlib.lapack registers the targets;
+    # initialize() binds the pointers (idempotent, a few µs).
+    if jax.default_backend() == "cpu":
+        try:
+            import jaxlib.lapack as _jl_lapack
+
+            _jl_lapack._lapack.initialize()
+        except Exception as e:  # pragma: no cover — jaxlib layout drift  # jaxlint: disable=silent-except — missing initializer only matters for deserialized lapack calls; the failure is logged and those programs fall back to trace+compile on their first (crashing-free) jit dispatch
+            log.warning(f"could not initialize CPU lapack kernels for "
+                        f"deserialized executables: {e}")
+
+
+def _aot_load_exe(label: str, key: str, args):
+    """Deserialize one artifact and AOT-compile its embedded module, or
+    None on miss. The PR-6/7 cache discipline: the stored full key must
+    equal the computed one (a truncated-filename-hash collision or any
+    version skew is a MISS, never a wrong executable); a corrupt or
+    unreadable entry is QUARANTINED beside the store with a
+    ``fetch.corrupt_quarantined`` ledger event and the program recompiles
+    cleanly.
+
+    The artifact carries the `jax.export` StableHLO module — portable
+    across processes by construction (custom-call targets referenced by
+    name, no baked host pointers). Loading traces only the tiny
+    `Exported.call` wrapper (never the model Python) and the XLA compile
+    of the embedded module is served by the persistent compile cache the
+    warmup run already populated — zero model traces, cache-served
+    compile."""
+    path = _aot_path(label, key)
+    if path is None or not path.exists():
+        return None
+    try:
+        header, blob = _aot_read_file(path)
+        if header.get("format") != _AOT_FORMAT or header.get("key") != key:
+            # full-key mismatch: version skew / hash collision — a miss,
+            # never a wrong executable
+            log.info(f"AOT artifact key mismatch for {path.name}; "
+                     "recompiling")
+            return None
+        from jax import export as _jexport
+
+        _ensure_export_registrations()
+        exported = _jexport.deserialize(bytearray(blob))
+        return jax.jit(exported.call).lower(*args).compile()
+    except Exception as e:  # noqa: BLE001 — corrupt artifact: quarantine + recompile
+        from pint_tpu.ops import degrade
+
+        qdir = path.parent / "quarantine"
+        try:
+            os.makedirs(qdir, exist_ok=True)
+            os.replace(path, qdir / path.name)
+        except OSError:
+            pass
+        degrade.record(
+            "fetch.corrupt_quarantined", "aot_executable",
+            f"corrupt serialized executable {path.name} quarantined ({e}); "
+            "recompiling from source",
+            bound_us=0.0,  # full recovery: the program recompiles
+            fix="delete the quarantined entry after diagnosis; the store "
+                "re-populates on the next compile",
+        )
+        return None
+
+
+def _aot_store(label: str, key: str, jfn, args) -> bool:
+    """Export one freshly-compiled program into the artifact store
+    (`jax.export` serialization, atomic replace, LRU prune). Failures
+    only cost the next process a retrace; a program the exporter refuses
+    is tried once per label."""
+    if label in _aot_unserializable:
+        return False
+    path = _aot_path(label, key)
+    if path is None:
+        return False
+    try:
+        from jax import export as _jexport
+
+        _ensure_export_registrations()
+        blob = bytes(_jexport.export(jfn)(*args).serialize())
+        _aot_write_file(path, {"format": _AOT_FORMAT, "key": key,
+                               "label": label, "jax": jax.__version__},
+                        blob)
+        aot_note(label, "exports")
+        perf.add("aot_exports", 1)
+        keep = int(knobs.get("PINT_TPU_AOT_CACHE_KEEP") or 0)
+        if keep > 0:
+            entries = sorted(path.parent.glob("*.aotx"), key=os.path.getmtime)
+            for old in entries[:-keep]:
+                old.unlink(missing_ok=True)
+        return True
+    except Exception as e:  # noqa: BLE001  # jaxlint: disable=silent-except — the artifact store is an optimization; an unserializable program only costs the next process a retrace and the miss is logged once per label
+        _aot_unserializable.add(label)
+        aot_note(label, "export_failures")
+        log.warning(f"could not serialize AOT executable for {label!r}: {e}")
+        return False
+
+
+def _expect_warm_trace(label: str, sig) -> None:
+    """The retrace-zero contract: under ``PINT_TPU_EXPECT_WARM=1`` a
+    TimedProgram that is about to trace+compile (the artifact store had
+    no matching entry) records a ledger-visible ``expect-warm`` violation
+    and raises — a warmed process performs ZERO traces, so any compile
+    event is a warmup-coverage bug, not a performance detail."""
+    if not knobs.flag("PINT_TPU_EXPECT_WARM"):
+        return
+    from pint_tpu.analysis.jaxpr_audit import expect_warm_violation
+
+    expect_warm_violation(
+        label,
+        f"program {label!r} had to trace+compile under "
+        "PINT_TPU_EXPECT_WARM=1 (no serialized executable matched this "
+        "signature) — the warmup profile did not cover this program; "
+        "re-run `pint_tpu warmup` with a matching (model-skeleton, "
+        f"dataset-shape) profile [sig={sig!r}]",
+    )
 
 
 # --- AOT program wrapper ---------------------------------------------------------
@@ -203,21 +562,41 @@ class TimedProgram:
       bytes and peak live buffer bytes per program label — the numbers
       ``python -m pint_tpu.analysis.cost --check`` gates against the
       checked-in budgets.
+    - ``aot_key`` (a string) marks the program AOT-SERIALIZABLE: its
+      closure content is fully described by (label, call signature,
+      source fingerprint, aot_key), so the compiled executable may be
+      exported to / deserialized from the on-disk artifact store when
+      ``PINT_TPU_AOT_EXPORT=1`` (zero-trace warm starts; the
+      ``aot_deserialize_hits`` counter and the ledger's ``aot`` block
+      report the traffic). ``aot_key=None`` (the default) opts out — a
+      program whose closure bakes data the key cannot see (e.g. the
+      memoized MCMC posterior) must never be served cross-process.
     """
 
     __slots__ = ("jfn", "label", "collective_axes", "canonical",
-                 "precision_spec", "_exes", "_lock")
+                 "precision_spec", "aot_key", "_exes", "_disk_sigs",
+                 "_bad_sigs", "_lock")
 
     def __init__(self, jfn, label: str,
                  collective_axes: tuple[str, ...] = (),
                  canonical: bool = True,
-                 precision_spec=None):
+                 precision_spec=None,
+                 aot_key: str | None = None):
         self.jfn = jfn
         self.label = label
         self.collective_axes = tuple(collective_axes)
         self.canonical = canonical
         self.precision_spec = precision_spec
+        self.aot_key = aot_key
         self._exes: dict = {}
+        # sig -> aot_epoch at deserialization time: a persistent-cache
+        # dir change invalidates these handles (never compiled ones)
+        self._disk_sigs: dict = {}
+        # signatures whose AOT executable rejected its operands once
+        # (layout/sharding mismatch): latched sticky so the failing
+        # dispatch is never paid again — one fit.aot_layout_fallback
+        # degradation event, then the plain jit path per call
+        self._bad_sigs: set = set()
         self._lock = threading.Lock()
 
     # deepcopy-atomic, like the bare jit wrappers these replace: model
@@ -231,21 +610,65 @@ class TimedProgram:
 
     def precompile(self, *args) -> None:
         sig = _args_signature(args)
+        self._evict_stale_disk_exes()
         if sig not in self._exes:
             self._compile(sig, args)
+
+    def _evict_stale_disk_exes(self) -> None:
+        """Drop deserialized executable handles loaded under a superseded
+        persistent-cache directory (setup_persistent_cache dir change):
+        the next call re-resolves against the NEW artifact root instead
+        of silently serving an executable from the old one."""
+        if not self._disk_sigs:
+            return
+        epoch = aot_epoch()
+        with self._lock:
+            stale = [s for s, e in self._disk_sigs.items() if e != epoch]
+            for s in stale:
+                self._exes.pop(s, None)
+                self._disk_sigs.pop(s, None)
+
+    def _try_deserialize(self, sig, args):
+        """One artifact-store probe for this (label, signature): the
+        deserialized executable on a full-key hit, else None (the miss is
+        counted — a warmup-coverage gap must be ledger-visible)."""
+        key = _aot_full_key(self.label, sig, self.collective_axes,
+                            self.aot_key)
+        with perf.stage("aot_load"):
+            exe = _aot_load_exe(self.label, key, args)
+        if exe is not None:
+            aot_note(self.label, "hits")
+            perf.add("aot_deserialize_hits", 1)
+        else:
+            aot_note(self.label, "misses")
+            perf.add("aot_deserialize_misses", 1)
+        return exe
 
     def _compile(self, sig, args):
         """(executable, compiled_here): compiled_here is False when another
         thread's in-flight compile of the same signature was waited out —
         that wait is recorded (``compile_wait_s``) so a partially-overlapped
         background precompile shows up in the fit breakdown instead of
-        hiding inside the enclosing stage."""
+        hiding inside the enclosing stage — or when the executable was
+        DESERIALIZED from the artifact store instead of compiled."""
         import time as _time
 
         t0 = _time.perf_counter()
         with self._lock:
             exe = self._exes.get(sig)
+            if exe is None and self.aot_key is not None and aot_enabled():
+                exe = self._try_deserialize(sig, args)
+                if exe is not None:
+                    self._exes[sig] = exe
+                    self._disk_sigs[sig] = aot_epoch()
+                    return exe, False
             if exe is None:
+                # the retrace-zero contract binds HERE: a warmed process
+                # must never reach the trace below
+                _expect_warm_trace(self.label, sig)
+                from pint_tpu.analysis.jaxpr_audit import record_compile
+
+                record_compile(self.label)
                 # trace (host Python, never cached) split from backend
                 # compile (XLA, served from the persistent cache when warm)
                 with perf.stage("trace"):
@@ -279,6 +702,15 @@ class TimedProgram:
                     costmodel.record_program(self.label, closed)
                 with perf.stage("compile"):
                     exe = lowered.compile()
+                    if self.aot_key is not None and aot_enabled():
+                        # export rides the compile stage: the serialize
+                        # cost is compile-shaped work and must stay
+                        # inside the named fit_compile_s attribution
+                        _aot_store(self.label,
+                                   _aot_full_key(self.label, sig,
+                                                 self.collective_axes,
+                                                 self.aot_key),
+                                   self.jfn, args)
                 perf.add(f"compiled:{self.label}", 1)
                 self._exes[sig] = exe
                 return exe, True
@@ -289,26 +721,51 @@ class TimedProgram:
 
     def __call__(self, *args):
         collecting = perf.active()
-        if not self._exes and not collecting:
+        aot = self.aot_key is not None and aot_enabled()
+        if not self._exes and not collecting and not aot:
             return self.jfn(*args)
+        self._evict_stale_disk_exes()
         sig = _args_signature(args)
+        if sig in self._bad_sigs:
+            # sticky layout fallback (one degradation event already
+            # recorded): skip the known-failing AOT dispatch entirely
+            perf.add("aot_fallbacks", 1)
+            out = self.jfn(*args)
+            if collecting:
+                out = jax.block_until_ready(out)
+            return out
         exe = self._exes.get(sig)
         compiled_here = False
         if exe is None:
-            if not collecting:
+            if not collecting and not aot:
                 return self.jfn(*args)
             exe, compiled_here = self._compile(sig, args)
         try:
             out = exe(*args)
             if not compiled_here:
                 # served by an executable compiled BEFORE this call
-                # (precompile overlap or an earlier iteration): the
-                # overlap_engaged breakdown field keys on this
+                # (precompile overlap, a deserialized artifact, or an
+                # earlier iteration): overlap_engaged keys on this
                 perf.add("aot_hits", 1)
-        except Exception:  # jaxlint: disable=silent-except — AOT layout mismatch re-dispatches through jit — counted as aot_fallbacks telemetry
+        except Exception as e:  # jaxlint: disable=silent-except — AOT layout mismatch re-dispatches through jit — latched sticky + one fit.aot_layout_fallback ledger event
             # AOT executables are stricter than jit (layout/sharding of the
-            # exact lowering); any mismatch falls back to the jit path
+            # exact lowering); a mismatch falls back to the jit path,
+            # latched per signature so the failing dispatch is paid ONCE
             perf.add("aot_fallbacks", 1)
+            self._bad_sigs.add(sig)
+            aot_note(self.label, "layout_fallbacks")
+            from pint_tpu.ops import degrade
+
+            degrade.record(
+                "fit.aot_layout_fallback", self.label,
+                "AOT executable rejected its call operands "
+                f"(layout/sharding mismatch: {type(e).__name__}); this "
+                "signature re-dispatches through jit from now on",
+                bound_us=0.0,  # accuracy preserved; dispatch cost degraded
+                fix="re-run pint_tpu warmup on THIS device topology, or "
+                    "clear the AOT artifact dir so the executable is "
+                    "rebuilt for the current layout",
+            )
             out = self.jfn(*args)
         if collecting:
             out = jax.block_until_ready(out)

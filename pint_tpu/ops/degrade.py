@@ -70,6 +70,10 @@ KINDS: dict[str, str] = {
     "fit.incremental_fallback": (
         "an incremental append refit left its staleness envelope; the full "
         "warm refit ran instead"),
+    "fit.aot_layout_fallback": (
+        "an AOT/deserialized executable rejected its call operands "
+        "(layout/sharding mismatch); the signature re-dispatches through "
+        "jit, latched sticky"),
     "fetch.mirror_failed": (
         "a remote file could not be refreshed from any mirror"),
     "fetch.corrupt_quarantined": (

@@ -1,0 +1,143 @@
+"""Workload profiles: named (model-skeleton, dataset-shape) builders.
+
+A *profile* is everything needed to reproduce a workload's program
+signatures without its data: a parfile skeleton, a synthetic-dataset
+builder and the default scan grids. Two consumers must agree on them
+EXACTLY, which is why they live in the package instead of bench.py:
+
+- ``bench.py`` builds its smoke/flagship-shaped benches from these
+  profiles (the telemetry-contract surfaces tier-1 locks);
+- ``pint_tpu warmup`` (pint_tpu/scripts/warmup.py) replays the same
+  profile with the AOT artifact store enabled, so a later process runs
+  the matching workload with ZERO traces — the executables it needs were
+  serialized under the exact (label, signature, topology) keys the
+  profile produces.
+
+A warmed process only deserializes when the signatures match, so any
+drift between the bench's dataset shapes and the warmup's would show up
+as ``expect-warm`` violations in tier-1 (tests/test_aot.py), not as a
+silent cold start.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = [
+    "SMOKE_PAR", "FLAGSHIP_SMOKE_PAR", "RECEIVERS",
+    "flagship_smoke_dataset", "spin_grid", "grid_for",
+]
+
+#: minimal single-receiver smoke par (astrometry + spin + DM): the
+#: --smoke bench fit and the fleet-bench base model
+SMOKE_PAR = """
+PSR SMOKE
+RAJ 04:37:15.9 1
+DECJ -47:15:09.1 1
+F0 173.6879489990983 1
+F1 -1.728e-15 1
+PEPOCH 55000
+POSEPOCH 55000
+DM 2.64 1
+TZRMJD 55000.1
+TZRSITE gbt
+TZRFRQ 1400
+"""
+
+#: NANOGrav-style receivers: (flag value, sub-band frequencies) — the
+#: epoch structure that binds the EFAC/EQUAD/ECORR masks
+RECEIVERS = (
+    ("Rcvr1_2_GUPPI", np.linspace(1150.0, 1850.0, 8)),
+    ("Rcvr_800_GUPPI", np.linspace(722.0, 919.0, 8)),
+)
+
+#: flagship-shaped smoke par: every major component family the J0740
+#: flagship model engages — astrometry incl. parallax/proper motion, spin,
+#: dispersion + derivative, an ELL1 binary, and the EFAC/EQUAD/ECORR
+#: noise masks bound to the NANOGrav-style receiver flags
+FLAGSHIP_SMOKE_PAR = """
+PSR FLAGSMOKE
+RAJ 07:40:45.79 1
+DECJ 66:20:33.6 1
+PMRA -9.9 1
+PMDEC -33.0 1
+PX 0.4 1
+F0 346.531996 1
+F1 -1.46e-15 1
+PEPOCH 57000
+POSEPOCH 57000
+DM 14.96 1
+DM1 0.0 1
+DMEPOCH 57000
+BINARY ELL1
+PB 4.766944 1
+A1 3.9775561 1
+TASC 56999.1 1
+EPS1 -5.7e-6 1
+EPS2 -1.4e-5 1
+M2 0.26
+SINI 0.99
+EFAC -f Rcvr1_2_GUPPI 1.02
+EQUAD -f Rcvr1_2_GUPPI 0.01
+ECORR -f Rcvr1_2_GUPPI 0.01
+EFAC -f Rcvr_800_GUPPI 1.03
+EQUAD -f Rcvr_800_GUPPI 0.01
+ECORR -f Rcvr_800_GUPPI 0.01
+TZRMJD 57000.1
+TZRSITE gbt
+TZRFRQ 1400
+"""
+
+
+def flagship_smoke_dataset(ntoas: int, seed: int = 17):
+    """(model, toas): J0740-shaped synthetic set at reduced N — sub-band
+    epoch structure, receiver flags binding every noise mask, all model
+    components live. Shapes (and therefore every program signature)
+    depend only on ``ntoas``; the noise draw only changes values."""
+    from pint_tpu.io.par import parse_parfile
+    from pint_tpu.models.builder import build_model
+    from pint_tpu.simulation import make_fake_toas_fromMJDs
+
+    model = build_model(parse_parfile(FLAGSHIP_SMOKE_PAR, from_text=True))
+    per_epoch = len(RECEIVERS[0][1])
+    n_epochs = max(ntoas // per_epoch, 2)
+    epoch_mjds = np.linspace(56650.0, 57350.0, n_epochs)
+    mjds, freqs, flags = [], [], []
+    for i, emjd in enumerate(epoch_mjds):
+        fname, subbands = RECEIVERS[i % len(RECEIVERS)]
+        for j, f in enumerate(subbands):
+            mjds.append(emjd + j * 0.1 / 86400.0)
+            freqs.append(f)
+            flags.append({"f": fname, "fe": fname.split("_GUPPI")[0]})
+    toas = make_fake_toas_fromMJDs(
+        np.array(mjds), model, obs="gbt", freq_mhz=np.array(freqs),
+        error_us=1.0, flags=flags, add_noise=True,
+        rng=np.random.default_rng(seed),
+    )
+    return model, toas
+
+
+def spin_grid(model, ftr):
+    """3x3 (F0, F1) grid around the model values, +-1 sigma when the
+    fitter has uncertainties (it may not have run yet). Grid VALUES are
+    data-dependent; grid SHAPES (what a program signature sees) are not."""
+    f0 = float(np.asarray(model.params["F0"].hi))  # jaxlint: disable=dd-truncate — grid CENTER only; a 1-sigma scan window needs f64, not dd64
+    f1 = float(np.asarray(model.params["F1"].hi))  # jaxlint: disable=dd-truncate — grid CENTER only; a 1-sigma scan window needs f64, not dd64
+    unc = ftr.result.uncertainties if ftr.result is not None else {}
+    s0 = unc.get("F0") or 1e-10
+    s1 = unc.get("F1") or 1e-18
+    return ("F0", "F1"), (
+        np.linspace(f0 - s0, f0 + s0, 3),
+        np.linspace(f1 - s1, f1 + s1, 3),
+    )
+
+
+def grid_for(model, ftr):
+    """The reference 3x3 (M2, SINI) grid (bench_chisq_grid_WLSFitter.py:
+    33-34) or a spin-term fallback for non-binary pars."""
+    if "M2" in model.param_meta and "SINI" in model.param_meta:
+        return ("M2", "SINI"), (
+            np.linspace(0.20, 0.30, 3),
+            np.sin(np.deg2rad(np.linspace(86.25, 88.5, 3))),
+        )
+    return spin_grid(model, ftr)

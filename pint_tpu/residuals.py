@@ -87,8 +87,12 @@ def get_resid_fn(model: TimingModel, subtract_mean: bool):
         # program too: the downhill loops call it once per damping trial,
         # and on the flagship it was the compile the background overlap
         # never covered (the r5 91 s first-fit wall)
-        cache[key] = TimedProgram(precision_jit(fn), "resid",
-                                  precision_spec=model.xprec.name)
+        cache[key] = TimedProgram(
+            precision_jit(fn), "resid",
+            precision_spec=model.xprec.name,
+            # closure = model structure + the mean-subtraction flag:
+            # serializable for zero-trace warm starts (ops/compile.py)
+            aot_key=f"{model.aot_structure_key()}|mean={subtract_mean}")
     return cache[key]
 
 

@@ -1,0 +1,50 @@
+"""The `pint_tpu` umbrella command: subcommand dispatch.
+
+Currently:
+
+- ``pint_tpu warmup`` — prefetch every startup artifact for a workload
+  profile (pint_tpu/scripts/warmup.py): prepared TOAs, kernel packs,
+  serialized AOT executables, warm-start fitter state.
+- ``pint_tpu knobs`` — print the sanctioned environment-knob inventory
+  (pint_tpu/utils/knobs.py).
+
+Single-purpose tools (pintempo, zima, ...) keep their own entry points;
+this command exists for operational verbs that act on the installation
+rather than on one dataset.
+"""
+
+from __future__ import annotations
+
+import sys
+
+_USAGE = """usage: pint_tpu <command> [args...]
+
+commands:
+  warmup   prefetch every startup artifact for a workload profile
+           (zero-trace warm starts; see `pint_tpu warmup --help`)
+  knobs    print the environment-knob inventory
+"""
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if not argv or argv[0] in ("-h", "--help"):
+        print(_USAGE, end="")
+        return 0
+    cmd, rest = argv[0], argv[1:]
+    if cmd == "warmup":
+        from pint_tpu.scripts.warmup import main as warmup_main
+
+        return warmup_main(rest)
+    if cmd == "knobs":
+        from pint_tpu.utils import knobs
+
+        print(knobs.describe())
+        return 0
+    print(f"pint_tpu: unknown command {cmd!r}\n{_USAGE}", end="",
+          file=sys.stderr)
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

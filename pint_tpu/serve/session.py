@@ -106,7 +106,17 @@ class TimingSession:
     def precompile(self, background: bool = False):
         """AOT-warm the session's full-fit programs (the incremental
         blocks/chi² programs compile on the first append of each bucket
-        signature and persist in the XLA disk cache)."""
+        signature and persist in the XLA disk cache).
+
+        With ``PINT_TPU_AOT_EXPORT=1`` this never traces in a warmed
+        process: every fit/append program is an AOT-eligible
+        ``TimedProgram`` (ops/compile.py ``aot_key=``), so a session
+        migrated across processes — `pint_tpu warmup`, or a prior
+        process of the same fleet — deserializes its executables from
+        the artifact store and restores its solution from the
+        ``FitterState`` snapshot (fitting/state.py): the item-3
+        cross-process session migration pays disk reads, not compiles.
+        ``stats()["aot"]`` reports the deserialize/compile traffic."""
         return self.fitter.precompile(background=background)
 
     # -- serving -------------------------------------------------------------------
@@ -168,10 +178,19 @@ class TimingSession:
         paths: dict[str, int] = {}
         for h in self.history:
             paths[h.path] = paths.get(h.path, 0) + 1
+        from pint_tpu.ops.compile import aot_block
+
+        blk = aot_block()
         out = {
             "n_requests": len(self.history),
             "paths": paths,
             "n_toas": len(self.toas),
+            # serialized-executable traffic (process-wide): a session
+            # fleet warmed by `pint_tpu warmup` serves from deserialized
+            # executables — hits > 0 and zero compiles on the warm path
+            "aot": {"deserialize_hits": blk["deserialize_hits"],
+                    "deserialize_misses": blk["deserialize_misses"],
+                    "enabled": blk["enabled"]},
         }
         if lat.size:
             out.update(

@@ -36,6 +36,9 @@ KNOBS: dict[str, tuple[str | None, str]] = {
     "PINT_TPU_COMPILE_CACHE": (None, "legacy knob: persistent-cache dir override, 0 disables"),
     "PINT_TPU_XLA_CACHE": ("1", "0: disable the persistent XLA compilation cache"),
     "PINT_TPU_XLA_CACHE_DIR": (None, "persistent XLA cache directory override"),
+    "PINT_TPU_AOT_EXPORT": ("0", "1: AOT-eligible programs round-trip their compiled executables through the on-disk artifact store (zero-trace warm starts; pint_tpu warmup populates it)"),
+    "PINT_TPU_AOT_CACHE_KEEP": ("128", "serialized-executable artifacts kept (oldest pruned)"),
+    "PINT_TPU_EXPECT_WARM": ("0", "1: retrace-zero contract — any TimedProgram trace/compile escalates to a strict audit failure (implies AOT deserialization)"),
     # --- program audit (pint_tpu/analysis/) ------------------------------------
     "PINT_TPU_AUDIT": ("warn", "jaxpr auditor mode: warn (default), strict (raise), 0 (off)"),
     "PINT_TPU_AUDIT_CONST_BYTES": ("262144", "large-constant-capture audit threshold in bytes"),

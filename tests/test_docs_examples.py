@@ -108,8 +108,10 @@ def test_performance_walkthrough_runs(tmp_path, monkeypatch):
     assert len(blocks) >= 5, "PERFORMANCE.md lost its executable blocks"
     monkeypatch.chdir(tmp_path)
     for var in ("PINT_TPU_CACHE_DIR", "PINT_TPU_NBODY",
-                "PINT_TPU_WARM_START"):
+                "PINT_TPU_WARM_START", "PINT_TPU_AOT_EXPORT",
+                "PINT_TPU_EXPECT_WARM"):
         monkeypatch.delenv(var, raising=False)
+    from pint_tpu.ops import compile as pcompile
     from pint_tpu.ops import perf
 
     ns: dict = {}
@@ -123,6 +125,12 @@ def test_performance_walkthrough_runs(tmp_path, monkeypatch):
                     f"{type(e).__name__}: {e}\n{block}")
     finally:
         perf.enable(False)
+        # §7 re-points the persistent cache + AOT store into the
+        # walkthrough dir: undo the env FIRST, then re-resolve, so the
+        # suite continues against the default cache root
+        monkeypatch.undo()
+        pcompile.reset_aot_stats()
+        pcompile.setup_persistent_cache(force=True)
 
 
 def test_analysis_walkthrough_runs(tmp_path, monkeypatch):
