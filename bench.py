@@ -671,6 +671,7 @@ def _noise_bench_core(ntoas: int, n_evals: int, n_chains: int, nsteps: int,
         "noise_hyper": list(nl.hyper),
         "n_evals": n_evals,
         "n_chains": n_chains,
+        "chain_kernel": "hmc",
         "chain_steps": nsteps,
         "chain_warmup": warmup,
         "chain_accept_frac": round(chains.accept_frac, 3),
@@ -707,6 +708,129 @@ def bench_noise(emit, ntoas: int | None = None) -> None:
     rec["value"] = rec["noise_loglike_evals_per_sec_per_chip"]
     rec["unit"] = "evals/s/chip"
     rec["vs_baseline"] = rec["noise_vs_baseline"]
+    emit(rec)
+
+
+def _pta_bench_core(n_pulsars: int, ntoas: int, n_evals: int,
+                    n_chains: int, nsteps: int, warmup: int,
+                    baseline_evals: int, sharded: bool = True,
+                    kernel: str = "hmc") -> dict:
+    """The joint-PTA bench: fused HD-coupled joint likelihood evaluations
+    + vmapped joint chains vs the per-pulsar host-loop + dense-joint
+    baseline.
+
+    Fused side: E joint hyperparameter points through ONE vmapped device
+    program (fitting/pta_like.py — per-pulsar Woodbury blocks on the
+    batch axis, one psum, a small replicated coupling solve), compile
+    included. Baseline side: the pre-fused shape — the O((N T)^3)
+    dense-joint covariance program (`dense_joint_program`, jitted once)
+    dispatched one host call per point, exactly what a host loop over a
+    materialized joint covariance pays — compile included on both sides.
+    """
+    import copy
+
+    import jax
+    import jax.numpy as jnp
+
+    import pint_tpu.distributed as dist
+    from pint_tpu import profiles
+    from pint_tpu.fitting.noise_like import NoiseLikelihood
+    from pint_tpu.fitting.pta_like import PTALikelihood
+    from pint_tpu.ops import perf
+
+    models, toas_list = profiles.pta_smoke_array(n_pulsars, ntoas)
+    mesh = dist.pta_mesh(n_pulsars) if sharded else None
+    n_shards = 1 if mesh is None else int(dict(mesh.shape)["batch"])
+    rec: dict = {
+        "n_pulsars": n_pulsars,
+        "ntoas_per_pulsar": len(toas_list[0]),
+        "backend": jax.default_backend(),
+        "n_devices": len(jax.devices()),
+        "pta_batch_shards": n_shards,
+        "pta_pulsars_per_chip": round(n_pulsars / n_shards, 2),
+    }
+    rng = np.random.default_rng(43)
+    with perf.collect() as rep:
+        t0 = time.time()
+        members = [NoiseLikelihood(t, copy.deepcopy(m))
+                   for t, m in zip(toas_list, models)]
+        pta = PTALikelihood(members, mesh=mesh)
+        # modest Laplace-scaled perturbations around the injected values
+        # — the surface a joint sampler actually evaluates
+        scales = 0.02 * pta.scales
+        etas = pta.x0 + scales * rng.standard_normal(
+            (n_evals, pta.nparams))
+        pta.loglike_many(etas)
+        pta.grad(pta.x0)
+        fused_wall = time.time() - t0
+        t0 = time.time()
+        chains = pta.sample(n_chains=n_chains, nsteps=nsteps,
+                            warmup=warmup, kernel=kernel, seed=5)
+        chain_wall = time.time() - t0
+    breakdown = perf.pta_breakdown(rep)
+
+    # the dense-joint host-loop baseline (compile included): one dispatch
+    # per point through the materialized (N T) x (N T) covariance
+    dense = pta.dense_joint_program()
+    deltas = pta.x0 + 0.3 * scales * rng.standard_normal(
+        (baseline_evals, pta.nparams))
+    t0 = time.time()
+    for d in deltas:
+        float(dense(jnp.asarray(d), pta._params0, pta._plain_data))
+    base_wall = time.time() - t0
+    base_eps = baseline_evals / base_wall
+
+    fused_eps = (n_evals + 1) / fused_wall
+    steps_ps = breakdown["pta_chain_steps"] / chain_wall
+    rhat = chains.rhat()
+    rec.update({
+        "gwb_loglike_evals_per_sec_per_chip": round(fused_eps, 2),
+        "gwb_vs_dense_baseline": round(fused_eps / base_eps, 2),
+        "pta_chain_steps_per_sec_per_chip": round(steps_ps, 2),
+        "pta_hyper_dim": pta.nparams,
+        "gw_modes": 2 * pta.gw_comp.nf,
+        "n_evals": n_evals,
+        "n_chains": n_chains,
+        "chain_kernel": kernel,
+        "chain_steps": nsteps,
+        "chain_warmup": warmup,
+        "chain_accept_frac": round(chains.accept_frac, 3),
+        "chain_divergences": chains.divergences,
+        "chain_rhat_max": round(float(np.max(rhat)), 4),
+        "fused_eval_wall_s": round(fused_wall, 3),
+        "chain_wall_s": round(chain_wall, 3),
+        "baseline_evals": baseline_evals,
+        "baseline_evals_per_sec": round(base_eps, 2),
+        "baseline": "host-loop dense-joint Cholesky likelihood (jitted "
+                    "once, one dispatch per point, compile included on "
+                    "both sides)",
+    })
+    rec.update(breakdown)
+    try:
+        from pint_tpu.analysis.jaxpr_audit import audit_block
+
+        rec["audit"] = audit_block()
+    except Exception:  # noqa: BLE001 — telemetry only  # jaxlint: disable=silent-except — telemetry assembly
+        rec["audit"] = None
+    rec["degradation_count"] = _degradation_count()
+    rec["degradation_kinds"] = _degradation_kinds()
+    return rec
+
+
+def bench_pta(emit, n_pulsars: int | None = None,
+              ntoas: int | None = None) -> None:
+    """Full joint-PTA bench for the flagship record (self-contained
+    synthetic array; PINT_TPU_BENCH_PTA_PULSARS / _NTOAS override)."""
+    if n_pulsars is None:
+        n_pulsars = int(os.environ.get("PINT_TPU_BENCH_PTA_PULSARS", "8"))
+    if ntoas is None:
+        ntoas = int(os.environ.get("PINT_TPU_BENCH_PTA_NTOAS", "500"))
+    rec = _pta_bench_core(n_pulsars, ntoas, n_evals=512, n_chains=4,
+                          nsteps=300, warmup=150, baseline_evals=8)
+    rec["metric"] = "gwb_loglike_evals_per_sec_per_chip"
+    rec["value"] = rec["gwb_loglike_evals_per_sec_per_chip"]
+    rec["unit"] = "evals/s/chip"
+    rec["vs_baseline"] = rec["gwb_vs_dense_baseline"]
     emit(rec)
 
 
@@ -821,6 +945,12 @@ def main() -> None:
         bench_noise(emit)
     except Exception as e:
         print(f"noise bench failed: {e}", file=sys.stderr)
+
+    # --- 1c. Joint PTA likelihood (fitting/pta_like.py) ----------------------
+    try:
+        bench_pta(emit)
+    except Exception as e:
+        print(f"pta bench failed: {e}", file=sys.stderr)
 
     # --- shared J0740-scale dataset -----------------------------------------
     # Setup degrades instead of dying: a failure at the full TOA count falls
@@ -1089,6 +1219,18 @@ def main() -> None:
         "noise_chain_steps_per_sec_per_chip": (
             records.get("noise_loglike_evals_per_sec_per_chip") or {}
         ).get("noise_chain_steps_per_sec_per_chip"),
+        # joint PTA likelihood (fitting/pta_like.py): fused HD-coupled
+        # joint GWB likelihood throughput + pulsars-per-chip scaling,
+        # folded in as TOP-LEVEL headline fields
+        "gwb_loglike_evals_per_sec_per_chip": (
+            records.get("gwb_loglike_evals_per_sec_per_chip") or {}
+        ).get("value"),
+        "gwb_vs_dense_baseline": (
+            records.get("gwb_loglike_evals_per_sec_per_chip") or {}
+        ).get("vs_baseline"),
+        "pta_pulsars_per_chip": (
+            records.get("gwb_loglike_evals_per_sec_per_chip") or {}
+        ).get("pta_pulsars_per_chip"),
         "toa_load_seconds": (records.get("toa_load_seconds") or {}).get("value"),
         # fleet-fitting figures (fitting/batch.py) folded in as TOP-LEVEL
         # fields so the single-last-line driver record carries the
@@ -1424,6 +1566,37 @@ def smoke_noise_bench(ntoas: int = 220, n_evals: int = 8192,
     return rec
 
 
+def smoke_pta_bench(n_pulsars: int = 4, ntoas: int = 96,
+                    n_evals: int = 1024, n_chains: int = 2,
+                    nsteps: int = 25, warmup: int = 15,
+                    baseline_evals: int = 8,
+                    kernel: str = "hmc") -> dict:
+    """CPU joint-PTA smoke bench: the fused Hellings-Downs joint GWB
+    likelihood (fitting/pta_like.py) evaluated E times in ONE vmapped
+    program plus C vmapped joint HMC chains, vs the host-loop
+    dense-joint Cholesky baseline — compile included on both sides. On a
+    multi-device backend (the tier-1 virtual mesh included) the fused
+    side shards pulsars over a batch-axis mesh (distributed.pta_mesh),
+    so the batch-axis psum placement is part of the audited surface.
+
+    This is the joint-PTA telemetry CONTRACT surface: tier-1
+    (tests/test_pta.py) asserts the `pta_breakdown` fields name >= 90%
+    of the pta wall, the jaxpr audit is strict-clean over every pta
+    program (ddflow + collective placement on the batch-axis psum), the
+    degradation ledger stays empty under PINT_TPU_DEGRADED=error, and
+    `gwb_vs_dense_baseline` clears the >= 5x acceptance bar. Run from
+    the CLI with ``python bench.py --smoke --pta`` (prints one JSON
+    line).
+    """
+    from pint_tpu.ops.compile import setup_persistent_cache
+
+    setup_persistent_cache()
+    rec = _pta_bench_core(n_pulsars, ntoas, n_evals, n_chains, nsteps,
+                          warmup, baseline_evals, kernel=kernel)
+    rec["metric"] = "smoke_pta_bench"
+    return rec
+
+
 def smoke_session_bench(ntoas: int = 700, n_appends: int = 10, k: int = 8,
                         n_full: int = 2) -> dict:
     """CPU timing-session smoke bench: a replayed append trace against a
@@ -1664,6 +1837,18 @@ if __name__ == "__main__":
             sys.exit(0)
         if noise:
             print(json.dumps(smoke_noise_bench()), flush=True)
+            sys.exit(0)
+        if "--pta" in sys.argv:
+            # the joint-PTA smoke shards pulsars over a batch-axis mesh
+            # when devices allow: force the virtual multi-device CPU
+            # layout so the psum placement is exercised on a 1-chip host
+            flags = os.environ.get("XLA_FLAGS", "")
+            if "xla_force_host_platform_device_count" not in flags:
+                os.environ["XLA_FLAGS"] = (
+                    flags + " --xla_force_host_platform_device_count=8"
+                ).strip()
+            os.environ.setdefault("JAX_PLATFORMS", "cpu")
+            print(json.dumps(smoke_pta_bench()), flush=True)
             sys.exit(0)
         if sharded or batched:
             # must precede the first jax import: the sharded/batched smoke

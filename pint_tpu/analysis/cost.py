@@ -44,7 +44,8 @@ BUDGET_PATH = Path(__file__).resolve().parent / "cost_budgets.json"
 #: canonical dataset shapes — budgets are pinned at these; changing them
 #: is a budget regen, not a silent re-baseline
 CANON = {"ntoas": 60, "noise_ntoas": 48, "batch": 3, "grid_pts": 4,
-         "chain_steps": 8, "chain_warmup": 4, "seed": 7, "incr_k": 8}
+         "chain_steps": 8, "chain_warmup": 4, "seed": 7, "incr_k": 8,
+         "pta_psrs": 2, "pta_ntoas": 20}
 
 _WLS_PAR = """
 PSR COST
@@ -252,6 +253,31 @@ def _build_noise_chain(nl=None):
                               nl._plain_data))
 
 
+def _pta_likelihood():
+    """Canonical tiny joint-PTA array (trace-only pricing: the jaxpr —
+    and so the static cost — depends only on (n_pulsars, rows, modes))."""
+    import copy
+
+    from pint_tpu import profiles
+    from pint_tpu.fitting.noise_like import NoiseLikelihood
+    from pint_tpu.fitting.pta_like import PTALikelihood
+
+    models, toas_list = profiles.pta_smoke_array(
+        CANON["pta_psrs"], CANON["pta_ntoas"], seed=CANON["seed"])
+    members = [NoiseLikelihood(t, copy.deepcopy(m))
+               for t, m in zip(toas_list, models)]
+    return PTALikelihood(members)
+
+
+def _build_pta_loglike():
+    import jax.numpy as jnp
+
+    pta = _pta_likelihood()
+    eta = jnp.asarray(pta.x0)
+    return _trace_cost(pta._programs.loglike,
+                       (eta, pta._params0, pta.data))
+
+
 def build_headline_costs(verbose=print) -> dict[str, dict]:
     """{label: cost record} for every headline program at the canonical
     shapes. Raises on any builder failure — coverage is the contract."""
@@ -268,6 +294,7 @@ def build_headline_costs(verbose=print) -> dict[str, dict]:
         ("kernel-pack eval", _build_kernel_eval),
         ("noise loglike", lambda: _build_noise_loglike(nl)),
         ("noise chain", lambda: _build_noise_chain(nl)),
+        ("pta loglike", _build_pta_loglike),
     ):
         if name == "noise loglike" and nl is None:
             nl = _noise_likelihood()

@@ -37,7 +37,7 @@ from pint_tpu.utils.logging import get_logger
 log = get_logger("pint_tpu.distributed")
 
 __all__ = ["batch_fit_mesh", "initialize", "fit_mesh", "global_mesh",
-           "process_info"]
+           "process_info", "pta_mesh"]
 
 
 def _init_args(
@@ -231,6 +231,29 @@ def batch_fit_mesh(devices=None, batch_axis: str = "batch",
     elif toa is None:
         toa = -1
     return global_mesh({batch_axis: batch, toa_axis: toa}, devices=devs)
+
+
+def pta_mesh(n_pulsars: int, devices=None, batch_axis: str = "batch"):
+    """Batch-axis mesh for the joint PTA likelihood (fitting/pta_like.py).
+
+    The joint program shards PULSARS over the batch axis (per-pulsar
+    Woodbury work is embarrassingly parallel; one psum completes the
+    small coupling blocks), so the shard count must divide the pulsar
+    count: this picks the LARGEST S <= device count with S | n_pulsars
+    and lays the mesh over the first S global devices — on a multi-host
+    pod (`initialize()` first) that takes N past one chip. Returns None
+    when only one shard fits, so callers pass the result straight to
+    ``PTALikelihood(mesh=...)`` and get the identical single-device
+    program on one chip."""
+    import jax
+
+    devs = list(devices if devices is not None else jax.devices())
+    s = max(min(len(devs), int(n_pulsars)), 1)
+    while s > 1 and n_pulsars % s:
+        s -= 1
+    if s < 2:
+        return None
+    return global_mesh({batch_axis: s}, devices=devs[:s])
 
 
 def process_info() -> dict:

@@ -19,6 +19,7 @@ from pint_tpu.fitting.noise_like import (  # noqa: F401
     noise_param_names,
     split_rhat,
 )
+from pint_tpu.fitting.pta_like import PTALikelihood  # noqa: F401
 
 
 def fit_auto(toas, model, downhill: bool = True, mesh=None,

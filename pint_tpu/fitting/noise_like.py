@@ -109,9 +109,9 @@ def default_noise_priors(model, hyper: tuple[str, ...]) -> dict:
             out[n] = UniformPrior(0.01, 10.0)
         elif base in ("EQUAD", "T2EQUAD", "ECORR", "TNECORR"):
             out[n] = UniformPrior(0.0, 1e-4)
-        elif base in ("TNREDAMP", "TNDMAMP"):
+        elif base in ("TNREDAMP", "TNDMAMP", "TNGWAMP"):
             out[n] = UniformPrior(-20.0, -8.0)
-        elif base in ("TNREDGAM", "TNDMGAM"):
+        elif base in ("TNREDGAM", "TNDMGAM", "TNGWGAM"):
             out[n] = UniformPrior(0.0, 7.0)
         else:
             out[n] = UniformPrior()
@@ -203,7 +203,347 @@ def _wrap_sharded(fn, mesh, axis, specs, out_spec):
     )
 
 
-class NoiseLikelihood:
+class MarginalizedPosterior:
+    """Shared evaluation / optimization / sampling surface over one
+    hyperparameter-marginalized likelihood.
+
+    Everything above the likelihood kernel is generic: prior composition,
+    the bucketed vmapped batch evaluator, gradients, batched Adam
+    restarts, Laplace-scale estimation and the vmapped HMC/stretch chain
+    fleets. Subclasses build the data layout + compiled ``_ProgramSet``
+    and set the attribute contract:
+
+    - ``STAGE`` / ``LABEL``: the perf-stage root and program-label/counter
+      prefix (``"noise"`` for the single-pulsar engine, ``"pta"`` for the
+      joint HD-coupled array, fitting/pta_like.py);
+    - ``hyper`` (coordinate names), ``priors`` ({name: prior}),
+      ``scales`` / ``x0`` (np arrays), ``model`` (a TimingModel for the
+      precision backend + AOT structure key), ``_params0``, ``data`` /
+      ``_plain_data`` (program operands; ``_plain_data`` is the
+      replicated layout the chain/optimizer/Hessian programs consume),
+      ``_programs`` (a ``_ProgramSet``), ``_loglike_traced`` (un-jitted
+      likelihood core for chain/optimizer composition), and the
+      ``_aot_base()`` / ``_aot_priors()`` fingerprints.
+    """
+
+    STAGE = "noise"
+    LABEL = "noise"
+
+    # --- prior / posterior ------------------------------------------------------
+
+    def lnprior(self, eta):
+        lp = 0.0
+        for i, n in enumerate(self.hyper):
+            lp = lp + self.priors[n].logpdf(eta[i])
+        return lp
+
+    def _lnpost_traced(self, eta, params0, data):
+        """Traceable (eta, params0, data) -> ln posterior — the closure
+        the chain kernels and vmapped optimizers compose over."""
+        lp = self.lnprior(eta)
+        ll = jnp.where(jnp.isfinite(lp),
+                       self._loglike_traced(eta, params0, data), 0.0)
+        return lp + ll
+
+    # --- public evaluation surfaces ----------------------------------------------
+
+    @property
+    def nparams(self) -> int:
+        return len(self.hyper)
+
+    def loglike(self, eta) -> float:
+        """Marginalized ln-likelihood at one hyperparameter vector."""
+        with perf.stage(self.STAGE):
+            with perf.stage("eval"):
+                out = self._programs.loglike(
+                    jnp.asarray(eta, jnp.float64), self._params0, self.data)
+        perf.add(f"{self.LABEL}_loglike_evals", 1)
+        return float(out)
+
+    #: vmapped-eval bucket: loglike_many pads E up to multiples of this
+    #: (power-of-two floored below it for small E), so ONE compiled batch
+    #: program serves every request size — the fitting/batch.py bucket
+    #: contract, enforced by the batch-retrace audit pass
+    EVAL_CHUNK = 256
+
+    def loglike_many(self, etas, chunk: int | None = None) -> np.ndarray:
+        """Vectorized ln-likelihood over (E, h) hyperparameter rows.
+
+        Evaluations ride a bucket-padded vmapped program: E points cost
+        ceil(E/chunk) device dispatches and at most ONE compile per
+        process (pad rows repeat the last point and are dropped)."""
+        etas = np.asarray(etas, np.float64)
+        E = etas.shape[0]
+        if chunk is None:
+            chunk = self.EVAL_CHUNK
+            while chunk >= 2 * max(E, 1):
+                chunk //= 2
+        n_pad = (-E) % chunk
+        if n_pad:
+            etas = np.concatenate([etas, np.repeat(etas[-1:], n_pad, 0)])
+        outs = []
+        with perf.stage(self.STAGE):
+            with perf.stage("eval"):
+                for k in range(0, etas.shape[0], chunk):
+                    outs.append(self._programs.loglike_batch(
+                        jnp.asarray(etas[k:k + chunk]), self._params0,
+                        self.data))
+        perf.add(f"{self.LABEL}_loglike_evals", E)
+        return np.concatenate([np.asarray(o) for o in outs])[:E]
+
+    def grad(self, eta) -> np.ndarray:
+        """d lnL / d eta (the surface NUTS/HMC and the ML optimizer ride)."""
+        with perf.stage(self.STAGE):
+            with perf.stage("eval"):
+                out = self._programs.grad(
+                    jnp.asarray(eta, jnp.float64), self._params0, self.data)
+        perf.add(f"{self.LABEL}_loglike_evals", 1)
+        return np.asarray(out)
+
+    def precompile(self) -> None:
+        """AOT-compile every likelihood surface (overlap contract)."""
+        eta = jnp.asarray(self.x0, jnp.float64)
+        self._programs.loglike.precompile(eta, self._params0, self.data)
+        self._programs.grad.precompile(eta, self._params0, self.data)
+
+    # --- batched optimizer restarts ----------------------------------------------
+
+    def optimize(self, n_restarts: int | None = None, n_steps: int = 200,
+                 lr: float = 0.05, seed: int = 0):
+        """Maximum-likelihood hyperparameters by R vmapped Adam restarts
+        (arXiv:2405.01977's downhill shape, batched): R starting points —
+        the current values plus prior-scaled perturbations — advance as
+        ONE `lax.scan` device program in the prior-scaled coordinates;
+        the best final point wins. Returns (eta_hat, lnpost_at_hat)."""
+        if n_restarts is None:
+            n_restarts = int(knobs.get("PINT_TPU_NOISE_RESTARTS") or 8)
+        lnpost = self._lnpost_traced
+        scales = jnp.asarray(self.scales)
+        center = jnp.asarray(self.x0)
+
+        def neg(z, params0, data):
+            return -lnpost(center + z * scales, params0, data)
+
+        vg = jax.value_and_grad(neg)
+        b1, b2, eps = 0.9, 0.999, 1e-8
+
+        def run(z0, params0, data):
+            def step(carry, t):
+                z, m, v, best_z, best_f = carry
+                f, g = vg(z, params0, data)
+                g = jnp.where(jnp.isfinite(g), g, 0.0)
+                m = b1 * m + (1 - b1) * g
+                v = b2 * v + (1 - b2) * g * g
+                mh = m / (1 - b1 ** (t + 1.0))
+                vh = v / (1 - b2 ** (t + 1.0))
+                z_new = z - lr * mh / (jnp.sqrt(vh) + eps)
+                better = jnp.isfinite(f) & (f < best_f)
+                best_z = jnp.where(better, z, best_z)
+                best_f = jnp.where(better, f, best_f)
+                return (z_new, m, v, best_z, best_f), None
+
+            init = (z0, jnp.zeros_like(z0), jnp.zeros_like(z0), z0,
+                    jnp.asarray(jnp.inf, jnp.float64))
+            (z, _, _, best_z, best_f), _ = jax.lax.scan(
+                step, init, jnp.arange(n_steps, dtype=jnp.float64))
+            f_end = neg(z, params0, data)
+            better = jnp.isfinite(f_end) & (f_end < best_f)
+            return (jnp.where(better, z, best_z),
+                    jnp.where(better, f_end, best_f))
+
+        vrun = jax.vmap(run, in_axes=(0, None, None))
+        from pint_tpu.ops.compile import TimedProgram, precision_jit
+
+        # the optimizer closure bakes the CENTER/SCALE values (x0, prior
+        # scales) and the Adam schedule: all of it lands in the aot_key so
+        # a serialized executable can never serve a different start point
+        import hashlib as _hashlib
+
+        cs = _hashlib.sha256(
+            np.asarray(self.x0).tobytes()
+            + np.asarray(self.scales).tobytes()).hexdigest()[:16]
+        prog = self.__dict__.setdefault(
+            "_opt_prog",
+            TimedProgram(precision_jit(vrun), f"{self.LABEL}_optimize",
+                         precision_spec=self.model.xprec.name,
+                         aot_key=(f"{self._aot_base()}|"
+                                  f"priors={self._aot_priors()}|"
+                                  f"opt={n_steps},{lr!r}|cs={cs}")))
+        rng = np.random.default_rng(seed)
+        z0 = np.zeros((n_restarts, self.nparams))
+        z0[1:] = rng.standard_normal((n_restarts - 1, self.nparams))
+        with perf.stage(self.STAGE):
+            with perf.stage("optimize"):
+                zs, fs = prog(jnp.asarray(z0), self._params0,
+                              self._plain_data)
+        perf.add(f"{self.LABEL}_loglike_evals", n_restarts * (n_steps + 1))
+        fs = np.asarray(fs)
+        best = int(np.nanargmin(fs))
+        eta = self.x0 + np.asarray(zs)[best] * self.scales
+        return eta, float(-fs[best])
+
+    # --- device-resident chains --------------------------------------------------
+
+    def laplace_scales(self) -> np.ndarray:
+        """Per-hyperparameter posterior scales from the Laplace
+        approximation at the current values: 1/sqrt(-d2 lnpost / d eta2)
+        on the Hessian diagonal, falling back to the prior-window scale
+        where the curvature is non-positive or non-finite. These are the
+        HMC mass matrix / restart-ball scales — prior widths alone
+        mis-condition the kernel by orders of magnitude (an EQUAD prior
+        spans 100 us while its posterior is sub-us)."""
+        cached = self.__dict__.get("_laplace_scales")
+        if cached is not None:
+            return cached
+        from pint_tpu.ops.compile import TimedProgram, precision_jit
+
+        hess = jax.hessian(self._lnpost_traced)
+        prog = TimedProgram(precision_jit(hess),
+                            f"{self.LABEL}_laplace_hessian",
+                            precision_spec=self.model.xprec.name,
+                            # lnpost closure = structure + priors; the
+                            # evaluation point rides the argument list
+                            aot_key=(f"{self._aot_base()}|"
+                                     f"priors={self._aot_priors()}|hessian"))
+        with perf.stage(self.STAGE):
+            with perf.stage("build"):
+                H = np.asarray(prog(jnp.asarray(self.x0), self._params0,
+                                    self._plain_data))
+        d = -np.diag(H)
+        good = np.isfinite(d) & (d > 0)
+        out = np.where(good, 1.0 / np.sqrt(np.where(good, d, 1.0)),
+                       self.scales)
+        # a curvature scale beyond the prior window is noise: clamp
+        out = np.minimum(out, self.scales * 10.0)
+        self._laplace_scales = out
+        return out
+
+    def _chain_kernel(self, kernel: str, nsteps: int, warmup: int,
+                      max_leapfrog: int | None = None):
+        """chain(z0, key, center, scales, params0, data) -> draws dict.
+
+        Chains run in CENTERED, SCALED coordinates z = (eta - center) /
+        scales (the HMC mass matrix); center/scales are operands so a
+        fleet vmaps per-member values through one program. Draws are
+        mapped back to eta on device."""
+        from pint_tpu import sampler as smp
+
+        if max_leapfrog is None:
+            max_leapfrog = int(knobs.get("PINT_TPU_NUTS_MAX_LEAPFROG") or 16)
+
+        def make(lnpost_z):
+            if kernel == "stretch":
+                return smp.make_stretch_chain(lnpost_z, nsteps)
+            return smp.make_hmc_chain(
+                lnpost_z, nsteps, warmup,
+                target_accept=float(
+                    knobs.get("PINT_TPU_NUTS_TARGET_ACCEPT") or 0.8),
+                max_leapfrog=max_leapfrog,
+                step_size0=0.5,
+            )
+
+        return smp.make_scaled_chain(make, self._lnpost_traced)
+
+    def _chain_starts(self, kernel: str, nd: int, nwalkers: int, seed: int,
+                      chain_ids, center: np.ndarray, scales: np.ndarray):
+        """(z0, keys): overdispersed starts clamped into the prior
+        interior, and the per-chain fold_in(seed, chain_id) keys — chain
+        c's whole trajectory depends only on its id, so fleet and solo
+        runs of the same id draw identically."""
+        n_chains = len(chain_ids)
+        shape = ((n_chains, nwalkers, nd) if kernel == "stretch"
+                 else (n_chains, nd))
+        z0 = np.zeros(shape)
+        keys = []
+        base = jax.random.PRNGKey(seed)
+        lo = np.array([getattr(self.priors[n], "lo", -np.inf)
+                       for n in self.hyper])
+        hi = np.array([getattr(self.priors[n], "hi", np.inf)
+                       for n in self.hyper])
+        width = np.where(np.isfinite(hi - lo), hi - lo, np.inf)
+        for c, cid in enumerate(chain_ids):
+            keys.append(jax.random.fold_in(base, int(cid)))
+            rng = np.random.default_rng(seed * 100003 + int(cid))
+            z = 2.0 * rng.standard_normal(shape[1:])
+            eta = center + z * scales
+            eta = np.clip(eta, lo + 1e-3 * width, hi - 1e-3 * width)
+            z0[c] = (eta - center) / scales
+        return z0, jnp.stack(keys)
+
+    def sample(self, n_chains: int | None = None, nsteps: int = 500,
+               warmup: int | None = None, kernel: str = "hmc",
+               seed: int = 0, nwalkers: int | None = None,
+               chain_ids=None,
+               max_leapfrog: int | None = None) -> "NoiseChains":
+        """C vmapped device-resident chains over the hyperposterior.
+
+        kernel "hmc": the `lax.scan` HMC kernel with dual-averaging
+        step-size warmup (divergent trajectories masked per chain);
+        "stretch": the affine-invariant ensemble move with `nwalkers`
+        walkers per chain. Chain c's trajectory depends only on
+        ``fold_in(seed, chain_ids[c])`` — a fleet run and a solo rerun of
+        one chain id produce the SAME draws (locked <= 1e-10 in tests).
+        """
+        if n_chains is None:
+            n_chains = int(knobs.get("PINT_TPU_NOISE_CHAINS") or 4)
+        if warmup is None:
+            warmup = (int(knobs.get("PINT_TPU_NUTS_WARMUP") or 0)
+                      or max(nsteps // 2, 32))
+        if chain_ids is None:
+            chain_ids = list(range(n_chains))
+        n_chains = len(chain_ids)
+        nd = self.nparams
+        if nwalkers is None:
+            nwalkers = max(2 * nd + 2, 8)
+        if nwalkers % 2:
+            nwalkers += 1
+
+        one_chain = self._chain_kernel(kernel, nsteps, warmup,
+                                       max_leapfrog)
+        vchain = jax.vmap(one_chain, in_axes=(0, 0, None, None, None, None))
+        from pint_tpu.ops.compile import TimedProgram, precision_jit
+
+        label = f"{self.LABEL}_chain_{kernel}"
+        cache = self.__dict__.setdefault("_chain_progs", {})
+        key = (kernel, nsteps, warmup, max_leapfrog,
+               nwalkers if kernel == "stretch" else 0)
+        prog = cache.get(key)
+        if prog is None:
+            prog = cache[key] = TimedProgram(
+                precision_jit(vchain), label,
+                precision_spec=self.model.xprec.name,
+                # chain closure = structure + priors + the kernel config
+                # in the cache key; starts/center/scales ride the args
+                aot_key=(f"{self._aot_base()}|"
+                         f"priors={self._aot_priors()}|{key!r}"))
+
+        scales = self.laplace_scales()
+        z0, keys = self._chain_starts(kernel, nd, nwalkers, seed, chain_ids,
+                                      self.x0, scales)
+        with perf.stage(self.STAGE):
+            with perf.stage("chain"):
+                out = prog(jnp.asarray(z0), keys, jnp.asarray(self.x0),
+                           jnp.asarray(scales), self._params0,
+                           self._plain_data)
+        steps = n_chains * nsteps * (nwalkers if kernel == "stretch" else 1)
+        perf.add(f"{self.LABEL}_chain_steps", steps)
+        perf.add(f"{self.LABEL}_loglike_evals", steps)
+        div = np.asarray(out.get("divergent", np.zeros(1)))
+        acc = np.asarray(out["accept"])
+        res = NoiseChains(
+            hyper=self.hyper,
+            samples=np.asarray(out["samples"]),
+            lnpost=np.asarray(out["lnpost"]),
+            accept_frac=float(np.mean(acc)),
+            divergences=int(div.sum()),
+            kernel=kernel,
+            warmup=warmup if kernel != "stretch" else 0,
+        )
+        perf.add(f"{self.LABEL}_divergences", res.divergences)
+        return res
+
+
+class NoiseLikelihood(MarginalizedPosterior):
     """The fused, audited noise-hyperparameter posterior of one dataset.
 
     Construction fixes the linearization point (the model's CURRENT
@@ -356,6 +696,21 @@ class NoiseLikelihood:
         )
         return data, specs
 
+    def _layout_padded(self, chunk: int):
+        """Memoized bucket-padded single-shard row layout (`_layout(1,
+        chunk=...)`): a ragged fleet re-buckets its members on every
+        NoiseFleet / PTALikelihood construction, but the padded stack of
+        one member depends only on the bucket row count — cache it per
+        chunk and count the hits (`fleet_stack_reuse` in the noise
+        breakdown), so repeated fleet builds over a resident member set
+        cost a dict lookup instead of a host re-pad + device transfer."""
+        cache = self.__dict__.setdefault("_padded_layouts", {})
+        hit = chunk in cache
+        if not hit:
+            cache[chunk] = self._layout(1, chunk=chunk)[0]
+        perf.add("fleet_stack_reuse", int(hit))
+        return cache[chunk]
+
     def _aot_base(self) -> str:
         """Structural closure fingerprint shared by every noise program:
         model structure + the hyperparameter set + the linearized-column
@@ -427,327 +782,6 @@ class NoiseLikelihood:
                               aot_key=akey),
         )
 
-    # --- prior / posterior ------------------------------------------------------
-
-    def lnprior(self, eta):
-        lp = 0.0
-        for i, n in enumerate(self.hyper):
-            lp = lp + self.priors[n].logpdf(eta[i])
-        return lp
-
-    def _lnpost_traced(self, eta, params0, data):
-        """Traceable (eta, params0, data) -> ln posterior — the closure
-        the chain kernels and vmapped optimizers compose over."""
-        lp = self.lnprior(eta)
-        ll = jnp.where(jnp.isfinite(lp),
-                       self._loglike_traced(eta, params0, data), 0.0)
-        return lp + ll
-
-    # --- public evaluation surfaces ----------------------------------------------
-
-    @property
-    def nparams(self) -> int:
-        return len(self.hyper)
-
-    def loglike(self, eta) -> float:
-        """Marginalized ln-likelihood at one hyperparameter vector."""
-        with perf.stage("noise"):
-            with perf.stage("eval"):
-                out = self._programs.loglike(
-                    jnp.asarray(eta, jnp.float64), self._params0, self.data)
-        perf.add("noise_loglike_evals", 1)
-        return float(out)
-
-    #: vmapped-eval bucket: loglike_many pads E up to multiples of this
-    #: (power-of-two floored below it for small E), so ONE compiled batch
-    #: program serves every request size — the fitting/batch.py bucket
-    #: contract, enforced by the batch-retrace audit pass
-    EVAL_CHUNK = 256
-
-    def loglike_many(self, etas, chunk: int | None = None) -> np.ndarray:
-        """Vectorized ln-likelihood over (E, h) hyperparameter rows.
-
-        Evaluations ride a bucket-padded vmapped program: E points cost
-        ceil(E/chunk) device dispatches and at most ONE compile per
-        process (pad rows repeat the last point and are dropped)."""
-        etas = np.asarray(etas, np.float64)
-        E = etas.shape[0]
-        if chunk is None:
-            chunk = self.EVAL_CHUNK
-            while chunk >= 2 * max(E, 1):
-                chunk //= 2
-        n_pad = (-E) % chunk
-        if n_pad:
-            etas = np.concatenate([etas, np.repeat(etas[-1:], n_pad, 0)])
-        outs = []
-        with perf.stage("noise"):
-            with perf.stage("eval"):
-                for k in range(0, etas.shape[0], chunk):
-                    outs.append(self._programs.loglike_batch(
-                        jnp.asarray(etas[k:k + chunk]), self._params0,
-                        self.data))
-        perf.add("noise_loglike_evals", E)
-        return np.concatenate([np.asarray(o) for o in outs])[:E]
-
-    def grad(self, eta) -> np.ndarray:
-        """d lnL / d eta (the surface NUTS/HMC and the ML optimizer ride)."""
-        with perf.stage("noise"):
-            with perf.stage("eval"):
-                out = self._programs.grad(
-                    jnp.asarray(eta, jnp.float64), self._params0, self.data)
-        perf.add("noise_loglike_evals", 1)
-        return np.asarray(out)
-
-    def precompile(self) -> None:
-        """AOT-compile every likelihood surface (overlap contract)."""
-        eta = jnp.asarray(self.x0, jnp.float64)
-        self._programs.loglike.precompile(eta, self._params0, self.data)
-        self._programs.grad.precompile(eta, self._params0, self.data)
-
-    # --- batched optimizer restarts ----------------------------------------------
-
-    def optimize(self, n_restarts: int | None = None, n_steps: int = 200,
-                 lr: float = 0.05, seed: int = 0):
-        """Maximum-likelihood hyperparameters by R vmapped Adam restarts
-        (arXiv:2405.01977's downhill shape, batched): R starting points —
-        the current values plus prior-scaled perturbations — advance as
-        ONE `lax.scan` device program in the prior-scaled coordinates;
-        the best final point wins. Returns (eta_hat, lnpost_at_hat)."""
-        if n_restarts is None:
-            n_restarts = int(knobs.get("PINT_TPU_NOISE_RESTARTS") or 8)
-        lnpost = self._lnpost_traced
-        scales = jnp.asarray(self.scales)
-        center = jnp.asarray(self.x0)
-
-        def neg(z, params0, data):
-            return -lnpost(center + z * scales, params0, data)
-
-        vg = jax.value_and_grad(neg)
-        b1, b2, eps = 0.9, 0.999, 1e-8
-
-        def run(z0, params0, data):
-            def step(carry, t):
-                z, m, v, best_z, best_f = carry
-                f, g = vg(z, params0, data)
-                g = jnp.where(jnp.isfinite(g), g, 0.0)
-                m = b1 * m + (1 - b1) * g
-                v = b2 * v + (1 - b2) * g * g
-                mh = m / (1 - b1 ** (t + 1.0))
-                vh = v / (1 - b2 ** (t + 1.0))
-                z_new = z - lr * mh / (jnp.sqrt(vh) + eps)
-                better = jnp.isfinite(f) & (f < best_f)
-                best_z = jnp.where(better, z, best_z)
-                best_f = jnp.where(better, f, best_f)
-                return (z_new, m, v, best_z, best_f), None
-
-            init = (z0, jnp.zeros_like(z0), jnp.zeros_like(z0), z0,
-                    jnp.asarray(jnp.inf, jnp.float64))
-            (z, _, _, best_z, best_f), _ = jax.lax.scan(
-                step, init, jnp.arange(n_steps, dtype=jnp.float64))
-            f_end = neg(z, params0, data)
-            better = jnp.isfinite(f_end) & (f_end < best_f)
-            return (jnp.where(better, z, best_z),
-                    jnp.where(better, f_end, best_f))
-
-        vrun = jax.vmap(run, in_axes=(0, None, None))
-        from pint_tpu.ops.compile import TimedProgram, precision_jit
-
-        # the optimizer closure bakes the CENTER/SCALE values (x0, prior
-        # scales) and the Adam schedule: all of it lands in the aot_key so
-        # a serialized executable can never serve a different start point
-        import hashlib as _hashlib
-
-        cs = _hashlib.sha256(
-            np.asarray(self.x0).tobytes()
-            + np.asarray(self.scales).tobytes()).hexdigest()[:16]
-        prog = self.__dict__.setdefault(
-            "_opt_prog",
-            TimedProgram(precision_jit(vrun), "noise_optimize",
-                         precision_spec=self.model.xprec.name,
-                         aot_key=(f"{self._aot_base()}|"
-                                  f"priors={self._aot_priors()}|"
-                                  f"opt={n_steps},{lr!r}|cs={cs}")))
-        rng = np.random.default_rng(seed)
-        z0 = np.zeros((n_restarts, self.nparams))
-        z0[1:] = rng.standard_normal((n_restarts - 1, self.nparams))
-        with perf.stage("noise"):
-            with perf.stage("optimize"):
-                zs, fs = prog(jnp.asarray(z0), self._params0,
-                              self._plain_data)
-        perf.add("noise_loglike_evals", n_restarts * (n_steps + 1))
-        fs = np.asarray(fs)
-        best = int(np.nanargmin(fs))
-        eta = self.x0 + np.asarray(zs)[best] * self.scales
-        return eta, float(-fs[best])
-
-    # --- device-resident chains --------------------------------------------------
-
-    def laplace_scales(self) -> np.ndarray:
-        """Per-hyperparameter posterior scales from the Laplace
-        approximation at the current values: 1/sqrt(-d2 lnpost / d eta2)
-        on the Hessian diagonal, falling back to the prior-window scale
-        where the curvature is non-positive or non-finite. These are the
-        HMC mass matrix / restart-ball scales — prior widths alone
-        mis-condition the kernel by orders of magnitude (an EQUAD prior
-        spans 100 us while its posterior is sub-us)."""
-        cached = self.__dict__.get("_laplace_scales")
-        if cached is not None:
-            return cached
-        from pint_tpu.ops.compile import TimedProgram, precision_jit
-
-        hess = jax.hessian(self._lnpost_traced)
-        prog = TimedProgram(precision_jit(hess), "noise_laplace_hessian",
-                            precision_spec=self.model.xprec.name,
-                            # lnpost closure = structure + priors; the
-                            # evaluation point rides the argument list
-                            aot_key=(f"{self._aot_base()}|"
-                                     f"priors={self._aot_priors()}|hessian"))
-        with perf.stage("noise"):
-            with perf.stage("build"):
-                H = np.asarray(prog(jnp.asarray(self.x0), self._params0,
-                                    self._plain_data))
-        d = -np.diag(H)
-        good = np.isfinite(d) & (d > 0)
-        out = np.where(good, 1.0 / np.sqrt(np.where(good, d, 1.0)),
-                       self.scales)
-        # a curvature scale beyond the prior window is noise: clamp
-        out = np.minimum(out, self.scales * 10.0)
-        self._laplace_scales = out
-        return out
-
-    def _chain_kernel(self, kernel: str, nsteps: int, warmup: int,
-                      max_leapfrog: int | None = None):
-        """chain(z0, key, center, scales, params0, data) -> draws dict.
-
-        Chains run in CENTERED, SCALED coordinates z = (eta - center) /
-        scales (the HMC mass matrix); center/scales are operands so a
-        fleet vmaps per-member values through one program. Draws are
-        mapped back to eta on device."""
-        from pint_tpu import sampler as smp
-
-        if max_leapfrog is None:
-            max_leapfrog = int(knobs.get("PINT_TPU_NUTS_MAX_LEAPFROG") or 16)
-
-        def make(lnpost_z):
-            if kernel == "stretch":
-                return smp.make_stretch_chain(lnpost_z, nsteps)
-            return smp.make_hmc_chain(
-                lnpost_z, nsteps, warmup,
-                target_accept=float(
-                    knobs.get("PINT_TPU_NUTS_TARGET_ACCEPT") or 0.8),
-                max_leapfrog=max_leapfrog,
-                step_size0=0.5,
-            )
-
-        lnpost = self._lnpost_traced
-
-        def one_chain(z0, key, center, scales, params0, data):
-            def lnpost_z(z, params0, data):
-                return lnpost(center + z * scales, params0, data)
-
-            out = make(lnpost_z)(z0, key, params0, data)
-            out["samples"] = center + out["samples"] * scales
-            return out
-
-        return one_chain
-
-    def _chain_starts(self, kernel: str, nd: int, nwalkers: int, seed: int,
-                      chain_ids, center: np.ndarray, scales: np.ndarray):
-        """(z0, keys): overdispersed starts clamped into the prior
-        interior, and the per-chain fold_in(seed, chain_id) keys — chain
-        c's whole trajectory depends only on its id, so fleet and solo
-        runs of the same id draw identically."""
-        n_chains = len(chain_ids)
-        shape = ((n_chains, nwalkers, nd) if kernel == "stretch"
-                 else (n_chains, nd))
-        z0 = np.zeros(shape)
-        keys = []
-        base = jax.random.PRNGKey(seed)
-        lo = np.array([getattr(self.priors[n], "lo", -np.inf)
-                       for n in self.hyper])
-        hi = np.array([getattr(self.priors[n], "hi", np.inf)
-                       for n in self.hyper])
-        width = np.where(np.isfinite(hi - lo), hi - lo, np.inf)
-        for c, cid in enumerate(chain_ids):
-            keys.append(jax.random.fold_in(base, int(cid)))
-            rng = np.random.default_rng(seed * 100003 + int(cid))
-            z = 2.0 * rng.standard_normal(shape[1:])
-            eta = center + z * scales
-            eta = np.clip(eta, lo + 1e-3 * width, hi - 1e-3 * width)
-            z0[c] = (eta - center) / scales
-        return z0, jnp.stack(keys)
-
-    def sample(self, n_chains: int | None = None, nsteps: int = 500,
-               warmup: int | None = None, kernel: str = "hmc",
-               seed: int = 0, nwalkers: int | None = None,
-               chain_ids=None,
-               max_leapfrog: int | None = None) -> "NoiseChains":
-        """C vmapped device-resident chains over the hyperposterior.
-
-        kernel "hmc": the `lax.scan` HMC kernel with dual-averaging
-        step-size warmup (divergent trajectories masked per chain);
-        "stretch": the affine-invariant ensemble move with `nwalkers`
-        walkers per chain. Chain c's trajectory depends only on
-        ``fold_in(seed, chain_ids[c])`` — a fleet run and a solo rerun of
-        one chain id produce the SAME draws (locked <= 1e-10 in tests).
-        """
-        if n_chains is None:
-            n_chains = int(knobs.get("PINT_TPU_NOISE_CHAINS") or 4)
-        if warmup is None:
-            warmup = (int(knobs.get("PINT_TPU_NUTS_WARMUP") or 0)
-                      or max(nsteps // 2, 32))
-        if chain_ids is None:
-            chain_ids = list(range(n_chains))
-        n_chains = len(chain_ids)
-        nd = self.nparams
-        if nwalkers is None:
-            nwalkers = max(2 * nd + 2, 8)
-        if nwalkers % 2:
-            nwalkers += 1
-
-        one_chain = self._chain_kernel(kernel, nsteps, warmup,
-                                       max_leapfrog)
-        vchain = jax.vmap(one_chain, in_axes=(0, 0, None, None, None, None))
-        from pint_tpu.ops.compile import TimedProgram, precision_jit
-
-        label = f"noise_chain_{kernel}"
-        cache = self.__dict__.setdefault("_chain_progs", {})
-        key = (kernel, nsteps, warmup, max_leapfrog,
-               nwalkers if kernel == "stretch" else 0)
-        prog = cache.get(key)
-        if prog is None:
-            prog = cache[key] = TimedProgram(
-                precision_jit(vchain), label,
-                precision_spec=self.model.xprec.name,
-                # chain closure = structure + priors + the kernel config
-                # in the cache key; starts/center/scales ride the args
-                aot_key=(f"{self._aot_base()}|"
-                         f"priors={self._aot_priors()}|{key!r}"))
-
-        scales = self.laplace_scales()
-        z0, keys = self._chain_starts(kernel, nd, nwalkers, seed, chain_ids,
-                                      self.x0, scales)
-        with perf.stage("noise"):
-            with perf.stage("chain"):
-                out = prog(jnp.asarray(z0), keys, jnp.asarray(self.x0),
-                           jnp.asarray(scales), self._params0,
-                           self._plain_data)
-        steps = n_chains * nsteps * (nwalkers if kernel == "stretch" else 1)
-        perf.add("noise_chain_steps", steps)
-        perf.add("noise_loglike_evals", steps)
-        div = np.asarray(out.get("divergent", np.zeros(1)))
-        acc = np.asarray(out["accept"])
-        res = NoiseChains(
-            hyper=self.hyper,
-            samples=np.asarray(out["samples"]),
-            lnpost=np.asarray(out["lnpost"]),
-            accept_frac=float(np.mean(acc)),
-            divergences=int(div.sum()),
-            kernel=kernel,
-            warmup=warmup if kernel != "stretch" else 0,
-        )
-        perf.add("noise_divergences", res.divergences)
-        return res
 
 
 class NoiseChains(NamedTuple):
@@ -827,7 +861,7 @@ class NoiseFleet:
                 raise ValueError("fleet timing-design width mismatch")
         rows = max(bucket_rows(nl._n_data, 1)[0] for nl in self.members)
         self.rows = rows
-        datas = [nl._layout(1, chunk=rows)[0] for nl in self.members]
+        datas = [nl._layout_padded(rows) for nl in self.members]
         sig0 = _args_signature(datas[0])
         for d in datas[1:]:
             if _args_signature(d) != sig0:

@@ -1,0 +1,633 @@
+"""Joint PTA likelihood: the fused Hellings-Downs cross-pulsar GWB kernel.
+
+The flagship PTA science case is N pulsars sharing a stochastic
+gravitational-wave background whose cross-pulsar correlations follow the
+Hellings-Downs curve (the GP formulation of van Haasteren & Vallisneri,
+arXiv:1407.1838; correlated-noise likelihoods of arXiv:1107.5366 /
+1202.5932; Vela.jl, arXiv:2412.15858, as the parallel-hardware
+exemplar). The naive joint likelihood materializes the dense
+(sum N_a) x (sum N_a) covariance — O((N T)^3) per evaluation, hopeless
+past a handful of pulsars.
+
+The TPU-native re-design exploits the low-rank structure of the
+coupling. With D = blockdiag(C_a) the per-pulsar noise covariances
+(white + ECORR + per-pulsar red/DM noise), G = blockdiag(G_a) the
+per-pulsar Fourier blocks of the common process on a SHARED frequency
+grid (m = 2 nf_gw columns each), and Phi = ORF (x) diag(phi_gw) the
+(N m) x (N m) coefficient prior (ORF the Hellings-Downs matrix,
+phi_gw the common power-law PSD weights at (log10_A_gw, gamma_gw)):
+
+    C = D + G Phi G^T
+    C^-1 = D^-1 - D^-1 G Sigma^-1 G^T D^-1,   Sigma = Phi^-1 + G^T D^-1 G
+    ln|C| = sum_a ln|C_a| + ln|Phi| + ln|Sigma|
+
+Every D^-1 application stays PER-PULSAR — the bucket-padded Woodbury
+algebra of fitting/woodbury.py, identical to the single-pulsar noise
+engine — so the heavy work is embarrassingly parallel over the
+``batch`` axis of the existing (batch, toa) mesh
+(distributed.batch_fit_mesh / distributed.pta_mesh): each device owns
+N/S pulsars and computes their small coupling blocks
+
+    chi2_a = r_a^T C_a^-1 r_a         ld_a = ln|C_a|
+    u_a = G_a^T C_a^-1 r_a  (m,)      V_a = G_a^T C_a^-1 G_a  (m, m)
+    b_a = M_a^T C_a^-1 r_a  (p,)      A_a = M_a^T C_a^-1 M_a  (p, p)
+    W_a = M_a^T C_a^-1 G_a  (p, m)
+
+(the `cinv_inner` reduce hook). The blocks are completed with ONE psum
+over the batch axis and the cross-pulsar coupling — the Sigma solve and
+the jointly-marginalized timing block
+
+    A = blockdiag(A_a) - Wb Sigma^-1 Wb^T,   Wb = blockdiag(W_a)
+    b = stack(b_a) - Wb Sigma^-1 u
+
+— is a small replicated dense solve ((N m) + (N p) sized, KB not GB).
+Joint cost = per-pulsar-parallel Woodbury work + one psum + a small
+dense solve, so ``pta_pulsars_per_chip`` scales with devices and
+`distributed.py`'s multi-host init takes N past one chip.
+
+The evaluation/optimizer/chain surface is inherited from
+:class:`~pint_tpu.fitting.noise_like.MarginalizedPosterior`: the joint
+hyperparameter vector eta = [per-pulsar noise blocks ..., (log10_A_gw,
+gamma_gw)] rides vmapped HMC/stretch chains in Laplace-scaled
+coordinates exactly like the single-pulsar engine, and the gradient is
+taken from OUTSIDE the shard_map (the PR-8 lesson: per-shard autodiff of
+a psum-completed expression double-counts replicated paths).
+
+Telemetry nests under a ``pta`` stage (ops/perf.py `pta_breakdown`);
+bench headlines are `gwb_loglike_evals_per_sec_per_chip` and
+`pta_pulsars_per_chip` (bench.py --smoke --pta).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from pint_tpu.fitting.batch import bucket_rows, stack_trees
+from pint_tpu.fitting.noise_like import (
+    _LN2PI,
+    RIDGE,
+    MarginalizedPosterior,
+    _apply_eta,
+    _prior_scale,
+    _ProgramSet,
+    default_noise_priors,
+)
+from pint_tpu.fitting.sharded import _AxisReduce, _shard_map
+from pint_tpu.fitting.woodbury import (
+    basis_dense,
+    cinv_inner,
+    logdet_C,
+    s_factor,
+    woodbury_chi2,
+)
+from pint_tpu.models.noise import orf_matrix, pulsar_position
+from pint_tpu.ops import perf
+from pint_tpu.utils.logging import get_logger
+
+log = get_logger("pint_tpu.pta_like")
+
+Array = jnp.ndarray
+
+
+def _block_diag(B: Array) -> Array:
+    """(n, p, q) stacked blocks -> (n p, n q) block-diagonal matrix."""
+    n, p, q = B.shape
+    out = jnp.zeros((n, p, n, q), B.dtype)
+    out = out.at[jnp.arange(n), :, jnp.arange(n), :].set(B)
+    return out.reshape(n * p, n * q)
+
+
+def _pta_loglike_fn(model, gw_comp, psr_hyper: tuple[str, ...],
+                    gw_hyper: tuple[str, ...], p_lin: int, n_psr: int,
+                    marginalize: bool, red: _AxisReduce):
+    """(eta, params0, data) -> scalar joint marginalized ln-likelihood.
+
+    eta: (n_psr * h + 2) — per-pulsar noise blocks then the common pair.
+    params0: member params stacked on a leading (batch-sharded) axis.
+    data: {"members": stacked member rows (the noise engine's layout),
+    "slot": (n,) global pulsar ids, "orf": (N, N) HD matrix,
+    "gw_tspan": the array-wide span} — under shard_map the members/slot
+    leaves are local (N/S) slices, orf/gw_tspan replicated.
+    """
+    h = len(psr_hyper)
+    nf = gw_comp.nf
+    m = 2 * nf
+
+    def pulsar_blocks(eta_a, params0_a, d_a, tspan):
+        """One pulsar's Woodbury terms + small coupling blocks — pure
+        per-pulsar work (its rows live on one device; pad rows carry
+        w = 0 and vanish from every inner product)."""
+        params = _apply_eta(params0_a, psr_hyper, eta_a)
+        tensor = d_a["tensor"]
+        mask = d_a["mask"]
+        r0 = d_a["r0"]
+        sigma = model.scaled_sigma(params, tensor)
+        w = jnp.where(mask > 0, 1.0 / sigma**2, 0.0)
+        basis = model.noise_basis_and_weights(params, tensor,
+                                              include_common=False)
+        sf = s_factor(basis, w) if basis is not None else None
+        chi2_a, _ = woodbury_chi2(basis, w, r0, sf=sf)
+        ld_a = logdet_C(basis, w, sf=sf, mask=mask)
+        G, _ = model.gwb_common_basis(params, tensor, tspan)
+        V_a, CinvG = cinv_inner(basis, w, G, sf=sf)
+        out = {"chi2": chi2_a, "ld": ld_a, "n": jnp.sum(mask),
+               "u": CinvG.T @ r0, "V": V_a}
+        if p_lin:  # jaxlint: disable=tracer-if — static closure int (the member timing-design width), never a tracer
+            Mn = d_a["Mn"]
+            A_a, CinvM = cinv_inner(basis, w, Mn, sf=sf)
+            out.update(A=A_a, b=CinvM.T @ r0, W=Mn.T @ CinvG,
+                       ldM=2.0 * jnp.sum(jnp.log(d_a["Mnorm"])))
+        return out
+
+    def loglike(eta, params0, data):
+        red.begin()
+        slot = data["slot"]
+        tspan = data["gw_tspan"]
+        eta_psr = eta[: n_psr * h].reshape(n_psr, h)
+        eta_gw = eta[n_psr * h:]
+        blocks = jax.vmap(pulsar_blocks, in_axes=(0, 0, 0, None))(
+            eta_psr[slot], params0, data["members"], tspan)
+
+        # complete the per-pulsar blocks across the batch axis with ONE
+        # psum: scatter each device's pulsars into their global slots of
+        # zeroed (N, ...) buffers, flatten, sum (identity on one device)
+        bufs = {
+            k: jnp.zeros((n_psr,) + v.shape[1:], v.dtype).at[slot].set(v)
+            for k, v in blocks.items()
+        }
+        flat, tree = jax.tree_util.tree_flatten(bufs)
+        sizes = [int(np.prod(x.shape)) for x in flat]
+        joined = red.psum(jnp.concatenate([x.reshape(-1) for x in flat]))
+        parts = jnp.split(joined, np.cumsum(sizes)[:-1])
+        g = jax.tree_util.tree_unflatten(
+            tree, [p.reshape(f.shape) for p, f in zip(parts, flat)])
+
+        chi2 = jnp.sum(g["chi2"])
+        ld = jnp.sum(g["ld"])
+        n_eff = jnp.sum(g["n"])
+
+        # --- the common-process coupling: small, dense, replicated -----
+        freqs = jnp.repeat(jnp.linspace(1.0 / tspan, nf / tspan, nf), 2)
+        params_gw = {gw_hyper[0]: eta_gw[0], gw_hyper[1]: eta_gw[1]}
+        phi = gw_comp.gwb_weights(params_gw, freqs)           # (m,)
+        orf = data["orf"]                                     # (N, N)
+        orf_cf = jax.scipy.linalg.cho_factor(orf)
+        orf_inv = jax.scipy.linalg.cho_solve(orf_cf, jnp.eye(n_psr))
+        # ln|Phi| = ln|ORF (x) diag(phi)| = m ln|ORF| + N sum ln phi
+        ld_phi = (m * 2.0 * jnp.sum(jnp.log(jnp.diag(orf_cf[0])))
+                  + n_psr * jnp.sum(jnp.log(phi)))
+        Sigma = (jnp.kron(orf_inv, jnp.diag(1.0 / phi))
+                 + _block_diag(g["V"]))
+        S_cf = jax.scipy.linalg.cho_factor(Sigma)
+        u = g["u"].reshape(n_psr * m)
+        su = jax.scipy.linalg.cho_solve(S_cf, u)
+        chi2 = chi2 - u @ su
+        ld = ld + ld_phi + 2.0 * jnp.sum(jnp.log(jnp.diag(S_cf[0])))
+
+        n_prof = 0.0
+        if p_lin:
+            # jointly-marginalized timing block: the GWB correction
+            # couples pulsars' timing columns through Sigma^-1, so A is
+            # dense (N p) x (N p) — still tiny, solved replicated
+            Wb = _block_diag(g["W"])                  # (N p, N m)
+            A = (_block_diag(g["A"])
+                 - Wb @ jax.scipy.linalg.cho_solve(S_cf, Wb.T)
+                 + RIDGE * jnp.eye(n_psr * p_lin))
+            b = g["b"].reshape(n_psr * p_lin) - Wb @ su
+            A_cf = jax.scipy.linalg.cho_factor(A)
+            chi2 = chi2 - b @ jax.scipy.linalg.cho_solve(A_cf, b)
+            if marginalize:
+                # ln|A_unequilibrated| = ln|A_n| + 2 sum ln norm_a
+                ld = ld + 2.0 * jnp.sum(jnp.log(jnp.diag(A_cf[0])))
+                ld = ld + jnp.sum(g["ldM"])
+                n_prof = float(n_psr * p_lin)
+        return -0.5 * (chi2 + ld + (n_eff - n_prof) * _LN2PI)
+
+    return loglike
+
+
+class PTALikelihood(MarginalizedPosterior):
+    """The joint N-pulsar GWB-marginalized posterior as ONE fused,
+    audited, cost-budgeted program set.
+
+    ``members`` are per-pulsar :class:`NoiseLikelihood` objects (each
+    fixes its pulsar's linearization point; construct them after a
+    downhill fit) whose models share a skeleton AND carry the common
+    :class:`~pint_tpu.models.noise.PLGWBNoise` component. The joint
+    hyperparameter vector is
+
+        eta = [psr_0 noise hyper ..., psr_{N-1} noise hyper ...,
+               log10_A_gw, gamma_gw]
+
+    with per-pulsar coordinates named ``"<PSR>:<name>"``. The common
+    GWB is EXCLUDED from every per-pulsar basis (its auto term rides the
+    ORF diagonal), pulsars couple only through the
+    ORF (x) diag(phi_gw) block, and with a mesh carrying a ``batch``
+    axis of size S | N the per-pulsar work shards S-wide with one psum
+    (`distributed.pta_mesh` builds a valid layout).
+    """
+
+    STAGE = "pta"
+    LABEL = "pta"
+
+    def __init__(self, likelihoods: list, mesh=None,
+                 batch_axis: str = "batch", priors: dict | None = None,
+                 marginalize_timing: bool = True):
+        from pint_tpu.ops.compile import _args_signature
+
+        if not likelihoods:
+            raise ValueError("empty pulsar array")
+        with perf.stage(self.STAGE):
+            with perf.stage("build"):
+                self._build(list(likelihoods), mesh, batch_axis,
+                            priors or {}, bool(marginalize_timing),
+                            _args_signature)
+
+    def _build(self, members, mesh, batch_axis, priors, marginalize,
+               _args_signature):
+        nl0 = members[0]
+        self.members = members
+        self.model = nl0.model
+        self.marginalize_timing = marginalize
+        self.mesh = mesh
+        self.batch_axis = batch_axis
+        n = len(members)
+
+        gw_comp = self.model.common_noise_component
+        if gw_comp is None:
+            raise ValueError(
+                "PTA members carry no common noise process (PLGWBNoise / "
+                "TNGWAMP) — nothing couples the pulsars")
+        self.gw_comp = gw_comp
+        self.gw_hyper = tuple(gw_comp.hyper_param_names(self.model.params))
+        if len(self.gw_hyper) != 2:
+            raise ValueError(
+                f"common process exposes {self.gw_hyper}; expected the "
+                "(log10 amplitude, spectral index) pair")
+        self.psr_hyper = tuple(
+            h for h in nl0.hyper if h not in self.gw_hyper)
+        for nl in members:
+            if tuple(h for h in nl.hyper if h not in self.gw_hyper) \
+                    != self.psr_hyper:
+                raise ValueError(
+                    f"array hyper mismatch: {nl.hyper} vs {nl0.hyper}")
+            if nl.p_lin != nl0.p_lin:
+                raise ValueError("array timing-design width mismatch")
+            if nl.model.common_noise_component is None or \
+                    nl.model.common_noise_component.nf != gw_comp.nf:
+                raise ValueError("array common-process mode-count mismatch")
+        self.p_lin = nl0.p_lin
+
+        # mesh layout first — an invalid shard count must fail BEFORE
+        # any member stacking work
+        n_shards = 1
+        if mesh is not None and batch_axis in mesh.shape:
+            n_shards = int(mesh.shape[batch_axis])
+        if n_shards > 1 and n % n_shards:
+            raise ValueError(
+                f"{n} pulsars do not divide over {n_shards} batch shards; "
+                "use distributed.pta_mesh(n_pulsars) for a valid layout")
+        self.n_shards = n_shards
+
+        # --- stacked bucket-padded member operands (the fleet recipe) --
+        rows = max(bucket_rows(nl._n_data, 1)[0] for nl in members)
+        self.rows = rows
+        datas = [nl._layout_padded(rows) for nl in members]
+        sig0 = _args_signature(datas[0])
+        for d in datas[1:]:
+            if _args_signature(d) != sig0:
+                raise ValueError(
+                    "array operand-signature mismatch: members must share "
+                    "a model skeleton (component graph, Fourier mode "
+                    "counts, ECORR epoch counts)")
+        self._params0 = stack_trees([nl._params0 for nl in members])
+
+        # sky geometry -> the HD matrix (host, once: positions are not
+        # sampled), and the ARRAY-WIDE span the shared frequency grid
+        # 1/T .. nf/T hangs off — per-pulsar spans would de-cohere the
+        # cross-pulsar Fourier modes
+        self.positions = np.stack([pulsar_position(nl.model)
+                                   for nl in members])
+        self.orf = orf_matrix(self.positions)
+        t_lo, t_hi = np.inf, -np.inf
+        for nl in members:
+            t = nl.toas.tdb.mjd_float() * 86400.0
+            real = np.asarray(nl.toas.error_us) > 0
+            t = t[real] if real.any() else t
+            t_lo, t_hi = min(t_lo, t.min()), max(t_hi, t.max())
+        self.gw_tspan = float(t_hi - t_lo)
+
+        self.data = {
+            "members": stack_trees(datas),
+            "slot": jnp.arange(n, dtype=jnp.int32),
+            "orf": jnp.asarray(self.orf),
+            # strong-typed scalar: a weak float leaf would retrace once
+            # it comes back as a committed array (weak-type audit pass)
+            "gw_tspan": jnp.asarray(np.float64(self.gw_tspan)),
+        }
+        self._plain_data = self.data  # no row re-layout: chains reuse it
+
+        # --- joint coordinates, priors, scales, start point ------------
+        psrs = [nl.model.psr_name or f"PSR{a}" for a, nl in
+                enumerate(members)]
+        if len(set(psrs)) != len(psrs):  # de-collide duplicate par names
+            psrs = [f"{p}#{a}" for a, p in enumerate(psrs)]
+        names, x0, scales = [], [], []
+        self.priors = {}
+        for nl, psr in zip(members, psrs):
+            for h in self.psr_hyper:
+                j = nl.hyper.index(h)
+                names.append(f"{psr}:{h}")
+                x0.append(nl.x0[j])
+                scales.append(nl.scales[j])
+                self.priors[f"{psr}:{h}"] = priors.get(h, nl.priors[h])
+        gw_defaults = default_noise_priors(self.model, self.gw_hyper)
+        from pint_tpu.models.base import leaf_to_f64
+
+        for h in self.gw_hyper:
+            names.append(h)
+            x0.append(float(np.asarray(leaf_to_f64(
+                self.model.params[h]))))
+            scales.append(_prior_scale(gw_defaults[h]))
+            self.priors[h] = priors.get(h, gw_defaults[h])
+        self.hyper = tuple(names)
+        self.x0 = np.asarray(x0)
+        self.scales = np.asarray(scales)
+
+        self._programs = self._compile(n, n_shards)
+
+    # --- program construction ----------------------------------------------------
+
+    def _aot_base(self) -> str:
+        return (f"{self.model.aot_structure_key()}|pta|"
+                f"n={len(self.members)}|rows={self.rows}|"
+                f"psr_hyper={','.join(self.psr_hyper)}|"
+                f"gw={','.join(self.gw_hyper)}x{self.gw_comp.nf}|"
+                f"plin={self.p_lin}|marg={self.marginalize_timing}")
+
+    def _aot_priors(self) -> str:
+        return ";".join(f"{n}={self.priors[n]!r}" for n in self.hyper)
+
+    def _wrap(self, fn, n_shards: int):
+        """shard_map a joint surface over the batch axis: each device
+        owns its pulsars' stacked rows, eta/orf/span stay replicated,
+        outputs are replicated (completed by the in-graph psum)."""
+        if n_shards <= 1:
+            return fn
+        from jax.sharding import PartitionSpec as P
+
+        B = P(self.batch_axis)
+        params_spec = jax.tree_util.tree_map(lambda _: B, self._params0)
+        data_spec = {
+            "members": jax.tree_util.tree_map(lambda _: B,
+                                              self.data["members"]),
+            "slot": B, "orf": P(), "gw_tspan": P(),
+        }
+        return _shard_map()(
+            fn, mesh=self.mesh,
+            in_specs=(P(), params_spec, data_spec),
+            out_specs=P(),
+            check_vma=False,
+        )
+
+    def _compile(self, n: int, n_shards: int) -> _ProgramSet:
+        from pint_tpu.ops.compile import TimedProgram, precision_jit
+
+        axis = self.batch_axis if n_shards > 1 else None
+        axes = (axis,) if axis else ()
+        mk = lambda: _AxisReduce(axis)  # noqa: E731 — one tally per program
+
+        args = (self.model, self.gw_comp, self.psr_hyper, self.gw_hyper,
+                self.p_lin, n, self.marginalize_timing)
+        # un-jitted replicated core for chain/optimizer/Hessian
+        # composition (reductions are identity — no collective)
+        self._loglike_traced = _pta_loglike_fn(*args, _AxisReduce(None))
+
+        single = self._wrap(_pta_loglike_fn(*args, mk()), n_shards)
+        batch = self._wrap(
+            jax.vmap(_pta_loglike_fn(*args, mk()), in_axes=(0, None, None)),
+            n_shards)
+        # gradient: differentiate the (possibly shard-mapped) VALUE
+        # function from outside — shard_map carries the correct AD rules,
+        # where grad-inside-then-psum would overcount every replicated
+        # eta path by the shard count (the PR-8 lesson)
+        grad = jax.grad(self._wrap(_pta_loglike_fn(*args, mk()), n_shards))
+
+        akey = f"{self._aot_base()}|shards={n_shards}"
+        spec = self.model.xprec.name
+        return _ProgramSet(
+            loglike=TimedProgram(precision_jit(single), "pta_loglike",
+                                 collective_axes=axes, precision_spec=spec,
+                                 aot_key=akey),
+            loglike_batch=TimedProgram(precision_jit(batch),
+                                       "pta_loglike_batch",
+                                       collective_axes=axes,
+                                       precision_spec=spec, aot_key=akey),
+            grad=TimedProgram(precision_jit(grad), "pta_loglike_grad",
+                              collective_axes=axes, precision_spec=spec,
+                              aot_key=akey),
+        )
+
+    # --- joint Laplace scales -----------------------------------------------------
+
+    def laplace_scales(self) -> np.ndarray:
+        """Laplace-scaled coordinates for the JOINT posterior: per-pulsar
+        coordinates reuse each member's own (cached) Laplace scales —
+        the GWB coupling barely moves per-pulsar curvatures — and the
+        common (log10_A_gw, gamma_gw) pair gets central-second-difference
+        curvatures of the joint lnpost through the compiled batch
+        program (6 evaluations, no (N h)^2 Hessian program)."""
+        cached = self.__dict__.get("_laplace_scales")
+        if cached is not None:
+            return cached
+        out = np.array(self.scales)
+        h = len(self.psr_hyper)
+        for a, nl in enumerate(self.members):
+            mem = nl.laplace_scales()
+            pick = [nl.hyper.index(x) for x in self.psr_hyper]
+            out[a * h:(a + 1) * h] = mem[pick]
+        with perf.stage(self.STAGE):
+            with perf.stage("build"):
+                base = len(self.members) * h
+                etas = [self.x0]
+                steps = []
+                for j in range(base, self.nparams):
+                    d = 0.05 * self.scales[j]
+                    steps.append(d)
+                    for s in (+d, -d):
+                        e = self.x0.copy()
+                        e[j] += s
+                        etas.append(e)
+                lp = self.loglike_many(np.asarray(etas))
+                lp = lp + np.array([float(self.lnprior(jnp.asarray(e)))
+                                    for e in etas])
+                for k, j in enumerate(range(base, self.nparams)):
+                    d = steps[k]
+                    curv = -(lp[1 + 2 * k] + lp[2 + 2 * k]
+                             - 2.0 * lp[0]) / d**2
+                    if np.isfinite(curv) and curv > 0:
+                        out[j] = min(1.0 / np.sqrt(curv),
+                                     self.scales[j] * 10.0)
+        self._laplace_scales = out
+        return out
+
+    # --- diagnostics ---------------------------------------------------------------
+
+    def gwb_coefficient_blocks(self, eta) -> dict:
+        """Per-pulsar common-process inner products at one eta — the
+        ingredients of the cross-correlation estimator the GWB recovery
+        harness plots against the HD curve: {"u": (N, m) G^T C^-1 r,
+        "V": (N, m, m) G^T C^-1 G, "phi": (m,), "orf": (N, N)}."""
+        fn = self.__dict__.get("_blocks_prog")
+        if fn is None:
+            from pint_tpu.ops.compile import TimedProgram, precision_jit
+
+            def blocks_fn(eta, params0, data):
+                h = len(self.psr_hyper)
+                n = len(self.members)
+                eta_psr = eta[: n * h].reshape(n, h)
+                eta_gw = eta[n * h:]
+                tspan = data["gw_tspan"]
+
+                def one(eta_a, params0_a, d_a):
+                    params = _apply_eta(params0_a, self.psr_hyper, eta_a)
+                    tensor = d_a["tensor"]
+                    mask = d_a["mask"]
+                    sigma = self.model.scaled_sigma(params, tensor)
+                    w = jnp.where(mask > 0, 1.0 / sigma**2, 0.0)
+                    basis = self.model.noise_basis_and_weights(
+                        params, tensor, include_common=False)
+                    sf = s_factor(basis, w) if basis is not None else None
+                    G, _ = self.model.gwb_common_basis(params, tensor,
+                                                       tspan)
+                    V, CinvG = cinv_inner(basis, w, G, sf=sf)
+                    return CinvG.T @ d_a["r0"], V
+
+                u, V = jax.vmap(one, in_axes=(0, 0, 0))(
+                    eta_psr, params0, data["members"])
+                nf = self.gw_comp.nf
+                freqs = jnp.repeat(
+                    jnp.linspace(1.0 / tspan, nf / tspan, nf), 2)
+                phi = self.gw_comp.gwb_weights(
+                    {self.gw_hyper[0]: eta_gw[0],
+                     self.gw_hyper[1]: eta_gw[1]}, freqs)
+                return {"u": u, "V": V, "phi": phi}
+
+            fn = self.__dict__["_blocks_prog"] = TimedProgram(
+                precision_jit(blocks_fn), "pta_gwb_blocks",
+                precision_spec=self.model.xprec.name,
+                aot_key=f"{self._aot_base()}|gwb_blocks")
+        with perf.stage(self.STAGE):
+            with perf.stage("eval"):
+                out = fn(jnp.asarray(eta, jnp.float64), self._params0,
+                         self._plain_data)
+        return {"u": np.asarray(out["u"]), "V": np.asarray(out["V"]),
+                "phi": np.asarray(out["phi"]), "orf": np.array(self.orf)}
+
+    def dense_joint_program(self):
+        """The O((N T)^3) dense-joint baseline as ONE jitted program:
+        materialize the full (sum rows) x (sum rows) HD-coupled
+        covariance, Cholesky it, profile every timing column jointly —
+        the pre-fused shape a host loop would dispatch per point. This
+        is the bench's measured baseline (`bench.py --smoke --pta`) and
+        a second implementation path for parity tests; it shares only
+        the operand layout with the fused kernel, not the algebra.
+
+        Returns ``prog(eta, params0, data) -> scalar`` (a TimedProgram
+        over the replicated layout)."""
+        prog = self.__dict__.get("_dense_prog")
+        if prog is not None:
+            return prog
+        from pint_tpu.ops.compile import TimedProgram, precision_jit
+
+        model = self.model
+        gw_comp = self.gw_comp
+        psr_hyper = self.psr_hyper
+        gw_hyper = self.gw_hyper
+        n_psr = len(self.members)
+        p_lin = self.p_lin
+        h = len(psr_hyper)
+        nf = gw_comp.nf
+        rows = self.rows
+        marginalize = self.marginalize_timing
+
+        def one(eta_a, params0_a, d_a, tspan):
+            params = _apply_eta(params0_a, psr_hyper, eta_a)
+            tensor = d_a["tensor"]
+            mask = d_a["mask"]
+            sigma = model.scaled_sigma(params, tensor)
+            # pad rows: unit diagonal (ld contribution 0), zero couplings
+            C = jnp.diag(jnp.where(mask > 0, sigma**2, 1.0))
+            basis = model.noise_basis_and_weights(params, tensor,
+                                                  include_common=False)
+            if basis is not None:
+                F, ph = basis_dense(basis, rows)
+                F = F * mask[:, None]
+                C = C + (F * ph) @ F.T
+            G, _ = model.gwb_common_basis(params, tensor, tspan)
+            return (C, G * mask[:, None], d_a["r0"],
+                    d_a["Mn"] * mask[:, None], jnp.sum(mask),
+                    2.0 * jnp.sum(jnp.log(d_a["Mnorm"])))
+
+        def dense(eta, params0, data):
+            tspan = data["gw_tspan"]
+            eta_psr = eta[: n_psr * h].reshape(n_psr, h)
+            eta_gw = eta[n_psr * h:]
+            Cs, Gs, rs, Ms, n_a, ldM = jax.vmap(
+                one, in_axes=(0, 0, 0, None))(eta_psr, params0,
+                                              data["members"], tspan)
+            freqs = jnp.repeat(jnp.linspace(1.0 / tspan, nf / tspan, nf),
+                               2)
+            phi = gw_comp.gwb_weights(
+                {gw_hyper[0]: eta_gw[0], gw_hyper[1]: eta_gw[1]}, freqs)
+            Gb = _block_diag(Gs)                       # (N rows, N m)
+            C = (_block_diag(Cs)
+                 + Gb @ jnp.kron(data["orf"], jnp.diag(phi)) @ Gb.T)
+            r = rs.reshape(-1)
+            cf = jax.scipy.linalg.cho_factor(C)
+            Cinv_r = jax.scipy.linalg.cho_solve(cf, r)
+            chi2 = r @ Cinv_r
+            ld = 2.0 * jnp.sum(jnp.log(jnp.diag(cf[0])))
+            n_prof = 0.0
+            if p_lin:
+                M = _block_diag(Ms)                    # (N rows, N p)
+                A = (M.T @ jax.scipy.linalg.cho_solve(cf, M)
+                     + RIDGE * jnp.eye(n_psr * p_lin))
+                b = M.T @ Cinv_r
+                cfA = jax.scipy.linalg.cho_factor(A)
+                chi2 = chi2 - b @ jax.scipy.linalg.cho_solve(cfA, b)
+                if marginalize:
+                    ld = ld + 2.0 * jnp.sum(jnp.log(jnp.diag(cfA[0])))
+                    ld = ld + jnp.sum(ldM)
+                    n_prof = float(n_psr * p_lin)
+            return -0.5 * (chi2 + ld + (jnp.sum(n_a) - n_prof) * _LN2PI)
+
+        prog = self.__dict__["_dense_prog"] = TimedProgram(
+            precision_jit(dense), "pta_dense_joint",
+            precision_spec=self.model.xprec.name,
+            aot_key=f"{self._aot_base()}|dense")
+        return prog
+
+    def pair_correlations(self, eta) -> dict:
+        """Cross-correlation estimator per pulsar pair vs the HD
+        prediction: rho_ab = u_a^T diag(phi) u_b normalized by the
+        auto terms — on average Gamma_ab for a strong common signal
+        (the optimal-statistic numerator shape, arXiv:1202.5932 s.4).
+        Returns {"angle_deg": (P,), "rho": (P,), "hd": (P,)}."""
+        blk = self.gwb_coefficient_blocks(eta)
+        u, phi = blk["u"], blk["phi"]
+        n = u.shape[0]
+        s = u * phi[None, :]
+        auto = np.einsum("am,am->a", s, u)
+        angles, rho, hd = [], [], []
+        cos = np.clip(self.positions @ self.positions.T, -1.0, 1.0)
+        for a in range(n):
+            for b in range(a + 1, n):
+                angles.append(float(np.degrees(np.arccos(cos[a, b]))))
+                rho.append(float(s[a] @ u[b]
+                                 / np.sqrt(max(auto[a] * auto[b], 1e-300))))
+                hd.append(float(self.orf[a, b]))
+        return {"angle_deg": np.asarray(angles), "rho": np.asarray(rho),
+                "hd": np.asarray(hd)}

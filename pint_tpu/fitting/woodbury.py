@@ -177,6 +177,21 @@ def cinv_apply(
     return wX - (w[:, None] * corr if X.ndim == 2 else w * corr)
 
 
+def cinv_inner(
+    basis: NoiseBasis | None, w: Array, X: Array, Y: Array | None = None,
+    sf: SFactor | None = None, reduce=_ident,
+):
+    """Basis inner products through C^-1, reduction completed: returns
+    ``(X^T C^-1 Y, C^-1 Y)`` with Y defaulting to X. This is the reduce
+    hook the joint PTA likelihood (fitting/pta_like.py) builds its small
+    cross-pulsar coupling blocks from — F^T C^-1 F, M^T C^-1 F,
+    M^T C^-1 r are all one `cinv_apply` plus one row-reduced matmul, so
+    the per-pulsar work stays O(N k) and shards over any row mesh."""
+    CinvY = cinv_apply(basis, w, X if Y is None else Y, sf, reduce)
+    XT = X.T if X.ndim == 2 else X
+    return reduce(XT @ CinvY), CinvY
+
+
 def woodbury_chi2(
     basis: NoiseBasis | None, w: Array, r: Array, reduce=_ident,
     sf: SFactor | None = None,

@@ -177,6 +177,7 @@ def build_model(pf: ParFile) -> TimingModel:
     from pint_tpu.models.noise import (
         EcorrNoise,
         PLDMNoise,
+        PLGWBNoise,
         PLRedNoise,
         ScaleDmError,
         ScaleToaError,
@@ -190,6 +191,8 @@ def build_model(pf: ParFile) -> TimingModel:
         components.append(PLRedNoise())
     if "TNDMAMP" in pf:
         components.append(PLDMNoise())
+    if "TNGWAMP" in pf:
+        components.append(PLGWBNoise())
     if "DMEFAC" in pf or "DMEQUAD" in pf:
         components.append(ScaleDmError())
 

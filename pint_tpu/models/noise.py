@@ -317,6 +317,129 @@ class PLRedNoise(NoiseComponent):
         return ("dense", F, phi)
 
 
+def hd_orf(cos_sep: Array) -> Array:
+    """Hellings-Downs overlap reduction function of the pair separation
+    cosine (Hellings & Downs 1983): Gamma(theta) = 1.5 x ln x - x/4 + 1/2
+    with x = (1 - cos theta)/2. Valid for DISTINCT pulsars; the
+    same-pulsar value (auto term + pulsar term) is 1 and is handled by
+    the caller (`orf_matrix` diagonal)."""
+    x = 0.5 * (1.0 - cos_sep)
+    # lim x->0+ of x ln x = 0: guard the log so a coincident pair traces
+    # clean (the matrix diagonal overwrites it anyway)
+    xs = jnp.where(x > 0, x, 1.0)
+    return 1.5 * x * jnp.log(xs) - 0.25 * x + 0.5
+
+
+def orf_matrix(positions: np.ndarray) -> np.ndarray:
+    """(N, N) Hellings-Downs correlation matrix of an array of unit sky
+    vectors: hd_orf off the diagonal, 1 on it (auto-correlation including
+    the pulsar term — the enterprise/standard-PTA convention)."""
+    pos = np.asarray(positions, float)
+    cos = np.clip(pos @ pos.T, -1.0, 1.0)
+    out = np.array(hd_orf(jnp.asarray(cos)))
+    np.fill_diagonal(out, 1.0)
+    return out
+
+
+def pulsar_position(model) -> np.ndarray:
+    """Host-side (3,) ICRS unit vector of one model's pulsar (angles
+    only — proper motion is irrelevant at ORF accuracy). Supports both
+    astrometry parameterizations."""
+    from pint_tpu.models.astrometry import ecliptic_to_icrs, unit_vector
+
+    p = model.params
+    if "RAJ" in p and "DECJ" in p:
+        v = unit_vector(leaf_to_f64(p["RAJ"]), leaf_to_f64(p["DECJ"]))
+        return np.asarray(v, float)
+    if "ELONG" in p and "ELAT" in p:
+        v = unit_vector(leaf_to_f64(p["ELONG"]), leaf_to_f64(p["ELAT"]))
+        return np.asarray(ecliptic_to_icrs(v), float)
+    raise ValueError(
+        f"model {model.psr_name!r} has no astrometry parameters; cannot "
+        "place it on the sky for the Hellings-Downs ORF")
+
+
+class PLGWBNoise(NoiseComponent):
+    """Common-process power-law red noise: the stochastic gravitational-
+    wave background every pulsar of a PTA shares, with Hellings-Downs
+    cross-pulsar correlations (the ORF of `hd_orf`).
+
+    Parameters: TNGWAMP (log10 strain amplitude), TNGWGAM (spectral
+    index; 13/3 for an SMBHB background), TNGWC (harmonic count on the
+    common frequency grid).
+
+    Two consumption modes:
+
+    - **Single-pulsar** (`basis_and_weights`): the auto-correlation term
+      only (Gamma_aa = 1) — the GWB looks like ordinary achromatic red
+      noise in one pulsar's marginal likelihood, so solo fits/noise runs
+      stay correct without the joint machinery.
+    - **Joint PTA** (`gwb_basis`): the per-pulsar Fourier block of the
+      common process evaluated on a SHARED frequency grid (the caller
+      passes the array-wide span), with the coefficient prior
+      ORF (x) diag(phi_gw) assembled by the joint likelihood
+      (fitting/pta_like.py) — which excludes this component from the
+      per-pulsar basis to avoid double counting the diagonal.
+    """
+
+    category = "pl_gwb_noise"
+    introduces_correlated_errors = True
+    #: marks the component as an array-COMMON process: the joint PTA
+    #: likelihood pulls it out of the per-pulsar basis and couples
+    #: pulsars through its ORF instead
+    common_process = True
+
+    def __init__(self):
+        super().__init__()
+        self.nf = 10  # TNGWC; static harmonic count, set at validate()
+
+    @classmethod
+    def param_specs(cls):
+        return [
+            ParamSpec("TNGWAMP", kind="float",
+                      description="log10 GWB strain amplitude"),
+            ParamSpec("TNGWGAM", kind="float",
+                      description="GWB spectral index (13/3 for SMBHBs)"),
+            ParamSpec("TNGWC", kind="int",
+                      description="number of GWB frequencies"),
+        ]
+
+    def validate(self, params, meta):
+        self.nf = int(meta.get("TNGWC", 10))
+        if "TNGWAMP" not in params or "TNGWGAM" not in params:
+            raise ValueError("PLGWBNoise needs TNGWAMP and TNGWGAM")
+
+    def hyper_param_names(self, params):
+        return [n for n in ("TNGWAMP", "TNGWGAM") if n in params]
+
+    def host_columns(self, toas, params):
+        cols = super().host_columns(toas, params)
+        cols["noise_tspan"] = _tspan_col(toas)
+        return cols
+
+    def gwb_weights(self, params: dict, freqs: Array) -> Array:
+        """phi_gw(eta) at the common frequencies (traced — the joint
+        likelihood's only hyperparameter-dependent common quantity)."""
+        amp = 10.0 ** leaf_to_f64(params["TNGWAMP"])
+        gamma = leaf_to_f64(params["TNGWGAM"])
+        return powerlaw_psd_weights(freqs, amp, gamma) * freqs[0]
+
+    def gwb_basis(self, params: dict, tensor: dict, sl,
+                  tspan) -> tuple[Array, Array]:
+        """(G (N_data, 2 nf), phi (2 nf,)) on the COMMON span `tspan` —
+        every pulsar of the array must pass the same span so the mode
+        frequencies line up across the ORF coupling."""
+        t = tensor["t_hi"][sl]
+        G, freqs = fourier_basis(t, self.nf, tspan)
+        return G, self.gwb_weights(params, freqs)
+
+    def basis_and_weights(self, params, tensor, sl):
+        # solo-marginal mode: auto term only, per-pulsar span
+        t = tensor["t_hi"][sl]
+        G, freqs = fourier_basis(t, self.nf, tensor["noise_tspan"][0, 0])
+        return ("dense", G, self.gwb_weights(params, freqs))
+
+
 class PLDMNoise(NoiseComponent):
     """Power-law dispersion-measure noise: the red-noise Fourier basis
     scaled by (1400 MHz / f)^2 per TOA (reference noise_model.py:400-510,

@@ -260,12 +260,42 @@ class TimingModel:
             sigma = sigma[:-1]
         return sigma
 
-    def noise_basis_and_weights(self, params: dict, tensor: dict):
+    @property
+    def common_noise_component(self):
+        """The array-common noise process (PLGWBNoise) this model carries,
+        or None. At most one: the joint PTA likelihood couples pulsars
+        through its ORF; a second common family is a model error."""
+        out = [c for c in self.noise_components
+               if getattr(c, "common_process", False)]
+        if len(out) > 1:
+            raise ValueError(
+                f"model carries {len(out)} common noise processes; the "
+                "joint PTA likelihood supports exactly one")
+        return out[0] if out else None
+
+    def gwb_common_basis(self, params: dict, tensor: dict, tspan):
+        """(G (N_data, m), phi_gw (m,)) of the common GWB process on the
+        ARRAY-WIDE span `tspan`, or None without a common component —
+        the per-pulsar block the joint likelihood couples through
+        ORF (x) diag(phi_gw) (fitting/pta_like.py)."""
+        c = self.common_noise_component
+        if c is None:
+            return None
+        sl = slice(None, -1) if self.has_abs_phase else slice(None)
+        return c.gwb_basis(params, tensor, sl, tspan)
+
+    def noise_basis_and_weights(self, params: dict, tensor: dict,
+                                include_common: bool = True):
         """Structured correlated-noise basis (fitting/woodbury.py
         NoiseBasis) or None: dense Fourier columns concatenated, the ECORR
         epoch structure kept implicit (reference noise_model_designmatrix /
         noise_model_basis_weight, timing_model.py — which concatenate
-        everything dense)."""
+        everything dense).
+
+        ``include_common=False`` drops the common GWB process from the
+        basis: the joint PTA likelihood handles it through the
+        cross-pulsar ORF block instead (its auto term rides the ORF
+        diagonal — including it here too would double count)."""
         import jax.numpy as _jnp
 
         from pint_tpu.fitting.woodbury import NoiseBasis
@@ -274,6 +304,8 @@ class TimingModel:
         Fs, phis = [], []
         eidx = ephi = None
         for c in self.noise_components:
+            if not include_common and getattr(c, "common_process", False):
+                continue
             out = c.basis_and_weights(params, tensor, sl)
             if out is None:
                 continue

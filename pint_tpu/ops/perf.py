@@ -46,7 +46,8 @@ from pint_tpu.utils import knobs
 __all__ = [
     "PerfReport", "active", "add", "collect", "enable", "enabled",
     "fit_breakdown", "incremental_breakdown", "instrument_fit",
-    "noise_breakdown", "prepare_breakdown", "put", "put_default", "stage",
+    "noise_breakdown", "prepare_breakdown", "pta_breakdown", "put",
+    "put_default", "stage",
 ]
 
 _env_enabled = knobs.flag("PINT_TPU_PERF")
@@ -272,27 +273,22 @@ def prepare_breakdown(rep: PerfReport) -> dict:
 _NOISE_COMPONENTS = ("build", "eval", "chain", "optimize")
 
 
-def noise_breakdown(rep: PerfReport) -> dict:
-    """Map "noise"-rooted stages into the canonical noise breakdown.
-
-    The contract (enforced by the --smoke --noise bench, tests/
-    test_noise_like.py): named components + compile + trace + other
-    account for the noise wall, so the Bayesian-engine telemetry cannot
-    silently rot. Counters: `noise_loglike_evals` is every marginalized
-    likelihood (or gradient) evaluation served, `noise_chain_steps` is
-    chain-step draws (walker-steps for the stretch kernel),
-    `noise_divergences` counts masked divergent HMC trajectories.
-    """
+def _root_breakdown(rep: PerfReport, root: str,
+                    components: tuple[str, ...]) -> dict:
+    """Map `root`-rooted stages into a canonical breakdown: named
+    components + compile + trace + other partition the `root` wall
+    (compile/trace nests inside the component that triggered it and is
+    subtracted there). Shared by the noise and PTA engines."""
     wall = 0.0
-    comp = {leaf: 0.0 for leaf in _NOISE_COMPONENTS}
-    nested_ct = {leaf: 0.0 for leaf in _NOISE_COMPONENTS}
+    comp = {leaf: 0.0 for leaf in components}
+    nested_ct = {leaf: 0.0 for leaf in components}
     compile_s = trace_s = 0.0
     direct = 0.0
     for path, (total, _count) in rep.timings.items():
         segs = path.split("/")
-        if "noise" not in segs:
+        if root not in segs:
             continue
-        i = segs.index("noise")
+        i = segs.index(root)
         if len(segs) == i + 1:
             wall += total
         elif len(segs) == i + 2:
@@ -306,17 +302,63 @@ def noise_breakdown(rep: PerfReport) -> dict:
                 trace_s += total
             if len(segs) > i + 2 and segs[i + 1] in nested_ct:
                 nested_ct[segs[i + 1]] += total
-    out = {"noise_wall_s": round(wall, 4)}
-    for leaf in _NOISE_COMPONENTS:
-        # compile/trace nests inside the component that triggered it:
-        # subtract so the named fields partition the wall
-        out[f"noise_{leaf}_s"] = round(comp[leaf] - nested_ct[leaf], 4)
-    out["noise_compile_s"] = round(compile_s, 4)
-    out["noise_trace_s"] = round(trace_s, 4)
-    out["noise_other_s"] = round(max(wall - direct, 0.0), 4)
+    out = {f"{root}_wall_s": round(wall, 4)}
+    for leaf in components:
+        out[f"{root}_{leaf}_s"] = round(comp[leaf] - nested_ct[leaf], 4)
+    out[f"{root}_compile_s"] = round(compile_s, 4)
+    out[f"{root}_trace_s"] = round(trace_s, 4)
+    out[f"{root}_other_s"] = round(max(wall - direct, 0.0), 4)
+    return out
+
+
+def noise_breakdown(rep: PerfReport) -> dict:
+    """Map "noise"-rooted stages into the canonical noise breakdown.
+
+    The contract (enforced by the --smoke --noise bench, tests/
+    test_noise_like.py): named components + compile + trace + other
+    account for the noise wall, so the Bayesian-engine telemetry cannot
+    silently rot. Counters: `noise_loglike_evals` is every marginalized
+    likelihood (or gradient) evaluation served, `noise_chain_steps` is
+    chain-step draws (walker-steps for the stretch kernel),
+    `noise_divergences` counts masked divergent HMC trajectories,
+    `fleet_stack_reuse` counts bucket-padded member layouts served from
+    the per-member memo instead of re-padded (NoiseFleet /
+    PTALikelihood construction over a resident member set).
+    """
+    out = _root_breakdown(rep, "noise", _NOISE_COMPONENTS)
     out["noise_loglike_evals"] = int(rep.counters.get("noise_loglike_evals", 0))
     out["noise_chain_steps"] = int(rep.counters.get("noise_chain_steps", 0))
     out["noise_divergences"] = int(rep.counters.get("noise_divergences", 0))
+    out["fleet_stack_reuse"] = int(rep.counters.get("fleet_stack_reuse", 0))
+    return out
+
+
+# --- the canonical joint-PTA breakdown -------------------------------------------
+
+#: PTA sub-stages named in the breakdown (fitting/pta_like.py): member
+#: stacking + ORF/span assembly + joint-program setup + Laplace scales
+#: (`build`), fused joint likelihood/gradient evaluations (`eval`),
+#: vmapped joint chains (`chain`) and batched optimizer restarts
+#: (`optimize`); anything else directly under a `pta` stage lands in
+#: pta_other_s.
+_PTA_COMPONENTS = ("build", "eval", "chain", "optimize")
+
+
+def pta_breakdown(rep: PerfReport) -> dict:
+    """Map "pta"-rooted stages into the canonical joint-PTA breakdown.
+
+    Contract (the ``--smoke --pta`` bench, tests/test_pta.py): named
+    components + compile + trace + other account for >= 90% of the PTA
+    wall. Counters: `pta_loglike_evals` is every fused joint
+    likelihood/gradient evaluation, `pta_chain_steps` is joint
+    chain-step draws, `pta_divergences` counts masked divergent HMC
+    trajectories, `fleet_stack_reuse` counts member layouts served from
+    the padded-stack memo."""
+    out = _root_breakdown(rep, "pta", _PTA_COMPONENTS)
+    out["pta_loglike_evals"] = int(rep.counters.get("pta_loglike_evals", 0))
+    out["pta_chain_steps"] = int(rep.counters.get("pta_chain_steps", 0))
+    out["pta_divergences"] = int(rep.counters.get("pta_divergences", 0))
+    out["fleet_stack_reuse"] = int(rep.counters.get("fleet_stack_reuse", 0))
     return out
 
 

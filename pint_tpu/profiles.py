@@ -24,8 +24,9 @@ from __future__ import annotations
 import numpy as np
 
 __all__ = [
-    "SMOKE_PAR", "FLAGSHIP_SMOKE_PAR", "RECEIVERS",
-    "flagship_smoke_dataset", "spin_grid", "grid_for",
+    "SMOKE_PAR", "FLAGSHIP_SMOKE_PAR", "PTA_PAR_TEMPLATE", "PTA_SKY",
+    "RECEIVERS", "flagship_smoke_dataset", "pta_smoke_array", "spin_grid",
+    "grid_for",
 ]
 
 #: minimal single-receiver smoke par (astrometry + spin + DM): the
@@ -115,6 +116,88 @@ def flagship_smoke_dataset(ntoas: int, seed: int = 17):
         rng=np.random.default_rng(seed),
     )
     return model, toas
+
+
+#: PTA-profile par skeleton: spin + astrometry + DM + EFAC white
+#: rescaling + per-pulsar red noise + the COMMON GWB process
+#: (TNGWAMP/TNGWGAM bind models/noise.py PLGWBNoise; the amplitude is a
+#: strong injection so recovery harnesses and benches are informative)
+PTA_PAR_TEMPLATE = """
+PSR {name}
+RAJ {raj} 1
+DECJ {decj} 1
+F0 {f0} 1
+F1 -1.46389e-15 1
+PEPOCH 57000
+POSEPOCH 57000
+DM 14.96 1
+EFAC -f Rcvr1_2_GUPPI 1.1
+TNREDAMP -13.5
+TNREDGAM 3.0
+TNREDC 5
+TNGWAMP -12.8
+TNGWGAM 4.33
+TNGWC 6
+TZRMJD 57000.1
+TZRSITE gbt
+TZRFRQ 1400
+"""
+
+#: fixed sky grid (name, RAJ, DECJ) — spread over the sphere so the
+#: pulsar-pair angles sample the Hellings-Downs curve; the first N rows
+#: serve an N-pulsar array, so growing an array never moves the
+#: positions (or the program signatures) of the pulsars already in it
+PTA_SKY = (
+    ("PTA0000", "04:37:15.9", "-47:15:09.1"),
+    ("PTA0001", "07:40:45.79", "66:20:33.6"),
+    ("PTA0002", "19:09:47.4", "-37:44:14.4"),
+    ("PTA0003", "16:43:38.1", "-12:24:58.7"),
+    ("PTA0004", "00:02:58.2", "54:31:25.6"),
+    ("PTA0005", "10:12:33.4", "53:07:02.5"),
+    ("PTA0006", "21:24:43.8", "-33:58:44.9"),
+    ("PTA0007", "13:00:00.0", "05:00:00.0"),
+)
+
+
+def pta_smoke_array(n_pulsars: int, ntoas: int, seed: int = 29):
+    """(models, toas_list): an N-pulsar PTA array with an injected
+    Hellings-Downs-correlated GWB, per-pulsar red + white noise drawn
+    from each model's own covariance. Shapes (and every program
+    signature) depend only on (n_pulsars, ntoas); the draws only change
+    values — the contract the `pta` warmup profile and the --smoke --pta
+    bench share."""
+    from pint_tpu.io.par import parse_parfile
+    from pint_tpu.models.builder import build_model
+    from pint_tpu.simulation import (add_gwb_to_arrays,
+                                     add_noise_from_model,
+                                     make_fake_toas_fromMJDs)
+
+    if n_pulsars > len(PTA_SKY):
+        raise ValueError(
+            f"pta profile carries {len(PTA_SKY)} sky positions; "
+            f"{n_pulsars} pulsars need more rows in PTA_SKY")
+    rng = np.random.default_rng(seed)
+    models, toas_list = [], []
+    for k in range(n_pulsars):
+        name, raj, decj = PTA_SKY[k]
+        par = PTA_PAR_TEMPLATE.format(
+            name=name, raj=raj, decj=decj, f0=346.531996493 + 0.37 * k)
+        model = build_model(parse_parfile(par, from_text=True))
+        n_epochs = max(ntoas // 2, 4)
+        mjds = np.repeat(np.linspace(56300.0, 57700.0, n_epochs), 2)
+        mjds[1::2] += 0.5 / 86400.0
+        freqs = np.where(np.arange(len(mjds)) % 2 == 0, 1400.0, 800.0)
+        flags = [{"f": "Rcvr1_2_GUPPI"} for _ in mjds]
+        toas = make_fake_toas_fromMJDs(
+            np.sort(mjds), model, obs="gbt", freq_mhz=freqs, error_us=0.5,
+            flags=flags)
+        # per-pulsar noise only — the common GWB is drawn BELOW,
+        # HD-correlated across the whole array in one realization
+        toas = add_noise_from_model(toas, model, rng=rng,
+                                    include_common=False)
+        models.append(model)
+        toas_list.append(toas)
+    return models, add_gwb_to_arrays(toas_list, models, rng=rng)
 
 
 def spin_grid(model, ftr):

@@ -187,6 +187,26 @@ def make_hmc_chain(lnpost, nsteps: int, warmup: int,
     return chain
 
 
+def make_scaled_chain(make_kernel, lnpost):
+    """Laplace-scaled-coordinate wrapper shared by the noise engine and
+    the joint PTA likelihood: returns ``chain(z0, key, center, scales,
+    *ctx)`` running ``make_kernel(lnpost_z)`` in centered, scaled
+    coordinates z = (x - center) / scales — the diagonal mass matrix HMC
+    assumes — with draws mapped back to x on device. ``center``/``scales``
+    are ARGUMENTS (not closure), so a fleet vmaps per-member values
+    through one compiled program."""
+
+    def chain(z0, key, center, scales, *ctx):
+        def lnpost_z(z, *c):
+            return lnpost(center + z * scales, *c)
+
+        out = make_kernel(lnpost_z)(z0, key, *ctx)
+        out["samples"] = center + out["samples"] * scales
+        return out
+
+    return chain
+
+
 # --- the classic walker-ensemble surface ------------------------------------------
 
 #: compiled chain programs keyed on the lnpost CALLABLE (weakly, so dead

@@ -90,12 +90,15 @@ def main(argv=None) -> int:
     src.add_argument("--par", help="parfile: derive the profile from real data")
     src.add_argument("--tim", help="tim file matching --par")
     src.add_argument("--profile", default="flagship-smoke",
-                     choices=["flagship-smoke", "smoke"],
+                     choices=["flagship-smoke", "smoke", "pta"],
                      help="named synthetic profile (pint_tpu/profiles.py; "
                           "ignored when --par is given)")
     ap.add_argument("--ntoas", type=int, default=1000,
                     help="synthetic-profile TOA count (signatures depend "
                          "on it; match the workload you will run)")
+    ap.add_argument("--pulsars", type=int, default=4,
+                    help="pta-profile array size (signatures depend on "
+                         "it; match the workload you will run)")
     ap.add_argument("--maxiter", type=int, default=5,
                     help="downhill iterations for the warming fit")
     ap.add_argument("--grid-maxiter", type=int, default=1,
@@ -174,6 +177,37 @@ def main(argv=None) -> int:
     return 0
 
 
+def _pta_pass(args):
+    """One joint-PTA workload pass: build the array, GLS-fit every
+    pulsar (the linearization points), then run the joint-likelihood,
+    gradient, batch and a short chain program so every `pta_*`
+    executable exports a `.aotx` artifact (bench.py --smoke --pta runs
+    the matching shapes). Fresh objects every call — the verify pass
+    proves the whole set deserializes with zero traces."""
+    import copy
+
+    from pint_tpu import profiles
+    from pint_tpu.fitting import DownhillGLSFitter
+    from pint_tpu.fitting.noise_like import NoiseLikelihood
+    from pint_tpu.fitting.pta_like import PTALikelihood
+    from pint_tpu.fitting.state import state_path
+
+    models, toas_list = profiles.pta_smoke_array(args.pulsars, args.ntoas)
+    ftr0 = None
+    members = []
+    for t, m in zip(toas_list, models):
+        f = DownhillGLSFitter(t, copy.deepcopy(m), fused=True)
+        res = f.fit_toas(maxiter=args.maxiter)
+        ftr0 = ftr0 or f
+        members.append(NoiseLikelihood(t, f.model))
+    pta = PTALikelihood(members)
+    pta.loglike(pta.x0)
+    pta.loglike_many([pta.x0])
+    pta.grad(pta.x0)
+    pta.sample(n_chains=2, nsteps=8, warmup=4, seed=0)
+    return models[0], toas_list[0], res, state_path(ftr0)
+
+
 def _one_pass(args):
     """One full workload pass for the profile: dataset build, fused WLS
     fit + grids, the GLS/ECORR fused fit and one noise-likelihood eval
@@ -182,6 +216,8 @@ def _one_pass(args):
     exercises deserialization instead of in-memory program caches."""
     import copy
 
+    if not args.par and args.profile == "pta":
+        return _pta_pass(args)
     model, toas = _profile_dataset(args)
 
     from pint_tpu.fitting import DownhillWLSFitter, fit_auto

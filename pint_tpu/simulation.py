@@ -175,7 +175,8 @@ def make_fake_toas_uniform(
     )
 
 
-def add_noise_from_model(toas: TOAs, model, rng=None) -> TOAs:
+def add_noise_from_model(toas: TOAs, model, rng=None,
+                         include_common: bool = True) -> TOAs:
     """Shift TOAs by one realization of the model's full noise covariance
     C = diag(sigma_scaled^2) + F phi F^T.
 
@@ -184,13 +185,16 @@ def add_noise_from_model(toas: TOAs, model, rng=None) -> TOAs:
     of every noise basis column (ECORR epoch blocks, power-law red/DM Fourier
     modes) and maps them through the basis — the same covariance the GLS
     fitter models, so GLS closure tests can inject exactly what they fit
-    (reference simulation.py:273-311)."""
+    (reference simulation.py:273-311). ``include_common=False`` leaves the
+    common GWB process out of the draw — the PTA injection flow draws it
+    HD-correlated across the array with `add_gwb_to_arrays` instead."""
     rng = rng or np.random.default_rng()
     res = Residuals(toas, model, subtract_mean=False)
     n = len(toas)
     sigma = np.asarray(model.scaled_sigma(model.params, res.tensor))[:n]
     shift = rng.standard_normal(n) * sigma
-    basis = model.noise_basis_and_weights(model.params, res.tensor)
+    basis = model.noise_basis_and_weights(model.params, res.tensor,
+                                          include_common=include_common)
     if basis is not None:
         import jax.numpy as jnp
 
@@ -208,6 +212,72 @@ def add_noise_from_model(toas: TOAs, model, rng=None) -> TOAs:
             )
         shift = shift + np.asarray(basis_matvec(basis, ae, ad))[:n]
     return _reprepare(toas, shift)
+
+
+def add_gwb_to_arrays(toas_list, models, rng=None):
+    """Shift an N-pulsar array of TOA sets by ONE Hellings-Downs-
+    correlated realization of the common GWB process the models carry
+    (models/noise.py PLGWBNoise).
+
+    The draw is the Cholesky of the coefficient prior
+    ORF (x) diag(phi_gw) on the SHARED Fourier basis: independent
+    normal mode coefficients xi_a scaled by sqrt(phi_gw) are mixed
+    across pulsars by chol(ORF) — cov(a_a, a_b) = Gamma_ab diag(phi) —
+    and mapped through each pulsar's common-basis block G_a evaluated
+    on the array-wide span. Exactly the covariance the joint PTA
+    likelihood (fitting/pta_like.py) marginalizes, so GWB
+    injection/recovery closes without reference data
+    (validation/gwb_recovery.py). Per-pulsar noise (white, ECORR,
+    pulsar red noise) is NOT drawn here — compose with
+    `add_noise_from_model` per pulsar; its basis draw must then exclude
+    the common component, which this function's companion flow in the
+    validation harness handles by drawing from models without TNGW*.
+
+    Returns the shifted TOAs list (same order)."""
+    from pint_tpu.models.noise import orf_matrix, pulsar_position
+
+    rng = rng or np.random.default_rng()
+    if len(toas_list) != len(models):
+        raise ValueError("toas_list and models must pair up")
+    comps = [m.common_noise_component for m in models]
+    if any(c is None for c in comps):
+        raise ValueError("every model needs a common GWB component "
+                         "(TNGWAMP/TNGWGAM) to draw a correlated GWB")
+    nf = comps[0].nf
+    if any(c.nf != nf for c in comps):
+        raise ValueError("array common-process mode counts differ")
+    n = len(models)
+    orf = orf_matrix(np.stack([pulsar_position(m) for m in models]))
+    L = np.linalg.cholesky(orf)
+
+    # the shared span + per-pulsar time columns, in the common absolute
+    # t convention (tensor t_hi: TDB seconds since the tensor epoch)
+    res = [Residuals(t, m, subtract_mean=False)
+           for t, m in zip(toas_list, models)]
+    t_cols, lo, hi = [], np.inf, -np.inf
+    for t, r, m in zip(toas_list, res, models):
+        tc = np.asarray(r.tensor["t_hi"])[: len(t)]
+        real = np.asarray(t.error_us) > 0
+        tr = tc[real] if real.any() else tc
+        lo, hi = min(lo, tr.min()), max(hi, tr.max())
+        t_cols.append(tc)
+    tspan = hi - lo
+
+    import jax.numpy as jnp
+
+    from pint_tpu.models.noise import fourier_basis
+
+    m_modes = 2 * nf
+    freqs = np.repeat(np.linspace(1.0 / tspan, nf / tspan, nf), 2)
+    phi = np.asarray(comps[0].gwb_weights(models[0].params,
+                                          jnp.asarray(freqs)))
+    xi = rng.standard_normal((n, m_modes)) * np.sqrt(phi)
+    coeff = L @ xi  # (N, m): HD-mixed mode coefficients
+    out = []
+    for a, (t, m) in enumerate(zip(toas_list, models)):
+        G, _ = fourier_basis(jnp.asarray(t_cols[a]), nf, tspan)
+        out.append(_reprepare(t, np.asarray(G) @ coeff[a]))
+    return out
 
 
 def make_fake_toas_fromtim(timfile: str, model, add_noise: bool = False, rng=None) -> TOAs:

@@ -1,0 +1,574 @@
+"""Joint PTA likelihood tests (fitting/pta_like.py + PLGWBNoise + the
+HD-correlated injection flow).
+
+Locks the ISSUE-12 acceptance surface:
+- golden parity: fused HD-coupled joint likelihood == dense-Cholesky
+  joint reference <= 1e-8 rel across N in {2, 4, 8} pulsars with
+  EFAC/EQUAD/ECORR + per-pulsar red noise + the common GWB, INCLUDING
+  the joint hyperparameter gradient (jax.grad vs finite differences);
+- sharded == single-device <= 1e-10 over the batch-axis mesh (gradient
+  taken from outside the shard_map), chain draws bitwise;
+- the Hellings-Downs ORF against known values, and the GWB recovery
+  harness (validation/gwb_recovery.py) at tier-1 scale;
+- the --smoke --pta bench contract: strict-clean jaxpr audit (collective
+  placement on the batch-axis psum included), empty degradation ledger
+  under PINT_TPU_DEGRADED=error, >= 90% stage attribution, and the
+  >= 5x dense-joint speedup bar;
+- the padded-stack memo (`fleet_stack_reuse`) and the zero-trace AOT
+  round-trip of the pta program set.
+"""
+
+import copy
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from pint_tpu.fitting.noise_like import RIDGE, NoiseLikelihood
+from pint_tpu.fitting.pta_like import PTALikelihood
+from pint_tpu.fitting.woodbury import basis_dense
+from pint_tpu.io.par import parse_parfile
+from pint_tpu.models.builder import build_model
+from pint_tpu.models.noise import hd_orf, orf_matrix, pulsar_position
+from pint_tpu.profiles import PTA_SKY
+from pint_tpu.simulation import (add_gwb_to_arrays, add_noise_from_model,
+                                 make_fake_toas_fromMJDs)
+
+#: full noise stack per pulsar: EFAC/EQUAD/ECORR white + per-pulsar red
+#: noise + the COMMON GWB — the acceptance configuration
+PTA_TEST_PAR = """
+PSR {name}
+RAJ {raj} 1
+DECJ {decj} 1
+F0 {f0} 1
+F1 -1.46389e-15 1
+PEPOCH 57000
+POSEPOCH 57000
+DM 14.96 1
+EFAC -f Rcvr1_2_GUPPI 1.1
+EQUAD -f Rcvr1_2_GUPPI 0.3
+ECORR -f Rcvr1_2_GUPPI 0.5
+TNREDAMP -13.2
+TNREDGAM 3.0
+TNREDC 4
+TNGWAMP -12.9
+TNGWGAM 4.33
+TNGWC 3
+TZRMJD 57000.1
+TZRSITE gbt
+TZRFRQ 1400
+"""
+
+
+#: ragged-array configuration: no ECORR (epoch-count shapes must match
+#: across a fleet — the existing NoiseFleet skeleton contract), so TOA
+#: counts can differ per pulsar and the bucket padding carries them
+PTA_RAGGED_PAR = PTA_TEST_PAR.replace("ECORR -f Rcvr1_2_GUPPI 0.5\n", "")
+
+
+def _array(n_psr: int, n_epochs: int = 8, seed: int = 5,
+           par: str = PTA_TEST_PAR, ragged: bool = False):
+    """(members-ready toas, models): N-pulsar array with the full noise
+    stack and one HD-correlated GWB realization injected."""
+    rng = np.random.default_rng(seed)
+    models, toas_list = [], []
+    for k in range(n_psr):
+        name, raj, decj = PTA_SKY[k]
+        parx = par.format(name=name, raj=raj, decj=decj,
+                          f0=346.531996493 + 0.37 * k)
+        model = build_model(parse_parfile(parx, from_text=True))
+        mjds = np.repeat(np.linspace(56600.0, 57400.0,
+                                     n_epochs + (k if ragged else 0)), 2)
+        mjds[1::2] += 0.5 / 86400.0
+        freqs = np.where(np.arange(len(mjds)) % 2 == 0, 1400.0, 800.0)
+        flags = [{"f": "Rcvr1_2_GUPPI"} for _ in mjds]
+        toas = make_fake_toas_fromMJDs(
+            np.sort(mjds), model, obs="gbt", freq_mhz=freqs, error_us=1.0,
+            flags=flags)
+        toas = add_noise_from_model(toas, model, rng=rng,
+                                    include_common=False)
+        models.append(model)
+        toas_list.append(toas)
+    return add_gwb_to_arrays(toas_list, models, rng=rng), models
+
+
+def _pta(n_psr: int, n_epochs: int = 8, seed: int = 5, **kw):
+    toas_list, models = _array(n_psr, n_epochs, seed)
+    members = [NoiseLikelihood(t, copy.deepcopy(m))
+               for t, m in zip(toas_list, models)]
+    return PTALikelihood(members, **kw)
+
+
+@pytest.fixture(scope="module")
+def pta4():
+    return _pta(4)
+
+
+@pytest.fixture(scope="module")
+def members2():
+    """Shared 2-pulsar member set (full noise stack) — reused by the
+    profiled-mode, mesh-guard and stack-memo tests; PTALikelihood /
+    NoiseFleet construction never mutates members."""
+    toas_list, models = _array(2, n_epochs=6, seed=21)
+    return [NoiseLikelihood(t, copy.deepcopy(m))
+            for t, m in zip(toas_list, models)]
+
+
+def _dense_joint(pta: PTALikelihood, eta, marginalize: bool = True):
+    """Independent dense-Cholesky joint reference: materialize the full
+    (sum N_a) x (sum N_a) HD-coupled covariance from each member's
+    UNPADDED rows, profile every timing column jointly — scipy on host,
+    sharing no algebra with the fused kernel."""
+    import scipy.linalg as sl
+
+    n = len(pta.members)
+    h = len(pta.psr_hyper)
+    eta_psr = np.asarray(eta)[: n * h].reshape(n, h)
+    eta_gw = np.asarray(eta)[n * h:]
+    tspan = pta.gw_tspan
+    nf = pta.gw_comp.nf
+    freqs = np.repeat(np.linspace(1.0 / tspan, nf / tspan, nf), 2)
+    phi_gw = np.asarray(pta.gw_comp.gwb_weights(
+        {pta.gw_hyper[0]: jnp.asarray(eta_gw[0]),
+         pta.gw_hyper[1]: jnp.asarray(eta_gw[1])}, jnp.asarray(freqs)))
+    Cs, Gs, rs, Ms, norms, ns = [], [], [], [], [], []
+    for a, nl in enumerate(pta.members):
+        params = dict(nl._params0)
+        for i, nm in enumerate(pta.psr_hyper):
+            params[nm] = jnp.asarray(float(eta_psr[a, i]))
+        tensor = nl.resids.tensor
+        sigma = np.asarray(nl.model.scaled_sigma(params, tensor))
+        na = sigma.size
+        C = np.diag(sigma**2)
+        basis = nl.model.noise_basis_and_weights(params, tensor,
+                                                 include_common=False)
+        if basis is not None:
+            F, ph = (np.asarray(x) for x in basis_dense(basis, na))
+            C = C + (F * ph) @ F.T
+        Cs.append(C)
+        Gs.append(np.asarray(
+            nl.model.gwb_common_basis(params, tensor, tspan)[0]))
+        rs.append(np.asarray(nl._vecs["r0"]))
+        Ms.append(np.asarray(nl._vecs["Mn"]))
+        norms.append(np.asarray(nl._mnorm))
+        ns.append(na)
+    Ntot = sum(ns)
+    off = np.cumsum([0] + ns)
+    C = np.zeros((Ntot, Ntot))
+    for a in range(n):
+        C[off[a]:off[a + 1], off[a]:off[a + 1]] += Cs[a]
+        for b in range(n):
+            C[off[a]:off[a + 1], off[b]:off[b + 1]] += (
+                Gs[a] * (pta.orf[a, b] * phi_gw)) @ Gs[b].T
+    r = np.concatenate(rs)
+    cf = sl.cho_factor(C)
+    Cinv_r = sl.cho_solve(cf, r)
+    chi2 = r @ Cinv_r
+    ld = 2.0 * np.sum(np.log(np.diag(cf[0])))
+    p = Ms[0].shape[1]
+    M = np.zeros((Ntot, n * p))
+    for a in range(n):
+        M[off[a]:off[a + 1], a * p:(a + 1) * p] = Ms[a]
+    n_prof = 0.0
+    if p:
+        A = M.T @ sl.cho_solve(cf, M) + RIDGE * np.eye(n * p)
+        b = M.T @ Cinv_r
+        cfA = sl.cho_factor(A)
+        chi2 -= b @ sl.cho_solve(cfA, b)
+        if marginalize:
+            ld += 2.0 * np.sum(np.log(np.diag(cfA[0])))
+            ld += 2.0 * sum(np.sum(np.log(nm)) for nm in norms)
+            n_prof = float(n * p)
+    return -0.5 * (chi2 + ld + (Ntot - n_prof) * np.log(2 * np.pi))
+
+
+class TestHellingsDowns:
+    def test_known_values(self):
+        # theta -> 0+: x -> 0, Gamma -> 1/2 (distinct-pulsar limit)
+        assert float(hd_orf(jnp.asarray(1.0 - 1e-12))) == pytest.approx(
+            0.5, abs=1e-6)
+        # antipodal: x = 1 -> -1/4 + 1/2 = 1/4
+        assert float(hd_orf(jnp.asarray(-1.0))) == pytest.approx(0.25)
+        # 90 degrees: x = 1/2 -> 0.75 ln(1/2) - 1/8 + 1/2
+        assert float(hd_orf(jnp.asarray(0.0))) == pytest.approx(
+            0.75 * np.log(0.5) + 0.375)
+
+    def test_orf_matrix_properties(self):
+        from pint_tpu.io.par import parse_parfile as pp
+
+        models = []
+        for name, raj, decj in PTA_SKY:
+            par = PTA_TEST_PAR.format(name=name, raj=raj, decj=decj,
+                                      f0=346.5)
+            models.append(build_model(pp(par, from_text=True)))
+        pos = np.stack([pulsar_position(m) for m in models])
+        np.testing.assert_allclose(np.sum(pos**2, axis=1), 1.0,
+                                   rtol=1e-12)
+        orf = orf_matrix(pos)
+        assert np.allclose(orf, orf.T)
+        assert np.allclose(np.diag(orf), 1.0)
+        # positive definite for generic positions (the Phi^-1 Cholesky
+        # the joint coupling takes)
+        assert np.min(np.linalg.eigvalsh(orf)) > 0
+        # off-diagonal entries live on the HD curve, strictly below the
+        # auto term
+        iu = np.triu_indices(len(models), k=1)
+        assert np.max(orf[iu]) < 0.51
+
+
+class TestGoldenParity:
+    @pytest.mark.slow
+    @pytest.mark.parametrize("n_psr", [2, 8])
+    def test_fused_equals_dense_joint(self, n_psr):
+        """Fused joint HD likelihood == dense-Cholesky joint reference
+        <= 1e-8 rel at the injected values and perturbed eta, for small
+        and wide arrays (EFAC/EQUAD/ECORR + red noise + GWB)."""
+        pta = _pta(n_psr, n_epochs=6, seed=11 + n_psr)
+        rng = np.random.default_rng(3)
+        for k in range(2):
+            eta = pta.x0 + (0.3 * pta.scales
+                            * rng.standard_normal(pta.nparams) if k
+                            else 0.0)
+            a = pta.loglike(eta)
+            b = _dense_joint(pta, eta)
+            assert abs(a - b) <= 1e-8 * abs(b), (n_psr, k, a, b)
+
+    def test_fused_equals_dense_joint_n4(self, pta4):
+        pta = pta4
+        rng = np.random.default_rng(4)
+        for k in range(3):
+            eta = pta.x0 + (0.3 * pta.scales
+                            * rng.standard_normal(pta.nparams) if k
+                            else 0.0)
+            a = pta.loglike(eta)
+            b = _dense_joint(pta, eta)
+            assert abs(a - b) <= 1e-8 * abs(b), (k, a, b)
+
+    def test_ragged_array_parity(self):
+        """Ragged TOA counts (different per pulsar) ride the shared
+        power-of-two bucket — pad rows carry zero weight and the fused
+        joint still matches the dense reference built from the UNPADDED
+        rows. (ECORR-free config: epoch-count shapes are the one
+        skeleton axis the fleet contract pins.)"""
+        toas_list, models = _array(3, n_epochs=6, seed=17,
+                                   par=PTA_RAGGED_PAR, ragged=True)
+        counts = {len(t) for t in toas_list}
+        assert len(counts) == 3  # genuinely ragged
+        members = [NoiseLikelihood(t, copy.deepcopy(m))
+                   for t, m in zip(toas_list, models)]
+        pta = PTALikelihood(members)
+        rng = np.random.default_rng(8)
+        for k in range(2):
+            eta = pta.x0 + (0.3 * pta.scales
+                            * rng.standard_normal(pta.nparams) if k
+                            else 0.0)
+            a = pta.loglike(eta)
+            b = _dense_joint(pta, eta)
+            assert abs(a - b) <= 1e-8 * abs(b), (k, a, b)
+
+    def test_profiled_mode_parity(self, members2):
+        """marginalize_timing=False (the ML objective) against the dense
+        reference — also the tier-1 N=2 parity lock (the wider-N dense
+        parity sweep rides the slow tier)."""
+        pta = PTALikelihood(members2, marginalize_timing=False)
+        a = pta.loglike(pta.x0)
+        b = _dense_joint(pta, pta.x0, marginalize=False)
+        assert abs(a - b) <= 1e-8 * abs(b)
+        ptam = PTALikelihood(members2)
+        am = ptam.loglike(ptam.x0)
+        bm = _dense_joint(ptam, ptam.x0, marginalize=True)
+        assert abs(am - bm) <= 1e-8 * abs(bm)
+
+    def test_gradient_vs_finite_differences(self, pta4):
+        """jax.grad of the fused joint program vs central finite
+        differences over every coordinate — per-pulsar noise blocks AND
+        the common (log10_A_gw, gamma_gw) pair."""
+        pta = pta4
+        g = pta.grad(pta.x0)
+        assert np.isfinite(g).all()
+        for i in range(pta.nparams):
+            h = 1e-6 * max(abs(pta.x0[i]), 1e-3)
+            ep, em = pta.x0.copy(), pta.x0.copy()
+            ep[i] += h
+            em[i] -= h
+            fd = (pta.loglike(ep) - pta.loglike(em)) / (2 * h)
+            assert g[i] == pytest.approx(fd, rel=2e-4, abs=1e-6), \
+                pta.hyper[i]
+
+    def test_batch_matches_pointwise(self, pta4):
+        pta = pta4
+        rng = np.random.default_rng(11)
+        etas = pta.x0 + 0.05 * pta.scales * rng.standard_normal(
+            (5, pta.nparams))
+        batch = pta.loglike_many(etas, chunk=4)  # forces one padded chunk
+        for i in range(5):
+            assert batch[i] == pytest.approx(pta.loglike(etas[i]),
+                                             rel=1e-12)
+
+    def test_coordinate_layout(self, pta4):
+        pta = pta4
+        assert pta.psr_hyper == ("EFAC1", "EQUAD1", "ECORR1",
+                                 "TNREDAMP", "TNREDGAM")
+        assert pta.gw_hyper == ("TNGWAMP", "TNGWGAM")
+        assert pta.nparams == 4 * 5 + 2
+        assert pta.hyper[0] == "PTA0000:EFAC1"
+        assert pta.hyper[-2:] == ("TNGWAMP", "TNGWGAM")
+        # the GWB is excluded from the per-pulsar basis: perturbing the
+        # common pair must not move the per-pulsar Woodbury terms, only
+        # the coupling (checked implicitly by parity; here: the prior)
+        assert pta.priors["TNGWAMP"].lo == -20.0
+
+
+class TestSharded:
+    def test_sharded_equals_single(self, members2):
+        """Batch-axis-sharded joint surfaces == single-device <= 1e-10
+        rel (value, gradient from OUTSIDE the shard_map)."""
+        import pint_tpu.distributed as dist
+
+        if len(jax.devices()) < 2:
+            pytest.skip("needs the multi-device virtual mesh")
+        pta1 = PTALikelihood(members2)
+        mesh = dist.pta_mesh(2)
+        assert mesh is not None and dict(mesh.shape)["batch"] == 2
+        ptas = PTALikelihood(members2, mesh=mesh)
+        eta = pta1.x0 * (1.0 + 0.02 * np.arange(pta1.nparams))
+        a, b = pta1.loglike(eta), ptas.loglike(eta)
+        assert abs(a - b) <= 1e-10 * abs(a)
+        ga, gb = pta1.grad(eta), ptas.grad(eta)
+        assert np.max(np.abs(ga - gb)
+                      / np.maximum(np.abs(ga), 1e-12)) <= 1e-8
+        # chains consume the REPLICATED layout on both: same stacked
+        # arrays (no row re-layout for the batch mesh), same structural
+        # program key — mesh choice cannot move a draw by construction
+        assert pta1._plain_data["slot"].shape \
+            == ptas._plain_data["slot"].shape
+        assert pta1._aot_base() == ptas._aot_base()
+
+    def test_mesh_divisibility_guard(self, members2):
+        import pint_tpu.distributed as dist
+
+        if len(jax.devices()) < 2:
+            pytest.skip("needs the multi-device virtual mesh")
+        # pta_mesh never hands out a non-dividing layout
+        m = dist.pta_mesh(3)
+        if m is not None:
+            assert 3 % dict(m.shape)["batch"] == 0
+        bad = dist.global_mesh({"batch": 8})
+        with pytest.raises(ValueError, match="divide"):
+            PTALikelihood(members2, mesh=bad)
+
+
+class TestChains:
+    # tier-1 keeps joint-chain coverage through the --smoke --pta
+    # contract and the recovery harness; the dedicated trajectory locks
+    # below compile extra chain programs, so they ride the slow tier
+    @pytest.mark.slow
+    def test_vmapped_equals_solo(self):
+        """A joint chain inside the vmapped fleet == the same chain id
+        run solo <= 1e-10 (HMC over per-pulsar noise + the common pair
+        in Laplace-scaled coordinates), and reruns are bitwise
+        deterministic. (Solo-parity is locked on the N=2 array: at
+        wider shapes XLA batches the joint coupling matmuls
+        differently per vmap width, and HMC amplifies that last-ulp
+        reduction-order noise over a trajectory — same-width runs stay
+        bitwise equal, which the wide fixture test below locks.)"""
+        pta = _pta(2, n_epochs=6, seed=71)
+        fleet = pta.sample(n_chains=3, nsteps=12, warmup=8, kernel="hmc",
+                           seed=3)
+        again = pta.sample(n_chains=3, nsteps=12, warmup=8, kernel="hmc",
+                           seed=3)
+        np.testing.assert_array_equal(fleet.samples, again.samples)
+        solo = pta.sample(nsteps=12, warmup=8, kernel="hmc", seed=3,
+                          chain_ids=[1])
+        ref = fleet.samples[1]
+        d = np.abs(solo.samples[0] - ref) / np.maximum(np.abs(ref),
+                                                       1e-300)
+        assert d.max() <= 1e-10
+        assert fleet.samples.shape == (3, 12, pta.nparams)
+        assert np.isfinite(fleet.lnpost).all()
+
+    @pytest.mark.slow
+    def test_joint_chain_over_full_noise_block(self, pta4):
+        """HMC over the FULL joint coordinate set (4 pulsars x 5 noise
+        hyperparameters + the common pair, dim 22) advances as one
+        vmapped program with finite posteriors and draws inside the
+        prior support."""
+        pta = pta4
+        out = pta.sample(n_chains=2, nsteps=10, warmup=6, seed=5)
+        assert out.samples.shape == (2, 10, 22)
+        assert np.isfinite(out.lnpost).all()
+        gw = out.samples[:, :, -2]
+        assert (gw > -20.0).all() and (gw < -8.0).all()
+
+    def test_pair_correlations_surface(self, pta4):
+        pc = pta4.pair_correlations(pta4.x0)
+        assert pc["rho"].shape == (6,)  # 4 choose 2
+        assert np.isfinite(pc["rho"]).all()
+        np.testing.assert_allclose(
+            pc["hd"], [pta4.orf[a, b] for a in range(4)
+                       for b in range(a + 1, 4)], rtol=1e-12)
+
+
+class TestFleetStackMemo:
+    def test_padded_stack_reused(self):
+        """The ISSUE-12 small fix: a ragged fleet's bucket-padded member
+        layouts are memoized per (member, bucket) — the second fleet
+        construction over the same members re-pads nothing and the
+        `fleet_stack_reuse` counter lands in the noise breakdown."""
+        from pint_tpu.fitting.noise_like import NoiseFleet
+        from pint_tpu.ops import perf
+
+        toas_list, models = _array(2, n_epochs=6, seed=51)
+        members = [NoiseLikelihood(t, copy.deepcopy(m))
+                   for t, m in zip(toas_list, models)]
+        f1 = NoiseFleet(members)   # primes the per-member memo
+        with perf.collect() as rep:
+            f2 = NoiseFleet(members)
+        bd = perf.noise_breakdown(rep)
+        assert bd["fleet_stack_reuse"] == len(members)
+        # the memo returns the SAME padded arrays — no fresh transfer
+        l1 = members[0]._layout_padded(f1.rows)
+        l2 = members[0]._layout_padded(f2.rows)
+        assert l1["r0"] is l2["r0"]
+        # and the joint likelihood rides the same memo
+        with perf.collect() as rep2:
+            PTALikelihood(members)
+        assert perf.pta_breakdown(rep2)["fleet_stack_reuse"] \
+            == len(members)
+
+
+TIME_GBT = """# time_gbt.dat
+ 40000.00    2.000
+ 62000.00    2.000
+"""
+GPS2UTC = """# gps2utc.clk
+ 40000.00    0.000
+ 62000.00    0.000
+"""
+
+
+class TestPtaBenchContract:
+    def test_smoke_pta_bench_contract(self, tmp_path, monkeypatch):
+        """bench.py --smoke --pta tier-1 contract: strict-clean jaxpr
+        audit over every pta program (ddflow + collective placement on
+        the batch-axis psum), empty degradation ledger under
+        PINT_TPU_DEGRADED=error, >= 90% stage attribution of the pta
+        wall, and the fused joint >= 5x the dense-joint baseline."""
+        import bench
+        from pint_tpu.ops import degrade
+
+        clk = tmp_path / "clk"
+        clk.mkdir()
+        (clk / "time_gbt.dat").write_text(TIME_GBT)
+        (clk / "gps2utc.clk").write_text(GPS2UTC)
+        monkeypatch.setenv("PINT_CLOCK_OVERRIDE", str(clk))
+        monkeypatch.setenv("PINT_TPU_DEGRADED", "error")
+        monkeypatch.setenv("PINT_TPU_AUDIT", "strict")
+        degrade.reset_ledger()
+        rec = bench.smoke_pta_bench(n_pulsars=4, ntoas=96, n_evals=1024,
+                                    n_chains=2, nsteps=25, warmup=15,
+                                    baseline_evals=8, kernel="stretch")
+        # headline fields present and meaningful
+        assert rec["gwb_loglike_evals_per_sec_per_chip"] > 0
+        assert rec["pta_pulsars_per_chip"] > 0
+        # the acceptance bar: fused joint >= 5x the dense-joint host
+        # loop at smoke shape, compile included both sides
+        assert rec["gwb_vs_dense_baseline"] >= 5.0, rec
+        # on the multi-device tier-1 mesh the pulsars really sharded
+        if rec["n_devices"] >= 4:
+            assert rec["pta_batch_shards"] == 4
+        # >= 90% stage attribution of the pta wall
+        named = (rec["pta_build_s"] + rec["pta_eval_s"]
+                 + rec["pta_chain_s"] + rec["pta_optimize_s"]
+                 + rec["pta_compile_s"] + rec["pta_trace_s"])
+        assert named >= 0.9 * rec["pta_wall_s"] - 0.01, rec
+        assert named + rec["pta_other_s"] == pytest.approx(
+            rec["pta_wall_s"], rel=0.02, abs=0.02)
+        # counters flowed
+        assert rec["pta_loglike_evals"] >= 1024
+        # stretch kernel: walker-steps; at least chains x steps flowed
+        assert rec["pta_chain_steps"] >= 2 * 25
+        # strict audit ran clean over every pta program, including the
+        # batch-axis collective placement when sharded
+        assert rec["audit"]["mode"] == "strict"
+        assert rec["audit"]["n_violations"] == 0
+        assert any(lbl.startswith("pta_")
+                   for lbl in rec["audit"]["signatures"])
+        # no corners cut: the ledger stayed empty with writes escalated
+        assert rec["degradation_count"] == 0
+        assert rec["degradation_kinds"] == []
+
+
+def test_recovery_harness_tier1():
+    """The ISSUE-12 acceptance harness at tier-1 scale: inject an
+    HD-correlated GWB, recover the joint (log10_A_gw, gamma_gw)
+    posterior with vmapped joint HMC chains, assert convergence and
+    that the injection lives inside the posterior; the checked-in
+    full-K summary carries the calibrated coverage + HD-curve verdicts."""
+    import json
+    import sys
+    from pathlib import Path
+
+    root = Path(__file__).resolve().parent.parent
+    sys.path.insert(0, str(root))
+    from validation import gwb_recovery as gr
+
+    s = gr.run(n_arrays=1, n_pulsars=4, ntoas=40, n_chains=4,
+               nsteps=2000)
+    assert s["rhat_max"] < 1.05, s
+    for name in ("TNGWAMP", "TNGWGAM"):
+        for row in s["arrays"]:
+            q = row[name]["quantile_of_injection"]
+            # the injection must live inside the central 99.5%
+            assert 0.0025 < q < 0.9975, (name, row)
+    assert np.isfinite(s["delta_lnL_hd_vs_uncorrelated_mean"])
+    assert len(s["hd_curve"]) == 6
+    # the checked-in full-K run's verdicts hold (regenerate with
+    # `python validation/gwb_recovery.py` after harness changes)
+    full = json.loads(
+        (root / "validation" / "gwb_recovery_summary.json").read_text())
+    assert full["verdict"]["rhat_converged"], full["verdict"]
+    assert full["verdict"]["coverage_calibrated"], full["verdict"]
+    assert full["verdict"]["hd_correlations_detected"], full["verdict"]
+
+
+class TestAotRoundTrip:
+    # the `pint_tpu warmup --profile pta` verify pass proves the same
+    # contract end-to-end; the in-suite round-trip rides the slow tier
+    @pytest.mark.slow
+    def test_pta_programs_zero_trace_on_rebuild(self, tmp_path,
+                                                monkeypatch):
+        """PINT_TPU_EXPECT_WARM contract for the pta program set: with
+        the artifact store on, a FRESH member/joint build (the warmup
+        CLI's verify pass, in miniature) serves every program by
+        deserialization — zero traces."""
+        from pint_tpu.analysis.jaxpr_audit import compile_count
+        from pint_tpu.ops import compile as pcompile
+
+        monkeypatch.setenv("PINT_TPU_CACHE_DIR", str(tmp_path))
+        monkeypatch.setenv("PINT_TPU_AOT_EXPORT", "1")
+        pcompile.setup_persistent_cache(force=True)
+        try:
+            toas_list, models = _array(2, n_epochs=6, seed=61)
+
+            def one_pass():
+                members = [NoiseLikelihood(t, copy.deepcopy(m))
+                           for t, m in zip(toas_list, models)]
+                pta = PTALikelihood(members)
+                pta.loglike(pta.x0)
+                pta.grad(pta.x0)
+
+            one_pass()
+            before = compile_count()
+            one_pass()
+            assert compile_count() == before, \
+                "pta rebuild traced — AOT coverage gap"
+            blk = pcompile.aot_block()
+            for lbl in ("pta_loglike", "pta_loglike_grad"):
+                assert blk["labels"][lbl]["hits"] >= 1, blk["labels"]
+        finally:
+            monkeypatch.undo()
+            pcompile.reset_aot_stats()
+            pcompile.setup_persistent_cache(force=True)
