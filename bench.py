@@ -1724,6 +1724,329 @@ def smoke_session_bench(ntoas: int = 700, n_appends: int = 10, k: int = 8,
     return rec
 
 
+def smoke_serve_bench(base_rows=(160, 200, 240), requests_per_session: int = 8,
+                      k: int = 1, max_wait_ms: float = 25.0,
+                      overload_depth: int = 4, overload_offered: int = 12,
+                      include_refits: bool = True) -> dict:
+    """CPU serving-engine smoke bench: a replayed concurrent-client trace
+    against the continuous-batching :class:`~pint_tpu.serve.ServingEngine`
+    over a mixed warm-session fleet (pint_tpu/profiles.py
+    ``serve_smoke_fleet``).
+
+    Four legs, one record:
+
+    - **nominal** (the headline): one client thread per session replays
+      its append stream into the running engine; same-session requests
+      coalesce into rank-k updates and (``include_refits``) cross-session
+      refits batch through ``fit_batch`` — measured as
+      ``sustained_append_fits_per_sec`` with per-request
+      ``serve_p50_ms``/``serve_p99_ms`` from the engine's bounded
+      quantile sketches, and ≥90% of the serve wall named by
+      ``serve_breakdown``. The comparator is the SAME trace drained one
+      request at a time on a twin fleet (``serial_append_fits_per_sec``;
+      both fleets pay their program warmup identically at session-fit
+      time, so neither side hides a compile) — acceptance bar
+      ``serve_vs_serial >= 2``.
+    - **overload**: more offered requests than the bounded queue admits,
+      against a NOT-yet-draining engine — admission sheds the excess
+      (``serve.shed`` on the degradation ledger, under a forced
+      PINT_TPU_DEGRADED=warn so the record survives; =error turns the
+      same path into a refusal, asserted by tests/test_serve.py) and the
+      served requests' p99 stays bounded by the queue depth
+      (``overload.p99_bound_ms``), not the offered load.
+    - **chaos** (``PINT_TPU_FAULTS=serve.admit:shed,serve.pool:evict``):
+      a forced shed plus a forced warm-pool eviction mid-trace — the
+      brownout drill: throughput degrades (a restore is paid), the
+      ledger explains (``serve.shed`` + ``serve.evict``), everything
+      admitted is answered, and the evicted-then-restored session
+      answers with ``traces_on_warm == 0`` (checkpoint/restore rides the
+      process program caches + the ``.aotx`` artifact store, never a
+      retrace).
+
+    Tier-1 contract (tests/test_serve.py): nominal legs strict-audit
+    clean with an EMPTY degradation ledger under PINT_TPU_DEGRADED=error,
+    ≥2x serial throughput, ≥90% serve attribution, shed events present
+    (and refusable) under overload, ``traces_on_warm == 0``. Run from
+    the CLI with ``python bench.py --smoke --serve`` (one JSON line).
+    """
+    from pint_tpu.ops.compile import setup_persistent_cache
+
+    setup_persistent_cache()
+    # the serving smoke measures SERVING mechanics (coalescing, batching,
+    # shedding, restore), not ephemeris accuracy: the N-body refinement
+    # quantizes its window per request span, so a narrow append span
+    # would integrate a DIFFERENT window than the base prepare and the
+    # appended rows would be inconsistent with the resident columns —
+    # exactly the geometry-staleness class the session guards against.
+    # Pin the analytic path for the bench (tier-1 already runs with
+    # PINT_TPU_NBODY=0) and restore the caller's env afterwards.
+    prev_nbody = os.environ.get("PINT_TPU_NBODY")
+    os.environ["PINT_TPU_NBODY"] = "0"
+    try:
+        return _smoke_serve_bench_body(
+            base_rows, requests_per_session, k, max_wait_ms,
+            overload_depth, overload_offered, include_refits)
+    finally:
+        if prev_nbody is None:
+            os.environ.pop("PINT_TPU_NBODY", None)
+        else:
+            os.environ["PINT_TPU_NBODY"] = prev_nbody
+
+
+def _smoke_serve_bench_body(base_rows, requests_per_session, k, max_wait_ms,
+                            overload_depth, overload_offered,
+                            include_refits) -> dict:
+    import copy
+    import threading
+
+    import jax
+
+    from pint_tpu.analysis.jaxpr_audit import compile_count
+    from pint_tpu.astro import time as ptime
+    from pint_tpu.fitting.wls import apply_delta
+    from pint_tpu.ops import perf
+    from pint_tpu.profiles import serve_smoke_fleet
+    from pint_tpu.serve import ServingEngine, SessionPool, ShedError, \
+        TimingSession
+
+    nominal_rows = requests_per_session * k
+    # extra rows beyond the nominal trace feed the overload + chaos legs
+    profile = serve_smoke_fleet(base_rows,
+                                n_append_rows=nominal_rows + 16)
+
+    def rows(full, lo, hi):
+        ep = full.utc_raw
+        return dict(
+            utc=ptime.MJDEpoch(ep.day[lo:hi], ep.frac_hi[lo:hi],
+                               ep.frac_lo[lo:hi]),
+            error_us=full.error_us[lo:hi], freq_mhz=full.freq_mhz[lo:hi],
+            obs=full.obs[lo:hi], flags=[dict(f) for f in full.flags[lo:hi]])
+
+    def build_fleet():
+        fleet = []
+        for model, full, base_n in profile:
+            m = copy.deepcopy(model)
+            free = tuple(m.free_params)
+            delta = np.array([2e-10 if nm == "F0" else 0.0 for nm in free])
+            m.params = apply_delta(m.params, free, delta)
+            base = full.select(np.arange(len(full)) < base_n)
+            ses = TimingSession(base, m)
+            ses.fit()
+            fleet.append((ses, full, base_n))
+        return fleet
+
+    t0 = time.time()
+    fleet_a = build_fleet()        # the engine's fleet
+    fleet_b = build_fleet()        # the serial one-at-a-time twin
+    setup_s = time.time() - t0
+
+    # --- nominal leg: concurrent clients into the running engine --------
+    pool = SessionPool(capacity=len(fleet_a) + 1)
+    engine = ServingEngine(pool, max_wait_ms=max_wait_ms)
+    for i, (ses, _, _) in enumerate(fleet_a):
+        engine.add_session(f"psr{i}", ses)
+
+    tickets: list = []
+    t_lock = threading.Lock()
+
+    def client(i):
+        ses, full, base_n = fleet_a[i]
+        mine = []
+        for j in range(requests_per_session):
+            lo = base_n + j * k
+            mine.append(engine.submit(
+                session=f"psr{i}", tenant=f"client{i}",
+                **rows(full, lo, lo + k)))
+        with t_lock:
+            tickets.extend(mine)
+
+    was = perf.enabled()
+    perf.enable(True)
+    with perf.collect() as rep:
+        engine.start()
+        t0 = time.time()
+        clients = [threading.Thread(target=client, args=(i,))
+                   for i in range(len(fleet_a))]
+        for th in clients:
+            th.start()
+        for th in clients:
+            th.join()
+        for t in tickets:
+            t.wait(timeout=300.0)
+        serve_wall = time.time() - t0
+        if include_refits:
+            # cross-session refit lane: fills (or deadlines) into ONE
+            # fleet-batched dispatch; outside the append-throughput span
+            refit_tickets = [engine.submit(session=f"psr{i}", kind="refit")
+                             for i in range(len(fleet_a))]
+            for t in refit_tickets:
+                t.wait(timeout=600.0)
+        engine.stop()
+    perf.enable(was)
+    breakdown = perf.serve_breakdown(rep)
+    n_requests = len(tickets)
+    sustained = n_requests / serve_wall
+    engine_stats = engine.stats()
+
+    # --- serial comparator: the SAME interleaved trace, one at a time ---
+    t0 = time.time()
+    for j in range(requests_per_session):
+        for (ses, full, base_n) in fleet_b:
+            lo = base_n + j * k
+            ses.append(**rows(full, lo, lo + k))
+    serial_wall = time.time() - t0
+    serial_rate = n_requests / serial_wall
+    if include_refits:
+        for (ses, _, _) in fleet_b:
+            ses.fit()  # the serial twin's full refits, one per session
+
+    # engine ≡ serial: every session's parameters match its twin's
+    parity = 0.0
+    from pint_tpu.models.base import leaf_to_f64
+
+    for (sa, _, _), (sb, _, _) in zip(fleet_a, fleet_b):
+        free = tuple(sa.model.free_params)
+        pa = np.array([float(np.asarray(leaf_to_f64(sa.fitter.model.params[n])))
+                       for n in free])
+        pb = np.array([float(np.asarray(leaf_to_f64(sb.fitter.model.params[n])))
+                       for n in free])
+        parity = max(parity, float(np.max(
+            np.abs(pa - pb) / np.maximum(np.abs(pb), 1e-300))))
+
+    # nominal ledger snapshot BEFORE the deliberately-degrading legs:
+    # this is the count the PINT_TPU_DEGRADED=error contract locks at 0
+    nominal_degradations = _degradation_count()
+    nominal_kinds = _degradation_kinds()
+    p50 = engine.latency.quantile(0.5)
+    p99 = engine.latency.quantile(0.99)
+
+    # --- overload leg: bounded queue sheds, p99 stays depth-bounded -----
+    prev_degraded = os.environ.get("PINT_TPU_DEGRADED")
+    prev_faults = os.environ.get("PINT_TPU_FAULTS")
+    # the shed must RECORD here (the refusal mode is locked separately in
+    # tier-1); restore whatever the caller had afterwards
+    os.environ["PINT_TPU_DEGRADED"] = "warn"
+    try:
+        ses0, full0, base0 = fleet_a[0]
+        cursor = base0 + nominal_rows
+        engine2 = ServingEngine(pool, max_wait_ms=max_wait_ms,
+                                queue_depth=overload_depth,
+                                shed_policy="reject")
+        shed = 0
+        for j in range(overload_offered):
+            lo = cursor + j * k
+            try:
+                engine2.submit(session="psr0", tenant="burst",
+                               **rows(full0, lo, lo + k))
+            except ShedError:
+                shed += 1
+        engine2.run_until_idle()
+        cursor += overload_depth * k  # only the admitted rows landed
+        p99_over = engine2.latency.quantile(0.99)
+        p99_bound = 10.0 * (overload_depth + 2) * max(p99 or 0.0, 30.0)
+        overload = {
+            "offered": overload_offered,
+            "queue_depth": overload_depth,
+            "shed": shed,
+            "served": engine2.served,
+            "serve_p99_ms": None if p99_over is None else round(p99_over, 3),
+            # non-collapse: the served tail is bounded by the queue
+            # depth x per-solve cost (generous 10x slack for CI jitter),
+            # never by the offered load
+            "p99_bound_ms": round(p99_bound, 3),
+            "degradation_kinds": _degradation_kinds(),
+        }
+
+        # --- chaos leg: PINT_TPU_FAULTS brownout drill ------------------
+        os.environ["PINT_TPU_FAULTS"] = "serve.admit:shed*1,serve.pool:evict*1"
+        evictions0, restores0 = pool.evictions, pool.restores
+        restore_s0 = pool.restore_s
+        compiles0 = compile_count()
+        engine3 = ServingEngine(pool, max_wait_ms=max_wait_ms)
+        chaos_shed = 0
+        chaos_tickets = []
+        for j in range(4):
+            lo = cursor + j * k
+            try:
+                chaos_tickets.append(engine3.submit(
+                    session="psr0", tenant="chaos",
+                    **rows(full0, lo, lo + k)))
+            except ShedError:
+                chaos_shed += 1
+        engine3.run_until_idle()
+        for t in chaos_tickets:
+            t.wait(timeout=300.0)
+        p99_chaos = engine3.latency.quantile(0.99)
+        chaos = {
+            "faults": "serve.admit:shed*1,serve.pool:evict*1",
+            "shed": chaos_shed,
+            "served": engine3.served,
+            "evictions": pool.evictions - evictions0,
+            "restores": pool.restores - restores0,
+            "restore_s": round(pool.restore_s - restore_s0, 4),
+            # the evicted-then-restored session answered WITHOUT a
+            # single program trace: checkpoint/restore is warm
+            "traces_on_warm": compile_count() - compiles0,
+            "serve_p99_ms": None if p99_chaos is None else round(p99_chaos, 3),
+            "degradation_kinds": _degradation_kinds(),
+        }
+    finally:
+        if prev_degraded is None:
+            os.environ.pop("PINT_TPU_DEGRADED", None)
+        else:
+            os.environ["PINT_TPU_DEGRADED"] = prev_degraded
+        if prev_faults is None:
+            os.environ.pop("PINT_TPU_FAULTS", None)
+        else:
+            os.environ["PINT_TPU_FAULTS"] = prev_faults
+
+    rec = {
+        "metric": "smoke_serve_bench",
+        "n_sessions": len(fleet_a),
+        "base_rows": list(base_rows),
+        "requests": n_requests,
+        "append_rows": k,
+        "backend": jax.default_backend(),
+        "n_devices": len(jax.devices()),
+        "setup_s": round(setup_s, 3),
+        # measured append-trace span (first submit -> last ticket); the
+        # breakdown's serve_wall_s (rec.update below) is the stage-tree
+        # wall including the refit leg
+        "serve_span_s": round(serve_wall, 3),
+        "sustained_append_fits_per_sec": round(sustained, 3),
+        "serial_wall_s": round(serial_wall, 3),
+        "serial_append_fits_per_sec": round(serial_rate, 3),
+        "serve_vs_serial": round(sustained / serial_rate, 2),
+        "serve_p50_ms": None if p50 is None else round(p50, 3),
+        "serve_p99_ms": None if p99 is None else round(p99, 3),
+        "refit_p99_ms": engine_stats["refit_latency"].get("p99_ms"),
+        "queue_wait_p50_ms": engine_stats["queue_wait"].get("p50_ms"),
+        "queue_wait_p99_ms": engine_stats["queue_wait"].get("p99_ms"),
+        "coalesce_ratio": engine_stats.get("coalesce_ratio"),
+        "parity_max_rel": parity,
+        "engine": engine_stats,
+        "pool": pool.stats(),
+        "overload": overload,
+        "chaos": chaos,
+        "note": "serial side = the identical interleaved trace drained "
+                "one request at a time on a twin fleet; both fleets "
+                "warmed their programs identically at session-fit time, "
+                "so the speedup is coalescing + batching, not a hidden "
+                "compile",
+        "degradation_count": nominal_degradations,
+        "degradation_kinds": nominal_kinds,
+        "static_cost": _static_cost(),
+    }
+    rec.update(breakdown)
+    try:
+        from pint_tpu.analysis.jaxpr_audit import audit_block
+
+        rec["audit"] = audit_block()
+    except Exception:  # noqa: BLE001 — telemetry only  # jaxlint: disable=silent-except — telemetry assembly
+        rec["audit"] = None
+    return rec
+
+
 def smoke_batched_bench(n_fits: int = 32, ntoas: int = 96, maxiter: int = 5,
                         compare_sequential: bool = True) -> dict:
     """CPU fleet-fit smoke bench: n_fits synthetic WLS fits as ONE batched
@@ -1831,6 +2154,9 @@ if __name__ == "__main__":
         noise = "--noise" in sys.argv
         if "--session" in sys.argv:
             print(json.dumps(smoke_session_bench()), flush=True)
+            sys.exit(0)
+        if "--serve" in sys.argv:
+            print(json.dumps(smoke_serve_bench()), flush=True)
             sys.exit(0)
         if flagship:
             print(json.dumps(smoke_flagship_bench()), flush=True)

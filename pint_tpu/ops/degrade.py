@@ -74,6 +74,14 @@ KINDS: dict[str, str] = {
         "an AOT/deserialized executable rejected its call operands "
         "(layout/sharding mismatch); the signature re-dispatches through "
         "jit, latched sticky"),
+    "serve.shed": (
+        "serving admission control refused or dropped a request under "
+        "overload (queue depth / per-tenant rate); the client saw an "
+        "explicit shed, not a collapsed tail latency"),
+    "serve.evict": (
+        "a warm resident session was evicted from the serving pool "
+        "(LRU under PINT_TPU_SERVE_POOL_SESSIONS); its next request "
+        "pays a checkpoint restore instead of a millisecond append"),
     "fetch.mirror_failed": (
         "a remote file could not be refreshed from any mirror"),
     "fetch.corrupt_quarantined": (

@@ -37,6 +37,7 @@ programmatically::
 from __future__ import annotations
 
 import functools
+import math
 import threading
 import time
 from contextlib import contextmanager
@@ -44,10 +45,10 @@ from contextlib import contextmanager
 from pint_tpu.utils import knobs
 
 __all__ = [
-    "PerfReport", "active", "add", "collect", "enable", "enabled",
-    "fit_breakdown", "incremental_breakdown", "instrument_fit",
+    "PerfReport", "QuantileSketch", "active", "add", "collect", "enable",
+    "enabled", "fit_breakdown", "incremental_breakdown", "instrument_fit",
     "noise_breakdown", "prepare_breakdown", "pta_breakdown", "put",
-    "put_default", "stage",
+    "put_default", "serve_breakdown", "stage",
 ]
 
 _env_enabled = knobs.flag("PINT_TPU_PERF")
@@ -424,6 +425,162 @@ def incremental_breakdown(rep: PerfReport) -> dict:
     out["prepare_rows"] = int(rep.counters.get("prepare_rows", 0))
     out["prepare_prefix_hits"] = int(
         rep.counters.get("prepare_prefix_hits", 0))
+    return out
+
+
+# --- bounded streaming quantiles --------------------------------------------------
+
+
+class QuantileSketch:
+    """Bounded-memory streaming quantile estimator (log-bucketed counts).
+
+    A long-lived serving process must report per-request p50/p99 without
+    holding every latency sample: this sketch buckets positive values
+    into a geometric grid of relative width ``2 * rel_err`` and answers
+    quantile queries from the cumulative bucket counts. Memory is
+    bounded by the value RANGE (one int per occupied bucket — a few
+    hundred buckets span nine decades at 2% resolution) and never by
+    the sample count; estimates carry ≤ ``rel_err`` relative error,
+    with the exact observed min/max returned at the extremes.
+    Thread-safe: the serving engine's worker and client threads feed
+    one sketch concurrently.
+    """
+
+    def __init__(self, rel_err: float = 0.02, lo: float = 1e-4):
+        self._base = math.log1p(2.0 * rel_err)
+        self._lo = float(lo)
+        self._counts: dict[int, int] = {}
+        self._n = 0
+        self._min = math.inf
+        self._max = -math.inf
+        self._sum = 0.0
+        self._lock = threading.Lock()
+
+    def __len__(self) -> int:
+        return self._n
+
+    @property
+    def count(self) -> int:
+        return self._n
+
+    def _index(self, x: float) -> int:
+        if x <= self._lo:
+            return 0
+        return 1 + int(math.log(x / self._lo) / self._base)
+
+    def add(self, x: float) -> None:
+        x = float(x)
+        if not math.isfinite(x):
+            return
+        i = self._index(max(x, 0.0))
+        with self._lock:
+            self._counts[i] = self._counts.get(i, 0) + 1
+            self._n += 1
+            self._sum += x
+            self._min = min(self._min, x)
+            self._max = max(self._max, x)
+
+    def merge(self, other: "QuantileSketch") -> None:
+        """Fold another sketch of the SAME grid into this one."""
+        if other._base != self._base or other._lo != self._lo:
+            raise ValueError("cannot merge QuantileSketches with "
+                             "different grids")
+        with other._lock:
+            counts = dict(other._counts)
+            n, s = other._n, other._sum
+            mn, mx = other._min, other._max
+        with self._lock:
+            for i, c in counts.items():
+                self._counts[i] = self._counts.get(i, 0) + c
+            self._n += n
+            self._sum += s
+            self._min = min(self._min, mn)
+            self._max = max(self._max, mx)
+
+    def quantile(self, q: float) -> float | None:
+        """Estimated q-quantile (None while empty). Monotone in q; the
+        0/1 extremes return the exact observed min/max."""
+        with self._lock:
+            if self._n == 0:
+                return None
+            if q <= 0.0:
+                return self._min
+            if q >= 1.0:
+                return self._max
+            target = q * self._n
+            seen = 0
+            for i in sorted(self._counts):
+                seen += self._counts[i]
+                if seen >= target:
+                    if i == 0:
+                        return min(self._lo, self._max)
+                    # geometric bucket midpoint, clamped to the observed
+                    # envelope so sparse tails cannot overshoot
+                    edge = self._lo * math.exp(self._base * (i - 1))
+                    mid = edge * math.exp(self._base * 0.5)
+                    return min(max(mid, self._min), self._max)
+            return self._max  # pragma: no cover — loop always hits target
+
+    @property
+    def mean(self) -> float | None:
+        with self._lock:
+            return (self._sum / self._n) if self._n else None
+
+    def n_buckets(self) -> int:
+        """Occupied buckets — the (bounded) memory footprint."""
+        with self._lock:
+            return len(self._counts)
+
+    def summary(self, unit: str = "ms") -> dict:
+        """JSON-ready {count, p50, p90, p99, min, max, mean} block."""
+        out = {"count": self.count}
+        for name, q in (("p50", 0.5), ("p90", 0.9), ("p99", 0.99)):
+            v = self.quantile(q)
+            out[f"{name}_{unit}"] = None if v is None else round(v, 3)
+        with self._lock:
+            if self._n:
+                out[f"min_{unit}"] = round(self._min, 3)
+                out[f"max_{unit}"] = round(self._max, 3)
+                out[f"mean_{unit}"] = round(self._sum / self._n, 3)
+        return out
+
+
+# --- the canonical serving breakdown ----------------------------------------------
+
+#: serving-engine sub-stages named in the breakdown (serve/engine.py):
+#: admission checks + token buckets (`admit`, recorded from the client
+#: threads), the worker's bounded wait for work or a lane deadline
+#: (`queue`), payload merging of coalesced same-session appends
+#: (`coalesce`), lane selection + warm-pool lookups incl. checkpoint
+#: restores (`dispatch`), the actual rank-k / batched-fleet device work
+#: (`solve`) and result installation + waiter wakeup (`finalize`).
+#: Anything else directly under a `serve` stage lands in serve_other_s.
+_SERVE_COMPONENTS = ("admit", "queue", "coalesce", "dispatch", "solve",
+                     "finalize")
+
+
+def serve_breakdown(rep: PerfReport) -> dict:
+    """Map "serve"-rooted stages into the canonical serving breakdown.
+
+    Contract (the ``--smoke --serve`` bench, tests/test_serve.py): named
+    components + compile + trace + other account for ≥90% of the serve
+    wall, so the throughput engine's telemetry cannot silently rot.
+    Counters: ``serve_requests`` admitted, ``serve_shed`` refused or
+    dropped by admission control, ``serve_dispatches`` batches sent to
+    the device, ``serve_coalesced`` requests answered by a shared
+    coalesced solve, ``serve_appends``/``serve_refits`` answered by
+    kind, ``serve_evictions``/``serve_restores`` warm-pool traffic.
+    Request-level p50/p99 live in the engine's :class:`QuantileSketch`
+    (``ServingEngine.stats()``), not here — the breakdown is wall
+    attribution, the sketches are SLO telemetry.
+    """
+    out = _root_breakdown(rep, "serve", _SERVE_COMPONENTS)
+    for c in ("serve_requests", "serve_shed", "serve_dispatches",
+              "serve_coalesced", "serve_appends", "serve_refits",
+              "serve_evictions", "serve_restores"):
+        out[c] = int(rep.counters.get(c, 0))
+    out["serve_waste_ewma"] = rep.values.get("serve_waste_ewma")
+    out["serve_eff_wait_ms"] = rep.values.get("serve_eff_wait_ms")
     return out
 
 

@@ -25,8 +25,8 @@ import numpy as np
 
 __all__ = [
     "SMOKE_PAR", "FLAGSHIP_SMOKE_PAR", "PTA_PAR_TEMPLATE", "PTA_SKY",
-    "RECEIVERS", "flagship_smoke_dataset", "pta_smoke_array", "spin_grid",
-    "grid_for",
+    "RECEIVERS", "flagship_smoke_dataset", "pta_smoke_array",
+    "serve_smoke_fleet", "spin_grid", "grid_for",
 ]
 
 #: minimal single-receiver smoke par (astrometry + spin + DM): the
@@ -198,6 +198,35 @@ def pta_smoke_array(n_pulsars: int, ntoas: int, seed: int = 29):
         models.append(model)
         toas_list.append(toas)
     return models, add_gwb_to_arrays(toas_list, models, rng=rng)
+
+
+def serve_smoke_fleet(base_rows=(160, 200, 240), n_append_rows: int = 8,
+                      seed: int = 41):
+    """Mixed-size resident-session fleet for the serving-engine bench
+    and its tier-1 contract (``bench.py --smoke --serve``,
+    tests/test_serve.py): one ``(model, full_toas, base_n)`` triple per
+    session, all sharing the SMOKE_PAR skeleton (so cross-session refits
+    batch into one fleet bucket) with DIFFERENT base row counts (so the
+    warm pool holds a genuinely mixed fleet). Each full set carries
+    ``n_append_rows`` extra rows beyond its base — the replayed append
+    trace's arrivals, sliced from one consistent fake set so they are
+    plausible observations. Shapes (and therefore program signatures)
+    depend only on the row counts; the draws only change values."""
+    from pint_tpu.io.par import parse_parfile
+    from pint_tpu.models.builder import build_model
+    from pint_tpu.simulation import make_fake_toas_uniform
+
+    fleet = []
+    for i, base_n in enumerate(base_rows):
+        model = build_model(parse_parfile(SMOKE_PAR, from_text=True))
+        N = int(base_n) + int(n_append_rows)
+        freqs = np.where(np.arange(N) % 2 == 0, 1400.0, 2300.0)
+        full = make_fake_toas_uniform(
+            54500, 55500, N, model, obs="gbt", freq_mhz=freqs,
+            error_us=1.0, add_noise=True,
+            rng=np.random.default_rng(seed + i))
+        fleet.append((model, full, int(base_n)))
+    return fleet
 
 
 def spin_grid(model, ftr):

@@ -1,11 +1,26 @@
 """Resident serving surfaces: warm processes answering timing requests.
 
-The "millions of users" shape (ROADMAP item 4) is not a script — it is a
+The "millions of users" shape (ROADMAP item 3) is not a script — it is a
 process that stays up, owns prepared TOAs + a converged fitter + the
 incremental-refit state, and answers small appends in milliseconds. This
-package holds those surfaces; the future async front-end plugs into
-:class:`~pint_tpu.serve.session.TimingSession` /
-:class:`~pint_tpu.serve.session.TimingService`.
+package holds those surfaces, bottom to top:
+
+- :class:`~pint_tpu.serve.session.TimingSession` /
+  :class:`~pint_tpu.serve.session.TimingService` — one resident pulsar /
+  a synchronous queue over many (PR 10's engine);
+- :class:`~pint_tpu.serve.pool.SessionPool` — the warm LRU pool with
+  FitterState checkpoint/restore (zero-trace under
+  ``PINT_TPU_EXPECT_WARM=1``);
+- :class:`~pint_tpu.serve.engine.ServingEngine` — the always-on
+  continuous-batching worker with admission control and load shedding;
+  an async network front-end plugs into its ``submit``/ticket surface.
 """
 
-from pint_tpu.serve.session import SessionResult, TimingService, TimingSession  # noqa: F401
+from pint_tpu.serve.engine import ServeTicket, ServingEngine  # noqa: F401
+from pint_tpu.serve.pool import SessionCheckpoint, SessionPool  # noqa: F401
+from pint_tpu.serve.scheduler import (AdmissionController,  # noqa: F401
+                                      ContinuousBatchScheduler, ShedError,
+                                      TokenBucket)
+from pint_tpu.serve.session import (SessionResult, TimingService,  # noqa: F401
+                                    TimingSession, batch_refit,
+                                    coalesce_append_payloads)

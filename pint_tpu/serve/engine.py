@@ -1,0 +1,398 @@
+"""The serving throughput engine: an always-on worker over TimingService
+primitives with continuous batching, a warm pool, and admission control.
+
+PR 10 built the physics of serving — O(k) appends, rank-k refits,
+fleet-batched full fits — behind a synchronous ``drain()``. This module
+is the part that makes it a *service*: a worker loop that keeps the
+device saturated with batched likelihood work (the Vela.jl lesson,
+arXiv:2412.15858) while bounding what any single client experiences.
+
+The life of a request::
+
+    client thread                      worker thread
+    -------------                      -------------
+    submit() ──admit──▶ lane  ──due──▶ coalesce ─▶ dispatch ─▶ solve ─▶ finalize
+       │        │                                   (pool.get,   (rank-k /    │
+       │     ShedError                               restore)    fit_batch)   │
+       ▼                                                                      ▼
+    ticket.wait() ◀──────────────────────────── result + per-request stamps ──┘
+
+- **submit** admits (bounded queue, per-tenant token buckets,
+  ``serve.shed`` on overload — scheduler.py) and queues the request into
+  its lane: per-session for appends, per-(fit-kind, row-bucket) skeleton
+  class for refits. Returns a :class:`ServeTicket` immediately.
+- **the worker** dispatches a lane the moment it fills or its oldest
+  request hits the live deadline (base ``PINT_TPU_SERVE_MAX_WAIT_MS``,
+  stretched when recent dispatches wasted padding, collapsed under
+  queue pressure). Same-session appends coalesce into ONE rank-k
+  update; refit lanes run through the fleet engine as one batched
+  program (session.py ``batch_refit``). Sessions come from the warm
+  :class:`~pint_tpu.serve.pool.SessionPool` (LRU + checkpoint/restore).
+- **telemetry**: every stage records into the ``serve`` perf tree
+  (``ops/perf.py serve_breakdown``, ≥90% attribution contract) and
+  every request feeds bounded :class:`~pint_tpu.ops.perf.QuantileSketch`
+  latency/queue-wait distributions — the p50/p99 a replayed-trace bench
+  (``python bench.py --smoke --serve``) reports as
+  ``serve_p50_ms``/``serve_p99_ms``.
+
+Run modes: :meth:`ServingEngine.start` spawns the resident worker
+thread (the always-on shape — `stop()` drains it); for deterministic
+tests and synchronous callers, :meth:`run_until_idle` serves the
+current queue to completion on the calling thread with identical code
+paths.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from pint_tpu.ops import perf
+from pint_tpu.serve.pool import SessionPool
+from pint_tpu.serve.scheduler import (AdmissionController,
+                                      ContinuousBatchScheduler, Lane,
+                                      ShedError)
+from pint_tpu.serve.session import (SessionResult, batch_refit,
+                                    coalesce_append_payloads)
+from pint_tpu.utils import knobs
+from pint_tpu.utils.logging import get_logger
+
+log = get_logger("pint_tpu.serve")
+
+__all__ = ["ServeTicket", "ServingEngine"]
+
+
+@dataclass
+class ServeTicket:
+    """One admitted request's handle: completion event, result slot and
+    the per-request SLO stamps (submit → dispatch → done)."""
+
+    session: str
+    kind: str                      # "append" | "refit"
+    tenant: str
+    rows: int                      # payload rows (appends; 1 for refits)
+    lane_key: tuple
+    payload: dict | None = None
+    t_submit: float = 0.0
+    t_dispatch: float | None = None
+    t_done: float | None = None
+    result: SessionResult | None = None
+    error: BaseException | None = None
+    _event: threading.Event = field(default_factory=threading.Event)
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def wait(self, timeout: float | None = None) -> SessionResult:
+        """Block until served; raises the shed/solve error when the
+        request failed, returns its :class:`SessionResult` otherwise."""
+        if not self._event.wait(timeout):
+            raise TimeoutError(
+                f"request for session {self.session!r} not served within "
+                f"{timeout} s")
+        if self.error is not None:
+            raise self.error
+        return self.result
+
+    @property
+    def latency_ms(self) -> float | None:
+        if self.t_done is None:
+            return None
+        return (self.t_done - self.t_submit) * 1e3
+
+    @property
+    def queue_ms(self) -> float | None:
+        if self.t_dispatch is None:
+            return None
+        return (self.t_dispatch - self.t_submit) * 1e3
+
+
+class ServingEngine:
+    """Continuous-batching serving engine over a warm session pool (see
+    module docstring). Constructor knobs default from the registry
+    (``PINT_TPU_SERVE_*``); explicit arguments override for tests."""
+
+    def __init__(self, pool: SessionPool | None = None, *,
+                 max_wait_ms: float | None = None,
+                 queue_depth: int | None = None,
+                 tenant_rps: float | None = None,
+                 shed_policy: str | None = None,
+                 coalesce_rows: int = 16, refit_batch: int = 4,
+                 maxiter: int = 30, clock=time.monotonic):
+        self.pool = pool if pool is not None else SessionPool()
+        self.admission = AdmissionController(
+            max_depth=queue_depth, tenant_rps=tenant_rps,
+            policy=shed_policy, clock=clock)
+        self.scheduler = ContinuousBatchScheduler(
+            max_wait_ms=max_wait_ms, coalesce_rows=coalesce_rows,
+            refit_batch=refit_batch, clock=clock)
+        self.maxiter = maxiter
+        self._clock = clock
+        self._cv = threading.Condition()
+        self._stopping = False
+        self._thread: threading.Thread | None = None
+        # served-request SLO sketches: bounded memory at any uptime;
+        # appends and refits keep separate latency distributions (a
+        # full-refit wall would otherwise smear the append p99 the SLO
+        # actually bounds)
+        self.latency = perf.QuantileSketch()
+        self.refit_latency = perf.QuantileSketch()
+        self.queue_wait = perf.QuantileSketch()
+        self.served = 0
+        self.dispatches = 0
+
+    # -- sessions --------------------------------------------------------------------
+
+    def add_session(self, sid: str, session) -> None:
+        """Register a fitted resident session under ``sid``."""
+        self.pool.put(sid, session)
+
+    def _lane_key(self, sid: str, kind: str) -> tuple:
+        if kind == "append":
+            return ("append", sid)
+        # refits batch across sessions sharing a fleet skeleton class:
+        # group by fit kind + padded row bucket so one lane fills one
+        # fixed-shape batched program (fitting/batch.py buckets further
+        # by exact skeleton — a mixed lane still dispatches correctly,
+        # it just fans into more than one bucket)
+        from pint_tpu.fitting.incremental import (MIN_APPEND_BUCKET,
+                                                  _pow2_at_least)
+
+        ses = self.pool.get(sid)
+        bucket = _pow2_at_least(len(ses.toas), MIN_APPEND_BUCKET)
+        return ("refit", ses.fitter._fused_kind, bucket)
+
+    def _append_cap(self, sid: str) -> int:
+        """Max rows one coalesced dispatch may append and stay inside
+        the incremental staleness envelope (PINT_TPU_INCR_MAX_FRAC)."""
+        try:
+            n = len(self.pool.get(sid).toas)
+        except KeyError:
+            return self.scheduler.coalesce_rows
+        frac = float(knobs.get("PINT_TPU_INCR_MAX_FRAC"))
+        return max(1, int(frac * n))
+
+    # -- intake ----------------------------------------------------------------------
+
+    def submit(self, *, session: str, kind: str = "append",
+               tenant: str = "default", utc=None, error_us=None,
+               freq_mhz=None, obs=None, flags=None) -> ServeTicket:
+        """Admit one request and queue it for the worker; returns its
+        :class:`ServeTicket`. Sheds raise :class:`ShedError` (or
+        ``DegradedError`` under ``PINT_TPU_DEGRADED=error``) here, at
+        the client — overload is an explicit refusal, not a timeout."""
+        if kind not in ("append", "refit"):
+            raise ValueError(f"unknown request kind {kind!r}")
+        if session not in self.pool:
+            raise KeyError(f"unknown session {session!r}")
+        payload = None
+        rows = 1
+        if kind == "append":
+            payload = dict(utc=utc, error_us=error_us, freq_mhz=freq_mhz,
+                           obs=obs, flags=flags)
+            rows = len(np.asarray(error_us))
+        with perf.stage("serve"):
+            with perf.stage("admit"):
+                action = self.admission.admit(tenant,
+                                              self.scheduler.depth())
+                if action == "drop_oldest":
+                    victim = self.scheduler.drop_oldest()
+                    if victim is not None:
+                        self.admission.record_drop(
+                            victim.tenant,
+                            f"request from tenant {victim.tenant!r} for "
+                            f"session {victim.session!r} dropped to admit "
+                            "newer work (PINT_TPU_SERVE_SHED_POLICY="
+                            "drop_oldest)")
+                        victim.error = ShedError(
+                            "dropped by a newer request under "
+                            "drop_oldest shed policy")
+                        victim.t_done = self._clock()
+                        victim._event.set()
+                ticket = ServeTicket(
+                    session=session, kind=kind, tenant=tenant, rows=rows,
+                    lane_key=self._lane_key(session, kind),
+                    payload=payload, t_submit=self._clock())
+                perf.add("serve_requests")
+                self.scheduler.offer(ticket, rows=rows)
+        with self._cv:
+            self._cv.notify()
+        return ticket
+
+    # -- the worker ------------------------------------------------------------------
+
+    def _dispatch_append(self, batch: Lane) -> None:
+        with perf.stage("dispatch"):
+            session = self.pool.get(batch.sid)
+        with perf.stage("coalesce"):
+            merged = coalesce_append_payloads(
+                [t.payload for t in batch.tickets])
+            if len(batch.tickets) > 1:
+                perf.add("serve_coalesced", len(batch.tickets))
+        with perf.stage("solve"):
+            shared = session.append(**merged)
+        self._finalize(batch, shared,
+                       waste=1.0 - batch.rows / self._append_bucket(
+                           batch.rows))
+        perf.add("serve_appends", len(batch.tickets))
+
+    @staticmethod
+    def _append_bucket(rows: int) -> int:
+        from pint_tpu.fitting.incremental import append_bucket
+
+        return append_bucket(rows)
+
+    def _dispatch_refit(self, batch: Lane) -> None:
+        # one ticket per (session, request); a session refits ONCE per
+        # dispatch — duplicate refit requests share the solve
+        sids: list[str] = []
+        for t in batch.tickets:
+            if t.session not in sids:
+                sids.append(t.session)
+        with perf.stage("dispatch"):
+            sessions = [self.pool.get(sid) for sid in sids]
+        with perf.stage("solve"), perf.collect() as rep:
+            results = batch_refit(sessions, maxiter=self.maxiter)
+        by_sid = dict(zip(sids, results))
+        self._finalize(batch, None, by_sid=by_sid,
+                       waste=rep.values.get("padding_waste_frac"))
+        perf.add("serve_refits", len(batch.tickets))
+
+    def _finalize(self, batch: Lane, shared: SessionResult | None,
+                  by_sid: dict | None = None,
+                  waste: float | None = None) -> None:
+        with perf.stage("finalize"):
+            now = self._clock()
+            for t in batch.tickets:
+                base = shared if shared is not None else by_sid[t.session]
+                t.t_dispatch = t.t_dispatch or batch.t_oldest
+                t.t_done = now
+                t.result = SessionResult(
+                    base.result, base.path, t.rows if t.kind == "append"
+                    else 0,
+                    latency_ms=(now - t.t_submit) * 1e3,
+                    reason=base.reason, breakdown=base.breakdown,
+                    queue_ms=max(t.t_dispatch - t.t_submit, 0.0) * 1e3)
+                (self.latency if t.kind == "append"
+                 else self.refit_latency).add(t.result.latency_ms)
+                self.queue_wait.add(t.result.queue_ms)
+                self.served += 1
+                t._event.set()
+            self.dispatches += 1
+            perf.add("serve_dispatches")
+            self.scheduler.observe_waste(waste)
+
+    def _dispatch(self, batch: Lane) -> None:
+        t_d = self._clock()
+        for t in batch.tickets:
+            t.t_dispatch = t_d
+        try:
+            if batch.kind == "append":
+                self._dispatch_append(batch)
+            else:
+                self._dispatch_refit(batch)
+        except BaseException as e:  # noqa: BLE001 — the failure is DELIVERED to every waiting client ticket (and re-raised to synchronous callers); nothing is swallowed  # jaxlint: disable=silent-except
+            now = self._clock()
+            for t in batch.tickets:
+                if not t._event.is_set():
+                    t.error = e
+                    t.t_done = now
+                    t._event.set()
+            if not isinstance(e, Exception):
+                raise
+
+    def step(self, wait_s: float = 0.0) -> int:
+        """One worker turn: (optionally) wait for work or the earliest
+        lane deadline, then dispatch everything due. Returns requests
+        served this turn."""
+        with perf.stage("serve"):
+            if wait_s > 0:
+                deadline = self.scheduler.next_deadline(
+                    self.admission.max_depth)
+                now = self._clock()
+                timeout = wait_s if deadline is None else max(
+                    min(deadline - now, wait_s), 0.0)
+                if timeout > 0:
+                    with perf.stage("queue"):
+                        with self._cv:
+                            self._cv.wait(timeout)
+            with perf.stage("dispatch"):
+                batches = self.scheduler.due(self.admission.max_depth,
+                                             self._append_cap)
+            n = 0
+            for batch in batches:
+                self._dispatch(batch)
+                n += len(batch.tickets)
+        return n
+
+    def run_until_idle(self, timeout_s: float = 120.0) -> int:
+        """Serve the current queue to completion on the calling thread
+        (deterministic test/synchronous mode). Lanes below their fill
+        target dispatch immediately once nothing else is due — idleness
+        beats occupancy when the queue has drained."""
+        t0 = self._clock()
+        total = 0
+        while self.scheduler.depth() > 0:
+            served = self.step(0.0)
+            if served == 0:
+                # nothing full: wait out the earliest lane deadline (the
+                # same bounded wait the resident worker uses), then the
+                # next turn dispatches it
+                served = self.step(
+                    wait_s=min(self.scheduler.base_wait_s, 0.05))
+            total += served
+            if self._clock() - t0 > timeout_s:
+                raise TimeoutError("run_until_idle exceeded its budget "
+                                   f"with {self.scheduler.depth()} queued")
+        return total
+
+    def _run(self) -> None:
+        while True:
+            with self._cv:
+                if self._stopping and self.scheduler.depth() == 0:
+                    return
+            self.step(wait_s=0.05)
+
+    def start(self) -> None:
+        """Spawn the resident worker thread (idempotent)."""
+        if self._thread is not None and self._thread.is_alive():
+            return
+        self._stopping = False
+        self._thread = threading.Thread(target=self._run,
+                                        name="pint-tpu-serve", daemon=True)
+        self._thread.start()
+
+    def stop(self, timeout_s: float = 60.0) -> None:
+        """Drain the queue and join the worker."""
+        if self._thread is None:
+            return
+        self._stopping = True
+        with self._cv:
+            self._cv.notify_all()
+        self._thread.join(timeout_s)
+        if self._thread.is_alive():  # pragma: no cover — debug aid
+            raise TimeoutError("serving worker did not stop")
+        self._thread = None
+
+    # -- telemetry -------------------------------------------------------------------
+
+    def stats(self) -> dict:
+        """JSON-ready serving telemetry: throughput counters, bounded
+        per-request latency/queue-wait quantiles, pool + shed traffic."""
+        out = {
+            "served": self.served,
+            "dispatches": self.dispatches,
+            "shed": self.admission.shed_count,
+            "queued": self.scheduler.depth(),
+            "waste_ewma": round(self.scheduler.waste_ewma, 4),
+            "latency": self.latency.summary("ms"),
+            "refit_latency": self.refit_latency.summary("ms"),
+            "queue_wait": self.queue_wait.summary("ms"),
+            "pool": self.pool.stats(),
+        }
+        if self.served and self.dispatches:
+            out["coalesce_ratio"] = round(self.served / self.dispatches, 3)
+        return out

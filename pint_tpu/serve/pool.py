@@ -1,0 +1,203 @@
+"""Warm session pool: LRU-resident timing sessions with checkpoint/restore.
+
+A resident :class:`~pint_tpu.serve.session.TimingSession` is what makes
+appends O(k): prepared columns, a built tensor, cached normal-equation
+blocks, warm program handles. It is also what bounds fleet size — a
+process cannot keep every pulsar's session hot. This pool keeps the
+``PINT_TPU_SERVE_POOL_SESSIONS`` most-recently-used sessions live and
+turns the rest into cheap checkpoints:
+
+- **Eviction** captures a :class:`SessionCheckpoint` — the fitted
+  solution as a :class:`~pint_tpu.fitting.state.FitterState` snapshot
+  (exact (hi, lo) parameter pairs) plus the RAW TOA inputs (epochs /
+  errors / frequencies / observatories / flags — a handful of scalars
+  per TOA, not the ~30-column prepared set) — then drops the live
+  session. Every eviction is a ledger-visible ``serve.evict``
+  degradation (ops/degrade.py): refusable under
+  ``PINT_TPU_DEGRADED=error``, observable in the bench headline.
+- **Restore** re-prepares the TOAs through the content-hash prepared-
+  column disk cache (sets stored by ``TOAs.append`` are direct hits),
+  rebuilds the fitter, warm-starts it from the snapshot and recaptures
+  the incremental blocks at that exact point
+  (:meth:`TimingSession.from_state`). Every program this touches is
+  served by the process-global program caches or the ``.aotx``
+  serialized-executable store — an evicted-then-restored session
+  answers its next append with ZERO traces under
+  ``PINT_TPU_EXPECT_WARM=1`` (locked by tests/test_serve.py), and its
+  answer is the never-evicted session's answer to ≤1e-10.
+
+The ``serve.pool:evict`` fault site (testing/faults.py) forces an
+eviction on the next :meth:`SessionPool.get`, so the restore path is
+drillable end-to-end via ``PINT_TPU_FAULTS`` without memory pressure.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import OrderedDict
+from dataclasses import dataclass
+
+import numpy as np
+
+from pint_tpu.ops import degrade, perf
+from pint_tpu.serve.session import TimingSession
+from pint_tpu.testing import faults
+from pint_tpu.utils import knobs
+from pint_tpu.utils.logging import get_logger
+
+log = get_logger("pint_tpu.serve")
+
+__all__ = ["SessionCheckpoint", "SessionPool"]
+
+
+@dataclass
+class SessionCheckpoint:
+    """Everything needed to rebuild a resident session without its live
+    device state: the model object (program caches key on it), the raw
+    TOA inputs, the fitted solution, and the session's serving config."""
+
+    model: object
+    state: object                  # fitting.state.FitterState
+    utc: object                    # astro.time.MJDEpoch of every row
+    error_us: np.ndarray
+    freq_mhz: np.ndarray
+    obs: np.ndarray
+    flags: list
+    n_toas: int
+    maxiter: int
+    required_chi2_decrease: float
+    max_rejects: int
+
+    @classmethod
+    def capture(cls, session: TimingSession) -> "SessionCheckpoint":
+        from pint_tpu.fitting.state import snapshot
+
+        toas = session.toas
+        if getattr(toas, "utc_raw", None) is None:
+            raise ValueError(
+                "session TOAs carry no raw UTC epochs; cannot checkpoint")
+        return cls(
+            model=session.model,
+            state=snapshot(session.fitter),
+            utc=toas.utc_raw,
+            error_us=np.asarray(toas.error_us),
+            freq_mhz=np.asarray(toas.freq_mhz),
+            obs=np.asarray(toas.obs),
+            flags=[dict(f) for f in toas.flags],
+            n_toas=len(toas),
+            maxiter=session.maxiter,
+            required_chi2_decrease=session.required_chi2_decrease,
+            max_rejects=session.max_rejects,
+        )
+
+    def restore(self) -> TimingSession:
+        """Rebuild the live session at the checkpointed solution. The
+        prepared columns come back through the content-hash disk cache
+        when available (an appended session stored its merged set under
+        its full key), a plain host re-prepare otherwise — either way no
+        program traces: the blocks/chi² programs the restored engine
+        re-warms are process-cache or ``.aotx`` hits."""
+        from pint_tpu.toas import prepare_arrays
+
+        toas = prepare_arrays(self.utc, self.error_us, self.freq_mhz,
+                              self.obs, flags=self.flags, cache=True)
+        return TimingSession.from_state(
+            toas, self.model, self.state, maxiter=self.maxiter,
+            required_chi2_decrease=self.required_chi2_decrease,
+            max_rejects=self.max_rejects)
+
+
+class SessionPool:
+    """LRU-bounded warm sessions, evicting to checkpoints (see module
+    docstring). ``capacity`` defaults to ``PINT_TPU_SERVE_POOL_SESSIONS``."""
+
+    def __init__(self, capacity: int | None = None):
+        self.capacity = int(knobs.get("PINT_TPU_SERVE_POOL_SESSIONS")) \
+            if capacity is None else int(capacity)
+        if self.capacity < 1:
+            raise ValueError("session pool capacity must be >= 1")
+        self._live: OrderedDict[str, TimingSession] = OrderedDict()
+        self._checkpoints: dict[str, SessionCheckpoint] = {}
+        self.hits = 0
+        self.evictions = 0
+        self.restores = 0
+        self.restore_s = 0.0
+
+    def __len__(self) -> int:
+        return len(self._live)
+
+    def __contains__(self, sid: str) -> bool:
+        return sid in self._live or sid in self._checkpoints
+
+    def sids(self) -> list[str]:
+        """Every registered session id (live + checkpointed)."""
+        return list(self._live) + [s for s in self._checkpoints
+                                   if s not in self._live]
+
+    def _evict(self, sid: str) -> None:
+        session = self._live.pop(sid)
+        self._checkpoints[sid] = SessionCheckpoint.capture(session)
+        self.evictions += 1
+        perf.add("serve_evictions")
+        degrade.record(
+            "serve.evict", f"session:{sid}",
+            f"warm session {sid!r} evicted at pool capacity "
+            f"{self.capacity}; next request pays a checkpoint restore",
+            bound_us=0.0,  # accuracy preserved; the restore latency lost
+            fix="raise PINT_TPU_SERVE_POOL_SESSIONS or shard the fleet "
+                "across more processes")
+
+    def put(self, sid: str, session: TimingSession) -> None:
+        """Register (or re-insert) a live session; evicts the LRU
+        session past capacity. Under ``PINT_TPU_DEGRADED=error`` the
+        eviction's ledger write raises BEFORE the new session is
+        inserted — an overfull pool refuses instead of silently churning
+        its warm set."""
+        if sid in self._live:
+            self._live.move_to_end(sid)
+            self._live[sid] = session
+            return
+        while len(self._live) >= self.capacity:
+            # the ledger write (and any PINT_TPU_DEGRADED=error raise)
+            # happens inside _evict, checkpoint captured first
+            self._evict(next(iter(self._live)))
+        self._live[sid] = session
+        self._checkpoints.pop(sid, None)
+
+    def get(self, sid: str) -> TimingSession:
+        """The live session for ``sid``, restoring from its checkpoint
+        when evicted. Unknown sids raise KeyError."""
+        if (sid in self._live
+                and faults.trip("serve.pool", f"session:{sid}") is not None):
+            # fault drill: evict the requested session so THIS request
+            # pays the restore path (PINT_TPU_FAULTS=serve.pool:evict)
+            self._evict(sid)
+        session = self._live.get(sid)
+        if session is not None:
+            self._live.move_to_end(sid)
+            self.hits += 1
+            return session
+        ck = self._checkpoints.get(sid)
+        if ck is None:
+            raise KeyError(f"unknown session {sid!r}")
+        t0 = time.perf_counter()
+        with perf.stage("restore"):
+            session = ck.restore()
+        self.restores += 1
+        self.restore_s += time.perf_counter() - t0
+        perf.add("serve_restores")
+        log.info(f"restored session {sid!r} from checkpoint "
+                 f"({ck.n_toas} TOAs)")
+        self.put(sid, session)
+        return session
+
+    def stats(self) -> dict:
+        return {
+            "capacity": self.capacity,
+            "live": len(self._live),
+            "checkpointed": len(self._checkpoints),
+            "hits": self.hits,
+            "evictions": self.evictions,
+            "restores": self.restores,
+            "restore_s": round(self.restore_s, 4),
+        }

@@ -1,0 +1,344 @@
+"""Admission control + continuous batching for the serving engine.
+
+A resident session answers one append in ~tens of ms; "heavy traffic
+from millions of users" is not one append — it is an unbounded stream of
+them, bursty per pulsar and uneven per tenant. This module holds the two
+decisions an always-on server makes BEFORE any device work runs:
+
+- **Admission** (:class:`AdmissionController`): is there room for this
+  request at all? A bounded queue (``PINT_TPU_SERVE_QUEUE_DEPTH``) and
+  per-tenant token buckets (``PINT_TPU_SERVE_TENANT_RPS``) turn overload
+  into an *explicit, ledger-visible shed* (``serve.shed``,
+  ops/degrade.py) instead of a collapsing p99: the shed policy
+  (``PINT_TPU_SERVE_SHED_POLICY``) either refuses the new request
+  (``reject``) or drops the oldest queued one (``drop_oldest``), and
+  under ``PINT_TPU_DEGRADED=error`` the ledger write itself raises — the
+  production refusal.
+- **Batching** (:class:`ContinuousBatchScheduler`): admitted requests
+  wait in *lanes* — one per (session) for appends, one per (fit-kind,
+  row-bucket) skeleton class for cross-session refits — and a lane
+  dispatches the moment it FILLS (enough rows to pack the fixed-shape
+  append bucket, enough sessions to fill a fleet bucket) or its oldest
+  request hits the deadline (``PINT_TPU_SERVE_MAX_WAIT_MS``). The
+  deadline-vs-occupancy tradeoff is driven by live telemetry: the
+  padding-waste fraction of recent dispatches (the same
+  ``padding_waste_frac`` the fleet engine reports) feeds an EWMA that
+  STRETCHES the effective wait when buckets go out underfilled, and
+  queue pressure (depth approaching capacity) SHRINKS it — padding waste
+  becomes a load-balancing signal instead of a post-hoc metric.
+
+Everything here is host bookkeeping with an injectable clock: tests
+drive deadlines and token buckets deterministically, no sleeps.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+
+from pint_tpu.ops import degrade, perf
+from pint_tpu.testing import faults
+from pint_tpu.utils import knobs
+from pint_tpu.utils.logging import get_logger
+
+log = get_logger("pint_tpu.serve")
+
+__all__ = ["AdmissionController", "ContinuousBatchScheduler", "Lane",
+           "ShedError", "TokenBucket"]
+
+
+class ShedError(RuntimeError):
+    """The request was refused or dropped by serving admission control.
+
+    Raised to the SUBMITTER (for ``reject``) or delivered through the
+    dropped request's ticket (for ``drop_oldest``) — in both cases after
+    the ``serve.shed`` degradation event is on the ledger, so the shed
+    is observable even when the client swallows the error."""
+
+
+class TokenBucket:
+    """Per-tenant request-rate limiter: ``rate`` tokens/s refill up to
+    ``burst``; a request takes one token or is shed. ``rate <= 0``
+    disables the bucket (always admits)."""
+
+    def __init__(self, rate: float, burst: float | None = None,
+                 clock=time.monotonic):
+        self.rate = float(rate)
+        self.burst = float(burst) if burst is not None else max(
+            self.rate, 1.0)
+        self._tokens = self.burst
+        self._clock = clock
+        self._t_last = clock()
+
+    def try_take(self) -> bool:
+        if self.rate <= 0:
+            return True
+        now = self._clock()
+        self._tokens = min(self.burst,
+                           self._tokens + (now - self._t_last) * self.rate)
+        self._t_last = now
+        if self._tokens >= 1.0:
+            self._tokens -= 1.0
+            return True
+        return False
+
+
+class AdmissionController:
+    """Bounded-queue + per-tenant-rate admission with an explicit
+    overload policy. One instance guards one serving engine's queue.
+
+    :meth:`admit` returns ``"admit"`` (room available) or
+    ``"drop_oldest"`` (the caller must shed its oldest queued request to
+    make room — only under that policy), and raises :class:`ShedError`
+    (or :class:`~pint_tpu.ops.degrade.DegradedError` under
+    ``PINT_TPU_DEGRADED=error``) when the request itself is shed. Every
+    shed records ``serve.shed`` on the degradation ledger and bumps the
+    ``serve_shed`` telemetry counter BEFORE any raise."""
+
+    def __init__(self, max_depth: int | None = None,
+                 tenant_rps: float | None = None,
+                 policy: str | None = None, clock=time.monotonic):
+        self.max_depth = int(knobs.get("PINT_TPU_SERVE_QUEUE_DEPTH")) \
+            if max_depth is None else int(max_depth)
+        self.tenant_rps = float(knobs.get("PINT_TPU_SERVE_TENANT_RPS")) \
+            if tenant_rps is None else float(tenant_rps)
+        policy = (knobs.get("PINT_TPU_SERVE_SHED_POLICY")
+                  if policy is None else policy) or "reject"
+        if policy not in ("reject", "drop_oldest"):
+            raise ValueError(
+                f"unknown shed policy {policy!r} "
+                "(PINT_TPU_SERVE_SHED_POLICY: reject | drop_oldest)")
+        self.policy = policy
+        self._clock = clock
+        self._buckets: dict[str, TokenBucket] = {}
+        self._lock = threading.Lock()
+        #: total requests shed (refused or dropped) by this controller
+        self.shed_count = 0
+
+    def _shed(self, tenant: str, why: str, detail: str) -> None:
+        with self._lock:
+            self.shed_count += 1
+        perf.add("serve_shed")
+        # the ledger write happens FIRST: under PINT_TPU_DEGRADED=error
+        # it raises DegradedError (the production refusal) with the shed
+        # already on the record; otherwise the caller gets ShedError
+        degrade.record(
+            "serve.shed", f"serve:{why}",
+            detail,
+            bound_us=0.0,  # accuracy untouched; availability degraded
+            fix="raise PINT_TPU_SERVE_QUEUE_DEPTH / "
+                "PINT_TPU_SERVE_TENANT_RPS, add capacity, or shed by "
+                "design (PINT_TPU_SERVE_SHED_POLICY)")
+        raise ShedError(detail)
+
+    def admit(self, tenant: str, depth: int) -> str:
+        """Admit one request from ``tenant`` given the current queue
+        ``depth``; see the class docstring for outcomes."""
+        if faults.trip("serve.admit", f"tenant:{tenant}") is not None:
+            self._shed(tenant, "fault",
+                       f"fault-injected shed for tenant {tenant!r} "
+                       "(PINT_TPU_FAULTS=serve.admit:shed)")
+        if self.tenant_rps > 0:
+            with self._lock:
+                bucket = self._buckets.get(tenant)
+                if bucket is None:
+                    bucket = self._buckets[tenant] = TokenBucket(
+                        self.tenant_rps, clock=self._clock)
+            if not bucket.try_take():
+                self._shed(tenant, "rate",
+                           f"tenant {tenant!r} exceeded "
+                           f"{self.tenant_rps:g} requests/s "
+                           "(PINT_TPU_SERVE_TENANT_RPS)")
+        if depth >= self.max_depth:
+            if self.policy == "drop_oldest":
+                return "drop_oldest"
+            self._shed(tenant, "depth",
+                       f"queue depth {depth} at capacity "
+                       f"{self.max_depth} (PINT_TPU_SERVE_QUEUE_DEPTH); "
+                       f"request from tenant {tenant!r} refused")
+        return "admit"
+
+    def record_drop(self, tenant: str, detail: str) -> None:
+        """Ledger + counters for a ``drop_oldest`` shed (the DROPPED
+        request's side — :meth:`admit` already told the caller to make
+        room). Never raises ShedError at the submit site; under
+        ``PINT_TPU_DEGRADED=error`` the ledger write still refuses."""
+        with self._lock:
+            self.shed_count += 1
+        perf.add("serve_shed")
+        degrade.record(
+            "serve.shed", "serve:drop_oldest", detail, bound_us=0.0,
+            fix="raise PINT_TPU_SERVE_QUEUE_DEPTH or add capacity")
+
+
+@dataclass
+class Lane:
+    """One dispatch queue: same-session appends, or one refit skeleton
+    class. ``rows`` counts payload rows (appends) or member sessions
+    (refits) toward the fill target."""
+
+    key: tuple
+    kind: str                      # "append" | "refit"
+    sid: str | None = None         # append lanes: the session
+    tickets: list = field(default_factory=list)
+    rows: int = 0
+    t_oldest: float = 0.0
+
+    def age_s(self, now: float) -> float:
+        return (now - self.t_oldest) if self.tickets else 0.0
+
+
+class ContinuousBatchScheduler:
+    """Lane bookkeeping for continuous batching (see module docstring).
+
+    The engine offers admitted tickets into lanes and calls :meth:`due`
+    every loop turn; lanes come back the moment they fill or their
+    oldest ticket ages past the *effective* wait — the base deadline
+    scaled by the padding-waste EWMA (underfilled dispatches → stretch,
+    up to 4x) and by queue pressure (depth ≥ half capacity → shrink to a
+    quarter). Appends dispatch at most ``coalesce_rows`` rows per batch:
+    that keeps every coalesced append inside the same fixed-shape
+    device bucket the session pre-warmed, so continuous batching never
+    costs a retrace."""
+
+    def __init__(self, max_wait_ms: float | None = None,
+                 coalesce_rows: int = 16, refit_batch: int = 4,
+                 waste_alpha: float = 0.3, clock=time.monotonic):
+        self.base_wait_s = (float(knobs.get("PINT_TPU_SERVE_MAX_WAIT_MS"))
+                            if max_wait_ms is None
+                            else float(max_wait_ms)) * 1e-3
+        self.coalesce_rows = int(coalesce_rows)
+        self.refit_batch = int(refit_batch)
+        self._clock = clock
+        self._lanes: dict[tuple, Lane] = {}
+        self._depth = 0
+        self._waste_ewma = 0.0
+        self._waste_alpha = float(waste_alpha)
+        self._lock = threading.Lock()
+
+    # -- state ---------------------------------------------------------------------
+
+    def depth(self) -> int:
+        """Tickets currently queued across all lanes."""
+        with self._lock:
+            return self._depth
+
+    @property
+    def waste_ewma(self) -> float:
+        return self._waste_ewma
+
+    def observe_waste(self, frac: float | None) -> None:
+        """Fold one dispatch's padding-waste fraction (fraction of
+        padded rows that were padding — the fleet engine's
+        ``padding_waste_frac``, or ``1 - k/bucket`` for a rank-k append)
+        into the EWMA steering the deadline."""
+        if frac is None:
+            return
+        frac = min(max(float(frac), 0.0), 1.0)
+        with self._lock:
+            self._waste_ewma += self._waste_alpha * (frac - self._waste_ewma)
+
+    def effective_wait_s(self, capacity: int) -> float:
+        """The live deadline: base max-wait stretched by the waste EWMA
+        (an underfilled fleet is cheap patience) and collapsed under
+        queue pressure (a deep queue needs latency, not occupancy)."""
+        with self._lock:
+            wait = self.base_wait_s * (1.0 + 3.0 * self._waste_ewma)
+            wait = min(wait, 4.0 * self.base_wait_s)
+            if capacity > 0 and self._depth >= 0.5 * capacity:
+                wait = 0.25 * self.base_wait_s
+        perf.put("serve_eff_wait_ms", round(wait * 1e3, 3))
+        perf.put("serve_waste_ewma", round(self._waste_ewma, 4))
+        return wait
+
+    # -- lane traffic ----------------------------------------------------------------
+
+    def offer(self, ticket, *, rows: int = 1) -> None:
+        """Queue one admitted ticket into its lane."""
+        now = self._clock()
+        with self._lock:
+            lane = self._lanes.get(ticket.lane_key)
+            if lane is None:
+                lane = self._lanes[ticket.lane_key] = Lane(
+                    ticket.lane_key, ticket.kind,
+                    sid=ticket.session if ticket.kind == "append" else None)
+            if not lane.tickets:
+                lane.t_oldest = now
+            lane.tickets.append(ticket)
+            lane.rows += rows
+            self._depth += 1
+
+    def drop_oldest(self):
+        """Pop the globally oldest queued ticket (the ``drop_oldest``
+        shed policy's victim); None when nothing is queued."""
+        with self._lock:
+            oldest, lane_at = None, None
+            for lane in self._lanes.values():
+                if lane.tickets and (oldest is None
+                                     or lane.t_oldest < oldest):
+                    oldest, lane_at = lane.t_oldest, lane
+            if lane_at is None:
+                return None
+            t = lane_at.tickets.pop(0)
+            lane_at.rows -= getattr(t, "rows", 1)
+            self._depth -= 1
+            if lane_at.tickets:
+                lane_at.t_oldest = getattr(lane_at.tickets[0], "t_submit",
+                                           self._clock())
+            return t
+
+    def next_deadline(self, capacity: int) -> float | None:
+        """Absolute clock time of the earliest lane deadline (None when
+        idle) — the worker's bounded wait."""
+        wait = self.effective_wait_s(capacity)
+        with self._lock:
+            ts = [lane.t_oldest + wait
+                  for lane in self._lanes.values() if lane.tickets]
+        return min(ts) if ts else None
+
+    def due(self, capacity: int, append_cap=None) -> list[Lane]:
+        """Pop every lane ready to dispatch NOW: full (appends — enough
+        rows to fill the coalesce bucket, capped per session by
+        ``append_cap(sid)`` so a dispatch never leaves the incremental
+        staleness envelope; refits — ``refit_batch`` members) or past
+        the effective deadline. Append lanes with more queued rows than
+        one bucket dispatch the HEAD of the lane and keep the rest
+        queued — continuous batching, not drain-the-world."""
+        now = self._clock()
+        wait = self.effective_wait_s(capacity)
+        out: list[Lane] = []
+        with self._lock:
+            for key in list(self._lanes):
+                lane = self._lanes[key]
+                if not lane.tickets:
+                    continue
+                cap = self.coalesce_rows
+                if lane.kind == "append" and append_cap is not None:
+                    cap = max(1, min(cap, int(append_cap(lane.sid))))
+                full = (lane.rows >= cap if lane.kind == "append"
+                        else len(lane.tickets) >= self.refit_batch)
+                if not full and (now - lane.t_oldest) < wait:
+                    continue
+                if lane.kind == "append":
+                    head, rows = [], 0
+                    while lane.tickets:
+                        t = lane.tickets[0]
+                        r = getattr(t, "rows", 1)
+                        if head and rows + r > cap:
+                            break
+                        head.append(lane.tickets.pop(0))
+                        rows += r
+                    batch = Lane(lane.key, lane.kind, sid=lane.sid,
+                                 tickets=head, rows=rows,
+                                 t_oldest=lane.t_oldest)
+                    lane.rows -= rows
+                    if lane.tickets:
+                        lane.t_oldest = now
+                else:
+                    batch = Lane(lane.key, lane.kind, tickets=lane.tickets,
+                                 rows=lane.rows, t_oldest=lane.t_oldest)
+                    lane.tickets, lane.rows = [], 0
+                self._depth -= len(batch.tickets)
+                out.append(batch)
+        return out

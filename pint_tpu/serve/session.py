@@ -18,16 +18,21 @@ deterministic: batched ≡ the same requests served one at a time
 (locked by tests/test_session.py), because the fleet driver's masked
 convergence reproduces every element's solo trajectory.
 
-This is the substrate an async front-end plugs into (ROADMAP item 4):
-the request objects are plain dicts, the latency telemetry is already
-per-request, and ``PINT_TPU_DEGRADED=error`` turns every silent
-corner-cut (including an incremental-refit fallback) into a refusal.
+This is the synchronous substrate of the serving stack: the always-on
+continuous-batching worker with admission control, load shedding and a
+warm session pool is :class:`pint_tpu.serve.engine.ServingEngine` (an
+async network front-end plugs into its submit/ticket surface). The
+request objects are plain dicts, the latency telemetry is per-request,
+and ``PINT_TPU_DEGRADED=error`` turns every silent corner-cut
+(including an incremental-refit fallback) into a refusal.
 """
 
 from __future__ import annotations
 
+import threading
 import time
-from dataclasses import dataclass, field
+from collections import deque
+from dataclasses import dataclass
 
 import numpy as np
 
@@ -36,7 +41,12 @@ from pint_tpu.utils.logging import get_logger
 
 log = get_logger("pint_tpu.serve")
 
-__all__ = ["SessionResult", "TimingSession", "TimingService"]
+__all__ = ["SessionResult", "TimingSession", "TimingService",
+           "batch_refit", "coalesce_append_payloads"]
+
+#: per-session request records retained in memory (the full latency
+#: distribution lives in a bounded QuantileSketch, never in this list)
+HISTORY_KEEP = 512
 
 
 @dataclass
@@ -49,6 +59,31 @@ class SessionResult:
     latency_ms: float
     reason: str | None = None      # fallback reason, when any
     breakdown: dict | None = None  # incremental_breakdown when telemetry on
+    #: time this request spent queued before its (possibly shared) solve
+    #: started — stamped per request, so coalesced requests carry their
+    #: own wait instead of inheriting one shared wall-clock figure
+    queue_ms: float | None = None
+
+
+def coalesce_append_payloads(reqs: list[dict]) -> dict:
+    """Merge several append payloads into one row block (submission
+    order preserved — the merged rows land in the order the requests
+    were queued, so coalesced ≡ sequential row-for-row)."""
+    from pint_tpu.astro import time as ptime
+
+    eps = [r["utc"] for r in reqs]
+    cat = np.concatenate
+    return {
+        "utc": ptime.MJDEpoch(cat([e.day for e in eps]),
+                              cat([e.frac_hi for e in eps]),
+                              cat([e.frac_lo for e in eps])),
+        "error_us": cat([np.asarray(r["error_us"]) for r in reqs]),
+        "freq_mhz": cat([np.asarray(r["freq_mhz"]) for r in reqs]),
+        "obs": cat([np.asarray(r["obs"]) for r in reqs]),
+        "flags": sum((list(r.get("flags") or
+                           [{} for _ in np.asarray(r["error_us"])])
+                      for r in reqs), []),
+    }
 
 
 class TimingSession:
@@ -74,8 +109,53 @@ class TimingSession:
         self.max_rejects = max_rejects
         self.fitter = fit_auto(toas, model, fused=True)
         self.engine = None
-        #: per-request SessionResult records, in arrival order
-        self.history: list[SessionResult] = []
+        #: the most recent request records, in arrival order (bounded:
+        #: long-lived sessions keep the last HISTORY_KEEP only — counts
+        #: and percentiles come from the bounded aggregates below)
+        self.history: deque[SessionResult] = deque(maxlen=HISTORY_KEEP)
+        self._n_requests = 0
+        self._path_counts: dict[str, int] = {}
+        #: bounded streaming latency quantiles over served refits — the
+        #: same sketch the serving engine uses for its SLO telemetry,
+        #: replacing the unbounded raw-sample percentile of old
+        self._lat_sketch = perf.QuantileSketch()
+
+    def _record(self, sr: SessionResult) -> SessionResult:
+        """Fold one answered request into the bounded telemetry."""
+        self.history.append(sr)
+        self._n_requests += 1
+        self._path_counts[sr.path] = self._path_counts.get(sr.path, 0) + 1
+        if sr.path in ("incremental", "full_fallback"):
+            self._lat_sketch.add(sr.latency_ms)
+        return sr
+
+    @classmethod
+    def from_state(cls, toas, model, state, *, maxiter: int = 30,
+                   required_chi2_decrease: float = 1e-2,
+                   max_rejects: int = 16,
+                   warm_appends: int = 8) -> "TimingSession":
+        """Rebuild a resident session from a :class:`FitterState`
+        snapshot WITHOUT re-running the fit: the fitter is constructed
+        over the (re-)prepared TOAs, warm-started to the snapshot's
+        exact (hi, lo) solution, and the incremental engine recaptures
+        its blocks at that point — so the restored session's next append
+        is served by the same rank-k update, from the same fixed point,
+        as the session that was checkpointed (serve/pool.py evictions;
+        parity locked by tests/test_serve.py). In a warmed process every
+        program this touches is served by the process-global program
+        caches or the ``.aotx`` artifact store: restore pays disk reads,
+        not traces (``PINT_TPU_EXPECT_WARM=1`` enforces it)."""
+        from pint_tpu.fitting.incremental import IncrementalEngine
+        from pint_tpu.fitting.state import warm_start
+
+        ses = cls(toas, model, maxiter=maxiter,
+                  required_chi2_decrease=required_chi2_decrease,
+                  max_rejects=max_rejects)
+        warm_start(ses.fitter, state, strict=True)
+        ses.engine = IncrementalEngine(ses.fitter)
+        if warm_appends:
+            ses.engine.precompile_append(ses.fitter, k_hint=warm_appends)
+        return ses
 
     # -- lifecycle -----------------------------------------------------------------
 
@@ -98,10 +178,8 @@ class TimingSession:
             self.engine.refresh(self.fitter)
         if warm_appends:
             self.engine.precompile_append(self.fitter, k_hint=warm_appends)
-        out = SessionResult(res, "full", 0,
-                            (time.perf_counter() - t0) * 1e3)
-        self.history.append(out)
-        return out
+        return self._record(SessionResult(
+            res, "full", 0, (time.perf_counter() - t0) * 1e3))
 
     def precompile(self, background: bool = False):
         """AOT-warm the session's full-fit programs (the incremental
@@ -165,25 +243,23 @@ class TimingSession:
         else:
             out = SessionResult(ir.result, ir.path, k, latency_ms,
                                 reason=ir.reason, breakdown=bd)
-        self.history.append(out)
-        return out
+        return self._record(out)
 
     # -- telemetry -----------------------------------------------------------------
 
     def stats(self) -> dict:
         """Per-request latency distribution + path counts — the per-chip
-        serving numbers the bench's ``--smoke --session`` record carries."""
-        lat = np.array([h.latency_ms for h in self.history
-                        if h.path in ("incremental", "full_fallback")])
-        paths: dict[str, int] = {}
-        for h in self.history:
-            paths[h.path] = paths.get(h.path, 0) + 1
+        serving numbers the bench's ``--smoke --session`` record carries.
+        Percentiles come from the bounded :class:`~pint_tpu.ops.perf.
+        QuantileSketch`, so a session serving appends for months reports
+        p50/p99 from a few hundred bucket counts, not a growing sample
+        list."""
         from pint_tpu.ops.compile import aot_block
 
         blk = aot_block()
         out = {
-            "n_requests": len(self.history),
-            "paths": paths,
+            "n_requests": self._n_requests,
+            "paths": dict(self._path_counts),
             "n_toas": len(self.toas),
             # serialized-executable traffic (process-wide): a session
             # fleet warmed by `pint_tpu warmup` serves from deserialized
@@ -192,19 +268,54 @@ class TimingSession:
                     "deserialize_misses": blk["deserialize_misses"],
                     "enabled": blk["enabled"]},
         }
-        if lat.size:
+        if self._lat_sketch.count:
             out.update(
-                incremental_refit_ms_p50=round(float(np.percentile(lat, 50)), 3),
-                incremental_refit_ms_p99=round(float(np.percentile(lat, 99)), 3),
+                incremental_refit_ms_p50=round(
+                    self._lat_sketch.quantile(0.5), 3),
+                incremental_refit_ms_p99=round(
+                    self._lat_sketch.quantile(0.99), 3),
             )
         return out
+
+
+def batch_refit(sessions: list[TimingSession],
+                maxiter: int | None = None) -> list[SessionResult]:
+    """Run full refits for several resident sessions as ONE fleet-fit
+    dispatch (fitting/batch.py skeleton buckets), then refresh each
+    session's incremental state. Shared by :meth:`TimingService.drain`
+    and the continuous-batching engine (serve/engine.py), so both answer
+    batched refits identically. Returns one :class:`SessionResult` per
+    session, already folded into that session's telemetry."""
+    from pint_tpu.fitting.batch import fit_batch
+
+    if not sessions:
+        return []
+    t0 = time.perf_counter()
+    fitters = [ses.fitter for ses in sessions]
+    with perf.stage("incremental"), perf.stage("full_refit"):
+        results = fit_batch(
+            fitters, maxiter=maxiter if maxiter is not None
+            else sessions[0].maxiter)
+    latency_ms = (time.perf_counter() - t0) * 1e3
+    out = []
+    for ses, res in zip(sessions, results):
+        if ses.engine is None:
+            from pint_tpu.fitting.incremental import IncrementalEngine
+
+            ses.engine = IncrementalEngine(ses.fitter)
+        else:
+            ses.engine.refresh(ses.fitter)
+        out.append(ses._record(SessionResult(res, "full", 0, latency_ms)))
+    return out
 
 
 class TimingService:
     """Many resident sessions behind one request queue.
 
     ``submit`` enqueues ``{"session": sid, "kind": "append"|"refit",
-    ...rows}`` requests; ``drain`` answers everything queued:
+    ...rows}`` requests (thread-safe: concurrent client threads submit
+    into one queue, each request stamped with its own enqueue time);
+    ``drain`` answers everything queued:
 
     - append requests for the same session coalesce into ONE prepared-
       column append + ONE rank-k refit (the batching a bursty client
@@ -214,12 +325,21 @@ class TimingService:
       after which each session's incremental state is refreshed.
 
     Batched ≡ sequential: the fleet driver freezes converged elements,
-    so every session's answer equals serving its requests alone.
+    so every session's answer equals serving its requests alone. Every
+    returned :class:`SessionResult` carries PER-REQUEST latency —
+    ``latency_ms`` measured from that request's own enqueue stamp and
+    ``queue_ms`` for the wait before its (possibly shared) solve — never
+    one wall-clock figure smeared over a coalesced batch.
+
+    This is the synchronous substrate; the always-on worker loop with
+    admission control and deadline-driven dispatch is
+    :class:`pint_tpu.serve.engine.ServingEngine`.
     """
 
     def __init__(self):
         self.sessions: dict[str, TimingSession] = {}
         self._queue: list[dict] = []
+        self._lock = threading.Lock()
 
     def add_session(self, sid: str, session: TimingSession) -> None:
         if sid in self.sessions:
@@ -233,63 +353,63 @@ class TimingService:
         kind = request.get("kind")
         if kind not in ("append", "refit"):
             raise ValueError(f"unknown request kind {kind!r}")
-        self._queue.append(dict(request))
+        request = dict(request)
+        # per-request enqueue stamp: queue wait is attributed to THIS
+        # request even when a coalesced batch answers it
+        request["_enqueue_t"] = time.perf_counter()
+        with self._lock:
+            self._queue.append(request)
 
     def _coalesce_appends(self, reqs: list[dict]) -> dict:
         """Merge several append payloads into one row block."""
-        from pint_tpu.astro import time as ptime
+        return coalesce_append_payloads(reqs)
 
-        eps = [r["utc"] for r in reqs]
-        cat = np.concatenate
-        return {
-            "utc": ptime.MJDEpoch(cat([e.day for e in eps]),
-                                  cat([e.frac_hi for e in eps]),
-                                  cat([e.frac_lo for e in eps])),
-            "error_us": cat([np.asarray(r["error_us"]) for r in reqs]),
-            "freq_mhz": cat([np.asarray(r["freq_mhz"]) for r in reqs]),
-            "obs": cat([np.asarray(r["obs"]) for r in reqs]),
-            "flags": sum((list(r.get("flags") or
-                               [{} for _ in np.asarray(r["error_us"])])
-                          for r in reqs), []),
-        }
+    @staticmethod
+    def _per_request(reqs: list[dict], shared: SessionResult,
+                     t_dispatch: float, t_done: float) -> list[SessionResult]:
+        """Wrap one shared solve into per-request results: each request
+        carries its own queue wait + end-to-end latency and its own row
+        count; the FitResult/breakdown of the shared solve is shared."""
+        out = []
+        for r in reqs:
+            t_enq = r.get("_enqueue_t", t_dispatch)
+            k = (len(np.asarray(r["error_us"]))
+                 if r.get("error_us") is not None else shared.k)
+            out.append(SessionResult(
+                shared.result, shared.path, k,
+                latency_ms=(t_done - t_enq) * 1e3,
+                reason=shared.reason, breakdown=shared.breakdown,
+                queue_ms=max(t_dispatch - t_enq, 0.0) * 1e3))
+        return out
 
     def drain(self) -> dict[str, list[SessionResult]]:
         """Answer every queued request; returns per-session results in
-        submission order (coalesced/batched requests share one wall)."""
-        from pint_tpu.fitting.batch import fit_batch
-
-        queue, self._queue = self._queue, []
+        submission order (coalesced/batched requests share one solve but
+        report their own latencies)."""
+        with self._lock:
+            queue, self._queue = self._queue, []
         out: dict[str, list[SessionResult]] = {}
         appends: dict[str, list[dict]] = {}
-        refits: list[str] = []
+        refits: dict[str, list[dict]] = {}
         for r in queue:
             sid = r["session"]
             if r["kind"] == "append":
                 appends.setdefault(sid, []).append(r)
-            elif sid not in refits:
-                refits.append(sid)
+            else:
+                refits.setdefault(sid, []).append(r)
         for sid, reqs in appends.items():
             ses = self.sessions[sid]
+            t_dispatch = time.perf_counter()
             res = ses.append(**self._coalesce_appends(reqs))
-            # every coalesced request is answered by the shared refit
-            out.setdefault(sid, []).extend([res] * len(reqs))
+            t_done = time.perf_counter()
+            out.setdefault(sid, []).extend(
+                self._per_request(reqs, res, t_dispatch, t_done))
         if refits:
-            t0 = time.perf_counter()
-            fitters = [self.sessions[sid].fitter for sid in refits]
-            with perf.stage("incremental"), perf.stage("full_refit"):
-                results = fit_batch(
-                    fitters,
-                    maxiter=self.sessions[refits[0]].maxiter)
-            latency_ms = (time.perf_counter() - t0) * 1e3
-            for sid, res in zip(refits, results):
-                ses = self.sessions[sid]
-                if ses.engine is None:
-                    from pint_tpu.fitting.incremental import IncrementalEngine
-
-                    ses.engine = IncrementalEngine(ses.fitter)
-                else:
-                    ses.engine.refresh(ses.fitter)
-                sr = SessionResult(res, "full", 0, latency_ms)
-                ses.history.append(sr)
-                out.setdefault(sid, []).append(sr)
+            t_dispatch = time.perf_counter()
+            sids = list(refits)
+            results = batch_refit([self.sessions[sid] for sid in sids])
+            t_done = time.perf_counter()
+            for sid, sr in zip(sids, results):
+                out.setdefault(sid, []).extend(
+                    self._per_request(refits[sid], sr, t_dispatch, t_done))
         return out

@@ -25,6 +25,14 @@ site                      armed modes
                           append refit declare its cached linearization
                           stale, driving the ``fit.incremental_fallback``
                           full-refit path (fitting/incremental.py)
+``serve.admit``           ``shed`` — :func:`trip` makes the serving
+                          admission controller shed the request as if the
+                          queue were at depth, driving the ``serve.shed``
+                          overload path (serve/scheduler.py)
+``serve.pool``            ``evict`` — :func:`trip` makes the warm session
+                          pool evict the requested session before serving
+                          it, driving the ``serve.evict`` +
+                          checkpoint-restore path (serve/pool.py)
 ========================  =====================================================
 
 Arming

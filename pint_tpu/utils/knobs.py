@@ -63,6 +63,12 @@ KNOBS: dict[str, tuple[str | None, str]] = {
     # --- incremental refits / timing sessions (fitting/incremental.py, serve/) --
     "PINT_TPU_INCR_MAX_FRAC": ("0.05", "appended-row fraction past which an incremental refit falls back to the full warm refit"),
     "PINT_TPU_INCR_MAX_SHIFT": ("3.0", "blocks-solve step bound in units of parameter sigma past which the incremental linearization is declared stale"),
+    # --- serving engine (pint_tpu/serve/) --------------------------------------
+    "PINT_TPU_SERVE_MAX_WAIT_MS": ("50", "continuous-batching lane deadline: max ms a queued request waits for its bucket to fill before dispatch"),
+    "PINT_TPU_SERVE_QUEUE_DEPTH": ("256", "bounded serving queue: requests admitted beyond this depth are shed (serve.shed)"),
+    "PINT_TPU_SERVE_POOL_SESSIONS": ("64", "warm session-pool capacity: LRU sessions beyond it are checkpointed + evicted (serve.evict)"),
+    "PINT_TPU_SERVE_SHED_POLICY": ("reject", "overload policy: reject (refuse the new request) or drop_oldest (shed the oldest queued request instead)"),
+    "PINT_TPU_SERVE_TENANT_RPS": ("0", "per-tenant token-bucket admission rate in requests/s (0: unlimited)"),
     # --- Bayesian noise engine (fitting/noise_like.py, sampler.py) -------------
     "PINT_TPU_NOISE_CHAINS": ("4", "vmapped noise-posterior chains per sample() call"),
     "PINT_TPU_NOISE_RESTARTS": ("8", "batched optimizer restarts for ML noise estimation"),

@@ -1,0 +1,637 @@
+"""The serving throughput engine (pint_tpu/serve/): continuous batching,
+warm session pool, admission control — ISSUE 13.
+
+Locks, bottom to top:
+
+- ``QuantileSketch`` (ops/perf.py): bounded memory, ≤5% relative error
+  vs exact percentiles, mergeable, monotone.
+- ``TokenBucket`` / ``AdmissionController`` (serve/scheduler.py): rate
+  and depth sheds raise :class:`ShedError` with a ``serve.shed`` ledger
+  event FIRST; ``PINT_TPU_DEGRADED=error`` turns the shed into a
+  refusal; the ``serve.admit:shed`` fault drives the path end-to-end
+  via ``PINT_TPU_FAULTS``.
+- ``ContinuousBatchScheduler``: lanes dispatch on fill or deadline,
+  append batches respect the coalesce bucket, the padding-waste EWMA
+  stretches the effective wait and queue pressure collapses it.
+- ``SessionPool`` (serve/pool.py): LRU eviction checkpoints through
+  ``FitterState`` + raw rows and records ``serve.evict``; an
+  evicted-then-restored session answers its next append with ZERO
+  traces under ``PINT_TPU_EXPECT_WARM=1`` and the never-evicted twin's
+  answer to ≤1e-10; the ``serve.pool:evict`` fault drill forces the
+  path via ``PINT_TPU_FAULTS``.
+- ``ServingEngine`` (serve/engine.py): coalesced continuous-batching
+  answers ≡ the same trace served sequentially, per-request SLO stamps,
+  ≥90% ``serve_breakdown`` attribution, ``drop_oldest`` overload
+  policy.
+- The ``bench.py --smoke --serve`` replayed-trace contract: ≥2x the
+  serial one-at-a-time drain, strict-audit clean, EMPTY ledger under
+  ``PINT_TPU_DEGRADED=error`` at nominal load, shed under overload with
+  a depth-bounded p99, graceful chaos brownout with
+  ``traces_on_warm == 0``.
+"""
+
+import copy
+import threading
+
+import numpy as np
+import pytest
+
+from pint_tpu.astro import time as ptime
+from pint_tpu.fitting.wls import apply_delta
+from pint_tpu.io.par import parse_parfile
+from pint_tpu.models.base import leaf_to_f64
+from pint_tpu.models.builder import build_model
+from pint_tpu.ops import degrade, perf
+from pint_tpu.ops.perf import QuantileSketch
+from pint_tpu.serve import (AdmissionController, ServeTicket, ServingEngine,
+                            SessionPool, ShedError, TimingSession,
+                            TokenBucket)
+from pint_tpu.serve.scheduler import ContinuousBatchScheduler
+from pint_tpu.simulation import make_fake_toas_uniform
+from pint_tpu.testing import faults
+
+PAR = """
+PSR SERVTEST
+RAJ 04:37:15.9 1
+DECJ -47:15:09.1 1
+F0 173.6879489990983 1
+F1 -1.728e-15 1
+PEPOCH 55000
+POSEPOCH 55000
+DM 2.64 1
+TZRMJD 55000.1
+TZRSITE gbt
+TZRFRQ 1400
+"""
+
+GPS2UTC = """# gps2utc.clk
+ 40000.00    0.000
+ 62000.00    0.000
+"""
+
+TIME_GBT = """# time_gbt.dat
+ 40000.00    2.000
+ 62000.00    2.000
+"""
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    degrade.reset_ledger()
+    faults.reset()
+    yield
+    degrade.reset_ledger()
+    faults.reset()
+
+
+@pytest.fixture(autouse=True)
+def _isolated_cache(tmp_path, monkeypatch):
+    monkeypatch.setenv("PINT_TPU_CACHE_DIR", str(tmp_path / "cache"))
+    yield
+
+
+class FakeClock:
+    def __init__(self, t: float = 100.0):
+        self.t = float(t)
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+def _dataset(N, seed=11):
+    model = build_model(parse_parfile(PAR, from_text=True))
+    freqs = np.where(np.arange(N) % 2 == 0, 1400.0, 2300.0)
+    toas = make_fake_toas_uniform(
+        54500, 55500, N, model, obs="gbt", freq_mhz=freqs, error_us=1.0,
+        add_noise=True, rng=np.random.default_rng(seed))
+    free = tuple(model.free_params)
+    delta = np.array([2e-10 if nm == "F0" else 0.0 for nm in free])
+    model.params = apply_delta(model.params, free, delta)
+    return model, toas
+
+
+def _rows(full, lo, hi):
+    ep = full.utc_raw
+    return dict(
+        utc=ptime.MJDEpoch(ep.day[lo:hi], ep.frac_hi[lo:hi],
+                           ep.frac_lo[lo:hi]),
+        error_us=full.error_us[lo:hi], freq_mhz=full.freq_mhz[lo:hi],
+        obs=full.obs[lo:hi], flags=[dict(f) for f in full.flags[lo:hi]],
+    )
+
+
+def _session(n=100, extra=24, seed=11):
+    model, full = _dataset(n + extra, seed=seed)
+    base = full.select(np.arange(len(full)) < n)
+    ses = TimingSession(base, model)
+    ses.fit()
+    return model, full, ses, n
+
+
+# --- the bounded quantile sketch ---------------------------------------------------
+
+
+class TestQuantileSketch:
+    def test_accuracy_vs_exact(self):
+        rng = np.random.default_rng(3)
+        vals = np.exp(rng.normal(3.0, 1.2, 8000))
+        sk = QuantileSketch()
+        for v in vals:
+            sk.add(v)
+        for q in (0.1, 0.5, 0.9, 0.99):
+            exact = float(np.percentile(vals, q * 100))
+            assert abs(sk.quantile(q) - exact) <= 0.05 * exact
+        assert sk.quantile(0.0) == float(vals.min())
+        assert sk.quantile(1.0) == float(vals.max())
+
+    def test_bounded_memory_and_monotone(self):
+        rng = np.random.default_rng(4)
+        sk = QuantileSketch()
+        # nine decades of values: memory stays a few hundred buckets, a
+        # raw sample buffer would hold 30000 floats
+        for v in 10.0 ** rng.uniform(-3, 6, 30000):
+            sk.add(v)
+        assert sk.count == 30000
+        assert sk.n_buckets() < 1200
+        qs = [sk.quantile(q) for q in (0.01, 0.25, 0.5, 0.75, 0.99)]
+        assert qs == sorted(qs)
+
+    def test_empty_and_merge(self):
+        sk = QuantileSketch()
+        assert sk.quantile(0.5) is None
+        assert sk.summary()["p50_ms"] is None
+        a, b, ab = QuantileSketch(), QuantileSketch(), QuantileSketch()
+        rng = np.random.default_rng(5)
+        va, vb = rng.exponential(10, 2000), rng.exponential(50, 2000)
+        for v in va:
+            a.add(v), ab.add(v)
+        for v in vb:
+            b.add(v), ab.add(v)
+        a.merge(b)
+        assert a.count == ab.count
+        for q in (0.5, 0.99):
+            assert a.quantile(q) == pytest.approx(ab.quantile(q))
+
+    def test_session_stats_use_sketch(self):
+        """ISSUE 13 satellite: TimingSession percentiles come from the
+        bounded sketch + counters, not an unbounded raw list — history
+        is capped while n_requests and p50/p99 keep counting."""
+        from pint_tpu.serve.session import HISTORY_KEEP, SessionResult
+
+        ses = TimingSession.__new__(TimingSession)
+        from collections import deque
+
+        ses.history = deque(maxlen=HISTORY_KEEP)
+        ses._n_requests = 0
+        ses._path_counts = {}
+        ses._lat_sketch = QuantileSketch()
+        for i in range(2 * HISTORY_KEEP):
+            ses._record(SessionResult(None, "incremental", 1,
+                                      latency_ms=10.0 + (i % 50)))
+        assert len(ses.history) == HISTORY_KEEP      # bounded
+        assert ses._n_requests == 2 * HISTORY_KEEP   # complete
+        assert ses._lat_sketch.count == 2 * HISTORY_KEEP
+        p50, p99 = (ses._lat_sketch.quantile(0.5),
+                    ses._lat_sketch.quantile(0.99))
+        assert 10.0 <= p50 <= p99 <= 60.0
+
+
+# --- admission control -------------------------------------------------------------
+
+
+class TestAdmission:
+    def test_token_bucket_rate(self):
+        fc = FakeClock()
+        tb = TokenBucket(rate=2.0, clock=fc)
+        assert tb.try_take() and tb.try_take()   # burst of 2
+        assert not tb.try_take()                 # drained
+        fc.advance(0.5)                          # +1 token
+        assert tb.try_take()
+        assert not tb.try_take()
+        assert TokenBucket(rate=0.0, clock=fc).try_take()  # disabled
+
+    def test_depth_shed_records_ledger(self):
+        adm = AdmissionController(max_depth=2, tenant_rps=0,
+                                  policy="reject")
+        assert adm.admit("t1", 0) == "admit"
+        with pytest.raises(ShedError):
+            adm.admit("t1", 2)
+        assert adm.shed_count == 1
+        evs = degrade.events()
+        assert [e.kind for e in evs] == ["serve.shed"]
+        assert "PINT_TPU_SERVE" in evs[0].fix
+
+    def test_tenant_rate_shed(self):
+        fc = FakeClock()
+        adm = AdmissionController(max_depth=100, tenant_rps=1.0,
+                                  policy="reject", clock=fc)
+        assert adm.admit("a", 0) == "admit"
+        with pytest.raises(ShedError):
+            adm.admit("a", 0)
+        # a DIFFERENT tenant has its own bucket
+        assert adm.admit("b", 0) == "admit"
+        fc.advance(1.0)
+        assert adm.admit("a", 0) == "admit"
+
+    def test_degraded_error_refuses(self, monkeypatch):
+        """The production contract: under PINT_TPU_DEGRADED=error the
+        shed IS a refusal (DegradedError), with the event recorded."""
+        monkeypatch.setenv("PINT_TPU_DEGRADED", "error")
+        adm = AdmissionController(max_depth=1, tenant_rps=0,
+                                  policy="reject")
+        with pytest.raises(degrade.DegradedError, match="serve.shed"):
+            adm.admit("t", 5)
+        assert degrade.degradation_count() == 1
+
+    def test_fault_drill_via_knob(self, monkeypatch):
+        """PINT_TPU_FAULTS=serve.admit:shed drives serve.shed end-to-end
+        with zero real load."""
+        monkeypatch.setenv("PINT_TPU_FAULTS", "serve.admit:shed*1")
+        adm = AdmissionController(max_depth=100, tenant_rps=0,
+                                  policy="reject")
+        with pytest.raises(ShedError, match="fault-injected"):
+            adm.admit("t", 0)
+        assert ("serve.admit", "shed") in [(s, m) for s, m, _ in faults.fired]
+        assert adm.admit("t", 0) == "admit"      # *1: one firing only
+        assert [e.kind for e in degrade.events()] == ["serve.shed"]
+
+    def test_unknown_policy_refused(self):
+        with pytest.raises(ValueError, match="shed policy"):
+            AdmissionController(max_depth=1, policy="frobnicate")
+
+
+# --- the continuous-batching scheduler ---------------------------------------------
+
+
+def _ticket(sid="s", rows=2, kind="append", fc=None):
+    t = ServeTicket(session=sid, kind=kind, tenant="t", rows=rows,
+                    lane_key=(("append", sid) if kind == "append"
+                              else ("refit", "wls", 128)))
+    t.t_submit = fc() if fc is not None else 0.0
+    return t
+
+
+class TestScheduler:
+    def test_append_lane_fills_to_coalesce_cap(self):
+        fc = FakeClock()
+        sch = ContinuousBatchScheduler(max_wait_ms=50.0, coalesce_rows=8,
+                                       clock=fc)
+        for _ in range(6):
+            sch.offer(_ticket(rows=2, fc=fc), rows=2)
+        assert sch.depth() == 6
+        batches = sch.due(capacity=256, append_cap=lambda sid: 8)
+        # full lane dispatches its HEAD (4 tickets = 8 rows = one device
+        # bucket); the remainder stays queued for the next turn
+        assert len(batches) == 1
+        assert len(batches[0].tickets) == 4 and batches[0].rows == 8
+        assert sch.depth() == 2
+        # the remainder is below the fill target: nothing due until the
+        # deadline passes
+        assert sch.due(capacity=256, append_cap=lambda sid: 8) == []
+        fc.advance(0.2)
+        batches = sch.due(capacity=256, append_cap=lambda sid: 8)
+        assert len(batches) == 1 and len(batches[0].tickets) == 2
+        assert sch.depth() == 0
+
+    def test_refit_lane_batches_and_deadline(self):
+        fc = FakeClock()
+        sch = ContinuousBatchScheduler(max_wait_ms=50.0, refit_batch=3,
+                                       clock=fc)
+        for _ in range(2):
+            sch.offer(_ticket(kind="refit", rows=1, fc=fc), rows=1)
+        assert sch.due(capacity=256) == []       # 2 < refit_batch
+        sch.offer(_ticket(kind="refit", rows=1, fc=fc), rows=1)
+        batches = sch.due(capacity=256)
+        assert len(batches) == 1 and len(batches[0].tickets) == 3
+        # a lone refit dispatches at the deadline instead of waiting
+        # forever for a fleet
+        sch.offer(_ticket(kind="refit", rows=1, fc=fc), rows=1)
+        fc.advance(0.2)
+        assert len(sch.due(capacity=256)) == 1
+
+    def test_waste_ewma_stretches_and_pressure_collapses(self):
+        fc = FakeClock()
+        sch = ContinuousBatchScheduler(max_wait_ms=100.0, clock=fc)
+        base = sch.effective_wait_s(capacity=256)
+        assert base == pytest.approx(0.1)
+        for _ in range(10):
+            sch.observe_waste(0.8)              # underfilled dispatches
+        stretched = sch.effective_wait_s(capacity=256)
+        assert base < stretched <= 4 * base     # padding waste -> patience
+        # queue pressure beats occupancy: at >= half capacity the wait
+        # collapses so latency is shed, not accumulated
+        for _ in range(8):
+            sch.offer(_ticket(fc=fc), rows=2)
+        assert sch.effective_wait_s(capacity=16) == pytest.approx(0.25 * 0.1)
+
+    def test_drop_oldest_pops_globally_oldest(self):
+        fc = FakeClock()
+        sch = ContinuousBatchScheduler(max_wait_ms=50.0, clock=fc)
+        t1 = _ticket(sid="a", fc=fc)
+        fc.advance(0.01)
+        t2 = _ticket(sid="b", fc=fc)
+        sch.offer(t1, rows=2)
+        sch.offer(t2, rows=2)
+        assert sch.drop_oldest() is t1
+        assert sch.depth() == 1
+
+
+# --- the warm session pool ---------------------------------------------------------
+
+
+class TestSessionPool:
+    def test_lru_evict_checkpoint_restore_parity(self, monkeypatch):
+        """Evict-then-restore: serve.evict on the ledger, the restored
+        session answers its next append with ZERO traces (under
+        PINT_TPU_EXPECT_WARM=1) and the never-evicted twin's parameters
+        to <= 1e-10."""
+        from pint_tpu.analysis.jaxpr_audit import compile_count
+
+        model, full, ses, n = _session(n=100, extra=24, seed=7)
+        twin = TimingSession(full.select(np.arange(len(full)) < n),
+                             copy.deepcopy(model))
+        twin.fit()
+        # both serve one append first, so every program shape is warm
+        ses.append(**_rows(full, n, n + 4))
+        twin.append(**_rows(full, n, n + 4))
+
+        pool = SessionPool(capacity=1)
+        pool.put("psr", ses)
+        pool.put("other", twin)        # capacity 1: evicts "psr"
+        assert pool.evictions == 1
+        assert "serve.evict" in {e.kind for e in degrade.events()}
+        assert "psr" in pool           # still addressable (checkpointed)
+
+        pool.capacity = 2              # room for the restore
+        c0 = compile_count()
+        with monkeypatch.context() as m:
+            m.setenv("PINT_TPU_EXPECT_WARM", "1")
+            restored = pool.get("psr")             # checkpoint restore
+            r = restored.append(**_rows(full, n + 4, n + 8))
+        assert compile_count() == c0               # traces_on_warm == 0
+        assert pool.restores == 1
+        assert r.path == "incremental"
+        rt = twin.append(**_rows(full, n + 4, n + 8))
+        free = tuple(model.free_params)
+        for nm in free:
+            a = float(np.asarray(leaf_to_f64(
+                restored.fitter.model.params[nm])))
+            b = float(np.asarray(leaf_to_f64(twin.fitter.model.params[nm])))
+            assert abs(a - b) <= 1e-10 * max(abs(b), 1e-300)
+            assert (abs(r.result.uncertainties[nm] - rt.result.uncertainties[nm])
+                    <= 1e-10 * rt.result.uncertainties[nm])
+
+    def test_eviction_refused_under_degraded_error(self, monkeypatch):
+        model, full, ses, n = _session(n=96, extra=8, seed=9)
+        pool = SessionPool(capacity=1)
+        pool.put("a", ses)
+        monkeypatch.setenv("PINT_TPU_DEGRADED", "error")
+        with pytest.raises(degrade.DegradedError, match="serve.evict"):
+            pool.put("b", ses)
+        # the refused insert did not register the new sid
+        assert "b" not in pool
+
+    def test_fault_drill_forces_evict_restore(self, monkeypatch):
+        """PINT_TPU_FAULTS=serve.pool:evict drives serve.evict + restore
+        end-to-end on a healthy pool."""
+        model, full, ses, n = _session(n=96, extra=8, seed=13)
+        pool = SessionPool(capacity=4)
+        pool.put("a", ses)
+        monkeypatch.setenv("PINT_TPU_FAULTS", "serve.pool:evict*1")
+        restored = pool.get("a")
+        assert pool.evictions == 1 and pool.restores == 1
+        assert restored is not ses
+        assert "serve.evict" in {e.kind for e in degrade.events()}
+        r = restored.append(**_rows(full, n, n + 4))
+        assert r.path == "incremental"
+        assert pool.get("a") is restored   # fault exhausted: plain hit
+        assert pool.stats()["hits"] == 1
+
+    def test_unknown_session_raises(self):
+        with pytest.raises(KeyError):
+            SessionPool(capacity=2).get("nope")
+
+
+# --- the serving engine ------------------------------------------------------------
+
+
+class TestServingEngine:
+    def _engine_fleet(self, n=96, seed=17, **kw):
+        model, full, ses, n = _session(n=n, extra=24, seed=seed)
+        pool = SessionPool(capacity=4)
+        engine = ServingEngine(pool, max_wait_ms=20.0, **kw)
+        engine.add_session("a", ses)
+        return model, full, ses, n, engine
+
+    def test_coalesced_equals_sequential_with_slo_stamps(self):
+        model, full, ses, n, engine = self._engine_fleet()
+        # the sequential twin serves the SAME rows one at a time
+        twin = TimingSession(full.select(np.arange(len(full)) < n),
+                             copy.deepcopy(model))
+        twin.fit()
+
+        was = perf.enabled()
+        perf.enable(True)
+        try:
+            with perf.collect() as rep:
+                tickets = [engine.submit(session="a", tenant="c",
+                                         **_rows(full, n + 2 * j,
+                                                 n + 2 * j + 2))
+                           for j in range(4)]
+                engine.run_until_idle()
+        finally:
+            perf.enable(was)
+        results = [t.wait(timeout=1.0) for t in tickets]
+        # coalescing happened: fewer dispatches than requests — with the
+        # append cap at PINT_TPU_INCR_MAX_FRAC * 96 = 4 rows, the 4
+        # two-row requests dispatched as 2 four-row rank-k updates
+        assert engine.served == 4
+        assert engine.dispatches == 2
+        # the twin replays the ENGINE'S partition directly on the
+        # session surface: each coalesced dispatch ≡ the same merged
+        # append served solo (cross-partition agreement is only bounded
+        # by the LM convergence tolerance, so the partition is the
+        # contract, not an incident)
+        twin.append(**_rows(full, n, n + 4))
+        twin.append(**_rows(full, n + 4, n + 8))
+        # per-request SLO stamps: each ticket carries its own latency
+        # and queue wait, and the sketches saw every request
+        for t in tickets:
+            assert t.latency_ms > 0 and t.queue_ms >= 0
+            assert t.latency_ms >= t.queue_ms
+        assert engine.latency.count == 4
+        st = engine.stats()
+        assert st["latency"]["p99_ms"] >= st["latency"]["p50_ms"]
+        # batched continuous serving ≡ the sequential twin
+        free = tuple(model.free_params)
+        for nm in free:
+            a = float(np.asarray(leaf_to_f64(ses.fitter.model.params[nm])))
+            b = float(np.asarray(leaf_to_f64(twin.fitter.model.params[nm])))
+            assert abs(a - b) <= 1e-10 * max(abs(b), 1e-300)
+        assert all(r.path == "incremental" for r in results)
+        # the serve breakdown names >=90% of the serve wall
+        bd = perf.serve_breakdown(rep)
+        named = sum(v for k, v in bd.items()
+                    if k.startswith("serve_") and k.endswith("_s")
+                    and k not in ("serve_wall_s", "serve_other_s"))
+        assert bd["serve_wall_s"] > 0
+        assert named >= 0.9 * bd["serve_wall_s"] - 0.01
+        assert bd["serve_requests"] == 4
+        assert bd["serve_appends"] == 4
+        assert bd["serve_dispatches"] == engine.dispatches
+
+    def test_background_worker_with_concurrent_clients(self):
+        model, full, ses, n, engine = self._engine_fleet(seed=19)
+        tickets, lock = [], threading.Lock()
+
+        def client(offsets):
+            mine = [engine.submit(session="a", tenant="c",
+                                  **_rows(full, n + o, n + o + 2))
+                    for o in offsets]
+            with lock:
+                tickets.extend(mine)
+
+        engine.start()
+        try:
+            threads = [threading.Thread(target=client, args=(offs,))
+                       for offs in ([0, 4, 8], [2, 6, 10])]
+            for th in threads:
+                th.start()
+            for th in threads:
+                th.join()
+            results = [t.wait(timeout=60.0) for t in tickets]
+        finally:
+            engine.stop()
+        assert len(results) == 6 and all(r.path == "incremental"
+                                         for r in results)
+        assert len(ses.toas) == n + 12
+
+    def test_drop_oldest_policy_delivers_shed_to_victim(self):
+        model, full, ses, n, engine = self._engine_fleet(
+            seed=23, queue_depth=2, shed_policy="drop_oldest")
+        # engine NOT running: the queue fills and the third submit
+        # drops the FIRST request instead of refusing the newest
+        t1 = engine.submit(session="a", tenant="c", **_rows(full, n, n + 2))
+        t2 = engine.submit(session="a", tenant="c",
+                           **_rows(full, n + 2, n + 4))
+        t3 = engine.submit(session="a", tenant="c",
+                           **_rows(full, n + 4, n + 6))
+        assert t1.done()
+        with pytest.raises(ShedError):
+            t1.wait(timeout=0.1)
+        assert "serve.shed" in {e.kind for e in degrade.events()}
+        engine.run_until_idle()
+        assert t2.wait(timeout=1.0).path == "incremental"
+        assert t3.wait(timeout=1.0).path == "incremental"
+        assert engine.admission.shed_count == 1
+        assert len(ses.toas) == n + 4            # t1's rows never landed
+
+    def test_refit_lane_batches_cross_session(self):
+        model, full, ses_a, n, engine = self._engine_fleet(seed=29)
+        model_b, full_b, ses_b, _ = _session(n=96, seed=31)
+        engine.add_session("b", ses_b)
+        t1 = engine.submit(session="a", kind="refit")
+        t2 = engine.submit(session="b", kind="refit")
+        engine.run_until_idle(timeout_s=600.0)
+        r1, r2 = t1.wait(timeout=1.0), t2.wait(timeout=1.0)
+        assert r1.path == "full" and r2.path == "full"
+        assert r1.result.converged and r2.result.converged
+        # ONE dispatch served both sessions through the fleet engine
+        assert engine.dispatches == 1
+        assert engine.stats()["refit_latency"]["count"] == 2
+
+    def test_unknown_session_and_kind(self):
+        _, _, _, _, engine = self._engine_fleet(seed=37)
+        with pytest.raises(KeyError):
+            engine.submit(session="nope", error_us=np.ones(1))
+        with pytest.raises(ValueError):
+            engine.submit(session="a", kind="frobnicate")
+
+
+# --- the bench contract ------------------------------------------------------------
+
+
+def _write_clock_dir(path):
+    path.mkdir(parents=True, exist_ok=True)
+    (path / "time_gbt.dat").write_text(TIME_GBT)
+    (path / "gps2utc.clk").write_text(GPS2UTC)
+
+
+class TestServeBenchContract:
+    def test_smoke_serve_bench_contract(self, tmp_path, monkeypatch):
+        """The --smoke --serve acceptance surface (ISSUE 13): >=2x the
+        serial drain, >=90% attribution, EMPTY nominal ledger under
+        PINT_TPU_DEGRADED=error, shed (recorded AND refusable) under
+        overload, graceful chaos brownout with traces_on_warm == 0,
+        strict-audit clean."""
+        import bench
+
+        from pint_tpu.analysis import jaxpr_audit
+
+        _write_clock_dir(tmp_path / "clk")
+        monkeypatch.setenv("PINT_CLOCK_OVERRIDE", str(tmp_path / "clk"))
+        monkeypatch.setenv("PINT_TPU_DEGRADED", "error")
+        monkeypatch.setenv("PINT_TPU_AUDIT", "strict")
+        degrade.reset_ledger()
+        jaxpr_audit.reset_ledger()
+        rec = bench.smoke_serve_bench(base_rows=(160, 200, 240),
+                                      requests_per_session=8, k=1)
+
+        # nominal: clean, fast, attributed
+        assert rec["degradation_count"] == 0
+        assert rec["serve_shed"] == 0 and rec["serve_evictions"] == 0
+        assert rec["serve_vs_serial"] >= 2.0
+        assert rec["sustained_append_fits_per_sec"] > 0
+        assert rec["serve_p50_ms"] > 0
+        assert rec["serve_p99_ms"] >= rec["serve_p50_ms"]
+        assert rec["parity_max_rel"] <= 1e-8
+        assert rec["serve_coalesced"] > 0 and rec["coalesce_ratio"] > 1.5
+        assert rec["serve_refits"] == rec["n_sessions"]
+        named = sum(v for k2, v in rec.items()
+                    if k2.startswith("serve_") and k2.endswith("_s")
+                    and k2 not in ("serve_wall_s", "serve_other_s",
+                                   "serve_span_s"))
+        assert named >= 0.9 * rec["serve_wall_s"] - 0.01
+
+        # overload: sheds recorded, p99 bounded by depth, not load
+        over = rec["overload"]
+        assert over["shed"] > 0 and over["served"] > 0
+        assert over["shed"] + over["served"] == over["offered"]
+        assert "serve.shed" in over["degradation_kinds"]
+        assert over["serve_p99_ms"] <= over["p99_bound_ms"]
+
+        # chaos: brownout, not collapse — everything admitted answered,
+        # the ledger explains, the restore was trace-free
+        chaos = rec["chaos"]
+        assert chaos["shed"] >= 1 and chaos["served"] >= 1
+        assert chaos["evictions"] >= 1 and chaos["restores"] >= 1
+        assert {"serve.shed", "serve.evict"} <= set(
+            chaos["degradation_kinds"])
+        assert chaos["traces_on_warm"] == 0
+
+        # strict-audit clean, with the serving path's programs on record
+        assert rec["audit"]["violations"] == []
+        labels = set(rec["audit"]["signatures"])
+        assert any(lbl.startswith("incr_blocks") for lbl in labels)
+        assert any(lbl.startswith("batched_") for lbl in labels)
+
+    def test_shed_refusable_under_degraded_error(self, monkeypatch):
+        """The 'refusable' half of the overload contract: the SAME
+        overload that sheds under warn REFUSES (DegradedError at the
+        submit site) under PINT_TPU_DEGRADED=error."""
+        model, full, ses, n = _session(n=96, extra=8, seed=41)
+        pool = SessionPool(capacity=2)
+        engine = ServingEngine(pool, max_wait_ms=20.0, queue_depth=1,
+                               shed_policy="reject")
+        engine.add_session("a", ses)
+        engine.submit(session="a", tenant="c", **_rows(full, n, n + 2))
+        monkeypatch.setenv("PINT_TPU_DEGRADED", "error")
+        with pytest.raises(degrade.DegradedError, match="serve.shed"):
+            engine.submit(session="a", tenant="c",
+                          **_rows(full, n + 2, n + 4))
+        monkeypatch.delenv("PINT_TPU_DEGRADED")
+        engine.run_until_idle()
+        assert engine.served == 1

@@ -275,10 +275,18 @@ class TestTimingService:
         svc.submit({"session": "psr1", "kind": "append",
                     **_rows(full, n + k, n + 2 * k)})
         out = svc.drain()
-        assert len(out["psr1"]) == 2           # both requests answered
-        assert out["psr1"][0] is out["psr1"][1]  # by ONE coalesced refit
-        assert out["psr1"][0].k == 2 * k
-        assert out["psr1"][0].path == "incremental"
+        r0, r1 = out["psr1"]                   # both requests answered...
+        assert r0.result is r1.result          # ...by ONE coalesced refit
+        assert r0.path == r1.path == "incremental"
+        # but each request reports ITS OWN rows and latency: the earlier
+        # request waited at least as long as the later one, and both
+        # carry a per-request queue-wait stamp — never one shared figure
+        assert r0.k == k and r1.k == k
+        assert r0.latency_ms >= r1.latency_ms > 0
+        assert r0.queue_ms >= r1.queue_ms >= 0
+        assert r0.latency_ms >= r0.queue_ms
+        # the session's own history holds the single coalesced solve
+        assert ses.history[-1].k == 2 * k
         assert len(ses.toas) == n + 2 * k
 
     def test_batched_equals_sequential(self):
@@ -319,6 +327,94 @@ class TestTimingService:
         svc.add_session("x", ses)
         with pytest.raises(ValueError):
             svc.submit({"session": "x", "kind": "frobnicate"})
+
+
+class TestConcurrentSubmit:
+    """ISSUE 13 satellite: `TimingService.submit` from many threads —
+    no lost or duplicated requests, deterministic coalescing (merged
+    rows follow queue order exactly), and the batched ≡ sequential
+    ≤1e-10 parity lock holds for whatever interleaving the threads
+    produced."""
+
+    N_THREADS, PER_THREAD, K = 4, 4, 1
+
+    def _fleet(self, n=240):
+        model, full = _dataset(n + 40, seed=31)
+        fleets = []
+        for _ in range(2):  # service fleet + sequential twin
+            sessions = {}
+            for sid in ("a", "b"):
+                base = full.select(np.arange(len(full)) < n)
+                ses = TimingSession(base, copy.deepcopy(model))
+                ses.fit()
+                sessions[sid] = ses
+            fleets.append(sessions)
+        return model, full, n, fleets[0], fleets[1]
+
+    def test_no_loss_deterministic_coalesce_and_parity(self):
+        import threading
+
+        model, full, n, fleet, twin = self._fleet()
+        svc = TimingService()
+        for sid, ses in fleet.items():
+            svc.add_session(sid, ses)
+
+        # each (thread, slot) owns a DISTINCT row slice; threads
+        # interleave their submissions however the scheduler runs them
+        def rows_for(t, j):
+            lo = n + (t * self.PER_THREAD + j) * self.K
+            return _rows(full, lo, lo + self.K)
+
+        barrier = threading.Barrier(self.N_THREADS)
+
+        def client(t):
+            barrier.wait()
+            for j in range(self.PER_THREAD):
+                svc.submit({"session": "a" if (t + j) % 2 == 0 else "b",
+                            "kind": "append", **rows_for(t, j)})
+
+        threads = [threading.Thread(target=client, args=(t,))
+                   for t in range(self.N_THREADS)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+
+        total = self.N_THREADS * self.PER_THREAD
+        # no lost or duplicated requests: every submission is queued once
+        assert len(svc._queue) == total
+        order = [dict(r) for r in svc._queue]  # the interleaving, frozen
+
+        out = svc.drain()
+        assert sum(len(v) for v in out.values()) == total
+        per_sid = {sid: sum(1 for r in order if r["session"] == sid)
+                   for sid in ("a", "b")}
+        for sid in ("a", "b"):
+            assert len(out[sid]) == per_sid[sid]
+            assert len(fleet[sid].toas) == n + per_sid[sid] * self.K
+
+        # sequential twin: the SAME captured interleaving served one
+        # request at a time
+        for r in order:
+            twin[r["session"]].append(
+                utc=r["utc"], error_us=r["error_us"],
+                freq_mhz=r["freq_mhz"], obs=r["obs"], flags=r["flags"])
+
+        free = tuple(model.free_params)
+        for sid in ("a", "b"):
+            # deterministic coalescing: the merged rows landed in queue
+            # order, so the grown datasets are IDENTICAL row-for-row
+            np.testing.assert_array_equal(fleet[sid].toas.utc_raw.day,
+                                          twin[sid].toas.utc_raw.day)
+            np.testing.assert_array_equal(fleet[sid].toas.utc_raw.frac_hi,
+                                          twin[sid].toas.utc_raw.frac_hi)
+            # coalesced ≡ sequential ≤1e-10 under the interleaved order
+            for nm in free:
+                a = float(np.asarray(leaf_to_f64(
+                    fleet[sid].fitter.model.params[nm])))
+                b = float(np.asarray(leaf_to_f64(
+                    twin[sid].fitter.model.params[nm])))
+                assert abs(a - b) <= 1e-10 * max(abs(b), 1e-300)
 
 
 def _write_clock_dir(path):
