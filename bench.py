@@ -28,6 +28,7 @@ from __future__ import annotations
 
 import json
 import os
+import shutil
 import sys
 import time
 
@@ -1754,6 +1755,17 @@ def smoke_serve_bench(base_rows=(160, 200, 240), requests_per_session: int = 8,
       same path into a refusal, asserted by tests/test_serve.py) and the
       served requests' p99 stays bounded by the queue depth
       (``overload.p99_bound_ms``), not the offered load.
+    - **recovery** (ISSUE 14): the nominal engine runs JOURNALED
+      (``durable_dir``; every admitted request write-ahead logged before
+      its ticket acks) and is killed crash-like (``stop(drain=False)``)
+      with a checkpointed fleet plus a journal suffix of un-checkpointed
+      requests — then ``recover_fleet`` rebuilds the whole fleet from
+      the checkpoints + journal replay, measured as ``recovery_time_s``
+      / ``journal_replay_reqs_per_sec`` with ``requests_lost == 0``,
+      recovered parameters ≡ the (never-crashed, still in-memory)
+      original fleet to ≤1e-10, ``traces_on_warm == 0``, and its own
+      ≥90%-named ``serve_breakdown`` over the journal/recover/replay
+      stages.
     - **chaos** (``PINT_TPU_FAULTS=serve.admit:shed,serve.pool:evict``):
       a forced shed plus a forced warm-pool eviction mid-trace — the
       brownout drill: throughput degrades (a restore is paid), the
@@ -1780,8 +1792,15 @@ def smoke_serve_bench(base_rows=(160, 200, 240), requests_per_session: int = 8,
     # exactly the geometry-staleness class the session guards against.
     # Pin the analytic path for the bench (tier-1 already runs with
     # PINT_TPU_NBODY=0) and restore the caller's env afterwards.
+    # the recovery leg restores UNPICKLED models (the cross-process
+    # shape): their program caches start empty, so trace-free recovery
+    # rides the .aotx serialized-executable store — turn it on for the
+    # whole bench, exactly as a durable production deployment would
+    # (pint_tpu warmup --profile serve does the same)
     prev_nbody = os.environ.get("PINT_TPU_NBODY")
+    prev_aot = os.environ.get("PINT_TPU_AOT_EXPORT")
     os.environ["PINT_TPU_NBODY"] = "0"
+    os.environ["PINT_TPU_AOT_EXPORT"] = "1"
     try:
         return _smoke_serve_bench_body(
             base_rows, requests_per_session, k, max_wait_ms,
@@ -1791,6 +1810,10 @@ def smoke_serve_bench(base_rows=(160, 200, 240), requests_per_session: int = 8,
             os.environ.pop("PINT_TPU_NBODY", None)
         else:
             os.environ["PINT_TPU_NBODY"] = prev_nbody
+        if prev_aot is None:
+            os.environ.pop("PINT_TPU_AOT_EXPORT", None)
+        else:
+            os.environ["PINT_TPU_AOT_EXPORT"] = prev_aot
 
 
 def _smoke_serve_bench_body(base_rows, requests_per_session, k, max_wait_ms,
@@ -1841,8 +1864,15 @@ def _smoke_serve_bench_body(base_rows, requests_per_session, k, max_wait_ms,
     setup_s = time.time() - t0
 
     # --- nominal leg: concurrent clients into the running engine --------
+    # journaled (durable_dir): every admitted request is write-ahead
+    # logged before its ticket acks — the recovery leg below proves the
+    # whole fleet survives a crash-like stop with requests_lost == 0
+    import tempfile
+
+    durable_dir = tempfile.mkdtemp(prefix="pint_tpu_serve_bench_")
     pool = SessionPool(capacity=len(fleet_a) + 1)
-    engine = ServingEngine(pool, max_wait_ms=max_wait_ms)
+    engine = ServingEngine(pool, max_wait_ms=max_wait_ms,
+                           durable_dir=durable_dir)
     for i, (ses, _, _) in enumerate(fleet_a):
         engine.add_session(f"psr{i}", ses)
 
@@ -1881,7 +1911,20 @@ def _smoke_serve_bench_body(base_rows, requests_per_session, k, max_wait_ms,
                              for i in range(len(fleet_a))]
             for t in refit_tickets:
                 t.wait(timeout=600.0)
-        engine.stop()
+        # durability drill setup: checkpoint the fleet (compacting the
+        # journal), serve ONE more append per session (the journal
+        # suffix a crash strands), then die WITHOUT draining — the
+        # recovery leg below must reassemble exactly this state
+        engine.checkpoint()
+        suffix_tickets = []
+        for i, (ses, full, base_n) in enumerate(fleet_a):
+            lo = base_n + nominal_rows
+            suffix_tickets.append(engine.submit(
+                session=f"psr{i}", tenant=f"client{i}",
+                **rows(full, lo, lo + k)))
+        for t in suffix_tickets:
+            t.wait(timeout=300.0)
+        engine.stop(drain=False)       # crash-like: no clean close
     perf.enable(was)
     breakdown = perf.serve_breakdown(rep)
     n_requests = len(tickets)
@@ -1899,6 +1942,11 @@ def _smoke_serve_bench_body(base_rows, requests_per_session, k, max_wait_ms,
     if include_refits:
         for (ses, _, _) in fleet_b:
             ses.fit()  # the serial twin's full refits, one per session
+    for (ses, full, base_n) in fleet_b:
+        # the twin replays the post-checkpoint journal-suffix append too,
+        # so fleet parity covers the whole durable trace
+        lo = base_n + nominal_rows
+        ses.append(**rows(full, lo, lo + k))
 
     # engine ≡ serial: every session's parameters match its twin's
     parity = 0.0
@@ -1920,6 +1968,46 @@ def _smoke_serve_bench_body(base_rows, requests_per_session, k, max_wait_ms,
     p50 = engine.latency.quantile(0.5)
     p99 = engine.latency.quantile(0.99)
 
+    # --- recovery leg: rebuild the crashed fleet, lose nothing ----------
+    # the journaled engine above died crash-like (no clean close) with a
+    # checkpointed fleet + a journal suffix of one append per session;
+    # recover_fleet must reassemble it exactly — requests_lost == 0,
+    # parameters ≡ the never-crashed in-memory fleet, zero traces
+    from pint_tpu.serve import recover_fleet
+
+    compiles_r0 = compile_count()
+    with perf.collect() as rep_r:
+        engine_r, rreport = recover_fleet(durable_dir)
+    rparity = 0.0
+    for i, (sa, _, _) in enumerate(fleet_a):
+        sr = engine_r.pool.get(f"psr{i}")
+        free = tuple(sa.model.free_params)
+        pa = np.array([float(np.asarray(leaf_to_f64(sa.fitter.model.params[n])))
+                       for n in free])
+        pr = np.array([float(np.asarray(leaf_to_f64(sr.fitter.model.params[n])))
+                       for n in free])
+        rparity = max(rparity, float(np.max(
+            np.abs(pr - pa) / np.maximum(np.abs(pa), 1e-300))))
+    recovery = {
+        "sessions": rreport["sessions"],
+        "requests_lost": rreport["requests_lost"],
+        "replayed": rreport["replayed"],
+        "deduped": rreport["deduped"],
+        "clean_close": rreport["clean_close"],
+        "recovery_time_s": rreport["recovery_time_s"],
+        "journal_replay_reqs_per_sec":
+            rreport["journal_replay_reqs_per_sec"],
+        "parity_max_rel": rparity,
+        "traces_on_warm": compile_count() - compiles_r0,
+    }
+    recovery.update(perf.serve_breakdown(rep_r))
+    # the durability tax on the submit path: WAL time as a fraction of
+    # the append-trace span (tier-1 bounds it at <= 10%, the proxy for
+    # "sustained_append_fits_per_sec >= 0.9x the unjournaled figure")
+    journal_overhead = (breakdown.get("serve_journal_s", 0.0)
+                        / max(serve_wall, 1e-9))
+    shutil.rmtree(durable_dir, ignore_errors=True)
+
     # --- overload leg: bounded queue sheds, p99 stays depth-bounded -----
     prev_degraded = os.environ.get("PINT_TPU_DEGRADED")
     prev_faults = os.environ.get("PINT_TPU_FAULTS")
@@ -1928,7 +2016,7 @@ def _smoke_serve_bench_body(base_rows, requests_per_session, k, max_wait_ms,
     os.environ["PINT_TPU_DEGRADED"] = "warn"
     try:
         ses0, full0, base0 = fleet_a[0]
-        cursor = base0 + nominal_rows
+        cursor = base0 + nominal_rows + k  # the journal suffix took one
         engine2 = ServingEngine(pool, max_wait_ms=max_wait_ms,
                                 queue_depth=overload_depth,
                                 shed_policy="reject")
@@ -2024,8 +2112,16 @@ def _smoke_serve_bench_body(base_rows, requests_per_session, k, max_wait_ms,
         "queue_wait_p99_ms": engine_stats["queue_wait"].get("p99_ms"),
         "coalesce_ratio": engine_stats.get("coalesce_ratio"),
         "parity_max_rel": parity,
+        # durability headline: a crash-killed journaled fleet recovers
+        # completely (these three are the ISSUE-14 acceptance fields)
+        "recovery_time_s": recovery["recovery_time_s"],
+        "journal_replay_reqs_per_sec":
+            recovery["journal_replay_reqs_per_sec"],
+        "requests_lost": recovery["requests_lost"],
+        "journal_overhead_frac": round(journal_overhead, 4),
         "engine": engine_stats,
         "pool": pool.stats(),
+        "recovery": recovery,
         "overload": overload,
         "chaos": chaos,
         "note": "serial side = the identical interleaved trace drained "

@@ -75,6 +75,31 @@ class TimingModel:
         for k in self._JIT_CACHES:
             self.__dict__.pop(k, None)
 
+    def __getstate__(self):
+        """Models pickle WITHOUT their runtime program caches (jitted
+        closures are process-local; the serving fleet checkpoints pickle
+        whole models, serve/recover.py). The unpickled model rebuilds
+        them lazily — and its programs still hit the ``.aotx`` artifact
+        store, whose keys are structural (aot_structure_key), not
+        object-identity."""
+        return {k: v for k, v in self.__dict__.items()
+                if not k.endswith("_cache")}
+
+    def __deepcopy__(self, memo):
+        """Deepcopy keeps the default full-``__dict__`` semantics —
+        cached programs and all (their closures re-bind to the copy via
+        the memo, so a deepcopied model stays warm). Defining
+        ``__getstate__`` above would otherwise make deepcopy drop the
+        caches too, silently re-tracing every program after a
+        ``copy.deepcopy(model)``."""
+        import copy as _copy
+
+        new = self.__class__.__new__(self.__class__)
+        memo[id(self)] = new
+        for k, v in self.__dict__.items():
+            new.__dict__[k] = _copy.deepcopy(v, memo)
+        return new
+
     def add_component(self, component: Component, params: dict | None = None,
                       validate: bool = True) -> None:
         """Insert a component into the chain at its DEFAULT_ORDER slot

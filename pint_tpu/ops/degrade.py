@@ -82,6 +82,25 @@ KINDS: dict[str, str] = {
         "a warm resident session was evicted from the serving pool "
         "(LRU under PINT_TPU_SERVE_POOL_SESSIONS); its next request "
         "pays a checkpoint restore instead of a millisecond append"),
+    "serve.deadline": (
+        "a queued request passed its deadline and was shed instead of "
+        "occupying a dispatch slot (submit deadline_s / "
+        "PINT_TPU_SERVE_DEADLINE_MS)"),
+    "serve.retry": (
+        "a serving dispatch failed transiently and was retried with "
+        "backoff (PINT_TPU_SERVE_RETRIES); latency lost, no wrong answer"),
+    "serve.quarantine": (
+        "a hung or crash-looping serving lane was quarantined (watchdog "
+        "/ consecutive-failure threshold); its session stops serving "
+        "while the rest of the fleet continues"),
+    "serve.journal_truncated": (
+        "the write-ahead request journal ended in a torn record (a "
+        "process died mid-write); recovery kept every whole record and "
+        "truncated the tail"),
+    "serve.journal_corrupt": (
+        "a journal segment or fleet checkpoint failed its checksum and "
+        "was quarantined beside the store; the records past the "
+        "corruption were NOT replayed"),
     "fetch.mirror_failed": (
         "a remote file could not be refreshed from any mirror"),
     "fetch.corrupt_quarantined": (

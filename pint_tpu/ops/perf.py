@@ -55,6 +55,11 @@ _env_enabled = knobs.flag("PINT_TPU_PERF")
 # all reports currently collecting; stage/add/put record into every one
 _reports: list["PerfReport"] = []
 _tls = threading.local()  # .path: list[str] — per-thread stage nesting
+# guards every report mutation (timings/counters/values): the serving
+# engine's worker, watchdog and client threads record concurrently, and
+# an unlocked read-modify-write on a counter LOSES bumps under the GIL's
+# preemption (locked by the tests/test_serve.py ledger hammer)
+_rec_lock = threading.Lock()
 
 
 class PerfReport:
@@ -151,13 +156,16 @@ class _Stage:
         path = _tls.path
         key = "/".join(path)
         path.pop()
-        for rep in _reports:
-            t = rep.timings.get(key)
-            if t is None:
-                rep.timings[key] = [dt, 1]
-            else:
-                t[0] += dt
-                t[1] += 1
+        if not _reports:
+            return False
+        with _rec_lock:
+            for rep in _reports:
+                t = rep.timings.get(key)
+                if t is None:
+                    rep.timings[key] = [dt, 1]
+                else:
+                    t[0] += dt
+                    t[1] += 1
         return False
 
 
@@ -170,21 +178,32 @@ def stage(name: str):
 
 
 def add(name: str, value: float = 1.0) -> None:
-    """Accumulate a counter (transfers, bytes, trials, ...)."""
-    for rep in _reports:
-        rep.counters[name] = rep.counters.get(name, 0) + value
+    """Accumulate a counter (transfers, bytes, trials, ...). Thread-safe:
+    concurrent bumps from serving worker + client threads never lose a
+    count (the lock is skipped entirely when nothing is collecting)."""
+    if not _reports:
+        return
+    with _rec_lock:
+        for rep in _reports:
+            rep.counters[name] = rep.counters.get(name, 0) + value
 
 
 def put(name: str, value) -> None:
     """Latch a value (e.g. solve_path); last write wins."""
-    for rep in _reports:
-        rep.values[name] = value
+    if not _reports:
+        return
+    with _rec_lock:
+        for rep in _reports:
+            rep.values[name] = value
 
 
 def put_default(name: str, value) -> None:
     """Latch a value only where nothing latched it yet."""
-    for rep in _reports:
-        rep.values.setdefault(name, value)
+    if not _reports:
+        return
+    with _rec_lock:
+        for rep in _reports:
+            rep.values.setdefault(name, value)
 
 
 # --- the canonical prepare breakdown ---------------------------------------------
@@ -555,8 +574,12 @@ class QuantileSketch:
 #: restores (`dispatch`), the actual rank-k / batched-fleet device work
 #: (`solve`) and result installation + waiter wakeup (`finalize`).
 #: Anything else directly under a `serve` stage lands in serve_other_s.
+#: journal = write-ahead record appends (serve/journal.py), checkpoint /
+#: recover / replay = the durability legs (serve/recover.py): fleet
+#: checkpointing, checkpoint restore on recovery, journal-suffix replay.
 _SERVE_COMPONENTS = ("admit", "queue", "coalesce", "dispatch", "solve",
-                     "finalize")
+                     "finalize", "journal", "checkpoint", "recover",
+                     "replay")
 
 
 def serve_breakdown(rep: PerfReport) -> dict:
@@ -577,7 +600,10 @@ def serve_breakdown(rep: PerfReport) -> dict:
     out = _root_breakdown(rep, "serve", _SERVE_COMPONENTS)
     for c in ("serve_requests", "serve_shed", "serve_dispatches",
               "serve_coalesced", "serve_appends", "serve_refits",
-              "serve_evictions", "serve_restores"):
+              "serve_evictions", "serve_restores",
+              "serve_journal_records", "serve_journal_compactions",
+              "serve_checkpoints", "serve_deadline_expired",
+              "serve_retries", "serve_quarantines"):
         out[c] = int(rep.counters.get(c, 0))
     out["serve_waste_ewma"] = rep.values.get("serve_waste_ewma")
     out["serve_eff_wait_ms"] = rep.values.get("serve_eff_wait_ms")

@@ -5,6 +5,10 @@ Currently:
 - ``pint_tpu warmup`` — prefetch every startup artifact for a workload
   profile (pint_tpu/scripts/warmup.py): prepared TOAs, kernel packs,
   serialized AOT executables, warm-start fitter state.
+- ``pint_tpu recover`` — rebuild a serving fleet from its durable
+  directory (session checkpoints + write-ahead journal,
+  pint_tpu/scripts/recover.py); ``--hold`` serves until SIGTERM then
+  drains gracefully.
 - ``pint_tpu knobs`` — print the sanctioned environment-knob inventory
   (pint_tpu/utils/knobs.py).
 
@@ -22,6 +26,8 @@ _USAGE = """usage: pint_tpu <command> [args...]
 commands:
   warmup   prefetch every startup artifact for a workload profile
            (zero-trace warm starts; see `pint_tpu warmup --help`)
+  recover  rebuild a serving fleet from checkpoints + the write-ahead
+           journal (crash recovery; see `pint_tpu recover --help`)
   knobs    print the environment-knob inventory
 """
 
@@ -36,6 +42,10 @@ def main(argv=None) -> int:
         from pint_tpu.scripts.warmup import main as warmup_main
 
         return warmup_main(rest)
+    if cmd == "recover":
+        from pint_tpu.scripts.recover import main as recover_main
+
+        return recover_main(rest)
     if cmd == "knobs":
         from pint_tpu.utils import knobs
 

@@ -1,0 +1,99 @@
+"""`pint_tpu recover`: restore a serving fleet from its durable state.
+
+The operational verb for the durability layer (serve/recover.py): point
+it at a serving directory — the one a journaled
+:class:`~pint_tpu.serve.engine.ServingEngine` (``durable_dir=``) wrote
+its session checkpoints and write-ahead journal into — and it rebuilds
+the whole fleet in THIS fresh process, replays the journal suffix with
+idempotency-key dedup, and prints the recovery report::
+
+    pint_tpu recover --dir /var/lib/pint_tpu/serve --json
+    # {"sessions": 3, "replayed": 2, "deduped": 1, "requests_lost": 0, ...}
+
+``requests_lost`` must be 0: every request that was acked by the dead
+process is either inside a checkpoint (deduped) or replayed. A dirty
+journal tail (the crash point) is truncated with
+``serve.journal_truncated`` on the degradation ledger; corrupt segments
+or checkpoints are quarantined with ``serve.journal_corrupt`` — run
+under ``PINT_TPU_DEGRADED=error`` to REFUSE a recovery that had to cut
+any corner.
+
+``--hold`` keeps the recovered engine serving (the systemd/k8s shape)
+with SIGTERM/SIGINT wired to the graceful drain:
+``ServingEngine.stop(drain=True)`` stops admitting, flushes every lane,
+checkpoints the fleet and closes the journal cleanly — so the NEXT
+recovery takes the fast no-replay path.
+
+For zero-trace recoveries, warm the artifact store first:
+``pint_tpu warmup --profile serve`` exports every serving-path
+executable (`.aotx`) so the restored fleet deserializes instead of
+retracing (``PINT_TPU_EXPECT_WARM=1`` enforces it).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import signal
+import sys
+import threading
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="pint_tpu recover",
+        description="Rebuild a serving fleet from its durable directory "
+                    "(session checkpoints + write-ahead journal) in a "
+                    "fresh process, replaying the journal suffix with "
+                    "idempotency dedup. requests_lost must be 0.")
+    ap.add_argument("--dir", required=True,
+                    help="the durable serving directory (the engine's "
+                         "durable_dir: sessions/ + journal/)")
+    ap.add_argument("--no-replay", action="store_true",
+                    help="restore checkpoints only; skip journal replay "
+                         "(inspection mode — the journal is untouched)")
+    ap.add_argument("--hold", action="store_true",
+                    help="keep the recovered engine serving until "
+                         "SIGTERM/SIGINT, then drain gracefully "
+                         "(checkpoint + clean journal close)")
+    ap.add_argument("--json", action="store_true",
+                    help="print the recovery report as one JSON line")
+    args = ap.parse_args(argv)
+
+    from pint_tpu.ops import degrade
+    from pint_tpu.ops.compile import setup_persistent_cache
+    from pint_tpu.serve.recover import recover_fleet
+
+    setup_persistent_cache()
+    engine, report = recover_fleet(args.dir, replay=not args.no_replay)
+    report = dict(report)
+    report["metric"] = "recover"
+    report["degradation_kinds"] = sorted(
+        {e.kind for e in degrade.events()})
+    print(json.dumps(report) if args.json
+          else "\n".join(f"{k}: {v}" for k, v in report.items()),
+          flush=True)
+    if report["requests_lost"]:
+        return 1
+
+    if args.hold:
+        engine.start()
+        done = threading.Event()
+
+        def _drain(signum, frame):  # noqa: ARG001 — signal signature
+            print(f"signal {signum}: draining (flush + checkpoint + "
+                  "clean journal close)", file=sys.stderr, flush=True)
+            done.set()
+
+        signal.signal(signal.SIGTERM, _drain)
+        signal.signal(signal.SIGINT, _drain)
+        while not done.wait(0.5):
+            pass
+        engine.stop(drain=True)
+        print("drained cleanly; recovery will take the no-replay path",
+              file=sys.stderr, flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -90,7 +90,7 @@ def main(argv=None) -> int:
     src.add_argument("--par", help="parfile: derive the profile from real data")
     src.add_argument("--tim", help="tim file matching --par")
     src.add_argument("--profile", default="flagship-smoke",
-                     choices=["flagship-smoke", "smoke", "pta"],
+                     choices=["flagship-smoke", "smoke", "pta", "serve"],
                      help="named synthetic profile (pint_tpu/profiles.py; "
                           "ignored when --par is given)")
     ap.add_argument("--ntoas", type=int, default=1000,
@@ -177,6 +177,48 @@ def main(argv=None) -> int:
     return 0
 
 
+def _serve_pass(args):
+    """One serving-fleet workload pass: build the serve_smoke_fleet
+    profile (the same (model, rows) triples ``bench.py --smoke --serve``
+    and the recovery drill use), fit every session resident, serve one
+    coalesced append per session and one cross-session batch refit — so
+    every program a RECOVERED fleet touches (fused fit, incremental
+    blocks/chi², batched fleet refit) exports a ``.aotx`` artifact and
+    ``pint_tpu recover`` restores with zero traces under
+    ``PINT_TPU_EXPECT_WARM=1``. Fresh objects every call, so the verify
+    pass proves the whole set deserializes."""
+    import copy
+
+    import numpy as np
+
+    from pint_tpu import profiles
+    from pint_tpu.astro import time as ptime
+    from pint_tpu.fitting.state import state_path
+    from pint_tpu.serve import TimingSession, batch_refit
+
+    k = args.session or 4
+    fleet = profiles.serve_smoke_fleet(n_append_rows=k)
+    sessions = []
+    for model, full, base_n in fleet:
+        base = full.select(np.arange(len(full)) < base_n)
+        ses = TimingSession(base, copy.deepcopy(model))
+        ses.fit(warm_appends=k)
+        ep = full.utc_raw
+        ses.append(
+            utc=ptime.MJDEpoch(ep.day[base_n:base_n + k],
+                               ep.frac_hi[base_n:base_n + k],
+                               ep.frac_lo[base_n:base_n + k]),
+            error_us=full.error_us[base_n:base_n + k],
+            freq_mhz=full.freq_mhz[base_n:base_n + k],
+            obs=full.obs[base_n:base_n + k],
+            flags=[dict(f) for f in full.flags[base_n:base_n + k]])
+        sessions.append(ses)
+    batch_refit(sessions)
+    model, full, _ = fleet[0]
+    res = sessions[0].fitter.result
+    return model, full, res, state_path(sessions[0].fitter)
+
+
 def _pta_pass(args):
     """One joint-PTA workload pass: build the array, GLS-fit every
     pulsar (the linearization points), then run the joint-likelihood,
@@ -218,6 +260,8 @@ def _one_pass(args):
 
     if not args.par and args.profile == "pta":
         return _pta_pass(args)
+    if not args.par and args.profile == "serve":
+        return _serve_pass(args)
     model, toas = _profile_dataset(args)
 
     from pint_tpu.fitting import DownhillWLSFitter, fit_auto

@@ -13,14 +13,24 @@ package holds those surfaces, bottom to top:
   ``PINT_TPU_EXPECT_WARM=1``);
 - :class:`~pint_tpu.serve.engine.ServingEngine` — the always-on
   continuous-batching worker with admission control and load shedding;
-  an async network front-end plugs into its ``submit``/ticket surface.
+  an async network front-end plugs into its ``submit``/ticket surface;
+- :class:`~pint_tpu.serve.journal.RequestJournal` /
+  serve/recover.py — the durability layer: a write-ahead request
+  journal ahead of every admission ack, crash-safe cross-process fleet
+  recovery (``pint_tpu recover``), deadline/retry/watchdog lifecycle
+  hardening.
 """
 
 from pint_tpu.serve.engine import ServeTicket, ServingEngine  # noqa: F401
+from pint_tpu.serve.journal import (JournalError,  # noqa: F401
+                                    RequestJournal, replay_records)
 from pint_tpu.serve.pool import SessionCheckpoint, SessionPool  # noqa: F401
+from pint_tpu.serve.recover import (checkpoint_fleet,  # noqa: F401
+                                    recover_fleet)
 from pint_tpu.serve.scheduler import (AdmissionController,  # noqa: F401
-                                      ContinuousBatchScheduler, ShedError,
-                                      TokenBucket)
+                                      ContinuousBatchScheduler,
+                                      DeadlineError, QuarantinedError,
+                                      ShedError, TokenBucket)
 from pint_tpu.serve.session import (SessionResult, TimingService,  # noqa: F401
                                     TimingSession, batch_refit,
                                     coalesce_append_payloads)

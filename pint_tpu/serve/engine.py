@@ -40,23 +40,54 @@ thread (the always-on shape — `stop()` drains it); for deterministic
 tests and synchronous callers, :meth:`run_until_idle` serves the
 current queue to completion on the calling thread with identical code
 paths.
+
+**Durability + lifecycle hardening (ISSUE 14).** With ``durable_dir``
+set, the engine is crash-safe end to end: every admitted request is
+appended to the write-ahead journal (serve/journal.py) *before* its
+ticket acks admission, ``stop(drain=True)`` flushes the queue +
+checkpoints the fleet + closes the journal cleanly, and a fresh process
+rebuilds the whole engine from the checkpoints + journal suffix
+(serve/recover.py, ``pint_tpu recover``). Request lifecycle:
+
+- **deadlines** — ``submit(deadline_s=...)`` (default
+  ``PINT_TPU_SERVE_DEADLINE_MS``) stamps an absolute deadline; a request
+  still queued past it is shed with ``serve.deadline`` on the
+  degradation ledger instead of occupying a dispatch slot;
+- **bounded retry** — a transiently failed dispatch (a NaN-poisoned
+  fused fit, a ``fit.host_fallback`` storm) retries up to
+  ``PINT_TPU_SERVE_RETRIES`` times with exponential backoff
+  (``serve.retry`` on the ledger per attempt), then delivers the error;
+- **watchdog + quarantine** — a crash-looping lane
+  (``PINT_TPU_SERVE_QUARANTINE_FAILS`` consecutive failed dispatches) or
+  a hung dispatch (``PINT_TPU_SERVE_WATCHDOG_S``, detected by the
+  watchdog thread, which abandons the hung worker and spawns a
+  replacement) quarantines the offending session — ``serve.quarantine``
+  on the ledger, refusable under ``PINT_TPU_DEGRADED=error``, new
+  submits for it raise :class:`QuarantinedError` — while the rest of
+  the fleet keeps serving.
 """
 
 from __future__ import annotations
 
+import os
 import threading
 import time
+import uuid
 from dataclasses import dataclass, field
+from pathlib import Path
 
 import numpy as np
 
-from pint_tpu.ops import perf
+from pint_tpu.ops import degrade, perf
+from pint_tpu.serve.journal import RequestJournal, encode_rows
 from pint_tpu.serve.pool import SessionPool
 from pint_tpu.serve.scheduler import (AdmissionController,
-                                      ContinuousBatchScheduler, Lane,
+                                      ContinuousBatchScheduler,
+                                      DeadlineError, Lane, QuarantinedError,
                                       ShedError)
 from pint_tpu.serve.session import (SessionResult, batch_refit,
                                     coalesce_append_payloads)
+from pint_tpu.testing import faults
 from pint_tpu.utils import knobs
 from pint_tpu.utils.logging import get_logger
 
@@ -76,6 +107,12 @@ class ServeTicket:
     rows: int                      # payload rows (appends; 1 for refits)
     lane_key: tuple
     payload: dict | None = None
+    #: idempotency key: journaled with the request and recorded on the
+    #: session once applied, so crash recovery never double-applies
+    idem: str = ""
+    #: absolute clock time past which the queued request is shed with
+    #: ``serve.deadline`` instead of dispatched (None: no deadline)
+    deadline: float | None = None
     t_submit: float = 0.0
     t_dispatch: float | None = None
     t_done: float | None = None
@@ -121,7 +158,15 @@ class ServingEngine:
                  tenant_rps: float | None = None,
                  shed_policy: str | None = None,
                  coalesce_rows: int = 16, refit_batch: int = 4,
-                 maxiter: int = 30, clock=time.monotonic):
+                 maxiter: int = 30, clock=time.monotonic,
+                 durable_dir: str | Path | None = None,
+                 journal: RequestJournal | None = None,
+                 deadline_ms: float | None = None,
+                 retries: int | None = None,
+                 retry_backoff_ms: float | None = None,
+                 quarantine_fails: int | None = None,
+                 watchdog_s: float | None = None,
+                 sleep=time.sleep):
         self.pool = pool if pool is not None else SessionPool()
         self.admission = AdmissionController(
             max_depth=queue_depth, tenant_rps=tenant_rps,
@@ -131,9 +176,41 @@ class ServingEngine:
             refit_batch=refit_batch, clock=clock)
         self.maxiter = maxiter
         self._clock = clock
+        self._sleep = sleep
         self._cv = threading.Condition()
         self._stopping = False
+        self._draining = False
         self._thread: threading.Thread | None = None
+        # durability: WAL every admitted request, checkpoint on drain
+        self.durable_dir = Path(durable_dir) if durable_dir else None
+        self.journal = journal
+        if self.journal is None and self.durable_dir is not None:
+            self.journal = RequestJournal(self.durable_dir / "journal")
+        # request lifecycle knobs (constructor overrides for tests)
+        self.deadline_s = (float(knobs.get("PINT_TPU_SERVE_DEADLINE_MS"))
+                           if deadline_ms is None
+                           else float(deadline_ms)) * 1e-3
+        self.retries = (int(knobs.get("PINT_TPU_SERVE_RETRIES"))
+                        if retries is None else int(retries))
+        self.retry_backoff_s = (
+            float(knobs.get("PINT_TPU_SERVE_RETRY_BACKOFF_MS"))
+            if retry_backoff_ms is None else float(retry_backoff_ms)) * 1e-3
+        self.quarantine_fails = (
+            int(knobs.get("PINT_TPU_SERVE_QUARANTINE_FAILS"))
+            if quarantine_fails is None else int(quarantine_fails))
+        self.watchdog_s = (float(knobs.get("PINT_TPU_SERVE_WATCHDOG_S"))
+                           if watchdog_s is None else float(watchdog_s))
+        #: sessions pulled out of service by the watchdog / crash-loop
+        #: detector; submits for them raise QuarantinedError
+        self.quarantined: set[str] = set()
+        self._fail_counts: dict[str, int] = {}
+        #: the dispatch currently on the device: (desc, t_start, gen) —
+        #: the watchdog's hung-lane signal
+        self._inflight: tuple | None = None
+        self._worker_gen = 0
+        self._watchdog: threading.Thread | None = None
+        self._watchdog_stop = threading.Event()
+        self._unhang = threading.Event()
         # served-request SLO sketches: bounded memory at any uptime;
         # appends and refits keep separate latency distributions (a
         # full-refit wall would otherwise smear the append p99 the SLO
@@ -143,6 +220,9 @@ class ServingEngine:
         self.queue_wait = perf.QuantileSketch()
         self.served = 0
         self.dispatches = 0
+        self.expired = 0
+        self.retried = 0
+        self.worker_replacements = 0
 
     # -- sessions --------------------------------------------------------------------
 
@@ -179,15 +259,34 @@ class ServingEngine:
 
     def submit(self, *, session: str, kind: str = "append",
                tenant: str = "default", utc=None, error_us=None,
-               freq_mhz=None, obs=None, flags=None) -> ServeTicket:
+               freq_mhz=None, obs=None, flags=None,
+               deadline_s: float | None = None,
+               idem: str | None = None) -> ServeTicket:
         """Admit one request and queue it for the worker; returns its
         :class:`ServeTicket`. Sheds raise :class:`ShedError` (or
         ``DegradedError`` under ``PINT_TPU_DEGRADED=error``) here, at
-        the client — overload is an explicit refusal, not a timeout."""
+        the client — overload is an explicit refusal, not a timeout.
+
+        ``deadline_s`` (relative; default ``PINT_TPU_SERVE_DEADLINE_MS``,
+        0 disables) bounds how long the request may wait queued before it
+        is shed with ``serve.deadline``. ``idem`` is the idempotency key
+        journaled with the request (auto-generated when omitted) — a
+        client retrying an acked-but-unanswered submit after a crash
+        passes the same key and recovery applies it exactly once.
+
+        With a journal configured the record is durably appended BEFORE
+        this method returns: an acked request survives a process kill
+        (``pint_tpu recover`` replays it); a failed journal write raises
+        :class:`~pint_tpu.serve.journal.JournalError` and the request
+        was never admitted."""
         if kind not in ("append", "refit"):
             raise ValueError(f"unknown request kind {kind!r}")
         if session not in self.pool:
             raise KeyError(f"unknown session {session!r}")
+        if session in self.quarantined:
+            raise QuarantinedError(
+                f"session {session!r} is quarantined (serve.quarantine on "
+                "the degradation ledger); restart or re-add it to resume")
         payload = None
         rows = 1
         if kind == "append":
@@ -196,6 +295,13 @@ class ServingEngine:
             rows = len(np.asarray(error_us))
         with perf.stage("serve"):
             with perf.stage("admit"):
+                if self._draining:
+                    # refuse-while-draining is a shed like any other:
+                    # ledger first, explicit error to the client
+                    self.admission.refuse(
+                        tenant, "drain",
+                        f"request for session {session!r} refused: the "
+                        "engine is draining for shutdown")
                 action = self.admission.admit(tenant,
                                               self.scheduler.depth())
                 if action == "drop_oldest":
@@ -212,11 +318,26 @@ class ServingEngine:
                             "drop_oldest shed policy")
                         victim.t_done = self._clock()
                         victim._event.set()
+                now = self._clock()
+                dl = deadline_s if deadline_s is not None else (
+                    self.deadline_s if self.deadline_s > 0 else None)
                 ticket = ServeTicket(
                     session=session, kind=kind, tenant=tenant, rows=rows,
                     lane_key=self._lane_key(session, kind),
-                    payload=payload, t_submit=self._clock())
+                    payload=payload, t_submit=now,
+                    idem=idem or uuid.uuid4().hex,
+                    deadline=None if dl is None else now + float(dl))
                 perf.add("serve_requests")
+            if self.journal is not None:
+                # the WAL contract: the record is durable (flushed to
+                # the OS, fsync-batched) BEFORE the ticket acks; a
+                # JournalError propagates and nothing was queued
+                self.journal.append({
+                    "session": session, "kind": kind, "tenant": tenant,
+                    "idem": ticket.idem, "deadline_s": dl,
+                    "rows": encode_rows(payload) if kind == "append"
+                    else None})
+            with perf.stage("admit"):
                 self.scheduler.offer(ticket, rows=rows)
         with self._cv:
             self._cv.notify()
@@ -234,6 +355,11 @@ class ServingEngine:
                 perf.add("serve_coalesced", len(batch.tickets))
         with perf.stage("solve"):
             shared = session.append(**merged)
+        # applied: record the idempotency keys so a checkpoint taken now
+        # captures them and crash recovery dedups instead of re-applying
+        for t in batch.tickets:
+            if t.idem:
+                session.applied_idem.add(t.idem)
         self._finalize(batch, shared,
                        waste=1.0 - batch.rows / self._append_bucket(
                            batch.rows))
@@ -257,6 +383,10 @@ class ServingEngine:
         with perf.stage("solve"), perf.collect() as rep:
             results = batch_refit(sessions, maxiter=self.maxiter)
         by_sid = dict(zip(sids, results))
+        by_ses = dict(zip(sids, sessions))
+        for t in batch.tickets:
+            if t.idem:
+                by_ses[t.session].applied_idem.add(t.idem)
         self._finalize(batch, None, by_sid=by_sid,
                        waste=rep.values.get("padding_waste_frac"))
         perf.add("serve_refits", len(batch.tickets))
@@ -285,29 +415,158 @@ class ServingEngine:
             perf.add("serve_dispatches")
             self.scheduler.observe_waste(waste)
 
+    def _deliver_error(self, batch: Lane, e: BaseException) -> None:
+        now = self._clock()
+        for t in batch.tickets:
+            if not t._event.is_set():
+                t.error = e
+                t.t_done = now
+                t._event.set()
+
+    def _batch_sids(self, batch: Lane) -> list[str]:
+        sids: list[str] = []
+        for t in batch.tickets:
+            if t.session not in sids:
+                sids.append(t.session)
+        return sids
+
+    def _quarantine(self, sid: str, why: str) -> BaseException | None:
+        """Pull ``sid`` out of service and put ``serve.quarantine`` on
+        the ledger. Returns the ``DegradedError`` under
+        ``PINT_TPU_DEGRADED=error`` (the caller delivers the refusal to
+        the waiting tickets — raising here would kill the worker the
+        rest of the fleet depends on)."""
+        self.quarantined.add(sid)
+        perf.add("serve_quarantines")
+        log.error(f"session {sid!r} quarantined: {why}")
+        try:
+            degrade.record(
+                "serve.quarantine", f"session:{sid}",
+                f"session {sid!r} quarantined ({why}); the rest of the "
+                "fleet keeps serving, new requests for it are refused",
+                bound_us=0.0,  # no wrong answers served; the lane is down
+                fix="investigate the failing lane; re-add the session "
+                    "(add_session) or recover it from its checkpoint to "
+                    "resume, tune PINT_TPU_SERVE_QUARANTINE_FAILS / "
+                    "PINT_TPU_SERVE_WATCHDOG_S")
+        except degrade.DegradedError as e:
+            return e
+        return None
+
+    def _note_failure(self, batch: Lane, e: BaseException) -> None:
+        """Account one exhausted (post-retry) dispatch failure; a lane
+        failing ``quarantine_fails`` times in a row is crash-looping and
+        its session(s) are quarantined."""
+        for sid in self._batch_sids(batch):
+            n = self._fail_counts.get(sid, 0) + 1
+            self._fail_counts[sid] = n
+            if n >= self.quarantine_fails and sid not in self.quarantined:
+                refused = self._quarantine(
+                    sid, f"{n} consecutive failed dispatches "
+                         f"(last: {type(e).__name__}: {e})")
+                if refused is not None:
+                    self._deliver_error(batch, refused)
+
     def _dispatch(self, batch: Lane) -> None:
         t_d = self._clock()
         for t in batch.tickets:
             t.t_dispatch = t_d
-        try:
-            if batch.kind == "append":
-                self._dispatch_append(batch)
-            else:
-                self._dispatch_refit(batch)
-        except BaseException as e:  # noqa: BLE001 — the failure is DELIVERED to every waiting client ticket (and re-raised to synchronous callers); nothing is swallowed  # jaxlint: disable=silent-except
-            now = self._clock()
-            for t in batch.tickets:
-                if not t._event.is_set():
-                    t.error = e
-                    t.t_done = now
-                    t._event.set()
-            if not isinstance(e, Exception):
+        if faults.trip("serve.crash", f"lane:{batch.key}") is not None:
+            # the kill-mid-trace drill: the process dies with the batch
+            # admitted + journaled but NOT applied — recovery must replay
+            # it (tests/test_recover.py). os._exit skips every finally:
+            # exactly what a SIGKILL/OOM looks like to the journal.
+            log.error("serve.crash fault: exiting mid-dispatch")
+            os._exit(70)
+        attempts = 1 + max(self.retries, 0)
+        for attempt in range(attempts):
+            self._inflight = (batch, self._clock(), self._worker_gen)
+            try:
+                mode = faults.trip("serve.dispatch", f"lane:{batch.key}")
+                if mode == "fail":
+                    raise RuntimeError(
+                        "injected dispatch failure (serve.dispatch:fail)")
+                if mode == "hang":
+                    # a hung device/lane: block until the watchdog has
+                    # moved on without this worker (or a 5 s safety
+                    # valve, so a watchdog-less engine cannot deadlock)
+                    self._unhang.wait(5.0)
+                if batch.kind == "append":
+                    self._dispatch_append(batch)
+                else:
+                    self._dispatch_refit(batch)
+                for sid in self._batch_sids(batch):
+                    self._fail_counts.pop(sid, None)
+                return
+            except Exception as e:  # noqa: BLE001 — retried (bounded, ledger-visible) then DELIVERED to every waiting ticket; nothing is swallowed  # jaxlint: disable=silent-except
+                if attempt + 1 < attempts:
+                    self.retried += 1
+                    perf.add("serve_retries")
+                    try:
+                        degrade.record(
+                            "serve.retry", f"lane:{batch.key}",
+                            f"dispatch attempt {attempt + 1} failed "
+                            f"({type(e).__name__}: {e}); retrying with "
+                            "backoff",
+                            bound_us=0.0,  # latency lost, no wrong answer
+                            fix="transient by definition — investigate if "
+                                "PINT_TPU_SERVE_RETRIES stops absorbing it")
+                    except degrade.DegradedError as refusal:
+                        # =error refuses the retry: the client gets the
+                        # refusal, the lane failure still counts
+                        self._deliver_error(batch, refusal)
+                        self._note_failure(batch, e)
+                        return
+                    self._sleep(self.retry_backoff_s * (2 ** attempt))
+                    continue
+                self._deliver_error(batch, e)
+                self._note_failure(batch, e)
+                return
+            except BaseException as e:  # noqa: BLE001 — delivered then re-raised to the caller  # jaxlint: disable=silent-except
+                self._deliver_error(batch, e)
                 raise
+            finally:
+                self._inflight = None
+
+    def _expire_queued(self) -> None:
+        """Shed every queued request whose deadline has passed —
+        ``serve.deadline`` on the ledger, :class:`DeadlineError` (or the
+        ``=error`` refusal) through the ticket — so expired work never
+        occupies a dispatch slot. The ``serve.deadline:expire`` fault
+        site forces the oldest queued request expired, driving the path
+        end-to-end without a clock."""
+        now = self._clock()
+        expired = self.scheduler.expire(now)
+        if (self.scheduler.depth() > 0
+                and faults.trip("serve.deadline") is not None):
+            victim = self.scheduler.drop_oldest()
+            if victim is not None:
+                expired.append(victim)
+        for t in expired:
+            self.expired += 1
+            perf.add("serve_deadline_expired")
+            err: BaseException = DeadlineError(
+                f"request for session {t.session!r} expired after "
+                f"{(now - t.t_submit) * 1e3:.1f} ms queued (deadline "
+                f"{t.deadline}); shed instead of dispatched")
+            try:
+                degrade.record(
+                    "serve.deadline", f"session:{t.session}",
+                    f"queued request from tenant {t.tenant!r} for session "
+                    f"{t.session!r} passed its deadline and was shed",
+                    bound_us=0.0,  # no stale answer served
+                    fix="raise the submit deadline_s / "
+                        "PINT_TPU_SERVE_DEADLINE_MS or add capacity")
+            except degrade.DegradedError as refusal:
+                err = refusal
+            t.error = err
+            t.t_done = now
+            t._event.set()
 
     def step(self, wait_s: float = 0.0) -> int:
         """One worker turn: (optionally) wait for work or the earliest
-        lane deadline, then dispatch everything due. Returns requests
-        served this turn."""
+        lane deadline, shed expired requests, then dispatch everything
+        due. Returns requests served this turn."""
         with perf.stage("serve"):
             if wait_s > 0:
                 deadline = self.scheduler.next_deadline(
@@ -319,13 +578,25 @@ class ServingEngine:
                     with perf.stage("queue"):
                         with self._cv:
                             self._cv.wait(timeout)
+            self._expire_queued()
             with perf.stage("dispatch"):
                 batches = self.scheduler.due(self.admission.max_depth,
                                              self._append_cap)
             n = 0
-            for batch in batches:
+            for bi, batch in enumerate(batches):
+                gen_before = self._worker_gen
                 self._dispatch(batch)
                 n += len(batch.tickets)
+                if self._worker_gen != gen_before:
+                    # the watchdog retired THIS worker mid-turn: hand
+                    # the not-yet-dispatched batches back to the
+                    # scheduler so the replacement worker serves them —
+                    # an abandoned worker must not strand popped work
+                    for later in batches[bi + 1:]:
+                        for t in later.tickets:
+                            if not t._event.is_set():
+                                self.scheduler.offer(t, rows=t.rows)
+                    break
         return n
 
     def run_until_idle(self, timeout_s: float = 120.0) -> int:
@@ -349,33 +620,124 @@ class ServingEngine:
                                    f"with {self.scheduler.depth()} queued")
         return total
 
-    def _run(self) -> None:
+    def _run(self, gen: int) -> None:
         while True:
             with self._cv:
+                if self._worker_gen != gen:
+                    return             # replaced by the watchdog
                 if self._stopping and self.scheduler.depth() == 0:
                     return
             self.step(wait_s=0.05)
 
+    # -- the watchdog ----------------------------------------------------------------
+
+    def _watchdog_check(self) -> bool:
+        """One watchdog turn: when the current worker has been inside a
+        single dispatch longer than ``watchdog_s``, quarantine the hung
+        lane's session(s), fail its waiting tickets, abandon the hung
+        worker (its generation is retired — it exits whenever the hang
+        releases) and spawn a replacement so the rest of the fleet keeps
+        serving. Returns True when it intervened."""
+        snap = self._inflight
+        if snap is None:
+            return False
+        batch, t_start, gen = snap
+        if gen != self._worker_gen:
+            return False               # the hung worker is already retired
+        if self._clock() - t_start < self.watchdog_s:
+            return False
+        refusal = None
+        for sid in self._batch_sids(batch):
+            refusal = self._quarantine(
+                sid, f"dispatch hung for more than {self.watchdog_s:g} s "
+                     "(watchdog)") or refusal
+        self._deliver_error(batch, refusal if refusal is not None
+                            else QuarantinedError(
+                                "dispatch hung past the watchdog "
+                                "threshold; session quarantined"))
+        with self._cv:
+            self._worker_gen += 1
+            gen2 = self._worker_gen
+        self.worker_replacements += 1
+        perf.add("serve_worker_replacements")
+        self._unhang.set()             # release a fault-injected hang
+        log.error("watchdog: abandoned a hung worker and spawned a "
+                  "replacement; the fleet keeps serving")
+        self._thread = threading.Thread(
+            target=self._run, args=(gen2,),
+            name=f"pint-tpu-serve-{gen2}", daemon=True)
+        self._thread.start()
+        return True
+
+    def _watchdog_run(self) -> None:
+        tick = max(min(self.watchdog_s / 4.0, 0.25), 0.01)
+        while not self._watchdog_stop.wait(tick):
+            self._watchdog_check()
+
     def start(self) -> None:
-        """Spawn the resident worker thread (idempotent)."""
+        """Spawn the resident worker thread (idempotent), plus the
+        watchdog thread when ``watchdog_s > 0``."""
         if self._thread is not None and self._thread.is_alive():
             return
         self._stopping = False
-        self._thread = threading.Thread(target=self._run,
-                                        name="pint-tpu-serve", daemon=True)
+        self._draining = False
+        self._thread = threading.Thread(
+            target=self._run, args=(self._worker_gen,),
+            name="pint-tpu-serve", daemon=True)
         self._thread.start()
+        if self.watchdog_s > 0 and (self._watchdog is None
+                                    or not self._watchdog.is_alive()):
+            self._watchdog_stop.clear()
+            self._watchdog = threading.Thread(
+                target=self._watchdog_run, name="pint-tpu-serve-watchdog",
+                daemon=True)
+            self._watchdog.start()
 
-    def stop(self, timeout_s: float = 60.0) -> None:
-        """Drain the queue and join the worker."""
-        if self._thread is None:
-            return
-        self._stopping = True
-        with self._cv:
-            self._cv.notify_all()
-        self._thread.join(timeout_s)
-        if self._thread.is_alive():  # pragma: no cover — debug aid
-            raise TimeoutError("serving worker did not stop")
-        self._thread = None
+    def checkpoint(self) -> list[str]:
+        """Durably checkpoint the whole fleet into ``durable_dir`` and
+        compact the journal to the boundary (serve/recover.py)."""
+        if self.durable_dir is None:
+            raise ValueError("engine has no durable_dir configured")
+        from pint_tpu.serve.recover import checkpoint_fleet
+
+        return checkpoint_fleet(self.pool, self.durable_dir,
+                                journal=self.journal)
+
+    def stop(self, timeout_s: float = 60.0, drain: bool = True) -> None:
+        """Stop serving. ``drain=True`` (the graceful shutdown, also the
+        CLI's SIGTERM path): stop admitting (late submits shed with an
+        explicit refusal), flush every queued lane, fsync the journal,
+        checkpoint all pooled sessions and mark the journal cleanly
+        closed — so recovery takes the fast no-replay path and zero
+        in-flight requests are lost. ``drain=False`` abandons the queue
+        (crash-like; the journal keeps the records for recovery)."""
+        self._draining = True
+        if self._thread is not None:
+            self._stopping = True
+            with self._cv:
+                if not drain:
+                    # abandon the queue: retire the worker generation so
+                    # it exits at its next loop check instead of draining
+                    self._worker_gen += 1
+                self._cv.notify_all()
+            self._thread.join(timeout_s)
+            if self._thread.is_alive():  # pragma: no cover — debug aid
+                raise TimeoutError("serving worker did not stop")
+            self._thread = None
+        if drain and self.scheduler.depth() > 0:
+            # no worker (synchronous mode): flush the queue here
+            self.run_until_idle(timeout_s)
+        if self._watchdog is not None:
+            self._watchdog_stop.set()
+            self._watchdog.join(timeout_s)
+            self._watchdog = None
+        if drain:
+            if self.durable_dir is not None:
+                self.checkpoint()
+            if self.journal is not None:
+                self.journal.close(clean=True)
+        elif self.journal is not None:
+            self.journal.fsync()       # crash-like stop: records survive
 
     # -- telemetry -------------------------------------------------------------------
 
@@ -386,6 +748,10 @@ class ServingEngine:
             "served": self.served,
             "dispatches": self.dispatches,
             "shed": self.admission.shed_count,
+            "expired": self.expired,
+            "retried": self.retried,
+            "quarantined": sorted(self.quarantined),
+            "worker_replacements": self.worker_replacements,
             "queued": self.scheduler.depth(),
             "waste_ewma": round(self.scheduler.waste_ewma, 4),
             "latency": self.latency.summary("ms"),
@@ -393,6 +759,8 @@ class ServingEngine:
             "queue_wait": self.queue_wait.summary("ms"),
             "pool": self.pool.stats(),
         }
+        if self.journal is not None:
+            out["journal"] = self.journal.stats()
         if self.served and self.dispatches:
             out["coalesce_ratio"] = round(self.served / self.dispatches, 3)
         return out

@@ -1,0 +1,360 @@
+"""Write-ahead request journal: accepted work survives the process.
+
+The serving engine's contract so far was *availability* — shed under
+overload, evict under memory pressure, never collapse. This module adds
+*durability*: every admitted request is appended to an on-disk journal
+BEFORE its ticket acks admission, so a crash between admission and
+finalize loses nothing — a fresh process replays the suffix
+(serve/recover.py) and answers exactly what the dead one would have.
+
+Layout (one directory per engine)::
+
+    <dir>/journal-000001.wal      closed segments (older first)
+    <dir>/journal-000007.wal      the active segment (appended live)
+    <dir>/quarantine/…            checksum-corrupt segments, preserved
+
+Each segment is a stream of framed records::
+
+    <u32 payload length> <u32 crc32(payload)> <payload: one JSON object>
+
+JSON keeps records inspectable with nothing but ``python -m json.tool``
+(the payload floats round-trip exactly — Python emits shortest-repr
+doubles); the frame makes torn writes and bit rot detectable per record.
+Record kinds (the ``op`` field):
+
+- ``request`` — one admitted request: session, kind (append/refit),
+  tenant, idempotency key, absolute deadline, and the raw TOA rows for
+  appends. Appended (and flushed to the OS) before ``submit`` returns.
+- ``checkpoint`` — a fleet-checkpoint boundary (serve/recover.py
+  ``checkpoint_fleet``): every earlier record is captured by the
+  session checkpoints, so :meth:`RequestJournal.mark_checkpoint` rotates
+  to a fresh segment and DELETES the superseded ones — the journal never
+  grows past one checkpoint interval.
+- ``close`` — a clean shutdown (``ServingEngine.stop(drain=True)``):
+  the queue was flushed and the fleet checkpointed, so recovery takes
+  the fast no-replay path.
+
+Durability knobs: writes always reach the OS (``flush`` per record — a
+killed *process* loses nothing, which is what the ``serve.crash`` drill
+proves), and ``PINT_TPU_SERVE_JOURNAL_FSYNC`` batches the fsyncs that
+survive a killed *machine* (every N records; rotation, checkpoint and
+close always fsync).
+
+Failure handling on read (:func:`replay_records`) follows the fetch
+quarantine discipline — never silently skip:
+
+- a torn FINAL record (the process died mid-write) is expected crash
+  debris: recovery keeps every whole record, records
+  ``serve.journal_truncated`` on the degradation ledger, and truncates
+  the segment so the journal is whole again;
+- a checksum-corrupt record (or a torn record anywhere but the live
+  tail) means storage lied: the segment is copied into ``quarantine/``
+  beside the journal, ``serve.journal_corrupt`` goes on the ledger
+  (refusable under ``PINT_TPU_DEGRADED=error``), and only the records
+  before the corruption are served.
+
+The ``serve.journal:torn`` fault site (testing/faults.py) writes a
+genuinely torn frame and raises, so the recovery path is drillable
+end-to-end without killing anything.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import struct
+import threading
+import zlib
+from pathlib import Path
+
+import numpy as np
+
+from pint_tpu.ops import degrade, perf
+from pint_tpu.testing import faults
+from pint_tpu.utils import knobs
+from pint_tpu.utils.logging import get_logger
+
+log = get_logger("pint_tpu.serve")
+
+__all__ = ["JournalError", "RequestJournal", "encode_rows", "decode_rows",
+           "replay_records"]
+
+_FRAME = struct.Struct("<II")          # payload length, crc32(payload)
+_SEGMENT_GLOB = "journal-*.wal"
+
+
+class JournalError(OSError):
+    """The write-ahead journal could not durably record a request; the
+    request was NOT acked (submit re-raises this to the client)."""
+
+
+def encode_rows(payload: dict) -> dict:
+    """JSON-ready form of an append payload (the raw TOA rows a
+    :meth:`ServingEngine.submit` call carries): the exact (day, frac_hi,
+    frac_lo) epoch triple plus errors/frequencies/observatories/flags.
+    Floats survive JSON exactly (shortest-repr round-trip), so a
+    replayed request prepares bit-identical rows."""
+    ep = payload["utc"]
+    return {
+        "day": np.asarray(ep.day).astype(int).tolist(),
+        "frac_hi": np.asarray(ep.frac_hi).astype(float).tolist(),
+        "frac_lo": np.asarray(ep.frac_lo).astype(float).tolist(),
+        "error_us": np.asarray(payload["error_us"]).astype(float).tolist(),
+        "freq_mhz": np.asarray(payload["freq_mhz"]).astype(float).tolist(),
+        "obs": [str(o) for o in np.asarray(payload["obs"])],
+        "flags": [dict(f) for f in (payload.get("flags") or
+                                    [{} for _ in np.asarray(
+                                        payload["error_us"])])],
+    }
+
+
+def decode_rows(rows: dict) -> dict:
+    """Inverse of :func:`encode_rows`: the kwargs ``TimingSession.append``
+    (and ``ServingEngine.submit``) take."""
+    from pint_tpu.astro import time as ptime
+
+    return {
+        "utc": ptime.MJDEpoch(np.asarray(rows["day"], dtype=np.int64),
+                              np.asarray(rows["frac_hi"], dtype=np.float64),
+                              np.asarray(rows["frac_lo"], dtype=np.float64)),
+        "error_us": np.asarray(rows["error_us"], dtype=np.float64),
+        "freq_mhz": np.asarray(rows["freq_mhz"], dtype=np.float64),
+        "obs": np.asarray(rows["obs"]),
+        "flags": [dict(f) for f in rows["flags"]],
+    }
+
+
+def _segment_index(path: Path) -> int:
+    return int(path.stem.split("-")[-1])
+
+
+def _segments(dirpath: Path) -> list[Path]:
+    return sorted(dirpath.glob(_SEGMENT_GLOB), key=_segment_index)
+
+
+class RequestJournal:
+    """Segmented, checksummed, fsync-batched write-ahead log (see module
+    docstring). One instance owns one directory; appends are serialized
+    by an internal lock so concurrent client submits interleave whole
+    records, never bytes."""
+
+    def __init__(self, dirpath: str | Path, fsync_every: int | None = None):
+        self.dir = Path(dirpath)
+        self.dir.mkdir(parents=True, exist_ok=True)
+        self.fsync_every = (int(knobs.get("PINT_TPU_SERVE_JOURNAL_FSYNC"))
+                            if fsync_every is None else int(fsync_every))
+        self._lock = threading.Lock()
+        self._unsynced = 0
+        self.seq = 0                       # monotonic record number
+        self.appended = 0                  # request records this process
+        existing = _segments(self.dir)
+        # a reopened journal (recovery) continues in a FRESH segment: the
+        # old ones stay replayable until the next checkpoint compacts them
+        self._seg_index = (_segment_index(existing[-1]) + 1 if existing
+                          else 1)
+        self._fh = self._open_segment()
+
+    # -- segment plumbing ------------------------------------------------------------
+
+    def _seg_path(self, index: int) -> Path:
+        return self.dir / f"journal-{index:06d}.wal"
+
+    def _open_segment(self):
+        return open(self._seg_path(self._seg_index), "ab")
+
+    @property
+    def active_segment(self) -> Path:
+        return self._seg_path(self._seg_index)
+
+    def segments(self) -> list[Path]:
+        """Every live (non-quarantined) segment, oldest first."""
+        return _segments(self.dir)
+
+    # -- writes ----------------------------------------------------------------------
+
+    def _write_record(self, rec: dict) -> None:
+        payload = json.dumps(rec, separators=(",", ":")).encode()
+        frame = _FRAME.pack(len(payload), zlib.crc32(payload))
+        mode = faults.trip("serve.journal", f"seq:{rec.get('seq')}")
+        if mode == "torn":
+            # a genuinely torn frame: the header plus half the payload
+            # reach the OS, then the "process dies" (the raise) — the
+            # recovery path must stop at the last whole record
+            self._fh.write(frame + payload[: max(len(payload) // 2, 1)])
+            self._fh.flush()
+            os.fsync(self._fh.fileno())
+            raise JournalError(
+                "injected torn journal write (serve.journal:torn) at "
+                f"record seq {rec.get('seq')}")
+        if mode == "corrupt":
+            # silent bit rot: the frame promises the original crc but
+            # the payload lies — only the read path can catch it
+            payload = bytes([payload[0] ^ 0xFF]) + payload[1:]
+        self._fh.write(frame + payload)
+        # flush every record: the bytes reach the OS before the ticket
+        # acks, so a killed process (the serve.crash drill) loses nothing
+        self._fh.flush()
+        self._unsynced += 1
+        if self.fsync_every > 0 and self._unsynced >= self.fsync_every:
+            os.fsync(self._fh.fileno())
+            self._unsynced = 0
+
+    def append(self, rec: dict) -> int:
+        """Durably append one ``request`` record; returns its seq number.
+        Called by ``submit`` BEFORE the ticket is queued — a raise here
+        means the request was never admitted."""
+        # staged as "journal" only: the caller (ServingEngine.submit) is
+        # already inside the "serve" root, so the WAL wall lands at
+        # serve/journal in the serve_breakdown attribution
+        with self._lock, perf.stage("journal"):
+            self.seq += 1
+            rec = dict(rec, op="request", seq=self.seq)
+            self._write_record(rec)
+            self.appended += 1
+            perf.add("serve_journal_records")
+            return self.seq
+
+    def fsync(self) -> None:
+        """Force the fsync a batched cadence may still owe."""
+        with self._lock:
+            self._fh.flush()
+            os.fsync(self._fh.fileno())
+            self._unsynced = 0
+
+    def mark_checkpoint(self, sids: list[str]) -> None:
+        """Record a fleet-checkpoint boundary, rotate to a fresh segment
+        and DELETE the superseded ones: every record before the marker is
+        captured by the session checkpoints (serve/recover.py), so the
+        journal's replay suffix — and its disk footprint — restarts at
+        zero here."""
+        with self._lock:
+            self.seq += 1
+            self._write_record({"op": "checkpoint", "seq": self.seq,
+                                "sids": list(sids)})
+            self._fh.flush()
+            os.fsync(self._fh.fileno())
+            self._unsynced = 0
+            self._fh.close()
+            old = [p for p in _segments(self.dir)
+                   if _segment_index(p) <= self._seg_index]
+            self._seg_index += 1
+            self._fh = self._open_segment()
+            for p in old:
+                p.unlink(missing_ok=True)
+            perf.add("serve_journal_compactions")
+        log.info(f"journal checkpoint at seq {self.seq}: compacted "
+                 f"{len(old)} segment(s), now in "
+                 f"{self.active_segment.name}")
+
+    def close(self, clean: bool = True) -> None:
+        """Close the journal; ``clean=True`` appends the clean-shutdown
+        marker recovery's fast no-replay path keys on (only correct
+        after the queue drained AND the fleet checkpointed —
+        ``ServingEngine.stop(drain=True)`` is the caller)."""
+        with self._lock:
+            if self._fh.closed:
+                return
+            if clean:
+                self.seq += 1
+                self._write_record({"op": "close", "seq": self.seq})
+            self._fh.flush()
+            os.fsync(self._fh.fileno())
+            self._fh.close()
+
+    def stats(self) -> dict:
+        segs = self.segments()
+        return {
+            "dir": str(self.dir),
+            "segments": len(segs),
+            "bytes": sum(p.stat().st_size for p in segs),
+            "seq": self.seq,
+            "appended": self.appended,
+            "fsync_every": self.fsync_every,
+        }
+
+
+def _quarantine_segment(path: Path, reason: str) -> None:
+    qdir = path.parent / "quarantine"
+    qdir.mkdir(parents=True, exist_ok=True)
+    shutil.copy2(path, qdir / path.name)
+    degrade.record(
+        "serve.journal_corrupt", path.name,
+        f"journal segment failed validation ({reason}); preserved at "
+        f"{qdir / path.name} — records after the corruption point were "
+        "NOT replayed",
+        fix="inspect the quarantined segment; restore the affected "
+            "sessions from their checkpoints and re-submit the lost tail")
+
+
+def replay_records(dirpath: str | Path) -> tuple[list[dict], dict]:
+    """Read every whole record from a journal directory, oldest first.
+
+    Returns ``(records, report)`` where ``report`` carries what the read
+    decided: ``clean_close`` (the last record is a ``close`` marker —
+    recovery may take the no-replay path), ``truncated_records`` (torn
+    final records dropped, with ``serve.journal_truncated`` on the
+    ledger), ``corrupt_segments`` (quarantined, ``serve.journal_corrupt``
+    on the ledger). Only records after the LAST ``checkpoint`` marker
+    are the replay suffix — earlier ones are captured by the session
+    checkpoints (and normally already compacted away).
+    """
+    dirpath = Path(dirpath)
+    records: list[dict] = []
+    report = {"segments": 0, "clean_close": False,
+              "truncated_records": 0, "corrupt_segments": 0}
+    segs = _segments(dirpath)
+    report["segments"] = len(segs)
+    for si, seg in enumerate(segs):
+        data = seg.read_bytes()
+        off = 0
+        is_last_seg = si == len(segs) - 1
+        while off < len(data):
+            if off + _FRAME.size > len(data):
+                break                      # torn frame header
+            length, crc = _FRAME.unpack_from(data, off)
+            payload = data[off + _FRAME.size: off + _FRAME.size + length]
+            if len(payload) < length:
+                break                      # torn payload
+            if zlib.crc32(payload) != crc:
+                _quarantine_segment(
+                    seg, f"crc mismatch at offset {off}")
+                report["corrupt_segments"] += 1
+                off = len(data)            # nothing past the lie is trusted
+                break
+            try:
+                rec = json.loads(payload)
+            except ValueError:
+                _quarantine_segment(
+                    seg, f"undecodable record at offset {off}")
+                report["corrupt_segments"] += 1
+                off = len(data)
+                break
+            records.append(rec)
+            off += _FRAME.size + length
+        if off < len(data):                # a torn (not corrupt) tail
+            if is_last_seg:
+                # expected crash debris: keep the whole prefix, truncate
+                # the segment so the journal is whole again
+                with open(seg, "r+b") as fh:
+                    fh.truncate(off)
+                report["truncated_records"] += 1
+                degrade.record(
+                    "serve.journal_truncated", seg.name,
+                    f"torn final record truncated at byte {off} "
+                    f"({len(data) - off} trailing bytes dropped); every "
+                    "whole record was recovered",
+                    fix="none needed — the torn tail is the crash point; "
+                        "the un-acked request was never admitted")
+            else:
+                # a torn record anywhere else means the storage lied
+                _quarantine_segment(
+                    seg, f"mid-journal truncation at byte {off}")
+                report["corrupt_segments"] += 1
+    report["clean_close"] = bool(records) and records[-1]["op"] == "close"
+    # the replay suffix: everything after the last checkpoint marker
+    last_ck = max((i for i, r in enumerate(records)
+                   if r["op"] == "checkpoint"), default=-1)
+    if last_ck >= 0:
+        records = records[last_ck + 1:]
+    return records, report

@@ -33,9 +33,10 @@ drillable end-to-end via ``PINT_TPU_FAULTS`` without memory pressure.
 
 from __future__ import annotations
 
+import threading
 import time
 from collections import OrderedDict
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 
 import numpy as np
 
@@ -67,6 +68,10 @@ class SessionCheckpoint:
     maxiter: int
     required_chi2_decrease: float
     max_rejects: int
+    #: idempotency keys already applied at capture time — journal replay
+    #: (serve/recover.py) dedups against this, so a request that landed
+    #: in the checkpoint AND survives in the journal is never re-applied
+    applied_idem: list = field(default_factory=list)
 
     @classmethod
     def capture(cls, session: TimingSession) -> "SessionCheckpoint":
@@ -88,6 +93,7 @@ class SessionCheckpoint:
             maxiter=session.maxiter,
             required_chi2_decrease=session.required_chi2_decrease,
             max_rejects=session.max_rejects,
+            applied_idem=sorted(getattr(session, "applied_idem", ())),
         )
 
     def restore(self) -> TimingSession:
@@ -101,10 +107,12 @@ class SessionCheckpoint:
 
         toas = prepare_arrays(self.utc, self.error_us, self.freq_mhz,
                               self.obs, flags=self.flags, cache=True)
-        return TimingSession.from_state(
+        ses = TimingSession.from_state(
             toas, self.model, self.state, maxiter=self.maxiter,
             required_chi2_decrease=self.required_chi2_decrease,
             max_rejects=self.max_rejects)
+        ses.applied_idem = set(self.applied_idem)
+        return ses
 
 
 class SessionPool:
@@ -118,21 +126,29 @@ class SessionPool:
             raise ValueError("session pool capacity must be >= 1")
         self._live: OrderedDict[str, TimingSession] = OrderedDict()
         self._checkpoints: dict[str, SessionCheckpoint] = {}
+        # guards the LRU bookkeeping: the serving worker, a watchdog
+        # replacement worker and client submit threads can all touch the
+        # pool concurrently (an OrderedDict mutated from two threads
+        # corrupts); the session OBJECTS stay single-dispatcher
+        self._lock = threading.RLock()
         self.hits = 0
         self.evictions = 0
         self.restores = 0
         self.restore_s = 0.0
 
     def __len__(self) -> int:
-        return len(self._live)
+        with self._lock:
+            return len(self._live)
 
     def __contains__(self, sid: str) -> bool:
-        return sid in self._live or sid in self._checkpoints
+        with self._lock:
+            return sid in self._live or sid in self._checkpoints
 
     def sids(self) -> list[str]:
         """Every registered session id (live + checkpointed)."""
-        return list(self._live) + [s for s in self._checkpoints
-                                   if s not in self._live]
+        with self._lock:
+            return list(self._live) + [s for s in self._checkpoints
+                                       if s not in self._live]
 
     def _evict(self, sid: str) -> None:
         session = self._live.pop(sid)
@@ -153,43 +169,47 @@ class SessionPool:
         eviction's ledger write raises BEFORE the new session is
         inserted — an overfull pool refuses instead of silently churning
         its warm set."""
-        if sid in self._live:
-            self._live.move_to_end(sid)
+        with self._lock:
+            if sid in self._live:
+                self._live.move_to_end(sid)
+                self._live[sid] = session
+                return
+            while len(self._live) >= self.capacity:
+                # the ledger write (and any PINT_TPU_DEGRADED=error
+                # raise) happens inside _evict, checkpoint captured first
+                self._evict(next(iter(self._live)))
             self._live[sid] = session
-            return
-        while len(self._live) >= self.capacity:
-            # the ledger write (and any PINT_TPU_DEGRADED=error raise)
-            # happens inside _evict, checkpoint captured first
-            self._evict(next(iter(self._live)))
-        self._live[sid] = session
-        self._checkpoints.pop(sid, None)
+            self._checkpoints.pop(sid, None)
 
     def get(self, sid: str) -> TimingSession:
         """The live session for ``sid``, restoring from its checkpoint
         when evicted. Unknown sids raise KeyError."""
-        if (sid in self._live
-                and faults.trip("serve.pool", f"session:{sid}") is not None):
-            # fault drill: evict the requested session so THIS request
-            # pays the restore path (PINT_TPU_FAULTS=serve.pool:evict)
-            self._evict(sid)
-        session = self._live.get(sid)
-        if session is not None:
-            self._live.move_to_end(sid)
-            self.hits += 1
+        with self._lock:
+            if (sid in self._live
+                    and faults.trip("serve.pool",
+                                    f"session:{sid}") is not None):
+                # fault drill: evict the requested session so THIS
+                # request pays the restore path
+                # (PINT_TPU_FAULTS=serve.pool:evict)
+                self._evict(sid)
+            session = self._live.get(sid)
+            if session is not None:
+                self._live.move_to_end(sid)
+                self.hits += 1
+                return session
+            ck = self._checkpoints.get(sid)
+            if ck is None:
+                raise KeyError(f"unknown session {sid!r}")
+            t0 = time.perf_counter()
+            with perf.stage("restore"):
+                session = ck.restore()
+            self.restores += 1
+            self.restore_s += time.perf_counter() - t0
+            perf.add("serve_restores")
+            log.info(f"restored session {sid!r} from checkpoint "
+                     f"({ck.n_toas} TOAs)")
+            self.put(sid, session)
             return session
-        ck = self._checkpoints.get(sid)
-        if ck is None:
-            raise KeyError(f"unknown session {sid!r}")
-        t0 = time.perf_counter()
-        with perf.stage("restore"):
-            session = ck.restore()
-        self.restores += 1
-        self.restore_s += time.perf_counter() - t0
-        perf.add("serve_restores")
-        log.info(f"restored session {sid!r} from checkpoint "
-                 f"({ck.n_toas} TOAs)")
-        self.put(sid, session)
-        return session
 
     def stats(self) -> dict:
         return {

@@ -1,0 +1,227 @@
+"""Crash-safe fleet recovery: checkpoints + journal replay, cross-process.
+
+The durability contract (ISSUE 14): a serving process that dies —
+killed mid-dispatch, OOMed, power-cycled — loses NO admitted work. The
+pieces it leaves behind are all durable, content-addressed artifacts:
+
+- ``<dir>/sessions/<sid>.ckpt`` — per-session fleet checkpoints
+  (:func:`checkpoint_fleet`): the pickled
+  :class:`~pint_tpu.serve.pool.SessionCheckpoint` (model + raw TOA rows
+  + exact ``FitterState`` solution + the idempotency keys already
+  applied), framed with a crc32 so a corrupt file is quarantined, never
+  silently restored;
+- ``<dir>/journal/`` — the write-ahead request journal
+  (serve/journal.py): every request admitted after the last checkpoint;
+- the ``.aotx`` serialized-executable store + prepared-TOA disk cache +
+  persistent XLA cache (shared ``PINT_TPU_CACHE_DIR``) — so the restored
+  fleet's programs deserialize instead of retracing.
+
+:func:`recover_fleet` reassembles a live :class:`ServingEngine` from
+them in a FRESH process: restore every checkpoint (zero traces under
+``PINT_TPU_EXPECT_WARM=1`` in a warmed environment), replay the journal
+suffix with idempotency-key dedup (a request that was journaled AND
+already applied in the checkpoint is skipped, so crash-then-recover
+never double-appends), and report ``requests_lost`` (must be 0),
+``recovery_time_s`` and ``journal_replay_reqs_per_sec``. The replay and
+restore walls land in the ``serve_breakdown`` perf stages (``recover`` /
+``replay``), so the ≥90% serve-attribution contract covers recovery.
+
+The CLI leg is ``pint_tpu recover --dir <dir>`` (scripts/recover.py);
+the kill-mid-trace drill in tier-1 (tests/test_recover.py) proves a
+killed process's twin recovers with results ≡ the never-crashed fleet.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import shutil
+import struct
+import time
+import zlib
+from pathlib import Path
+
+from pint_tpu.ops import degrade, perf
+from pint_tpu.utils.logging import get_logger
+
+log = get_logger("pint_tpu.serve")
+
+__all__ = ["checkpoint_fleet", "load_fleet_checkpoints", "recover_fleet"]
+
+_FRAME = struct.Struct("<II")          # payload length, crc32(payload)
+
+
+def _session_dir(dirpath: Path) -> Path:
+    return Path(dirpath) / "sessions"
+
+
+def _write_checkpoint(path: Path, ck) -> None:
+    payload = pickle.dumps(ck, protocol=pickle.HIGHEST_PROTOCOL)
+    tmp = path.with_suffix(".tmp")
+    with open(tmp, "wb") as fh:
+        fh.write(_FRAME.pack(len(payload), zlib.crc32(payload)))
+        fh.write(payload)
+        fh.flush()
+        os.fsync(fh.fileno())
+    tmp.replace(path)                  # atomic: never a half checkpoint
+
+
+def _read_checkpoint(path: Path):
+    data = path.read_bytes()
+    if len(data) < _FRAME.size:
+        raise ValueError("checkpoint shorter than its frame header")
+    length, crc = _FRAME.unpack_from(data, 0)
+    payload = data[_FRAME.size: _FRAME.size + length]
+    if len(payload) < length or zlib.crc32(payload) != crc:
+        raise ValueError("checkpoint failed its crc32")
+    return pickle.loads(payload)
+
+
+def checkpoint_fleet(pool, dirpath: str | Path, journal=None) -> list[str]:
+    """Durably checkpoint EVERY pooled session (live ones are captured
+    non-destructively — they stay live) into ``<dir>/sessions/``, then
+    pin the journal's compaction boundary to it: records covered by the
+    checkpoints are deleted and each session's applied-idempotency-key
+    set restarts empty (those keys can never be replayed again).
+    Returns the checkpointed sids.
+
+    Call at a quiesced boundary — between worker turns, or while the
+    engine is draining (``ServingEngine.stop(drain=True)`` does): a
+    request admitted between a session's capture and the compaction
+    marker would have its journal record compacted away before its
+    effect reaches any checkpoint."""
+    from pint_tpu.serve.pool import SessionCheckpoint
+
+    sdir = _session_dir(Path(dirpath))
+    sdir.mkdir(parents=True, exist_ok=True)
+    sids = []
+    with perf.stage("serve"), perf.stage("checkpoint"), pool._lock:
+        for sid in pool.sids():
+            ses = pool._live.get(sid)
+            ck = (SessionCheckpoint.capture(ses) if ses is not None
+                  else pool._checkpoints[sid])
+            _write_checkpoint(sdir / f"{sid}.ckpt", ck)
+            sids.append(sid)
+        if journal is not None:
+            journal.mark_checkpoint(sids)
+            # the compacted records are gone: their idempotency keys are
+            # unreachable by any future replay, so the per-session sets
+            # (bounded memory) restart at the checkpoint boundary
+            for sid in sids:
+                ses = pool._live.get(sid)
+                if ses is not None:
+                    ses.applied_idem.clear()
+    perf.add("serve_checkpoints", len(sids))
+    return sids
+
+
+def load_fleet_checkpoints(dirpath: str | Path) -> dict:
+    """Read every session checkpoint under ``<dir>/sessions/``; a file
+    that fails its crc (or does not unpickle) is quarantined beside the
+    store with ``serve.journal_corrupt`` on the ledger — a lying
+    checkpoint must refuse loudly (``PINT_TPU_DEGRADED=error``), never
+    restore garbage."""
+    sdir = _session_dir(Path(dirpath))
+    out = {}
+    for path in sorted(sdir.glob("*.ckpt")):
+        try:
+            out[path.stem] = _read_checkpoint(path)
+        except Exception as e:  # noqa: BLE001 — quarantined + ledgered below, never silent  # jaxlint: disable=silent-except
+            qdir = sdir / "quarantine"
+            qdir.mkdir(parents=True, exist_ok=True)
+            shutil.copy2(path, qdir / path.name)
+            degrade.record(
+                "serve.journal_corrupt", path.name,
+                f"fleet checkpoint failed validation ({e}); preserved at "
+                f"{qdir / path.name}, session NOT restored",
+                fix="restore the session from an older checkpoint or "
+                    "refit it from its TOAs, then re-checkpoint")
+    return out
+
+
+def recover_fleet(dirpath: str | Path, *, replay: bool = True,
+                  engine_kwargs: dict | None = None):
+    """Rebuild a live, journaled :class:`ServingEngine` from a durable
+    serving directory in THIS (fresh) process.
+
+    Restores every session checkpoint into a warm pool, replays the
+    journal suffix with idempotency-key dedup, and reopens the journal
+    for continued service. Returns ``(engine, report)``; the engine is
+    NOT started (call ``engine.start()`` — the CLI leg does).
+
+    ``report["requests_lost"]`` counts journaled requests that could be
+    neither applied nor deduped; the durability contract (and the tier-1
+    kill drill) locks it at 0.
+    """
+    from pint_tpu.serve.engine import ServingEngine
+    from pint_tpu.serve.journal import decode_rows, replay_records
+    from pint_tpu.serve.pool import SessionPool
+
+    dirpath = Path(dirpath)
+    t0 = time.perf_counter()
+    kw = dict(engine_kwargs or {})
+    with perf.stage("serve"), perf.stage("recover"):
+        checkpoints = load_fleet_checkpoints(dirpath)
+        pool = SessionPool(capacity=max(len(checkpoints) + 1,
+                                        kw.pop("pool_capacity", 0) or 0))
+        for sid, ck in checkpoints.items():
+            pool.put(sid, ck.restore())
+            pool.restores += 1
+        records, jreport = replay_records(dirpath / "journal")
+    restore_s = time.perf_counter() - t0
+
+    engine = ServingEngine(pool, durable_dir=dirpath, **kw)
+    replayed = deduped = lost = 0
+    t1 = time.perf_counter()
+    if replay and not jreport["clean_close"]:
+        with perf.stage("serve"), perf.stage("replay"):
+            for rec in records:
+                if rec.get("op") != "request":
+                    continue
+                sid = rec["session"]
+                if sid not in pool:
+                    lost += 1
+                    log.error(f"journal record seq {rec['seq']} names "
+                              f"unknown session {sid!r}; request LOST")
+                    continue
+                ses = pool.get(sid)
+                if rec.get("idem") in ses.applied_idem:
+                    deduped += 1     # already inside the checkpoint
+                    continue
+                # accepted work is data: replay applies it directly on
+                # the session (admission/deadline govern live clients,
+                # not recovery — the client that was acked is gone, the
+                # TOAs it delivered must not be)
+                if rec["kind"] == "append":
+                    ses.append(**decode_rows(rec["rows"]))
+                else:
+                    from pint_tpu.serve.session import batch_refit
+
+                    batch_refit([ses], maxiter=engine.maxiter)
+                if rec.get("idem"):
+                    ses.applied_idem.add(rec["idem"])
+                replayed += 1
+    replay_s = time.perf_counter() - t1
+    recovery_s = time.perf_counter() - t0
+
+    report = {
+        "dir": str(dirpath),
+        "sessions": len(checkpoints),
+        "clean_close": jreport["clean_close"],
+        "journal_records": len(records),
+        "replayed": replayed,
+        "deduped": deduped,
+        "requests_lost": lost,
+        "truncated_records": jreport["truncated_records"],
+        "corrupt_segments": jreport["corrupt_segments"],
+        "restore_s": round(restore_s, 4),
+        "replay_s": round(replay_s, 4),
+        "recovery_time_s": round(recovery_s, 4),
+        "journal_replay_reqs_per_sec": (
+            round(replayed / replay_s, 3) if replayed and replay_s > 0
+            else None),
+    }
+    log.info(f"recovered fleet from {dirpath}: {len(checkpoints)} "
+             f"session(s), {replayed} replayed, {deduped} deduped, "
+             f"{lost} lost in {recovery_s:.2f}s")
+    return engine, report

@@ -44,8 +44,9 @@ from pint_tpu.utils.logging import get_logger
 
 log = get_logger("pint_tpu.serve")
 
-__all__ = ["AdmissionController", "ContinuousBatchScheduler", "Lane",
-           "ShedError", "TokenBucket"]
+__all__ = ["AdmissionController", "ContinuousBatchScheduler",
+           "DeadlineError", "Lane", "QuarantinedError", "ShedError",
+           "TokenBucket"]
 
 
 class ShedError(RuntimeError):
@@ -55,6 +56,18 @@ class ShedError(RuntimeError):
     dropped request's ticket (for ``drop_oldest``) — in both cases after
     the ``serve.shed`` degradation event is on the ledger, so the shed
     is observable even when the client swallows the error."""
+
+
+class DeadlineError(RuntimeError):
+    """The request's deadline expired while it was still queued; it was
+    shed instead of occupying a dispatch slot (``serve.deadline`` on the
+    degradation ledger, delivered through the ticket)."""
+
+
+class QuarantinedError(RuntimeError):
+    """The target session is quarantined (a hung or crash-looping lane,
+    ``serve.quarantine`` on the degradation ledger); requests for it are
+    refused while the rest of the fleet keeps serving."""
 
 
 class TokenBucket:
@@ -158,6 +171,13 @@ class AdmissionController:
                        f"{self.max_depth} (PINT_TPU_SERVE_QUEUE_DEPTH); "
                        f"request from tenant {tenant!r} refused")
         return "admit"
+
+    def refuse(self, tenant: str, why: str, detail: str) -> None:
+        """Shed one request for a reason OUTSIDE the depth/rate checks
+        (e.g. the engine refusing new work while draining): same ledger
+        write, same counters, same :class:`ShedError` (or
+        ``DegradedError``) as any other shed."""
+        self._shed(tenant, why, detail)
 
     def record_drop(self, tenant: str, detail: str) -> None:
         """Ledger + counters for a ``drop_oldest`` shed (the DROPPED
@@ -287,6 +307,31 @@ class ContinuousBatchScheduler:
                 lane_at.t_oldest = getattr(lane_at.tickets[0], "t_submit",
                                            self._clock())
             return t
+
+    def expire(self, now: float) -> list:
+        """Pop every queued ticket whose absolute request deadline has
+        passed — expired work is shed (``serve.deadline``, engine-side)
+        instead of occupying a dispatch slot. Returns the expired
+        tickets, oldest first."""
+        out = []
+        with self._lock:
+            for lane in self._lanes.values():
+                if not lane.tickets:
+                    continue
+                keep = []
+                for t in lane.tickets:
+                    dl = getattr(t, "deadline", None)
+                    if dl is not None and now >= dl:
+                        out.append(t)
+                        self._depth -= 1
+                    else:
+                        keep.append(t)
+                if len(keep) != len(lane.tickets):
+                    lane.tickets = keep
+                    lane.rows = sum(getattr(t, "rows", 1) for t in keep)
+                    if keep:
+                        lane.t_oldest = getattr(keep[0], "t_submit", now)
+        return sorted(out, key=lambda t: getattr(t, "t_submit", 0.0))
 
     def next_deadline(self, capacity: int) -> float | None:
         """Absolute clock time of the earliest lane deadline (None when

@@ -109,6 +109,12 @@ class TimingSession:
         self.max_rejects = max_rejects
         self.fitter = fit_auto(toas, model, fused=True)
         self.engine = None
+        #: idempotency keys of requests already applied to this session
+        #: (serve/journal.py write-ahead records carry the same keys, so
+        #: crash recovery replays the journal suffix without ever
+        #: double-appending; bounded — the set restarts empty at every
+        #: journal-compacting fleet checkpoint, serve/recover.py)
+        self.applied_idem: set[str] = set()
         #: the most recent request records, in arrival order (bounded:
         #: long-lived sessions keep the last HISTORY_KEEP only — counts
         #: and percentiles come from the bounded aggregates below)

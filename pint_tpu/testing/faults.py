@@ -33,6 +33,26 @@ site                      armed modes
                           pool evict the requested session before serving
                           it, driving the ``serve.evict`` +
                           checkpoint-restore path (serve/pool.py)
+``serve.journal``         ``torn`` (a genuinely torn frame reaches disk,
+                          then the write raises — the crash-mid-write
+                          shape recovery truncates), ``corrupt`` (the
+                          payload is bit-flipped under a valid-looking
+                          frame — silent rot the read path quarantines)
+                          — applied by the journal writer
+                          (serve/journal.py)
+``serve.dispatch``        ``fail`` (one dispatch attempt raises, driving
+                          the bounded-retry ``serve.retry`` path and,
+                          exhausted, the crash-loop ``serve.quarantine``
+                          path), ``hang`` (the dispatch blocks until the
+                          watchdog abandons the worker) — serve/engine.py
+``serve.deadline``        ``expire`` — :func:`trip` makes the engine shed
+                          its oldest queued request as if its deadline
+                          had passed, driving the ``serve.deadline``
+                          path without a clock (serve/engine.py)
+``serve.crash``           ``exit`` — the dispatch path calls
+                          ``os._exit`` mid-trace (admitted + journaled,
+                          not applied): the kill-mid-trace recovery
+                          drill (serve/engine.py, tests/test_recover.py)
 ========================  =====================================================
 
 Arming
@@ -62,8 +82,49 @@ from dataclasses import dataclass
 
 from pint_tpu.utils import knobs
 
-__all__ = ["arm", "fired", "mangle", "maybe_raise", "armed",
+__all__ = ["KIND_DRILLS", "arm", "fired", "mangle", "maybe_raise", "armed",
            "poison_nonfinite", "reset", "trip"]
+
+#: the fault-taxonomy completeness contract (tests/test_degrade.py gate):
+#: EVERY degradation kind registered in ops/degrade.py KINDS maps here to
+#: the injected-fault site that drives it end-to-end — ``("site", name,
+#: mode)`` — or to a documented exemption ``("env", why)`` for kinds
+#: driven by an engineered environment instead of a fault hook. A new
+#: ledger kind without an entry fails tier-1: no kind ships without an
+#: injection drill.
+KIND_DRILLS: dict[str, tuple] = {
+    "clock.zero_corrections": (
+        "env", "engineered empty clock environment — no discoverable "
+               "clock files (tests/test_degrade.py bare_clock_env)"),
+    "clock.stale_cache": ("site", "fetch", "timeout"),
+    "clock.beyond_table": (
+        "env", "TOAs constructed past the clock table's last entry "
+               "(tests/test_degrade.py / test_clock.py)"),
+    "eop.outside_table": (
+        "env", "epochs outside a configured finals2000A table "
+               "(tests/test_eop.py)"),
+    "ephemeris.analytic_fallback": (
+        "env", "a DE kernel requested with no PINT_TPU_EPHEM configured "
+               "(tests/test_degrade.py, docs/ROBUSTNESS.md)"),
+    "fit.host_fallback": ("site", "fit.fused", "nan"),
+    "fit.incremental_fallback": ("site", "fit.incremental", "stale"),
+    "fit.aot_layout_fallback": (
+        "env", "an AOT executable handed operands with a mismatched "
+               "layout/sharding (tests/test_aot.py "
+               "test_layout_fallback_sticky_single_event)"),
+    "serve.shed": ("site", "serve.admit", "shed"),
+    "serve.evict": ("site", "serve.pool", "evict"),
+    "serve.deadline": ("site", "serve.deadline", "expire"),
+    "serve.retry": ("site", "serve.dispatch", "fail"),
+    "serve.quarantine": ("site", "serve.dispatch", "fail"),
+    "serve.journal_truncated": ("site", "serve.journal", "torn"),
+    "serve.journal_corrupt": ("site", "serve.journal", "corrupt"),
+    "fetch.mirror_failed": ("site", "fetch", "refuse"),
+    "fetch.corrupt_quarantined": ("site", "fetch.payload", "corrupt"),
+    "obs.zero_velocity": (
+        "env", "spacecraft TOAs built without velocity flags "
+               "(tests/test_astro.py)"),
+}
 
 
 @dataclass

@@ -69,6 +69,12 @@ KNOBS: dict[str, tuple[str | None, str]] = {
     "PINT_TPU_SERVE_POOL_SESSIONS": ("64", "warm session-pool capacity: LRU sessions beyond it are checkpointed + evicted (serve.evict)"),
     "PINT_TPU_SERVE_SHED_POLICY": ("reject", "overload policy: reject (refuse the new request) or drop_oldest (shed the oldest queued request instead)"),
     "PINT_TPU_SERVE_TENANT_RPS": ("0", "per-tenant token-bucket admission rate in requests/s (0: unlimited)"),
+    "PINT_TPU_SERVE_DEADLINE_MS": ("0", "default per-request serving deadline in ms: queued past it, the request is shed (serve.deadline); 0 disables"),
+    "PINT_TPU_SERVE_RETRIES": ("2", "bounded retries (with exponential backoff) for a transiently failed serving dispatch before the error is delivered"),
+    "PINT_TPU_SERVE_RETRY_BACKOFF_MS": ("10", "base backoff in ms between serving dispatch retries (doubles per attempt)"),
+    "PINT_TPU_SERVE_QUARANTINE_FAILS": ("3", "consecutive failed dispatches after which a serving lane's session is quarantined (serve.quarantine)"),
+    "PINT_TPU_SERVE_WATCHDOG_S": ("30", "serving watchdog threshold in s: a dispatch hung past it is abandoned, its session quarantined, the worker replaced; 0 disables"),
+    "PINT_TPU_SERVE_JOURNAL_FSYNC": ("8", "write-ahead journal fsync batching: fsync every N records (1: every record, 0: only at rotation/close); records always flush to the OS before the ticket acks"),
     # --- Bayesian noise engine (fitting/noise_like.py, sampler.py) -------------
     "PINT_TPU_NOISE_CHAINS": ("4", "vmapped noise-posterior chains per sample() call"),
     "PINT_TPU_NOISE_RESTARTS": ("8", "batched optimizer restarts for ML noise estimation"),

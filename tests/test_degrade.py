@@ -455,3 +455,47 @@ class TestCleanRunContract:
         res = Residuals(toas, model)
         blk = res.degradations
         assert blk["kinds"] == ["clock.stale_cache"]
+
+
+class TestTaxonomyCompletenessGate:
+    """ISSUE 14 satellite: every registered degradation kind maps to the
+    injected-fault site that drives it end-to-end (or an explicit,
+    documented environment-driven exemption) — a new ledger kind can
+    never ship without an injection drill."""
+
+    def test_every_kind_has_a_drill(self):
+        from pint_tpu.testing.faults import KIND_DRILLS
+
+        missing = set(degrade.KINDS) - set(KIND_DRILLS)
+        assert not missing, (
+            f"degradation kinds without a KIND_DRILLS entry: {missing} — "
+            "add a fault site (pint_tpu/testing/faults.py) that drives "
+            "each end-to-end, or a documented ('env', why) exemption")
+        stale = set(KIND_DRILLS) - set(degrade.KINDS)
+        assert not stale, f"KIND_DRILLS names unregistered kinds: {stale}"
+
+    def test_site_drills_are_documented_and_armable(self):
+        from pint_tpu.testing import faults as fmod
+        from pint_tpu.testing.faults import KIND_DRILLS
+
+        for kind, drill in KIND_DRILLS.items():
+            if drill[0] != "site":
+                continue
+            _, site, mode = drill
+            # the site appears in the module's site/mode table, so an
+            # operator reading the docstring can reproduce the drill
+            assert f"``{site}``" in fmod.__doc__, (kind, site)
+            faults.arm(site, mode, times=1)
+            assert faults.armed(site)
+            assert faults.trip(site, "gate") == mode
+            assert not faults.armed(site)
+        faults.reset()
+
+    def test_env_exemptions_carry_a_reason(self):
+        from pint_tpu.testing.faults import KIND_DRILLS
+
+        for kind, drill in KIND_DRILLS.items():
+            if drill[0] == "env":
+                assert len(drill[1]) > 20, (
+                    f"{kind}: an exemption must document HOW the path is "
+                    "driven (which test, which engineered environment)")

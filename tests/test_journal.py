@@ -1,0 +1,226 @@
+"""The write-ahead request journal (pint_tpu/serve/journal.py) — ISSUE 14.
+
+Locks the durability substrate below the engine: framed+checksummed
+records round-trip exactly, segments rotate/compact at checkpoint
+boundaries, a clean close is detectable, and the two storage-failure
+classes follow the quarantine discipline — a torn FINAL record (crash
+debris) truncates cleanly with ``serve.journal_truncated`` on the
+ledger, while a checksum-corrupt record quarantines the segment with
+``serve.journal_corrupt`` and never silently skips.
+"""
+
+import json
+import struct
+import zlib
+
+import numpy as np
+import pytest
+
+from pint_tpu.ops import degrade
+from pint_tpu.serve.journal import (JournalError, RequestJournal,
+                                    decode_rows, encode_rows,
+                                    replay_records)
+from pint_tpu.testing import faults
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    degrade.reset_ledger()
+    faults.reset()
+    yield
+    degrade.reset_ledger()
+    faults.reset()
+
+
+def _rec(i, sid="psr0"):
+    return {"session": sid, "kind": "append", "tenant": "t",
+            "idem": f"k{i}", "deadline_s": None,
+            "rows": {"day": [55000], "frac_hi": [0.25], "frac_lo": [1e-18],
+                     "error_us": [1.0], "freq_mhz": [1400.0],
+                     "obs": ["gbt"], "flags": [{}]}}
+
+
+class TestFraming:
+    def test_append_replay_round_trip(self, tmp_path):
+        j = RequestJournal(tmp_path, fsync_every=2)
+        for i in range(5):
+            assert j.append(_rec(i)) == i + 1
+        j.close(clean=False)
+        records, report = replay_records(tmp_path)
+        assert [r["idem"] for r in records] == [f"k{i}" for i in range(5)]
+        assert all(r["op"] == "request" for r in records)
+        # floats round-trip exactly through the JSON frames
+        assert records[0]["rows"]["frac_lo"] == [1e-18]
+        assert report["clean_close"] is False
+        assert report["truncated_records"] == 0
+        assert report["corrupt_segments"] == 0
+        assert degrade.degradation_count() == 0
+
+    def test_encode_decode_rows_exact(self):
+        from pint_tpu.astro import time as ptime
+
+        rng = np.random.default_rng(7)
+        n = 6
+        payload = dict(
+            utc=ptime.MJDEpoch(
+                np.arange(55000, 55000 + n, dtype=np.int64),
+                rng.uniform(0, 1, n), rng.uniform(-1e-16, 1e-16, n)),
+            error_us=rng.uniform(0.1, 2.0, n),
+            freq_mhz=np.where(np.arange(n) % 2 == 0, 1400.0, 2300.0),
+            obs=np.array(["gbt"] * n),
+            flags=[{"f": "Rcvr1_2_GUPPI"}] * n)
+        enc = json.loads(json.dumps(encode_rows(payload)))  # disk round trip
+        dec = decode_rows(enc)
+        assert np.array_equal(dec["utc"].day, payload["utc"].day)
+        # EXACT: shortest-repr doubles survive JSON bit-for-bit
+        assert np.array_equal(dec["utc"].frac_hi, payload["utc"].frac_hi)
+        assert np.array_equal(dec["utc"].frac_lo, payload["utc"].frac_lo)
+        assert np.array_equal(dec["error_us"], payload["error_us"])
+        assert list(dec["obs"]) == ["gbt"] * n
+        assert dec["flags"][0] == {"f": "Rcvr1_2_GUPPI"}
+
+    def test_clean_close_marker(self, tmp_path):
+        j = RequestJournal(tmp_path)
+        j.append(_rec(0))
+        j.close(clean=True)
+        records, report = replay_records(tmp_path)
+        assert report["clean_close"] is True
+        assert records[-1]["op"] == "close"
+
+
+class TestRotation:
+    def test_checkpoint_compacts_segments(self, tmp_path):
+        j = RequestJournal(tmp_path)
+        for i in range(4):
+            j.append(_rec(i))
+        seg0 = j.active_segment
+        j.mark_checkpoint(["psr0"])
+        # the superseded segment is GONE — the journal never grows past
+        # one checkpoint interval
+        assert not seg0.exists()
+        assert j.segments() == [j.active_segment]
+        j.append(_rec(9))
+        j.close(clean=False)
+        records, _ = replay_records(tmp_path)
+        # only the post-checkpoint suffix replays
+        assert [r["idem"] for r in records] == ["k9"]
+
+    def test_reopen_continues_fresh_segment(self, tmp_path):
+        j = RequestJournal(tmp_path)
+        j.append(_rec(0))
+        j.close(clean=False)
+        j2 = RequestJournal(tmp_path)
+        j2.append(_rec(1))
+        j2.close(clean=False)
+        assert len(list(tmp_path.glob("journal-*.wal"))) == 2
+        records, _ = replay_records(tmp_path)
+        assert [r["idem"] for r in records] == ["k0", "k1"]
+
+    def test_replay_suffix_after_midstream_checkpoint(self, tmp_path):
+        """Records BEFORE the last checkpoint marker are excluded from
+        the replay suffix even when compaction never ran (e.g. the
+        marker and its records share the active segment)."""
+        j = RequestJournal(tmp_path)
+        j.append(_rec(0))
+        j.close(clean=False)
+        # hand-append a checkpoint marker + one more record to the SAME
+        # file, simulating a crash between marker write and compaction
+        seg = sorted(tmp_path.glob("journal-*.wal"))[-1]
+        with open(seg, "ab") as fh:
+            for rec in ({"op": "checkpoint", "seq": 2, "sids": ["psr0"]},
+                        dict(_rec(1), op="request", seq=3)):
+                payload = json.dumps(rec).encode()
+                fh.write(struct.pack("<II", len(payload),
+                                     zlib.crc32(payload)) + payload)
+        records, _ = replay_records(tmp_path)
+        assert [r["idem"] for r in records] == ["k1"]
+
+
+class TestFailureModes:
+    def test_torn_final_record_truncates_with_ledger(self, tmp_path):
+        """A torn tail (fault-injected mid-write kill) recovers at the
+        last whole record: serve.journal_truncated on the ledger, the
+        segment truncated so the journal is whole again."""
+        j = RequestJournal(tmp_path)
+        j.append(_rec(0))
+        j.append(_rec(1))
+        faults.arm("serve.journal", "torn", times=1)
+        with pytest.raises(JournalError, match="torn"):
+            j.append(_rec(2))
+        assert ("serve.journal", "torn") in [(s, m) for s, m, _ in
+                                             faults.fired]
+        j.close(clean=False)
+        size_dirty = j.active_segment.stat().st_size
+        records, report = replay_records(tmp_path)
+        assert [r["idem"] for r in records] == ["k0", "k1"]
+        assert report["truncated_records"] == 1
+        assert report["corrupt_segments"] == 0
+        assert [e.kind for e in degrade.events()] == [
+            "serve.journal_truncated"]
+        # the truncation healed the file: a second read is clean
+        assert j.active_segment.stat().st_size < size_dirty
+        degrade.reset_ledger()
+        records2, report2 = replay_records(tmp_path)
+        assert [r["idem"] for r in records2] == ["k0", "k1"]
+        assert report2["truncated_records"] == 0
+        assert degrade.degradation_count() == 0
+
+    def test_manual_truncation_equivalent(self, tmp_path):
+        """The same recovery without the fault harness: byte-truncate
+        the tail mid-record."""
+        j = RequestJournal(tmp_path)
+        j.append(_rec(0))
+        j.append(_rec(1))
+        j.close(clean=False)
+        seg = j.active_segment
+        seg.write_bytes(seg.read_bytes()[:-7])
+        records, report = replay_records(tmp_path)
+        assert [r["idem"] for r in records] == ["k0"]
+        assert report["truncated_records"] == 1
+
+    def test_corrupt_record_quarantines_segment(self, tmp_path):
+        """Checksum corruption is NOT crash debris: the segment is
+        preserved in quarantine/ beside the journal (the
+        fetch.corrupt_quarantined discipline), serve.journal_corrupt is
+        on the ledger, and records before the lie still serve."""
+        j = RequestJournal(tmp_path)
+        j.append(_rec(0))
+        faults.arm("serve.journal", "corrupt", times=1)
+        j.append(_rec(1))               # written with a lying checksum
+        j.append(_rec(2))
+        j.close(clean=False)
+        records, report = replay_records(tmp_path)
+        assert [r["idem"] for r in records] == ["k0"]   # before the lie
+        assert report["corrupt_segments"] == 1
+        assert [e.kind for e in degrade.events()] == [
+            "serve.journal_corrupt"]
+        qfiles = list((tmp_path / "quarantine").glob("*.wal"))
+        assert len(qfiles) == 1
+
+    def test_corrupt_refused_under_degraded_error(self, tmp_path,
+                                                  monkeypatch):
+        j = RequestJournal(tmp_path)
+        faults.arm("serve.journal", "corrupt", times=1)
+        j.append(_rec(0))
+        j.close(clean=False)
+        monkeypatch.setenv("PINT_TPU_DEGRADED", "error")
+        with pytest.raises(degrade.DegradedError,
+                           match="serve.journal_corrupt"):
+            replay_records(tmp_path)
+
+    def test_fsync_batching_counts(self, tmp_path):
+        # fsync_every=0: never mid-stream (rotation/close still fsync);
+        # the knob default comes from PINT_TPU_SERVE_JOURNAL_FSYNC
+        j = RequestJournal(tmp_path, fsync_every=0)
+        for i in range(10):
+            j.append(_rec(i))
+        j.close(clean=True)
+        records, report = replay_records(tmp_path)
+        assert len(records) == 11 and report["clean_close"]
+
+    def test_stats(self, tmp_path):
+        j = RequestJournal(tmp_path, fsync_every=4)
+        j.append(_rec(0))
+        st = j.stats()
+        assert st["appended"] == 1 and st["segments"] == 1
+        assert st["bytes"] > 0 and st["fsync_every"] == 4
