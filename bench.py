@@ -1725,6 +1725,43 @@ def smoke_session_bench(ntoas: int = 700, n_appends: int = 10, k: int = 8,
     return rec
 
 
+def _scrape_metrics_endpoint(port: int) -> dict:
+    """GET the running engine's localhost /metrics + /healthz and
+    validate: the text parses as OpenMetrics and the serve/degrade/
+    journal family set is declared (the ISSUE-15 endpoint contract)."""
+    import urllib.request
+
+    base = f"http://127.0.0.1:{port}"
+    out: dict = {"port": port}
+    want = ("pint_tpu_serve_requests", "pint_tpu_serve_dispatches",
+            "pint_tpu_serve_appends", "pint_tpu_serve_shed",
+            "pint_tpu_serve_journal_records", "pint_tpu_degradations",
+            "pint_tpu_serve_journal_fsync_seconds",
+            "pint_tpu_serve_queue_depth", "pint_tpu_serve_latency_ms",
+            "pint_tpu_incremental_refits")
+    try:
+        with urllib.request.urlopen(base + "/metrics", timeout=10) as r:
+            text = r.read().decode()
+        with urllib.request.urlopen(base + "/healthz", timeout=10) as r:
+            health = json.loads(r.read().decode())
+        from pint_tpu.obs.metrics import parse_openmetrics
+
+        samples, families = parse_openmetrics(text)
+        out.update(
+            ok=True,
+            families=len(families),
+            samples=len(samples),
+            healthz_ok=bool(health.get("ok")),
+            healthz_queued=health.get("queued"),
+            serve_requests_total=samples.get(
+                "pint_tpu_serve_requests_total"),
+            missing_families=[w for w in want if w not in families],
+        )
+    except Exception as e:  # noqa: BLE001 — the failure IS the bench result
+        out.update(ok=False, error=f"{type(e).__name__}: {e}")
+    return out
+
+
 def smoke_serve_bench(base_rows=(160, 200, 240), requests_per_session: int = 8,
                       k: int = 1, max_wait_ms: float = 25.0,
                       overload_depth: int = 4, overload_offered: int = 12,
@@ -1806,6 +1843,11 @@ def smoke_serve_bench(base_rows=(160, 200, 240), requests_per_session: int = 8,
             base_rows, requests_per_session, k, max_wait_ms,
             overload_depth, overload_offered, include_refits)
     finally:
+        # the body turns request tracing on programmatically; follow
+        # the caller's PINT_TPU_TRACE again on the way out
+        from pint_tpu.obs import trace as _trace
+
+        _trace.configure()
         if prev_nbody is None:
             os.environ.pop("PINT_TPU_NBODY", None)
         else:
@@ -1827,6 +1869,7 @@ def _smoke_serve_bench_body(base_rows, requests_per_session, k, max_wait_ms,
     from pint_tpu.analysis.jaxpr_audit import compile_count
     from pint_tpu.astro import time as ptime
     from pint_tpu.fitting.wls import apply_delta
+    from pint_tpu.obs import flight, trace
     from pint_tpu.ops import perf
     from pint_tpu.profiles import serve_smoke_fleet
     from pint_tpu.serve import ServingEngine, SessionPool, ShedError, \
@@ -1870,9 +1913,16 @@ def _smoke_serve_bench_body(base_rows, requests_per_session, k, max_wait_ms,
     import tempfile
 
     durable_dir = tempfile.mkdtemp(prefix="pint_tpu_serve_bench_")
+    # observability leg (ISSUE 15): the whole nominal trace runs with
+    # request tracing ON (spans to a bounded JSONL buffer beside the
+    # journal) and the OpenMetrics endpoint serving on an ephemeral
+    # localhost port — the bench proves coverage, endpoint correctness
+    # and the <=5% tracing tax in one record
+    trace.reset()
+    trace.configure(enable=True, dir=os.path.join(durable_dir, "traces"))
     pool = SessionPool(capacity=len(fleet_a) + 1)
     engine = ServingEngine(pool, max_wait_ms=max_wait_ms,
-                           durable_dir=durable_dir)
+                           durable_dir=durable_dir, metrics_port=0)
     for i, (ses, _, _) in enumerate(fleet_a):
         engine.add_session(f"psr{i}", ses)
 
@@ -1904,6 +1954,10 @@ def _smoke_serve_bench_body(base_rows, requests_per_session, k, max_wait_ms,
         for t in tickets:
             t.wait(timeout=300.0)
         serve_wall = time.time() - t0
+        # scrape the live endpoint while the engine serves: /metrics
+        # must parse as OpenMetrics and carry the serve/degrade/journal
+        # counter set; /healthz must answer ready (localhost only)
+        metrics_rec = _scrape_metrics_endpoint(engine.metrics_port)
         if include_refits:
             # cross-session refit lane: fills (or deadlines) into ONE
             # fleet-batched dispatch; outside the append-throughput span
@@ -1930,6 +1984,13 @@ def _smoke_serve_bench_body(base_rows, requests_per_session, k, max_wait_ms,
     n_requests = len(tickets)
     sustained = n_requests / serve_wall
     engine_stats = engine.stats()
+    # per-request attribution contract (the trace pillar): every served
+    # request's named spans (admit/queue/solve under its request root)
+    # must cover >= 90% of its wall — snapshot BEFORE the failure legs
+    # below add deliberately-errored requests
+    trace_rec = trace.coverage_summary()
+    trace_rec["span_records"] = len(trace.records())
+    trace_rec["buffer_dir"] = os.path.join(durable_dir, "traces")
 
     # --- serial comparator: the SAME interleaved trace, one at a time ---
     t0 = time.time()
@@ -1960,6 +2021,34 @@ def _smoke_serve_bench_body(base_rows, requests_per_session, k, max_wait_ms,
                        for n in free])
         parity = max(parity, float(np.max(
             np.abs(pa - pb) / np.maximum(np.abs(pb), 1e-300))))
+
+    # --- tracing-overhead leg: the <=5% tax contract --------------------
+    # the same warm session serves the same k-row append with tracing
+    # OFF then ON (the twin fleet, already outside every parity
+    # comparison): span recording must not tax serve throughput — the
+    # production bound is >= 0.95x, asserted with CI slack in tier-1
+    ses_ov, full_ov, base_ov = fleet_b[1]
+    m_ov = 8
+    trace.configure(enable=False)
+    t0 = time.time()
+    for _ in range(m_ov):
+        ses_ov.append(**rows(full_ov, base_ov, base_ov + k))
+    overhead_off_s = time.time() - t0
+    trace.configure(enable=True,
+                    dir=os.path.join(durable_dir, "traces"))
+    t0 = time.time()
+    for _ in range(m_ov):
+        ses_ov.append(**rows(full_ov, base_ov, base_ov + k))
+    overhead_on_s = time.time() - t0
+    trace_rec["overhead"] = {
+        "requests_each": m_ov,
+        "off_wall_s": round(overhead_off_s, 4),
+        "on_wall_s": round(overhead_on_s, 4),
+        # >1.0 means tracing-on was FASTER (noise); the contract bound
+        # is on this ratio
+        "throughput_ratio": round(overhead_off_s / max(overhead_on_s,
+                                                       1e-9), 3),
+    }
 
     # nominal ledger snapshot BEFORE the deliberately-degrading legs:
     # this is the count the PINT_TPU_DEGRADED=error contract locks at 0
@@ -2006,7 +2095,24 @@ def _smoke_serve_bench_body(base_rows, requests_per_session, k, max_wait_ms,
     # "sustained_append_fits_per_sec >= 0.9x the unjournaled figure")
     journal_overhead = (breakdown.get("serve_journal_s", 0.0)
                         / max(serve_wall, 1e-9))
-    shutil.rmtree(durable_dir, ignore_errors=True)
+
+    # --- fleet-wide percentiles: the cross-process sketch merge ---------
+    # the dead engine's latency sketch (marshalled through its JSON
+    # form, the cross-process path) merged with the recovery twin's
+    # per-session sketches = ONE fleet latency distribution spanning the
+    # crash — merged ≡ pooled-sample quantiles within the sketch's 2%
+    # bound (unit-locked in tests/test_obs.py)
+    fleet_sketch = perf.QuantileSketch.from_dict(engine.latency.to_dict())
+    for i in range(len(fleet_a)):
+        fleet_sketch.merge(engine_r.pool.get(f"psr{i}")._lat_sketch)
+    fleet_latency = {
+        "count": fleet_sketch.count,
+        "engines_merged": 2,
+        "p50_ms": (None if fleet_sketch.quantile(0.5) is None
+                   else round(fleet_sketch.quantile(0.5), 3)),
+        "p99_ms": (None if fleet_sketch.quantile(0.99) is None
+                   else round(fleet_sketch.quantile(0.99), 3)),
+    }
 
     # --- overload leg: bounded queue sheds, p99 stays depth-bounded -----
     prev_degraded = os.environ.get("PINT_TPU_DEGRADED")
@@ -2078,6 +2184,48 @@ def _smoke_serve_bench_body(base_rows, requests_per_session, k, max_wait_ms,
             "serve_p99_ms": None if p99_chaos is None else round(p99_chaos, 3),
             "degradation_kinds": _degradation_kinds(),
         }
+        cursor += 4 * k
+
+        # --- hang-chaos leg: the flight recorder's crash report ---------
+        # a serve.dispatch:hang mid-dispatch trips the watchdog: the
+        # lane is quarantined AND a complete crash report (ring events +
+        # the still-open dispatch span + an OpenMetrics snapshot) lands
+        # beside the journal — the post-mortem `pint_tpu recover` prints
+        os.environ.pop("PINT_TPU_FAULTS", None)
+        from pint_tpu.testing import faults as _faults
+
+        _faults.arm("serve.dispatch", "hang", times=1)
+        engine4 = ServingEngine(pool, max_wait_ms=max_wait_ms,
+                                durable_dir=durable_dir,
+                                watchdog_s=0.4, retries=0)
+        engine4.start()
+        t_hang = engine4.submit(session="psr0", tenant="chaos",
+                                **rows(full0, cursor, cursor + k))
+        hang_error = None
+        try:
+            t_hang.wait(timeout=60.0)
+        except Exception as e:  # noqa: BLE001 — the quarantine refusal IS the expected outcome
+            hang_error = type(e).__name__
+        engine4.stop(drain=False)
+        _faults.reset()
+        report_path = flight.latest_report(durable_dir)
+        crash_rec: dict = {"faults": "serve.dispatch:hang*1",
+                           "ticket_error": hang_error}
+        if report_path is not None:
+            rpt = json.loads(open(report_path).read())
+            crash_rec.update(
+                report=os.path.basename(str(report_path)),
+                reason=rpt.get("reason"),
+                events=len(rpt.get("events") or []),
+                active_spans=len(rpt.get("active_spans") or []),
+                has_metrics=bool(rpt.get("metrics")),
+                has_degradations=bool(rpt.get("degradations")),
+                summary_lines=len(
+                    flight.summarize_crash_report(report_path)
+                    .splitlines()),
+            )
+        else:
+            crash_rec["report"] = None
     finally:
         if prev_degraded is None:
             os.environ.pop("PINT_TPU_DEGRADED", None)
@@ -2124,6 +2272,13 @@ def _smoke_serve_bench_body(base_rows, requests_per_session, k, max_wait_ms,
         "recovery": recovery,
         "overload": overload,
         "chaos": chaos,
+        # the ISSUE-15 observability legs: per-request span coverage +
+        # tracing tax, endpoint correctness, fleet-merged percentiles,
+        # and the watchdog-triggered crash report
+        "trace": trace_rec,
+        "metrics_endpoint": metrics_rec,
+        "fleet_latency": fleet_latency,
+        "crash": crash_rec,
         "note": "serial side = the identical interleaved trace drained "
                 "one request at a time on a twin fleet; both fleets "
                 "warmed their programs identically at session-fit time, "
@@ -2140,6 +2295,7 @@ def _smoke_serve_bench_body(base_rows, requests_per_session, k, max_wait_ms,
         rec["audit"] = audit_block()
     except Exception:  # noqa: BLE001 — telemetry only  # jaxlint: disable=silent-except — telemetry assembly
         rec["audit"] = None
+    shutil.rmtree(durable_dir, ignore_errors=True)
     return rec
 
 

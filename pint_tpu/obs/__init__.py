@@ -1,0 +1,25 @@
+"""Observability: the ops surface of the serving stack (ISSUE 15).
+
+Three pillars, one package:
+
+- :mod:`pint_tpu.obs.trace` — per-request tracing: context-propagated
+  trace/span ids minted at ``ServingEngine.submit``, riding the ticket
+  and the write-ahead journal record, threaded through admit/queue/
+  dispatch/solve and down into ``TimedProgram`` compiles; spans export
+  as JSON Lines to a bounded buffer with a per-request >=90%
+  attribution contract. Zero-cost when ``PINT_TPU_TRACE`` is off.
+- :mod:`pint_tpu.obs.metrics` — a process-global registry of counters/
+  gauges/histograms FED by the existing telemetry surfaces (perf
+  counters, the degradation ledger, audit compile counts, engine/pool
+  live state, journal fsync latency, QuantileSketch distributions),
+  rendered as OpenMetrics and served over localhost ``/metrics`` +
+  ``/healthz`` (``PINT_TPU_METRICS_PORT``) or dumped by
+  ``pint_tpu status``.
+- :mod:`pint_tpu.obs.flight` — a bounded ring of recent structured
+  events (``PINT_TPU_FLIGHT_EVENTS``) that dumps itself — with the
+  active spans and a metrics snapshot — to a crash report beside the
+  journal on watchdog quarantine, dispatch failure, the ``serve.crash``
+  drill, or SIGUSR1; ``pint_tpu recover`` prints the post-mortem.
+"""
+
+from pint_tpu.obs import flight, metrics, trace  # noqa: F401

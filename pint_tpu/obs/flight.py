@@ -1,0 +1,242 @@
+"""Flight recorder: the last N structured events, dumped on a crash.
+
+When the watchdog quarantines a lane, a dispatch dies with an unhandled
+exception, or the ``serve.crash`` drill kills the process, the
+aggregate telemetry says *that* something happened — the operator needs
+the last ten seconds of *what*: the dispatches in flight, the sheds and
+evictions leading up to it, the degradations that fired, the compiles a
+request triggered. This module keeps that story in a bounded in-memory
+ring (:class:`FlightRecorder`, size ``PINT_TPU_FLIGHT_EVENTS``) that
+every serving surface feeds:
+
+- ledger degradations (sheds, evictions, deadline expiries, retries,
+  quarantines, journal truncation/corruption, host fallbacks) arrive
+  through the ``ops/degrade.py`` observer hook — registered at import,
+  so ANY degradation anywhere lands in the ring with its trace id;
+- the engine notes dispatches + watchdog beats, the journal notes
+  checkpoints, the pool notes restores, ``TimedProgram`` notes
+  compile / ``.aotx`` deserialize events (ops/compile.py).
+
+On trigger — watchdog quarantine, exhausted dispatch retries, the
+``serve.crash`` fault, or ``SIGUSR1`` — :func:`dump_crash_report`
+writes one JSON **crash report** beside the journal store
+(``<durable_dir>/crash/``): the ring snapshot, the currently-open trace
+spans (what was in flight), an OpenMetrics snapshot, and the
+degradation block. ``pint_tpu recover`` picks the newest report up and
+prints the post-mortem summary (:func:`summarize_crash_report`).
+
+Event notes are a lock + deque append of a small dict: cheap enough to
+leave on everywhere; ``PINT_TPU_FLIGHT_EVENTS=0`` disables recording.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+from pathlib import Path
+
+from pint_tpu.ops import degrade
+from pint_tpu.utils import knobs
+from pint_tpu.utils.logging import get_logger
+
+log = get_logger("pint_tpu.obs")
+
+__all__ = [
+    "FlightRecorder", "crash_report", "dump_crash_report",
+    "install_signal_handler", "latest_report", "note", "recorder",
+    "summarize_crash_report",
+]
+
+
+class FlightRecorder:
+    """Bounded ring of recent structured events (thread-safe)."""
+
+    def __init__(self, maxlen: int | None = None):
+        if maxlen is None:
+            maxlen = int(knobs.get("PINT_TPU_FLIGHT_EVENTS") or 0)
+        self.maxlen = int(maxlen)
+        self._ring: deque = deque(maxlen=max(self.maxlen, 1))
+        self._lock = threading.Lock()
+        self._seq = 0
+        self.total = 0                 # events ever noted (ring evicts)
+
+    def note(self, kind: str, **fields) -> None:
+        if self.maxlen <= 0:
+            return
+        rec = {"kind": kind, "t": time.time(),
+               "t_mono": time.monotonic()}
+        if fields:
+            rec.update(fields)
+        with self._lock:
+            self._seq += 1
+            rec["seq"] = self._seq
+            self._ring.append(rec)
+            self.total += 1
+
+    def snapshot(self) -> list[dict]:
+        """The ring contents, oldest first."""
+        with self._lock:
+            return [dict(r) for r in self._ring]
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ring.clear()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._ring)
+
+
+_recorder: FlightRecorder | None = None
+_rec_lock = threading.Lock()
+
+
+def recorder() -> FlightRecorder:
+    """The process-global ring (created on first use; ring size reads
+    ``PINT_TPU_FLIGHT_EVENTS`` at creation)."""
+    global _recorder
+    with _rec_lock:
+        if _recorder is None:
+            _recorder = FlightRecorder()
+        return _recorder
+
+
+def reset_recorder() -> None:
+    """Fresh ring (test isolation; re-reads the size knob)."""
+    global _recorder
+    with _rec_lock:
+        _recorder = None
+
+
+def note(kind: str, **fields) -> None:
+    """Append one event to the process ring."""
+    recorder().note(kind, **fields)
+
+
+def _on_degrade(event) -> None:
+    note("degrade", degrade_kind=event.kind, component=event.component,
+         detail=event.detail, trace=event.trace_id, count=event.count)
+
+
+# every ledger write anywhere in the process lands in the ring — the
+# crash report's core narrative (sheds, evictions, fallbacks, journal
+# damage) comes for free from the taxonomy
+degrade.add_observer(_on_degrade)
+
+
+# -- crash reports ------------------------------------------------------------------
+
+
+def crash_report(reason: str, extra: dict | None = None) -> dict:
+    """Assemble the post-mortem payload: ring events + active trace
+    spans + an OpenMetrics snapshot + the degradation block."""
+    from pint_tpu.obs import metrics, trace
+
+    rep = {
+        "reason": reason,
+        "pid": os.getpid(),
+        "t": time.time(),
+        "events": recorder().snapshot(),
+        "events_total": recorder().total,
+        "active_spans": trace.active_spans(),
+        "metrics": metrics.registry().render(),
+        "degradations": degrade.degradation_block(),
+    }
+    if extra:
+        rep.update(extra)
+    return rep
+
+
+def dump_crash_report(dirpath: str | os.PathLike, reason: str,
+                      extra: dict | None = None) -> Path | None:
+    """Write one crash report under ``<dirpath>/`` (the engine passes
+    its ``<durable_dir>/crash`` directory — beside the journal store).
+    Returns the path, or None when the directory is unwritable (a crash
+    report must never turn a degradation into a crash)."""
+    try:
+        d = Path(dirpath)
+        d.mkdir(parents=True, exist_ok=True)
+        rep = crash_report(reason, extra=extra)
+        path = d / f"crash-{os.getpid()}-{int(time.time() * 1e3)}.json"
+        tmp = path.with_suffix(".tmp")
+        tmp.write_text(json.dumps(rep, indent=1, default=str))
+        tmp.replace(path)
+        log.error(f"flight recorder: crash report written to {path} "
+                  f"({len(rep['events'])} ring events, "
+                  f"{len(rep['active_spans'])} active spans) — {reason}")
+        return path
+    except Exception as e:  # noqa: BLE001  # jaxlint: disable=silent-except — crash-report writing is best-effort telemetry on an already-failing path; the failure itself is logged
+        log.error(f"flight recorder: could not write crash report: {e}")
+        return None
+
+
+def latest_report(dirpath: str | os.PathLike) -> Path | None:
+    """The newest crash report under ``<dirpath>/crash/`` (or under
+    ``<dirpath>`` itself), None when there is none — what
+    ``pint_tpu recover`` summarizes."""
+    for d in (Path(dirpath) / "crash", Path(dirpath)):
+        if d.is_dir():
+            reports = sorted(d.glob("crash-*.json"),
+                             key=lambda p: p.stat().st_mtime)
+            if reports:
+                return reports[-1]
+    return None
+
+
+def summarize_crash_report(path: str | os.PathLike) -> str:
+    """Human-readable post-mortem: the reason, the active spans at the
+    moment of death, the last ring events and the degradation kinds —
+    what ``pint_tpu recover`` prints when it finds a report."""
+    rep = json.loads(Path(path).read_text())
+    lines = [
+        f"crash report {Path(path).name}",
+        f"  reason: {rep.get('reason')}",
+        f"  pid {rep.get('pid')} at {time.strftime('%Y-%m-%d %H:%M:%S', time.localtime(rep.get('t', 0)))}",
+    ]
+    spans = rep.get("active_spans") or []
+    lines.append(f"  in flight when it died: {len(spans)} span(s)")
+    for s in spans[:8]:
+        lines.append(
+            f"    {s.get('name')} (trace {s.get('trace')}) open "
+            f"{s.get('open_ms', 0):.0f} ms")
+    degr = rep.get("degradations") or {}
+    if degr.get("kinds"):
+        lines.append(f"  degradations: {', '.join(degr['kinds'])}")
+    events = rep.get("events") or []
+    lines.append(f"  last {min(len(events), 10)} of {len(events)} ring "
+                 "event(s):")
+    for ev in events[-10:]:
+        detail = ev.get("degrade_kind") or ev.get("label") or \
+            ev.get("lane") or ev.get("session") or ""
+        lines.append(f"    [{ev.get('seq')}] {ev.get('kind')} {detail}")
+    if rep.get("metrics"):
+        n = sum(1 for ln in rep["metrics"].splitlines()
+                if ln.startswith("# TYPE"))
+        lines.append(f"  metrics snapshot: {n} families (in the report)")
+    return "\n".join(lines)
+
+
+# -- SIGUSR1 ------------------------------------------------------------------------
+
+_signal_state: dict = {"installed_for": None}
+
+
+def install_signal_handler(dirpath: str | os.PathLike) -> bool:
+    """Dump a crash report to ``dirpath`` on ``SIGUSR1`` — the
+    live-process inspection hook (``kill -USR1 <pid>``). Returns False
+    when signals cannot be installed from this thread (only the main
+    thread may set handlers — a worker-thread engine start skips it)."""
+    import signal
+
+    def _dump(signum, frame):  # noqa: ARG001 — signal handler signature
+        dump_crash_report(dirpath, f"signal {signum} (operator request)")
+
+    try:
+        signal.signal(signal.SIGUSR1, _dump)
+    except (ValueError, OSError, AttributeError):  # jaxlint: disable=silent-except — non-main-thread/platform without SIGUSR1: the on-demand dump is unavailable, every crash-triggered dump still works
+        return False
+    _signal_state["installed_for"] = str(dirpath)
+    return True

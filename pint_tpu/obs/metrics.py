@@ -1,0 +1,492 @@
+"""Process-global metrics registry + OpenMetrics export.
+
+Every number the serving stack already measures — perf counters
+(ops/perf.py), degradation-ledger kinds (ops/degrade.py), audit
+compile counts, serve lane depths, pool occupancy, journal fsync
+latency, the bounded :class:`~pint_tpu.ops.perf.QuantileSketch`
+latency distributions — lives inside one Python process and dies with
+it. This module is the export surface: a process-global
+:class:`MetricsRegistry` that those surfaces *feed* (they stay the
+single source of truth — nothing is measured twice), rendered as an
+OpenMetrics text snapshot by :meth:`MetricsRegistry.render` and served
+by a stdlib HTTP endpoint (:class:`MetricsServer`: ``/metrics`` +
+``/healthz``, localhost, knob ``PINT_TPU_METRICS_PORT``) or dumped
+one-shot by ``pint_tpu status``.
+
+Feeding, not duplicating:
+
+- ``perf.add`` forwards every counter bump through the
+  :func:`feed_counter` hook (``perf.set_metrics_feed``); only counters
+  registered here (the :data:`COUNTER_HELP` inventory) are exported —
+  and the **no-orphan gate** (tests/test_obs.py) walks every
+  ``serve_*``/``incremental_*`` ``perf.add`` call site in the source
+  and fails when one is missing from the inventory, so a new signal
+  cannot silently bypass export.
+- ``degrade.record`` feeds the ``pint_tpu_degradations_total{kind=…}``
+  labeled counter through the ledger's observer hook; the label set is
+  the registered taxonomy (``degrade.KINDS``) by construction.
+- Gauges take a callback (``fn=``) so live state — queue depth, pool
+  occupancy, quarantined lanes — is read at scrape time from the
+  owning object, never mirrored. Re-registering a gauge replaces its
+  callback (the newest engine wins).
+- Histograms wrap a :class:`~pint_tpu.ops.perf.QuantileSketch`
+  (bounded memory, mergeable) and render as OpenMetrics summaries;
+  :meth:`MetricsRegistry.summary` exports an externally-owned sketch
+  (the engine's latency distributions) the same way.
+
+The registry is created (and all hooks installed) on the first
+:func:`registry` call — a process that never touches the serving or
+observability surfaces pays nothing.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+
+from pint_tpu.ops import perf
+from pint_tpu.utils import knobs
+from pint_tpu.utils.logging import get_logger
+
+log = get_logger("pint_tpu.obs")
+
+__all__ = [
+    "COUNTER_HELP", "MetricsRegistry", "MetricsServer", "feed_counter",
+    "observe", "parse_openmetrics", "registry", "reset_registry",
+]
+
+#: every metric exports under this prefix (OpenMetrics namespacing)
+PREFIX = "pint_tpu_"
+
+#: the explicit counter inventory: every ``serve_*``/``incremental_*``
+#: perf counter the telemetry layer bumps, with its export help line.
+#: The no-orphan gate (tests/test_obs.py) greps the source for
+#: ``perf.add("serve_…")``/``perf.add("incremental_…")`` call sites and
+#: fails when one is missing here — registration is a contract, not a
+#: convention.
+COUNTER_HELP: dict[str, str] = {
+    "serve_requests": "requests admitted by the serving engine",
+    "serve_shed": "requests refused or dropped by admission control",
+    "serve_dispatches": "batches dispatched to the device",
+    "serve_coalesced": "requests answered by a shared coalesced solve",
+    "serve_appends": "append requests served",
+    "serve_refits": "refit requests served",
+    "serve_evictions": "warm sessions evicted from the pool",
+    "serve_restores": "sessions restored from checkpoints",
+    "serve_journal_records": "write-ahead journal records appended",
+    "serve_journal_compactions": "journal checkpoint compactions",
+    "serve_checkpoints": "fleet session checkpoints written",
+    "serve_deadline_expired": "queued requests shed past their deadline",
+    "serve_retries": "transiently failed dispatches retried",
+    "serve_quarantines": "sessions quarantined by the watchdog/crash-loop detector",
+    "serve_worker_replacements": "hung workers abandoned and replaced",
+    "incremental_refits": "appends answered by the rank-k incremental path",
+    "incremental_fallbacks": "appends that fell back to the full warm refit",
+    "incremental_rows_appended": "TOA rows appended into resident sessions",
+}
+
+
+def _sanitize(name: str) -> str:
+    return re.sub(r"[^a-zA-Z0-9_:]", "_", name)
+
+
+class _Metric:
+    kind = "untyped"
+
+    def __init__(self, name: str, help: str):
+        self.name = _sanitize(name)
+        self.help = help
+
+    def head(self) -> list[str]:
+        full = PREFIX + self.name
+        return [f"# HELP {full} {self.help}", f"# TYPE {full} {self.kind}"]
+
+
+class Counter(_Metric):
+    """Monotone counter; ``fn`` makes it a live read-through to an
+    existing process-global count (the feeding surface stays the source
+    of truth)."""
+
+    kind = "counter"
+
+    def __init__(self, name, help, fn=None):
+        super().__init__(name, help)
+        self.fn = fn
+        self._v = 0.0
+
+    def inc(self, v: float = 1.0) -> None:
+        self._v += v
+
+    @property
+    def value(self) -> float:
+        return float(self.fn()) if self.fn is not None else self._v
+
+    def samples(self) -> list[str]:
+        return [f"{PREFIX}{self.name}_total {self.value:g}"]
+
+
+class Gauge(_Metric):
+    """Point-in-time value; ``fn`` reads live state at scrape time."""
+
+    kind = "gauge"
+
+    def __init__(self, name, help, fn=None):
+        super().__init__(name, help)
+        self.fn = fn
+        self._v = 0.0
+
+    def set(self, v: float) -> None:
+        self._v = float(v)
+
+    @property
+    def value(self) -> float:
+        if self.fn is None:
+            return self._v
+        try:
+            return float(self.fn())
+        except Exception:  # jaxlint: disable=silent-except — a dead gauge callback (its engine was stopped) scrapes as 0 rather than failing the whole /metrics page
+            return 0.0
+
+    def samples(self) -> list[str]:
+        return [f"{PREFIX}{self.name} {self.value:g}"]
+
+
+class LabeledCounter(_Metric):
+    """One counter family with a single label dimension (the
+    degradation taxonomy: ``…_total{kind="serve.shed"}``)."""
+
+    kind = "counter"
+
+    def __init__(self, name, help, label: str):
+        super().__init__(name, help)
+        self.label = label
+        self._v: dict[str, float] = {}
+        # the degrade observer feeds from whatever thread degraded; an
+        # unlocked read-modify-write would lose bumps under contention
+        self._vlock = threading.Lock()
+
+    def inc(self, label_value: str, v: float = 1.0) -> None:
+        with self._vlock:
+            self._v[label_value] = self._v.get(label_value, 0.0) + v
+
+    def samples(self) -> list[str]:
+        with self._vlock:
+            items = sorted(self._v.items())
+        return [
+            f'{PREFIX}{self.name}_total{{{self.label}="{lv}"}} {val:g}'
+            for lv, val in items
+        ]
+
+
+class Summary(_Metric):
+    """Quantile summary over a bounded :class:`~pint_tpu.ops.perf.
+    QuantileSketch` — registry-owned (``observe``) or wrapping an
+    externally-owned sketch (the engine's latency distributions)."""
+
+    kind = "summary"
+
+    def __init__(self, name, help, sketch=None):
+        super().__init__(name, help)
+        self.sketch = sketch if sketch is not None else perf.QuantileSketch()
+
+    def observe(self, v: float) -> None:
+        self.sketch.add(v)
+
+    def samples(self) -> list[str]:
+        full = PREFIX + self.name
+        out = []
+        for q in (0.5, 0.9, 0.99):
+            v = self.sketch.quantile(q)
+            if v is not None:
+                out.append(f'{full}{{quantile="{q:g}"}} {v:g}')
+        with self.sketch._lock:
+            n, s = self.sketch._n, self.sketch._sum
+        out.append(f"{full}_count {n}")
+        out.append(f"{full}_sum {s:g}")
+        return out
+
+
+class MetricsRegistry:
+    """Name -> metric, rendered as one OpenMetrics text snapshot."""
+
+    def __init__(self):
+        self._metrics: dict[str, _Metric] = {}
+        self._lock = threading.Lock()
+
+    # -- registration (get-or-create; gauges replace their callback) ------------
+
+    def counter(self, name: str, help: str, fn=None) -> Counter:
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = self._metrics[name] = Counter(name, help, fn=fn)
+            return m
+
+    def gauge(self, name: str, help: str, fn=None) -> Gauge:
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = self._metrics[name] = Gauge(name, help, fn=fn)
+            elif fn is not None:
+                m.fn = fn              # the newest owner wins (engine churn)
+            return m
+
+    def labeled_counter(self, name: str, help: str, label: str
+                        ) -> LabeledCounter:
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = self._metrics[name] = LabeledCounter(name, help, label)
+            return m
+
+    def summary(self, name: str, help: str, sketch=None) -> Summary:
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = self._metrics[name] = Summary(name, help, sketch=sketch)
+            elif sketch is not None:
+                m.sketch = sketch
+            return m
+
+    # -- feeding -----------------------------------------------------------------
+
+    def feed(self, name: str, value: float) -> None:
+        """One perf-counter bump: exported iff the name is registered
+        (the COUNTER_HELP inventory); anything else is not a serve/
+        incremental export signal and is ignored."""
+        m = self._metrics.get(name)
+        if isinstance(m, Counter) and m.fn is None:
+            with self._lock:
+                m.inc(value)
+
+    def observe(self, name: str, value: float) -> None:
+        m = self._metrics.get(name)
+        if isinstance(m, Summary):
+            m.observe(value)
+
+    # -- introspection -----------------------------------------------------------
+
+    def names(self) -> list[str]:
+        with self._lock:
+            return sorted(self._metrics)
+
+    def get(self, name: str) -> _Metric | None:
+        return self._metrics.get(name)
+
+    def render(self) -> str:
+        """The OpenMetrics text snapshot (``# HELP``/``# TYPE`` heads,
+        samples, terminating ``# EOF``)."""
+        lines: list[str] = []
+        with self._lock:
+            metrics = list(self._metrics.values())
+        for m in metrics:
+            lines.extend(m.head())
+            lines.extend(m.samples())
+        lines.append("# EOF")
+        return "\n".join(lines) + "\n"
+
+
+# -- the process-global registry + hooks -------------------------------------------
+
+_registry: MetricsRegistry | None = None
+_reg_lock = threading.Lock()
+
+
+def feed_counter(name: str, value: float) -> None:
+    """The ``perf.add`` forwarding hook (installed by :func:`registry`)."""
+    reg = _registry
+    if reg is not None:
+        reg.feed(name, value)
+
+
+def observe(name: str, value: float) -> None:
+    """Feed one observation into a registered summary (e.g. the journal
+    fsync latency). No-op until the registry exists — a process that
+    never scrapes pays nothing."""
+    reg = _registry
+    if reg is not None:
+        reg.observe(name, value)
+
+
+def _on_degrade(event) -> None:
+    reg = _registry
+    if reg is not None:
+        reg.labeled_counter(
+            "degradations",
+            "graceful-degradation ledger events by kind (ops/degrade.py)",
+            "kind").inc(event.kind)
+
+
+def _install(reg: MetricsRegistry) -> None:
+    """Register the standard export set and wire the feeding hooks."""
+    for name, help in COUNTER_HELP.items():
+        reg.counter(name, help)
+    reg.labeled_counter(
+        "degradations",
+        "graceful-degradation ledger events by kind (ops/degrade.py)",
+        "kind")
+    reg.summary("serve_journal_fsync_seconds",
+                "write-ahead journal fsync latency in seconds")
+
+    from pint_tpu.utils import logging as plog
+
+    reg.counter("log_suppressed", "log records suppressed by the dedup "
+                "filter / log_once (survives handler re-init)",
+                fn=plog.suppressed_total)
+
+    def _compiles():
+        from pint_tpu.analysis.jaxpr_audit import compile_count
+
+        return compile_count()
+
+    reg.counter("program_compiles",
+                "TimedProgram trace+compile events (audit ledger)",
+                fn=_compiles)
+
+    def _aot(field):
+        def read():
+            from pint_tpu.ops.compile import aot_block
+
+            return aot_block()[field]
+        return read
+
+    reg.counter("aot_deserialize_hits",
+                "programs served by a deserialized .aotx executable",
+                fn=_aot("deserialize_hits"))
+    reg.counter("aot_deserialize_misses",
+                "artifact-store probes that fell back to trace+compile",
+                fn=_aot("deserialize_misses"))
+
+    perf.set_metrics_feed(feed_counter)
+    from pint_tpu.ops import degrade
+
+    degrade.add_observer(_on_degrade)
+
+
+def registry() -> MetricsRegistry:
+    """The process-global registry, created (and hooks installed) on
+    first use."""
+    global _registry
+    with _reg_lock:
+        if _registry is None:
+            reg = MetricsRegistry()
+            _install(reg)
+            _registry = reg
+        return _registry
+
+
+def reset_registry() -> None:
+    """Replace the registry with a fresh installed one (test isolation;
+    the perf/degrade hooks keep pointing at the module global)."""
+    global _registry
+    with _reg_lock:
+        reg = MetricsRegistry()
+        _install(reg)
+        _registry = reg
+
+
+# -- OpenMetrics parsing (the bench/test validator) --------------------------------
+
+_SAMPLE_RE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{[^}]*\})?\s+(-?[0-9.eE+infa]+)$")
+_COMMENT_RE = re.compile(r"^# (HELP|TYPE|UNIT) ([a-zA-Z_:][a-zA-Z0-9_:]*) ?")
+
+
+def parse_openmetrics(text: str) -> tuple[dict[str, float], set[str]]:
+    """Strict-enough OpenMetrics validation for the bench/test
+    contract: every line must be a HELP/TYPE/UNIT comment, a sample, or
+    the terminating ``# EOF``. Returns ``(samples, families)`` where
+    ``samples`` maps the full sample key (name + label set) to its
+    value and ``families`` is the set of declared metric names.
+    Raises ``ValueError`` on any malformed line or a missing EOF."""
+    samples: dict[str, float] = {}
+    families: set[str] = set()
+    lines = text.splitlines()
+    if not lines or lines[-1] != "# EOF":
+        raise ValueError("OpenMetrics text must end with '# EOF'")
+    for ln in lines[:-1]:
+        if not ln:
+            continue
+        m = _COMMENT_RE.match(ln)
+        if m:
+            families.add(m.group(2))
+            continue
+        m = _SAMPLE_RE.match(ln)
+        if m is None:
+            raise ValueError(f"malformed OpenMetrics line: {ln!r}")
+        samples[m.group(1) + (m.group(2) or "")] = float(m.group(3))
+    return samples, families
+
+
+# -- the HTTP endpoint --------------------------------------------------------------
+
+
+class MetricsServer:
+    """Localhost ``/metrics`` + ``/healthz`` over stdlib http.server.
+
+    ``health_fn`` returns ``(ok, detail_dict)``; ``/healthz`` answers
+    200/503 with the JSON detail. ``port=0`` binds an ephemeral port
+    (read it back from :attr:`port`). The server thread is a daemon —
+    it never blocks interpreter exit."""
+
+    def __init__(self, reg: MetricsRegistry | None = None, port: int = 0,
+                 health_fn=None):
+        self.reg = reg if reg is not None else registry()
+        self.health_fn = health_fn
+        self._httpd = None
+        self._thread = None
+        self.port = int(port)
+
+    def start(self) -> int:
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):  # noqa: A003 — silence stdlib access logs
+                pass
+
+            def _send(self, code: int, body: bytes, ctype: str) -> None:
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):  # noqa: N802 — stdlib handler API
+                if self.path.split("?")[0] == "/metrics":
+                    body = server.reg.render().encode()
+                    self._send(200, body,
+                               "application/openmetrics-text; "
+                               "version=1.0.0; charset=utf-8")
+                    return
+                if self.path.split("?")[0] == "/healthz":
+                    ok, detail = (True, {}) if server.health_fn is None \
+                        else server.health_fn()
+                    body = json.dumps(
+                        dict(detail, ok=bool(ok))).encode()
+                    self._send(200 if ok else 503, body,
+                               "application/json")
+                    return
+                self._send(404, b"not found\n", "text/plain")
+
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", self.port), Handler)
+        self._httpd.daemon_threads = True
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="pint-tpu-metrics",
+            daemon=True)
+        self._thread.start()
+        log.info(f"metrics endpoint serving on 127.0.0.1:{self.port} "
+                 "(/metrics, /healthz)")
+        return self.port
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+        if self._thread is not None:
+            self._thread.join(5.0)
+            self._thread = None

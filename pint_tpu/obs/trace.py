@@ -1,0 +1,382 @@
+"""Request tracing: context-propagated spans over the serving stack.
+
+The serving engine's telemetry so far is *aggregate* — stage walls
+(``serve_breakdown``), bounded latency sketches, counters. None of it
+answers the operator question *what happened to request X*: which lane
+it queued in, how long it waited, whether the dispatch that served it
+had to compile a program, which degradations fired while it was in
+flight. This module is the per-request instrument:
+
+- :func:`new_trace_id` mints a trace id; ``ServingEngine.submit`` stamps
+  it on the :class:`~pint_tpu.serve.engine.ServeTicket` AND on the
+  write-ahead journal record, so a request is joinable across the live
+  engine, the trace buffer, and the durable store.
+- :func:`attach` sets the calling thread's current trace (the worker
+  attaches the dispatching batch's primary trace), so any
+  :func:`span` opened underneath — the session append, a
+  ``TimedProgram`` compile or ``.aotx`` deserialize (ops/compile.py) —
+  is attributed to the request that triggered it.
+- :func:`span` is a timed context manager; :func:`emit` writes a
+  synthetic span directly (the engine reconstructs each request's
+  ``request``/``admit``/``queue``/``solve`` spans from its SLO stamps at
+  finalize, so the named spans cover the request's whole wall — the
+  attribution-contract pattern, per request).
+- Spans export as JSON Lines to a **bounded** on-disk buffer (one
+  rotation generation kept) plus a bounded in-memory tail, so a
+  long-lived process never grows its trace footprint.
+
+Zero-cost when off: ``PINT_TPU_TRACE`` unset/``0`` makes :func:`span`
+return one shared no-op context manager and :func:`emit` a single
+boolean check — the serve path stays exactly as fast as before.
+``PINT_TPU_TRACE=1`` writes under ``<cache_root>/traces``; any other
+value is the output directory. :func:`configure` is the programmatic
+override (bench/tests).
+
+Coverage contract: :func:`coverage` computes, per trace, the fraction
+of the ``request`` root span's wall covered by its named child spans;
+the serve smoke bench locks ``coverage_min >= 0.9`` for every request
+(tests/test_serve.py, tests/test_obs.py).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import uuid
+from collections import deque
+from pathlib import Path
+
+from pint_tpu.utils import knobs
+
+__all__ = [
+    "active_spans", "attach", "configure", "coverage", "coverage_summary",
+    "current_span_id", "current_trace_id", "emit", "enabled",
+    "new_trace_id", "read_trace_file", "records", "reset", "span",
+    "trace_dir",
+]
+
+#: on-disk buffer bound: the live JSONL file rotates to ``<name>.1`` at
+#: this size and ONE predecessor generation is kept — total trace disk
+#: footprint is bounded at ~2x this regardless of uptime
+MAX_FILE_BYTES = 8 << 20
+#: in-memory record tail (coverage / crash reports read this, not disk)
+TAIL_KEEP = 4096
+
+_lock = threading.Lock()
+_tls = threading.local()      # .trace: str | None, .stack: list[str]
+#: programmatic overrides (None = follow the PINT_TPU_TRACE knob)
+_state: dict = {"enable": None, "dir": None}
+#: the bounded in-memory tail of emitted span records
+_tail: deque = deque(maxlen=TAIL_KEEP)
+#: currently-open live spans: id(obj) -> record-in-progress (the flight
+#: recorder snapshots this into crash reports)
+_open: dict[int, dict] = {}
+_seq = [0]
+_file_state: dict = {"path": None, "fh": None, "bytes": 0}
+
+
+def enabled() -> bool:
+    """True when spans record (programmatic override, else the knob)."""
+    if _state["enable"] is not None:
+        return bool(_state["enable"])
+    v = knobs.get("PINT_TPU_TRACE")
+    return bool(v) and v != "0"
+
+
+def configure(enable: bool | None = None, dir: str | os.PathLike | None = None
+              ) -> None:
+    """Programmatic override of the knob (None = follow the env). A dir
+    change closes the current buffer file; records already in the
+    in-memory tail are kept."""
+    with _lock:
+        _state["enable"] = enable
+        _state["dir"] = None if dir is None else str(dir)
+        _close_file_locked()
+
+
+def trace_dir() -> Path:
+    """Where span records are written (knob value when it is a path,
+    else ``<cache_root>/traces``)."""
+    if _state["dir"] is not None:
+        return Path(_state["dir"])
+    v = knobs.get("PINT_TPU_TRACE")
+    if v and v not in ("0", "1"):
+        return Path(v)
+    from pint_tpu.utils.cache import cache_root
+
+    return cache_root() / "traces"
+
+
+def reset() -> None:
+    """Drop the in-memory tail + open-span registry and close the
+    buffer file (test isolation; the knob/override is untouched)."""
+    with _lock:
+        _tail.clear()
+        _open.clear()
+        _close_file_locked()
+
+
+# -- ids + context -----------------------------------------------------------------
+
+
+def new_trace_id() -> str:
+    """A fresh 16-hex-char trace id."""
+    return uuid.uuid4().hex[:16]
+
+
+def _next_span_id() -> str:
+    with _lock:
+        _seq[0] += 1
+        return f"s{_seq[0]:x}"
+
+
+def current_trace_id() -> str | None:
+    """The calling thread's attached trace id (None outside a request)."""
+    return getattr(_tls, "trace", None)
+
+
+def current_span_id() -> str | None:
+    """The innermost open span id on this thread (None outside spans)."""
+    stack = getattr(_tls, "stack", None)
+    return stack[-1] if stack else None
+
+
+class _Attach:
+    __slots__ = ("trace", "_prev")
+
+    def __init__(self, trace_id):
+        self.trace = trace_id
+
+    def __enter__(self):
+        self._prev = getattr(_tls, "trace", None)
+        if self.trace is not None:
+            _tls.trace = self.trace
+        return self
+
+    def __exit__(self, *exc):
+        _tls.trace = self._prev
+        return False
+
+
+def attach(trace_id: str | None):
+    """Context manager setting this thread's current trace id (the
+    cross-thread propagation hook: the engine worker attaches the
+    batch's primary trace around a dispatch). ``None`` is a no-op
+    attach, so call sites need no conditional."""
+    return _Attach(trace_id)
+
+
+# -- the span API ------------------------------------------------------------------
+
+
+class _NullSpan:
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL = _NullSpan()
+
+
+class _Span:
+    __slots__ = ("name", "attrs", "rec", "_t0")
+
+    def __init__(self, name: str, attrs: dict):
+        self.name = name
+        self.attrs = attrs
+
+    def __enter__(self):
+        stack = getattr(_tls, "stack", None)
+        if stack is None:
+            stack = _tls.stack = []
+        sid = _next_span_id()
+        self.rec = {
+            "trace": getattr(_tls, "trace", None),
+            "span": sid,
+            "parent": stack[-1] if stack else None,
+            "name": self.name,
+            "t0": time.time(),
+            "thread": threading.current_thread().name,
+        }
+        if self.attrs:
+            self.rec.update(self.attrs)
+        stack.append(sid)
+        with _lock:
+            _open[id(self)] = self.rec
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        dur = time.perf_counter() - self._t0
+        _tls.stack.pop()
+        rec = dict(self.rec)
+        rec["dur_ms"] = round(dur * 1e3, 4)
+        if exc_type is not None:
+            rec["error"] = exc_type.__name__
+        with _lock:
+            _open.pop(id(self), None)
+        _write(rec)
+        return False
+
+
+def span(name: str, **attrs):
+    """Timed, nestable span on the current thread's trace. Returns one
+    shared no-op object when tracing is off — the zero-cost contract."""
+    if not enabled():
+        return _NULL
+    return _Span(name, attrs)
+
+
+def emit(name: str, t0: float, dur_s: float, *, trace: str | None = None,
+         span_id: str | None = None, parent: str | None = None,
+         **attrs) -> None:
+    """Write one synthetic span record directly (no context manager):
+    the engine reconstructs per-request ``request``/``admit``/``queue``/
+    ``solve`` spans from its SLO stamps at finalize. ``t0``/``dur_s``
+    may come from any one consistent clock — coverage only ever compares
+    durations within a trace."""
+    if not enabled():
+        return
+    rec = {
+        "trace": trace if trace is not None else getattr(_tls, "trace", None),
+        "span": span_id if span_id is not None else _next_span_id(),
+        "parent": parent,
+        "name": name,
+        "t0": float(t0),
+        "dur_ms": round(max(float(dur_s), 0.0) * 1e3, 4),
+    }
+    if attrs:
+        rec.update(attrs)
+    _write(rec)
+
+
+# -- the bounded buffer ------------------------------------------------------------
+
+
+def _close_file_locked() -> None:
+    fh = _file_state["fh"]
+    if fh is not None:
+        try:
+            fh.close()
+        except OSError:  # pragma: no cover — close race on teardown  # jaxlint: disable=silent-except — buffer close failure only affects trace flushing, never results
+            pass
+    _file_state.update(path=None, fh=None, bytes=0)
+
+
+def _file_locked():
+    """The live JSONL file handle (opened lazily; None when the trace
+    dir is unwritable — the in-memory tail still records)."""
+    if _file_state["fh"] is not None:
+        return _file_state["fh"]
+    try:
+        d = trace_dir()
+        d.mkdir(parents=True, exist_ok=True)
+        path = d / f"trace-{os.getpid()}.jsonl"
+        fh = open(path, "ab")
+        _file_state.update(path=path, fh=fh, bytes=path.stat().st_size)
+        return fh
+    except OSError:  # jaxlint: disable=silent-except — an unwritable trace dir degrades to memory-only tracing; spans still serve coverage/crash reports from the tail
+        _file_state.update(path=None, fh=None, bytes=0)
+        return None
+
+
+def _write(rec: dict) -> None:
+    line = (json.dumps(rec, separators=(",", ":")) + "\n").encode()
+    with _lock:
+        _tail.append(rec)
+        fh = _file_locked()
+        if fh is None:
+            return
+        try:
+            fh.write(line)
+            fh.flush()
+            _file_state["bytes"] += len(line)
+            if _file_state["bytes"] >= MAX_FILE_BYTES:
+                # bounded on disk: rotate, keeping ONE predecessor
+                path = _file_state["path"]
+                fh.close()
+                os.replace(path, path.with_suffix(path.suffix + ".1"))
+                _file_state.update(fh=open(path, "ab"), bytes=0)
+        except OSError:  # jaxlint: disable=silent-except — a failed trace write degrades to memory-only tracing, never breaks the serve path
+            _close_file_locked()
+
+
+def records() -> list[dict]:
+    """Snapshot of the in-memory record tail (newest last)."""
+    with _lock:
+        return list(_tail)
+
+
+def active_spans() -> list[dict]:
+    """Currently-open live spans with their age — what a crash report
+    captures as 'what was in flight when it died'."""
+    now = time.time()
+    with _lock:
+        snap = [dict(rec) for rec in _open.values()]
+    for rec in snap:
+        rec["open_ms"] = round(max(now - rec["t0"], 0.0) * 1e3, 3)
+    return snap
+
+
+def read_trace_file(path: str | os.PathLike) -> list[dict]:
+    """Parse one JSONL trace file (malformed lines are skipped — a
+    torn final line is expected crash debris)."""
+    out = []
+    for line in Path(path).read_bytes().splitlines():
+        try:
+            out.append(json.loads(line))
+        except ValueError:  # jaxlint: disable=silent-except — a torn trailing line is expected crash debris; whole records all parse
+            continue
+    return out
+
+
+# -- the per-request coverage contract ---------------------------------------------
+
+
+def coverage(recs: list[dict] | None = None) -> dict[str, float]:
+    """Per-trace attribution: for every trace with a ``request`` root
+    span, the fraction of the root's wall covered by its direct named
+    child spans (clamped to 1.0). The serve contract requires >= 0.9
+    for every request."""
+    recs = records() if recs is None else recs
+    roots: dict[str, dict] = {}
+    child_ms: dict[str, float] = {}
+    for r in recs:
+        t = r.get("trace")
+        if not t or "dur_ms" not in r:
+            continue
+        if r.get("name") == "request" and "error" not in r:
+            # failed requests close their root with an error attr and no
+            # children — the coverage contract binds on served requests
+            roots[t] = r
+    for r in recs:
+        t = r.get("trace")
+        root = roots.get(t)
+        if root is None or r.get("parent") != root["span"]:
+            continue
+        child_ms[t] = child_ms.get(t, 0.0) + float(r["dur_ms"])
+    out = {}
+    for t, root in roots.items():
+        wall = float(root["dur_ms"])
+        if wall <= 0.0:
+            out[t] = 1.0
+        else:
+            out[t] = min(child_ms.get(t, 0.0) / wall, 1.0)
+    return out
+
+
+def coverage_summary(recs: list[dict] | None = None) -> dict:
+    """JSON-ready coverage block: request count, min/mean coverage."""
+    cov = coverage(recs)
+    vals = sorted(cov.values())
+    return {
+        "requests_traced": len(vals),
+        "coverage_min": round(vals[0], 4) if vals else None,
+        "coverage_mean": (round(sum(vals) / len(vals), 4) if vals else None),
+    }

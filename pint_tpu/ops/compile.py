@@ -51,6 +51,7 @@ from pathlib import Path
 
 import jax
 
+from pint_tpu.obs import flight, trace as otrace
 from pint_tpu.ops import perf
 from pint_tpu.utils import knobs
 from pint_tpu.utils.logging import get_logger
@@ -634,11 +635,17 @@ class TimedProgram:
         counted — a warmup-coverage gap must be ledger-visible)."""
         key = _aot_full_key(self.label, sig, self.collective_axes,
                             self.aot_key)
-        with perf.stage("aot_load"):
+        # traced + flight-noted: a deserialize triggered mid-request is
+        # attributed to the request whose dispatch needed it (the worker
+        # attaches the batch's trace id around the dispatch)
+        with perf.stage("aot_load"), \
+                otrace.span(f"aot_load:{self.label}"):
             exe = _aot_load_exe(self.label, key, args)
         if exe is not None:
             aot_note(self.label, "hits")
             perf.add("aot_deserialize_hits", 1)
+            flight.note("aot_load", label=self.label,
+                        trace=otrace.current_trace_id())
         else:
             aot_note(self.label, "misses")
             perf.add("aot_deserialize_misses", 1)
@@ -669,48 +676,58 @@ class TimedProgram:
                 from pint_tpu.analysis.jaxpr_audit import record_compile
 
                 record_compile(self.label)
-                # trace (host Python, never cached) split from backend
-                # compile (XLA, served from the persistent cache when warm)
-                with perf.stage("trace"):
-                    traced = None
-                    if hasattr(self.jfn, "trace"):
-                        try:
-                            traced = self.jfn.trace(*args)
-                        except Exception:  # pragma: no cover — stage API drift  # jaxlint: disable=silent-except — trace-API drift falls back to lower(); same program, attribution only
-                            traced = None
-                    lowered = (traced.lower() if traced is not None
-                               else self.jfn.lower(*args))
-                from pint_tpu.analysis.jaxpr_audit import audit_program
+                # observability: the compile event lands in the flight
+                # ring and, when a request trace is attached (the serve
+                # worker's dispatch), as a span on THAT request — the
+                # operator sees which request paid for which compile
+                flight.note("compile", label=self.label,
+                            trace=otrace.current_trace_id())
+                with otrace.span(f"compile:{self.label}"):
+                    # trace (host Python, never cached) split from backend
+                    # compile (XLA, served from the persistent cache when
+                    # warm)
+                    with perf.stage("trace"):
+                        traced = None
+                        if hasattr(self.jfn, "trace"):
+                            try:
+                                traced = self.jfn.trace(*args)
+                            except Exception:  # pragma: no cover — stage API drift  # jaxlint: disable=silent-except — trace-API drift falls back to lower(); same program, attribution only
+                                traced = None
+                        lowered = (traced.lower() if traced is not None
+                                   else self.jfn.lower(*args))
+                    from pint_tpu.analysis.jaxpr_audit import audit_program
 
-                closed = None if traced is None else traced.jaxpr
-                audit_program(
-                    self.label,
-                    closed,
-                    args,
-                    collective_axes=self.collective_axes,
-                    canonical=self.canonical,
-                    prior_sigs=tuple(self._exes.keys()),
-                    sig=sig,
-                    program_id=id(self),
-                    spec=self.precision_spec,
-                )
-                if closed is not None:
-                    # static cost ledger (analysis/costmodel.py): every
-                    # lowering's FLOPs/bytes land beside the audit block
-                    from pint_tpu.analysis import costmodel
+                    closed = None if traced is None else traced.jaxpr
+                    audit_program(
+                        self.label,
+                        closed,
+                        args,
+                        collective_axes=self.collective_axes,
+                        canonical=self.canonical,
+                        prior_sigs=tuple(self._exes.keys()),
+                        sig=sig,
+                        program_id=id(self),
+                        spec=self.precision_spec,
+                    )
+                    if closed is not None:
+                        # static cost ledger (analysis/costmodel.py):
+                        # every lowering's FLOPs/bytes land beside the
+                        # audit block
+                        from pint_tpu.analysis import costmodel
 
-                    costmodel.record_program(self.label, closed)
-                with perf.stage("compile"):
-                    exe = lowered.compile()
-                    if self.aot_key is not None and aot_enabled():
-                        # export rides the compile stage: the serialize
-                        # cost is compile-shaped work and must stay
-                        # inside the named fit_compile_s attribution
-                        _aot_store(self.label,
-                                   _aot_full_key(self.label, sig,
-                                                 self.collective_axes,
-                                                 self.aot_key),
-                                   self.jfn, args)
+                        costmodel.record_program(self.label, closed)
+                    with perf.stage("compile"):
+                        exe = lowered.compile()
+                        if self.aot_key is not None and aot_enabled():
+                            # export rides the compile stage: the
+                            # serialize cost is compile-shaped work and
+                            # must stay inside the named fit_compile_s
+                            # attribution
+                            _aot_store(self.label,
+                                       _aot_full_key(self.label, sig,
+                                                     self.collective_axes,
+                                                     self.aot_key),
+                                       self.jfn, args)
                 perf.add(f"compiled:{self.label}", 1)
                 self._exes[sig] = exe
                 return exe, True

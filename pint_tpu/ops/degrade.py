@@ -39,6 +39,7 @@ to both recover and write the right ledger event.
 from __future__ import annotations
 
 import threading
+import time
 from typing import NamedTuple
 
 from pint_tpu.utils import knobs
@@ -47,8 +48,9 @@ from pint_tpu.utils.logging import get_logger
 log = get_logger("pint_tpu.degrade")
 
 __all__ = [
-    "KINDS", "DegradedError", "DegradationEvent", "degradation_block",
-    "degradation_count", "events", "mode", "record", "reset_ledger",
+    "KINDS", "DegradedError", "DegradationEvent", "add_observer",
+    "degradation_block", "degradation_count", "events", "mode", "record",
+    "remove_observer", "reset_ledger",
 ]
 
 #: the degradation taxonomy: kind -> one-line description. A ledger write
@@ -123,6 +125,14 @@ class DegradationEvent(NamedTuple):
     #: the knob/config that would fix the degradation
     fix: str | None
     count: int = 1
+    #: monotonic clock of the LATEST occurrence (time.monotonic —
+    #: orderable against trace spans and flight-recorder events)
+    t_mono: float | None = None
+    #: the active request trace id at the latest occurrence, when the
+    #: degradation fired inside a traced request (pint_tpu/obs/trace.py)
+    #: — serve.shed/serve.evict/fit.host_fallback events are joinable
+    #: against the trace buffer
+    trace_id: str | None = None
 
 
 def mode() -> str:
@@ -134,6 +144,22 @@ def mode() -> str:
 _lock = threading.Lock()
 #: (kind, component) -> DegradationEvent (count bumped on repeats)
 _events: dict[tuple[str, str], DegradationEvent] = {}
+#: ledger observers, called with every (merged) event AFTER the ledger
+#: write and BEFORE any =error escalation — the flight recorder and the
+#: metrics registry subscribe here, so a refused degradation is still
+#: on every observability surface
+_observers: list = []
+
+
+def add_observer(fn) -> None:
+    """Subscribe ``fn(event)`` to every ledger write (idempotent)."""
+    if fn not in _observers:
+        _observers.append(fn)
+
+
+def remove_observer(fn) -> None:
+    if fn in _observers:
+        _observers.remove(fn)
 
 
 def reset_ledger() -> None:
@@ -158,16 +184,35 @@ def record(kind: str, component: str, detail: str = "",
             "pint_tpu.ops.degrade.KINDS so the taxonomy stays complete "
             f"(known: {sorted(KINDS)})"
         )
+    # joinability: every event is stamped with a monotonic clock and,
+    # when it fires inside a traced request, the active trace id — a
+    # serve.shed/serve.evict/fit.host_fallback on the ledger points at
+    # the exact request trace that suffered it
+    t_mono = time.monotonic()
+    try:
+        from pint_tpu.obs import trace as _trace
+
+        trace_id = _trace.current_trace_id()
+    except ImportError:  # pragma: no cover — obs layer absent mid-bootstrap  # jaxlint: disable=silent-except — tracing is optional context; the ledger write itself must never fail
+        trace_id = None
     key = (kind, component)
     with _lock:
         prior = _events.get(key)
         if prior is not None:
-            _events[key] = prior._replace(count=prior.count + 1)
+            _events[key] = merged = prior._replace(
+                count=prior.count + 1, t_mono=t_mono,
+                trace_id=trace_id or prior.trace_id)
             first = False
         else:
-            _events[key] = DegradationEvent(kind, component, detail,
-                                            bound_us, fix)
+            _events[key] = merged = DegradationEvent(
+                kind, component, detail, bound_us, fix,
+                t_mono=t_mono, trace_id=trace_id)
             first = True
+    for obs in list(_observers):
+        try:
+            obs(merged)
+        except Exception as e:  # noqa: BLE001  # jaxlint: disable=silent-except — an observer failure must never break the ledger write it observes; logged once per message by the dedup filter
+            log.error(f"degradation observer {obs!r} failed: {e}")
     m = mode()
     msg = f"degraded [{kind}] {component}: {detail}"
     if bound_us is not None:
@@ -204,7 +249,8 @@ def degradation_block(max_events: int = 20) -> dict:
         "kinds": sorted({e.kind for e in evs}),
         "events": [
             {"kind": e.kind, "component": e.component, "detail": e.detail,
-             "bound_us": e.bound_us, "fix": e.fix, "count": e.count}
+             "bound_us": e.bound_us, "fix": e.fix, "count": e.count,
+             "t_mono": e.t_mono, "trace": e.trace_id}
             for e in evs[:max_events]
         ],
         "mode": mode(),

@@ -45,10 +45,11 @@ from contextlib import contextmanager
 from pint_tpu.utils import knobs
 
 __all__ = [
-    "PerfReport", "QuantileSketch", "active", "add", "collect", "enable",
-    "enabled", "fit_breakdown", "incremental_breakdown", "instrument_fit",
-    "noise_breakdown", "prepare_breakdown", "pta_breakdown", "put",
-    "put_default", "serve_breakdown", "stage",
+    "INCR_COUNTERS", "PerfReport", "QuantileSketch", "SERVE_COUNTERS",
+    "active", "add", "collect", "enable", "enabled", "fit_breakdown",
+    "incremental_breakdown", "instrument_fit", "noise_breakdown",
+    "prepare_breakdown", "pta_breakdown", "put", "put_default",
+    "serve_breakdown", "set_metrics_feed", "stage",
 ]
 
 _env_enabled = knobs.flag("PINT_TPU_PERF")
@@ -177,10 +178,30 @@ def stage(name: str):
     return _Stage(name)
 
 
+#: the metrics-export forwarding hook (pint_tpu/obs/metrics.py installs
+#: it on first registry use): every counter bump is offered to the
+#: process-global metrics registry, which exports the registered subset
+#: — the existing telemetry stays the single measurement point. None
+#: (the default) costs one identity check per add().
+_metrics_feed = None
+
+
+def set_metrics_feed(fn) -> None:
+    """Install (or remove, fn=None) the counter-export hook."""
+    global _metrics_feed
+    _metrics_feed = fn
+
+
 def add(name: str, value: float = 1.0) -> None:
     """Accumulate a counter (transfers, bytes, trials, ...). Thread-safe:
     concurrent bumps from serving worker + client threads never lose a
-    count (the lock is skipped entirely when nothing is collecting)."""
+    count (the lock is skipped entirely when nothing is collecting).
+    With the metrics feed installed, every bump is ALSO offered to the
+    process-global export registry — counters export even when no perf
+    report is collecting (a production process scrapes /metrics without
+    paying for per-fit breakdowns)."""
+    if _metrics_feed is not None:
+        _metrics_feed(name, value)
     if not _reports:
         return
     with _rec_lock:
@@ -436,11 +457,8 @@ def incremental_breakdown(rep: PerfReport) -> dict:
     out["incremental_compile_s"] = round(compile_s, 4)
     out["incremental_trace_s"] = round(trace_s, 4)
     out["incremental_other_s"] = round(max(wall - direct, 0.0), 4)
-    out["incremental_refits"] = int(rep.counters.get("incremental_refits", 0))
-    out["incremental_fallbacks"] = int(
-        rep.counters.get("incremental_fallbacks", 0))
-    out["incremental_rows_appended"] = int(
-        rep.counters.get("incremental_rows_appended", 0))
+    for c in INCR_COUNTERS:
+        out[c] = int(rep.counters.get(c, 0))
     out["prepare_rows"] = int(rep.counters.get("prepare_rows", 0))
     out["prepare_prefix_hits"] = int(
         rep.counters.get("prepare_prefix_hits", 0))
@@ -516,6 +534,35 @@ class QuantileSketch:
             self._min = min(self._min, mn)
             self._max = max(self._max, mx)
 
+    def to_dict(self) -> dict:
+        """JSON-ready marshalled form: the exact grid + bucket counts,
+        so a sketch crosses a process boundary (a crash report, a
+        recovery twin, a multi-engine fleet rollup) and merges on the
+        other side with zero information loss."""
+        with self._lock:
+            return {
+                "base": self._base,
+                "lo": self._lo,
+                "counts": {str(i): c for i, c in self._counts.items()},
+                "n": self._n,
+                "sum": self._sum,
+                "min": None if self._n == 0 else self._min,
+                "max": None if self._n == 0 else self._max,
+            }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "QuantileSketch":
+        """Inverse of :meth:`to_dict` (bitwise round-trip)."""
+        sk = cls()
+        sk._base = float(d["base"])
+        sk._lo = float(d["lo"])
+        sk._counts = {int(i): int(c) for i, c in d["counts"].items()}
+        sk._n = int(d["n"])
+        sk._sum = float(d["sum"])
+        sk._min = math.inf if d["min"] is None else float(d["min"])
+        sk._max = -math.inf if d["max"] is None else float(d["max"])
+        return sk
+
     def quantile(self, q: float) -> float | None:
         """Estimated q-quantile (None while empty). Monotone in q; the
         0/1 extremes return the exact observed min/max."""
@@ -581,6 +628,25 @@ _SERVE_COMPONENTS = ("admit", "queue", "coalesce", "dispatch", "solve",
                      "finalize", "journal", "checkpoint", "recover",
                      "replay")
 
+#: the canonical serving counter set: every ``serve_*`` counter the
+#: engine/scheduler/pool/journal bump. serve_breakdown reports them and
+#: the metrics registry (pint_tpu/obs/metrics.py) exports them — the
+#: no-orphan gate (tests/test_obs.py) walks the ``perf.add`` call sites
+#: and fails when a new counter bypasses either surface.
+SERVE_COUNTERS = (
+    "serve_requests", "serve_shed", "serve_dispatches",
+    "serve_coalesced", "serve_appends", "serve_refits",
+    "serve_evictions", "serve_restores",
+    "serve_journal_records", "serve_journal_compactions",
+    "serve_checkpoints", "serve_deadline_expired",
+    "serve_retries", "serve_quarantines", "serve_worker_replacements",
+)
+
+#: same contract for the incremental-refit counters (serve/session.py +
+#: fitting/incremental.py)
+INCR_COUNTERS = ("incremental_refits", "incremental_fallbacks",
+                 "incremental_rows_appended")
+
 
 def serve_breakdown(rep: PerfReport) -> dict:
     """Map "serve"-rooted stages into the canonical serving breakdown.
@@ -598,12 +664,7 @@ def serve_breakdown(rep: PerfReport) -> dict:
     attribution, the sketches are SLO telemetry.
     """
     out = _root_breakdown(rep, "serve", _SERVE_COMPONENTS)
-    for c in ("serve_requests", "serve_shed", "serve_dispatches",
-              "serve_coalesced", "serve_appends", "serve_refits",
-              "serve_evictions", "serve_restores",
-              "serve_journal_records", "serve_journal_compactions",
-              "serve_checkpoints", "serve_deadline_expired",
-              "serve_retries", "serve_quarantines"):
+    for c in SERVE_COUNTERS:
         out[c] = int(rep.counters.get(c, 0))
     out["serve_waste_ewma"] = rep.values.get("serve_waste_ewma")
     out["serve_eff_wait_ms"] = rep.values.get("serve_eff_wait_ms")

@@ -9,6 +9,10 @@ Currently:
   directory (session checkpoints + write-ahead journal,
   pint_tpu/scripts/recover.py); ``--hold`` serves until SIGTERM then
   drains gracefully.
+- ``pint_tpu status`` — one-shot observability snapshot: scrape a
+  running engine's localhost ``/metrics`` + ``/healthz`` (``--port``),
+  or dump this process's metrics registry / degradation ledger /
+  artifact-store state (pint_tpu/scripts/status.py).
 - ``pint_tpu knobs`` — print the sanctioned environment-knob inventory
   (pint_tpu/utils/knobs.py).
 
@@ -28,6 +32,8 @@ commands:
            (zero-trace warm starts; see `pint_tpu warmup --help`)
   recover  rebuild a serving fleet from checkpoints + the write-ahead
            journal (crash recovery; see `pint_tpu recover --help`)
+  status   observability snapshot: scrape a running engine's /metrics
+           + /healthz, or dump this process's registry/ledger state
   knobs    print the environment-knob inventory
 """
 
@@ -46,6 +52,10 @@ def main(argv=None) -> int:
         from pint_tpu.scripts.recover import main as recover_main
 
         return recover_main(rest)
+    if cmd == "status":
+        from pint_tpu.scripts.status import main as status_main
+
+        return status_main(rest)
     if cmd == "knobs":
         from pint_tpu.utils import knobs
 

@@ -60,6 +60,7 @@ def main(argv=None) -> int:
                     help="print the recovery report as one JSON line")
     args = ap.parse_args(argv)
 
+    from pint_tpu.obs import flight
     from pint_tpu.ops import degrade
     from pint_tpu.ops.compile import setup_persistent_cache
     from pint_tpu.serve.recover import recover_fleet
@@ -70,9 +71,18 @@ def main(argv=None) -> int:
     report["metric"] = "recover"
     report["degradation_kinds"] = sorted(
         {e.kind for e in degrade.events()})
+    # post-mortem: the dead process may have left a flight-recorder
+    # crash report beside the journal (watchdog quarantine, dispatch
+    # failure, serve.crash, SIGUSR1) — surface what it was doing when
+    # it died next to the recovery numbers
+    crash_path = flight.latest_report(args.dir)
+    report["crash_report"] = None if crash_path is None else str(crash_path)
     print(json.dumps(report) if args.json
           else "\n".join(f"{k}: {v}" for k, v in report.items()),
           flush=True)
+    if crash_path is not None:
+        print(flight.summarize_crash_report(crash_path),
+              file=sys.stderr, flush=True)
     if report["requests_lost"]:
         return 1
 

@@ -1,0 +1,118 @@
+"""`pint_tpu status`: one-shot observability snapshot.
+
+Two modes:
+
+- ``pint_tpu status --port <N>`` scrapes a RUNNING engine's endpoint on
+  localhost (the one ``PINT_TPU_METRICS_PORT`` / ``metrics_port=``
+  started): prints ``/healthz`` then the ``/metrics`` OpenMetrics text
+  — what an operator (or a scrape config smoke test) runs against a
+  live process. Localhost only; no other network.
+- ``pint_tpu status`` (no port) dumps THIS process's observability
+  state: the metrics registry render, the degradation ledger, the
+  ``.aotx`` artifact-store traffic, the flight-recorder ring size, the
+  non-default knobs — the "what is this installation doing" snapshot a
+  support ticket wants attached.
+
+``--json`` emits one machine-readable JSON object either way (the
+tier-1 smoke: ``pint_tpu status --json`` must parse and carry the
+standard keys — tests/test_obs.py).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+
+def _scrape(port: int, as_json: bool) -> int:
+    import urllib.request
+
+    base = f"http://127.0.0.1:{int(port)}"
+    try:
+        with urllib.request.urlopen(base + "/healthz", timeout=5) as r:
+            health = json.loads(r.read().decode())
+    except OSError as e:
+        # a 503 still carries the health JSON (not-ready is an answer)
+        body = getattr(e, "read", lambda: b"")()
+        try:
+            health = json.loads(body.decode())
+        except Exception:  # noqa: BLE001  # jaxlint: disable=silent-except — an unreachable endpoint is reported as the command's failure output below
+            print(f"pint_tpu status: cannot reach {base}/healthz: {e}",
+                  file=sys.stderr)
+            return 1
+    with urllib.request.urlopen(base + "/metrics", timeout=5) as r:
+        metrics_text = r.read().decode()
+    if as_json:
+        print(json.dumps({"metric": "status", "mode": "scrape",
+                          "port": int(port), "healthz": health,
+                          "openmetrics": metrics_text}))
+    else:
+        print(json.dumps(health, indent=1))
+        print(metrics_text, end="")
+    return 0 if health.get("ok") else 3
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="pint_tpu status",
+        description="One-shot observability snapshot: scrape a running "
+                    "engine's localhost /metrics + /healthz (--port), or "
+                    "dump this process's registry/ledger/artifact state.")
+    ap.add_argument("--port", type=int, default=None,
+                    help="scrape the running engine's metrics endpoint "
+                         "on this localhost port")
+    ap.add_argument("--json", action="store_true",
+                    help="emit one JSON object instead of text")
+    args = ap.parse_args(argv)
+
+    if args.port is not None:
+        return _scrape(args.port, args.json)
+
+    from pint_tpu.obs import flight, metrics, trace
+    from pint_tpu.ops import degrade
+    from pint_tpu.ops.compile import aot_block, setup_persistent_cache
+    from pint_tpu.utils import knobs
+
+    setup_persistent_cache()
+    reg = metrics.registry()
+    env = os.environ  # jaxlint: disable=env-read — status reports which registered knobs the operator set; values come from the same registry-documented names
+    set_knobs = {n: env[n] for n in knobs.KNOBS if n in env}
+    snap = {
+        "metric": "status",
+        "mode": "process",
+        "pid": os.getpid(),
+        "t": time.time(),
+        "knobs_set": set_knobs,
+        "metrics_families": len(reg.names()),
+        "openmetrics": reg.render(),
+        "degradations": degrade.degradation_block(),
+        "aot": aot_block(),
+        "flight_events": len(flight.recorder()),
+        "trace_enabled": trace.enabled(),
+    }
+    if args.json:
+        print(json.dumps(snap, default=str))
+    else:
+        print(f"pint_tpu status (pid {snap['pid']})")
+        if set_knobs:
+            print("knobs set in the environment:")
+            for n, v in sorted(set_knobs.items()):
+                print(f"  {n}={v}")
+        d = snap["degradations"]
+        print(f"degradations: {d['n_events']} kind/component pairs "
+              f"({', '.join(d['kinds']) or 'none'})")
+        a = snap["aot"]
+        print(f"aot store: {a['deserialize_hits']} hits / "
+              f"{a['deserialize_misses']} misses / {a['exports']} exports "
+              f"({a['cache_dir'] or 'disabled'})")
+        print(f"flight ring: {snap['flight_events']} recent event(s)")
+        print("-- metrics --")
+        print(snap["openmetrics"], end="")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

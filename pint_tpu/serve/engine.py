@@ -78,6 +78,7 @@ from pathlib import Path
 
 import numpy as np
 
+from pint_tpu.obs import flight, metrics, trace
 from pint_tpu.ops import degrade, perf
 from pint_tpu.serve.journal import RequestJournal, encode_rows
 from pint_tpu.serve.pool import SessionPool
@@ -110,10 +111,18 @@ class ServeTicket:
     #: idempotency key: journaled with the request and recorded on the
     #: session once applied, so crash recovery never double-applies
     idem: str = ""
+    #: the request's trace id (pint_tpu/obs/trace.py): minted at submit
+    #: when PINT_TPU_TRACE is on, journaled with the request, attached
+    #: by the worker around the dispatch that serves it ("" = tracing
+    #: off — zero-cost)
+    trace_id: str = ""
     #: absolute clock time past which the queued request is shed with
     #: ``serve.deadline`` instead of dispatched (None: no deadline)
     deadline: float | None = None
     t_submit: float = 0.0
+    #: when submit finished admitting+journaling (the ack): the span
+    #: boundary between the "admit" and "queue" trace spans
+    t_acked: float = 0.0
     t_dispatch: float | None = None
     t_done: float | None = None
     result: SessionResult | None = None
@@ -166,6 +175,7 @@ class ServingEngine:
                  retry_backoff_ms: float | None = None,
                  quarantine_fails: int | None = None,
                  watchdog_s: float | None = None,
+                 metrics_port: int | None = None,
                  sleep=time.sleep):
         self.pool = pool if pool is not None else SessionPool()
         self.admission = AdmissionController(
@@ -223,6 +233,106 @@ class ServingEngine:
         self.expired = 0
         self.retried = 0
         self.worker_replacements = 0
+        # observability (pint_tpu/obs/): crash reports land beside the
+        # journal store; the metrics endpoint serves /metrics + /healthz
+        # when a port is configured (knob PINT_TPU_METRICS_PORT, or an
+        # explicit metrics_port= — 0 means "pick an ephemeral port" when
+        # explicit, "off" when it comes from the knob's default)
+        self.crash_dir = (self.durable_dir / "crash"
+                          if self.durable_dir is not None else None)
+        self._metrics_explicit = metrics_port is not None
+        self.metrics_port = (int(knobs.get("PINT_TPU_METRICS_PORT"))
+                             if metrics_port is None else int(metrics_port))
+        self.metrics_server: metrics.MetricsServer | None = None
+        self._register_metrics()
+
+    # -- observability ---------------------------------------------------------------
+
+    def _register_metrics(self) -> None:
+        """Expose live engine state through the process metrics registry
+        (pint_tpu/obs/metrics.py): gauges read THIS engine at scrape
+        time (re-registration replaces the callback — the newest engine
+        wins), and the latency/queue-wait sketches export as summaries.
+        Counters (serve_requests, serve_shed, ...) flow in through the
+        perf.add feed — nothing is measured twice."""
+        reg = metrics.registry()
+        reg.gauge("serve_queue_depth",
+                  "requests currently queued in serving lanes",
+                  fn=self.scheduler.depth)
+        reg.gauge("serve_pool_live", "live sessions in the warm pool",
+                  fn=lambda: self.pool.stats()["live"])
+        reg.gauge("serve_pool_checkpointed",
+                  "sessions evicted to checkpoints",
+                  fn=lambda: self.pool.stats()["checkpointed"])
+        reg.gauge("serve_quarantined", "sessions pulled out of service",
+                  fn=lambda: len(self.quarantined))
+        reg.gauge("serve_inflight", "1 while a dispatch is on the device",
+                  fn=lambda: 1 if self._inflight is not None else 0)
+        reg.gauge("serve_waste_ewma",
+                  "padding-waste EWMA steering the lane deadline",
+                  fn=lambda: self.scheduler.waste_ewma)
+        reg.summary("serve_latency_ms",
+                    "end-to-end append latency (submit to done)",
+                    sketch=self.latency)
+        reg.summary("serve_refit_latency_ms",
+                    "end-to-end refit latency (submit to done)",
+                    sketch=self.refit_latency)
+        reg.summary("serve_queue_wait_ms",
+                    "queue wait before the (possibly shared) solve",
+                    sketch=self.queue_wait)
+
+    def health(self) -> tuple[bool, dict]:
+        """Readiness for ``/healthz``: ok iff the engine is not draining,
+        the journal (when configured) is open, and the worker (when
+        started) is alive. The detail block carries the journal/pool/
+        watchdog state an operator triages from."""
+        worker_alive = self._thread is not None and self._thread.is_alive()
+        journal_ok = (self.journal is None
+                      or not self.journal._fh.closed)
+        ok = (not self._draining and journal_ok
+              and (self._thread is None or worker_alive))
+        detail = {
+            "draining": self._draining,
+            "worker_alive": worker_alive,
+            "watchdog_alive": (self._watchdog is not None
+                               and self._watchdog.is_alive()),
+            "queued": self.scheduler.depth(),
+            "served": self.served,
+            "quarantined": sorted(self.quarantined),
+            "pool": self.pool.stats(),
+            "journal": (None if self.journal is None
+                        else self.journal.stats()),
+        }
+        return ok, detail
+
+    def _dump_crash(self, reason: str):
+        """Write a flight-recorder crash report beside the journal store
+        (no-op without a durable_dir). Best-effort: the report must
+        never turn a failing dispatch into a worse failure."""
+        if self.crash_dir is None:
+            return None
+        return flight.dump_crash_report(
+            self.crash_dir, reason, extra={"engine": self.stats()})
+
+    def _emit_request_trace(self, t: ServeTicket, shared: int) -> None:
+        """Reconstruct one served request's named spans from its SLO
+        stamps: ``request`` (the root, submit->done) with ``admit`` /
+        ``queue`` / ``solve`` children partitioning it — the >=90%
+        per-request attribution contract holds by construction, and any
+        live spans the dispatch recorded (session append, compiles,
+        .aotx loads) join the same trace id."""
+        root = f"{t.trace_id}:r"
+        kw = dict(trace=t.trace_id, parent=root)
+        acked = t.t_acked or t.t_submit
+        td = t.t_dispatch if t.t_dispatch is not None else acked
+        done = t.t_done
+        trace.emit("request", t.t_submit, done - t.t_submit,
+                   trace=t.trace_id, span_id=root, session=t.session,
+                   kind=t.kind, rows=t.rows, tenant=t.tenant,
+                   coalesced=shared)
+        trace.emit("admit", t.t_submit, acked - t.t_submit, **kw)
+        trace.emit("queue", acked, td - acked, **kw)
+        trace.emit("solve", td, done - td, shared=shared, **kw)
 
     # -- sessions --------------------------------------------------------------------
 
@@ -293,7 +403,12 @@ class ServingEngine:
             payload = dict(utc=utc, error_us=error_us, freq_mhz=freq_mhz,
                            obs=obs, flags=flags)
             rows = len(np.asarray(error_us))
-        with perf.stage("serve"):
+        # mint the request's trace id ("" when tracing is off — every
+        # hook below degrades to a no-op); attaching here means any
+        # degradation the admit path records (a shed, a rate refusal)
+        # carries this trace id on the ledger
+        tid = trace.new_trace_id() if trace.enabled() else ""
+        with trace.attach(tid or None), perf.stage("serve"):
             with perf.stage("admit"):
                 if self._draining:
                     # refuse-while-draining is a shed like any other:
@@ -325,20 +440,24 @@ class ServingEngine:
                     session=session, kind=kind, tenant=tenant, rows=rows,
                     lane_key=self._lane_key(session, kind),
                     payload=payload, t_submit=now,
-                    idem=idem or uuid.uuid4().hex,
+                    idem=idem or uuid.uuid4().hex, trace_id=tid,
                     deadline=None if dl is None else now + float(dl))
                 perf.add("serve_requests")
             if self.journal is not None:
                 # the WAL contract: the record is durable (flushed to
                 # the OS, fsync-batched) BEFORE the ticket acks; a
-                # JournalError propagates and nothing was queued
+                # JournalError propagates and nothing was queued. The
+                # trace id rides the record, so a replayed request is
+                # joinable against the dead process's trace buffer.
                 self.journal.append({
                     "session": session, "kind": kind, "tenant": tenant,
                     "idem": ticket.idem, "deadline_s": dl,
+                    "trace": tid,
                     "rows": encode_rows(payload) if kind == "append"
                     else None})
             with perf.stage("admit"):
                 self.scheduler.offer(ticket, rows=rows)
+            ticket.t_acked = self._clock()
         with self._cv:
             self._cv.notify()
         return ticket
@@ -410,6 +529,8 @@ class ServingEngine:
                  else self.refit_latency).add(t.result.latency_ms)
                 self.queue_wait.add(t.result.queue_ms)
                 self.served += 1
+                if t.trace_id:
+                    self._emit_request_trace(t, shared=len(batch.tickets))
                 t._event.set()
             self.dispatches += 1
             perf.add("serve_dispatches")
@@ -421,6 +542,14 @@ class ServingEngine:
             if not t._event.is_set():
                 t.error = e
                 t.t_done = now
+                if t.trace_id:
+                    # failed requests still close their trace: the root
+                    # span carries the error so the buffer answers
+                    # "what happened to request X" for failures too
+                    trace.emit("request", t.t_submit, now - t.t_submit,
+                               trace=t.trace_id, span_id=f"{t.trace_id}:r",
+                               session=t.session, kind=t.kind,
+                               error=type(e).__name__)
                 t._event.set()
 
     def _batch_sids(self, batch: Lane) -> list[str]:
@@ -439,6 +568,7 @@ class ServingEngine:
         self.quarantined.add(sid)
         perf.add("serve_quarantines")
         log.error(f"session {sid!r} quarantined: {why}")
+        refused = None
         try:
             degrade.record(
                 "serve.quarantine", f"session:{sid}",
@@ -450,34 +580,63 @@ class ServingEngine:
                     "resume, tune PINT_TPU_SERVE_QUARANTINE_FAILS / "
                     "PINT_TPU_SERVE_WATCHDOG_S")
         except degrade.DegradedError as e:
-            return e
-        return None
+            refused = e
+        # quarantine is a crash-report trigger: the flight ring + the
+        # active spans (the hung dispatch is still open) + a metrics
+        # snapshot land beside the journal for the post-mortem
+        self._dump_crash(f"session {sid!r} quarantined: {why}")
+        return refused
 
-    def _note_failure(self, batch: Lane, e: BaseException) -> None:
+    def _note_failure(self, batch: Lane, e: BaseException) -> bool:
         """Account one exhausted (post-retry) dispatch failure; a lane
         failing ``quarantine_fails`` times in a row is crash-looping and
-        its session(s) are quarantined."""
+        its session(s) are quarantined. Returns True when a quarantine
+        fired (which already dumped a crash report)."""
+        quarantined = False
         for sid in self._batch_sids(batch):
             n = self._fail_counts.get(sid, 0) + 1
             self._fail_counts[sid] = n
             if n >= self.quarantine_fails and sid not in self.quarantined:
+                quarantined = True
                 refused = self._quarantine(
                     sid, f"{n} consecutive failed dispatches "
                          f"(last: {type(e).__name__}: {e})")
                 if refused is not None:
                     self._deliver_error(batch, refused)
+        return quarantined
 
     def _dispatch(self, batch: Lane) -> None:
         t_d = self._clock()
         for t in batch.tickets:
             t.t_dispatch = t_d
+        # trace propagation across the submit->worker thread hop: the
+        # batch's primary trace id is attached for the whole dispatch,
+        # so every span underneath (session append, TimedProgram
+        # compile/.aotx load) and every degradation the solve records is
+        # attributed to the request that triggered it
+        primary = next((t.trace_id for t in batch.tickets if t.trace_id),
+                       None)
+        with trace.attach(primary), \
+                trace.span("dispatch", lane=str(batch.key),
+                           tickets=len(batch.tickets), kind=batch.kind):
+            self._dispatch_inner(batch)
+
+    def _dispatch_inner(self, batch: Lane) -> None:
         if faults.trip("serve.crash", f"lane:{batch.key}") is not None:
             # the kill-mid-trace drill: the process dies with the batch
             # admitted + journaled but NOT applied — recovery must replay
             # it (tests/test_recover.py). os._exit skips every finally:
-            # exactly what a SIGKILL/OOM looks like to the journal.
+            # exactly what a SIGKILL/OOM looks like to the journal. The
+            # flight recorder dumps its ring first — a real OOM-killer
+            # gives no such grace, but every crash the process itself
+            # can see leaves a post-mortem beside the journal.
             log.error("serve.crash fault: exiting mid-dispatch")
+            self._dump_crash("serve.crash fault: killed mid-dispatch "
+                             f"(lane {batch.key})")
             os._exit(70)
+        flight.note("serve.dispatch", lane=str(batch.key),
+                    batch_kind=batch.kind, tickets=len(batch.tickets),
+                    trace=trace.current_trace_id())
         attempts = 1 + max(self.retries, 0)
         for attempt in range(attempts):
             self._inflight = (batch, self._clock(), self._worker_gen)
@@ -490,7 +649,14 @@ class ServingEngine:
                     # a hung device/lane: block until the watchdog has
                     # moved on without this worker (or a 5 s safety
                     # valve, so a watchdog-less engine cannot deadlock)
+                    gen0 = self._worker_gen
                     self._unhang.wait(5.0)
+                    if self._worker_gen != gen0:
+                        # the watchdog retired THIS worker mid-hang: its
+                        # tickets were already failed and the session
+                        # quarantined — applying the batch now would
+                        # land rows the client was told were NOT served
+                        return
                 if batch.kind == "append":
                     self._dispatch_append(batch)
                 else:
@@ -520,7 +686,14 @@ class ServingEngine:
                     self._sleep(self.retry_backoff_s * (2 ** attempt))
                     continue
                 self._deliver_error(batch, e)
-                self._note_failure(batch, e)
+                if not self._note_failure(batch, e):
+                    # an unhandled (post-retry) dispatch failure is a
+                    # crash-report trigger: the ring + active spans +
+                    # metrics explain what led up to it (a quarantine
+                    # above already dumped one for this failure)
+                    self._dump_crash(
+                        f"dispatch failed after {attempts} attempt(s) on "
+                        f"lane {batch.key}: {type(e).__name__}: {e}")
                 return
             except BaseException as e:  # noqa: BLE001 — delivered then re-raised to the caller  # jaxlint: disable=silent-except
                 self._deliver_error(batch, e)
@@ -550,17 +723,26 @@ class ServingEngine:
                 f"{(now - t.t_submit) * 1e3:.1f} ms queued (deadline "
                 f"{t.deadline}); shed instead of dispatched")
             try:
-                degrade.record(
-                    "serve.deadline", f"session:{t.session}",
-                    f"queued request from tenant {t.tenant!r} for session "
-                    f"{t.session!r} passed its deadline and was shed",
-                    bound_us=0.0,  # no stale answer served
-                    fix="raise the submit deadline_s / "
-                        "PINT_TPU_SERVE_DEADLINE_MS or add capacity")
+                # attached so the serve.deadline ledger event carries
+                # the expired request's trace id (joinable post-mortem)
+                with trace.attach(t.trace_id or None):
+                    degrade.record(
+                        "serve.deadline", f"session:{t.session}",
+                        f"queued request from tenant {t.tenant!r} for "
+                        f"session {t.session!r} passed its deadline and "
+                        "was shed",
+                        bound_us=0.0,  # no stale answer served
+                        fix="raise the submit deadline_s / "
+                            "PINT_TPU_SERVE_DEADLINE_MS or add capacity")
             except degrade.DegradedError as refusal:
                 err = refusal
             t.error = err
             t.t_done = now
+            if t.trace_id:
+                trace.emit("request", t.t_submit, now - t.t_submit,
+                           trace=t.trace_id, span_id=f"{t.trace_id}:r",
+                           session=t.session, kind=t.kind,
+                           error=type(err).__name__)
             t._event.set()
 
     def step(self, wait_s: float = 0.0) -> int:
@@ -672,11 +854,18 @@ class ServingEngine:
     def _watchdog_run(self) -> None:
         tick = max(min(self.watchdog_s / 4.0, 0.25), 0.01)
         while not self._watchdog_stop.wait(tick):
+            # the heartbeat is flight-recorder state: a crash report
+            # shows whether the watchdog was alive and what it saw
+            flight.note("watchdog.beat",
+                        inflight=self._inflight is not None,
+                        queued=self.scheduler.depth())
             self._watchdog_check()
 
     def start(self) -> None:
         """Spawn the resident worker thread (idempotent), plus the
-        watchdog thread when ``watchdog_s > 0``."""
+        watchdog thread when ``watchdog_s > 0``, the metrics endpoint
+        when a port is configured, and the SIGUSR1 crash-report hook
+        when the engine is durable."""
         if self._thread is not None and self._thread.is_alive():
             return
         self._stopping = False
@@ -692,6 +881,17 @@ class ServingEngine:
                 target=self._watchdog_run, name="pint-tpu-serve-watchdog",
                 daemon=True)
             self._watchdog.start()
+        # /metrics + /healthz: knob port > 0 serves there; an EXPLICIT
+        # metrics_port=0 binds an ephemeral port (tests/bench); the
+        # knob's 0 default stays off
+        want = self.metrics_port > 0 or (self._metrics_explicit
+                                         and self.metrics_port == 0)
+        if want and self.metrics_server is None:
+            self.metrics_server = metrics.MetricsServer(
+                port=self.metrics_port, health_fn=self.health)
+            self.metrics_port = self.metrics_server.start()
+        if self.crash_dir is not None:
+            flight.install_signal_handler(self.crash_dir)
 
     def checkpoint(self) -> list[str]:
         """Durably checkpoint the whole fleet into ``durable_dir`` and
@@ -738,6 +938,9 @@ class ServingEngine:
                 self.journal.close(clean=True)
         elif self.journal is not None:
             self.journal.fsync()       # crash-like stop: records survive
+        if self.metrics_server is not None:
+            self.metrics_server.stop()
+            self.metrics_server = None
 
     # -- telemetry -------------------------------------------------------------------
 
@@ -763,4 +966,6 @@ class ServingEngine:
             out["journal"] = self.journal.stats()
         if self.served and self.dispatches:
             out["coalesce_ratio"] = round(self.served / self.dispatches, 3)
+        if self.metrics_server is not None:
+            out["metrics_port"] = self.metrics_port
         return out

@@ -65,11 +65,13 @@ import os
 import shutil
 import struct
 import threading
+import time
 import zlib
 from pathlib import Path
 
 import numpy as np
 
+from pint_tpu.obs import flight, metrics as obs_metrics
 from pint_tpu.ops import degrade, perf
 from pint_tpu.testing import faults
 from pint_tpu.utils import knobs
@@ -197,8 +199,17 @@ class RequestJournal:
         self._fh.flush()
         self._unsynced += 1
         if self.fsync_every > 0 and self._unsynced >= self.fsync_every:
-            os.fsync(self._fh.fileno())
+            self._fsync_timed()
             self._unsynced = 0
+
+    def _fsync_timed(self) -> None:
+        """fsync with its latency exported: the WAL's durability tax is
+        a first-class SLO signal (the serve_journal_fsync_seconds
+        summary in the metrics registry)."""
+        t0 = time.perf_counter()
+        os.fsync(self._fh.fileno())
+        obs_metrics.observe("serve_journal_fsync_seconds",
+                            time.perf_counter() - t0)
 
     def append(self, rec: dict) -> int:
         """Durably append one ``request`` record; returns its seq number.
@@ -219,7 +230,7 @@ class RequestJournal:
         """Force the fsync a batched cadence may still owe."""
         with self._lock:
             self._fh.flush()
-            os.fsync(self._fh.fileno())
+            self._fsync_timed()
             self._unsynced = 0
 
     def mark_checkpoint(self, sids: list[str]) -> None:
@@ -233,7 +244,7 @@ class RequestJournal:
             self._write_record({"op": "checkpoint", "seq": self.seq,
                                 "sids": list(sids)})
             self._fh.flush()
-            os.fsync(self._fh.fileno())
+            self._fsync_timed()
             self._unsynced = 0
             self._fh.close()
             old = [p for p in _segments(self.dir)
@@ -243,6 +254,8 @@ class RequestJournal:
             for p in old:
                 p.unlink(missing_ok=True)
             perf.add("serve_journal_compactions")
+        flight.note("journal.checkpoint", seq=self.seq,
+                    compacted=len(old), sids=len(sids))
         log.info(f"journal checkpoint at seq {self.seq}: compacted "
                  f"{len(old)} segment(s), now in "
                  f"{self.active_segment.name}")

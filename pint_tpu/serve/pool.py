@@ -40,6 +40,7 @@ from dataclasses import dataclass, field
 
 import numpy as np
 
+from pint_tpu.obs import flight
 from pint_tpu.ops import degrade, perf
 from pint_tpu.serve.session import TimingSession
 from pint_tpu.testing import faults
@@ -206,6 +207,9 @@ class SessionPool:
             self.restores += 1
             self.restore_s += time.perf_counter() - t0
             perf.add("serve_restores")
+            flight.note("pool.restore", session=sid, n_toas=ck.n_toas,
+                        restore_ms=round(
+                            (time.perf_counter() - t0) * 1e3, 3))
             log.info(f"restored session {sid!r} from checkpoint "
                      f"({ck.n_toas} TOAs)")
             self.put(sid, session)

@@ -221,6 +221,10 @@ def recover_fleet(dirpath: str | Path, *, replay: bool = True,
             round(replayed / replay_s, 3) if replayed and replay_s > 0
             else None),
     }
+    from pint_tpu.obs import flight
+
+    flight.note("recover", dir=str(dirpath), sessions=len(checkpoints),
+                replayed=replayed, deduped=deduped, lost=lost)
     log.info(f"recovered fleet from {dirpath}: {len(checkpoints)} "
              f"session(s), {replayed} replayed, {deduped} deduped, "
              f"{lost} lost in {recovery_s:.2f}s")

@@ -36,6 +36,7 @@ from dataclasses import dataclass
 
 import numpy as np
 
+from pint_tpu.obs import trace
 from pint_tpu.ops import perf
 from pint_tpu.utils.logging import get_logger
 
@@ -228,7 +229,10 @@ class TimingSession:
         rep_cm = perf.collect() if collecting else None
         rep = rep_cm.__enter__() if rep_cm is not None else None
         try:
-            with perf.stage("incremental"):
+            # the span joins this append to the request trace the
+            # serving worker attached (a direct session.append outside
+            # the engine records with trace=None — still inspectable)
+            with trace.span("session.append"), perf.stage("incremental"):
                 with perf.stage("append"):
                     merged = self.toas.append(
                         lines, utc=utc, error_us=error_us,

@@ -75,6 +75,10 @@ KNOBS: dict[str, tuple[str | None, str]] = {
     "PINT_TPU_SERVE_QUARANTINE_FAILS": ("3", "consecutive failed dispatches after which a serving lane's session is quarantined (serve.quarantine)"),
     "PINT_TPU_SERVE_WATCHDOG_S": ("30", "serving watchdog threshold in s: a dispatch hung past it is abandoned, its session quarantined, the worker replaced; 0 disables"),
     "PINT_TPU_SERVE_JOURNAL_FSYNC": ("8", "write-ahead journal fsync batching: fsync every N records (1: every record, 0: only at rotation/close); records always flush to the OS before the ticket acks"),
+    # --- observability (pint_tpu/obs/) -----------------------------------------
+    "PINT_TPU_TRACE": ("0", "request tracing: 0 off (zero-cost), 1 on (spans as JSON Lines under <cache_root>/traces), any other value = the output directory"),
+    "PINT_TPU_METRICS_PORT": ("0", "serve the OpenMetrics endpoint (/metrics + /healthz, localhost) on this port when the engine starts; 0 disables"),
+    "PINT_TPU_FLIGHT_EVENTS": ("512", "flight-recorder ring size: recent structured events kept for crash reports; 0 disables"),
     # --- Bayesian noise engine (fitting/noise_like.py, sampler.py) -------------
     "PINT_TPU_NOISE_CHAINS": ("4", "vmapped noise-posterior chains per sample() call"),
     "PINT_TPU_NOISE_RESTARTS": ("8", "batched optimizer restarts for ML noise estimation"),

@@ -19,6 +19,13 @@ _configured = False
 # reloads) must NOT reset the suppression counts, or every re-init earns
 # the chatty messages another max_repeats round
 _dedup_counts: dict[str, int] = {}
+# how many records were actually DROPPED (per dedup key / log_once key):
+# also process-global — a handler re-init used to make these counts
+# unreachable (they lived implicitly in _dedup_counts arithmetic tied to
+# a filter instance's max_repeats); now every suppression is counted
+# here and exported as the `log_suppressed` metrics-registry counter
+# (pint_tpu/obs/metrics.py)
+_suppressed_counts: dict[str, int] = {}
 
 
 class DedupFilter(logging.Filter):
@@ -27,7 +34,9 @@ class DedupFilter(logging.Filter):
     Mirrors the behavior of the reference's LogFilter (pint/logging.py:125):
     chatty per-TOA warnings collapse to a single line. The counts are
     process-global (shared by every filter instance), so a re-created
-    handler keeps suppressing what the old one suppressed.
+    handler keeps suppressing what the old one suppressed — and the
+    suppression tally itself survives re-init and is visible through
+    :func:`suppressed_total`.
     """
 
     def __init__(self, max_repeats: int = 3):
@@ -41,7 +50,22 @@ class DedupFilter(logging.Filter):
         self._counts[key] = n + 1
         if n == self.max_repeats:
             record.msg = f"{record.msg} [further repeats suppressed]"
+        if n > self.max_repeats:
+            _suppressed_counts[key] = _suppressed_counts.get(key, 0) + 1
         return n <= self.max_repeats
+
+
+def suppressed_total() -> int:
+    """Log records dropped by the dedup filter + :func:`log_once`
+    repeats, process-wide — survives any number of handler re-inits
+    (``setup()`` calls) because the tally never lives on a filter
+    instance. Exported as the ``log_suppressed`` registry counter."""
+    return sum(_suppressed_counts.values())
+
+
+def suppressed_counts() -> dict[str, int]:
+    """Per-message suppression tallies (diagnostics surface)."""
+    return dict(_suppressed_counts)
 
 
 def setup(level: str = "INFO", sink=sys.stderr, dedup: bool = True) -> None:
@@ -87,6 +111,8 @@ def log_once(logger: logging.Logger, msg: str, level: int = logging.INFO) -> Non
     multichip dryrun), and one line carries all the information."""
     key = f"{logger.name}:{level}:{msg}"
     if key in _once_keys:
+        _suppressed_counts[f"once:{key}"] = \
+            _suppressed_counts.get(f"once:{key}", 0) + 1
         return
     _once_keys.add(key)
     logger.log(level, msg)

@@ -133,6 +133,36 @@ def test_performance_walkthrough_runs(tmp_path, monkeypatch):
         pcompile.setup_persistent_cache(force=True)
 
 
+def test_observability_walkthrough_runs(tmp_path, monkeypatch):
+    """docs/OBSERVABILITY.md is executable WITHOUT reference data and
+    with no network beyond localhost (the /metrics scrape) and runs in
+    tier-1: the trace/metrics/flight-recorder walkthrough an operator
+    copies from must keep working verbatim."""
+    blocks = extract_blocks(DOCS / "OBSERVABILITY.md")
+    assert len(blocks) >= 5, "OBSERVABILITY.md lost its executable blocks"
+    monkeypatch.chdir(tmp_path)
+    monkeypatch.delenv("PINT_TPU_TRACE", raising=False)
+    monkeypatch.delenv("PINT_TPU_DEGRADED", raising=False)
+    from pint_tpu.obs import flight, trace
+    from pint_tpu.ops.degrade import reset_ledger
+
+    ns: dict = {}
+    try:
+        for i, block in enumerate(blocks):
+            try:
+                exec(compile(block, f"OBSERVABILITY.md[block {i}]",
+                             "exec"), ns)
+            except Exception as e:
+                pytest.fail(
+                    f"OBSERVABILITY.md block {i} failed: "
+                    f"{type(e).__name__}: {e}\n{block}")
+    finally:
+        trace.configure()
+        trace.reset()
+        flight.reset_recorder()
+        reset_ledger()
+
+
 def test_analysis_walkthrough_runs(tmp_path, monkeypatch):
     """docs/ANALYSIS.md is executable WITHOUT reference data (synthetic
     TOAs only) and runs in tier-1: the auditor walkthrough a user copies

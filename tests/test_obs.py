@@ -1,0 +1,615 @@
+"""The observability layer (pint_tpu/obs/) — ISSUE 15.
+
+Locks, bottom to top:
+
+- ``trace``: zero-cost when off, nested span parentage, cross-thread
+  attach, bounded JSONL buffer, per-request coverage computation.
+- ``metrics``: OpenMetrics render/parse round-trip, the perf.add feed
+  (counters export without a collecting perf report), the degrade
+  observer feed, the **no-orphan gate** (every ``serve_*``/
+  ``incremental_*`` perf.add call site in the source must be in the
+  export inventory), ``log_suppressed`` surviving handler re-init.
+- ``flight``: ring bound (PINT_TPU_FLIGHT_EVENTS), degrade events in
+  the ring, crash-report completeness (events + active spans + metrics
+  snapshot), SIGUSR1 dump, the post-mortem summary.
+- QuantileSketch: merged ≡ pooled-sample quantiles within the 2% bound,
+  dict round-trip (the cross-process path).
+- Engine integration: trace ids on tickets + journal records, >=90%
+  per-request span coverage, compile-span attribution, /metrics +
+  /healthz endpoint, quarantine -> crash report -> `pint_tpu recover`
+  post-mortem, `pint_tpu status --json` smoke.
+"""
+
+import json
+import os
+import re
+import signal
+import threading
+import time
+import urllib.request
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from pint_tpu.astro import time as ptime
+from pint_tpu.fitting.wls import apply_delta
+from pint_tpu.io.par import parse_parfile
+from pint_tpu.models.builder import build_model
+from pint_tpu.obs import flight, metrics, trace
+from pint_tpu.ops import degrade, perf
+from pint_tpu.serve import ServingEngine, SessionPool, TimingSession
+from pint_tpu.simulation import make_fake_toas_uniform
+from pint_tpu.testing import faults
+from pint_tpu.utils import logging as plog
+
+PAR = """
+PSR OBSTEST
+RAJ 04:37:15.9 1
+DECJ -47:15:09.1 1
+F0 173.6879489990983 1
+F1 -1.728e-15 1
+PEPOCH 55000
+POSEPOCH 55000
+DM 2.64 1
+TZRMJD 55000.1
+TZRSITE gbt
+TZRFRQ 1400
+"""
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    degrade.reset_ledger()
+    faults.reset()
+    trace.configure()
+    trace.reset()
+    flight.reset_recorder()
+    yield
+    degrade.reset_ledger()
+    faults.reset()
+    trace.configure()
+    trace.reset()
+    flight.reset_recorder()
+
+
+@pytest.fixture(scope="module")
+def _module_cache_dir(tmp_path_factory):
+    return tmp_path_factory.mktemp("obs_cache")
+
+
+@pytest.fixture(autouse=True)
+def _isolated_cache(_module_cache_dir, monkeypatch):
+    monkeypatch.setenv("PINT_TPU_CACHE_DIR", str(_module_cache_dir))
+    yield
+
+
+def _dataset(N, seed=11):
+    model = build_model(parse_parfile(PAR, from_text=True))
+    freqs = np.where(np.arange(N) % 2 == 0, 1400.0, 2300.0)
+    toas = make_fake_toas_uniform(
+        54500, 55500, N, model, obs="gbt", freq_mhz=freqs, error_us=1.0,
+        add_noise=True, rng=np.random.default_rng(seed))
+    free = tuple(model.free_params)
+    delta = np.array([2e-10 if nm == "F0" else 0.0 for nm in free])
+    model.params = apply_delta(model.params, free, delta)
+    return model, toas
+
+
+def _rows(full, lo, hi):
+    ep = full.utc_raw
+    return dict(
+        utc=ptime.MJDEpoch(ep.day[lo:hi], ep.frac_hi[lo:hi],
+                           ep.frac_lo[lo:hi]),
+        error_us=full.error_us[lo:hi], freq_mhz=full.freq_mhz[lo:hi],
+        obs=full.obs[lo:hi], flags=[dict(f) for f in full.flags[lo:hi]],
+    )
+
+
+def _session(n=96, extra=48, seed=11):
+    model, full = _dataset(n + extra, seed=seed)
+    base = full.select(np.arange(len(full)) < n)
+    ses = TimingSession(base, model)
+    ses.fit()
+    return model, full, ses, n
+
+
+# --- tracing -----------------------------------------------------------------------
+
+
+class TestTrace:
+    def test_zero_cost_when_off(self):
+        assert not trace.enabled()
+        s = trace.span("anything")
+        assert s is trace._NULL                  # the shared no-op object
+        with s:
+            pass
+        trace.emit("request", 0.0, 1.0, trace="t")
+        assert trace.records() == []             # emit was a boolean check
+
+    def test_span_nesting_parents_and_file(self, tmp_path):
+        trace.configure(enable=True, dir=tmp_path)
+        with trace.attach("t1"):
+            with trace.span("outer", lane="x"):
+                with trace.span("inner"):
+                    pass
+        recs = trace.records()
+        assert [r["name"] for r in recs] == ["inner", "outer"]
+        inner, outer = recs
+        assert inner["trace"] == outer["trace"] == "t1"
+        assert inner["parent"] == outer["span"]
+        assert outer["parent"] is None
+        assert outer["lane"] == "x"
+        assert inner["dur_ms"] <= outer["dur_ms"]
+        # the JSONL buffer holds the same records
+        files = list(Path(tmp_path).glob("trace-*.jsonl"))
+        assert len(files) == 1
+        on_disk = trace.read_trace_file(files[0])
+        assert on_disk == recs
+
+    def test_attach_propagates_to_thread_spans(self):
+        trace.configure(enable=True)
+        seen = []
+
+        def worker():
+            with trace.attach("req42"):
+                with trace.span("dispatch"):
+                    seen.append(trace.current_trace_id())
+
+        th = threading.Thread(target=worker)
+        th.start()
+        th.join()
+        assert seen == ["req42"]
+        assert trace.records()[-1]["trace"] == "req42"
+        # the attach never leaked into this thread
+        assert trace.current_trace_id() is None
+
+    def test_coverage_contract(self):
+        trace.configure(enable=True)
+        # a fully-covered request
+        trace.emit("request", 0.0, 1.0, trace="a", span_id="a:r")
+        trace.emit("admit", 0.0, 0.1, trace="a", parent="a:r")
+        trace.emit("queue", 0.1, 0.4, trace="a", parent="a:r")
+        trace.emit("solve", 0.5, 0.5, trace="a", parent="a:r")
+        # an under-attributed one
+        trace.emit("request", 0.0, 1.0, trace="b", span_id="b:r")
+        trace.emit("solve", 0.0, 0.2, trace="b", parent="b:r")
+        # an errored one: excluded from the coverage contract
+        trace.emit("request", 0.0, 1.0, trace="c", span_id="c:r",
+                   error="ShedError")
+        cov = trace.coverage()
+        assert cov["a"] == pytest.approx(1.0)
+        assert cov["b"] == pytest.approx(0.2)
+        assert "c" not in cov
+        summ = trace.coverage_summary()
+        assert summ["requests_traced"] == 2
+        assert summ["coverage_min"] == pytest.approx(0.2)
+
+    def test_active_spans_visible_while_open(self):
+        trace.configure(enable=True)
+        entered, release = threading.Event(), threading.Event()
+
+        def worker():
+            with trace.attach("hung"), trace.span("dispatch"):
+                entered.set()
+                release.wait(5.0)
+
+        th = threading.Thread(target=worker)
+        th.start()
+        try:
+            assert entered.wait(5.0)
+            live = trace.active_spans()
+            assert any(s["name"] == "dispatch" and s["trace"] == "hung"
+                       and s["open_ms"] >= 0.0 for s in live)
+        finally:
+            release.set()
+            th.join()
+        assert trace.active_spans() == []
+
+
+# --- metrics -----------------------------------------------------------------------
+
+
+class TestMetrics:
+    def test_render_parses_and_carries_values(self):
+        metrics.reset_registry()
+        reg = metrics.registry()
+        reg.counter("serve_requests", "x")       # pre-registered anyway
+        reg.feed("serve_requests", 3)
+        reg.gauge("obs_test_gauge", "live state", fn=lambda: 7.5)
+        reg.summary("obs_test_ms", "latencies").observe(12.0)
+        text = reg.render()
+        samples, families = metrics.parse_openmetrics(text)
+        assert samples["pint_tpu_serve_requests_total"] == 3.0
+        assert samples["pint_tpu_obs_test_gauge"] == 7.5
+        assert samples["pint_tpu_obs_test_ms_count"] == 1.0
+        assert 'pint_tpu_obs_test_ms{quantile="0.5"}' in samples
+        assert "pint_tpu_serve_requests" in families
+        with pytest.raises(ValueError, match="EOF"):
+            metrics.parse_openmetrics(text.replace("# EOF\n", ""))
+        with pytest.raises(ValueError, match="malformed"):
+            metrics.parse_openmetrics("!!!\n# EOF")
+
+    def test_perf_add_feeds_without_collecting(self):
+        """The production shape: /metrics counts serve traffic even
+        when no perf report is collecting (PINT_TPU_PERF off)."""
+        metrics.reset_registry()
+        reg = metrics.registry()
+        assert not perf.active()
+        perf.add("serve_requests", 2)
+        perf.add("incremental_refits")
+        perf.add("not_a_registered_counter", 99)  # ignored, not exported
+        samples, _ = metrics.parse_openmetrics(reg.render())
+        assert samples["pint_tpu_serve_requests_total"] == 2.0
+        assert samples["pint_tpu_incremental_refits_total"] == 1.0
+        assert not any("not_a_registered" in k for k in samples)
+
+    def test_degrade_ledger_feeds_labeled_counter(self, monkeypatch):
+        metrics.reset_registry()
+        reg = metrics.registry()
+        monkeypatch.setenv("PINT_TPU_DEGRADED", "0")
+        degrade.record("serve.shed", "t", "x")
+        degrade.record("serve.shed", "t", "x")
+        degrade.record("serve.evict", "s", "y")
+        samples, _ = metrics.parse_openmetrics(reg.render())
+        assert samples['pint_tpu_degradations_total{kind="serve.shed"}'] == 2.0
+        assert samples['pint_tpu_degradations_total{kind="serve.evict"}'] == 1.0
+
+    def test_no_orphan_metrics_gate(self, monkeypatch):
+        """Every serve_*/incremental_* perf counter bumped anywhere in
+        the source, and every degradation kind in the taxonomy, must be
+        registered for export — a new signal cannot silently bypass
+        /metrics."""
+        import pint_tpu
+
+        pkg = Path(pint_tpu.__file__).parent
+        pat = re.compile(
+            r'perf\.add\(\s*"((?:serve|incremental)_[a-z_]+)"')
+        bumped = set()
+        for p in pkg.rglob("*.py"):
+            bumped |= set(pat.findall(p.read_text()))
+        assert bumped, "source walk found no serve/incremental counters"
+        # the breakdown tuples are part of the same contract
+        bumped |= set(perf.SERVE_COUNTERS) | set(perf.INCR_COUNTERS)
+        missing = bumped - set(metrics.COUNTER_HELP)
+        assert not missing, (
+            f"perf counters not registered for metrics export: {missing} "
+            "— add them to pint_tpu.obs.metrics.COUNTER_HELP")
+        # every registered counter is in the registry
+        metrics.reset_registry()
+        reg = metrics.registry()
+        for name in bumped:
+            assert isinstance(reg.get(name), metrics.Counter), name
+        # every degradation kind exports through the labeled counter
+        monkeypatch.setenv("PINT_TPU_DEGRADED", "0")
+        for kind in degrade.KINDS:
+            degrade.record(kind, "orphan-gate", "drill")
+        samples, _ = metrics.parse_openmetrics(reg.render())
+        for kind in degrade.KINDS:
+            assert f'pint_tpu_degradations_total{{kind="{kind}"}}' \
+                in samples, kind
+
+    def test_log_suppressed_survives_handler_reinit(self):
+        """The ISSUE-15 satellite: suppression counts are process-global
+        and exported — a mid-process setup() (handler re-init) neither
+        resets them nor hides further suppressions."""
+        metrics.reset_registry()
+        reg = metrics.registry()
+        lg = plog.get_logger("pint_tpu.obs_suppress_test")
+        base = plog.suppressed_total()
+        for _ in range(8):
+            lg.warning("obs dedup drill message")
+        grew = plog.suppressed_total() - base
+        assert grew >= 3                       # 8 sends, 4 pass the filter
+        plog.setup()                           # handler re-init mid-process
+        for _ in range(5):
+            lg.warning("obs dedup drill message")
+        assert plog.suppressed_total() - base >= grew + 5
+        # log_once repeats count too
+        plog.log_once(lg, "obs once drill")
+        plog.log_once(lg, "obs once drill")
+        assert plog.suppressed_total() - base >= grew + 6
+        samples, _ = metrics.parse_openmetrics(reg.render())
+        assert samples["pint_tpu_log_suppressed_total"] == \
+            plog.suppressed_total()
+
+
+# --- the sketch merge (cross-process percentiles) ----------------------------------
+
+
+class TestSketchMerge:
+    def test_merged_equals_pooled_within_bound(self):
+        """ISSUE-15 satellite: merging per-engine sketches reproduces
+        the pooled-sample quantiles within the sketch's 2% relative
+        bound — the fleet headline percentile is trustworthy."""
+        rng = np.random.default_rng(7)
+        a = np.exp(rng.normal(3.0, 1.0, 5000))
+        b = np.exp(rng.normal(4.0, 0.8, 3000))
+        sa, sb = perf.QuantileSketch(), perf.QuantileSketch()
+        for v in a:
+            sa.add(v)
+        for v in b:
+            sb.add(v)
+        merged = perf.QuantileSketch.from_dict(sa.to_dict())  # x-process
+        merged.merge(sb)
+        pooled = np.concatenate([a, b])
+        assert merged.count == pooled.size
+        for q in (0.5, 0.9, 0.99):
+            exact = float(np.percentile(pooled, q * 100))
+            assert abs(merged.quantile(q) - exact) <= 0.021 * exact, q
+
+    def test_dict_round_trip_exact(self):
+        sk = perf.QuantileSketch()
+        for v in (0.5, 3.0, 3.0, 250.0, 1e4):
+            sk.add(v)
+        d = json.loads(json.dumps(sk.to_dict()))   # through JSON, as on disk
+        rt = perf.QuantileSketch.from_dict(d)
+        assert rt.count == sk.count
+        for q in (0.0, 0.25, 0.5, 0.99, 1.0):
+            assert rt.quantile(q) == sk.quantile(q)
+        other = perf.QuantileSketch()
+        other.add(42.0)
+        rt.merge(other)                            # grids stay compatible
+        assert rt.count == sk.count + 1
+
+
+# --- the flight recorder -----------------------------------------------------------
+
+
+class TestFlight:
+    def test_ring_bounded_by_knob(self, monkeypatch):
+        monkeypatch.setenv("PINT_TPU_FLIGHT_EVENTS", "8")
+        flight.reset_recorder()
+        for i in range(20):
+            flight.note("tick", i=i)
+        rec = flight.recorder()
+        assert len(rec) == 8
+        assert rec.total == 20
+        snap = rec.snapshot()
+        assert [e["i"] for e in snap] == list(range(12, 20))
+        assert all(e["kind"] == "tick" and "t_mono" in e for e in snap)
+        monkeypatch.setenv("PINT_TPU_FLIGHT_EVENTS", "0")
+        flight.reset_recorder()
+        flight.note("dropped")
+        assert len(flight.recorder()) == 0         # disabled
+
+    def test_degrade_events_land_in_ring_with_trace(self, monkeypatch):
+        monkeypatch.setenv("PINT_TPU_DEGRADED", "0")
+        trace.configure(enable=True)
+        with trace.attach("reqX"):
+            degrade.record("serve.shed", "t", "overload")
+        evs = [e for e in flight.recorder().snapshot()
+               if e["kind"] == "degrade"]
+        assert evs and evs[-1]["degrade_kind"] == "serve.shed"
+        assert evs[-1]["trace"] == "reqX"
+
+    def test_crash_report_complete_and_summarized(self, tmp_path,
+                                                  monkeypatch):
+        monkeypatch.setenv("PINT_TPU_DEGRADED", "0")
+        trace.configure(enable=True)
+        metrics.registry()
+        degrade.record("serve.quarantine", "session:a", "hung lane")
+        flight.note("serve.dispatch", lane="x", tickets=2)
+        entered, release = threading.Event(), threading.Event()
+
+        def worker():                    # a dispatch still in flight
+            with trace.attach("hung"), trace.span("dispatch"):
+                entered.set()
+                release.wait(5.0)
+
+        th = threading.Thread(target=worker)
+        th.start()
+        try:
+            assert entered.wait(5.0)
+            path = flight.dump_crash_report(tmp_path / "crash",
+                                            "watchdog drill")
+        finally:
+            release.set()
+            th.join()
+        assert path is not None and path.exists()
+        rep = json.loads(path.read_text())
+        assert rep["reason"] == "watchdog drill"
+        kinds = [e["kind"] for e in rep["events"]]
+        assert "degrade" in kinds and "serve.dispatch" in kinds
+        assert any(s["name"] == "dispatch" for s in rep["active_spans"])
+        # the metrics snapshot inside the report is valid OpenMetrics
+        metrics.parse_openmetrics(rep["metrics"])
+        assert "serve.quarantine" in rep["degradations"]["kinds"]
+        assert flight.latest_report(tmp_path) == path
+        summary = flight.summarize_crash_report(path)
+        assert "watchdog drill" in summary
+        assert "dispatch" in summary
+        assert "serve.quarantine" in summary
+
+    def test_sigusr1_dumps_report(self, tmp_path):
+        prev = signal.getsignal(signal.SIGUSR1)
+        try:
+            assert flight.install_signal_handler(tmp_path / "crash")
+            flight.note("before.signal")
+            os.kill(os.getpid(), signal.SIGUSR1)
+            deadline = time.time() + 5.0
+            while (flight.latest_report(tmp_path) is None
+                   and time.time() < deadline):
+                time.sleep(0.02)
+            path = flight.latest_report(tmp_path)
+            assert path is not None
+            rep = json.loads(path.read_text())
+            assert "operator request" in rep["reason"]
+        finally:
+            signal.signal(signal.SIGUSR1, prev)
+
+
+# --- degrade joinability (ISSUE-15 satellite) --------------------------------------
+
+
+class TestDegradeJoinability:
+    def test_events_carry_monotonic_time_and_trace(self, monkeypatch):
+        monkeypatch.setenv("PINT_TPU_DEGRADED", "0")
+        trace.configure(enable=True)
+        t0 = time.monotonic()
+        degrade.record("serve.shed", "a", "first")
+        with trace.attach("reqJ"):
+            degrade.record("serve.evict", "b", "second")
+        e1, e2 = degrade.events()
+        assert t0 <= e1.t_mono <= e2.t_mono <= time.monotonic()
+        assert e1.trace_id is None and e2.trace_id == "reqJ"
+        # repeats refresh the timestamp, keep the ordering, keep a trace
+        degrade.record("serve.shed", "a", "again")
+        e1b = degrade.events()[0]
+        assert e1b.count == 2 and e1b.t_mono >= e2.t_mono
+        blk = degrade.degradation_block()
+        assert blk["events"][0]["t_mono"] == e1b.t_mono
+        assert blk["events"][1]["trace"] == "reqJ"
+        assert [e["kind"] for e in blk["events"]] == [
+            "serve.shed", "serve.evict"]          # ordering preserved
+
+
+# --- engine integration ------------------------------------------------------------
+
+
+class TestEngineObservability:
+    def test_request_tracing_end_to_end(self, tmp_path):
+        """Submit -> ticket.trace_id -> journal record -> >=90% span
+        coverage per request, with the dispatch-side spans on the same
+        trace."""
+        from pint_tpu.serve.journal import replay_records
+
+        trace.configure(enable=True, dir=tmp_path / "traces")
+        model, full, ses, n = _session(seed=21)
+        engine = ServingEngine(SessionPool(capacity=4), max_wait_ms=20.0,
+                               durable_dir=tmp_path / "dur")
+        engine.add_session("a", ses)
+        tickets = [engine.submit(session="a", tenant="c",
+                                 **_rows(full, n + 2 * j, n + 2 * j + 2))
+                   for j in range(4)]
+        engine.run_until_idle()
+        for t in tickets:
+            t.wait(timeout=5.0)
+            assert re.fullmatch(r"[0-9a-f]{16}", t.trace_id)
+        assert len({t.trace_id for t in tickets}) == 4
+        # the journal records carry the same trace ids (joinable)
+        engine.journal.fsync()
+        records, _ = replay_records(tmp_path / "dur" / "journal")
+        journaled = {r["trace"] for r in records if r["op"] == "request"}
+        assert journaled == {t.trace_id for t in tickets}
+        # the per-request attribution contract
+        cov = trace.coverage()
+        for t in tickets:
+            assert cov[t.trace_id] >= 0.9, (t.trace_id, cov)
+        # dispatch-side spans joined the request traces
+        recs = trace.records()
+        dispatch_traces = {r["trace"] for r in recs
+                           if r["name"] == "dispatch"}
+        assert dispatch_traces <= {t.trace_id for t in tickets}
+        assert any(r["name"] == "session.append"
+                   and r["trace"] in journaled for r in recs)
+        engine.stop(drain=False)
+
+    def test_compile_spans_attributed_to_request(self):
+        """A TimedProgram compile triggered under an attached trace
+        records a compile:<label> span on THAT trace (and a flight
+        event) — the operator sees which request paid for the compile."""
+        import jax
+
+        from pint_tpu.ops.compile import TimedProgram
+
+        trace.configure(enable=True)
+        prog = TimedProgram(jax.jit(lambda x: x + 1.0),
+                            "obs_compile_probe", canonical=False)
+        with perf.collect():
+            with trace.attach("reqC"):
+                prog(np.arange(3.0))
+        recs = [r for r in trace.records()
+                if r["name"] == "compile:obs_compile_probe"]
+        assert recs and recs[0]["trace"] == "reqC"
+        evs = [e for e in flight.recorder().snapshot()
+               if e["kind"] == "compile"
+               and e["label"] == "obs_compile_probe"]
+        assert evs and evs[0]["trace"] == "reqC"
+
+    def test_metrics_endpoint_and_healthz(self):
+        model, full, ses, n = _session(seed=23)
+        metrics.reset_registry()
+        engine = ServingEngine(SessionPool(capacity=4), max_wait_ms=20.0,
+                               metrics_port=0)
+        engine.add_session("a", ses)
+        engine.start()
+        try:
+            assert engine.metrics_port > 0
+            t = engine.submit(session="a", tenant="c",
+                              **_rows(full, n, n + 2))
+            t.wait(timeout=30.0)
+            base = f"http://127.0.0.1:{engine.metrics_port}"
+            with urllib.request.urlopen(base + "/metrics", timeout=5) as r:
+                assert "openmetrics" in r.headers["Content-Type"]
+                text = r.read().decode()
+            samples, families = metrics.parse_openmetrics(text)
+            assert samples["pint_tpu_serve_requests_total"] >= 1
+            assert samples["pint_tpu_serve_appends_total"] >= 1
+            assert "pint_tpu_serve_queue_depth" in samples
+            assert "pint_tpu_serve_pool_live" in samples
+            assert samples["pint_tpu_serve_latency_ms_count"] >= 1
+            with urllib.request.urlopen(base + "/healthz", timeout=5) as r:
+                health = json.loads(r.read().decode())
+            assert health["ok"] is True
+            assert health["worker_alive"] is True
+            assert health["queued"] == 0
+            assert health["pool"]["live"] == 1
+        finally:
+            engine.stop()
+        assert engine.metrics_server is None       # shut down with the engine
+
+    def test_quarantine_writes_crash_report_recover_summarizes(
+            self, tmp_path, capsys, monkeypatch):
+        """The failure-path contract end to end: a crash-looping lane is
+        quarantined -> a complete crash report lands beside the journal
+        -> `pint_tpu recover` restores the fleet AND prints the
+        post-mortem (requests_lost == 0: the failed append was journaled
+        and replays)."""
+        from pint_tpu.scripts.recover import main as recover_main
+
+        trace.configure(enable=True, dir=tmp_path / "traces")
+        model, full, ses, n = _session(seed=29)
+        engine = ServingEngine(SessionPool(capacity=4), max_wait_ms=20.0,
+                               durable_dir=tmp_path, retries=0,
+                               quarantine_fails=1)
+        engine.add_session("a", ses)
+        engine.checkpoint()
+        faults.arm("serve.dispatch", "fail", times=1)
+        t = engine.submit(session="a", tenant="c", **_rows(full, n, n + 2))
+        engine.run_until_idle()
+        with pytest.raises(RuntimeError, match="injected dispatch"):
+            t.wait(timeout=5.0)
+        assert engine.quarantined == {"a"}
+        engine.stop(drain=False)
+        path = flight.latest_report(tmp_path)
+        assert path is not None
+        rep = json.loads(path.read_text())
+        assert "quarantined" in rep["reason"]
+        assert rep["events"] and rep["metrics"]
+        assert rep["engine"]["quarantined"] == ["a"]
+
+        rc = recover_main(["--dir", str(tmp_path), "--json"])
+        out = capsys.readouterr()
+        assert rc == 0
+        report = json.loads(out.out.strip().splitlines()[0])
+        assert report["requests_lost"] == 0
+        assert report["replayed"] == 1             # the failed append landed
+        assert report["crash_report"] == str(path)
+        assert "quarantined" in out.err            # the printed post-mortem
+        assert "crash report" in out.err
+
+    def test_status_cli_smoke(self, capsys):
+        from pint_tpu.scripts.cli import main as cli_main
+
+        metrics.reset_registry()
+        perf.add("serve_requests", 5)
+        rc = cli_main(["status", "--json"])
+        out = capsys.readouterr().out
+        assert rc == 0
+        snap = json.loads(out)
+        assert snap["metric"] == "status" and snap["mode"] == "process"
+        samples, _ = metrics.parse_openmetrics(snap["openmetrics"])
+        assert samples["pint_tpu_serve_requests_total"] == 5.0
+        assert "degradations" in snap and "aot" in snap
+        assert isinstance(snap["metrics_families"], int)

@@ -332,9 +332,13 @@ class TestTimingService:
 class TestConcurrentSubmit:
     """ISSUE 13 satellite: `TimingService.submit` from many threads —
     no lost or duplicated requests, deterministic coalescing (merged
-    rows follow queue order exactly), and the batched ≡ sequential
-    ≤1e-10 parity lock holds for whatever interleaving the threads
-    produced."""
+    rows follow queue order exactly), and the ≤1e-10 parity lock for
+    the SAME partition drain() used, under whatever interleaving the
+    threads produced. (Cross-partition agreement — one merged append vs
+    one-at-a-time — is only bounded by the LM convergence tolerance and
+    varies with the interleaving, so the partition is the contract;
+    the fixed-order sequential comparison lives in
+    TestTimingService::test_batched_equals_sequential.)"""
 
     N_THREADS, PER_THREAD, K = 4, 4, 1
 
@@ -393,12 +397,19 @@ class TestConcurrentSubmit:
             assert len(out[sid]) == per_sid[sid]
             assert len(fleet[sid].toas) == n + per_sid[sid] * self.K
 
-        # sequential twin: the SAME captured interleaving served one
-        # request at a time
+        # the twin replays the SAME partition drain() used: each
+        # session's captured requests coalesce into ONE append in queue
+        # order and are served on the raw session surface — so the
+        # parity below locks the serving machinery (queueing, coalesce,
+        # drain bookkeeping) deterministically, independent of which
+        # interleaving the threads happened to produce
+        from pint_tpu.serve.session import coalesce_append_payloads
+
+        by_sid: dict = {}
         for r in order:
-            twin[r["session"]].append(
-                utc=r["utc"], error_us=r["error_us"],
-                freq_mhz=r["freq_mhz"], obs=r["obs"], flags=r["flags"])
+            by_sid.setdefault(r["session"], []).append(r)
+        for sid, reqs in by_sid.items():
+            twin[sid].append(**coalesce_append_payloads(reqs))
 
         free = tuple(model.free_params)
         for sid in ("a", "b"):
@@ -408,7 +419,7 @@ class TestConcurrentSubmit:
                                           twin[sid].toas.utc_raw.day)
             np.testing.assert_array_equal(fleet[sid].toas.utc_raw.frac_hi,
                                           twin[sid].toas.utc_raw.frac_hi)
-            # coalesced ≡ sequential ≤1e-10 under the interleaved order
+            # drained ≡ the same merged append served directly, ≤1e-10
             for nm in free:
                 a = float(np.asarray(leaf_to_f64(
                     fleet[sid].fitter.model.params[nm])))
