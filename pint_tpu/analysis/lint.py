@@ -47,6 +47,19 @@ Rules
     members. Files listed in the ``dd-accessors`` config (default: the
     dd module itself) are exempt; a justified hi-only read carries an
     inline suppression.
+``blocking-in-gateway``
+    A synchronous engine/fit call reachable from an HTTP handler scope
+    in a gateway file (the ``gateway-files`` config, default
+    pint_tpu/serve/gateway.py). Handler scopes are the ``do_*`` methods
+    http.server dispatches into, every def lexically nested in one, and
+    — one resolution step — same-module functions a handler calls by
+    name. The gateway's handler threads must never block on timing
+    work: hand it to the engine with ``submit`` and poll the ticket.
+    Flagged call names: ``fit`` / ``fit_toas`` / ``batch_refit`` /
+    ``run_until_idle`` / ``recover_fleet`` / ``drain``, plus ``append``
+    on a session-like receiver (``ses``/``session`` in the receiver
+    expression — ``TimingSession.append`` refits synchronously;
+    ``list.append`` is fine and not flagged).
 
 Reachability is deliberately *lexical and conservative*: a function is
 jit-reachable when it (or an enclosing function) is passed by name or as
@@ -75,7 +88,7 @@ from dataclasses import dataclass, field
 __all__ = ["Finding", "lint_file", "lint_paths", "load_config", "main", "RULES"]
 
 RULES = ("env-read", "np-in-jit", "tracer-if", "host-sync-in-loop",
-         "silent-except", "dd-truncate")
+         "silent-except", "dd-truncate", "blocking-in-gateway")
 
 #: call targets whose function arguments become jit-reachable
 _JIT_WRAPPERS = {"jit", "precision_jit", "pjit", "TimedProgram", "vmap",
@@ -87,6 +100,11 @@ _LOOP_WRAPPERS = {"while_loop", "scan", "cond", "fori_loop", "map",
 #: np.* attribute names that are metadata/dtype helpers, not array math
 _NP_SAFE = {"float32", "float64", "int32", "int64", "bool_", "dtype",
             "shape", "ndim", "result_type", "finfo", "iinfo", "newaxis"}
+#: call names that block a gateway handler thread on timing work
+#: (``append`` is special-cased to session-like receivers)
+_GATEWAY_BLOCKING = {"fit", "fit_toas", "batch_refit", "run_until_idle",
+                     "recover_fleet", "drain"}
+_SESSIONISH_RE = re.compile(r"\b(ses|sess|session)\b", re.I)
 
 _SUPPRESS_RE = re.compile(r"#\s*jaxlint:\s*disable=([\w,-]+)")
 _SKIP_FILE_RE = re.compile(r"#\s*jaxlint:\s*skip-file")
@@ -111,6 +129,7 @@ class _Scope:
     parent: "_Scope | None"
     jitted: bool = False
     loop_body: bool = False
+    gateway: bool = False  # reachable from a do_* HTTP handler
     defs: dict = field(default_factory=dict)  # name -> _Scope of local def
 
     @property
@@ -230,6 +249,38 @@ def _mark_nested(scope: _Scope):
         _mark_nested(child)
 
 
+def _close_gateway(scope: _Scope):
+    for child in scope.defs.values():
+        child.gateway = True
+        _close_gateway(child)
+
+
+def _mark_gateway(scopes: _ScopeBuilder):
+    """Mark HTTP handler scopes in a gateway file: the ``do_*`` methods
+    http.server dispatches into, every def lexically nested in one, and
+    — one resolution step, same module — functions a handler calls by
+    (attribute) name. Class bodies are transparent in the scope tree, so
+    a handler's ``self._submit(...)`` resolves to the method def
+    registered in the enclosing scope."""
+    for node, scope in scopes.by_node.items():
+        if getattr(node, "name", "").startswith("do_"):
+            scope.gateway = True
+            _close_gateway(scope)
+    called: list[_Scope] = []
+    for node, scope in scopes.by_node.items():
+        if not scope.gateway:
+            continue
+        for call in ast.walk(scope.node):
+            if isinstance(call, ast.Call):
+                name = _fn_name(call.func)
+                target = _resolve(scope, name) if name else None
+                if target is not None and not target.gateway:
+                    called.append(target)
+    for target in called:
+        target.gateway = True
+        _close_gateway(target)
+
+
 def _names_in(node: ast.AST) -> set[str]:
     return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
 
@@ -247,12 +298,13 @@ class _RuleChecker(ast.NodeVisitor):
     """Third pass: emit findings inside marked scopes."""
 
     def __init__(self, path, scopes: _ScopeBuilder, select, registry: bool,
-                 dd_accessor: bool = False):
+                 dd_accessor: bool = False, gateway_file: bool = False):
         self.path = path
         self.scopes = scopes
         self.select = select
         self.registry = registry  # file IS the env registry (env-read exempt)
         self.dd_accessor = dd_accessor  # file IS a sanctioned dd accessor
+        self.gateway_file = gateway_file  # file holds HTTP handler scopes
         self.findings: list[Finding] = []
         self._stack: list[_Scope] = [scopes.root]
         # per-scope {base-expr: {"hi"|"lo": first lineno}} for dd-truncate
@@ -353,7 +405,30 @@ class _RuleChecker(ast.NodeVisitor):
                 self._emit(node, "host-sync-in-loop",
                            "jax.device_get inside a fused-loop body forces "
                            "a host sync per device iteration")
+        if self.gateway_file and scope.gateway:
+            if fname in _GATEWAY_BLOCKING:
+                self._emit(node, "blocking-in-gateway",
+                           f"`{fname}(...)` reachable from an HTTP handler "
+                           "scope blocks a gateway thread on timing work — "
+                           "hand it to the engine with submit() and poll "
+                           "the ticket")
+            elif (fname == "append"
+                    and isinstance(node.func, ast.Attribute)
+                    and self._sessionish(node.func.value)):
+                self._emit(node, "blocking-in-gateway",
+                           "`.append(...)` on a session-like receiver in an "
+                           "HTTP handler scope runs a synchronous "
+                           "incremental refit — submit an append request "
+                           "instead")
         self.generic_visit(node)
+
+    @staticmethod
+    def _sessionish(expr: ast.AST) -> bool:
+        try:
+            base = ast.unparse(expr)
+        except Exception:  # pragma: no cover — unparse drift  # jaxlint: disable=silent-except — unkeyable receiver just skips the heuristic
+            return False
+        return bool(_SESSIONISH_RE.search(base))
 
     # --- silent-except ----------------------------------------------------------
     _BROAD_EXC = {"Exception", "BaseException"}
@@ -460,8 +535,11 @@ def lint_file(path: str, src: str | None = None,
     norm = path.replace(os.sep, "/")
     registry = any(norm.endswith(r) for r in config["env-registry"])
     dd_accessor = any(norm.endswith(r) for r in config["dd-accessors"])
+    gateway_file = any(norm.endswith(r) for r in config["gateway-files"])
+    if gateway_file:
+        _mark_gateway(scopes)
     checker = _RuleChecker(path, scopes, set(config["select"]), registry,
-                           dd_accessor)
+                           dd_accessor, gateway_file)
     checker.visit(tree)
     checker.finalize()
     sup = _suppressions(src)
@@ -505,6 +583,8 @@ _DEFAULTS = {
     # files whose whole PURPOSE is member access on dd pairs: the dd
     # module's own accessors (dd_to_float, dd_rint, device_split, ...)
     "dd-accessors": ["pint_tpu/ops/dd.py"],
+    # files holding HTTP handler scopes (blocking-in-gateway applies)
+    "gateway-files": ["pint_tpu/serve/gateway.py"],
     "exclude": [],
     "select": list(RULES),
 }

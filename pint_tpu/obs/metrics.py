@@ -81,6 +81,10 @@ COUNTER_HELP: dict[str, str] = {
     "serve_retries": "transiently failed dispatches retried",
     "serve_quarantines": "sessions quarantined by the watchdog/crash-loop detector",
     "serve_worker_replacements": "hung workers abandoned and replaced",
+    "serve_migrations": "live sessions migrated between replicas",
+    "serve_replicas_lost": "replica processes lost and absorbed by survivors",
+    "serve_gateway_requests": "requests proxied by the fleet gateway",
+    "serve_gateway_shed": "gateway requests refused with 429/503",
     "incremental_refits": "appends answered by the rank-k incremental path",
     "incremental_fallbacks": "appends that fell back to the full warm refit",
     "incremental_rows_appended": "TOA rows appended into resident sessions",

@@ -103,6 +103,15 @@ KINDS: dict[str, str] = {
         "a journal segment or fleet checkpoint failed its checksum and "
         "was quarantined beside the store; the records past the "
         "corruption were NOT replayed"),
+    "serve.migrate": (
+        "a live session was migrated between serving replicas "
+        "(checkpoint + journal-suffix handoff with idempotency dedup, "
+        "serve/migrate.py); the session paused for the handoff, no "
+        "request was lost"),
+    "serve.replica_lost": (
+        "a serving replica died or stopped answering; the survivors "
+        "absorbed its sessions from its durable checkpoints + journal "
+        "suffix (serve/fleet.py absorb) and kept serving"),
     "fetch.mirror_failed": (
         "a remote file could not be refreshed from any mirror"),
     "fetch.corrupt_quarantined": (

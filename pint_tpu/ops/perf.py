@@ -640,6 +640,8 @@ SERVE_COUNTERS = (
     "serve_journal_records", "serve_journal_compactions",
     "serve_checkpoints", "serve_deadline_expired",
     "serve_retries", "serve_quarantines", "serve_worker_replacements",
+    "serve_migrations", "serve_replicas_lost",
+    "serve_gateway_requests", "serve_gateway_shed",
 )
 
 #: same contract for the incremental-refit counters (serve/session.py +
@@ -668,6 +670,11 @@ def serve_breakdown(rep: PerfReport) -> dict:
         out[c] = int(rep.counters.get(c, 0))
     out["serve_waste_ewma"] = rep.values.get("serve_waste_ewma")
     out["serve_eff_wait_ms"] = rep.values.get("serve_eff_wait_ms")
+    # submit-path overhead sketch quantiles (engine.submit_lat), latched
+    # per submit while a report is active: the lock-hold tax the
+    # two-phase journal append exists to shrink
+    out["serve_submit_us_p50"] = rep.values.get("serve_submit_us_p50")
+    out["serve_submit_us_p99"] = rep.values.get("serve_submit_us_p99")
     return out
 
 

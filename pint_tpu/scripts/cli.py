@@ -33,7 +33,8 @@ commands:
   recover  rebuild a serving fleet from checkpoints + the write-ahead
            journal (crash recovery; see `pint_tpu recover --help`)
   status   observability snapshot: scrape a running engine's /metrics
-           + /healthz, or dump this process's registry/ledger state
+           + /healthz (--fleet merges a whole replica fleet into one
+           report), or dump this process's registry/ledger state
   knobs    print the environment-knob inventory
 """
 

@@ -1,12 +1,22 @@
 """`pint_tpu status`: one-shot observability snapshot.
 
-Two modes:
+Three modes:
 
 - ``pint_tpu status --port <N>`` scrapes a RUNNING engine's endpoint on
   localhost (the one ``PINT_TPU_METRICS_PORT`` / ``metrics_port=``
   started): prints ``/healthz`` then the ``/metrics`` OpenMetrics text
   — what an operator (or a scrape config smoke test) runs against a
   live process. Localhost only; no other network.
+- ``pint_tpu status --fleet <P1,P2,...>`` scrapes EVERY replica of a
+  serving fleet (comma-separated localhost ports — the replica gateway
+  ports a :class:`~pint_tpu.serve.fleet.ReplicaFleet` reported) and
+  merges them into ONE report: counters are summed across replicas,
+  latency distributions are merged loss-lessly through
+  ``QuantileSketch.from_dict`` + ``merge`` over each replica's
+  ``/v1/sketches`` (per-replica p99s do NOT average into a fleet p99 —
+  the sketches must be merged before quantiling). Exit 0 when every
+  replica is healthy, 3 when any is degraded, 1 when any is
+  unreachable.
 - ``pint_tpu status`` (no port) dumps THIS process's observability
   state: the metrics registry render, the degradation ledger, the
   ``.aotx`` artifact-store traffic, the flight-recorder ring size, the
@@ -55,6 +65,77 @@ def _scrape(port: int, as_json: bool) -> int:
     return 0 if health.get("ok") else 3
 
 
+def _scrape_fleet(ports: list[int], as_json: bool) -> int:
+    """Scrape each replica's /healthz + /metrics + /v1/sketches and
+    print one merged fleet report (counters summed, sketches merged)."""
+    from pint_tpu.obs.metrics import parse_openmetrics
+    from pint_tpu.ops.perf import QuantileSketch
+    from pint_tpu.serve.gateway import http_json
+
+    replicas = []
+    counters: dict[str, float] = {}
+    sketches: dict[str, QuantileSketch] = {}
+    unreachable = unhealthy = 0
+    for port in ports:
+        base = f"http://127.0.0.1:{int(port)}"
+        try:
+            _, health, _ = http_json(base + "/healthz", timeout=5)
+            _, sk, _ = http_json(base + "/v1/sketches", timeout=5)
+            import urllib.request
+
+            with urllib.request.urlopen(base + "/metrics", timeout=5) as r:
+                samples, _ = parse_openmetrics(r.read().decode())
+        except (OSError, ValueError) as e:
+            unreachable += 1
+            replicas.append({"port": int(port), "reachable": False,
+                             "error": str(e)})
+            continue
+        if not health.get("ok"):
+            unhealthy += 1
+        replicas.append({"port": int(port), "reachable": True,
+                         "healthz": health})
+        for key, val in samples.items():
+            if 'quantile="' in key:
+                continue  # quantiles don't sum — merged via sketches
+            counters[key] = counters.get(key, 0.0) + val
+        for name, d in sk.items():
+            merged = sketches.setdefault(name, QuantileSketch())
+            merged.merge(QuantileSketch.from_dict(d))
+
+    fleet_quantiles = {
+        name: {"p50": s.quantile(0.5), "p90": s.quantile(0.9),
+               "p99": s.quantile(0.99), "count": s.count}
+        for name, s in sketches.items()}
+    rc = 1 if unreachable else (3 if unhealthy else 0)
+    if as_json:
+        print(json.dumps({
+            "metric": "status", "mode": "fleet", "ports": list(ports),
+            "replicas": replicas, "counters": counters,
+            "quantiles": fleet_quantiles, "unreachable": unreachable,
+            "unhealthy": unhealthy}))
+        return rc
+    up = sum(1 for r in replicas if r["reachable"])
+    print(f"fleet status: {up}/{len(ports)} replica(s) reachable, "
+          f"{unhealthy} unhealthy")
+    for r in replicas:
+        if r["reachable"]:
+            h = r["healthz"]
+            print(f"  :{r['port']}  ok={h.get('ok')} "
+                  f"sessions={h.get('sessions', h.get('pool_sessions'))} "
+                  f"inflight={h.get('inflight')}")
+        else:
+            print(f"  :{r['port']}  UNREACHABLE ({r['error']})")
+    print("-- merged counters (summed across replicas) --")
+    for key in sorted(counters):
+        print(f"  {key} {counters[key]:g}")
+    print("-- merged latency sketches --")
+    for name, q in sorted(fleet_quantiles.items()):
+        p50 = q["p50"] if q["p50"] is not None else float("nan")
+        p99 = q["p99"] if q["p99"] is not None else float("nan")
+        print(f"  {name}: p50={p50:.3f} p99={p99:.3f} n={q['count']}")
+    return rc
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="pint_tpu status",
@@ -64,10 +145,19 @@ def main(argv=None) -> int:
     ap.add_argument("--port", type=int, default=None,
                     help="scrape the running engine's metrics endpoint "
                          "on this localhost port")
+    ap.add_argument("--fleet", default=None, metavar="P1,P2,...",
+                    help="scrape a replica fleet (comma-separated "
+                         "localhost replica ports) and print one merged "
+                         "report: counters summed, sketches merged")
     ap.add_argument("--json", action="store_true",
                     help="emit one JSON object instead of text")
     args = ap.parse_args(argv)
 
+    if args.fleet is not None:
+        ports = [int(p) for p in args.fleet.split(",") if p.strip()]
+        if not ports:
+            ap.error("--fleet needs at least one port")
+        return _scrape_fleet(ports, args.json)
     if args.port is not None:
         return _scrape(args.port, args.json)
 

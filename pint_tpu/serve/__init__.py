@@ -13,17 +13,29 @@ package holds those surfaces, bottom to top:
   ``PINT_TPU_EXPECT_WARM=1``);
 - :class:`~pint_tpu.serve.engine.ServingEngine` — the always-on
   continuous-batching worker with admission control and load shedding;
-  an async network front-end plugs into its ``submit``/ticket surface;
 - :class:`~pint_tpu.serve.journal.RequestJournal` /
   serve/recover.py — the durability layer: a write-ahead request
   journal ahead of every admission ack, crash-safe cross-process fleet
   recovery (``pint_tpu recover``), deadline/retry/watchdog lifecycle
-  hardening.
+  hardening;
+- :class:`~pint_tpu.serve.gateway.Gateway` /
+  :class:`~pint_tpu.serve.gateway.FleetGateway` + serve/fleet.py —
+  horizontal scale-out: the async HTTP front-end over the
+  ``submit``/ticket surface, R replica worker processes sharing the
+  content-addressed warm caches, rendezvous session routing
+  (serve/route.py) and live checkpoint-handoff migration
+  (serve/migrate.py) with kill-absorb failover.
 """
 
 from pint_tpu.serve.engine import ServeTicket, ServingEngine  # noqa: F401
+from pint_tpu.serve.fleet import ReplicaFleet  # noqa: F401
+from pint_tpu.serve.gateway import (FleetGateway, Gateway,  # noqa: F401
+                                    http_json)
 from pint_tpu.serve.journal import (JournalError,  # noqa: F401
                                     RequestJournal, replay_records)
+from pint_tpu.serve.migrate import (MigrateError,  # noqa: F401
+                                    export_session, import_session,
+                                    migrate_session)
 from pint_tpu.serve.pool import SessionCheckpoint, SessionPool  # noqa: F401
 from pint_tpu.serve.recover import (checkpoint_fleet,  # noqa: F401
                                     recover_fleet)

@@ -69,6 +69,7 @@ rebuilds the whole engine from the checkpoints + journal suffix
 
 from __future__ import annotations
 
+import contextlib
 import os
 import threading
 import time
@@ -228,6 +229,10 @@ class ServingEngine:
         self.latency = perf.QuantileSketch()
         self.refit_latency = perf.QuantileSketch()
         self.queue_wait = perf.QuantileSketch()
+        # submit-path overhead (ticket mint + journal append + queue
+        # offer, in µs): the client-thread tax every request pays before
+        # its ack, the thing the two-phase journal append shrinks
+        self.submit_lat = perf.QuantileSketch()
         self.served = 0
         self.dispatches = 0
         self.expired = 0
@@ -280,6 +285,9 @@ class ServingEngine:
         reg.summary("serve_queue_wait_ms",
                     "queue wait before the (possibly shared) solve",
                     sketch=self.queue_wait)
+        reg.summary("serve_submit_us",
+                    "submit-path overhead (mint + journal + offer) in us",
+                    sketch=self.submit_lat)
 
     def health(self) -> tuple[bool, dict]:
         """Readiness for ``/healthz``: ok iff the engine is not draining,
@@ -458,6 +466,12 @@ class ServingEngine:
             with perf.stage("admit"):
                 self.scheduler.offer(ticket, rows=rows)
             ticket.t_acked = self._clock()
+            self.submit_lat.add((ticket.t_acked - ticket.t_submit) * 1e6)
+            if perf.active():
+                perf.put("serve_submit_us_p50",
+                         self.submit_lat.quantile(0.5) * 1.0)
+                perf.put("serve_submit_us_p99",
+                         self.submit_lat.quantile(0.99) * 1.0)
         with self._cv:
             self._cv.notify()
         return ticket
@@ -465,20 +479,25 @@ class ServingEngine:
     # -- the worker ------------------------------------------------------------------
 
     def _dispatch_append(self, batch: Lane) -> None:
-        with perf.stage("dispatch"):
-            session = self.pool.get(batch.sid)
-        with perf.stage("coalesce"):
-            merged = coalesce_append_payloads(
-                [t.payload for t in batch.tickets])
-            if len(batch.tickets) > 1:
-                perf.add("serve_coalesced", len(batch.tickets))
-        with perf.stage("solve"):
-            shared = session.append(**merged)
-        # applied: record the idempotency keys so a checkpoint taken now
-        # captures them and crash recovery dedups instead of re-applying
-        for t in batch.tickets:
-            if t.idem:
-                session.applied_idem.add(t.idem)
+        # the per-session mutex pins the session for the whole mutation:
+        # a concurrent LRU eviction try-acquires it and picks another
+        # victim instead of capturing a checkpoint mid-append
+        with self.pool.session_lock(batch.sid):
+            with perf.stage("dispatch"):
+                session = self.pool.get(batch.sid)
+            with perf.stage("coalesce"):
+                merged = coalesce_append_payloads(
+                    [t.payload for t in batch.tickets])
+                if len(batch.tickets) > 1:
+                    perf.add("serve_coalesced", len(batch.tickets))
+            with perf.stage("solve"):
+                shared = session.append(**merged)
+            # applied: record the idempotency keys so a checkpoint taken
+            # now captures them and crash recovery dedups instead of
+            # re-applying
+            for t in batch.tickets:
+                if t.idem:
+                    session.applied_idem.add(t.idem)
         self._finalize(batch, shared,
                        waste=1.0 - batch.rows / self._append_bucket(
                            batch.rows))
@@ -497,15 +516,20 @@ class ServingEngine:
         for t in batch.tickets:
             if t.session not in sids:
                 sids.append(t.session)
-        with perf.stage("dispatch"):
-            sessions = [self.pool.get(sid) for sid in sids]
-        with perf.stage("solve"), perf.collect() as rep:
-            results = batch_refit(sessions, maxiter=self.maxiter)
-        by_sid = dict(zip(sids, results))
-        by_ses = dict(zip(sids, sessions))
-        for t in batch.tickets:
-            if t.idem:
-                by_ses[t.session].applied_idem.add(t.idem)
+        # pin every session for the batched mutation (sorted acquire so
+        # two refit lanes can never deadlock on overlapping session sets)
+        with contextlib.ExitStack() as stack:
+            for sid in sorted(sids):
+                stack.enter_context(self.pool.session_lock(sid))
+            with perf.stage("dispatch"):
+                sessions = [self.pool.get(sid) for sid in sids]
+            with perf.stage("solve"), perf.collect() as rep:
+                results = batch_refit(sessions, maxiter=self.maxiter)
+            by_sid = dict(zip(sids, results))
+            by_ses = dict(zip(sids, sessions))
+            for t in batch.tickets:
+                if t.idem:
+                    by_ses[t.session].applied_idem.add(t.idem)
         self._finalize(batch, None, by_sid=by_sid,
                        waste=rep.values.get("padding_waste_frac"))
         perf.add("serve_refits", len(batch.tickets))
@@ -960,6 +984,7 @@ class ServingEngine:
             "latency": self.latency.summary("ms"),
             "refit_latency": self.refit_latency.summary("ms"),
             "queue_wait": self.queue_wait.summary("ms"),
+            "submit": self.submit_lat.summary("us"),
             "pool": self.pool.stats(),
         }
         if self.journal is not None:

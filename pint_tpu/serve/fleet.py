@@ -1,0 +1,216 @@
+"""Replicated serving fleet: R engine worker processes, shared warm caches.
+
+One serving process is bounded by one dispatcher and one warm pool;
+horizontal scale-out runs R of them (:class:`ReplicaFleet`), each a
+plain ``python -m pint_tpu.serve.fleet --replica`` worker that
+
+- shares the content-addressed stores through ``PINT_TPU_CACHE_DIR`` —
+  the ``.aotx`` serialized-executable artifacts, the prepared-TOA disk
+  cache, the ephemeris kernel packs and the persistent XLA cache are
+  all keyed by content, so replica #2 starting into a warmed cache root
+  compiles NOTHING (``traces_on_warm == 0``, the bench's second-replica
+  bar);
+- owns a durable directory (checkpoints + write-ahead journal,
+  serve/recover.py) it recovers from at startup and journals into while
+  serving — which doubles as the migration/absorb handoff source: the
+  durable layout IS the handoff layout;
+- serves its HTTP surface through a :class:`~pint_tpu.serve.gateway.
+  Gateway` and reports ``READY::{json}`` on stdout once recovered.
+
+Placement is rendezvous hashing (serve/route.py): the parent stages
+each session's checkpoint into its owner replica's durable dir before
+spawning, every router recomputes the same owner, and adding a replica
+moves ~1/R of the sessions. The :class:`~pint_tpu.serve.gateway.
+FleetGateway` fronts the fleet (routing, pins, merged telemetry,
+migrate/absorb control).
+
+Chaos drill (``bench.py --smoke --fleet``): arm ``serve.crash:exit`` in
+a replica via its ``/v1/fault`` endpoint, submit — the replica dies
+mid-dispatch with exit code 70 (admitted + journaled, not applied) —
+then ``FleetGateway.absorb`` moves its sessions onto the survivors from
+the durable store with ``requests_lost == 0`` and ``serve.replica_lost``
+on the ledger.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+from pint_tpu.serve import route
+from pint_tpu.utils import knobs
+from pint_tpu.utils.logging import get_logger
+
+log = get_logger("pint_tpu.serve")
+
+__all__ = ["ReplicaFleet"]
+
+_READY = "READY::"
+
+
+class ReplicaFleet:
+    """Spawn, stage and supervise R replica worker processes (see module
+    docstring). The parent stays a pure controller: it writes staging
+    checkpoints, launches workers, and talks HTTP afterwards."""
+
+    def __init__(self, root: str | Path, names: list[str] | None = None):
+        self.root = Path(root)
+        if names is None:
+            n = int(knobs.get("PINT_TPU_FLEET_REPLICAS"))
+            names = [f"r{i}" for i in range(n)]
+        self.names = list(names)
+        #: name -> {"proc": Popen|None, "port": int, "ready": dict}
+        self.procs: dict[str, dict] = {}
+
+    def dir_for(self, name: str) -> Path:
+        return self.root / f"replica-{name}"
+
+    # -- staging -----------------------------------------------------------------
+
+    def stage_session(self, sid: str, session) -> str:
+        """Write ``sid``'s checkpoint into its rendezvous owner's
+        durable dir (the worker recovers it warm at startup). Returns
+        the owner's name."""
+        from pint_tpu.serve.pool import SessionCheckpoint
+        from pint_tpu.serve.recover import _write_checkpoint
+
+        name = route.owner(sid, self.names)
+        sdir = self.dir_for(name) / "sessions"
+        sdir.mkdir(parents=True, exist_ok=True)
+        _write_checkpoint(sdir / f"{sid}.ckpt",
+                          SessionCheckpoint.capture(session))
+        return name
+
+    # -- process supervision -----------------------------------------------------
+
+    def spawn(self, name: str, extra_env: dict | None = None,
+              timeout_s: float = 600.0) -> dict:
+        """Launch one replica worker and block until its ``READY::``
+        line (recovery + gateway bind are done). Returns the ready
+        report (port, sessions, traces_on_warm, ...)."""
+        d = self.dir_for(name)
+        d.mkdir(parents=True, exist_ok=True)
+        env = dict(os.environ)  # jaxlint: disable=env-read — the worker must inherit the parent's knob/cache environment verbatim
+        env.update(extra_env or {})
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "pint_tpu.serve.fleet", "--replica",
+             "--dir", str(d), "--port", "0"],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=env)
+        deadline = time.monotonic() + timeout_s
+        ready = None
+        assert proc.stdout is not None
+        while time.monotonic() < deadline:
+            line = proc.stdout.readline()
+            if not line:
+                break
+            if line.startswith(_READY):
+                ready = json.loads(line[len(_READY):])
+                break
+        if ready is None:
+            proc.kill()
+            err = proc.stderr.read() if proc.stderr else ""
+            raise RuntimeError(
+                f"replica {name!r} never reported ready: {err[-2000:]}")
+        self.procs[name] = {"proc": proc, "port": ready["port"],
+                            "ready": ready}
+        log.info(f"replica {name!r} ready on port {ready['port']} "
+                 f"({ready['sessions']} session(s), "
+                 f"{ready['traces_on_warm']} traces)")
+        return ready
+
+    def spawn_all(self, extra_env: dict | None = None) -> dict:
+        return {name: self.spawn(name, extra_env) for name in self.names}
+
+    def url(self, name: str) -> str:
+        return f"http://127.0.0.1:{self.procs[name]['port']}"
+
+    def gateway(self, handoff_root: str | Path | None = None):
+        """A :class:`~pint_tpu.serve.gateway.FleetGateway` fronting every
+        spawned replica (handoff_root defaults under the fleet root)."""
+        from pint_tpu.serve.gateway import FleetGateway
+
+        fg = FleetGateway(handoff_root=self.root / "handoff"
+                          if handoff_root is None else handoff_root)
+        for name in self.procs:
+            fg.add_replica(name, self.url(name),
+                           durable_dir=self.dir_for(name))
+        return fg
+
+    def wait_exit(self, name: str, timeout_s: float = 120.0) -> int:
+        """Block until a replica process exits; returns its returncode
+        (70 = the ``serve.crash:exit`` chaos drill fired)."""
+        proc = self.procs[name]["proc"]
+        rc = proc.wait(timeout=timeout_s)
+        return rc
+
+    def stop_all(self, drain: bool = True, timeout_s: float = 120.0):
+        """Stop every live replica through its ``/v1/stop`` endpoint
+        (drain flushes + checkpoints + closes the journal clean), then
+        reap the processes."""
+        from pint_tpu.serve.gateway import http_json
+
+        for name, info in list(self.procs.items()):
+            proc = info["proc"]
+            if proc.poll() is not None:
+                continue
+            try:
+                http_json(self.url(name) + "/v1/stop", {"drain": drain},
+                          timeout=timeout_s)
+            except OSError:
+                pass
+            try:
+                proc.wait(timeout=timeout_s)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait(timeout=10.0)
+
+
+def _replica_main(argv: list[str] | None = None) -> int:
+    """The worker entrypoint (``python -m pint_tpu.serve.fleet
+    --replica --dir D --port P``): recover the durable dir into a live
+    engine (warm via the shared caches — the READY report carries
+    ``traces_on_warm`` so the bench can lock it at 0), start serving,
+    bind the gateway, report ready, and wait for ``/v1/stop``."""
+    import argparse
+
+    ap = argparse.ArgumentParser(prog="pint_tpu.serve.fleet")
+    ap.add_argument("--replica", action="store_true")
+    ap.add_argument("--dir", required=True)
+    ap.add_argument("--port", type=int, default=None)
+    args = ap.parse_args(argv)
+
+    from pint_tpu.ops.compile import setup_persistent_cache
+
+    setup_persistent_cache()
+    from pint_tpu.analysis.jaxpr_audit import compile_count
+    from pint_tpu.serve.gateway import Gateway
+    from pint_tpu.serve.recover import recover_fleet
+
+    c0 = compile_count()
+    engine, report = recover_fleet(args.dir)
+    traces = compile_count() - c0
+    engine.start()
+    gw = Gateway(engine, port=args.port)
+    port = gw.start()
+    print(_READY + json.dumps({
+        "port": port,
+        "pid": os.getpid(),
+        "dir": args.dir,
+        "sessions": report["sessions"],
+        "traces_on_warm": traces,
+        "replayed": report["replayed"],
+        "deduped": report["deduped"],
+        "requests_lost": report["requests_lost"],
+        "recovery_time_s": report["recovery_time_s"],
+    }), flush=True)
+    gw.stopped.wait()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(_replica_main())

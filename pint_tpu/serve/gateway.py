@@ -1,0 +1,684 @@
+"""Async HTTP front-end over the serving engine, and the fleet fan-out.
+
+Two layers, same stdlib discipline as the metrics endpoint
+(obs/metrics.py — ``ThreadingHTTPServer`` on 127.0.0.1, daemon threads,
+``port=0`` binds ephemeral):
+
+- :class:`Gateway` — one replica's wire surface over
+  ``ServingEngine.submit`` / :class:`~pint_tpu.serve.engine.ServeTicket`.
+  The handler scopes are ASYNC BY CONSTRUCTION: they admit (``submit``),
+  poll tickets and read telemetry — never a synchronous fit/append/
+  drain — and the ``blocking-in-gateway`` lint rule
+  (pint_tpu/analysis/lint.py) fails the build if a blocking engine call
+  ever creeps into one. The trace id minted at submit rides back as the
+  ``X-Pint-Trace`` response header; admission sheds map to HTTP 429
+  (rate/queue refusals) and 503 (draining / quarantined / refused under
+  ``PINT_TPU_DEGRADED=error``), queued-past-deadline to 504.
+- :class:`FleetGateway` — the front door of a replicated fleet: routes
+  each session to its replica by rendezvous hashing
+  (serve/route.py; adding a replica moves ~1/R of the sessions),
+  honours live-migration pins, aggregates every replica's ``/metrics``
+  into one OpenMetrics page (counters summed, latency summaries merged
+  LOSSLESSLY via ``QuantileSketch.from_dict`` from each replica's
+  ``/v1/sketches``), and drives live migration / kill-absorb through
+  the replicas' ``/v1/migrate/*`` control surface (serve/migrate.py).
+
+Wire format: JSON bodies; append rows use the journal's row encoding
+(serve/journal.py ``encode_rows``/``decode_rows``), so a gateway client,
+a journal record and a replayed request are the same bytes.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import urllib.error
+import urllib.request
+from collections import OrderedDict
+
+from pint_tpu.obs import metrics as obs_metrics
+from pint_tpu.ops import degrade, perf
+from pint_tpu.serve import route
+from pint_tpu.serve.journal import JournalError, decode_rows
+from pint_tpu.serve.scheduler import (DeadlineError, QuarantinedError,
+                                      ShedError)
+from pint_tpu.testing import faults
+from pint_tpu.utils import knobs
+from pint_tpu.utils.logging import get_logger
+
+log = get_logger("pint_tpu.serve")
+
+__all__ = ["Gateway", "FleetGateway", "http_json"]
+
+#: exception -> (HTTP status, stable error kind). Sheds are EXPLICIT
+#: refusals the client can act on: 429 = back off and retry (admission
+#: rate/queue), 503 = this replica cannot serve you right now
+#: (draining, quarantined, refused under PINT_TPU_DEGRADED=error),
+#: 504 = the queued request outlived its deadline.
+_STATUS = (
+    (ShedError, 429, "shed"),
+    (DeadlineError, 504, "deadline"),
+    (TimeoutError, 504, "timeout"),
+    (QuarantinedError, 503, "quarantined"),
+    (JournalError, 503, "journal"),
+    (degrade.DegradedError, 503, "degraded"),
+    (KeyError, 404, "unknown"),
+    (ValueError, 400, "bad_request"),
+)
+
+
+def _status_of(exc: BaseException) -> tuple[int, str]:
+    from pint_tpu.serve.migrate import MigrateError
+
+    if isinstance(exc, MigrateError):
+        return 409, "migrate"
+    for cls, code, kind in _STATUS:
+        if isinstance(exc, cls):
+            return code, kind
+    return 500, "internal"
+
+
+def http_json(url: str, body: dict | None = None, *,
+              timeout: float = 30.0) -> tuple[int, dict, dict]:
+    """One JSON-over-HTTP exchange (GET when ``body`` is None, POST
+    otherwise) against a localhost gateway. Returns ``(status, payload,
+    headers)``; non-2xx statuses return their JSON error payload instead
+    of raising, so callers branch on status like any HTTP client."""
+    data = None if body is None else json.dumps(body).encode()
+    req = urllib.request.Request(
+        url, data=data,
+        headers={} if data is None else {"Content-Type":
+                                         "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return (resp.status, json.loads(resp.read() or b"{}"),
+                    dict(resp.headers))
+    except urllib.error.HTTPError as e:
+        raw = e.read()
+        try:
+            payload = json.loads(raw) if raw else {}
+        except ValueError:
+            payload = {"error": "internal", "detail": raw.decode(
+                "utf-8", "replace")}
+        return e.code, payload, dict(e.headers or {})
+
+
+def _result_block(ticket) -> dict:
+    sr = ticket.result
+    out = {
+        "done": True,
+        "idem": ticket.idem,
+        "session": ticket.session,
+        "kind": ticket.kind,
+        "trace": ticket.trace_id,
+        "latency_ms": ticket.latency_ms,
+        "queue_ms": ticket.queue_ms,
+    }
+    if sr is not None:
+        out.update(path=sr.path, k=sr.k, solve_latency_ms=sr.latency_ms,
+                   reason=sr.reason)
+    return out
+
+
+class _HttpServerMixin:
+    """Shared stdlib-server plumbing (the obs/metrics.py discipline):
+    127.0.0.1 only, ephemeral port on 0, daemon serve thread."""
+
+    _name = "pint-tpu-gateway"
+
+    def _serve(self, handler_cls, port: int) -> int:
+        from http.server import ThreadingHTTPServer
+
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", int(port)),
+                                          handler_cls)
+        self._httpd.daemon_threads = True
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name=self._name, daemon=True)
+        self._thread.start()
+        return self.port
+
+    def stop(self) -> None:
+        if getattr(self, "_httpd", None) is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+        if getattr(self, "_thread", None) is not None:
+            self._thread.join(5.0)
+            self._thread = None
+
+    @property
+    def url(self) -> str:
+        return f"http://127.0.0.1:{self.port}"
+
+
+class Gateway(_HttpServerMixin):
+    """One serving replica's HTTP surface (see module docstring).
+
+    Endpoints::
+
+        POST /v1/submit       admit a request; ?wait=1 (default) blocks
+                              for the result, wait=0 answers 202 + the
+                              ticket id for later /v1/tickets polls
+        GET  /v1/tickets/<id> poll an async ticket by idempotency key
+        GET  /v1/sessions     session ids this replica owns
+        GET  /v1/params?session=<sid>   fitted parameters (parity checks)
+        GET  /v1/stats        engine.stats() snapshot
+        GET  /v1/sketches     latency QuantileSketches, marshalled for
+                              lossless cross-process merging
+        GET  /v1/degraded     this process's degradation-ledger block
+        GET  /metrics         OpenMetrics (process registry)
+        GET  /healthz         engine readiness (200/503 + JSON detail)
+        POST /v1/checkpoint   durably checkpoint the fleet + compact WAL
+        POST /v1/migrate/out  export a session into a handoff dir
+        POST /v1/migrate/in   import a handed-off session
+        POST /v1/fault        arm a fault spec in THIS process (drills)
+        POST /v1/knob         set a registered PINT_TPU_* knob
+        POST /v1/stop         stop serving (?drain=1 flushes + closes)
+    """
+
+    def __init__(self, engine, port: int | None = None):
+        self.engine = engine
+        self.port = (int(knobs.get("PINT_TPU_GATEWAY_PORT"))
+                     if port is None else int(port))
+        self._httpd = None
+        self._thread = None
+        self.stopped = threading.Event()
+        # bounded async-ticket registry: idem -> ServeTicket, oldest
+        # dropped (a client that never polls must not leak tickets)
+        self._tickets: OrderedDict[str, object] = OrderedDict()
+        self._tlock = threading.Lock()
+
+    # -- request plumbing (called from handler scopes) ---------------------------
+
+    def _remember(self, ticket) -> None:
+        with self._tlock:
+            self._tickets[ticket.idem] = ticket
+            while len(self._tickets) > 1024:
+                self._tickets.popitem(last=False)
+
+    def _ticket(self, idem: str):
+        with self._tlock:
+            return self._tickets.get(idem)
+
+    def _submit(self, body: dict, wait: bool, timeout: float) -> tuple:
+        """Admit one wire request; returns (status, payload, trace_id).
+        The ONLY engine calls here are ``submit`` and a ticket wait —
+        the blocking-in-gateway lint contract."""
+        kind = body.get("kind", "append")
+        kw = {}
+        if kind == "append":
+            kw = decode_rows(body["rows"])
+        ticket = self.engine.submit(
+            session=body["session"], kind=kind,
+            tenant=body.get("tenant", "default"),
+            deadline_s=body.get("deadline_s"),
+            idem=body.get("idem"), **kw)
+        if not wait:
+            self._remember(ticket)
+            return 202, {"done": False, "idem": ticket.idem,
+                         "session": ticket.session,
+                         "trace": ticket.trace_id}, ticket.trace_id
+        ticket.wait(timeout)
+        return 200, _result_block(ticket), ticket.trace_id
+
+    def _control(self, path: str, body: dict) -> tuple[int, dict]:
+        """POST control surface (checkpoint / migrate / fault / knob /
+        stop) — small, explicit, localhost-only."""
+        import os
+
+        from pint_tpu.serve import migrate as migrate_mod
+
+        if path == "/v1/checkpoint":
+            return 200, {"checkpointed": self.engine.checkpoint()}
+        if path == "/v1/migrate/out":
+            return 200, migrate_mod.export_session(
+                self.engine, body["sid"], body["handoff_dir"])
+        if path == "/v1/migrate/in":
+            return 200, migrate_mod.import_session(
+                self.engine, body["handoff_dir"], sid=body.get("sid"))
+        if path == "/v1/fault":
+            return 200, {"armed": faults.arm_spec(body["spec"])}
+        if path == "/v1/knob":
+            name = body["name"]
+            if name not in knobs.KNOBS:
+                raise KeyError(f"{name} is not a registered knob")
+            # the remote-control twin of a shell `export`: bench legs
+            # flip e.g. PINT_TPU_DEGRADED inside a running replica
+            os.environ[name] = str(body["value"])  # jaxlint: disable=env-read — registered-knob write via the control endpoint
+            return 200, {"set": name, "value": str(body["value"])}
+        if path == "/v1/stop":
+            drain = bool(body.get("drain", True))
+            threading.Thread(target=self._late_stop, args=(drain,),
+                             daemon=True).start()
+            return 200, {"stopping": True, "drain": drain}
+        raise KeyError(f"unknown control path {path}")
+
+    def _late_stop(self, drain: bool) -> None:
+        self.engine.stop(drain=drain)
+        self.stopped.set()
+        self.stop()
+
+    def _read(self, path: str, query: dict) -> tuple[int, dict]:
+        """GET surface: tickets, sessions, params, stats, sketches."""
+        if path.startswith("/v1/tickets/"):
+            t = self._ticket(path.rsplit("/", 1)[-1])
+            if t is None:
+                raise KeyError("unknown ticket")
+            if not t.done():
+                return 202, {"done": False, "idem": t.idem}
+            if t.error is not None:
+                code, kind = _status_of(t.error)
+                return code, {"done": True, "error": kind,
+                              "detail": str(t.error)}
+            return 200, _result_block(t)
+        if path == "/v1/sessions":
+            return 200, {"sessions": self.engine.pool.sids()}
+        if path == "/v1/params":
+            from pint_tpu.fitting.state import snapshot
+
+            sid = query["session"]
+            ses = self.engine.pool.get(sid)
+            st = snapshot(ses.fitter)
+            return 200, {"session": sid, "n_toas": len(ses.toas),
+                         "params": {n: [hi, lo] for n, (hi, lo)
+                                    in st.params.items()},
+                         "chi2": st.chi2}
+        if path == "/v1/stats":
+            return 200, self.engine.stats()
+        if path == "/v1/sketches":
+            return 200, {
+                "latency_ms": self.engine.latency.to_dict(),
+                "refit_latency_ms": self.engine.refit_latency.to_dict(),
+                "queue_wait_ms": self.engine.queue_wait.to_dict(),
+                "submit_us": self.engine.submit_lat.to_dict(),
+            }
+        if path == "/v1/degraded":
+            return 200, degrade.degradation_block()
+        raise KeyError(f"unknown path {path}")
+
+    def start(self) -> int:
+        gw = self
+
+        from http.server import BaseHTTPRequestHandler
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):  # noqa: A003 — silence stdlib access logs
+                pass
+
+            def _reply(self, code: int, payload: dict,
+                       trace_id: str = "") -> None:
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                if trace_id:
+                    self.send_header("X-Pint-Trace", trace_id)
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _query(self) -> dict:
+                from urllib.parse import parse_qsl, urlsplit
+
+                return dict(parse_qsl(urlsplit(self.path).query))
+
+            def _body(self) -> dict:
+                n = int(self.headers.get("Content-Length") or 0)
+                return json.loads(self.rfile.read(n) or b"{}")
+
+            def do_GET(self):  # noqa: N802 — stdlib handler API
+                path = self.path.split("?")[0]
+                if path == "/metrics":
+                    body = obs_metrics.registry().render().encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type",
+                                     "application/openmetrics-text; "
+                                     "version=1.0.0; charset=utf-8")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
+                if path == "/healthz":
+                    ok, detail = gw.engine.health()
+                    self._reply(200 if ok else 503,
+                                dict(detail, ok=bool(ok)))
+                    return
+                try:
+                    code, payload = gw._read(path, self._query())
+                except Exception as e:  # noqa: BLE001 — mapped to a wire status, never a stack dump on the socket  # jaxlint: disable=silent-except
+                    code, kind = _status_of(e)
+                    payload = {"error": kind, "detail": str(e)}
+                self._reply(code, payload)
+
+            def do_POST(self):  # noqa: N802 — stdlib handler API
+                path = self.path.split("?")[0]
+                try:
+                    body = self._body()
+                    if path == "/v1/submit":
+                        q = self._query()
+                        wait = q.get("wait", "1") != "0"
+                        timeout = float(q.get("timeout_s", "60"))
+                        code, payload, tid = gw._submit(
+                            body, wait, timeout)
+                        self._reply(code, payload, tid)
+                        return
+                    code, payload = gw._control(path, body)
+                except Exception as e:  # noqa: BLE001 — mapped to a wire status, never a stack dump on the socket  # jaxlint: disable=silent-except
+                    code, kind = _status_of(e)
+                    payload = {"error": kind, "detail": str(e)}
+                self._reply(code, payload)
+
+        port = self._serve(Handler, self.port)
+        log.info(f"serving gateway on 127.0.0.1:{port} "
+                 f"(engine: {len(self.engine.pool.sids())} session(s))")
+        return port
+
+
+class FleetGateway(_HttpServerMixin):
+    """The fleet's front door (see module docstring): consistent
+    session->replica routing with live-migration pins, proxied submits,
+    merged fleet-wide telemetry, and the absorb path that moves a dead
+    replica's sessions onto the survivors with zero lost requests."""
+
+    _name = "pint-tpu-fleet-gateway"
+
+    def __init__(self, port: int = 0, handoff_root=None):
+        from pathlib import Path
+
+        self.port = int(port)
+        self._httpd = None
+        self._thread = None
+        #: replica name -> {"url": base url, "dir": durable dir}
+        self.replicas: dict[str, dict] = {}
+        #: session -> replica name (rendezvous placement + migration pins)
+        self.sessions: dict[str, str] = {}
+        self._lock = threading.RLock()
+        self.handoff_root = (None if handoff_root is None
+                             else Path(handoff_root))
+        # materialize the export registry NOW: Registry.feed drops
+        # perf-counter bumps until the singleton exists, and the
+        # gateway's serve_gateway_* counters must count from the first
+        # proxied request, not from the first /metrics scrape
+        obs_metrics.registry()
+
+    # -- membership / routing ----------------------------------------------------
+
+    def add_replica(self, name: str, base_url: str,
+                    durable_dir=None) -> list[str]:
+        """Register a live replica and adopt the sessions it reports.
+        Returns the adopted session ids."""
+        code, payload, _ = http_json(base_url + "/v1/sessions")
+        owned = payload.get("sessions", []) if code == 200 else []
+        with self._lock:
+            self.replicas[name] = {"url": base_url,
+                                   "dir": (None if durable_dir is None
+                                           else str(durable_dir))}
+            for sid in owned:
+                self.sessions[sid] = name
+        return owned
+
+    def replica_for(self, sid: str) -> str:
+        """The replica owning ``sid``: its recorded placement (set at
+        adoption or by a migration pin), else rendezvous routing over
+        the current membership."""
+        with self._lock:
+            name = self.sessions.get(sid)
+            if name is not None and name in self.replicas:
+                return name
+            name = route.owner(sid, self.replicas)
+            self.sessions[sid] = name
+            return name
+
+    def _url(self, name: str) -> str:
+        with self._lock:
+            return self.replicas[name]["url"]
+
+    # -- data path ---------------------------------------------------------------
+
+    def proxy_submit(self, body: dict, wait: bool = True,
+                     timeout: float = 60.0) -> tuple[int, dict, dict]:
+        """Route one submit to its session's replica. The
+        ``serve.migrate:force`` drill hook lives here: tripped, the
+        session is live-migrated to another replica FIRST and the
+        request then lands on the new owner — proving a migration is
+        invisible to the client that triggered it."""
+        sid = body["session"]
+        name = self.replica_for(sid)
+        if (faults.trip("serve.migrate", f"session:{sid}") == "force"
+                and len(self.replicas) > 1):
+            ranked = route.rank(sid, self.replicas)
+            target = next(r for r in ranked if r != name)
+            self.migrate(sid, target)
+            name = target
+        perf.add("serve_gateway_requests")
+        code, payload, headers = http_json(
+            self._url(name) + f"/v1/submit?wait={'1' if wait else '0'}"
+            f"&timeout_s={timeout}", body, timeout=timeout + 10.0)
+        if code in (429, 503):
+            perf.add("serve_gateway_shed")
+        return code, payload, headers
+
+    # -- control path ------------------------------------------------------------
+
+    def migrate(self, sid: str, target: str) -> dict:
+        """Live-migrate ``sid`` onto replica ``target`` (checkpoint +
+        journal-suffix handoff, serve/migrate.py) and pin it there.
+        Bounded by ``PINT_TPU_MIGRATE_TIMEOUT_S``; a failed export
+        leaves the session on the source."""
+        from pint_tpu.serve.migrate import MigrateError
+
+        budget = float(knobs.get("PINT_TPU_MIGRATE_TIMEOUT_S"))
+        source = self.replica_for(sid)
+        if source == target:
+            return {"sid": sid, "noop": True}
+        if self.handoff_root is None:
+            raise MigrateError("FleetGateway needs a handoff_root to "
+                               "migrate sessions")
+        handoff = self.handoff_root / f"handoff-{sid}"
+        code, out, _ = http_json(
+            self._url(source) + "/v1/migrate/out",
+            {"sid": sid, "handoff_dir": str(handoff)}, timeout=budget)
+        if code != 200:
+            raise MigrateError(
+                f"export of {sid!r} from {source} failed: {out}")
+        code, inp, _ = http_json(
+            self._url(target) + "/v1/migrate/in",
+            {"sid": sid, "handoff_dir": str(handoff)}, timeout=budget)
+        if code != 200:
+            raise MigrateError(
+                f"import of {sid!r} into {target} failed: {out}")
+        with self._lock:
+            self.sessions[sid] = target
+        log.info(f"migrated session {sid!r}: {source} -> {target}")
+        return dict(out, **inp, source=source, target=target)
+
+    def absorb(self, victim: str) -> dict:
+        """A replica died: drop it from membership and import every
+        session it owned onto the survivors — straight from the victim's
+        durable store (same layout as a migration handoff: checkpoints +
+        journal), so the absorb replays the victim's un-checkpointed
+        tail with idempotency dedup and loses nothing. Rendezvous
+        routing picks each session's new home without a handoff table."""
+        with self._lock:
+            dead = self.replicas.pop(victim)
+            orphans = sorted(s for s, n in self.sessions.items()
+                             if n == victim)
+            survivors = dict(self.replicas)
+        if not survivors:
+            raise RuntimeError("no surviving replicas to absorb into")
+        degrade.record(
+            "serve.replica_lost", f"replica:{victim}",
+            f"serving replica {victim!r} was lost; {len(orphans)} "
+            "session(s) reassigned to the survivors from its durable "
+            "checkpoints + journal suffix",
+            bound_us=0.0,          # accuracy preserved; a failover pause
+            fix="restart the replica and re-add it; rendezvous routing "
+                "will move ~1/R of the sessions back")
+        perf.add("serve_replicas_lost")
+        report = {"victim": victim, "sessions": orphans, "replayed": 0,
+                  "deduped": 0, "requests_lost": 0}
+        for sid in orphans:
+            name = route.owner(sid, survivors)
+            code, out, _ = http_json(
+                self._url(name) + "/v1/migrate/in",
+                {"sid": sid, "handoff_dir": dead["dir"]},
+                timeout=float(knobs.get("PINT_TPU_MIGRATE_TIMEOUT_S")))
+            if code != 200:
+                raise RuntimeError(
+                    f"absorb of {sid!r} into {name} failed: {out}")
+            with self._lock:
+                self.sessions[sid] = name
+            for k in ("replayed", "deduped", "requests_lost"):
+                report[k] += out.get(k, 0)
+        log.info(f"absorbed replica {victim!r}: {len(orphans)} "
+                 f"session(s) onto {sorted(survivors)} "
+                 f"({report['replayed']} replayed, "
+                 f"{report['requests_lost']} lost)")
+        return report
+
+    # -- merged telemetry --------------------------------------------------------
+
+    def merged_sketches(self) -> dict:
+        """Fleet-wide latency sketches: every replica's marshalled
+        QuantileSketches folded grid-exactly (perf.QuantileSketch
+        merge) — fleet p50/p99 with zero information loss."""
+        merged: dict[str, perf.QuantileSketch] = {}
+        with self._lock:
+            urls = [r["url"] for r in self.replicas.values()]
+        for u in urls:
+            code, payload, _ = http_json(u + "/v1/sketches")
+            if code != 200:
+                continue
+            for name, d in payload.items():
+                sk = perf.QuantileSketch.from_dict(d)
+                if name in merged:
+                    merged[name].merge(sk)
+                else:
+                    merged[name] = sk
+        return merged
+
+    def render_metrics(self) -> str:
+        """One OpenMetrics page for the whole fleet: replica counters
+        and gauges summed sample-by-sample, summary quantiles replaced
+        by the LOSSLESSLY merged fleet sketches, this process's own
+        gateway counters included."""
+        totals: dict[str, float] = {}
+        texts = [obs_metrics.registry().render()]
+        with self._lock:
+            urls = [r["url"] for r in self.replicas.values()]
+        for u in urls:
+            try:
+                with urllib.request.urlopen(u + "/metrics",
+                                            timeout=10.0) as resp:
+                    texts.append(resp.read().decode())
+            except (OSError, urllib.error.URLError):
+                continue           # a dead replica scrapes as absent
+        for t in texts:
+            samples, _ = obs_metrics.parse_openmetrics(t)
+            for k, v in samples.items():
+                if 'quantile="' in k:
+                    continue       # per-replica quantiles do not sum
+                totals[k] = totals.get(k, 0.0) + v
+        lines = [f"{k} {v:g}" for k, v in sorted(totals.items())]
+        for name, sk in sorted(self.merged_sketches().items()):
+            full = obs_metrics.PREFIX + "fleet_" + name
+            for q in (0.5, 0.9, 0.99):
+                v = sk.quantile(q)
+                if v is not None:
+                    lines.append(f'{full}{{quantile="{q:g}"}} {v:g}')
+        lines.append("# EOF")
+        return "\n".join(lines)
+
+    def health(self) -> tuple[bool, dict]:
+        with self._lock:
+            members = dict(self.replicas)
+        detail = {"replicas": {}, "sessions": len(self.sessions)}
+        ok = bool(members)
+        for name, r in members.items():
+            code, payload, _ = http_json(r["url"] + "/healthz",
+                                         timeout=10.0)
+            detail["replicas"][name] = {"ok": code == 200,
+                                        "queued": payload.get("queued")}
+            ok = ok and code == 200
+        return ok, detail
+
+    # -- the HTTP front door -----------------------------------------------------
+
+    def start(self) -> int:
+        fg = self
+
+        from http.server import BaseHTTPRequestHandler
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):  # noqa: A003 — silence stdlib access logs
+                pass
+
+            def _reply(self, code: int, body: bytes, ctype: str,
+                       headers: dict | None = None) -> None:
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                for k, v in (headers or {}).items():
+                    self.send_header(k, v)
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _json(self, code: int, payload: dict,
+                      headers: dict | None = None) -> None:
+                self._reply(code, json.dumps(payload).encode(),
+                            "application/json", headers)
+
+            def do_GET(self):  # noqa: N802 — stdlib handler API
+                path = self.path.split("?")[0]
+                if path == "/metrics":
+                    self._reply(200, fg.render_metrics().encode(),
+                                "application/openmetrics-text; "
+                                "version=1.0.0; charset=utf-8")
+                    return
+                if path == "/healthz":
+                    ok, detail = fg.health()
+                    self._json(200 if ok else 503,
+                               dict(detail, ok=bool(ok)))
+                    return
+                if path == "/v1/sketches":
+                    self._json(200, {n: sk.to_dict() for n, sk in
+                                     fg.merged_sketches().items()})
+                    return
+                if path == "/v1/sessions":
+                    self._json(200, {"sessions": dict(fg.sessions)})
+                    return
+                self._json(404, {"error": "unknown",
+                                 "detail": path})
+
+            def do_POST(self):  # noqa: N802 — stdlib handler API
+                from urllib.parse import parse_qsl, urlsplit
+
+                path = self.path.split("?")[0]
+                q = dict(parse_qsl(urlsplit(self.path).query))
+                n = int(self.headers.get("Content-Length") or 0)
+                try:
+                    body = json.loads(self.rfile.read(n) or b"{}")
+                    if path == "/v1/submit":
+                        code, payload, hdrs = fg.proxy_submit(
+                            body, wait=q.get("wait", "1") != "0",
+                            timeout=float(q.get("timeout_s", "60")))
+                        tid = hdrs.get("X-Pint-Trace", "")
+                        self._json(code, payload,
+                                   {"X-Pint-Trace": tid} if tid else None)
+                        return
+                    if path == "/v1/migrate":
+                        self._json(200, fg.migrate(body["sid"],
+                                                   body["target"]))
+                        return
+                    if path == "/v1/absorb":
+                        self._json(200, fg.absorb(body["victim"]))
+                        return
+                    self._json(404, {"error": "unknown", "detail": path})
+                except Exception as e:  # noqa: BLE001 — mapped to a wire status, never a stack dump on the socket  # jaxlint: disable=silent-except
+                    code, kind = _status_of(e)
+                    self._json(code, {"error": kind, "detail": str(e)})
+
+        port = self._serve(Handler, self.port)
+        log.info(f"fleet gateway on 127.0.0.1:{port} "
+                 f"({len(self.replicas)} replica(s))")
+        return port

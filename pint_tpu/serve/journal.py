@@ -176,9 +176,12 @@ class RequestJournal:
     # -- writes ----------------------------------------------------------------------
 
     def _write_record(self, rec: dict) -> None:
-        payload = json.dumps(rec, separators=(",", ":")).encode()
+        self._write_payload(json.dumps(rec, separators=(",", ":")).encode(),
+                            rec.get("seq"))
+
+    def _write_payload(self, payload: bytes, seq) -> None:
         frame = _FRAME.pack(len(payload), zlib.crc32(payload))
-        mode = faults.trip("serve.journal", f"seq:{rec.get('seq')}")
+        mode = faults.trip("serve.journal", f"seq:{seq}")
         if mode == "torn":
             # a genuinely torn frame: the header plus half the payload
             # reach the OS, then the "process dies" (the raise) — the
@@ -188,7 +191,7 @@ class RequestJournal:
             os.fsync(self._fh.fileno())
             raise JournalError(
                 "injected torn journal write (serve.journal:torn) at "
-                f"record seq {rec.get('seq')}")
+                f"record seq {seq}")
         if mode == "corrupt":
             # silent bit rot: the frame promises the original crc but
             # the payload lies — only the read path can catch it
@@ -218,12 +221,35 @@ class RequestJournal:
         # staged as "journal" only: the caller (ServingEngine.submit) is
         # already inside the "serve" root, so the WAL wall lands at
         # serve/journal in the serve_breakdown attribution
-        with self._lock, perf.stage("journal"):
-            self.seq += 1
-            rec = dict(rec, op="request", seq=self.seq)
-            self._write_record(rec)
-            self.appended += 1
+        with perf.stage("journal"):
+            # two-phase append: the seq reservation is the only thing the
+            # JSON encode needs, so the encode — the CPU-bound half of a
+            # large-rows append, easily hundreds of µs — runs OUTSIDE the
+            # journal lock and concurrent submits serialize only on the
+            # actual frame write. Seq order and byte order may differ
+            # under contention; replay orders by seq, not byte position.
+            with self._lock:
+                self.seq += 1
+                seq = self.seq
+            payload = json.dumps(dict(rec, op="request", seq=seq),
+                                 separators=(",", ":")).encode()
+            with self._lock:
+                self._write_payload(payload, seq)
+                self.appended += 1
             perf.add("serve_journal_records")
+            return seq
+
+    def mark(self, op: str, **fields) -> int:
+        """Durably append (and fsync) a non-request marker record — the
+        migration handoff's ``migrate_out``/``migrate_in`` ownership
+        markers (serve/migrate.py). Recovery treats the marked session's
+        earlier records as moved, not lost."""
+        with self._lock:
+            self.seq += 1
+            self._write_record({"op": op, "seq": self.seq, **fields})
+            self._fh.flush()
+            self._fsync_timed()
+            self._unsynced = 0
             return self.seq
 
     def fsync(self) -> None:
@@ -364,6 +390,10 @@ def replay_records(dirpath: str | Path) -> tuple[list[dict], dict]:
                 _quarantine_segment(
                     seg, f"mid-journal truncation at byte {off}")
                 report["corrupt_segments"] += 1
+    # canonical order is seq, not byte position: the two-phase append
+    # serializes frame writes but not seq reservation, so two contending
+    # submits may land on disk swapped — replay must not care
+    records.sort(key=lambda r: r.get("seq", 0))
     report["clean_close"] = bool(records) and records[-1]["op"] == "close"
     # the replay suffix: everything after the last checkpoint marker
     last_ck = max((i for i, r in enumerate(records)

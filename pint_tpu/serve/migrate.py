@@ -1,0 +1,260 @@
+"""Live session migration: checkpoint + journal-suffix handoff.
+
+A replicated fleet (serve/fleet.py) rebalances by MOVING a warm session
+between replicas without losing a single admitted request. The handoff
+is assembled from the proven durability pieces (ISSUE 14, serve/
+recover.py + serve/journal.py) — nothing here invents a new encoding:
+
+- **Export** (:func:`export_session`, on the source replica): under the
+  session's restore/evict mutex, capture a
+  :class:`~pint_tpu.serve.pool.SessionCheckpoint` (exact ``FitterState``
+  solution + raw TOA rows + the idempotency keys already applied) into
+  ``<handoff>/sessions/<sid>.ckpt`` (crc32-framed, atomic), copy the
+  session's post-checkpoint journal suffix into ``<handoff>/journal/``
+  as ordinary framed journal records, then forget the session — the
+  source no longer owns it. A ``migrate_out`` marker in the source
+  journal makes the ownership transfer itself durable: a source crash
+  after the handoff does not count the moved session's old records as
+  lost.
+- **Import** (:func:`import_session`, on the target replica): restore
+  the checkpoint into the warm pool (zero traces in a warmed shared-
+  cache environment — the whole point of migrating instead of cold-
+  starting), then replay the handoff journal suffix with
+  idempotency-key dedup: a request that landed in the checkpoint AND
+  survives in the suffix is applied exactly once. The report locks
+  ``requests_lost == 0``.
+
+Every migration is a ledger-visible ``serve.migrate`` degradation
+(ops/degrade.py) — the session paused for the handoff — refusable under
+``PINT_TPU_DEGRADED=error`` and drillable end-to-end via the
+``serve.migrate:force`` fault site. ``PINT_TPU_MIGRATE_TIMEOUT_S``
+bounds the whole handoff; past it :class:`MigrateError` is raised and
+the fleet keeps the session where it was rather than stalling.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import zlib
+from pathlib import Path
+
+from pint_tpu.obs import flight
+from pint_tpu.ops import degrade, perf
+from pint_tpu.serve.journal import _FRAME, replay_records
+
+
+def _read_live_records(journal) -> list[dict]:
+    """Every whole post-checkpoint record in a LIVE journal, read under
+    its lock (so no writer is mid-frame) and WITHOUT the mutating repair
+    steps :func:`replay_records` applies to a dead one — truncating a
+    live segment under an open appending handle would eat a record."""
+    records: list[dict] = []
+    with journal._lock:
+        journal._fh.flush()
+        for seg in journal.segments():
+            data = seg.read_bytes()
+            off = 0
+            while off + _FRAME.size <= len(data):
+                length, crc = _FRAME.unpack_from(data, off)
+                payload = data[off + _FRAME.size:
+                               off + _FRAME.size + length]
+                if len(payload) < length or zlib.crc32(payload) != crc:
+                    break
+                try:
+                    records.append(json.loads(payload))
+                except ValueError:
+                    break
+                off += _FRAME.size + length
+    records.sort(key=lambda r: r.get("seq", 0))
+    last_ck = max((i for i, r in enumerate(records)
+                   if r.get("op") == "checkpoint"), default=-1)
+    return records[last_ck + 1:]
+from pint_tpu.serve.recover import _read_checkpoint, _write_checkpoint
+from pint_tpu.utils import knobs
+from pint_tpu.utils.logging import get_logger
+
+log = get_logger("pint_tpu.serve")
+
+__all__ = ["MigrateError", "export_session", "import_session",
+           "migrate_session"]
+
+
+class MigrateError(RuntimeError):
+    """The handoff could not complete (timeout, missing session, corrupt
+    handoff dir); the session stays where it last was — migration fails
+    closed, it never halves a session between replicas."""
+
+
+def _handoff_paths(handoff_dir: str | Path) -> tuple[Path, Path]:
+    root = Path(handoff_dir)
+    return root / "sessions", root / "journal"
+
+
+def export_session(engine, sid: str, handoff_dir: str | Path) -> dict:
+    """Capture ``sid`` from ``engine`` into a durable handoff directory
+    and release ownership (see module docstring). Returns the export
+    report: ``{"sid", "n_toas", "suffix_records", "export_s"}``.
+
+    The per-session mutex is held for the whole capture, so the
+    checkpoint can never freeze a half-applied append; the engine keeps
+    serving every OTHER session meanwhile."""
+    from pint_tpu.serve.pool import SessionCheckpoint
+
+    t0 = time.perf_counter()
+    sdir, jdir = _handoff_paths(handoff_dir)
+    sdir.mkdir(parents=True, exist_ok=True)
+    jdir.mkdir(parents=True, exist_ok=True)
+    pool = engine.pool
+    with perf.stage("serve"), perf.stage("checkpoint"), \
+            pool.session_lock(sid):
+        with pool._lock:
+            ses = pool._live.get(sid)
+            ck = (SessionCheckpoint.capture(ses) if ses is not None
+                  else pool._checkpoints.get(sid))
+        if ck is None:
+            raise MigrateError(f"unknown session {sid!r}; nothing to "
+                               "export")
+        _write_checkpoint(sdir / f"{sid}.ckpt", ck)
+        # the session's post-checkpoint journal suffix rides along as
+        # ordinary framed records: the target replays them through the
+        # same idempotency dedup recovery uses — requests the checkpoint
+        # already captured are skipped, the rest apply exactly once
+        suffix = []
+        if engine.journal is not None:
+            suffix = [r for r in _read_live_records(engine.journal)
+                      if r.get("op") == "request"
+                      and r.get("session") == sid]
+            with open(jdir / "journal-000001.wal", "ab") as fh:
+                for rec in suffix:
+                    payload = json.dumps(
+                        rec, separators=(",", ":")).encode()
+                    fh.write(_FRAME.pack(len(payload),
+                                         zlib.crc32(payload)))
+                    fh.write(payload)
+                fh.flush()
+            # durable ownership transfer: a source crash after this
+            # marker must not count the moved session's records as lost
+            engine.journal.mark("migrate_out", sid=sid)
+        pool.remove(sid)
+        if engine.durable_dir is not None:
+            # the source's own durable store forgets the session too: a
+            # later source recovery must not resurrect a moved session
+            own = Path(engine.durable_dir) / "sessions" / f"{sid}.ckpt"
+            own.unlink(missing_ok=True)
+    report = {
+        "sid": sid,
+        "n_toas": ck.n_toas,
+        "suffix_records": len(suffix),
+        "export_s": round(time.perf_counter() - t0, 4),
+    }
+    flight.note("migrate.export", session=sid, n_toas=ck.n_toas,
+                suffix=len(suffix))
+    log.info(f"exported session {sid!r} for migration "
+             f"({ck.n_toas} TOAs, {len(suffix)} suffix record(s))")
+    return report
+
+
+def import_session(engine, handoff_dir: str | Path,
+                   sid: str | None = None) -> dict:
+    """Restore a handed-off session into ``engine`` and replay its
+    journal suffix with idempotency dedup (see module docstring).
+    ``sid=None`` imports every session in the handoff directory.
+    Returns ``{"sids", "replayed", "deduped", "requests_lost",
+    "import_s"}`` — the migration contract locks ``requests_lost`` at 0.
+    """
+    from pint_tpu.serve.journal import decode_rows
+
+    t0 = time.perf_counter()
+    sdir, jdir = _handoff_paths(handoff_dir)
+    paths = ([sdir / f"{sid}.ckpt"] if sid is not None
+             else sorted(sdir.glob("*.ckpt")))
+    if not paths or not all(p.exists() for p in paths):
+        raise MigrateError(
+            f"handoff directory {handoff_dir} carries no checkpoint for "
+            f"{sid if sid is not None else 'any session'!r}")
+    pool = engine.pool
+    sids: list[str] = []
+    with perf.stage("serve"), perf.stage("recover"):
+        for path in paths:
+            ck = _read_checkpoint(path)
+            with pool.session_lock(path.stem):
+                pool.put(path.stem, ck.restore())
+                pool.restores += 1
+            sids.append(path.stem)
+    replayed = deduped = lost = 0
+    records, _ = (replay_records(jdir) if jdir.exists() else ([], None))
+    with perf.stage("serve"), perf.stage("replay"):
+        for rec in records:
+            if rec.get("op") != "request" or rec["session"] not in sids:
+                continue
+            ses = pool.get(rec["session"])
+            if rec.get("idem") in ses.applied_idem:
+                deduped += 1           # already inside the checkpoint
+                continue
+            if rec["kind"] == "append":
+                ses.append(**decode_rows(rec["rows"]))
+            else:
+                from pint_tpu.serve.session import batch_refit
+
+                batch_refit([ses], maxiter=engine.maxiter)
+            if rec.get("idem"):
+                ses.applied_idem.add(rec["idem"])
+            replayed += 1
+    # the target now owns the sessions durably: checkpoint them into its
+    # OWN store (and mark the journal) so a target crash right after the
+    # handoff still recovers them
+    if engine.durable_dir is not None:
+        own = Path(engine.durable_dir) / "sessions"
+        own.mkdir(parents=True, exist_ok=True)
+        from pint_tpu.serve.pool import SessionCheckpoint
+
+        for s in sids:
+            with pool.session_lock(s):
+                _write_checkpoint(own / f"{s}.ckpt",
+                                  SessionCheckpoint.capture(pool.get(s)))
+    if engine.journal is not None:
+        for s in sids:
+            engine.journal.mark("migrate_in", sid=s)
+    for s in sids:
+        perf.add("serve_migrations")
+        degrade.record(
+            "serve.migrate", f"session:{s}",
+            f"session {s!r} live-migrated onto this replica (checkpoint "
+            f"+ {replayed} journal-suffix record(s) replayed, {deduped} "
+            "deduped); the session paused for the handoff, no request "
+            "was lost",
+            bound_us=0.0,              # accuracy preserved; a pause, not an error
+            fix="none needed — rebalancing is routine; raise "
+                "PINT_TPU_MIGRATE_TIMEOUT_S if handoffs time out")
+    report = {
+        "sids": sids,
+        "replayed": replayed,
+        "deduped": deduped,
+        "requests_lost": lost,
+        "import_s": round(time.perf_counter() - t0, 4),
+    }
+    flight.note("migrate.import", sessions=len(sids), replayed=replayed,
+                deduped=deduped)
+    log.info(f"imported migrated session(s) {sids}: {replayed} "
+             f"replayed, {deduped} deduped, {lost} lost")
+    return report
+
+
+def migrate_session(src, dst, sid: str,
+                    handoff_dir: str | Path) -> dict:
+    """One-call in-process migration: export from ``src``, import into
+    ``dst``, bounded by ``PINT_TPU_MIGRATE_TIMEOUT_S``. Returns the
+    merged report (export + import keys). The fleet's cross-process path
+    drives the same two halves over HTTP (serve/gateway.py)."""
+    budget = float(knobs.get("PINT_TPU_MIGRATE_TIMEOUT_S"))
+    t0 = time.perf_counter()
+    out = export_session(src, sid, handoff_dir)
+    if time.perf_counter() - t0 > budget:
+        raise MigrateError(
+            f"migration of {sid!r} blew its {budget:.0f}s budget during "
+            "export; the handoff checkpoint is durable — re-import it "
+            "explicitly or raise PINT_TPU_MIGRATE_TIMEOUT_S")
+    out.update(import_session(dst, handoff_dir, sid=sid))
+    out["migrate_s"] = round(time.perf_counter() - t0, 4)
+    return out

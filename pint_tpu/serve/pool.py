@@ -132,6 +132,12 @@ class SessionPool:
         # pool concurrently (an OrderedDict mutated from two threads
         # corrupts); the session OBJECTS stay single-dispatcher
         self._lock = threading.RLock()
+        # per-session restore/evict mutexes (see session_lock): held
+        # across a restore (which runs OUTSIDE the global lock) and by
+        # the dispatcher while it mutates the session, so an eviction
+        # can never capture a checkpoint of a session mid-restore or
+        # mid-append — eviction try-acquires and skips a busy session
+        self._sess_locks: dict[str, threading.RLock] = {}
         self.hits = 0
         self.evictions = 0
         self.restores = 0
@@ -151,18 +157,45 @@ class SessionPool:
             return list(self._live) + [s for s in self._checkpoints
                                        if s not in self._live]
 
-    def _evict(self, sid: str) -> None:
-        session = self._live.pop(sid)
-        self._checkpoints[sid] = SessionCheckpoint.capture(session)
-        self.evictions += 1
-        perf.add("serve_evictions")
-        degrade.record(
-            "serve.evict", f"session:{sid}",
-            f"warm session {sid!r} evicted at pool capacity "
-            f"{self.capacity}; next request pays a checkpoint restore",
-            bound_us=0.0,  # accuracy preserved; the restore latency lost
-            fix="raise PINT_TPU_SERVE_POOL_SESSIONS or shard the fleet "
-                "across more processes")
+    def session_lock(self, sid: str) -> threading.RLock:
+        """The per-session restore/evict mutex for ``sid`` (created on
+        first use, reentrant). Held by :meth:`get` across a checkpoint
+        restore and by the serving dispatcher while it mutates the
+        session, so a concurrent eviction (which try-acquires it) can
+        never capture a checkpoint of a half-restored or half-appended
+        session — the race a watchdog replacement worker used to lose."""
+        with self._lock:
+            lk = self._sess_locks.get(sid)
+            if lk is None:
+                lk = self._sess_locks[sid] = threading.RLock()
+            return lk
+
+    def _evict(self, sid: str) -> bool:
+        """Capture + drop ``sid`` (caller holds the global lock). The
+        per-session mutex is try-acquired: a session pinned by a
+        concurrent restore or an in-flight dispatch is NOT evictable —
+        returns False and the caller picks another victim — because a
+        checkpoint captured mid-mutation would lose the mutation."""
+        lk = self._sess_locks.get(sid)
+        if lk is None:
+            lk = self._sess_locks[sid] = threading.RLock()
+        if not lk.acquire(blocking=False):
+            return False
+        try:
+            session = self._live.pop(sid)
+            self._checkpoints[sid] = SessionCheckpoint.capture(session)
+            self.evictions += 1
+            perf.add("serve_evictions")
+            degrade.record(
+                "serve.evict", f"session:{sid}",
+                f"warm session {sid!r} evicted at pool capacity "
+                f"{self.capacity}; next request pays a checkpoint restore",
+                bound_us=0.0,  # accuracy preserved; the restore latency lost
+                fix="raise PINT_TPU_SERVE_POOL_SESSIONS or shard the fleet "
+                    "across more processes")
+        finally:
+            lk.release()
+        return True
 
     def put(self, sid: str, session: TimingSession) -> None:
         """Register (or re-insert) a live session; evicts the LRU
@@ -177,30 +210,55 @@ class SessionPool:
                 return
             while len(self._live) >= self.capacity:
                 # the ledger write (and any PINT_TPU_DEGRADED=error
-                # raise) happens inside _evict, checkpoint captured first
-                self._evict(next(iter(self._live)))
+                # raise) happens inside _evict, checkpoint captured
+                # first; a victim pinned by a concurrent restore/
+                # dispatch is skipped (evicting it would capture a
+                # half-mutated session)
+                if not any(self._evict(cand) for cand in list(self._live)):
+                    log.warning(
+                        f"session pool over capacity ({len(self._live)} "
+                        f">= {self.capacity}) with every victim pinned "
+                        "by a concurrent restore/dispatch; admitting "
+                        f"{sid!r} over capacity")
+                    break
             self._live[sid] = session
             self._checkpoints.pop(sid, None)
 
+    def remove(self, sid: str) -> None:
+        """Forget ``sid`` entirely — live session and checkpoint. The
+        migration export path (serve/migrate.py) calls this after the
+        handoff checkpoint is written: the source replica no longer
+        owns the session. Unknown sids are a no-op."""
+        with self.session_lock(sid):
+            with self._lock:
+                self._live.pop(sid, None)
+                self._checkpoints.pop(sid, None)
+
     def get(self, sid: str) -> TimingSession:
         """The live session for ``sid``, restoring from its checkpoint
-        when evicted. Unknown sids raise KeyError."""
-        with self._lock:
-            if (sid in self._live
-                    and faults.trip("serve.pool",
-                                    f"session:{sid}") is not None):
-                # fault drill: evict the requested session so THIS
-                # request pays the restore path
-                # (PINT_TPU_FAULTS=serve.pool:evict)
-                self._evict(sid)
-            session = self._live.get(sid)
-            if session is not None:
-                self._live.move_to_end(sid)
-                self.hits += 1
-                return session
-            ck = self._checkpoints.get(sid)
-            if ck is None:
-                raise KeyError(f"unknown session {sid!r}")
+        when evicted. Unknown sids raise KeyError. The restore runs
+        under the per-session mutex but OUTSIDE the global lock: a
+        multi-second re-prepare must not block the whole pool, and two
+        threads racing for the same evicted session restore it once
+        (the loser blocks, then takes the warm fast path)."""
+        with self.session_lock(sid):
+            with self._lock:
+                if (sid in self._live
+                        and faults.trip("serve.pool",
+                                        f"session:{sid}") is not None):
+                    # fault drill: evict the requested session so THIS
+                    # request pays the restore path
+                    # (PINT_TPU_FAULTS=serve.pool:evict); the acquire
+                    # inside _evict is reentrant — same thread
+                    self._evict(sid)
+                session = self._live.get(sid)
+                if session is not None:
+                    self._live.move_to_end(sid)
+                    self.hits += 1
+                    return session
+                ck = self._checkpoints.get(sid)
+                if ck is None:
+                    raise KeyError(f"unknown session {sid!r}")
             t0 = time.perf_counter()
             with perf.stage("restore"):
                 session = ck.restore()

@@ -174,11 +174,25 @@ def recover_fleet(dirpath: str | Path, *, replay: bool = True,
     replayed = deduped = lost = 0
     t1 = time.perf_counter()
     if replay and not jreport["clean_close"]:
+        # live-migration ownership markers (serve/migrate.py): a
+        # ``migrate_out`` voids the session's EARLIER records — they
+        # moved with it, another replica owns them now — unless a later
+        # ``migrate_in`` handed the session back. Pre-scan for each
+        # session's last ownership transfer, then skip request records
+        # it covers.
+        moved_out_at: dict = {}
+        for rec in records:
+            if rec.get("op") == "migrate_out":
+                moved_out_at[rec.get("sid")] = rec.get("seq", 0)
+            elif rec.get("op") == "migrate_in":
+                moved_out_at.pop(rec.get("sid"), None)
         with perf.stage("serve"), perf.stage("replay"):
             for rec in records:
                 if rec.get("op") != "request":
                     continue
                 sid = rec["session"]
+                if rec.get("seq", 0) < moved_out_at.get(sid, -1):
+                    continue           # moved with the session, not lost
                 if sid not in pool:
                     lost += 1
                     log.error(f"journal record seq {rec['seq']} names "

@@ -1,0 +1,58 @@
+"""Consistent session→replica routing: rendezvous (HRW) hashing.
+
+A replicated serving fleet (serve/fleet.py) needs every component that
+routes a request — the gateway, the controller, a recovering survivor —
+to agree on which replica owns a session WITHOUT a coordination service.
+Rendezvous (highest-random-weight) hashing gives exactly that: every
+router computes ``score(session, replica) = blake2b(replica || session)``
+for the live replica set and picks the max. Properties the fleet leans
+on (locked by tests/test_fleet.py):
+
+- **Deterministic + coordination-free** — same inputs, same owner, in
+  any process, forever (the hash is keyed content, never id()/seed).
+- **Minimal disruption** — adding a replica to a fleet of R steals only
+  the sessions whose new score beats every old one: ~1/(R+1) of the
+  keyspace moves, everything else stays warm where it is. Removing a
+  replica reassigns ONLY its own sessions, spread over the survivors by
+  the same scores — which is why a crashed replica's sessions can be
+  absorbed by recomputing ``owner(sid, survivors)`` with no handoff
+  table (serve/fleet.py ``absorb``).
+- **Uniform** — scores are independent uniform hashes, so S sessions
+  spread ~S/R per replica without a rebalancing pass.
+
+Explicit placement overrides (a live migration pinning a hot session to
+a chosen replica) layer ON TOP of this in the fleet's routing table —
+the pure function here never carries state.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+__all__ = ["owner", "rank", "score"]
+
+
+def score(replica: str, key: str) -> int:
+    """The HRW weight of ``replica`` for ``key``: a 64-bit keyed hash,
+    stable across processes and Python versions (blake2b is seedless —
+    unlike ``hash()``, which PYTHONHASHSEED perturbs per process)."""
+    h = hashlib.blake2b(digest_size=8)
+    h.update(replica.encode())
+    h.update(b"\x00")                  # unambiguous (replica, key) framing
+    h.update(key.encode())
+    return int.from_bytes(h.digest(), "big")
+
+
+def rank(key: str, replicas) -> list[str]:
+    """Every replica ordered by descending HRW score for ``key`` (ties
+    broken by name so the order is total). ``rank(...)[0]`` is the
+    owner; ``rank(...)[1]`` is the natural failover target."""
+    reps = sorted(set(replicas))
+    if not reps:
+        raise ValueError("cannot route: empty replica set")
+    return sorted(reps, key=lambda r: (-score(r, key), r))
+
+
+def owner(key: str, replicas) -> str:
+    """The replica that owns ``key`` under rendezvous hashing."""
+    return rank(key, replicas)[0]

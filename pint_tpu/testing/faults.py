@@ -52,7 +52,18 @@ site                      armed modes
 ``serve.crash``           ``exit`` — the dispatch path calls
                           ``os._exit`` mid-trace (admitted + journaled,
                           not applied): the kill-mid-trace recovery
-                          drill (serve/engine.py, tests/test_recover.py)
+                          drill (serve/engine.py, tests/test_recover.py);
+                          in a replicated fleet the same site is the
+                          kill-one-replica chaos drill — survivors
+                          absorb the victim's sessions with
+                          ``serve.replica_lost`` on the ledger
+                          (serve/fleet.py, bench.py --smoke --fleet)
+``serve.migrate``         ``force`` — :func:`trip` makes the fleet
+                          controller live-migrate the target session to
+                          another replica before forwarding the request,
+                          driving the ``serve.migrate``
+                          checkpoint-handoff path end-to-end
+                          (serve/fleet.py)
 ========================  =====================================================
 
 Arming
@@ -82,8 +93,8 @@ from dataclasses import dataclass
 
 from pint_tpu.utils import knobs
 
-__all__ = ["KIND_DRILLS", "arm", "fired", "mangle", "maybe_raise", "armed",
-           "poison_nonfinite", "reset", "trip"]
+__all__ = ["KIND_DRILLS", "arm", "arm_spec", "fired", "mangle",
+           "maybe_raise", "armed", "poison_nonfinite", "reset", "trip"]
 
 #: the fault-taxonomy completeness contract (tests/test_degrade.py gate):
 #: EVERY degradation kind registered in ops/degrade.py KINDS maps here to
@@ -119,6 +130,8 @@ KIND_DRILLS: dict[str, tuple] = {
     "serve.quarantine": ("site", "serve.dispatch", "fail"),
     "serve.journal_truncated": ("site", "serve.journal", "torn"),
     "serve.journal_corrupt": ("site", "serve.journal", "corrupt"),
+    "serve.migrate": ("site", "serve.migrate", "force"),
+    "serve.replica_lost": ("site", "serve.crash", "exit"),
     "fetch.mirror_failed": ("site", "fetch", "refuse"),
     "fetch.corrupt_quarantined": ("site", "fetch.payload", "corrupt"),
     "obs.zero_velocity": (
@@ -156,6 +169,18 @@ def arm(site: str, fault_mode: str, times: int | None = 1) -> None:
     (None = every firing until :func:`reset`)."""
     with _lock:
         _armed[site] = _Fault(fault_mode, times)
+
+
+def arm_spec(spec: str) -> list[str]:
+    """Arm every fault in a ``site:mode[*N][,...]`` spec string (the
+    ``PINT_TPU_FAULTS`` grammar) programmatically — the remote-control
+    surface a fleet replica's ``/v1/fault`` endpoint exposes so a chaos
+    drill can arm a fault inside a running worker process without
+    touching its environment. Returns the armed site names."""
+    parsed = _parse_env(spec)
+    with _lock:
+        _armed.update(parsed)
+    return sorted(parsed)
 
 
 def _parse_env(raw: str) -> dict[str, _Fault]:

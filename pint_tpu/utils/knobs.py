@@ -75,6 +75,10 @@ KNOBS: dict[str, tuple[str | None, str]] = {
     "PINT_TPU_SERVE_QUARANTINE_FAILS": ("3", "consecutive failed dispatches after which a serving lane's session is quarantined (serve.quarantine)"),
     "PINT_TPU_SERVE_WATCHDOG_S": ("30", "serving watchdog threshold in s: a dispatch hung past it is abandoned, its session quarantined, the worker replaced; 0 disables"),
     "PINT_TPU_SERVE_JOURNAL_FSYNC": ("8", "write-ahead journal fsync batching: fsync every N records (1: every record, 0: only at rotation/close); records always flush to the OS before the ticket acks"),
+    # --- replicated serving fleet (serve/gateway.py, serve/fleet.py) -----------
+    "PINT_TPU_GATEWAY_PORT": ("0", "serve the HTTP gateway (submit/ticket/metrics, localhost) on this port; 0 = an ephemeral port chosen at bind"),
+    "PINT_TPU_FLEET_REPLICAS": ("2", "replica worker processes a ReplicaFleet spawns by default"),
+    "PINT_TPU_MIGRATE_TIMEOUT_S": ("30", "live session migration budget in s: a checkpoint-handoff (export + import + journal replay) past it fails the migration instead of stalling the fleet"),
     # --- observability (pint_tpu/obs/) -----------------------------------------
     "PINT_TPU_TRACE": ("0", "request tracing: 0 off (zero-cost), 1 on (spans as JSON Lines under <cache_root>/traces), any other value = the output directory"),
     "PINT_TPU_METRICS_PORT": ("0", "serve the OpenMetrics endpoint (/metrics + /healthz, localhost) on this port when the engine starts; 0 disables"),

@@ -1,0 +1,550 @@
+"""The replicated serving fleet (ISSUE 16): rendezvous routing, the
+replica gateway's HTTP surface, live session migration, the fleet
+gateway's routing/merging front door, and the session pool's per-session
+restore/evict mutex under concurrent load.
+
+Locks, bottom to top:
+
+- ``serve.route`` rendezvous hashing: deterministic and order-free,
+  adding a replica to a fleet of R moves ~1/(R+1) of the keys (all of
+  them TO the new replica), removing one reassigns ONLY its own keys.
+- ``SessionPool.session_lock``: an eviction can never capture a
+  checkpoint of a session mid-restore or mid-append (the try-acquire
+  skips pinned victims), two threads racing for the same evicted
+  session restore it exactly once, and a mutate/evict hammer loses no
+  update.
+- ``export_session``/``import_session`` (serve/migrate.py): an applied
+  + journaled request rides the handoff and is answered exactly once
+  (``deduped == 1``, ``requests_lost == 0``); the source forgets the
+  session; every migration is a ledger-visible ``serve.migrate``.
+- :class:`~pint_tpu.serve.gateway.Gateway`: submit ``wait=1`` answers
+  200 + the result + the ``X-Pint-Trace`` header, ``wait=0`` answers
+  202 and the ticket is pollable at ``/v1/tickets/<idem>``; unknown
+  sessions map to 404; the read surface (sessions/params/sketches)
+  matches the in-process engine.
+- :class:`~pint_tpu.serve.gateway.FleetGateway`: adoption pins
+  sessions to their replicas, proxied submits land on the owner, a
+  live migration moves the session and repins it, merged sketches fold
+  replica counts loss-lessly — and ``pint_tpu status --fleet`` renders
+  the same fleet into one report (exit 1 on an unreachable replica).
+"""
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from pint_tpu.astro import time as ptime
+from pint_tpu.fitting.state import snapshot
+from pint_tpu.ops import degrade
+from pint_tpu.serve import (FleetGateway, Gateway, MigrateError,
+                            ServingEngine, SessionPool, TimingSession,
+                            export_session, http_json, migrate_session,
+                            route)
+from pint_tpu.serve.journal import encode_rows
+from pint_tpu.testing import faults
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    degrade.reset_ledger()
+    faults.reset()
+    yield
+    degrade.reset_ledger()
+    faults.reset()
+
+
+@pytest.fixture(scope="module")
+def _module_cache_dir(tmp_path_factory):
+    """One content-addressed cache root shared by the whole module (the
+    tests/test_serve.py discipline): repeat fits hit the persistent
+    caches instead of rebuilding identical programs."""
+    return tmp_path_factory.mktemp("fleet_cache")
+
+
+@pytest.fixture(autouse=True)
+def _isolated_cache(_module_cache_dir, monkeypatch):
+    monkeypatch.setenv("PINT_TPU_CACHE_DIR", str(_module_cache_dir))
+    yield
+
+
+@pytest.fixture(scope="module")
+def _fleet_data(_module_cache_dir):
+    """Two fitted mixed-size sessions, captured as checkpoints ONCE per
+    module — each test restores its own fresh live session from the
+    checkpoint (the cheap warm path, answer within 1e-10 of the fit)
+    instead of paying a full fit per test."""
+    from pint_tpu.profiles import serve_smoke_fleet
+    from pint_tpu.serve.pool import SessionCheckpoint
+
+    prev = os.environ.get("PINT_TPU_CACHE_DIR")
+    os.environ["PINT_TPU_CACHE_DIR"] = str(_module_cache_dir)
+    try:
+        data = []
+        for model, full, base_n in serve_smoke_fleet(
+                (56, 64), n_append_rows=8, seed=51):
+            ses = TimingSession(
+                full.select(np.arange(len(full)) < base_n), model)
+            ses.fit(warm_appends=2)
+            data.append((model, full, base_n,
+                         SessionCheckpoint.capture(ses)))
+        return data
+    finally:
+        if prev is None:
+            os.environ.pop("PINT_TPU_CACHE_DIR", None)
+        else:
+            os.environ["PINT_TPU_CACHE_DIR"] = prev
+
+
+def _rows(full, lo, hi):
+    ep = full.utc_raw
+    return dict(utc=ptime.MJDEpoch(ep.day[lo:hi], ep.frac_hi[lo:hi],
+                                   ep.frac_lo[lo:hi]),
+                error_us=full.error_us[lo:hi],
+                freq_mhz=full.freq_mhz[lo:hi], obs=full.obs[lo:hi],
+                flags=[dict(f) for f in full.flags[lo:hi]])
+
+
+# --- rendezvous routing ------------------------------------------------------------
+
+
+class TestRendezvousRouting:
+    def test_rank_is_deterministic_and_order_free(self):
+        reps = [f"r{i}" for i in range(5)]
+        for key in ("psr0", "J0437-4715", "a" * 64):
+            ranked = route.rank(key, reps)
+            assert ranked == route.rank(key, tuple(reversed(reps)))
+            assert ranked == route.rank(key, set(reps))
+            assert sorted(ranked) == sorted(reps)
+            assert route.owner(key, reps) == ranked[0]
+
+    def test_empty_replica_set_refused(self):
+        with pytest.raises(ValueError, match="empty replica set"):
+            route.owner("psr0", [])
+
+    def test_add_replica_moves_about_one_over_r(self):
+        keys = [f"psr{i}" for i in range(400)]
+        old = [f"r{i}" for i in range(4)]
+        before = {k: route.owner(k, old) for k in keys}
+        after = {k: route.owner(k, old + ["r4"]) for k in keys}
+        moved = [k for k in keys if before[k] != after[k]]
+        # every moved key moved TO the new replica (nothing reshuffles
+        # between the old members), and ~1/5 of the keyspace moved
+        assert all(after[k] == "r4" for k in moved)
+        assert 0.05 * len(keys) <= len(moved) <= 0.40 * len(keys)
+
+    def test_remove_replica_reassigns_only_its_keys(self):
+        keys = [f"psr{i}" for i in range(400)]
+        reps = [f"r{i}" for i in range(4)]
+        before = {k: route.owner(k, reps) for k in keys}
+        survivors = [r for r in reps if r != "r2"]
+        after = {k: route.owner(k, survivors) for k in keys}
+        for k in keys:
+            if before[k] != "r2":
+                assert after[k] == before[k], k
+        # the victim's keys spread over MULTIPLE survivors (no single
+        # failover target inherits the whole load)
+        new_homes = {after[k] for k in keys if before[k] == "r2"}
+        assert len(new_homes) >= 2
+
+    def test_uniform_spread(self):
+        keys = [f"psr{i}" for i in range(400)]
+        reps = [f"r{i}" for i in range(4)]
+        counts = {r: 0 for r in reps}
+        for k in keys:
+            counts[route.owner(k, reps)] += 1
+        for r, c in counts.items():
+            assert len(keys) / len(reps) / 3 <= c <= \
+                3 * len(keys) / len(reps), counts
+
+
+# --- the per-session restore/evict mutex under load --------------------------------
+
+
+class _FakeSession:
+    def __init__(self, name):
+        self.name = name
+        self.applied = 0
+        self.busy = False          # set while a "dispatch" mutates us
+
+
+def _fake_checkpoint(state, restore_sleep=0.001):
+    """A SessionCheckpoint stand-in that records whether a capture ever
+    froze a mid-mutation session and how many restores ran at once."""
+    gate = threading.Lock()
+
+    class FakeCkpt:
+        def __init__(self, ses):
+            self.ses = ses
+            self.n_toas = ses.applied
+
+        @classmethod
+        def capture(cls, ses):
+            if ses.busy:
+                state["mid_mutation"] += 1
+            return cls(ses)
+
+        def restore(self):
+            with gate:
+                state["active"] += 1
+                state["max_active"] = max(state["max_active"],
+                                          state["active"])
+            time.sleep(restore_sleep)
+            with gate:
+                state["active"] -= 1
+            return self.ses
+
+    return FakeCkpt
+
+
+class TestSessionLock:
+    """The ISSUE 16 satellite: SessionPool's per-session mutex
+    serializes restore/evict against concurrent appends."""
+
+    def _pool(self, monkeypatch, state, capacity=1, restore_sleep=0.001):
+        from pint_tpu.serve import pool as pool_mod
+
+        monkeypatch.setattr(pool_mod, "SessionCheckpoint",
+                            _fake_checkpoint(state, restore_sleep))
+        return pool_mod.SessionPool(capacity=capacity)
+
+    def test_eviction_skips_locked_victim(self, monkeypatch):
+        state = {"mid_mutation": 0, "active": 0, "max_active": 0}
+        pool = self._pool(monkeypatch, state)
+        pool.put("hot", _FakeSession("hot"))
+        held, release = threading.Event(), threading.Event()
+
+        def holder():
+            with pool.session_lock("hot"):
+                held.set()
+                release.wait(10.0)
+
+        t = threading.Thread(target=holder, daemon=True)
+        t.start()
+        assert held.wait(5.0)
+        # capacity 1, the only victim is pinned by another thread: the
+        # pool admits over capacity rather than freezing a half-mutated
+        # checkpoint
+        pool.put("new", _FakeSession("new"))
+        assert "hot" in pool._live and "new" in pool._live
+        assert pool.evictions == 0
+        release.set()
+        t.join(5.0)
+        # unpinned, the next insert evicts normally
+        pool.put("new2", _FakeSession("new2"))
+        assert "hot" not in pool._live
+        assert "hot" in pool._checkpoints
+        assert state["mid_mutation"] == 0
+
+    def test_concurrent_get_restores_once(self, monkeypatch):
+        state = {"mid_mutation": 0, "active": 0, "max_active": 0}
+        pool = self._pool(monkeypatch, state, capacity=1,
+                          restore_sleep=0.05)
+        hot = _FakeSession("hot")
+        pool.put("hot", hot)
+        pool.put("cold", _FakeSession("cold"))     # evicts hot
+        assert "hot" in pool._checkpoints
+        barrier = threading.Barrier(2)
+        results = []
+
+        def getter():
+            barrier.wait(5.0)
+            results.append(pool.get("hot"))
+
+        threads = [threading.Thread(target=getter, daemon=True)
+                   for _ in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(10.0)
+        # the loser blocked on the mutex, then took the warm fast path
+        assert results == [hot, hot]
+        assert pool.restores == 1
+        assert state["max_active"] == 1
+
+    def test_mutate_evict_hammer_loses_nothing(self, monkeypatch):
+        state = {"mid_mutation": 0, "active": 0, "max_active": 0}
+        pool = self._pool(monkeypatch, state, capacity=1,
+                          restore_sleep=0.0005)
+        hot = _FakeSession("hot")
+        pool.put("hot", hot)
+        n = 200
+        errors = []
+
+        def mutate():
+            try:
+                for _ in range(n):
+                    # the dispatcher discipline: hold the session mutex
+                    # across the read-modify-write
+                    with pool.session_lock("hot"):
+                        ses = pool.get("hot")
+                        ses.busy = True
+                        v = ses.applied
+                        time.sleep(0.0002)
+                        ses.applied = v + 1
+                        ses.busy = False
+            except Exception as e:  # noqa: BLE001 — surfaced via the errors list  # jaxlint: disable=silent-except
+                errors.append(e)
+
+        def churn():
+            try:
+                for i in range(n):
+                    pool.put(f"cold{i % 3}",
+                             _FakeSession(f"cold{i % 3}"))
+                    time.sleep(0.0001)
+            except Exception as e:  # noqa: BLE001 — surfaced via the errors list  # jaxlint: disable=silent-except
+                errors.append(e)
+
+        threads = [threading.Thread(target=mutate, daemon=True),
+                   threading.Thread(target=churn, daemon=True)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(60.0)
+        assert not errors, errors
+        assert state["mid_mutation"] == 0
+        # force one final evict+restore cycle so the path is exercised
+        # even if the hammer's timing never caught "hot" unpinned
+        pool.put("force", _FakeSession("force"))
+        final = pool.get("hot")
+        assert final is hot
+        assert final.applied == n              # no update was lost
+        assert pool.restores >= 1
+        assert state["mid_mutation"] == 0
+
+
+# --- live migration, in process ----------------------------------------------------
+
+
+class TestMigrateInProcess:
+    def test_round_trip_answers_exactly_once(self, tmp_path,
+                                             _fleet_data):
+        model, full, base_n, ck = _fleet_data[0]
+        src = ServingEngine(SessionPool(capacity=2), max_wait_ms=5.0,
+                            durable_dir=str(tmp_path / "src"))
+        src.add_session("psr0", ck.restore())
+        t = src.submit(session="psr0", idem="m-1",
+                       **_rows(full, base_n, base_n + 2))
+        src.run_until_idle()
+        assert t.wait(timeout=60.0).path == "incremental"
+        dst = ServingEngine(SessionPool(capacity=2), max_wait_ms=5.0,
+                            durable_dir=str(tmp_path / "dst"))
+        rep = migrate_session(src, dst, "psr0", tmp_path / "handoff")
+        # m-1 rode BOTH the checkpoint and the journal suffix: the
+        # target's replay deduped it by key — answered exactly once
+        assert rep["deduped"] == 1
+        assert rep["replayed"] == 0
+        assert rep["requests_lost"] == 0
+        assert "psr0" not in src.pool          # the source forgot it
+        moved = dst.pool.get("psr0")
+        assert len(moved.toas) == base_n + 2
+        assert "m-1" in moved.applied_idem
+        assert "serve.migrate" in degrade.degradation_block()["kinds"]
+
+    def test_unknown_session_fails_closed(self, tmp_path):
+        engine = ServingEngine(SessionPool(capacity=2), max_wait_ms=5.0,
+                               durable_dir=str(tmp_path / "d"))
+        with pytest.raises(MigrateError, match="unknown session"):
+            export_session(engine, "ghost", tmp_path / "handoff")
+        with pytest.raises(MigrateError, match="no checkpoint"):
+            from pint_tpu.serve import import_session
+
+            import_session(engine, tmp_path / "nothing-here")
+
+
+# --- one replica's HTTP surface ----------------------------------------------------
+
+
+class TestGatewayHTTP:
+    @pytest.fixture()
+    def served(self, _fleet_data):
+        model, full, base_n, ck = _fleet_data[0]
+        engine = ServingEngine(SessionPool(capacity=2), max_wait_ms=5.0)
+        engine.add_session("psr0", ck.restore())
+        engine.start()
+        gw = Gateway(engine, port=0)
+        gw.start()
+        yield gw, engine, full, base_n
+        gw.stop()
+        engine.stop(drain=False)
+
+    def test_submit_wait_roundtrip_with_trace_header(self, served):
+        from pint_tpu.obs import trace
+
+        gw, engine, full, base_n = served
+        trace.configure(enable=True)   # the trace id is minted at submit
+        try:
+            code, payload, headers = http_json(
+                gw.url + "/v1/submit?wait=1&timeout_s=60",
+                {"session": "psr0", "kind": "append", "idem": "g-1",
+                 "rows": encode_rows(_rows(full, base_n, base_n + 2))})
+        finally:
+            trace.configure(enable=None)   # back to following the knob
+        assert code == 200, payload
+        assert payload["done"] is True
+        assert payload["path"] == "incremental"
+        assert headers.get("X-Pint-Trace")
+        # the wire served the SAME session the engine holds
+        code, p, _ = http_json(gw.url + "/v1/params?session=psr0")
+        assert code == 200
+        assert p["n_toas"] == base_n + 2
+        st = snapshot(engine.pool.get("psr0").fitter)
+        for name, (hi, lo) in st.params.items():
+            assert p["params"][name] == [hi, lo]
+
+    def test_submit_nowait_then_ticket_poll(self, served):
+        gw, engine, full, base_n = served
+        code, payload, _ = http_json(
+            gw.url + "/v1/submit?wait=0",
+            {"session": "psr0", "kind": "append", "idem": "g-2",
+             "rows": encode_rows(_rows(full, base_n + 2, base_n + 4))})
+        assert code == 202
+        assert payload == {"done": False, "idem": "g-2",
+                           "session": "psr0",
+                           "trace": payload["trace"]}
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            code, payload, _ = http_json(gw.url + "/v1/tickets/g-2")
+            if code != 202:
+                break
+            time.sleep(0.05)
+        assert code == 200, payload
+        assert payload["path"] == "incremental"
+        # unknown tickets are a 404, not a hang
+        code, payload, _ = http_json(gw.url + "/v1/tickets/never-was")
+        assert code == 404
+        assert payload["error"] == "unknown"
+
+    def test_unknown_session_maps_to_404(self, served):
+        gw, engine, full, base_n = served
+        code, payload, _ = http_json(
+            gw.url + "/v1/submit?wait=1",
+            {"session": "ghost", "kind": "append",
+             "rows": encode_rows(_rows(full, base_n, base_n + 1))})
+        assert code == 404
+        assert payload["error"] == "unknown"
+
+    def test_read_surface(self, served):
+        gw, engine, full, base_n = served
+        code, payload, _ = http_json(gw.url + "/v1/sessions")
+        assert code == 200 and payload["sessions"] == ["psr0"]
+        code, payload, _ = http_json(gw.url + "/healthz")
+        assert code == 200 and payload["ok"] is True
+        code, payload, _ = http_json(gw.url + "/v1/sketches")
+        assert code == 200
+        assert set(payload) == {"latency_ms", "refit_latency_ms",
+                                "queue_wait_ms", "submit_us"}
+        code, payload, _ = http_json(gw.url + "/v1/degraded")
+        assert code == 200 and "kinds" in payload
+
+
+# --- the fleet's front door --------------------------------------------------------
+
+
+class TestFleetGateway:
+    @pytest.fixture()
+    def fleet(self, _fleet_data, tmp_path):
+        gws, engines = [], []
+        for i, (model, full, base_n, ck) in enumerate(_fleet_data):
+            engine = ServingEngine(
+                SessionPool(capacity=2), max_wait_ms=5.0,
+                durable_dir=str(tmp_path / f"r{i}"))
+            engine.add_session(f"psr{i}", ck.restore())
+            engine.start()
+            gw = Gateway(engine, port=0)
+            gw.start()
+            engines.append(engine)
+            gws.append(gw)
+        fg = FleetGateway(handoff_root=tmp_path / "handoff")
+        for i, gw in enumerate(gws):
+            adopted = fg.add_replica(f"r{i}", gw.url,
+                                     durable_dir=tmp_path / f"r{i}")
+            assert adopted == [f"psr{i}"]
+        yield fg, gws, engines
+        for gw in gws:
+            gw.stop()
+        for engine in engines:
+            engine.stop(drain=False)
+
+    def test_routing_proxy_and_migration(self, fleet, _fleet_data,
+                                         tmp_path):
+        fg, gws, engines = fleet
+        model, full, base_n, ck = _fleet_data[1]
+        # adoption pinned each session to the replica that reported it
+        assert fg.replica_for("psr0") == "r0"
+        assert fg.replica_for("psr1") == "r1"
+        # an unknown session routes by rendezvous, stably
+        assert fg.replica_for("newcomer") == route.owner(
+            "newcomer", ["r0", "r1"])
+        # a proxied submit lands on the owner
+        code, payload, headers = fg.proxy_submit(
+            {"session": "psr1", "kind": "append", "idem": "f-1",
+             "rows": encode_rows(_rows(full, base_n, base_n + 2))})
+        assert code == 200, payload
+        assert payload["path"] == "incremental"
+        code, p, _ = http_json(gws[1].url + "/v1/params?session=psr1")
+        assert p["n_toas"] == base_n + 2
+        # live-migrate psr1 onto r0: repinned, moved, nothing lost
+        assert fg.migrate("psr1", "r1") == {"sid": "psr1", "noop": True}
+        rep = fg.migrate("psr1", "r0")
+        assert rep["requests_lost"] == 0
+        assert rep["source"] == "r1" and rep["target"] == "r0"
+        assert fg.replica_for("psr1") == "r0"
+        _, p0, _ = http_json(gws[0].url + "/v1/sessions")
+        _, p1, _ = http_json(gws[1].url + "/v1/sessions")
+        assert "psr1" in p0["sessions"]
+        assert "psr1" not in p1["sessions"]
+        # the post-migrate submit is served by the new owner
+        code, payload, _ = fg.proxy_submit(
+            {"session": "psr1", "kind": "append", "idem": "f-2",
+             "rows": encode_rows(_rows(full, base_n + 2, base_n + 4))})
+        assert code == 200, payload
+        code, p, _ = http_json(gws[0].url + "/v1/params?session=psr1")
+        assert p["n_toas"] == base_n + 4
+
+    def test_merged_sketches_fold_replica_counts(self, fleet,
+                                                 _fleet_data):
+        fg, gws, engines = fleet
+        for i, (model, full, base_n, ck) in enumerate(_fleet_data):
+            code, payload, _ = fg.proxy_submit(
+                {"session": f"psr{i}", "kind": "append",
+                 "idem": f"s-{i}",
+                 "rows": encode_rows(_rows(full, base_n, base_n + 2))})
+            assert code == 200, payload
+        merged = fg.merged_sketches()
+        assert merged["latency_ms"].count == sum(
+            e.latency.count for e in engines)
+        assert merged["latency_ms"].quantile(0.5) is not None
+        # the fleet /healthz sees every member
+        ok, detail = fg.health()
+        assert ok is True
+        assert set(detail["replicas"]) == {"r0", "r1"}
+
+    def test_status_fleet_cli_merges_replicas(self, fleet, capsys):
+        fg, gws, engines = fleet
+        from pint_tpu.scripts.status import main as status_main
+
+        ports = ",".join(str(gw.port) for gw in gws)
+        rc = status_main(["--fleet", ports, "--json"])
+        assert rc == 0
+        out = json.loads(capsys.readouterr().out)
+        assert out["mode"] == "fleet"
+        assert out["unreachable"] == 0 and out["unhealthy"] == 0
+        assert len(out["replicas"]) == len(gws)
+        assert "submit_us" in out["quantiles"]
+
+
+class TestStatusFleetUnreachable:
+    def test_unreachable_replica_exits_one(self, capsys):
+        from pint_tpu.scripts.status import main as status_main
+
+        # nothing listens on port 1: connection refused, exit code 1
+        rc = status_main(["--fleet", "1", "--json"])
+        assert rc == 1
+        out = json.loads(capsys.readouterr().out)
+        assert out["mode"] == "fleet"
+        assert out["unreachable"] == 1
+        assert out["replicas"][0]["reachable"] is False

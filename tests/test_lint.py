@@ -302,6 +302,84 @@ class TestDdTruncate:
         assert _lint(src) == []
 
 
+GATEWAY_BLOCKING = """
+class Handler:
+    def do_POST(self):
+        ses = self.engine.pool.get("psr0")
+        ses.fit()                     # synchronous refit in a handler
+"""
+
+GATEWAY_ONE_STEP = """
+def _apply(engine, sid, rows):
+    ses = engine.pool.get(sid)
+    ses.append(**rows)                # session append = blocking refit
+
+class Handler:
+    def do_POST(self):
+        _apply(self.engine, "psr0", {})
+"""
+
+GATEWAY_NESTED = """
+class Handler:
+    def do_GET(self):
+        def drainer():
+            self.engine.drain()
+        drainer()
+"""
+
+GATEWAY_OK = """
+class Handler:
+    def do_POST(self):
+        lines = []
+        lines.append("ok")            # list.append: not a session
+        ticket = self.engine.submit(session="psr0", kind="refit")
+        ticket.wait(1.0)
+
+def helper(engine):
+    engine.run_until_idle()           # NOT handler-reachable
+"""
+
+
+class TestBlockingInGateway:
+    """Satellite of ISSUE 16: gateway handler threads must hand timing
+    work to the engine (submit + ticket poll), never run it inline."""
+
+    GW = "pint_tpu/serve/gateway.py"
+
+    def test_fires_on_fit_in_handler(self):
+        assert _rules(_lint(GATEWAY_BLOCKING, path=self.GW)) == [
+            "blocking-in-gateway"]
+
+    def test_fires_through_one_step_call(self):
+        """A handler calling a same-module helper that blocks is still a
+        blocked handler thread."""
+        assert "blocking-in-gateway" in _rules(
+            _lint(GATEWAY_ONE_STEP, path=self.GW))
+
+    def test_fires_in_nested_def(self):
+        assert "blocking-in-gateway" in _rules(
+            _lint(GATEWAY_NESTED, path=self.GW))
+
+    def test_submit_ticket_and_list_append_ok(self):
+        assert _lint(GATEWAY_OK, path=self.GW) == []
+
+    def test_non_gateway_file_exempt(self):
+        """The same source outside a gateway file is fine — sessions DO
+        fit synchronously inside the engine worker."""
+        assert _lint(GATEWAY_BLOCKING, path="pint_tpu/serve/engine.py") == []
+
+    def test_inline_suppression(self):
+        src = ("class Handler:\n"
+               "    def do_POST(self):\n"
+               "        self.engine.drain()  "
+               "# jaxlint: disable=blocking-in-gateway — shutdown path\n")
+        assert _lint(src, path=self.GW) == []
+
+    def test_real_gateway_is_clean(self):
+        real = os.path.join(REPO, "pint_tpu", "serve", "gateway.py")
+        assert lint_file(real, config=load_config(REPO)) == []
+
+
 class TestConfig:
     def test_pyproject_block_parsed(self):
         cfg = load_config(REPO)
@@ -309,6 +387,8 @@ class TestConfig:
         assert any(p.endswith("knobs.py") for p in cfg["env-registry"])
         assert set(cfg["select"]) == set(RULES)
         assert any(p.endswith("ops/dd.py") for p in cfg["dd-accessors"])
+        assert any(p.endswith("serve/gateway.py")
+                   for p in cfg["gateway-files"])
 
     def test_defaults_without_pyproject(self, tmp_path):
         cfg = load_config(str(tmp_path))

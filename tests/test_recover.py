@@ -339,6 +339,146 @@ class TestKillMidTraceDrill:
         assert res["parity_max_rel"] <= 1e-10, res["parity_max_rel"]
 
 
+# --- the cross-process handoff dedup drill (ISSUE 16) -------------------------------
+
+_HANDOFF_EXPORT = """
+import json, os
+import numpy as np
+from pint_tpu.astro import time as ptime
+from pint_tpu.profiles import serve_smoke_fleet
+from pint_tpu.serve import (ServingEngine, SessionPool, TimingSession,
+                            export_session)
+
+def rows(full, lo, hi):
+    ep = full.utc_raw
+    return dict(utc=ptime.MJDEpoch(ep.day[lo:hi], ep.frac_hi[lo:hi],
+                                   ep.frac_lo[lo:hi]),
+                error_us=full.error_us[lo:hi],
+                freq_mhz=full.freq_mhz[lo:hi], obs=full.obs[lo:hi],
+                flags=[dict(f) for f in full.flags[lo:hi]])
+
+[(model, full, base_n)] = serve_smoke_fleet((56,), n_append_rows=4, seed=48)
+engine = ServingEngine(SessionPool(capacity=2), max_wait_ms=5.0,
+                       durable_dir=os.environ["SRC_DIR"])
+ses = TimingSession(full.select(np.arange(len(full)) < base_n), model)
+ses.fit(warm_appends=2)
+engine.add_session("psr0", ses)
+# the request is journaled on the source AND applied (so the export's
+# checkpoint carries both its rows and its idempotency key) — the
+# handoff suffix still carries its record, the dup the target must kill
+t = engine.submit(session="psr0", idem="hand-1",
+                  **rows(full, base_n, base_n + 2))
+engine.run_until_idle()
+assert t.wait(timeout=60.0).path == "incremental"
+rep = export_session(engine, "psr0", os.environ["HANDOFF_DIR"])
+engine.stop(drain=False)
+print("RESULT::" + json.dumps({
+    "n_toas": rep["n_toas"],
+    "suffix_records": rep["suffix_records"],
+}))
+"""
+
+_HANDOFF_IMPORT = """
+import json, os
+import numpy as np
+from pint_tpu.astro import time as ptime
+from pint_tpu.models.base import leaf_to_f64
+from pint_tpu.ops.compile import setup_persistent_cache
+from pint_tpu.profiles import serve_smoke_fleet
+from pint_tpu.serve import (ServingEngine, SessionPool, TimingSession,
+                            import_session)
+
+setup_persistent_cache()
+
+def rows(full, lo, hi):
+    ep = full.utc_raw
+    return dict(utc=ptime.MJDEpoch(ep.day[lo:hi], ep.frac_hi[lo:hi],
+                                   ep.frac_lo[lo:hi]),
+                error_us=full.error_us[lo:hi],
+                freq_mhz=full.freq_mhz[lo:hi], obs=full.obs[lo:hi],
+                flags=[dict(f) for f in full.flags[lo:hi]])
+
+[(model, full, base_n)] = serve_smoke_fleet((56,), n_append_rows=4, seed=48)
+engine = ServingEngine(SessionPool(capacity=2), max_wait_ms=5.0,
+                       durable_dir=os.environ["TGT_DIR"])
+rep = import_session(engine, os.environ["HANDOFF_DIR"])
+ses = engine.pool.get("psr0")
+# the never-handed-off twin answered the request exactly once
+twin = TimingSession(full.select(np.arange(len(full)) < base_n), model)
+twin.fit(warm_appends=2)
+twin.append(**rows(full, base_n, base_n + 2))
+parity = 0.0
+for nm in tuple(model.free_params):
+    a = float(np.asarray(leaf_to_f64(ses.fitter.model.params[nm])))
+    b = float(np.asarray(leaf_to_f64(twin.fitter.model.params[nm])))
+    parity = max(parity, abs(a - b) / max(abs(b), 1e-300))
+print("RESULT::" + json.dumps({
+    "sids": rep["sids"],
+    "replayed": rep["replayed"],
+    "deduped": rep["deduped"],
+    "requests_lost": rep["requests_lost"],
+    "n_toas": len(ses.toas),
+    "idem_carried": "hand-1" in ses.applied_idem,
+    "parity_max_rel": parity,
+}))
+"""
+
+
+@pytest.mark.skipif(os.environ.get("PINT_TPU_SKIP_SUBPROCESS") == "1",
+                    reason="subprocess benches disabled")
+class TestHandoffDedupTwoProcess:
+    """ISSUE 16 satellite: idempotency-key dedup across a replica
+    handoff. A request journaled AND applied on the source replica rides
+    the migration handoff (checkpoint + journal suffix) into a genuinely
+    different process, where the replay must answer it EXACTLY once —
+    the key is already inside the checkpoint's applied set."""
+
+    def test_export_then_import_fresh_process(self, tmp_path,
+                                              _module_cache_dir):
+        env = dict(os.environ)
+        env.update({
+            "PINT_TPU_CACHE_DIR": str(_module_cache_dir),
+            "PINT_TPU_NBODY": "0",
+            "JAX_PLATFORMS": "cpu",
+            "PINT_TPU_AOT_EXPORT": "1",
+            "SRC_DIR": str(tmp_path / "src"),
+            "TGT_DIR": str(tmp_path / "tgt"),
+            "HANDOFF_DIR": str(tmp_path / "handoff"),
+        })
+        for var in ("PINT_TPU_EXPECT_WARM", "PINT_TPU_FAULTS",
+                    "PINT_TPU_DEGRADED"):
+            env.pop(var, None)
+        export = subprocess.run(
+            [sys.executable, "-c", _HANDOFF_EXPORT], cwd=REPO, env=env,
+            capture_output=True, text=True, timeout=480)
+        assert export.returncode == 0, (export.stdout[-500:],
+                                        export.stderr[-3000:])
+        line = [ln for ln in export.stdout.splitlines()
+                if ln.startswith("RESULT::")][-1]
+        exp = json.loads(line[len("RESULT::"):])
+        # the handoff carries the applied request's journal record
+        assert exp["suffix_records"] == 1
+        assert (tmp_path / "handoff" / "sessions" / "psr0.ckpt").exists()
+        assert list((tmp_path / "handoff" / "journal")
+                    .glob("journal-*.wal"))
+
+        imp_proc = subprocess.run(
+            [sys.executable, "-c", _HANDOFF_IMPORT], cwd=REPO, env=env,
+            capture_output=True, text=True, timeout=480)
+        assert imp_proc.returncode == 0, (imp_proc.stdout[-500:],
+                                          imp_proc.stderr[-3000:])
+        line = [ln for ln in imp_proc.stdout.splitlines()
+                if ln.startswith("RESULT::")][-1]
+        imp = json.loads(line[len("RESULT::"):])
+        assert imp["sids"] == ["psr0"]
+        assert imp["deduped"] == 1            # the dup died by its key
+        assert imp["replayed"] == 0
+        assert imp["requests_lost"] == 0
+        assert imp["n_toas"] == exp["n_toas"]  # applied exactly once
+        assert imp["idem_carried"] is True
+        assert imp["parity_max_rel"] <= 1e-10, imp["parity_max_rel"]
+
+
 class TestRecoverCLI:
     def test_recover_cli_reports_clean_dir(self, tmp_path, capsys):
         """`pint_tpu recover --dir D --json` parses a durable dir and
