@@ -715,7 +715,8 @@ def bench_noise(emit, ntoas: int | None = None) -> None:
 def _pta_bench_core(n_pulsars: int, ntoas: int, n_evals: int,
                     n_chains: int, nsteps: int, warmup: int,
                     baseline_evals: int, sharded: bool = True,
-                    kernel: str = "hmc") -> dict:
+                    kernel: str = "hmc",
+                    nwalkers: int | None = None) -> dict:
     """The joint-PTA bench: fused HD-coupled joint likelihood evaluations
     + vmapped joint chains vs the per-pulsar host-loop + dense-joint
     baseline.
@@ -766,7 +767,8 @@ def _pta_bench_core(n_pulsars: int, ntoas: int, n_evals: int,
         fused_wall = time.time() - t0
         t0 = time.time()
         chains = pta.sample(n_chains=n_chains, nsteps=nsteps,
-                            warmup=warmup, kernel=kernel, seed=5)
+                            warmup=warmup, kernel=kernel, seed=5,
+                            nwalkers=nwalkers)
         chain_wall = time.time() - t0
     breakdown = perf.pta_breakdown(rep)
 
@@ -807,6 +809,7 @@ def _pta_bench_core(n_pulsars: int, ntoas: int, n_evals: int,
                     "both sides)",
     })
     rec.update(breakdown)
+    rec["pta_peak_bytes_per_chip"] = pta.static_peak_bytes_per_chip()
     try:
         from pint_tpu.analysis.jaxpr_audit import audit_block
 
@@ -816,6 +819,110 @@ def _pta_bench_core(n_pulsars: int, ntoas: int, n_evals: int,
     rec["degradation_count"] = _degradation_count()
     rec["degradation_kinds"] = _degradation_kinds()
     return rec
+
+
+def _pta_scaling_leg(n_pulsars: int, ntoas: int, n_evals: int,
+                     devices=None, baseline_evals: int = 0) -> dict:
+    """One steady-state joint-PTA throughput point: build an N-pulsar
+    array (sharded over `devices` when >= 2 divide N), warm the batch
+    program, then time E fused joint evaluations. Unlike the headline
+    smoke record (compile included on both sides), the scaling legs
+    time steady-state dispatch — the quantity whose SHAPE in N and S is
+    the claim under test."""
+    import copy
+
+    import jax
+    import jax.numpy as jnp
+
+    import pint_tpu.distributed as dist
+    from pint_tpu import profiles
+    from pint_tpu.fitting.noise_like import NoiseLikelihood
+    from pint_tpu.fitting.pta_like import PTALikelihood
+
+    models, toas_list = profiles.pta_smoke_array(n_pulsars, ntoas)
+    mesh = dist.pta_mesh(n_pulsars, devices=devices)
+    members = [NoiseLikelihood(t, copy.deepcopy(m))
+               for t, m in zip(toas_list, models)]
+    pta = PTALikelihood(members, mesh=mesh)
+    rng = np.random.default_rng(43)
+    etas = pta.x0 + 0.02 * pta.scales * rng.standard_normal(
+        (n_evals, pta.nparams))
+    pta.loglike_many(etas[:1])  # compile + warm outside the timed window
+    t0 = time.time()
+    pta.loglike_many(etas)
+    eps = n_evals / (time.time() - t0)
+    leg = {
+        "n_pulsars": n_pulsars,
+        "ntoas_per_pulsar": len(toas_list[0]),
+        "pta_batch_shards": pta.n_shards,
+        "pta_pulsars_per_chip": round(n_pulsars / pta.n_shards, 2),
+        "gwb_loglike_evals_per_sec": round(eps, 2),
+        "pta_peak_bytes_per_chip": pta.static_peak_bytes_per_chip(),
+        "pta_hyper_dim": pta.nparams,
+        "n_evals": n_evals,
+    }
+    if baseline_evals:
+        # dense-joint O((N T)^3) baseline, also steady-state: one warm
+        # dispatch per point through the materialized joint covariance
+        dense = pta.dense_joint_program()
+        float(dense(jnp.asarray(pta.x0), pta._params0, pta._plain_data))
+        t0 = time.time()
+        for d in etas[:baseline_evals]:
+            float(dense(jnp.asarray(d), pta._params0, pta._plain_data))
+        base_eps = baseline_evals / (time.time() - t0)
+        leg["baseline_evals_per_sec"] = round(base_eps, 3)
+        leg["gwb_vs_dense_baseline"] = round(eps / base_eps, 2)
+    return leg
+
+
+def pta_scaling_legs(ns: tuple = (8, 32, 64), ntoas: int = 96,
+                     n_evals: int = 16, baseline_evals: int = 1) -> dict:
+    """The N-scaling leg of the PTA bench: fused joint-likelihood
+    throughput at N in `ns` on the full device mesh, with the
+    dense-joint baseline priced at the LARGEST N only (the O((N T)^3)
+    matrix is exactly what the fused operand plan exists to avoid
+    paying repeatedly). Returns {"pta_n_scaling": [leg...],
+    "gwb_loglike_evals_per_sec": <at max N>, ...}."""
+    legs = [
+        _pta_scaling_leg(
+            n, ntoas, n_evals,
+            baseline_evals=baseline_evals if n == max(ns) else 0)
+        for n in sorted(ns)
+    ]
+    top = legs[-1]
+    out = {
+        "pta_n_scaling": legs,
+        "gwb_loglike_evals_per_sec": top["gwb_loglike_evals_per_sec"],
+        "pta_peak_bytes_per_chip": top["pta_peak_bytes_per_chip"],
+    }
+    if "gwb_vs_dense_baseline" in top:
+        out["gwb_vs_dense_baseline_n_max"] = top["gwb_vs_dense_baseline"]
+    return out
+
+
+def pta_weak_scaling_legs(per_chip: int = 8, ntoas: int = 48,
+                          n_evals: int = 16) -> dict:
+    """The weak-scaling leg: hold pulsars-per-chip fixed and grow the
+    forced device count S in {1, 2, 4, 8} with N = per_chip * S, forcing
+    each mesh onto the first S devices. `pta_pulsars_per_chip` must stay
+    flat — the sharded operand plan places only N/S pulsars' stacks per
+    device, so a mesh that silently failed to shard shows up here as a
+    per-chip blow-up, not a hidden slowdown."""
+    import jax
+
+    devs = jax.devices()
+    ss = [s for s in (1, 2, 4, 8) if s <= len(devs)]
+    legs = [_pta_scaling_leg(per_chip * s, ntoas, n_evals,
+                             devices=devs[:s]) for s in ss]
+    for leg, s in zip(legs, ss):
+        leg["forced_devices"] = s
+    ppc = [leg["pta_pulsars_per_chip"] for leg in legs]
+    return {
+        "pta_weak_scaling": legs,
+        "pta_pulsars_per_chip": ppc[-1],
+        "pta_pulsars_per_chip_flat": bool(
+            max(ppc) <= 1.2 * min(ppc)),
+    }
 
 
 def bench_pta(emit, n_pulsars: int | None = None,
@@ -832,6 +939,8 @@ def bench_pta(emit, n_pulsars: int | None = None,
     rec["value"] = rec["gwb_loglike_evals_per_sec_per_chip"]
     rec["unit"] = "evals/s/chip"
     rec["vs_baseline"] = rec["gwb_vs_dense_baseline"]
+    rec.update(pta_scaling_legs())
+    rec.update(pta_weak_scaling_legs())
     emit(rec)
 
 
@@ -1571,7 +1680,9 @@ def smoke_pta_bench(n_pulsars: int = 4, ntoas: int = 96,
                     n_evals: int = 1024, n_chains: int = 2,
                     nsteps: int = 25, warmup: int = 15,
                     baseline_evals: int = 8,
-                    kernel: str = "hmc") -> dict:
+                    kernel: str = "hmc",
+                    nwalkers: int | None = None,
+                    scaling: bool = False) -> dict:
     """CPU joint-PTA smoke bench: the fused Hellings-Downs joint GWB
     likelihood (fitting/pta_like.py) evaluated E times in ONE vmapped
     program plus C vmapped joint HMC chains, vs the host-loop
@@ -1593,8 +1704,15 @@ def smoke_pta_bench(n_pulsars: int = 4, ntoas: int = 96,
 
     setup_persistent_cache()
     rec = _pta_bench_core(n_pulsars, ntoas, n_evals, n_chains, nsteps,
-                          warmup, baseline_evals, kernel=kernel)
+                          warmup, baseline_evals, kernel=kernel,
+                          nwalkers=nwalkers)
     rec["metric"] = "smoke_pta_bench"
+    if scaling:
+        # array-scale legs: N-scaling to N=64 on the full mesh (dense
+        # baseline priced at N=64 only) + weak scaling on forced device
+        # subsets — steady-state dispatch, see pta_scaling_legs
+        rec.update(pta_scaling_legs())
+        rec.update(pta_weak_scaling_legs())
     return rec
 
 
@@ -2860,7 +2978,7 @@ if __name__ == "__main__":
                     flags + " --xla_force_host_platform_device_count=8"
                 ).strip()
             os.environ.setdefault("JAX_PLATFORMS", "cpu")
-            print(json.dumps(smoke_pta_bench()), flush=True)
+            print(json.dumps(smoke_pta_bench(scaling=True)), flush=True)
             sys.exit(0)
         if sharded or batched:
             # must precede the first jax import: the sharded/batched smoke

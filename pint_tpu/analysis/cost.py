@@ -45,7 +45,8 @@ BUDGET_PATH = Path(__file__).resolve().parent / "cost_budgets.json"
 #: is a budget regen, not a silent re-baseline
 CANON = {"ntoas": 60, "noise_ntoas": 48, "batch": 3, "grid_pts": 4,
          "chain_steps": 8, "chain_warmup": 4, "seed": 7, "incr_k": 8,
-         "pta_psrs": 2, "pta_ntoas": 20}
+         "pta_psrs": 2, "pta_ntoas": 20,
+         "pta_array_psrs": 64, "pta_array_ntoas": 20}
 
 _WLS_PAR = """
 PSR COST
@@ -278,11 +279,48 @@ def _build_pta_loglike():
                        (eta, pta._params0, pta.data))
 
 
+def _pta_array():
+    """Canonical ARRAY-SCALE joint-PTA likelihood: N = 64 pulsars at the
+    tiny per-pulsar TOA count (trace-only pricing — the N-scaling of
+    the fused operand plan is what the budget pins; mesh=None so the
+    virtual test mesh cannot skew it, matching every other builder)."""
+    import copy
+
+    from pint_tpu import profiles
+    from pint_tpu.fitting.noise_like import NoiseLikelihood
+    from pint_tpu.fitting.pta_like import PTALikelihood
+
+    models, toas_list = profiles.pta_smoke_array(
+        CANON["pta_array_psrs"], CANON["pta_array_ntoas"],
+        seed=CANON["seed"])
+    members = [NoiseLikelihood(t, copy.deepcopy(m))
+               for t, m in zip(toas_list, models)]
+    return PTALikelihood(members)
+
+
+def _build_pta_array_loglike(pta):
+    import jax.numpy as jnp
+
+    _, rec = _trace_cost(pta._programs.loglike,
+                         (jnp.asarray(pta.x0), pta._params0, pta.data))
+    # distinct budget key: the same program label is budgeted at BOTH
+    # the tiny (N=2) and the array-scale (N=64) canonical shapes
+    return "pta_loglike@n64", rec
+
+
+def _build_pta_detection(pta):
+    import jax.numpy as jnp
+
+    return _trace_cost(pta.detection_program(),
+                       (jnp.asarray(pta.x0), pta._params0, pta.data))
+
+
 def build_headline_costs(verbose=print) -> dict[str, dict]:
     """{label: cost record} for every headline program at the canonical
     shapes. Raises on any builder failure — coverage is the contract."""
     out: dict[str, dict] = {}
     nl = None
+    pta64 = None
     for name, build in (
         ("fused WLS fit", _build_fused_wls),
         ("fused GLS fit", _build_fused_gls),
@@ -295,9 +333,13 @@ def build_headline_costs(verbose=print) -> dict[str, dict]:
         ("noise loglike", lambda: _build_noise_loglike(nl)),
         ("noise chain", lambda: _build_noise_chain(nl)),
         ("pta loglike", _build_pta_loglike),
+        ("pta array loglike", lambda: _build_pta_array_loglike(pta64)),
+        ("pta detection stat", lambda: _build_pta_detection(pta64)),
     ):
         if name == "noise loglike" and nl is None:
             nl = _noise_likelihood()
+        if name == "pta array loglike" and pta64 is None:
+            pta64 = _pta_array()
         label, rec = build()
         out[label] = rec
         verbose(f"  {label:<24s} flops={rec['flops']:>12d} "

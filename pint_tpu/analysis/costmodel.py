@@ -240,17 +240,52 @@ def _walk(jaxpr) -> tuple[Cost, float]:
     return cost, peak
 
 
-def program_cost(closed) -> dict:
-    """JSON-ready static cost record of one ClosedJaxpr."""
+def _donation_savings(jaxpr, donate_invars) -> float:
+    """Bytes XLA input-output aliasing saves off the static peak: a
+    donated invar whose shape/dtype matches an outvar is written in
+    place (the executable reuses the donated buffer for that result), so
+    the two never live simultaneously — without this credit a donating
+    in-place update (``stack.at[slot].set`` with the stack donated)
+    would show a doubled stack on the ledger. Greedy 1:1 matching; an
+    unmatched donation saves nothing (jit emits the same warning)."""
+    outs: dict = {}
+    for v in jaxpr.outvars:
+        aval = getattr(v, "aval", None)
+        if aval is None:
+            continue
+        k = (tuple(getattr(aval, "shape", ())), str(aval.dtype))
+        outs[k] = outs.get(k, 0) + 1
+    saved = 0.0
+    for i in donate_invars:
+        if not 0 <= int(i) < len(jaxpr.invars):
+            continue
+        aval = jaxpr.invars[int(i)].aval
+        k = (tuple(getattr(aval, "shape", ())), str(aval.dtype))
+        if outs.get(k, 0) > 0:
+            outs[k] -= 1
+            saved += _nbytes(aval)
+    return saved
+
+
+def program_cost(closed, donate_invars=()) -> dict:
+    """JSON-ready static cost record of one ClosedJaxpr.
+
+    ``donate_invars`` — flat invar indices the program's jit donates
+    (TimedProgram ``donate_invars``): matched donations are credited off
+    ``peak_bytes`` (see :func:`_donation_savings`) and reported as
+    ``donated_bytes``."""
     cost, peak = _walk(closed.jaxpr)
     const_bytes = sum(int(getattr(c, "nbytes", 0) or 0)
                       for c in getattr(closed, "consts", ()))
+    donated = (_donation_savings(closed.jaxpr, donate_invars)
+               if donate_invars else 0.0)
     return {
         "flops": int(cost.flops),
         "bytes_read": int(cost.bytes_read),
         "bytes_written": int(cost.bytes_written),
         "collective_bytes": int(cost.collective_bytes),
-        "peak_bytes": int(peak + const_bytes),
+        "peak_bytes": int(max(0.0, peak - donated) + const_bytes),
+        "donated_bytes": int(donated),
         "n_eqns": _count_eqns(closed.jaxpr),
     }
 
@@ -273,13 +308,13 @@ _lock = threading.Lock()
 _ledger: dict[str, dict] = {}
 
 
-def record_program(label: str, closed) -> None:
+def record_program(label: str, closed, donate_invars=()) -> None:
     """Ledger hook (TimedProgram._compile): keep the costliest lowering
     per label — multiple signatures of one program (grid tile shapes,
     fleet buckets) canonicalize to the biggest. Never raises: a cost-model
     bug must not break a compile."""
     try:
-        rec = program_cost(closed)
+        rec = program_cost(closed, donate_invars=donate_invars)
     except Exception as e:  # pragma: no cover — cost model must never break a fit  # jaxlint: disable=silent-except — static-cost telemetry only; compile correctness unaffected
         log.warning(f"cost model failed on {label}: {e}")
         return

@@ -73,7 +73,7 @@ from pint_tpu.utils.logging import get_logger
 log = get_logger("pint_tpu.fitting")
 
 __all__ = ["BatchedFitter", "bucket_rows", "clear_batch_cache", "fit_batch",
-           "stack_trees", "tree_index"]
+           "placed_stack", "stack_token", "stack_trees", "tree_index"]
 
 #: smallest row bucket — tiny fits share one executable instead of
 #: compiling per-count programs for 3 vs 5 vs 11 TOAs
@@ -158,6 +158,193 @@ _stack_trees = stack_trees
 _tree_index = tree_index
 
 
+# --- amortized, device-placed member stacking -------------------------------------
+#
+# An N-pulsar array (fitting/pta_like.py) or a resident chain fleet
+# (NoiseFleet) re-stacks its members' bucket-padded layouts on every
+# construction. At array scale that restack is the operand-staging cost:
+# O(N) host stacks + an O(N) host->device transfer per rebuild, even when
+# one pulsar's data changed — and on a multi-device `pta_mesh` the full
+# (N, ...) stack used to be materialized on the default device before the
+# first shard_mapped call re-laid it. `placed_stack` fixes both:
+#
+# - **Per-slot invalidation.** Each member object carries a monotone
+#   `stack_token`; a rebuild under the same cache key diffs tokens and
+#   rewrites ONLY the changed slots (single-device: `.at[slot].set`;
+#   sharded: rebuild the one shard holding the slot and reassemble the
+#   global array from the other shards' EXISTING device buffers). The
+#   `stack_slot_reuse` counter reports the slots that never re-stacked.
+# - **Placement by mesh coordinate.** With a mesh, shard s's N/S member
+#   slice is stacked host-side and `jax.device_put` straight onto device
+#   s; the global array is assembled with
+#   `jax.make_array_from_single_device_arrays`, so no device (and no
+#   jit reshard) ever holds the full N-pulsar stack.
+
+_SLOT_STACK_LOCK = threading.Lock()
+_SLOT_STACKS: dict = {}
+_SLOT_STACKS_MAX = 8
+_STACK_TOKENS = iter(range(1, 1 << 62))
+_RESTACK_PROG: list = []
+
+
+def _restack_prog():
+    """The donating slot-update program: ``stack.at[slot].set(row)`` with
+    the stack operand DONATED, so the rewrite is a true in-place device
+    update — the old stack's buffer is consumed, never a second copy
+    (the cost ledger's ``fleet_restack`` entry carries the matching
+    ``donated_bytes`` credit). One compile per leaf (shape, dtype);
+    ``canonical=False`` because those signatures are legitimate, not
+    retrace churn."""
+    if not _RESTACK_PROG:
+        from pint_tpu.ops.compile import TimedProgram, precision_jit
+
+        def _restack(stack, row, slot):
+            return stack.at[slot].set(row)
+
+        with _SLOT_STACK_LOCK:
+            if not _RESTACK_PROG:
+                _RESTACK_PROG.append(TimedProgram(
+                    precision_jit(_restack, donate_argnums=(0,)),
+                    "fleet_restack", canonical=False,
+                    donate_invars=(0,),
+                    # pure buffer movement: no arithmetic to carry a
+                    # dd64 pair through — f64 is the honest spec
+                    precision_spec="f64"))
+    return _RESTACK_PROG[0]
+
+
+def stack_token(obj) -> int:
+    """Monotone identity token of one stack member: assigned once per
+    object, never recycled (unlike `id()`), so a token match under a
+    cache key proves the slot's layout is the one already stacked."""
+    tok = obj.__dict__.get("_stack_token")
+    if tok is None:
+        with _SLOT_STACK_LOCK:
+            tok = obj.__dict__.setdefault("_stack_token",
+                                          next(_STACK_TOKENS))
+    return tok
+
+
+def _host_stack(trees):
+    """Host-side (numpy) slot stack — the transfer-free half of a placed
+    build: the result moves to ITS device in one `jax.device_put`."""
+    return jax.tree_util.tree_map(
+        lambda *xs: None if xs[0] is None else np.stack(
+            [np.asarray(x) for x in xs]),
+        *trees, is_leaf=_is_none)
+
+
+def _mesh_axis_devices(mesh, axis: str):
+    """The device per shard along `axis` (the only non-trivial mesh axis
+    a member stack shards over)."""
+    n = int(mesh.shape[axis])
+    devs = list(np.asarray(mesh.devices).reshape(-1))
+    if len(devs) != n:
+        raise ValueError(
+            f"member stacks shard over '{axis}' alone, but the mesh "
+            f"carries {len(devs)} devices for {n} '{axis}' shards")
+    return devs
+
+
+def placed_stack(members, trees, *, key, mesh=None, axis: str = "batch"):
+    """Batch-stacked operand tree over ``trees`` (one per member),
+    incrementally rebuilt and (with a mesh) placed shard-by-shard.
+
+    ``members`` provide identity (`stack_token`); ``key`` names the stack
+    family (kind, bucket rows, mesh fingerprint ...) a rebuild diffs
+    against. Unchanged slots reuse the previous stack's device buffers —
+    one member's data change invalidates one slot (one shard's local
+    stack on a mesh), not the O(N) rebuild — counted as
+    ``stack_slot_reuse``. With ``mesh`` carrying ``axis`` (S shards,
+    S | len(members)), each shard's local stack lives ONLY on its device
+    and the returned leaves are global sharded arrays matching the
+    shard_map in_specs, so the likelihood programs consume them without
+    a reshard.
+    """
+    tokens = tuple(stack_token(m) for m in members)
+    n = len(tokens)
+    S = 1
+    if mesh is not None and axis in mesh.shape:
+        S = int(mesh.shape[axis])
+    if n % max(S, 1):
+        raise ValueError(f"{n} members do not divide over {S} shards")
+    with _SLOT_STACK_LOCK:
+        prev = _SLOT_STACKS.pop(key, None)
+
+    if prev is not None and prev["tokens"] == tokens:
+        perf.add("stack_slot_reuse", n)
+        with _SLOT_STACK_LOCK:
+            _SLOT_STACKS[key] = prev
+        return prev["global"]
+
+    changed = (set(range(n)) if prev is None else
+               {i for i in range(n) if prev["tokens"][i] != tokens[i]})
+    incremental = prev is not None and len(changed) <= n // 2
+
+    if S <= 1:
+        with perf.stage("stack"):
+            if incremental:
+                # in-place slot rewrite: the previous stack is DONATED to
+                # the update program leaf by leaf, so the rebuild
+                # allocates one row, not a second N-slot stack. Contract:
+                # an incremental rebuild consumes the prior stack's
+                # buffers — callers keep the RETURNED tree and drop
+                # references to the old one.
+                out = prev["global"]
+                prog = _restack_prog()
+                for i in sorted(changed):
+                    out = jax.tree_util.tree_map(
+                        lambda G, x: None if G is None else prog(
+                            G, jnp.asarray(x), np.int32(i)),
+                        out, trees[i], is_leaf=_is_none)
+                perf.add("stack_slot_reuse", n - len(changed))
+            else:
+                out = stack_trees(trees)
+                perf.add("stack_slot_reuse", 0)
+        entry = {"tokens": tokens, "global": out}
+    else:
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+
+        devs = _mesh_axis_devices(mesh, axis)
+        k = n // S
+        dirty = {i // k for i in changed}
+        locals_, placed, reuse = {}, [], 0
+        with perf.stage("stack"):
+            for s in range(S):
+                if not (incremental and s not in dirty):
+                    locals_[s] = _host_stack(trees[s * k:(s + 1) * k])
+        with perf.stage("place"):
+            for s in range(S):
+                if incremental and s not in dirty:
+                    placed.append(prev["placed"][s])
+                    reuse += k
+                else:
+                    placed.append(jax.tree_util.tree_map(
+                        lambda x: None if x is None else jax.device_put(
+                            x, devs[s]),
+                        locals_[s], is_leaf=_is_none))
+            perf.add("stack_slot_reuse", reuse if prev is not None else 0)
+            sharding = NamedSharding(mesh, P(axis))
+
+            def assemble(*shards):
+                if shards[0] is None:
+                    return None
+                shape = (n,) + tuple(shards[0].shape[1:])
+                return jax.make_array_from_single_device_arrays(
+                    shape, sharding, list(shards))
+
+            out = jax.tree_util.tree_map(assemble, *placed,
+                                         is_leaf=_is_none)
+        entry = {"tokens": tokens, "global": out, "placed": placed}
+
+    with _SLOT_STACK_LOCK:
+        while len(_SLOT_STACKS) >= _SLOT_STACKS_MAX:
+            _SLOT_STACKS.pop(next(iter(_SLOT_STACKS)))
+        _SLOT_STACKS[key] = entry
+    return out
+
+
 class _BatchEntry:
     """One compiled batched-fit program + its bookkeeping."""
 
@@ -186,9 +373,12 @@ _CACHE_LOCK = threading.Lock()
 
 def clear_batch_cache() -> None:
     """Drop every cached batched-fit program (test isolation; also
-    releases the model references the cached closures hold)."""
+    releases the model references the cached closures hold) and every
+    cached slot stack (releases the device buffers placed stacks pin)."""
     with _CACHE_LOCK:
         _CACHE.clear()
+    with _SLOT_STACK_LOCK:
+        _SLOT_STACKS.clear()
 
 
 def get_batched_fit_fn(model, kind: str, free, subtract_mean: bool,

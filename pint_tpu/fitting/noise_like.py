@@ -543,6 +543,68 @@ class MarginalizedPosterior:
         return res
 
 
+#: process-global design-program cache keyed by the structural aot_key —
+#: an N-pulsar array builds N members over one or two model skeletons,
+#: and the design lowering depends only on model STRUCTURE (every value
+#: rides the params/tensor operands), so member k>0 reuses member 0's
+#: compiled program instead of paying a fresh trace (measured ~0.5 s per
+#: member at the PTA smoke shape; N=64 turns that into 32 s of pure
+#: retrace). Bounded LRU: each entry pins one model skeleton via the
+#: closure.
+_DESIGN_PROGRAMS: dict = {}
+_DESIGN_PROGRAMS_MAX = 8
+
+
+def _design_program(model, free: tuple[str, ...]):
+    """The (r0, M) linearization program for one model structure: shared
+    across every member with the same structural key, same free-parameter
+    set and same precision mode (the exact contract under which the AOT
+    artifact store already re-serves the executable cross-process)."""
+    from pint_tpu.fitting.wls import apply_delta
+    from pint_tpu.ops.compile import TimedProgram, precision_jit
+    from pint_tpu.residuals import phase_residual_frac
+
+    aot_key = (f"{model.aot_structure_key()}|design|"
+               f"free={','.join(free)}")
+    cache_key = f"{aot_key}|xprec={model.xprec.name}"
+    prog = _DESIGN_PROGRAMS.get(cache_key)
+    if prog is not None:
+        perf.add("design_program_reuse", 1)
+        return prog
+
+    # (r0, M) at the linearization point: one device program, never
+    # re-run. subtract_mean=False — the phase offset is profiled as an
+    # explicit column instead (the reference's "Offset" column), so
+    # the marginalization stays exact as the weights move with EFAC.
+    def design(params, tensor, track_pn, delta_pn):
+        # pulse-number tracking columns ride the ARGUMENT list (like
+        # get_resid_fn): the closure stays structural, so the program
+        # is AOT-serializable for zero-trace warm starts
+        def rfun(delta):
+            _, r, f = phase_residual_frac(
+                model, apply_delta(params, free, delta), tensor,
+                track_pn=track_pn, delta_pn=delta_pn,
+                subtract_mean=False,
+            )
+            return r / f, f
+
+        (r0, f0), jvp = jax.linearize(rfun, jnp.zeros(len(free)))
+        cols = [jvp(col)[0] for col in jnp.eye(len(free))]
+        if not model.has_phase_offset:
+            cols.append(1.0 / f0)  # the profiled overall phase offset
+        M = (jnp.stack(cols, axis=1) if cols
+             else jnp.zeros((r0.shape[0], 0)))
+        return r0, M
+
+    prog = TimedProgram(
+        precision_jit(design), "noise_design",
+        precision_spec=model.xprec.name, aot_key=aot_key)
+    while len(_DESIGN_PROGRAMS) >= _DESIGN_PROGRAMS_MAX:
+        _DESIGN_PROGRAMS.pop(next(iter(_DESIGN_PROGRAMS)))
+    _DESIGN_PROGRAMS[cache_key] = prog
+    return prog
+
+
 class NoiseLikelihood(MarginalizedPosterior):
     """The fused, audited noise-hyperparameter posterior of one dataset.
 
@@ -603,9 +665,7 @@ class NoiseLikelihood(MarginalizedPosterior):
         return tuple(n for n in self.model.free_params if n not in owned)
 
     def _build(self, resids):
-        from pint_tpu.fitting.wls import apply_delta
-        from pint_tpu.ops.compile import TimedProgram, canonicalize_params, precision_jit
-        from pint_tpu.residuals import phase_residual_frac
+        from pint_tpu.ops.compile import canonicalize_params
 
         model = self.model
         self.resids = resids
@@ -614,35 +674,7 @@ class NoiseLikelihood(MarginalizedPosterior):
         params0 = canonicalize_params(model.xprec.convert_params(model.params))
         self._params0 = params0
 
-        # (r0, M) at the linearization point: one device program, never
-        # re-run. subtract_mean=False — the phase offset is profiled as an
-        # explicit column instead (the reference's "Offset" column), so
-        # the marginalization stays exact as the weights move with EFAC.
-        def design(params, tensor, track_pn, delta_pn):
-            # pulse-number tracking columns ride the ARGUMENT list (like
-            # get_resid_fn): the closure stays structural, so the program
-            # is AOT-serializable for zero-trace warm starts
-            def rfun(delta):
-                _, r, f = phase_residual_frac(
-                    model, apply_delta(params, free, delta), tensor,
-                    track_pn=track_pn, delta_pn=delta_pn,
-                    subtract_mean=False,
-                )
-                return r / f, f
-
-            (r0, f0), jvp = jax.linearize(rfun, jnp.zeros(len(free)))
-            cols = [jvp(col)[0] for col in jnp.eye(len(free))]
-            if not model.has_phase_offset:
-                cols.append(1.0 / f0)  # the profiled overall phase offset
-            M = (jnp.stack(cols, axis=1) if cols
-                 else jnp.zeros((r0.shape[0], 0)))
-            return r0, M
-
-        design_prog = TimedProgram(
-            precision_jit(design), "noise_design",
-            precision_spec=model.xprec.name,
-            aot_key=(f"{model.aot_structure_key()}|design|"
-                     f"free={','.join(free)}"))
+        design_prog = _design_program(model, free)
         r0, M = design_prog(params0, tensor, resids._track_pn,
                             resids._delta_pn)
         r0 = np.asarray(r0)
@@ -817,6 +849,10 @@ def split_rhat(chains: np.ndarray) -> np.ndarray:
     non-stationarity inflates the statistic too."""
     C, S, d = chains.shape
     half = S // 2
+    if half < 2:
+        # fewer than 2 draws per half-chain: no within-chain variance to
+        # compare against — the statistic is undefined, not divergent
+        return np.full(d, np.nan)
     s = np.concatenate([chains[:, :half], chains[:, half:2 * half]], axis=0)
     m, n = s.shape[0], s.shape[1]
     means = s.mean(axis=1)             # (m, d)
@@ -845,7 +881,7 @@ class NoiseFleet:
     belongs in separate NoiseFleets)."""
 
     def __init__(self, likelihoods: list[NoiseLikelihood]):
-        from pint_tpu.fitting.batch import bucket_rows, stack_trees
+        from pint_tpu.fitting.batch import bucket_rows, placed_stack
         from pint_tpu.ops.compile import _args_signature
 
         if not likelihoods:
@@ -869,8 +905,16 @@ class NoiseFleet:
                     "fleet operand-signature mismatch: members must share "
                     "a model skeleton (component graph, Fourier mode "
                     "counts, ECORR epoch counts)")
-        self.data = stack_trees(datas)
-        self.params0 = stack_trees([nl._params0 for nl in self.members])
+        # amortized stacking (fitting/batch.py): a rebuild over a
+        # mostly-unchanged member set rewrites only the changed slots of
+        # the previous stacked operands (`stack_slot_reuse`), on top of
+        # the per-member `_layout_padded` memo (`fleet_stack_reuse`)
+        B = len(self.members)
+        self.data = placed_stack(self.members, datas,
+                                 key=("fleet", "data", B, rows))
+        self.params0 = placed_stack(
+            self.members, [nl._params0 for nl in self.members],
+            key=("fleet", "params0", B, rows))
         self._progs: dict = {}
 
     def sample(self, n_chains: int | None = None, nsteps: int = 500,

@@ -45,6 +45,27 @@ Joint cost = per-pulsar-parallel Woodbury work + one psum + a small
 dense solve, so ``pta_pulsars_per_chip`` scales with devices and
 `distributed.py`'s multi-host init takes N past one chip.
 
+Array-scale operand plan (the N=64 weak-scaling contract):
+
+- **Sharded placement.** The bucket-padded member stacks are built
+  shard-by-shard and `jax.device_put` straight onto each mesh
+  coordinate's device (fitting/batch.py ``placed_stack``): no device —
+  and no jit reshard — ever holds the full N-pulsar stack. Rebuilds are
+  per-slot incremental: one pulsar's data change restacks one slot (one
+  shard), counted by ``stack_slot_reuse``.
+- **Donation.** The single-device incremental restack DONATES the
+  previous stack to its in-place update program (``fleet_restack``), so
+  a rebuild never holds two N-slot copies; the cost ledger credits the
+  aliasing (``donated_bytes``). The eval/grad/chain programs must NOT
+  donate their stacked operands — the chains re-dispatch the same
+  buffers thousands of times, so consuming them would be semantically
+  wrong (and XLA cannot alias a stacked operand onto their scalar
+  outputs anyway).
+- **Remat.** The per-pulsar Woodbury inner products are wrapped in
+  ``jax.checkpoint``: the joint gradient re-runs each pulsar's forward
+  pass instead of storing every (rows,)-sized basis intermediate, so
+  peak live bytes per chip stay flat as N grows.
+
 The evaluation/optimizer/chain surface is inherited from
 :class:`~pint_tpu.fitting.noise_like.MarginalizedPosterior`: the joint
 hyperparameter vector eta = [per-pulsar noise blocks ..., (log10_A_gw,
@@ -53,8 +74,20 @@ coordinates exactly like the single-pulsar engine, and the gradient is
 taken from OUTSIDE the shard_map (the PR-8 lesson: per-shard autodiff of
 a psum-completed expression double-counts replicated paths).
 
-Telemetry nests under a ``pta`` stage (ops/perf.py `pta_breakdown`);
-bench headlines are `gwb_loglike_evals_per_sec_per_chip` and
+The detection pipeline rides the same per-pulsar blocks as ONE fused
+program (``pta_detection_stat``): the HD-correlated joint likelihood,
+the common-uncorrelated (CURN) alternative — the identical coupling
+with the identity ORF operand — the per-pair correlation statistic
+rho_ab against the HD curve, and the optimal-statistic amplitude ratio,
+all from a single psum-completed block set
+(:meth:`PTALikelihood.detection_statistic`;
+validation/gwb_detection.py runs the injection campaign on top).
+
+Telemetry nests under a ``pta`` stage (ops/perf.py `pta_breakdown`):
+`build` / `stack` / `place` / `eval` / `chain` / `optimize` partition
+the wall, with the in-graph psum payload and replicated solve dimension
+latched statically (`pta_psum_bytes_per_eval`, `pta_solve_dim`). Bench
+headlines are `gwb_loglike_evals_per_sec_per_chip` and
 `pta_pulsars_per_chip` (bench.py --smoke --pta).
 """
 
@@ -64,7 +97,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from pint_tpu.fitting.batch import bucket_rows, stack_trees
+from pint_tpu.fitting.batch import bucket_rows, placed_stack, stack_trees
 from pint_tpu.fitting.noise_like import (
     _LN2PI,
     RIDGE,
@@ -99,13 +132,31 @@ def _block_diag(B: Array) -> Array:
     return out.reshape(n * p, n * q)
 
 
-def _pta_loglike_fn(model, gw_comp, psr_hyper: tuple[str, ...],
-                    gw_hyper: tuple[str, ...], p_lin: int, n_psr: int,
-                    marginalize: bool, red: _AxisReduce):
-    """(eta, params0, data) -> scalar joint marginalized ln-likelihood.
+def _phi_weights(gw_comp, gw_hyper, eta_gw, tspan):
+    """Common-process PSD weights phi (m,) on the shared 1/T .. nf/T
+    frequency grid at one (log10_A_gw, gamma_gw) point."""
+    nf = gw_comp.nf
+    freqs = jnp.repeat(jnp.linspace(1.0 / tspan, nf / tspan, nf), 2)
+    return gw_comp.gwb_weights(
+        {gw_hyper[0]: eta_gw[0], gw_hyper[1]: eta_gw[1]}, freqs)
+
+
+def _pta_core(model, gw_comp, psr_hyper: tuple[str, ...],
+              gw_hyper: tuple[str, ...], p_lin: int, n_psr: int,
+              marginalize: bool, red: _AxisReduce):
+    """The two shared halves of every joint program: ``(gather, couple)``.
+
+    ``gather(eta, params0, data) -> (g, eta_gw, tspan)`` — the per-pulsar
+    (batch-sharded) Woodbury half: each device computes its pulsars'
+    coupling blocks, scatters them into global (N, ...) slots and
+    completes them with ONE psum. ``couple(g, eta_gw, tspan, orf) ->
+    scalar`` — the small replicated coupling half for an ARBITRARY ORF
+    operand: the HD matrix gives the GWB likelihood, the identity gives
+    the common-uncorrelated (CURN) alternative, with no retrace between
+    them (the operand-swap pattern).
 
     eta: (n_psr * h + 2) — per-pulsar noise blocks then the common pair.
-    params0: member params stacked on a leading (batch-sharded) axis.
+    params0: member params stacked on a leading axis (replicated).
     data: {"members": stacked member rows (the noise engine's layout),
     "slot": (n,) global pulsar ids, "orf": (N, N) HD matrix,
     "gw_tspan": the array-wide span} — under shard_map the members/slot
@@ -141,7 +192,14 @@ def _pta_loglike_fn(model, gw_comp, psr_hyper: tuple[str, ...],
                        ldM=2.0 * jnp.sum(jnp.log(d_a["Mnorm"])))
         return out
 
-    def loglike(eta, params0, data):
+    # remat: the joint gradient re-runs each pulsar's forward pass
+    # instead of storing every (rows,)-sized residual/basis/S-factor
+    # intermediate across all N/S local pulsars — per-chip peak live
+    # bytes stay flat in N (the weak-scaling memory contract); only the
+    # tiny coupling blocks persist to the backward pass
+    pulsar_blocks = jax.checkpoint(pulsar_blocks)
+
+    def gather(eta, params0, data):
         red.begin()
         slot = data["slot"]
         tspan = data["gw_tspan"]
@@ -163,16 +221,15 @@ def _pta_loglike_fn(model, gw_comp, psr_hyper: tuple[str, ...],
         parts = jnp.split(joined, np.cumsum(sizes)[:-1])
         g = jax.tree_util.tree_unflatten(
             tree, [p.reshape(f.shape) for p, f in zip(parts, flat)])
+        return g, eta_gw, tspan
 
+    def couple(g, eta_gw, tspan, orf):
         chi2 = jnp.sum(g["chi2"])
         ld = jnp.sum(g["ld"])
         n_eff = jnp.sum(g["n"])
 
         # --- the common-process coupling: small, dense, replicated -----
-        freqs = jnp.repeat(jnp.linspace(1.0 / tspan, nf / tspan, nf), 2)
-        params_gw = {gw_hyper[0]: eta_gw[0], gw_hyper[1]: eta_gw[1]}
-        phi = gw_comp.gwb_weights(params_gw, freqs)           # (m,)
-        orf = data["orf"]                                     # (N, N)
+        phi = _phi_weights(gw_comp, gw_hyper, eta_gw, tspan)   # (m,)
         orf_cf = jax.scipy.linalg.cho_factor(orf)
         orf_inv = jax.scipy.linalg.cho_solve(orf_cf, jnp.eye(n_psr))
         # ln|Phi| = ln|ORF (x) diag(phi)| = m ln|ORF| + N sum ln phi
@@ -205,7 +262,57 @@ def _pta_loglike_fn(model, gw_comp, psr_hyper: tuple[str, ...],
                 n_prof = float(n_psr * p_lin)
         return -0.5 * (chi2 + ld + (n_eff - n_prof) * _LN2PI)
 
+    return gather, couple
+
+
+def _pta_loglike_fn(model, gw_comp, psr_hyper: tuple[str, ...],
+                    gw_hyper: tuple[str, ...], p_lin: int, n_psr: int,
+                    marginalize: bool, red: _AxisReduce):
+    """(eta, params0, data) -> scalar joint marginalized ln-likelihood
+    (the HD-correlated GWB model — couple at the data's ORF operand)."""
+    gather, couple = _pta_core(model, gw_comp, psr_hyper, gw_hyper,
+                               p_lin, n_psr, marginalize, red)
+
+    def loglike(eta, params0, data):
+        g, eta_gw, tspan = gather(eta, params0, data)
+        return couple(g, eta_gw, tspan, data["orf"])
+
     return loglike
+
+
+def _pta_detection_fn(model, gw_comp, psr_hyper: tuple[str, ...],
+                      gw_hyper: tuple[str, ...], p_lin: int, n_psr: int,
+                      marginalize: bool, red: _AxisReduce):
+    """(eta, params0, data) -> the fused detection-statistic record.
+
+    ONE psum-completed block set feeds every detection quantity:
+    ``ll_hd`` (the HD-correlated joint likelihood), ``ll_curn`` (the
+    common-uncorrelated alternative: the identical coupling at the
+    identity ORF), ``rho`` (P = N(N-1)/2 per-pair correlation statistics
+    in `numpy.triu_indices` order — on average Gamma_ab for a strong
+    common signal, the optimal-statistic numerator of arXiv:1202.5932
+    s.4) and ``os`` (the OS amplitude-ratio estimate
+    sum rho Gamma / sum Gamma^2)."""
+    gather, couple = _pta_core(model, gw_comp, psr_hyper, gw_hyper,
+                               p_lin, n_psr, marginalize, red)
+    ia, ib = np.triu_indices(n_psr, 1)  # static pair index
+
+    def detect(eta, params0, data):
+        g, eta_gw, tspan = gather(eta, params0, data)
+        orf = data["orf"]
+        ll_hd = couple(g, eta_gw, tspan, orf)
+        ll_curn = couple(g, eta_gw, tspan, jnp.eye(n_psr))
+        phi = _phi_weights(gw_comp, gw_hyper, eta_gw, tspan)
+        u = g["u"]                                    # (N, m)
+        s = u * phi[None, :]
+        auto = jnp.einsum("am,am->a", s, u)
+        denom = jnp.sqrt(jnp.maximum(auto[ia] * auto[ib], 1e-300))
+        rho = jnp.einsum("pm,pm->p", s[ia], u[ib]) / denom
+        gam = orf[ia, ib]
+        os = jnp.sum(rho * gam) / jnp.maximum(jnp.sum(gam * gam), 1e-300)
+        return {"ll_hd": ll_hd, "ll_curn": ll_curn, "rho": rho, "os": os}
+
+    return detect
 
 
 class PTALikelihood(MarginalizedPosterior):
@@ -226,7 +333,16 @@ class PTALikelihood(MarginalizedPosterior):
     ORF diagonal), pulsars couple only through the
     ORF (x) diag(phi_gw) block, and with a mesh carrying a ``batch``
     axis of size S | N the per-pulsar work shards S-wide with one psum
-    (`distributed.pta_mesh` builds a valid layout).
+    (`distributed.pta_mesh` builds a valid layout) — each device
+    materializes ONLY its N/S pulsars' bucket-padded stacks
+    (fitting/batch.py ``placed_stack``).
+
+    Rebuild contract: constructing a new array over a mostly-unchanged
+    member set reuses the previous stacked operands per slot
+    (``stack_slot_reuse``); a single-device incremental rebuild DONATES
+    the previous stack's buffers to the in-place update, so the OLDER
+    ``PTALikelihood`` over the same (kind, shape) member family must be
+    dropped before rebuilding with changed members.
     """
 
     STAGE = "pta"
@@ -240,123 +356,191 @@ class PTALikelihood(MarginalizedPosterior):
         if not likelihoods:
             raise ValueError("empty pulsar array")
         with perf.stage(self.STAGE):
-            with perf.stage("build"):
-                self._build(list(likelihoods), mesh, batch_axis,
-                            priors or {}, bool(marginalize_timing),
-                            _args_signature)
+            self._build(list(likelihoods), mesh, batch_axis,
+                        priors or {}, bool(marginalize_timing),
+                        _args_signature)
 
     def _build(self, members, mesh, batch_axis, priors, marginalize,
                _args_signature):
-        nl0 = members[0]
-        self.members = members
-        self.model = nl0.model
-        self.marginalize_timing = marginalize
-        self.mesh = mesh
-        self.batch_axis = batch_axis
-        n = len(members)
+        with perf.stage("build"):
+            nl0 = members[0]
+            self.members = members
+            self.model = nl0.model
+            self.marginalize_timing = marginalize
+            self.mesh = mesh
+            self.batch_axis = batch_axis
+            n = len(members)
 
-        gw_comp = self.model.common_noise_component
-        if gw_comp is None:
-            raise ValueError(
-                "PTA members carry no common noise process (PLGWBNoise / "
-                "TNGWAMP) — nothing couples the pulsars")
-        self.gw_comp = gw_comp
-        self.gw_hyper = tuple(gw_comp.hyper_param_names(self.model.params))
-        if len(self.gw_hyper) != 2:
-            raise ValueError(
-                f"common process exposes {self.gw_hyper}; expected the "
-                "(log10 amplitude, spectral index) pair")
-        self.psr_hyper = tuple(
-            h for h in nl0.hyper if h not in self.gw_hyper)
-        for nl in members:
-            if tuple(h for h in nl.hyper if h not in self.gw_hyper) \
-                    != self.psr_hyper:
+            gw_comp = self.model.common_noise_component
+            if gw_comp is None:
                 raise ValueError(
-                    f"array hyper mismatch: {nl.hyper} vs {nl0.hyper}")
-            if nl.p_lin != nl0.p_lin:
-                raise ValueError("array timing-design width mismatch")
-            if nl.model.common_noise_component is None or \
-                    nl.model.common_noise_component.nf != gw_comp.nf:
-                raise ValueError("array common-process mode-count mismatch")
-        self.p_lin = nl0.p_lin
-
-        # mesh layout first — an invalid shard count must fail BEFORE
-        # any member stacking work
-        n_shards = 1
-        if mesh is not None and batch_axis in mesh.shape:
-            n_shards = int(mesh.shape[batch_axis])
-        if n_shards > 1 and n % n_shards:
-            raise ValueError(
-                f"{n} pulsars do not divide over {n_shards} batch shards; "
-                "use distributed.pta_mesh(n_pulsars) for a valid layout")
-        self.n_shards = n_shards
-
-        # --- stacked bucket-padded member operands (the fleet recipe) --
-        rows = max(bucket_rows(nl._n_data, 1)[0] for nl in members)
-        self.rows = rows
-        datas = [nl._layout_padded(rows) for nl in members]
-        sig0 = _args_signature(datas[0])
-        for d in datas[1:]:
-            if _args_signature(d) != sig0:
+                    "PTA members carry no common noise process (PLGWBNoise"
+                    " / TNGWAMP) — nothing couples the pulsars")
+            self.gw_comp = gw_comp
+            self.gw_hyper = tuple(
+                gw_comp.hyper_param_names(self.model.params))
+            if len(self.gw_hyper) != 2:
                 raise ValueError(
-                    "array operand-signature mismatch: members must share "
-                    "a model skeleton (component graph, Fourier mode "
-                    "counts, ECORR epoch counts)")
-        self._params0 = stack_trees([nl._params0 for nl in members])
+                    f"common process exposes {self.gw_hyper}; expected the "
+                    "(log10 amplitude, spectral index) pair")
+            self.psr_hyper = tuple(
+                h for h in nl0.hyper if h not in self.gw_hyper)
+            for nl in members:
+                if tuple(h for h in nl.hyper if h not in self.gw_hyper) \
+                        != self.psr_hyper:
+                    raise ValueError(
+                        f"array hyper mismatch: {nl.hyper} vs {nl0.hyper}")
+                if nl.p_lin != nl0.p_lin:
+                    raise ValueError("array timing-design width mismatch")
+                if nl.model.common_noise_component is None or \
+                        nl.model.common_noise_component.nf != gw_comp.nf:
+                    raise ValueError(
+                        "array common-process mode-count mismatch")
+            self.p_lin = nl0.p_lin
 
-        # sky geometry -> the HD matrix (host, once: positions are not
-        # sampled), and the ARRAY-WIDE span the shared frequency grid
-        # 1/T .. nf/T hangs off — per-pulsar spans would de-cohere the
-        # cross-pulsar Fourier modes
-        self.positions = np.stack([pulsar_position(nl.model)
-                                   for nl in members])
-        self.orf = orf_matrix(self.positions)
-        t_lo, t_hi = np.inf, -np.inf
-        for nl in members:
-            t = nl.toas.tdb.mjd_float() * 86400.0
-            real = np.asarray(nl.toas.error_us) > 0
-            t = t[real] if real.any() else t
-            t_lo, t_hi = min(t_lo, t.min()), max(t_hi, t.max())
-        self.gw_tspan = float(t_hi - t_lo)
+            # mesh layout first — an invalid shard count must fail BEFORE
+            # any member stacking work
+            n_shards = 1
+            if mesh is not None and batch_axis in mesh.shape:
+                n_shards = int(mesh.shape[batch_axis])
+            if n_shards > 1 and n % n_shards:
+                raise ValueError(
+                    f"{n} pulsars do not divide over {n_shards} batch "
+                    "shards; use distributed.pta_mesh(n_pulsars) for a "
+                    "valid layout")
+            self.n_shards = n_shards
 
-        self.data = {
-            "members": stack_trees(datas),
-            "slot": jnp.arange(n, dtype=jnp.int32),
-            "orf": jnp.asarray(self.orf),
+            # sky geometry -> the HD matrix (host, once: positions are
+            # not sampled), and the ARRAY-WIDE span the shared frequency
+            # grid 1/T .. nf/T hangs off — per-pulsar spans would
+            # de-cohere the cross-pulsar Fourier modes
+            self.positions = np.stack([pulsar_position(nl.model)
+                                       for nl in members])
+            self.orf = orf_matrix(self.positions)
+            t_lo, t_hi = np.inf, -np.inf
+            for nl in members:
+                t = nl.toas.tdb.mjd_float() * 86400.0
+                real = np.asarray(nl.toas.error_us) > 0
+                t = t[real] if real.any() else t
+                t_lo, t_hi = min(t_lo, t.min()), max(t_hi, t.max())
+            self.gw_tspan = float(t_hi - t_lo)
+
+        # --- stacked bucket-padded member operands (the fleet recipe,
+        # placed by mesh coordinate) ------------------------------------
+        with perf.stage("stack"):
+            rows = max(bucket_rows(nl._n_data, 1)[0] for nl in members)
+            self.rows = rows
+            datas = [nl._layout_padded(rows) for nl in members]
+            sig0 = _args_signature(datas[0])
+            for d in datas[1:]:
+                if _args_signature(d) != sig0:
+                    raise ValueError(
+                        "array operand-signature mismatch: members must "
+                        "share a model skeleton (component graph, Fourier "
+                        "mode counts, ECORR epoch counts)")
+        # placed_stack opens its own pta/stack + pta/place stages; the
+        # member-data stack shards over the mesh, params0 stays a small
+        # replicated stack (the chain programs consume it outside any
+        # shard_map), both incrementally rebuilt per slot
+        mesh_key = None
+        if n_shards > 1:
+            mesh_key = (tuple(int(d.id) for d in
+                              np.asarray(mesh.devices).reshape(-1)),
+                        tuple(mesh.shape.items()), batch_axis)
+        members_stack = placed_stack(
+            members, datas, key=("pta", "data", n, rows, mesh_key),
+            mesh=mesh if n_shards > 1 else None, axis=batch_axis)
+        self._params0 = placed_stack(
+            members, [nl._params0 for nl in members],
+            key=("pta", "params0", n, rows, mesh_key))
+
+        with perf.stage("place"):
+            slot = jnp.arange(n, dtype=jnp.int32)
+            orf = jnp.asarray(self.orf)
             # strong-typed scalar: a weak float leaf would retrace once
             # it comes back as a committed array (weak-type audit pass)
-            "gw_tspan": jnp.asarray(np.float64(self.gw_tspan)),
-        }
-        self._plain_data = self.data  # no row re-layout: chains reuse it
+            tspan = jnp.asarray(np.float64(self.gw_tspan))
+            if n_shards > 1:
+                from jax.sharding import NamedSharding
+                from jax.sharding import PartitionSpec as P
 
-        # --- joint coordinates, priors, scales, start point ------------
-        psrs = [nl.model.psr_name or f"PSR{a}" for a, nl in
-                enumerate(members)]
-        if len(set(psrs)) != len(psrs):  # de-collide duplicate par names
-            psrs = [f"{p}#{a}" for a, p in enumerate(psrs)]
-        names, x0, scales = [], [], []
-        self.priors = {}
-        for nl, psr in zip(members, psrs):
-            for h in self.psr_hyper:
-                j = nl.hyper.index(h)
-                names.append(f"{psr}:{h}")
-                x0.append(nl.x0[j])
-                scales.append(nl.scales[j])
-                self.priors[f"{psr}:{h}"] = priors.get(h, nl.priors[h])
-        gw_defaults = default_noise_priors(self.model, self.gw_hyper)
-        from pint_tpu.models.base import leaf_to_f64
+                # slot shards with the members; the small ORF/span
+                # operands are REPLICATED onto every mesh device up
+                # front, so no eval re-broadcasts them
+                slot = jax.device_put(
+                    slot, NamedSharding(mesh, P(batch_axis)))
+                orf = jax.device_put(orf, NamedSharding(mesh, P()))
+                tspan = jax.device_put(tspan, NamedSharding(mesh, P()))
+            self.data = {"members": members_stack, "slot": slot,
+                         "orf": orf, "gw_tspan": tspan}
 
-        for h in self.gw_hyper:
-            names.append(h)
-            x0.append(float(np.asarray(leaf_to_f64(
-                self.model.params[h]))))
-            scales.append(_prior_scale(gw_defaults[h]))
-            self.priors[h] = priors.get(h, gw_defaults[h])
-        self.hyper = tuple(names)
-        self.x0 = np.asarray(x0)
-        self.scales = np.asarray(scales)
+        with perf.stage("build"):
+            # --- joint coordinates, priors, scales, start point --------
+            psrs = [nl.model.psr_name or f"PSR{a}" for a, nl in
+                    enumerate(members)]
+            if len(set(psrs)) != len(psrs):  # de-collide duplicates
+                psrs = [f"{p}#{a}" for a, p in enumerate(psrs)]
+            names, x0, scales = [], [], []
+            self.priors = {}
+            for nl, psr in zip(members, psrs):
+                for h in self.psr_hyper:
+                    j = nl.hyper.index(h)
+                    names.append(f"{psr}:{h}")
+                    x0.append(nl.x0[j])
+                    scales.append(nl.scales[j])
+                    self.priors[f"{psr}:{h}"] = priors.get(h, nl.priors[h])
+            gw_defaults = default_noise_priors(self.model, self.gw_hyper)
+            from pint_tpu.models.base import leaf_to_f64
 
-        self._programs = self._compile(n, n_shards)
+            for h in self.gw_hyper:
+                names.append(h)
+                x0.append(float(np.asarray(leaf_to_f64(
+                    self.model.params[h]))))
+                scales.append(_prior_scale(gw_defaults[h]))
+                self.priors[h] = priors.get(h, gw_defaults[h])
+            self.hyper = tuple(names)
+            self.x0 = np.asarray(x0)
+            self.scales = np.asarray(scales)
+
+            self._programs = self._compile(n, n_shards)
+
+            # the psum and replicated-solve halves of an eval live INSIDE
+            # the one fused program; their static shape is latched for
+            # the breakdown (ops/perf.py pta_breakdown)
+            m = 2 * self.gw_comp.nf
+            p = self.p_lin
+            elems = n * (3 + m + m * m)
+            if p:
+                elems += n * (p * p + p + p * m) + n
+            perf.put("pta_psum_bytes_per_eval",
+                     int(8 * elems) if n_shards > 1 else 0)
+            perf.put("pta_solve_dim", int(n * m + n * p))
+
+    # chains/optimizer/Hessian run the REPLICATED composition (the
+    # gradient-outside-shard_map rule), so on a mesh they need a plain
+    # full stack — materialized lazily (each member's bucket-padded
+    # layout is memoized, so this is a host re-stack, not a re-layout)
+    # and only if the chain surface is actually used; the sharded eval/
+    # grad path never pays for it.
+    @property
+    def _plain_data(self):
+        if self.n_shards <= 1:
+            return self.data
+        cached = self.__dict__.get("_plain_cache")
+        if cached is None:
+            with perf.stage(self.STAGE):
+                with perf.stage("stack"):
+                    cached = {
+                        "members": stack_trees(
+                            [nl._layout_padded(self.rows)
+                             for nl in self.members]),
+                        "slot": jnp.arange(len(self.members),
+                                           dtype=jnp.int32),
+                        "orf": jnp.asarray(self.orf),
+                        "gw_tspan": jnp.asarray(np.float64(self.gw_tspan)),
+                    }
+            self.__dict__["_plain_cache"] = cached
+        return cached
 
     # --- program construction ----------------------------------------------------
 
@@ -473,7 +657,107 @@ class PTALikelihood(MarginalizedPosterior):
         self._laplace_scales = out
         return out
 
+    # --- detection statistics ------------------------------------------------------
+
+    def detection_program(self):
+        """The fused detection-statistic program (``pta_detection_stat``,
+        sharded like the likelihood): ``prog(eta, params0, data) ->
+        {"ll_hd", "ll_curn", "rho", "os"}`` — one psum-completed block
+        set feeds the HD/CURN model comparison AND the per-pair
+        correlation statistics (see :func:`_pta_detection_fn`)."""
+        prog = self.__dict__.get("_detect_prog")
+        if prog is not None:
+            return prog
+        from pint_tpu.ops.compile import TimedProgram, precision_jit
+
+        axis = self.batch_axis if self.n_shards > 1 else None
+        fn = self._wrap(
+            _pta_detection_fn(self.model, self.gw_comp, self.psr_hyper,
+                              self.gw_hyper, self.p_lin,
+                              len(self.members), self.marginalize_timing,
+                              _AxisReduce(axis)),
+            self.n_shards)
+        prog = self.__dict__["_detect_prog"] = TimedProgram(
+            precision_jit(fn), "pta_detection_stat",
+            collective_axes=(axis,) if axis else (),
+            precision_spec=self.model.xprec.name,
+            aot_key=f"{self._aot_base()}|detect|shards={self.n_shards}")
+        return prog
+
+    def detection_statistic(self, eta) -> dict:
+        """Every detection-pipeline quantity at one eta, from ONE fused
+        evaluation: {"ll_hd", "ll_curn", "dll" (the HD-vs-CURN
+        log-likelihood margin), "rho" (P,), "os", "angle_deg" (P,),
+        "hd" (P,)} with pairs in `numpy.triu_indices(N, 1)` order."""
+        prog = self.detection_program()
+        with perf.stage(self.STAGE):
+            with perf.stage("eval"):
+                perf.add("pta_loglike_evals", 1)
+                out = prog(jnp.asarray(eta, jnp.float64), self._params0,
+                           self.data)
+        n = len(self.members)
+        ia, ib = np.triu_indices(n, 1)
+        cos = np.clip(self.positions @ self.positions.T, -1.0, 1.0)
+        return {
+            "ll_hd": float(out["ll_hd"]),
+            "ll_curn": float(out["ll_curn"]),
+            "dll": float(out["ll_hd"]) - float(out["ll_curn"]),
+            "rho": np.asarray(out["rho"]),
+            "os": float(out["os"]),
+            "angle_deg": np.degrees(np.arccos(cos[ia, ib])),
+            "hd": np.asarray(self.orf)[ia, ib],
+        }
+
+    def loglike_curn(self, eta) -> float:
+        """The common-uncorrelated (CURN) alternative's joint marginalized
+        ln-likelihood: the SAME compiled program as :meth:`loglike`
+        evaluated with the identity ORF operand (an operand swap — zero
+        extra traces/compiles), for HD-vs-CURN model comparison."""
+        data = dict(self.data)
+        eye = jnp.eye(len(self.members))
+        if self.n_shards > 1:
+            from jax.sharding import NamedSharding
+            from jax.sharding import PartitionSpec as P
+
+            eye = jax.device_put(eye, NamedSharding(self.mesh, P()))
+        data["orf"] = eye
+        with perf.stage(self.STAGE):
+            with perf.stage("eval"):
+                perf.add("pta_loglike_evals", 1)
+                out = self._programs.loglike(
+                    jnp.asarray(eta, jnp.float64), self._params0, data)
+        return float(out)
+
     # --- diagnostics ---------------------------------------------------------------
+
+    def static_peak_bytes_per_chip(self) -> int:
+        """Per-chip peak live bytes of the fused joint ln-likelihood from
+        the STATIC cost model (trace-only — no compile, no execution).
+
+        The ledger's liveness walk prices the program at its global
+        (unsharded) signature, counting every pulsar-sharded operand at
+        full ``(N, ...)`` size; each device only ever materializes its
+        ``N/S`` slice, so the per-chip peak subtracts the sharded operand
+        bytes and adds back one shard's worth.  The replicated coupling
+        stage (the ``(N m + N p)``-dim Sigma solve) is global physics and
+        stays whole on every chip — weak scaling holds the per-pulsar
+        term flat while the coupling term grows with N, which is exactly
+        what the checked-in budget prices."""
+        cached = self.__dict__.get("_static_peak_per_chip")
+        if cached is not None:
+            return cached
+        from pint_tpu.analysis import costmodel
+
+        closed = self._programs.loglike.jfn.trace(
+            jnp.asarray(self.x0), self._params0, self.data).jaxpr
+        peak = costmodel.program_cost(closed)["peak_bytes"]
+        sharded = sum(
+            int(np.prod(x.shape)) * x.dtype.itemsize
+            for x in jax.tree_util.tree_leaves(
+                (self.data["members"], self.data["slot"])))
+        out = int(peak - sharded + -(-sharded // max(1, self.n_shards)))
+        self._static_peak_per_chip = out
+        return out
 
     def gwb_coefficient_blocks(self, eta) -> dict:
         """Per-pulsar common-process inner products at one eta — the
@@ -507,12 +791,8 @@ class PTALikelihood(MarginalizedPosterior):
 
                 u, V = jax.vmap(one, in_axes=(0, 0, 0))(
                     eta_psr, params0, data["members"])
-                nf = self.gw_comp.nf
-                freqs = jnp.repeat(
-                    jnp.linspace(1.0 / tspan, nf / tspan, nf), 2)
-                phi = self.gw_comp.gwb_weights(
-                    {self.gw_hyper[0]: eta_gw[0],
-                     self.gw_hyper[1]: eta_gw[1]}, freqs)
+                phi = _phi_weights(self.gw_comp, self.gw_hyper, eta_gw,
+                                   tspan)
                 return {"u": u, "V": V, "phi": phi}
 
             fn = self.__dict__["_blocks_prog"] = TimedProgram(
@@ -578,10 +858,7 @@ class PTALikelihood(MarginalizedPosterior):
             Cs, Gs, rs, Ms, n_a, ldM = jax.vmap(
                 one, in_axes=(0, 0, 0, None))(eta_psr, params0,
                                               data["members"], tspan)
-            freqs = jnp.repeat(jnp.linspace(1.0 / tspan, nf / tspan, nf),
-                               2)
-            phi = gw_comp.gwb_weights(
-                {gw_hyper[0]: eta_gw[0], gw_hyper[1]: eta_gw[1]}, freqs)
+            phi = _phi_weights(gw_comp, gw_hyper, eta_gw, tspan)
             Gb = _block_diag(Gs)                       # (N rows, N m)
             C = (_block_diag(Cs)
                  + Gb @ jnp.kron(data["orf"], jnp.diag(phi)) @ Gb.T)
@@ -615,19 +892,9 @@ class PTALikelihood(MarginalizedPosterior):
         prediction: rho_ab = u_a^T diag(phi) u_b normalized by the
         auto terms — on average Gamma_ab for a strong common signal
         (the optimal-statistic numerator shape, arXiv:1202.5932 s.4).
+        Rides the fused detection-statistic program (one device
+        evaluation for all N(N-1)/2 pairs).
         Returns {"angle_deg": (P,), "rho": (P,), "hd": (P,)}."""
-        blk = self.gwb_coefficient_blocks(eta)
-        u, phi = blk["u"], blk["phi"]
-        n = u.shape[0]
-        s = u * phi[None, :]
-        auto = np.einsum("am,am->a", s, u)
-        angles, rho, hd = [], [], []
-        cos = np.clip(self.positions @ self.positions.T, -1.0, 1.0)
-        for a in range(n):
-            for b in range(a + 1, n):
-                angles.append(float(np.degrees(np.arccos(cos[a, b]))))
-                rho.append(float(s[a] @ u[b]
-                                 / np.sqrt(max(auto[a] * auto[b], 1e-300))))
-                hd.append(float(self.orf[a, b]))
-        return {"angle_deg": np.asarray(angles), "rho": np.asarray(rho),
-                "hd": np.asarray(hd)}
+        det = self.detection_statistic(eta)
+        return {"angle_deg": det["angle_deg"], "rho": det["rho"],
+                "hd": det["hd"]}

@@ -575,20 +575,26 @@ class TimedProgram:
     """
 
     __slots__ = ("jfn", "label", "collective_axes", "canonical",
-                 "precision_spec", "aot_key", "_exes", "_disk_sigs",
-                 "_bad_sigs", "_lock")
+                 "precision_spec", "aot_key", "donate_invars", "_exes",
+                 "_disk_sigs", "_bad_sigs", "_lock")
 
     def __init__(self, jfn, label: str,
                  collective_axes: tuple[str, ...] = (),
                  canonical: bool = True,
                  precision_spec=None,
-                 aot_key: str | None = None):
+                 aot_key: str | None = None,
+                 donate_invars: tuple[int, ...] = ()):
         self.jfn = jfn
         self.label = label
         self.collective_axes = tuple(collective_axes)
         self.canonical = canonical
         self.precision_spec = precision_spec
         self.aot_key = aot_key
+        #: flat jaxpr invar indices the wrapped jit donates
+        #: (``donate_argnums`` on a flat-array signature): the cost model
+        #: credits the input-output aliasing so the ledger's peak_bytes
+        #: reflects the in-place update instead of a doubled buffer
+        self.donate_invars = tuple(donate_invars)
         self._exes: dict = {}
         # sig -> aot_epoch at deserialization time: a persistent-cache
         # dir change invalidates these handles (never compiled ones)
@@ -715,7 +721,9 @@ class TimedProgram:
                         # audit block
                         from pint_tpu.analysis import costmodel
 
-                        costmodel.record_program(self.label, closed)
+                        costmodel.record_program(
+                            self.label, closed,
+                            donate_invars=self.donate_invars)
                     with perf.stage("compile"):
                         exe = lowered.compile()
                         if self.aot_key is not None and aot_enabled():
@@ -739,7 +747,11 @@ class TimedProgram:
     def __call__(self, *args):
         collecting = perf.active()
         aot = self.aot_key is not None and aot_enabled()
-        if not self._exes and not collecting and not aot:
+        if (not self._exes and not collecting and not aot
+                and not self.donate_invars):
+            # donating programs never take this bypass: the donated
+            # input-output aliasing is part of the cost-ledger contract
+            # (no doubled peak), which only the _compile path records
             return self.jfn(*args)
         self._evict_stale_disk_exes()
         sig = _args_signature(args)
@@ -754,7 +766,7 @@ class TimedProgram:
         exe = self._exes.get(sig)
         compiled_here = False
         if exe is None:
-            if not collecting and not aot:
+            if not collecting and not aot and not self.donate_invars:
                 return self.jfn(*args)
             exe, compiled_here = self._compile(sig, args)
         try:

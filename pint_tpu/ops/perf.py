@@ -364,25 +364,36 @@ def noise_breakdown(rep: PerfReport) -> dict:
     `noise_divergences` counts masked divergent HMC trajectories,
     `fleet_stack_reuse` counts bucket-padded member layouts served from
     the per-member memo instead of re-padded (NoiseFleet /
-    PTALikelihood construction over a resident member set).
+    PTALikelihood construction over a resident member set), and
+    `stack_slot_reuse` counts stacked slots whose device buffers were
+    reused across a rebuild (fitting/batch.py placed_stack — the
+    per-slot invalidation contract).
     """
     out = _root_breakdown(rep, "noise", _NOISE_COMPONENTS)
     out["noise_loglike_evals"] = int(rep.counters.get("noise_loglike_evals", 0))
     out["noise_chain_steps"] = int(rep.counters.get("noise_chain_steps", 0))
     out["noise_divergences"] = int(rep.counters.get("noise_divergences", 0))
     out["fleet_stack_reuse"] = int(rep.counters.get("fleet_stack_reuse", 0))
+    out["stack_slot_reuse"] = int(rep.counters.get("stack_slot_reuse", 0))
     return out
 
 
 # --- the canonical joint-PTA breakdown -------------------------------------------
 
-#: PTA sub-stages named in the breakdown (fitting/pta_like.py): member
-#: stacking + ORF/span assembly + joint-program setup + Laplace scales
-#: (`build`), fused joint likelihood/gradient evaluations (`eval`),
-#: vmapped joint chains (`chain`) and batched optimizer restarts
-#: (`optimize`); anything else directly under a `pta` stage lands in
-#: pta_other_s.
-_PTA_COMPONENTS = ("build", "eval", "chain", "optimize")
+#: PTA sub-stages named in the breakdown (fitting/pta_like.py): ORF/span
+#: assembly + joint-program setup + Laplace scales (`build`), per-member
+#: bucket-padded layout + host slot stacking (`stack`), device placement
+#: of the stacked operands by mesh coordinate (`place`), fused joint
+#: likelihood/gradient evaluations (`eval`), vmapped joint chains
+#: (`chain`) and batched optimizer restarts (`optimize`); anything else
+#: directly under a `pta` stage lands in pta_other_s. The in-graph psum
+#: and replicated dense-solve halves of an eval cannot be host-timed
+#: (they live inside ONE fused program), so the breakdown carries their
+#: STATIC shape instead: `pta_psum_bytes_per_eval` (the interconnect
+#: payload of the one completing psum) and `pta_solve_dim` (the
+#: replicated Sigma+timing solve dimension N·m + N·p), latched at
+#: program-build time.
+_PTA_COMPONENTS = ("build", "stack", "place", "eval", "chain", "optimize")
 
 
 def pta_breakdown(rep: PerfReport) -> dict:
@@ -394,12 +405,18 @@ def pta_breakdown(rep: PerfReport) -> dict:
     likelihood/gradient evaluation, `pta_chain_steps` is joint
     chain-step draws, `pta_divergences` counts masked divergent HMC
     trajectories, `fleet_stack_reuse` counts member layouts served from
-    the padded-stack memo."""
+    the padded-stack memo, and `stack_slot_reuse` counts stacked slots
+    whose device buffers were reused across a rebuild (fitting/batch.py
+    placed_stack — the per-slot invalidation contract)."""
     out = _root_breakdown(rep, "pta", _PTA_COMPONENTS)
     out["pta_loglike_evals"] = int(rep.counters.get("pta_loglike_evals", 0))
     out["pta_chain_steps"] = int(rep.counters.get("pta_chain_steps", 0))
     out["pta_divergences"] = int(rep.counters.get("pta_divergences", 0))
     out["fleet_stack_reuse"] = int(rep.counters.get("fleet_stack_reuse", 0))
+    out["stack_slot_reuse"] = int(rep.counters.get("stack_slot_reuse", 0))
+    for k in ("pta_psum_bytes_per_eval", "pta_solve_dim"):
+        if k in rep.values:
+            out[k] = rep.values[k]
     return out
 
 

@@ -25,7 +25,7 @@ import numpy as np
 
 __all__ = [
     "SMOKE_PAR", "FLAGSHIP_SMOKE_PAR", "PTA_PAR_TEMPLATE", "PTA_SKY",
-    "RECEIVERS", "flagship_smoke_dataset", "pta_smoke_array",
+    "RECEIVERS", "flagship_smoke_dataset", "pta_sky", "pta_smoke_array",
     "serve_smoke_fleet", "spin_grid", "grid_for",
 ]
 
@@ -159,29 +159,75 @@ PTA_SKY = (
 )
 
 
-def pta_smoke_array(n_pulsars: int, ntoas: int, seed: int = 29):
+def _hms(hours: float) -> str:
+    h = int(hours)
+    rem = (hours - h) * 60.0
+    m = int(rem)
+    return f"{h:02d}:{m:02d}:{(rem - m) * 60.0:07.4f}"
+
+
+def _dms(deg: float) -> str:
+    sign = "-" if deg < 0 else ""
+    deg = abs(deg)
+    d = int(deg)
+    rem = (deg - d) * 60.0
+    m = int(rem)
+    return f"{sign}{d:02d}:{m:02d}:{(rem - m) * 60.0:06.3f}"
+
+
+def pta_sky(n_pulsars: int):
+    """First ``n_pulsars`` rows of the array sky: the fixed PTA_SKY grid
+    extended procedurally past 8 with an index-only low-discrepancy map
+    (golden-angle RA, irrational-stride sin(dec)). Row k depends on k
+    alone — never on the array size — so growing an array to NANOGrav
+    scale (N=64+) never moves the positions (or program signatures) of
+    the pulsars already in it."""
+    rows = list(PTA_SKY[:n_pulsars])
+    golden = np.pi * (3.0 - np.sqrt(5.0))
+    for k in range(len(rows), n_pulsars):
+        ra_hours = (k * golden / (2.0 * np.pi)) % 1.0 * 24.0
+        # keep |dec| < ~72 deg: pair angles still sweep the HD curve and
+        # the parfile round-trip stays away from polar-coordinate edges
+        sin_dec = np.clip(2.0 * ((k * np.sqrt(2.0)) % 1.0) - 1.0,
+                          -0.95, 0.95)
+        dec_deg = float(np.degrees(np.arcsin(sin_dec)))
+        rows.append((f"PTA{k:04d}", _hms(ra_hours), _dms(dec_deg)))
+    return tuple(rows)
+
+
+def pta_smoke_array(n_pulsars: int, ntoas: int, seed: int = 29,
+                    gwb_amp: float | None = None):
     """(models, toas_list): an N-pulsar PTA array with an injected
     Hellings-Downs-correlated GWB, per-pulsar red + white noise drawn
     from each model's own covariance. Shapes (and every program
     signature) depend only on (n_pulsars, ntoas); the draws only change
     values — the contract the `pta` warmup profile and the --smoke --pta
-    bench share."""
+    bench share.
+
+    `gwb_amp` overrides the INJECTED log10 GWB amplitude only: the
+    returned likelihood models keep the template's TNGWAMP, so a
+    detection campaign (validation/gwb_detection.py) can sweep the
+    injected strain — including an effectively-null -20 — against a
+    fixed analysis model without perturbing any program signature or
+    the per-pulsar noise draws (the rng stream is identical across
+    amplitudes at a fixed seed: paired realizations)."""
     from pint_tpu.io.par import parse_parfile
     from pint_tpu.models.builder import build_model
     from pint_tpu.simulation import (add_gwb_to_arrays,
                                      add_noise_from_model,
                                      make_fake_toas_fromMJDs)
 
-    if n_pulsars > len(PTA_SKY):
-        raise ValueError(
-            f"pta profile carries {len(PTA_SKY)} sky positions; "
-            f"{n_pulsars} pulsars need more rows in PTA_SKY")
     rng = np.random.default_rng(seed)
-    models, toas_list = [], []
+    sky = pta_sky(n_pulsars)
+    models, toas_list, inject_models = [], [], []
     for k in range(n_pulsars):
-        name, raj, decj = PTA_SKY[k]
+        name, raj, decj = sky[k]
         par = PTA_PAR_TEMPLATE.format(
             name=name, raj=raj, decj=decj, f0=346.531996493 + 0.37 * k)
+        if gwb_amp is not None:
+            inject_models.append(build_model(parse_parfile(
+                par.replace("TNGWAMP -12.8", f"TNGWAMP {gwb_amp}"),
+                from_text=True)))
         model = build_model(parse_parfile(par, from_text=True))
         n_epochs = max(ntoas // 2, 4)
         mjds = np.repeat(np.linspace(56300.0, 57700.0, n_epochs), 2)
@@ -197,7 +243,9 @@ def pta_smoke_array(n_pulsars: int, ntoas: int, seed: int = 29):
                                     include_common=False)
         models.append(model)
         toas_list.append(toas)
-    return models, add_gwb_to_arrays(toas_list, models, rng=rng)
+    return models, add_gwb_to_arrays(
+        toas_list, inject_models if gwb_amp is not None else models,
+        rng=rng)
 
 
 def serve_smoke_fleet(base_rows=(160, 200, 240), n_append_rows: int = 8,

@@ -246,6 +246,12 @@ def _pta_pass(args):
     pta.loglike(pta.x0)
     pta.loglike_many([pta.x0])
     pta.grad(pta.x0)
+    # the detection pipeline: the fused detection-statistic program and
+    # the CURN alternative — the latter is an ORF operand swap through
+    # the already-warm joint program, so the verify pass proves the
+    # model comparison adds ZERO traces on a warm process
+    pta.detection_statistic(pta.x0)
+    pta.loglike_curn(pta.x0)
     pta.sample(n_chains=2, nsteps=8, warmup=4, seed=0)
     return models[0], toas_list[0], res, state_path(ftr0)
 

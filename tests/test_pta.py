@@ -32,7 +32,7 @@ from pint_tpu.fitting.woodbury import basis_dense
 from pint_tpu.io.par import parse_parfile
 from pint_tpu.models.builder import build_model
 from pint_tpu.models.noise import hd_orf, orf_matrix, pulsar_position
-from pint_tpu.profiles import PTA_SKY
+from pint_tpu.profiles import PTA_SKY, pta_sky
 from pint_tpu.simulation import (add_gwb_to_arrays, add_noise_from_model,
                                  make_fake_toas_fromMJDs)
 
@@ -73,14 +73,16 @@ def _array(n_psr: int, n_epochs: int = 8, seed: int = 5,
     """(members-ready toas, models): N-pulsar array with the full noise
     stack and one HD-correlated GWB realization injected."""
     rng = np.random.default_rng(seed)
+    sky = pta_sky(n_psr)
     models, toas_list = [], []
     for k in range(n_psr):
-        name, raj, decj = PTA_SKY[k]
+        name, raj, decj = sky[k]
         parx = par.format(name=name, raj=raj, decj=decj,
                           f0=346.531996493 + 0.37 * k)
         model = build_model(parse_parfile(parx, from_text=True))
         mjds = np.repeat(np.linspace(56600.0, 57400.0,
-                                     n_epochs + (k if ragged else 0)), 2)
+                                     n_epochs + (k % 5 if ragged else 0)),
+                         2)
         mjds[1::2] += 0.5 / 86400.0
         freqs = np.where(np.arange(len(mjds)) % 2 == 0, 1400.0, 800.0)
         flags = [{"f": "Rcvr1_2_GUPPI"} for _ in mjds]
@@ -346,6 +348,64 @@ class TestSharded:
             == ptas._plain_data["slot"].shape
         assert pta1._aot_base() == ptas._aot_base()
 
+    def test_array_scale_sharded_parity_n64(self):
+        """The array-scale operand plan (ISSUE-17 tentpole): a
+        64-pulsar RAGGED array on the forced 8-device `pta_mesh` must
+        match the single-device build — joint value, joint gradient,
+        and one joint HMC chain step — and the donated incremental
+        restack must show NO doubled peak buffer in the cost ledger
+        (the old stack's buffers are credited as reused in place)."""
+        import pint_tpu.distributed as dist
+        from pint_tpu.analysis import costmodel
+
+        if len(jax.devices()) < 8:
+            pytest.skip("needs the 8-device virtual mesh")
+        toas_list, models = _array(64, n_epochs=5, seed=23,
+                                   par=PTA_RAGGED_PAR, ragged=True)
+        assert len({len(t) for t in toas_list}) == 5  # genuinely ragged
+        members = [NoiseLikelihood(t, copy.deepcopy(m))
+                   for t, m in zip(toas_list, models)]
+        pta1 = PTALikelihood(members)
+        mesh = dist.pta_mesh(64)
+        assert mesh is not None and dict(mesh.shape)["batch"] == 8
+        ptas = PTALikelihood(members, mesh=mesh)
+        eta = pta1.x0 * (1.0 + 0.002 * np.arange(pta1.nparams) / 194.0)
+        a, b = pta1.loglike(eta), ptas.loglike(eta)
+        assert abs(a - b) <= 1e-10 * abs(a)
+        ga, gb = pta1.grad(eta), ptas.grad(eta)
+        assert np.max(np.abs(ga - gb)) \
+            <= 1e-10 * max(1.0, np.max(np.abs(ga)))
+        # one joint HMC chain step, both builds: the chains consume the
+        # replicated composition on identical stacked operands, so the
+        # mesh must not move a draw beyond roundoff. Identical injected
+        # step scales on both sides (Laplace estimation is covered
+        # elsewhere) keep any difference purely mesh-induced — and keep
+        # 64 per-member Laplace builds out of the tier-1 budget.
+        pta1._laplace_scales = ptas._laplace_scales = \
+            np.asarray(pta1.scales)
+        c1 = pta1.sample(n_chains=2, nsteps=1, warmup=0, kernel="hmc",
+                         seed=11)
+        cs = ptas.sample(n_chains=2, nsteps=1, warmup=0, kernel="hmc",
+                         seed=11)
+        assert np.max(np.abs(c1.samples - cs.samples)) \
+            <= 1e-10 * max(1.0, np.max(np.abs(c1.samples)))
+        # donation leg: rebuild the single-device stack after one
+        # member changed — the fleet_restack ledger record must carry
+        # the donated-buffer credit (no in+out double-residency)
+        t_new, m_new = _array(1, n_epochs=5, seed=77,
+                              par=PTA_RAGGED_PAR)
+        members2 = [NoiseLikelihood(t_new[0], copy.deepcopy(m_new[0]))
+                    ] + members[1:]
+        del pta1  # donation contract: drop the old stack's owner first
+        PTALikelihood(members2)
+        rec = costmodel.cost_block().get("fleet_restack")
+        assert rec is not None
+        assert rec["donated_bytes"] > 0
+        # without donation the update would hold stack-in AND stack-out
+        # live at once (>= 2x the donated bytes); with it the peak is
+        # the donated stack plus one row's worth of operands
+        assert rec["peak_bytes"] < 2 * rec["donated_bytes"]
+
     def test_mesh_divisibility_guard(self, members2):
         import pint_tpu.distributed as dist
 
@@ -438,6 +498,47 @@ class TestFleetStackMemo:
         assert perf.pta_breakdown(rep2)["fleet_stack_reuse"] \
             == len(members)
 
+    def test_single_member_update_invalidates_one_slot(self):
+        """The slot-invalidation contract (fitting/batch.py
+        placed_stack): rebuilding a fleet after ONE member changed must
+        re-pad and re-stack exactly that member's slot — the other B-1
+        slots ride the per-member layout memo (`fleet_stack_reuse`) and
+        the incremental device restack (`stack_slot_reuse`) — and the
+        rebuilt stack must carry the NEW member's rows, not a stale
+        slot."""
+        from pint_tpu.fitting.noise_like import NoiseFleet
+        from pint_tpu.ops import perf
+
+        toas_list, models = _array(4, n_epochs=6, seed=52)
+        members = [NoiseLikelihood(t, copy.deepcopy(m))
+                   for t, m in zip(toas_list, models)]
+        B = len(members)
+        f1 = NoiseFleet(members)
+        rows = f1.rows
+        # single-member update: a NEW likelihood for pulsar 0 with the
+        # same operand signature but different data values
+        t_new, m_new = _array(1, n_epochs=6, seed=99)
+        members2 = [NoiseLikelihood(t_new[0], copy.deepcopy(m_new[0]))
+                    ] + members[1:]
+        # donation contract: the incremental rebuild donates the
+        # previous stack's device buffers in place — the older fleet
+        # over the same member set must be dropped first
+        del f1
+        with perf.collect() as rep:
+            f2 = NoiseFleet(members2)
+        bd = perf.noise_breakdown(rep)
+        assert bd["fleet_stack_reuse"] >= B - 1
+        assert bd["stack_slot_reuse"] >= B - 1
+        # the rebuilt stack is CORRECT, not merely cheap: every slot
+        # equals its member's own padded layout, changed slot included
+        for a, nl in enumerate(members2):
+            np.testing.assert_array_equal(
+                np.asarray(f2.data["r0"][a]),
+                np.asarray(nl._layout_padded(rows)["r0"]))
+        assert not np.array_equal(np.asarray(f2.data["r0"][0]),
+                                  np.asarray(members[0]
+                                             ._layout_padded(rows)["r0"]))
+
 
 TIME_GBT = """# time_gbt.dat
  40000.00    2.000
@@ -479,8 +580,10 @@ class TestPtaBenchContract:
         # on the multi-device tier-1 mesh the pulsars really sharded
         if rec["n_devices"] >= 4:
             assert rec["pta_batch_shards"] == 4
-        # >= 90% stage attribution of the pta wall
-        named = (rec["pta_build_s"] + rec["pta_eval_s"]
+        # >= 90% stage attribution of the pta wall, the amortized
+        # stacking stages (stack/place) included
+        named = (rec["pta_build_s"] + rec["pta_stack_s"]
+                 + rec["pta_place_s"] + rec["pta_eval_s"]
                  + rec["pta_chain_s"] + rec["pta_optimize_s"]
                  + rec["pta_compile_s"] + rec["pta_trace_s"])
         assert named >= 0.9 * rec["pta_wall_s"] - 0.01, rec
@@ -490,6 +593,18 @@ class TestPtaBenchContract:
         assert rec["pta_loglike_evals"] >= 1024
         # stretch kernel: walker-steps; at least chains x steps flowed
         assert rec["pta_chain_steps"] >= 2 * 25
+        # the static in-program shapes latched (psum payload when
+        # sharded, replicated solve dimension always)
+        assert rec["pta_solve_dim"] > 0
+        if rec["pta_batch_shards"] > 1:
+            assert rec["pta_psum_bytes_per_eval"] > 0
+        # the per-chip peak from the static cost model is priced and
+        # within the checked-in N=64 canonical budget (the array-scale
+        # budget bounds every smaller shape)
+        from pint_tpu.analysis.cost import load_budgets
+        budget = load_budgets()["programs"]["pta_loglike@n64"]
+        assert 0 < rec["pta_peak_bytes_per_chip"] \
+            <= budget["peak_bytes"] * 1.15
         # strict audit ran clean over every pta program, including the
         # batch-axis collective placement when sharded
         assert rec["audit"]["mode"] == "strict"
@@ -497,6 +612,61 @@ class TestPtaBenchContract:
         assert any(lbl.startswith("pta_")
                    for lbl in rec["audit"]["signatures"])
         # no corners cut: the ledger stayed empty with writes escalated
+        assert rec["degradation_count"] == 0
+        assert rec["degradation_kinds"] == []
+
+    def test_smoke_pta_bench_contract_n64(self, tmp_path, monkeypatch):
+        """The SAME telemetry contract at the ISSUE-17 array-scale
+        smoke shape: N=64 pulsars sharded 8 ways on the tier-1 virtual
+        mesh — strict-clean audit over the sharded programs, empty
+        degradation ledger under PINT_TPU_DEGRADED=error, >= 90% stage
+        attribution with the stack/place stages carrying the operand
+        plan, and flat pulsars-per-chip. (The >= 5x dense bar lives on
+        the default smoke shape and the bench's N-scaling leg: at the
+        deliberately tiny per-pulsar TOA count used here the dense
+        baseline does not pay its O((N T)^3) cost.) Member-level
+        Laplace preconditioning is pinned to prior scales — 64 per-
+        member Hessian builds are chain-quality tuning, not part of the
+        telemetry contract, and would dominate the tier-1 wall."""
+        import bench
+        from pint_tpu.fitting.noise_like import NoiseLikelihood
+        from pint_tpu.ops import degrade
+
+        clk = tmp_path / "clk"
+        clk.mkdir()
+        (clk / "time_gbt.dat").write_text(TIME_GBT)
+        (clk / "gps2utc.clk").write_text(GPS2UTC)
+        monkeypatch.setenv("PINT_CLOCK_OVERRIDE", str(clk))
+        monkeypatch.setenv("PINT_TPU_DEGRADED", "error")
+        monkeypatch.setenv("PINT_TPU_AUDIT", "strict")
+        monkeypatch.setattr(NoiseLikelihood, "laplace_scales",
+                            lambda self: np.asarray(self.scales))
+        degrade.reset_ledger()
+        # nwalkers=8: the stretch default (2 nd + 2 = 262 walkers at
+        # N=64) prices chain QUALITY, not the telemetry contract —
+        # thousands of joint evals that tier-1 cannot afford
+        rec = bench.smoke_pta_bench(n_pulsars=64, ntoas=24, n_evals=32,
+                                    n_chains=2, nsteps=8, warmup=0,
+                                    baseline_evals=1, kernel="stretch",
+                                    nwalkers=8)
+        if rec["n_devices"] >= 8:
+            assert rec["pta_batch_shards"] == 8
+            assert rec["pta_pulsars_per_chip"] == 8.0
+        assert rec["gwb_loglike_evals_per_sec_per_chip"] > 0
+        assert rec["pta_loglike_evals"] >= 32
+        named = (rec["pta_build_s"] + rec["pta_stack_s"]
+                 + rec["pta_place_s"] + rec["pta_eval_s"]
+                 + rec["pta_chain_s"] + rec["pta_optimize_s"]
+                 + rec["pta_compile_s"] + rec["pta_trace_s"])
+        assert named >= 0.9 * rec["pta_wall_s"] - 0.01, rec
+        # the sharded psum payload and solve dimension latched at the
+        # array shape: N * (m + p) rows in the replicated solve
+        assert rec["pta_solve_dim"] >= 64
+        if rec["pta_batch_shards"] > 1:
+            assert rec["pta_psum_bytes_per_eval"] > 0
+        assert rec["pta_peak_bytes_per_chip"] > 0
+        assert rec["audit"]["mode"] == "strict"
+        assert rec["audit"]["n_violations"] == 0
         assert rec["degradation_count"] == 0
         assert rec["degradation_kinds"] == []
 
@@ -534,6 +704,38 @@ def test_recovery_harness_tier1():
     assert full["verdict"]["hd_correlations_detected"], full["verdict"]
 
 
+def test_detection_harness_tier1():
+    """The ISSUE-17 detection harness at tier-1 scale: one null (no
+    GWB) and one loudly-injected realization through the fused
+    detection-statistic program — the HD-vs-CURN margin must separate
+    the two, and the checked-in full-campaign summary's detection-
+    probability verdicts hold."""
+    import json
+    import sys
+    from pathlib import Path
+
+    root = Path(__file__).resolve().parent.parent
+    sys.path.insert(0, str(root))
+    from validation import gwb_detection as gd
+
+    s = gd.run(n_arrays=1, n_pulsars=4, ntoas=40, n_chains=4,
+               nsteps=1500, amps=(-20.0, -12.8))
+    sweep = {row["log10_A_gw"]: row for row in s["detection_sweep"]}
+    assert sweep[-20.0]["null"] and not sweep[-12.8]["null"]
+    # the loud injection's margin must beat the null's (the reduced-K
+    # CALIBRATION check: one paired realization, same noise draws)
+    assert sweep[-12.8]["dll_mean"] > sweep[-20.0]["dll_mean"], s
+    assert np.isfinite(sweep[-12.8]["os_mean"])
+    # the checked-in full-campaign verdicts hold (regenerate with
+    # `python validation/gwb_detection.py` after harness changes)
+    full = json.loads(
+        (root / "validation" / "gwb_detection_summary.json").read_text())
+    assert full["verdict"]["null_false_alarm_ok"], full["verdict"]
+    assert full["verdict"]["detected_at_loudest"], full["verdict"]
+    assert full["verdict"]["margin_grows_with_amplitude"], full["verdict"]
+    assert full["verdict"]["rhat_converged"], full["verdict"]
+
+
 class TestAotRoundTrip:
     # the `pint_tpu warmup --profile pta` verify pass proves the same
     # contract end-to-end; the in-suite round-trip rides the slow tier
@@ -559,6 +761,11 @@ class TestAotRoundTrip:
                 pta = PTALikelihood(members)
                 pta.loglike(pta.x0)
                 pta.grad(pta.x0)
+                # the detection pipeline rides the same warm set: the
+                # statistic is its own program, the CURN alternative is
+                # an ORF operand swap (zero additional programs)
+                pta.detection_statistic(pta.x0)
+                pta.loglike_curn(pta.x0)
 
             one_pass()
             before = compile_count()
@@ -566,7 +773,8 @@ class TestAotRoundTrip:
             assert compile_count() == before, \
                 "pta rebuild traced — AOT coverage gap"
             blk = pcompile.aot_block()
-            for lbl in ("pta_loglike", "pta_loglike_grad"):
+            for lbl in ("pta_loglike", "pta_loglike_grad",
+                        "pta_detection_stat"):
                 assert blk["labels"][lbl]["hits"] >= 1, blk["labels"]
         finally:
             monkeypatch.undo()
