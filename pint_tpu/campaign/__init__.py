@@ -1,0 +1,16 @@
+"""Preemption-safe campaigns: durable, resumable long-running device work.
+
+See campaign/runner.py for the durability + bitwise-resume contract and
+campaign/sampling.py for the built-in unit kinds.
+"""
+
+from pint_tpu.campaign.runner import (CampaignRunner, WorkUnit,
+                                      campaign_status, content_key,
+                                      register_kind, resolve_kind,
+                                      work_unit)
+from pint_tpu.campaign.sampling import (chain_units, grid_units,
+                                        result_digest)
+
+__all__ = ["CampaignRunner", "WorkUnit", "campaign_status", "chain_units",
+           "content_key", "grid_units", "register_kind", "resolve_kind",
+           "result_digest", "work_unit"]

@@ -1,0 +1,80 @@
+"""Campaign CLI: run a self-contained demo campaign, or probe progress.
+
+``python -m pint_tpu.campaign --dir D --demo-chains 4 --steps 60 --seed 7``
+runs (or RESUMES — the same command line is both) a demo stretch-move
+campaign in ``D``, printing machine-parseable progress:
+
+- ``UNIT::<uid>`` after each unit's result is durable — the tier-1
+  kill drill SIGKILLs the process on the first of these, exactly
+  between checkpoints;
+- ``RESULT::{json}`` at exit: status, done/total, the bitwise digest
+  over every assembled result array (resume parity locks on it), the
+  campaign perf breakdown (attribution >= 90% named), degradation
+  kinds and ledger ops.
+
+``--status`` prints the read-only :func:`campaign_status` probe
+instead (what ``pint_tpu status --campaign`` wraps).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(prog="pint_tpu.campaign")
+    ap.add_argument("--dir", required=True)
+    ap.add_argument("--status", action="store_true",
+                    help="print the read-only progress probe and exit")
+    ap.add_argument("--demo-chains", type=int, default=4)
+    ap.add_argument("--steps", type=int, default=60)
+    ap.add_argument("--walkers", type=int, default=8)
+    ap.add_argument("--ndim", type=int, default=3)
+    ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--checkpoint-every", type=int, default=None)
+    ap.add_argument("--unit-sleep", type=float, default=0.0,
+                    help="stall this many seconds after each durable "
+                         "unit (the SIGKILL drill kills into the stall "
+                         "so the kill provably lands BETWEEN checkpoints)")
+    args = ap.parse_args(argv)
+
+    from pint_tpu.campaign import (CampaignRunner, campaign_status,
+                                   chain_units, result_digest)
+
+    if args.status:
+        print(json.dumps(campaign_status(args.dir), indent=1))
+        return 0
+
+    from pint_tpu.ops import degrade, perf
+
+    units = chain_units(args.demo_chains, args.seed, nsteps=args.steps,
+                        walkers=args.walkers, ndim=args.ndim)
+    runner = CampaignRunner(args.dir, units, name="demo",
+                            checkpoint_every=args.checkpoint_every)
+
+    def _progress(u, result):
+        print(f"UNIT::{u.uid}", flush=True)
+        if args.unit_sleep > 0:
+            import time
+
+            time.sleep(args.unit_sleep)
+
+    with perf.collect() as rep:
+        report = runner.run(progress=_progress)
+    out = dict(report)
+    out["breakdown"] = perf.campaign_breakdown(rep)
+    out["degradations"] = sorted({e.kind for e in degrade.events()})
+    if report["status"] == "complete":
+        out["digest"] = result_digest(runner.results())
+    status = campaign_status(args.dir)
+    out["ledger_events"] = status["ledger_events"]
+    out["resumes"] = status["resumes"]
+    print("RESULT::" + json.dumps(out, default=float), flush=True)
+    return 0 if report["status"] in ("complete", "preempted",
+                                     "paused") else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

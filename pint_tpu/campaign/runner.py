@@ -1,0 +1,535 @@
+"""Durable, resumable campaigns: preemption-safe long-running device work.
+
+A *campaign* is hours of device work decomposed into **content-keyed
+work units** — one unit per chain / grid point / injection realization —
+executed by a :class:`CampaignRunner` that makes every completed unit
+durable the moment it finishes. The failure mode this kills is the
+canonical one on shared TPU fleets: a preempted process losing a whole
+B×C sampling run because nothing between "started" and "finished" ever
+reached disk.
+
+The durability discipline is the serving stack's (serve/journal.py,
+serve/recover.py), reused verbatim:
+
+- **Unit results** — ``<dir>/results/<uid>.ckpt`` — crc-framed pickles
+  written via the shared atomic writer (``_write_checkpoint``: tmp +
+  fsync + rename; a kill mid-write leaves a torn ``.tmp`` and an intact
+  previous generation). A result that fails its crc on resume is
+  quarantined beside the store with ``campaign.checkpoint_corrupt`` on
+  the degradation ledger and the unit re-runs — garbage is never
+  restored.
+- **Progress snapshots** — ``<dir>/snapshots/snapshot-NNNNNN.ckpt`` —
+  generational (``PINT_TPU_CAMPAIGN_KEEP`` kept, >= 2) campaign state
+  written every ``PINT_TPU_CAMPAIGN_CHECKPOINT_EVERY`` completed units:
+  done/total, cumulative wall, status. ``pint_tpu status --campaign``
+  and the metrics gauges read these.
+- **The campaign ledger** — ``<dir>/ledger/`` — a
+  :class:`~pint_tpu.serve.journal.RequestJournal` of marker records
+  (``resumed``, ``unit_done``, ``snapshot``, ``campaign_status``), so
+  "what happened to this campaign" is answerable from disk with the
+  same framing + quarantine discipline as the serving WAL.
+
+**Bitwise resume.** Work units are WHOLE deterministic computations:
+chain c's entire trajectory depends only on ``fold_in(seed, chain_id)``
+(fitting/noise_like.py locks fleet ≡ solo per chain id), a grid point
+only on its coordinates. Resume therefore skips completed units and
+re-runs incomplete ones from their seeds — the assembled result is
+**bitwise-equal** to an uninterrupted run, proven by the
+kill-mid-campaign drill (tests/test_campaign.py): SIGKILL between
+checkpoints, resume in a fresh process, sha256 over the raw result
+bytes identical to the never-killed twin's.
+
+**Graceful drain.** SIGTERM/SIGINT (the preemption notice) set a drain
+flag: the runner finishes the unit in flight, snapshots, writes the
+ledger marker and returns status ``preempted`` — the next process
+resumes. A SIGKILL (no notice) loses only the unit in flight.
+
+Every resume is ledger-visible (``campaign.resumed``, refusable under
+``PINT_TPU_DEGRADED=error``), on the flight recorder, and counted in
+the metrics registry; live gauges export units done/total, checkpoint
+age and ETA so ``pint_tpu status --campaign <dir>`` answers "how far
+along and when did it last checkpoint". Wall attribution lands in
+:func:`pint_tpu.ops.perf.campaign_breakdown` (>= 90% named).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import importlib
+import json
+import os
+import signal
+import threading
+import time
+from dataclasses import dataclass, field
+from pathlib import Path
+
+from pint_tpu.obs import flight, metrics as obs_metrics
+from pint_tpu.ops import degrade, perf
+from pint_tpu.serve.journal import (JournalError, RequestJournal,
+                                    replay_records)
+from pint_tpu.serve.recover import _read_checkpoint, _write_checkpoint
+from pint_tpu.testing import faults
+from pint_tpu.utils import knobs
+from pint_tpu.utils.logging import get_logger
+
+log = get_logger("pint_tpu.campaign")
+
+__all__ = ["CampaignRunner", "WorkUnit", "campaign_status",
+           "content_key", "register_kind", "resolve_kind", "work_unit"]
+
+
+def content_key(kind: str, payload: dict) -> str:
+    """The unit's identity: sha256 over the canonical JSON of (kind,
+    payload). Two units with the same key compute the same thing — the
+    resume scan keys durable results on it, so a manifest edit that
+    changes a unit's inputs changes its key and forces a re-run."""
+    blob = json.dumps({"kind": kind, "payload": payload},
+                      sort_keys=True, separators=(",", ":"))
+    return hashlib.sha256(blob.encode()).hexdigest()[:16]
+
+
+@dataclass(frozen=True)
+class WorkUnit:
+    """One content-keyed unit of campaign work: ``kind`` names a
+    registered executor (or a ``module:function`` entry point — the
+    manifest must be resolvable in a FRESH process), ``payload`` is its
+    JSON-able argument dict, ``uid`` the content key."""
+
+    kind: str
+    payload: dict = field(default_factory=dict)
+    uid: str = ""
+
+
+def work_unit(kind: str, **payload) -> WorkUnit:
+    """Build a :class:`WorkUnit` with its content key computed."""
+    return WorkUnit(kind, payload, content_key(kind, payload))
+
+
+# -- the unit-kind registry ---------------------------------------------------------
+
+_KINDS: dict = {}
+
+
+def register_kind(name: str):
+    """Decorator registering a unit executor under ``name``. Executors
+    take the payload dict and return a picklable result; they must be
+    DETERMINISTIC in the payload (seeds ride the payload) — that is
+    what makes resume bitwise-equal to an uninterrupted run."""
+    def deco(fn):
+        _KINDS[name] = fn
+        return fn
+    return deco
+
+
+def resolve_kind(kind: str):
+    """The executor for ``kind``: a registered name (the built-ins in
+    campaign/sampling.py) or an importable ``module:function`` entry
+    point — the form a manifest written by one process and resumed by
+    another relies on."""
+    from pint_tpu.campaign import sampling  # noqa: F401 — registers built-ins
+
+    fn = _KINDS.get(kind)
+    if fn is None and ":" in kind:
+        mod, _, attr = kind.partition(":")
+        fn = getattr(importlib.import_module(mod), attr, None)
+    if fn is None:
+        raise KeyError(
+            f"unknown campaign unit kind {kind!r}; register it with "
+            "pint_tpu.campaign.register_kind or name an importable "
+            "module:function entry point")
+    return fn
+
+
+# -- helpers ------------------------------------------------------------------------
+
+def _atomic_write_text(path: Path, text: str) -> None:
+    tmp = path.with_suffix(".tmp")
+    tmp.write_text(text)
+    os.replace(tmp, path)
+
+
+def _snap_index(path: Path) -> int:
+    return int(path.stem.split("-")[-1])
+
+
+class CampaignRunner:
+    """Execute a campaign's work units with durable, resumable progress
+    (see module docstring).
+
+    First construction against a directory writes the manifest (the
+    unit list with content keys, atomically); a later construction
+    against the same directory — with or without ``units`` — loads it
+    and becomes a RESUME: completed units are skipped after their
+    durable results validate. Passing ``units`` whose content keys
+    differ from the manifest's refuses loudly: a campaign directory
+    holds exactly one campaign.
+    """
+
+    def __init__(self, dirpath: str | Path, units=None, *,
+                 name: str = "campaign", checkpoint_every: int | None = None,
+                 keep: int | None = None):
+        self.dir = Path(dirpath)
+        self.dir.mkdir(parents=True, exist_ok=True)
+        self.checkpoint_every = max(
+            int(knobs.get("PINT_TPU_CAMPAIGN_CHECKPOINT_EVERY"))
+            if checkpoint_every is None else int(checkpoint_every), 1)
+        # keep >= 2: a kill mid-snapshot-write must always leave an
+        # intact previous generation behind the atomic rename
+        self.keep = max(int(knobs.get("PINT_TPU_CAMPAIGN_KEEP"))
+                        if keep is None else int(keep), 2)
+        manifest = self.dir / "manifest.json"
+        if manifest.exists():
+            man = json.loads(manifest.read_text())
+            if units is not None:
+                mine = [{"uid": u.uid, "kind": u.kind,
+                         "payload": u.payload} for u in units]
+                if mine != man["units"]:
+                    raise ValueError(
+                        f"campaign dir {self.dir} holds a DIFFERENT "
+                        "campaign (content keys differ); use a fresh "
+                        "directory per campaign")
+            self.name = man["name"]
+            self.units = [WorkUnit(d["kind"], d["payload"], d["uid"])
+                          for d in man["units"]]
+            self._fresh = False
+        else:
+            if units is None:
+                raise ValueError(
+                    f"{self.dir} has no campaign manifest and no units "
+                    "were given")
+            self.name = name
+            self.units = list(units)
+            _atomic_write_text(manifest, json.dumps({
+                "name": name,
+                "units": [{"uid": u.uid, "kind": u.kind,
+                           "payload": u.payload} for u in self.units],
+            }, indent=1))
+            self._fresh = True
+        self._done: set[str] = set()
+        self._drain = False
+        self._old_handlers: dict = {}
+        self._gen = max((_snap_index(p) for p in
+                         self._snap_dir.glob("snapshot-*.ckpt")),
+                        default=0)
+        self._last_snapshot_mono: float | None = None
+        self._prior_wall_s = 0.0
+        self._unit_s: list[float] = []
+        self.ledger: RequestJournal | None = None
+        self._register_gauges()
+
+    # -- layout ------------------------------------------------------------------
+
+    @property
+    def _results_dir(self) -> Path:
+        return self.dir / "results"
+
+    @property
+    def _snap_dir(self) -> Path:
+        return self.dir / "snapshots"
+
+    # -- durable state -----------------------------------------------------------
+
+    def _scan_results(self) -> set[str]:
+        """Validate every durable unit result: crc-clean ones are DONE;
+        a corrupt one is quarantined beside the store
+        (``campaign.checkpoint_corrupt``) and its unit re-runs. Torn
+        ``.tmp`` files (kill-mid-write debris) are dropped — the rename
+        never happened, the unit was never done."""
+        rdir = self._results_dir
+        rdir.mkdir(parents=True, exist_ok=True)
+        known = {u.uid for u in self.units}
+        done: set[str] = set()
+        for p in sorted(rdir.glob("*.ckpt")):
+            if p.stem not in known:
+                continue               # a stray file is not campaign work
+            try:
+                _read_checkpoint(p)
+            except Exception as e:  # noqa: BLE001 — quarantined + ledgered below, never silent  # jaxlint: disable=silent-except
+                qdir = rdir / "quarantine"
+                qdir.mkdir(parents=True, exist_ok=True)
+                os.replace(p, qdir / p.name)
+                degrade.record(
+                    "campaign.checkpoint_corrupt", p.name,
+                    f"unit result failed validation ({e}); preserved at "
+                    f"{qdir / p.name}, the unit re-runs from its seed",
+                    fix="none needed — the re-run rebuilds the exact "
+                        "result from the unit's content-keyed payload")
+                continue
+            done.add(p.stem)
+        for t in rdir.glob("*.tmp"):
+            t.unlink(missing_ok=True)  # kill-mid-write debris
+        return done
+
+    def _latest_snapshot(self):
+        """(snapshot dict, path) from the newest generation that loads
+        clean; corrupt generations are quarantined
+        (``campaign.checkpoint_corrupt``) and the previous one serves —
+        the generational discipline the kill/corrupt drills prove."""
+        sdir = self._snap_dir
+        for p in sorted(sdir.glob("snapshot-*.ckpt"),
+                        key=_snap_index, reverse=True):
+            try:
+                return _read_checkpoint(p), p
+            except Exception as e:  # noqa: BLE001 — quarantined + ledgered below, never silent  # jaxlint: disable=silent-except
+                qdir = sdir / "quarantine"
+                qdir.mkdir(parents=True, exist_ok=True)
+                os.replace(p, qdir / p.name)
+                degrade.record(
+                    "campaign.checkpoint_corrupt", p.name,
+                    f"campaign snapshot failed validation ({e}); "
+                    f"preserved at {qdir / p.name}, the previous "
+                    "generation serves",
+                    fix="none needed — snapshots are progress metadata; "
+                        "unit results are the durable work product")
+        return None, None
+
+    def _snapshot(self, status: str = "running") -> Path:
+        self._gen += 1
+        self._snap_dir.mkdir(parents=True, exist_ok=True)
+        path = self._snap_dir / f"snapshot-{self._gen:06d}.ckpt"
+        wall = self._prior_wall_s + sum(self._unit_s)
+        _write_checkpoint(path, {
+            "name": self.name,
+            "status": status,
+            "done": sorted(self._done),
+            "total": len(self.units),
+            "wall_s": round(wall, 4),
+            "t_unix": time.time(),
+        })
+        perf.add("campaign_checkpoints")
+        self._last_snapshot_mono = time.monotonic()
+        # prune to the newest `keep` generations — never fewer than 2,
+        # so the latest write always has an intact predecessor
+        snaps = sorted(self._snap_dir.glob("snapshot-*.ckpt"),
+                       key=_snap_index)
+        for p in snaps[:-self.keep]:
+            p.unlink(missing_ok=True)
+        return path
+
+    # -- observability -----------------------------------------------------------
+
+    def _register_gauges(self) -> None:
+        reg = obs_metrics.registry()
+        reg.gauge("campaign_units_total",
+                  "work units in the campaign manifest",
+                  fn=lambda: float(len(self.units)))
+        reg.gauge("campaign_units_done",
+                  "campaign units with a validated durable result",
+                  fn=lambda: float(len(self._done)))
+        reg.gauge("campaign_checkpoint_age_s",
+                  "seconds since the last campaign progress snapshot "
+                  "(-1 before the first)",
+                  fn=self._checkpoint_age_s)
+        reg.gauge("campaign_eta_s",
+                  "estimated seconds to campaign completion at the "
+                  "observed unit rate (-1 before the first unit)",
+                  fn=self._eta_s)
+
+    def _checkpoint_age_s(self) -> float:
+        if self._last_snapshot_mono is None:
+            return -1.0
+        return round(time.monotonic() - self._last_snapshot_mono, 3)
+
+    def _eta_s(self) -> float:
+        if not self._unit_s:
+            return -1.0
+        per = sum(self._unit_s) / len(self._unit_s)
+        return round(per * (len(self.units) - len(self._done)), 3)
+
+    # -- preemption notice -------------------------------------------------------
+
+    def _install_signals(self) -> None:
+        """SIGTERM/SIGINT = the preemption notice: finish the unit in
+        flight, snapshot, exit ``preempted``. Installed only on the
+        main thread (signal.signal raises elsewhere); a SIGKILL drill
+        simply never reaches this path."""
+        if threading.current_thread() is not threading.main_thread():
+            return
+
+        def _drain_handler(signum, frame):
+            self._drain = True
+            flight.note("campaign.drain", name=self.name, signal=signum)
+            log.warning(f"campaign {self.name!r}: drain requested "
+                        f"(signal {signum}); finishing the unit in "
+                        "flight, then snapshotting")
+
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            self._old_handlers[sig] = signal.signal(sig, _drain_handler)
+
+    def _restore_signals(self) -> None:
+        for sig, old in self._old_handlers.items():
+            signal.signal(sig, old)
+        self._old_handlers.clear()
+
+    # -- the run loop ------------------------------------------------------------
+
+    def _mark(self, op: str, **fields) -> None:
+        """A ledger write that never kills the campaign: the ledger is
+        the EXPLANATION, the unit results are the work product. A shed
+        write (journal disk full — serve.journal_full is already on the
+        degradation ledger by the time JournalError surfaces) drops the
+        marker and the campaign keeps computing."""
+        try:
+            with perf.stage("ledger"):
+                self.ledger.mark(op, **fields)
+        except JournalError:
+            log.warning(f"campaign {self.name!r}: ledger marker {op!r} "
+                        "shed (journal full); campaign continues")
+
+    def run(self, max_units: int | None = None,
+            progress=None) -> dict:
+        """Execute every pending unit to a durable result; returns the
+        campaign report (status ``complete`` / ``preempted`` /
+        ``paused``). Safe to call again after ANY interruption — a
+        completed campaign returns immediately with everything skipped.
+        ``progress(unit, result)`` fires after each unit's result is
+        durable (the kill drills key their timing on it)."""
+        self._install_signals()
+        t0 = time.monotonic()
+        status = "complete"
+        ran = skipped = 0
+        try:
+            with perf.stage("campaign"):
+                with perf.stage("resume"):
+                    self._done = self._scan_results()
+                    snap, _ = self._latest_snapshot()
+                    if self.ledger is None:
+                        self.ledger = RequestJournal(self.dir / "ledger",
+                                                     fsync_every=1)
+                    resumed = (not self._fresh) and (
+                        bool(self._done) or snap is not None)
+                    if snap is not None:
+                        self._prior_wall_s = float(snap.get("wall_s", 0.0))
+                    if resumed:
+                        perf.add("campaign_resumes")
+                        self._mark("resumed", done=len(self._done),
+                                   total=len(self.units))
+                        flight.note("campaign.resume", name=self.name,
+                                    done=len(self._done),
+                                    total=len(self.units))
+                        degrade.record(
+                            "campaign.resumed", self.name,
+                            f"campaign resumed with {len(self._done)}/"
+                            f"{len(self.units)} units already durable; "
+                            "completed units skipped, the remainder "
+                            "re-runs — assembly is bitwise-identical to "
+                            "an uninterrupted run",
+                            fix="none needed — resume IS the designed "
+                                "recovery path")
+                self._fresh = False
+                skipped = len(self._done)
+                pending = [u for u in self.units if u.uid not in self._done]
+                for u in pending:
+                    if self._drain:
+                        status = "preempted"
+                        break
+                    if max_units is not None and ran >= max_units:
+                        status = "paused"
+                        break
+                    fn = resolve_kind(u.kind)
+                    tu = time.monotonic()
+                    with perf.stage("unit"):
+                        result = fn(dict(u.payload))
+                    with perf.stage("checkpoint"):
+                        _write_checkpoint(
+                            self._results_dir / f"{u.uid}.ckpt", result)
+                    self._unit_s.append(time.monotonic() - tu)
+                    self._done.add(u.uid)
+                    ran += 1
+                    perf.add("campaign_units_run")
+                    self._mark("unit_done", uid=u.uid, kind=u.kind)
+                    if progress is not None:
+                        progress(u, result)
+                    if ran % self.checkpoint_every == 0:
+                        with perf.stage("checkpoint"):
+                            self._snapshot()
+                        self._mark("snapshot", gen=self._gen,
+                                   done=len(self._done))
+                    # the preemption drill: os._exit(70) AFTER this
+                    # unit's result is durable — exactly what a SIGKILL
+                    # between checkpoints looks like to the store
+                    if faults.trip("campaign.run",
+                                   f"unit:{u.uid}") == "kill":
+                        os._exit(70)
+                with perf.stage("checkpoint"):
+                    self._snapshot(status=status)
+                self._mark("campaign_status", status=status,
+                           done=len(self._done), total=len(self.units))
+        finally:
+            self._restore_signals()
+        wall = time.monotonic() - t0
+        report = {
+            "name": self.name,
+            "status": status,
+            "units_total": len(self.units),
+            "units_done": len(self._done),
+            "units_run": ran,
+            "units_skipped": skipped,
+            "wall_s": round(wall, 4),
+            "snapshot_gen": self._gen,
+            "dir": str(self.dir),
+        }
+        flight.note("campaign.status", **{k: v for k, v in report.items()
+                                          if k != "dir"})
+        log.info(f"campaign {self.name!r} {status}: "
+                 f"{len(self._done)}/{len(self.units)} done "
+                 f"({ran} run, {skipped} skipped) in {wall:.2f}s")
+        return report
+
+    # -- results -----------------------------------------------------------------
+
+    def results(self) -> dict:
+        """uid -> validated durable result, manifest order. Raises
+        FileNotFoundError while units are still pending — assembly is
+        for finished campaigns (``status == "complete"``)."""
+        out = {}
+        for u in self.units:
+            out[u.uid] = _read_checkpoint(
+                self._results_dir / f"{u.uid}.ckpt")
+        return out
+
+
+def campaign_status(dirpath: str | Path) -> dict:
+    """Read-only progress probe for ``pint_tpu status --campaign``:
+    manifest + newest loadable snapshot + durable-result count, with
+    checkpoint age and ETA. Never mutates the store (a corrupt newest
+    snapshot is simply skipped here; the runner's resume path is what
+    quarantines)."""
+    d = Path(dirpath)
+    man = json.loads((d / "manifest.json").read_text())
+    total = len(man["units"])
+    done = len(list((d / "results").glob("*.ckpt"))) \
+        if (d / "results").is_dir() else 0
+    snap = age = eta = status = None
+    snaps = sorted((d / "snapshots").glob("snapshot-*.ckpt"),
+                   key=_snap_index, reverse=True) \
+        if (d / "snapshots").is_dir() else []
+    for p in snaps:
+        try:
+            snap = _read_checkpoint(p)
+        except Exception:  # noqa: BLE001 — read-only probe: skip to the previous generation  # jaxlint: disable=silent-except
+            continue
+        age = round(max(time.time() - snap.get("t_unix", 0.0), 0.0), 3)
+        status = snap.get("status")
+        wall = float(snap.get("wall_s", 0.0))
+        sdone = len(snap.get("done", ()))
+        if 0 < sdone < total and wall > 0:
+            eta = round(wall / sdone * (total - sdone), 3)
+        break
+    events = []
+    ledger = d / "ledger"
+    if ledger.is_dir():
+        records, _ = replay_records(ledger)
+        events = [r["op"] for r in records]
+    return {
+        "name": man["name"],
+        "dir": str(d),
+        "status": status or ("complete" if done >= total else "unknown"),
+        "units_done": done,
+        "units_total": total,
+        "checkpoint_age_s": age,
+        "eta_s": 0.0 if done >= total else eta,
+        "resumes": events.count("resumed"),
+        "ledger_events": len(events),
+    }

@@ -1,0 +1,163 @@
+"""Built-in campaign unit kinds: the chain/sweep surfaces as work units.
+
+Each kind is one deterministic computation keyed ENTIRELY by its
+payload — that is the contract that makes campaign resume bitwise-exact
+(campaign/runner.py): re-running a lost unit from its payload rebuilds
+the identical result, so assembled output never depends on where a
+preemption landed.
+
+- ``demo.stretch_chain`` — one affine-invariant stretch-move chain over
+  a small correlated Gaussian posterior. Self-contained (no reference
+  data, no network), per-chain keys via ``fold_in(seed, chain_id)`` —
+  the tier-1 kill drill and the docs walkthrough run campaigns of
+  these.
+- ``noise.sample_chain`` — one chain of a real
+  :class:`~pint_tpu.fitting.noise_like.MarginalizedPosterior` (or any
+  factory returning an object with ``.sample``), via
+  ``post.sample(chain_ids=[c])`` — the per-chain determinism that API
+  already locks (fleet ≡ solo per chain id) is what the campaign
+  inherits.
+- ``grid.eval`` — one point of a grid scan: an importable
+  ``module:function`` applied to the point's coordinates.
+
+Factories named by ``noise.sample_chain`` payloads are memoized
+per-process (building a posterior is expensive; every chain unit of a
+campaign shares one), keyed by the factory string + canonical kwargs.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import importlib
+import json
+
+import numpy as np
+
+from pint_tpu.campaign.runner import WorkUnit, register_kind, work_unit
+
+__all__ = ["chain_units", "grid_units", "result_digest"]
+
+
+# -- demo.stretch_chain -------------------------------------------------------------
+
+def _demo_lnpost(ndim: int):
+    """A correlated Gaussian log-posterior (the walkthrough target):
+    banded precision, deterministic in ndim only."""
+    import jax.numpy as jnp
+
+    prec = np.eye(ndim) + 0.4 * (np.eye(ndim, k=1) + np.eye(ndim, k=-1))
+    prec_j = jnp.asarray(prec)
+
+    def lnpost(x):
+        return -0.5 * x @ prec_j @ x
+
+    return lnpost
+
+
+@register_kind("demo.stretch_chain")
+def _run_demo_chain(payload: dict) -> dict:
+    """One stretch-move chain: ``{"chain_id", "seed", "nsteps",
+    "ndim", "walkers"}`` -> the chain's full output as numpy arrays.
+    Key and starts derive from (seed, chain_id) exactly as
+    MarginalizedPosterior._chain_starts does — chain c is the same
+    bits whether run solo, in a fleet, or re-run after a kill."""
+    import jax
+    import jax.numpy as jnp
+
+    from pint_tpu.sampler import make_stretch_chain
+
+    cid = int(payload["chain_id"])
+    seed = int(payload["seed"])
+    ndim = int(payload.get("ndim", 3))
+    nw = int(payload.get("walkers", 8))
+    nsteps = int(payload.get("nsteps", 50))
+
+    chain = jax.jit(make_stretch_chain(_demo_lnpost(ndim), nsteps))
+    key = jax.random.fold_in(jax.random.PRNGKey(seed), cid)
+    rng = np.random.default_rng(seed * 100003 + cid)
+    x0 = jnp.asarray(rng.normal(size=(nw, ndim)) * 0.5)
+    out = chain(x0, key)
+    return {"chain_id": cid,
+            "samples": np.asarray(out["samples"]),
+            "lnpost": np.asarray(out["lnpost"]),
+            "accept": np.asarray(out["accept"])}
+
+
+# -- noise.sample_chain -------------------------------------------------------------
+
+_FACTORY_MEMO: dict = {}
+
+
+def _factory_result(entry: str, kwargs: dict):
+    key = (entry, json.dumps(kwargs, sort_keys=True, default=str))
+    if key not in _FACTORY_MEMO:
+        mod, _, attr = entry.partition(":")
+        _FACTORY_MEMO[key] = getattr(importlib.import_module(mod),
+                                     attr)(**kwargs)
+    return _FACTORY_MEMO[key]
+
+
+@register_kind("noise.sample_chain")
+def _run_noise_chain(payload: dict) -> dict:
+    """One chain of a factory-built posterior: ``{"factory":
+    "module:function", "factory_kwargs": {...}, "chain_id": c}`` plus
+    optional ``sample_kwargs`` forwarded to ``.sample``. The factory is
+    memoized per-process; the chain itself is ``sample(chain_ids=[c])``
+    — bitwise per-chain by the fleet-determinism contract."""
+    post = _factory_result(payload["factory"],
+                           dict(payload.get("factory_kwargs", {})))
+    cid = int(payload["chain_id"])
+    out = post.sample(chain_ids=[cid],
+                      **dict(payload.get("sample_kwargs", {})))
+    return {"chain_id": cid,
+            **{k: np.asarray(v) for k, v in out.items()
+               if not k.startswith("_")}}
+
+
+# -- grid.eval ----------------------------------------------------------------------
+
+@register_kind("grid.eval")
+def _run_grid_point(payload: dict) -> dict:
+    """One grid-scan point: ``{"fn": "module:function", "point":
+    {...}}`` -> ``{"point", "value"}``. The function must be pure in
+    the point (seeds, if any, ride inside it)."""
+    mod, _, attr = payload["fn"].partition(":")
+    fn = getattr(importlib.import_module(mod), attr)
+    value = fn(**dict(payload["point"]))
+    return {"point": dict(payload["point"]),
+            "value": np.asarray(value)}
+
+
+# -- unit factories -----------------------------------------------------------------
+
+def chain_units(nchains: int, seed: int, *, kind: str = "demo.stretch_chain",
+                **payload) -> list[WorkUnit]:
+    """One unit per chain id, the campaign shape for sampling runs."""
+    return [work_unit(kind, chain_id=c, seed=seed, **payload)
+            for c in range(nchains)]
+
+
+def grid_units(fn: str, points: list[dict]) -> list[WorkUnit]:
+    """One unit per grid point for an importable ``module:function``."""
+    return [work_unit("grid.eval", fn=fn, point=p) for p in points]
+
+
+# -- assembly -----------------------------------------------------------------------
+
+def result_digest(results: dict) -> str:
+    """sha256 over the raw bytes of every array in every result, in
+    manifest order — the bitwise-resume witness: a resumed campaign and
+    its uninterrupted twin must produce the SAME digest."""
+    h = hashlib.sha256()
+    for uid in results:
+        h.update(uid.encode())
+        r = results[uid]
+        for k in sorted(r):
+            v = r[k]
+            h.update(k.encode())
+            if isinstance(v, np.ndarray):
+                h.update(np.ascontiguousarray(v).tobytes())
+            else:
+                h.update(json.dumps(v, sort_keys=True,
+                                    default=str).encode())
+    return h.hexdigest()

@@ -76,6 +76,7 @@ COUNTER_HELP: dict[str, str] = {
     "serve_restores": "sessions restored from checkpoints",
     "serve_journal_records": "write-ahead journal records appended",
     "serve_journal_compactions": "journal checkpoint compactions",
+    "serve_journal_full": "journal writes shed on ENOSPC (disk full)",
     "serve_checkpoints": "fleet session checkpoints written",
     "serve_deadline_expired": "queued requests shed past their deadline",
     "serve_retries": "transiently failed dispatches retried",
@@ -88,6 +89,12 @@ COUNTER_HELP: dict[str, str] = {
     "incremental_refits": "appends answered by the rank-k incremental path",
     "incremental_fallbacks": "appends that fell back to the full warm refit",
     "incremental_rows_appended": "TOA rows appended into resident sessions",
+    # durable-campaign telemetry (pint_tpu/campaign/runner.py); the live
+    # progress gauges (campaign_units_done/total, checkpoint age, ETA)
+    # register with fn= callbacks when a CampaignRunner exists
+    "campaign_units_run": "campaign work units executed to a durable result",
+    "campaign_checkpoints": "campaign progress snapshots written",
+    "campaign_resumes": "campaigns resumed from durable checkpoints",
 }
 
 

@@ -112,6 +112,21 @@ KINDS: dict[str, str] = {
         "a serving replica died or stopped answering; the survivors "
         "absorbed its sessions from its durable checkpoints + journal "
         "suffix (serve/fleet.py absorb) and kept serving"),
+    "serve.journal_full": (
+        "the write-ahead journal hit ENOSPC on an append/fsync; the "
+        "write was shed with an explicit refusal (JournalError -> 503 "
+        "at the gateway) while reads and already-admitted work "
+        "continue — an acked request is never silently undurable"),
+    "campaign.resumed": (
+        "a campaign resumed from its durable unit checkpoints after a "
+        "preemption/kill; completed units were skipped and the "
+        "remainder re-ran from their content-keyed seeds, so the "
+        "assembled result is bitwise-identical to an uninterrupted run"),
+    "campaign.checkpoint_corrupt": (
+        "a campaign unit result or progress snapshot failed its crc32 "
+        "and was quarantined beside the store; the unit re-runs from "
+        "its seed (or an older snapshot generation serves) instead of "
+        "restoring garbage"),
     "fetch.mirror_failed": (
         "a remote file could not be refreshed from any mirror"),
     "fetch.corrupt_quarantined": (

@@ -46,10 +46,11 @@ from pint_tpu.utils import knobs
 
 __all__ = [
     "INCR_COUNTERS", "PerfReport", "QuantileSketch", "SERVE_COUNTERS",
-    "active", "add", "collect", "enable", "enabled", "fit_breakdown",
-    "incremental_breakdown", "instrument_fit", "noise_breakdown",
-    "prepare_breakdown", "pta_breakdown", "put", "put_default",
-    "serve_breakdown", "set_metrics_feed", "stage",
+    "active", "add", "campaign_breakdown", "collect", "enable",
+    "enabled", "fit_breakdown", "incremental_breakdown",
+    "instrument_fit", "noise_breakdown", "prepare_breakdown",
+    "pta_breakdown", "put", "put_default", "serve_breakdown",
+    "set_metrics_feed", "stage",
 ]
 
 _env_enabled = knobs.flag("PINT_TPU_PERF")
@@ -482,6 +483,32 @@ def incremental_breakdown(rep: PerfReport) -> dict:
     return out
 
 
+# --- the canonical campaign breakdown --------------------------------------------
+
+#: campaign sub-stages named in the breakdown (campaign/runner.py): the
+#: resume scan (validating durable unit results + replaying the ledger),
+#: unit execution (the device work), the crc-framed atomic checkpoint
+#: writes (unit results + progress snapshots), and the campaign ledger
+#: appends. Anything else directly under a ``campaign`` stage lands in
+#: campaign_other_s.
+_CAMPAIGN_COMPONENTS = ("resume", "unit", "checkpoint", "ledger")
+
+
+def campaign_breakdown(rep: PerfReport) -> dict:
+    """Map "campaign"-rooted stages into the canonical campaign
+    breakdown. Contract (tests/test_campaign.py, the kill-mid-campaign
+    drill): named components + compile + trace + other account for
+    >= 90% of the campaign wall — preemption-safety telemetry cannot
+    silently rot. Counters: ``campaign_units_run`` units executed to a
+    durable result, ``campaign_checkpoints`` progress snapshots
+    written, ``campaign_resumes`` resumes from durable state."""
+    out = _root_breakdown(rep, "campaign", _CAMPAIGN_COMPONENTS)
+    for c in ("campaign_units_run", "campaign_checkpoints",
+              "campaign_resumes"):
+        out[c] = int(rep.counters.get(c, 0))
+    return out
+
+
 # --- bounded streaming quantiles --------------------------------------------------
 
 
@@ -655,6 +682,7 @@ SERVE_COUNTERS = (
     "serve_coalesced", "serve_appends", "serve_refits",
     "serve_evictions", "serve_restores",
     "serve_journal_records", "serve_journal_compactions",
+    "serve_journal_full",
     "serve_checkpoints", "serve_deadline_expired",
     "serve_retries", "serve_quarantines", "serve_worker_replacements",
     "serve_migrations", "serve_replicas_lost",

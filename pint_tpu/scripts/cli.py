@@ -11,6 +11,7 @@ Currently:
   drains gracefully.
 - ``pint_tpu status`` — one-shot observability snapshot: scrape a
   running engine's localhost ``/metrics`` + ``/healthz`` (``--port``),
+  probe a campaign directory's durable progress (``--campaign``),
   or dump this process's metrics registry / degradation ledger /
   artifact-store state (pint_tpu/scripts/status.py).
 - ``pint_tpu knobs`` — print the sanctioned environment-knob inventory
@@ -34,7 +35,8 @@ commands:
            journal (crash recovery; see `pint_tpu recover --help`)
   status   observability snapshot: scrape a running engine's /metrics
            + /healthz (--fleet merges a whole replica fleet into one
-           report), or dump this process's registry/ledger state
+           report; --campaign probes a campaign directory's durable
+           progress), or dump this process's registry/ledger state
   knobs    print the environment-knob inventory
 """
 

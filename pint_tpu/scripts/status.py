@@ -17,6 +17,12 @@ Three modes:
   the sketches must be merged before quantiling). Exit 0 when every
   replica is healthy, 3 when any is degraded, 1 when any is
   unreachable.
+- ``pint_tpu status --campaign <dir>`` probes a campaign directory
+  (pint_tpu/campaign/) READ-ONLY: units done/total, status, checkpoint
+  age, ETA and resume count from the manifest + newest loadable
+  snapshot + durable results — answerable whether the campaign process
+  is alive, preempted, or long gone. Exit 0 when complete, 4 while
+  in flight.
 - ``pint_tpu status`` (no port) dumps THIS process's observability
   state: the metrics registry render, the degradation ledger, the
   ``.aotx`` artifact-store traffic, the flight-recorder ring size, the
@@ -149,9 +155,32 @@ def main(argv=None) -> int:
                     help="scrape a replica fleet (comma-separated "
                          "localhost replica ports) and print one merged "
                          "report: counters summed, sketches merged")
+    ap.add_argument("--campaign", default=None, metavar="DIR",
+                    help="probe a campaign directory read-only: "
+                         "progress, checkpoint age, ETA, resumes")
     ap.add_argument("--json", action="store_true",
                     help="emit one JSON object instead of text")
     args = ap.parse_args(argv)
+
+    if args.campaign is not None:
+        from pint_tpu.campaign import campaign_status
+
+        st = campaign_status(args.campaign)
+        if args.json:
+            print(json.dumps({"metric": "status", "mode": "campaign",
+                              **st}))
+        else:
+            age = st["checkpoint_age_s"]
+            eta = st["eta_s"]
+            print(f"campaign {st['name']!r} ({st['dir']}): "
+                  f"{st['status']} — {st['units_done']}/"
+                  f"{st['units_total']} units durable")
+            print(f"  last checkpoint: "
+                  f"{'never' if age is None else f'{age:.1f}s ago'}; "
+                  f"eta: {'unknown' if eta is None else f'{eta:.1f}s'}; "
+                  f"resumes: {st['resumes']}; "
+                  f"ledger events: {st['ledger_events']}")
+        return 0 if st["status"] == "complete" else 4
 
     if args.fleet is not None:
         ports = [int(p) for p in args.fleet.split(",") if p.strip()]

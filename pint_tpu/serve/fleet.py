@@ -36,11 +36,13 @@ from __future__ import annotations
 
 import json
 import os
+import select
 import subprocess
 import sys
 import time
 from pathlib import Path
 
+from pint_tpu.ops import degrade
 from pint_tpu.serve import route
 from pint_tpu.utils import knobs
 from pint_tpu.utils.logging import get_logger
@@ -88,10 +90,21 @@ class ReplicaFleet:
     # -- process supervision -----------------------------------------------------
 
     def spawn(self, name: str, extra_env: dict | None = None,
-              timeout_s: float = 600.0) -> dict:
+              timeout_s: float | None = None) -> dict:
         """Launch one replica worker and block until its ``READY::``
         line (recovery + gateway bind are done). Returns the ready
-        report (port, sessions, traces_on_warm, ...)."""
+        report (port, sessions, traces_on_warm, ...).
+
+        The handshake is bounded by ``timeout_s`` (default
+        ``PINT_TPU_FLEET_READY_TIMEOUT_S``) with a non-blocking read
+        loop: a worker that HANGS before its handshake (deadlocked
+        recovery, wedged device init) — not just one that dies — is
+        reaped at the deadline instead of blocking the fleet start
+        forever. Both shapes raise RuntimeError; :meth:`spawn_all`
+        converts that into a degraded R−1 start."""
+        if timeout_s is None:
+            timeout_s = float(
+                knobs.get("PINT_TPU_FLEET_READY_TIMEOUT_S"))
         d = self.dir_for(name)
         d.mkdir(parents=True, exist_ok=True)
         env = dict(os.environ)  # jaxlint: disable=env-read — the worker must inherit the parent's knob/cache environment verbatim
@@ -103,19 +116,43 @@ class ReplicaFleet:
             env=env)
         deadline = time.monotonic() + timeout_s
         ready = None
+        died = False
         assert proc.stdout is not None
-        while time.monotonic() < deadline:
-            line = proc.stdout.readline()
-            if not line:
-                break
-            if line.startswith(_READY):
-                ready = json.loads(line[len(_READY):])
+        # raw-fd read loop: readline() on the pipe would block past the
+        # deadline on a hung worker — select + os.read keeps the budget
+        fd = proc.stdout.fileno()
+        buf = b""
+        while time.monotonic() < deadline and ready is None:
+            r, _, _ = select.select(
+                [fd], [], [], min(0.2, max(deadline - time.monotonic(),
+                                           0.01)))
+            if r:
+                chunk = os.read(fd, 65536)
+                if not chunk:
+                    died = True        # EOF: the worker died pre-ready
+                    break
+                buf += chunk
+                *lines, buf = buf.split(b"\n")
+                for line in lines:
+                    text = line.decode(errors="replace")
+                    if text.startswith(_READY):
+                        ready = json.loads(text[len(_READY):])
+                        break
+            elif proc.poll() is not None:
+                died = True
                 break
         if ready is None:
             proc.kill()
-            err = proc.stderr.read() if proc.stderr else ""
+            try:
+                _, err = proc.communicate(timeout=10.0)
+            except subprocess.TimeoutExpired:
+                err = ""
+            shape = ("died before" if died else
+                     f"hung past the {timeout_s:.0f}s handshake budget "
+                     "(PINT_TPU_FLEET_READY_TIMEOUT_S) before")
             raise RuntimeError(
-                f"replica {name!r} never reported ready: {err[-2000:]}")
+                f"replica {name!r} {shape} its READY:: handshake: "
+                f"{(err or '')[-2000:]}")
         self.procs[name] = {"proc": proc, "port": ready["port"],
                             "ready": ready}
         log.info(f"replica {name!r} ready on port {ready['port']} "
@@ -123,8 +160,39 @@ class ReplicaFleet:
                  f"{ready['traces_on_warm']} traces)")
         return ready
 
-    def spawn_all(self, extra_env: dict | None = None) -> dict:
-        return {name: self.spawn(name, extra_env) for name in self.names}
+    def spawn_all(self, extra_env: dict | None = None,
+                  per_replica_env: dict | None = None) -> dict:
+        """Spawn every replica; one that dies or hangs before its
+        handshake is reaped and recorded as ``serve.replica_lost``
+        (refusable under ``PINT_TPU_DEGRADED=error``) and the fleet
+        STARTS DEGRADED at R−1 — the lost name leaves ``self.names`` so
+        rendezvous routing covers only live replicas. Sessions staged
+        into the lost replica's durable dir are absorbable later
+        (``FleetGateway.absorb``). Raises only when NO replica reports
+        ready. ``per_replica_env`` layers name-keyed env overrides on
+        top of ``extra_env`` (chaos drills poison one worker)."""
+        out: dict = {}
+        total = len(self.names)
+        for name in list(self.names):
+            env = dict(extra_env or {})
+            env.update((per_replica_env or {}).get(name, {}))
+            try:
+                out[name] = self.spawn(name, env)
+            except RuntimeError as e:
+                self.names.remove(name)
+                degrade.record(
+                    "serve.replica_lost", f"replica:{name}",
+                    f"replica {name!r} failed its READY:: handshake "
+                    f"({e}); the fleet starts degraded at "
+                    f"{len(self.names)} of {total} replicas",
+                    fix="raise PINT_TPU_FLEET_READY_TIMEOUT_S or inspect "
+                        "the replica's stderr and durable dir; absorb its "
+                        "staged sessions or re-spawn it once fixed")
+        if not out:
+            raise RuntimeError(
+                f"no replica of {total} reported ready; fleet start "
+                "refused")
+        return out
 
     def url(self, name: str) -> str:
         return f"http://127.0.0.1:{self.procs[name]['port']}"
@@ -183,6 +251,18 @@ def _replica_main(argv: list[str] | None = None) -> int:
     ap.add_argument("--dir", required=True)
     ap.add_argument("--port", type=int, default=None)
     args = ap.parse_args(argv)
+
+    from pint_tpu.testing import faults
+
+    # the startup-robustness drill (serve.ready site): "hang" wedges the
+    # worker before its handshake — the parent's READY timeout must reap
+    # it; "exit" dies before the handshake — either way the fleet starts
+    # degraded at R−1 with serve.replica_lost on the ledger
+    mode = faults.trip("serve.ready", f"dir:{args.dir}")
+    if mode == "hang":
+        time.sleep(3600.0)
+    elif mode == "exit":
+        return 70
 
     from pint_tpu.ops.compile import setup_persistent_cache
 

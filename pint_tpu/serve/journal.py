@@ -60,6 +60,7 @@ end-to-end without killing anything.
 
 from __future__ import annotations
 
+import errno
 import json
 import os
 import shutil
@@ -196,21 +197,56 @@ class RequestJournal:
             # silent bit rot: the frame promises the original crc but
             # the payload lies — only the read path can catch it
             payload = bytes([payload[0] ^ 0xFF]) + payload[1:]
-        self._fh.write(frame + payload)
-        # flush every record: the bytes reach the OS before the ticket
-        # acks, so a killed process (the serve.crash drill) loses nothing
-        self._fh.flush()
+        if mode == "enospc":
+            self._shed_full(OSError(errno.ENOSPC,
+                                    "injected disk-full on journal append "
+                                    "(serve.journal:enospc)"),
+                            f"seq:{seq}")
+        try:
+            self._fh.write(frame + payload)
+            # flush every record: the bytes reach the OS before the
+            # ticket acks, so a killed process (the serve.crash drill)
+            # loses nothing
+            self._fh.flush()
+        except OSError as e:
+            if e.errno == errno.ENOSPC:
+                self._shed_full(e, f"seq:{seq}")
+            raise
         self._unsynced += 1
         if self.fsync_every > 0 and self._unsynced >= self.fsync_every:
             self._fsync_timed()
             self._unsynced = 0
+
+    def _shed_full(self, e: OSError, context: str) -> None:
+        """ENOSPC on an append/fsync: record ``serve.journal_full`` on
+        the degradation ledger (refusable under
+        ``PINT_TPU_DEGRADED=error`` — the ledger write raises first)
+        and shed the write with :class:`JournalError` — the gateway maps
+        it to an explicit 503, the request was never acked, and the
+        engine keeps serving reads and already-admitted work. Writes
+        resume as soon as an append succeeds again; nothing latches."""
+        degrade.record(
+            "serve.journal_full", self.dir.name,
+            f"journal write at {context} hit ENOSPC ({e}); the request "
+            "was refused un-acked, reads and admitted work continue",
+            fix="free disk space (or compact via checkpoint_fleet) — "
+                "writes resume on the next successful append")
+        perf.add("serve_journal_full")
+        raise JournalError(
+            f"write-ahead journal disk full at {context}: the write was "
+            "shed (503); reads continue") from e
 
     def _fsync_timed(self) -> None:
         """fsync with its latency exported: the WAL's durability tax is
         a first-class SLO signal (the serve_journal_fsync_seconds
         summary in the metrics registry)."""
         t0 = time.perf_counter()
-        os.fsync(self._fh.fileno())
+        try:
+            os.fsync(self._fh.fileno())
+        except OSError as e:
+            if e.errno == errno.ENOSPC:
+                self._shed_full(e, "fsync")
+            raise
         obs_metrics.observe("serve_journal_fsync_seconds",
                             time.perf_counter() - t0)
 

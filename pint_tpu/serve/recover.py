@@ -42,6 +42,7 @@ import zlib
 from pathlib import Path
 
 from pint_tpu.ops import degrade, perf
+from pint_tpu.testing import faults
 from pint_tpu.utils.logging import get_logger
 
 log = get_logger("pint_tpu.serve")
@@ -56,10 +57,34 @@ def _session_dir(dirpath: Path) -> Path:
 
 
 def _write_checkpoint(path: Path, ck) -> None:
+    """crc-framed atomic checkpoint write — shared by the fleet
+    ``SessionCheckpoint`` store and the campaign unit-result/snapshot
+    stores (pint_tpu/campaign/runner.py). The ``campaign.checkpoint``
+    fault site drills both: ``kill`` dies mid-write with a torn ``.tmp``
+    on disk (the previous generation behind the atomic rename must stay
+    intact and loadable), ``corrupt`` bit-flips the payload under a
+    valid-looking frame (the read path must quarantine, never restore
+    garbage)."""
     payload = pickle.dumps(ck, protocol=pickle.HIGHEST_PROTOCOL)
     tmp = path.with_suffix(".tmp")
+    mode = faults.trip("campaign.checkpoint", path.name)
+    if mode == "corrupt":
+        # the frame promises the original crc but the payload lies —
+        # only the read path (crc validation) can catch it
+        frame = _FRAME.pack(len(payload), zlib.crc32(payload))
+        payload = bytes([payload[0] ^ 0xFF]) + payload[1:]
+    else:
+        frame = _FRAME.pack(len(payload), zlib.crc32(payload))
     with open(tmp, "wb") as fh:
-        fh.write(_FRAME.pack(len(payload), zlib.crc32(payload)))
+        fh.write(frame)
+        if mode == "kill":
+            # the kill-mid-write drill: half the payload reaches disk,
+            # then the process dies — the torn .tmp is never renamed,
+            # so the previous checkpoint generation stays intact
+            fh.write(payload[: max(len(payload) // 2, 1)])
+            fh.flush()
+            os.fsync(fh.fileno())
+            os._exit(70)
         fh.write(payload)
         fh.flush()
         os.fsync(fh.fileno())

@@ -1,0 +1,242 @@
+"""Composable chaos: seeded multi-fault schedules + invariant monitors.
+
+Single-fault drills (testing/faults.py) prove each degradation path in
+isolation; real incidents stack — a replica crash DURING a journal-full
+episode WHILE a campaign is resuming. :class:`ChaosSchedule` composes
+fault sites into one deterministic timeline:
+
+- a schedule is a list of :class:`ChaosEvent`\\ s — ``(t_offset_s,
+  site, mode, count, target)`` — where ``target=None`` arms the local
+  process (:func:`faults.arm`) and a URL arms a REMOTE serving process
+  through its ``/v1/fault`` endpoint (the same surface the fleet bench
+  uses), so one schedule spans engine + fleet + campaign processes;
+- :meth:`ChaosSchedule.randomized` draws a schedule from a seeded
+  ``np.random.default_rng`` — same seed, same timeline, so a chaos soak
+  that fails REPLAYS exactly;
+- :meth:`start` fires the timeline from a daemon thread (the bench
+  soak); :meth:`arm_now` arms everything immediately (deterministic
+  tier-1 drills — no wall-clock in the loop).
+
+After the disturbed run, **invariant monitors** decide green/red —
+declarative callables returning ``(ok, detail)``:
+
+- :func:`ledger_explained` — every degradation kind on the ledger is
+  explained by a scheduled fault (via the KIND_DRILLS inversion) or an
+  explicit allowance: chaos may cause NOTHING the schedule doesn't
+  predict;
+- :func:`requests_lost_zero` — no acked request vanished across
+  crash/recover/absorb;
+- :func:`parity_within` — the disturbed run's numbers match the
+  undisturbed twin's to tolerance (default 1e-10);
+- :func:`traces_on_warm_zero` — chaos never silently invalidated the
+  warm compile caches.
+
+``python bench.py --smoke --chaos`` runs the soak leg: a replicated
+fleet + client load under a >= 3-kind schedule, all monitors green.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass
+
+import numpy as np
+
+from pint_tpu.ops import degrade
+from pint_tpu.testing import faults
+from pint_tpu.utils.logging import get_logger
+
+log = get_logger("pint_tpu.chaos")
+
+__all__ = ["ChaosEvent", "ChaosSchedule", "check_invariants",
+           "ledger_explained", "parity_within", "requests_lost_zero",
+           "traces_on_warm_zero"]
+
+
+@dataclass(frozen=True)
+class ChaosEvent:
+    """One scheduled fault: arm ``site`` with ``mode`` for ``count``
+    firings at ``t_offset_s`` after the schedule starts, locally
+    (``target=None``) or in the serving process at ``target`` (a base
+    URL with a ``/v1/fault`` endpoint)."""
+
+    t_offset_s: float
+    site: str
+    mode: str
+    count: int = 1
+    target: str | None = None
+
+    @property
+    def spec(self) -> str:
+        return f"{self.site}:{self.mode}*{self.count}"
+
+
+class ChaosSchedule:
+    """A deterministic multi-fault timeline (see module docstring)."""
+
+    def __init__(self, events: list[ChaosEvent], seed: int | None = None):
+        self.events = sorted(events, key=lambda e: (e.t_offset_s, e.site))
+        self.seed = seed
+        #: (t_offset_s, spec, target) for every event actually armed
+        self.armed_log: list[tuple[float, str, str | None]] = []
+        self._thread: threading.Thread | None = None
+        self._stop = threading.Event()
+
+    @classmethod
+    def randomized(cls, seed: int, menu: list[tuple[str, str]],
+                   duration_s: float, n_events: int,
+                   targets: list[str | None] = (None,)) -> "ChaosSchedule":
+        """Draw ``n_events`` events from ``menu`` (site, mode) pairs,
+        offsets uniform over ``[0, duration_s)``, targets uniform over
+        ``targets`` — all from one seeded generator, so the same seed
+        reproduces the same timeline bit-for-bit."""
+        rng = np.random.default_rng(seed)
+        targets = list(targets)
+        events = []
+        for _ in range(n_events):
+            site, mode = menu[int(rng.integers(len(menu)))]
+            events.append(ChaosEvent(
+                t_offset_s=round(float(rng.uniform(0.0, duration_s)), 3),
+                site=site, mode=mode,
+                target=targets[int(rng.integers(len(targets)))]))
+        return cls(events, seed=seed)
+
+    def kinds(self) -> set[str]:
+        """The distinct fault kinds (site, mode) in the schedule — the
+        bench's >= 3-concurrent-kinds floor counts these."""
+        return {(e.site, e.mode) for e in self.events}
+
+    def explained_kinds(self) -> set[str]:
+        """Degradation kinds this schedule can legitimately put on the
+        ledger: the KIND_DRILLS inversion — every registered kind whose
+        drill site/mode appears in the schedule. One scheduled fault
+        may explain several kinds (``serve.dispatch:fail`` drives both
+        ``serve.retry`` and ``serve.quarantine``)."""
+        scheduled = self.kinds()
+        out = set()
+        for kind, drill in faults.KIND_DRILLS.items():
+            if drill[0] == "site" and (drill[1], drill[2]) in scheduled:
+                out.add(kind)
+        return out
+
+    # -- arming -----------------------------------------------------------------
+
+    def _arm(self, e: ChaosEvent) -> None:
+        if e.target is None:
+            faults.arm(e.site, e.mode, e.count)
+        else:
+            from pint_tpu.serve.gateway import http_json
+
+            http_json(e.target + "/v1/fault", {"spec": e.spec})
+        self.armed_log.append((e.t_offset_s, e.spec, e.target))
+        log.info(f"chaos: armed {e.spec} "
+                 f"{'locally' if e.target is None else 'at ' + e.target} "
+                 f"(t+{e.t_offset_s:.3f}s)")
+
+    def arm_now(self) -> "ChaosSchedule":
+        """Arm every event immediately, in timeline order — the
+        deterministic form the tier-1 drills use (no wall-clock between
+        a test and its faults). Returns self for chaining."""
+        for e in self.events:
+            self._arm(e)
+        return self
+
+    def start(self) -> "ChaosSchedule":
+        """Fire the timeline on wall-clock offsets from a daemon thread
+        (the bench soak form). :meth:`join` waits for the last event;
+        :meth:`stop` cancels the remainder."""
+        def _run():
+            t0 = time.monotonic()
+            for e in self.events:
+                delay = e.t_offset_s - (time.monotonic() - t0)
+                if delay > 0 and self._stop.wait(delay):
+                    return
+                if self._stop.is_set():
+                    return
+                self._arm(e)
+
+        self._stop.clear()
+        self._thread = threading.Thread(target=_run, name="chaos-schedule",
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def join(self, timeout_s: float = 120.0) -> None:
+        if self._thread is not None:
+            self._thread.join(timeout_s)
+
+    def stop(self) -> None:
+        self._stop.set()
+        self.join(10.0)
+
+
+# -- invariant monitors -------------------------------------------------------------
+
+def ledger_explained(schedule: ChaosSchedule, allowed: tuple = ()):
+    """Monitor: every degradation kind on the local ledger is explained
+    by a scheduled fault or explicitly ``allowed`` — chaos must cause
+    nothing the schedule doesn't predict."""
+    def check():
+        ok_kinds = schedule.explained_kinds() | set(allowed)
+        seen = {e.kind for e in degrade.events()}
+        orphans = sorted(seen - ok_kinds)
+        return (not orphans,
+                f"ledger kinds {sorted(seen)} vs explained "
+                f"{sorted(ok_kinds)}; unexplained: {orphans}")
+    check.__name__ = "ledger_explained"
+    return check
+
+
+def requests_lost_zero(reports) -> tuple[bool, str]:
+    """Monitor payload: ``requests_lost == 0`` in every recovery /
+    absorb / READY report (pass a list of dicts carrying the key)."""
+    lost = {i: r.get("requests_lost") for i, r in enumerate(reports)
+            if r.get("requests_lost")}
+    return (not lost, f"requests_lost by report: {lost or 'all zero'}")
+
+
+def parity_within(disturbed, undisturbed, tol: float = 1e-10
+                  ) -> tuple[bool, str]:
+    """Monitor payload: the disturbed run's numbers equal the
+    undisturbed twin's to ``tol`` (arrays or scalars, nested dicts ok).
+    ``tol=0`` demands bitwise equality."""
+    def _flat(x, prefix=""):
+        if isinstance(x, dict):
+            for k in sorted(x):
+                yield from _flat(x[k], f"{prefix}{k}.")
+        else:
+            yield prefix.rstrip("."), np.asarray(x)
+
+    a = dict(_flat(disturbed))
+    b = dict(_flat(undisturbed))
+    if a.keys() != b.keys():
+        return False, (f"key mismatch: {sorted(a.keys() ^ b.keys())}")
+    worst = ("", 0.0)
+    for k in a:
+        if a[k].shape != b[k].shape:
+            return False, f"shape mismatch at {k}: {a[k].shape} vs {b[k].shape}"
+        if a[k].dtype.kind in "fc":
+            d = float(np.max(np.abs(a[k] - b[k]))) if a[k].size else 0.0
+        else:
+            d = 0.0 if np.array_equal(a[k], b[k]) else float("inf")
+        if d > worst[1]:
+            worst = (k, d)
+    return (worst[1] <= tol,
+            f"max |disturbed - twin| = {worst[1]:.3e} at "
+            f"{worst[0] or '<all>'} (tol {tol:g})")
+
+
+def traces_on_warm_zero(ready_reports) -> tuple[bool, str]:
+    """Monitor payload: no warm-started process compiled anything —
+    chaos never silently invalidated the content-addressed caches."""
+    traces = {i: r.get("traces_on_warm") for i, r in enumerate(ready_reports)
+              if r.get("traces_on_warm")}
+    return (not traces, f"traces_on_warm by report: {traces or 'all zero'}")
+
+
+def check_invariants(monitors: dict) -> tuple[bool, dict]:
+    """Evaluate named monitors — each a zero-arg callable returning
+    ``(ok, detail)`` — into ``(all_green, {name: (ok, detail)})``."""
+    results = {name: fn() for name, fn in monitors.items()}
+    return all(ok for ok, _ in results.values()), results

@@ -37,9 +37,12 @@ site                      armed modes
                           then the write raises — the crash-mid-write
                           shape recovery truncates), ``corrupt`` (the
                           payload is bit-flipped under a valid-looking
-                          frame — silent rot the read path quarantines)
-                          — applied by the journal writer
-                          (serve/journal.py)
+                          frame — silent rot the read path quarantines),
+                          ``enospc`` (the append sees a disk-full
+                          OSError — ``serve.journal_full`` on the
+                          ledger, the write shed with JournalError/503
+                          while reads continue) — applied by the
+                          journal writer (serve/journal.py)
 ``serve.dispatch``        ``fail`` (one dispatch attempt raises, driving
                           the bounded-retry ``serve.retry`` path and,
                           exhausted, the crash-loop ``serve.quarantine``
@@ -64,6 +67,30 @@ site                      armed modes
                           driving the ``serve.migrate``
                           checkpoint-handoff path end-to-end
                           (serve/fleet.py)
+``serve.ready``           ``hang`` (the replica worker blocks before its
+                          ``READY::`` handshake — the parent's
+                          ``PINT_TPU_FLEET_READY_TIMEOUT_S`` budget
+                          reaps it), ``exit`` (the worker dies before
+                          the handshake) — both drive the degraded
+                          R−1 fleet start with ``serve.replica_lost``
+                          on the ledger (serve/fleet.py spawn_all)
+``campaign.run``          ``kill`` — the campaign loop ``os._exit(70)``s
+                          after durably checkpointing a completed unit
+                          (the preemption drill: a fresh process must
+                          resume bitwise-identically,
+                          ``campaign.resumed`` on the ledger —
+                          campaign/runner.py)
+``campaign.checkpoint``   ``kill`` (the checkpoint writer dies mid-write
+                          — a torn ``.tmp`` reaches disk, the previous
+                          generation stays intact behind the atomic
+                          rename), ``corrupt`` (the payload is
+                          bit-flipped under a valid-looking frame — the
+                          read path quarantines it,
+                          ``campaign.checkpoint_corrupt``) — applied
+                          by the shared crc-framed checkpoint writer
+                          (serve/recover.py), so the drill covers both
+                          fleet ``SessionCheckpoint`` stores and campaign
+                          snapshots
 ========================  =====================================================
 
 Arming
@@ -130,6 +157,10 @@ KIND_DRILLS: dict[str, tuple] = {
     "serve.quarantine": ("site", "serve.dispatch", "fail"),
     "serve.journal_truncated": ("site", "serve.journal", "torn"),
     "serve.journal_corrupt": ("site", "serve.journal", "corrupt"),
+    "serve.journal_full": ("site", "serve.journal", "enospc"),
+    "campaign.resumed": ("site", "campaign.run", "kill"),
+    "campaign.checkpoint_corrupt": ("site", "campaign.checkpoint",
+                                    "corrupt"),
     "serve.migrate": ("site", "serve.migrate", "force"),
     "serve.replica_lost": ("site", "serve.crash", "exit"),
     "fetch.mirror_failed": ("site", "fetch", "refuse"),

@@ -78,7 +78,11 @@ KNOBS: dict[str, tuple[str | None, str]] = {
     # --- replicated serving fleet (serve/gateway.py, serve/fleet.py) -----------
     "PINT_TPU_GATEWAY_PORT": ("0", "serve the HTTP gateway (submit/ticket/metrics, localhost) on this port; 0 = an ephemeral port chosen at bind"),
     "PINT_TPU_FLEET_REPLICAS": ("2", "replica worker processes a ReplicaFleet spawns by default"),
+    "PINT_TPU_FLEET_READY_TIMEOUT_S": ("600", "replica READY:: handshake budget in s: a worker not ready past it (hung OR dead) is reaped and spawn_all starts the fleet degraded at R-1 (serve.replica_lost)"),
     "PINT_TPU_MIGRATE_TIMEOUT_S": ("30", "live session migration budget in s: a checkpoint-handoff (export + import + journal replay) past it fails the migration instead of stalling the fleet"),
+    # --- durable campaigns (pint_tpu/campaign/) --------------------------------
+    "PINT_TPU_CAMPAIGN_CHECKPOINT_EVERY": ("1", "campaign progress-snapshot cadence in completed units (campaign/runner.py); unit RESULTS are always durable per unit"),
+    "PINT_TPU_CAMPAIGN_KEEP": ("2", "campaign snapshot generations kept (>= 2, so a kill mid-write always leaves an intact previous generation)"),
     # --- observability (pint_tpu/obs/) -----------------------------------------
     "PINT_TPU_TRACE": ("0", "request tracing: 0 off (zero-cost), 1 on (spans as JSON Lines under <cache_root>/traces), any other value = the output directory"),
     "PINT_TPU_METRICS_PORT": ("0", "serve the OpenMetrics endpoint (/metrics + /healthz, localhost) on this port when the engine starts; 0 disables"),

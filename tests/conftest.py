@@ -95,3 +95,30 @@ def production_ephemeris():
             os.environ.pop("PINT_TPU_NBODY", None)
         else:
             os.environ["PINT_TPU_NBODY"] = old
+
+
+# -- tier-1 time-budget guard (ISSUE 19) --------------------------------------------
+#
+# The suite has a hard wall-clock ceiling; one unmarked heavyweight test
+# can silently eat it until `timeout` kills the whole run mid-file. Any
+# test that PASSES but takes longer than PINT_TPU_TEST_BUDGET_S (default
+# 60 s; 0 disables) without a @pytest.mark.slow mark is FAILED with an
+# explanation — the budget is part of the contract, not a vibe.
+
+_TEST_BUDGET_S = float(os.environ.get("PINT_TPU_TEST_BUDGET_S", "60") or 0)
+
+
+@pytest.hookimpl(hookwrapper=True)
+def pytest_runtest_makereport(item, call):
+    outcome = yield
+    rep = outcome.get_result()
+    if (rep.when == "call" and rep.passed and _TEST_BUDGET_S > 0
+            and "slow" not in item.keywords
+            and call.duration > _TEST_BUDGET_S):
+        rep.outcome = "failed"
+        rep.longrepr = (
+            f"{item.nodeid} passed but took {call.duration:.1f}s — over "
+            f"the {_TEST_BUDGET_S:.0f}s tier-1 per-test budget. Mark it "
+            "@pytest.mark.slow (and give it a dedicated-run story) or "
+            "make it cheaper; PINT_TPU_TEST_BUDGET_S overrides the "
+            "budget (0 disables).")

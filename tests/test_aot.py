@@ -414,6 +414,7 @@ class TestWarmProcessGate:
     """The tier-1 zero-trace gate: warmup CLI in one subprocess, the
     flagship smoke under PINT_TPU_EXPECT_WARM=1 in a FRESH subprocess."""
 
+    @pytest.mark.slow
     def test_warmup_then_zero_trace_flagship_smoke(self, tmp_path):
         env = dict(os.environ)
         env.update({

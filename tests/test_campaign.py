@@ -1,0 +1,390 @@
+"""Preemption-safe campaigns (pint_tpu/campaign/) — ISSUE 19.
+
+Bottom to top:
+
+- content keys: canonical, payload-sensitive, manifest-stable; a
+  campaign directory refuses a DIFFERENT campaign's unit list.
+- durable progress: every completed unit is a crc-framed atomic
+  checkpoint; resume skips validated results and re-runs the rest —
+  the assembled digest is BITWISE-equal to an uninterrupted twin
+  (in-process pause/resume, fault-kill, corrupt-and-requarantine,
+  SIGTERM drain legs).
+- THE KILL DRILL (the ISSUE-19 acceptance): a sampling campaign
+  subprocess is SIGKILLed between checkpoints, a genuinely fresh
+  process resumes from the durable store, and the final chain states
+  are bitwise-equal to the never-killed twin's — with the resume
+  ledger-visible (``campaign.resumed``) and >= 90% of campaign wall
+  attributed by ``perf.campaign_breakdown``.
+- atomic-writer drills: ``campaign.checkpoint:kill`` mid-write leaves
+  a torn ``.tmp`` and an INTACT previous generation (campaign snapshot
+  AND session-checkpoint stores — the writer is shared); ``:corrupt``
+  is quarantined on read with ``campaign.checkpoint_corrupt``.
+- the ``pint_tpu status --campaign`` probe answers progress read-only.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from pint_tpu.campaign import (CampaignRunner, campaign_status, chain_units,
+                               content_key, result_digest, work_unit)
+from pint_tpu.ops import degrade, perf
+from pint_tpu.serve.journal import replay_records
+from pint_tpu.serve.recover import _read_checkpoint, _write_checkpoint
+from pint_tpu.testing import faults
+
+REPO = str(Path(__file__).resolve().parent.parent)
+
+# small enough that a full campaign runs in ~1s; the drills re-run it
+# several times
+DEMO = dict(ndim=2, walkers=6, nsteps=8)
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    degrade.reset_ledger()
+    faults.reset()
+    yield
+    degrade.reset_ledger()
+    faults.reset()
+
+
+def _campaign(tmp_path, n=3, seed=7, sub="camp", **kw):
+    return CampaignRunner(tmp_path / sub,
+                          chain_units(n, seed, **DEMO), **kw)
+
+
+class TestContentKeys:
+    def test_key_is_canonical_and_payload_sensitive(self):
+        a = content_key("demo.stretch_chain", {"chain_id": 0, "seed": 7})
+        b = content_key("demo.stretch_chain", {"seed": 7, "chain_id": 0})
+        assert a == b                      # dict order never matters
+        assert a != content_key("demo.stretch_chain",
+                                {"chain_id": 1, "seed": 7})
+        assert a != content_key("demo.stretch_chain",
+                                {"chain_id": 0, "seed": 8})
+
+    def test_dir_refuses_a_different_campaign(self, tmp_path):
+        _campaign(tmp_path, n=2)
+        with pytest.raises(ValueError, match="DIFFERENT"):
+            _campaign(tmp_path, n=3)
+        # the SAME units (or none at all) resume fine
+        _campaign(tmp_path, n=2)
+        CampaignRunner(tmp_path / "camp")
+
+    def test_unknown_kind_is_loud(self, tmp_path):
+        r = CampaignRunner(tmp_path / "c", [work_unit("no.such.kind")])
+        with pytest.raises(KeyError, match="no.such.kind"):
+            r.run()
+
+
+class TestRunAndResume:
+    def test_complete_run_reports_and_assembles(self, tmp_path):
+        r = _campaign(tmp_path)
+        with perf.collect() as rep:
+            report = r.run()
+        assert report["status"] == "complete"
+        assert report["units_run"] == report["units_done"] == 3
+        res = r.results()
+        assert len(res) == 3
+        assert all(v["samples"].shape == (DEMO["nsteps"], DEMO["walkers"],
+                                          DEMO["ndim"])
+                   for v in res.values())
+        # the perf contract: >= 90% of campaign wall attributed to named
+        # components (resume / unit / checkpoint / ledger / compile)
+        b = perf.campaign_breakdown(rep)
+        assert b["campaign_units_run"] == 3
+        attributed = 1.0 - b["campaign_other_s"] / b["campaign_wall_s"]
+        assert attributed >= 0.90, b
+
+    def test_pause_resume_is_bitwise(self, tmp_path):
+        twin = _campaign(tmp_path, sub="twin")
+        twin.run()
+        want = result_digest(twin.results())
+
+        r = _campaign(tmp_path, sub="paused")
+        assert r.run(max_units=1)["status"] == "paused"
+        # a FRESH runner (new process stand-in) resumes from disk
+        r2 = CampaignRunner(tmp_path / "paused")
+        report = r2.run()
+        assert report["status"] == "complete"
+        assert report["units_skipped"] == 1 and report["units_run"] == 2
+        assert result_digest(r2.results()) == want
+        # the resume is ledger-visible twice over: the degradation
+        # ledger and the campaign's own journal
+        assert "campaign.resumed" in {e.kind for e in degrade.events()}
+        ops = [rec["op"] for rec in
+               replay_records(tmp_path / "paused" / "ledger")[0]]
+        assert "resumed" in ops and ops.count("unit_done") == 3
+        assert ops[-1] == "campaign_status"
+
+    def test_completed_campaign_reruns_as_noop(self, tmp_path):
+        r = _campaign(tmp_path)
+        r.run()
+        report = CampaignRunner(tmp_path / "camp").run()
+        assert report["units_run"] == 0
+        assert report["units_skipped"] == 3
+        assert report["status"] == "complete"
+
+    def test_fault_kill_then_resume_is_bitwise(self, tmp_path):
+        """campaign.run:kill — the in-process face of preemption: the
+        process dies the instant after a unit's result is durable."""
+        twin = _campaign(tmp_path, sub="twin")
+        twin.run()
+        want = result_digest(twin.results())
+
+        env = dict(os.environ)
+        env.pop("PINT_TPU_FAULTS", None)
+        env["PINT_TPU_FAULTS"] = "campaign.run:kill*1"
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        kill = subprocess.run(
+            [sys.executable, "-m", "pint_tpu.campaign", "--dir",
+             str(tmp_path / "killed"), "--demo-chains", "3",
+             "--steps", str(DEMO["nsteps"]), "--walkers",
+             str(DEMO["walkers"]), "--ndim", str(DEMO["ndim"])],
+            cwd=REPO, env=env, capture_output=True, text=True,
+            timeout=240)
+        assert kill.returncode == 70, kill.stderr[-2000:]
+        r = CampaignRunner(tmp_path / "killed")
+        assert r.run()["status"] == "complete"
+        assert result_digest(r.results()) == want
+
+    def test_sigterm_drains_then_resumes_bitwise(self, tmp_path):
+        """SIGTERM mid-campaign = the preemption NOTICE: finish the
+        unit in flight, snapshot, report ``preempted``."""
+        twin = _campaign(tmp_path, sub="twin")
+        twin.run()
+        want = result_digest(twin.results())
+
+        r = _campaign(tmp_path, sub="drained")
+
+        def _preempt(u, result):
+            os.kill(os.getpid(), signal.SIGTERM)
+
+        report = r.run(progress=_preempt)
+        assert report["status"] == "preempted"
+        assert report["units_run"] == 1
+        # the drain snapshot is on disk and the probe sees it
+        st = campaign_status(tmp_path / "drained")
+        assert st["status"] == "preempted"
+        assert st["units_done"] == 1
+        r2 = CampaignRunner(tmp_path / "drained")
+        assert r2.run()["status"] == "complete"
+        assert result_digest(r2.results()) == want
+
+
+class TestAtomicCheckpoints:
+    """The shared crc-framed atomic writer under injected kill/corrupt
+    — covering BOTH its stores: campaign results/snapshots and the
+    fleet's session-checkpoint files (same ``_write_checkpoint``)."""
+
+    def test_corrupt_result_is_quarantined_and_rerun(self, tmp_path):
+        twin = _campaign(tmp_path, sub="twin")
+        twin.run()
+        want = result_digest(twin.results())
+
+        faults.arm("campaign.checkpoint", "corrupt", 1)
+        r = _campaign(tmp_path, sub="corrupted")
+        r.run()                            # unit 1's result is garbage
+        faults.reset()
+        degrade.reset_ledger()
+        r2 = CampaignRunner(tmp_path / "corrupted")
+        report = r2.run()
+        assert report["status"] == "complete"
+        kinds = {e.kind for e in degrade.events()}
+        assert "campaign.checkpoint_corrupt" in kinds
+        q = list((tmp_path / "corrupted" / "results" /
+                  "quarantine").glob("*.ckpt"))
+        assert len(q) == 1                 # preserved, never restored
+        assert result_digest(r2.results()) == want
+
+    def test_corrupt_snapshot_falls_back_a_generation(self, tmp_path):
+        r = _campaign(tmp_path, checkpoint_every=1, keep=3)
+        r.run()
+        snaps = sorted((tmp_path / "camp" / "snapshots").glob("*.ckpt"))
+        assert len(snaps) == 3             # pruned to keep
+        # bit-flip the NEWEST under its valid-looking frame
+        blob = bytearray(snaps[-1].read_bytes())
+        blob[-1] ^= 0xFF
+        snaps[-1].write_bytes(bytes(blob))
+        # the read-only probe skips it; the runner quarantines it
+        assert campaign_status(tmp_path / "camp")["units_done"] == 3
+        r2 = CampaignRunner(tmp_path / "camp")
+        snap, path = r2._latest_snapshot()
+        assert path == snaps[-2]           # previous generation serves
+        assert snap["done"]
+        assert "campaign.checkpoint_corrupt" in {
+            e.kind for e in degrade.events()}
+
+    def test_kill_mid_write_leaves_previous_generation(self, tmp_path):
+        """``campaign.checkpoint:kill`` — die INSIDE the writer, tmp
+        half-written: the rename never happened, generation N-1 loads
+        clean, and a fresh run resumes to the twin's digest. Run
+        against the session-checkpoint layout too: same writer, same
+        guarantee."""
+        script = r"""
+import os, sys
+from pathlib import Path
+from pint_tpu.serve.recover import _write_checkpoint
+from pint_tpu.testing import faults
+d = Path(sys.argv[1])
+# generation 1 lands clean in both stores
+_write_checkpoint(d / "snapshot-000001.ckpt", {"gen": 1})
+_write_checkpoint(d / "session.ckpt", {"params": [1.0, 2.0]})
+faults.arm("campaign.checkpoint", "kill", 1)
+_write_checkpoint(d / "snapshot-000002.ckpt", {"gen": 2})
+print("UNREACHABLE")
+"""
+        d = tmp_path / "store"
+        d.mkdir()
+        env = dict(os.environ)
+        env.pop("PINT_TPU_FAULTS", None)
+        proc = subprocess.run([sys.executable, "-c", script, str(d)],
+                              cwd=REPO, env=env, capture_output=True,
+                              text=True, timeout=120)
+        assert proc.returncode == 70, proc.stderr[-2000:]
+        assert "UNREACHABLE" not in proc.stdout
+        # the torn tmp is debris; the renamed generations are intact
+        assert (d / "snapshot-000002.tmp").exists()
+        assert not (d / "snapshot-000002.ckpt").exists()
+        assert _read_checkpoint(d / "snapshot-000001.ckpt") == {"gen": 1}
+        assert _read_checkpoint(d / "session.ckpt") == {
+            "params": [1.0, 2.0]}
+
+    def test_kill_mid_campaign_snapshot_resumes_clean(self, tmp_path):
+        twin = _campaign(tmp_path, sub="twin")
+        twin.run()
+        want = result_digest(twin.results())
+
+        env = dict(os.environ)
+        env.pop("PINT_TPU_FAULTS", None)
+        # fire on the FIRST checkpoint write = unit 1's result: the
+        # campaign dies with nothing durable but the manifest
+        env["PINT_TPU_FAULTS"] = "campaign.checkpoint:kill*1"
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        args = [sys.executable, "-m", "pint_tpu.campaign", "--dir",
+                str(tmp_path / "killed"), "--demo-chains", "3",
+                "--steps", str(DEMO["nsteps"]), "--walkers",
+                str(DEMO["walkers"]), "--ndim", str(DEMO["ndim"])]
+        kill = subprocess.run(args, cwd=REPO, env=env,
+                              capture_output=True, text=True, timeout=240)
+        assert kill.returncode == 70, kill.stderr[-2000:]
+        tmps = list((tmp_path / "killed" / "results").glob("*.tmp"))
+        assert len(tmps) == 1              # the torn write
+        r = CampaignRunner(tmp_path / "killed")
+        report = r.run()
+        assert report["status"] == "complete"
+        assert report["units_run"] == 3    # nothing was durable
+        assert result_digest(r.results()) == want
+
+
+class TestStatusProbe:
+    def test_probe_reads_progress_without_mutating(self, tmp_path):
+        r = _campaign(tmp_path, checkpoint_every=1)
+        r.run(max_units=2)
+        st = campaign_status(tmp_path / "camp")
+        assert st["units_done"] == 2 and st["units_total"] == 3
+        assert st["status"] == "paused"
+        assert st["checkpoint_age_s"] is not None
+        assert st["eta_s"] is not None and st["eta_s"] > 0
+        # read-only: probing twice changes nothing on disk
+        files = sorted(p.name for p in
+                       (tmp_path / "camp").rglob("*") if p.is_file())
+        campaign_status(tmp_path / "camp")
+        assert sorted(p.name for p in
+                      (tmp_path / "camp").rglob("*") if p.is_file()) == files
+
+    def test_status_cli_json(self, tmp_path):
+        from pint_tpu.scripts.status import main as status_main
+
+        _campaign(tmp_path).run()
+        rc = status_main(["--campaign", str(tmp_path / "camp"), "--json"])
+        assert rc == 0
+
+    def test_gauges_export_progress(self, tmp_path):
+        from pint_tpu.obs import metrics
+
+        r = _campaign(tmp_path)
+        r.run(max_units=1)
+        text = metrics.registry().render()
+        assert "campaign_units_total 3" in text
+        assert "campaign_units_done 1" in text
+        assert "campaign_checkpoint_age_s" in text
+        assert "campaign_eta_s" in text
+
+
+class TestKillMidCampaignDrill:
+    """The ISSUE-19 acceptance drill: SIGKILL a sampling campaign
+    subprocess between checkpoints; a fresh process resumes; the final
+    chain states are bitwise-equal to an uninterrupted twin."""
+
+    def test_sigkill_then_fresh_process_resume_is_bitwise(self, tmp_path):
+        env = dict(os.environ)
+        for var in ("PINT_TPU_FAULTS", "PINT_TPU_DEGRADED",
+                    "PINT_TPU_EXPECT_WARM"):
+            env.pop(var, None)
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        args = ["--demo-chains", "3", "--steps", str(DEMO["nsteps"]),
+                "--walkers", str(DEMO["walkers"]),
+                "--ndim", str(DEMO["ndim"])]
+
+        # leg 0: the uninterrupted twin, in its own directory
+        twin = subprocess.run(
+            [sys.executable, "-m", "pint_tpu.campaign", "--dir",
+             str(tmp_path / "twin"), *args],
+            cwd=REPO, env=env, capture_output=True, text=True, timeout=240)
+        assert twin.returncode == 0, twin.stderr[-2000:]
+        twin_res = json.loads(
+            [ln for ln in twin.stdout.splitlines()
+             if ln.startswith("RESULT::")][-1][len("RESULT::"):])
+
+        # leg 1: SIGKILL between checkpoints — the worker stalls after
+        # each durable unit (--unit-sleep) so the kill signal lands
+        # with unit 1 on disk and units 2..N not started
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "pint_tpu.campaign", "--dir",
+             str(tmp_path / "drill"), "--unit-sleep", "120", *args],
+            cwd=REPO, env=env, stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE, text=True)
+        try:
+            line = proc.stdout.readline()
+            while line and not line.startswith("UNIT::"):
+                line = proc.stdout.readline()
+            assert line.startswith("UNIT::"), "worker died pre-unit"
+        finally:
+            proc.kill()                    # SIGKILL: no drain, no notice
+        proc.wait(timeout=30)
+        assert proc.returncode == -signal.SIGKILL
+        assert len(list(
+            (tmp_path / "drill" / "results").glob("*.ckpt"))) == 1
+
+        # leg 2: a genuinely fresh process resumes to completion
+        resume = subprocess.run(
+            [sys.executable, "-m", "pint_tpu.campaign", "--dir",
+             str(tmp_path / "drill"), *args],
+            cwd=REPO, env=env, capture_output=True, text=True, timeout=240)
+        assert resume.returncode == 0, resume.stderr[-2000:]
+        res = json.loads(
+            [ln for ln in resume.stdout.splitlines()
+             if ln.startswith("RESULT::")][-1][len("RESULT::"):])
+
+        # bitwise: the assembled digest equals the never-killed twin's
+        assert res["digest"] == twin_res["digest"]
+        assert res["status"] == "complete"
+        assert res["units_skipped"] >= 1   # the durable unit was reused
+        # the resume is ledger-visible
+        assert "campaign.resumed" in res["degradations"]
+        assert res["resumes"] == 1
+        # >= 90% of campaign wall attributed to named components
+        b = res["breakdown"]
+        attributed = 1.0 - b["campaign_other_s"] / b["campaign_wall_s"]
+        assert attributed >= 0.90, b
+        # the probe agrees from a third process's point of view
+        st = campaign_status(tmp_path / "drill")
+        assert st["status"] == "complete"
+        assert st["resumes"] == 1

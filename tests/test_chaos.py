@@ -1,0 +1,196 @@
+"""Composable chaos schedules (pint_tpu/testing/chaos.py) — ISSUE 19.
+
+- a randomized schedule is a pure function of its seed (a failed soak
+  replays exactly);
+- ``explained_kinds`` inverts the KIND_DRILLS taxonomy — including
+  one-site-many-kinds entries (``serve.dispatch:fail`` explains both
+  ``serve.retry`` and ``serve.quarantine``);
+- invariant monitors go red on the exact things they watch: an
+  unexplained ledger kind, a lost request, a parity drift, a warm-start
+  trace;
+- the in-process multi-fault soak: a campaign disturbed by a composed
+  corrupt-checkpoint + journal-disk-full timeline completes, resumes,
+  and lands BITWISE on the undisturbed twin with every monitor green.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from pint_tpu.campaign import CampaignRunner, chain_units, result_digest
+from pint_tpu.ops import degrade
+from pint_tpu.testing import faults
+from pint_tpu.testing.chaos import (ChaosEvent, ChaosSchedule,
+                                    check_invariants, ledger_explained,
+                                    parity_within, requests_lost_zero,
+                                    traces_on_warm_zero)
+
+MENU = [("serve.admit", "shed"), ("serve.pool", "evict"),
+        ("serve.journal", "enospc"), ("serve.dispatch", "fail")]
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    degrade.reset_ledger()
+    faults.reset()
+    yield
+    degrade.reset_ledger()
+    faults.reset()
+
+
+class TestScheduleDeterminism:
+    def test_same_seed_same_timeline(self):
+        a = ChaosSchedule.randomized(99, MENU, 10.0, 8,
+                                     targets=[None, "http://x"])
+        b = ChaosSchedule.randomized(99, MENU, 10.0, 8,
+                                     targets=[None, "http://x"])
+        assert [(e.t_offset_s, e.spec, e.target) for e in a.events] == \
+               [(e.t_offset_s, e.spec, e.target) for e in b.events]
+
+    def test_different_seed_different_timeline(self):
+        a = ChaosSchedule.randomized(1, MENU, 10.0, 8)
+        b = ChaosSchedule.randomized(2, MENU, 10.0, 8)
+        assert [(e.t_offset_s, e.spec) for e in a.events] != \
+               [(e.t_offset_s, e.spec) for e in b.events]
+
+    def test_events_sorted_and_bounded(self):
+        s = ChaosSchedule.randomized(5, MENU, 3.0, 16)
+        offs = [e.t_offset_s for e in s.events]
+        assert offs == sorted(offs)
+        assert all(0.0 <= t < 3.0 for t in offs)
+
+
+class TestExplainedKinds:
+    def test_inversion_covers_multi_kind_sites(self):
+        s = ChaosSchedule([ChaosEvent(0.0, "serve.dispatch", "fail")])
+        assert s.explained_kinds() == {"serve.retry", "serve.quarantine"}
+
+    def test_campaign_and_journal_sites(self):
+        s = ChaosSchedule([
+            ChaosEvent(0.0, "serve.journal", "enospc"),
+            ChaosEvent(0.1, "campaign.run", "kill"),
+            ChaosEvent(0.2, "campaign.checkpoint", "corrupt"),
+        ])
+        assert s.explained_kinds() == {
+            "serve.journal_full", "campaign.resumed",
+            "campaign.checkpoint_corrupt"}
+
+    def test_unscheduled_mode_explains_nothing(self):
+        s = ChaosSchedule([ChaosEvent(0.0, "serve.journal", "torn")])
+        assert s.explained_kinds() == {"serve.journal_truncated"}
+
+
+class TestTimeline:
+    def test_arm_now_is_immediate_and_ordered(self):
+        s = ChaosSchedule([ChaosEvent(1.0, "serve.admit", "shed"),
+                           ChaosEvent(0.0, "serve.pool", "evict")])
+        s.arm_now()
+        assert faults.armed("serve.admit") and faults.armed("serve.pool")
+        assert [spec for _, spec, _ in s.armed_log] == [
+            "serve.pool:evict*1", "serve.admit:shed*1"]
+
+    def test_start_fires_on_offsets(self):
+        s = ChaosSchedule([ChaosEvent(0.0, "serve.admit", "shed"),
+                           ChaosEvent(0.15, "serve.pool", "evict")])
+        s.start()
+        deadline = time.monotonic() + 5.0
+        while len(s.armed_log) < 2 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert len(s.armed_log) == 2
+        assert faults.armed("serve.pool")
+
+    def test_stop_cancels_the_remainder(self):
+        s = ChaosSchedule([ChaosEvent(30.0, "serve.admit", "shed")])
+        s.start()
+        s.stop()
+        assert not s.armed_log
+        assert not faults.armed("serve.admit")
+
+
+class TestInvariants:
+    def test_ledger_explained_green_and_red(self):
+        s = ChaosSchedule([ChaosEvent(0.0, "serve.journal", "enospc")])
+        degrade.record("serve.journal_full", "j", "scheduled fault",
+                       fix="free space")
+        green, res = check_invariants({"ledger": ledger_explained(s)})
+        assert green, res
+        degrade.record("serve.evict", "pool", "NOT scheduled",
+                       fix="n/a")
+        green, res = check_invariants({"ledger": ledger_explained(s)})
+        assert not green
+        assert "serve.evict" in res["ledger"][1]
+        # an explicit allowance turns it green again
+        green, _ = check_invariants({
+            "ledger": ledger_explained(s, allowed=("serve.evict",))})
+        assert green
+
+    def test_requests_lost_zero(self):
+        ok, _ = requests_lost_zero([{"requests_lost": 0},
+                                    {"requests_lost": 0}])
+        assert ok
+        ok, detail = requests_lost_zero([{"requests_lost": 0},
+                                         {"requests_lost": 2}])
+        assert not ok and "2" in detail
+
+    def test_traces_on_warm_zero(self):
+        ok, _ = traces_on_warm_zero([{"traces_on_warm": 0}])
+        assert ok
+        ok, detail = traces_on_warm_zero([{"traces_on_warm": 3}])
+        assert not ok and "3" in detail
+
+    def test_parity_within(self):
+        a = {"fit": {"params": np.array([1.0, 2.0])},
+             "n": np.array([3])}
+        ok, _ = parity_within(a, {"fit": {"params": np.array([1.0, 2.0])},
+                                  "n": np.array([3])}, tol=0.0)
+        assert ok
+        ok, detail = parity_within(
+            a, {"fit": {"params": np.array([1.0, 2.0 + 1e-8])},
+                "n": np.array([3])}, tol=1e-10)
+        assert not ok and "fit.params" in detail
+        ok, detail = parity_within(a, {"fit": {}, "n": np.array([3])})
+        assert not ok and "mismatch" in detail
+
+
+class TestMultiFaultSoak:
+    def test_campaign_survives_composed_chaos_bitwise(self, tmp_path):
+        """Two concurrent fault kinds against one campaign process: the
+        first unit's durable result is corrupted under a valid frame
+        AND the campaign ledger's journal hits disk-full. The campaign
+        still completes, the resume quarantines + re-runs, and assembly
+        is bitwise-identical to the undisturbed twin — with every
+        ledger kind explained by the schedule."""
+        demo = dict(ndim=2, walkers=6, nsteps=8)
+        twin = CampaignRunner(tmp_path / "twin", chain_units(3, 7, **demo))
+        twin.run()
+        want = twin.results()
+
+        schedule = ChaosSchedule([
+            ChaosEvent(0.0, "campaign.checkpoint", "corrupt"),
+            ChaosEvent(0.0, "serve.journal", "enospc"),
+        ]).arm_now()
+        disturbed = CampaignRunner(tmp_path / "dist",
+                                   chain_units(3, 7, **demo))
+        report = disturbed.run()
+        assert report["status"] == "complete"
+        # the ledger-full shed is on the degradation ledger, and the
+        # shed marker did NOT kill the campaign
+        assert "serve.journal_full" in {e.kind for e in degrade.events()}
+
+        # a fresh process notices the corrupt result, quarantines it,
+        # re-runs the unit — and the assembly matches the twin to 0
+        resumed = CampaignRunner(tmp_path / "dist")
+        assert resumed.run()["status"] == "complete"
+        kinds = {e.kind for e in degrade.events()}
+        assert "campaign.checkpoint_corrupt" in kinds
+        ok, detail = parity_within(resumed.results(), want, tol=0.0)
+        assert ok, detail
+
+        green, res = check_invariants({
+            "ledger_explained": ledger_explained(
+                schedule, allowed=("campaign.resumed",)),
+            "parity": lambda: parity_within(resumed.results(), want,
+                                            tol=0.0),
+        })
+        assert green, res

@@ -99,6 +99,7 @@ def test_robustness_walkthrough_runs(tmp_path, monkeypatch):
         faults.reset()
 
 
+@pytest.mark.slow
 def test_performance_walkthrough_runs(tmp_path, monkeypatch):
     """docs/PERFORMANCE.md is executable WITHOUT reference data or
     network (synthetic TOAs, isolated cache dir) and runs in tier-1:

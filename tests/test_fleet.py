@@ -548,3 +548,51 @@ class TestStatusFleetUnreachable:
         assert out["mode"] == "fleet"
         assert out["unreachable"] == 1
         assert out["replicas"][0]["reachable"] is False
+
+
+class TestDegradedFleetStart:
+    """Startup robustness (ISSUE 19): a replica that HANGS before its
+    READY:: handshake (serve.ready:hang) is reaped at the
+    PINT_TPU_FLEET_READY_TIMEOUT_S deadline, one that dies early
+    (serve.ready:exit) is reaped immediately — either way the fleet
+    STARTS DEGRADED at the survivors, with ``serve.replica_lost`` on
+    the ledger and routing covering only live replicas."""
+
+    def test_hang_and_death_start_degraded(self, tmp_path, monkeypatch):
+        from pint_tpu.serve.fleet import ReplicaFleet
+
+        monkeypatch.setenv("PINT_TPU_FLEET_READY_TIMEOUT_S", "3")
+        fleet = ReplicaFleet(tmp_path, names=["good", "wedged", "dead"])
+        try:
+            ready = fleet.spawn_all(per_replica_env={
+                "wedged": {"PINT_TPU_FAULTS": "serve.ready:hang*1"},
+                "dead": {"PINT_TPU_FAULTS": "serve.ready:exit*1"},
+            })
+            # degraded start: the survivor serves, the lost names left
+            # the routing set
+            assert sorted(ready) == ["good"]
+            assert fleet.names == ["good"]
+            assert ready["good"]["sessions"] == 0
+            lost = [e for e in degrade.events()
+                    if e.kind == "serve.replica_lost"]
+            assert {e.component for e in lost} == {
+                "replica:wedged", "replica:dead"}
+            # the failure *shapes* are distinguished in the details
+            details = {e.component: e.detail for e in lost}
+            assert "hung past" in details["replica:wedged"]
+            assert "died before" in details["replica:dead"]
+            # no zombie: the wedged worker was reaped at the deadline
+            assert all(info["proc"].poll() is not None
+                       for name, info in fleet.procs.items()
+                       if name != "good")
+        finally:
+            fleet.stop_all()
+
+    def test_no_replica_ready_refuses(self, tmp_path, monkeypatch):
+        from pint_tpu.serve.fleet import ReplicaFleet
+
+        monkeypatch.setenv("PINT_TPU_FLEET_READY_TIMEOUT_S", "3")
+        fleet = ReplicaFleet(tmp_path, names=["r0"])
+        with pytest.raises(RuntimeError, match="no replica"):
+            fleet.spawn_all(per_replica_env={
+                "r0": {"PINT_TPU_FAULTS": "serve.ready:exit*1"}})

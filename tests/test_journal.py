@@ -224,3 +224,37 @@ class TestFailureModes:
         st = j.stats()
         assert st["appended"] == 1 and st["segments"] == 1
         assert st["bytes"] > 0 and st["fsync_every"] == 4
+
+
+class TestDiskFull:
+    """ENOSPC is a SHED, not a crash: the write is refused un-acked
+    with ``serve.journal_full`` on the ledger (the gateway's
+    JournalError -> 503 mapping), reads keep serving, and writes resume
+    the moment an append succeeds — nothing latches."""
+
+    def test_enospc_sheds_then_recovers(self, tmp_path):
+        j = RequestJournal(tmp_path, fsync_every=1)
+        j.append(_rec(0))
+        faults.arm("serve.journal", "enospc", times=1)
+        with pytest.raises(JournalError, match="disk full"):
+            j.append(_rec(1))
+        assert [e.kind for e in degrade.events()] == ["serve.journal_full"]
+        # reads continue: the successful record still replays...
+        records, _ = replay_records(tmp_path)
+        assert [r["idem"] for r in records] == ["k0"]
+        # ...and the journal is NOT latched: the next append lands
+        j.append(_rec(2))
+        j.close(clean=True)
+        records, report = replay_records(tmp_path)
+        assert [r["idem"] for r in records
+                if r["op"] == "request"] == ["k0", "k2"]
+        assert report["clean_close"]
+
+    def test_enospc_refused_under_degraded_error(self, tmp_path,
+                                                 monkeypatch):
+        monkeypatch.setenv("PINT_TPU_DEGRADED", "error")
+        j = RequestJournal(tmp_path, fsync_every=1)
+        faults.arm("serve.journal", "enospc", times=1)
+        with pytest.raises(degrade.DegradedError,
+                           match="serve.journal_full"):
+            j.append(_rec(0))

@@ -440,6 +440,7 @@ class TestKernelSmokeContracts:
         assert rec["audit"]["n_violations"] == 0, rec["audit"]
         assert rec["aot_fallbacks"] == 0
 
+    @pytest.mark.slow
     def test_flagship_smoke_kernel_warm_cache(self, tmp_path, monkeypatch):
         """The flagship acceptance shape at tier-1 budget: with a WARM
         kernel-pack cache the window-build stage collapses to a cache

@@ -211,6 +211,7 @@ class TestChains:
         # masked divergences: the solo run's divergence count is chain 2's
         assert solo.divergences <= fleet.divergences
 
+    @pytest.mark.slow
     def test_vmapped_equals_solo_stretch(self, full_nl):
         nl = full_nl
         fleet = nl.sample(n_chains=3, nsteps=40, kernel="stretch", seed=7)
@@ -219,6 +220,7 @@ class TestChains:
         d = np.abs(solo.samples[0] - ref) / np.maximum(np.abs(ref), 1e-300)
         assert d.max() <= 1e-10
 
+    @pytest.mark.slow
     def test_fleet_member_parity(self):
         """B-pulsar fleet: member 0 of a 2-member fleet == the 1-member
         fleet of the same dataset (identical bucket layout), <= 1e-10 —
@@ -287,6 +289,7 @@ class TestShardedParity:
         np.testing.assert_array_equal(r1.samples, r8.samples)
 
 
+@pytest.mark.slow
 def test_recovery_harness_tier1(monkeypatch):
     """The ISSUE-8 acceptance harness at tier-1 scale: inject powerlaw
     red noise, recover the (log10_A, gamma) posterior with vmapped HMC
@@ -321,6 +324,7 @@ GPS2UTC = """# gps2utc.clk
 
 
 class TestNoiseBenchContract:
+    @pytest.mark.slow
     def test_smoke_noise_bench_contract(self, tmp_path, monkeypatch):
         """bench.py --smoke --noise tier-1 contract: strict-clean jaxpr
         audit over every noise program, empty degradation ledger under

@@ -184,6 +184,7 @@ class TestPrepareTelemetry:
         assert bd["prepare_ephemeris_s"] > 0
         assert bd["prepare_geometry_s"] > 0
 
+    @pytest.mark.slow
     def test_nbody_build_is_counted(self, monkeypatch, nbody_cache_dir):
         monkeypatch.setenv("PINT_TPU_CACHE_DIR", nbody_cache_dir)
         monkeypatch.setenv("PINT_TPU_NBODY", "1")
@@ -216,7 +217,10 @@ class TestDevicePrepareParity:
         args = _inputs(n=48)
         return prepare_arrays(*args, planets=True)
 
-    @pytest.mark.parametrize("nbody", ["0", "1"])
+    # the "1" leg pays the one-time ~60 s N-body window build (shared
+    # via nbody_cache_dir with the other slow-marked N-body tests)
+    @pytest.mark.parametrize(
+        "nbody", ["0", pytest.param("1", marks=pytest.mark.slow)])
     def test_columns_match_host(self, monkeypatch, nbody, nbody_cache_dir):
         monkeypatch.setenv("PINT_TPU_CACHE_DIR", nbody_cache_dir)
         host = self._columns(monkeypatch, "0", nbody)

@@ -348,6 +348,7 @@ class TestSharded:
             == ptas._plain_data["slot"].shape
         assert pta1._aot_base() == ptas._aot_base()
 
+    @pytest.mark.slow
     def test_array_scale_sharded_parity_n64(self):
         """The array-scale operand plan (ISSUE-17 tentpole): a
         64-pulsar RAGGED array on the forced 8-device `pta_mesh` must
@@ -615,6 +616,7 @@ class TestPtaBenchContract:
         assert rec["degradation_count"] == 0
         assert rec["degradation_kinds"] == []
 
+    @pytest.mark.slow
     def test_smoke_pta_bench_contract_n64(self, tmp_path, monkeypatch):
         """The SAME telemetry contract at the ISSUE-17 array-scale
         smoke shape: N=64 pulsars sharded 8 ways on the tier-1 virtual
@@ -704,6 +706,7 @@ def test_recovery_harness_tier1():
     assert full["verdict"]["hd_correlations_detected"], full["verdict"]
 
 
+@pytest.mark.slow
 def test_detection_harness_tier1():
     """The ISSUE-17 detection harness at tier-1 scale: one null (no
     GWB) and one loudly-injected realization through the fused

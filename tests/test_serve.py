@@ -764,6 +764,7 @@ def _write_clock_dir(path):
 
 
 class TestServeBenchContract:
+    @pytest.mark.slow
     def test_smoke_serve_bench_contract(self, tmp_path, monkeypatch):
         """The --smoke --serve acceptance surface (ISSUE 13): >=2x the
         serial drain, >=90% attribution, EMPTY nominal ledger under
